@@ -1,0 +1,94 @@
+#!/usr/bin/env python3
+"""Benchmark driver: prints ONE JSON line.
+
+Headline metric (mirrors the reference's headline echo benchmark,
+docs/cn/benchmark.md:104 — 2.3 GB/s echo throughput on loopback): large-
+payload echo throughput through the full stack (client Channel -> framed
+protocol -> Socket -> loopback TCP -> Server -> echo service -> response),
+measured by the C++ `echo_bench` tool once the RPC slice exists.
+
+Falls back to the IOBuf zero-copy pipeline microbench while the full slice
+is under construction, and to 0 if nothing is built.
+"""
+import json
+import subprocess
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent
+BUILD = REPO / "build"
+
+BASELINE_MBPS = 2300.0  # reference echo throughput (BASELINE.md: 2.3 GB/s)
+
+
+def build():
+    BUILD.mkdir(exist_ok=True)
+    if not (BUILD / "build.ninja").exists():
+        subprocess.run(
+            ["cmake", "-G", "Ninja", "-S", str(REPO), "-B", str(BUILD)],
+            check=True, capture_output=True,
+        )
+    subprocess.run(
+        ["ninja", "-C", str(BUILD)], check=True, capture_output=True
+    )
+
+
+def run_tool(name, args):
+    exe = BUILD / name
+    if not exe.exists():
+        return None
+    proc = subprocess.run(
+        [str(exe)] + args, capture_output=True, text=True, timeout=300
+    )
+    if proc.returncode != 0:
+        return None
+    for line in proc.stdout.splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                continue
+    return None
+
+
+def main():
+    try:
+        build()
+    except Exception:
+        print(json.dumps({
+            "metric": "echo_throughput", "value": 0, "unit": "MB/s",
+            "vs_baseline": 0.0, "error": "build failed",
+        }))
+        return
+    result = run_tool("echo_bench", ["--json"])
+    if result is not None and "mbps" in result:
+        mbps = float(result["mbps"])
+        out = {
+            "metric": "echo_throughput_1MB_loopback",
+            "value": round(mbps, 1),
+            "unit": "MB/s",
+            "vs_baseline": round(mbps / BASELINE_MBPS, 3),
+        }
+        for k in ("qps_4k", "p99_us_4k"):
+            if k in result:
+                out[k] = result[k]
+        print(json.dumps(out))
+        return
+    result = run_tool("iobuf_bench", ["--json"])
+    if result is not None and "mbps" in result:
+        mbps = float(result["mbps"])
+        print(json.dumps({
+            "metric": "iobuf_pipeline_throughput",
+            "value": round(mbps, 1),
+            "unit": "MB/s",
+            "vs_baseline": round(mbps / BASELINE_MBPS, 3),
+        }))
+        return
+    print(json.dumps({
+        "metric": "echo_throughput", "value": 0, "unit": "MB/s",
+        "vs_baseline": 0.0, "error": "no bench tool built",
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,9 @@
+"""Collective data plane: combo-channel fan-out lowered to XLA collectives.
+
+The reference's ParallelChannel (src/brpc/parallel_channel.h:94) fans one RPC
+out to N sub-channels and merges responses; PartitionChannel
+(src/brpc/partition_channel.h:34) shards by partition tag. On TPU the regular
+cases of these patterns lower to mesh collectives (all_gather /
+psum / reduce_scatter over ICI) instead of per-peer socket writes — the
+BASELINE.json north star.
+"""

@@ -1,0 +1,110 @@
+// DoublyBufferedData: RCU-like read-mostly store. Readers take a
+// thread-local mutex (uncontended in steady state = near-free); the writer
+// modifies the background copy, flips the index, then serializes with every
+// reader by locking each thread-local mutex once.
+//
+// Modeled on reference src/butil/containers/doubly_buffered_data.h:39-68.
+// Backs load-balancer server lists (read on every RPC, written on naming
+// updates).
+#pragma once
+
+#include <atomic>
+#include <cstdint>
+#include <memory>
+#include <mutex>
+#include <vector>
+
+namespace tpurpc {
+
+template <typename T>
+class DoublyBufferedData {
+    struct Wrapper;
+
+public:
+    class ScopedPtr {
+    public:
+        ScopedPtr() : data_(nullptr), w_(nullptr) {}
+        ~ScopedPtr() {
+            if (w_) w_->mu.unlock();
+        }
+        ScopedPtr(const ScopedPtr&) = delete;
+        ScopedPtr& operator=(const ScopedPtr&) = delete;
+        const T* get() const { return data_; }
+        const T& operator*() const { return *data_; }
+        const T* operator->() const { return data_; }
+
+    private:
+        friend class DoublyBufferedData;
+        const T* data_;
+        Wrapper* w_;
+    };
+
+    DoublyBufferedData() : index_(0) {}
+
+    // Read access; holds the thread-local lock for the scope of *ptr.
+    int Read(ScopedPtr* ptr) {
+        Wrapper* w = tls_wrapper();
+        w->mu.lock();
+        ptr->w_ = w;
+        ptr->data_ = &data_[index_.load(std::memory_order_acquire)];
+        return 0;
+    }
+
+    // Modify both copies with fn(T&) -> bool (false aborts before flip).
+    template <typename Fn>
+    size_t Modify(Fn&& fn) {
+        std::lock_guard<std::mutex> g(modify_mu_);
+        const int bg = 1 - index_.load(std::memory_order_relaxed);
+        if (!fn(data_[bg])) return 0;
+        index_.store(bg, std::memory_order_release);
+        // Wait for readers of the old foreground: lock each reader mutex
+        // once. After this loop no reader can be using the old copy.
+        {
+            std::lock_guard<std::mutex> wg(wrappers_mu_);
+            for (auto& w : wrappers_) {
+                w->mu.lock();
+                w->mu.unlock();
+            }
+        }
+        // Apply the same change to the (now background) old copy.
+        fn(data_[1 - bg]);
+        return 1;
+    }
+
+private:
+    struct Wrapper {
+        std::mutex mu;
+    };
+
+    // One wrapper per (thread, instance), keyed by a never-reused instance
+    // uid rather than `this` — a destroyed instance's address can be reused
+    // by a successor, and a raw-pointer key would hand the new instance a
+    // dangling Wrapper from the old one's registry.
+    Wrapper* tls_wrapper() {
+        thread_local std::vector<std::pair<uint64_t, Wrapper*>> map;
+        for (auto& p : map) {
+            if (p.first == uid_) return p.second;
+        }
+        auto* nw = new Wrapper;
+        {
+            std::lock_guard<std::mutex> g(wrappers_mu_);
+            wrappers_.emplace_back(nw);
+        }
+        map.emplace_back(uid_, nw);
+        return nw;
+    }
+
+    static uint64_t next_uid() {
+        static std::atomic<uint64_t> c{1};
+        return c.fetch_add(1, std::memory_order_relaxed);
+    }
+
+    const uint64_t uid_ = next_uid();
+    T data_[2];
+    std::atomic<int> index_;
+    std::mutex modify_mu_;
+    std::mutex wrappers_mu_;
+    std::vector<std::unique_ptr<Wrapper>> wrappers_;
+};
+
+}  // namespace tpurpc

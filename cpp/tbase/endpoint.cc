@@ -1,0 +1,93 @@
+#include "tbase/endpoint.h"
+
+#include <arpa/inet.h>
+#include <netdb.h>
+
+#include <cstdio>
+#include <cstring>
+
+namespace tpurpc {
+
+int str2endpoint(const char* ip_str, int port, EndPoint* ep) {
+    if (port < 0 || port > 65535) return -1;
+    in_addr ip;
+    if (strcmp(ip_str, "0.0.0.0") == 0 || ip_str[0] == '\0') {
+        ip.s_addr = INADDR_ANY;
+    } else if (inet_aton(ip_str, &ip) == 0) {
+        return -1;
+    }
+    ep->ip = ip;
+    ep->port = port;
+    return 0;
+}
+
+int str2endpoint(const char* str, EndPoint* ep) {
+    const char* colon = strrchr(str, ':');
+    if (colon == nullptr) return -1;
+    char ip_buf[64];
+    size_t ip_len = (size_t)(colon - str);
+    if (ip_len >= sizeof(ip_buf)) return -1;
+    memcpy(ip_buf, str, ip_len);
+    ip_buf[ip_len] = '\0';
+    char* end = nullptr;
+    long port = strtol(colon + 1, &end, 10);
+    if (end == colon + 1 || *end != '\0') return -1;
+    return str2endpoint(ip_buf, (int)port, ep);
+}
+
+int hostname2endpoint(const char* str, EndPoint* ep) {
+    const char* colon = strrchr(str, ':');
+    std::string host = colon ? std::string(str, colon - str) : std::string(str);
+    int port = 0;
+    if (colon) {
+        char* end = nullptr;
+        long p = strtol(colon + 1, &end, 10);
+        // Same validation as str2endpoint: reject junk and out-of-range
+        // ports here too, or "host:99999" would silently truncate via
+        // htons later.
+        if (end == colon + 1 || *end != '\0' || p < 0 || p > 65535) return -1;
+        port = (int)p;
+    }
+    // Fast path: already an IP literal.
+    if (str2endpoint(host.c_str(), port, ep) == 0) return 0;
+    addrinfo hints{};
+    hints.ai_family = AF_INET;
+    hints.ai_socktype = SOCK_STREAM;
+    addrinfo* result = nullptr;
+    if (getaddrinfo(host.c_str(), nullptr, &hints, &result) != 0) return -1;
+    int rc = -1;
+    for (addrinfo* ai = result; ai; ai = ai->ai_next) {
+        if (ai->ai_family == AF_INET) {
+            ep->ip = ((sockaddr_in*)ai->ai_addr)->sin_addr;
+            ep->port = port;
+            rc = 0;
+            break;
+        }
+    }
+    freeaddrinfo(result);
+    return rc;
+}
+
+std::string endpoint2str(const EndPoint& ep) {
+    char buf[32];
+    char ip_buf[INET_ADDRSTRLEN];
+    inet_ntop(AF_INET, &ep.ip, ip_buf, sizeof(ip_buf));
+    snprintf(buf, sizeof(buf), "%s:%d", ip_buf, ep.port);
+    return buf;
+}
+
+void endpoint2sockaddr(const EndPoint& ep, sockaddr_in* out) {
+    memset(out, 0, sizeof(*out));
+    out->sin_family = AF_INET;
+    out->sin_addr = ep.ip;
+    out->sin_port = htons((uint16_t)ep.port);
+}
+
+EndPoint sockaddr2endpoint(const sockaddr_in& in) {
+    EndPoint ep;
+    ep.ip = in.sin_addr;
+    ep.port = ntohs(in.sin_port);
+    return ep;
+}
+
+}  // namespace tpurpc

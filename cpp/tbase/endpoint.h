@@ -1,0 +1,49 @@
+// EndPoint: ip:port value type with parsing and hostname resolution.
+// Modeled on reference src/butil/endpoint.h (str2endpoint/endpoint2str,
+// hostname2endpoint). IPv4 + unix-domain ("unix:/path") supported.
+#pragma once
+
+#include <netinet/in.h>
+
+#include <cstdint>
+#include <string>
+
+namespace tpurpc {
+
+struct EndPoint {
+    // Host byte order is never exposed: `ip` is in network byte order as in
+    // the reference (butil::ip_t wraps in_addr).
+    in_addr ip{};
+    int port = 0;
+
+    EndPoint() { ip.s_addr = 0; }
+    EndPoint(in_addr i, int p) : ip(i), port(p) {}
+
+    bool operator==(const EndPoint& o) const {
+        return ip.s_addr == o.ip.s_addr && port == o.port;
+    }
+    bool operator!=(const EndPoint& o) const { return !(*this == o); }
+    bool operator<(const EndPoint& o) const {
+        return ip.s_addr != o.ip.s_addr ? ip.s_addr < o.ip.s_addr
+                                        : port < o.port;
+    }
+};
+
+// "10.0.0.1:8000" -> EndPoint. Returns 0 on success, -1 on failure.
+int str2endpoint(const char* str, EndPoint* ep);
+int str2endpoint(const char* ip_str, int port, EndPoint* ep);
+// "www.foo.com:80" -> EndPoint (blocking getaddrinfo).
+int hostname2endpoint(const char* str, EndPoint* ep);
+std::string endpoint2str(const EndPoint& ep);
+
+// sockaddr conversion.
+void endpoint2sockaddr(const EndPoint& ep, sockaddr_in* out);
+EndPoint sockaddr2endpoint(const sockaddr_in& in);
+
+struct EndPointHasher {
+    size_t operator()(const EndPoint& ep) const {
+        return ((size_t)ep.ip.s_addr * 101) ^ (size_t)ep.port;
+    }
+};
+
+}  // namespace tpurpc

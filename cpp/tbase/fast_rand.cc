@@ -1,0 +1,59 @@
+#include "tbase/fast_rand.h"
+
+#include <ctime>
+
+namespace tpurpc {
+
+namespace {
+struct SplitMix64 {
+    uint64_t x;
+    uint64_t next() {
+        uint64_t z = (x += 0x9e3779b97f4a7c15ULL);
+        z = (z ^ (z >> 30)) * 0xbf58476d1ce4e5b9ULL;
+        z = (z ^ (z >> 27)) * 0x94d049bb133111ebULL;
+        return z ^ (z >> 31);
+    }
+};
+
+struct Xoshiro256 {
+    uint64_t s[4];
+    bool seeded = false;
+    static uint64_t rotl(uint64_t x, int k) { return (x << k) | (x >> (64 - k)); }
+    void seed() {
+        struct timespec ts;
+        clock_gettime(CLOCK_MONOTONIC, &ts);
+        SplitMix64 sm{(uint64_t)ts.tv_nsec ^ ((uint64_t)ts.tv_sec << 32) ^
+                      (uint64_t)(uintptr_t)this};
+        for (auto& v : s) v = sm.next();
+        seeded = true;
+    }
+    uint64_t next() {
+        if (!seeded) seed();
+        const uint64_t result = rotl(s[1] * 5, 7) * 9;
+        const uint64_t t = s[1] << 17;
+        s[2] ^= s[0];
+        s[3] ^= s[1];
+        s[1] ^= s[2];
+        s[0] ^= s[3];
+        s[2] ^= t;
+        s[3] = rotl(s[3], 45);
+        return result;
+    }
+};
+
+thread_local Xoshiro256 tls_rng;
+}  // namespace
+
+uint64_t fast_rand() { return tls_rng.next(); }
+
+uint64_t fast_rand_less_than(uint64_t range) {
+    if (range == 0) return 0;
+    // Lemire's multiply-shift rejection-free approximation is fine here.
+    return fast_rand() % range;
+}
+
+double fast_rand_double() {
+    return (double)(fast_rand() >> 11) * (1.0 / 9007199254740992.0);
+}
+
+}  // namespace tpurpc

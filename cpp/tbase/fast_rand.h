@@ -1,0 +1,17 @@
+// Thread-local PRNG (xoshiro256**), the fast_rand of this framework.
+// Modeled on reference src/butil/fast_rand.h: cheap, non-cryptographic,
+// per-thread state so there is never contention.
+#pragma once
+
+#include <cstdint>
+
+namespace tpurpc {
+
+// Uniform in [0, 2^64).
+uint64_t fast_rand();
+// Uniform in [0, range). range == 0 returns 0.
+uint64_t fast_rand_less_than(uint64_t range);
+// Uniform double in [0, 1).
+double fast_rand_double();
+
+}  // namespace tpurpc

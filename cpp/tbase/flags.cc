@@ -1,0 +1,113 @@
+#include "tbase/flags.h"
+
+#include <cstdio>
+#include <cstdlib>
+
+namespace tpurpc {
+
+namespace {
+struct Registry {
+    std::mutex mu;
+    std::vector<FlagBase*> flags;
+};
+Registry* registry() {
+    static Registry* r = new Registry;
+    return r;
+}
+}  // namespace
+
+void RegisterFlag(FlagBase* flag) {
+    Registry* r = registry();
+    std::lock_guard<std::mutex> g(r->mu);
+    r->flags.push_back(flag);
+}
+
+FlagBase* FindFlag(const std::string& name) {
+    Registry* r = registry();
+    std::lock_guard<std::mutex> g(r->mu);
+    for (FlagBase* f : r->flags) {
+        if (name == f->name()) return f;
+    }
+    return nullptr;
+}
+
+std::vector<FlagBase*> ListFlags() {
+    Registry* r = registry();
+    std::lock_guard<std::mutex> g(r->mu);
+    return r->flags;
+}
+
+bool SetFlagValue(const std::string& name, const std::string& value) {
+    FlagBase* f = FindFlag(name);
+    if (f == nullptr) return false;
+    return f->SetString(value);
+}
+
+template <>
+bool Flag<int32_t>::SetString(const std::string& s) {
+    char* end = nullptr;
+    long v = strtol(s.c_str(), &end, 10);
+    if (end == s.c_str() || *end != '\0') return false;
+    if (validator_ && !validator_((int32_t)v)) return false;
+    value_.store((int32_t)v, std::memory_order_relaxed);
+    return true;
+}
+
+template <>
+bool Flag<int64_t>::SetString(const std::string& s) {
+    char* end = nullptr;
+    long long v = strtoll(s.c_str(), &end, 10);
+    if (end == s.c_str() || *end != '\0') return false;
+    if (validator_ && !validator_((int64_t)v)) return false;
+    value_.store((int64_t)v, std::memory_order_relaxed);
+    return true;
+}
+
+template <>
+bool Flag<bool>::SetString(const std::string& s) {
+    bool v;
+    if (s == "true" || s == "1") {
+        v = true;
+    } else if (s == "false" || s == "0") {
+        v = false;
+    } else {
+        return false;
+    }
+    if (validator_ && !validator_(v)) return false;
+    value_.store(v, std::memory_order_relaxed);
+    return true;
+}
+
+template <>
+bool Flag<double>::SetString(const std::string& s) {
+    char* end = nullptr;
+    double v = strtod(s.c_str(), &end);
+    if (end == s.c_str() || *end != '\0') return false;
+    if (validator_ && !validator_(v)) return false;
+    value_.store(v, std::memory_order_relaxed);
+    return true;
+}
+
+template <>
+std::string Flag<bool>::GetString() const {
+    return get() ? "true" : "false";
+}
+
+template <>
+std::string Flag<double>::GetString() const {
+    char buf[64];
+    snprintf(buf, sizeof(buf), "%g", get());
+    return buf;
+}
+
+template <typename T>
+std::string Flag<T>::GetString() const {
+    return std::to_string(get());
+}
+
+template class Flag<int32_t>;
+template class Flag<int64_t>;
+template class Flag<bool>;
+template class Flag<double>;
+
+}  // namespace tpurpc

@@ -1,0 +1,112 @@
+// Runtime flag registry: DEFINE_*/DECLARE_* macros with a global registry,
+// string get/set (for the /flags builtin portal service), and optional
+// validators.
+//
+// The reference uses gflags throughout with live mutation via the /flags
+// builtin (reference src/brpc/builtin/flags_service.* and
+// src/brpc/reloadable_flags.h). gflags is not in this image, so this is a
+// native equivalent with the same capabilities: typed globals, runtime
+// set-by-name with validation, enumeration for the portal.
+#pragma once
+
+#include <atomic>
+#include <cstdint>
+#include <functional>
+#include <mutex>
+#include <string>
+#include <vector>
+
+namespace tpurpc {
+
+class FlagBase {
+public:
+    FlagBase(const char* name, const char* desc, const char* type)
+        : name_(name), desc_(desc), type_(type) {}
+    virtual ~FlagBase() = default;
+    const char* name() const { return name_; }
+    const char* description() const { return desc_; }
+    const char* type() const { return type_; }
+    virtual std::string GetString() const = 0;
+    // Returns false if parsing/validation failed.
+    virtual bool SetString(const std::string& value) = 0;
+
+private:
+    const char* name_;
+    const char* desc_;
+    const char* type_;
+};
+
+// Global registry.
+void RegisterFlag(FlagBase* flag);
+FlagBase* FindFlag(const std::string& name);
+std::vector<FlagBase*> ListFlags();
+// Returns false (and leaves the flag unchanged) on parse/validation error.
+bool SetFlagValue(const std::string& name, const std::string& value);
+
+template <typename T>
+class Flag : public FlagBase {
+public:
+    Flag(const char* name, T default_value, const char* desc, const char* type)
+        : FlagBase(name, desc, type), value_(default_value) {
+        RegisterFlag(this);
+    }
+    T get() const { return value_.load(std::memory_order_relaxed); }
+    void set(T v) {
+        if (!validator_ || validator_(v)) {
+            value_.store(v, std::memory_order_relaxed);
+        }
+    }
+    void set_validator(std::function<bool(T)> v) { validator_ = std::move(v); }
+    operator T() const { return get(); }
+
+    std::string GetString() const override;
+    bool SetString(const std::string& s) override;
+
+private:
+    std::atomic<T> value_;
+    std::function<bool(T)> validator_;
+};
+
+class StringFlag : public FlagBase {
+public:
+    StringFlag(const char* name, const char* default_value, const char* desc)
+        : FlagBase(name, desc, "string"), value_(default_value) {
+        RegisterFlag(this);
+    }
+    std::string get() const {
+        std::lock_guard<std::mutex> g(mu_);
+        return value_;
+    }
+    void set(const std::string& v) {
+        std::lock_guard<std::mutex> g(mu_);
+        value_ = v;
+    }
+    std::string GetString() const override { return get(); }
+    bool SetString(const std::string& s) override {
+        set(s);
+        return true;
+    }
+
+private:
+    mutable std::mutex mu_;
+    std::string value_;
+};
+
+}  // namespace tpurpc
+
+#define DEFINE_int32(name, default_value, desc) \
+    ::tpurpc::Flag<int32_t> FLAGS_##name(#name, default_value, desc, "int32")
+#define DEFINE_int64(name, default_value, desc) \
+    ::tpurpc::Flag<int64_t> FLAGS_##name(#name, default_value, desc, "int64")
+#define DEFINE_bool(name, default_value, desc) \
+    ::tpurpc::Flag<bool> FLAGS_##name(#name, default_value, desc, "bool")
+#define DEFINE_double(name, default_value, desc) \
+    ::tpurpc::Flag<double> FLAGS_##name(#name, default_value, desc, "double")
+#define DEFINE_string(name, default_value, desc) \
+    ::tpurpc::StringFlag FLAGS_##name(#name, default_value, desc)
+
+#define DECLARE_int32(name) extern ::tpurpc::Flag<int32_t> FLAGS_##name
+#define DECLARE_int64(name) extern ::tpurpc::Flag<int64_t> FLAGS_##name
+#define DECLARE_bool(name) extern ::tpurpc::Flag<bool> FLAGS_##name
+#define DECLARE_double(name) extern ::tpurpc::Flag<double> FLAGS_##name
+#define DECLARE_string(name) extern ::tpurpc::StringFlag FLAGS_##name

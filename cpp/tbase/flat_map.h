@@ -1,0 +1,186 @@
+// FlatMap: open-addressing hash map optimized for small maps (method maps,
+// socket maps). Modeled on reference src/butil/containers/flat_map.h:145 —
+// that one uses single-linked buckets; ours uses robin-hood-style linear
+// probing which serves the same role (cache-friendly small maps) with less
+// code. Iteration order is unspecified.
+#pragma once
+
+#include <cstddef>
+#include <cstdint>
+#include <functional>
+#include <string>
+#include <utility>
+#include <vector>
+
+namespace tpurpc {
+
+// Case-insensitive string hash/eq for HTTP header maps
+// (reference CaseIgnoredFlatMap).
+struct CaseIgnoredHash {
+    size_t operator()(const std::string& s) const {
+        size_t h = 14695981039346656037ULL;
+        for (char c : s) {
+            h ^= (size_t)(c | 0x20);
+            h *= 1099511628211ULL;
+        }
+        return h;
+    }
+};
+struct CaseIgnoredEqual {
+    bool operator()(const std::string& a, const std::string& b) const {
+        if (a.size() != b.size()) return false;
+        for (size_t i = 0; i < a.size(); ++i) {
+            if ((a[i] | 0x20) != (b[i] | 0x20)) return false;
+        }
+        return true;
+    }
+};
+
+template <typename K, typename V, typename Hash = std::hash<K>,
+          typename Equal = std::equal_to<K>>
+class FlatMap {
+public:
+    struct Slot {
+        K key;
+        V value;
+        uint8_t state;  // 0 empty, 1 used, 2 tombstone
+        Slot() : state(0) {}
+    };
+
+    FlatMap() : size_(0) {}
+
+    V* seek(const K& key) const {
+        if (slots_.empty()) return nullptr;
+        size_t i = index_of(key);
+        size_t probes = 0;
+        while (probes < slots_.size()) {
+            const Slot& s = slots_[i];
+            if (s.state == 0) return nullptr;
+            if (s.state == 1 && eq_(s.key, key)) {
+                return const_cast<V*>(&s.value);
+            }
+            i = (i + 1) & mask_;
+            ++probes;
+        }
+        return nullptr;
+    }
+
+    V& operator[](const K& key) {
+        maybe_grow();
+        size_t i = index_of(key);
+        size_t first_tomb = (size_t)-1;
+        size_t probes = 0;
+        while (probes < slots_.size()) {
+            Slot& s = slots_[i];
+            if (s.state == 0) {
+                Slot& dst = (first_tomb != (size_t)-1) ? slots_[first_tomb] : s;
+                if (&dst != &s) --tombs_;
+                dst.key = key;
+                dst.value = V();
+                dst.state = 1;
+                ++size_;
+                return dst.value;
+            }
+            if (s.state == 2 && first_tomb == (size_t)-1) first_tomb = i;
+            if (s.state == 1 && eq_(s.key, key)) return s.value;
+            i = (i + 1) & mask_;
+            ++probes;
+        }
+        // Table is all used+tombstones: reuse the first tombstone (one must
+        // exist — maybe_grow() bounds used+tombstones below capacity).
+        if (first_tomb == (size_t)-1) abort();  // unreachable by invariant
+        Slot& dst = slots_[first_tomb];
+        --tombs_;
+        dst.key = key;
+        dst.value = V();
+        dst.state = 1;
+        ++size_;
+        return dst.value;
+    }
+
+    bool insert(const K& key, const V& value) {
+        V& v = (*this)[key];
+        v = value;
+        return true;
+    }
+
+    size_t erase(const K& key) {
+        if (slots_.empty()) return 0;
+        size_t i = index_of(key);
+        size_t probes = 0;
+        while (probes < slots_.size()) {
+            Slot& s = slots_[i];
+            if (s.state == 0) return 0;
+            if (s.state == 1 && eq_(s.key, key)) {
+                s.state = 2;
+                s.key = K();
+                s.value = V();
+                --size_;
+                ++tombs_;
+                return 1;
+            }
+            i = (i + 1) & mask_;
+            ++probes;
+        }
+        return 0;
+    }
+
+    size_t size() const { return size_; }
+    bool empty() const { return size_ == 0; }
+    void clear() {
+        slots_.clear();
+        size_ = 0;
+        tombs_ = 0;
+        mask_ = 0;
+    }
+
+    // for_each(fn(key, value)).
+    template <typename Fn>
+    void for_each(Fn&& fn) const {
+        for (const Slot& s : slots_) {
+            if (s.state == 1) fn(s.key, s.value);
+        }
+    }
+
+private:
+    size_t index_of(const K& key) const { return hash_(key) & mask_; }
+
+    void maybe_grow() {
+        if (slots_.empty()) {
+            slots_.resize(16);
+            mask_ = 15;
+            return;
+        }
+        // Tombstones count against the load factor, otherwise a table with
+        // erase churn fills with tombstones and probes degrade/never end.
+        if ((size_ + tombs_) * 4 >= slots_.size() * 3) {  // load factor 0.75
+            std::vector<Slot> old;
+            old.swap(slots_);
+            // Only grow if live entries justify it; otherwise rehash in
+            // place to shed tombstones.
+            const size_t new_size =
+                (size_ * 4 >= old.size() * 2) ? old.size() * 2 : old.size();
+            slots_.resize(new_size);
+            mask_ = slots_.size() - 1;
+            size_ = 0;
+            tombs_ = 0;
+            for (Slot& s : old) {
+                if (s.state == 1) {
+                    (*this)[s.key] = std::move(s.value);
+                }
+            }
+        }
+    }
+
+    std::vector<Slot> slots_;
+    size_t size_;
+    size_t tombs_ = 0;
+    size_t mask_ = 0;
+    Hash hash_;
+    Equal eq_;
+};
+
+template <typename V>
+using CaseIgnoredFlatMap = FlatMap<std::string, V, CaseIgnoredHash, CaseIgnoredEqual>;
+
+}  // namespace tpurpc

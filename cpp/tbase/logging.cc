@@ -1,0 +1,71 @@
+#include "tbase/logging.h"
+
+#include <unistd.h>
+
+#include <atomic>
+#include <cstdio>
+#include <cstring>
+#include <ctime>
+#include <mutex>
+
+namespace tpurpc {
+
+static std::atomic<int> g_min_log_level{LOG_INFO};
+static LogSink g_sink;
+static std::mutex g_sink_mu;
+
+int GetMinLogLevel() { return g_min_log_level.load(std::memory_order_relaxed); }
+void SetMinLogLevel(int level) {
+    g_min_log_level.store(level, std::memory_order_relaxed);
+}
+void SetLogSink(LogSink sink) {
+    std::lock_guard<std::mutex> g(g_sink_mu);
+    g_sink = std::move(sink);
+}
+
+static const char* SeverityName(int s) {
+    switch (s) {
+        case LOG_TRACE: return "T";
+        case LOG_DEBUG: return "D";
+        case LOG_INFO: return "I";
+        case LOG_WARNING: return "W";
+        case LOG_ERROR: return "E";
+        case LOG_FATAL: return "F";
+    }
+    return "?";
+}
+
+LogMessage::LogMessage(const char* file, int line, int severity)
+    : file_(file), line_(line), severity_(severity) {}
+
+LogMessage::~LogMessage() {
+    std::string msg = stream_.str();
+    {
+        std::lock_guard<std::mutex> g(g_sink_mu);
+        if (g_sink && g_sink(severity_, file_, line_, msg)) {
+            if (severity_ >= LOG_FATAL) abort();
+            return;
+        }
+    }
+    // One formatted line, single write() so concurrent logs don't interleave.
+    const char* base = strrchr(file_, '/');
+    base = base ? base + 1 : file_;
+    struct timespec ts;
+    clock_gettime(CLOCK_REALTIME, &ts);
+    struct tm tm_buf;
+    localtime_r(&ts.tv_sec, &tm_buf);
+    char line_buf[4096];
+    int n = snprintf(line_buf, sizeof(line_buf),
+                     "%s%02d%02d %02d:%02d:%02d.%06ld %s:%d] %s\n",
+                     SeverityName(severity_), tm_buf.tm_mon + 1, tm_buf.tm_mday,
+                     tm_buf.tm_hour, tm_buf.tm_min, tm_buf.tm_sec,
+                     ts.tv_nsec / 1000, base, line_, msg.c_str());
+    if (n > 0) {
+        ssize_t unused = write(STDERR_FILENO, line_buf,
+                               (size_t)(n < (int)sizeof(line_buf) ? n : (int)sizeof(line_buf)));
+        (void)unused;
+    }
+    if (severity_ >= LOG_FATAL) abort();
+}
+
+}  // namespace tpurpc

@@ -1,0 +1,90 @@
+// Stream-style logging with CHECK macros.
+//
+// Modeled on the reference's chromium-derived logger (reference:
+// src/butil/logging.h — LOG(x) streams, CHECK/DCHECK macros, severity
+// levels, optional glog backend). This implementation is deliberately lean:
+// severities, thread-safe line-buffered output to stderr, CHECK* that
+// abort with the failed expression, and a pluggable sink so the builtin
+// portal can capture recent logs later.
+#pragma once
+
+#include <cstdint>
+#include <cstdlib>
+#include <functional>
+#include <sstream>
+#include <string>
+
+namespace tpurpc {
+
+enum LogSeverity : int {
+    LOG_TRACE = -1,
+    LOG_DEBUG = 0,
+    LOG_INFO = 1,
+    LOG_WARNING = 2,
+    LOG_ERROR = 3,
+    LOG_FATAL = 4,
+};
+
+// Minimum severity that actually gets emitted (default INFO).
+int GetMinLogLevel();
+void SetMinLogLevel(int level);
+
+// Optional sink; return true to suppress the default stderr write.
+using LogSink = std::function<bool(int severity, const char* file, int line,
+                                   const std::string& message)>;
+void SetLogSink(LogSink sink);
+
+class LogMessage {
+public:
+    LogMessage(const char* file, int line, int severity);
+    ~LogMessage();
+    std::ostream& stream() { return stream_; }
+
+private:
+    std::ostringstream stream_;
+    const char* file_;
+    int line_;
+    int severity_;
+};
+
+// Swallows the stream when the severity is below the threshold.
+class LogMessageVoidify {
+public:
+    void operator&(std::ostream&) {}
+};
+
+}  // namespace tpurpc
+
+#define TPURPC_LOG_STREAM(severity)                                       \
+    ::tpurpc::LogMessage(__FILE__, __LINE__, ::tpurpc::LOG_##severity)   \
+        .stream()
+
+#define LOG(severity)                                                \
+    (::tpurpc::LOG_##severity < ::tpurpc::GetMinLogLevel())          \
+        ? (void)0                                                    \
+        : ::tpurpc::LogMessageVoidify() & TPURPC_LOG_STREAM(severity)
+
+#define LOG_IF(severity, cond) \
+    !(cond) ? (void)0 : ::tpurpc::LogMessageVoidify() & TPURPC_LOG_STREAM(severity)
+
+#define CHECK(cond)                                                         \
+    (cond) ? (void)0                                                        \
+           : ::tpurpc::LogMessageVoidify() &                                \
+                 (TPURPC_LOG_STREAM(FATAL) << "Check failed: " #cond " ")
+
+#define CHECK_EQ(a, b) CHECK((a) == (b))
+#define CHECK_NE(a, b) CHECK((a) != (b))
+#define CHECK_LT(a, b) CHECK((a) < (b))
+#define CHECK_LE(a, b) CHECK((a) <= (b))
+#define CHECK_GT(a, b) CHECK((a) > (b))
+#define CHECK_GE(a, b) CHECK((a) >= (b))
+
+#ifdef NDEBUG
+#define DCHECK(cond) CHECK(true || (cond))
+#else
+#define DCHECK(cond) CHECK(cond)
+#endif
+
+// PLOG appends errno text.
+#define PLOG(severity) \
+    LOG(severity) << "[" << strerror(errno) << "] "

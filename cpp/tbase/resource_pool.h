@@ -1,0 +1,141 @@
+// ResourcePool: slab allocator addressed by 32/64-bit ids with O(1)
+// get/address/return — the "weak_ptr as integer" idiom underlying SocketId,
+// fiber ids and butex ids.
+//
+// Modeled on reference src/butil/resource_pool.h:97-118 (get_resource /
+// address_resource / return_resource over per-thread free chunks and a
+// two-level block table). Objects are NEVER destructed until process exit;
+// a returned slot is recycled to a later get_resource() call, and stale ids
+// are guarded by version schemes layered above (versioned_ref.h).
+#pragma once
+
+#include <atomic>
+#include <cstdint>
+#include <mutex>
+#include <vector>
+
+namespace tpurpc {
+
+using ResourceId = uint64_t;
+
+template <typename T>
+class ResourcePool {
+public:
+    static constexpr size_t BLOCK_NITEM = 256;
+    static constexpr size_t MAX_BLOCKS = 1 << 16;
+
+    static ResourcePool* singleton() {
+        // Intentionally leaked: slots must outlive all static destructors.
+        static ResourcePool* pool = new ResourcePool;
+        return pool;
+    }
+
+    // Get a free slot; *id receives its address. The object is NOT
+    // re-constructed on reuse (same as the reference) — callers re-init.
+    T* get_resource(ResourceId* id) {
+        {
+            std::lock_guard<std::mutex> g(free_mu_);
+            if (!free_list_.empty()) {
+                ResourceId rid = free_list_.back();
+                free_list_.pop_back();
+                *id = rid;
+                return unsafe_address(rid);
+            }
+        }
+        // Allocate a new slot.
+        std::lock_guard<std::mutex> g(grow_mu_);
+        size_t n = nitem_.load(std::memory_order_relaxed);
+        const size_t block_idx = n / BLOCK_NITEM;
+        if (block_idx >= MAX_BLOCKS) return nullptr;
+        if (block_idx >= nblock_.load(std::memory_order_acquire)) {
+            Block* b = new Block;
+            blocks_[block_idx] = b;
+            nblock_.store(block_idx + 1, std::memory_order_release);
+        }
+        nitem_.store(n + 1, std::memory_order_relaxed);
+        *id = (ResourceId)n;
+        return &blocks_[block_idx]->items[n % BLOCK_NITEM];
+    }
+
+    // Wait-free id -> pointer. Never fails for ids previously returned by
+    // get_resource (slots are never freed).
+    T* address_resource(ResourceId id) const {
+        const size_t block_idx = (size_t)id / BLOCK_NITEM;
+        if (block_idx >= nblock_.load(std::memory_order_acquire)) {
+            return nullptr;
+        }
+        return &blocks_[block_idx]->items[(size_t)id % BLOCK_NITEM];
+    }
+
+    void return_resource(ResourceId id) {
+        std::lock_guard<std::mutex> g(free_mu_);
+        free_list_.push_back(id);
+    }
+
+    size_t size() const { return nitem_.load(std::memory_order_relaxed); }
+
+private:
+    struct Block {
+        T items[BLOCK_NITEM];
+    };
+
+    ResourcePool() : blocks_(MAX_BLOCKS, nullptr) {}
+
+    T* unsafe_address(ResourceId id) const {
+        return &blocks_[(size_t)id / BLOCK_NITEM]->items[(size_t)id % BLOCK_NITEM];
+    }
+
+    std::mutex free_mu_;
+    std::vector<ResourceId> free_list_;
+    std::mutex grow_mu_;
+    std::atomic<size_t> nitem_{0};
+    std::atomic<size_t> nblock_{0};
+    mutable std::vector<Block*> blocks_;
+};
+
+// Convenience wrappers mirroring the reference's free functions
+// (resource_pool.h:97 get_resource / address_resource / return_resource).
+template <typename T>
+inline T* get_resource(ResourceId* id) {
+    return ResourcePool<T>::singleton()->get_resource(id);
+}
+template <typename T>
+inline T* address_resource(ResourceId id) {
+    return ResourcePool<T>::singleton()->address_resource(id);
+}
+template <typename T>
+inline void return_resource(ResourceId id) {
+    ResourcePool<T>::singleton()->return_resource(id);
+}
+
+// ObjectPool: like ResourcePool but addressed by pointer, with TLS free
+// lists (reference src/butil/object_pool.h). Used for hot small objects.
+template <typename T>
+class ObjectPool {
+public:
+    static T* get() {
+        auto& tls = tls_free();
+        if (!tls.empty()) {
+            T* obj = tls.back();
+            tls.pop_back();
+            return obj;
+        }
+        return new T;
+    }
+    static void put(T* obj) {
+        auto& tls = tls_free();
+        if (tls.size() < 128) {
+            tls.push_back(obj);
+        } else {
+            delete obj;
+        }
+    }
+
+private:
+    static std::vector<T*>& tls_free() {
+        thread_local std::vector<T*> v;
+        return v;
+    }
+};
+
+}  // namespace tpurpc

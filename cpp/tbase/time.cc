@@ -1,0 +1,27 @@
+#include "tbase/time.h"
+
+#include <unistd.h>
+
+namespace tpurpc {
+
+static double CalibrateTicksPerUs() {
+#if defined(__x86_64__)
+    const int64_t t0_ns = monotonic_time_ns();
+    const uint64_t c0 = cpuwide_ticks();
+    usleep(2000);
+    const int64_t t1_ns = monotonic_time_ns();
+    const uint64_t c1 = cpuwide_ticks();
+    const double us = (double)(t1_ns - t0_ns) / 1000.0;
+    if (us <= 0) return 1000.0;
+    return (double)(c1 - c0) / us;
+#else
+    return 1000.0;  // ticks == ns
+#endif
+}
+
+double ticks_per_us() {
+    static const double v = CalibrateTicksPerUs();
+    return v;
+}
+
+}  // namespace tpurpc

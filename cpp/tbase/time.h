@@ -1,0 +1,59 @@
+// Time utilities: wall/monotonic microsecond clocks and cpu-wide ticks.
+// Modeled on reference src/butil/time.h (gettimeofday_us, cpuwide_time_*).
+#pragma once
+
+#include <cstdint>
+#include <ctime>
+
+namespace tpurpc {
+
+inline int64_t gettimeofday_us() {
+    struct timespec ts;
+    clock_gettime(CLOCK_REALTIME, &ts);
+    return ts.tv_sec * 1000000L + ts.tv_nsec / 1000;
+}
+
+inline int64_t monotonic_time_ns() {
+    struct timespec ts;
+    clock_gettime(CLOCK_MONOTONIC, &ts);
+    return ts.tv_sec * 1000000000L + ts.tv_nsec;
+}
+
+inline int64_t monotonic_time_us() { return monotonic_time_ns() / 1000; }
+inline int64_t monotonic_time_ms() { return monotonic_time_ns() / 1000000; }
+
+// Raw TSC: the cheapest timestamp on x86_64 (reference uses cpuwide ticks for
+// hot-path latency measurements, src/butil/time.h).
+inline uint64_t cpuwide_ticks() {
+#if defined(__x86_64__)
+    uint32_t lo, hi;
+    __asm__ __volatile__("rdtsc" : "=a"(lo), "=d"(hi));
+    return ((uint64_t)hi << 32) | lo;
+#else
+    return (uint64_t)monotonic_time_ns();
+#endif
+}
+
+// Ticks-per-microsecond, calibrated once at startup.
+double ticks_per_us();
+
+inline int64_t cpuwide_time_us() {
+    return (int64_t)((double)cpuwide_ticks() / ticks_per_us());
+}
+
+// Simple stopwatch.
+class Timer {
+public:
+    Timer() : start_(0), stop_(0) {}
+    void start() { start_ = monotonic_time_ns(); }
+    void stop() { stop_ = monotonic_time_ns(); }
+    int64_t n_elapsed() const { return stop_ - start_; }
+    int64_t u_elapsed() const { return n_elapsed() / 1000; }
+    int64_t m_elapsed() const { return n_elapsed() / 1000000; }
+
+private:
+    int64_t start_;
+    int64_t stop_;
+};
+
+}  // namespace tpurpc

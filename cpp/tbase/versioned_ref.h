@@ -1,0 +1,184 @@
+// VersionedRefWithId: generic 64-bit id = (version<<32)|slot with an atomic
+// (version,nref) pair packed in one u64. Address() is wait-free; SetFailed
+// flips the version to odd so stale ids fail to resolve.
+//
+// Modeled on reference src/brpc/versioned_ref_with_id.h:55-207 — the base of
+// Socket and IOEventData there; the base of Socket and Stream here.
+//
+// Lifecycle contract (same as the reference):
+//  - Create(): version is even, nref starts at 1 (the "creation ref").
+//  - Address(id): succeeds only while version(id) == current even version;
+//    bumps nref. Caller must Dereference (use the RAII Ptr).
+//  - SetFailed(): flips version to odd exactly once (further Address fails),
+//    calls OnFailed(), drops the creation ref.
+//  - When nref hits 0, OnRecycle() runs and the slot returns to the pool
+//    with version advanced to the next even number.
+#pragma once
+
+#include <atomic>
+#include <cstdint>
+
+#include "tbase/logging.h"
+#include "tbase/resource_pool.h"
+
+namespace tpurpc {
+
+using VRefId = uint64_t;
+
+constexpr VRefId INVALID_VREF_ID = (VRefId)-1;
+
+inline VRefId MakeVRefId(uint32_t version, ResourceId slot) {
+    return ((uint64_t)version << 32) | (uint64_t)slot;
+}
+inline uint32_t VRefVersion(VRefId id) { return (uint32_t)(id >> 32); }
+inline ResourceId VRefSlot(VRefId id) { return (ResourceId)(uint32_t)id; }
+
+// T must derive from VersionedRefWithId<T> and provide:
+//   void OnFailed();   // called once when SetFailed wins
+//   void OnRecycle();  // called when the last ref drops
+template <typename T>
+class VersionedRefWithId {
+public:
+    VersionedRefWithId() : versioned_nref_(0), id_(INVALID_VREF_ID) {}
+
+    // Create a new T addressed by *id. Returns 0 on success.
+    static int Create(VRefId* id_out, T** out = nullptr) {
+        ResourceId slot;
+        T* obj = get_resource<T>(&slot);
+        if (obj == nullptr) return -1;
+        // Current packed state holds the version from the previous life
+        // (even) and nref 0.
+        uint64_t vn = obj->versioned_nref_.load(std::memory_order_relaxed);
+        uint32_t ver = (uint32_t)(vn >> 32);
+        CHECK((ver & 1) == 0) << "recycled slot has odd version";
+        obj->id_ = MakeVRefId(ver, slot);
+        obj->versioned_nref_.store(((uint64_t)ver << 32) | 1,
+                                   std::memory_order_release);
+        *id_out = obj->id_;
+        if (out) *out = obj;
+        return 0;
+    }
+
+    // Wait-free address: returns nullptr if the id's version is stale.
+    static T* Address(VRefId id) {
+        T* obj = address_resource<T>(VRefSlot(id));
+        if (obj == nullptr) return nullptr;
+        const uint32_t expect_ver = VRefVersion(id);
+        uint64_t vn = obj->versioned_nref_.load(std::memory_order_acquire);
+        while (true) {
+            uint32_t ver = (uint32_t)(vn >> 32);
+            uint32_t nref = (uint32_t)vn;
+            if (ver != expect_ver || nref == 0) return nullptr;
+            if (obj->versioned_nref_.compare_exchange_weak(
+                    vn, vn + 1, std::memory_order_acquire,
+                    std::memory_order_acquire)) {
+                return obj;
+            }
+        }
+    }
+
+    VRefId vref_id() const { return id_; }
+
+    void AddRef() { versioned_nref_.fetch_add(1, std::memory_order_relaxed); }
+
+    void Dereference() {
+        uint64_t prev = versioned_nref_.fetch_sub(1, std::memory_order_acq_rel);
+        const uint32_t prev_nref = (uint32_t)prev;
+        CHECK_GE(prev_nref, 1u);
+        if (prev_nref == 1) {
+            // Last ref: recycle. Advance version to the next even value so
+            // the slot can be reused.
+            uint32_t ver = (uint32_t)(prev >> 32);
+            uint32_t next_ver = (ver | 1) + 1;  // next even
+            static_cast<T*>(this)->OnRecycle();
+            versioned_nref_.store((uint64_t)next_ver << 32,
+                                  std::memory_order_release);
+            return_resource<T>(VRefSlot(id_));
+        }
+    }
+
+    // Flip version to odd (only the first caller wins), run OnFailed, drop
+    // the creation ref. Returns 0 if this call performed the failure.
+    int SetFailed() {
+        uint64_t vn = versioned_nref_.load(std::memory_order_relaxed);
+        while (true) {
+            uint32_t ver = (uint32_t)(vn >> 32);
+            if (ver & 1) return -1;  // already failed
+            uint32_t nref = (uint32_t)vn;
+            if (nref == 0) return -1;  // already recycled
+            uint64_t next = ((uint64_t)(ver | 1) << 32) | nref;
+            if (versioned_nref_.compare_exchange_weak(
+                    vn, next, std::memory_order_acq_rel,
+                    std::memory_order_relaxed)) {
+                static_cast<T*>(this)->OnFailed();
+                Dereference();  // drop creation ref
+                return 0;
+            }
+        }
+    }
+
+    bool Failed() const {
+        return (uint32_t)(versioned_nref_.load(std::memory_order_acquire) >>
+                          32) &
+               1;
+    }
+
+    int32_t nref() const {
+        return (int32_t)(uint32_t)versioned_nref_.load(
+            std::memory_order_acquire);
+    }
+
+    static int SetFailedById(VRefId id) {
+        T* obj = Address(id);
+        if (obj == nullptr) return -1;
+        int rc = obj->SetFailed();
+        obj->Dereference();
+        return rc;
+    }
+
+private:
+    // high 32: version (odd = failed); low 32: nref.
+    std::atomic<uint64_t> versioned_nref_;
+    VRefId id_;
+};
+
+// RAII reference holder (the SocketUniquePtr pattern).
+template <typename T>
+class VRefPtr {
+public:
+    VRefPtr() : obj_(nullptr) {}
+    explicit VRefPtr(T* obj) : obj_(obj) {}  // takes over an existing ref
+    ~VRefPtr() { reset(); }
+    VRefPtr(const VRefPtr&) = delete;
+    VRefPtr& operator=(const VRefPtr&) = delete;
+    VRefPtr(VRefPtr&& o) noexcept : obj_(o.obj_) { o.obj_ = nullptr; }
+    VRefPtr& operator=(VRefPtr&& o) noexcept {
+        reset();
+        obj_ = o.obj_;
+        o.obj_ = nullptr;
+        return *this;
+    }
+
+    static VRefPtr FromId(VRefId id) { return VRefPtr(T::Address(id)); }
+
+    T* get() const { return obj_; }
+    T* operator->() const { return obj_; }
+    T& operator*() const { return *obj_; }
+    explicit operator bool() const { return obj_ != nullptr; }
+    void reset() {
+        if (obj_) {
+            obj_->Dereference();
+            obj_ = nullptr;
+        }
+    }
+    T* release() {
+        T* o = obj_;
+        obj_ = nullptr;
+        return o;
+    }
+
+private:
+    T* obj_;
+};
+
+}  // namespace tpurpc

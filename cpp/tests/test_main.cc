@@ -1,0 +1,6 @@
+// Single test runner for all C++ unit tests (reference keeps one gtest main
+// per suite, test/butil_unittest_main.cpp:19-41; we link everything into one
+// binary because the build host has a single core).
+#include "ttest/ttest.h"
+
+int main(int argc, char** argv) { return ttest::run_all(argc, argv); }

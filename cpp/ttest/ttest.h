@@ -1,0 +1,146 @@
+// ttest — a minimal gtest-shaped unit test framework.
+//
+// The reference uses googletest with one main per suite
+// (reference: test/butil_unittest_main.cpp:19-41). gtest is not available in
+// this image, so we provide a single-header framework with the same macro
+// surface (TEST, EXPECT_*, ASSERT_*) so tests read identically. All tests
+// link into one runner binary (cheaper on a 1-core build host).
+#pragma once
+
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <functional>
+#include <sstream>
+#include <string>
+#include <vector>
+
+namespace ttest {
+
+struct TestCase {
+    const char* suite;
+    const char* name;
+    std::function<void()> fn;
+};
+
+inline std::vector<TestCase>& registry() {
+    static std::vector<TestCase> r;
+    return r;
+}
+
+struct Registrar {
+    Registrar(const char* suite, const char* name, std::function<void()> fn) {
+        registry().push_back({suite, name, std::move(fn)});
+    }
+};
+
+// Per-test failure state.
+inline int& current_failures() {
+    static int f = 0;
+    return f;
+}
+inline bool& fatal_failure() {
+    static bool f = false;
+    return f;
+}
+
+struct FailureReporter {
+    std::ostringstream msg;
+    bool fatal;
+    const char* file;
+    int line;
+    FailureReporter(bool is_fatal, const char* f, int l)
+        : fatal(is_fatal), file(f), line(l) {}
+    ~FailureReporter() {
+        std::fprintf(stderr, "FAILURE at %s:%d: %s\n", file, line,
+                     msg.str().c_str());
+        ++current_failures();
+        if (fatal) fatal_failure() = true;
+    }
+    template <typename T>
+    FailureReporter& operator<<(const T& v) {
+        msg << v;
+        return *this;
+    }
+};
+
+inline int run_all(int argc, char** argv) {
+    const char* filter = nullptr;
+    for (int i = 1; i < argc; ++i) {
+        if (strncmp(argv[i], "--filter=", 9) == 0) filter = argv[i] + 9;
+    }
+    int failed = 0, ran = 0;
+    for (auto& tc : registry()) {
+        std::string full = std::string(tc.suite) + "." + tc.name;
+        if (filter && full.find(filter) == std::string::npos) continue;
+        ++ran;
+        current_failures() = 0;
+        fatal_failure() = false;
+        std::fprintf(stderr, "[ RUN      ] %s\n", full.c_str());
+        tc.fn();
+        if (current_failures() > 0) {
+            ++failed;
+            std::fprintf(stderr, "[  FAILED  ] %s\n", full.c_str());
+        } else {
+            std::fprintf(stderr, "[       OK ] %s\n", full.c_str());
+        }
+    }
+    std::fprintf(stderr, "%d test(s) ran, %d failed\n", ran, failed);
+    return failed == 0 ? 0 : 1;
+}
+
+}  // namespace ttest
+
+#define TTEST_CONCAT_(a, b) a##b
+#define TTEST_CONCAT(a, b) TTEST_CONCAT_(a, b)
+
+#define TEST(suite, name)                                                  \
+    static void TTEST_CONCAT(ttest_##suite##_##name##_, body)();           \
+    static ::ttest::Registrar TTEST_CONCAT(ttest_reg_##suite##_##name##_,  \
+                                           __LINE__)(                      \
+        #suite, #name, TTEST_CONCAT(ttest_##suite##_##name##_, body));     \
+    static void TTEST_CONCAT(ttest_##suite##_##name##_, body)()
+
+// Expectation macros. The `else` branch binds the streaming output.
+#define TTEST_CHECK_IMPL(cond, fatal)                                  \
+    if (cond) {                                                        \
+    } else                                                             \
+        ::ttest::FailureReporter(fatal, __FILE__, __LINE__)            \
+            << "expected: " << #cond
+
+#define EXPECT_TRUE(c) TTEST_CHECK_IMPL((c), false)
+#define EXPECT_FALSE(c) TTEST_CHECK_IMPL(!(c), false)
+#define EXPECT_EQ(a, b) TTEST_CHECK_IMPL((a) == (b), false)
+#define EXPECT_NE(a, b) TTEST_CHECK_IMPL((a) != (b), false)
+#define EXPECT_LT(a, b) TTEST_CHECK_IMPL((a) < (b), false)
+#define EXPECT_LE(a, b) TTEST_CHECK_IMPL((a) <= (b), false)
+#define EXPECT_GT(a, b) TTEST_CHECK_IMPL((a) > (b), false)
+#define EXPECT_GE(a, b) TTEST_CHECK_IMPL((a) >= (b), false)
+#define EXPECT_STREQ(a, b) TTEST_CHECK_IMPL(std::strcmp((a), (b)) == 0, false)
+
+#define ASSERT_RET_IF_FATAL() \
+    if (::ttest::fatal_failure()) return
+#define ASSERT_TRUE(c)            \
+    TTEST_CHECK_IMPL((c), true);  \
+    ASSERT_RET_IF_FATAL()
+#define ASSERT_FALSE(c)           \
+    TTEST_CHECK_IMPL(!(c), true); \
+    ASSERT_RET_IF_FATAL()
+#define ASSERT_EQ(a, b)                  \
+    TTEST_CHECK_IMPL((a) == (b), true);  \
+    ASSERT_RET_IF_FATAL()
+#define ASSERT_NE(a, b)                  \
+    TTEST_CHECK_IMPL((a) != (b), true);  \
+    ASSERT_RET_IF_FATAL()
+#define ASSERT_LT(a, b)                  \
+    TTEST_CHECK_IMPL((a) < (b), true);   \
+    ASSERT_RET_IF_FATAL()
+#define ASSERT_GT(a, b)                  \
+    TTEST_CHECK_IMPL((a) > (b), true);   \
+    ASSERT_RET_IF_FATAL()
+#define ASSERT_GE(a, b)                  \
+    TTEST_CHECK_IMPL((a) >= (b), true);  \
+    ASSERT_RET_IF_FATAL()
+#define ASSERT_LE(a, b)                  \
+    TTEST_CHECK_IMPL((a) <= (b), true);  \
+    ASSERT_RET_IF_FATAL()

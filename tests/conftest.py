@@ -1,0 +1,45 @@
+"""pytest harness: builds the C++ core once per session, then runs both the
+C++ unit-test binary (tests/test_cpp.py) and the Python-level tests.
+
+JAX tests run on a virtual 8-device CPU mesh (multi-chip sharding is
+validated without hardware, per the driver's dryrun_multichip contract).
+"""
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+BUILD_DIR = REPO / "build"
+
+# Force a deterministic virtual 8-device CPU platform for all JAX tests
+# BEFORE jax is imported anywhere.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+
+def _build_cpp():
+    BUILD_DIR.mkdir(exist_ok=True)
+    if not (BUILD_DIR / "build.ninja").exists():
+        subprocess.run(
+            ["cmake", "-G", "Ninja", "-S", str(REPO), "-B", str(BUILD_DIR)],
+            check=True,
+        )
+    subprocess.run(["ninja", "-C", str(BUILD_DIR)], check=True)
+
+
+@pytest.fixture(scope="session")
+def cpp_build():
+    _build_cpp()
+    return BUILD_DIR
+
+
+@pytest.fixture(scope="session")
+def cpp_tests_bin(cpp_build):
+    return cpp_build / "cpp_tests"

@@ -1,0 +1,10 @@
+"""Runs the C++ unit-test binary (all tbase/tfiber/tvar/tnet/trpc suites)."""
+import subprocess
+
+
+def test_cpp_unit_tests(cpp_tests_bin):
+    proc = subprocess.run(
+        [str(cpp_tests_bin)], capture_output=True, text=True, timeout=600
+    )
+    sys_out = proc.stdout + proc.stderr
+    assert proc.returncode == 0, f"C++ tests failed:\n{sys_out[-8000:]}"

@@ -1,0 +1,63 @@
+// IOBuf zero-copy pipeline microbench: append / cut / writev-readv over a
+// pipe, the data motion under every RPC. Interim stand-in until echo_bench
+// (full-stack loopback echo) exists. Prints one JSON line with --json.
+#include <fcntl.h>
+#include <unistd.h>
+
+#include <cerrno>
+#include <cstdio>
+#include <cstring>
+#include <string>
+
+#include "tbase/iobuf.h"
+#include "tbase/time.h"
+
+using namespace tpurpc;
+
+int main(int argc, char** argv) {
+    bool json = false;
+    for (int i = 1; i < argc; ++i) {
+        if (strcmp(argv[i], "--json") == 0) json = true;
+    }
+    int fds[2];
+    if (pipe(fds) != 0) return 1;
+    // Non-blocking both ends: a single thread plays writer and reader, and a
+    // blocking writev of more than the pipe capacity would deadlock.
+    fcntl(fds[0], F_SETFL, O_NONBLOCK);
+    fcntl(fds[1], F_SETFL, O_NONBLOCK);
+
+    const size_t kMsg = 1 << 20;  // 1MB messages
+    const int kIters = 300;
+    std::string payload(kMsg, 'x');
+
+    Timer t;
+    t.start();
+    size_t total = 0;
+    for (int i = 0; i < kIters; ++i) {
+        IOBuf out;
+        out.append(payload.data(), payload.size());
+        IOBuf echoed;
+        IOPortal in;
+        while (!out.empty() || echoed.size() < kMsg) {
+            if (!out.empty()) {
+                ssize_t w = out.cut_into_file_descriptor(fds[1], 65536);
+                if (w < 0 && errno != EAGAIN) return 1;
+            }
+            ssize_t r = in.append_from_file_descriptor(fds[0], 65536);
+            if (r < 0 && errno != EAGAIN) return 1;
+            in.cutn(&echoed, in.size());
+        }
+        total += echoed.size();
+    }
+    t.stop();
+    const double secs = (double)t.n_elapsed() / 1e9;
+    const double mbps = (double)total / (1 << 20) / secs;
+    if (json) {
+        printf("{\"mbps\": %.1f, \"iters\": %d, \"msg_bytes\": %zu}\n", mbps,
+               kIters, kMsg);
+    } else {
+        printf("IOBuf pipe pipeline: %.1f MB/s over %d x %zuB messages\n",
+               mbps, kIters, kMsg);
+    }
+    return 0;
+}

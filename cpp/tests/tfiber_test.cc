@@ -1,0 +1,385 @@
+// Fiber runtime tests, mirroring the reference's bthread suite coverage
+// (test/bthread_unittest.cpp, butex, mutex, cond, execution_queue,
+// work_stealing_queue, ping-pong).
+#include <unistd.h>
+
+#include <atomic>
+#include <cerrno>
+#include <thread>
+#include <vector>
+
+#include "tbase/time.h"
+#include "tfiber/butex.h"
+#include "tfiber/execution_queue.h"
+#include "tfiber/fiber.h"
+#include "tfiber/fiber_sync.h"
+#include "tfiber/work_stealing_queue.h"
+#include "ttest/ttest.h"
+
+using namespace tpurpc;
+
+TEST(Fiber, StartJoin) {
+    std::atomic<int> x{0};
+    fiber_t tid;
+    ASSERT_EQ(fiber_start_background(
+                  &tid, nullptr,
+                  [](void* arg) -> void* {
+                      ((std::atomic<int>*)arg)->store(42);
+                      return nullptr;
+                  },
+                  &x),
+              0);
+    ASSERT_EQ(fiber_join(tid, nullptr), 0);
+    EXPECT_EQ(x.load(), 42);
+    // Joining a finished fiber returns immediately.
+    EXPECT_EQ(fiber_join(tid, nullptr), 0);
+    EXPECT_FALSE(fiber_exists(tid));
+}
+
+TEST(Fiber, ManyFibers) {
+    std::atomic<int> count{0};
+    std::vector<fiber_t> tids(500);
+    for (auto& tid : tids) {
+        ASSERT_EQ(fiber_start_background(
+                      &tid, nullptr,
+                      [](void* arg) -> void* {
+                          ((std::atomic<int>*)arg)->fetch_add(1);
+                          fiber_yield();
+                          return nullptr;
+                      },
+                      &count),
+                  0);
+    }
+    for (auto tid : tids) fiber_join(tid, nullptr);
+    EXPECT_EQ(count.load(), 500);
+}
+
+TEST(Fiber, SelfInsideWorker) {
+    fiber_t tid;
+    std::atomic<uint64_t> observed{0};
+    fiber_start_background(
+        &tid, nullptr,
+        [](void* arg) -> void* {
+            ((std::atomic<uint64_t>*)arg)->store(fiber_self());
+            return nullptr;
+        },
+        &observed);
+    fiber_join(tid, nullptr);
+    EXPECT_EQ(observed.load(), tid);
+    EXPECT_EQ(fiber_self(), INVALID_FIBER);  // not on a worker here
+}
+
+TEST(Fiber, Usleep) {
+    fiber_t tid;
+    std::atomic<int64_t> elapsed{0};
+    fiber_start_background(
+        &tid, nullptr,
+        [](void* arg) -> void* {
+            const int64_t t0 = monotonic_time_us();
+            fiber_usleep(30000);
+            ((std::atomic<int64_t>*)arg)->store(monotonic_time_us() - t0);
+            return nullptr;
+        },
+        &elapsed);
+    fiber_join(tid, nullptr);
+    EXPECT_GE(elapsed.load(), 25000);
+    EXPECT_LT(elapsed.load(), 500000);
+}
+
+TEST(Butex, WakeFromPthread) {
+    void* b = butex_create();
+    butex_word(b)->store(7);
+    std::atomic<int> woke{0};
+    fiber_t tid;
+    struct Ctx {
+        void* b;
+        std::atomic<int>* woke;
+    } ctx{b, &woke};
+    fiber_start_background(
+        &tid, nullptr,
+        [](void* arg) -> void* {
+            Ctx* c = (Ctx*)arg;
+            while (butex_word(c->b)->load() == 7) {
+                butex_wait(c->b, 7, nullptr);
+            }
+            c->woke->store(1);
+            return nullptr;
+        },
+        &ctx);
+    usleep(20000);  // give the fiber time to park
+    EXPECT_EQ(woke.load(), 0);
+    butex_word(b)->store(8);
+    butex_wake(b);
+    fiber_join(tid, nullptr);
+    EXPECT_EQ(woke.load(), 1);
+    butex_destroy(b);
+}
+
+TEST(Butex, TimedWaitTimesOut) {
+    void* b = butex_create();
+    butex_word(b)->store(3);
+    fiber_t tid;
+    std::atomic<int> rc{-2};
+    struct Ctx {
+        void* b;
+        std::atomic<int>* rc;
+    } ctx{b, &rc};
+    fiber_start_background(
+        &tid, nullptr,
+        [](void* arg) -> void* {
+            Ctx* c = (Ctx*)arg;
+            const int64_t abst = monotonic_time_us() + 20000;
+            int r = butex_wait(c->b, 3, &abst);
+            c->rc->store(r == -1 && errno == ETIMEDOUT ? 1 : 0);
+            return nullptr;
+        },
+        &ctx);
+    fiber_join(tid, nullptr);
+    EXPECT_EQ(rc.load(), 1);
+    butex_destroy(b);
+}
+
+TEST(Butex, ValueMismatchReturnsWouldblock) {
+    void* b = butex_create();
+    butex_word(b)->store(5);
+    EXPECT_EQ(butex_wait(b, 99, nullptr), -1);
+    EXPECT_EQ(errno, EWOULDBLOCK);
+    butex_destroy(b);
+}
+
+TEST(Butex, PthreadWaiter) {
+    // Wait from a NON-worker pthread; wake from a fiber.
+    void* b = butex_create();
+    butex_word(b)->store(1);
+    std::thread waiter([&] {
+        while (butex_word(b)->load() == 1) {
+            butex_wait(b, 1, nullptr);
+        }
+    });
+    usleep(10000);
+    fiber_t tid;
+    fiber_start_background(
+        &tid, nullptr,
+        [](void* arg) -> void* {
+            void* b = arg;
+            butex_word(b)->store(2);
+            butex_wake_all(b);
+            return nullptr;
+        },
+        b);
+    fiber_join(tid, nullptr);
+    waiter.join();
+    butex_destroy(b);
+}
+
+TEST(FiberSync, MutexContention) {
+    FiberMutex mu;
+    int counter = 0;  // protected by mu
+    struct Ctx {
+        FiberMutex* mu;
+        int* counter;
+    } ctx{&mu, &counter};
+    std::vector<fiber_t> tids(16);
+    for (auto& tid : tids) {
+        fiber_start_background(
+            &tid, nullptr,
+            [](void* arg) -> void* {
+                Ctx* c = (Ctx*)arg;
+                for (int i = 0; i < 100; ++i) {
+                    c->mu->lock();
+                    ++*c->counter;
+                    if (i % 10 == 0) fiber_yield();  // hold across yield
+                    c->mu->unlock();
+                }
+                return nullptr;
+            },
+            &ctx);
+    }
+    for (auto tid : tids) fiber_join(tid, nullptr);
+    EXPECT_EQ(counter, 1600);
+}
+
+TEST(FiberSync, CondPingPong) {
+    struct Ctx {
+        FiberMutex mu;
+        FiberCond cond;
+        int turn = 0;  // 0: ping's turn, 1: pong's turn
+        int rounds = 0;
+    } ctx;
+    auto body = [](void* arg, int me) {
+        Ctx* c = (Ctx*)arg;
+        for (int i = 0; i < 50; ++i) {
+            c->mu.lock();
+            while (c->turn != me) c->cond.wait(c->mu);
+            c->turn = 1 - me;
+            ++c->rounds;
+            c->cond.notify_all();
+            c->mu.unlock();
+        }
+    };
+    fiber_t ping, pong;
+    struct Thunk {
+        void* ctx;
+        int me;
+        void (*body)(void*, int);
+    };
+    static auto trampoline = [](void* a) -> void* {
+        Thunk* t = (Thunk*)a;
+        t->body(t->ctx, t->me);
+        return nullptr;
+    };
+    void (*body_fn)(void*, int) = body;
+    Thunk t0{&ctx, 0, body_fn}, t1{&ctx, 1, body_fn};
+    fiber_start_background(&ping, nullptr, trampoline, &t0);
+    fiber_start_background(&pong, nullptr, trampoline, &t1);
+    fiber_join(ping, nullptr);
+    fiber_join(pong, nullptr);
+    EXPECT_EQ(ctx.rounds, 100);
+}
+
+TEST(FiberSync, CountdownFromPthread) {
+    CountdownEvent ev(3);
+    for (int i = 0; i < 3; ++i) {
+        fiber_t tid;
+        fiber_start_background(
+            &tid, nullptr,
+            [](void* arg) -> void* {
+                fiber_usleep(5000);
+                ((CountdownEvent*)arg)->signal();
+                return nullptr;
+            },
+            &ev);
+    }
+    EXPECT_EQ(ev.wait(), 0);  // waits on this plain pthread
+}
+
+TEST(FiberSync, CountdownTimeout) {
+    CountdownEvent ev(1);
+    const int64_t abst = monotonic_time_us() + 20000;
+    EXPECT_EQ(ev.wait(&abst), ETIMEDOUT);
+    ev.signal();
+    EXPECT_EQ(ev.wait(), 0);
+}
+
+TEST(WSQ, OwnerPushPopThiefSteal) {
+    WorkStealingQueue<int> q;
+    ASSERT_EQ(q.init(64), 0);
+    for (int i = 0; i < 10; ++i) EXPECT_TRUE(q.push(i));
+    int v;
+    // Owner pops LIFO (bottom).
+    EXPECT_TRUE(q.pop(&v));
+    EXPECT_EQ(v, 9);
+    // Thief steals FIFO (top) from another thread.
+    std::atomic<int> stolen{-1};
+    std::thread thief([&] {
+        int s;
+        if (q.steal(&s)) stolen.store(s);
+    });
+    thief.join();
+    EXPECT_EQ(stolen.load(), 0);
+    size_t left = 0;
+    while (q.pop(&v)) ++left;
+    EXPECT_EQ(left, 8u);
+}
+
+TEST(WSQ, ConcurrentStealAndPop) {
+    WorkStealingQueue<int> q;
+    ASSERT_EQ(q.init(2048), 0);
+    std::atomic<int64_t> sum{0};
+    std::atomic<bool> done{false};
+    int64_t expect = 0;
+    std::thread thief1([&] {
+        int v;
+        while (!done.load(std::memory_order_acquire)) {
+            if (q.steal(&v)) sum.fetch_add(v);
+        }
+        while (q.steal(&v)) sum.fetch_add(v);
+    });
+    for (int round = 0; round < 50; ++round) {
+        for (int i = 1; i <= 20; ++i) {
+            if (q.push(i)) expect += i;
+        }
+        int v;
+        while (q.pop(&v)) sum.fetch_add(v);
+    }
+    done.store(true, std::memory_order_release);
+    thief1.join();
+    EXPECT_EQ(sum.load(), expect);
+}
+
+TEST(ExecutionQueue, SerializedFifo) {
+    struct Sink {
+        std::vector<int> seen;
+        std::atomic<int> batches{0};
+    } sink;
+    ExecutionQueue<int> q;
+    q.start(
+        [](void* meta, ExecutionQueue<int>::TaskIterator& it) -> int {
+            Sink* s = (Sink*)meta;
+            for (; it; ++it) s->seen.push_back(*it);
+            s->batches.fetch_add(1);
+            return 0;
+        },
+        &sink);
+    for (int i = 0; i < 200; ++i) {
+        ASSERT_EQ(q.execute(i), 0);
+    }
+    q.stop();
+    q.join();
+    ASSERT_EQ(sink.seen.size(), 200u);
+    for (int i = 0; i < 200; ++i) EXPECT_EQ(sink.seen[i], i);
+    EXPECT_EQ(q.execute(1), -1);  // stopped
+}
+
+TEST(ExecutionQueue, MultiProducer) {
+    struct Sink {
+        std::atomic<int64_t> sum{0};
+    } sink;
+    ExecutionQueue<int> q;
+    q.start(
+        [](void* meta, ExecutionQueue<int>::TaskIterator& it) -> int {
+            for (; it; ++it) ((Sink*)meta)->sum.fetch_add(*it);
+            return 0;
+        },
+        &sink);
+    std::vector<std::thread> producers;
+    for (int t = 0; t < 4; ++t) {
+        producers.emplace_back([&q] {
+            for (int i = 1; i <= 500; ++i) q.execute(i);
+        });
+    }
+    for (auto& t : producers) t.join();
+    q.stop();
+    q.join();
+    EXPECT_EQ(sink.sum.load(), 4 * 500 * 501 / 2);
+}
+
+TEST(Fiber, PingPongThroughput) {
+    // Cooperative switch benchmark (reference test/bthread_ping_pong.cpp
+    // style) — also a smoke test that heavy switching doesn't corrupt state.
+    struct Ctx {
+        void* b;
+        int rounds = 0;
+    } ctx;
+    ctx.b = butex_create();
+    butex_word(ctx.b)->store(0);
+    auto runner = [](void* arg) -> void* {
+        Ctx* c = (Ctx*)arg;
+        for (int i = 0; i < 2000; ++i) {
+            std::atomic<int>* w = butex_word(c->b);
+            int v = w->load();
+            w->store(v + 1);
+            ++c->rounds;
+            butex_wake(c->b);
+            fiber_yield();
+        }
+        return nullptr;
+    };
+    fiber_t a, b2;
+    fiber_start_background(&a, nullptr, runner, &ctx);
+    fiber_start_background(&b2, nullptr, runner, &ctx);
+    fiber_join(a, nullptr);
+    fiber_join(b2, nullptr);
+    EXPECT_EQ(ctx.rounds, 4000);
+    butex_destroy(ctx.b);
+}

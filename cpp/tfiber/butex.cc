@@ -1,0 +1,283 @@
+#include "tfiber/butex.h"
+
+#include <cerrno>
+#include <mutex>
+
+#include "tbase/logging.h"
+#include "tbase/time.h"
+#include "tfiber/sys_futex.h"
+#include "tfiber/task_group.h"
+#include "tfiber/timer_thread.h"
+
+namespace tpurpc {
+
+namespace {
+
+enum WaiterState : int {
+    WAITER_PARKED = 0,
+    WAITER_WOKEN = 1,
+    WAITER_TIMEDOUT = 2,
+    WAITER_CANCELLED = 3,  // value mismatch discovered at publish time
+};
+
+struct Butex;
+
+// Lives on the waiting fiber's / pthread's stack. Lifetime: from enqueue
+// until the owner resumes; the owner guarantees (via TimerThread::unschedule
+// blocking semantics) that no timer callback can still touch it after
+// butex_wait returns.
+struct ButexWaiter {
+    ButexWaiter* next = nullptr;
+    ButexWaiter* prev = nullptr;
+    Butex* container = nullptr;
+    bool is_fiber = false;
+    fiber_t tid = INVALID_FIBER;
+    TaskMeta* meta = nullptr;
+    std::atomic<int> state{WAITER_PARKED};
+    std::atomic<int> pthread_word{0};
+    TimerId timer_id = INVALID_TIMER_ID;
+};
+
+struct Butex {
+    std::atomic<int> value{0};
+    std::mutex mu;
+    // Intrusive doubly-linked list, FIFO wake order.
+    ButexWaiter* head = nullptr;
+    ButexWaiter* tail = nullptr;
+
+    void enqueue(ButexWaiter* w) {
+        w->container = this;
+        w->next = nullptr;
+        w->prev = tail;
+        if (tail) {
+            tail->next = w;
+        } else {
+            head = w;
+        }
+        tail = w;
+    }
+
+    // Returns true if w was in the list.
+    bool erase(ButexWaiter* w) {
+        if (w->container != this) return false;
+        if (w->prev) {
+            w->prev->next = w->next;
+        } else {
+            head = w->next;
+        }
+        if (w->next) {
+            w->next->prev = w->prev;
+        } else {
+            tail = w->prev;
+        }
+        w->container = nullptr;
+        w->next = w->prev = nullptr;
+        return true;
+    }
+
+    ButexWaiter* pop_front() {
+        ButexWaiter* w = head;
+        if (w) erase(w);
+        return w;
+    }
+};
+
+void wake_waiter_locked_popped(ButexWaiter* w) {
+    // w is already off the list; caller dropped the butex lock.
+    if (w->is_fiber) {
+        // Set state BEFORE requeue: the fiber may resume instantly on
+        // another worker and inspect it.
+        w->state.store(WAITER_WOKEN, std::memory_order_release);
+        fiber_requeue_meta(w->meta);
+    } else {
+        w->state.store(WAITER_WOKEN, std::memory_order_release);
+        w->pthread_word.store(1, std::memory_order_release);
+        futex_wake_private(&w->pthread_word, 1);
+    }
+}
+
+// Timer callback for timed waits: if the waiter is still enqueued, remove
+// and wake it with TIMEDOUT. Runs on the timer thread; synchronized with
+// wakers via the butex mutex, and with the waiter's stack lifetime via
+// TimerThread::unschedule's blocking guarantee.
+struct TimeoutArg {
+    Butex* b;
+    ButexWaiter* w;
+};
+
+void butex_timeout_cb(void* raw) {
+    TimeoutArg* ta = (TimeoutArg*)raw;
+    Butex* b = ta->b;
+    ButexWaiter* w = ta->w;
+    {
+        std::lock_guard<std::mutex> g(b->mu);
+        if (!b->erase(w)) return;  // already woken
+        w->state.store(WAITER_TIMEDOUT, std::memory_order_release);
+    }
+    if (w->is_fiber) {
+        fiber_requeue_meta(w->meta);
+    } else {
+        w->pthread_word.store(1, std::memory_order_release);
+        futex_wake_private(&w->pthread_word, 1);
+    }
+}
+
+// The publish-after-switch hook of the fiber wait path: runs on the main
+// context after the fiber has switched out; only then does the waiter become
+// visible to wakers.
+struct PublishArgs {
+    Butex* b;
+    ButexWaiter* w;
+    TimeoutArg ta;
+    bool timed;
+    int64_t abstime;
+    int expected_value;
+};
+
+void publish_waiter_cb(void* raw) {
+    PublishArgs* pa = (PublishArgs*)raw;
+    Butex* b = pa->b;
+    ButexWaiter* w = pa->w;
+    std::lock_guard<std::mutex> lk(b->mu);
+    if (b->value.load(std::memory_order_relaxed) != pa->expected_value) {
+        w->state.store(WAITER_CANCELLED, std::memory_order_release);
+        fiber_requeue_meta(w->meta);
+        return;
+    }
+    // Arm the timer BEFORE enqueueing, all under the butex lock: once a
+    // waker can pop w, w->timer_id is already set (the resumed fiber reads
+    // it), and the timeout callback blocks on this same lock so it cannot
+    // run before the enqueue either.
+    if (pa->timed) {
+        w->timer_id = TimerThread::singleton()->schedule(butex_timeout_cb,
+                                                         &pa->ta, pa->abstime);
+    }
+    b->enqueue(w);
+}
+
+int wait_pthread(Butex* b, int expected, const int64_t* abstime_us) {
+    ButexWaiter w;
+    w.is_fiber = false;
+    {
+        std::lock_guard<std::mutex> g(b->mu);
+        if (b->value.load(std::memory_order_relaxed) != expected) {
+            errno = EWOULDBLOCK;
+            return -1;
+        }
+        b->enqueue(&w);
+    }
+    while (w.pthread_word.load(std::memory_order_acquire) == 0) {
+        timespec ts;
+        timespec* ts_ptr = nullptr;
+        if (abstime_us != nullptr) {
+            const int64_t now = monotonic_time_us();
+            int64_t left = *abstime_us - now;
+            if (left <= 0) {
+                // Timed out: remove ourselves unless a waker got us first.
+                std::unique_lock<std::mutex> g(b->mu);
+                if (b->erase(&w)) {
+                    errno = ETIMEDOUT;
+                    return -1;
+                }
+                g.unlock();
+                // A waker popped us: it WILL set pthread_word shortly; spin
+                // on the futex until it does (keeps &w alive meanwhile).
+                while (w.pthread_word.load(std::memory_order_acquire) == 0) {
+                    futex_wait_private(&w.pthread_word, 0, nullptr);
+                }
+                return 0;
+            }
+            ts.tv_sec = left / 1000000;
+            ts.tv_nsec = (left % 1000000) * 1000;
+            ts_ptr = &ts;
+        }
+        futex_wait_private(&w.pthread_word, 0, ts_ptr);
+    }
+    return 0;
+}
+
+}  // namespace
+
+void* butex_create() { return new Butex; }
+
+void butex_destroy(void* butex) { delete (Butex*)butex; }
+
+std::atomic<int>* butex_word(void* butex) { return &((Butex*)butex)->value; }
+
+int butex_wait(void* butex, int expected_value, const int64_t* abstime_us) {
+    Butex* b = (Butex*)butex;
+    if (b->value.load(std::memory_order_acquire) != expected_value) {
+        errno = EWOULDBLOCK;
+        return -1;
+    }
+    TaskGroup* g = TaskGroup::tls_group();
+    if (g == nullptr || g->current() == nullptr) {
+        return wait_pthread(b, expected_value, abstime_us);
+    }
+
+    // Fiber path. The waiter is published to the butex list only AFTER the
+    // fiber has switched off its stack (the `remained` hook runs on the
+    // main context) — so a waker can never requeue a fiber that is still
+    // running (reference butex.cpp wait_for_butex via set_remained).
+    TaskMeta* m = g->current();
+    ButexWaiter w;
+    w.is_fiber = true;
+    w.tid = m->tid;
+    w.meta = m;
+    // All publish-hook state lives on this (parked) fiber's stack.
+    PublishArgs pa;
+    pa.b = b;
+    pa.w = &w;
+    pa.ta = TimeoutArg{b, &w};
+    pa.timed = abstime_us != nullptr;
+    pa.abstime = abstime_us ? *abstime_us : 0;
+    pa.expected_value = expected_value;
+    g->set_remained(publish_waiter_cb, &pa);
+    g->sched_park();
+
+    // Resumed. If a timer was armed, make sure its callback is not running
+    // before the stack-allocated waiter state goes out of scope.
+    if (pa.timed && w.timer_id != INVALID_TIMER_ID) {
+        TimerThread::singleton()->unschedule(w.timer_id);
+    }
+    const int st = w.state.load(std::memory_order_acquire);
+    if (st == WAITER_TIMEDOUT) {
+        errno = ETIMEDOUT;
+        return -1;
+    }
+    if (st == WAITER_CANCELLED) {
+        errno = EWOULDBLOCK;
+        return -1;
+    }
+    return 0;
+}
+
+int butex_wake(void* butex) {
+    Butex* b = (Butex*)butex;
+    ButexWaiter* w;
+    {
+        std::lock_guard<std::mutex> g(b->mu);
+        w = b->pop_front();
+    }
+    if (w == nullptr) return 0;
+    wake_waiter_locked_popped(w);
+    return 1;
+}
+
+int butex_wake_all(void* butex) {
+    Butex* b = (Butex*)butex;
+    int n = 0;
+    while (true) {
+        ButexWaiter* w;
+        {
+            std::lock_guard<std::mutex> g(b->mu);
+            w = b->pop_front();
+        }
+        if (w == nullptr) break;
+        wake_waiter_locked_popped(w);
+        ++n;
+    }
+    return n;
+}
+
+}  // namespace tpurpc

@@ -1,0 +1,34 @@
+// butex: a futex for fibers — a 32-bit word plus a waiter list. Fibers park
+// on it without blocking their worker pthread; plain pthreads can wait on
+// the same butex (they fall back to a real futex), so sync primitives work
+// identically inside and outside workers.
+//
+// Modeled on reference src/bthread/butex.h:41-84 / butex.cpp (pthread
+// waiters butex.cpp:81-143). ALL higher synchronization in this framework —
+// FiberMutex, cond, countdown, fiber join, CallId, Socket waits — builds on
+// these four calls.
+#pragma once
+
+#include <atomic>
+#include <ctime>
+
+namespace tpurpc {
+
+// Create/destroy a butex (the returned handle owns a 32-bit word).
+void* butex_create();
+void butex_destroy(void* butex);
+
+// The 32-bit word (value is user-controlled).
+std::atomic<int>* butex_word(void* butex);
+
+// Park the caller until woken, iff *word == expected_value at publish time.
+// abstime (monotonic_time_us clock, microseconds) may be null for infinite.
+// Returns 0 when woken; -1 with errno EWOULDBLOCK if the value didn't match,
+// ETIMEDOUT on timeout.
+int butex_wait(void* butex, int expected_value, const int64_t* abstime_us);
+
+// Wake up to one / all waiters. Returns the number woken.
+int butex_wake(void* butex);
+int butex_wake_all(void* butex);
+
+}  // namespace tpurpc

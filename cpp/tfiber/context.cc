@@ -1,0 +1,38 @@
+#include "tfiber/context.h"
+
+#include <cstdlib>
+#include <cstring>
+
+namespace tpurpc {
+
+namespace {
+// Safety net: a fresh context's entry function must never return; if it
+// does, `ret` lands here.
+void fiber_entry_returned() { abort(); }
+}  // namespace
+
+fcontext_t tf_make_fcontext(void* stack_base, size_t size, void (*fn)(void*)) {
+    // Stack grows down. Align the top to 16 bytes.
+    uintptr_t top = ((uintptr_t)stack_base + size) & ~(uintptr_t)15;
+    // Reserve the saved-register frame (0x40 bytes, layout in context.S)
+    // plus one slot above rip for the safety-net return address.
+    uintptr_t sp = top - 0x48;
+    uint64_t* slots = (uint64_t*)sp;
+    // mxcsr/x87cw: capture the current thread's control words.
+    uint32_t mxcsr;
+    uint16_t fcw;
+    __asm__ volatile("stmxcsr %0" : "=m"(mxcsr));
+    __asm__ volatile("fnstcw %0" : "=m"(fcw));
+    slots[0] = (uint64_t)mxcsr | ((uint64_t)fcw << 32);
+    slots[1] = 0;  // r12
+    slots[2] = 0;  // r13
+    slots[3] = 0;  // r14
+    slots[4] = 0;  // r15
+    slots[5] = 0;  // rbx
+    slots[6] = 0;  // rbp
+    slots[7] = (uint64_t)(void*)fn;  // rip
+    slots[8] = (uint64_t)(void*)fiber_entry_returned;
+    return (fcontext_t)sp;
+}
+
+}  // namespace tpurpc

@@ -1,0 +1,33 @@
+// Fiber context switching (x86_64 SysV).
+//
+// The reference uses boost.context-derived per-arch assembly
+// (src/bthread/context.cpp:17-148, bthread_jump_fcontext /
+// bthread_make_fcontext). We implement our own minimal variant for x86_64
+// (TPU-VM hosts are x86_64/aarch64; this image is x86_64): a context is just
+// a stack pointer; switching saves the 6 callee-saved GPRs + return address
+// on the old stack and restores them from the new stack.
+//
+// FP/SSE state: per SysV ABI all xmm registers are caller-saved and the
+// x87/mxcsr control words are rarely changed; like boost's fcontext we also
+// save/restore mxcsr + x87cw to be safe in code that toggles rounding modes.
+#pragma once
+
+#include <cstddef>
+#include <cstdint>
+
+namespace tpurpc {
+
+// Opaque context: points into the fiber's stack where registers are saved.
+using fcontext_t = void*;
+
+extern "C" {
+// Switch from the current context (saved to *from) to `to`. `arg` appears as
+// the return value in the resumed context / first argument of a fresh one.
+void* tf_jump_fcontext(fcontext_t* from, fcontext_t to, void* arg);
+}
+
+// Build a fresh context on [stack_base, stack_base+size) that will call
+// fn(arg_from_first_jump) when first jumped to. fn must never return.
+fcontext_t tf_make_fcontext(void* stack_base, size_t size, void (*fn)(void*));
+
+}  // namespace tpurpc

@@ -1,0 +1,59 @@
+// Public fiber API: M:N user-space threads over a work-stealing scheduler.
+//
+// Mirrors the reference's bthread C API (src/bthread/bthread.h:
+// bthread_start_urgent / bthread_start_background / bthread_join /
+// bthread_yield / bthread_usleep / bthread_self) with tpurpc naming. Every
+// I/O callback and user service method in this framework runs on a fiber.
+#pragma once
+
+#include <cstdint>
+
+namespace tpurpc {
+
+// fiber_t = (version << 32) | resource-pool slot; 0 = invalid.
+using fiber_t = uint64_t;
+constexpr fiber_t INVALID_FIBER = 0;
+
+struct FiberAttr {
+    int stack_type = 1;  // STACK_TYPE_NORMAL
+};
+
+constexpr FiberAttr FIBER_ATTR_NORMAL = {1};
+constexpr FiberAttr FIBER_ATTR_SMALL = {0};
+constexpr FiberAttr FIBER_ATTR_LARGE = {2};
+
+// Start a fiber. `urgent` hints the scheduler to run it ASAP (the caller of
+// start_background keeps running; reference bthread.h start_urgent vs
+// start_background).
+int fiber_start_background(fiber_t* tid, const FiberAttr* attr,
+                           void* (*fn)(void*), void* arg);
+int fiber_start_urgent(fiber_t* tid, const FiberAttr* attr,
+                       void* (*fn)(void*), void* arg);
+
+// Wait for fiber termination. Returns 0; joining a dead/invalid tid
+// returns 0 immediately (same contract as bthread_join).
+int fiber_join(fiber_t tid, void** ret);
+
+// True while the fiber exists and has not finished.
+bool fiber_exists(fiber_t tid);
+
+// Current fiber id; INVALID_FIBER when called outside a worker.
+fiber_t fiber_self();
+
+// Cooperative reschedule.
+void fiber_yield();
+
+// Sleep without blocking the worker thread.
+int fiber_usleep(int64_t us);
+
+// True if the calling thread is a fiber worker (i.e. fiber context).
+bool is_running_on_fiber_worker();
+
+// Scheduler control.
+// Start the scheduler with `num_workers` worker pthreads (idempotent;
+// auto-started on first fiber_start with a default from flag
+// fiber_worker_count).
+void fiber_set_worker_count(int num_workers);
+int fiber_get_worker_count();
+
+}  // namespace tpurpc

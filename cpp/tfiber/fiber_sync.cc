@@ -1,0 +1,106 @@
+#include "tfiber/fiber_sync.h"
+
+#include <cerrno>
+
+namespace tpurpc {
+
+// ---------------- FiberMutex ----------------
+
+FiberMutex::FiberMutex() { butex_ = butex_create(); }
+FiberMutex::~FiberMutex() { butex_destroy(butex_); }
+
+bool FiberMutex::try_lock() {
+    std::atomic<int>* w = butex_word(butex_);
+    int expected = 0;
+    return w->compare_exchange_strong(expected, 1, std::memory_order_acquire,
+                                      std::memory_order_relaxed);
+}
+
+void FiberMutex::lock() {
+    std::atomic<int>* w = butex_word(butex_);
+    int expected = 0;
+    if (w->compare_exchange_strong(expected, 1, std::memory_order_acquire,
+                                   std::memory_order_relaxed)) {
+        return;
+    }
+    // Contended: advertise waiters (state 2) and park.
+    while (w->exchange(2, std::memory_order_acquire) != 0) {
+        butex_wait(butex_, 2, nullptr);
+    }
+}
+
+void FiberMutex::unlock() {
+    std::atomic<int>* w = butex_word(butex_);
+    const int prev = w->exchange(0, std::memory_order_release);
+    if (prev == 2) {
+        butex_wake(butex_);
+    }
+}
+
+// ---------------- FiberCond ----------------
+
+FiberCond::FiberCond() { butex_ = butex_create(); }
+FiberCond::~FiberCond() { butex_destroy(butex_); }
+
+void FiberCond::wait(FiberMutex& mu) { wait_until(mu, 0); }
+
+int FiberCond::wait_until(FiberMutex& mu, int64_t abstime_us) {
+    std::atomic<int>* seq = butex_word(butex_);
+    const int expected = seq->load(std::memory_order_acquire);
+    mu.unlock();
+    int rc = 0;
+    const int64_t* abs_ptr = abstime_us > 0 ? &abstime_us : nullptr;
+    if (butex_wait(butex_, expected, abs_ptr) != 0 && errno == ETIMEDOUT) {
+        rc = ETIMEDOUT;
+    }
+    mu.lock();
+    return rc;
+}
+
+void FiberCond::notify_one() {
+    butex_word(butex_)->fetch_add(1, std::memory_order_release);
+    butex_wake(butex_);
+}
+
+void FiberCond::notify_all() {
+    butex_word(butex_)->fetch_add(1, std::memory_order_release);
+    butex_wake_all(butex_);
+}
+
+// ---------------- CountdownEvent ----------------
+
+CountdownEvent::CountdownEvent(int initial) {
+    butex_ = butex_create();
+    butex_word(butex_)->store(initial, std::memory_order_relaxed);
+}
+
+CountdownEvent::~CountdownEvent() { butex_destroy(butex_); }
+
+void CountdownEvent::signal(int n) {
+    std::atomic<int>* w = butex_word(butex_);
+    const int prev = w->fetch_sub(n, std::memory_order_release);
+    if (prev - n <= 0) {
+        butex_wake_all(butex_);
+    }
+}
+
+void CountdownEvent::add_count(int n) {
+    butex_word(butex_)->fetch_add(n, std::memory_order_release);
+}
+
+void CountdownEvent::reset(int n) {
+    butex_word(butex_)->store(n, std::memory_order_release);
+}
+
+int CountdownEvent::wait(const int64_t* abstime_us) {
+    std::atomic<int>* w = butex_word(butex_);
+    while (true) {
+        const int v = w->load(std::memory_order_acquire);
+        if (v <= 0) return 0;
+        if (butex_wait(butex_, v, abstime_us) != 0 && errno == ETIMEDOUT) {
+            return ETIMEDOUT;
+        }
+    }
+}
+
+}  // namespace tpurpc

@@ -1,0 +1,74 @@
+// Fiber-aware synchronization primitives built on butex: mutex, condition
+// variable, countdown event, semaphore. Usable from fibers AND plain
+// pthreads (butex handles both waiter kinds), matching the reference's
+// bthread_mutex/bthread_cond/CountdownEvent (src/bthread/mutex.cpp,
+// condition_variable.cpp, countdown_event.cpp).
+#pragma once
+
+#include <cstdint>
+
+#include "tfiber/butex.h"
+
+namespace tpurpc {
+
+class FiberMutex {
+public:
+    FiberMutex();
+    ~FiberMutex();
+    FiberMutex(const FiberMutex&) = delete;
+    FiberMutex& operator=(const FiberMutex&) = delete;
+
+    void lock();
+    void unlock();
+    bool try_lock();
+
+    void* butex() { return butex_; }
+
+private:
+    // value: 0 unlocked, 1 locked no waiters, 2 locked with (possible)
+    // waiters — the classic futex mutex protocol.
+    void* butex_;
+};
+
+class FiberMutexGuard {
+public:
+    explicit FiberMutexGuard(FiberMutex& mu) : mu_(mu) { mu_.lock(); }
+    ~FiberMutexGuard() { mu_.unlock(); }
+
+private:
+    FiberMutex& mu_;
+};
+
+class FiberCond {
+public:
+    FiberCond();
+    ~FiberCond();
+
+    // mu must be held; atomically releases it while waiting.
+    void wait(FiberMutex& mu);
+    // Returns 0, or ETIMEDOUT.
+    int wait_until(FiberMutex& mu, int64_t abstime_us);
+    void notify_one();
+    void notify_all();
+
+private:
+    void* butex_;  // value = notification sequence number
+};
+
+class CountdownEvent {
+public:
+    explicit CountdownEvent(int initial = 1);
+    ~CountdownEvent();
+
+    void signal(int n = 1);
+    void add_count(int n = 1);
+    void reset(int n);
+    // Block until the count reaches zero. Returns 0, or ETIMEDOUT when
+    // abstime_us (monotonic) passes first.
+    int wait(const int64_t* abstime_us = nullptr);
+
+private:
+    void* butex_;
+};
+
+}  // namespace tpurpc

@@ -1,0 +1,81 @@
+#include "tfiber/stack.h"
+
+#include <sys/mman.h>
+#include <unistd.h>
+
+#include <mutex>
+#include <vector>
+
+#include "tbase/logging.h"
+
+namespace tpurpc {
+
+size_t stack_size_of(int type) {
+    switch (type) {
+        case STACK_TYPE_SMALL: return 32 * 1024;
+        case STACK_TYPE_LARGE: return 1024 * 1024;
+        default: return 256 * 1024;
+    }
+}
+
+namespace {
+
+struct StackPool {
+    std::mutex mu;
+    std::vector<void*> free_bases;  // low addresses incl. guard page
+};
+
+StackPool g_pools[3];
+
+constexpr size_t kGuard = 4096;
+
+void* allocate_raw(int type) {
+    StackPool& pool = g_pools[type];
+    {
+        std::lock_guard<std::mutex> g(pool.mu);
+        if (!pool.free_bases.empty()) {
+            void* base = pool.free_bases.back();
+            pool.free_bases.pop_back();
+            return base;
+        }
+    }
+    const size_t total = stack_size_of(type) + kGuard;
+    void* mem = mmap(nullptr, total, PROT_READ | PROT_WRITE,
+                     MAP_PRIVATE | MAP_ANONYMOUS | MAP_STACK, -1, 0);
+    if (mem == MAP_FAILED) return nullptr;
+    // Guard page at the low end (stacks grow down into it -> SIGSEGV
+    // instead of silent corruption).
+    if (mprotect(mem, kGuard, PROT_NONE) != 0) {
+        munmap(mem, total);
+        return nullptr;
+    }
+    return mem;
+}
+
+}  // namespace
+
+bool get_stack(StackStorage* s, int type, void (*entry)(void*)) {
+    void* raw = allocate_raw(type);
+    if (raw == nullptr) return false;
+    s->base = (char*)raw + kGuard;
+    s->size = stack_size_of(type);
+    s->type = type;
+    s->context = tf_make_fcontext(s->base, s->size, entry);
+    return true;
+}
+
+void return_stack(StackStorage* s) {
+    if (s->base == nullptr) return;
+    void* raw = (char*)s->base - kGuard;
+    StackPool& pool = g_pools[s->type];
+    std::lock_guard<std::mutex> g(pool.mu);
+    if (pool.free_bases.size() < 64) {
+        pool.free_bases.push_back(raw);
+    } else {
+        munmap(raw, stack_size_of(s->type) + kGuard);
+    }
+    s->base = nullptr;
+    s->context = nullptr;
+}
+
+}  // namespace tpurpc

@@ -1,0 +1,33 @@
+// Guard-paged, pooled fiber stacks.
+// Modeled on reference src/bthread/stack.h:56-75: SMALL/NORMAL/LARGE mmap'd
+// stacks with a guard page, pooled for reuse (stack allocation dominates
+// fiber-start cost otherwise).
+#pragma once
+
+#include <cstddef>
+
+#include "tfiber/context.h"
+
+namespace tpurpc {
+
+enum StackType {
+    STACK_TYPE_SMALL = 0,   // 32KB
+    STACK_TYPE_NORMAL = 1,  // 256KB (default)
+    STACK_TYPE_LARGE = 2,   // 1MB
+};
+
+struct StackStorage {
+    void* base = nullptr;   // usable low address (above guard page)
+    size_t size = 0;        // usable bytes
+    int type = STACK_TYPE_NORMAL;
+    fcontext_t context = nullptr;  // saved context when suspended
+};
+
+// Get a pooled stack of `type`, with its entry context built for `entry`.
+// Returns false on mmap failure.
+bool get_stack(StackStorage* s, int type, void (*entry)(void*));
+void return_stack(StackStorage* s);
+
+size_t stack_size_of(int type);
+
+}  // namespace tpurpc

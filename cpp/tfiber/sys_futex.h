@@ -1,0 +1,25 @@
+// Raw futex syscall wrappers (reference src/bthread/sys_futex.h).
+#pragma once
+
+#include <linux/futex.h>
+#include <sys/syscall.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <cerrno>
+#include <ctime>
+
+namespace tpurpc {
+
+inline int futex_wait_private(std::atomic<int>* addr, int expected,
+                              const timespec* timeout) {
+    return (int)syscall(SYS_futex, addr, FUTEX_WAIT_PRIVATE, expected, timeout,
+                        nullptr, 0);
+}
+
+inline int futex_wake_private(std::atomic<int>* addr, int nwake) {
+    return (int)syscall(SYS_futex, addr, FUTEX_WAKE_PRIVATE, nwake, nullptr,
+                        nullptr, 0);
+}
+
+}  // namespace tpurpc

@@ -1,0 +1,351 @@
+#include "tfiber/task_group.h"
+
+#include <unistd.h>
+
+#include <cerrno>
+
+#include "tbase/fast_rand.h"
+#include "tbase/time.h"
+#include "tbase/flags.h"
+#include "tbase/logging.h"
+#include "tbase/resource_pool.h"
+#include "tfiber/butex.h"
+#include "tfiber/timer_thread.h"
+
+DEFINE_int32(fiber_worker_count, 4, "number of fiber worker pthreads");
+
+namespace tpurpc {
+
+namespace {
+thread_local TaskGroup* tls_task_group = nullptr;
+}  // namespace
+
+TaskGroup* TaskGroup::tls_group() { return tls_task_group; }
+
+bool is_running_on_fiber_worker() {
+    TaskGroup* g = tls_task_group;
+    return g != nullptr && g->current() != nullptr;
+}
+
+// ---------------- TaskGroup ----------------
+
+TaskGroup::TaskGroup(TaskControl* control, int index)
+    : control_(control), index_(index), steal_seed_(fast_rand() | 1) {
+    CHECK_EQ(rq_.init(1024), 0);
+}
+
+void TaskGroup::run_main_task() {
+    tls_task_group = this;
+    while (true) {
+        TaskMeta* m = wait_task();
+        if (m == nullptr) break;  // stopped
+        sched_to(m);
+        // Back on the main context: first run the publish-after-switch
+        // hook of the fiber that just switched out (butex parking, yield
+        // requeue) — it must run before we pick another task.
+        if (remained_fn_ != nullptr) {
+            void (*fn)(void*) = remained_fn_;
+            void* arg = remained_arg_;
+            remained_fn_ = nullptr;
+            remained_arg_ = nullptr;
+            fn(arg);
+        }
+        if (cur_ended_) {
+            // The fiber finished: recycle stack + slot, wake joiners.
+            TaskMeta* dead = cur_meta_;
+            cur_meta_ = nullptr;
+            cur_ended_ = false;
+            return_stack(&dead->stack);
+            std::atomic<int>* vb = butex_word(dead->version_butex);
+            const fiber_t dead_tid = dead->tid;
+            vb->fetch_add(1, std::memory_order_release);
+            butex_wake_all(dead->version_butex);
+            control_->nfibers.fetch_sub(1, std::memory_order_relaxed);
+            return_resource<TaskMeta>((ResourceId)((dead_tid & 0xffffffff) - 1));
+        } else {
+            cur_meta_ = nullptr;
+        }
+    }
+}
+
+TaskMeta* TaskGroup::wait_task() {
+    while (true) {
+        if (control_->stopped()) return nullptr;
+        TaskMeta* m = nullptr;
+        if (rq_.pop(&m)) return m;
+        if (control_->pop_remote(&m)) return m;
+        if (control_->steal_task(&m, &steal_seed_, index_)) return m;
+        const ParkingLot::State st = control_->parking_lot().get_state();
+        // Re-check after reading the state so a concurrent signal is never
+        // missed (the futex value would have changed).
+        if (rq_.pop(&m) || control_->pop_remote(&m) ||
+            control_->steal_task(&m, &steal_seed_, index_)) {
+            return m;
+        }
+        control_->parking_lot().wait(st);
+    }
+}
+
+void TaskGroup::sched_to(TaskMeta* next) {
+    cur_meta_ = next;
+    cur_ended_ = false;
+    tf_jump_fcontext(&main_ctx_, next->stack.context, next);
+}
+
+void TaskGroup::fiber_entry(void* arg) {
+    TaskMeta* m = (TaskMeta*)arg;
+    m->ret = m->fn(m->arg);
+    TaskGroup::tls_group()->exit_current();
+}
+
+void TaskGroup::exit_current() {
+    cur_ended_ = true;
+    TaskMeta* m = cur_meta_;
+    tf_jump_fcontext(&m->stack.context, main_ctx_, nullptr);
+    CHECK(false) << "dead fiber resumed";
+}
+
+void TaskGroup::sched_park() {
+    TaskMeta* m = cur_meta_;
+    tf_jump_fcontext(&m->stack.context, main_ctx_, nullptr);
+    // Resumed later on possibly a DIFFERENT worker; re-read tls_group
+    // callers must not cache `this` across sched_park (they don't: all
+    // callers go through TaskGroup::tls_group()).
+}
+
+namespace {
+void requeue_meta_cb(void* arg) {
+    TaskControl::singleton()->ready_to_run((TaskMeta*)arg);
+}
+}  // namespace
+
+void TaskGroup::yield() {
+    TaskMeta* m = cur_meta_;
+    set_remained(requeue_meta_cb, m);
+    sched_park();
+}
+
+void TaskGroup::ready_to_run(TaskMeta* m) {
+    if (!rq_.push(m)) {
+        control_->ready_to_run_remote(m);
+        return;
+    }
+    control_->parking_lot().signal(1);
+}
+
+// ---------------- TaskControl ----------------
+
+TaskControl* TaskControl::singleton() {
+    static TaskControl* c = new TaskControl;
+    return c;
+}
+
+void TaskControl::ensure_started() {
+    if (started_.load(std::memory_order_acquire)) return;
+    std::lock_guard<std::mutex> g(start_mu_);
+    if (started_.load(std::memory_order_relaxed)) return;
+    concurrency_ = FLAGS_fiber_worker_count.get();
+    if (concurrency_ < 1) concurrency_ = 1;
+    groups_.reserve(concurrency_);
+    for (int i = 0; i < concurrency_; ++i) {
+        groups_.push_back(new TaskGroup(this, i));
+    }
+    for (int i = 0; i < concurrency_; ++i) {
+        TaskGroup* tg = groups_[i];
+        workers_.emplace_back([tg] { tg->run_main_task(); });
+    }
+    started_.store(true, std::memory_order_release);
+}
+
+void TaskControl::set_concurrency(int n) {
+    std::lock_guard<std::mutex> g(start_mu_);
+    if (!started_.load(std::memory_order_relaxed)) {
+        FLAGS_fiber_worker_count.set(n);
+    }
+    // Changing after start is not supported yet (reference supports
+    // add_workers; tracked as a TODO).
+}
+
+void TaskControl::ready_to_run(TaskMeta* m) {
+    TaskGroup* g = tls_task_group;
+    if (g != nullptr) {
+        g->ready_to_run(m);
+    } else {
+        ready_to_run_remote(m);
+    }
+}
+
+void TaskControl::ready_to_run_remote(TaskMeta* m) {
+    {
+        std::lock_guard<std::mutex> g(remote_mu_);
+        remote_q_.push_back(m);
+    }
+    parking_lot_.signal(1);
+}
+
+bool TaskControl::pop_remote(TaskMeta** m) {
+    std::lock_guard<std::mutex> g(remote_mu_);
+    if (remote_q_.empty()) return false;
+    *m = remote_q_.front();
+    remote_q_.pop_front();
+    return true;
+}
+
+bool TaskControl::steal_task(TaskMeta** m, uint64_t* seed, int exclude) {
+    const size_t n = groups_.size();
+    if (n <= 1) return false;
+    // xorshift over group indices, starting at a pseudo-random offset.
+    uint64_t s = *seed;
+    s ^= s << 13;
+    s ^= s >> 7;
+    s ^= s << 17;
+    *seed = s;
+    const size_t start = (size_t)(s % n);
+    for (size_t i = 0; i < n; ++i) {
+        const size_t idx = (start + i) % n;
+        if ((int)idx == exclude) continue;
+        if (groups_[idx]->steal(m)) return true;
+    }
+    return false;
+}
+
+void TaskControl::stop_and_join() {
+    stopped_.store(true, std::memory_order_release);
+    parking_lot_.stop();
+    for (auto& t : workers_) {
+        if (t.joinable()) t.join();
+    }
+}
+
+// ---------------- fiber API ----------------
+
+TaskMeta* fiber_meta_of(fiber_t tid) {
+    if (tid == INVALID_FIBER) return nullptr;
+    const ResourceId slot = (ResourceId)((tid & 0xffffffff) - 1);
+    TaskMeta* m = address_resource<TaskMeta>(slot);
+    if (m == nullptr || m->version_butex == nullptr) return nullptr;
+    const uint32_t expect_version = (uint32_t)(tid >> 32);
+    if ((uint32_t)butex_word(m->version_butex)
+            ->load(std::memory_order_acquire) != expect_version) {
+        return nullptr;
+    }
+    return m;
+}
+
+void fiber_requeue_meta(TaskMeta* m) {
+    TaskControl::singleton()->ready_to_run(m);
+}
+
+void fiber_requeue(fiber_t tid) {
+    TaskMeta* m = fiber_meta_of(tid);
+    if (m != nullptr) fiber_requeue_meta(m);
+}
+
+static int start_fiber_impl(fiber_t* tid, const FiberAttr* attr,
+                            void* (*fn)(void*), void* arg) {
+    TaskControl* c = TaskControl::singleton();
+    c->ensure_started();
+    ResourceId slot;
+    TaskMeta* m = get_resource<TaskMeta>(&slot);
+    if (m == nullptr) return -1;
+    if (m->version_butex == nullptr) {
+        m->version_butex = butex_create();
+    }
+    m->version =
+        (uint32_t)butex_word(m->version_butex)->load(std::memory_order_relaxed);
+    m->fn = fn;
+    m->arg = arg;
+    m->ret = nullptr;
+    m->stack_type = attr ? attr->stack_type : STACK_TYPE_NORMAL;
+    m->tid = ((fiber_t)m->version << 32) | (fiber_t)(slot + 1);
+    if (!get_stack(&m->stack, m->stack_type, TaskGroup::fiber_entry)) {
+        return_resource<TaskMeta>(slot);
+        return -1;
+    }
+    if (tid) *tid = m->tid;
+    c->nfibers.fetch_add(1, std::memory_order_relaxed);
+    c->ready_to_run(m);
+    return 0;
+}
+
+int fiber_start_background(fiber_t* tid, const FiberAttr* attr,
+                           void* (*fn)(void*), void* arg) {
+    return start_fiber_impl(tid, attr, fn, arg);
+}
+
+int fiber_start_urgent(fiber_t* tid, const FiberAttr* attr, void* (*fn)(void*),
+                       void* arg) {
+    // Same queueing; urgency is a scheduling hint we don't separate yet
+    // (reference runs the new bthread immediately and requeues the caller,
+    // task_group.cpp sched_to path — tracked as a TODO).
+    return start_fiber_impl(tid, attr, fn, arg);
+}
+
+int fiber_join(fiber_t tid, void** ret) {
+    if (ret) *ret = nullptr;
+    if (tid == INVALID_FIBER) return 0;
+    if (tid == fiber_self()) return EINVAL;  // self-join would park forever
+    const ResourceId slot = (ResourceId)((tid & 0xffffffff) - 1);
+    TaskMeta* m = address_resource<TaskMeta>(slot);
+    if (m == nullptr || m->version_butex == nullptr) return 0;
+    const uint32_t expect_version = (uint32_t)(tid >> 32);
+    std::atomic<int>* word = butex_word(m->version_butex);
+    while ((uint32_t)word->load(std::memory_order_acquire) == expect_version) {
+        butex_wait(m->version_butex, (int)expect_version, nullptr);
+    }
+    return 0;
+}
+
+bool fiber_exists(fiber_t tid) { return fiber_meta_of(tid) != nullptr; }
+
+fiber_t fiber_self() {
+    TaskGroup* g = tls_task_group;
+    if (g == nullptr || g->current() == nullptr) return INVALID_FIBER;
+    return g->current()->tid;
+}
+
+void fiber_yield() {
+    TaskGroup* g = tls_task_group;
+    if (g == nullptr || g->current() == nullptr) {
+        std::this_thread::yield();
+        return;
+    }
+    g->yield();
+}
+
+namespace {
+void usleep_timer_cb(void* arg) { fiber_requeue((fiber_t)(uintptr_t)arg); }
+
+struct SleepArgs {
+    fiber_t tid;
+    int64_t abstime;
+};
+
+void usleep_remained_cb(void* raw) {
+    SleepArgs* sa = (SleepArgs*)raw;  // lives on the parked fiber's stack
+    TimerThread::singleton()->schedule(usleep_timer_cb,
+                                       (void*)(uintptr_t)sa->tid, sa->abstime);
+}
+}  // namespace
+
+int fiber_usleep(int64_t us) {
+    TaskGroup* g = tls_task_group;
+    if (g == nullptr || g->current() == nullptr) {
+        ::usleep((useconds_t)us);
+        return 0;
+    }
+    TaskMeta* m = g->current();
+    SleepArgs sa{m->tid, monotonic_time_us() + us};
+    g->set_remained(usleep_remained_cb, &sa);
+    g->sched_park();
+    return 0;
+}
+
+void fiber_set_worker_count(int n) {
+    TaskControl::singleton()->set_concurrency(n);
+}
+int fiber_get_worker_count() {
+    return TaskControl::singleton()->concurrency();
+}
+
+}  // namespace tpurpc

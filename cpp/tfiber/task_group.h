@@ -1,0 +1,127 @@
+// TaskGroup: one per worker pthread — local run queue + scheduling loop.
+// TaskControl: the global scheduler owning all groups.
+//
+// Modeled on reference src/bthread/task_group.{h,cpp} (run_main_task
+// task_group.cpp:199, sched_to :703, ready_to_run[_remote] task_group.h:184)
+// and src/bthread/task_control.{h,cpp} (steal_task :528, signal_task :564).
+//
+// Scheduling model (simplified vs the reference, same semantics): every
+// worker has a "main context" (the pthread stack). Fibers always switch
+// back to the main context when they yield/park/end; the main loop then runs
+// the pending `remained` closure (the publish-after-switch hook that makes
+// butex parking race-free) and picks the next fiber.
+#pragma once
+
+#include <atomic>
+#include <deque>
+#include <functional>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+#include "tfiber/context.h"
+#include "tfiber/parking_lot.h"
+#include "tfiber/task_meta.h"
+#include "tfiber/work_stealing_queue.h"
+
+namespace tpurpc {
+
+class TaskControl;
+
+class TaskGroup {
+public:
+    explicit TaskGroup(TaskControl* control, int index);
+
+    // The worker pthread body.
+    void run_main_task();
+
+    // Called from fibers running on this group's worker:
+    void yield();                      // requeue self, run others
+    void sched_park();                 // switch out; `remained` publishes us
+    // The publish-after-switch hook. Raw fn+arg (not std::function) so the
+    // scheduler's hottest path never heap-allocates; `arg` typically lives
+    // on the parked fiber's stack, which outlives the hook by construction
+    // (reference task_group.h set_remained has the same shape).
+    void set_remained(void (*fn)(void*), void* arg) {
+        remained_fn_ = fn;
+        remained_arg_ = arg;
+    }
+    void exit_current();               // current fiber is done (never returns)
+
+    // Enqueue a ready fiber from this worker thread.
+    void ready_to_run(TaskMeta* m);
+
+    TaskMeta* current() const { return cur_meta_; }
+    int index() const { return index_; }
+
+    // Steal interface for other groups.
+    bool steal(TaskMeta** m) { return rq_.steal(m); }
+
+    static TaskGroup* tls_group();
+
+    // Entry point of every fiber stack (public: stack.cc needs its address).
+    static void fiber_entry(void* arg);
+
+private:
+    friend class TaskControl;
+
+    TaskMeta* wait_task();             // pop/steal/park until a task or stop
+    void sched_to(TaskMeta* next);     // main context -> fiber
+
+    TaskControl* control_;
+    int index_;
+    WorkStealingQueue<TaskMeta*> rq_;
+    fcontext_t main_ctx_ = nullptr;
+    TaskMeta* cur_meta_ = nullptr;
+    void (*remained_fn_)(void*) = nullptr;
+    void* remained_arg_ = nullptr;
+    bool cur_ended_ = false;
+    uint64_t steal_seed_;
+    ParkingLot::State park_state_{0};
+};
+
+class TaskControl {
+public:
+    static TaskControl* singleton();
+
+    // Idempotent; starts `concurrency` workers on first call.
+    void ensure_started();
+    void set_concurrency(int n);
+    int concurrency() const { return concurrency_; }
+
+    // Enqueue from any thread (worker: local queue; other: remote queue).
+    void ready_to_run(TaskMeta* m);
+    // Push to the shared remote queue (non-worker producers).
+    void ready_to_run_remote(TaskMeta* m);
+
+    bool steal_task(TaskMeta** m, uint64_t* seed, int exclude_index);
+    bool pop_remote(TaskMeta** m);
+
+    ParkingLot& parking_lot() { return parking_lot_; }
+    bool stopped() const { return stopped_.load(std::memory_order_acquire); }
+    void stop_and_join();
+
+    std::atomic<int64_t> nfibers{0};  // live fibers (metrics)
+
+private:
+    TaskControl() = default;
+
+    std::atomic<bool> started_{false};
+    std::atomic<bool> stopped_{false};
+    std::mutex start_mu_;
+    int concurrency_ = 0;
+    std::vector<TaskGroup*> groups_;
+    std::vector<std::thread> workers_;
+    std::mutex remote_mu_;
+    std::deque<TaskMeta*> remote_q_;
+    ParkingLot parking_lot_;
+
+    friend class TaskGroup;
+};
+
+// ---- internal helpers shared with butex/fiber impl ----
+TaskMeta* fiber_meta_of(fiber_t tid);         // nullptr if stale
+void fiber_requeue(fiber_t tid);              // ready_to_run if still alive
+void fiber_requeue_meta(TaskMeta* m);
+
+}  // namespace tpurpc

@@ -1,0 +1,39 @@
+// TaskMeta: the fiber descriptor, pooled in a ResourcePool and addressed by
+// fiber_t = (version<<32)|slot. Modeled on reference src/bthread/task_meta.h.
+#pragma once
+
+#include <atomic>
+#include <cstdint>
+#include <functional>
+
+#include "tfiber/fiber.h"
+#include "tfiber/stack.h"
+
+namespace tpurpc {
+
+class TaskGroup;
+
+struct TaskMeta {
+    // Entry + result.
+    void* (*fn)(void*) = nullptr;
+    void* arg = nullptr;
+    void* ret = nullptr;
+
+    // Join/versioning: `version_butex` points to a pooled butex word whose
+    // value is the current version of this slot. fiber_join waits for it to
+    // move past the version embedded in the tid (reference task_meta.h
+    // version_butex; controller retries rely on the same scheme for ids).
+    uint32_t version = 0;
+    void* version_butex = nullptr;
+
+    StackStorage stack;
+    int stack_type = STACK_TYPE_NORMAL;
+    fiber_t tid = INVALID_FIBER;
+
+    // Fiber-local storage (lazily created; reference bthread keytables).
+    void* local_storage = nullptr;
+
+    bool about_to_quit = false;
+};
+
+}  // namespace tpurpc

@@ -1,0 +1,78 @@
+#include "tfiber/timer_thread.h"
+
+#include "tbase/time.h"
+
+namespace tpurpc {
+
+TimerThread* TimerThread::singleton() {
+    static TimerThread* t = new TimerThread;
+    return t;
+}
+
+TimerThread::TimerThread() { thread_ = std::thread([this] { Run(); }); }
+
+TimerId TimerThread::schedule(void (*fn)(void*), void* arg,
+                              int64_t abstime_us) {
+    std::unique_lock<std::mutex> lk(mu_);
+    if (stopped_) return INVALID_TIMER_ID;
+    const TimerId id = next_id_++;
+    const bool need_wake =
+        tasks_.empty() || abstime_us < tasks_.begin()->first;
+    auto it = tasks_.emplace(abstime_us, Task{fn, arg, id});
+    by_id_[id] = it;
+    if (need_wake) cv_.notify_one();
+    return id;
+}
+
+int TimerThread::unschedule(TimerId id) {
+    std::unique_lock<std::mutex> lk(mu_);
+    auto idx = by_id_.find(id);
+    if (idx != by_id_.end()) {
+        tasks_.erase(idx->second);
+        by_id_.erase(idx);
+        return 0;
+    }
+    if (running_id_ == id) {
+        // Block until the in-flight callback finishes (butex timed-wait
+        // safety depends on this).
+        run_done_cv_.wait(lk, [this, id] { return running_id_ != id; });
+        return 1;
+    }
+    return -1;  // already ran (or never existed)
+}
+
+void TimerThread::Run() {
+    std::unique_lock<std::mutex> lk(mu_);
+    while (!stopped_) {
+        if (tasks_.empty()) {
+            cv_.wait(lk);
+            continue;
+        }
+        const int64_t now = monotonic_time_us();
+        auto it = tasks_.begin();
+        if (it->first > now) {
+            cv_.wait_for(lk, std::chrono::microseconds(it->first - now));
+            continue;
+        }
+        Task task = it->second;
+        by_id_.erase(task.id);
+        tasks_.erase(it);
+        running_id_ = task.id;
+        lk.unlock();
+        task.fn(task.arg);
+        lk.lock();
+        running_id_ = 0;
+        run_done_cv_.notify_all();
+    }
+}
+
+void TimerThread::stop_and_join() {
+    {
+        std::lock_guard<std::mutex> g(mu_);
+        stopped_ = true;
+        cv_.notify_all();
+    }
+    if (thread_.joinable()) thread_.join();
+}
+
+}  // namespace tpurpc

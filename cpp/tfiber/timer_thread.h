@@ -1,0 +1,60 @@
+// TimerThread: one dedicated pthread running scheduled callbacks (RPC
+// timeouts, backup-request timers, fiber sleeps).
+// Modeled on reference src/bthread/timer_thread.h:53-82 (schedule /
+// unschedule); unschedule guarantees that on return the callback is either
+// cancelled or has finished running — the property butex timed-wait relies
+// on to keep stack-allocated waiters safe.
+#pragma once
+
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <map>
+#include <mutex>
+#include <thread>
+
+namespace tpurpc {
+
+using TimerId = uint64_t;
+constexpr TimerId INVALID_TIMER_ID = 0;
+
+class TimerThread {
+public:
+    static TimerThread* singleton();
+
+    // Run fn(arg) at absolute microsecond time `abstime_us`
+    // (monotonic_time_us clock). Returns a TimerId.
+    TimerId schedule(void (*fn)(void*), void* arg, int64_t abstime_us);
+
+    // Cancel. Returns 0 if cancelled before running; 1 if it already ran or
+    // was running (in which case this call BLOCKS until the callback
+    // completes); -1 if unknown.
+    int unschedule(TimerId id);
+
+    void stop_and_join();
+
+private:
+    TimerThread();
+    ~TimerThread() = default;
+    void Run();
+
+    struct Task {
+        void (*fn)(void*);
+        void* arg;
+        TimerId id;
+    };
+
+    std::mutex mu_;
+    std::condition_variable cv_;
+    std::condition_variable run_done_cv_;
+    std::multimap<int64_t, Task> tasks_;
+    // id -> position, so unschedule is O(log n) instead of a full scan
+    // (every timed wait that completes early cancels its timer).
+    std::map<TimerId, std::multimap<int64_t, Task>::iterator> by_id_;
+    TimerId next_id_ = 1;
+    TimerId running_id_ = 0;
+    bool stopped_ = false;
+    std::thread thread_;
+};
+
+}  // namespace tpurpc

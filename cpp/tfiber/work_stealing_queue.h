@@ -1,0 +1,94 @@
+// Chase-Lev work-stealing deque: owner pushes/pops at the bottom, thieves
+// steal from the top. Modeled on reference
+// src/bthread/work_stealing_queue.h:32 (same algorithm, bounded ring).
+#pragma once
+
+#include <atomic>
+#include <cstddef>
+
+#include "tbase/logging.h"
+
+namespace tpurpc {
+
+template <typename T>
+class WorkStealingQueue {
+public:
+    WorkStealingQueue() : buffer_(nullptr), cap_(0) {}
+    ~WorkStealingQueue() { delete[] buffer_; }
+
+    int init(size_t capacity) {
+        CHECK((capacity & (capacity - 1)) == 0) << "capacity must be 2^n";
+        buffer_ = new T[capacity];
+        cap_ = capacity;
+        return 0;
+    }
+
+    // Owner only. Returns false when full.
+    bool push(const T& v) {
+        const size_t b = bottom_.load(std::memory_order_relaxed);
+        const size_t t = top_.load(std::memory_order_acquire);
+        if (b >= t + cap_) return false;
+        buffer_[b & (cap_ - 1)] = v;
+        bottom_.store(b + 1, std::memory_order_release);
+        return true;
+    }
+
+    // Owner only.
+    bool pop(T* v) {
+        const size_t b = bottom_.load(std::memory_order_relaxed);
+        size_t t = top_.load(std::memory_order_relaxed);
+        if (t >= b) return false;  // empty
+        const size_t new_b = b - 1;
+        bottom_.store(new_b, std::memory_order_relaxed);
+        std::atomic_thread_fence(std::memory_order_seq_cst);
+        t = top_.load(std::memory_order_relaxed);
+        if (t > new_b) {
+            bottom_.store(b, std::memory_order_relaxed);
+            return false;
+        }
+        *v = buffer_[new_b & (cap_ - 1)];
+        if (t != new_b) return true;  // more than one item left
+        // Last item: race with stealers via CAS on top.
+        const bool won = top_.compare_exchange_strong(
+            t, t + 1, std::memory_order_seq_cst, std::memory_order_relaxed);
+        bottom_.store(b, std::memory_order_relaxed);
+        return won;
+    }
+
+    // Any thread. The seq_cst fence before (re)reading bottom_ pairs with
+    // the fence in pop(): without it a thief can act on a stale bottom and
+    // take the element the owner is popping without a CAS (the reference
+    // has the same fence, src/bthread/work_stealing_queue.h:115-125).
+    bool steal(T* v) {
+        size_t t = top_.load(std::memory_order_acquire);
+        std::atomic_thread_fence(std::memory_order_seq_cst);
+        size_t b = bottom_.load(std::memory_order_acquire);
+        while (t < b) {
+            *v = buffer_[t & (cap_ - 1)];
+            if (top_.compare_exchange_strong(t, t + 1,
+                                             std::memory_order_seq_cst,
+                                             std::memory_order_relaxed)) {
+                return true;
+            }
+            std::atomic_thread_fence(std::memory_order_seq_cst);
+            b = bottom_.load(std::memory_order_acquire);
+        }
+        return false;
+    }
+
+    size_t volatile_size() const {
+        const size_t b = bottom_.load(std::memory_order_relaxed);
+        const size_t t = top_.load(std::memory_order_relaxed);
+        return b > t ? b - t : 0;
+    }
+
+    size_t capacity() const { return cap_; }
+
+private:
+    std::atomic<size_t> bottom_{1};
+    std::atomic<size_t> top_{1};
+    T* buffer_;
+    size_t cap_;
+};
+
+}  // namespace tpurpc

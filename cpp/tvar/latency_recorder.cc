@@ -1,0 +1,65 @@
+#include "tvar/latency_recorder.h"
+
+namespace tpurpc {
+
+void LatencyRecorder::take_sample() {
+    Snap s;
+    s.count = count_.load(std::memory_order_relaxed);
+    s.sum = sum_.load(std::memory_order_relaxed);
+    s.max = live_max_.exchange(0, std::memory_order_relaxed);
+    s.hist.add_from(hist_);
+    std::lock_guard<std::mutex> g(mu_);
+    samples_.push_back(s);
+    while ((int)samples_.size() > window_size_ + 1) samples_.pop_front();
+}
+
+LatencyRecorder::Snap LatencyRecorder::window_delta() const {
+    std::lock_guard<std::mutex> g(mu_);
+    Snap d;
+    if (samples_.size() < 2) {
+        // Window not warmed up: report live totals so early reads show data.
+        d.count = count_.load(std::memory_order_relaxed);
+        d.sum = sum_.load(std::memory_order_relaxed);
+        d.max = live_max_.load(std::memory_order_relaxed);
+        // A sampler tick may already have folded the max into samples_.
+        for (const Snap& s : samples_) {
+            if (s.max > d.max) d.max = s.max;
+        }
+        d.hist.add_from(hist_);
+        return d;
+    }
+    const Snap& newest = samples_.back();
+    const Snap& oldest = samples_.front();
+    d.count = newest.count - oldest.count;
+    d.sum = newest.sum - oldest.sum;
+    d.hist = newest.hist;
+    d.hist.subtract(oldest.hist);
+    // Skip front(): its interval precedes the window start.
+    for (size_t i = 1; i < samples_.size(); ++i) {
+        if (samples_[i].max > d.max) d.max = samples_[i].max;
+    }
+    const int64_t live = live_max_.load(std::memory_order_relaxed);
+    if (live > d.max) d.max = live;
+    return d;
+}
+
+int64_t LatencyRecorder::qps() const {
+    std::unique_lock<std::mutex> g(mu_);
+    if (samples_.size() < 2) return 0;
+    const int64_t dc = samples_.back().count - samples_.front().count;
+    const int64_t secs = (int64_t)samples_.size() - 1;
+    return dc / (secs > 0 ? secs : 1);
+}
+
+int64_t LatencyRecorder::latency() const {
+    Snap d = window_delta();
+    return d.count > 0 ? d.sum / d.count : 0;
+}
+
+int64_t LatencyRecorder::latency_percentile(double q) const {
+    return window_delta().hist.quantile(q);
+}
+
+int64_t LatencyRecorder::max_latency() const { return window_delta().max; }
+
+}  // namespace tpurpc

@@ -1,0 +1,91 @@
+// LatencyRecorder: the one-liner latency metric — qps + avg + percentiles
+// (p50/p90/p99/p999) + max over a sliding window.
+//
+// Modeled on reference src/bvar/latency_recorder.h (LatencyRecorder
+// composes IntRecorder + Percentile + Maxer + qps windows). Ours composes
+// an Adder<count>, Adder<sum>, the log-histogram PercentileHistogram (see
+// percentile.h for the design tradeoff vs the reference's reservoirs), and
+// a windowed max.
+#pragma once
+
+#include <cstdint>
+#include <deque>
+#include <mutex>
+#include <sstream>
+
+#include "tbase/time.h"
+#include "tvar/percentile.h"
+#include "tvar/reducer.h"
+#include "tvar/window.h"
+
+namespace tpurpc {
+
+class LatencyRecorder : public Variable {
+public:
+    explicit LatencyRecorder(int window_size = 10)
+        : window_size_(window_size) {
+        sampler_id_ = SamplerCollector::singleton()->add([this] { take_sample(); });
+    }
+    ~LatencyRecorder() override {
+        SamplerCollector::singleton()->remove(sampler_id_);
+        hide();
+    }
+
+    // Record one latency (microseconds).
+    LatencyRecorder& operator<<(int64_t latency_us) {
+        count_.fetch_add(1, std::memory_order_relaxed);
+        sum_.fetch_add(latency_us, std::memory_order_relaxed);
+        hist_.add(latency_us);
+        // Windowed max: racy update is fine (metrics).
+        int64_t cur = live_max_.load(std::memory_order_relaxed);
+        while (latency_us > cur &&
+               !live_max_.compare_exchange_weak(cur, latency_us)) {
+        }
+        return *this;
+    }
+
+    int64_t count() const { return count_.load(std::memory_order_relaxed); }
+
+    // Window stats (over the last window_size seconds).
+    int64_t qps() const;
+    int64_t latency() const;  // avg us
+    int64_t latency_percentile(double q) const;
+    int64_t max_latency() const;
+
+    std::string get_description() const override {
+        std::ostringstream os;
+        os << "{\"qps\":" << qps() << ",\"avg_us\":" << latency()
+           << ",\"p50\":" << latency_percentile(0.5)
+           << ",\"p90\":" << latency_percentile(0.9)
+           << ",\"p99\":" << latency_percentile(0.99)
+           << ",\"p999\":" << latency_percentile(0.999)
+           << ",\"max\":" << max_latency() << ",\"count\":" << count() << "}";
+        return os.str();
+    }
+
+    // Expose under a family name (like the reference's
+    // LatencyRecorder::expose creating name_latency, name_qps, ...).
+    int expose(const std::string& prefix) { return Variable::expose(prefix); }
+
+private:
+    void take_sample();
+
+    struct Snap {
+        int64_t count = 0;
+        int64_t sum = 0;
+        int64_t max = 0;
+        HistogramSnapshot hist;
+    };
+    Snap window_delta() const;
+
+    int window_size_;
+    uint64_t sampler_id_ = 0;
+    std::atomic<int64_t> count_{0};
+    std::atomic<int64_t> sum_{0};
+    std::atomic<int64_t> live_max_{0};
+    PercentileHistogram hist_;
+    mutable std::mutex mu_;
+    std::deque<Snap> samples_;
+};
+
+}  // namespace tpurpc

@@ -1,0 +1,99 @@
+// Percentile: quantile estimation for latency values.
+//
+// The reference uses per-thread reservoir buckets combined on sample
+// (src/bvar/detail/percentile.h). We use a log-scale histogram instead:
+// fixed 256-bucket layout (32 octaves x 8 sub-buckets covering 1us..2^32us)
+// with relaxed atomic counters — O(1) contention-free writes, O(256) reads,
+// exact below 16, ~7% worst-case relative error above, and histograms merge
+// trivially across
+// threads and windows (prometheus-style). This trades the reference's exact
+// small-sample quantiles for simpler, faster, mergeable state.
+#pragma once
+
+#include <atomic>
+#include <cstdint>
+#include <cstring>
+
+namespace tpurpc {
+
+class PercentileHistogram {
+public:
+    static constexpr int kOctaves = 32;
+    static constexpr int kSub = 8;
+    static constexpr int kBuckets = kOctaves * kSub;
+
+    void add(int64_t value) {
+        buckets_[bucket_of(value)].fetch_add(1, std::memory_order_relaxed);
+    }
+
+    // Copy counters out (for window snapshots).
+    void snapshot(uint64_t out[kBuckets]) const {
+        for (int i = 0; i < kBuckets; ++i) {
+            out[i] = buckets_[i].load(std::memory_order_relaxed);
+        }
+    }
+
+    static int bucket_of(int64_t value) {
+        if (value < 0) value = 0;
+        uint64_t v = (uint64_t)value;
+        if (v < kSub) return (int)v;  // exact for tiny values
+        const int msb = 63 - __builtin_clzll(v);
+        const int octave = msb;
+        const int sub = (int)((v >> (msb - 3)) & 7);  // top 3 bits after msb
+        int idx = octave * kSub + sub;
+        return idx < kBuckets ? idx : kBuckets - 1;
+    }
+
+    // Representative value of a bucket: exact for values < 16 (octaves 0-3
+    // store them exactly), geometric midpoint above.
+    static int64_t bucket_value(int idx) {
+        if (idx < kSub) return idx;  // exact 0..7
+        const int octave = idx / kSub;
+        const int sub = idx % kSub;
+        const uint64_t base = (uint64_t)1 << octave;
+        // octave 3: base/8 == 1, base/16 == 0 -> exact 8..15.
+        return (int64_t)(base + (base / 8) * (uint64_t)sub + base / 16);
+    }
+
+private:
+    std::atomic<uint64_t> buckets_[kBuckets] = {};
+};
+
+// A plain (non-atomic) histogram snapshot with quantile math.
+struct HistogramSnapshot {
+    uint64_t buckets[PercentileHistogram::kBuckets] = {};
+
+    void add_from(const PercentileHistogram& h) {
+        uint64_t tmp[PercentileHistogram::kBuckets];
+        h.snapshot(tmp);
+        for (int i = 0; i < PercentileHistogram::kBuckets; ++i) {
+            buckets[i] += tmp[i];
+        }
+    }
+    void subtract(const HistogramSnapshot& other) {
+        for (int i = 0; i < PercentileHistogram::kBuckets; ++i) {
+            buckets[i] -= other.buckets[i];
+        }
+    }
+    uint64_t total() const {
+        uint64_t t = 0;
+        for (uint64_t b : buckets) t += b;
+        return t;
+    }
+    // q in (0,1]; returns representative latency value.
+    int64_t quantile(double q) const {
+        const uint64_t t = total();
+        if (t == 0) return 0;
+        uint64_t target = (uint64_t)(q * (double)t);
+        if (target >= t) target = t - 1;
+        uint64_t seen = 0;
+        for (int i = 0; i < PercentileHistogram::kBuckets; ++i) {
+            seen += buckets[i];
+            if (seen > target) return PercentileHistogram::bucket_value(i);
+        }
+        return PercentileHistogram::bucket_value(
+            PercentileHistogram::kBuckets - 1);
+    }
+};
+
+}  // namespace tpurpc

@@ -1,0 +1,70 @@
+#include "tvar/variable.h"
+
+#include <map>
+
+namespace tpurpc {
+
+namespace {
+struct Registry {
+    std::mutex mu;
+    std::map<std::string, Variable*> vars;
+};
+Registry* registry() {
+    static Registry* r = new Registry;
+    return r;
+}
+}  // namespace
+
+Variable::~Variable() { hide(); }
+
+int Variable::expose(const std::string& name) {
+    Registry* r = registry();
+    std::lock_guard<std::mutex> g(r->mu);
+    if (!name_.empty()) r->vars.erase(name_);
+    name_ = name;
+    if (!name.empty()) {
+        // Last expose wins (same as reference semantics with a warning).
+        r->vars[name] = this;
+    }
+    return 0;
+}
+
+void Variable::hide() {
+    if (name_.empty()) return;
+    Registry* r = registry();
+    std::lock_guard<std::mutex> g(r->mu);
+    auto it = r->vars.find(name_);
+    if (it != r->vars.end() && it->second == this) r->vars.erase(it);
+    name_.clear();
+}
+
+std::vector<std::string> Variable::list_exposed() {
+    Registry* r = registry();
+    std::lock_guard<std::mutex> g(r->mu);
+    std::vector<std::string> out;
+    out.reserve(r->vars.size());
+    for (auto& kv : r->vars) out.push_back(kv.first);
+    return out;
+}
+
+bool Variable::describe_exposed(const std::string& name, std::string* out) {
+    Registry* r = registry();
+    std::lock_guard<std::mutex> g(r->mu);
+    auto it = r->vars.find(name);
+    if (it == r->vars.end()) return false;
+    *out = it->second->get_description();
+    return true;
+}
+
+std::vector<std::pair<std::string, std::string>> Variable::dump_exposed() {
+    Registry* r = registry();
+    std::lock_guard<std::mutex> g(r->mu);
+    std::vector<std::pair<std::string, std::string>> out;
+    out.reserve(r->vars.size());
+    for (auto& kv : r->vars) {
+        out.emplace_back(kv.first, kv.second->get_description());
+    }
+    return out;
+}
+
+}  // namespace tpurpc

@@ -1,0 +1,42 @@
+// tvar: low-contention write-mostly metrics. Base Variable + named registry.
+//
+// Modeled on the reference's bvar (src/bvar/variable.h:118-197: expose /
+// dump_exposed / list_exposed). Every subsystem of the framework exposes
+// counters through this registry; the /vars builtin service and the
+// prometheus exporter render it.
+#pragma once
+
+#include <mutex>
+#include <string>
+#include <vector>
+
+namespace tpurpc {
+
+class Variable {
+public:
+    Variable() = default;
+    virtual ~Variable();
+    Variable(const Variable&) = delete;
+    Variable& operator=(const Variable&) = delete;
+
+    // Register under `name` (empty hides it). Re-exposing renames.
+    int expose(const std::string& name);
+    void hide();
+    const std::string& name() const { return name_; }
+    bool is_exposed() const { return !name_.empty(); }
+
+    // Render current value as text (the /vars format).
+    virtual std::string get_description() const = 0;
+
+    // Registry queries.
+    static std::vector<std::string> list_exposed();
+    // Returns false if no such variable.
+    static bool describe_exposed(const std::string& name, std::string* out);
+    // name -> description for every exposed variable.
+    static std::vector<std::pair<std::string, std::string>> dump_exposed();
+
+private:
+    std::string name_;
+};
+
+}  // namespace tpurpc

@@ -1,0 +1,42 @@
+#include "tvar/window.h"
+
+#include <thread>
+
+namespace tpurpc {
+
+SamplerCollector* SamplerCollector::singleton() {
+    static SamplerCollector* s = new SamplerCollector;
+    return s;
+}
+
+SamplerCollector::SamplerCollector() {
+    std::thread([this] { Run(); }).detach();
+}
+
+uint64_t SamplerCollector::add(SampleFn fn) {
+    std::lock_guard<std::mutex> g(mu_);
+    const uint64_t id = next_id_++;
+    fns_.emplace_back(id, std::move(fn));
+    return id;
+}
+
+void SamplerCollector::remove(uint64_t id) {
+    std::lock_guard<std::mutex> g(mu_);
+    for (size_t i = 0; i < fns_.size(); ++i) {
+        if (fns_[i].first == id) {
+            fns_[i] = std::move(fns_.back());
+            fns_.pop_back();
+            return;
+        }
+    }
+}
+
+void SamplerCollector::Run() {
+    while (true) {
+        std::this_thread::sleep_for(std::chrono::seconds(1));
+        std::lock_guard<std::mutex> g(mu_);
+        for (auto& p : fns_) p.second();
+    }
+}
+
+}  // namespace tpurpc

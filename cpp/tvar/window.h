@@ -1,0 +1,91 @@
+// Sampler + Window: time-windowed views over reducers.
+//
+// Modeled on reference src/bvar/detail/sampler.h:44-51 (a background thread
+// samples every windowed variable once per second) and src/bvar/window.h.
+// Window<R> shows the delta of reducer R over the last N seconds.
+#pragma once
+
+#include <cstdint>
+#include <deque>
+#include <functional>
+#include <mutex>
+#include <sstream>
+
+#include "tvar/variable.h"
+
+namespace tpurpc {
+
+// Background 1Hz sampling service.
+class SamplerCollector {
+public:
+    static SamplerCollector* singleton();
+    using SampleFn = std::function<void()>;
+    // Returns a registration id.
+    uint64_t add(SampleFn fn);
+    void remove(uint64_t id);
+
+private:
+    SamplerCollector();
+    void Run();
+    std::mutex mu_;
+    std::vector<std::pair<uint64_t, SampleFn>> fns_;
+    uint64_t next_id_ = 1;
+};
+
+// Window over a reducer-like R (requires R::get_value() returning T and
+// operator semantics where the windowed value = now - value_at(now - N)).
+template <typename R, typename T>
+class WindowBase : public Variable {
+public:
+    explicit WindowBase(R* reducer, int window_size = 10)
+        : reducer_(reducer), window_size_(window_size) {
+        sampler_id_ = SamplerCollector::singleton()->add([this] { take_sample(); });
+    }
+    ~WindowBase() override {
+        SamplerCollector::singleton()->remove(sampler_id_);
+        hide();
+    }
+
+    T get_value() const {
+        std::lock_guard<std::mutex> g(mu_);
+        if (samples_.empty()) return T();
+        return samples_.back().value - samples_.front().value;
+    }
+
+    // Value change per second over the window.
+    double get_qps() const {
+        std::lock_guard<std::mutex> g(mu_);
+        if (samples_.size() < 2) return 0.0;
+        const double dv =
+            (double)(samples_.back().value - samples_.front().value);
+        const double dt = (double)(samples_.size() - 1);
+        return dv / dt;
+    }
+
+    std::string get_description() const override {
+        std::ostringstream os;
+        os << get_value();
+        return os.str();
+    }
+
+private:
+    void take_sample() {
+        const T v = reducer_->get_value();
+        std::lock_guard<std::mutex> g(mu_);
+        samples_.push_back(Sample{v});
+        while ((int)samples_.size() > window_size_ + 1) {
+            samples_.pop_front();
+        }
+    }
+
+    struct Sample {
+        T value;
+    };
+    R* reducer_;
+    int window_size_;
+    uint64_t sampler_id_;
+    mutable std::mutex mu_;
+    std::deque<Sample> samples_;
+};
+
+}  // namespace tpurpc

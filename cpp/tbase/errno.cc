@@ -1,0 +1,24 @@
+#include "tbase/errno.h"
+
+#include <cstring>
+
+namespace tpurpc {
+
+const char* terror(int code) {
+    switch (code) {
+        case TERR_EOF: return "EOF";
+        case TERR_OVERCROWDED: return "The write backlog is overcrowded";
+        case TERR_RPC_TIMEDOUT: return "RPC call timed out";
+        case TERR_FAILED_SOCKET: return "The socket was failed";
+        case TERR_NO_METHOD: return "Method not found";
+        case TERR_REQUEST: return "Bad request";
+        case TERR_RESPONSE: return "Bad response";
+        case TERR_BACKUP_REQUEST: return "Backup request";
+        case TERR_LIMIT_EXCEEDED: return "Concurrency limit exceeded";
+        case TERR_CLOSE: return "Connection closed";
+        case TERR_INTERNAL: return "Internal error";
+        default: return strerror(code);
+    }
+}
+
+}  // namespace tpurpc

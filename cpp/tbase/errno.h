@@ -1,0 +1,24 @@
+// Framework error codes beyond the OS errno range.
+// Modeled on reference src/brpc/errno.proto (EEOF/EOVERCROWDED/
+// ERPCTIMEDOUT/EFAILEDSOCKET/EBACKUPREQUEST...) with the same roles.
+#pragma once
+
+namespace tpurpc {
+
+enum RpcErrno {
+    TERR_EOF = 4000,          // remote closed the connection
+    TERR_OVERCROWDED = 4001,  // write backlog too large (back-pressure)
+    TERR_RPC_TIMEDOUT = 4002, // RPC deadline exceeded
+    TERR_FAILED_SOCKET = 4003,// the connection was failed mid-RPC
+    TERR_NO_METHOD = 4004,    // service/method not found on server
+    TERR_REQUEST = 4005,      // malformed request
+    TERR_RESPONSE = 4006,     // malformed response
+    TERR_BACKUP_REQUEST = 4007,
+    TERR_LIMIT_EXCEEDED = 4008,  // concurrency limiter rejected
+    TERR_CLOSE = 4009,           // connection closed by user
+    TERR_INTERNAL = 4010,
+};
+
+const char* terror(int code);
+
+}  // namespace tpurpc

@@ -1,0 +1,251 @@
+// I/O core loopback tests: real sockets, real epoll, full read/write paths —
+// the in-process loopback style of the reference's tests (e.g.
+// test/brpc_channel_unittest.cpp:195 starts a real listener in-process).
+#include <unistd.h>
+
+#include <algorithm>
+#include <atomic>
+#include <cstring>
+#include <mutex>
+#include <vector>
+
+#include "tbase/errno.h"
+#include "tfiber/fiber_sync.h"
+#include "tnet/acceptor.h"
+#include "tnet/event_dispatcher.h"
+#include "tnet/input_messenger.h"
+#include "tnet/socket.h"
+#include "tnet/socket_map.h"
+#include "ttest/ttest.h"
+
+using namespace tpurpc;
+
+namespace {
+
+// Test protocol: "TST0" + u32le length + payload.
+constexpr char kMagic[4] = {'T', 'S', 'T', '0'};
+
+struct TestMsg : public InputMessageBase {
+    IOBuf payload;
+};
+
+ParseResult test_parse(IOBuf* source, Socket* s, bool read_eof,
+                       const void* arg) {
+    if (source->size() < 8) {
+        char head[4];
+        const size_t n = source->copy_to(head, 4);
+        if (memcmp(head, kMagic, n) != 0) {
+            return ParseResult::make(ParseError::TRY_OTHERS);
+        }
+        return ParseResult::make(ParseError::NOT_ENOUGH_DATA);
+    }
+    char header[8];
+    source->copy_to(header, 8);
+    if (memcmp(header, kMagic, 4) != 0) {
+        return ParseResult::make(ParseError::TRY_OTHERS);
+    }
+    uint32_t len;
+    memcpy(&len, header + 4, 4);
+    if (source->size() < 8 + (size_t)len) {
+        return ParseResult::make(ParseError::NOT_ENOUGH_DATA);
+    }
+    source->pop_front(8);
+    auto* msg = new TestMsg;
+    source->cutn(&msg->payload, len);
+    return ParseResult::make_ok(msg);
+}
+
+void frame(IOBuf* out, const IOBuf& payload) {
+    char header[8];
+    memcpy(header, kMagic, 4);
+    const uint32_t len = (uint32_t)payload.size();
+    memcpy(header + 4, &len, 4);
+    out->append(header, 8);
+    out->append(payload);
+}
+
+// Server side: echo the payload back.
+void server_process(InputMessageBase* raw) {
+    TestMsg* msg = (TestMsg*)raw;
+    SocketUniquePtr s;
+    if (Socket::AddressSocket(msg->socket_id, &s) == 0) {
+        IOBuf out;
+        frame(&out, msg->payload);
+        s->Write(&out);
+    }
+    delete msg;
+}
+
+// Client side: collect responses.
+struct ClientSink {
+    std::mutex mu;
+    std::vector<std::string> responses;
+    CountdownEvent pending{0};
+};
+ClientSink* g_sink = nullptr;
+
+void client_process(InputMessageBase* raw) {
+    TestMsg* msg = (TestMsg*)raw;
+    {
+        std::lock_guard<std::mutex> g(g_sink->mu);
+        g_sink->responses.push_back(msg->payload.to_string());
+    }
+    g_sink->pending.signal();
+    delete msg;
+}
+
+int g_server_proto = -1;
+int g_client_proto = -1;
+
+void register_test_protocols() {
+    static std::once_flag once;
+    std::call_once(once, [] {
+        Protocol sp;
+        sp.parse = test_parse;
+        sp.process = server_process;
+        sp.name = "test_echo_server";
+        g_server_proto = RegisterProtocol(sp);
+        Protocol cp;
+        cp.parse = test_parse;
+        cp.process = client_process;
+        cp.name = "test_echo_client";
+        g_client_proto = RegisterProtocol(cp);
+    });
+}
+
+}  // namespace
+
+TEST(Net, LoopbackEchoSmallAndLarge) {
+    register_test_protocols();
+    ClientSink sink;
+    g_sink = &sink;
+
+    InputMessenger server_m({g_server_proto});
+    Acceptor acceptor(&server_m);
+    EndPoint listen_ep;
+    str2endpoint("127.0.0.1:0", &listen_ep);
+    ASSERT_EQ(acceptor.StartAccept(listen_ep), 0);
+    ASSERT_GT(acceptor.listened_port(), 0);
+
+    InputMessenger client_m({g_client_proto});
+    EndPoint server_ep;
+    str2endpoint("127.0.0.1", acceptor.listened_port(), &server_ep);
+    SocketId cid;
+    ASSERT_EQ(SocketMap::singleton()->GetOrCreate(server_ep, &client_m, &cid),
+              0);
+
+    SocketUniquePtr cs;
+    ASSERT_EQ(Socket::AddressSocket(cid, &cs), 0);
+
+    // Small message.
+    {
+        IOBuf payload;
+        payload.append("hello tpu-rpc");
+        IOBuf framed;
+        frame(&framed, payload);
+        sink.pending.reset(1);
+        ASSERT_EQ(cs->Write(&framed), 0);
+        ASSERT_EQ(sink.pending.wait(), 0);
+        std::lock_guard<std::mutex> g(sink.mu);
+        ASSERT_EQ(sink.responses.size(), 1u);
+        EXPECT_EQ(sink.responses[0], "hello tpu-rpc");
+        sink.responses.clear();
+    }
+
+    // Large (1MB) message exercising multi-block iobufs + partial writes.
+    {
+        std::string big(1 << 20, 'x');
+        for (size_t i = 0; i < big.size(); ++i) big[i] = (char)('a' + i % 26);
+        IOBuf payload;
+        payload.append(big);
+        IOBuf framed;
+        frame(&framed, payload);
+        sink.pending.reset(1);
+        ASSERT_EQ(cs->Write(&framed), 0);
+        ASSERT_EQ(sink.pending.wait(), 0);
+        std::lock_guard<std::mutex> g(sink.mu);
+        ASSERT_EQ(sink.responses.size(), 1u);
+        EXPECT_TRUE(sink.responses[0] == big);
+        sink.responses.clear();
+    }
+
+    // Burst of messages: ordering + batching through the write queue.
+    {
+        const int kN = 200;
+        sink.pending.reset(kN);
+        for (int i = 0; i < kN; ++i) {
+            IOBuf payload;
+            payload.append("msg-" + std::to_string(i));
+            IOBuf framed;
+            frame(&framed, payload);
+            ASSERT_EQ(cs->Write(&framed), 0);
+        }
+        ASSERT_EQ(sink.pending.wait(), 0);
+        std::lock_guard<std::mutex> g(sink.mu);
+        ASSERT_EQ(sink.responses.size(), (size_t)kN);
+        // Each request runs on its own fiber (reference QueueMessage), so
+        // response ORDER is not guaranteed at this layer — correlation ids
+        // provide matching at the RPC layer. Check the full set round-
+        // tripped intact.
+        std::vector<std::string> got = sink.responses;
+        std::sort(got.begin(), got.end());
+        std::vector<std::string> want;
+        for (int i = 0; i < kN; ++i) want.push_back("msg-" + std::to_string(i));
+        std::sort(want.begin(), want.end());
+        EXPECT_TRUE(got == want);
+        sink.responses.clear();
+    }
+
+    EXPECT_EQ(acceptor.accepted_count(), 1);  // one shared connection
+
+    // Failure path: failed socket rejects writes.
+    cs->SetFailedWithError(TERR_CLOSE);
+    {
+        IOBuf framed;
+        frame(&framed, IOBuf());
+        IOBuf copy = framed;
+        EXPECT_EQ(cs->Write(&copy), -1);
+        EXPECT_EQ(errno, TERR_FAILED_SOCKET);
+    }
+    SocketMap::singleton()->Remove(server_ep, cid);
+    g_sink = nullptr;
+}
+
+TEST(Net, StaleSocketIdAddressFails) {
+    SocketOptions opts;
+    opts.fd = -1;
+    str2endpoint("127.0.0.1:1", &opts.remote_side);
+    SocketId id;
+    ASSERT_EQ(Socket::Create(opts, &id), 0);
+    SocketUniquePtr ptr;
+    ASSERT_EQ(Socket::AddressSocket(id, &ptr), 0);
+    ptr->SetFailed();
+    SocketUniquePtr ptr2;
+    EXPECT_EQ(Socket::AddressSocket(id, &ptr2), -1);
+}
+
+TEST(Net, ConnectFailureFailsSocket) {
+    register_test_protocols();
+    InputMessenger client_m({g_client_proto});
+    // Port 1 on localhost: connection refused.
+    EndPoint dead_ep;
+    str2endpoint("127.0.0.1:1", &dead_ep);
+    SocketOptions opts;
+    opts.fd = -1;
+    opts.remote_side = dead_ep;
+    opts.on_edge_triggered_events = &InputMessenger::OnNewMessages;
+    opts.user = &client_m;
+    SocketId id;
+    ASSERT_EQ(Socket::Create(opts, &id), 0);
+    SocketUniquePtr s;
+    ASSERT_EQ(Socket::AddressSocket(id, &s), 0);
+    IOBuf data;
+    data.append("doomed");
+    EXPECT_EQ(s->Write(&data), 0);  // queued; fails async
+    // The KeepWrite fiber discovers the refused connection and fails the
+    // socket.
+    for (int i = 0; i < 200 && !s->Failed(); ++i) {
+        usleep(10000);
+    }
+    EXPECT_TRUE(s->Failed());
+}

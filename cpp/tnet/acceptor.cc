@@ -1,0 +1,84 @@
+#include "tnet/acceptor.h"
+
+#include <netinet/in.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cerrno>
+
+#include "tbase/logging.h"
+#include "tfiber/fiber.h"
+
+namespace tpurpc {
+
+int Acceptor::StartAccept(const EndPoint& ep) {
+    const int listen_fd =
+        ::socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK | SOCK_CLOEXEC, 0);
+    if (listen_fd < 0) return -1;
+    int one = 1;
+    setsockopt(listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in addr;
+    endpoint2sockaddr(ep, &addr);
+    if (bind(listen_fd, (sockaddr*)&addr, sizeof(addr)) != 0 ||
+        listen(listen_fd, 1024) != 0) {
+        close(listen_fd);
+        return -1;
+    }
+    sockaddr_in bound;
+    socklen_t blen = sizeof(bound);
+    getsockname(listen_fd, (sockaddr*)&bound, &blen);
+    listened_port_ = ntohs(bound.sin_port);
+
+    SocketOptions opts;
+    opts.fd = listen_fd;
+    opts.on_edge_triggered_events = &Acceptor::OnNewConnections;
+    opts.user = this;
+    if (Socket::Create(opts, &listen_id_) != 0) {
+        // Socket::Create owns (and closed) listen_fd on failure.
+        return -1;
+    }
+    return 0;
+}
+
+void Acceptor::StopAccept() {
+    if (listen_id_ != INVALID_VREF_ID) {
+        Socket::SetFailedById(listen_id_);
+        listen_id_ = INVALID_VREF_ID;
+    }
+}
+
+void Acceptor::OnNewConnections(Socket* listen_socket) {
+    Acceptor* a = (Acceptor*)listen_socket->user();
+    while (true) {
+        sockaddr_in peer;
+        socklen_t plen = sizeof(peer);
+        const int fd = accept4(listen_socket->fd(), (sockaddr*)&peer, &plen,
+                               SOCK_NONBLOCK | SOCK_CLOEXEC);
+        if (fd < 0) {
+            if (errno == EAGAIN || errno == EWOULDBLOCK) return;
+            if (errno == EINTR || errno == ECONNABORTED) continue;
+            if (errno == EMFILE || errno == ENFILE) {
+                // fd exhaustion: with an edge-triggered listen fd, returning
+                // now would strand the queued backlog until a NEW connection
+                // arrives. Pause on this fiber and retry (reference acceptor
+                // does the same).
+                fiber_usleep(100 * 1000);
+                continue;
+            }
+            return;
+        }
+        SocketOptions opts;
+        opts.fd = fd;
+        opts.remote_side = sockaddr2endpoint(peer);
+        opts.on_edge_triggered_events = &InputMessenger::OnNewMessages;
+        opts.user = a->messenger_;
+        SocketId id;
+        if (Socket::Create(opts, &id) != 0) {
+            // Socket::Create owns (and closed) fd on failure.
+            continue;
+        }
+        a->accepted_.fetch_add(1, std::memory_order_relaxed);
+    }
+}
+
+}  // namespace tpurpc

@@ -1,0 +1,38 @@
+// Acceptor: the listen-socket loop creating per-connection Sockets bound to
+// a messenger. Modeled on reference src/brpc/acceptor.{h,cpp} (accept() as
+// an InputMessenger subclass; per-connection Socket::Create).
+#pragma once
+
+#include <atomic>
+
+#include "tbase/endpoint.h"
+#include "tnet/input_messenger.h"
+#include "tnet/socket.h"
+
+namespace tpurpc {
+
+class Acceptor {
+public:
+    explicit Acceptor(InputMessenger* messenger) : messenger_(messenger) {}
+    ~Acceptor() { StopAccept(); }
+
+    // Listen on `ep` (port 0 picks one; see listened_port()). Returns 0.
+    int StartAccept(const EndPoint& ep);
+    void StopAccept();
+    int listened_port() const { return listened_port_; }
+
+    // # connections accepted (metrics / tests).
+    int64_t accepted_count() const {
+        return accepted_.load(std::memory_order_relaxed);
+    }
+
+private:
+    static void OnNewConnections(Socket* listen_socket);
+
+    InputMessenger* messenger_;
+    SocketId listen_id_ = INVALID_VREF_ID;
+    int listened_port_ = 0;
+    std::atomic<int64_t> accepted_{0};
+};
+
+}  // namespace tpurpc

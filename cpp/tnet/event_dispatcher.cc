@@ -1,0 +1,117 @@
+#include "tnet/event_dispatcher.h"
+
+#include <sys/epoll.h>
+#include <unistd.h>
+
+#include <cerrno>
+
+#include "tbase/flags.h"
+#include "tbase/logging.h"
+
+DEFINE_int32(event_dispatcher_num, 1, "number of epoll loops");
+
+namespace tpurpc {
+
+namespace {
+// epoll_data carries the SocketId; EPOLLOUT interest is encoded in the
+// registration mode only.
+}  // namespace
+
+EventDispatcher::EventDispatcher() {
+    epfd_ = epoll_create1(EPOLL_CLOEXEC);
+    CHECK_GE(epfd_, 0) << "epoll_create1 failed";
+    thread_ = std::thread([this] { Run(); });
+}
+
+EventDispatcher::~EventDispatcher() {
+    stop_.store(true, std::memory_order_release);
+    if (epfd_ >= 0) {
+        // Wake the loop by closing; epoll_wait returns EBADF.
+        close(epfd_);
+        epfd_ = -1;
+    }
+    if (thread_.joinable()) thread_.join();
+}
+
+int EventDispatcher::AddConsumer(SocketId id, int fd) {
+    epoll_event evt;
+    evt.events = EPOLLIN | EPOLLET;
+    evt.data.u64 = id;
+    return epoll_ctl(epfd_, EPOLL_CTL_ADD, fd, &evt);
+}
+
+int EventDispatcher::AddConsumerWithEpollOut(SocketId id, int fd) {
+    epoll_event evt;
+    evt.events = EPOLLIN | EPOLLOUT | EPOLLET;
+    evt.data.u64 = id;
+    return epoll_ctl(epfd_, EPOLL_CTL_ADD, fd, &evt);
+}
+
+int EventDispatcher::RegisterEpollOut(SocketId id, int fd, bool pollin) {
+    epoll_event evt;
+    evt.data.u64 = id;
+    evt.events = EPOLLOUT | EPOLLET | (pollin ? EPOLLIN : 0);
+    if (pollin) {
+        return epoll_ctl(epfd_, EPOLL_CTL_MOD, fd, &evt);
+    }
+    return epoll_ctl(epfd_, EPOLL_CTL_ADD, fd, &evt);
+}
+
+int EventDispatcher::UnregisterEpollOut(SocketId id, int fd, bool pollin) {
+    if (pollin) {
+        epoll_event evt;
+        evt.data.u64 = id;
+        evt.events = EPOLLIN | EPOLLET;
+        return epoll_ctl(epfd_, EPOLL_CTL_MOD, fd, &evt);
+    }
+    return epoll_ctl(epfd_, EPOLL_CTL_DEL, fd, nullptr);
+}
+
+int EventDispatcher::RemoveConsumer(int fd) {
+    return epoll_ctl(epfd_, EPOLL_CTL_DEL, fd, nullptr);
+}
+
+void EventDispatcher::Run() {
+    epoll_event events[64];
+    while (!stop_.load(std::memory_order_acquire)) {
+        const int epfd = epfd_;
+        if (epfd < 0) break;
+        const int n = epoll_wait(epfd, events, 64, 100 /*ms*/);
+        if (n < 0) {
+            if (errno == EINTR) continue;
+            break;  // epfd closed
+        }
+        for (int i = 0; i < n; ++i) {
+            const SocketId id = events[i].data.u64;
+            if (events[i].events & (EPOLLOUT | EPOLLERR | EPOLLHUP)) {
+                Socket::OnOutputEventById(id);
+            }
+            if (events[i].events & (EPOLLIN | EPOLLERR | EPOLLHUP)) {
+                Socket::OnInputEventById(id);
+            }
+        }
+    }
+}
+
+namespace {
+struct Dispatchers {
+    std::vector<EventDispatcher*> list;
+};
+}  // namespace
+
+EventDispatcher& EventDispatcher::GetGlobalDispatcher(int fd) {
+    static Dispatchers* d = [] {
+        auto* dd = new Dispatchers;
+        int n = FLAGS_event_dispatcher_num.get();
+        if (n < 1) n = 1;
+        for (int i = 0; i < n; ++i) dd->list.push_back(new EventDispatcher);
+        return dd;
+    }();
+    return *d->list[(size_t)fd % d->list.size()];
+}
+
+void EventDispatcher::StopAll() {
+    // Dispatchers are process-lifetime (like the reference); nothing to do.
+}
+
+}  // namespace tpurpc

@@ -1,0 +1,46 @@
+// EventDispatcher: epoll loops delivering readiness events to Sockets by id.
+//
+// Modeled on reference src/brpc/event_dispatcher.h:92-143 +
+// event_dispatcher_epoll.cpp (epoll_wait loop :196-209, edge-triggered, fds
+// registered with versioned ids so stale events on recycled sockets are
+// ignored). Sharded by fd across `event_dispatcher_num` loops. Each loop
+// runs on a dedicated pthread (the reference wraps it in a bthread; the
+// callbacks here immediately hand off to fibers, which is what matters).
+#pragma once
+
+#include <atomic>
+#include <thread>
+#include <vector>
+
+#include "tnet/socket.h"
+
+namespace tpurpc {
+
+class EventDispatcher {
+public:
+    // Register fd for edge-triggered EPOLLIN events delivered to socket id.
+    int AddConsumer(SocketId id, int fd);
+    // ADD with EPOLLIN|EPOLLOUT (async connect in flight).
+    int AddConsumerWithEpollOut(SocketId id, int fd);
+    // Also wait for EPOLLOUT once (connect / blocked write). `pollin` keeps
+    // the read registration alive.
+    int RegisterEpollOut(SocketId id, int fd, bool pollin);
+    int UnregisterEpollOut(SocketId id, int fd, bool pollin);
+    int RemoveConsumer(int fd);
+
+    static EventDispatcher& GetGlobalDispatcher(int fd);
+    static void StopAll();
+
+private:
+    EventDispatcher();
+    ~EventDispatcher();
+    void Run();
+
+    int epfd_ = -1;
+    std::atomic<bool> stop_{false};
+    std::thread thread_;
+
+    friend EventDispatcher* global_dispatchers();
+};
+
+}  // namespace tpurpc

@@ -1,0 +1,34 @@
+// InputMessenger: the protocol-agnostic message pump — reads bytes off a
+// socket, sniffs/cuts messages with registered protocol parsers, and hands
+// each message to a processing fiber.
+//
+// Modeled on reference src/brpc/input_messenger.{h,cpp}: OnNewMessages
+// (:360) reads into an IOPortal; CutInputMessage (:84) tries the socket's
+// last-successful protocol first then the others; QueueMessage (:194-234)
+// spawns a fiber per message, keeping the LAST message inline for cache
+// locality.
+#pragma once
+
+#include "tnet/protocol.h"
+#include "tnet/socket.h"
+
+namespace tpurpc {
+
+class InputMessenger {
+public:
+    // The subset of registered protocols this messenger accepts, by index
+    // (servers accept server protocols; a client channel accepts its own).
+    explicit InputMessenger(std::vector<int> protocol_indexes = {})
+        : protocols_(std::move(protocol_indexes)) {}
+
+    void add_protocol(int index) { protocols_.push_back(index); }
+
+    // Socket edge-trigger callback (runs on a fiber).
+    static void OnNewMessages(Socket* s);
+
+private:
+    friend class Acceptor;
+    std::vector<int> protocols_;
+};
+
+}  // namespace tpurpc

@@ -1,0 +1,44 @@
+#include "tnet/protocol.h"
+
+#include <mutex>
+#include <vector>
+
+namespace tpurpc {
+
+namespace {
+struct Registry {
+    std::mutex mu;
+    std::vector<Protocol> protocols;
+};
+Registry* registry() {
+    static Registry* r = [] {
+        auto* rr = new Registry;
+        // Pointers returned by GetProtocol must stay stable.
+        rr->protocols.reserve(64);
+        return rr;
+    }();
+    return r;
+}
+}  // namespace
+
+int RegisterProtocol(const Protocol& p) {
+    Registry* r = registry();
+    std::lock_guard<std::mutex> g(r->mu);
+    r->protocols.push_back(p);
+    return (int)r->protocols.size() - 1;
+}
+
+const Protocol* GetProtocol(int index) {
+    Registry* r = registry();
+    std::lock_guard<std::mutex> g(r->mu);
+    if (index < 0 || index >= (int)r->protocols.size()) return nullptr;
+    return &r->protocols[(size_t)index];
+}
+
+int ProtocolCount() {
+    Registry* r = registry();
+    std::lock_guard<std::mutex> g(r->mu);
+    return (int)r->protocols.size();
+}
+
+}  // namespace tpurpc

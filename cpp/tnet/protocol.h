@@ -1,0 +1,67 @@
+// Protocol: the function-pointer table every wire protocol implements, plus
+// the global registry.
+//
+// Modeled on reference src/brpc/protocol.h:77-172 (struct Protocol {parse,
+// serialize_request, pack_request, process_request, process_response,
+// verify}) and RegisterProtocol/FindProtocol (protocol.h:186-193). The
+// InputMessenger sniffs protocols per connection and remembers the winner
+// (socket->preferred_protocol_index).
+#pragma once
+
+#include <cstdint>
+#include <functional>
+#include <string>
+
+#include "tbase/iobuf.h"
+
+namespace tpurpc {
+
+class Socket;
+class InputMessageBase;
+
+enum class ParseError {
+    OK = 0,
+    NOT_ENOUGH_DATA,  // keep bytes, wait for more
+    TRY_OTHERS,       // not this protocol; let another parser sniff
+    ERROR,            // corrupt stream: fail the connection
+};
+
+struct ParseResult {
+    ParseError error = ParseError::TRY_OTHERS;
+    InputMessageBase* msg = nullptr;
+
+    static ParseResult make_ok(InputMessageBase* m) {
+        return ParseResult{ParseError::OK, m};
+    }
+    static ParseResult make(ParseError e) { return ParseResult{e, nullptr}; }
+};
+
+// Base of every cut message flowing from parse() to process().
+class InputMessageBase {
+public:
+    virtual ~InputMessageBase() = default;
+    // Socket the message arrived on (id; Address() to use).
+    uint64_t socket_id = 0;
+    int protocol_index = -1;
+};
+
+struct Protocol {
+    // Cut one message from `source` (bytes already read from the socket).
+    ParseResult (*parse)(IOBuf* source, Socket* socket, bool read_eof,
+                         const void* arg) = nullptr;
+    // Handle a cut message (request on servers, response on clients). Runs
+    // on a fiber. Owns `msg` (must delete).
+    void (*process)(InputMessageBase* msg) = nullptr;
+    // Human name (diagnostics + /connections).
+    const char* name = "unknown";
+    // Opaque arg passed to parse (e.g. the Server*).
+    const void* parse_arg = nullptr;
+};
+
+// Global registry (reference global.cpp:416-601 registers all protocols at
+// init). Index is stable after registration.
+int RegisterProtocol(const Protocol& p);
+const Protocol* GetProtocol(int index);
+int ProtocolCount();
+
+}  // namespace tpurpc

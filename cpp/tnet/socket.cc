@@ -1,0 +1,421 @@
+#include "tnet/socket.h"
+
+#include <fcntl.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cerrno>
+
+#include "tbase/errno.h"
+#include "tbase/flags.h"
+#include "tbase/logging.h"
+#include "tbase/time.h"
+#include "tnet/event_dispatcher.h"
+
+DEFINE_int64(socket_max_unwritten_bytes, 64 * 1024 * 1024,
+             "write backlog limit before EOVERCROWDED back-pressure");
+
+namespace tpurpc {
+
+static int make_non_blocking(int fd) {
+    const int flags = fcntl(fd, F_GETFL, 0);
+    if (flags < 0) return -1;
+    return fcntl(fd, F_SETFL, flags | O_NONBLOCK);
+}
+
+// ---------------- creation / recycle ----------------
+
+// Takes ownership of options.fd: on ANY failure path the fd is closed here
+// (callers must not close it again — fd numbers recycle fast under load and
+// a double close can kill an unrelated connection).
+int Socket::Create(const SocketOptions& options, SocketId* id) {
+    Socket* s = nullptr;
+    if (VersionedRefWithId<Socket>::Create(id, &s) != 0) {
+        if (options.fd >= 0) close(options.fd);
+        return -1;
+    }
+    // Slots are recycled without destruction: re-init everything.
+    s->fd_.store(options.fd, std::memory_order_relaxed);
+    s->remote_side_ = options.remote_side;
+    s->local_side_ = EndPoint();
+    s->on_edge_triggered_events_ = options.on_edge_triggered_events;
+    s->user_ = options.user;
+    s->transport_ = options.transport;
+    s->write_head_.store(nullptr, std::memory_order_relaxed);
+    s->write_pending_.store(0, std::memory_order_relaxed);
+    s->unwritten_bytes_.store(0, std::memory_order_relaxed);
+    s->inflight_batch_.clear();
+    s->inflight_index_ = 0;
+    s->writer_consumed_ = 0;
+    s->nevent_.store(0, std::memory_order_relaxed);
+    s->error_code_.store(0, std::memory_order_relaxed);
+    s->connecting_.store(false, std::memory_order_relaxed);
+    s->read_buf.clear();
+    s->preferred_protocol_index = -1;
+    if (s->epollout_butex_ == nullptr) s->epollout_butex_ = butex_create();
+    if (s->connect_butex_ == nullptr) s->connect_butex_ = butex_create();
+
+    if (options.fd >= 0) {
+        make_non_blocking(options.fd);
+        int one = 1;
+        setsockopt(options.fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+        if (EventDispatcher::GetGlobalDispatcher(options.fd)
+                .AddConsumer(*id, options.fd) != 0) {
+            PLOG(ERROR) << "AddConsumer failed for fd=" << options.fd;
+            Socket* addr = Address(*id);
+            if (addr) {
+                addr->SetFailed();
+                addr->Dereference();
+            }
+            return -1;
+        }
+    }
+    return 0;
+}
+
+void Socket::OnFailed() {
+    // Wake anything parked on this socket so it observes the failure.
+    butex_word(epollout_butex_)->fetch_add(1, std::memory_order_release);
+    butex_wake_all(epollout_butex_);
+    butex_word(connect_butex_)->fetch_add(1, std::memory_order_release);
+    butex_wake_all(connect_butex_);
+}
+
+void Socket::OnRecycle() {
+    const int fd = fd_.exchange(-1, std::memory_order_acq_rel);
+    if (fd >= 0) {
+        EventDispatcher::GetGlobalDispatcher(fd).RemoveConsumer(fd);
+        close(fd);
+    }
+    // Free any queued write requests (writers stopped: Address fails).
+    for (size_t i = inflight_index_; i < inflight_batch_.size(); ++i) {
+        delete inflight_batch_[i];
+    }
+    inflight_batch_.clear();
+    inflight_index_ = 0;
+    WriteRequest* head = write_head_.exchange(nullptr, std::memory_order_acq_rel);
+    while (head != nullptr) {
+        WriteRequest* next = head->next.load(std::memory_order_acquire);
+        while (next == WriteRequest::unlinked()) {
+            next = head->next.load(std::memory_order_acquire);
+        }
+        delete head;
+        head = next;
+    }
+    read_buf.clear();
+}
+
+int Socket::SetFailedWithError(int error_code) {
+    error_code_.store(error_code, std::memory_order_release);
+    return SetFailed();
+}
+
+// ---------------- write path ----------------
+
+int Socket::Write(IOBuf* data) {
+    if (Failed()) {
+        errno = TERR_FAILED_SOCKET;
+        return -1;
+    }
+    const int64_t sz = (int64_t)data->size();
+    if (unwritten_bytes_.load(std::memory_order_relaxed) + sz >
+        FLAGS_socket_max_unwritten_bytes.get()) {
+        errno = TERR_OVERCROWDED;
+        return -1;
+    }
+    WriteRequest* req = new WriteRequest;
+    req->data.swap(*data);
+    req->next.store(WriteRequest::unlinked(), std::memory_order_relaxed);
+    unwritten_bytes_.fetch_add(sz, std::memory_order_relaxed);
+    WriteRequest* old = write_head_.exchange(req, std::memory_order_acq_rel);
+    req->next.store(old, std::memory_order_release);
+    if (write_pending_.fetch_add(1, std::memory_order_acq_rel) != 0) {
+        return 0;  // an active writer owns the queue
+    }
+    // Elected the writer.
+    StartKeepWriteIfNeeded();
+    return 0;
+}
+
+void Socket::StartKeepWriteIfNeeded() {
+    // Try one inline non-blocking flush first (the common small-write case:
+    // everything fits in the socket buffer, no fiber needed — reference
+    // socket.cpp:1615 "write once in the calling thread").
+    if (fd() >= 0) {
+        if (FlushOnce(false)) return;  // fully drained + retired
+    }
+    // Leftovers (or not yet connected): hand off to a KeepWrite fiber.
+    AddRef();  // ownership ref for the fiber; released there
+    fiber_t tid;
+    if (fiber_start_background(&tid, nullptr, &Socket::KeepWriteThunk,
+                               (void*)(uintptr_t)id()) != 0) {
+        Dereference();
+        SetFailedWithError(TERR_INTERNAL);
+    }
+}
+
+void* Socket::KeepWriteThunk(void* arg) {
+    const SocketId id = (SocketId)(uintptr_t)arg;
+    Socket* s = Address(id);
+    if (s == nullptr) {
+        // Socket failed before the fiber ran. The AddRef from
+        // StartKeepWriteIfNeeded still pins the object; balance it through
+        // the slot or the socket (fd + queued requests) leaks forever.
+        Socket* raw = address_resource<Socket>(VRefSlot(id));
+        if (raw != nullptr) raw->Dereference();
+        return nullptr;
+    }
+    SocketUniquePtr owned(s);
+    s->Dereference();  // balance StartKeepWriteIfNeeded's AddRef
+    s->KeepWrite();
+    return nullptr;
+}
+
+void Socket::KeepWrite() {
+    if (fd() < 0) {
+        if (ConnectIfNot() != 0) {
+            SetFailedWithError(errno ? errno : TERR_FAILED_SOCKET);
+            return;
+        }
+    }
+    while (true) {
+        if (Failed()) return;
+        if (FlushOnce(true)) return;  // retired
+    }
+}
+
+// The single-writer drain loop. Grabs LIFO segments from write_head_,
+// reverses to FIFO, writevs across requests (the KeepWrite batching of
+// reference socket.cpp:1920 DoWrite). Returns true when the writer retired
+// (queue balanced) or the socket failed; false when it should continue
+// (only with allow_block=false on EAGAIN).
+bool Socket::FlushOnce(bool allow_block) {
+    int64_t& consumed = writer_consumed_;
+    while (true) {
+        // Refill the owned batch.
+        if (inflight_index_ >= inflight_batch_.size()) {
+            inflight_batch_.clear();
+            inflight_index_ = 0;
+            WriteRequest* grabbed =
+                write_head_.exchange(nullptr, std::memory_order_acq_rel);
+            // Reverse newest->oldest chain into oldest-first order.
+            std::vector<WriteRequest*> tmp;
+            for (WriteRequest* cur = grabbed; cur != nullptr;) {
+                WriteRequest* next = cur->next.load(std::memory_order_acquire);
+                while (next == WriteRequest::unlinked()) {
+                    next = cur->next.load(std::memory_order_acquire);
+                }
+                tmp.push_back(cur);
+                cur = next;
+            }
+            inflight_batch_.assign(tmp.rbegin(), tmp.rend());
+        }
+        if (inflight_index_ >= inflight_batch_.size()) {
+            // Nothing visible: try to retire.
+            const int64_t prev =
+                write_pending_.fetch_sub(consumed, std::memory_order_acq_rel);
+            const bool retired = (prev == consumed);
+            // Either way these requests are now accounted; the next writer
+            // generation must start from zero or it over-subtracts the
+            // election count and the queue wedges.
+            consumed = 0;
+            if (retired) return true;
+            continue;  // more requests were queued; grab again
+        }
+        // Gather up to 64 iovecs from the batch tail.
+        IOBuf* pieces[64];
+        size_t npieces = 0;
+        for (size_t i = inflight_index_;
+             i < inflight_batch_.size() && npieces < 64; ++i) {
+            pieces[npieces++] = &inflight_batch_[i]->data;
+        }
+        const ssize_t nw = IOBuf::cut_multiple_into_file_descriptor(
+            fd(), pieces, npieces);
+        if (nw < 0) {
+            if (errno == EAGAIN || errno == EWOULDBLOCK) {
+                if (!allow_block) return false;  // caller spawns KeepWrite
+                if (WaitEpollOut() != 0) {
+                    SetFailedWithError(TERR_FAILED_SOCKET);
+                    return true;
+                }
+                continue;
+            }
+            if (errno == EINTR) continue;
+            SetFailedWithError(errno);
+            return true;
+        }
+        unwritten_bytes_.fetch_sub(nw, std::memory_order_relaxed);
+        // Drop fully-written requests.
+        while (inflight_index_ < inflight_batch_.size() &&
+               inflight_batch_[inflight_index_]->data.empty()) {
+            delete inflight_batch_[inflight_index_];
+            ++inflight_index_;
+            ++consumed;
+        }
+    }
+}
+
+int Socket::WaitEpollOut() {
+    const int the_fd = fd();
+    if (the_fd < 0) return -1;
+    std::atomic<int>* word = butex_word(epollout_butex_);
+    const int expected = word->load(std::memory_order_acquire);
+    EventDispatcher& d = EventDispatcher::GetGlobalDispatcher(the_fd);
+    if (d.RegisterEpollOut(id(), the_fd, true) != 0) return -1;
+    const int64_t abstime = monotonic_time_us() + 2 * 1000 * 1000;
+    butex_wait(epollout_butex_, expected, &abstime);
+    d.UnregisterEpollOut(id(), the_fd, true);
+    return Failed() ? -1 : 0;
+}
+
+// ---------------- connect ----------------
+
+int Socket::ConnectIfNot() {
+    if (fd() >= 0) return 0;
+    bool expected = false;
+    if (!connecting_.compare_exchange_strong(expected, true)) {
+        // Another fiber connects; wait for it.
+        std::atomic<int>* word = butex_word(connect_butex_);
+        while (fd() < 0 && !Failed()) {
+            const int v = word->load(std::memory_order_acquire);
+            if (fd() >= 0 || Failed()) break;
+            const int64_t abst = monotonic_time_us() + 100 * 1000;
+            butex_wait(connect_butex_, v, &abst);
+        }
+        return (fd() >= 0 && !Failed()) ? 0 : -1;
+    }
+    const int sock = ::socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK, 0);
+    if (sock < 0) {
+        connecting_.store(false, std::memory_order_release);
+        return -1;
+    }
+    int one = 1;
+    setsockopt(sock, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    sockaddr_in addr;
+    endpoint2sockaddr(remote_side_, &addr);
+    int rc = ::connect(sock, (sockaddr*)&addr, sizeof(addr));
+    if (rc != 0 && errno != EINPROGRESS) {
+        close(sock);
+        connecting_.store(false, std::memory_order_release);
+        return -1;
+    }
+    EventDispatcher& d = EventDispatcher::GetGlobalDispatcher(sock);
+    std::atomic<int>* word = butex_word(connect_butex_);
+    int seq = word->load(std::memory_order_acquire);
+    if (d.AddConsumerWithEpollOut(id(), sock) != 0) {
+        close(sock);
+        connecting_.store(false, std::memory_order_release);
+        return -1;
+    }
+    if (rc != 0) {
+        // Await writability (= connect completion), 3s cap.
+        const int64_t deadline = monotonic_time_us() + 3 * 1000 * 1000;
+        while (!Failed()) {
+            int err = 0;
+            socklen_t len = sizeof(err);
+            getsockopt(sock, SOL_SOCKET, SO_ERROR, &err, &len);
+            if (err != 0) {
+                errno = err;
+                break;
+            }
+            // Poll connection state cheaply: getpeername succeeds once
+            // connected.
+            sockaddr_in peer;
+            socklen_t plen = sizeof(peer);
+            if (getpeername(sock, (sockaddr*)&peer, &plen) == 0) {
+                rc = 0;
+                break;
+            }
+            if (monotonic_time_us() >= deadline) {
+                errno = ETIMEDOUT;
+                break;
+            }
+            const int64_t abst = monotonic_time_us() + 50 * 1000;
+            butex_wait(connect_butex_, seq, &abst);
+            seq = word->load(std::memory_order_acquire);
+        }
+        if (rc != 0 || Failed()) {
+            d.RemoveConsumer(sock);
+            close(sock);
+            connecting_.store(false, std::memory_order_release);
+            word->fetch_add(1, std::memory_order_release);
+            butex_wake_all(connect_butex_);
+            return -1;
+        }
+    }
+    // Connected: record sides, drop EPOLLOUT interest.
+    sockaddr_in local;
+    socklen_t llen = sizeof(local);
+    if (getsockname(sock, (sockaddr*)&local, &llen) == 0) {
+        local_side_ = sockaddr2endpoint(local);
+    }
+    d.UnregisterEpollOut(id(), sock, true);
+    fd_.store(sock, std::memory_order_release);
+    connecting_.store(false, std::memory_order_release);
+    word->fetch_add(1, std::memory_order_release);
+    butex_wake_all(connect_butex_);
+    return 0;
+}
+
+// ---------------- read events ----------------
+
+void Socket::OnInputEventById(SocketId id) {
+    Socket* s = Address(id);
+    if (s == nullptr) return;
+    SocketUniquePtr ptr(s);
+    if (s->on_edge_triggered_events_ == nullptr) return;
+    if (s->nevent_.fetch_add(1, std::memory_order_acq_rel) == 0) {
+        // First event of a burst: elect one processing fiber.
+        s->AddRef();
+        fiber_t tid;
+        if (fiber_start_background(&tid, nullptr, &Socket::ProcessEventThunk,
+                                   (void*)(uintptr_t)id) != 0) {
+            s->Dereference();
+            s->nevent_.store(0, std::memory_order_release);
+        }
+    }
+}
+
+void* Socket::ProcessEventThunk(void* arg) {
+    const SocketId id = (SocketId)(uintptr_t)arg;
+    Socket* s = Address(id);
+    if (s == nullptr) {
+        // Balance the AddRef: the socket was failed but memory persists.
+        // (Address failed => versioned ref says stale; the extra ref we
+        // took in OnInputEventById still pins the object.)
+        s = address_resource<Socket>(VRefSlot(id));
+        if (s != nullptr) s->Dereference();
+        return nullptr;
+    }
+    SocketUniquePtr ptr(s);
+    s->Dereference();  // balance OnInputEventById's AddRef
+    while (true) {
+        const int n = s->nevent_.load(std::memory_order_acquire);
+        // fd() < 0 means an async connect is still in flight: EPOLLERR/HUP
+        // on the connecting fd routes here too, but the read callback must
+        // not run against fd -1 (the connect loop surfaces the error).
+        if (!s->Failed() && s->on_edge_triggered_events_ != nullptr &&
+            s->fd() >= 0) {
+            s->on_edge_triggered_events_(s);
+        }
+        if (s->nevent_.fetch_sub(n, std::memory_order_acq_rel) == n) {
+            break;
+        }
+    }
+    return nullptr;
+}
+
+void Socket::OnOutputEventById(SocketId id) {
+    Socket* s = Address(id);
+    if (s == nullptr) return;
+    SocketUniquePtr ptr(s);
+    // Wake connecters and blocked writers.
+    butex_word(s->connect_butex_)->fetch_add(1, std::memory_order_release);
+    butex_wake_all(s->connect_butex_);
+    butex_word(s->epollout_butex_)->fetch_add(1, std::memory_order_release);
+    butex_wake_all(s->epollout_butex_);
+}
+
+}  // namespace tpurpc

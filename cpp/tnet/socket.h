@@ -1,0 +1,143 @@
+// Socket: the central connection object — versioned-id addressed, wait-free
+// write queue, edge-triggered read dispatch.
+//
+// Modeled on reference src/brpc/socket.h:294 / socket.cpp:
+//  - SocketId addressing + SetFailed/recycle via VersionedRefWithId
+//  - write path: wait-free MPSC stack `_write_head` (socket.cpp:488,1695),
+//    first writer writes inline once (socket.cpp:1615), leftovers go to a
+//    KeepWrite fiber (socket.cpp:1800) batching via DoWrite (:1920);
+//    back-pressure via EOVERCROWDED
+//  - read path: OnInputEvent's atomic `_nevent` starts exactly one
+//    processing fiber per readiness burst (socket.cpp:2229,2256)
+//  - connect-on-first-write (ConnectIfNot socket.cpp:1409)
+// The transport is pluggable: a TransportEndpoint (ICI/shm, see
+// tnet/transport.h) can take over the data plane while this Socket keeps
+// the id/lifecycle/queue semantics — the RdmaEndpoint pattern
+// (reference src/brpc/rdma/rdma_endpoint.h).
+#pragma once
+
+#include <atomic>
+#include <cstdint>
+#include <vector>
+
+#include "tbase/endpoint.h"
+#include "tbase/iobuf.h"
+#include "tbase/versioned_ref.h"
+#include "tfiber/butex.h"
+#include "tfiber/fiber.h"
+
+namespace tpurpc {
+
+class Socket;
+class TransportEndpoint;
+using SocketId = VRefId;
+using SocketUniquePtr = VRefPtr<Socket>;
+
+struct SocketOptions {
+    int fd = -1;  // may be -1: connect-on-first-write to remote_side
+    EndPoint remote_side;
+    // Edge-triggered readable callback (InputMessenger::OnNewMessages or
+    // Acceptor::OnNewConnections). Runs on a fiber.
+    void (*on_edge_triggered_events)(Socket*) = nullptr;
+    void* user = nullptr;  // InputMessenger* / Acceptor* / Server*
+    // Optional transport endpoint taking over the data plane (ICI).
+    TransportEndpoint* transport = nullptr;
+};
+
+class Socket : public VersionedRefWithId<Socket> {
+public:
+    // ---- creation / addressing ----
+    static int Create(const SocketOptions& options, SocketId* id);
+    static int AddressSocket(SocketId id, SocketUniquePtr* out) {
+        out->reset();
+        Socket* s = Address(id);
+        if (s == nullptr) return -1;
+        *out = SocketUniquePtr(s);
+        return 0;
+    }
+
+    SocketId id() const { return vref_id(); }
+    int fd() const { return fd_.load(std::memory_order_acquire); }
+    const EndPoint& remote_side() const { return remote_side_; }
+    const EndPoint& local_side() const { return local_side_; }
+    void* user() const { return user_; }
+
+    // ---- write path ----
+    // Queue `data` (zero-copy moved) for ordered write. Returns 0, or -1
+    // with errno (EOVERCROWDED when the unwritten backlog is too large,
+    // or the socket is failed). Never blocks.
+    int Write(IOBuf* data);
+
+    // ---- read path (called by EventDispatcher) ----
+    static void OnInputEventById(SocketId id);
+    static void OnOutputEventById(SocketId id);
+
+    // ---- connect ----
+    // Ensure connected (used by client sockets created with fd == -1);
+    // blocks the calling fiber until connected or error. Returns 0 / -1.
+    int ConnectIfNot();
+
+    // ---- failure ----
+    int SetFailedWithError(int error_code);
+    int error_code() const { return error_code_.load(std::memory_order_acquire); }
+
+    // ---- per-connection parsing state (owned by InputMessenger) ----
+    IOPortal read_buf;
+    int preferred_protocol_index = -1;
+    // Correlation of in-flight requests awaiting responses could hang off
+    // here later (pipelined protocols).
+
+    // Bytes queued but not yet written (back-pressure signal).
+    int64_t unwritten_bytes() const {
+        return unwritten_bytes_.load(std::memory_order_relaxed);
+    }
+
+    // VersionedRefWithId hooks.
+    void OnFailed();
+    void OnRecycle();
+
+private:
+    friend class VersionedRefWithId<Socket>;
+    friend class EventDispatcher;
+
+    struct WriteRequest {
+        std::atomic<WriteRequest*> next{nullptr};
+        IOBuf data;
+        static WriteRequest* unlinked() { return (WriteRequest*)0x1; }
+    };
+
+    void StartKeepWriteIfNeeded();
+    static void* KeepWriteThunk(void* arg);  // arg = SocketId
+    void KeepWrite();
+    // Drain pending write requests once; returns false on fatal error.
+    bool FlushOnce(bool allow_block);
+    // Wait (fiber) until the fd is writable.
+    int WaitEpollOut();
+    static void* ProcessEventThunk(void* arg);  // arg = SocketId
+
+    std::atomic<int> fd_{-1};
+    EndPoint remote_side_;
+    EndPoint local_side_;
+    void (*on_edge_triggered_events_)(Socket*) = nullptr;
+    void* user_ = nullptr;
+    TransportEndpoint* transport_ = nullptr;
+
+    std::atomic<WriteRequest*> write_head_{nullptr};
+    std::atomic<int64_t> write_pending_{0};
+    std::atomic<int64_t> unwritten_bytes_{0};
+    // In-progress batch owned by the single active writer. writer_consumed_
+    // counts fully-written requests not yet subtracted from write_pending_;
+    // it must survive the inline-flush -> KeepWrite handoff or the writer
+    // election count drifts and the queue wedges.
+    std::vector<WriteRequest*> inflight_batch_;
+    size_t inflight_index_ = 0;
+    int64_t writer_consumed_ = 0;
+
+    std::atomic<int> nevent_{0};
+    void* epollout_butex_ = nullptr;
+    std::atomic<int> error_code_{0};
+    std::atomic<bool> connecting_{false};
+    void* connect_butex_ = nullptr;
+};
+
+}  // namespace tpurpc

@@ -1,0 +1,44 @@
+#include "tnet/socket_map.h"
+
+#include "tnet/input_messenger.h"
+
+namespace tpurpc {
+
+SocketMap* SocketMap::singleton() {
+    static SocketMap* m = new SocketMap;
+    return m;
+}
+
+int SocketMap::GetOrCreate(const EndPoint& remote, InputMessenger* messenger,
+                           SocketId* id) {
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = map_.find(remote);
+    if (it != map_.end()) {
+        // Verify liveness: a failed socket is replaced.
+        Socket* s = Socket::Address(it->second);
+        if (s != nullptr) {
+            *id = it->second;
+            s->Dereference();
+            return 0;
+        }
+        map_.erase(it);
+    }
+    SocketOptions opts;
+    opts.fd = -1;  // connect on first write
+    opts.remote_side = remote;
+    opts.on_edge_triggered_events = &InputMessenger::OnNewMessages;
+    opts.user = messenger;
+    if (Socket::Create(opts, id) != 0) return -1;
+    map_[remote] = *id;
+    return 0;
+}
+
+void SocketMap::Remove(const EndPoint& remote, SocketId expected_id) {
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = map_.find(remote);
+    if (it != map_.end() && it->second == expected_id) {
+        map_.erase(it);
+    }
+}
+
+}  // namespace tpurpc

@@ -1,0 +1,32 @@
+// SocketMap: the client-side connection registry — one shared connection
+// per remote endpoint ("single" connection mode). Modeled on reference
+// src/brpc/socket_map.h:82-150 (SocketMapInsert/Remove keyed by endpoint).
+#pragma once
+
+#include <map>
+#include <mutex>
+
+#include "tbase/endpoint.h"
+#include "tnet/socket.h"
+
+namespace tpurpc {
+
+class InputMessenger;
+
+class SocketMap {
+public:
+    static SocketMap* singleton();
+
+    // Get (or create, connect-on-first-write) the shared socket to `remote`
+    // whose input is handled by `messenger`. Returns 0 and sets *id.
+    int GetOrCreate(const EndPoint& remote, InputMessenger* messenger,
+                    SocketId* id);
+    // Drop the cached socket (e.g. after SetFailed).
+    void Remove(const EndPoint& remote, SocketId expected_id);
+
+private:
+    std::mutex mu_;
+    std::map<EndPoint, SocketId> map_;
+};
+
+}  // namespace tpurpc

@@ -62,4 +62,17 @@ int64_t LatencyRecorder::latency_percentile(double q) const {
 
 int64_t LatencyRecorder::max_latency() const { return window_delta().max; }
 
+std::string LatencyRecorder::get_description() const {
+    const Snap d = window_delta();
+    std::ostringstream os;
+    os << "{\"qps\":" << qps()
+       << ",\"avg_us\":" << (d.count > 0 ? d.sum / d.count : 0)
+       << ",\"p50\":" << d.hist.quantile(0.5)
+       << ",\"p90\":" << d.hist.quantile(0.9)
+       << ",\"p99\":" << d.hist.quantile(0.99)
+       << ",\"p999\":" << d.hist.quantile(0.999) << ",\"max\":" << d.max
+       << ",\"count\":" << count() << "}";
+    return os.str();
+}
+
 }  // namespace tpurpc

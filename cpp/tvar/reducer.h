@@ -10,7 +10,10 @@
 //
 // Lifetime contract (same spirit as bvar): a Reducer must not be destroyed
 // while other threads may still be writing to it — destroy after writer
-// threads quiesce. Reducers are typically process-lifetime globals.
+// threads quiesce. Reducers are typically process-lifetime globals. Note
+// that destroying a reducer orphans its per-thread cells until each writer
+// thread exits (one allocation + one TLS map entry per destroyed reducer
+// per thread) — don't create/destroy reducers in a hot loop.
 #pragma once
 
 #include <atomic>
@@ -33,12 +36,11 @@ struct AgentCell {
     std::mutex mu;
     T value{};
     void* owner = nullptr;
-    AgentCell* next_free = nullptr;
 };
 
 }  // namespace tvar_detail
 
-template <typename T, typename Op, typename InvOp = void>
+template <typename T, typename Op>
 class Reducer : public Variable {
 public:
     using Cell = tvar_detail::AgentCell<T>;
@@ -229,6 +231,7 @@ class PassiveStatus : public Variable {
 public:
     using Getter = T (*)(void*);
     PassiveStatus(Getter getter, void* arg) : getter_(getter), arg_(arg) {}
+    ~PassiveStatus() override { hide(); }
     T get_value() const { return getter_(arg_); }
     std::string get_description() const override {
         std::ostringstream os;
@@ -246,6 +249,9 @@ template <typename T>
 class Status : public Variable {
 public:
     explicit Status(T v = T()) : value_(v) {}
+    // Unregister BEFORE members are destroyed: a /vars scrape between
+    // ~Status and ~Variable would virtual-dispatch into a half-dead object.
+    ~Status() override { hide(); }
     void set_value(const T& v) {
         std::lock_guard<std::mutex> g(mu_);
         value_ = v;

@@ -1,0 +1,190 @@
+#include "tfiber/call_id.h"
+
+#include <mutex>
+#include <vector>
+
+#include "tbase/resource_pool.h"
+#include "tfiber/butex.h"
+
+namespace tpurpc {
+
+namespace {
+
+struct IdSlot {
+    std::mutex mu;
+    // The create-time version and the current wire version: retries bump
+    // live_ver (stale responses fail the strict lock check) but errors and
+    // joins stay valid across the whole [first_ver, live_ver] range — the
+    // reference's ranged bthread_id (id.h:56 bthread_id_create_ranged).
+    uint32_t first_ver = 0;
+    uint32_t live_ver = 0;
+    bool destroyed = true;
+    bool locked = false;
+    void* data = nullptr;
+    IdOnError on_error = nullptr;
+    void* lock_butex = nullptr;  // word: lock release sequence
+    void* join_butex = nullptr;  // word: destroy sequence
+    std::vector<int> pending_errors;
+};
+
+inline ResourceId slot_of(CallId id) {
+    return (ResourceId)((id & 0xffffffffu) - 1);
+}
+inline uint32_t ver_of(CallId id) { return (uint32_t)(id >> 32); }
+inline CallId make_id(uint32_t ver, ResourceId slot) {
+    return ((CallId)ver << 32) | (CallId)(slot + 1);
+}
+
+IdSlot* resolve(CallId id) {
+    if (id == INVALID_CALL_ID) return nullptr;
+    return address_resource<IdSlot>(slot_of(id));
+}
+
+// Strict: only the current wire version may lock (stale responses drop).
+bool valid_locked(IdSlot* s, CallId id) {
+    return !s->destroyed && s->live_ver == ver_of(id);
+}
+// Range: any version of this RPC may deliver errors / join.
+bool valid_range(IdSlot* s, CallId id) {
+    const uint32_t v = ver_of(id);
+    return !s->destroyed && v >= s->first_ver && v <= s->live_ver;
+}
+
+}  // namespace
+
+int id_create(CallId* id, void* data, IdOnError on_error) {
+    ResourceId slot;
+    IdSlot* s = get_resource<IdSlot>(&slot);
+    if (s == nullptr) return -1;
+    std::lock_guard<std::mutex> g(s->mu);
+    if (s->lock_butex == nullptr) s->lock_butex = butex_create();
+    if (s->join_butex == nullptr) s->join_butex = butex_create();
+    s->first_ver = s->live_ver;
+    s->destroyed = false;
+    s->locked = false;
+    s->data = data;
+    s->on_error = on_error;
+    s->pending_errors.clear();
+    *id = make_id(s->live_ver, slot);
+    return 0;
+}
+
+int id_lock(CallId id, void** data_out) {
+    IdSlot* s = resolve(id);
+    if (s == nullptr) return -1;
+    while (true) {
+        int seq;
+        {
+            std::lock_guard<std::mutex> g(s->mu);
+            if (!valid_locked(s, id)) return -1;
+            if (!s->locked) {
+                s->locked = true;
+                if (data_out) *data_out = s->data;
+                return 0;
+            }
+            seq = butex_word(s->lock_butex)->load(std::memory_order_relaxed);
+        }
+        butex_wait(s->lock_butex, seq, nullptr);
+    }
+}
+
+int id_unlock(CallId id) {
+    IdSlot* s = resolve(id);
+    if (s == nullptr) return -1;
+    int deferred_error = 0;
+    bool run_error = false;
+    {
+        std::lock_guard<std::mutex> g(s->mu);
+        if (!s->locked) return -1;
+        if (!s->pending_errors.empty() && valid_range(s, id)) {
+            // Keep the lock and deliver the queued error to on_error.
+            deferred_error = s->pending_errors.front();
+            s->pending_errors.erase(s->pending_errors.begin());
+            run_error = true;
+        } else {
+            s->locked = false;
+            butex_word(s->lock_butex)
+                ->fetch_add(1, std::memory_order_release);
+        }
+    }
+    if (run_error) {
+        IdOnError cb = s->on_error;
+        void* data = s->data;
+        if (cb != nullptr) {
+            return cb(id, data, deferred_error);
+        }
+        return id_unlock_and_destroy(id);
+    }
+    butex_wake(s->lock_butex);
+    return 0;
+}
+
+int id_unlock_and_destroy(CallId id) {
+    IdSlot* s = resolve(id);
+    if (s == nullptr) return -1;
+    {
+        std::lock_guard<std::mutex> g(s->mu);
+        if (s->destroyed) return -1;
+        s->destroyed = true;
+        s->locked = false;
+        ++s->live_ver;  // all outstanding versions go stale
+        s->pending_errors.clear();
+        butex_word(s->lock_butex)->fetch_add(1, std::memory_order_release);
+        butex_word(s->join_butex)->fetch_add(1, std::memory_order_release);
+    }
+    butex_wake_all(s->lock_butex);
+    butex_wake_all(s->join_butex);
+    return_resource<IdSlot>(slot_of(id));
+    return 0;
+}
+
+int id_error(CallId id, int error_code) {
+    IdSlot* s = resolve(id);
+    if (s == nullptr) return -1;
+    {
+        std::lock_guard<std::mutex> g(s->mu);
+        if (!valid_range(s, id)) return -1;
+        if (s->locked) {
+            s->pending_errors.push_back(error_code);
+            return 0;
+        }
+        s->locked = true;
+    }
+    IdOnError cb = s->on_error;
+    if (cb != nullptr) {
+        return cb(id, s->data, error_code);
+    }
+    return id_unlock_and_destroy(id);
+}
+
+int id_join(CallId id) {
+    IdSlot* s = resolve(id);
+    if (s == nullptr) return 0;
+    while (true) {
+        int seq;
+        {
+            std::lock_guard<std::mutex> g(s->mu);
+            if (!valid_range(s, id)) return 0;
+            seq = butex_word(s->join_butex)->load(std::memory_order_relaxed);
+        }
+        butex_wait(s->join_butex, seq, nullptr);
+    }
+}
+
+CallId id_next_version(CallId id) {
+    IdSlot* s = resolve(id);
+    if (s == nullptr) return INVALID_CALL_ID;
+    std::lock_guard<std::mutex> g(s->mu);
+    if (!valid_locked(s, id)) return INVALID_CALL_ID;
+    ++s->live_ver;
+    return make_id(s->live_ver, slot_of(id));
+}
+
+bool id_exists(CallId id) {
+    IdSlot* s = resolve(id);
+    if (s == nullptr) return false;
+    std::lock_guard<std::mutex> g(s->mu);
+    return valid_locked(s, id);
+}
+
+}  // namespace tpurpc

@@ -1,0 +1,49 @@
+// CallId: lockable, versioned 64-bit handle with error propagation — the
+// RPC correlation-id mechanism.
+//
+// Modeled on reference src/bthread/id.h:34-100 (bthread_id_create/lock/
+// unlock/unlock_and_destroy/error/join): one RPC's Controller is locked by
+// its CallId; the response path and the error path (timeout, socket
+// failure) both contend for the lock, and retries bump the version so
+// stale responses from earlier tries fail to lock.
+//
+// Simplifications vs the reference: the internal lock is a small mutex +
+// condition (the reference queues lockers on a butex); version ranges are a
+// single live version bumped by next_version().
+#pragma once
+
+#include <cstdint>
+
+namespace tpurpc {
+
+using CallId = uint64_t;
+constexpr CallId INVALID_CALL_ID = 0;
+
+// on_error runs with the id LOCKED; it must eventually call
+// id_unlock (retry path) or id_unlock_and_destroy (final failure).
+using IdOnError = int (*)(CallId id, void* data, int error_code);
+
+int id_create(CallId* id, void* data, IdOnError on_error);
+
+// Lock the id; fails (-1) if the id/version is stale or destroyed. Blocks
+// (fiber- and pthread-aware) while another holder has the lock.
+int id_lock(CallId id, void** data_out);
+int id_unlock(CallId id);
+// Unlock and destroy: wakes all joiners; further locks fail.
+int id_unlock_and_destroy(CallId id);
+
+// Deliver an error: locks the id and invokes on_error(data, error_code).
+// Returns -1 if the id is stale/destroyed.
+int id_error(CallId id, int error_code);
+
+// Block until the id is destroyed (returns immediately if stale).
+int id_join(CallId id);
+
+// Invalidate the current version and return the next one (retries). Caller
+// must hold the lock; the returned id replaces the old one on the wire.
+CallId id_next_version(CallId id);
+
+// True while the id (this version) is live.
+bool id_exists(CallId id);
+
+}  // namespace tpurpc

@@ -24,7 +24,7 @@ TimerId TimerThread::schedule(void (*fn)(void*), void* arg,
     return id;
 }
 
-int TimerThread::unschedule(TimerId id) {
+int TimerThread::unschedule(TimerId id, bool wait_running) {
     std::unique_lock<std::mutex> lk(mu_);
     auto idx = by_id_.find(id);
     if (idx != by_id_.end()) {
@@ -33,9 +33,11 @@ int TimerThread::unschedule(TimerId id) {
         return 0;
     }
     if (running_id_ == id) {
-        // Block until the in-flight callback finishes (butex timed-wait
-        // safety depends on this).
-        run_done_cv_.wait(lk, [this, id] { return running_id_ != id; });
+        if (wait_running) {
+            // Block until the in-flight callback finishes (butex timed-wait
+            // safety depends on this).
+            run_done_cv_.wait(lk, [this, id] { return running_id_ != id; });
+        }
         return 1;
     }
     return -1;  // already ran (or never existed)

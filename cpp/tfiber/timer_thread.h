@@ -27,9 +27,12 @@ public:
     TimerId schedule(void (*fn)(void*), void* arg, int64_t abstime_us);
 
     // Cancel. Returns 0 if cancelled before running; 1 if it already ran or
-    // was running (in which case this call BLOCKS until the callback
-    // completes); -1 if unknown.
-    int unschedule(TimerId id);
+    // was running; -1 if unknown. With wait_running (the default) a call
+    // BLOCKS until an in-flight callback completes — the guarantee butex
+    // timed-waits need for stack-allocated waiters. Pass false for
+    // fire-and-forget cancels whose callbacks hold only values (RPC
+    // timeout timers carry CallId values, never pointers).
+    int unschedule(TimerId id, bool wait_running = true);
 
     void stop_and_join();
 

@@ -45,6 +45,44 @@ void Acceptor::StopAccept() {
         Socket::SetFailedById(listen_id_);
         listen_id_ = INVALID_VREF_ID;
     }
+    std::lock_guard<std::mutex> g(conn_mu_);
+    for (SocketId id : conn_ids_) {
+        Socket::SetFailedById(id);
+    }
+    conn_ids_.clear();
+}
+
+std::vector<SocketId> Acceptor::connections() {
+    std::lock_guard<std::mutex> g(conn_mu_);
+    std::vector<SocketId> live;
+    for (auto it = conn_ids_.begin(); it != conn_ids_.end();) {
+        Socket* s = Socket::Address(*it);
+        if (s == nullptr) {
+            it = conn_ids_.erase(it);  // prune dead ids
+        } else {
+            s->Dereference();
+            live.push_back(*it);
+            ++it;
+        }
+    }
+    return live;
+}
+
+void Acceptor::record_connection(SocketId id) {
+    std::lock_guard<std::mutex> g(conn_mu_);
+    conn_ids_.insert(id);
+    // Bound growth under connection churn: prune dead ids periodically.
+    if (conn_ids_.size() > 1024 && (conn_ids_.size() & 1023) == 0) {
+        for (auto it = conn_ids_.begin(); it != conn_ids_.end();) {
+            Socket* s = Socket::Address(*it);
+            if (s == nullptr) {
+                it = conn_ids_.erase(it);
+            } else {
+                s->Dereference();
+                ++it;
+            }
+        }
+    }
 }
 
 void Acceptor::OnNewConnections(Socket* listen_socket) {
@@ -77,6 +115,7 @@ void Acceptor::OnNewConnections(Socket* listen_socket) {
             // Socket::Create owns (and closed) fd on failure.
             continue;
         }
+        a->record_connection(id);
         a->accepted_.fetch_add(1, std::memory_order_relaxed);
     }
 }

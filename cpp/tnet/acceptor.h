@@ -4,6 +4,9 @@
 #pragma once
 
 #include <atomic>
+#include <mutex>
+#include <set>
+#include <vector>
 
 #include "tbase/endpoint.h"
 #include "tnet/input_messenger.h"
@@ -18,6 +21,10 @@ public:
 
     // Listen on `ep` (port 0 picks one; see listened_port()). Returns 0.
     int StartAccept(const EndPoint& ep);
+    // Stops listening AND fails all accepted connections — their sockets
+    // hold pointers into the owning server, which may be destroyed next
+    // (reference Acceptor keeps the connection list for the same reason,
+    // acceptor.h + /connections).
     void StopAccept();
     int listened_port() const { return listened_port_; }
 
@@ -25,14 +32,19 @@ public:
     int64_t accepted_count() const {
         return accepted_.load(std::memory_order_relaxed);
     }
+    // Live accepted connections (for /connections later).
+    std::vector<SocketId> connections();
 
 private:
     static void OnNewConnections(Socket* listen_socket);
+    void record_connection(SocketId id);
 
     InputMessenger* messenger_;
     SocketId listen_id_ = INVALID_VREF_ID;
     int listened_port_ = 0;
     std::atomic<int64_t> accepted_{0};
+    std::mutex conn_mu_;
+    std::set<SocketId> conn_ids_;
 };
 
 }  // namespace tpurpc

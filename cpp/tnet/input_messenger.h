@@ -23,6 +23,10 @@ public:
 
     void add_protocol(int index) { protocols_.push_back(index); }
 
+    // Owner context (the Server* for server-side messengers; null for the
+    // client messenger) — how protocol process() finds the server.
+    void* context = nullptr;
+
     // Socket edge-trigger callback (runs on a fiber).
     static void OnNewMessages(Socket* s);
 

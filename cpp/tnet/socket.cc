@@ -12,6 +12,7 @@
 #include "tbase/flags.h"
 #include "tbase/logging.h"
 #include "tbase/time.h"
+#include "tfiber/call_id.h"
 #include "tnet/event_dispatcher.h"
 
 DEFINE_int64(socket_max_unwritten_bytes, 64 * 1024 * 1024,
@@ -83,6 +84,28 @@ void Socket::OnFailed() {
     butex_wake_all(connect_butex_);
 }
 
+namespace {
+void* id_error_fiber(void* arg) {
+    id_error((uint64_t)(uintptr_t)arg, TERR_FAILED_SOCKET);
+    return nullptr;
+}
+}  // namespace
+
+// Dropped (never-written) requests error-notify their RPCs — from a fresh
+// fiber, never inline: OnRecycle can run under arbitrary locks (e.g.
+// SocketMap::mu_ via Dereference) and the error handler may retry into
+// those same locks.
+void Socket::DropWriteRequest(WriteRequest* req) {
+    if (req->notify_id != 0) {
+        fiber_t tid;
+        if (fiber_start_background(&tid, nullptr, id_error_fiber,
+                                   (void*)(uintptr_t)req->notify_id) != 0) {
+            id_error(req->notify_id, TERR_FAILED_SOCKET);
+        }
+    }
+    delete req;
+}
+
 void Socket::OnRecycle() {
     const int fd = fd_.exchange(-1, std::memory_order_acq_rel);
     if (fd >= 0) {
@@ -91,7 +114,7 @@ void Socket::OnRecycle() {
     }
     // Free any queued write requests (writers stopped: Address fails).
     for (size_t i = inflight_index_; i < inflight_batch_.size(); ++i) {
-        delete inflight_batch_[i];
+        DropWriteRequest(inflight_batch_[i]);
     }
     inflight_batch_.clear();
     inflight_index_ = 0;
@@ -101,7 +124,7 @@ void Socket::OnRecycle() {
         while (next == WriteRequest::unlinked()) {
             next = head->next.load(std::memory_order_acquire);
         }
-        delete head;
+        DropWriteRequest(head);
         head = next;
     }
     read_buf.clear();
@@ -114,7 +137,7 @@ int Socket::SetFailedWithError(int error_code) {
 
 // ---------------- write path ----------------
 
-int Socket::Write(IOBuf* data) {
+int Socket::Write(IOBuf* data, uint64_t notify_id) {
     if (Failed()) {
         errno = TERR_FAILED_SOCKET;
         return -1;
@@ -126,6 +149,7 @@ int Socket::Write(IOBuf* data) {
         return -1;
     }
     WriteRequest* req = new WriteRequest;
+    req->notify_id = notify_id;
     req->data.swap(*data);
     req->next.store(WriteRequest::unlinked(), std::memory_order_relaxed);
     unwritten_bytes_.fetch_add(sz, std::memory_order_relaxed);

@@ -65,8 +65,11 @@ public:
     // ---- write path ----
     // Queue `data` (zero-copy moved) for ordered write. Returns 0, or -1
     // with errno (EOVERCROWDED when the unwritten backlog is too large,
-    // or the socket is failed). Never blocks.
-    int Write(IOBuf* data);
+    // or the socket is failed). Never blocks. `notify_id` (a CallId value)
+    // is error-notified if the request is dropped by a write failure —
+    // how in-flight RPCs learn their connection died (the reference passes
+    // Controller ids through WriteRequest, socket.cpp Write w/ id_wait).
+    int Write(IOBuf* data, uint64_t notify_id = 0);
 
     // ---- read path (called by EventDispatcher) ----
     static void OnInputEventById(SocketId id);
@@ -103,9 +106,11 @@ private:
     struct WriteRequest {
         std::atomic<WriteRequest*> next{nullptr};
         IOBuf data;
+        uint64_t notify_id = 0;
         static WriteRequest* unlinked() { return (WriteRequest*)0x1; }
     };
 
+    static void DropWriteRequest(WriteRequest* req);
     void StartKeepWriteIfNeeded();
     static void* KeepWriteThunk(void* arg);  // arg = SocketId
     void KeepWrite();

@@ -1,0 +1,109 @@
+#include "trpc/channel.h"
+
+#include "tbase/errno.h"
+#include "tbase/logging.h"
+#include "tbase/time.h"
+#include "tfiber/call_id.h"
+#include "trpc/controller.h"
+#include "trpc/pb_compat.h"
+#include "trpc/policy_tpu_std.h"
+
+namespace tpurpc {
+
+Channel::~Channel() = default;
+
+InputMessenger* Channel::client_messenger() {
+    static InputMessenger* m = [] {
+        GlobalInitializeOrDie();
+        return new InputMessenger({TpuStdProtocolIndex()});
+    }();
+    return m;
+}
+
+int Channel::Init(const EndPoint& server, const ChannelOptions* options) {
+    GlobalInitializeOrDie();
+    server_ep_ = server;
+    if (options != nullptr) options_ = *options;
+    return 0;
+}
+
+int Channel::Init(const char* server_addr_and_port,
+                  const ChannelOptions* options) {
+    EndPoint ep;
+    if (hostname2endpoint(server_addr_and_port, &ep) != 0) {
+        LOG(ERROR) << "bad address: " << server_addr_and_port;
+        return -1;
+    }
+    return Init(ep, options);
+}
+
+int Channel::Init(const char* naming_url, const char* lb_name,
+                  const ChannelOptions* options) {
+    // Naming + LB lands with the client-robustness milestone (SURVEY §7.7).
+    LOG(ERROR) << "naming-service channels not wired yet: " << naming_url
+               << " lb=" << lb_name;
+    (void)options;
+    return -1;
+}
+
+// Timer callback for RPC deadlines: holds only the CallId VALUE (never a
+// pointer), so a finished/destroyed RPC makes this a no-op (reference
+// HandleTimeout, controller.cpp:593).
+static void HandleTimeoutCb(void* arg) {
+    id_error((CallId)(uintptr_t)arg, TERR_RPC_TIMEDOUT);
+}
+
+void Channel::CallMethod(const google::protobuf::MethodDescriptor* method,
+                         google::protobuf::RpcController* controller,
+                         const google::protobuf::Message* request,
+                         google::protobuf::Message* response,
+                         google::protobuf::Closure* done) {
+    Controller* cntl = static_cast<Controller*>(controller);
+    cntl->channel_ = this;
+    cntl->method_ = method;
+    cntl->response_ = response;
+    cntl->done_ = done;
+    cntl->start_us_ = monotonic_time_us();
+
+    if (id_create(&cntl->correlation_id_, cntl,
+                  &Controller::HandleErrorThunk) != 0) {
+        cntl->SetFailed(TERR_INTERNAL, "id_create failed");
+        if (done) done->Run();
+        return;
+    }
+    cntl->current_cid_ = cntl->correlation_id_;
+
+    // Hold the id lock through setup + IssueRPC (reference CallMethod does
+    // the same, channel.cpp:467): an early timeout/error gets QUEUED on the
+    // locked id and delivered at unlock, instead of destroying the
+    // Controller under our feet mid-issue.
+    const CallId cid = cntl->correlation_id_;
+    void* unused;
+    CHECK_EQ(id_lock(cid, &unused), 0);
+
+    if (!SerializePbToIOBuf(*request, &cntl->request_buf_)) {
+        cntl->SetFailed(TERR_REQUEST, "serialize request failed");
+        cntl->EndRPC(cid);
+        return;
+    }
+
+    const int64_t timeout_ms =
+        cntl->timeout_ms_ >= 0 ? cntl->timeout_ms_ : options_.timeout_ms;
+    if (timeout_ms > 0) {
+        cntl->deadline_us_ = cntl->start_us_ + timeout_ms * 1000;
+        cntl->timeout_timer_ = TimerThread::singleton()->schedule(
+            HandleTimeoutCb, (void*)(uintptr_t)cid, cntl->deadline_us_);
+    }
+
+    cntl->IssueRPC();
+    id_unlock(cid);  // delivers any queued early error
+    // `cntl` may already be gone here (async completion).
+
+    if (done == nullptr) {
+        // Synchronous call: wait for destroy (works from fibers and plain
+        // pthreads alike — butex handles both waiter kinds).
+        id_join(cid);
+    }
+}
+
+}  // namespace tpurpc

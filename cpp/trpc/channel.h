@@ -1,0 +1,61 @@
+// Channel: the client stub transport — a protobuf RpcChannel over the
+// native tpu_std protocol.
+//
+// Modeled on reference src/brpc/channel.{h,cpp}: Init with "ip:port"
+// (InitSingle channel.cpp:342) or a naming-service URL + load-balancer name
+// (channel.cpp:260-430), CallMethod (:433) creating the correlation id,
+// serializing, arming timers and delegating to Controller::IssueRPC.
+#pragma once
+
+#include <google/protobuf/service.h>
+
+#include <memory>
+#include <string>
+
+#include "tbase/endpoint.h"
+#include "tnet/input_messenger.h"
+
+namespace tpurpc {
+
+class LoadBalancerWithNaming;
+
+struct ChannelOptions {
+    int64_t timeout_ms = 500;   // same default as the reference
+    int max_retry = 3;
+    int64_t backup_request_ms = -1;  // <0 disabled
+};
+
+class Channel : public google::protobuf::RpcChannel {
+public:
+    Channel() = default;
+    ~Channel() override;
+
+    // Single-server init: "127.0.0.1:8002".
+    int Init(const char* server_addr_and_port, const ChannelOptions* options);
+    int Init(const EndPoint& server, const ChannelOptions* options);
+    // Naming + load balancing: Init("list://h1:p1,h2:p2", "rr", &opts)
+    // (naming URL schemes and LB names per SURVEY §2.6; wired in the
+    // client-robustness milestone).
+    int Init(const char* naming_url, const char* lb_name,
+             const ChannelOptions* options);
+
+    void CallMethod(const google::protobuf::MethodDescriptor* method,
+                    google::protobuf::RpcController* controller,
+                    const google::protobuf::Message* request,
+                    google::protobuf::Message* response,
+                    google::protobuf::Closure* done) override;
+
+    const ChannelOptions& options() const { return options_; }
+    const EndPoint& server() const { return server_ep_; }
+    LoadBalancerWithNaming* lb() const { return lb_.get(); }
+
+    // The process-wide client messenger for tpu_std responses.
+    static InputMessenger* client_messenger();
+
+private:
+    EndPoint server_ep_;
+    ChannelOptions options_;
+    std::shared_ptr<LoadBalancerWithNaming> lb_;
+};
+
+}  // namespace tpurpc

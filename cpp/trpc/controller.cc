@@ -1,0 +1,221 @@
+#include "trpc/controller.h"
+
+#include <google/protobuf/descriptor.h>
+
+#include <cstdarg>
+
+#include "rpc_meta.pb.h"
+#include "tbase/errno.h"
+#include "tbase/logging.h"
+#include "tbase/time.h"
+#include "tfiber/fiber.h"
+#include "tnet/socket_map.h"
+#include "trpc/channel.h"
+#include "trpc/pb_compat.h"
+#include "trpc/policy_tpu_std.h"
+
+namespace tpurpc {
+
+Controller::~Controller() = default;
+
+void Controller::Reset() {
+    error_code_ = 0;
+    error_text_.clear();
+    timeout_ms_ = -1;   // -1: use the channel default
+    max_retry_ = -1;
+    log_id_ = 0;
+    canceled_ = false;
+    request_attachment_.clear();
+    response_attachment_.clear();
+    remote_side_ = EndPoint();
+    local_side_ = EndPoint();
+    latency_us_ = 0;
+    channel_ = nullptr;
+    method_ = nullptr;
+    response_ = nullptr;
+    done_ = nullptr;
+    correlation_id_ = INVALID_CALL_ID;
+    current_cid_ = INVALID_CALL_ID;
+    request_buf_.clear();
+    current_try_ = 0;
+    start_us_ = 0;
+    deadline_us_ = 0;
+    timeout_timer_ = INVALID_TIMER_ID;
+    single_server_id_ = INVALID_VREF_ID;
+    server_ = nullptr;
+}
+
+void Controller::SetFailed(const std::string& reason) {
+    error_code_ = TERR_INTERNAL;
+    error_text_ = reason;
+}
+
+void Controller::SetFailed(int error_code, const char* fmt, ...) {
+    error_code_ = error_code;
+    char buf[512];
+    va_list ap;
+    va_start(ap, fmt);
+    vsnprintf(buf, sizeof(buf), fmt, ap);
+    va_end(ap);
+    error_text_ = buf;
+}
+
+void Controller::StartCancel() {
+    canceled_ = true;
+    if (correlation_id_ != INVALID_CALL_ID) {
+        id_error(correlation_id_, ECANCELED);
+    }
+}
+
+// ---------------- client call machinery ----------------
+
+int Controller::HandleErrorThunk(CallId id, void* data, int error) {
+    return ((Controller*)data)->HandleError(id, error);
+}
+
+static bool is_retryable(int error) {
+    // The default retry policy (reference src/brpc/retry_policy.cpp
+    // DefaultRetryPolicy: EFAILEDSOCKET/EEOF/EHOSTDOWN/...): connection-
+    // level failures retry, server-side/user errors and timeouts don't.
+    switch (error) {
+        case TERR_FAILED_SOCKET:
+        case TERR_EOF:
+        case TERR_OVERCROWDED:
+        case ECONNREFUSED:
+        case ECONNRESET:
+        case EPIPE:
+            return true;
+        default:
+            return false;
+    }
+}
+
+int Controller::HandleError(CallId id, int error) {
+    // Runs with the id locked.
+    const int effective_max_retry =
+        max_retry_ >= 0 ? max_retry_
+                        : (channel_ ? channel_->options().max_retry : 0);
+    if (is_retryable(error) && current_try_ < effective_max_retry &&
+        (deadline_us_ == 0 || monotonic_time_us() < deadline_us_)) {
+        ++current_try_;
+        const CallId next = id_next_version(current_cid_);
+        if (next != INVALID_CALL_ID) {
+            current_cid_ = next;
+            IssueRPC();
+            return id_unlock(id);
+        }
+    }
+    SetFailed(error, "%s", terror(error));
+    EndRPC(id);
+    return 0;
+}
+
+void Controller::IssueRPC() {
+    SocketId sid = INVALID_VREF_ID;
+    if (SocketMap::singleton()->GetOrCreate(channel_->server(),
+                                            Channel::client_messenger(),
+                                            &sid) != 0) {
+        id_error(current_cid_, TERR_FAILED_SOCKET);
+        return;
+    }
+    single_server_id_ = sid;
+    SocketUniquePtr s;
+    if (Socket::AddressSocket(sid, &s) != 0) {
+        id_error(current_cid_, TERR_FAILED_SOCKET);
+        return;
+    }
+    remote_side_ = s->remote_side();
+
+    // Sender-side frame limit: the receiver rejects >256MB frames as a
+    // PROTOCOL error (failing the whole connection); catch it here so only
+    // this one RPC fails (also guards the uint32 length field).
+    if (request_buf_.size() + request_attachment_.size() > (200u << 20)) {
+        id_error(current_cid_, TERR_REQUEST);
+        return;
+    }
+
+    rpc::RpcMeta meta;
+    auto* req_meta = meta.mutable_request();
+    req_meta->set_service_name(method_->service()->full_name());
+    req_meta->set_method_name(method_->name());
+    if (deadline_us_ > 0) {
+        req_meta->set_timeout_ms((deadline_us_ - monotonic_time_us()) / 1000);
+    }
+    if (log_id_ != 0) req_meta->set_log_id(log_id_);
+    meta.set_correlation_id(current_cid_);
+    meta.set_attachment_size((uint32_t)request_attachment_.size());
+    IOBuf meta_buf;
+    SerializePbToIOBuf(meta, &meta_buf);
+    IOBuf frame;
+    PackTpuStdFrame(&frame, meta_buf, request_buf_, request_attachment_);
+    if (s->Write(&frame, current_cid_) != 0) {
+        // Queue full or failed socket: deliver the error (may retry).
+        id_error(current_cid_, errno != 0 ? errno : TERR_FAILED_SOCKET);
+    }
+}
+
+void* Controller::RunDoneThunk(void* arg) {
+    ((google::protobuf::Closure*)arg)->Run();
+    return nullptr;
+}
+
+void Controller::EndRPC(CallId locked_id) {
+    latency_us_ = monotonic_time_us() - start_us_;
+    if (timeout_timer_ != INVALID_TIMER_ID) {
+        // Best-effort: if the callback is running it will find the id
+        // destroyed (it only holds the id VALUE, never this pointer).
+        TimerThread::singleton()->unschedule(timeout_timer_, false);
+        timeout_timer_ = INVALID_TIMER_ID;
+    }
+    google::protobuf::Closure* done = done_;
+    id_unlock_and_destroy(locked_id);
+    // `this` may be deleted by done from here on.
+    if (done != nullptr) {
+        if (is_running_on_fiber_worker()) {
+            done->Run();
+        } else {
+            // Never run user code on the timer thread.
+            fiber_t tid;
+            if (fiber_start_background(&tid, nullptr, RunDoneThunk, done) !=
+                0) {
+                done->Run();
+            }
+        }
+    }
+}
+
+// ---------------- client response path ----------------
+
+void ProcessTpuStdResponse(TpuStdMessage* msg, const rpc::RpcMeta& meta) {
+    const CallId cid = meta.correlation_id();
+    void* data = nullptr;
+    if (id_lock(cid, &data) != 0) {
+        return;  // stale retry/duplicate/timeout-finished: drop
+    }
+    Controller* cntl = (Controller*)data;
+    const auto& rmeta = meta.response();
+    if (rmeta.error_code() != 0) {
+        cntl->SetFailed(rmeta.error_code(), "%s", rmeta.error_text().c_str());
+        cntl->EndRPC(cid);
+        return;
+    }
+    // Split payload/attachment and deserialize.
+    const uint32_t att_size = meta.attachment_size();
+    if ((size_t)att_size > msg->body.size()) {
+        cntl->SetFailed(TERR_RESPONSE, "attachment_size %u > body %zu",
+                        att_size, msg->body.size());
+        cntl->EndRPC(cid);
+        return;
+    }
+    IOBuf payload;
+    msg->body.cutn(&payload, msg->body.size() - att_size);
+    cntl->response_attachment().clear();
+    cntl->response_attachment().swap(msg->body);
+    if (cntl->response_ != nullptr &&
+        !ParsePbFromIOBuf(cntl->response_, payload)) {
+        cntl->SetFailed(TERR_RESPONSE, "parse response failed");
+    }
+    cntl->EndRPC(cid);
+}
+
+}  // namespace tpurpc

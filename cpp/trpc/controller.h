@@ -1,0 +1,122 @@
+// Controller: the per-RPC state machine and user knob surface, client and
+// server side.
+//
+// Modeled on reference src/brpc/controller.h / controller.cpp: IssueRPC
+// (:1047) picks the server + connection and writes the packed request;
+// OnVersionedRPCReturned (:598) is the response/failure funnel handling
+// retries via versioned call ids (:1059-1065) and timeouts (:593);
+// Call::OnComplete (:780) feeds the load balancer. Implements
+// google::protobuf::RpcController so generated stubs work unchanged.
+#pragma once
+
+#include <google/protobuf/message.h>
+#include <google/protobuf/service.h>
+
+#include <atomic>
+#include <string>
+
+#include "tbase/endpoint.h"
+#include "tbase/iobuf.h"
+#include "tfiber/call_id.h"
+#include "tfiber/timer_thread.h"
+#include "tnet/socket.h"
+
+namespace tpurpc {
+
+namespace rpc {
+class RpcMeta;
+}
+
+class Channel;
+class Server;
+
+class Controller : public google::protobuf::RpcController {
+public:
+    Controller() { Reset(); }
+    ~Controller() override;
+
+    // ---- client-side knobs ----
+    void set_timeout_ms(int64_t t) { timeout_ms_ = t; }
+    int64_t timeout_ms() const { return timeout_ms_; }
+    void set_max_retry(int r) { max_retry_ = r; }
+    int max_retry() const { return max_retry_; }
+    void set_log_id(int64_t id) { log_id_ = id; }
+    int64_t log_id() const { return log_id_; }
+    // Attachment bytes carried outside the pb payload (zero-copy).
+    IOBuf& request_attachment() { return request_attachment_; }
+    IOBuf& response_attachment() { return response_attachment_; }
+
+    // ---- results ----
+    bool Failed() const override { return error_code_ != 0; }
+    std::string ErrorText() const override { return error_text_; }
+    int ErrorCode() const { return error_code_; }
+    void SetFailed(const std::string& reason) override;
+    void SetFailed(int error_code, const char* fmt, ...);
+    int64_t latency_us() const { return latency_us_; }
+    EndPoint remote_side() const { return remote_side_; }
+    EndPoint local_side() const { return local_side_; }
+    int retried_count() const { return current_try_; }
+
+    // The correlation id of this RPC (join it to wait for async calls).
+    CallId call_id() const { return correlation_id_; }
+
+    // ---- protobuf::RpcController surface ----
+    void Reset() override;
+    void StartCancel() override;
+    bool IsCanceled() const override { return canceled_; }
+    void NotifyOnCancel(google::protobuf::Closure*) override {}
+
+    // ---- server side ----
+    bool is_server_side() const { return server_ != nullptr; }
+    Server* server() const { return server_; }
+    // Called by the server-side protocol when building the call context.
+    void InitServerSide(Server* server, const EndPoint& remote) {
+        server_ = server;
+        remote_side_ = remote;
+    }
+
+private:
+    friend class Channel;
+    friend class Server;
+    friend void ProcessTpuStdResponse(class TpuStdMessage* msg,
+                                      const rpc::RpcMeta& meta);
+
+    // Client call machinery (used by Channel).
+    static int HandleErrorThunk(CallId id, void* data, int error);
+    int HandleError(CallId id, int error);   // runs with the id locked
+    void IssueRPC();                          // (re)send the current try
+    void EndRPC(CallId locked_id);            // finalize: done/join wakeup
+    static void* RunDoneThunk(void* arg);
+
+    // --- shared fields ---
+    int error_code_;
+    std::string error_text_;
+    int64_t timeout_ms_;
+    int max_retry_;
+    int64_t log_id_;
+    bool canceled_;
+    IOBuf request_attachment_;
+    IOBuf response_attachment_;
+    EndPoint remote_side_;
+    EndPoint local_side_;
+    int64_t latency_us_;
+
+    // --- client call state ---
+    Channel* channel_;
+    const google::protobuf::MethodDescriptor* method_;
+    google::protobuf::Message* response_;
+    google::protobuf::Closure* done_;
+    CallId correlation_id_;   // base id (create version)
+    CallId current_cid_;      // wire id of the current try
+    IOBuf request_buf_;       // serialized request payload (pb bytes)
+    int current_try_;
+    int64_t start_us_;
+    int64_t deadline_us_;
+    TimerId timeout_timer_;
+    SocketId single_server_id_;
+
+    // --- server call state ---
+    Server* server_;
+};
+
+}  // namespace tpurpc

@@ -1,0 +1,29 @@
+// protobuf <-> IOBuf glue.
+// The reference bridges via IOBufAsZeroCopy{In,Out}putStream
+// (src/butil/iobuf.h:163-195); we start with a copy-based path (payload pbs
+// are small — bulk bytes ride attachments zero-copy) and will add the
+// zero-copy streams in a perf pass.
+#pragma once
+
+#include <google/protobuf/message_lite.h>
+
+#include <string>
+
+#include "tbase/iobuf.h"
+
+namespace tpurpc {
+
+inline bool SerializePbToIOBuf(const google::protobuf::MessageLite& msg,
+                               IOBuf* out) {
+    std::string s;
+    if (!msg.SerializeToString(&s)) return false;
+    out->append(s);
+    return true;
+}
+
+inline bool ParsePbFromIOBuf(google::protobuf::MessageLite* msg,
+                             const IOBuf& buf) {
+    return msg->ParseFromString(buf.to_string());
+}
+
+}  // namespace tpurpc

@@ -1,0 +1,262 @@
+#include "trpc/policy_tpu_std.h"
+
+#include <arpa/inet.h>
+
+#include <cstring>
+#include <mutex>
+
+#include "rpc_meta.pb.h"
+#include "tbase/errno.h"
+#include "tbase/logging.h"
+#include "tbase/time.h"
+#include "tnet/input_messenger.h"
+#include "trpc/controller.h"
+#include "trpc/pb_compat.h"
+#include "trpc/server.h"
+
+namespace tpurpc {
+
+namespace {
+constexpr char kMagic[4] = {'T', 'R', 'P', 'C'};
+constexpr size_t kHeaderLen = 12;
+int g_tpu_std_index = -1;
+}  // namespace
+
+int TpuStdProtocolIndex() { return g_tpu_std_index; }
+
+ParseResult ParseTpuStdMessage(IOBuf* source, Socket* socket, bool read_eof,
+                               const void* arg) {
+    if (source->size() < kHeaderLen) {
+        char head[4];
+        const size_t n = source->copy_to(head, 4);
+        if (memcmp(head, kMagic, n) != 0) {
+            return ParseResult::make(ParseError::TRY_OTHERS);
+        }
+        return ParseResult::make(ParseError::NOT_ENOUGH_DATA);
+    }
+    char header[kHeaderLen];
+    source->copy_to(header, kHeaderLen);
+    if (memcmp(header, kMagic, 4) != 0) {
+        return ParseResult::make(ParseError::TRY_OTHERS);
+    }
+    uint32_t body_size, meta_size;
+    memcpy(&body_size, header + 4, 4);
+    memcpy(&meta_size, header + 8, 4);
+    body_size = ntohl(body_size);
+    meta_size = ntohl(meta_size);
+    if (meta_size > body_size || body_size > (256u << 20)) {
+        return ParseResult::make(ParseError::ERROR);
+    }
+    if (source->size() < kHeaderLen + body_size) {
+        return ParseResult::make(ParseError::NOT_ENOUGH_DATA);
+    }
+    source->pop_front(kHeaderLen);
+    auto* msg = new TpuStdMessage;
+    source->cutn(&msg->meta, meta_size);
+    source->cutn(&msg->body, body_size - meta_size);
+    return ParseResult::make_ok(msg);
+}
+
+void PackTpuStdFrame(IOBuf* out, const IOBuf& meta_pb, const IOBuf& payload,
+                     const IOBuf& attachment) {
+    char header[kHeaderLen];
+    memcpy(header, kMagic, 4);
+    const uint32_t body =
+        htonl((uint32_t)(meta_pb.size() + payload.size() + attachment.size()));
+    const uint32_t meta = htonl((uint32_t)meta_pb.size());
+    memcpy(header + 4, &body, 4);
+    memcpy(header + 8, &meta, 4);
+    out->append(header, kHeaderLen);
+    out->append(meta_pb);
+    out->append(payload);
+    out->append(attachment);
+}
+
+// ---------------- server side ----------------
+
+namespace {
+
+// done-closure finishing one server call: serialize + respond + stats.
+class SendResponseClosure : public google::protobuf::Closure {
+public:
+    SendResponseClosure(Server* server, Server::MethodProperty* mp,
+                        Controller* cntl, google::protobuf::Message* req,
+                        google::protobuf::Message* res, SocketId sid,
+                        uint64_t cid, int64_t start_us)
+        : server_(server),
+          mp_(mp),
+          cntl_(cntl),
+          req_(req),
+          res_(res),
+          sid_(sid),
+          cid_(cid),
+          start_us_(start_us) {}
+
+    void Run() override {
+        rpc::RpcMeta meta;
+        auto* rmeta = meta.mutable_response();
+        rmeta->set_error_code(cntl_->ErrorCode());
+        if (cntl_->Failed()) {
+            rmeta->set_error_text(cntl_->ErrorText());
+        }
+        meta.set_correlation_id(cid_);
+        IOBuf payload;
+        if (!cntl_->Failed()) {
+            if (!SerializePbToIOBuf(*res_, &payload)) {
+                rmeta->set_error_code(TERR_RESPONSE);
+                rmeta->set_error_text("serialize response failed");
+                payload.clear();
+            }
+        }
+        const IOBuf& att = cntl_->response_attachment();
+        meta.set_attachment_size((uint32_t)att.size());
+        IOBuf meta_buf;
+        SerializePbToIOBuf(meta, &meta_buf);
+        IOBuf frame;
+        PackTpuStdFrame(&frame, meta_buf, payload, att);
+        SocketUniquePtr s;
+        if (Socket::AddressSocket(sid_, &s) == 0) {
+            s->Write(&frame);
+        }
+        // Stats.
+        if (mp_ != nullptr) {
+            mp_->status->latency << (monotonic_time_us() - start_us_);
+            mp_->status->concurrency.fetch_sub(1, std::memory_order_relaxed);
+            if (cntl_->Failed()) {
+                mp_->status->nerror.fetch_add(1, std::memory_order_relaxed);
+            }
+        }
+        server_->nprocessing.fetch_sub(1, std::memory_order_relaxed);
+        delete req_;
+        delete res_;
+        delete cntl_;
+        delete this;
+    }
+
+private:
+    Server* server_;
+    Server::MethodProperty* mp_;
+    Controller* cntl_;
+    google::protobuf::Message* req_;
+    google::protobuf::Message* res_;
+    SocketId sid_;
+    uint64_t cid_;
+    int64_t start_us_;
+};
+
+void SendErrorResponse(SocketId sid, uint64_t cid, int err,
+                       const std::string& text) {
+    rpc::RpcMeta meta;
+    meta.mutable_response()->set_error_code(err);
+    meta.mutable_response()->set_error_text(text);
+    meta.set_correlation_id(cid);
+    IOBuf meta_buf;
+    SerializePbToIOBuf(meta, &meta_buf);
+    IOBuf frame;
+    PackTpuStdFrame(&frame, meta_buf, IOBuf(), IOBuf());
+    SocketUniquePtr s;
+    if (Socket::AddressSocket(sid, &s) == 0) {
+        s->Write(&frame);
+    }
+}
+
+void ProcessTpuStdRequest(TpuStdMessage* msg, const rpc::RpcMeta& meta) {
+    const SocketId sid = msg->socket_id;
+    const uint64_t cid = meta.correlation_id();
+    SocketUniquePtr s;
+    if (Socket::AddressSocket(sid, &s) != 0) return;
+    InputMessenger* m = (InputMessenger*)s->user();
+    Server* server = m != nullptr ? (Server*)m->context : nullptr;
+    if (server == nullptr) {
+        return;  // no server bound (shutting down)
+    }
+    const auto& req_meta = meta.request();
+    Server::MethodProperty* mp =
+        server->FindMethod(req_meta.service_name(), req_meta.method_name());
+    if (mp == nullptr) {
+        SendErrorResponse(sid, cid, TERR_NO_METHOD,
+                          "no such method " + req_meta.service_name() + "." +
+                              req_meta.method_name());
+        return;
+    }
+    // Admission control (the "constant" limiter; reference
+    // ConcurrencyLimiter::OnRequested).
+    const int64_t cur =
+        mp->status->concurrency.fetch_add(1, std::memory_order_relaxed) + 1;
+    if (mp->status->max_concurrency > 0 &&
+        cur > mp->status->max_concurrency) {
+        mp->status->concurrency.fetch_sub(1, std::memory_order_relaxed);
+        mp->status->nrejected.fetch_add(1, std::memory_order_relaxed);
+        SendErrorResponse(sid, cid, TERR_LIMIT_EXCEEDED, "concurrency limit");
+        return;
+    }
+    server->nprocessing.fetch_add(1, std::memory_order_relaxed);
+
+    // Split payload / attachment.
+    const uint32_t att_size = meta.attachment_size();
+    if ((size_t)att_size > msg->body.size()) {
+        mp->status->concurrency.fetch_sub(1, std::memory_order_relaxed);
+        server->nprocessing.fetch_sub(1, std::memory_order_relaxed);
+        SendErrorResponse(sid, cid, TERR_REQUEST,
+                          "attachment_size exceeds body");
+        return;
+    }
+    IOBuf payload;
+    IOBuf attachment;
+    const size_t payload_size = msg->body.size() - att_size;
+    msg->body.cutn(&payload, payload_size);
+    attachment.swap(msg->body);
+
+    auto* req = mp->service->GetRequestPrototype(mp->method).New();
+    auto* res = mp->service->GetResponsePrototype(mp->method).New();
+    auto* cntl = new Controller;
+    cntl->InitServerSide(server, s->remote_side());
+    cntl->request_attachment() = attachment;
+    const int64_t start_us = monotonic_time_us();
+    auto* done = new SendResponseClosure(server, mp, cntl, req, res, sid, cid,
+                                         start_us);
+    if (!ParsePbFromIOBuf(req, payload)) {
+        cntl->SetFailed(TERR_REQUEST, "parse request failed");
+        done->Run();
+        return;
+    }
+    // Run the user method on this fiber (we are already on a per-message
+    // fiber; reference runs inline or via usercode backup pool).
+    mp->service->CallMethod(mp->method, cntl, req, res, done);
+}
+
+}  // namespace
+
+// ---------------- client side ----------------
+
+void ProcessTpuStdResponse(TpuStdMessage* msg, const rpc::RpcMeta& meta);
+
+void ProcessTpuStdMessage(InputMessageBase* raw) {
+    std::unique_ptr<TpuStdMessage> msg((TpuStdMessage*)raw);
+    rpc::RpcMeta meta;
+    if (!ParsePbFromIOBuf(&meta, msg->meta)) {
+        SocketUniquePtr s;
+        if (Socket::AddressSocket(msg->socket_id, &s) == 0) {
+            s->SetFailedWithError(TERR_REQUEST);
+        }
+        return;
+    }
+    if (meta.has_request()) {
+        ProcessTpuStdRequest(msg.get(), meta);
+    } else {
+        ProcessTpuStdResponse(msg.get(), meta);
+    }
+}
+
+void GlobalInitializeOrDie() {
+    static std::once_flag once;
+    std::call_once(once, [] {
+        Protocol p;
+        p.parse = ParseTpuStdMessage;
+        p.process = ProcessTpuStdMessage;
+        p.name = "tpu_std";
+        g_tpu_std_index = RegisterProtocol(p);
+    });
+}
+
+}  // namespace tpurpc

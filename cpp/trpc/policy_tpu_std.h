@@ -1,0 +1,41 @@
+// tpu_std: the native framed protocol.
+//
+// Wire format (modeled on the reference's default baidu_std protocol,
+// src/brpc/policy/baidu_rpc_protocol.cpp — 12-byte "PRPC" header + pb meta
+// + pb payload + raw attachment):
+//
+//   "TRPC" | u32be body_size | u32be meta_size
+//   body = RpcMeta(pb, meta_size bytes) | payload(pb) | attachment(raw)
+//
+// parse  -> ParseTpuStdMessage   (reference ParseRpcMessage :102)
+// server -> ProcessTpuStdRequest (reference ProcessRpcRequest :565)
+// client -> ProcessTpuStdResponse(reference ProcessRpcResponse :907)
+#pragma once
+
+#include "tnet/protocol.h"
+
+namespace tpurpc {
+
+class TpuStdMessage : public InputMessageBase {
+public:
+    IOBuf meta;
+    IOBuf body;  // payload + attachment (split after meta parse)
+};
+
+ParseResult ParseTpuStdMessage(IOBuf* source, Socket* socket, bool read_eof,
+                               const void* arg);
+void ProcessTpuStdMessage(InputMessageBase* msg);
+
+// Frame a request/response: header + serialized meta + payload + attachment.
+void PackTpuStdFrame(IOBuf* out, const IOBuf& meta_pb, const IOBuf& payload,
+                     const IOBuf& attachment);
+
+// Registered index of the tpu_std protocol (valid after
+// GlobalInitializeOrDie).
+int TpuStdProtocolIndex();
+
+// One-time registration of built-in protocols (reference
+// GlobalInitializeOrDie, src/brpc/global.cpp:364-626).
+void GlobalInitializeOrDie();
+
+}  // namespace tpurpc

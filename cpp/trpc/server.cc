@@ -1,0 +1,79 @@
+#include "trpc/server.h"
+
+#include <google/protobuf/descriptor.h>
+#include <unistd.h>
+
+#include "tbase/logging.h"
+#include "tfiber/fiber.h"
+#include "trpc/policy_tpu_std.h"
+
+namespace tpurpc {
+
+Server::~Server() { Stop(); }
+
+int Server::AddService(google::protobuf::Service* service) {
+    if (started_) {
+        LOG(ERROR) << "AddService after Start";
+        return -1;
+    }
+    const auto* sd = service->GetDescriptor();
+    for (int i = 0; i < sd->method_count(); ++i) {
+        const auto* md = sd->method(i);
+        const std::string key = sd->full_name() + "." + md->name();
+        MethodProperty& mp = methods_[key];
+        mp.service = service;
+        mp.method = md;
+        mp.status.reset(new MethodStatus);
+        // Expose as service_method (dots break /vars conventions).
+        std::string var_name = key;
+        for (char& c : var_name) {
+            if (c == '.') c = '_';
+        }
+        mp.status->latency.expose(var_name);
+    }
+    return 0;
+}
+
+int Server::Start(const EndPoint& ep, const ServerOptions* options) {
+    if (started_) return -1;
+    GlobalInitializeOrDie();
+    if (options != nullptr) options_ = *options;
+    for (auto& kv : methods_) {
+        kv.second.status->max_concurrency = options_.max_concurrency;
+    }
+    messenger_.add_protocol(TpuStdProtocolIndex());
+    messenger_.context = this;
+    if (acceptor_.StartAccept(ep) != 0) {
+        LOG(ERROR) << "listen failed on " << endpoint2str(ep);
+        return -1;
+    }
+    started_ = true;
+    return 0;
+}
+
+int Server::Start(int port, const ServerOptions* options) {
+    EndPoint ep;
+    str2endpoint("0.0.0.0", port, &ep);
+    return Start(ep, options);
+}
+
+void Server::Stop() {
+    if (!started_) return;
+    acceptor_.StopAccept();
+    started_ = false;
+}
+
+void Server::Join() {
+    // Drain in-flight requests (reference Server::Join semantics).
+    while (nprocessing.load(std::memory_order_acquire) > 0) {
+        usleep(10000);
+    }
+}
+
+Server::MethodProperty* Server::FindMethod(const std::string& service_name,
+                                           const std::string& method_name) {
+    auto it = methods_.find(service_name + "." + method_name);
+    return it == methods_.end() ? nullptr : &it->second;
+}
+
+}  // namespace tpurpc

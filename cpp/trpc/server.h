@@ -1,0 +1,77 @@
+// Server: hosts protobuf services over the native protocol.
+//
+// Modeled on reference src/brpc/server.{h,cpp}: AddService builds the
+// service/method maps (server.cpp:1383-1655), Start listens and wires the
+// Acceptor + InputMessenger (StartInternal :845-1230), per-method
+// MethodStatus records qps/latency/concurrency, a ConcurrencyLimiter
+// guards admission (concurrency_limiter.h:29).
+#pragma once
+
+#include <google/protobuf/service.h>
+
+#include <atomic>
+#include <map>
+#include <memory>
+#include <string>
+
+#include "tbase/endpoint.h"
+#include "tnet/acceptor.h"
+#include "tnet/input_messenger.h"
+#include "tvar/latency_recorder.h"
+
+namespace tpurpc {
+
+// Per-method stats (reference src/brpc/details/method_status.h): latency
+// recorder + live concurrency, exposed as <service>_<method> in /vars.
+struct MethodStatus {
+    LatencyRecorder latency;
+    std::atomic<int64_t> concurrency{0};
+    std::atomic<int64_t> nerror{0};
+    std::atomic<int64_t> nrejected{0};
+    int max_concurrency = 0;  // 0 = unlimited ("constant" limiter)
+};
+
+struct ServerOptions {
+    // 0 = unlimited. The "constant" concurrency limiter; the gradient
+    // "auto" limiter (reference policy/auto_concurrency_limiter.cpp) comes
+    // with the robustness milestone.
+    int max_concurrency = 0;
+};
+
+class Server {
+public:
+    Server() : messenger_(), acceptor_(&messenger_) {}
+    ~Server();
+
+    struct MethodProperty {
+        google::protobuf::Service* service = nullptr;
+        const google::protobuf::MethodDescriptor* method = nullptr;
+        std::unique_ptr<MethodStatus> status;
+    };
+
+    // Does NOT take ownership (reference SERVER_DOESNT_OWN_SERVICE default).
+    int AddService(google::protobuf::Service* service);
+
+    int Start(const EndPoint& ep, const ServerOptions* options);
+    int Start(int port, const ServerOptions* options);  // 0 = ephemeral
+    void Stop();
+    void Join();
+
+    int listened_port() const { return acceptor_.listened_port(); }
+    const ServerOptions& options() const { return options_; }
+
+    // "ServiceName.MethodName" lookup (called by the protocol layer).
+    MethodProperty* FindMethod(const std::string& service_name,
+                               const std::string& method_name);
+
+    std::atomic<int64_t> nprocessing{0};  // in-flight requests
+
+private:
+    InputMessenger messenger_;
+    Acceptor acceptor_;
+    ServerOptions options_;
+    bool started_ = false;
+    std::map<std::string, MethodProperty> methods_;
+};
+
+}  // namespace tpurpc

@@ -1,15 +1,12 @@
-// Loopback echo benchmark through the full I/O stack: Acceptor ->
-// InputMessenger -> framed protocol -> Socket wait-free write queue ->
-// epoll -> fibers, client and server in one process.
-//
-// Mirrors the reference's headline echo benchmark setup
+// Loopback echo benchmark through the FULL RPC stack: protobuf stub ->
+// Channel -> tpu_std protocol -> Socket -> epoll -> Server -> service ->
+// response, client and server in one process. Bulk bytes ride the
+// attachment (zero-copy), matching the reference's echo benchmark setup
 // (docs/cn/benchmark.md:104 — 2.3 GB/s large-payload echo on loopback;
-// example/echo_c++ + example/rdma_performance drivers). Once the RPC layer
-// (Channel/Server) lands this driver switches to it; the framing here is
-// the same shape (magic + length + payload).
+// example/echo_c++ attachment echo).
 //
 // Prints one JSON line with --json:
-//   {"mbps": ..., "qps_4k": ..., "p99_us_4k": ...}
+//   {"mbps": ..., "qps_4k": ..., "p50_us_4k": ..., "p99_us_4k": ...}
 #include <unistd.h>
 
 #include <atomic>
@@ -17,108 +14,83 @@
 #include <cstring>
 #include <string>
 
+#include "bench_echo.pb.h"
 #include "tbase/time.h"
 #include "tfiber/fiber_sync.h"
-#include "tnet/acceptor.h"
-#include "tnet/input_messenger.h"
-#include "tnet/socket.h"
-#include "tnet/socket_map.h"
+#include "trpc/channel.h"
+#include "trpc/controller.h"
+#include "trpc/server.h"
 #include "tvar/latency_recorder.h"
 
 using namespace tpurpc;
 
 namespace {
 
-constexpr char kMagic[4] = {'E', 'C', 'H', '1'};
-
-struct Msg : public InputMessageBase {
-    IOBuf payload;
+class EchoServiceImpl : public benchpb::EchoService {
+public:
+    void Echo(google::protobuf::RpcController* cntl_base,
+              const benchpb::EchoRequest* request,
+              benchpb::EchoResponse* response,
+              google::protobuf::Closure* done) override {
+        Controller* cntl = static_cast<Controller*>(cntl_base);
+        response->set_send_ts_us(request->send_ts_us());
+        cntl->response_attachment().append(cntl->request_attachment());
+        done->Run();
+    }
 };
 
-ParseResult parse(IOBuf* source, Socket*, bool, const void*) {
-    if (source->size() < 8) {
-        return ParseResult::make(ParseError::NOT_ENOUGH_DATA);
-    }
-    char header[8];
-    source->copy_to(header, 8);
-    if (memcmp(header, kMagic, 4) != 0) {
-        return ParseResult::make(ParseError::TRY_OTHERS);
-    }
-    uint32_t len;
-    memcpy(&len, header + 4, 4);
-    if (source->size() < 8 + (size_t)len) {
-        return ParseResult::make(ParseError::NOT_ENOUGH_DATA);
-    }
-    source->pop_front(8);
-    auto* m = new Msg;
-    source->cutn(&m->payload, len);
-    return ParseResult::make_ok(m);
-}
+struct CallCtx {
+    Controller cntl;
+    benchpb::EchoRequest req;
+    benchpb::EchoResponse res;
+    CountdownEvent* pending;
+    LatencyRecorder* lat;
+    std::atomic<int64_t>* bytes;
+};
 
-void frame(IOBuf* out, IOBuf&& payload) {
-    char header[8];
-    memcpy(header, kMagic, 4);
-    const uint32_t len = (uint32_t)payload.size();
-    memcpy(header + 4, &len, 4);
-    out->append(header, 8);
-    out->append(std::move(payload));
-}
-
-void server_process(InputMessageBase* raw) {
-    Msg* m = (Msg*)raw;
-    SocketUniquePtr s;
-    if (Socket::AddressSocket(m->socket_id, &s) == 0) {
-        IOBuf out;
-        frame(&out, std::move(m->payload));
-        s->Write(&out);
-    }
-    delete m;
-}
-
-CountdownEvent* g_pending = nullptr;
-std::atomic<int64_t> g_bytes{0};
-LatencyRecorder* g_lat = nullptr;
-
-void client_process(InputMessageBase* raw) {
-    Msg* m = (Msg*)raw;
-    // First 8 payload bytes carry the send timestamp: exact per-message
-    // latency independent of response order.
-    int64_t ts = 0;
-    if (m->payload.size() >= 8) {
-        m->payload.copy_to(&ts, 8);
-        if (g_lat != nullptr) {
-            *g_lat << (monotonic_time_us() - ts);
+void OnEchoDone(CallCtx* ctx) {
+    if (!ctx->cntl.Failed()) {
+        if (ctx->lat != nullptr) {
+            *ctx->lat << (monotonic_time_us() - ctx->res.send_ts_us());
         }
+        if (ctx->bytes != nullptr) {
+            ctx->bytes->fetch_add(
+                (int64_t)ctx->cntl.response_attachment().size(),
+                std::memory_order_relaxed);
+        }
+    } else {
+        fprintf(stderr, "rpc failed: %s\n", ctx->cntl.ErrorText().c_str());
     }
-    g_bytes.fetch_add((int64_t)m->payload.size(), std::memory_order_relaxed);
-    g_pending->signal();
-    delete m;
+    ctx->pending->signal();
+    delete ctx;
 }
 
-// Send `iters` messages of msg_bytes in windows of `window`; returns
-// elapsed seconds.
-double run_round(SocketUniquePtr& cs, size_t msg_bytes, int iters,
-                 int window) {
-    std::string filler(msg_bytes, 'e');
+// `iters` async echo RPCs with `window` in flight; returns elapsed secs.
+double run_round(benchpb::EchoService_Stub& stub, size_t attachment_bytes,
+                 int iters, int window, LatencyRecorder* lat,
+                 std::atomic<int64_t>* bytes) {
+    std::string filler(attachment_bytes, 'e');
     Timer t;
     t.start();
     int sent = 0;
+    CountdownEvent pending(0);
     while (sent < iters) {
         const int batch = std::min(window, iters - sent);
-        g_pending->reset(batch);
+        pending.reset(batch);
         for (int i = 0; i < batch; ++i) {
-            IOBuf payload;
-            const int64_t now = monotonic_time_us();
-            memcpy(&filler[0], &now, 8);
-            payload.append(filler);
-            IOBuf framed;
-            frame(&framed, std::move(payload));
-            while (cs->Write(&framed) != 0) {
-                usleep(1000);  // EOVERCROWDED back-pressure: retry
-                if (cs->Failed()) return -1;
+            auto* ctx = new CallCtx;
+            ctx->pending = &pending;
+            ctx->lat = lat;
+            ctx->bytes = bytes;
+            ctx->cntl.set_timeout_ms(10000);
+            ctx->req.set_send_ts_us(monotonic_time_us());
+            if (attachment_bytes > 0) {
+                ctx->cntl.request_attachment().append(filler);
             }
+            stub.Echo(&ctx->cntl, &ctx->req, &ctx->res,
+                      google::protobuf::NewCallback(OnEchoDone, ctx));
         }
-        if (g_pending->wait() != 0) return -1;
+        if (pending.wait() != 0) return -1;
         sent += batch;
     }
     t.stop();
@@ -132,71 +104,53 @@ int main(int argc, char** argv) {
     for (int i = 1; i < argc; ++i) {
         if (strcmp(argv[i], "--json") == 0) json = true;
     }
-    Protocol sp;
-    sp.parse = parse;
-    sp.process = server_process;
-    sp.name = "echo_bench_server";
-    const int sidx = RegisterProtocol(sp);
-    Protocol cp;
-    cp.parse = parse;
-    cp.process = client_process;
-    cp.name = "echo_bench_client";
-    const int cidx = RegisterProtocol(cp);
+    Server server;
+    EchoServiceImpl service;
+    if (server.AddService(&service) != 0) return 1;
+    EndPoint listen;
+    str2endpoint("127.0.0.1:0", &listen);
+    if (server.Start(listen, nullptr) != 0) return 1;
 
-    InputMessenger server_m({sidx});
-    Acceptor acceptor(&server_m);
+    Channel channel;
+    ChannelOptions copts;
+    copts.timeout_ms = 10000;
     EndPoint ep;
-    str2endpoint("127.0.0.1:0", &ep);
-    if (acceptor.StartAccept(ep) != 0) {
-        fprintf(stderr, "listen failed\n");
-        return 1;
-    }
-    InputMessenger client_m({cidx});
-    EndPoint server_ep;
-    str2endpoint("127.0.0.1", acceptor.listened_port(), &server_ep);
-    SocketId cid;
-    if (SocketMap::singleton()->GetOrCreate(server_ep, &client_m, &cid) != 0) {
-        return 1;
-    }
-    SocketUniquePtr cs;
-    if (Socket::AddressSocket(cid, &cs) != 0) return 1;
+    str2endpoint("127.0.0.1", server.listened_port(), &ep);
+    if (channel.Init(ep, &copts) != 0) return 1;
+    benchpb::EchoService_Stub stub(&channel);
 
-    CountdownEvent pending(0);
-    g_pending = &pending;
     LatencyRecorder lat;
-    lat.expose("echo_4k_latency");
+    lat.expose("rpc_echo_4k_latency");
 
-    // Warmup (connect + caches).
-    run_round(cs, 4096, 200, 32);
+    // Warmup.
+    run_round(stub, 4096, 500, 32, nullptr, nullptr);
 
-    // 4KB round: qps + latency. Capture percentiles immediately — they're
-    // computed over a 10s sliding window and would rotate out during the
-    // 1MB round.
-    g_lat = &lat;
+    // 4KB round.
     const int kSmallIters = 20000;
-    const double small_secs = run_round(cs, 4096, kSmallIters, 64);
-    g_lat = nullptr;
+    const double small_secs =
+        run_round(stub, 4096, kSmallIters, 64, &lat, nullptr);
     if (small_secs < 0) return 1;
     const double qps_4k = kSmallIters / small_secs;
-    const long long p99 = (long long)lat.latency_percentile(0.99);
     const long long p50 = (long long)lat.latency_percentile(0.5);
+    const long long p99 = (long long)lat.latency_percentile(0.99);
 
-    // 1MB round: throughput.
-    g_bytes.store(0);
+    // 1MB round.
+    std::atomic<int64_t> bytes{0};
     const int kBigIters = 300;
-    const double big_secs = run_round(cs, 1 << 20, kBigIters, 4);
+    const double big_secs =
+        run_round(stub, 1 << 20, kBigIters, 4, nullptr, &bytes);
     if (big_secs < 0) return 1;
-    const double mbps =
-        (double)g_bytes.load() / (1024.0 * 1024.0) / big_secs;
+    const double mbps = (double)bytes.load() / (1024.0 * 1024.0) / big_secs;
 
     if (json) {
         printf("{\"mbps\": %.1f, \"qps_4k\": %.0f, \"p50_us_4k\": %lld, "
                "\"p99_us_4k\": %lld}\n",
                mbps, qps_4k, p50, p99);
     } else {
-        printf("1MB echo throughput: %.1f MB/s (%d msgs)\n", mbps, kBigIters);
-        printf("4KB echo: %.0f qps, p50 %lldus, p99 %lldus\n", qps_4k, p50,
-               p99);
+        printf("RPC 1MB attachment echo: %.1f MB/s (%d calls)\n", mbps,
+               kBigIters);
+        printf("RPC 4KB echo: %.0f qps, p50 %lldus, p99 %lldus\n", qps_4k,
+               p50, p99);
     }
     return 0;
 }

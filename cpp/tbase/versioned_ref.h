@@ -79,6 +79,19 @@ public:
 
     VRefId vref_id() const { return id_; }
 
+    // Address WITHOUT taking a ref, resolving even if currently failed
+    // (version compared modulo the failed bit). For flag-setting on an
+    // object some longer-lived party (e.g. its health-check fiber) keeps
+    // alive; must not be used to touch connection state.
+    static T* UnsafeAddress(VRefId id) {
+        T* obj = address_resource<T>(VRefSlot(id));
+        if (obj == nullptr) return nullptr;
+        const uint32_t ver = (uint32_t)(
+            obj->versioned_nref_.load(std::memory_order_acquire) >> 32);
+        if ((ver & ~1u) != (VRefVersion(id) & ~1u)) return nullptr;
+        return obj;
+    }
+
     void AddRef() { versioned_nref_.fetch_add(1, std::memory_order_relaxed); }
 
     void Dereference() {
@@ -112,6 +125,29 @@ public:
                     std::memory_order_relaxed)) {
                 static_cast<T*>(this)->OnFailed();
                 Dereference();  // drop creation ref
+                return 0;
+            }
+        }
+    }
+
+    // Un-fail a failed object: version returns to the original even value
+    // so ids minted before SetFailed resolve again, and the creation ref is
+    // re-added. Caller must hold a ref (keeping the slot from recycling)
+    // and must have reset T's state first. This is how health check revives
+    // a Socket without invalidating ids held by load balancers (reference
+    // src/brpc/socket.cpp Socket::Revive, health_check.cpp).
+    int Revive() {
+        uint64_t vn = versioned_nref_.load(std::memory_order_relaxed);
+        while (true) {
+            uint32_t ver = (uint32_t)(vn >> 32);
+            if (!(ver & 1)) return -1;  // not failed
+            uint32_t nref = (uint32_t)vn;
+            CHECK_GE(nref, 1u) << "Revive without a held ref";
+            uint64_t next =
+                ((uint64_t)(ver & ~1u) << 32) | (uint64_t)(nref + 1);
+            if (versioned_nref_.compare_exchange_weak(
+                    vn, next, std::memory_order_acq_rel,
+                    std::memory_order_relaxed)) {
                 return 0;
             }
         }

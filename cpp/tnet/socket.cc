@@ -2,6 +2,7 @@
 
 #include <fcntl.h>
 #include <netinet/in.h>
+#include <poll.h>
 #include <netinet/tcp.h>
 #include <sys/socket.h>
 #include <unistd.h>
@@ -55,6 +56,8 @@ int Socket::Create(const SocketOptions& options, SocketId* id) {
     s->connecting_.store(false, std::memory_order_relaxed);
     s->read_buf.clear();
     s->preferred_protocol_index = -1;
+    s->health_check_interval_ms_ = options.health_check_interval_ms;
+    s->hc_stop_.store(false, std::memory_order_relaxed);
     if (s->epollout_butex_ == nullptr) s->epollout_butex_ = butex_create();
     if (s->connect_butex_ == nullptr) s->connect_butex_ = butex_create();
 
@@ -82,6 +85,82 @@ void Socket::OnFailed() {
     butex_wake_all(epollout_butex_);
     butex_word(connect_butex_)->fetch_add(1, std::memory_order_release);
     butex_wake_all(connect_butex_);
+    // Health check: keep the slot alive with our own ref and probe until
+    // the remote answers, then Revive the SAME id (reference
+    // src/brpc/details/health_check.cpp:140 HealthCheckTask).
+    if (health_check_interval_ms_ > 0 &&
+        !hc_stop_.load(std::memory_order_acquire)) {
+        AddRef();  // released by HealthCheckLoop
+        fiber_t tid;
+        if (fiber_start_background(&tid, nullptr, HealthCheckThunk, this) !=
+            0) {
+            Dereference();
+        }
+    }
+}
+
+void* Socket::HealthCheckThunk(void* arg) {
+    ((Socket*)arg)->HealthCheckLoop();
+    return nullptr;
+}
+
+// Probe TCP connect with a bounded wait; returns 0 when the remote accepts.
+static int ProbeConnect(const EndPoint& remote, int timeout_ms) {
+    const int fd = ::socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK, 0);
+    if (fd < 0) return -1;
+    sockaddr_in addr;
+    endpoint2sockaddr(remote, &addr);
+    int rc = ::connect(fd, (sockaddr*)&addr, sizeof(addr));
+    if (rc != 0 && errno == EINPROGRESS) {
+        pollfd pfd{fd, POLLOUT, 0};
+        rc = ::poll(&pfd, 1, timeout_ms) == 1 ? 0 : -1;
+        if (rc == 0) {
+            int err = 0;
+            socklen_t len = sizeof(err);
+            getsockopt(fd, SOL_SOCKET, SO_ERROR, &err, &len);
+            rc = err == 0 ? 0 : -1;
+        }
+    }
+    ::close(fd);
+    return rc;
+}
+
+void Socket::HealthCheckLoop() {
+    const int64_t interval_us = (int64_t)health_check_interval_ms_ * 1000;
+    while (!hc_stop_.load(std::memory_order_acquire)) {
+        fiber_usleep(interval_us);
+        if (hc_stop_.load(std::memory_order_acquire)) break;
+        // Only probe/revive once every other ref is gone: then no KeepWrite
+        // or event fiber can race the connection-state reset below.
+        if (nref() > 1) continue;
+        if (ProbeConnect(remote_side_, 200) != 0) continue;
+        if (ReviveAfterHealthCheck() == 0) {
+            // StopHealthCheck may have raced the probe window: a revived
+            // socket nobody tracks anymore would leak alive forever. Undo.
+            if (hc_stop_.load(std::memory_order_acquire)) SetFailed();
+            break;
+        }
+    }
+    Dereference();
+}
+
+int Socket::ReviveAfterHealthCheck() {
+    // Drop every remnant of the dead connection. We are the only ref.
+    CloseFdAndDropQueued();
+    write_pending_.store(0, std::memory_order_relaxed);
+    unwritten_bytes_.store(0, std::memory_order_relaxed);
+    nevent_.store(0, std::memory_order_relaxed);
+    read_buf.clear();
+    preferred_protocol_index = -1;
+    error_code_.store(0, std::memory_order_relaxed);
+    connecting_.store(false, std::memory_order_relaxed);
+    local_side_ = EndPoint();
+    const int rc = Revive();
+    if (rc == 0) {
+        LOG(INFO) << "Revived socket id=" << id()
+                  << " remote=" << endpoint2str(remote_side_);
+    }
+    return rc;
 }
 
 namespace {
@@ -107,17 +186,26 @@ void Socket::DropWriteRequest(WriteRequest* req) {
 }
 
 void Socket::OnRecycle() {
+    CloseFdAndDropQueued();
+    read_buf.clear();
+}
+
+// Shared teardown of a dead connection: close + deregister the fd and drop
+// every queued write request (error-notifying their CallIds). Callers must
+// be the sole toucher of write state (recycle: nref==0; revive: sole-ref
+// health-check fiber).
+void Socket::CloseFdAndDropQueued() {
     const int fd = fd_.exchange(-1, std::memory_order_acq_rel);
     if (fd >= 0) {
         EventDispatcher::GetGlobalDispatcher(fd).RemoveConsumer(fd);
         close(fd);
     }
-    // Free any queued write requests (writers stopped: Address fails).
     for (size_t i = inflight_index_; i < inflight_batch_.size(); ++i) {
         DropWriteRequest(inflight_batch_[i]);
     }
     inflight_batch_.clear();
     inflight_index_ = 0;
+    writer_consumed_ = 0;
     WriteRequest* head = write_head_.exchange(nullptr, std::memory_order_acq_rel);
     while (head != nullptr) {
         WriteRequest* next = head->next.load(std::memory_order_acquire);
@@ -127,7 +215,6 @@ void Socket::OnRecycle() {
         DropWriteRequest(head);
         head = next;
     }
-    read_buf.clear();
 }
 
 int Socket::SetFailedWithError(int error_code) {
@@ -184,11 +271,15 @@ void* Socket::KeepWriteThunk(void* arg) {
     const SocketId id = (SocketId)(uintptr_t)arg;
     Socket* s = Address(id);
     if (s == nullptr) {
-        // Socket failed before the fiber ran. The AddRef from
-        // StartKeepWriteIfNeeded still pins the object; balance it through
-        // the slot or the socket (fd + queued requests) leaks forever.
+        // Socket failed before the fiber ran. We still own the writer role
+        // (and the AddRef from StartKeepWriteIfNeeded pins the object):
+        // drop the queued requests NOW — recycle-time cleanup is deferred
+        // indefinitely on health-checked sockets — then balance the ref.
         Socket* raw = address_resource<Socket>(VRefSlot(id));
-        if (raw != nullptr) raw->Dereference();
+        if (raw != nullptr) {
+            raw->DrainWriteQueue();
+            raw->Dereference();
+        }
         return nullptr;
     }
     SocketUniquePtr owned(s);
@@ -201,12 +292,52 @@ void Socket::KeepWrite() {
     if (fd() < 0) {
         if (ConnectIfNot() != 0) {
             SetFailedWithError(errno ? errno : TERR_FAILED_SOCKET);
+            DrainWriteQueue();
             return;
         }
     }
     while (true) {
-        if (Failed()) return;
-        if (FlushOnce(true)) return;  // retired
+        if (Failed()) {
+            DrainWriteQueue();
+            return;
+        }
+        if (FlushOnce(true)) return;  // retired (or failed + drained)
+    }
+}
+
+void Socket::DrainWriteQueue() {
+    int64_t& consumed = writer_consumed_;
+    while (true) {
+        if (inflight_index_ >= inflight_batch_.size()) {
+            inflight_batch_.clear();
+            inflight_index_ = 0;
+            WriteRequest* grabbed =
+                write_head_.exchange(nullptr, std::memory_order_acq_rel);
+            for (WriteRequest* cur = grabbed; cur != nullptr;) {
+                WriteRequest* next = cur->next.load(std::memory_order_acquire);
+                while (next == WriteRequest::unlinked()) {
+                    next = cur->next.load(std::memory_order_acquire);
+                }
+                inflight_batch_.push_back(cur);
+                cur = next;
+            }
+        }
+        if (inflight_index_ >= inflight_batch_.size()) {
+            const int64_t prev =
+                write_pending_.fetch_sub(consumed, std::memory_order_acq_rel);
+            const bool retired = (prev == consumed);
+            consumed = 0;
+            if (retired) return;
+            continue;  // racing Write slipped in: grab again
+        }
+        while (inflight_index_ < inflight_batch_.size()) {
+            WriteRequest* req = inflight_batch_[inflight_index_];
+            unwritten_bytes_.fetch_sub((int64_t)req->data.size(),
+                                       std::memory_order_relaxed);
+            DropWriteRequest(req);
+            ++inflight_index_;
+            ++consumed;
+        }
     }
 }
 
@@ -262,12 +393,14 @@ bool Socket::FlushOnce(bool allow_block) {
                 if (!allow_block) return false;  // caller spawns KeepWrite
                 if (WaitEpollOut() != 0) {
                     SetFailedWithError(TERR_FAILED_SOCKET);
+                    DrainWriteQueue();
                     return true;
                 }
                 continue;
             }
             if (errno == EINTR) continue;
             SetFailedWithError(errno);
+            DrainWriteQueue();
             return true;
         }
         unwritten_bytes_.fetch_sub(nw, std::memory_order_relaxed);

@@ -42,6 +42,11 @@ struct SocketOptions {
     void* user = nullptr;  // InputMessenger* / Acceptor* / Server*
     // Optional transport endpoint taking over the data plane (ICI).
     TransportEndpoint* transport = nullptr;
+    // >0: on SetFailed, keep probing the remote every this-many ms and
+    // Revive the SAME SocketId on success (reference
+    // src/brpc/details/health_check.cpp — ids held by load balancers stay
+    // valid across failures). 0 disables.
+    int health_check_interval_ms = 0;
 };
 
 class Socket : public VersionedRefWithId<Socket> {
@@ -80,9 +85,16 @@ public:
     // blocks the calling fiber until connected or error. Returns 0 / -1.
     int ConnectIfNot();
 
-    // ---- failure ----
+    // ---- failure / health check ----
     int SetFailedWithError(int error_code);
     int error_code() const { return error_code_.load(std::memory_order_acquire); }
+    // Stop the revive loop (set when the naming layer removes this server
+    // for good; the health-check fiber then drops its ref and the socket
+    // recycles).
+    void StopHealthCheck() {
+        hc_stop_.store(true, std::memory_order_release);
+    }
+    int health_check_interval_ms() const { return health_check_interval_ms_; }
 
     // ---- per-connection parsing state (owned by InputMessenger) ----
     IOPortal read_buf;
@@ -111,11 +123,22 @@ private:
     };
 
     static void DropWriteRequest(WriteRequest* req);
+    void CloseFdAndDropQueued();
+    static void* HealthCheckThunk(void* arg);  // arg = Socket* (ref held)
+    void HealthCheckLoop();
+    // Reset connection state and un-fail (health-check fiber only, with
+    // every other ref gone so no writer/reader is concurrent).
+    int ReviveAfterHealthCheck();
     void StartKeepWriteIfNeeded();
     static void* KeepWriteThunk(void* arg);  // arg = SocketId
     void KeepWrite();
     // Drain pending write requests once; returns false on fatal error.
     bool FlushOnce(bool allow_block);
+    // Drop every queued write request, error-notifying their CallIds. Only
+    // the elected writer may call this (owns batch state). Needed at
+    // failure time: recycle-time cleanup is too late for health-checked
+    // sockets whose slot stays pinned while failed.
+    void DrainWriteQueue();
     // Wait (fiber) until the fd is writable.
     int WaitEpollOut();
     static void* ProcessEventThunk(void* arg);  // arg = SocketId
@@ -143,6 +166,8 @@ private:
     std::atomic<int> error_code_{0};
     std::atomic<bool> connecting_{false};
     void* connect_butex_ = nullptr;
+    int health_check_interval_ms_ = 0;
+    std::atomic<bool> hc_stop_{false};
 };
 
 }  // namespace tpurpc

@@ -1,9 +1,12 @@
 #include "trpc/channel.h"
 
+#include <cstring>
+
 #include "tbase/errno.h"
 #include "tbase/logging.h"
 #include "tbase/time.h"
 #include "tfiber/call_id.h"
+#include "trpc/lb_with_naming.h"
 #include "trpc/controller.h"
 #include "trpc/pb_compat.h"
 #include "trpc/policy_tpu_std.h"
@@ -39,11 +42,18 @@ int Channel::Init(const char* server_addr_and_port,
 
 int Channel::Init(const char* naming_url, const char* lb_name,
                   const ChannelOptions* options) {
-    // Naming + LB lands with the client-robustness milestone (SURVEY §7.7).
-    LOG(ERROR) << "naming-service channels not wired yet: " << naming_url
-               << " lb=" << lb_name;
-    (void)options;
-    return -1;
+    GlobalInitializeOrDie();
+    if (options != nullptr) options_ = *options;
+    // Plain "ip:port" with an LB name degenerates to single-server.
+    if (strstr(naming_url, "://") == nullptr) {
+        return Init(naming_url, options);
+    }
+    auto lb = std::make_shared<LoadBalancerWithNaming>();
+    if (lb->Init(naming_url, lb_name == nullptr ? "rr" : lb_name) != 0) {
+        return -1;
+    }
+    lb_ = std::move(lb);
+    return 0;
 }
 
 // Timer callback for RPC deadlines: holds only the CallId VALUE (never a

@@ -11,12 +11,13 @@
 #include "tfiber/fiber.h"
 #include "tnet/socket_map.h"
 #include "trpc/channel.h"
+#include "trpc/lb_with_naming.h"
 #include "trpc/pb_compat.h"
 #include "trpc/policy_tpu_std.h"
 
 namespace tpurpc {
 
-Controller::~Controller() = default;
+Controller::~Controller() { delete excluded_; }
 
 void Controller::Reset() {
     error_code_ = 0;
@@ -42,6 +43,12 @@ void Controller::Reset() {
     deadline_us_ = 0;
     timeout_timer_ = INVALID_TIMER_ID;
     single_server_id_ = INVALID_VREF_ID;
+    current_server_id_ = INVALID_VREF_ID;
+    try_start_us_ = 0;
+    request_code_ = 0;
+    has_request_code_ = false;
+    delete excluded_;
+    excluded_ = nullptr;
     server_ = nullptr;
 }
 
@@ -84,6 +91,7 @@ static bool is_retryable(int error) {
         case ECONNREFUSED:
         case ECONNRESET:
         case EPIPE:
+        case EHOSTDOWN:  // LB found only failed servers; retry re-selects
             return true;
         default:
             return false;
@@ -95,6 +103,7 @@ int Controller::HandleError(CallId id, int error) {
     const int effective_max_retry =
         max_retry_ >= 0 ? max_retry_
                         : (channel_ ? channel_->options().max_retry : 0);
+    FeedbackToLB(error);  // per-try completion (the retry is a new pick)
     if (is_retryable(error) && current_try_ < effective_max_retry &&
         (deadline_us_ == 0 || monotonic_time_us() < deadline_us_)) {
         ++current_try_;
@@ -110,19 +119,55 @@ int Controller::HandleError(CallId id, int error) {
     return 0;
 }
 
-void Controller::IssueRPC() {
-    SocketId sid = INVALID_VREF_ID;
-    if (SocketMap::singleton()->GetOrCreate(channel_->server(),
-                                            Channel::client_messenger(),
-                                            &sid) != 0) {
-        id_error(current_cid_, TERR_FAILED_SOCKET);
-        return;
+void Controller::FeedbackToLB(int error) {
+    if (channel_ == nullptr || current_server_id_ == INVALID_VREF_ID) return;
+    LoadBalancerWithNaming* lb = channel_->lb();
+    if (lb != nullptr) {
+        LoadBalancer::CallInfo info;
+        info.server_id = current_server_id_;
+        // Per-try latency: charging earlier failed tries' time to the
+        // final server would invert locality-aware ranking.
+        info.latency_us = monotonic_time_us() - try_start_us_;
+        info.error_code = error;
+        lb->Feedback(info);
     }
-    single_server_id_ = sid;
+    current_server_id_ = INVALID_VREF_ID;
+}
+
+void Controller::IssueRPC() {
+    try_start_us_ = monotonic_time_us();
     SocketUniquePtr s;
-    if (Socket::AddressSocket(sid, &s) != 0) {
-        id_error(current_cid_, TERR_FAILED_SOCKET);
-        return;
+    if (channel_->lb() != nullptr) {
+        // LB mode: pick a live server, excluding ones tried by earlier
+        // attempts of this RPC (reference controller.cpp:1098 SelectServer
+        // + ExcludedServers controller.cpp:644-680).
+        SelectIn in;
+        in.request_code = request_code_;
+        in.has_request_code = has_request_code_;
+        in.excluded = excluded_;
+        SelectOut out;
+        const int rc = channel_->lb()->SelectServer(in, &out);
+        if (rc != 0) {
+            id_error(current_cid_, rc);
+            return;
+        }
+        s = std::move(out.ptr);
+        current_server_id_ = s->id();
+        if (excluded_ == nullptr) excluded_ = new ExcludedServers;
+        excluded_->Add(s->id());
+    } else {
+        SocketId sid = INVALID_VREF_ID;
+        if (SocketMap::singleton()->GetOrCreate(channel_->server(),
+                                                Channel::client_messenger(),
+                                                &sid) != 0) {
+            id_error(current_cid_, TERR_FAILED_SOCKET);
+            return;
+        }
+        single_server_id_ = sid;
+        if (Socket::AddressSocket(sid, &s) != 0) {
+            id_error(current_cid_, TERR_FAILED_SOCKET);
+            return;
+        }
     }
     remote_side_ = s->remote_side();
 
@@ -161,6 +206,7 @@ void* Controller::RunDoneThunk(void* arg) {
 
 void Controller::EndRPC(CallId locked_id) {
     latency_us_ = monotonic_time_us() - start_us_;
+    FeedbackToLB(error_code_);
     if (timeout_timer_ != INVALID_TIMER_ID) {
         // Best-effort: if the callback is running it will find the id
         // destroyed (it only holds the id VALUE, never this pointer).

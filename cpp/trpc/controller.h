@@ -32,7 +32,7 @@ class Server;
 
 class Controller : public google::protobuf::RpcController {
 public:
-    Controller() { Reset(); }
+    Controller() : excluded_(nullptr) { Reset(); }
     ~Controller() override;
 
     // ---- client-side knobs ----
@@ -42,6 +42,12 @@ public:
     int max_retry() const { return max_retry_; }
     void set_log_id(int64_t id) { log_id_ = id; }
     int64_t log_id() const { return log_id_; }
+    // Hash key for consistent-hashing load balancers (reference
+    // Controller::set_request_code).
+    void set_request_code(uint64_t code) {
+        request_code_ = code;
+        has_request_code_ = true;
+    }
     // Attachment bytes carried outside the pb payload (zero-copy).
     IOBuf& request_attachment() { return request_attachment_; }
     IOBuf& response_attachment() { return response_attachment_; }
@@ -87,6 +93,9 @@ private:
     void IssueRPC();                          // (re)send the current try
     void EndRPC(CallId locked_id);            // finalize: done/join wakeup
     static void* RunDoneThunk(void* arg);
+    // Report the finished try to the LB (latency + error feed the
+    // locality-aware policy; reference Call::OnComplete controller.cpp:780).
+    void FeedbackToLB(int error);
 
     // --- shared fields ---
     int error_code_;
@@ -114,6 +123,11 @@ private:
     int64_t deadline_us_;
     TimerId timeout_timer_;
     SocketId single_server_id_;
+    SocketId current_server_id_;  // server of the in-flight try (LB mode)
+    int64_t try_start_us_;        // start of the current try (LB feedback)
+    uint64_t request_code_;
+    bool has_request_code_;
+    class ExcludedServers* excluded_;  // servers tried by earlier attempts
 
     // --- server call state ---
     Server* server_;

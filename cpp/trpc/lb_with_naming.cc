@@ -1,0 +1,206 @@
+#include "trpc/lb_with_naming.h"
+
+#include <unordered_map>
+
+#include "tbase/flags.h"
+#include "tbase/logging.h"
+#include "tbase/time.h"
+#include "tfiber/butex.h"
+#include "tfiber/fiber.h"
+#include "trpc/channel.h"
+
+DEFINE_int32(ns_health_check_interval_ms, 1000,
+             "Failed naming-resolved servers are probed this often and "
+             "revived in place (0 disables)");
+
+namespace tpurpc {
+
+// Adapter pushing naming results into the thread (lets RunNamingService
+// stay ignorant of NamingServiceThread).
+class NamingActions : public NamingServiceActions {
+public:
+    explicit NamingActions(NamingServiceThread* t) : t_(t) {}
+    void ResetServers(const std::vector<NSNode>& servers) override {
+        t_->ResetServers(servers);
+    }
+
+private:
+    NamingServiceThread* t_;
+};
+
+NamingServiceThread::NamingServiceThread(std::string url, NamingService* ns,
+                                         std::string rest)
+    : url_(std::move(url)), ns_(ns), rest_(std::move(rest)) {
+    first_batch_butex_ = butex_create();
+}
+
+// Stop a server socket for good: no more revives, then fail it so refs
+// drain and the slot recycles.
+static void RetireServerSocket(SocketId id) {
+    Socket* s = Socket::UnsafeAddress(id);
+    if (s != nullptr) s->StopHealthCheck();
+    Socket::SetFailedById(id);
+}
+
+NamingServiceThread::~NamingServiceThread() {
+    // Unreached in practice (registry keeps these alive process-wide).
+    ns_->Destroy();
+    std::lock_guard<std::mutex> g(mu_);
+    for (auto& [node, id] : entries_) RetireServerSocket(id);
+    entries_.clear();
+    butex_destroy(first_batch_butex_);
+}
+
+void* NamingServiceThread::RunThunk(void* arg) {
+    // The registry keeps NamingServiceThread objects alive for the whole
+    // process (shared polling threads are few and channel-independent —
+    // same lifetime the reference gives them in practice), so a raw
+    // pointer is safe here.
+    auto* t = (NamingServiceThread*)arg;
+    NamingActions actions(t);
+    t->ns_->RunNamingService(t->rest_.c_str(), &actions);
+    return nullptr;
+}
+
+static std::mutex g_nst_mu;
+static std::unordered_map<std::string,
+                          std::shared_ptr<NamingServiceThread>>* g_nst_map;
+
+std::shared_ptr<NamingServiceThread> NamingServiceThread::GetOrCreate(
+    const std::string& url) {
+    const size_t sep = url.find("://");
+    if (sep == std::string::npos) return nullptr;
+    const std::string scheme = url.substr(0, sep);
+    const std::string rest = url.substr(sep + 3);
+
+    std::lock_guard<std::mutex> g(g_nst_mu);
+    if (g_nst_map == nullptr) {
+        g_nst_map = new std::unordered_map<
+            std::string, std::shared_ptr<NamingServiceThread>>;
+    }
+    auto it = g_nst_map->find(url);
+    if (it != g_nst_map->end()) return it->second;
+    NamingService* ns = NamingService::New(scheme);
+    if (ns == nullptr) {
+        LOG(ERROR) << "unknown naming scheme: " << scheme;
+        return nullptr;
+    }
+    std::shared_ptr<NamingServiceThread> t(
+        new NamingServiceThread(url, ns, rest));
+    (*g_nst_map)[url] = t;
+    fiber_t tid;
+    if (fiber_start_background(&tid, nullptr, RunThunk, t.get()) != 0) {
+        g_nst_map->erase(url);
+        return nullptr;
+    }
+    return t;
+}
+
+void NamingServiceThread::ResetServers(const std::vector<NSNode>& servers) {
+    std::vector<ServerNode> added;
+    std::vector<SocketId> removed;
+    std::set<Watcher*> watchers_snapshot;
+    {
+        std::lock_guard<std::mutex> g(mu_);
+        const std::set<NSNode> fresh(servers.begin(), servers.end());
+        // Removals: present here, absent in fresh.
+        for (auto it = entries_.begin(); it != entries_.end();) {
+            if (fresh.count(it->first) == 0) {
+                removed.push_back(it->second);
+                RetireServerSocket(it->second);
+                it = entries_.erase(it);
+            } else {
+                ++it;
+            }
+        }
+        // Additions: in fresh, not yet tracked.
+        for (const NSNode& node : fresh) {
+            if (entries_.count(node)) continue;
+            SocketOptions opts;
+            opts.fd = -1;
+            opts.remote_side = node.ep;
+            opts.on_edge_triggered_events = &InputMessenger::OnNewMessages;
+            opts.user = Channel::client_messenger();
+            opts.health_check_interval_ms =
+                FLAGS_ns_health_check_interval_ms.get();
+            SocketId id;
+            if (Socket::Create(opts, &id) != 0) {
+                LOG(ERROR) << "Socket::Create failed for "
+                           << endpoint2str(node.ep);
+                continue;
+            }
+            entries_[node] = id;
+            added.push_back({id, WeightFromTag(node.tag), node.ep});
+        }
+        watchers_snapshot = watchers_;
+    }
+    for (Watcher* w : watchers_snapshot) {
+        if (!added.empty() || !removed.empty()) {
+            w->OnServersChanged(added, removed);
+        }
+    }
+    // Signal first batch.
+    std::atomic<int>* word = butex_word(first_batch_butex_);
+    if (word->load(std::memory_order_acquire) == 0) {
+        word->store(1, std::memory_order_release);
+        butex_wake_all(first_batch_butex_);
+    }
+}
+
+void NamingServiceThread::AddWatcher(Watcher* w) {
+    std::vector<ServerNode> current;
+    {
+        std::lock_guard<std::mutex> g(mu_);
+        watchers_.insert(w);
+        for (const auto& [node, id] : entries_) {
+            current.push_back({id, WeightFromTag(node.tag), node.ep});
+        }
+    }
+    if (!current.empty()) w->OnServersChanged(current, {});
+}
+
+void NamingServiceThread::RemoveWatcher(Watcher* w) {
+    std::lock_guard<std::mutex> g(mu_);
+    watchers_.erase(w);
+}
+
+int NamingServiceThread::WaitForFirstBatch(int64_t timeout_ms) {
+    std::atomic<int>* word = butex_word(first_batch_butex_);
+    const int64_t deadline = monotonic_time_us() + timeout_ms * 1000;
+    while (word->load(std::memory_order_acquire) == 0) {
+        if (monotonic_time_us() >= deadline) return -1;
+        butex_wait(first_batch_butex_, 0, &deadline);
+    }
+    return 0;
+}
+
+// ---------------- LoadBalancerWithNaming ----------------
+
+LoadBalancerWithNaming::~LoadBalancerWithNaming() {
+    if (ns_thread_) ns_thread_->RemoveWatcher(this);
+}
+
+int LoadBalancerWithNaming::Init(const std::string& naming_url,
+                                 const std::string& lb_name) {
+    lb_.reset(LoadBalancer::New(lb_name));
+    if (!lb_) {
+        LOG(ERROR) << "unknown load balancer: " << lb_name;
+        return -1;
+    }
+    ns_thread_ = NamingServiceThread::GetOrCreate(naming_url);
+    if (!ns_thread_) return -1;
+    ns_thread_->AddWatcher(this);
+    // Give the first resolution a chance so immediate calls see servers
+    // (list:// resolves instantly; dns may take a beat).
+    ns_thread_->WaitForFirstBatch(1000);
+    return 0;
+}
+
+void LoadBalancerWithNaming::OnServersChanged(
+    const std::vector<ServerNode>& added,
+    const std::vector<SocketId>& removed) {
+    if (!added.empty()) lb_->AddServersInBatch(added);
+    if (!removed.empty()) lb_->RemoveServersInBatch(removed);
+}
+
+}  // namespace tpurpc

@@ -1,0 +1,98 @@
+// NamingServiceThread + LoadBalancerWithNaming: the glue between naming
+// services and load balancers.
+//
+// Modeled on reference src/brpc/details/naming_service_thread.h:59 (one
+// shared polling fiber per naming URL, fanning diffs out to watchers) and
+// src/brpc/details/load_balancer_with_naming.* (the object a Channel holds
+// when Init'ed with a naming URL + LB name).
+#pragma once
+
+#include <map>
+#include <memory>
+#include <mutex>
+#include <set>
+#include <string>
+#include <vector>
+
+#include "trpc/load_balancer.h"
+#include "trpc/naming_service.h"
+
+namespace tpurpc {
+
+// One fiber per distinct naming URL, shared by every channel using it.
+// Owns one Socket per resolved server (ref held, health-checked) and
+// notifies watchers with add/remove diffs.
+class NamingServiceThread {
+public:
+    class Watcher {
+    public:
+        virtual ~Watcher() = default;
+        virtual void OnServersChanged(const std::vector<ServerNode>& added,
+                                      const std::vector<SocketId>& removed) = 0;
+    };
+
+    ~NamingServiceThread();
+
+    // Shared instance for `url` ("list://h1:p1,h2:p2"). Starts the polling
+    // fiber on first use. Returns nullptr for unknown schemes.
+    static std::shared_ptr<NamingServiceThread> GetOrCreate(
+        const std::string& url);
+
+    // Registers and immediately replays the current server set as "added".
+    void AddWatcher(Watcher* w);
+    void RemoveWatcher(Watcher* w);
+
+    // Block (fiber-aware) until the first ResetServers arrived, up to
+    // timeout. Returns 0 if servers are known.
+    int WaitForFirstBatch(int64_t timeout_ms);
+
+    // Current endpoint of a server id (diagnostics).
+    std::string url() const { return url_; }
+
+private:
+    friend class NamingActions;
+    NamingServiceThread(std::string url, NamingService* ns, std::string rest);
+    static void* RunThunk(void* arg);
+
+    // Diff a freshly-resolved list against current entries.
+    void ResetServers(const std::vector<NSNode>& servers);
+
+    const std::string url_;
+    std::unique_ptr<NamingService> ns_;
+    const std::string rest_;  // after scheme://
+
+    std::mutex mu_;
+    // Id only — no ref held: a held ref would block the health-check
+    // fiber's sole-owner revive condition. Hc-enabled sockets can't recycle
+    // until StopHealthCheck, so ids stay resolvable for removal.
+    std::map<NSNode, SocketId> entries_;
+    std::set<Watcher*> watchers_;
+    void* first_batch_butex_ = nullptr;  // word flips 0->1 once
+};
+
+// What Channel::Init(naming_url, lb_name) creates: LB fed by a (shared)
+// naming thread.
+class LoadBalancerWithNaming : public NamingServiceThread::Watcher {
+public:
+    ~LoadBalancerWithNaming() override;
+
+    // Returns 0 on success (unknown scheme/LB name: -1).
+    int Init(const std::string& naming_url, const std::string& lb_name);
+
+    int SelectServer(const SelectIn& in, SelectOut* out) {
+        return lb_->SelectServer(in, out);
+    }
+    void Feedback(const LoadBalancer::CallInfo& info) {
+        lb_->Feedback(info);
+    }
+    LoadBalancer* lb() const { return lb_.get(); }
+
+    void OnServersChanged(const std::vector<ServerNode>& added,
+                          const std::vector<SocketId>& removed) override;
+
+private:
+    std::unique_ptr<LoadBalancer> lb_;
+    std::shared_ptr<NamingServiceThread> ns_thread_;
+};
+
+}  // namespace tpurpc

@@ -1,0 +1,474 @@
+// Load balancer policies: rr, wrr, random, consistent-hash ring, and
+// locality-aware. Reference policy set: src/brpc/global.cpp:384-392 and
+// src/brpc/policy/{round_robin,weighted_round_robin,randomized,
+// consistent_hashing,locality_aware}_load_balancer.*.
+#include "trpc/load_balancer.h"
+
+#include <algorithm>
+#include <atomic>
+#include <map>
+#include <mutex>
+#include <numeric>
+#include <unordered_map>
+
+#include "tbase/doubly_buffered_data.h"
+#include "tbase/endpoint.h"
+#include "tbase/fast_rand.h"
+#include "tbase/logging.h"
+#include "tbase/time.h"
+
+namespace tpurpc {
+
+void LoadBalancer::Describe(std::string* out) const {
+    out->append(name());
+}
+
+// Shared server-list state for list-based policies.
+struct ServerList {
+    std::vector<ServerNode> list;
+    std::map<SocketId, size_t> index;  // id -> position in list
+
+    bool Add(const ServerNode& s) {
+        if (index.count(s.id)) return false;
+        index[s.id] = list.size();
+        list.push_back(s);
+        return true;
+    }
+    bool Remove(SocketId id) {
+        auto it = index.find(id);
+        if (it == index.end()) return false;
+        const size_t pos = it->second;
+        index.erase(it);
+        // Swap-with-last keeps removal O(1).
+        if (pos + 1 < list.size()) {
+            list[pos] = list.back();
+            index[list[pos].id] = pos;
+        }
+        list.pop_back();
+        return true;
+    }
+};
+
+int SelectFromList(const std::vector<ServerNode>& list, size_t start,
+                   const SelectIn& in, SelectOut* out) {
+    const size_t n = list.size();
+    if (n == 0) return ENODATA;
+    for (size_t i = 0; i < n; ++i) {
+        const ServerNode& node = list[(start + i) % n];
+        if (in.excluded != nullptr && in.excluded->IsExcluded(node.id)) {
+            continue;
+        }
+        Socket* s = Socket::Address(node.id);
+        if (s == nullptr) continue;
+        out->ptr = SocketUniquePtr(s);
+        return 0;
+    }
+    // Everything excluded/failed: as a last resort allow an excluded-but-
+    // live server (better to retry a tried server than to fail outright —
+    // reference round_robin_load_balancer.cpp falls back the same way).
+    for (size_t i = 0; i < n; ++i) {
+        const ServerNode& node = list[(start + i) % n];
+        Socket* s = Socket::Address(node.id);
+        if (s == nullptr) continue;
+        out->ptr = SocketUniquePtr(s);
+        return 0;
+    }
+    return EHOSTDOWN;
+}
+
+// ---------------- round robin ----------------
+
+class RoundRobinLoadBalancer : public LoadBalancer {
+public:
+    bool AddServer(const ServerNode& s) override {
+        return db_.Modify([&](ServerList& sl) { return sl.Add(s); }) != 0;
+    }
+    bool RemoveServer(SocketId id) override {
+        return db_.Modify([&](ServerList& sl) { return sl.Remove(id); }) != 0;
+    }
+    int SelectServer(const SelectIn& in, SelectOut* out) override {
+        DoublyBufferedData<ServerList>::ScopedPtr ptr;
+        if (db_.Read(&ptr) != 0) return ENOMEM;
+        const size_t start =
+            next_.fetch_add(1, std::memory_order_relaxed);
+        return SelectFromList(ptr->list, start, in, out);
+    }
+    const char* name() const override { return "rr"; }
+
+private:
+    DoublyBufferedData<ServerList> db_;
+    std::atomic<size_t> next_{0};
+};
+
+// ---------------- random ----------------
+
+class RandomizedLoadBalancer : public LoadBalancer {
+public:
+    bool AddServer(const ServerNode& s) override {
+        return db_.Modify([&](ServerList& sl) { return sl.Add(s); }) != 0;
+    }
+    bool RemoveServer(SocketId id) override {
+        return db_.Modify([&](ServerList& sl) { return sl.Remove(id); }) != 0;
+    }
+    int SelectServer(const SelectIn& in, SelectOut* out) override {
+        DoublyBufferedData<ServerList>::ScopedPtr ptr;
+        if (db_.Read(&ptr) != 0) return ENOMEM;
+        if (ptr->list.empty()) return ENODATA;
+        return SelectFromList(ptr->list, fast_rand_less_than(ptr->list.size()),
+                              in, out);
+    }
+    const char* name() const override { return "random"; }
+
+private:
+    DoublyBufferedData<ServerList> db_;
+};
+
+// ---------------- weighted round robin ----------------
+// The foreground copy carries a precomputed schedule (weights reduced by
+// their gcd, entries interleaved) walked by an atomic cursor — selection
+// stays wait-free (reference weighted_round_robin_load_balancer.cpp keeps
+// per-thread stride state; a shared schedule is simpler and as fair).
+
+struct WrrList : ServerList {
+    std::vector<size_t> schedule;  // indexes into list
+
+    void Rebuild() {
+        schedule.clear();
+        if (list.empty()) return;
+        int g = 0;
+        for (const auto& s : list) g = std::gcd(g, std::max(s.weight, 1));
+        std::vector<int64_t> remain(list.size());
+        int64_t total = 0;
+        for (size_t i = 0; i < list.size(); ++i) {
+            remain[i] = std::max(list[i].weight, 1) / g;
+            total += remain[i];
+        }
+        if (total > 65536) {  // clamp pathological weight ratios
+            for (auto& r : remain) {
+                r = std::max<int64_t>(1, r * 65536 / total);
+            }
+        }
+        // Interleave: repeatedly emit each server still owed slots.
+        bool more = true;
+        while (more) {
+            more = false;
+            for (size_t i = 0; i < list.size(); ++i) {
+                if (remain[i] > 0) {
+                    schedule.push_back(i);
+                    if (--remain[i] > 0) more = true;
+                }
+            }
+        }
+    }
+};
+
+class WeightedRoundRobinLoadBalancer : public LoadBalancer {
+public:
+    bool AddServer(const ServerNode& s) override {
+        return db_.Modify([&](WrrList& sl) {
+            if (!sl.Add(s)) return false;
+            sl.Rebuild();
+            return true;
+        }) != 0;
+    }
+    bool RemoveServer(SocketId id) override {
+        return db_.Modify([&](WrrList& sl) {
+            if (!sl.Remove(id)) return false;
+            sl.Rebuild();
+            return true;
+        }) != 0;
+    }
+    int SelectServer(const SelectIn& in, SelectOut* out) override {
+        DoublyBufferedData<WrrList>::ScopedPtr ptr;
+        if (db_.Read(&ptr) != 0) return ENOMEM;
+        const auto& sched = ptr->schedule;
+        if (sched.empty()) return ENODATA;
+        const size_t n = sched.size();
+        size_t start = next_.fetch_add(1, std::memory_order_relaxed) % n;
+        for (size_t i = 0; i < n; ++i) {
+            const ServerNode& node = ptr->list[sched[(start + i) % n]];
+            if (in.excluded && in.excluded->IsExcluded(node.id)) continue;
+            Socket* s = Socket::Address(node.id);
+            if (s == nullptr) continue;
+            out->ptr = SocketUniquePtr(s);
+            return 0;
+        }
+        return SelectFromList(ptr->list, start, in, out);
+    }
+    const char* name() const override { return "wrr"; }
+
+private:
+    DoublyBufferedData<WrrList> db_;
+    std::atomic<size_t> next_{0};
+};
+
+// ---------------- consistent hashing (ketama ring) ----------------
+// Each server contributes `weight * kReplicasPerServer` virtual nodes at
+// hash("ip:port-i"); requests map to the first ring point >= hash(request
+// _code). Reference: src/brpc/policy/consistent_hashing_load_balancer.*.
+
+static uint64_t fmix64(uint64_t k) {
+    // 64-bit avalanche finalizer (murmur3-style).
+    k ^= k >> 33;
+    k *= 0xff51afd7ed558ccdULL;
+    k ^= k >> 33;
+    k *= 0xc4ceb9fe1a85ec53ULL;
+    k ^= k >> 33;
+    return k;
+}
+
+static uint64_t hash_bytes(const std::string& s, uint64_t seed) {
+    uint64_t h = seed;
+    for (char c : s) h = fmix64(h ^ (uint8_t)c);
+    return h;
+}
+
+struct HashRing {
+    struct Point {
+        uint64_t hash;
+        SocketId id;
+        bool operator<(const Point& o) const { return hash < o.hash; }
+    };
+    std::vector<Point> ring;
+    std::map<SocketId, ServerNode> members;
+
+    static constexpr int kReplicasPerServer = 100;
+
+    void Rebuild(uint64_t seed) {
+        ring.clear();
+        for (const auto& [id, node] : members) {
+            // Ring keys come from registration-time data only, so both
+            // DoublyBufferedData copies and every rebuild agree regardless
+            // of the socket's momentary health.
+            const std::string key = node.ep.port != 0
+                                        ? endpoint2str(node.ep)
+                                        : std::to_string(id);
+            const int replicas = kReplicasPerServer * std::max(node.weight, 1);
+            for (int i = 0; i < replicas; ++i) {
+                ring.push_back(
+                    {hash_bytes(key + "-" + std::to_string(i), seed), id});
+            }
+        }
+        std::sort(ring.begin(), ring.end());
+    }
+};
+
+class ConsistentHashLoadBalancer : public LoadBalancer {
+public:
+    explicit ConsistentHashLoadBalancer(uint64_t seed, const char* name)
+        : seed_(seed), name_(name) {}
+
+    bool AddServer(const ServerNode& s) override {
+        return db_.Modify([&](HashRing& r) {
+            if (r.members.count(s.id)) return false;
+            r.members[s.id] = s;
+            r.Rebuild(seed_);
+            return true;
+        }) != 0;
+    }
+    bool RemoveServer(SocketId id) override {
+        return db_.Modify([&](HashRing& r) {
+            if (r.members.erase(id) == 0) return false;
+            r.Rebuild(seed_);
+            return true;
+        }) != 0;
+    }
+    int SelectServer(const SelectIn& in, SelectOut* out) override {
+        DoublyBufferedData<HashRing>::ScopedPtr ptr;
+        if (db_.Read(&ptr) != 0) return ENOMEM;
+        const auto& ring = ptr->ring;
+        if (ring.empty()) return ENODATA;
+        const uint64_t h =
+            in.has_request_code ? fmix64(in.request_code) : fast_rand();
+        HashRing::Point probe{h, 0};
+        auto it = std::lower_bound(ring.begin(), ring.end(), probe);
+        const size_t start = it == ring.end() ? 0 : it - ring.begin();
+        // Walk the ring until a live, non-excluded server is found.
+        SocketId last_live = INVALID_VREF_ID;
+        for (size_t i = 0; i < ring.size(); ++i) {
+            const SocketId id = ring[(start + i) % ring.size()].id;
+            Socket* s = Socket::Address(id);
+            if (s == nullptr) continue;
+            if (in.excluded && in.excluded->IsExcluded(id)) {
+                if (last_live == INVALID_VREF_ID) last_live = id;
+                s->Dereference();
+                continue;
+            }
+            out->ptr = SocketUniquePtr(s);
+            return 0;
+        }
+        if (last_live != INVALID_VREF_ID) {
+            Socket* s = Socket::Address(last_live);
+            if (s != nullptr) {
+                out->ptr = SocketUniquePtr(s);
+                return 0;
+            }
+        }
+        return EHOSTDOWN;
+    }
+    const char* name() const override { return name_; }
+
+private:
+    DoublyBufferedData<HashRing> db_;
+    const uint64_t seed_;
+    const char* name_;
+};
+
+// ---------------- locality-aware ----------------
+// Weight each server by expected goodness 1/(ema_latency * (inflight+1))
+// and pick weighted-random. The reference's la (src/brpc/policy/
+// locality_aware_load_balancer.*, docs lalb.md) maintains a weight tree
+// updated through an ExecutionQueue; this design keeps per-server atomics
+// and recomputes the CDF on read — O(n) per select but n is small and the
+// arithmetic is branch-free.
+
+class LocalityAwareLoadBalancer : public LoadBalancer {
+    struct Stats {
+        std::atomic<int64_t> ema_latency_us{0};  // 0 = no data yet
+        std::atomic<int32_t> inflight{0};
+        std::atomic<int32_t> recent_errors{0};
+    };
+
+public:
+    bool AddServer(const ServerNode& s) override {
+        {
+            std::lock_guard<std::mutex> g(stats_mu_);
+            if (!stats_.count(s.id)) {
+                stats_[s.id] = std::make_shared<Stats>();
+            }
+        }
+        return db_.Modify([&](ServerList& sl) { return sl.Add(s); }) != 0;
+    }
+    bool RemoveServer(SocketId id) override {
+        bool removed =
+            db_.Modify([&](ServerList& sl) { return sl.Remove(id); }) != 0;
+        if (removed) {
+            std::lock_guard<std::mutex> g(stats_mu_);
+            stats_.erase(id);
+        }
+        return removed;
+    }
+    int SelectServer(const SelectIn& in, SelectOut* out) override {
+        DoublyBufferedData<ServerList>::ScopedPtr ptr;
+        if (db_.Read(&ptr) != 0) return ENOMEM;
+        const auto& list = ptr->list;
+        if (list.empty()) return ENODATA;
+        // Two passes: compute weights, then pick by weighted random.
+        double weights[kMaxInline];
+        const size_t n = std::min(list.size(), (size_t)kMaxInline);
+        double total = 0;
+        {
+            std::lock_guard<std::mutex> g(stats_mu_);
+            for (size_t i = 0; i < n; ++i) {
+                const SocketId id = list[i].id;
+                double w = 0;
+                if (!(in.excluded && in.excluded->IsExcluded(id))) {
+                    auto it = stats_.find(id);
+                    if (it != stats_.end()) {
+                        const int64_t lat =
+                            it->second->ema_latency_us.load(
+                                std::memory_order_relaxed);
+                        // Clamp: transient pick/feedback races must never
+                        // drive the weight negative or divide by zero.
+                        const int32_t inflight =
+                            std::max(it->second->inflight.load(
+                                         std::memory_order_relaxed),
+                                     0);
+                        // Unprobed servers get the optimistic base weight so
+                        // they attract traffic and build an estimate.
+                        const double base =
+                            lat > 0 ? 1e6 / (double)lat : kInitialWeight;
+                        w = base / (inflight + 1);
+                    } else {
+                        w = kInitialWeight;
+                    }
+                }
+                weights[i] = w;
+                total += w;
+            }
+        }
+        if (total <= 0) {
+            // All excluded: fall back to plain scan.
+            const int rc =
+                SelectFromList(list, fast_rand_less_than(list.size()), in, out);
+            if (rc == 0) OnPicked(out->ptr->id());  // keep inflight balanced
+            return rc;
+        }
+        double pick = fast_rand_double() * total;
+        for (size_t i = 0; i < n; ++i) {
+            pick -= weights[i];
+            if (pick <= 0 && weights[i] > 0) {
+                Socket* s = Socket::Address(list[i].id);
+                if (s != nullptr) {
+                    out->ptr = SocketUniquePtr(s);
+                    OnPicked(list[i].id);
+                    return 0;
+                }
+            }
+        }
+        const int rc =
+            SelectFromList(list, fast_rand_less_than(list.size()), in, out);
+        if (rc == 0) OnPicked(out->ptr->id());
+        return rc;
+    }
+    void Feedback(const CallInfo& info) override {
+        std::shared_ptr<Stats> st;
+        {
+            std::lock_guard<std::mutex> g(stats_mu_);
+            auto it = stats_.find(info.server_id);
+            if (it == stats_.end()) return;
+            st = it->second;
+        }
+        st->inflight.fetch_sub(1, std::memory_order_relaxed);
+        if (info.error_code == 0) {
+            // EMA with alpha = 1/8.
+            int64_t prev = st->ema_latency_us.load(std::memory_order_relaxed);
+            int64_t next = prev == 0
+                               ? info.latency_us
+                               : prev + (info.latency_us - prev) / 8;
+            st->ema_latency_us.store(std::max<int64_t>(next, 1),
+                                     std::memory_order_relaxed);
+        } else {
+            // Penalize errors: double the latency estimate.
+            int64_t prev = st->ema_latency_us.load(std::memory_order_relaxed);
+            st->ema_latency_us.store(prev == 0 ? 100000 : prev * 2,
+                                     std::memory_order_relaxed);
+        }
+    }
+    const char* name() const override { return "la"; }
+
+private:
+    static constexpr int kMaxInline = 1024;
+    static constexpr double kInitialWeight = 100.0;  // ~10ms equivalent
+
+    void OnPicked(SocketId id) {
+        std::lock_guard<std::mutex> g(stats_mu_);
+        auto it = stats_.find(id);
+        if (it != stats_.end()) {
+            it->second->inflight.fetch_add(1, std::memory_order_relaxed);
+        }
+    }
+
+    DoublyBufferedData<ServerList> db_;
+    mutable std::mutex stats_mu_;
+    std::unordered_map<SocketId, std::shared_ptr<Stats>> stats_;
+};
+
+// ---------------- factory ----------------
+
+LoadBalancer* LoadBalancer::New(const std::string& name) {
+    if (name == "rr") return new RoundRobinLoadBalancer;
+    if (name == "random") return new RandomizedLoadBalancer;
+    if (name == "wrr") return new WeightedRoundRobinLoadBalancer;
+    if (name == "c_murmurhash" || name == "ch") {
+        return new ConsistentHashLoadBalancer(0x9e3779b97f4a7c15ULL,
+                                              "c_murmurhash");
+    }
+    if (name == "c_md5") {
+        return new ConsistentHashLoadBalancer(0x517cc1b727220a95ULL, "c_md5");
+    }
+    if (name == "la") return new LocalityAwareLoadBalancer;
+    return nullptr;
+}
+
+}  // namespace tpurpc

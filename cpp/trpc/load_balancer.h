@@ -1,0 +1,114 @@
+// LoadBalancer: pluggable server selection over a read-mostly server list.
+//
+// Modeled on reference src/brpc/load_balancer.h:35-77 (interface
+// SelectServer/AddServer/RemoveServer/Feedback over DoublyBufferedData) and
+// the policy set registered in src/brpc/global.cpp:384-392 (rr, wrr,
+// random, wr, consistent-hash variants, locality-aware). Server identity is
+// a SocketId whose validity survives failure: health check revives the same
+// id (reference src/brpc/socket.h:469 HealthCheck + Revive), so lists don't
+// churn on transient failures.
+#pragma once
+
+#include <cstdint>
+#include <memory>
+#include <string>
+#include <vector>
+
+#include "tbase/endpoint.h"
+#include "tnet/socket.h"
+
+namespace tpurpc {
+
+// Servers tried by earlier attempts of the same RPC, excluded on retry
+// (reference src/brpc/excluded_servers.h — fixed small array, linear scan).
+class ExcludedServers {
+public:
+    void Add(SocketId id) {
+        if (count_ < kMax) ids_[count_++] = id;
+    }
+    bool IsExcluded(SocketId id) const {
+        for (int i = 0; i < count_; ++i) {
+            if (ids_[i] == id) return true;
+        }
+        return false;
+    }
+    int size() const { return count_; }
+
+private:
+    static constexpr int kMax = 8;
+    SocketId ids_[kMax];
+    int count_ = 0;
+};
+
+struct SelectIn {
+    // Hash key for consistent-hashing policies (reference
+    // Controller::set_request_code).
+    uint64_t request_code = 0;
+    bool has_request_code = false;
+    const ExcludedServers* excluded = nullptr;  // may be null
+};
+
+struct SelectOut {
+    // On success the chosen server with a held ref (guaranteed alive and
+    // non-failed at selection time).
+    SocketUniquePtr ptr;
+};
+
+// A server as registered by the naming layer: stable socket id + weight
+// (from naming tags like "host:port w=10") + endpoint (captured at
+// registration so consistent-hash ring keys never depend on transient
+// socket liveness).
+struct ServerNode {
+    SocketId id = INVALID_VREF_ID;
+    int weight = 1;
+    EndPoint ep;
+};
+
+class LoadBalancer {
+public:
+    virtual ~LoadBalancer() = default;
+
+    virtual bool AddServer(const ServerNode& server) = 0;
+    virtual bool RemoveServer(SocketId id) = 0;
+    // Returns number added.
+    virtual size_t AddServersInBatch(const std::vector<ServerNode>& servers) {
+        size_t n = 0;
+        for (const auto& s : servers) n += AddServer(s);
+        return n;
+    }
+    virtual size_t RemoveServersInBatch(const std::vector<SocketId>& ids) {
+        size_t n = 0;
+        for (SocketId id : ids) n += RemoveServer(id);
+        return n;
+    }
+
+    // Pick a live server. Returns 0 on success, ENODATA when the list is
+    // empty, EHOSTDOWN when every candidate is failed/excluded.
+    virtual int SelectServer(const SelectIn& in, SelectOut* out) = 0;
+
+    // RPC completion feedback (latency in us; error_code 0 = success).
+    // Only locality-aware uses it; default no-op.
+    struct CallInfo {
+        SocketId server_id = INVALID_VREF_ID;
+        int64_t latency_us = 0;
+        int error_code = 0;
+    };
+    virtual void Feedback(const CallInfo&) {}
+
+    // Describe current servers (diagnostics / builtin portal).
+    virtual void Describe(std::string* out) const;
+
+    virtual const char* name() const = 0;
+
+    // Factory over the registered policy set ("rr", "wrr", "random",
+    // "c_murmurhash", "c_md5"(alias to murmur ring w/ different seed),
+    // "la"). Returns nullptr for unknown names.
+    static LoadBalancer* New(const std::string& name);
+};
+
+// Common helper: try up to all candidates starting at `start`, skipping
+// excluded and failed ids; holds the first addressable live one.
+int SelectFromList(const std::vector<ServerNode>& list, size_t start,
+                   const SelectIn& in, SelectOut* out);
+
+}  // namespace tpurpc

@@ -1,0 +1,167 @@
+// Naming service implementations: list://, file://, dns://.
+// Reference impls: src/brpc/policy/{list,file,domain}_naming_service.*.
+#include "trpc/naming_service.h"
+
+#include <netdb.h>
+
+#include <cstring>
+#include <fstream>
+#include <sstream>
+
+#include "tbase/flags.h"
+#include "tbase/logging.h"
+#include "tfiber/fiber.h"
+
+DEFINE_int32(ns_refresh_interval_ms, 5000,
+             "Interval between naming-service refreshes (file mtime poll, "
+             "DNS re-resolve)");
+
+namespace tpurpc {
+
+int ParseNamingLine(const std::string& raw, NSNode* out) {
+    // Strip comments and whitespace; split "endpoint tag".
+    std::string line = raw;
+    const size_t hash = line.find('#');
+    if (hash != std::string::npos) line.resize(hash);
+    std::istringstream iss(line);
+    std::string ep_str, tag;
+    if (!(iss >> ep_str)) return -1;  // blank
+    std::getline(iss, tag);
+    // Trim tag.
+    const size_t b = tag.find_first_not_of(" \t");
+    tag = b == std::string::npos ? "" : tag.substr(b);
+    const size_t e = tag.find_last_not_of(" \t\r");
+    if (e != std::string::npos) tag.resize(e + 1);
+    if (hostname2endpoint(ep_str.c_str(), &out->ep) != 0) return -1;
+    out->tag = tag;
+    return 0;
+}
+
+int WeightFromTag(const std::string& tag) {
+    if (tag.rfind("w=", 0) == 0) {
+        const int w = atoi(tag.c_str() + 2);
+        if (w > 0) return w;
+    }
+    return 1;
+}
+
+// ---------------- periodic base ----------------
+
+int PeriodicNamingService::RunNamingService(const char* service_name,
+                                            NamingServiceActions* actions) {
+    std::vector<NSNode> servers;
+    while (!stop_.load(std::memory_order_acquire)) {
+        servers.clear();
+        if (GetServers(service_name, &servers) == 0) {
+            actions->ResetServers(servers);
+        }
+        const int64_t interval_ms = FLAGS_ns_refresh_interval_ms.get();
+        // Sleep in small slices so Destroy() takes effect quickly.
+        for (int64_t slept = 0;
+             slept < interval_ms && !stop_.load(std::memory_order_acquire);
+             slept += 100) {
+            fiber_usleep(100 * 1000);
+        }
+    }
+    return 0;
+}
+
+void PeriodicNamingService::Destroy() {
+    stop_.store(true, std::memory_order_release);
+}
+
+// ---------------- list:// ----------------
+// "list://h1:p1,h2:p2 w=3,h3:p3" — static, pushed once.
+
+class ListNamingService : public NamingService {
+public:
+    int RunNamingService(const char* service_name,
+                         NamingServiceActions* actions) override {
+        std::vector<NSNode> servers;
+        std::string rest(service_name);
+        size_t pos = 0;
+        while (pos <= rest.size()) {
+            size_t comma = rest.find(',', pos);
+            if (comma == std::string::npos) comma = rest.size();
+            NSNode node;
+            if (ParseNamingLine(rest.substr(pos, comma - pos), &node) == 0) {
+                servers.push_back(node);
+            }
+            pos = comma + 1;
+        }
+        actions->ResetServers(servers);
+        return 0;
+    }
+    const char* scheme() const override { return "list"; }
+};
+
+// ---------------- file:// ----------------
+// One server per line; re-read when mtime changes.
+
+class FileNamingService : public PeriodicNamingService {
+public:
+    const char* scheme() const override { return "file"; }
+
+protected:
+    int GetServers(const char* service_name,
+                   std::vector<NSNode>* out) override {
+        std::ifstream in(service_name);
+        if (!in) {
+            LOG(WARNING) << "cannot open naming file " << service_name;
+            return -1;
+        }
+        std::string line;
+        while (std::getline(in, line)) {
+            NSNode node;
+            if (ParseNamingLine(line, &node) == 0) out->push_back(node);
+        }
+        return 0;
+    }
+};
+
+// ---------------- dns:// ----------------
+// "host:port" re-resolved every interval; every A record becomes a server.
+
+class DomainNamingService : public PeriodicNamingService {
+public:
+    const char* scheme() const override { return "dns"; }
+
+protected:
+    int GetServers(const char* service_name,
+                   std::vector<NSNode>* out) override {
+        std::string host(service_name);
+        int port = 80;
+        const size_t colon = host.rfind(':');
+        if (colon != std::string::npos) {
+            port = atoi(host.c_str() + colon + 1);
+            host.resize(colon);
+        }
+        addrinfo hints{};
+        hints.ai_family = AF_INET;
+        hints.ai_socktype = SOCK_STREAM;
+        addrinfo* res = nullptr;
+        if (getaddrinfo(host.c_str(), nullptr, &hints, &res) != 0) {
+            LOG(WARNING) << "DNS resolve failed for " << host;
+            return -1;
+        }
+        for (addrinfo* p = res; p != nullptr; p = p->ai_next) {
+            NSNode node;
+            node.ep.ip = ((sockaddr_in*)p->ai_addr)->sin_addr;
+            node.ep.port = port;
+            out->push_back(node);
+        }
+        freeaddrinfo(res);
+        return 0;
+    }
+};
+
+// ---------------- factory ----------------
+
+NamingService* NamingService::New(const std::string& scheme) {
+    if (scheme == "list") return new ListNamingService;
+    if (scheme == "file") return new FileNamingService;
+    if (scheme == "dns" || scheme == "http") return new DomainNamingService;
+    return nullptr;
+}
+
+}  // namespace tpurpc

@@ -1,0 +1,85 @@
+// NamingService: resolves a service name ("list://h1:p1,h2:p2",
+// "file://path", "dns://host:port") into a live server list, pushing
+// updates to actions.
+//
+// Modeled on reference src/brpc/naming_service.h:36-61 (RunNamingService +
+// NamingServiceActions::ResetServers), the periodic base
+// (src/brpc/periodic_naming_service.*) and the impl set registered in
+// src/brpc/global.cpp:370-381 (list/file/domain/...). The shared
+// per-URL polling fiber + watcher fan-out lives in lb_with_naming.h
+// (reference src/brpc/details/naming_service_thread.h:59).
+#pragma once
+
+#include <atomic>
+#include <string>
+#include <vector>
+
+#include "tbase/endpoint.h"
+
+namespace tpurpc {
+
+// One resolved server: endpoint + optional tag ("w=N" weight for wrr/la,
+// partition tags like "0/3" for PartitionChannel).
+struct NSNode {
+    EndPoint ep;
+    std::string tag;
+
+    bool operator==(const NSNode& o) const {
+        return ep == o.ep && tag == o.tag;
+    }
+    bool operator<(const NSNode& o) const {
+        if (ep < o.ep) return true;
+        if (o.ep < ep) return false;
+        return tag < o.tag;
+    }
+};
+
+class NamingServiceActions {
+public:
+    virtual ~NamingServiceActions() = default;
+    // Replace the whole list (the naming thread diffs old vs new).
+    virtual void ResetServers(const std::vector<NSNode>& servers) = 0;
+};
+
+class NamingService {
+public:
+    virtual ~NamingService() = default;
+
+    // Resolve `service_name` (the part after "scheme://") and push lists
+    // into `actions` until Destroy() or process exit. One-shot services
+    // (list/file without watching) may return after one push. Runs on a
+    // dedicated fiber. Returns 0 on a clean stop.
+    virtual int RunNamingService(const char* service_name,
+                                 NamingServiceActions* actions) = 0;
+
+    // Ask a running RunNamingService to stop soon.
+    virtual void Destroy() {}
+
+    virtual const char* scheme() const = 0;
+
+    // New instance by scheme ("list", "file", "dns"); nullptr if unknown.
+    static NamingService* New(const std::string& scheme);
+};
+
+// Base for poll-style services: calls GetServers every
+// FLAGS_ns_refresh_interval_ms and pushes the result.
+class PeriodicNamingService : public NamingService {
+public:
+    int RunNamingService(const char* service_name,
+                         NamingServiceActions* actions) override;
+    void Destroy() override;
+
+protected:
+    virtual int GetServers(const char* service_name,
+                           std::vector<NSNode>* out) = 0;
+
+private:
+    std::atomic<bool> stop_{false};
+};
+
+// Parse "host:port w=2" / "ip:port tag" entries (shared by list/file).
+int ParseNamingLine(const std::string& line, NSNode* out);
+// Weight from a node tag ("w=N"); 1 when absent/invalid.
+int WeightFromTag(const std::string& tag);
+
+}  // namespace tpurpc

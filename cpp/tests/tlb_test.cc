@@ -407,3 +407,103 @@ TEST(LbIntegration, HealthCheckRevives) {
     keep.server.Stop();
     revived.server.Stop();
 }
+
+// ---------------- circuit breaker ----------------
+
+TEST(CircuitBreaker, TripsOnErrorRate) {
+    CircuitBreaker cb;
+    // All-success never trips.
+    for (int i = 0; i < 2000; ++i) {
+        EXPECT_TRUE(cb.OnCallEnd(0, 1000));
+    }
+    EXPECT_FALSE(cb.IsBroken());
+    // 100% errors trip the short window once a quarter-window of samples
+    // accumulated.
+    int calls_until_trip = 0;
+    while (cb.OnCallEnd(ECONNRESET, 1000) && calls_until_trip < 10000) {
+        ++calls_until_trip;
+    }
+    EXPECT_TRUE(cb.IsBroken());
+    EXPECT_LT(calls_until_trip, 200);
+    EXPECT_EQ(1, cb.isolated_times());
+    // Reset re-arms.
+    cb.Reset();
+    EXPECT_FALSE(cb.IsBroken());
+    EXPECT_TRUE(cb.OnCallEnd(0, 1000));
+    EXPECT_EQ(1, cb.isolated_times());  // history survives reset
+}
+
+TEST(CircuitBreaker, LowErrorRateStaysClosed) {
+    CircuitBreaker cb;
+    // 2% errors: below both thresholds (short 30%, long 5%).
+    for (int i = 0; i < 5000; ++i) {
+        EXPECT_TRUE(cb.OnCallEnd(i % 50 == 0 ? ECONNRESET : 0, 1000));
+    }
+    EXPECT_FALSE(cb.IsBroken());
+}
+
+namespace {
+class FlakyEchoServiceImpl : public test::EchoService {
+public:
+    void Echo(google::protobuf::RpcController* cntl_base,
+              const test::EchoRequest* req, test::EchoResponse* res,
+              google::protobuf::Closure* done) override {
+        ncalls.fetch_add(1, std::memory_order_relaxed);
+        if (fail_all.load(std::memory_order_relaxed)) {
+            static_cast<Controller*>(cntl_base)
+                ->SetFailed(ECONNABORTED, "injected failure");
+        } else {
+            res->set_message(req->message());
+        }
+        done->Run();
+    }
+    std::atomic<int> ncalls{0};
+    std::atomic<bool> fail_all{false};
+};
+}  // namespace
+
+TEST(CircuitBreakerIntegration, IsolatesFailingServer) {
+    // One healthy server + one server failing every request at the
+    // application level: the breaker isolates the failing one so traffic
+    // converges on the healthy server (reference behavior:
+    // CircuitBreaker::MarkAsBroken -> health check).
+    Server healthy_srv, flaky_srv;
+    EchoServiceImpl healthy;
+    FlakyEchoServiceImpl flaky;
+    flaky.fail_all = true;
+    ASSERT_EQ(0, healthy_srv.AddService(&healthy));
+    ASSERT_EQ(0, flaky_srv.AddService(&flaky));
+    EndPoint any;
+    str2endpoint("127.0.0.1:0", &any);
+    ASSERT_EQ(0, healthy_srv.Start(any, nullptr));
+    ASSERT_EQ(0, flaky_srv.Start(any, nullptr));
+    EndPoint hep, fep;
+    str2endpoint("127.0.0.1", healthy_srv.listened_port(), &hep);
+    str2endpoint("127.0.0.1", flaky_srv.listened_port(), &fep);
+
+    char url[128];
+    snprintf(url, sizeof(url), "list://%s,%s", endpoint2str(hep).c_str(),
+             endpoint2str(fep).c_str());
+    Channel channel;
+    ChannelOptions opts;
+    opts.timeout_ms = 2000;
+    opts.max_retry = 0;  // application errors are not retried anyway
+    ASSERT_EQ(0, channel.Init(url, "rr", &opts));
+
+    // Drive enough calls for the short window (30% of 100) to trip.
+    int failures = 0;
+    for (int i = 0; i < 300; ++i) {
+        if (call_echo(&channel, "cb") != 0) ++failures;
+    }
+    // The flaky server got isolated: it served far fewer than its rr
+    // half-share, and late-phase traffic all succeeds.
+    EXPECT_LT(flaky.ncalls.load(), 100);
+    EXPECT_GT(healthy.ncalls.load(), 200);
+    int late_failures = 0;
+    for (int i = 0; i < 20; ++i) {
+        if (call_echo(&channel, "late") != 0) ++late_failures;
+    }
+    EXPECT_EQ(0, late_failures);
+    healthy_srv.Stop();
+    flaky_srv.Stop();
+}

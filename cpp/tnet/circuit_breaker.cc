@@ -1,0 +1,39 @@
+#include "tnet/circuit_breaker.h"
+
+#include "tbase/flags.h"
+
+// Defaults shaped like the reference's (src/brpc/circuit_breaker.cpp
+// flags circuit_breaker_short_window_size/..._error_percent etc.).
+DEFINE_bool(enable_circuit_breaker, true,
+            "Isolate servers whose error rate trips the breaker");
+DEFINE_int32(circuit_breaker_short_window_size, 100,
+             "EMA window (calls) for bursty-failure detection");
+DEFINE_double(circuit_breaker_short_window_error_percent, 30.0,
+              "Error percent tripping the short window");
+DEFINE_int32(circuit_breaker_long_window_size, 1000,
+             "EMA window (calls) for chronic-failure detection");
+DEFINE_double(circuit_breaker_long_window_error_percent, 5.0,
+              "Error percent tripping the long window");
+
+namespace tpurpc {
+
+void CircuitBreaker::Reset() {
+    short_.Init(FLAGS_circuit_breaker_short_window_size.get(),
+                FLAGS_circuit_breaker_short_window_error_percent.get());
+    long_.Init(FLAGS_circuit_breaker_long_window_size.get(),
+               FLAGS_circuit_breaker_long_window_error_percent.get());
+    broken_.store(false, std::memory_order_release);
+}
+
+bool CircuitBreaker::OnCallEnd(int error_code, int64_t latency_us) {
+    (void)latency_us;  // reserved: latency-weighted error cost
+    if (!FLAGS_enable_circuit_breaker.get()) return true;
+    if (IsBroken()) return false;
+    const bool error = error_code != 0;
+    bool ok = short_.OnCallEnd(error);
+    ok = long_.OnCallEnd(error) && ok;
+    if (!ok) MarkAsBroken();
+    return ok;
+}
+
+}  // namespace tpurpc

@@ -1,0 +1,94 @@
+// CircuitBreaker: per-connection EMA error-rate tracker that isolates a
+// server when its error rate exceeds thresholds in a short (bursty) or
+// long (chronic) window.
+//
+// Modeled on reference src/brpc/circuit_breaker.h:25-85 (two
+// EmaErrorRecorders; MarkAsBroken isolates the node and hands it to the
+// health checker, which revives it). Lives in tnet because Socket embeds
+// one; it has no upper-layer dependencies.
+#pragma once
+
+#include <atomic>
+#include <cstdint>
+
+namespace tpurpc {
+
+// One EMA window: error rate estimated as an exponential moving average
+// over the last ~window_size calls; trips after enough samples.
+class EmaErrorRate {
+public:
+    void Init(int window_size, double max_error_percent) {
+        window_size_ = window_size < 1 ? 1 : window_size;
+        threshold_ = max_error_percent;
+        Reset();
+    }
+    void Reset() {
+        rate_fp_.store(0, std::memory_order_relaxed);
+        samples_.store(0, std::memory_order_relaxed);
+    }
+    // Returns false when the window trips (error rate above threshold).
+    bool OnCallEnd(bool error) {
+        // rate' = rate * (N-1)/N + (error ? 100% : 0) / N in 2^20
+        // fixed-point. Decay rounds UP so small rates still decay (a
+        // truncating cur/N is 0 below N and the rate would only ratchet
+        // upward). Lock-free CAS; races only blur the EMA.
+        int64_t cur = rate_fp_.load(std::memory_order_relaxed);
+        int64_t next;
+        do {
+            const int64_t decay = (cur + window_size_ - 1) / window_size_;
+            next = cur - decay + (error ? kOne100 / window_size_ : 0);
+        } while (!rate_fp_.compare_exchange_weak(
+            cur, next, std::memory_order_relaxed));
+        const int64_t n = samples_.fetch_add(1, std::memory_order_relaxed) + 1;
+        // Demand a quarter window of evidence before tripping.
+        return !(n >= window_size_ / 4 &&
+                 (double)next / kOne > threshold_);
+    }
+    double error_percent() const {
+        return (double)rate_fp_.load(std::memory_order_relaxed) / kOne;
+    }
+
+private:
+    static constexpr int64_t kOne = 1 << 20;       // fixed-point 1 percent
+    static constexpr int64_t kOne100 = kOne * 100;  // 100 percent
+    int window_size_ = 100;
+    double threshold_ = 100.0;
+    std::atomic<int64_t> rate_fp_{0};
+    std::atomic<int64_t> samples_{0};
+};
+
+class CircuitBreaker {
+public:
+    CircuitBreaker() { Reset(); }
+
+    // Re-arm (socket creation and revive). Keeps the isolation history so
+    // repeated isolation can back off harder (reference
+    // circuit_breaker.cpp _isolation_duration_ms doubling).
+    void Reset();
+
+    // Record one finished call. Returns false when the breaker trips:
+    // the caller should isolate the connection (SetFailed -> health
+    // check). error_code 0 = success.
+    bool OnCallEnd(int error_code, int64_t latency_us);
+
+    void MarkAsBroken() {
+        broken_.store(true, std::memory_order_release);
+        isolated_times_.fetch_add(1, std::memory_order_relaxed);
+    }
+    bool IsBroken() const { return broken_.load(std::memory_order_acquire); }
+    int isolated_times() const {
+        return isolated_times_.load(std::memory_order_relaxed);
+    }
+    double short_window_error_percent() const {
+        return short_.error_percent();
+    }
+    double long_window_error_percent() const { return long_.error_percent(); }
+
+private:
+    EmaErrorRate short_;  // bursty failures (small window, high threshold)
+    EmaErrorRate long_;   // chronic failures (large window, low threshold)
+    std::atomic<bool> broken_{false};
+    std::atomic<int> isolated_times_{0};
+};
+
+}  // namespace tpurpc

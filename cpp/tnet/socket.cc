@@ -58,6 +58,7 @@ int Socket::Create(const SocketOptions& options, SocketId* id) {
     s->preferred_protocol_index = -1;
     s->health_check_interval_ms_ = options.health_check_interval_ms;
     s->hc_stop_.store(false, std::memory_order_relaxed);
+    s->circuit_breaker_.Reset();
     if (s->epollout_butex_ == nullptr) s->epollout_butex_ = butex_create();
     if (s->connect_butex_ == nullptr) s->connect_butex_ = butex_create();
 
@@ -155,6 +156,7 @@ int Socket::ReviveAfterHealthCheck() {
     error_code_.store(0, std::memory_order_relaxed);
     connecting_.store(false, std::memory_order_relaxed);
     local_side_ = EndPoint();
+    circuit_breaker_.Reset();  // fresh windows for the revived server
     const int rc = Revive();
     if (rc == 0) {
         LOG(INFO) << "Revived socket id=" << id()

@@ -23,6 +23,7 @@
 #include "tbase/endpoint.h"
 #include "tbase/iobuf.h"
 #include "tbase/versioned_ref.h"
+#include "tnet/circuit_breaker.h"
 #include "tfiber/butex.h"
 #include "tfiber/fiber.h"
 
@@ -95,6 +96,9 @@ public:
         hc_stop_.store(true, std::memory_order_release);
     }
     int health_check_interval_ms() const { return health_check_interval_ms_; }
+    // Per-connection breaker (reference keeps one per Socket too); fed by
+    // the client stack after each call, isolation = SetFailed + revive.
+    CircuitBreaker& circuit_breaker() { return circuit_breaker_; }
 
     // ---- per-connection parsing state (owned by InputMessenger) ----
     IOPortal read_buf;
@@ -168,6 +172,7 @@ private:
     void* connect_butex_ = nullptr;
     int health_check_interval_ms_ = 0;
     std::atomic<bool> hc_stop_{false};
+    CircuitBreaker circuit_breaker_;
 };
 
 }  // namespace tpurpc

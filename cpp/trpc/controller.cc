@@ -122,14 +122,28 @@ int Controller::HandleError(CallId id, int error) {
 void Controller::FeedbackToLB(int error) {
     if (channel_ == nullptr || current_server_id_ == INVALID_VREF_ID) return;
     LoadBalancerWithNaming* lb = channel_->lb();
+    const int64_t try_latency_us = monotonic_time_us() - try_start_us_;
     if (lb != nullptr) {
         LoadBalancer::CallInfo info;
         info.server_id = current_server_id_;
         // Per-try latency: charging earlier failed tries' time to the
         // final server would invert locality-aware ranking.
-        info.latency_us = monotonic_time_us() - try_start_us_;
+        info.latency_us = try_latency_us;
         info.error_code = error;
         lb->Feedback(info);
+        // Circuit breaker: chronic/bursty error rates isolate the server
+        // (SetFailed -> health check revives it later with fresh windows;
+        // reference Call::OnComplete -> Socket::FeedbackCircuitBreaker).
+        SocketUniquePtr s = SocketUniquePtr::FromId(current_server_id_);
+        if (s && !s->circuit_breaker().OnCallEnd(error, try_latency_us)) {
+            LOG(WARNING) << "circuit breaker isolating "
+                         << endpoint2str(s->remote_side()) << " (short "
+                         << s->circuit_breaker().short_window_error_percent()
+                         << "%, long "
+                         << s->circuit_breaker().long_window_error_percent()
+                         << "%)";
+            s->SetFailedWithError(EHOSTDOWN);
+        }
     }
     current_server_id_ = INVALID_VREF_ID;
 }

@@ -1,0 +1,169 @@
+#include "tbase/cpu_profiler.h"
+
+#include <signal.h>
+#include <stdio.h>
+#include <string.h>
+#include <sys/time.h>
+#include <sys/uio.h>
+#include <ucontext.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <cstdint>
+
+namespace tpurpc {
+
+namespace {
+
+constexpr size_t kMaxSamples = 1 << 20;  // 1M samples * 4 slots
+constexpr int kDepth = 4;                // pc + 3 caller frames
+
+// Preallocated sample buffer: kDepth slots per sample, 0-terminated rows.
+uintptr_t* g_samples = nullptr;
+std::atomic<size_t> g_nsamples{0};
+std::atomic<bool> g_running{false};
+struct sigaction g_old_action;
+
+#if defined(__x86_64__)
+inline uintptr_t context_pc(ucontext_t* uc) {
+    return (uintptr_t)uc->uc_mcontext.gregs[REG_RIP];
+}
+inline uintptr_t context_fp(ucontext_t* uc) {
+    return (uintptr_t)uc->uc_mcontext.gregs[REG_RBP];
+}
+#elif defined(__aarch64__)
+inline uintptr_t context_pc(ucontext_t* uc) {
+    return (uintptr_t)uc->uc_mcontext.pc;
+}
+inline uintptr_t context_fp(ucontext_t* uc) {
+    return (uintptr_t)uc->uc_mcontext.regs[29];
+}
+#else
+inline uintptr_t context_pc(ucontext_t*) { return 0; }
+inline uintptr_t context_fp(ucontext_t*) { return 0; }
+#endif
+
+// Reads [fp, fp+16) safely via process_vm_readv (a syscall — async-
+// signal-safe, and it simply fails on unmapped addresses instead of
+// faulting; the build may omit frame pointers so RBP can hold anything).
+bool safe_read_frame(uintptr_t fp, uintptr_t out[2]) {
+    iovec local{out, 2 * sizeof(uintptr_t)};
+    iovec remote{(void*)fp, 2 * sizeof(uintptr_t)};
+    return process_vm_readv(getpid(), &local, 1, &remote, 1, 0) ==
+           (ssize_t)(2 * sizeof(uintptr_t));
+}
+
+// Frame-pointer walk with safe reads; fibers run on mmap'd stacks so we
+// only trust monotonically-increasing frame pointers within a 1MB span.
+void prof_handler(int, siginfo_t*, void* ucv) {
+    if (!g_running.load(std::memory_order_relaxed)) return;
+    const size_t i = g_nsamples.fetch_add(1, std::memory_order_relaxed);
+    if (i >= kMaxSamples) {
+        g_nsamples.store(kMaxSamples, std::memory_order_relaxed);
+        return;
+    }
+    ucontext_t* uc = (ucontext_t*)ucv;
+    uintptr_t* row = g_samples + i * kDepth;
+    row[0] = context_pc(uc);
+    uintptr_t fp = context_fp(uc);
+    const uintptr_t lo = fp;
+    const uintptr_t hi = fp + (1u << 20);
+    int d = 1;
+    while (d < kDepth && fp >= lo && fp < hi && (fp & 7) == 0) {
+        uintptr_t frame[2];
+        if (!safe_read_frame(fp, frame)) break;
+        const uintptr_t next_fp = frame[0];
+        const uintptr_t ret = frame[1];
+        if (ret < 4096) break;
+        row[d++] = ret;
+        if (next_fp <= fp) break;
+        fp = next_fp;
+    }
+    while (d < kDepth) row[d++] = 0;
+}
+
+int write_profile(FILE* f) {
+    const size_t n = g_nsamples.load(std::memory_order_acquire);
+    for (size_t i = 0; i < n; ++i) {
+        uintptr_t* row = g_samples + i * kDepth;
+        fprintf(f, "%lx", (unsigned long)row[0]);
+        for (int d = 1; d < kDepth && row[d] != 0; ++d) {
+            fprintf(f, " %lx", (unsigned long)row[d]);
+        }
+        fputc('\n', f);
+    }
+    fprintf(f, "--- maps ---\n");
+    FILE* maps = fopen("/proc/self/maps", "r");
+    if (maps != nullptr) {
+        char buf[4096];
+        size_t nr;
+        while ((nr = fread(buf, 1, sizeof(buf), maps)) > 0) {
+            fwrite(buf, 1, nr, f);
+        }
+        fclose(maps);
+    }
+    return (int)n;
+}
+
+}  // namespace
+
+int StartCpuProfiler(int hz) {
+    bool expected = false;
+    if (!g_running.compare_exchange_strong(expected, true)) return -1;
+    if (g_samples == nullptr) {
+        g_samples = new uintptr_t[kMaxSamples * kDepth];
+    }
+    g_nsamples.store(0, std::memory_order_relaxed);
+    struct sigaction sa;
+    memset(&sa, 0, sizeof(sa));
+    sa.sa_sigaction = prof_handler;
+    sa.sa_flags = SA_SIGINFO | SA_RESTART;
+    sigemptyset(&sa.sa_mask);
+    sigaction(SIGPROF, &sa, &g_old_action);
+    itimerval tv;
+    tv.it_interval.tv_sec = 0;
+    tv.it_interval.tv_usec = 1000000 / (hz > 0 ? hz : 997);
+    tv.it_value = tv.it_interval;
+    setitimer(ITIMER_PROF, &tv, nullptr);
+    return 0;
+}
+
+bool CpuProfilerRunning() {
+    return g_running.load(std::memory_order_acquire);
+}
+
+static void stop_sampling() {
+    itimerval tv;
+    memset(&tv, 0, sizeof(tv));
+    setitimer(ITIMER_PROF, &tv, nullptr);
+    g_running.store(false, std::memory_order_release);
+    // Keep our (no-op when stopped) handler installed: a tick generated
+    // just before the disarm may still be pending, and restoring SIG_DFL
+    // here would let that late SIGPROF terminate the process.
+}
+
+int StopCpuProfiler(const std::string& path) {
+    if (!g_running.load(std::memory_order_acquire)) return -1;
+    stop_sampling();
+    FILE* f = fopen(path.c_str(), "w");
+    if (f == nullptr) return -1;
+    const int n = write_profile(f);
+    fclose(f);
+    return n;
+}
+
+std::string StopCpuProfilerToString() {
+    if (!g_running.load(std::memory_order_acquire)) return std::string();
+    stop_sampling();
+    char* buf = nullptr;
+    size_t len = 0;
+    FILE* f = open_memstream(&buf, &len);
+    if (f == nullptr) return std::string();
+    write_profile(f);
+    fclose(f);
+    std::string out(buf, len);
+    free(buf);
+    return out;
+}
+
+}  // namespace tpurpc

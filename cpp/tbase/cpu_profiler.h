@@ -1,0 +1,33 @@
+// SIGPROF sampling CPU profiler — the engine behind the /hotspots/cpu
+// builtin (reference: src/brpc/builtin/hotspots_service.cpp drives
+// gperftools ProfilerStart; here we own the sampler so the framework has
+// no external profiler dependency).
+//
+// Samples the interrupted PC (and a short frame-pointer backtrace) on
+// every ITIMER_PROF tick (all running threads, kernel-selected) into a
+// preallocated lock-free buffer. Dump format is text:
+//   one "pc fp1 fp2 ..." hex line per sample, then "--- maps ---" and a
+//   copy of /proc/self/maps so offline tooling (tools/symbolize_prof.py)
+//   can map addresses to functions with addr2line.
+#pragma once
+
+#include <cstddef>
+#include <string>
+
+namespace tpurpc {
+
+// Starts sampling at `hz` (default 997 to avoid lockstep with timers).
+// Returns 0, or -1 if already running.
+int StartCpuProfiler(int hz = 997);
+
+// Stops sampling and writes samples + memory map to `path`.
+// Returns number of samples written, or -1 on error.
+int StopCpuProfiler(const std::string& path);
+
+bool CpuProfilerRunning();
+
+// Stops sampling and returns the profile as a string (same format as the
+// file dump) — used by the /hotspots builtin service.
+std::string StopCpuProfilerToString();
+
+}  // namespace tpurpc

@@ -7,7 +7,16 @@
 #include <algorithm>
 #include <new>
 
+#include "tbase/flags.h"
 #include "tbase/logging.h"
+
+// 512 x 8KB = 4MB per thread: enough that a windowed stream of 1MB
+// messages (128 blocks each) recycles through the cache instead of
+// malloc/free + arena-trim churn (profiled at ~20% of echo_bench CPU
+// with a 16-block cache). Tune down on memory-constrained many-core
+// hosts (cost scales with thread count).
+DEFINE_int32(iobuf_tls_cache_blocks, 512,
+             "max free 8KB blocks cached per thread");
 
 namespace tpurpc {
 
@@ -32,7 +41,6 @@ struct TLSData {
     ~TLSData();
 };
 
-constexpr size_t kMaxCachedBlocks = 16;
 
 thread_local TLSData tls_data;
 
@@ -68,8 +76,9 @@ void IOBuf::Block::dec_ref() {
     if (nshared.fetch_sub(1, std::memory_order_acq_rel) == 1) {
         const size_t total = cap + offsetof(Block, data);
         // Cache only blocks from the current allocator pair.
+        const int32_t cache_cap = FLAGS_iobuf_tls_cache_blocks.get();
         if (total == DEFAULT_BLOCK_SIZE && dealloc == blockmem_deallocate &&
-            tls_data.num_cached < kMaxCachedBlocks) {
+            cache_cap > 0 && tls_data.num_cached < (size_t)cache_cap) {
             portal_next = tls_data.cache_head;
             tls_data.cache_head = this;
             ++tls_data.num_cached;
@@ -518,8 +527,9 @@ void IOPortal::return_cached_blocks() {
 
 ssize_t IOPortal::append_from_file_descriptor(int fd, size_t max_count) {
     // Assemble an iovec over [tail of current block] + fresh blocks.
-    iovec vec[8];
-    Block* blocks[8];
+    constexpr size_t kReadVecs = 64;
+    iovec vec[kReadVecs];
+    Block* blocks[kReadVecs];
     size_t nvec = 0;
     size_t space = 0;
     if (block_ != nullptr && !block_->full()) {
@@ -529,7 +539,7 @@ ssize_t IOPortal::append_from_file_descriptor(int fd, size_t max_count) {
         space += block_->left_space();
         ++nvec;
     }
-    while (space < max_count && nvec < 8) {
+    while (space < max_count && nvec < kReadVecs) {
         Block* b = create_block();
         if (b == nullptr) break;
         blocks[nvec] = b;

@@ -467,6 +467,16 @@ TEST(CircuitBreakerIntegration, IsolatesFailingServer) {
     // application level: the breaker isolates the failing one so traffic
     // converges on the healthy server (reference behavior:
     // CircuitBreaker::MarkAsBroken -> health check).
+    //
+    // Health-check revive is pinned far out: on a slow run a 1s revive of
+    // the (TCP-alive) flaky server would reset the breaker mid-test and
+    // break the call-count assertions.
+    const int32_t old_hc = FLAGS_ns_health_check_interval_ms.get();
+    FLAGS_ns_health_check_interval_ms.set(600 * 1000);
+    struct HcRestore {
+        int32_t old;
+        ~HcRestore() { FLAGS_ns_health_check_interval_ms.set(old); }
+    } restore{old_hc};
     Server healthy_srv, flaky_srv;
     EchoServiceImpl healthy;
     FlakyEchoServiceImpl flaky;

@@ -2,7 +2,9 @@
 
 #include <unistd.h>
 
+#include <algorithm>
 #include <cerrno>
+#include <thread>
 
 #include "tbase/fast_rand.h"
 #include "tbase/time.h"
@@ -12,7 +14,10 @@
 #include "tfiber/butex.h"
 #include "tfiber/timer_thread.h"
 
-DEFINE_int32(fiber_worker_count, 4, "number of fiber worker pthreads");
+// 0 = auto: hardware_concurrency + 1, min 4 (the reference defaults to
+// cores+1 via FLAGS_bthread_concurrency; a fixed count would cap
+// throughput on many-core TPU-VM hosts).
+DEFINE_int32(fiber_worker_count, 0, "number of fiber worker pthreads");
 
 namespace tpurpc {
 
@@ -145,7 +150,10 @@ void TaskControl::ensure_started() {
     std::lock_guard<std::mutex> g(start_mu_);
     if (started_.load(std::memory_order_relaxed)) return;
     concurrency_ = FLAGS_fiber_worker_count.get();
-    if (concurrency_ < 1) concurrency_ = 1;
+    if (concurrency_ <= 0) {
+        const unsigned hc = std::thread::hardware_concurrency();
+        concurrency_ = (int)std::max(4u, hc + 1);
+    }
     groups_.reserve(concurrency_);
     for (int i = 0; i < concurrency_; ++i) {
         groups_.push_back(new TaskGroup(this, i));

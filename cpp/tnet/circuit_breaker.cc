@@ -1,5 +1,8 @@
 #include "tnet/circuit_breaker.h"
 
+#include <cerrno>
+
+#include "tbase/errno.h"
 #include "tbase/flags.h"
 
 // Defaults shaped like the reference's (src/brpc/circuit_breaker.cpp
@@ -14,6 +17,10 @@ DEFINE_int32(circuit_breaker_long_window_size, 1000,
              "EMA window (calls) for chronic-failure detection");
 DEFINE_double(circuit_breaker_long_window_error_percent, 5.0,
               "Error percent tripping the long window");
+DEFINE_int32(circuit_breaker_min_isolation_duration_ms, 100,
+             "Isolation duration after the first trip");
+DEFINE_int32(circuit_breaker_max_isolation_duration_ms, 30000,
+             "Isolation duration cap (doubles per repeated trip)");
 
 namespace tpurpc {
 
@@ -25,15 +32,35 @@ void CircuitBreaker::Reset() {
     broken_.store(false, std::memory_order_release);
 }
 
+// Client-local conditions must not count against the server: a cancelled
+// RPC or local write back-pressure says nothing about remote health, and
+// feeding them in would isolate healthy servers (reference feeds only
+// server-attributable codes into the breaker).
+static bool ClientLocalError(int error_code) {
+    return error_code == ECANCELED || error_code == TERR_OVERCROWDED ||
+           error_code == TERR_BACKUP_REQUEST;
+}
+
 bool CircuitBreaker::OnCallEnd(int error_code, int64_t latency_us) {
     (void)latency_us;  // reserved: latency-weighted error cost
     if (!FLAGS_enable_circuit_breaker.get()) return true;
     if (IsBroken()) return false;
+    if (ClientLocalError(error_code)) return true;
     const bool error = error_code != 0;
     bool ok = short_.OnCallEnd(error);
     ok = long_.OnCallEnd(error) && ok;
     if (!ok) MarkAsBroken();
     return ok;
+}
+
+int CircuitBreaker::isolation_duration_ms() const {
+    const int times = isolated_times_.load(std::memory_order_relaxed);
+    if (times <= 0) return 0;
+    const int64_t base = FLAGS_circuit_breaker_min_isolation_duration_ms.get();
+    const int64_t cap = FLAGS_circuit_breaker_max_isolation_duration_ms.get();
+    const int shift = times - 1 > 16 ? 16 : times - 1;
+    const int64_t d = base << shift;
+    return (int)(d > cap ? cap : d);
 }
 
 }  // namespace tpurpc

@@ -61,10 +61,17 @@ class CircuitBreaker {
 public:
     CircuitBreaker() { Reset(); }
 
-    // Re-arm (socket creation and revive). Keeps the isolation history so
+    // Re-arm after health-check revive. Keeps the isolation history so
     // repeated isolation can back off harder (reference
     // circuit_breaker.cpp _isolation_duration_ms doubling).
     void Reset();
+
+    // Full reset for a brand-new connection (socket slot reuse must not
+    // inherit the previous tenant's isolation history).
+    void ResetAll() {
+        Reset();
+        isolated_times_.store(0, std::memory_order_relaxed);
+    }
 
     // Record one finished call. Returns false when the breaker trips:
     // the caller should isolate the connection (SetFailed -> health
@@ -72,9 +79,19 @@ public:
     bool OnCallEnd(int error_code, int64_t latency_us);
 
     void MarkAsBroken() {
-        broken_.store(true, std::memory_order_release);
-        isolated_times_.fetch_add(1, std::memory_order_relaxed);
+        // exchange: concurrent trippers in the same episode must count it
+        // once or the backoff doubling overshoots.
+        if (!broken_.exchange(true, std::memory_order_acq_rel)) {
+            isolated_times_.fetch_add(1, std::memory_order_relaxed);
+        }
     }
+
+    // How long the node should stay isolated before the health checker may
+    // revive it: min_isolation << (isolated_times-1), capped at
+    // max_isolation (reference circuit_breaker.cpp _isolation_duration_ms
+    // doubling). 0 when never isolated.
+    int isolation_duration_ms() const;
+
     bool IsBroken() const { return broken_.load(std::memory_order_acquire); }
     int isolated_times() const {
         return isolated_times_.load(std::memory_order_relaxed);

@@ -79,28 +79,39 @@ void InputMessenger::OnNewMessages(Socket* s) {
                 return;
             }
         }
-        // Cut as many whole messages as the buffer holds.
+        // Cut as many whole messages as the buffer holds. A message is
+        // processed inline when it is the last one cut from this burst
+        // (reference input_messenger.cpp:194-234 QueueMessage keeps the
+        // LAST message in-place for cache locality); earlier messages get
+        // their own processing fiber so a slow handler can't block parsing.
+        InputMessageBase* pending_msg = nullptr;
+        const Protocol* pending_proto = nullptr;
         while (!s->read_buf.empty()) {
             ParseResult r = CutInputMessage(s, m->protocols_, read_eof);
             if (r.error == ParseError::OK) {
                 r.msg->socket_id = s->id();
                 const Protocol* p = GetProtocol(r.msg->protocol_index);
-                // Hand off to a processing fiber (one per message; the
-                // reference keeps the last inline — we keep the handoff
-                // uniform for now and revisit with profiles).
-                auto* pa = new ProcessArgs{r.msg, p};
-                fiber_t tid;
-                if (fiber_start_background(&tid, nullptr, process_msg_thunk,
-                                           pa) != 0) {
-                    p->process(r.msg);
-                    delete pa;
+                if (pending_msg != nullptr) {
+                    auto* pa = new ProcessArgs{pending_msg, pending_proto};
+                    fiber_t tid;
+                    if (fiber_start_background(&tid, nullptr,
+                                               process_msg_thunk, pa) != 0) {
+                        pending_proto->process(pending_msg);
+                        delete pa;
+                    }
                 }
+                pending_msg = r.msg;
+                pending_proto = p;
                 continue;
             }
             if (r.error == ParseError::NOT_ENOUGH_DATA) break;
             // TRY_OTHERS with data left or hard ERROR: broken stream.
             s->SetFailedWithError(TERR_REQUEST);
+            if (pending_msg != nullptr) pending_proto->process(pending_msg);
             return;
+        }
+        if (pending_msg != nullptr) {
+            pending_proto->process(pending_msg);
         }
         if (read_eof) {
             s->SetFailedWithError(TERR_EOF);

@@ -18,6 +18,13 @@
 
 DEFINE_int64(socket_max_unwritten_bytes, 64 * 1024 * 1024,
              "write backlog limit before EOVERCROWDED back-pressure");
+// -1 keeps kernel autotuning (the right default: pinning a size disables
+// both shrinking of idle connections and growth on high-BDP links).
+// Benchmarks with windowed large messages set these explicitly.
+DEFINE_int32(socket_send_buffer_size, -1,
+             "SO_SNDBUF per connection; -1 = kernel autotune");
+DEFINE_int32(socket_recv_buffer_size, -1,
+             "SO_RCVBUF per connection; -1 = kernel autotune");
 
 namespace tpurpc {
 
@@ -25,6 +32,13 @@ static int make_non_blocking(int fd) {
     const int flags = fcntl(fd, F_GETFL, 0);
     if (flags < 0) return -1;
     return fcntl(fd, F_SETFL, flags | O_NONBLOCK);
+}
+
+static void ApplySocketBufferSizes(int fd) {
+    const int snd = FLAGS_socket_send_buffer_size.get();
+    if (snd > 0) setsockopt(fd, SOL_SOCKET, SO_SNDBUF, &snd, sizeof(snd));
+    const int rcv = FLAGS_socket_recv_buffer_size.get();
+    if (rcv > 0) setsockopt(fd, SOL_SOCKET, SO_RCVBUF, &rcv, sizeof(rcv));
 }
 
 // ---------------- creation / recycle ----------------
@@ -58,7 +72,7 @@ int Socket::Create(const SocketOptions& options, SocketId* id) {
     s->preferred_protocol_index = -1;
     s->health_check_interval_ms_ = options.health_check_interval_ms;
     s->hc_stop_.store(false, std::memory_order_relaxed);
-    s->circuit_breaker_.Reset();
+    s->circuit_breaker_.ResetAll();
     if (s->epollout_butex_ == nullptr) s->epollout_butex_ = butex_create();
     if (s->connect_butex_ == nullptr) s->connect_butex_ = butex_create();
 
@@ -66,6 +80,7 @@ int Socket::Create(const SocketOptions& options, SocketId* id) {
         make_non_blocking(options.fd);
         int one = 1;
         setsockopt(options.fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+        ApplySocketBufferSizes(options.fd);
         if (EventDispatcher::GetGlobalDispatcher(options.fd)
                 .AddConsumer(*id, options.fd) != 0) {
             PLOG(ERROR) << "AddConsumer failed for fd=" << options.fd;
@@ -128,8 +143,16 @@ static int ProbeConnect(const EndPoint& remote, int timeout_ms) {
 
 void Socket::HealthCheckLoop() {
     const int64_t interval_us = (int64_t)health_check_interval_ms_ * 1000;
+    // Breaker-tripped sockets stay isolated for a duration that doubles
+    // per repeated trip; a TCP-alive-but-RPC-failing server would
+    // otherwise flap isolate->revive every interval, eating ~a window of
+    // failed user calls per cycle.
+    const int64_t iso_us =
+        (int64_t)circuit_breaker_.isolation_duration_ms() * 1000;
+    bool first = true;
     while (!hc_stop_.load(std::memory_order_acquire)) {
-        fiber_usleep(interval_us);
+        fiber_usleep(first && iso_us > interval_us ? iso_us : interval_us);
+        first = false;
         if (hc_stop_.load(std::memory_order_acquire)) break;
         // Only probe/revive once every other ref is gone: then no KeepWrite
         // or event fiber can race the connection-state reset below.
@@ -452,6 +475,7 @@ int Socket::ConnectIfNot() {
     }
     int one = 1;
     setsockopt(sock, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    ApplySocketBufferSizes(sock);
     sockaddr_in addr;
     endpoint2sockaddr(remote_side_, &addr);
     int rc = ::connect(sock, (sockaddr*)&addr, sizeof(addr));

@@ -15,6 +15,8 @@
 #include <string>
 
 #include "bench_echo.pb.h"
+#include "tbase/cpu_profiler.h"
+#include "tbase/flags.h"
 #include "tbase/time.h"
 #include "tfiber/fiber_sync.h"
 #include "trpc/channel.h"
@@ -23,6 +25,9 @@
 #include "tvar/latency_recorder.h"
 
 using namespace tpurpc;
+
+DECLARE_int32(socket_send_buffer_size);
+DECLARE_int32(socket_recv_buffer_size);
 
 namespace {
 
@@ -69,7 +74,11 @@ void OnEchoDone(CallCtx* ctx) {
 double run_round(benchpb::EchoService_Stub& stub, size_t attachment_bytes,
                  int iters, int window, LatencyRecorder* lat,
                  std::atomic<int64_t>* bytes) {
-    std::string filler(attachment_bytes, 'e');
+    // Pre-built attachment appended by reference (zero-copy), matching the
+    // reference drivers (example/multi_threaded_echo_c++ appends a global
+    // butil::IOBuf g_attachment).
+    IOBuf filler;
+    filler.append(std::string(attachment_bytes, 'e'));
     Timer t;
     t.start();
     int sent = 0;
@@ -101,9 +110,17 @@ double run_round(benchpb::EchoService_Stub& stub, size_t attachment_bytes,
 
 int main(int argc, char** argv) {
     bool json = false;
+    const char* prof_path = nullptr;
     for (int i = 1; i < argc; ++i) {
         if (strcmp(argv[i], "--json") == 0) json = true;
+        if (strcmp(argv[i], "--prof") == 0 && i + 1 < argc) {
+            prof_path = argv[++i];
+        }
     }
+    // Windowed 1MB messages benefit from fixed large socket buffers on
+    // loopback; production connections keep kernel autotuning (-1).
+    FLAGS_socket_send_buffer_size.set(1 << 20);
+    FLAGS_socket_recv_buffer_size.set(1 << 20);
     Server server;
     EchoServiceImpl service;
     if (server.AddService(&service) != 0) return 1;
@@ -124,6 +141,7 @@ int main(int argc, char** argv) {
 
     // Warmup.
     run_round(stub, 4096, 500, 32, nullptr, nullptr);
+    if (prof_path != nullptr) StartCpuProfiler();
 
     // 4KB round.
     const int kSmallIters = 20000;
@@ -141,6 +159,10 @@ int main(int argc, char** argv) {
         run_round(stub, 1 << 20, kBigIters, 4, nullptr, &bytes);
     if (big_secs < 0) return 1;
     const double mbps = (double)bytes.load() / (1024.0 * 1024.0) / big_secs;
+    if (prof_path != nullptr) {
+        const int n = StopCpuProfiler(prof_path);
+        fprintf(stderr, "wrote %d samples to %s\n", n, prof_path);
+    }
 
     if (json) {
         printf("{\"mbps\": %.1f, \"qps_4k\": %.0f, \"p50_us_4k\": %lld, "

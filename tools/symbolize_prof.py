@@ -1,0 +1,117 @@
+#!/usr/bin/env python3
+"""Symbolize a tpurpc cpu_profiler dump (see cpp/tbase/cpu_profiler.h).
+
+Usage: symbolize_prof.py PROFILE [--tree]
+
+Prints a flat profile (sample count per function, descending). With
+--tree, also prints the top caller->callee edges from the captured
+frame-pointer backtraces.
+"""
+import bisect
+import subprocess
+import sys
+from collections import Counter
+from pathlib import Path
+
+
+def load(path):
+    samples = []
+    maps = []
+    in_maps = False
+    for line in Path(path).read_text().splitlines():
+        if line.startswith("--- maps ---"):
+            in_maps = True
+            continue
+        if in_maps:
+            maps.append(line)
+        elif line.strip():
+            samples.append([int(x, 16) for x in line.split()])
+    return samples, maps
+
+
+def parse_maps(maps):
+    """Returns sorted list of (start, end, file_offset, path) for x regions."""
+    regions = []
+    for line in maps:
+        parts = line.split()
+        if len(parts) < 6 or "x" not in parts[1]:
+            continue
+        start, end = (int(x, 16) for x in parts[0].split("-"))
+        off = int(parts[2], 16)
+        regions.append((start, end, off, parts[5]))
+    regions.sort()
+    return regions
+
+
+class Symbolizer:
+    def __init__(self, regions):
+        self.regions = regions
+        self.starts = [r[0] for r in regions]
+        self.cache = {}
+
+    def region_of(self, addr):
+        i = bisect.bisect_right(self.starts, addr) - 1
+        if i >= 0:
+            start, end, off, path = self.regions[i]
+            if addr < end:
+                return start, off, path
+        return None
+
+    def resolve_batch(self, addrs):
+        by_mod = {}
+        for a in addrs:
+            r = self.region_of(a)
+            if r is None:
+                self.cache[a] = "??"
+                continue
+            start, off, path = r
+            by_mod.setdefault((start, off, path), []).append(a)
+        for (start, off, path), mod_addrs in by_mod.items():
+            file_addrs = [hex(a - start + off) for a in mod_addrs]
+            try:
+                out = subprocess.run(
+                    ["addr2line", "-f", "-C", "-e", path] + file_addrs,
+                    capture_output=True, text=True, timeout=120,
+                ).stdout.splitlines()
+            except Exception:
+                out = []
+            funcs = out[0::2]
+            for a, fn in zip(mod_addrs, funcs):
+                name = fn if fn and fn != "??" else Path(path).name + "+?"
+                self.cache[a] = name
+            for a in mod_addrs:
+                self.cache.setdefault(a, Path(path).name + "+?")
+
+    def name(self, addr):
+        return self.cache.get(addr, "??")
+
+
+def main():
+    prof = sys.argv[1]
+    tree = "--tree" in sys.argv
+    samples, maps = load(prof)
+    if not samples:
+        print("no samples")
+        return
+    sym = Symbolizer(parse_maps(maps))
+    all_addrs = {a for row in samples for a in row}
+    sym.resolve_batch(sorted(all_addrs))
+
+    flat = Counter(sym.name(row[0]) for row in samples)
+    total = len(samples)
+    print(f"== flat profile ({total} samples) ==")
+    for name, n in flat.most_common(40):
+        print(f"{n:8d} {100.0 * n / total:5.1f}%  {name}")
+
+    if tree:
+        edges = Counter()
+        for row in samples:
+            for i in range(len(row) - 1):
+                edges[(sym.name(row[i + 1]), sym.name(row[i]))] += 1
+        print("\n== top edges (caller -> callee) ==")
+        for (caller, callee), n in edges.most_common(30):
+            print(f"{n:8d}  {caller} -> {callee}")
+
+
+if __name__ == "__main__":
+    main()

@@ -110,7 +110,13 @@ size_t IOBuf::tls_cached_blocks() { return tls_data.num_cached; }
 // fresh one when absent or full.
 static IOBuf::Block* share_tls_block() {
     IOBuf::Block* b = tls_data.append_block;
-    if (b != nullptr && !b->full()) return b;
+    // The allocator-pair check keeps the registered-memory guarantee: once
+    // a transport installs its pool, a pre-install malloc'd append block
+    // must not keep receiving payload bytes.
+    if (b != nullptr && !b->full() &&
+        b->dealloc == IOBuf::blockmem_deallocate) {
+        return b;
+    }
     if (b != nullptr) b->dec_ref();
     b = IOBuf::create_block();
     tls_data.append_block = b;
@@ -170,6 +176,25 @@ void IOBuf::push_back_ref_(const BlockRef& r) {
     big_.refs[(big_.start + big_.count) % big_.cap] = r;
     ++big_.count;
     nbytes_ += r.length;
+}
+
+bool IOBuf::cut_front_ref(BlockRef* out) {
+    if (nref_() == 0) return false;
+    *out = ref_at(0);
+    nbytes_ -= out->length;
+    // Remove the ref WITHOUT dec_ref: ownership moves to *out.
+    if (is_big_) {
+        big_.start = (big_.start + 1) % big_.cap;
+        --big_.count;
+        if (big_.count == 0) {
+            free(big_.refs);
+            reset_small();
+        }
+    } else {
+        if (small_count_ == 2) small_[0] = small_[1];
+        --small_count_;
+    }
+    return true;
 }
 
 void IOBuf::pop_front_ref_() {

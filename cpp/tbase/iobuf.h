@@ -113,6 +113,11 @@ public:
     size_t backing_block_num() const { return nref_(); }
     // i-th ref's readable span. Valid until the IOBuf is mutated.
     const char* backing_block_data(size_t i, size_t* len) const;
+    // Pop the front BlockRef, transferring its block reference to *out
+    // (the caller now owns one ref and must dec_ref it). How a transport
+    // moves blocks into its send queue without touching refcounts. Returns
+    // false when empty.
+    bool cut_front_ref(BlockRef* out);
 
     // Equality by content (test convenience).
     bool equals(const std::string& s) const;

@@ -1,0 +1,298 @@
+// ICI transport tests: the fake-ICI loopback link plays the role loopback
+// TCP plays in the reference's tests (SURVEY §4: "a fake/loopback ICI
+// endpoint plays the role loopback TCP plays"). Covers the block pool,
+// the queue-pair data path, credit flow control, event suppression, EOF,
+// and a full RPC echo over the link.
+#include <unistd.h>
+
+#include <atomic>
+#include <cstring>
+#include <string>
+
+#include "echo.pb.h"
+#include "tbase/iobuf.h"
+#include "tbase/errno.h"
+#include "tbase/time.h"
+#include "tfiber/fiber.h"
+#include "tfiber/fiber_sync.h"
+#include "tici/block_pool.h"
+#include "tici/ici_link.h"
+#include "tnet/socket.h"
+#include "trpc/channel.h"
+#include "trpc/controller.h"
+#include "trpc/server.h"
+#include "ttest/ttest.h"
+
+using namespace tpurpc;
+
+namespace {
+
+// Pump endpoint `e` into `portal` until `want` bytes arrived (poll-style,
+// for link-level tests that bypass the dispatcher).
+ssize_t pump_until(IciEndpoint* e, IOPortal* portal, size_t want) {
+    ssize_t total = 0;
+    for (int spins = 0; spins < 100000 && (size_t)total < want; ++spins) {
+        const ssize_t nr = e->Pump(portal);
+        if (nr > 0) {
+            total += nr;
+        } else if (nr == 0) {
+            return total;  // EOF
+        }
+    }
+    return total;
+}
+
+}  // namespace
+
+TEST(IciBlockPool, InstallsAndServesRegisteredMemory) {
+    ASSERT_EQ(0, IciBlockPool::Init(4u << 20));
+    ASSERT_TRUE(IciBlockPool::initialized());
+    // New IOBuf blocks now come from registered regions.
+    IOBuf buf;
+    buf.append(std::string(100, 'x'));
+    size_t len = 0;
+    const char* p = buf.backing_block_data(0, &len);
+    EXPECT_TRUE(IciBlockPool::Contains(p));
+    EXPECT_EQ(100u, len);
+    // Odd-size direct allocation round-trips too.
+    void* odd = IciBlockPool::Allocate(123456);
+    ASSERT_TRUE(odd != nullptr);
+    IciBlockPool::Deallocate(odd);
+}
+
+TEST(IciLink, BytesFlowBothWays) {
+    IciLink& link = *IciLink::Create();
+    IOBuf msg;
+    msg.append("hello over ici");
+    IOBuf* pieces[1] = {&msg};
+    ASSERT_EQ((ssize_t)14, link.first()->CutFromIOBufList(pieces, 1));
+    EXPECT_TRUE(msg.empty());
+
+    IOPortal in;
+    ASSERT_EQ((ssize_t)14, pump_until(link.second(), &in, 14));
+    EXPECT_TRUE(in.equals("hello over ici"));
+
+    // Reverse direction.
+    IOBuf rev;
+    rev.append("pong");
+    IOBuf* rp[1] = {&rev};
+    ASSERT_EQ((ssize_t)4, link.second()->CutFromIOBufList(rp, 1));
+    IOPortal rin;
+    ASSERT_EQ((ssize_t)4, pump_until(link.first(), &rin, 4));
+    EXPECT_TRUE(rin.equals("pong"));
+    link.first()->Release();
+    link.second()->Release();
+}
+
+TEST(IciLink, LargeTransferSurvivesWindowRecycling) {
+    // 8MB >> the 256-descriptor window: requires credits to recycle.
+    IciLink& link = *IciLink::Create();
+    const size_t kTotal = 8u << 20;
+    std::string big(kTotal, 0);
+    for (size_t i = 0; i < kTotal; ++i) big[i] = (char)(i * 1315423911u >> 7);
+    IOBuf src;
+    src.append(big);
+
+    std::atomic<bool> done{false};
+    std::string got;
+    got.reserve(kTotal);
+    // Consumer fiber: pump into a portal, drain to string.
+    struct Ctx {
+        IciLink* link;
+        std::string* got;
+        size_t want;
+        std::atomic<bool>* done;
+    } ctx{&link, &got, kTotal, &done};
+    fiber_t consumer;
+    fiber_start_background(
+        &consumer, nullptr,
+        [](void* a) -> void* {
+            Ctx* c = (Ctx*)a;
+            IOPortal in;
+            while (c->got->size() < c->want) {
+                const ssize_t nr = c->link->second()->Pump(&in);
+                if (nr > 0) {
+                    std::string chunk;
+                    in.cutn(&chunk, in.size());
+                    c->got->append(chunk);
+                } else if (nr == 0) {
+                    break;
+                } else {
+                    fiber_usleep(100);
+                }
+            }
+            c->done->store(true);
+            return nullptr;
+        },
+        &ctx);
+
+    // Producer: post with window waits.
+    IOBuf* pieces[1] = {&src};
+    while (!src.empty()) {
+        const ssize_t nw = link.first()->CutFromIOBufList(pieces, 1);
+        if (nw < 0 && errno == EAGAIN) {
+            ASSERT_EQ(0, link.first()->WaitWritable(monotonic_time_us() +
+                                                    2 * 1000 * 1000));
+        } else {
+            ASSERT_GT(nw, 0);
+        }
+    }
+    fiber_join(consumer, nullptr);
+    ASSERT_TRUE(done.load());
+    ASSERT_EQ(kTotal, got.size());
+    EXPECT_EQ(0, memcmp(got.data(), big.data(), kTotal));
+    link.first()->Release();
+    link.second()->Release();
+}
+
+TEST(IciLink, EventSuppressionBatchesDoorbells) {
+    IciLink& link = *IciLink::Create();
+    // Burst of 50 posts with no consumer arm/drain in between: the
+    // doorbell fires once for the burst, not 50 times.
+    for (int i = 0; i < 50; ++i) {
+        IOBuf m;
+        m.append("x");
+        IOBuf* p[1] = {&m};
+        ASSERT_EQ((ssize_t)1, link.first()->CutFromIOBufList(p, 1));
+    }
+    EXPECT_EQ(1u, link.first()->signals_sent());
+    IOPortal in;
+    EXPECT_EQ((ssize_t)50, pump_until(link.second(), &in, 50));
+    link.first()->Release();
+    link.second()->Release();
+}
+
+TEST(IciLink, CloseDeliversEofAfterDrain) {
+    IciLink& link = *IciLink::Create();
+    IOBuf m;
+    m.append("last words");
+    IOBuf* p[1] = {&m};
+    ASSERT_EQ((ssize_t)10, link.first()->CutFromIOBufList(p, 1));
+    link.first()->Close();
+    IOPortal in;
+    // Data still delivered...
+    ASSERT_EQ((ssize_t)10, pump_until(link.second(), &in, 10));
+    EXPECT_TRUE(in.equals("last words"));
+    // ...then EOF.
+    EXPECT_EQ((ssize_t)0, link.second()->Pump(&in));
+    // Writes now fail.
+    IOBuf m2;
+    m2.append("x");
+    IOBuf* p2[1] = {&m2};
+    EXPECT_EQ((ssize_t)-1, link.second()->CutFromIOBufList(p2, 1));
+    link.first()->Release();
+    link.second()->Release();
+}
+
+// ---------------- full RPC over the link ----------------
+
+namespace {
+
+class IciEchoServiceImpl : public test::EchoService {
+public:
+    void Echo(google::protobuf::RpcController* cntl_base,
+              const test::EchoRequest* req, test::EchoResponse* res,
+              google::protobuf::Closure* done) override {
+        Controller* cntl = static_cast<Controller*>(cntl_base);
+        res->set_message(req->message());
+        cntl->response_attachment().append(cntl->request_attachment());
+        done->Run();
+    }
+};
+
+}  // namespace
+
+TEST(IciRpc, EchoOverIciLink) {
+    // Server with no TCP listener: the data plane is the ICI link.
+    Server server;
+    IciEchoServiceImpl service;
+    ASSERT_EQ(0, server.AddService(&service));
+    ASSERT_EQ(0, server.StartNoListen(nullptr));
+
+    IciLink& link = *IciLink::Create();
+    // Server side socket bound to the server's messenger. The sockets own
+    // the endpoints: the link frees itself after both recycle.
+    SocketOptions sopts;
+    sopts.fd = link.second()->event_fd();
+    sopts.transport = link.second();
+    sopts.owns_transport = true;
+    sopts.on_edge_triggered_events = InputMessenger::OnNewMessages;
+    sopts.user = server.messenger();
+    SocketId server_sid;
+    ASSERT_EQ(0, Socket::Create(sopts, &server_sid));
+
+    // Client side socket bound to the client messenger.
+    SocketOptions copts;
+    copts.fd = link.first()->event_fd();
+    copts.transport = link.first();
+    copts.owns_transport = true;
+    copts.on_edge_triggered_events = InputMessenger::OnNewMessages;
+    copts.user = Channel::client_messenger();
+    SocketId client_sid;
+    ASSERT_EQ(0, Socket::Create(copts, &client_sid));
+
+    Channel channel;
+    ChannelOptions chopts;
+    chopts.timeout_ms = 5000;
+    ASSERT_EQ(0, channel.InitWithSocketId(client_sid, &chopts));
+    test::EchoService_Stub stub(&channel);
+
+    // Small sync echo.
+    {
+        Controller cntl;
+        test::EchoRequest req;
+        test::EchoResponse res;
+        req.set_message("ici says hi");
+        stub.Echo(&cntl, &req, &res, nullptr);
+        ASSERT_FALSE(cntl.Failed());
+        EXPECT_EQ("ici says hi", res.message());
+    }
+    // 1MB attachment echo (exercises window recycling through the stack).
+    {
+        Controller cntl;
+        test::EchoRequest req;
+        test::EchoResponse res;
+        req.set_message("big");
+        cntl.request_attachment().append(std::string(1u << 20, 'A'));
+        stub.Echo(&cntl, &req, &res, nullptr);
+        ASSERT_FALSE(cntl.Failed());
+        EXPECT_EQ((size_t)(1u << 20), cntl.response_attachment().size());
+    }
+    // Many pipelined calls.
+    {
+        struct AsyncCall {
+            Controller cntl;
+            test::EchoRequest req;
+            test::EchoResponse res;
+            std::atomic<int>* ok;
+            CountdownEvent* pending;
+            static void Done(AsyncCall* c) {
+                if (!c->cntl.Failed()) c->ok->fetch_add(1);
+                c->pending->signal();
+                delete c;
+            }
+        };
+        std::atomic<int> ok{0};
+        CountdownEvent pending(64);
+        for (int i = 0; i < 64; ++i) {
+            auto* call = new AsyncCall;
+            call->ok = &ok;
+            call->pending = &pending;
+            call->req.set_message("m" + std::to_string(i));
+            stub.Echo(&call->cntl, &call->req, &call->res,
+                      google::protobuf::NewCallback(&AsyncCall::Done, call));
+        }
+        pending.wait();
+        EXPECT_EQ(64, ok.load());
+    }
+
+    // Teardown: failing the client socket closes the link; the server
+    // socket sees EOF and fails too. Join drains server-side fibers that
+    // still touch the Server's method map for stats.
+    SocketUniquePtr cs;
+    ASSERT_EQ(0, Socket::AddressSocket(client_sid, &cs));
+    cs->SetFailedWithError(TERR_CLOSE);
+    cs.reset();
+    server.Stop();
+    server.Join();
+}

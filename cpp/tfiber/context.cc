@@ -11,7 +11,16 @@ namespace {
 void fiber_entry_returned() { abort(); }
 }  // namespace
 
-fcontext_t tf_make_fcontext(void* stack_base, size_t size, void (*fn)(void*)) {
+#ifndef __has_feature
+#define __has_feature(x) 0  // gcc signals ASan via __SANITIZE_ADDRESS__
+#endif
+#if defined(__SANITIZE_ADDRESS__) || __has_feature(address_sanitizer)
+// Writes into a freshly mmap'd fiber stack; ASan misreads it as a stack
+// overflow (the switch annotations live in task_group.cc, not here).
+__attribute__((no_sanitize_address))
+#endif
+fcontext_t
+tf_make_fcontext(void* stack_base, size_t size, void (*fn)(void*)) {
     // Stack grows down. Align the top to 16 bytes.
     uintptr_t top = ((uintptr_t)stack_base + size) & ~(uintptr_t)15;
     // Reserve the saved-register frame (0x40 bytes, layout in context.S)

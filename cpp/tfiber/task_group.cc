@@ -1,5 +1,6 @@
 #include "tfiber/task_group.h"
 
+#include <pthread.h>
 #include <unistd.h>
 
 #include <algorithm>
@@ -32,6 +33,36 @@ bool is_running_on_fiber_worker() {
     return g != nullptr && g->current() != nullptr;
 }
 
+// ---------------- ASan fiber-switch annotations ----------------
+// Without these, ASan keeps using the OLD stack's bounds after a context
+// switch and reports wild stack-buffer-underflow/overflow (the reference
+// carries the same annotations in src/bthread/stack_inl.h).
+// The fake-stack handle of each context must be saved at switch-out and
+// handed back at switch-in (a null save tells ASan the context is DYING
+// and frees its fake frames — only exit_current may pass null).
+#ifndef __has_feature
+#define __has_feature(x) 0  // gcc signals ASan via __SANITIZE_ADDRESS__
+#endif
+#if defined(__SANITIZE_ADDRESS__) || __has_feature(address_sanitizer)
+extern "C" {
+void __sanitizer_start_switch_fiber(void** fake_stack_save,
+                                    const void* bottom, size_t size);
+void __sanitizer_finish_switch_fiber(void* fake_stack_save,
+                                     const void** bottom_old,
+                                     size_t* size_old);
+}
+static void asan_before_jump(void** fake_save, const void* bottom,
+                             size_t size) {
+    __sanitizer_start_switch_fiber(fake_save, bottom, size);
+}
+static void asan_after_jump(void* fake_restore) {
+    __sanitizer_finish_switch_fiber(fake_restore, nullptr, nullptr);
+}
+#else
+static void asan_before_jump(void**, const void*, size_t) {}
+static void asan_after_jump(void*) {}
+#endif
+
 // ---------------- TaskGroup ----------------
 
 TaskGroup::TaskGroup(TaskControl* control, int index)
@@ -41,6 +72,17 @@ TaskGroup::TaskGroup(TaskControl* control, int index)
 
 void TaskGroup::run_main_task() {
     tls_task_group = this;
+    {
+        pthread_attr_t attr;
+        if (pthread_getattr_np(pthread_self(), &attr) == 0) {
+            void* base = nullptr;
+            size_t size = 0;
+            pthread_attr_getstack(&attr, &base, &size);
+            worker_stack_base_ = base;
+            worker_stack_size_ = size;
+            pthread_attr_destroy(&attr);
+        }
+    }
     while (true) {
         TaskMeta* m = wait_task();
         if (m == nullptr) break;  // stopped
@@ -94,11 +136,15 @@ TaskMeta* TaskGroup::wait_task() {
 void TaskGroup::sched_to(TaskMeta* next) {
     cur_meta_ = next;
     cur_ended_ = false;
+    asan_before_jump(&worker_asan_fake_, next->stack.base,
+                     next->stack.size);
     tf_jump_fcontext(&main_ctx_, next->stack.context, next);
+    asan_after_jump(worker_asan_fake_);
 }
 
 void TaskGroup::fiber_entry(void* arg) {
     TaskMeta* m = (TaskMeta*)arg;
+    asan_after_jump(m->asan_fake);
     m->ret = m->fn(m->arg);
     TaskGroup::tls_group()->exit_current();
 }
@@ -106,16 +152,22 @@ void TaskGroup::fiber_entry(void* arg) {
 void TaskGroup::exit_current() {
     cur_ended_ = true;
     TaskMeta* m = cur_meta_;
+    // null save: the fiber context dies here; ASan frees its fake frames.
+    asan_before_jump(nullptr, worker_stack_base_, worker_stack_size_);
     tf_jump_fcontext(&m->stack.context, main_ctx_, nullptr);
     CHECK(false) << "dead fiber resumed";
 }
 
 void TaskGroup::sched_park() {
     TaskMeta* m = cur_meta_;
+    asan_before_jump(&m->asan_fake, worker_stack_base_,
+                     worker_stack_size_);
     tf_jump_fcontext(&m->stack.context, main_ctx_, nullptr);
-    // Resumed later on possibly a DIFFERENT worker; re-read tls_group
+    // Resumed later on possibly a DIFFERENT worker; re-read tls_group —
     // callers must not cache `this` across sched_park (they don't: all
-    // callers go through TaskGroup::tls_group()).
+    // callers go through TaskGroup::tls_group()). `m` lives on this fiber
+    // stack and is still our own meta.
+    asan_after_jump(m->asan_fake);
 }
 
 namespace {
@@ -264,6 +316,9 @@ static int start_fiber_impl(fiber_t* tid, const FiberAttr* attr,
     m->fn = fn;
     m->arg = arg;
     m->ret = nullptr;
+    // Stale handle from the slot's previous tenant would hand ASan a freed
+    // fake stack on this fiber's first switch-in.
+    m->asan_fake = nullptr;
     m->stack_type = attr ? attr->stack_type : STACK_TYPE_NORMAL;
     m->tid = ((fiber_t)m->version << 32) | (fiber_t)(slot + 1);
     if (!get_stack(&m->stack, m->stack_type, TaskGroup::fiber_entry)) {

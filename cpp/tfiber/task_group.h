@@ -78,6 +78,11 @@ private:
     bool cur_ended_ = false;
     uint64_t steal_seed_;
     ParkingLot::State park_state_{0};
+    // Worker pthread stack bounds + fake-stack handle (ASan fiber-switch
+    // annotations).
+    const void* worker_stack_base_ = nullptr;
+    size_t worker_stack_size_ = 0;
+    void* worker_asan_fake_ = nullptr;
 };
 
 class TaskControl {

@@ -34,6 +34,10 @@ struct TaskMeta {
     void* local_storage = nullptr;
 
     bool about_to_quit = false;
+
+    // ASan fake-stack handle saved when this fiber switches out (fiber
+    // annotations in task_group.cc; unused in non-ASan builds).
+    void* asan_fake = nullptr;
 };
 
 }  // namespace tpurpc

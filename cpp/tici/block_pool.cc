@@ -1,0 +1,138 @@
+#include "tici/block_pool.h"
+
+#include <sys/mman.h>
+
+#include <atomic>
+#include <cstdlib>
+#include <mutex>
+#include <vector>
+
+#include "tbase/iobuf.h"
+#include "tbase/logging.h"
+
+namespace tpurpc {
+
+namespace {
+
+struct Region {
+    char* base;
+    size_t size;
+};
+
+struct PoolState {
+    std::mutex mu;
+    std::vector<Region> regions;
+    std::vector<void*> freelist;   // default-size blocks
+    size_t region_step = 64u << 20;
+    size_t carve_offset = 0;       // into regions.back()
+    std::atomic<size_t> live{0};
+    std::atomic<bool> inited{false};
+};
+
+PoolState& pool() {
+    static PoolState p;
+    return p;
+}
+
+// mmap one more region. Caller holds mu.
+bool grow_locked(PoolState& p) {
+    void* mem = mmap(nullptr, p.region_step, PROT_READ | PROT_WRITE,
+                     MAP_PRIVATE | MAP_ANONYMOUS, -1, 0);
+    if (mem == MAP_FAILED) {
+        PLOG(ERROR) << "IciBlockPool: mmap " << p.region_step << " failed";
+        return false;
+    }
+    p.regions.push_back(Region{(char*)mem, p.region_step});
+    p.carve_offset = 0;
+    return true;
+}
+
+}  // namespace
+
+void* IciBlockPool::Allocate(size_t n) {
+    PoolState& p = pool();
+    if (n == IOBuf::DEFAULT_BLOCK_SIZE) {
+        std::lock_guard<std::mutex> g(p.mu);
+        if (!p.freelist.empty()) {
+            void* b = p.freelist.back();
+            p.freelist.pop_back();
+            p.live.fetch_add(1, std::memory_order_relaxed);
+            return b;
+        }
+        if (p.regions.empty() ||
+            p.carve_offset + n > p.regions.back().size) {
+            if (!grow_locked(p)) return nullptr;
+        }
+        void* b = p.regions.back().base + p.carve_offset;
+        p.carve_offset += n;
+        p.live.fetch_add(1, std::memory_order_relaxed);
+        return b;
+    }
+    // Odd-size block: plain malloc, tagged so Deallocate can tell it from
+    // a pool block (a real libtpu build would register these mappings on
+    // demand; the fake-ICI loopback can transfer from any memory).
+    void* mem = malloc(n);
+    return mem;
+}
+
+void IciBlockPool::Deallocate(void* b) {
+    PoolState& p = pool();
+    {
+        std::lock_guard<std::mutex> g(p.mu);
+        const char* c = (const char*)b;
+        for (const Region& r : p.regions) {
+            if (c >= r.base && c < r.base + r.size) {
+                p.freelist.push_back(b);
+                p.live.fetch_sub(1, std::memory_order_relaxed);
+                return;
+            }
+        }
+    }
+    free(b);  // odd-size malloc'd block
+}
+
+bool IciBlockPool::Contains(const void* ptr) {
+    PoolState& p = pool();
+    std::lock_guard<std::mutex> g(p.mu);
+    const char* c = (const char*)ptr;
+    for (const Region& r : p.regions) {
+        if (c >= r.base && c < r.base + r.size) return true;
+    }
+    return false;
+}
+
+int IciBlockPool::Init(size_t region_bytes) {
+    PoolState& p = pool();
+    bool expected = false;
+    if (!p.inited.compare_exchange_strong(expected, true)) return 0;
+    {
+        std::lock_guard<std::mutex> g(p.mu);
+        p.region_step = region_bytes < (1u << 20) ? (1u << 20) : region_bytes;
+        if (!grow_locked(p)) {
+            p.inited.store(false);
+            return -1;
+        }
+    }
+    // From here on every new IOBuf block is transferable memory (the
+    // TLS block cache only recycles blocks whose deallocator matches the
+    // current pair, so stale malloc'd blocks are not handed back out).
+    IOBuf::blockmem_allocate = &IciBlockPool::Allocate;
+    IOBuf::blockmem_deallocate = &IciBlockPool::Deallocate;
+    return 0;
+}
+
+bool IciBlockPool::initialized() {
+    return pool().inited.load(std::memory_order_acquire);
+}
+
+size_t IciBlockPool::allocated_blocks() {
+    return pool().live.load(std::memory_order_relaxed);
+}
+
+size_t IciBlockPool::free_blocks() {
+    PoolState& p = pool();
+    std::lock_guard<std::mutex> g(p.mu);
+    return p.freelist.size();
+}
+
+}  // namespace tpurpc

@@ -1,0 +1,39 @@
+// Registered-memory block pool for the ICI transport: takes over IOBuf's
+// block allocator so every payload block lives in transfer-registered
+// memory and can be posted to the interconnect zero-copy.
+//
+// Modeled on reference src/brpc/rdma/block_pool.{h,cpp} (628 LoC): the
+// RDMA build registers GB-step regions with the NIC and swaps IOBuf's
+// `blockmem_allocate` hook (butil/iobuf.cpp:168) so send buffers need no
+// bounce copy. Here "registered" means: carved from mmap'd regions the
+// transfer engine may DMA from — on real TPU-VM hosts these become
+// libtpu-registered / pinned host buffers; the fake-ICI loopback treats
+// any pool region as transferable. Structure kept: regions grown in
+// fixed steps, freelist under a mutex (the per-thread IOBuf block cache
+// in front absorbs nearly all traffic), O(1) Contains() via region list.
+#pragma once
+
+#include <cstddef>
+#include <cstdint>
+
+namespace tpurpc {
+
+class IciBlockPool {
+public:
+    // Install the pool as IOBuf's block allocator. Idempotent.
+    // `region_bytes` is the mmap growth step (default 64MB).
+    static int Init(size_t region_bytes = 64u << 20);
+
+    // Allocator pair installed into IOBuf::blockmem_allocate/deallocate.
+    static void* Allocate(size_t n);
+    static void Deallocate(void* p);
+
+    // True if p lies inside a registered region (i.e. transferable).
+    static bool Contains(const void* p);
+
+    static bool initialized();
+    static size_t allocated_blocks();  // live default-size blocks
+    static size_t free_blocks();       // freelist depth
+};
+
+}  // namespace tpurpc

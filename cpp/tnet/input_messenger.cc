@@ -5,6 +5,7 @@
 #include "tbase/errno.h"
 #include "tbase/logging.h"
 #include "tfiber/fiber.h"
+#include "tnet/transport.h"
 
 namespace tpurpc {
 
@@ -66,8 +67,14 @@ void InputMessenger::OnNewMessages(Socket* s) {
     bool read_eof = false;
     while (!s->Failed()) {
         if (!read_eof) {
-            const ssize_t nr = s->read_buf.append_from_file_descriptor(
-                s->fd(), 512 * 1024);
+            // ICI transport sockets pump their completion queue (identical
+            // nr semantics); fd sockets readv (reference
+            // input_messenger.cpp:416 checks _rdma_state the same way).
+            const ssize_t nr =
+                s->transport() != nullptr
+                    ? s->transport()->Pump(&s->read_buf)
+                    : s->read_buf.append_from_file_descriptor(s->fd(),
+                                                              512 * 1024);
             if (nr == 0) {
                 read_eof = true;
             } else if (nr < 0) {

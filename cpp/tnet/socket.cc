@@ -15,6 +15,7 @@
 #include "tbase/time.h"
 #include "tfiber/call_id.h"
 #include "tnet/event_dispatcher.h"
+#include "tnet/transport.h"
 
 DEFINE_int64(socket_max_unwritten_bytes, 64 * 1024 * 1024,
              "write backlog limit before EOVERCROWDED back-pressure");
@@ -59,6 +60,7 @@ int Socket::Create(const SocketOptions& options, SocketId* id) {
     s->on_edge_triggered_events_ = options.on_edge_triggered_events;
     s->user_ = options.user;
     s->transport_ = options.transport;
+    s->owns_transport_ = options.owns_transport;
     s->write_head_.store(nullptr, std::memory_order_relaxed);
     s->write_pending_.store(0, std::memory_order_relaxed);
     s->unwritten_bytes_.store(0, std::memory_order_relaxed);
@@ -213,6 +215,10 @@ void Socket::DropWriteRequest(WriteRequest* req) {
 void Socket::OnRecycle() {
     CloseFdAndDropQueued();
     read_buf.clear();
+    if (transport_ != nullptr) {
+        if (owns_transport_) transport_->Release();
+        transport_ = nullptr;
+    }
 }
 
 // Shared teardown of a dead connection: close + deregister the fd and drop
@@ -223,8 +229,11 @@ void Socket::CloseFdAndDropQueued() {
     const int fd = fd_.exchange(-1, std::memory_order_acq_rel);
     if (fd >= 0) {
         EventDispatcher::GetGlobalDispatcher(fd).RemoveConsumer(fd);
-        close(fd);
+        // A transport's doorbell fd is owned by the transport (its link
+        // may outlive this socket); only plain TCP fds are ours to close.
+        if (transport_ == nullptr) close(fd);
     }
+    if (transport_ != nullptr) transport_->Close();
     for (size_t i = inflight_index_; i < inflight_batch_.size(); ++i) {
         DropWriteRequest(inflight_batch_[i]);
     }
@@ -411,12 +420,23 @@ bool Socket::FlushOnce(bool allow_block) {
              i < inflight_batch_.size() && npieces < 64; ++i) {
             pieces[npieces++] = &inflight_batch_[i]->data;
         }
-        const ssize_t nw = IOBuf::cut_multiple_into_file_descriptor(
-            fd(), pieces, npieces);
+        // Data plane: ICI queue pair when plugged (the RdmaEndpoint
+        // bypass — reference socket.cpp checks _rdma_state on the write
+        // path), else the fd.
+        const ssize_t nw =
+            transport_ != nullptr
+                ? transport_->CutFromIOBufList(pieces, npieces)
+                : IOBuf::cut_multiple_into_file_descriptor(fd(), pieces,
+                                                           npieces);
         if (nw < 0) {
             if (errno == EAGAIN || errno == EWOULDBLOCK) {
                 if (!allow_block) return false;  // caller spawns KeepWrite
-                if (WaitEpollOut() != 0) {
+                const int wrc =
+                    transport_ != nullptr
+                        ? transport_->WaitWritable(monotonic_time_us() +
+                                                   2 * 1000 * 1000)
+                        : WaitEpollOut();
+                if (wrc != 0) {
                     SetFailedWithError(TERR_FAILED_SOCKET);
                     DrainWriteQueue();
                     return true;

@@ -43,6 +43,9 @@ struct SocketOptions {
     void* user = nullptr;  // InputMessenger* / Acceptor* / Server*
     // Optional transport endpoint taking over the data plane (ICI).
     TransportEndpoint* transport = nullptr;
+    // If set, the socket Release()s the endpoint at recycle time (the
+    // link frees itself once both sides' sockets are gone).
+    bool owns_transport = false;
     // >0: on SetFailed, keep probing the remote every this-many ms and
     // Revive the SAME SocketId on success (reference
     // src/brpc/details/health_check.cpp — ids held by load balancers stay
@@ -100,6 +103,9 @@ public:
     // the client stack after each call, isolation = SetFailed + revive.
     CircuitBreaker& circuit_breaker() { return circuit_breaker_; }
 
+    // Plugged data-plane transport (ICI), or null for the fd path.
+    TransportEndpoint* transport() const { return transport_; }
+
     // ---- per-connection parsing state (owned by InputMessenger) ----
     IOPortal read_buf;
     int preferred_protocol_index = -1;
@@ -153,6 +159,7 @@ private:
     void (*on_edge_triggered_events_)(Socket*) = nullptr;
     void* user_ = nullptr;
     TransportEndpoint* transport_ = nullptr;
+    bool owns_transport_ = false;
 
     std::atomic<WriteRequest*> write_head_{nullptr};
     std::atomic<int64_t> write_pending_{0};

@@ -1,0 +1,60 @@
+// TransportEndpoint: the pluggable data-plane seam of Socket — how an
+// ICI/shm queue-pair transport takes over reads and writes while Socket
+// keeps the id/lifecycle/wait-free-queue semantics.
+//
+// Modeled on the role of reference src/brpc/rdma/rdma_endpoint.h: the
+// RDMA endpoint bypasses the fd write path (CutFromIOBufList
+// rdma_endpoint.cpp:777 posts IOBuf blocks as SGEs zero-copy), delivers
+// completions through a comp-channel fd registered with the normal
+// EventDispatcher (PollCq rdma_endpoint.cpp:1364), and rejoins the
+// standard InputMessenger parse pipeline (input_messenger.cpp:416). The
+// four pillars preserved here (SURVEY §2.9): zero-copy block posting,
+// windowed credit flow control, event suppression/batched completions,
+// completions unified into the one event dispatcher.
+#pragma once
+
+#include <sys/types.h>
+
+#include "tbase/iobuf.h"
+
+namespace tpurpc {
+
+class TransportEndpoint {
+public:
+    virtual ~TransportEndpoint() = default;
+
+    // The doorbell/completion fd. Registered with the EventDispatcher as
+    // the Socket's fd (the comp-channel-fd pattern): readable when data
+    // arrived or credits freed.
+    virtual int event_fd() const = 0;
+
+    // True once the endpoint can carry data (post-handshake).
+    virtual bool Established() const = 0;
+
+    // Post bytes from pieces[0..count) into the send queue, zero-copy:
+    // block references are held by the queue until the remote side
+    // completes them. Returns bytes posted (pieces are pop_front'd);
+    // -1/EAGAIN when out of window credits; -1/other errno on failure.
+    virtual ssize_t CutFromIOBufList(IOBuf* const* pieces, size_t count) = 0;
+
+    // Block the calling fiber until credits may be available (woken by the
+    // pump when the peer consumes). Returns 0, or -1 on timeout/failure.
+    virtual int WaitWritable(int64_t abstime_us) = 0;
+
+    // Drain the completion queue: move received bytes into *dst, release
+    // send-side refs completed by the peer, wake writable waiters.
+    // fd-read semantics: >0 bytes appended; 0 = peer closed (EOF);
+    // -1/EAGAIN = nothing pending.
+    virtual ssize_t Pump(IOPortal* dst) = 0;
+
+    // Half-close: peer's next drained Pump returns EOF. Idempotent.
+    virtual void Close() = 0;
+
+    // Drop the owner's reference (a Socket with owns_transport, or the
+    // harness). The endpoint's backing link frees itself when every
+    // endpoint is released — the socket and the peer's socket can tear
+    // down in any order without dangling pipes.
+    virtual void Release() {}
+};
+
+}  // namespace tpurpc

@@ -40,6 +40,19 @@ int Channel::Init(const char* server_addr_and_port,
     return Init(ep, options);
 }
 
+int Channel::InitWithSocketId(SocketId sid, const ChannelOptions* options) {
+    GlobalInitializeOrDie();
+    if (options != nullptr) options_ = *options;
+    SocketUniquePtr s;
+    if (Socket::AddressSocket(sid, &s) != 0) {
+        LOG(ERROR) << "InitWithSocketId: dead socket id=" << sid;
+        return -1;
+    }
+    server_ep_ = s->remote_side();
+    pinned_socket_ = sid;
+    return 0;
+}
+
 int Channel::Init(const char* naming_url, const char* lb_name,
                   const ChannelOptions* options) {
     GlobalInitializeOrDie();

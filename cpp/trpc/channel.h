@@ -38,6 +38,10 @@ public:
     // client-robustness milestone).
     int Init(const char* naming_url, const char* lb_name,
              const ChannelOptions* options);
+    // Pin the channel to an existing socket (ICI transport endpoints are
+    // created out-of-band by the link setup, not by the SocketMap —
+    // reference Channel::Init(fd) single-socket mode is the analog).
+    int InitWithSocketId(SocketId sid, const ChannelOptions* options);
 
     void CallMethod(const google::protobuf::MethodDescriptor* method,
                     google::protobuf::RpcController* controller,
@@ -52,10 +56,13 @@ public:
     // The process-wide client messenger for tpu_std responses.
     static InputMessenger* client_messenger();
 
+    SocketId pinned_socket() const { return pinned_socket_; }
+
 private:
     EndPoint server_ep_;
     ChannelOptions options_;
     std::shared_ptr<LoadBalancerWithNaming> lb_;
+    SocketId pinned_socket_ = INVALID_VREF_ID;
 };
 
 }  // namespace tpurpc

@@ -170,8 +170,9 @@ void Controller::IssueRPC() {
         if (excluded_ == nullptr) excluded_ = new ExcludedServers;
         excluded_->Add(s->id());
     } else {
-        SocketId sid = INVALID_VREF_ID;
-        if (SocketMap::singleton()->GetOrCreate(channel_->server(),
+        SocketId sid = channel_->pinned_socket();
+        if (sid == INVALID_VREF_ID &&
+            SocketMap::singleton()->GetOrCreate(channel_->server(),
                                                 Channel::client_messenger(),
                                                 &sid) != 0) {
             id_error(current_cid_, TERR_FAILED_SOCKET);

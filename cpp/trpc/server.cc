@@ -9,7 +9,14 @@
 
 namespace tpurpc {
 
-Server::~Server() { Stop(); }
+// Join in the destructor: a request fiber touches this server's method
+// map (stats in the done-closure) until nprocessing hits zero, so
+// destroying without draining is a use-after-free (the reference requires
+// Stop+Join too, and its ~Server performs them).
+Server::~Server() {
+    Stop();
+    Join();
+}
 
 int Server::AddService(google::protobuf::Service* service) {
     if (started_) {
@@ -48,6 +55,7 @@ int Server::Start(const EndPoint& ep, const ServerOptions* options) {
         return -1;
     }
     started_ = true;
+    listening_ = true;
     return 0;
 }
 
@@ -57,9 +65,23 @@ int Server::Start(int port, const ServerOptions* options) {
     return Start(ep, options);
 }
 
+int Server::StartNoListen(const ServerOptions* options) {
+    if (started_) return -1;
+    GlobalInitializeOrDie();
+    if (options != nullptr) options_ = *options;
+    for (auto& kv : methods_) {
+        kv.second.status->max_concurrency = options_.max_concurrency;
+    }
+    messenger_.add_protocol(TpuStdProtocolIndex());
+    messenger_.context = this;
+    started_ = true;
+    listening_ = false;
+    return 0;
+}
+
 void Server::Stop() {
     if (!started_) return;
-    acceptor_.StopAccept();
+    if (listening_) acceptor_.StopAccept();
     started_ = false;
 }
 

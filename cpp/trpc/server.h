@@ -60,6 +60,16 @@ public:
     int listened_port() const { return acceptor_.listened_port(); }
     const ServerOptions& options() const { return options_; }
 
+    // The server's message pump — out-of-band transports (ICI endpoints)
+    // bind their sockets to it so requests flow into this server's
+    // services. Valid after Start (requires started protocol registry) or
+    // StartNoListen.
+    InputMessenger* messenger() { return &messenger_; }
+    // Initialize services/registries without a TCP listener: an
+    // ICI-endpoint-only server (data plane rides the interconnect; no
+    // DCN port).
+    int StartNoListen(const ServerOptions* options);
+
     // "ServiceName.MethodName" lookup (called by the protocol layer).
     MethodProperty* FindMethod(const std::string& service_name,
                                const std::string& method_name);
@@ -71,6 +81,7 @@ private:
     Acceptor acceptor_;
     ServerOptions options_;
     bool started_ = false;
+    bool listening_ = false;
     std::map<std::string, MethodProperty> methods_;
 };
 

@@ -69,10 +69,31 @@ inline int run_all(int argc, char** argv) {
     for (int i = 1; i < argc; ++i) {
         if (strncmp(argv[i], "--filter=", 9) == 0) filter = argv[i] + 9;
     }
+    // Comma-separated substring patterns; a test runs if any matches.
+    std::vector<std::string> patterns;
+    if (filter != nullptr) {
+        std::string f = filter;
+        size_t pos = 0;
+        while (pos <= f.size()) {
+            const size_t c = f.find(',', pos);
+            const size_t end = c == std::string::npos ? f.size() : c;
+            if (end > pos) patterns.push_back(f.substr(pos, end - pos));
+            pos = end + 1;
+        }
+    }
     int failed = 0, ran = 0;
     for (auto& tc : registry()) {
         std::string full = std::string(tc.suite) + "." + tc.name;
-        if (filter && full.find(filter) == std::string::npos) continue;
+        if (!patterns.empty()) {
+            bool match = false;
+            for (const auto& p : patterns) {
+                if (full.find(p) != std::string::npos) {
+                    match = true;
+                    break;
+                }
+            }
+            if (!match) continue;
+        }
         ++ran;
         current_failures() = 0;
         fatal_failure() = false;

@@ -18,6 +18,9 @@
 #include "tbase/cpu_profiler.h"
 #include "tbase/flags.h"
 #include "tbase/time.h"
+#include "tici/block_pool.h"
+#include "tici/ici_link.h"
+#include "tnet/socket.h"
 #include "tfiber/fiber_sync.h"
 #include "trpc/channel.h"
 #include "trpc/controller.h"
@@ -110,9 +113,11 @@ double run_round(benchpb::EchoService_Stub& stub, size_t attachment_bytes,
 
 int main(int argc, char** argv) {
     bool json = false;
+    bool use_ici = false;
     const char* prof_path = nullptr;
     for (int i = 1; i < argc; ++i) {
         if (strcmp(argv[i], "--json") == 0) json = true;
+        if (strcmp(argv[i], "--ici") == 0) use_ici = true;
         if (strcmp(argv[i], "--prof") == 0 && i + 1 < argc) {
             prof_path = argv[++i];
         }
@@ -124,16 +129,42 @@ int main(int argc, char** argv) {
     Server server;
     EchoServiceImpl service;
     if (server.AddService(&service) != 0) return 1;
-    EndPoint listen;
-    str2endpoint("127.0.0.1:0", &listen);
-    if (server.Start(listen, nullptr) != 0) return 1;
 
     Channel channel;
     ChannelOptions copts;
     copts.timeout_ms = 10000;
-    EndPoint ep;
-    str2endpoint("127.0.0.1", server.listened_port(), &ep);
-    if (channel.Init(ep, &copts) != 0) return 1;
+    if (use_ici) {
+        // ICI data plane: registered-memory pool + software queue pair
+        // (the loopback stand-in for the interconnect; see
+        // cpp/tici/ici_link.h). One copy per byte instead of TCP's four.
+        if (IciBlockPool::Init() != 0) return 1;
+        if (server.StartNoListen(nullptr) != 0) return 1;
+        IciLink& link = *IciLink::Create();
+        SocketOptions sopts;
+        sopts.fd = link.second()->event_fd();
+        sopts.transport = link.second();
+        sopts.owns_transport = true;
+        sopts.on_edge_triggered_events = InputMessenger::OnNewMessages;
+        sopts.user = server.messenger();
+        SocketId server_sid;
+        if (Socket::Create(sopts, &server_sid) != 0) return 1;
+        SocketOptions ccopts;
+        ccopts.fd = link.first()->event_fd();
+        ccopts.transport = link.first();
+        ccopts.owns_transport = true;
+        ccopts.on_edge_triggered_events = InputMessenger::OnNewMessages;
+        ccopts.user = Channel::client_messenger();
+        SocketId client_sid;
+        if (Socket::Create(ccopts, &client_sid) != 0) return 1;
+        if (channel.InitWithSocketId(client_sid, &copts) != 0) return 1;
+    } else {
+        EndPoint listen;
+        str2endpoint("127.0.0.1:0", &listen);
+        if (server.Start(listen, nullptr) != 0) return 1;
+        EndPoint ep;
+        str2endpoint("127.0.0.1", server.listened_port(), &ep);
+        if (channel.Init(ep, &copts) != 0) return 1;
+    }
     benchpb::EchoService_Stub stub(&channel);
 
     LatencyRecorder lat;

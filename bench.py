@@ -60,22 +60,26 @@ def main():
             "vs_baseline": 0.0, "error": "build failed",
         }))
         return
+    def assemble(result, metric, prefix=""):
+        mbps = float(result["mbps"])
+        out = {
+            "metric": metric,
+            "value": round(mbps, 1),
+            "unit": "MB/s",
+            "vs_baseline": round(mbps / BASELINE_MBPS, 3),
+        }
+        for k in ("qps_4k", "p99_us_4k"):
+            if k in result:
+                out[prefix + k] = result[k]
+        return out
+
     # Headline: echo over the ICI transport (the point of the project —
     # SURVEY §2.9 north star). TCP-loopback numbers ride along for
     # comparison against the reference's own transport.
     ici = run_tool("echo_bench", ["--json", "--ici"])
     tcp = run_tool("echo_bench", ["--json"])
     if ici is not None and "mbps" in ici:
-        mbps = float(ici["mbps"])
-        out = {
-            "metric": "echo_throughput_1MB_ici",
-            "value": round(mbps, 1),
-            "unit": "MB/s",
-            "vs_baseline": round(mbps / BASELINE_MBPS, 3),
-        }
-        for k in ("qps_4k", "p99_us_4k"):
-            if k in ici:
-                out["ici_" + k] = ici[k]
+        out = assemble(ici, "echo_throughput_1MB_ici", "ici_")
         if tcp is not None and "mbps" in tcp:
             out["tcp_mbps"] = tcp["mbps"]
             for k in ("qps_4k", "p99_us_4k"):
@@ -84,17 +88,7 @@ def main():
         print(json.dumps(out))
         return
     if tcp is not None and "mbps" in tcp:
-        mbps = float(tcp["mbps"])
-        out = {
-            "metric": "echo_throughput_1MB_loopback",
-            "value": round(mbps, 1),
-            "unit": "MB/s",
-            "vs_baseline": round(mbps / BASELINE_MBPS, 3),
-        }
-        for k in ("qps_4k", "p99_us_4k"):
-            if k in tcp:
-                out[k] = tcp[k]
-        print(json.dumps(out))
+        print(json.dumps(assemble(tcp, "echo_throughput_1MB_loopback")))
         return
     result = run_tool("iobuf_bench", ["--json"])
     if result is not None and "mbps" in result:

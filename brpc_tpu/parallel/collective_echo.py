@@ -90,3 +90,39 @@ def make_parallel_echo_step(mesh: Mesh):
         out_specs=P(axis, None),
     )
     return jax.jit(sharded)
+
+
+def make_partition_echo_step(mesh: Mesh):
+    """PartitionChannel sharding lowered to XLA: each peer owns one shard.
+
+    The C++ PartitionChannel (cpp/trpc/combo_channels.h; reference
+    src/brpc/partition_channel.h:34) splits one logical service across M
+    partitions and fans every call out to all of them, merging the
+    responses. On a mesh that IS sharded computation: requests are laid
+    out with one partition per device (jax.sharding), each device serves
+    its shard (frame checksum + echo), and the "merge" is the sharded
+    output itself — XLA inserts the collectives only where the layout
+    demands them.
+
+    Returns a jitted step: uint32[n_parts, words] ->
+    (uint32[n_parts], uint32[n_parts, words], uint32[]): per-partition
+    checksums, echoed shards, and the cluster-wide merged integrity word
+    (the psum that rides ICI on hardware).
+    """
+    axis = mesh.axis_names[0]
+
+    def _shard_body(local: jax.Array):
+        # local: uint32[parts_per_device, words] — this device's shard.
+        check = _adler_frame_checksum(local)
+        # Cross-partition integrity word (the fan-out's merged status):
+        # one psum over ICI, the cheapest possible "ResponseMerger".
+        total = jax.lax.psum(jnp.sum(check, dtype=jnp.uint32), axis)
+        return check, local, total
+
+    sharded = jax.shard_map(
+        _shard_body,
+        mesh=mesh,
+        in_specs=P(axis, None),
+        out_specs=(P(axis), P(axis, None), P()),
+    )
+    return jax.jit(sharded)

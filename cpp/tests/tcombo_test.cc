@@ -1,0 +1,313 @@
+// Combo-channel tests: ParallelChannel fan-out + merge, fail_limit,
+// CallMapper skip, PartitionChannel tag routing, SelectiveChannel
+// retry-on-another-channel, DynamicPartitionChannel capacity choice.
+// In-process loopback servers, the reference's test style
+// (test/brpc_channel_unittest.cpp combo-channel sections).
+#include <atomic>
+#include <string>
+#include <vector>
+
+#include "echo.pb.h"
+#include "tbase/errno.h"
+#include "tfiber/fiber_sync.h"
+#include "trpc/combo_channels.h"
+#include "trpc/controller.h"
+#include "trpc/server.h"
+#include "ttest/ttest.h"
+
+using namespace tpurpc;
+
+namespace {
+
+// Echo server whose responses are prefixed with its name (merge order is
+// observable) and which can fail on demand.
+class NamedEchoService : public test::EchoService {
+public:
+    explicit NamedEchoService(std::string name) : name_(std::move(name)) {}
+    void Echo(google::protobuf::RpcController* cntl_base,
+              const test::EchoRequest* req, test::EchoResponse* res,
+              google::protobuf::Closure* done) override {
+        ncalls.fetch_add(1, std::memory_order_relaxed);
+        if (fail.load(std::memory_order_relaxed)) {
+            static_cast<Controller*>(cntl_base)
+                ->SetFailed(ECONNABORTED, "injected");
+        } else {
+            res->set_message(name_ + ":" + req->message());
+        }
+        done->Run();
+    }
+    std::string name_;
+    std::atomic<int> ncalls{0};
+    std::atomic<bool> fail{false};
+};
+
+struct TestServer {
+    explicit TestServer(const std::string& name) : service(name) {
+        server.AddService(&service);
+        EndPoint any;
+        str2endpoint("127.0.0.1:0", &any);
+        server.Start(any, nullptr);
+    }
+    int port() const { return server.listened_port(); }
+    std::string addr() const {
+        return "127.0.0.1:" + std::to_string(port());
+    }
+    Server server;
+    NamedEchoService service;
+};
+
+// Concatenating merger: parent message += "|" + sub message.
+class ConcatMerger : public ResponseMerger {
+public:
+    int Merge(google::protobuf::Message* response,
+              const google::protobuf::Message* sub) override {
+        auto* r = static_cast<test::EchoResponse*>(response);
+        const auto* s = static_cast<const test::EchoResponse*>(sub);
+        if (!r->message().empty()) {
+            r->set_message(r->message() + "|" + s->message());
+        } else {
+            r->set_message(s->message());
+        }
+        return 0;
+    }
+};
+
+}  // namespace
+
+TEST(ParallelChannel, FanoutAndMergeInOrder) {
+    TestServer s1("a"), s2("b"), s3("c");
+    Channel c1, c2, c3;
+    ChannelOptions copts;
+    copts.timeout_ms = 3000;
+    ASSERT_EQ(0, c1.Init(s1.addr().c_str(), &copts));
+    ASSERT_EQ(0, c2.Init(s2.addr().c_str(), &copts));
+    ASSERT_EQ(0, c3.Init(s3.addr().c_str(), &copts));
+
+    ParallelChannel pc;
+    ASSERT_EQ(0, pc.AddChannel(&c1, nullptr, new ConcatMerger));
+    ASSERT_EQ(0, pc.AddChannel(&c2, nullptr, new ConcatMerger));
+    ASSERT_EQ(0, pc.AddChannel(&c3, nullptr, new ConcatMerger));
+
+    test::EchoService_Stub stub(&pc);
+    Controller cntl;
+    cntl.set_timeout_ms(3000);
+    test::EchoRequest req;
+    test::EchoResponse res;
+    req.set_message("x");
+    stub.Echo(&cntl, &req, &res, nullptr);
+    ASSERT_FALSE(cntl.Failed());
+    // Deterministic sub-channel index order regardless of completion order.
+    EXPECT_EQ("a:x|b:x|c:x", res.message());
+    EXPECT_EQ(1, s1.service.ncalls.load());
+    EXPECT_EQ(1, s2.service.ncalls.load());
+    EXPECT_EQ(1, s3.service.ncalls.load());
+}
+
+TEST(ParallelChannel, FailLimit) {
+    TestServer good("g"), bad("b");
+    bad.service.fail = true;
+    Channel cg, cb;
+    ChannelOptions copts;
+    copts.timeout_ms = 3000;
+    copts.max_retry = 0;
+    ASSERT_EQ(0, cg.Init(good.addr().c_str(), &copts));
+    ASSERT_EQ(0, cb.Init(bad.addr().c_str(), &copts));
+
+    // fail_limit=2: one failure tolerated.
+    ParallelChannelOptions popts;
+    popts.fail_limit = 2;
+    {
+        ParallelChannel pc(&popts);
+        ASSERT_EQ(0, pc.AddChannel(&cg, nullptr, new ConcatMerger));
+        ASSERT_EQ(0, pc.AddChannel(&cb, nullptr, new ConcatMerger));
+        test::EchoService_Stub stub(&pc);
+        Controller cntl;
+        cntl.set_max_retry(0);
+        test::EchoRequest req;
+        test::EchoResponse res;
+        req.set_message("y");
+        stub.Echo(&cntl, &req, &res, nullptr);
+        EXPECT_FALSE(cntl.Failed());
+        EXPECT_EQ("g:y", res.message());
+    }
+    // Default fail_limit: any failure fails the parent.
+    {
+        ParallelChannel pc;
+        ASSERT_EQ(0, pc.AddChannel(&cg, nullptr, new ConcatMerger));
+        ASSERT_EQ(0, pc.AddChannel(&cb, nullptr, new ConcatMerger));
+        test::EchoService_Stub stub(&pc);
+        Controller cntl;
+        cntl.set_max_retry(0);
+        test::EchoRequest req;
+        test::EchoResponse res;
+        req.set_message("z");
+        stub.Echo(&cntl, &req, &res, nullptr);
+        EXPECT_TRUE(cntl.Failed());
+    }
+}
+
+namespace {
+
+// Maps only even-indexed sub-channels; odd ones are skipped.
+class EvenOnlyMapper : public CallMapper {
+public:
+    SubCall Map(int channel_index, int channel_count,
+                const google::protobuf::MethodDescriptor* method,
+                const google::protobuf::Message* request,
+                google::protobuf::Message* response) override {
+        (void)channel_count;
+        (void)method;
+        (void)request;
+        (void)response;
+        if (channel_index % 2 != 0) return SubCall::Skip();
+        return SubCall{};  // defaults: parent method/request, fresh response
+    }
+};
+
+}  // namespace
+
+TEST(ParallelChannel, MapperSkipsSubChannels) {
+    TestServer s1("a"), s2("b"), s3("c");
+    Channel c1, c2, c3;
+    ChannelOptions copts;
+    copts.timeout_ms = 3000;
+    ASSERT_EQ(0, c1.Init(s1.addr().c_str(), &copts));
+    ASSERT_EQ(0, c2.Init(s2.addr().c_str(), &copts));
+    ASSERT_EQ(0, c3.Init(s3.addr().c_str(), &copts));
+
+    ParallelChannel pc;
+    ASSERT_EQ(0, pc.AddChannel(&c1, new EvenOnlyMapper, new ConcatMerger));
+    ASSERT_EQ(0, pc.AddChannel(&c2, new EvenOnlyMapper, new ConcatMerger));
+    ASSERT_EQ(0, pc.AddChannel(&c3, new EvenOnlyMapper, new ConcatMerger));
+
+    test::EchoService_Stub stub(&pc);
+    Controller cntl;
+    test::EchoRequest req;
+    test::EchoResponse res;
+    req.set_message("m");
+    stub.Echo(&cntl, &req, &res, nullptr);
+    ASSERT_FALSE(cntl.Failed());
+    EXPECT_EQ("a:m|c:m", res.message());
+    EXPECT_EQ(0, s2.service.ncalls.load());
+}
+
+TEST(ParallelChannel, AsyncFanout) {
+    TestServer s1("a"), s2("b");
+    Channel c1, c2;
+    ChannelOptions copts;
+    copts.timeout_ms = 3000;
+    ASSERT_EQ(0, c1.Init(s1.addr().c_str(), &copts));
+    ASSERT_EQ(0, c2.Init(s2.addr().c_str(), &copts));
+    ParallelChannel pc;
+    ASSERT_EQ(0, pc.AddChannel(&c1, nullptr, new ConcatMerger));
+    ASSERT_EQ(0, pc.AddChannel(&c2, nullptr, new ConcatMerger));
+
+    struct Ctx {
+        Controller cntl;
+        test::EchoRequest req;
+        test::EchoResponse res;
+        CountdownEvent ev{1};
+        static void Done(Ctx* c) { c->ev.signal(); }
+    } ctx;
+    ctx.req.set_message("q");
+    test::EchoService_Stub stub(&pc);
+    stub.Echo(&ctx.cntl, &ctx.req, &ctx.res,
+              google::protobuf::NewCallback(&Ctx::Done, &ctx));
+    ctx.ev.wait();
+    ASSERT_FALSE(ctx.cntl.Failed());
+    EXPECT_EQ("a:q|b:q", ctx.res.message());
+}
+
+TEST(PartitionChannel, RoutesByTag) {
+    TestServer p0("p0"), p1("p1");
+    char url[256];
+    snprintf(url, sizeof(url), "list://%s 0/2,%s 1/2", p0.addr().c_str(),
+             p1.addr().c_str());
+    PartitionChannel pc;
+    PartitionChannelOptions opts;
+    opts.timeout_ms = 3000;
+    opts.response_merger = new ConcatMerger;
+    ASSERT_EQ(0, pc.Init(url, "rr", nullptr, &opts));
+    EXPECT_EQ(2, pc.partition_count());
+
+    test::EchoService_Stub stub(&pc);
+    Controller cntl;
+    test::EchoRequest req;
+    test::EchoResponse res;
+    req.set_message("k");
+    stub.Echo(&cntl, &req, &res, nullptr);
+    ASSERT_FALSE(cntl.Failed());
+    // Both partitions served the fan-out.
+    EXPECT_EQ(1, p0.service.ncalls.load());
+    EXPECT_EQ(1, p1.service.ncalls.load());
+    EXPECT_EQ("p0:k|p1:k", res.message());
+}
+
+TEST(PartitionChannel, IncompleteSchemeFailsInit) {
+    TestServer p0("p0");
+    char url[128];
+    snprintf(url, sizeof(url), "list://%s 0/2", p0.addr().c_str());
+    PartitionChannel pc;
+    EXPECT_NE(0, pc.Init(url, "rr", nullptr, nullptr));
+}
+
+TEST(SelectiveChannel, RetriesOnAnotherChannel) {
+    TestServer good("g"), bad("b");
+    bad.service.fail = true;
+    Channel cg, cb;
+    ChannelOptions copts;
+    copts.timeout_ms = 3000;
+    copts.max_retry = 0;
+    ASSERT_EQ(0, cg.Init(good.addr().c_str(), &copts));
+    ASSERT_EQ(0, cb.Init(bad.addr().c_str(), &copts));
+
+    SelectiveChannel sc;
+    ASSERT_EQ(0, sc.AddChannel(&cb));  // rr starts somewhere; retries cover
+    ASSERT_EQ(0, sc.AddChannel(&cg));
+
+    test::EchoService_Stub stub(&sc);
+    int ok = 0;
+    for (int i = 0; i < 8; ++i) {
+        Controller cntl;
+        cntl.set_max_retry(2);
+        cntl.set_timeout_ms(3000);
+        test::EchoRequest req;
+        test::EchoResponse res;
+        req.set_message("s");
+        stub.Echo(&cntl, &req, &res, nullptr);
+        if (!cntl.Failed()) {
+            ++ok;
+            EXPECT_EQ("g:s", res.message());
+        }
+    }
+    // Every call lands on the good server eventually (retry hops away
+    // from the failing channel).
+    EXPECT_EQ(8, ok);
+    EXPECT_GE(good.service.ncalls.load(), 8);
+}
+
+TEST(DynamicPartitionChannel, PicksLargestScheme) {
+    TestServer a0("a0"), b0("b0"), b1("b1"), b2("b2");
+    char url_small[128], url_big[384];
+    snprintf(url_small, sizeof(url_small), "list://%s 0/1",
+             a0.addr().c_str());
+    snprintf(url_big, sizeof(url_big), "list://%s 0/3,%s 1/3,%s 2/3",
+             b0.addr().c_str(), b1.addr().c_str(), b2.addr().c_str());
+    DynamicPartitionChannel dc;
+    PartitionChannelOptions opts;
+    opts.timeout_ms = 3000;
+    ASSERT_EQ(0, dc.Init({url_small, url_big}, "rr", &opts));
+    EXPECT_EQ(1, dc.chosen_scheme());  // 3 servers > 1 server
+
+    test::EchoService_Stub stub(&dc);
+    Controller cntl;
+    test::EchoRequest req;
+    test::EchoResponse res;
+    req.set_message("d");
+    stub.Echo(&cntl, &req, &res, nullptr);
+    ASSERT_FALSE(cntl.Failed());
+    EXPECT_EQ(1, b0.service.ncalls.load());
+    EXPECT_EQ(1, b1.service.ncalls.load());
+    EXPECT_EQ(1, b2.service.ncalls.load());
+    EXPECT_EQ(0, a0.service.ncalls.load());
+}

@@ -4,6 +4,7 @@
 #include <mutex>
 
 #include "tbase/logging.h"
+#include "tbase/resource_pool.h"
 #include "tbase/time.h"
 #include "tfiber/sys_futex.h"
 #include "tfiber/task_group.h"
@@ -44,6 +45,7 @@ struct Butex {
     // Intrusive doubly-linked list, FIFO wake order.
     ButexWaiter* head = nullptr;
     ButexWaiter* tail = nullptr;
+    ResourceId pool_id = 0;  // slot in the butex pool (never unmapped)
 
     void enqueue(ButexWaiter* w) {
         w->container = this;
@@ -198,9 +200,29 @@ int wait_pthread(Butex* b, int expected, const int64_t* abstime_us) {
 
 }  // namespace
 
-void* butex_create() { return new Butex; }
+// Butexes live in a ResourcePool whose slots are NEVER unmapped
+// (reference butex.cpp uses the same scheme): a waker that lost the race
+// with butex_destroy touches a still-mapped, possibly-recycled Butex and
+// produces at most a spurious wake (waiters re-check their condition in a
+// loop), never a use-after-free. This is what makes the
+// "signal() then waiter frees the event" idiom of CountdownEvent and the
+// RPC sync paths safe.
+void* butex_create() {
+    ResourceId id;
+    Butex* b = get_resource<Butex>(&id);
+    if (b == nullptr) return nullptr;
+    b->pool_id = id;
+    b->value.store(0, std::memory_order_relaxed);
+    return b;
+}
 
-void butex_destroy(void* butex) { delete (Butex*)butex; }
+void butex_destroy(void* butex) {
+    if (butex == nullptr) return;
+    Butex* b = (Butex*)butex;
+    // Waiter list must already be empty (callers own that invariant: no
+    // destroy with parked waiters).
+    return_resource<Butex>(b->pool_id);
+}
 
 std::atomic<int>* butex_word(void* butex) { return &((Butex*)butex)->value; }
 

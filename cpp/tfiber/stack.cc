@@ -8,6 +8,22 @@
 
 #include "tbase/logging.h"
 
+// A fiber that finishes leaves via a context switch, so its frames'
+// shadow-poisoning epilogues never run; a recycled stack then carries
+// stale ASan redzones that flag the next fiber's perfectly valid frames.
+// Unpoison the whole usable range on recycle (reference keeps the same
+// annotation in src/bthread/stack_inl.h).
+#ifndef __has_feature
+#define __has_feature(x) 0
+#endif
+#if defined(__SANITIZE_ADDRESS__) || __has_feature(address_sanitizer)
+extern "C" void __asan_unpoison_memory_region(void const volatile* addr,
+                                              size_t size);
+#define TF_UNPOISON_STACK(base, size) __asan_unpoison_memory_region(base, size)
+#else
+#define TF_UNPOISON_STACK(base, size) ((void)0)
+#endif
+
 namespace tpurpc {
 
 size_t stack_size_of(int type) {
@@ -66,6 +82,7 @@ bool get_stack(StackStorage* s, int type, void (*entry)(void*)) {
 
 void return_stack(StackStorage* s) {
     if (s->base == nullptr) return;
+    TF_UNPOISON_STACK(s->base, s->size);
     void* raw = (char*)s->base - kGuard;
     StackPool& pool = g_pools[s->type];
     std::lock_guard<std::mutex> g(pool.mu);

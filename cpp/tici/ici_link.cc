@@ -59,20 +59,23 @@ bool IciEndpoint::Established() const {
 
 void IciEndpoint::ReleaseCompleted() {
     Pipe* p = out_;
-    const uint64_t consumed = p->tail.load(std::memory_order_acquire);
-    uint64_t from = p->released.load(std::memory_order_relaxed);
-    // Claim [from, consumed) with a CAS: the writer fiber and the pump
-    // fiber both call this concurrently, and a slot double-dec_ref'd
-    // would underflow the block refcount (use-after-free).
-    while (from < consumed) {
-        if (p->released.compare_exchange_weak(from, consumed,
-                                              std::memory_order_acq_rel)) {
-            for (uint64_t i = from; i < consumed; ++i) {
-                p->ring[i % Pipe::kDepth].block->dec_ref();
-            }
-            break;
-        }
+    // Single claimer: the writer fiber and the pump fiber both call this
+    // concurrently. The loser simply skips — the holder is about to free
+    // the same range, and `released` (hence producer credits) only
+    // advances AFTER the dec_refs are done, so no slot is reused while
+    // its old block pointer is pending.
+    bool expected = false;
+    if (!p->releasing.compare_exchange_strong(expected, true,
+                                              std::memory_order_acquire)) {
+        return;
     }
+    const uint64_t consumed = p->tail.load(std::memory_order_acquire);
+    const uint64_t from = p->released.load(std::memory_order_relaxed);
+    for (uint64_t i = from; i < consumed; ++i) {
+        p->ring[i % Pipe::kDepth].block->dec_ref();
+    }
+    p->released.store(consumed, std::memory_order_release);
+    p->releasing.store(false, std::memory_order_release);
 }
 
 ssize_t IciEndpoint::CutFromIOBufList(IOBuf* const* pieces, size_t count) {
@@ -84,8 +87,10 @@ ssize_t IciEndpoint::CutFromIOBufList(IOBuf* const* pieces, size_t count) {
     ReleaseCompleted();
     Pipe* p = out_;
     uint64_t head = p->head.load(std::memory_order_relaxed);
+    // Reuse bounded by RELEASED slots (see Pipe::credits): slots in
+    // [released, tail) still hold owned block pointers.
     const uint64_t limit =
-        p->tail.load(std::memory_order_acquire) + Pipe::kDepth;
+        p->released.load(std::memory_order_acquire) + Pipe::kDepth;
     ssize_t posted = 0;
     size_t pending_bytes = 0;
     for (size_t i = 0; i < count; ++i) pending_bytes += pieces[i]->size();

@@ -65,15 +65,18 @@ struct Pipe {
     // producer's doorbell when it consumes.
     std::atomic<bool> tx_waiting{false};
 
-    // Refs freed up to this slot. Atomic: BOTH endpoint paths (the
-    // elected-writer fiber via CutFromIOBufList and the input fiber via
-    // Pump) release completions; the CAS in ReleaseCompleted makes each
-    // slot's dec_ref happen exactly once.
+    // Refs freed up to this slot. Advanced ONLY after the dec_refs are
+    // done (single claimer via `releasing`): the producer's reuse window
+    // is bounded by `released`, so a slot is never overwritten while its
+    // old block pointer is still pending a dec_ref.
     std::atomic<uint64_t> released{0};
+    std::atomic<bool> releasing{false};
 
+    // Producer credits: bounded by RELEASED (not consumed) slots — a
+    // consumed-but-unreleased slot still holds an owned block pointer.
     uint32_t credits() const {
         return kDepth - (uint32_t)(head.load(std::memory_order_relaxed) -
-                                   tail.load(std::memory_order_acquire));
+                                   released.load(std::memory_order_acquire));
     }
 };
 

@@ -1,0 +1,485 @@
+#include "trpc/combo_channels.h"
+
+#include <atomic>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <deque>
+#include <map>
+
+#include "tbase/errno.h"
+#include "tbase/logging.h"
+#include "tfiber/fiber_sync.h"
+#include "trpc/controller.h"
+#include "trpc/naming_service.h"
+
+namespace tpurpc {
+
+// ---------------- ParallelChannel ----------------
+
+ParallelChannel::ParallelChannel(const ParallelChannelOptions* options) {
+    if (options != nullptr) options_ = *options;
+}
+
+ParallelChannel::~ParallelChannel() = default;
+
+int ParallelChannel::AddChannel(google::protobuf::RpcChannel* sub,
+                                CallMapper* mapper, ResponseMerger* merger) {
+    return AddChannelShared(sub, std::shared_ptr<CallMapper>(mapper),
+                            std::shared_ptr<ResponseMerger>(merger));
+}
+
+int ParallelChannel::AddChannelShared(google::protobuf::RpcChannel* sub,
+                                      std::shared_ptr<CallMapper> mapper,
+                                      std::shared_ptr<ResponseMerger> merger) {
+    if (sub == nullptr) return -1;
+    Sub s;
+    s.chan = sub;
+    s.mapper = std::move(mapper);
+    s.merger = std::move(merger);
+    subs_.push_back(std::move(s));
+    return 0;
+}
+
+namespace {
+
+// Aggregation state of one fanned-out call (reference
+// ParallelChannelDone, parallel_channel.cpp:40-172). Heap-allocated;
+// the LAST sub-completion finalizes the parent and deletes it.
+struct FanoutCtx {
+    struct SubState {
+        Controller cntl;
+        CallMapper::SubCall call;
+        ResponseMerger* merger = nullptr;  // borrowed from the channel
+        bool skipped = false;
+    };
+
+    Controller* parent = nullptr;
+    google::protobuf::Message* response = nullptr;
+    google::protobuf::Closure* done = nullptr;  // null = sync
+    CountdownEvent sync_wait{0};
+    // deque: SubState holds a (non-movable) Controller; elements are
+    // constructed in place and never relocated.
+    std::deque<SubState> subs;
+    std::atomic<int> nleft{0};
+    int fail_limit = 0;
+
+    static void SubDone(FanoutCtx* ctx, int index) {
+        if (ctx->nleft.fetch_sub(1, std::memory_order_acq_rel) == 1) {
+            ctx->Finish();
+        }
+        (void)index;
+    }
+
+    void Finish() {
+        // All sub-calls done: fold results in sub-channel index order
+        // (deterministic merge, independent of completion order).
+        int nfailed = 0;
+        int first_error = 0;
+        std::string first_text;
+        int nran = 0;
+        for (SubState& s : subs) {
+            if (s.skipped) continue;
+            ++nran;
+            if (s.cntl.Failed()) {
+                ++nfailed;
+                if (first_error == 0) {
+                    first_error = s.cntl.ErrorCode();
+                    first_text = s.cntl.ErrorText();
+                }
+                continue;
+            }
+            if (response != nullptr && s.call.response != nullptr) {
+                int rc = 0;
+                if (s.merger != nullptr) {
+                    rc = s.merger->Merge(response, s.call.response);
+                } else if (response != s.call.response) {
+                    response->MergeFrom(*s.call.response);
+                }
+                if (rc < 0) {
+                    ++nfailed;
+                    if (first_error == 0) {
+                        first_error = TERR_RESPONSE;
+                        first_text = "response merger failed";
+                    }
+                }
+            }
+        }
+        const int limit = fail_limit > 0 ? fail_limit : 1;
+        if (nran == 0) {
+            parent->SetFailed(TERR_INTERNAL, "all sub-calls skipped");
+        } else if (nfailed >= limit) {
+            parent->SetFailed(first_error != 0 ? first_error : TERR_INTERNAL,
+                              "%d/%d sub-calls failed: %s", nfailed, nran,
+                              first_text.c_str());
+        }
+        // Release owned sub-messages.
+        for (SubState& s : subs) {
+            if (s.call.owns_request) delete s.call.request;
+            if (s.call.owns_response) delete s.call.response;
+        }
+        google::protobuf::Closure* user_done = done;
+        if (user_done != nullptr) {
+            delete this;
+            user_done->Run();
+        } else {
+            sync_wait.signal();  // CallMethod's stack frame deletes us
+        }
+    }
+};
+
+}  // namespace
+
+void ParallelChannel::CallMethod(
+    const google::protobuf::MethodDescriptor* method,
+    google::protobuf::RpcController* controller,
+    const google::protobuf::Message* request,
+    google::protobuf::Message* response, google::protobuf::Closure* done) {
+    Controller* cntl = static_cast<Controller*>(controller);
+    if (subs_.empty()) {
+        cntl->SetFailed(TERR_INTERNAL, "ParallelChannel has no sub-channels");
+        if (done != nullptr) done->Run();
+        return;
+    }
+    auto* ctx = new FanoutCtx;
+    ctx->parent = cntl;
+    ctx->response = response;
+    ctx->done = done;
+    ctx->fail_limit = options_.fail_limit;
+    ctx->subs.resize(subs_.size());
+    const int64_t timeout_ms =
+        cntl->timeout_ms() >= 0 ? cntl->timeout_ms() : options_.timeout_ms;
+
+    // Map every sub-call first (so nleft is exact before any completion).
+    int nactive = 0;
+    for (size_t i = 0; i < subs_.size(); ++i) {
+        FanoutCtx::SubState& s = ctx->subs[i];
+        s.merger = subs_[i].merger.get();
+        if (subs_[i].mapper != nullptr) {
+            s.call = subs_[i].mapper->Map((int)i, (int)subs_.size(), method,
+                                          request, response);
+            if (s.call.skip) {
+                s.skipped = true;
+                continue;
+            }
+            if (s.call.method == nullptr) s.call.method = method;
+            if (s.call.request == nullptr) s.call.request = request;
+        } else {
+            s.call.method = method;
+            s.call.request = request;
+        }
+        if (s.call.response == nullptr) {
+            s.call.response = response->New();
+            s.call.owns_response = true;
+        }
+        ++nactive;
+    }
+    if (nactive == 0) {
+        cntl->SetFailed(TERR_INTERNAL, "all sub-calls skipped");
+        delete ctx;
+        if (done != nullptr) done->Run();
+        return;
+    }
+    ctx->nleft.store(nactive, std::memory_order_release);
+    const bool sync = done == nullptr;
+    if (sync) ctx->sync_wait.reset(1);
+
+    // Snapshot the issue list BEFORE issuing anything: once the last
+    // ACTIVE sub-call completes (possibly inline), ctx is gone — the loop
+    // must not touch it again even to skip trailing mapped-out entries.
+    struct Issue {
+        google::protobuf::RpcChannel* chan;
+        const google::protobuf::MethodDescriptor* method;
+        Controller* cntl;
+        const google::protobuf::Message* request;
+        google::protobuf::Message* response;
+        int index;
+    };
+    std::vector<Issue> issues;
+    issues.reserve(nactive);
+    for (size_t i = 0; i < subs_.size(); ++i) {
+        FanoutCtx::SubState& s = ctx->subs[i];
+        if (s.skipped) continue;
+        s.cntl.set_timeout_ms(timeout_ms);
+        s.cntl.set_max_retry(cntl->max_retry());
+        issues.push_back(Issue{subs_[i].chan, s.call.method, &s.cntl,
+                               s.call.request, s.call.response, (int)i});
+    }
+    for (const Issue& is : issues) {
+        is.chan->CallMethod(
+            is.method, is.cntl, is.request, is.response,
+            google::protobuf::NewCallback(&FanoutCtx::SubDone, ctx,
+                                          is.index));
+    }
+    if (sync) {
+        ctx->sync_wait.wait();
+        delete ctx;
+    }
+}
+
+// ---------------- PartitionParser ----------------
+
+bool PartitionParser::ParseFromTag(const std::string& tag, Partition* out) {
+    // "N/M": partition N of M.
+    int index = -1, count = 0;
+    if (sscanf(tag.c_str(), "%d/%d", &index, &count) != 2) return false;
+    if (index < 0 || count <= 0 || index >= count) return false;
+    out->index = index;
+    out->count = count;
+    return true;
+}
+
+// ---------------- PartitionChannel ----------------
+
+PartitionChannel::PartitionChannel() = default;
+PartitionChannel::~PartitionChannel() = default;
+
+namespace {
+
+// One-shot resolution through the registered naming service: stop the
+// polling loop right after its first push.
+class CollectActions : public NamingServiceActions {
+public:
+    explicit CollectActions(NamingService* ns) : ns_(ns) {}
+    void ResetServers(const std::vector<NSNode>& servers) override {
+        nodes = servers;
+        got = true;
+        ns_->Destroy();  // first push is all we need
+    }
+    NamingService* ns_;
+    std::vector<NSNode> nodes;
+    bool got = false;
+};
+
+int ResolveOnce(const char* naming_url, std::vector<NSNode>* out) {
+    const char* sep = strstr(naming_url, "://");
+    if (sep == nullptr) return -1;
+    std::string scheme(naming_url, sep - naming_url);
+    std::unique_ptr<NamingService> ns(NamingService::New(scheme));
+    if (ns == nullptr) {
+        LOG(ERROR) << "unknown naming scheme in " << naming_url;
+        return -1;
+    }
+    CollectActions actions(ns.get());
+    if (ns->RunNamingService(sep + 3, &actions) != 0 || !actions.got) {
+        return -1;
+    }
+    *out = std::move(actions.nodes);
+    return 0;
+}
+
+}  // namespace
+
+int PartitionChannel::Init(const char* naming_url, const char* lb_name,
+                           PartitionParser* parser,
+                           const PartitionChannelOptions* options) {
+    parser_.reset(parser != nullptr ? parser : new PartitionParser);
+    PartitionChannelOptions opts;
+    if (options != nullptr) opts = *options;
+    // Ownership transfers at the call, not at success: wrap before any
+    // early return or a failed Init leaks the caller's mapper/merger.
+    std::shared_ptr<CallMapper> mapper(opts.call_mapper);
+    std::shared_ptr<ResponseMerger> merger(opts.response_merger);
+
+    std::vector<NSNode> nodes;
+    if (ResolveOnce(naming_url, &nodes) != 0) return -1;
+
+    // Partition membership by tag.
+    std::map<int, std::string> members;  // index -> "ep,ep,..."
+    int count = 0;
+    for (const NSNode& n : nodes) {
+        PartitionParser::Partition p;
+        if (!parser_->ParseFromTag(n.tag, &p)) {
+            LOG(WARNING) << "unparsable partition tag '" << n.tag << "' for "
+                         << endpoint2str(n.ep);
+            continue;
+        }
+        if (count == 0) count = p.count;
+        if (p.count != count) {
+            LOG(WARNING) << "mixed partition counts " << p.count << " vs "
+                         << count << "; skipping " << endpoint2str(n.ep);
+            continue;
+        }
+        std::string& list = members[p.index];
+        if (!list.empty()) list += ",";
+        list += endpoint2str(n.ep);
+    }
+    if (count == 0 || (int)members.size() != count) {
+        LOG(ERROR) << "partition scheme incomplete: have " << members.size()
+                   << " of " << count << " partitions";
+        return -1;
+    }
+
+    ParallelChannelOptions popts = opts;
+    fanout_.reset(new ParallelChannel(&popts));
+    ChannelOptions chopts;
+    chopts.timeout_ms = opts.timeout_ms;
+    chopts.max_retry = opts.max_retry;
+    for (int i = 0; i < count; ++i) {
+        auto ch = std::make_unique<Channel>();
+        const std::string url = "list://" + members[i];
+        if (ch->Init(url.c_str(), lb_name, &chopts) != 0) return -1;
+        if (fanout_->AddChannelShared(ch.get(), mapper, merger) != 0) {
+            return -1;
+        }
+        parts_.push_back(std::move(ch));
+    }
+    nparts_ = count;
+    return 0;
+}
+
+void PartitionChannel::CallMethod(
+    const google::protobuf::MethodDescriptor* method,
+    google::protobuf::RpcController* controller,
+    const google::protobuf::Message* request,
+    google::protobuf::Message* response, google::protobuf::Closure* done) {
+    if (fanout_ == nullptr) {
+        auto* cntl = static_cast<Controller*>(controller);
+        cntl->SetFailed(TERR_INTERNAL, "PartitionChannel not initialized");
+        if (done != nullptr) done->Run();
+        return;
+    }
+    fanout_->CallMethod(method, controller, request, response, done);
+}
+
+// ---------------- SelectiveChannel ----------------
+
+int SelectiveChannel::AddChannel(google::protobuf::RpcChannel* sub) {
+    if (sub == nullptr) return -1;
+    subs_.push_back(sub);
+    return 0;
+}
+
+// Per-call retry driver: issues on one sub-channel; a failure triggers the
+// next sub-channel (the reference takes over IssueRPC via the _sender
+// hook, selective_channel.cpp; the retry-on-another-channel semantics are
+// the same).
+struct SelectiveCallCtx {
+    SelectiveChannel* chan;
+    const google::protobuf::MethodDescriptor* method;
+    Controller* parent;
+    const google::protobuf::Message* request;
+    google::protobuf::Message* response;
+    google::protobuf::Closure* done;  // null = sync
+    CountdownEvent sync_wait{1};
+    Controller sub_cntl;
+    int tries_left = 0;
+    uint32_t next_index = 0;
+
+    void IssueOne() {
+        sub_cntl.Reset();
+        sub_cntl.set_timeout_ms(parent->timeout_ms());
+        const uint32_t idx = next_index++ % (uint32_t)chan->subs_.size();
+        chan->subs_[idx]->CallMethod(
+            method, &sub_cntl, request, response,
+            google::protobuf::NewCallback(&SelectiveCallCtx::OneDone, this));
+    }
+
+    static void OneDone(SelectiveCallCtx* ctx) {
+        if (ctx->sub_cntl.Failed() && ctx->tries_left-- > 0) {
+            ctx->IssueOne();
+            return;
+        }
+        if (ctx->sub_cntl.Failed()) {
+            ctx->parent->SetFailed(ctx->sub_cntl.ErrorCode(), "%s",
+                                   ctx->sub_cntl.ErrorText().c_str());
+        }
+        google::protobuf::Closure* user_done = ctx->done;
+        if (user_done != nullptr) {
+            delete ctx;
+            user_done->Run();
+        } else {
+            ctx->sync_wait.signal();
+        }
+    }
+};
+
+void SelectiveChannel::CallMethod(
+    const google::protobuf::MethodDescriptor* method,
+    google::protobuf::RpcController* controller,
+    const google::protobuf::Message* request,
+    google::protobuf::Message* response, google::protobuf::Closure* done) {
+    Controller* cntl = static_cast<Controller*>(controller);
+    if (subs_.empty()) {
+        cntl->SetFailed(TERR_INTERNAL, "SelectiveChannel has no sub-channels");
+        if (done != nullptr) done->Run();
+        return;
+    }
+    auto* ctx = new SelectiveCallCtx;
+    ctx->chan = this;
+    ctx->method = method;
+    ctx->parent = cntl;
+    ctx->request = request;
+    ctx->response = response;
+    ctx->done = done;
+    ctx->tries_left = cntl->max_retry();
+    ctx->next_index = rr_.fetch_add(1, std::memory_order_relaxed);
+    const bool sync = done == nullptr;
+    ctx->IssueOne();
+    if (sync) {
+        ctx->sync_wait.wait();
+        delete ctx;
+    }
+}
+
+// ---------------- DynamicPartitionChannel ----------------
+
+int DynamicPartitionChannel::Init(const std::vector<std::string>& naming_urls,
+                                  const char* lb_name,
+                                  const PartitionChannelOptions* options) {
+    // mapper/merger ownership is per-PartitionChannel; forwarding one raw
+    // pointer into several schemes would double-free it. Schemes use the
+    // defaults — custom ones are not supported here yet, and the caller's
+    // objects must still be freed (ownership transferred at the call).
+    PartitionChannelOptions per_scheme;
+    if (options != nullptr) per_scheme = *options;
+    if (per_scheme.call_mapper != nullptr ||
+        per_scheme.response_merger != nullptr) {
+        LOG(WARNING) << "DynamicPartitionChannel ignores custom "
+                        "call_mapper/response_merger (schemes use defaults)";
+        delete per_scheme.call_mapper;
+        delete per_scheme.response_merger;
+    }
+    per_scheme.call_mapper = nullptr;
+    per_scheme.response_merger = nullptr;
+    for (const std::string& url : naming_urls) {
+        std::vector<NSNode> nodes;
+        int cap = 0;
+        if (ResolveOnce(url.c_str(), &nodes) == 0) cap = (int)nodes.size();
+        auto pc = std::make_unique<PartitionChannel>();
+        if (cap > 0 &&
+            pc->Init(url.c_str(), lb_name, nullptr, &per_scheme) == 0) {
+            capacities_.push_back(cap);
+            schemes_.push_back(std::move(pc));
+        } else {
+            capacities_.push_back(0);
+            schemes_.push_back(nullptr);
+        }
+    }
+    // Route to the scheme with the most servers (capacity-weighted
+    // migration narrows to "pick max" with Init-time capacities).
+    for (size_t i = 0; i < capacities_.size(); ++i) {
+        if (schemes_[i] != nullptr &&
+            (chosen_ < 0 || capacities_[i] > capacities_[chosen_])) {
+            chosen_ = (int)i;
+        }
+    }
+    return chosen_ >= 0 ? 0 : -1;
+}
+
+void DynamicPartitionChannel::CallMethod(
+    const google::protobuf::MethodDescriptor* method,
+    google::protobuf::RpcController* controller,
+    const google::protobuf::Message* request,
+    google::protobuf::Message* response, google::protobuf::Closure* done) {
+    if (chosen_ < 0) {
+        auto* cntl = static_cast<Controller*>(controller);
+        cntl->SetFailed(TERR_INTERNAL, "no usable partition scheme");
+        if (done != nullptr) done->Run();
+        return;
+    }
+    schemes_[chosen_]->CallMethod(method, controller, request, response,
+                                  done);
+}
+
+}  // namespace tpurpc

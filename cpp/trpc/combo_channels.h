@@ -1,0 +1,221 @@
+// Combo channels: fan-out, sharding and policy-routing over sub-channels —
+// the reference's parallelism-strategy family (SURVEY §2.6).
+//
+// Modeled on:
+//  - ParallelChannel (reference src/brpc/parallel_channel.h:94-262): one
+//    RPC fanned out to every sub-channel concurrently; CallMapper maps the
+//    parent call onto each sub-channel, ResponseMerger folds sub-responses
+//    into the parent response; ParallelChannelDone aggregates completions
+//    with fail_limit (parallel_channel.cpp:40-172).
+//  - PartitionChannel (src/brpc/partition_channel.h:34-93): shard-addressed
+//    fan-out; naming tags like "2/5" (partition 2 of 5) parsed by a
+//    PartitionParser route servers to per-partition sub-channels.
+//  - SelectiveChannel (src/brpc/selective_channel.h): policy routing — each
+//    call picks ONE sub-channel (round-robin here), retrying on another
+//    when it fails.
+//  - DynamicPartitionChannel (src/brpc/partition_channel.h:~130): serves
+//    whichever partition scheme currently has capacity, weighted by server
+//    count.
+//
+// In the TPU build this family is also lowered onto XLA collectives for
+// regular fan-out patterns (brpc_tpu/parallel/): ParallelChannel fan-out ==
+// AllGather, ResponseMerger == ReduceScatter (BASELINE north star).
+#pragma once
+
+#include <google/protobuf/service.h>
+
+#include <atomic>
+#include <memory>
+#include <string>
+#include <vector>
+
+#include "trpc/channel.h"
+
+namespace tpurpc {
+
+class Controller;
+
+// Maps the parent call onto sub-channel `channel_index`. Default (null
+// mapper): sub-request = parent request, sub-response = fresh instance of
+// the parent response type (merged back by the merger).
+class CallMapper {
+public:
+    struct SubCall {
+        // Null method = skip this sub-channel entirely
+        // (reference SubCall::Skip()).
+        const google::protobuf::MethodDescriptor* method = nullptr;
+        const google::protobuf::Message* request = nullptr;
+        google::protobuf::Message* response = nullptr;
+        bool owns_request = false;   // delete after the call
+        bool owns_response = false;  // delete after merging
+        bool skip = false;
+        static SubCall Skip() {
+            SubCall s;
+            s.skip = true;
+            return s;
+        }
+    };
+    virtual ~CallMapper() = default;
+    virtual SubCall Map(int channel_index, int channel_count,
+                        const google::protobuf::MethodDescriptor* method,
+                        const google::protobuf::Message* request,
+                        google::protobuf::Message* response) = 0;
+};
+
+// Folds one successful sub-response into the parent response. Default
+// (null merger): protobuf MergeFrom in sub-channel index order.
+class ResponseMerger {
+public:
+    virtual ~ResponseMerger() = default;
+    // Return 0 on success, <0 to count the sub-call as failed
+    // (reference ResponseMerger::Result).
+    virtual int Merge(google::protobuf::Message* response,
+                      const google::protobuf::Message* sub_response) = 0;
+};
+
+struct ParallelChannelOptions {
+    // Parent fails once this many sub-calls failed; <=0 means "any
+    // failure fails the parent" (reference fail_limit semantics:
+    // unset -> all sub-calls must succeed).
+    int fail_limit = 0;
+    int64_t timeout_ms = 500;
+};
+
+// Fan-out one RPC to every sub-channel concurrently.
+class ParallelChannel : public google::protobuf::RpcChannel {
+public:
+    explicit ParallelChannel(const ParallelChannelOptions* options = nullptr);
+    ~ParallelChannel() override;
+
+    // Does NOT take ownership of `sub` (channels are commonly shared);
+    // takes ownership of mapper/merger (reference takes refcounted ptrs).
+    int AddChannel(google::protobuf::RpcChannel* sub, CallMapper* mapper,
+                   ResponseMerger* merger);
+
+    int channel_count() const { return (int)subs_.size(); }
+
+    void CallMethod(const google::protobuf::MethodDescriptor* method,
+                    google::protobuf::RpcController* controller,
+                    const google::protobuf::Message* request,
+                    google::protobuf::Message* response,
+                    google::protobuf::Closure* done) override;
+
+    // Attach with shared mapper/merger instances (one stateless object
+    // serving every sub-channel — how PartitionChannel wires its
+    // partitions).
+    int AddChannelShared(google::protobuf::RpcChannel* sub,
+                         std::shared_ptr<CallMapper> mapper,
+                         std::shared_ptr<ResponseMerger> merger);
+
+private:
+    struct Sub {
+        google::protobuf::RpcChannel* chan;
+        std::shared_ptr<CallMapper> mapper;
+        std::shared_ptr<ResponseMerger> merger;
+    };
+    ParallelChannelOptions options_;
+    std::vector<Sub> subs_;
+};
+
+// Parses a naming tag into (index, count). Default: "N/M".
+class PartitionParser {
+public:
+    struct Partition {
+        int index = -1;
+        int count = 0;
+    };
+    virtual ~PartitionParser() = default;
+    virtual bool ParseFromTag(const std::string& tag, Partition* out);
+};
+
+struct PartitionChannelOptions : public ParallelChannelOptions {
+    int max_retry = 3;
+    // Applied to every partition sub-channel; owned by the
+    // PartitionChannel after Init (may be null: parent request fanned
+    // out as-is, responses MergeFrom'd).
+    CallMapper* call_mapper = nullptr;
+    ResponseMerger* response_merger = nullptr;
+};
+
+// Shard-addressed fan-out: one sub-channel per partition, fan-out to all
+// partitions per call. Partition membership comes from naming tags.
+//
+// Round-1 scope note: the server list is resolved once at Init (list://
+// and file:// schemes); live naming updates re-partitioning the set are
+// wired with the naming-thread watcher in a later milestone (reference
+// PartitionChannelBase::Init hooks the shared NamingServiceThread).
+class PartitionChannel : public google::protobuf::RpcChannel {
+public:
+    PartitionChannel();
+    ~PartitionChannel() override;
+
+    // `parser` owned; null = default "N/M" parser.
+    int Init(const char* naming_url, const char* lb_name,
+             PartitionParser* parser, const PartitionChannelOptions* options);
+
+    int partition_count() const { return nparts_; }
+
+    void CallMethod(const google::protobuf::MethodDescriptor* method,
+                    google::protobuf::RpcController* controller,
+                    const google::protobuf::Message* request,
+                    google::protobuf::Message* response,
+                    google::protobuf::Closure* done) override;
+
+private:
+    int nparts_ = 0;
+    std::unique_ptr<PartitionParser> parser_;
+    std::vector<std::unique_ptr<Channel>> parts_;
+    std::unique_ptr<ParallelChannel> fanout_;
+};
+
+// Policy routing: each call goes to ONE sub-channel; a failed call retries
+// on the next one (up to the controller's max_retry).
+class SelectiveChannel : public google::protobuf::RpcChannel {
+public:
+    SelectiveChannel() = default;
+    ~SelectiveChannel() override = default;
+
+    // Does NOT take ownership.
+    int AddChannel(google::protobuf::RpcChannel* sub);
+    int channel_count() const { return (int)subs_.size(); }
+
+    void CallMethod(const google::protobuf::MethodDescriptor* method,
+                    google::protobuf::RpcController* controller,
+                    const google::protobuf::Message* request,
+                    google::protobuf::Message* response,
+                    google::protobuf::Closure* done) override;
+
+private:
+    friend struct SelectiveCallCtx;
+    std::vector<google::protobuf::RpcChannel*> subs_;
+    std::atomic<uint32_t> rr_{0};
+};
+
+// Serves whichever partition scheme has the most capacity right now:
+// Init with several "N/M" schemes' naming urls; calls route to the scheme
+// with the most servers (reference DynamicPartitionChannel migrates
+// traffic between schemes by capacity — here capacity = resolved server
+// count at Init; live migration follows the naming-watcher milestone).
+class DynamicPartitionChannel : public google::protobuf::RpcChannel {
+public:
+    DynamicPartitionChannel() = default;
+    ~DynamicPartitionChannel() override = default;
+
+    int Init(const std::vector<std::string>& naming_urls, const char* lb_name,
+             const PartitionChannelOptions* options);
+
+    void CallMethod(const google::protobuf::MethodDescriptor* method,
+                    google::protobuf::RpcController* controller,
+                    const google::protobuf::Message* request,
+                    google::protobuf::Message* response,
+                    google::protobuf::Closure* done) override;
+
+    int chosen_scheme() const { return chosen_; }
+
+private:
+    std::vector<std::unique_ptr<PartitionChannel>> schemes_;
+    std::vector<int> capacities_;
+    int chosen_ = -1;
+};
+
+}  // namespace tpurpc

@@ -42,19 +42,12 @@ int Server::AddService(google::protobuf::Service* service) {
 }
 
 int Server::Start(const EndPoint& ep, const ServerOptions* options) {
-    if (started_) return -1;
-    GlobalInitializeOrDie();
-    if (options != nullptr) options_ = *options;
-    for (auto& kv : methods_) {
-        kv.second.status->max_concurrency = options_.max_concurrency;
-    }
-    messenger_.add_protocol(TpuStdProtocolIndex());
-    messenger_.context = this;
+    if (StartNoListen(options) != 0) return -1;
     if (acceptor_.StartAccept(ep) != 0) {
         LOG(ERROR) << "listen failed on " << endpoint2str(ep);
+        started_ = false;
         return -1;
     }
-    started_ = true;
     listening_ = true;
     return 0;
 }

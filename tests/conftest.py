@@ -16,12 +16,22 @@ BUILD_DIR = REPO / "build"
 
 # Force a deterministic virtual 8-device CPU platform for all JAX tests
 # BEFORE jax is imported anywhere.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Unconditional override: the environment may point JAX at a real
+# accelerator (e.g. JAX_PLATFORMS=axon with one chip), but this suite is
+# specified to run on the virtual 8-device CPU mesh. The env var alone is
+# NOT enough: a sitecustomize may import jax before this conftest runs,
+# locking the config default — pin the config explicitly so the
+# accelerator backend is never initialized (its remote tunnel can hang).
+os.environ["JAX_PLATFORMS"] = "cpu"
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
         xla_flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402  (after the env setup above, by design)
+
+jax.config.update("jax_platforms", "cpu")
 
 
 def _build_cpp():

@@ -54,6 +54,7 @@ public:
         return 0;
     }
 
+
     // Wait-free-ish from any thread (one atomic exchange + one fetch_add).
     // Returns -1 if stopped.
     int execute(const T& value) {
@@ -140,12 +141,21 @@ private:
                 delete *it;
             }
             // The stopped iteration is delivered exactly once (a callback
-            // may release `meta` on it); later spin passes waiting for the
-            // pending count to land must not re-deliver it.
-            if (!batch.empty() || (saw_stop && !stop_delivered)) {
+            // may release `meta` on it) — and NOTHING is delivered after
+            // it: a racing execute() that slipped past the stopping_ check
+            // must not reach fn_ once meta may be gone. stop_delivered_ is
+            // an object member because that late push can spawn a fresh
+            // consumer run with fresh locals.
+            const bool delivered_already =
+                stop_delivered_.load(std::memory_order_acquire);
+            if (!delivered_already &&
+                (!batch.empty() || (saw_stop && !stop_delivered))) {
                 TaskIterator iter(&batch);
                 iter.stopped_ = saw_stop;
                 stop_delivered |= saw_stop;
+                if (saw_stop) {
+                    stop_delivered_.store(true, std::memory_order_release);
+                }
                 fn_(meta_, iter);
             }
             // Retire when the count we processed matches all submissions;
@@ -165,6 +175,7 @@ private:
     std::atomic<Node*> head_{nullptr};
     std::atomic<int64_t> pending_{0};
     std::atomic<bool> stopping_{false};
+    std::atomic<bool> stop_delivered_{false};
     CountdownEvent join_event_{1};
 };
 

@@ -18,7 +18,8 @@ Channel::~Channel() = default;
 InputMessenger* Channel::client_messenger() {
     static InputMessenger* m = [] {
         GlobalInitializeOrDie();
-        return new InputMessenger({TpuStdProtocolIndex()});
+        return new InputMessenger(
+            {TpuStdProtocolIndex(), stream_internal::StreamProtocolIndex()});
     }();
     return m;
 }

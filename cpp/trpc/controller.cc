@@ -49,6 +49,14 @@ void Controller::Reset() {
     has_request_code_ = false;
     delete excluded_;
     excluded_ = nullptr;
+    request_stream_ = INVALID_VREF_ID;
+    request_stream_window_ = 0;
+    has_remote_stream_ = false;
+    remote_stream_id_ = 0;
+    remote_stream_window_ = 0;
+    accepted_stream_ = INVALID_VREF_ID;
+    accepted_stream_window_ = 0;
+    server_socket_ = INVALID_VREF_ID;
     server_ = nullptr;
 }
 
@@ -204,6 +212,11 @@ void Controller::IssueRPC() {
     if (log_id_ != 0) req_meta->set_log_id(log_id_);
     meta.set_correlation_id(current_cid_);
     meta.set_attachment_size((uint32_t)request_attachment_.size());
+    if (request_stream_ != INVALID_VREF_ID) {
+        auto* ss = meta.mutable_stream_settings();
+        ss->set_stream_id(request_stream_);
+        ss->set_window_size(request_stream_window_);
+    }
     IOBuf meta_buf;
     SerializePbToIOBuf(meta, &meta_buf);
     IOBuf frame;
@@ -275,6 +288,18 @@ void ProcessTpuStdResponse(TpuStdMessage* msg, const rpc::RpcMeta& meta) {
     if (cntl->response_ != nullptr &&
         !ParsePbFromIOBuf(cntl->response_, payload)) {
         cntl->SetFailed(TERR_RESPONSE, "parse response failed");
+    }
+    // Stream establishment: the server accepted (its settings ride the
+    // response meta) — bind the client stream to this connection.
+    if (cntl->request_stream() != INVALID_VREF_ID) {
+        if (!cntl->Failed() && meta.has_stream_settings()) {
+            stream_internal::ConnectClientStream(
+                cntl->request_stream(), msg->socket_id,
+                meta.stream_settings().stream_id(),
+                meta.stream_settings().window_size());
+        } else {
+            stream_internal::FailStream(cntl->request_stream());
+        }
     }
     cntl->EndRPC(cid);
 }

@@ -81,6 +81,36 @@ public:
         remote_side_ = remote;
     }
 
+    // ---- streaming plumbing (see trpc/stream.h) ----
+    // Client: StreamCreate records the local stream to announce in the
+    // request meta; the response path connects or fails it.
+    void set_request_stream(VRefId id, int64_t window) {
+        request_stream_ = id;
+        request_stream_window_ = window;
+    }
+    VRefId request_stream() const { return request_stream_; }
+    int64_t request_stream_window() const { return request_stream_window_; }
+    // Server: the requester's announced stream (from request meta).
+    void SetRemoteStream(uint64_t id, int64_t window) {
+        remote_stream_id_ = id;
+        remote_stream_window_ = window;
+        has_remote_stream_ = true;
+    }
+    bool has_remote_stream() const { return has_remote_stream_; }
+    uint64_t remote_stream_id() const { return remote_stream_id_; }
+    int64_t remote_stream_window() const { return remote_stream_window_; }
+    SocketId server_socket() const { return server_socket_; }
+    void set_server_socket(SocketId sid) { server_socket_ = sid; }
+    // Server: StreamAccept's local stream to announce in the response.
+    void set_accepted_stream(VRefId id, int64_t window) {
+        accepted_stream_ = id;
+        accepted_stream_window_ = window;
+    }
+    VRefId accepted_stream() const { return accepted_stream_; }
+    int64_t accepted_stream_window() const {
+        return accepted_stream_window_;
+    }
+
 private:
     friend class Channel;
     friend class Server;
@@ -128,6 +158,16 @@ private:
     uint64_t request_code_;
     bool has_request_code_;
     class ExcludedServers* excluded_;  // servers tried by earlier attempts
+
+    // --- streaming state ---
+    VRefId request_stream_;
+    int64_t request_stream_window_;
+    bool has_remote_stream_;
+    uint64_t remote_stream_id_;
+    int64_t remote_stream_window_;
+    VRefId accepted_stream_;
+    int64_t accepted_stream_window_;
+    SocketId server_socket_;
 
     // --- server call state ---
     Server* server_;

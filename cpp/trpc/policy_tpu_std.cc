@@ -13,6 +13,7 @@
 #include "trpc/controller.h"
 #include "trpc/pb_compat.h"
 #include "trpc/server.h"
+#include "trpc/stream.h"
 
 namespace tpurpc {
 
@@ -100,6 +101,11 @@ public:
             rmeta->set_error_text(cntl_->ErrorText());
         }
         meta.set_correlation_id(cid_);
+        if (cntl_->accepted_stream() != INVALID_VREF_ID) {
+            auto* ss = meta.mutable_stream_settings();
+            ss->set_stream_id(cntl_->accepted_stream());
+            ss->set_window_size(cntl_->accepted_stream_window());
+        }
         IOBuf payload;
         if (!cntl_->Failed()) {
             if (!SerializePbToIOBuf(*res_, &payload)) {
@@ -211,6 +217,11 @@ void ProcessTpuStdRequest(TpuStdMessage* msg, const rpc::RpcMeta& meta) {
     auto* res = mp->service->GetResponsePrototype(mp->method).New();
     auto* cntl = new Controller;
     cntl->InitServerSide(server, s->remote_side());
+    cntl->set_server_socket(sid);
+    if (meta.has_stream_settings()) {
+        cntl->SetRemoteStream(meta.stream_settings().stream_id(),
+                              meta.stream_settings().window_size());
+    }
     cntl->request_attachment() = attachment;
     const int64_t start_us = monotonic_time_us();
     auto* done = new SendResponseClosure(server, mp, cntl, req, res, sid, cid,
@@ -256,6 +267,7 @@ void GlobalInitializeOrDie() {
         p.process = ProcessTpuStdMessage;
         p.name = "tpu_std";
         g_tpu_std_index = RegisterProtocol(p);
+        stream_internal::RegisterStreamProtocolOrDie();
     });
 }
 

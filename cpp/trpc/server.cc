@@ -66,6 +66,7 @@ int Server::StartNoListen(const ServerOptions* options) {
         kv.second.status->max_concurrency = options_.max_concurrency;
     }
     messenger_.add_protocol(TpuStdProtocolIndex());
+    messenger_.add_protocol(stream_internal::StreamProtocolIndex());
     messenger_.context = this;
     started_ = true;
     listening_ = false;

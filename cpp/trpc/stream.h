@@ -1,0 +1,99 @@
+// Streaming RPC: unbounded ordered byte/message flow established by an
+// RPC, with windowed flow control.
+//
+// Modeled on reference src/brpc/stream.{h,cpp} + stream_impl.h:
+//  - StreamCreate attaches stream settings to an RPC's meta
+//    (stream.cpp:47-122); StreamAccept answers server-side; data then
+//    flows as STRM frames on the SAME connection
+//    (policy/streaming_rpc_protocol.cpp:61-156).
+//  - The reference wraps a "fake Socket" so stream writes reuse the
+//    wait-free write queue; here Stream writes frames through the real
+//    host Socket directly — the same queue, one object fewer.
+//  - Receiving side runs handler callbacks in an ExecutionQueue (ordered,
+//    batched: messages_in_batch); flow control is a window of unconsumed
+//    bytes with explicit feedback frames (stream.h:55-88, SendFeedback
+//    stream.cpp:631); writers block in StreamWait until the window opens
+//    (stream.cpp:429-474 Wait/on_writable).
+#pragma once
+
+#include <atomic>
+#include <cstdint>
+
+#include "tbase/iobuf.h"
+#include "tbase/versioned_ref.h"
+
+namespace tpurpc {
+
+class Controller;
+using StreamId = VRefId;
+constexpr StreamId INVALID_STREAM_ID = INVALID_VREF_ID;
+
+// Receiving-side callbacks. Called on an ExecutionQueue consumer fiber —
+// ordered, never concurrent for one stream.
+class StreamInputHandler {
+public:
+    virtual ~StreamInputHandler() = default;
+    virtual int on_received_messages(StreamId id, IOBuf* const messages[],
+                                     size_t size) = 0;
+    virtual void on_closed(StreamId id) = 0;
+};
+
+struct StreamOptions {
+    // Bytes of unconsumed data we allow the PEER to have in flight toward
+    // us (announced in the handshake; reference max_buf_size, default 2MB).
+    int64_t window_size = 2 * 1024 * 1024;
+    // Max messages per handler callback (reference messages_in_batch).
+    size_t messages_in_batch = 128;
+    StreamInputHandler* handler = nullptr;  // not owned
+};
+
+// ---- establishment (reference stream.h StreamCreate/StreamAccept) ----
+
+// Client side, BEFORE issuing the RPC whose cntl is passed: creates the
+// local stream and attaches settings to the RPC. The stream becomes
+// writable once the RPC response accepts it; it fails if the RPC fails
+// or the server does not accept.
+int StreamCreate(StreamId* id, Controller* cntl,
+                 const StreamOptions* options);
+
+// Server side, INSIDE the service method, before done->Run(): accepts the
+// requester's stream.
+int StreamAccept(StreamId* id, Controller* cntl,
+                 const StreamOptions* options);
+
+// ---- data plane ----
+
+// Queue one message; zero-copy moves *data. Returns 0, or -1 with errno:
+// EAGAIN (peer window full — StreamWait then retry), EINVAL (bad id),
+// EPIPE (closed).
+int StreamWrite(StreamId id, IOBuf* data);
+
+// Block the calling fiber until the stream is writable (or failed).
+// abstime_us 0 = wait forever. Returns 0 when (likely) writable.
+int StreamWait(StreamId id, int64_t abstime_us);
+
+// Close: sends a CLOSE frame, fails the local stream; the peer's handler
+// gets on_closed after delivering queued data. Idempotent-ish.
+int StreamClose(StreamId id);
+
+// ---- internals shared with the protocol layer ----
+
+namespace stream_internal {
+
+// Bind the client's half-open stream to the connection + peer settings
+// (called by the response path).
+int ConnectClientStream(StreamId id, VRefId socket_id, uint64_t peer_id,
+                        int64_t peer_window);
+void FailStream(StreamId id);  // RPC failed / peer vanished
+
+// Frame handlers (called by the STRM protocol).
+void OnStreamData(uint64_t stream_id, IOBuf* payload);
+void OnStreamFeedback(uint64_t stream_id, int64_t consumed);
+void OnStreamClose(uint64_t stream_id);
+
+void RegisterStreamProtocolOrDie();  // idempotent; index for messengers
+int StreamProtocolIndex();
+
+}  // namespace stream_internal
+
+}  // namespace tpurpc

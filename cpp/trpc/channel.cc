@@ -10,6 +10,7 @@
 #include "trpc/controller.h"
 #include "trpc/pb_compat.h"
 #include "trpc/policy_tpu_std.h"
+#include "trpc/stream.h"
 
 namespace tpurpc {
 

@@ -14,6 +14,7 @@
 #include "trpc/lb_with_naming.h"
 #include "trpc/pb_compat.h"
 #include "trpc/policy_tpu_std.h"
+#include "trpc/stream.h"
 
 namespace tpurpc {
 

@@ -6,6 +6,7 @@
 #include "tbase/logging.h"
 #include "tfiber/fiber.h"
 #include "trpc/policy_tpu_std.h"
+#include "trpc/stream.h"
 
 namespace tpurpc {
 

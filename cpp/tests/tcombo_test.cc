@@ -130,7 +130,9 @@ TEST(ParallelChannel, FailLimit) {
         EXPECT_FALSE(cntl.Failed());
         EXPECT_EQ("g:y", res.message());
     }
-    // Default fail_limit: any failure fails the parent.
+    // Default (unset) fail_limit: parent fails only when ALL sub-calls
+    // fail (reference parallel_channel.h:165-167) — one failure of two is
+    // tolerated and the successful response still merges.
     {
         ParallelChannel pc;
         ASSERT_EQ(0, pc.AddChannel(&cg, nullptr, new ConcatMerger));
@@ -141,6 +143,39 @@ TEST(ParallelChannel, FailLimit) {
         test::EchoRequest req;
         test::EchoResponse res;
         req.set_message("z");
+        stub.Echo(&cntl, &req, &res, nullptr);
+        EXPECT_FALSE(cntl.Failed()) << cntl.ErrorText();
+        EXPECT_EQ("g:z", res.message());
+    }
+    // fail_limit=1: any failure fails the parent, and the user response
+    // stays untouched (no partial merge beside a failed controller).
+    {
+        ParallelChannelOptions strict;
+        strict.fail_limit = 1;
+        ParallelChannel pc(&strict);
+        ASSERT_EQ(0, pc.AddChannel(&cg, nullptr, new ConcatMerger));
+        ASSERT_EQ(0, pc.AddChannel(&cb, nullptr, new ConcatMerger));
+        test::EchoService_Stub stub(&pc);
+        Controller cntl;
+        cntl.set_max_retry(0);
+        test::EchoRequest req;
+        test::EchoResponse res;
+        req.set_message("w");
+        stub.Echo(&cntl, &req, &res, nullptr);
+        EXPECT_TRUE(cntl.Failed());
+        EXPECT_EQ("", res.message());
+    }
+    // Default fail_limit, every sub-call failing: parent fails.
+    {
+        ParallelChannel pc;
+        ASSERT_EQ(0, pc.AddChannel(&cb, nullptr, new ConcatMerger));
+        ASSERT_EQ(0, pc.AddChannel(&cb, nullptr, new ConcatMerger));
+        test::EchoService_Stub stub(&pc);
+        Controller cntl;
+        cntl.set_max_retry(0);
+        test::EchoRequest req;
+        test::EchoResponse res;
+        req.set_message("v");
         stub.Echo(&cntl, &req, &res, nullptr);
         EXPECT_TRUE(cntl.Failed());
     }

@@ -135,6 +135,13 @@ int IciEndpoint::WaitWritable(int64_t abstime_us) {
     // Tell the consumer to ring our doorbell when it consumes, then
     // re-check credits (the consume may have happened in between).
     p->tx_waiting.store(true, std::memory_order_release);
+    // Fold already-consumed slots into `released` before the credit
+    // re-check: credits() reads the producer-side `released` counter,
+    // which only advances here — a consume that landed between the last
+    // release pass and the tx_waiting store above produced no doorbell
+    // (tx_waiting was still false), and without this the writer parks for
+    // the whole wait despite free credits.
+    ReleaseCompleted();
     if (p->credits() > 0 || p->closed.load(std::memory_order_acquire) ||
         in_->closed.load(std::memory_order_acquire)) {
         p->tx_waiting.store(false, std::memory_order_release);

@@ -93,6 +93,11 @@ void Channel::CallMethod(const google::protobuf::MethodDescriptor* method,
     if (id_create(&cntl->correlation_id_, cntl,
                   &Controller::HandleErrorThunk) != 0) {
         cntl->SetFailed(TERR_INTERNAL, "id_create failed");
+        // This path never reaches EndRPC (there is no id to destroy), so
+        // release any pre-attached client stream here.
+        if (cntl->request_stream() != INVALID_VREF_ID) {
+            stream_internal::FailStream(cntl->request_stream());
+        }
         if (done) done->Run();
         return;
     }

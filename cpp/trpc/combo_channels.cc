@@ -1,11 +1,13 @@
 #include "trpc/combo_channels.h"
 
+#include <algorithm>
 #include <atomic>
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
 #include <deque>
 #include <map>
+#include <memory>
 
 #include "tbase/errno.h"
 #include "tbase/logging.h"
@@ -72,8 +74,10 @@ struct FanoutCtx {
     }
 
     void Finish() {
-        // All sub-calls done: fold results in sub-channel index order
-        // (deterministic merge, independent of completion order).
+        // All sub-calls done. Count failures FIRST: once the call is known
+        // failed, the user's response must stay untouched — no partial
+        // merge beside a SetFailed controller (reference
+        // parallel_channel.cpp:313-319 counts then merges).
         int nfailed = 0;
         int first_error = 0;
         std::string first_text;
@@ -87,14 +91,32 @@ struct FanoutCtx {
                     first_error = s.cntl.ErrorCode();
                     first_text = s.cntl.ErrorText();
                 }
-                continue;
             }
-            if (response != nullptr && s.call.response != nullptr) {
+        }
+        // Unset (<=0) fail_limit matches the reference default: the parent
+        // fails only when ALL sub-calls failed (parallel_channel.h:165-167).
+        // Clamp to nran: a limit above the ran count must not report total
+        // failure as success.
+        const int limit = std::min(fail_limit > 0 ? fail_limit : nran,
+                                   nran > 0 ? nran : 1);
+        if (nfailed < limit && response != nullptr) {
+            // Call so far succeeded: fold successful sub-responses in
+            // sub-channel index order (deterministic merge, independent of
+            // completion order). Merge into a scratch message so a merger
+            // rejection that pushes the call over the limit leaves the
+            // user's response untouched (no partial merge beside a failed
+            // controller).
+            std::unique_ptr<google::protobuf::Message> scratch(
+                response->New());
+            scratch->CopyFrom(*response);
+            for (SubState& s : subs) {
+                if (s.skipped || s.cntl.Failed()) continue;
+                if (s.call.response == nullptr) continue;
                 int rc = 0;
                 if (s.merger != nullptr) {
-                    rc = s.merger->Merge(response, s.call.response);
+                    rc = s.merger->Merge(scratch.get(), s.call.response);
                 } else if (response != s.call.response) {
-                    response->MergeFrom(*s.call.response);
+                    scratch->MergeFrom(*s.call.response);
                 }
                 if (rc < 0) {
                     ++nfailed;
@@ -104,8 +126,10 @@ struct FanoutCtx {
                     }
                 }
             }
+            if (nfailed < limit) {
+                response->GetReflection()->Swap(response, scratch.get());
+            }
         }
-        const int limit = fail_limit > 0 ? fail_limit : 1;
         if (nran == 0) {
             parent->SetFailed(TERR_INTERNAL, "all sub-calls skipped");
         } else if (nfailed >= limit) {

@@ -74,9 +74,9 @@ public:
 };
 
 struct ParallelChannelOptions {
-    // Parent fails once this many sub-calls failed; <=0 means "any
-    // failure fails the parent" (reference fail_limit semantics:
-    // unset -> all sub-calls must succeed).
+    // Parent fails once this many sub-calls failed; <=0 (unset) matches
+    // the reference default: the parent fails only when ALL sub-calls
+    // failed (reference parallel_channel.h:165-167).
     int fail_limit = 0;
     int64_t timeout_ms = 500;
 };

@@ -52,6 +52,7 @@ void Controller::Reset() {
     excluded_ = nullptr;
     request_stream_ = INVALID_VREF_ID;
     request_stream_window_ = 0;
+    request_stream_bound_ = false;
     has_remote_stream_ = false;
     remote_stream_id_ = 0;
     remote_stream_window_ = 0;
@@ -236,6 +237,13 @@ void* Controller::RunDoneThunk(void* arg) {
 void Controller::EndRPC(CallId locked_id) {
     latency_us_ = monotonic_time_us() - start_us_;
     FeedbackToLB(error_code_);
+    // A client stream that never got bound to a connection must be failed
+    // here — EndRPC is the single funnel every termination path (success
+    // without stream settings, server error, timeout, socket failure)
+    // passes through, so the stream's creation/rx refs can't leak.
+    if (request_stream_ != INVALID_VREF_ID && !request_stream_bound_) {
+        stream_internal::FailStream(request_stream_);
+    }
     if (timeout_timer_ != INVALID_TIMER_ID) {
         // Best-effort: if the callback is running it will find the id
         // destroyed (it only holds the id VALUE, never this pointer).
@@ -291,15 +299,16 @@ void ProcessTpuStdResponse(TpuStdMessage* msg, const rpc::RpcMeta& meta) {
         cntl->SetFailed(TERR_RESPONSE, "parse response failed");
     }
     // Stream establishment: the server accepted (its settings ride the
-    // response meta) — bind the client stream to this connection.
-    if (cntl->request_stream() != INVALID_VREF_ID) {
-        if (!cntl->Failed() && meta.has_stream_settings()) {
-            stream_internal::ConnectClientStream(
+    // response meta) — bind the client stream to this connection. Any
+    // not-bound stream (including the early-return error paths above) is
+    // failed centrally by EndRPC.
+    if (cntl->request_stream() != INVALID_VREF_ID && !cntl->Failed() &&
+        meta.has_stream_settings()) {
+        if (stream_internal::ConnectClientStream(
                 cntl->request_stream(), msg->socket_id,
                 meta.stream_settings().stream_id(),
-                meta.stream_settings().window_size());
-        } else {
-            stream_internal::FailStream(cntl->request_stream());
+                meta.stream_settings().window_size()) == 0) {
+            cntl->set_request_stream_bound();
         }
     }
     cntl->EndRPC(cid);

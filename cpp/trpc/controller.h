@@ -90,6 +90,11 @@ public:
     }
     VRefId request_stream() const { return request_stream_; }
     int64_t request_stream_window() const { return request_stream_window_; }
+    // Set once the response path bound the stream to a connection; EndRPC
+    // fails any still-unbound stream so every termination path (timeout,
+    // socket failure, server error, parse error) releases it (reference:
+    // Controller::EndRPC -> HandleStreamConnection fails _request_stream).
+    void set_request_stream_bound() { request_stream_bound_ = true; }
     // Server: the requester's announced stream (from request meta).
     void SetRemoteStream(uint64_t id, int64_t window) {
         remote_stream_id_ = id;
@@ -162,6 +167,7 @@ private:
     // --- streaming state ---
     VRefId request_stream_;
     int64_t request_stream_window_;
+    bool request_stream_bound_;
     bool has_remote_stream_;
     uint64_t remote_stream_id_;
     int64_t remote_stream_window_;

@@ -74,12 +74,19 @@ def main():
         return out
 
     # Headline: echo over the ICI transport (the point of the project —
-    # SURVEY §2.9 north star). TCP-loopback numbers ride along for
-    # comparison against the reference's own transport.
+    # SURVEY §2.9 north star). The cross-process shared-memory link
+    # (handshake over TCP, registered-memory data plane — the product
+    # transport) and TCP loopback ride along for comparison.
     ici = run_tool("echo_bench", ["--json", "--ici"])
+    xproc = run_tool("echo_bench", ["--json", "--xproc"])
     tcp = run_tool("echo_bench", ["--json"])
     if ici is not None and "mbps" in ici:
         out = assemble(ici, "echo_throughput_1MB_ici", "ici_")
+        if xproc is not None and "mbps" in xproc:
+            out["xproc_mbps"] = xproc["mbps"]
+            for k in ("qps_4k", "p99_us_4k"):
+                if k in xproc:
+                    out["xproc_" + k] = xproc[k]
         if tcp is not None and "mbps" in tcp:
             out["tcp_mbps"] = tcp["mbps"]
             for k in ("qps_4k", "p99_us_4k"):

@@ -106,6 +106,17 @@ TLSData::~TLSData() {
 
 size_t IOBuf::tls_cached_blocks() { return tls_data.num_cached; }
 
+void IOBuf::flush_tls_cache() {
+    IOBuf::Block* b = tls_data.cache_head;
+    tls_data.cache_head = nullptr;
+    tls_data.num_cached = 0;
+    while (b) {
+        IOBuf::Block* next = b->portal_next;
+        b->dealloc(b);
+        b = next;
+    }
+}
+
 // Returns the thread's current append block (holding a TLS ref), creating a
 // fresh one when absent or full.
 static IOBuf::Block* share_tls_block() {

@@ -126,6 +126,10 @@ public:
     static Block* create_block(size_t block_size = DEFAULT_BLOCK_SIZE);
     // Thread-local block cache stats (tests).
     static size_t tls_cached_blocks();
+    // Return this thread's cached blocks to their deallocators (a pool
+    // allocator can then reuse them for region-constrained needs, e.g.
+    // cross-process bounce buffers when the shared region ran dry).
+    static void flush_tls_cache();
 
 protected:
     friend class IOPortal;

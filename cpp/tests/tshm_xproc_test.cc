@@ -1,0 +1,242 @@
+// Cross-process ICI transport tests: TCP-handshake bootstrap + shared-
+// memory data plane between two real processes (reference analog:
+// test/brpc_socket_unittest + rdma handshake paths; SURVEY §2.9).
+//
+// The server side is `echo_bench --ici-server`, spawned fork+exec (exec
+// immediately — forking a threaded test binary is only safe up to exec).
+// Covers: echo across processes, handshake rejection (bad version),
+// client half-close (server survives, accepts a new link), and peer
+// crash (SIGKILL mid-link fails the socket via the TCP failure detector).
+#include <libgen.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <signal.h>
+#include <sys/socket.h>
+#include <sys/wait.h>
+#include <unistd.h>
+
+#include <cstring>
+#include <string>
+
+#include "bench_echo.pb.h"
+#include "tbase/endpoint.h"
+#include "tici/block_pool.h"
+#include "tici/shm_link.h"
+#include "tnet/socket.h"
+#include "trpc/channel.h"
+#include "trpc/controller.h"
+#include "ttest/ttest.h"
+
+using namespace tpurpc;
+
+namespace {
+
+std::string bench_binary_path() {
+    char self[4096];
+    const ssize_t n = readlink("/proc/self/exe", self, sizeof(self) - 1);
+    if (n <= 0) return "";
+    self[n] = '\0';
+    return std::string(dirname(self)) + "/echo_bench";
+}
+
+struct ServerChild {
+    pid_t pid = -1;
+    int port = 0;
+    int stdin_wr = -1;  // closing it shuts the child down
+
+    bool Spawn() {
+        const std::string bin = bench_binary_path();
+        int out_pipe[2], in_pipe[2];
+        if (pipe(out_pipe) != 0 || pipe(in_pipe) != 0) return false;
+        pid = fork();
+        if (pid < 0) return false;
+        if (pid == 0) {
+            dup2(out_pipe[1], 1);
+            dup2(in_pipe[0], 0);
+            close(out_pipe[0]);
+            close(out_pipe[1]);
+            close(in_pipe[0]);
+            close(in_pipe[1]);
+            execl(bin.c_str(), "echo_bench", "--ici-server", (char*)nullptr);
+            _exit(127);
+        }
+        close(out_pipe[1]);
+        close(in_pipe[0]);
+        stdin_wr = in_pipe[1];
+        char line[64];
+        size_t got = 0;
+        while (got < sizeof(line) - 1) {
+            const ssize_t r = read(out_pipe[0], line + got, 1);
+            if (r <= 0) break;
+            if (line[got] == '\n') break;
+            ++got;
+        }
+        line[got] = '\0';
+        close(out_pipe[0]);
+        return sscanf(line, "PORT %d", &port) == 1;
+    }
+
+    void Shutdown() {
+        if (stdin_wr >= 0) {
+            close(stdin_wr);
+            stdin_wr = -1;
+        }
+        if (pid > 0) {
+            // Bounded wait, then escalate.
+            for (int i = 0; i < 300; ++i) {
+                if (waitpid(pid, nullptr, WNOHANG) == pid) {
+                    pid = -1;
+                    return;
+                }
+                usleep(10000);
+            }
+            kill(pid, SIGKILL);
+            waitpid(pid, nullptr, 0);
+            pid = -1;
+        }
+    }
+
+    void Kill9() {
+        if (pid > 0) {
+            kill(pid, SIGKILL);
+            waitpid(pid, nullptr, 0);
+            pid = -1;
+        }
+        if (stdin_wr >= 0) {
+            close(stdin_wr);
+            stdin_wr = -1;
+        }
+    }
+
+    ~ServerChild() { Kill9(); }
+};
+
+int DoEcho(Channel& ch, const std::string& payload, std::string* echoed) {
+    benchpb::EchoService_Stub stub(&ch);
+    Controller cntl;
+    cntl.set_timeout_ms(3000);
+    benchpb::EchoRequest req;
+    benchpb::EchoResponse res;
+    req.set_send_ts_us(42);
+    cntl.request_attachment().append(payload);
+    stub.Echo(&cntl, &req, &res, nullptr);
+    if (cntl.Failed()) return cntl.ErrorCode();
+    *echoed = cntl.response_attachment().to_string();
+    return 0;
+}
+
+}  // namespace
+
+TEST(ShmXproc, EchoAcrossProcesses) {
+    ASSERT_EQ(0, IciBlockPool::Init());
+    ServerChild child;
+    ASSERT_TRUE(child.Spawn());
+    EndPoint ep;
+    str2endpoint("127.0.0.1", child.port, &ep);
+    Channel ch;
+    ChannelOptions copts;
+    copts.timeout_ms = 3000;
+    ASSERT_EQ(0, ch.InitIci(ep, &copts));
+    // Small payload and a payload larger than one block (spans multiple
+    // descriptors + exercises the ring).
+    std::string echoed;
+    ASSERT_EQ(0, DoEcho(ch, "hello-over-shm", &echoed));
+    EXPECT_EQ("hello-over-shm", echoed);
+    std::string big(512 * 1024, 'x');
+    for (size_t i = 0; i < big.size(); i += 4096) big[i] = (char)('a' + (i / 4096) % 26);
+    ASSERT_EQ(0, DoEcho(ch, big, &echoed));
+    EXPECT_TRUE(echoed == big);
+    child.Shutdown();
+}
+
+TEST(ShmXproc, HandshakeBadVersionRejected) {
+    ServerChild child;
+    ASSERT_TRUE(child.Spawn());
+    // Craft a handshake with an unsupported version directly over TCP.
+    const int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+    ASSERT_GE(fd, 0);
+    sockaddr_in addr;
+    EndPoint ep;
+    str2endpoint("127.0.0.1", child.port, &ep);
+    endpoint2sockaddr(ep, &addr);
+    ASSERT_EQ(0, ::connect(fd, (sockaddr*)&addr, sizeof(addr)));
+    shm_internal::HandshakeRequest req;
+    memset(&req, 0, sizeof(req));
+    memcpy(req.magic, "TICI", 4);
+    req.version = 99;
+    snprintf(req.pool_name, sizeof(req.pool_name), "/nonexistent");
+    req.pool_size = 1 << 20;
+    snprintf(req.link_name, sizeof(req.link_name), "/nonexistent");
+    req.link_size = sizeof(shm_internal::ShmLinkCtrl);
+    ASSERT_EQ((ssize_t)sizeof(req), write(fd, &req, sizeof(req)));
+    shm_internal::HandshakeResponse rsp;
+    size_t got = 0;
+    while (got < sizeof(rsp)) {
+        const ssize_t r = read(fd, (char*)&rsp + got, sizeof(rsp) - got);
+        if (r <= 0) break;
+        got += (size_t)r;
+    }
+    ASSERT_EQ(sizeof(rsp), got);
+    EXPECT_EQ(0, memcmp(rsp.magic, "TICJ", 4));
+    EXPECT_NE(0u, rsp.status);
+    close(fd);
+    child.Shutdown();
+}
+
+TEST(ShmXproc, HalfCloseThenReconnect) {
+    ASSERT_EQ(0, IciBlockPool::Init());
+    ServerChild child;
+    ASSERT_TRUE(child.Spawn());
+    EndPoint ep;
+    str2endpoint("127.0.0.1", child.port, &ep);
+    {
+        // First link: use it, then fail the client socket (half-close).
+        SocketId sid;
+        ASSERT_EQ(0, IciConnect(ep, Channel::client_messenger(), &sid));
+        Channel ch;
+        ASSERT_EQ(0, ch.InitWithSocketId(sid, nullptr));
+        std::string echoed;
+        ASSERT_EQ(0, DoEcho(ch, "first-link", &echoed));
+        SocketUniquePtr s = SocketUniquePtr::FromId(sid);
+        ASSERT_TRUE((bool)s);
+        s->SetFailed();  // client-side close: transport Close -> EOF at peer
+    }
+    // The server must survive the half-close and accept a fresh link.
+    Channel ch2;
+    ASSERT_EQ(0, ch2.InitIci(ep, nullptr));
+    std::string echoed;
+    ASSERT_EQ(0, DoEcho(ch2, "second-link", &echoed));
+    EXPECT_EQ("second-link", echoed);
+    child.Shutdown();
+}
+
+TEST(ShmXproc, PeerCrashFailsSocket) {
+    ASSERT_EQ(0, IciBlockPool::Init());
+    ServerChild child;
+    ASSERT_TRUE(child.Spawn());
+    EndPoint ep;
+    str2endpoint("127.0.0.1", child.port, &ep);
+    SocketId sid;
+    ASSERT_EQ(0, IciConnect(ep, Channel::client_messenger(), &sid));
+    Channel ch;
+    ASSERT_EQ(0, ch.InitWithSocketId(sid, nullptr));
+    std::string echoed;
+    ASSERT_EQ(0, DoEcho(ch, "pre-crash", &echoed));
+    // SIGKILL the server: no orderly close, only the TCP RST/EOF failure
+    // detector. The client socket must fail (promptly, via the dispatcher)
+    // and subsequent RPCs must error rather than hang.
+    child.Kill9();
+    int rc = -1;
+    for (int i = 0; i < 100; ++i) {
+        rc = DoEcho(ch, "post-crash", &echoed);
+        if (rc != 0) break;
+        usleep(20000);
+    }
+    EXPECT_NE(0, rc);
+    SocketUniquePtr s = SocketUniquePtr::FromId(sid);
+    // The versioned id must now be stale (socket failed) or at least the
+    // endpoint must report not-established.
+    if (s) {
+        EXPECT_TRUE(s->Failed() || !s->transport()->Established());
+    }
+}

@@ -1,14 +1,20 @@
 #include "tici/block_pool.h"
 
+#include <fcntl.h>
 #include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
 
 #include <atomic>
+#include <cstdio>
 #include <cstdlib>
+#include <cstring>
 #include <mutex>
 #include <vector>
 
 #include "tbase/iobuf.h"
 #include "tbase/logging.h"
+#include "tbase/fast_rand.h"
 
 namespace tpurpc {
 
@@ -21,12 +27,25 @@ struct Region {
 
 struct PoolState {
     std::mutex mu;
-    std::vector<Region> regions;
-    std::vector<void*> freelist;   // default-size blocks
+    std::vector<Region> regions;   // [0] is the shared primary (if any)
+    // Freed default-size blocks, partitioned by transferability: blocks
+    // inside the shared primary can be posted to peers zero-copy and are
+    // preferred on allocation (keeps the zero-copy rate high after the
+    // pool has ever overflowed into anonymous regions).
+    std::vector<void*> freelist_shared;
+    std::vector<void*> freelist_other;
     size_t region_step = 64u << 20;
     size_t carve_offset = 0;       // into regions.back()
     std::atomic<size_t> live{0};
     std::atomic<bool> inited{false};
+    char shm_name[64] = "";
+    char* shm_base = nullptr;
+    size_t shm_size = 0;
+
+    bool in_shared(const void* ptr) const {
+        const char* c = (const char*)ptr;
+        return shm_base != nullptr && c >= shm_base && c < shm_base + shm_size;
+    }
 };
 
 PoolState& pool() {
@@ -34,7 +53,53 @@ PoolState& pool() {
     return p;
 }
 
-// mmap one more region. Caller holds mu.
+void unlink_shm_at_exit() {
+    PoolState& p = pool();
+    if (p.shm_name[0] != '\0') shm_unlink(p.shm_name);
+}
+
+// Create the primary region as a named POSIX shm segment so peers can map
+// it (the "memory registration" of this transport). Returns false on any
+// failure; caller falls back to an anonymous region. Caller holds mu.
+bool create_shared_primary_locked(PoolState& p) {
+    snprintf(p.shm_name, sizeof(p.shm_name), "/tpurpc_pool_%d_%08lx",
+             (int)getpid(), (unsigned long)fast_rand());
+    const int fd = shm_open(p.shm_name, O_CREAT | O_EXCL | O_RDWR, 0600);
+    if (fd < 0) {
+        PLOG(WARNING) << "IciBlockPool: shm_open " << p.shm_name
+                      << " failed; pool is process-local";
+        p.shm_name[0] = '\0';
+        return false;
+    }
+    if (ftruncate(fd, (off_t)p.region_step) != 0) {
+        PLOG(ERROR) << "IciBlockPool: ftruncate failed";
+        close(fd);
+        shm_unlink(p.shm_name);
+        p.shm_name[0] = '\0';
+        return false;
+    }
+    void* mem = mmap(nullptr, p.region_step, PROT_READ | PROT_WRITE,
+                     MAP_SHARED, fd, 0);
+    close(fd);  // the mapping keeps the segment alive
+    if (mem == MAP_FAILED) {
+        PLOG(ERROR) << "IciBlockPool: mmap shared primary failed";
+        shm_unlink(p.shm_name);
+        p.shm_name[0] = '\0';
+        return false;
+    }
+    p.shm_base = (char*)mem;
+    p.shm_size = p.region_step;
+    p.regions.push_back(Region{(char*)mem, p.region_step});
+    p.carve_offset = 0;
+    // The name must outlive process setup so late-connecting peers can
+    // map it; unlink on orderly exit (a crash leaves a /dev/shm entry the
+    // next Init from the same pid range won't collide with — names embed
+    // pid+random).
+    atexit(unlink_shm_at_exit);
+    return true;
+}
+
+// mmap one more (anonymous, process-local) region. Caller holds mu.
 bool grow_locked(PoolState& p) {
     void* mem = mmap(nullptr, p.region_step, PROT_READ | PROT_WRITE,
                      MAP_PRIVATE | MAP_ANONYMOUS, -1, 0);
@@ -53,9 +118,15 @@ void* IciBlockPool::Allocate(size_t n) {
     PoolState& p = pool();
     if (n == IOBuf::DEFAULT_BLOCK_SIZE) {
         std::lock_guard<std::mutex> g(p.mu);
-        if (!p.freelist.empty()) {
-            void* b = p.freelist.back();
-            p.freelist.pop_back();
+        if (!p.freelist_shared.empty()) {
+            void* b = p.freelist_shared.back();
+            p.freelist_shared.pop_back();
+            p.live.fetch_add(1, std::memory_order_relaxed);
+            return b;
+        }
+        if (!p.freelist_other.empty()) {
+            void* b = p.freelist_other.back();
+            p.freelist_other.pop_back();
             p.live.fetch_add(1, std::memory_order_relaxed);
             return b;
         }
@@ -70,7 +141,7 @@ void* IciBlockPool::Allocate(size_t n) {
     }
     // Odd-size block: plain malloc, tagged so Deallocate can tell it from
     // a pool block (a real libtpu build would register these mappings on
-    // demand; the fake-ICI loopback can transfer from any memory).
+    // demand; the send path bounce-copies them into pool blocks).
     void* mem = malloc(n);
     return mem;
 }
@@ -82,13 +153,37 @@ void IciBlockPool::Deallocate(void* b) {
         const char* c = (const char*)b;
         for (const Region& r : p.regions) {
             if (c >= r.base && c < r.base + r.size) {
-                p.freelist.push_back(b);
+                (p.in_shared(b) ? p.freelist_shared : p.freelist_other)
+                    .push_back(b);
                 p.live.fetch_sub(1, std::memory_order_relaxed);
                 return;
             }
         }
     }
     free(b);  // odd-size malloc'd block
+}
+
+void IciBlockPool::DeallocateShared(void* p) { Deallocate(p); }
+
+void* IciBlockPool::AllocateSharedBlock() {
+    PoolState& p = pool();
+    std::lock_guard<std::mutex> g(p.mu);
+    if (p.shm_base == nullptr) return nullptr;
+    if (!p.freelist_shared.empty()) {
+        void* b = p.freelist_shared.back();
+        p.freelist_shared.pop_back();
+        p.live.fetch_add(1, std::memory_order_relaxed);
+        return b;
+    }
+    // Carve only while the carve pointer is still inside the primary.
+    if (!p.regions.empty() && p.regions.back().base == p.shm_base &&
+        p.carve_offset + IOBuf::DEFAULT_BLOCK_SIZE <= p.regions.back().size) {
+        void* b = p.regions.back().base + p.carve_offset;
+        p.carve_offset += IOBuf::DEFAULT_BLOCK_SIZE;
+        p.live.fetch_add(1, std::memory_order_relaxed);
+        return b;
+    }
+    return nullptr;
 }
 
 bool IciBlockPool::Contains(const void* ptr) {
@@ -101,6 +196,23 @@ bool IciBlockPool::Contains(const void* ptr) {
     return false;
 }
 
+const char* IciBlockPool::shm_name() { return pool().shm_name; }
+size_t IciBlockPool::shm_size() { return pool().shm_size; }
+char* IciBlockPool::shm_base() { return pool().shm_base; }
+
+bool IciBlockPool::OffsetOf(const void* ptr, uint64_t* offset) {
+    PoolState& p = pool();
+    // shm_base/shm_size are written once under Init's mu and read-only
+    // after; no lock needed on this hot path.
+    const char* c = (const char*)ptr;
+    if (p.shm_base == nullptr || c < p.shm_base ||
+        c >= p.shm_base + p.shm_size) {
+        return false;
+    }
+    *offset = (uint64_t)(c - p.shm_base);
+    return true;
+}
+
 int IciBlockPool::Init(size_t region_bytes) {
     PoolState& p = pool();
     bool expected = false;
@@ -108,7 +220,10 @@ int IciBlockPool::Init(size_t region_bytes) {
     {
         std::lock_guard<std::mutex> g(p.mu);
         p.region_step = region_bytes < (1u << 20) ? (1u << 20) : region_bytes;
-        if (!grow_locked(p)) {
+        // Primary region: shared (cross-process transferable). Fall back
+        // to anonymous when /dev/shm is unavailable — in-process links
+        // still work, cross-process connects will refuse.
+        if (!create_shared_primary_locked(p) && !grow_locked(p)) {
             p.inited.store(false);
             return -1;
         }
@@ -116,8 +231,13 @@ int IciBlockPool::Init(size_t region_bytes) {
     // From here on every new IOBuf block is transferable memory (the
     // TLS block cache only recycles blocks whose deallocator matches the
     // current pair, so stale malloc'd blocks are not handed back out).
-    IOBuf::blockmem_allocate = &IciBlockPool::Allocate;
+    // Deallocate hook FIRST: Init may run lazily (first ICI handshake on
+    // a busy server) while other threads allocate; a racer that sees the
+    // new allocator must also see a deallocator that can free its block
+    // (Deallocate falls back to free() for non-pool pointers, so the
+    // reverse mix is safe — free() on a pool block is not).
     IOBuf::blockmem_deallocate = &IciBlockPool::Deallocate;
+    IOBuf::blockmem_allocate = &IciBlockPool::Allocate;
     return 0;
 }
 
@@ -132,7 +252,7 @@ size_t IciBlockPool::allocated_blocks() {
 size_t IciBlockPool::free_blocks() {
     PoolState& p = pool();
     std::lock_guard<std::mutex> g(p.mu);
-    return p.freelist.size();
+    return p.freelist_shared.size() + p.freelist_other.size();
 }
 
 }  // namespace tpurpc

@@ -5,12 +5,16 @@
 // Modeled on reference src/brpc/rdma/block_pool.{h,cpp} (628 LoC): the
 // RDMA build registers GB-step regions with the NIC and swaps IOBuf's
 // `blockmem_allocate` hook (butil/iobuf.cpp:168) so send buffers need no
-// bounce copy. Here "registered" means: carved from mmap'd regions the
-// transfer engine may DMA from — on real TPU-VM hosts these become
-// libtpu-registered / pinned host buffers; the fake-ICI loopback treats
-// any pool region as transferable. Structure kept: regions grown in
-// fixed steps, freelist under a mutex (the per-thread IOBuf block cache
-// in front absorbs nearly all traffic), O(1) Contains() via region list.
+// bounce copy. Here "registered" means: the PRIMARY region is a named
+// POSIX shared-memory segment other processes can map (the cross-process
+// "memory registration"), so a peer can resolve posted (offset,length)
+// descriptors against its read-only mapping of this pool — on real
+// TPU-VM hosts this seam becomes libtpu-registered / pinned host
+// buffers. Overflow regions are anonymous (non-transferable; the send
+// path bounce-copies from them). Structure kept from the reference:
+// regions grown in fixed steps, freelist under a mutex (the per-thread
+// IOBuf block cache in front absorbs nearly all traffic), O(1)
+// Contains() via the region list.
 #pragma once
 
 #include <cstddef>
@@ -21,15 +25,37 @@ namespace tpurpc {
 class IciBlockPool {
 public:
     // Install the pool as IOBuf's block allocator. Idempotent.
-    // `region_bytes` is the mmap growth step (default 64MB).
+    // `region_bytes` sizes the primary (shared, transferable) region;
+    // overflow grows in anonymous regions of the same step.
     static int Init(size_t region_bytes = 64u << 20);
 
     // Allocator pair installed into IOBuf::blockmem_allocate/deallocate.
     static void* Allocate(size_t n);
     static void Deallocate(void* p);
+    // A DEFAULT_BLOCK_SIZE block guaranteed inside the shared region, or
+    // null when none is free (bounce buffers for the cross-process send
+    // path, which must be peer-visible). Deallocate() as usual.
+    static void* AllocateSharedBlock();
+    // Deallocator for bounce blocks: same routing as Deallocate, but a
+    // DISTINCT function pointer so IOBuf::Block::dec_ref bypasses the TLS
+    // block cache (bounce blocks must return to the shared freelist where
+    // AllocateSharedBlock can find them, not vanish into a thread cache).
+    static void DeallocateShared(void* p);
 
-    // True if p lies inside a registered region (i.e. transferable).
+    // True if p lies inside a registered region (pool memory; primary or
+    // overflow).
     static bool Contains(const void* p);
+
+    // ---- cross-process registration (the shared primary region) ----
+    // Name of the shm segment backing the primary region ("" when the
+    // pool fell back to anonymous memory). Peers shm_open this name
+    // during the ICI handshake.
+    static const char* shm_name();
+    static size_t shm_size();
+    static char* shm_base();
+    // True + byte offset into the shared region when p points into it —
+    // i.e. the bytes at p can be posted to a peer zero-copy.
+    static bool OffsetOf(const void* p, uint64_t* offset);
 
     static bool initialized();
     static size_t allocated_blocks();  // live default-size blocks

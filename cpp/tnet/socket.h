@@ -105,6 +105,15 @@ public:
 
     // Plugged data-plane transport (ICI), or null for the fd path.
     TransportEndpoint* transport() const { return transport_; }
+    // Upgrade a live connection to a transport data plane (server side of
+    // the ICI handshake). Must be called from the socket's input fiber
+    // with no concurrent writers — i.e. before the peer can have sent any
+    // post-handshake request (the handshake protocol guarantees this).
+    // The socket takes ownership (Release()d at recycle).
+    void InstallTransport(TransportEndpoint* t) {
+        transport_ = t;
+        owns_transport_ = true;
+    }
 
     // ---- per-connection parsing state (owned by InputMessenger) ----
     IOPortal read_buf;

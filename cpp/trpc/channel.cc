@@ -6,6 +6,7 @@
 #include "tbase/logging.h"
 #include "tbase/time.h"
 #include "tfiber/call_id.h"
+#include "tici/shm_link.h"
 #include "trpc/lb_with_naming.h"
 #include "trpc/controller.h"
 #include "trpc/pb_compat.h"
@@ -53,6 +54,17 @@ int Channel::InitWithSocketId(SocketId sid, const ChannelOptions* options) {
     server_ep_ = s->remote_side();
     pinned_socket_ = sid;
     return 0;
+}
+
+int Channel::InitIci(const EndPoint& server, const ChannelOptions* options) {
+    GlobalInitializeOrDie();
+    SocketId sid;
+    if (IciConnect(server, client_messenger(), &sid) != 0) {
+        LOG(ERROR) << "InitIci: handshake with " << endpoint2str(server)
+                   << " failed";
+        return -1;
+    }
+    return InitWithSocketId(sid, options);
 }
 
 int Channel::Init(const char* naming_url, const char* lb_name,

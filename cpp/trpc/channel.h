@@ -42,6 +42,10 @@ public:
     // created out-of-band by the link setup, not by the SocketMap —
     // reference Channel::Init(fd) single-socket mode is the analog).
     int InitWithSocketId(SocketId sid, const ChannelOptions* options);
+    // Cross-process ICI: TCP handshake with `server`, then pin the channel
+    // to the shared-memory queue pair (tici/shm_link.h). Requires
+    // IciBlockPool::Init() with a shared region in this process.
+    int InitIci(const EndPoint& server, const ChannelOptions* options);
 
     void CallMethod(const google::protobuf::MethodDescriptor* method,
                     google::protobuf::RpcController* controller,

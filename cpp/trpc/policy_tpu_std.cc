@@ -9,6 +9,7 @@
 #include "tbase/errno.h"
 #include "tbase/logging.h"
 #include "tbase/time.h"
+#include "tici/shm_link.h"
 #include "tnet/input_messenger.h"
 #include "trpc/controller.h"
 #include "trpc/pb_compat.h"
@@ -268,6 +269,7 @@ void GlobalInitializeOrDie() {
         p.name = "tpu_std";
         g_tpu_std_index = RegisterProtocol(p);
         stream_internal::RegisterStreamProtocolOrDie();
+        RegisterIciHandshakeProtocol();
     });
 }
 

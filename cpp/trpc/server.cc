@@ -5,6 +5,7 @@
 
 #include "tbase/logging.h"
 #include "tfiber/fiber.h"
+#include "tici/shm_link.h"
 #include "trpc/policy_tpu_std.h"
 #include "trpc/stream.h"
 
@@ -68,6 +69,9 @@ int Server::StartNoListen(const ServerOptions* options) {
     }
     messenger_.add_protocol(TpuStdProtocolIndex());
     messenger_.add_protocol(stream_internal::StreamProtocolIndex());
+    // Any accepted TCP connection may upgrade itself to the shared-memory
+    // ICI data plane (cross-process queue pair; see tici/shm_link.h).
+    messenger_.add_protocol(IciHandshakeProtocolIndex());
     messenger_.context = this;
     started_ = true;
     listening_ = false;

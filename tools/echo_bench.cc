@@ -7,6 +7,9 @@
 //
 // Prints one JSON line with --json:
 //   {"mbps": ..., "qps_4k": ..., "p50_us_4k": ..., "p99_us_4k": ...}
+#include <signal.h>
+#include <sys/prctl.h>
+#include <sys/wait.h>
 #include <unistd.h>
 
 #include <atomic>
@@ -20,6 +23,7 @@
 #include "tbase/time.h"
 #include "tici/block_pool.h"
 #include "tici/ici_link.h"
+#include "tici/shm_link.h"
 #include "tnet/socket.h"
 #include "tfiber/fiber_sync.h"
 #include "trpc/channel.h"
@@ -109,17 +113,94 @@ double run_round(benchpb::EchoService_Stub& stub, size_t attachment_bytes,
     return (double)t.n_elapsed() / 1e9;
 }
 
+// Child mode for the cross-process benchmark/tests: a standalone echo
+// server with the ICI handshake enabled, port announced on stdout.
+// Exits when stdin reaches EOF (parent closed its pipe or died).
+int RunIciServer() {
+    prctl(PR_SET_PDEATHSIG, SIGKILL);  // die with the parent
+    FLAGS_socket_send_buffer_size.set(1 << 20);
+    FLAGS_socket_recv_buffer_size.set(1 << 20);
+    if (IciBlockPool::Init() != 0) return 1;
+    static Server server;
+    static EchoServiceImpl service;
+    if (server.AddService(&service) != 0) return 1;
+    EndPoint listen;
+    str2endpoint("127.0.0.1:0", &listen);
+    if (server.Start(listen, nullptr) != 0) return 1;
+    printf("PORT %d\n", server.listened_port());
+    fflush(stdout);
+    char buf[16];
+    while (read(0, buf, sizeof(buf)) > 0) {
+    }
+    return 0;
+}
+
+// Spawn this binary as --ici-server; returns the child's pid and fills
+// *port. *stdin_wr keeps the child alive: closing it shuts the child down.
+pid_t SpawnIciServer(int* port, int* stdin_wr) {
+    int out_pipe[2], in_pipe[2];
+    if (pipe(out_pipe) != 0 || pipe(in_pipe) != 0) return -1;
+    const pid_t pid = fork();
+    if (pid < 0) return -1;
+    if (pid == 0) {
+        dup2(out_pipe[1], 1);
+        dup2(in_pipe[0], 0);
+        close(out_pipe[0]);
+        close(out_pipe[1]);
+        close(in_pipe[0]);
+        close(in_pipe[1]);
+        execl("/proc/self/exe", "echo_bench", "--ici-server",
+              (char*)nullptr);
+        _exit(127);
+    }
+    close(out_pipe[1]);
+    close(in_pipe[0]);
+    *stdin_wr = in_pipe[1];
+    // Read "PORT <n>\n" from the child.
+    char line[64];
+    size_t got = 0;
+    while (got < sizeof(line) - 1) {
+        const ssize_t r = read(out_pipe[0], line + got, 1);
+        if (r <= 0) break;
+        if (line[got] == '\n') break;
+        ++got;
+    }
+    line[got] = '\0';
+    close(out_pipe[0]);
+    if (sscanf(line, "PORT %d", port) != 1) {
+        kill(pid, SIGKILL);
+        waitpid(pid, nullptr, 0);
+        return -1;
+    }
+    return pid;
+}
+
 }  // namespace
 
 int main(int argc, char** argv) {
     bool json = false;
     bool use_ici = false;
+    bool xproc = false;
     const char* prof_path = nullptr;
     for (int i = 1; i < argc; ++i) {
         if (strcmp(argv[i], "--json") == 0) json = true;
         if (strcmp(argv[i], "--ici") == 0) use_ici = true;
+        if (strcmp(argv[i], "--xproc") == 0) xproc = true;
+        if (strcmp(argv[i], "--ici-server") == 0) return RunIciServer();
         if (strcmp(argv[i], "--prof") == 0 && i + 1 < argc) {
             prof_path = argv[++i];
+        }
+    }
+    // Spawn the cross-process server BEFORE any framework threads exist
+    // (fork after the dispatcher/fiber workers start is unsafe).
+    int xproc_port = 0;
+    int xproc_stdin = -1;
+    pid_t xproc_pid = -1;
+    if (xproc) {
+        xproc_pid = SpawnIciServer(&xproc_port, &xproc_stdin);
+        if (xproc_pid < 0) {
+            fprintf(stderr, "failed to spawn --ici-server child\n");
+            return 1;
         }
     }
     // Windowed 1MB messages benefit from fixed large socket buffers on
@@ -133,7 +214,15 @@ int main(int argc, char** argv) {
     Channel channel;
     ChannelOptions copts;
     copts.timeout_ms = 10000;
-    if (use_ici) {
+    if (xproc) {
+        // Cross-process data plane: TCP handshake to the child, then the
+        // shared-memory queue pair (tici/shm_link.h). The server runs in
+        // its own process; TCP stays as doorbell + failure detector.
+        if (IciBlockPool::Init() != 0) return 1;
+        EndPoint ep;
+        str2endpoint("127.0.0.1", xproc_port, &ep);
+        if (channel.InitIci(ep, &copts) != 0) return 1;
+    } else if (use_ici) {
         // ICI data plane: registered-memory pool + software queue pair
         // (the loopback stand-in for the interconnect; see
         // cpp/tici/ici_link.h). One copy per byte instead of TCP's four.
@@ -204,6 +293,11 @@ int main(int argc, char** argv) {
                kBigIters);
         printf("RPC 4KB echo: %.0f qps, p50 %lldus, p99 %lldus\n", qps_4k,
                p50, p99);
+    }
+    if (xproc_pid > 0) {
+        close(xproc_stdin);  // child sees stdin EOF and exits
+        int status = 0;
+        waitpid(xproc_pid, &status, 0);
     }
     return 0;
 }

@@ -1,0 +1,199 @@
+#include "thttp/builtin_services.h"
+
+#include <cctype>
+#include <cstdio>
+#include <cstdlib>
+#include <string>
+
+#include "tbase/flags.h"
+#include "thttp/http_message.h"
+#include "thttp/http_protocol.h"
+#include "tnet/socket.h"
+#include "trpc/server.h"
+#include "tvar/variable.h"
+
+namespace tpurpc {
+
+namespace {
+
+void HandleIndex(Server*, const HttpRequest&, HttpResponse* res) {
+    res->set_content_type("text/plain");
+    res->Append(
+        "tpu-rpc server portal\n"
+        "\n"
+        "/health       liveness\n"
+        "/status       per-method stats\n"
+        "/vars         exposed variables (/vars/<name> for one)\n"
+        "/flags        runtime flags (/flags/<name>?setvalue=v to set)\n"
+        "/connections  accepted connections\n"
+        "/metrics      prometheus exposition\n");
+}
+
+void HandleHealth(Server*, const HttpRequest&, HttpResponse* res) {
+    res->set_content_type("text/plain");
+    res->Append("OK\n");
+}
+
+void HandleStatus(Server* server, const HttpRequest&, HttpResponse* res) {
+    res->set_content_type("text/plain");
+    char line[512];
+    snprintf(line, sizeof(line), "nprocessing: %lld\n\n",
+             (long long)server->nprocessing.load());
+    res->Append(line);
+    for (const auto& kv : server->methods()) {
+        const MethodStatus& st = *kv.second.status;
+        snprintf(line, sizeof(line),
+                 "%s\n"
+                 "  count: %lld  qps: %lld  concurrency: %lld"
+                 "  errors: %lld  rejected: %lld\n"
+                 "  latency_us: p50 %lld  p99 %lld  p999 %lld  max %lld\n",
+                 kv.first.c_str(), (long long)st.latency.count(),
+                 (long long)st.latency.qps(),
+                 (long long)st.concurrency.load(),
+                 (long long)st.nerror.load(), (long long)st.nrejected.load(),
+                 (long long)st.latency.latency_percentile(0.5),
+                 (long long)st.latency.latency_percentile(0.99),
+                 (long long)st.latency.latency_percentile(0.999),
+                 (long long)st.latency.max_latency());
+        res->Append(line);
+    }
+}
+
+void HandleVars(Server*, const HttpRequest& req, HttpResponse* res) {
+    res->set_content_type("text/plain");
+    // /vars/<name> -> one variable.
+    if (req.path.size() > 6 && req.path.compare(0, 6, "/vars/") == 0) {
+        const std::string name = req.path.substr(6);
+        std::string value;
+        if (!Variable::describe_exposed(name, &value)) {
+            res->status = 404;
+            res->Append("no such var: " + name + "\n");
+            return;
+        }
+        res->Append(name + " : " + value + "\n");
+        return;
+    }
+    for (const auto& kv : Variable::dump_exposed()) {
+        res->Append(kv.first + " : " + kv.second + "\n");
+    }
+}
+
+void HandleFlags(Server*, const HttpRequest& req, HttpResponse* res) {
+    res->set_content_type("text/plain");
+    if (req.path.size() > 7 && req.path.compare(0, 7, "/flags/") == 0) {
+        const std::string name = req.path.substr(7);
+        FlagBase* f = FindFlag(name);
+        if (f == nullptr) {
+            res->status = 404;
+            res->Append("no such flag: " + name + "\n");
+            return;
+        }
+        bool has_setvalue = false;
+        const std::string setvalue = req.QueryParam("setvalue", &has_setvalue);
+        if (has_setvalue) {
+            if (!SetFlagValue(name, setvalue)) {
+                res->status = 400;
+                res->Append("bad value for " + name + ": '" + setvalue +
+                            "'\n");
+                return;
+            }
+        }
+        res->Append(name + " = " + f->GetString() + " (" + f->type() +
+                    ")  # " + f->description() + "\n");
+        return;
+    }
+    for (FlagBase* f : ListFlags()) {
+        res->Append(std::string(f->name()) + " = " + f->GetString() + " (" +
+                    f->type() + ")  # " + f->description() + "\n");
+    }
+}
+
+void HandleConnections(Server* server, const HttpRequest&,
+                       HttpResponse* res) {
+    res->set_content_type("text/plain");
+    char line[256];
+    res->Append("socket_id            fd    remote              "
+                "unwritten_bytes\n");
+    for (SocketId id : server->acceptor()->connections()) {
+        SocketUniquePtr s = SocketUniquePtr::FromId(id);
+        if (!s) continue;
+        snprintf(line, sizeof(line), "%-20llu %-5d %-19s %lld\n",
+                 (unsigned long long)id, s->fd(),
+                 endpoint2str(s->remote_side()).c_str(),
+                 (long long)s->unwritten_bytes());
+        res->Append(line);
+    }
+}
+
+// Prometheus text exposition: every exposed numeric var becomes a gauge
+// (reference builtin/prometheus_metrics_service.cpp:244 does the same
+// name-sanitize + filter).
+std::string sanitize_metric_name(std::string name) {
+    for (char& c : name) {
+        if (!isalnum((unsigned char)c) && c != '_' && c != ':') c = '_';
+    }
+    if (!name.empty() && isdigit((unsigned char)name[0])) {
+        name.insert(name.begin(), '_');
+    }
+    return name;
+}
+
+bool is_number(const std::string& s) {
+    char* end = nullptr;
+    strtod(s.c_str(), &end);
+    return end != s.c_str() && *end == '\0' && !s.empty();
+}
+
+void HandleMetrics(Server*, const HttpRequest&, HttpResponse* res) {
+    res->set_content_type("text/plain; version=0.0.4");
+    for (const auto& kv : Variable::dump_exposed()) {
+        const std::string& value = kv.second;
+        const std::string name = sanitize_metric_name(kv.first);
+        if (is_number(value)) {
+            res->Append("# TYPE " + name + " gauge\n");
+            res->Append(name + " " + value + "\n");
+            continue;
+        }
+        // Composite vars (LatencyRecorder) dump as a flat JSON object of
+        // numeric fields: expand each as <name>_<field> (reference
+        // prometheus_metrics_service.cpp emits latency_recorder series
+        // the same way).
+        if (value.size() < 2 || value[0] != '{') continue;
+        size_t pos = 1;
+        while (pos < value.size()) {
+            const size_t kstart = value.find('"', pos);
+            if (kstart == std::string::npos) break;
+            const size_t kend = value.find('"', kstart + 1);
+            if (kend == std::string::npos) break;
+            const size_t colon = value.find(':', kend);
+            if (colon == std::string::npos) break;
+            size_t vend = value.find_first_of(",}", colon);
+            if (vend == std::string::npos) vend = value.size();
+            const std::string field = value.substr(kstart + 1, kend - kstart - 1);
+            const std::string fval = value.substr(colon + 1, vend - colon - 1);
+            if (is_number(fval)) {
+                const std::string mname =
+                    name + "_" + sanitize_metric_name(field);
+                res->Append("# TYPE " + mname + " gauge\n");
+                res->Append(mname + " " + fval + "\n");
+            }
+            pos = vend + 1;
+        }
+    }
+}
+
+}  // namespace
+
+void AddBuiltinHttpServices(Server* server) {
+    server->RegisterHttpHandler("/", HandleIndex);
+    server->RegisterHttpHandler("/health", HandleHealth);
+    server->RegisterHttpHandler("/status", HandleStatus);
+    server->RegisterHttpHandler("/vars", HandleVars);
+    server->RegisterHttpHandler("/vars/*", HandleVars);
+    server->RegisterHttpHandler("/flags", HandleFlags);
+    server->RegisterHttpHandler("/flags/*", HandleFlags);
+    server->RegisterHttpHandler("/connections", HandleConnections);
+    server->RegisterHttpHandler("/metrics", HandleMetrics);
+}
+
+}  // namespace tpurpc

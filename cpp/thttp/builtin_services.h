@@ -1,0 +1,24 @@
+// Builtin observability portal: the HTTP services every server exposes.
+//
+// Plays the role of reference src/brpc/builtin/ (the ~30 services
+// auto-registered by Server::AddBuiltinServices, server.cpp:499-614),
+// starting with the operationally load-bearing set:
+//   /          index (what's here)
+//   /health    liveness probe
+//   /status    per-method qps/latency/concurrency/errors (status_service)
+//   /vars      every exposed tvar (vars_service); /vars/<name> for one
+//   /flags     runtime flags; /flags/<name>?setvalue=v mutates
+//              (flags_service + reloadable_flags)
+//   /connections  accepted sockets (connections_service)
+//   /metrics   Prometheus text exposition
+//              (prometheus_metrics_service.cpp:244)
+#pragma once
+
+namespace tpurpc {
+
+class Server;
+
+// Install the portal handlers on `server` (called by StartNoListen).
+void AddBuiltinHttpServices(Server* server);
+
+}  // namespace tpurpc

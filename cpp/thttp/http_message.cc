@@ -1,0 +1,250 @@
+#include "thttp/http_message.h"
+
+#include <cctype>
+#include <cstdio>
+#include <cstring>
+
+namespace tpurpc {
+
+namespace {
+
+constexpr size_t kMaxHeaderBytes = 64 * 1024;
+constexpr uint64_t kMaxBodyBytes = 64ull << 20;
+
+// Known request verbs (sniffing + validation).
+const char* const kMethods[] = {"GET",     "POST",  "HEAD",  "PUT",
+                                "DELETE",  "PATCH", "OPTIONS"};
+
+bool ieq(const std::string& a, const char* b) {
+    const size_t n = strlen(b);
+    if (a.size() != n) return false;
+    for (size_t i = 0; i < n; ++i) {
+        if (tolower((unsigned char)a[i]) != tolower((unsigned char)b[i])) {
+            return false;
+        }
+    }
+    return true;
+}
+
+// %xx-decode (path only; '+' is literal in paths).
+std::string url_decode(const std::string& in) {
+    std::string out;
+    out.reserve(in.size());
+    for (size_t i = 0; i < in.size(); ++i) {
+        if (in[i] == '%' && i + 2 < in.size() && isxdigit((unsigned char)in[i + 1]) &&
+            isxdigit((unsigned char)in[i + 2])) {
+            const char hex[3] = {in[i + 1], in[i + 2], 0};
+            out.push_back((char)strtol(hex, nullptr, 16));
+            i += 2;
+        } else {
+            out.push_back(in[i]);
+        }
+    }
+    return out;
+}
+
+}  // namespace
+
+bool CaseLess::operator()(const std::string& a, const std::string& b) const {
+    const size_t n = a.size() < b.size() ? a.size() : b.size();
+    for (size_t i = 0; i < n; ++i) {
+        const int ca = tolower((unsigned char)a[i]);
+        const int cb = tolower((unsigned char)b[i]);
+        if (ca != cb) return ca < cb;
+    }
+    return a.size() < b.size();
+}
+
+std::string HttpRequest::QueryParam(const std::string& key,
+                                    bool* found) const {
+    if (found != nullptr) *found = false;
+    size_t pos = 0;
+    while (pos < query.size()) {
+        size_t amp = query.find('&', pos);
+        if (amp == std::string::npos) amp = query.size();
+        const size_t eq = query.find('=', pos);
+        if (eq != std::string::npos && eq < amp && eq - pos == key.size() &&
+            query.compare(pos, eq - pos, key) == 0) {
+            if (found != nullptr) *found = true;
+            return url_decode(query.substr(eq + 1, amp - eq - 1));
+        }
+        if ((eq == std::string::npos || eq >= amp) &&
+            amp - pos == key.size() &&
+            query.compare(pos, amp - pos, key) == 0) {
+            // bare key (no '=')
+            if (found != nullptr) *found = true;
+            return "";
+        }
+        pos = amp + 1;
+    }
+    return "";
+}
+
+HttpParseStatus ParseHttpRequest(IOBuf* source, HttpRequest* out) {
+    // Fast sniff on the first bytes: must start with a known verb + SP.
+    {
+        char probe[8];
+        const size_t n = source->copy_to(probe, sizeof(probe), 0);
+        bool maybe = false;
+        for (const char* m : kMethods) {
+            const size_t ml = strlen(m);
+            const size_t cmp = n < ml + 1 ? n : ml + 1;
+            if (cmp == 0) return HttpParseStatus::kNeedMore;
+            char want[9];
+            snprintf(want, sizeof(want), "%s ", m);
+            if (memcmp(probe, want, cmp) == 0) {
+                maybe = true;
+                break;
+            }
+        }
+        if (!maybe) return HttpParseStatus::kNotHttp;
+        if (n < sizeof(probe) && source->size() == n) {
+            // All buffered bytes are a verb prefix: need more to be sure.
+            // (kNotHttp was already returned on any mismatch above.)
+        }
+    }
+    // Copy the (bounded) header section out and find CRLFCRLF.
+    const size_t scan = source->size() < kMaxHeaderBytes + 4
+                            ? source->size()
+                            : kMaxHeaderBytes + 4;
+    std::string hdr;
+    source->copy_to(&hdr, scan, 0);
+    const size_t hdr_end = hdr.find("\r\n\r\n");
+    if (hdr_end == std::string::npos) {
+        if (source->size() > kMaxHeaderBytes) return HttpParseStatus::kError;
+        return HttpParseStatus::kNeedMore;
+    }
+    const size_t header_len = hdr_end + 4;
+
+    HttpRequest req;
+    // ---- request line ----
+    const size_t line_end = hdr.find("\r\n");
+    const std::string line = hdr.substr(0, line_end);
+    const size_t sp1 = line.find(' ');
+    const size_t sp2 = line.rfind(' ');
+    if (sp1 == std::string::npos || sp2 == sp1) return HttpParseStatus::kError;
+    req.method = line.substr(0, sp1);
+    bool known = false;
+    for (const char* m : kMethods) known |= req.method == m;
+    if (!known) return HttpParseStatus::kError;
+    std::string target = line.substr(sp1 + 1, sp2 - sp1 - 1);
+    const std::string proto = line.substr(sp2 + 1);
+    if (proto.size() != 8 || proto.compare(0, 5, "HTTP/") != 0 ||
+        !isdigit((unsigned char)proto[5]) || proto[6] != '.' ||
+        !isdigit((unsigned char)proto[7])) {
+        return HttpParseStatus::kError;
+    }
+    req.version_major = proto[5] - '0';
+    req.version_minor = proto[7] - '0';
+    if (target.empty()) return HttpParseStatus::kError;
+    const size_t q = target.find('?');
+    if (q != std::string::npos) {
+        req.query = target.substr(q + 1);
+        target.resize(q);
+    }
+    req.path = url_decode(target);
+
+    // ---- headers ----
+    size_t pos = line_end + 2;
+    while (pos < hdr_end) {
+        size_t eol = hdr.find("\r\n", pos);
+        if (eol == std::string::npos || eol > hdr_end) eol = hdr_end;
+        const std::string hline = hdr.substr(pos, eol - pos);
+        pos = eol + 2;
+        const size_t colon = hline.find(':');
+        if (colon == std::string::npos || colon == 0) {
+            return HttpParseStatus::kError;
+        }
+        std::string name = hline.substr(0, colon);
+        // No whitespace allowed in field names (request smuggling guard).
+        for (char c : name) {
+            if (isspace((unsigned char)c)) return HttpParseStatus::kError;
+        }
+        size_t vs = colon + 1;
+        while (vs < hline.size() && (hline[vs] == ' ' || hline[vs] == '\t')) {
+            ++vs;
+        }
+        size_t ve = hline.size();
+        while (ve > vs && (hline[ve - 1] == ' ' || hline[ve - 1] == '\t')) {
+            --ve;
+        }
+        std::string value = hline.substr(vs, ve - vs);
+        auto ins = req.headers.emplace(name, value);
+        if (!ins.second) {
+            // Duplicate header. Differing Content-Length values are the
+            // classic request-smuggling vector (RFC 9112 §6.3): reject.
+            if (ieq(name, "Content-Length") && ins.first->second != value) {
+                return HttpParseStatus::kError;
+            }
+            ins.first->second = std::move(value);  // otherwise last wins
+        }
+    }
+
+    // ---- body ----
+    uint64_t content_length = 0;
+    if (const std::string* te = req.FindHeader("Transfer-Encoding")) {
+        (void)te;
+        return HttpParseStatus::kError;  // portal requests never chunk
+    }
+    if (const std::string* cl = req.FindHeader("Content-Length")) {
+        char* end = nullptr;
+        content_length = strtoull(cl->c_str(), &end, 10);
+        if (end == cl->c_str() || *end != '\0' ||
+            content_length > kMaxBodyBytes) {
+            return HttpParseStatus::kError;
+        }
+    }
+    if (source->size() < header_len + content_length) {
+        return HttpParseStatus::kNeedMore;
+    }
+    source->pop_front(header_len);
+    source->cutn(&req.body, content_length);
+    *out = std::move(req);
+    return HttpParseStatus::kOk;
+}
+
+const char* HttpReasonPhrase(int status) {
+    switch (status) {
+        case 200: return "OK";
+        case 204: return "No Content";
+        case 301: return "Moved Permanently";
+        case 302: return "Found";
+        case 400: return "Bad Request";
+        case 403: return "Forbidden";
+        case 404: return "Not Found";
+        case 405: return "Method Not Allowed";
+        case 411: return "Length Required";
+        case 413: return "Payload Too Large";
+        case 431: return "Request Header Fields Too Large";
+        case 500: return "Internal Server Error";
+        case 501: return "Not Implemented";
+        case 503: return "Service Unavailable";
+        default: return "Unknown";
+    }
+}
+
+void SerializeHttpResponse(HttpResponse* res, IOBuf* out) {
+    char line[128];
+    snprintf(line, sizeof(line), "HTTP/1.1 %d %s\r\n", res->status,
+             res->reason.empty() ? HttpReasonPhrase(res->status)
+                                 : res->reason.c_str());
+    out->append(line);
+    if (res->headers.find("Content-Length") == res->headers.end()) {
+        snprintf(line, sizeof(line), "Content-Length: %zu\r\n",
+                 res->body.size());
+        out->append(line);
+    }
+    if (res->headers.find("Connection") == res->headers.end()) {
+        out->append("Connection: keep-alive\r\n");
+    }
+    for (const auto& kv : res->headers) {
+        out->append(kv.first);
+        out->append(": ", 2);
+        out->append(kv.second);
+        out->append("\r\n", 2);
+    }
+    out->append("\r\n", 2);
+    out->append(std::move(res->body));
+}
+
+}  // namespace tpurpc

@@ -1,0 +1,83 @@
+// HTTP/1.x message types + incremental request parser + response
+// serializer — the portal's wire layer.
+//
+// Plays the role of reference src/brpc/details/http_parser.{h,cpp} (the
+// joyent C parser) + src/brpc/details/http_message.{h,cpp} + http_header.h,
+// reduced to what an observability portal and REST handlers need:
+// request-line + headers + Content-Length bodies, case-insensitive header
+// lookup, keep-alive. Parsing is resumable at the message level: the
+// parser returns NeedMore until a full message is buffered (the
+// InputMessenger cut loop re-calls with more bytes), which keeps the
+// state machine trivial and the attack surface small — the fuzzer
+// (tests) hammers exactly this entry point.
+#pragma once
+
+#include <cstdint>
+#include <map>
+#include <string>
+
+#include "tbase/iobuf.h"
+
+namespace tpurpc {
+
+// Case-insensitive comparator for header names (HTTP headers are
+// case-insensitive; reference CaseIgnoredFlatMap plays this role).
+struct CaseLess {
+    bool operator()(const std::string& a, const std::string& b) const;
+};
+
+struct HttpRequest {
+    std::string method;   // "GET", "POST", ...
+    std::string path;     // decoded path, no query ("/vars")
+    std::string query;    // raw query string ("a=b&c=d"), no '?'
+    int version_major = 1;
+    int version_minor = 1;
+    std::map<std::string, std::string, CaseLess> headers;
+    IOBuf body;
+
+    const std::string* FindHeader(const std::string& name) const {
+        auto it = headers.find(name);
+        return it == headers.end() ? nullptr : &it->second;
+    }
+    // First value of `key` in the query string, or "" (portal knobs,
+    // e.g. /flags/foo?setvalue=3). `found` (optional) distinguishes a
+    // present-but-empty value from an absent key.
+    std::string QueryParam(const std::string& key,
+                           bool* found = nullptr) const;
+};
+
+struct HttpResponse {
+    int status = 200;
+    std::string reason;  // "" = canonical for status
+    std::map<std::string, std::string, CaseLess> headers;
+    IOBuf body;
+
+    void SetHeader(const std::string& k, const std::string& v) {
+        headers[k] = v;
+    }
+    void set_content_type(const std::string& ct) {
+        headers["Content-Type"] = ct;
+    }
+    // Convenience: append text to the body.
+    void Append(const std::string& s) { body.append(s); }
+};
+
+enum class HttpParseStatus {
+    kOk,        // one full request cut from the source
+    kNeedMore,  // keep bytes, wait for more
+    kNotHttp,   // doesn't start like an HTTP request (protocol sniffing)
+    kError,     // malformed beyond recovery: fail the connection
+};
+
+// Cut one full request off `source` (bytes are consumed only on kOk).
+// Enforces: header section <= 64KB, Content-Length body <= 64MB, no
+// Transfer-Encoding (411 territory — portal requests never chunk).
+HttpParseStatus ParseHttpRequest(IOBuf* source, HttpRequest* out);
+
+// Serialize status line + headers + body. Adds Content-Length and
+// Connection: keep-alive unless already present.
+void SerializeHttpResponse(HttpResponse* res, IOBuf* out);
+
+const char* HttpReasonPhrase(int status);
+
+}  // namespace tpurpc

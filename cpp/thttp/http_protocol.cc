@@ -1,0 +1,107 @@
+#include "thttp/http_protocol.h"
+
+#include <memory>
+
+#include "tbase/errno.h"
+#include "tbase/logging.h"
+#include "thttp/http_message.h"
+#include "tnet/input_messenger.h"
+#include "tnet/protocol.h"
+#include "tnet/socket.h"
+#include "trpc/server.h"
+
+namespace tpurpc {
+
+namespace {
+
+struct HttpInputMessage : public InputMessageBase {
+    HttpRequest req;
+    Server* server = nullptr;
+};
+
+ParseResult ParseHttp(IOBuf* source, Socket* s, bool read_eof, const void*) {
+    (void)read_eof;
+    HttpRequest req;
+    switch (ParseHttpRequest(source, &req)) {
+        case HttpParseStatus::kNotHttp:
+            return ParseResult::make(ParseError::TRY_OTHERS);
+        case HttpParseStatus::kNeedMore:
+            return ParseResult::make(ParseError::NOT_ENOUGH_DATA);
+        case HttpParseStatus::kError:
+            return ParseResult::make(ParseError::ERROR);
+        case HttpParseStatus::kOk:
+            break;
+    }
+    auto* msg = new HttpInputMessage;
+    msg->req = std::move(req);
+    InputMessenger* m = (InputMessenger*)s->user();
+    msg->server = m != nullptr ? (Server*)m->context : nullptr;
+    return ParseResult::make_ok(msg);
+}
+
+void ProcessHttp(InputMessageBase* msg_base) {
+    std::unique_ptr<HttpInputMessage> msg((HttpInputMessage*)msg_base);
+    SocketUniquePtr s = SocketUniquePtr::FromId(msg->socket_id);
+    if (!s) return;
+    HttpResponse res;
+    const bool close_conn = [&] {
+        const std::string* conn = msg->req.FindHeader("Connection");
+        if (conn != nullptr) {
+            return conn->find("close") != std::string::npos;
+        }
+        return msg->req.version_minor == 0;  // HTTP/1.0 default
+    }();
+    if (msg->server == nullptr) {
+        res.status = 503;
+        res.Append("no server bound to this port\n");
+    } else {
+        const HttpHandler* h = msg->server->FindHttpHandler(msg->req.path);
+        if (h == nullptr) {
+            res.status = 404;
+            res.set_content_type("text/plain");
+            res.Append("404 not found: " + msg->req.path + "\n");
+        } else {
+            (*h)(msg->server, msg->req, &res);
+        }
+    }
+    if (close_conn) res.SetHeader("Connection", "close");
+    // HEAD: headers (incl. the Content-Length the body WOULD have), no
+    // body bytes (RFC 9110 §9.3.2 — sending them desyncs keep-alive).
+    if (msg->req.method == "HEAD") {
+        char cl[32];
+        snprintf(cl, sizeof(cl), "%zu", res.body.size());
+        res.SetHeader("Content-Length", cl);
+        res.body.clear();
+    }
+    IOBuf out;
+    SerializeHttpResponse(&res, &out);
+    s->Write(&out);
+    if (close_conn) {
+        // Honor the advertised close ourselves: read-until-EOF clients
+        // (HTTP/1.0, simple scripts) block forever otherwise. Wait for
+        // the write queue to drain (bounded), then fail the socket —
+        // which closes the fd.
+        for (int i = 0; i < 200 && s->unwritten_bytes() > 0; ++i) {
+            fiber_usleep(1000);
+        }
+        s->SetFailedWithError(TERR_EOF);
+    }
+}
+
+int g_http_index = -1;
+
+}  // namespace
+
+void RegisterHttpProtocol() {
+    if (g_http_index >= 0) return;
+    Protocol p;
+    p.parse = ParseHttp;
+    p.process = ProcessHttp;
+    p.name = "http";
+    p.process_in_order = true;  // no correlation ids: FIFO responses
+    g_http_index = RegisterProtocol(p);
+}
+
+int HttpProtocolIndex() { return g_http_index; }
+
+}  // namespace tpurpc

@@ -1,0 +1,29 @@
+// HTTP/1.x server protocol: sniffs HTTP requests on any server
+// connection and dispatches them to the server's handler map (builtin
+// portal services + user handlers).
+//
+// Plays the role of reference src/brpc/policy/http_rpc_protocol.cpp's
+// server half: ParseHttpMessage feeding ProcessHttpRequest, registered in
+// the same protocol registry the native framed protocol uses, so one
+// port serves both RPC and the portal (reference server.cpp: builtin
+// services are plain services on the same acceptor).
+#pragma once
+
+#include <functional>
+#include <string>
+
+namespace tpurpc {
+
+class Server;
+struct HttpRequest;
+struct HttpResponse;
+
+// A handler owns one path (exact) or path prefix (see
+// Server::RegisterHttpHandler). Runs on a fiber.
+using HttpHandler =
+    std::function<void(Server*, const HttpRequest&, HttpResponse*)>;
+
+int HttpProtocolIndex();
+void RegisterHttpProtocol();  // idempotent; called from global init
+
+}  // namespace tpurpc

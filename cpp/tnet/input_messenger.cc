@@ -98,6 +98,12 @@ void InputMessenger::OnNewMessages(Socket* s) {
             if (r.error == ParseError::OK) {
                 r.msg->socket_id = s->id();
                 const Protocol* p = GetProtocol(r.msg->protocol_index);
+                if (p->process_in_order) {
+                    // No correlation ids on this protocol: responses must
+                    // leave in request order, so run inline right now.
+                    p->process(r.msg);
+                    continue;
+                }
                 if (pending_msg != nullptr) {
                     auto* pa = new ProcessArgs{pending_msg, pending_proto};
                     fiber_t tid;

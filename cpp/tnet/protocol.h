@@ -56,6 +56,11 @@ struct Protocol {
     const char* name = "unknown";
     // Opaque arg passed to parse (e.g. the Server*).
     const void* parse_arg = nullptr;
+    // Process every message inline on the input fiber, in cut order.
+    // Required by protocols without correlation ids (HTTP): spawning
+    // earlier burst messages onto fibers would let responses overtake
+    // each other on one connection.
+    bool process_in_order = false;
 };
 
 // Global registry (reference global.cpp:416-601 registers all protocols at

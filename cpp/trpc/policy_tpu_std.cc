@@ -9,6 +9,7 @@
 #include "tbase/errno.h"
 #include "tbase/logging.h"
 #include "tbase/time.h"
+#include "thttp/http_protocol.h"
 #include "tici/shm_link.h"
 #include "tnet/input_messenger.h"
 #include "trpc/controller.h"
@@ -270,6 +271,7 @@ void GlobalInitializeOrDie() {
         g_tpu_std_index = RegisterProtocol(p);
         stream_internal::RegisterStreamProtocolOrDie();
         RegisterIciHandshakeProtocol();
+        RegisterHttpProtocol();
     });
 }
 

@@ -5,6 +5,7 @@
 
 #include "tbase/logging.h"
 #include "tfiber/fiber.h"
+#include "thttp/builtin_services.h"
 #include "tici/shm_link.h"
 #include "trpc/policy_tpu_std.h"
 #include "trpc/stream.h"
@@ -72,6 +73,11 @@ int Server::StartNoListen(const ServerOptions* options) {
     // Any accepted TCP connection may upgrade itself to the shared-memory
     // ICI data plane (cross-process queue pair; see tici/shm_link.h).
     messenger_.add_protocol(IciHandshakeProtocolIndex());
+    // The observability portal rides the same port (reference
+    // server.cpp:499 AddBuiltinServices — builtins are plain services on
+    // the one acceptor).
+    messenger_.add_protocol(HttpProtocolIndex());
+    AddBuiltinHttpServices(this);
     messenger_.context = this;
     started_ = true;
     listening_ = false;
@@ -95,6 +101,39 @@ Server::MethodProperty* Server::FindMethod(const std::string& service_name,
                                            const std::string& method_name) {
     auto it = methods_.find(service_name + "." + method_name);
     return it == methods_.end() ? nullptr : &it->second;
+}
+
+void Server::RegisterHttpHandler(const std::string& path,
+                                 HttpHandler handler) {
+    if (started_) {
+        // Same rule as AddService: the handler maps are read without
+        // locks by request fibers once serving.
+        LOG(ERROR) << "RegisterHttpHandler(" << path << ") after Start";
+        return;
+    }
+    if (path.size() >= 2 && path.compare(path.size() - 2, 2, "/*") == 0) {
+        http_prefix_[path.substr(0, path.size() - 2)] = std::move(handler);
+    } else {
+        http_exact_[path] = std::move(handler);
+    }
+}
+
+const HttpHandler* Server::FindHttpHandler(const std::string& path) const {
+    auto it = http_exact_.find(path);
+    if (it != http_exact_.end()) return &it->second;
+    // Longest matching prefix whose registration was "<prefix>/*": the
+    // request path must continue with '/' after the prefix.
+    const HttpHandler* best = nullptr;
+    size_t best_len = 0;
+    for (const auto& kv : http_prefix_) {
+        const std::string& p = kv.first;
+        if (p.size() >= best_len && path.size() > p.size() &&
+            path.compare(0, p.size(), p) == 0 && path[p.size()] == '/') {
+            best = &kv.second;
+            best_len = p.size();
+        }
+    }
+    return best;
 }
 
 }  // namespace tpurpc

@@ -15,6 +15,7 @@
 #include <string>
 
 #include "tbase/endpoint.h"
+#include "thttp/http_protocol.h"
 #include "tnet/acceptor.h"
 #include "tnet/input_messenger.h"
 #include "tvar/latency_recorder.h"
@@ -74,6 +75,21 @@ public:
     MethodProperty* FindMethod(const std::string& service_name,
                                const std::string& method_name);
 
+    // ---- HTTP portal (thttp/; reference src/brpc/builtin/) ----
+    // Register a handler for an exact path, or a prefix when `path` ends
+    // with "/*" ("/vars/*" matches /vars/anything). Builtins are added at
+    // StartNoListen; user handlers may be added before Start.
+    void RegisterHttpHandler(const std::string& path, HttpHandler handler);
+    // Exact match first, then longest registered "/x/*" prefix; null if
+    // nothing matches.
+    const HttpHandler* FindHttpHandler(const std::string& path) const;
+
+    // Portal introspection accessors.
+    const std::map<std::string, MethodProperty>& methods() const {
+        return methods_;
+    }
+    Acceptor* acceptor() { return &acceptor_; }
+
     std::atomic<int64_t> nprocessing{0};  // in-flight requests
 
 private:
@@ -83,6 +99,8 @@ private:
     bool started_ = false;
     bool listening_ = false;
     std::map<std::string, MethodProperty> methods_;
+    std::map<std::string, HttpHandler> http_exact_;
+    std::map<std::string, HttpHandler> http_prefix_;  // key without "/*"
 };
 
 }  // namespace tpurpc

@@ -1,0 +1,89 @@
+// Fuzz driver for the HTTP request parser (and the tpu_std frame parser's
+// header stage): deterministic seeded mutation loop, no libFuzzer
+// dependency (clang is not in this image — reference test/fuzzing/
+// fuzz_http.cpp uses libFuzzer; this driver covers the same entry point).
+//
+//   http_fuzz [iterations] [seed]
+//
+// Exits non-zero (or crashes under ASan) on any invariant violation:
+// parser must make progress on kOk, consume nothing otherwise, and never
+// abort/hang.
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+
+#include "thttp/http_message.h"
+
+using namespace tpurpc;
+
+int main(int argc, char** argv) {
+    long long iters = argc > 1 ? atoll(argv[1]) : 1000000;
+    unsigned long long rng = argc > 2 ? strtoull(argv[2], nullptr, 10)
+                                      : 0x9e3779b97f4a7c15ull;
+    auto next = [&rng]() {
+        rng ^= rng << 13;
+        rng ^= rng >> 7;
+        rng ^= rng << 17;
+        return rng;
+    };
+    const char* seeds[] = {
+        "GET /vars?a=b HTTP/1.1\r\nHost: h\r\nAccept: */*\r\n\r\n",
+        "POST /flags/x?setvalue=1 HTTP/1.1\r\nContent-Length: 4\r\n\r\n"
+        "body",
+        "PUT /a/b/c HTTP/1.0\r\nX-Long: "
+        "0123456789012345678901234567890123456789\r\n\r\n",
+        "DELETE /x HTTP/1.1\r\nConnection: close\r\n\r\n",
+        "OPTIONS * HTTP/1.1\r\n\r\n",
+    };
+    constexpr int nseeds = sizeof(seeds) / sizeof(seeds[0]);
+    long long parsed_ok = 0;
+    for (long long iter = 0; iter < iters; ++iter) {
+        std::string input = seeds[next() % nseeds];
+        const int nmut = 1 + (int)(next() % 10);
+        for (int m = 0; m < nmut; ++m) {
+            switch (next() % 5) {
+                case 0:
+                    input[next() % input.size()] = (char)next();
+                    break;
+                case 1:
+                    input.resize(next() % (input.size() + 1));
+                    break;
+                case 2:
+                    if (!input.empty()) {
+                        input.insert(next() % input.size(),
+                                     input.substr(0, next() % 32));
+                    }
+                    break;
+                case 3:
+                    for (int i = 0; i < (int)(next() % 16); ++i) {
+                        input.push_back((char)next());
+                    }
+                    break;
+                case 4: {  // splice two seeds
+                    const char* other = seeds[next() % nseeds];
+                    input.insert(next() % (input.size() + 1), other);
+                    break;
+                }
+            }
+            if (input.empty()) input = "P";
+        }
+        IOBuf buf;
+        buf.append(input);
+        const size_t before = buf.size();
+        HttpRequest req;
+        const HttpParseStatus st = ParseHttpRequest(&buf, &req);
+        if (st == HttpParseStatus::kOk) {
+            ++parsed_ok;
+            if (buf.size() >= before) {
+                fprintf(stderr, "NO PROGRESS on kOk at iter %lld\n", iter);
+                return 1;
+            }
+        } else if (buf.size() != before) {
+            fprintf(stderr, "CONSUMED on non-OK at iter %lld\n", iter);
+            return 1;
+        }
+    }
+    printf("{\"iters\": %lld, \"parsed_ok\": %lld}\n", iters, parsed_ok);
+    return 0;
+}

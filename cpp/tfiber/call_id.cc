@@ -69,14 +69,17 @@ int id_create(CallId* id, void* data, IdOnError on_error) {
     return 0;
 }
 
-int id_lock(CallId id, void** data_out) {
+namespace {
+int id_lock_impl(CallId id, void** data_out, bool range) {
     IdSlot* s = resolve(id);
     if (s == nullptr) return -1;
     while (true) {
         int seq;
         {
             std::lock_guard<std::mutex> g(s->mu);
-            if (!valid_locked(s, id)) return -1;
+            if (!(range ? valid_range(s, id) : valid_locked(s, id))) {
+                return -1;
+            }
             if (!s->locked) {
                 s->locked = true;
                 if (data_out) *data_out = s->data;
@@ -86,6 +89,15 @@ int id_lock(CallId id, void** data_out) {
         }
         butex_wait(s->lock_butex, seq, nullptr);
     }
+}
+}  // namespace
+
+int id_lock(CallId id, void** data_out) {
+    return id_lock_impl(id, data_out, false);
+}
+
+int id_lock_range(CallId id, void** data_out) {
+    return id_lock_impl(id, data_out, true);
 }
 
 int id_unlock(CallId id) {

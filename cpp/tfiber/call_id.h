@@ -28,6 +28,12 @@ int id_create(CallId* id, void* data, IdOnError on_error);
 // Lock the id; fails (-1) if the id/version is stale or destroyed. Blocks
 // (fiber- and pthread-aware) while another holder has the lock.
 int id_lock(CallId id, void** data_out);
+// Like id_lock but accepts ANY version in [first_ver, live_ver] — the
+// ranged lock of reference bthread_id_create_ranged (id.h:56). Backup
+// requests need it: the original call and the backup are BOTH live, and
+// whichever response arrives first must be able to lock; the caller
+// decides staleness by comparing the version against its in-flight calls.
+int id_lock_range(CallId id, void** data_out);
 int id_unlock(CallId id);
 // Unlock and destroy: wakes all joiners; further locks fail.
 int id_unlock_and_destroy(CallId id);

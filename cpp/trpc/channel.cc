@@ -136,6 +136,17 @@ void Channel::CallMethod(const google::protobuf::MethodDescriptor* method,
         cntl->timeout_timer_ = TimerThread::singleton()->schedule(
             HandleTimeoutCb, (void*)(uintptr_t)cid, cntl->deadline_us_);
     }
+    // Backup request timer (reference controller.cpp:344-358): fires
+    // before the deadline, re-issues on a second call id, first response
+    // wins. Requires retry budget (a backup consumes one retry).
+    const int64_t backup_ms = cntl->backup_request_ms_ >= 0
+                                  ? cntl->backup_request_ms_
+                                  : options_.backup_request_ms;
+    if (backup_ms >= 0 && (timeout_ms <= 0 || backup_ms < timeout_ms)) {
+        cntl->backup_timer_ = TimerThread::singleton()->schedule(
+            &Controller::HandleBackupThunk, (void*)(uintptr_t)cid,
+            cntl->start_us_ + backup_ms * 1000);
+    }
 
     cntl->IssueRPC();
     id_unlock(cid);  // delivers any queued early error

@@ -38,6 +38,9 @@ void Controller::Reset() {
     done_ = nullptr;
     correlation_id_ = INVALID_CALL_ID;
     current_cid_ = INVALID_CALL_ID;
+    unfinished_cid_ = INVALID_CALL_ID;
+    backup_timer_ = INVALID_TIMER_ID;
+    backup_request_ms_ = -1;
     request_buf_.clear();
     current_try_ = 0;
     start_us_ = 0;
@@ -110,6 +113,22 @@ static bool is_retryable(int error) {
 
 int Controller::HandleError(CallId id, int error) {
     // Runs with the id locked.
+    if (id != current_cid_ && id == unfinished_cid_ && is_retryable(error)) {
+        // A connection-level failure of the NON-current in-flight call
+        // (the original behind a backup request): only that call dies;
+        // the current call may still complete the RPC.
+        unfinished_cid_ = INVALID_CALL_ID;
+        return id_unlock(id);
+    }
+    if (id == current_cid_ && unfinished_cid_ != INVALID_CALL_ID &&
+        is_retryable(error)) {
+        // The backup's connection died while the original is still
+        // pending: fall back to waiting on the original instead of
+        // failing the whole RPC.
+        current_cid_ = unfinished_cid_;
+        unfinished_cid_ = INVALID_CALL_ID;
+        return id_unlock(id);
+    }
     const int effective_max_retry =
         max_retry_ >= 0 ? max_retry_
                         : (channel_ ? channel_->options().max_retry : 0);
@@ -234,6 +253,45 @@ void* Controller::RunDoneThunk(void* arg) {
     return nullptr;
 }
 
+// ---------------- backup requests ----------------
+
+// Timer callback: holds only the base CallId VALUE (a finished RPC makes
+// the lock fail — same hazard discipline as HandleTimeoutCb).
+void Controller::HandleBackupThunk(void* arg) {
+    const CallId cid = (CallId)(uintptr_t)arg;
+    void* data = nullptr;
+    if (id_lock_range(cid, &data) != 0) {
+        return;  // RPC already completed
+    }
+    ((Controller*)data)->MaybeIssueBackup();
+    id_unlock(cid);
+}
+
+void Controller::MaybeIssueBackup() {
+    // Runs with the id locked.
+    if (Failed() || canceled_ || unfinished_cid_ != INVALID_CALL_ID) {
+        return;  // already failed / already one backup out
+    }
+    const int effective_max_retry =
+        max_retry_ >= 0 ? max_retry_
+                        : (channel_ ? channel_->options().max_retry : 0);
+    if (current_try_ >= effective_max_retry) {
+        return;  // backup consumes retry budget (reference semantics)
+    }
+    const CallId next = id_next_version(current_cid_);
+    if (next == INVALID_CALL_ID) return;
+    // The original call STAYS live (ranged id): record it so its response
+    // can still win and its socket errors fail only it. Feed the LB a
+    // slow-but-ok data point for the original's server (elapsed latency,
+    // no error — the locality-aware policy deprioritizes it; the breaker
+    // sees no failure). The winner's stats land in EndRPC.
+    unfinished_cid_ = current_cid_;
+    FeedbackToLB(0);
+    current_cid_ = next;
+    ++current_try_;
+    IssueRPC();
+}
+
 void Controller::EndRPC(CallId locked_id) {
     latency_us_ = monotonic_time_us() - start_us_;
     FeedbackToLB(error_code_);
@@ -249,6 +307,10 @@ void Controller::EndRPC(CallId locked_id) {
         // destroyed (it only holds the id VALUE, never this pointer).
         TimerThread::singleton()->unschedule(timeout_timer_, false);
         timeout_timer_ = INVALID_TIMER_ID;
+    }
+    if (backup_timer_ != INVALID_TIMER_ID) {
+        TimerThread::singleton()->unschedule(backup_timer_, false);
+        backup_timer_ = INVALID_TIMER_ID;
     }
     google::protobuf::Closure* done = done_;
     id_unlock_and_destroy(locked_id);
@@ -272,10 +334,17 @@ void Controller::EndRPC(CallId locked_id) {
 void ProcessTpuStdResponse(TpuStdMessage* msg, const rpc::RpcMeta& meta) {
     const CallId cid = meta.correlation_id();
     void* data = nullptr;
-    if (id_lock(cid, &data) != 0) {
-        return;  // stale retry/duplicate/timeout-finished: drop
+    // Ranged lock: with a backup request out, TWO versions are in flight
+    // and either response may win. Versions outside the live set (retried
+    // tries, duplicates, finished RPCs) are dropped below / by the lock.
+    if (id_lock_range(cid, &data) != 0) {
+        return;  // destroyed (finished) or stale beyond the range: drop
     }
     Controller* cntl = (Controller*)data;
+    if (cid != cntl->current_cid_ && cid != cntl->unfinished_cid_) {
+        id_unlock(cid);  // an abandoned try's late response
+        return;
+    }
     const auto& rmeta = meta.response();
     if (rmeta.error_code() != 0) {
         cntl->SetFailed(rmeta.error_code(), "%s", rmeta.error_text().c_str());

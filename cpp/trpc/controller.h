@@ -122,12 +122,25 @@ private:
     friend void ProcessTpuStdResponse(class TpuStdMessage* msg,
                                       const rpc::RpcMeta& meta);
 
+public:
+    // Arm a backup request for this call at the given delay (overrides
+    // ChannelOptions::backup_request_ms; <0 disables).
+    void set_backup_request_ms(int64_t ms) { backup_request_ms_ = ms; }
+
+private:
+
     // Client call machinery (used by Channel).
     static int HandleErrorThunk(CallId id, void* data, int error);
     int HandleError(CallId id, int error);   // runs with the id locked
     void IssueRPC();                          // (re)send the current try
     void EndRPC(CallId locked_id);            // finalize: done/join wakeup
     static void* RunDoneThunk(void* arg);
+    // Backup request machinery (reference controller.cpp:344-358,625-638
+    // HandleBackupRequest): the timer fires at backup_request_ms; if the
+    // RPC is still pending, a second call goes out on the next id version
+    // while the original stays live — first response wins.
+    static void HandleBackupThunk(void* arg);  // arg = base CallId value
+    void MaybeIssueBackup();                   // runs with the id locked
     // Report the finished try to the LB (latency + error feed the
     // locality-aware policy; reference Call::OnComplete controller.cpp:780).
     void FeedbackToLB(int error);
@@ -152,6 +165,12 @@ private:
     google::protobuf::Closure* done_;
     CallId correlation_id_;   // base id (create version)
     CallId current_cid_;      // wire id of the current try
+    // The still-live other in-flight call once a backup went out (the
+    // reference's _unfinished_call): its response may win; its socket
+    // errors kill only it.
+    CallId unfinished_cid_;
+    TimerId backup_timer_;
+    int64_t backup_request_ms_;  // per-call override; <0 = channel default
     IOBuf request_buf_;       // serialized request payload (pb bytes)
     int current_try_;
     int64_t start_us_;

@@ -19,6 +19,7 @@
 
 #include "bench_echo.pb.h"
 #include "tbase/cpu_profiler.h"
+#include "tbase/fast_rand.h"
 #include "tbase/flags.h"
 #include "tbase/time.h"
 #include "tici/block_pool.h"
@@ -36,6 +37,12 @@ using namespace tpurpc;
 DECLARE_int32(socket_send_buffer_size);
 DECLARE_int32(socket_recv_buffer_size);
 
+// Long-tail injection for the backup-request benchmark (reference
+// docs/cn/benchmark.md:126-206: 1% of requests made slow, latency CDF
+// with/without backup requests stays flat).
+DEFINE_int32(echo_slow_percent, 0, "percent of echo calls made slow");
+DEFINE_int32(echo_slow_us, 10000, "injected handler delay in us");
+
 namespace {
 
 class EchoServiceImpl : public benchpb::EchoService {
@@ -45,6 +52,10 @@ public:
               benchpb::EchoResponse* response,
               google::protobuf::Closure* done) override {
         Controller* cntl = static_cast<Controller*>(cntl_base);
+        const int slow_pct = FLAGS_echo_slow_percent.get();
+        if (slow_pct > 0 && (int)(fast_rand() % 100) < slow_pct) {
+            fiber_usleep(FLAGS_echo_slow_us.get());
+        }
         response->set_send_ts_us(request->send_ts_us());
         cntl->response_attachment().append(cntl->request_attachment());
         done->Run();
@@ -78,9 +89,10 @@ void OnEchoDone(CallCtx* ctx) {
 }
 
 // `iters` async echo RPCs with `window` in flight; returns elapsed secs.
+// backup_ms >= 0 arms a backup request per call at that delay.
 double run_round(benchpb::EchoService_Stub& stub, size_t attachment_bytes,
                  int iters, int window, LatencyRecorder* lat,
-                 std::atomic<int64_t>* bytes) {
+                 std::atomic<int64_t>* bytes, int64_t backup_ms = -1) {
     // Pre-built attachment appended by reference (zero-copy), matching the
     // reference drivers (example/multi_threaded_echo_c++ appends a global
     // butil::IOBuf g_attachment).
@@ -99,6 +111,10 @@ double run_round(benchpb::EchoService_Stub& stub, size_t attachment_bytes,
             ctx->lat = lat;
             ctx->bytes = bytes;
             ctx->cntl.set_timeout_ms(10000);
+            if (backup_ms >= 0) {
+                ctx->cntl.set_backup_request_ms(backup_ms);
+                ctx->cntl.set_max_retry(1);  // backup consumes retry budget
+            }
             ctx->req.set_send_ts_us(monotonic_time_us());
             if (attachment_bytes > 0) {
                 ctx->cntl.request_attachment().append(filler);
@@ -181,11 +197,13 @@ int main(int argc, char** argv) {
     bool json = false;
     bool use_ici = false;
     bool xproc = false;
+    bool tail = false;
     const char* prof_path = nullptr;
     for (int i = 1; i < argc; ++i) {
         if (strcmp(argv[i], "--json") == 0) json = true;
         if (strcmp(argv[i], "--ici") == 0) use_ici = true;
         if (strcmp(argv[i], "--xproc") == 0) xproc = true;
+        if (strcmp(argv[i], "--tail") == 0) tail = true;
         if (strcmp(argv[i], "--ici-server") == 0) return RunIciServer();
         if (strcmp(argv[i], "--prof") == 0 && i + 1 < argc) {
             prof_path = argv[++i];
@@ -255,6 +273,50 @@ int main(int argc, char** argv) {
         if (channel.Init(ep, &copts) != 0) return 1;
     }
     benchpb::EchoService_Stub stub(&channel);
+
+    if (tail) {
+        // Backup-request tail benchmark (reference benchmark.md:126-206):
+        // 2% of handler calls sleep echo_slow_us; compare the latency
+        // distribution without and with backup requests armed at 2ms.
+        run_round(stub, 4096, 500, 16, nullptr, nullptr);  // warmup
+        FLAGS_echo_slow_percent.set(2);
+        const int kTailIters = 6000;
+        LatencyRecorder lat_nb, lat_b;
+        lat_nb.expose("tail_echo_nobackup");
+        lat_b.expose("tail_echo_backup");
+        if (run_round(stub, 4096, kTailIters, 16, &lat_nb, nullptr) < 0) {
+            return 1;
+        }
+        if (run_round(stub, 4096, kTailIters, 16, &lat_b, nullptr, 2) < 0) {
+            return 1;
+        }
+        FLAGS_echo_slow_percent.set(0);
+        if (json) {
+            printf("{\"tail_p50_us\": %lld, "
+                   "\"tail_p99_nobackup_us\": %lld, "
+                   "\"tail_p999_nobackup_us\": %lld, "
+                   "\"tail_p99_backup_us\": %lld, "
+                   "\"tail_p999_backup_us\": %lld}\n",
+                   (long long)lat_b.latency_percentile(0.5),
+                   (long long)lat_nb.latency_percentile(0.99),
+                   (long long)lat_nb.latency_percentile(0.999),
+                   (long long)lat_b.latency_percentile(0.99),
+                   (long long)lat_b.latency_percentile(0.999));
+        } else {
+            printf("tail (2%% of calls +%dus), no backup: p50 %lld p99 "
+                   "%lld p999 %lld\n",
+                   FLAGS_echo_slow_us.get(),
+                   (long long)lat_nb.latency_percentile(0.5),
+                   (long long)lat_nb.latency_percentile(0.99),
+                   (long long)lat_nb.latency_percentile(0.999));
+            printf("tail with backup@2ms:          p50 %lld p99 %lld "
+                   "p999 %lld\n",
+                   (long long)lat_b.latency_percentile(0.5),
+                   (long long)lat_b.latency_percentile(0.99),
+                   (long long)lat_b.latency_percentile(0.999));
+        }
+        return 0;
+    }
 
     LatencyRecorder lat;
     lat.expose("rpc_echo_4k_latency");

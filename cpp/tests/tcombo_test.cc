@@ -52,8 +52,8 @@ struct TestServer {
     std::string addr() const {
         return "127.0.0.1:" + std::to_string(port());
     }
-    Server server;
     NamedEchoService service;
+    Server server;
 };
 
 // Concatenating merger: parent message += "|" + sub message.

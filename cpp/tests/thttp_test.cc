@@ -269,16 +269,16 @@ TEST(HttpPortal, LivePortalOverTcp) {
         ASSERT_EQ((ssize_t)strlen(two), write(fd, two, strlen(two)));
         std::string out;
         char buf[4096];
-        for (int i = 0; i < 100 && out.size() < 2; ++i) {
-            const ssize_t r = read(fd, buf, sizeof(buf));
-            if (r <= 0) break;
-            out.append(buf, (size_t)r);
+        for (int i = 0; i < 100; ++i) {
             size_t count = 0, pos = 0;
             while ((pos = out.find("200 OK", pos)) != std::string::npos) {
                 ++count;
                 pos += 6;
             }
             if (count >= 2) break;
+            const ssize_t r = read(fd, buf, sizeof(buf));
+            if (r <= 0) break;
+            out.append(buf, (size_t)r);
         }
         size_t count = 0, pos = 0;
         while ((pos = out.find("200 OK", pos)) != std::string::npos) {

@@ -204,8 +204,10 @@ public:
 
 TEST(IciRpc, EchoOverIciLink) {
     // Server with no TCP listener: the data plane is the ICI link.
-    Server server;
+    // service declared BEFORE server: ~Server (Stop+Join) must
+    // drain handler fibers while the service object is still alive.
     IciEchoServiceImpl service;
+    Server server;
     ASSERT_EQ(0, server.AddService(&service));
     ASSERT_EQ(0, server.StartNoListen(nullptr));
 

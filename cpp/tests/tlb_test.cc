@@ -54,8 +54,10 @@ public:
 };
 
 struct TestServer {
-    Server server;
+    // service declared BEFORE server: ~Server (Stop+Join) must
+    // drain handler fibers while the service object is still alive.
     EchoServiceImpl service;
+    Server server;
     EndPoint ep;
 
     bool start() {
@@ -477,9 +479,9 @@ TEST(CircuitBreakerIntegration, IsolatesFailingServer) {
         int32_t old;
         ~HcRestore() { FLAGS_ns_health_check_interval_ms.set(old); }
     } restore{old_hc};
-    Server healthy_srv, flaky_srv;
     EchoServiceImpl healthy;
     FlakyEchoServiceImpl flaky;
+    Server healthy_srv, flaky_srv;
     flaky.fail_all = true;
     ASSERT_EQ(0, healthy_srv.AddService(&healthy));
     ASSERT_EQ(0, flaky_srv.AddService(&flaky));

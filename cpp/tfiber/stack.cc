@@ -27,10 +27,19 @@ extern "C" void __asan_unpoison_memory_region(void const volatile* addr,
 namespace tpurpc {
 
 size_t stack_size_of(int type) {
+    // ASan redzones inflate every frame several-fold, and its fatal-error
+    // reporter runs on the faulting (fiber) stack — undersized stacks turn
+    // any report into a nested guard-page fault that truncates it.
+    // (Same gcc+clang detection idiom as TF_UNPOISON_STACK above.)
+#if defined(__SANITIZE_ADDRESS__) || __has_feature(address_sanitizer)
+    constexpr size_t kScale = 8;
+#else
+    constexpr size_t kScale = 1;
+#endif
     switch (type) {
-        case STACK_TYPE_SMALL: return 32 * 1024;
-        case STACK_TYPE_LARGE: return 1024 * 1024;
-        default: return 256 * 1024;
+        case STACK_TYPE_SMALL: return kScale * 32 * 1024;
+        case STACK_TYPE_LARGE: return kScale * 1024 * 1024;
+        default: return kScale * 256 * 1024;
     }
 }
 
@@ -41,7 +50,13 @@ struct StackPool {
     std::vector<void*> free_bases;  // low addresses incl. guard page
 };
 
-StackPool g_pools[3];
+// Intentionally leaked: this was the ONLY static destructor in the whole
+// library, and it freed the free_bases vectors at process exit while
+// worker/dispatcher/timer threads still start and finish fibers — whose
+// return_stack() then pushed into the freed vector buffer (an exit-time
+// heap-use-after-free observed under ASan). Process-lifetime threads
+// require process-lifetime pools (same rule as every other singleton).
+StackPool* const g_pools = new StackPool[3];
 
 constexpr size_t kGuard = 4096;
 

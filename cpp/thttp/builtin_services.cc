@@ -44,12 +44,13 @@ void HandleStatus(Server* server, const HttpRequest&, HttpResponse* res) {
         const MethodStatus& st = *kv.second.status;
         snprintf(line, sizeof(line),
                  "%s\n"
-                 "  count: %lld  qps: %lld  concurrency: %lld"
+                 "  count: %lld  qps: %lld  concurrency: %lld/%lld"
                  "  errors: %lld  rejected: %lld\n"
                  "  latency_us: p50 %lld  p99 %lld  p999 %lld  max %lld\n",
                  kv.first.c_str(), (long long)st.latency.count(),
                  (long long)st.latency.qps(),
                  (long long)st.concurrency.load(),
+                 (long long)st.max_concurrency(),  // 0 = unlimited
                  (long long)st.nerror.load(), (long long)st.nrejected.load(),
                  (long long)st.latency.latency_percentile(0.5),
                  (long long)st.latency.latency_percentile(0.99),

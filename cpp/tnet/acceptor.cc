@@ -5,8 +5,10 @@
 #include <unistd.h>
 
 #include <cerrno>
+#include <string>
 
 #include "tbase/logging.h"
+#include "tbase/time.h"
 #include "tfiber/fiber.h"
 
 namespace tpurpc {
@@ -33,11 +35,47 @@ int Acceptor::StartAccept(const EndPoint& ep) {
     opts.fd = listen_fd;
     opts.on_edge_triggered_events = &Acceptor::OnNewConnections;
     opts.user = this;
+    opts.on_recycle = &Acceptor::ListenRecycled;
+    opts.recycle_arg = this;
+    listen_live_.store(true, std::memory_order_release);
     if (Socket::Create(opts, &listen_id_) != 0) {
-        // Socket::Create owns (and closed) listen_fd on failure.
+        // Socket::Create owns (and closed) listen_fd on failure; the
+        // recycle callback already reset listen_live_.
+        listen_id_ = INVALID_VREF_ID;
         return -1;
     }
     return 0;
+}
+
+// Both recycle callbacks follow the same teardown-safe protocol as
+// Server::EndRequest: every touch of the Acceptor happens BEFORE the
+// releasing store/decrement that lets StopAccept return (the object is
+// pinned until then), the butex pointer is captured into a local, and the
+// only post-release action is butex_wake_all on that local — which on a
+// recycled slot is at worst a spurious wake (butex.cc pool contract; the
+// word itself is bumped pre-release so slot reuse cannot be corrupted).
+
+void Acceptor::ListenRecycled(void* arg, SocketId) {
+    auto* a = (Acceptor*)arg;
+    void* qb = a->quiesce_butex_;
+    butex_word(qb)->fetch_add(1, std::memory_order_release);
+    a->listen_live_.store(false, std::memory_order_release);
+    // `a` may be freed from here on.
+    butex_wake_all(qb);
+}
+
+void Acceptor::ConnRecycled(void* arg, SocketId id) {
+    auto* a = (Acceptor*)arg;
+    if (id != INVALID_VREF_ID) {
+        std::lock_guard<std::mutex> g(a->conn_mu_);
+        a->conn_ids_.erase(id);
+    }
+    void* qb = a->quiesce_butex_;
+    butex_word(qb)->fetch_add(1, std::memory_order_release);
+    if (a->live_conns_.fetch_sub(1, std::memory_order_acq_rel) == 1) {
+        // `a` may be freed from here on.
+        butex_wake_all(qb);
+    }
 }
 
 void Acceptor::StopAccept() {
@@ -45,49 +83,65 @@ void Acceptor::StopAccept() {
         Socket::SetFailedById(listen_id_);
         listen_id_ = INVALID_VREF_ID;
     }
-    std::lock_guard<std::mutex> g(conn_mu_);
-    for (SocketId id : conn_ids_) {
+    // Fail every accepted connection (copy ids first: the recycle callback
+    // takes conn_mu_, possibly inline from SetFailedById's last deref).
+    std::vector<SocketId> ids;
+    {
+        std::lock_guard<std::mutex> g(conn_mu_);
+        ids.assign(conn_ids_.begin(), conn_ids_.end());
+    }
+    for (SocketId id : ids) {
         Socket::SetFailedById(id);
     }
-    conn_ids_.clear();
+    // Quiesce: no accepted socket (or the listen socket) may survive this
+    // function — a live one means some fiber can still reach the Server
+    // this Acceptor is embedded in.
+    const int64_t quiesce_t0 = monotonic_time_us();
+    int64_t next_warn_us = quiesce_t0 + 2 * 1000 * 1000;
+    while (live_conns_.load(std::memory_order_acquire) > 0 ||
+           listen_live_.load(std::memory_order_acquire)) {
+        const int seq =
+            butex_word(quiesce_butex_)->load(std::memory_order_acquire);
+        if (live_conns_.load(std::memory_order_acquire) <= 0 &&
+            !listen_live_.load(std::memory_order_acquire)) {
+            break;
+        }
+        if (monotonic_time_us() >= next_warn_us) {
+            next_warn_us += 2 * 1000 * 1000;
+            std::string detail;
+            {
+                std::lock_guard<std::mutex> g(conn_mu_);
+                for (SocketId cid : conn_ids_) {
+                    Socket* raw = address_resource<Socket>(VRefSlot(cid));
+                    char buf[64];
+                    snprintf(buf, sizeof(buf), " id=%llu nref=%d",
+                             (unsigned long long)cid,
+                             raw != nullptr ? raw->nref() : -1);
+                    detail += buf;
+                }
+            }
+            LOG(WARNING) << "StopAccept quiescing for "
+                         << (monotonic_time_us() - quiesce_t0) / 1000
+                         << "ms: live_conns=" << live_conns_.load()
+                         << " listen_live=" << listen_live_.load()
+                         << detail;
+        }
+        // Backstop timeout: wake-before-wait races resolve on re-check.
+        const int64_t abst = monotonic_time_us() + 50 * 1000;
+        butex_wait(quiesce_butex_, seq, &abst);
+    }
 }
 
 std::vector<SocketId> Acceptor::connections() {
     std::lock_guard<std::mutex> g(conn_mu_);
-    std::vector<SocketId> live;
-    for (auto it = conn_ids_.begin(); it != conn_ids_.end();) {
-        Socket* s = Socket::Address(*it);
-        if (s == nullptr) {
-            it = conn_ids_.erase(it);  // prune dead ids
-        } else {
-            s->Dereference();
-            live.push_back(*it);
-            ++it;
-        }
-    }
-    return live;
-}
-
-void Acceptor::record_connection(SocketId id) {
-    std::lock_guard<std::mutex> g(conn_mu_);
-    conn_ids_.insert(id);
-    // Bound growth under connection churn: prune dead ids periodically.
-    if (conn_ids_.size() > 1024 && (conn_ids_.size() & 1023) == 0) {
-        for (auto it = conn_ids_.begin(); it != conn_ids_.end();) {
-            Socket* s = Socket::Address(*it);
-            if (s == nullptr) {
-                it = conn_ids_.erase(it);
-            } else {
-                s->Dereference();
-                ++it;
-            }
-        }
-    }
+    // The recycle callback erases dead ids, so everything here is live or
+    // at worst mid-failure.
+    return std::vector<SocketId>(conn_ids_.begin(), conn_ids_.end());
 }
 
 void Acceptor::OnNewConnections(Socket* listen_socket) {
     Acceptor* a = (Acceptor*)listen_socket->user();
-    while (true) {
+    while (!listen_socket->Failed()) {
         sockaddr_in peer;
         socklen_t plen = sizeof(peer);
         const int fd = accept4(listen_socket->fd(), (sockaddr*)&peer, &plen,
@@ -110,12 +164,39 @@ void Acceptor::OnNewConnections(Socket* listen_socket) {
         opts.remote_side = sockaddr2endpoint(peer);
         opts.on_edge_triggered_events = &InputMessenger::OnNewMessages;
         opts.user = a->messenger_;
+        opts.on_recycle = &Acceptor::ConnRecycled;
+        opts.recycle_arg = a;
+        // Account BEFORE Create: the socket can fail+recycle (firing the
+        // callback) before Create even returns; the liveness-checked
+        // insert below then skips the already-recycled id.
+        a->live_conns_.fetch_add(1, std::memory_order_acq_rel);
         SocketId id;
         if (Socket::Create(opts, &id) != 0) {
-            // Socket::Create owns (and closed) fd on failure.
+            // Create closed fd and fired the callback (which balanced the
+            // counter).
             continue;
         }
-        a->record_connection(id);
+        // Address OUTSIDE conn_mu_, and drop the ref outside it too: if
+        // ours is the last ref (instant peer RST), Dereference runs
+        // OnRecycle inline, whose ConnRecycled callback locks conn_mu_ —
+        // holding it here would self-deadlock.
+        Socket* s = Socket::Address(id);
+        if (s != nullptr) {
+            {
+                std::lock_guard<std::mutex> g(a->conn_mu_);
+                a->conn_ids_.insert(id);
+            }
+            s->Dereference();
+        }
+        // Teardown handshake: StopAccept fails the listener BEFORE copying
+        // conn_ids_ (under conn_mu_); we insert under conn_mu_ BEFORE this
+        // check. So either our insert made StopAccept's copy (it fails the
+        // conn), or our check observes the failed listener (we fail it).
+        // Without this, a connection accepted by an in-flight burst right
+        // after the copy is never failed and quiesce hangs forever.
+        if (listen_socket->Failed()) {
+            Socket::SetFailedById(id);
+        }
         a->accepted_.fetch_add(1, std::memory_order_relaxed);
     }
 }

@@ -1,6 +1,8 @@
 // Acceptor: the listen-socket loop creating per-connection Sockets bound to
 // a messenger. Modeled on reference src/brpc/acceptor.{h,cpp} (accept() as
-// an InputMessenger subclass; per-connection Socket::Create).
+// an InputMessenger subclass; per-connection Socket::Create; Join() waits
+// for every accepted connection's Socket to be *recycled* before returning
+// so no in-flight event fiber can outlive the owning Server).
 #pragma once
 
 #include <atomic>
@@ -9,6 +11,7 @@
 #include <vector>
 
 #include "tbase/endpoint.h"
+#include "tfiber/butex.h"
 #include "tnet/input_messenger.h"
 #include "tnet/socket.h"
 
@@ -16,15 +19,22 @@ namespace tpurpc {
 
 class Acceptor {
 public:
-    explicit Acceptor(InputMessenger* messenger) : messenger_(messenger) {}
-    ~Acceptor() { StopAccept(); }
+    explicit Acceptor(InputMessenger* messenger) : messenger_(messenger) {
+        quiesce_butex_ = butex_create();
+    }
+    ~Acceptor() {
+        StopAccept();
+        butex_destroy(quiesce_butex_);
+    }
 
     // Listen on `ep` (port 0 picks one; see listened_port()). Returns 0.
     int StartAccept(const EndPoint& ep);
-    // Stops listening AND fails all accepted connections — their sockets
-    // hold pointers into the owning server, which may be destroyed next
-    // (reference Acceptor keeps the connection list for the same reason,
-    // acceptor.h + /connections).
+    // Stops listening, fails all accepted connections, then BLOCKS until
+    // the listen socket and every accepted socket have been recycled —
+    // i.e. no event/processing fiber still holds a pointer into this
+    // Acceptor or its messenger/Server. Without this wait, destroying a
+    // Server races in-flight fibers (the reference's Acceptor::Join,
+    // acceptor.cpp, exists for exactly this).
     void StopAccept();
     int listened_port() const { return listened_port_; }
 
@@ -32,12 +42,13 @@ public:
     int64_t accepted_count() const {
         return accepted_.load(std::memory_order_relaxed);
     }
-    // Live accepted connections (for /connections later).
+    // Live accepted connections (for /connections).
     std::vector<SocketId> connections();
 
 private:
     static void OnNewConnections(Socket* listen_socket);
-    void record_connection(SocketId id);
+    static void ConnRecycled(void* arg, SocketId id);
+    static void ListenRecycled(void* arg, SocketId id);
 
     InputMessenger* messenger_;
     SocketId listen_id_ = INVALID_VREF_ID;
@@ -45,6 +56,11 @@ private:
     std::atomic<int64_t> accepted_{0};
     std::mutex conn_mu_;
     std::set<SocketId> conn_ids_;
+    // Quiesce accounting: +1 per accepted socket (before Create), -1 from
+    // the recycle callback; listen_live_ covers the listen socket itself.
+    std::atomic<int64_t> live_conns_{0};
+    std::atomic<bool> listen_live_{false};
+    void* quiesce_butex_ = nullptr;
 };
 
 }  // namespace tpurpc

@@ -51,6 +51,11 @@ int Socket::Create(const SocketOptions& options, SocketId* id) {
     Socket* s = nullptr;
     if (VersionedRefWithId<Socket>::Create(id, &s) != 0) {
         if (options.fd >= 0) close(options.fd);
+        // Keep the fires-exactly-once contract even when no slot was ever
+        // allocated (callers pre-account and rely on the callback to undo).
+        if (options.on_recycle != nullptr) {
+            options.on_recycle(options.recycle_arg, INVALID_VREF_ID);
+        }
         return -1;
     }
     // Slots are recycled without destruction: re-init everything.
@@ -75,6 +80,10 @@ int Socket::Create(const SocketOptions& options, SocketId* id) {
     s->health_check_interval_ms_ = options.health_check_interval_ms;
     s->hc_stop_.store(false, std::memory_order_relaxed);
     s->circuit_breaker_.ResetAll();
+    // Install before any failure path below: AddConsumer failure recycles
+    // the socket, which must still deliver the notification.
+    s->on_recycle_ = options.on_recycle;
+    s->recycle_arg_ = options.recycle_arg;
     if (s->epollout_butex_ == nullptr) s->epollout_butex_ = butex_create();
     if (s->connect_butex_ == nullptr) s->connect_butex_ = butex_create();
 
@@ -218,6 +227,16 @@ void Socket::OnRecycle() {
     if (transport_ != nullptr) {
         if (owns_transport_) transport_->Release();
         transport_ = nullptr;
+    }
+    // Last: the recycle notification (quiesce signal for Acceptor/Server
+    // teardown). After this fires the owner may free itself, so nothing
+    // below may touch user_/recycle_arg_ again.
+    if (on_recycle_ != nullptr) {
+        auto cb = on_recycle_;
+        void* arg = recycle_arg_;
+        on_recycle_ = nullptr;
+        recycle_arg_ = nullptr;
+        cb(arg, id());
     }
 }
 

@@ -51,6 +51,14 @@ struct SocketOptions {
     // src/brpc/details/health_check.cpp — ids held by load balancers stay
     // valid across failures). 0 disables.
     int health_check_interval_ms = 0;
+    // Invoked exactly once when the socket's last ref drops and the slot
+    // recycles (reference SocketUser::BeforeRecycled). This is how an
+    // Acceptor learns no event/processing fiber can still be touching a
+    // connection — the quiesce signal Server teardown waits on. Must be
+    // cheap and lock-light (runs on whatever fiber dropped the last ref).
+    // Guarantee: if set, it fires even when Create() itself fails.
+    void (*on_recycle)(void* arg, SocketId id) = nullptr;
+    void* recycle_arg = nullptr;
 };
 
 class Socket : public VersionedRefWithId<Socket> {
@@ -189,6 +197,8 @@ private:
     int health_check_interval_ms_ = 0;
     std::atomic<bool> hc_stop_{false};
     CircuitBreaker circuit_breaker_;
+    void (*on_recycle_)(void*, SocketId) = nullptr;
+    void* recycle_arg_ = nullptr;
 };
 
 }  // namespace tpurpc

@@ -1,0 +1,139 @@
+#include "trpc/concurrency_limiter.h"
+
+#include "tbase/fast_rand.h"
+#include "tbase/time.h"
+
+namespace tpurpc {
+
+void AutoConcurrencyLimiter::OnResponded(int error_code, int64_t latency_us) {
+    const int64_t now_us = monotonic_time_us();
+    // Rate-limit sampling: one sample per sampling_interval (reference
+    // AddSample checks _last_sampling_time_us the same way) so the hot
+    // path is one atomic load + compare for most requests.
+    int64_t last = last_sampling_time_us_.load(std::memory_order_relaxed);
+    if (now_us - last < opt_.sampling_interval_us) {
+        return;
+    }
+    if (!last_sampling_time_us_.compare_exchange_strong(
+            last, now_us, std::memory_order_relaxed)) {
+        return;  // another responder sampled this tick
+    }
+
+    std::lock_guard<std::mutex> g(sw_mu_);
+    if (reset_latency_us_ > 0) {
+        // Remeasure probe in progress: ignore responses admitted under the
+        // old (higher) limit until they drain, then restart the estimate.
+        if (now_us < reset_latency_us_) {
+            return;
+        }
+        reset_latency_us_ = 0;
+        min_latency_us_ = -1;
+    }
+    if (sw_.start_time_us == 0) {
+        sw_.start_time_us = now_us;
+    }
+    if (error_code == 0) {
+        ++sw_.succ_count;
+        sw_.total_succ_us += latency_us;
+    } else {
+        ++sw_.failed_count;
+        sw_.total_failed_us += latency_us;
+    }
+    const int32_t n = sw_.succ_count + sw_.failed_count;
+    const int64_t elapsed = now_us - sw_.start_time_us;
+    if (elapsed < opt_.sample_window_us && n < opt_.max_sample_count) {
+        return;  // window still filling
+    }
+    if (n < opt_.min_sample_count) {
+        // Sparse window (low-QPS service): too few samples to act on.
+        // Updating here would read the tiny window's QPS as the service's
+        // capacity and collapse the limit (reference resets and skips).
+        ResetSampleWindow(now_us);
+        return;
+    }
+    if (sw_.succ_count > 0) {
+        UpdateMaxConcurrency(now_us);
+    } else {
+        // Every request in the window failed: halve.
+        const int64_t cur = max_concurrency_.load(std::memory_order_relaxed);
+        max_concurrency_.store(
+            std::max(opt_.min_max_concurrency, cur / 2),
+            std::memory_order_relaxed);
+    }
+    ResetSampleWindow(now_us);
+}
+
+void AutoConcurrencyLimiter::ResetSampleWindow(int64_t now_us) {
+    sw_.start_time_us = now_us;
+    sw_.succ_count = 0;
+    sw_.failed_count = 0;
+    sw_.total_failed_us = 0;
+    sw_.total_succ_us = 0;
+}
+
+void AutoConcurrencyLimiter::UpdateMaxConcurrency(int64_t now_us) {
+    const double failed_punish =
+        (double)sw_.total_failed_us * opt_.fail_punish_ratio;
+    const int64_t avg_latency = (int64_t)std::ceil(
+        (failed_punish + (double)sw_.total_succ_us) / sw_.succ_count);
+    const double qps = 1e6 * (sw_.succ_count + sw_.failed_count) /
+                       (double)std::max<int64_t>(1, now_us - sw_.start_time_us);
+
+    // EMA of the window-minimum latency: only lower observations move it
+    // (and slowly), so transient congestion can't inflate the baseline.
+    if (min_latency_us_ <= 0) {
+        min_latency_us_ = avg_latency;
+    } else if (avg_latency < min_latency_us_) {
+        min_latency_us_ = (int64_t)(avg_latency * opt_.alpha_ema +
+                                    min_latency_us_ * (1 - opt_.alpha_ema));
+    }
+    // EMA of peak throughput: jumps up instantly, decays slowly.
+    if (qps >= ema_max_qps_) {
+        ema_max_qps_ = qps;
+    } else {
+        const double f = opt_.alpha_ema / 10;
+        ema_max_qps_ = qps * f + ema_max_qps_ * (1 - f);
+    }
+
+    if (remeasure_start_us_ == 0) {
+        // First completed window: schedule the first probe one interval
+        // out (jittered). Probing immediately would cut the limit and
+        // discard the estimate that was just built.
+        remeasure_start_us_ =
+            now_us + opt_.remeasure_interval_us / 2 +
+            (int64_t)(fast_rand() %
+                      (uint64_t)(opt_.remeasure_interval_us / 2 + 1));
+    }
+    int64_t next;
+    if (opt_.remeasure_interval_us > 1 && remeasure_start_us_ <= now_us) {
+        // Periodic no-load remeasure: drop the limit, flag the drain
+        // period, clear min_latency once drained.
+        reset_latency_us_ = now_us + avg_latency * 2;
+        remeasure_start_us_ =
+            now_us + (opt_.remeasure_interval_us / 2 +
+                      (int64_t)(fast_rand() %
+                                (uint64_t)(opt_.remeasure_interval_us / 2)));
+        next = (int64_t)std::ceil(ema_max_qps_ * min_latency_us_ / 1e6 *
+                                  opt_.remeasure_reduce_ratio);
+    } else {
+        // Steady state: explore upward while latency stays near the
+        // no-load baseline, back off as congestion shows up.
+        if (avg_latency <=
+                min_latency_us_ * (1.0 + opt_.min_explore_ratio) ||
+            qps <= ema_max_qps_ / (1.0 + opt_.min_explore_ratio)) {
+            explore_ratio_ = std::min(opt_.max_explore_ratio,
+                                      explore_ratio_ +
+                                          opt_.explore_change_step);
+        } else {
+            explore_ratio_ = std::max(opt_.min_explore_ratio,
+                                      explore_ratio_ -
+                                          opt_.explore_change_step);
+        }
+        next = (int64_t)(min_latency_us_ * ema_max_qps_ / 1e6 *
+                         (1 + explore_ratio_));
+    }
+    max_concurrency_.store(std::max(opt_.min_max_concurrency, next),
+                           std::memory_order_relaxed);
+}
+
+}  // namespace tpurpc

@@ -1,0 +1,118 @@
+// ConcurrencyLimiter: per-method admission control.
+//
+// Modeled on reference src/brpc/concurrency_limiter.h:29 and
+// policy/auto_concurrency_limiter.{h,cpp} (state fields .h:57-73): the
+// "auto" limiter estimates the no-load latency (EMA of window minima) and
+// the peak service rate (EMA of max QPS), and sets
+//   max_concurrency = min_latency_us * ema_max_qps * (1 + explore_ratio)
+// (Little's law with headroom). Periodically it shrinks the limit hard to
+// re-measure the no-load latency, so a slowly-degrading backend can't
+// ratchet the estimate upward. Failed requests punish the average latency.
+#pragma once
+
+#include <algorithm>
+#include <atomic>
+#include <cmath>
+#include <cstdint>
+#include <mutex>
+
+namespace tpurpc {
+
+class ConcurrencyLimiter {
+public:
+    virtual ~ConcurrencyLimiter() = default;
+    // current = concurrency AFTER this request was counted in. True =
+    // admit.
+    virtual bool OnRequested(int64_t current) = 0;
+    // Every admitted request reports its outcome.
+    virtual void OnResponded(int error_code, int64_t latency_us) = 0;
+    virtual int64_t MaxConcurrency() const = 0;
+};
+
+// "constant": fixed cap; 0 = unlimited.
+class ConstantConcurrencyLimiter : public ConcurrencyLimiter {
+public:
+    explicit ConstantConcurrencyLimiter(int64_t max) : max_(max) {}
+    bool OnRequested(int64_t current) override {
+        const int64_t m = max_.load(std::memory_order_relaxed);
+        return m <= 0 || current <= m;
+    }
+    void OnResponded(int, int64_t) override {}
+    int64_t MaxConcurrency() const override {
+        return max_.load(std::memory_order_relaxed);
+    }
+
+private:
+    std::atomic<int64_t> max_;
+};
+
+// "auto": the gradient limiter.
+class AutoConcurrencyLimiter : public ConcurrencyLimiter {
+public:
+    struct Options {
+        int64_t initial_max_concurrency = 40;
+        int64_t min_max_concurrency = 4;    // never throttle below this
+        int64_t sampling_interval_us = 100;  // min gap between samples
+        int64_t sample_window_us = 1000 * 1000;
+        int32_t min_sample_count = 100;
+        int32_t max_sample_count = 200;
+        double alpha_ema = 0.1;              // min-latency smoothing
+        double fail_punish_ratio = 1.0;      // failed time charged to avg
+        double max_explore_ratio = 0.3;
+        double min_explore_ratio = 0.06;
+        double explore_change_step = 0.02;
+        double remeasure_reduce_ratio = 0.9;  // limit factor while probing
+        int64_t remeasure_interval_us = 20 * 1000 * 1000;
+    };
+
+    AutoConcurrencyLimiter() : AutoConcurrencyLimiter(Options()) {}
+    explicit AutoConcurrencyLimiter(const Options& opt)
+        : opt_(opt),
+          max_concurrency_(opt.initial_max_concurrency),
+          remeasure_start_us_(0),
+          reset_latency_us_(0),
+          min_latency_us_(-1),
+          ema_max_qps_(-1),
+          explore_ratio_(opt.max_explore_ratio) {}
+
+    bool OnRequested(int64_t current) override {
+        return current <= max_concurrency_.load(std::memory_order_relaxed);
+    }
+
+    void OnResponded(int error_code, int64_t latency_us) override;
+
+    int64_t MaxConcurrency() const override {
+        return max_concurrency_.load(std::memory_order_relaxed);
+    }
+
+    // Exposed for tests: the smoothed no-load latency estimate.
+    int64_t min_latency_us() const { return min_latency_us_; }
+    double ema_max_qps() const { return ema_max_qps_; }
+
+private:
+    // All called under sw_mu_.
+    void UpdateMaxConcurrency(int64_t now_us);
+    void ResetSampleWindow(int64_t now_us);
+
+    struct SampleWindow {
+        int64_t start_time_us = 0;
+        int32_t succ_count = 0;
+        int32_t failed_count = 0;
+        int64_t total_failed_us = 0;
+        int64_t total_succ_us = 0;
+    };
+
+    const Options opt_;
+    std::atomic<int64_t> max_concurrency_;
+    // Window state (sampled path only).
+    int64_t remeasure_start_us_;
+    int64_t reset_latency_us_;
+    int64_t min_latency_us_;
+    double ema_max_qps_;
+    double explore_ratio_;
+    std::atomic<int64_t> last_sampling_time_us_{0};
+    std::mutex sw_mu_;
+    SampleWindow sw_;
+};
+
+}  // namespace tpurpc

@@ -126,15 +126,20 @@ public:
         if (Socket::AddressSocket(sid_, &s) == 0) {
             s->Write(&frame);
         }
-        // Stats.
+        // Stats. EndRequest is the LAST touch of Server memory: it wakes
+        // Server::Join, after which the Server may be destroyed.
         if (mp_ != nullptr) {
-            mp_->status->latency << (monotonic_time_us() - start_us_);
+            const int64_t lat_us = monotonic_time_us() - start_us_;
+            mp_->status->latency << lat_us;
             mp_->status->concurrency.fetch_sub(1, std::memory_order_relaxed);
             if (cntl_->Failed()) {
                 mp_->status->nerror.fetch_add(1, std::memory_order_relaxed);
             }
+            if (mp_->status->limiter != nullptr) {
+                mp_->status->limiter->OnResponded(cntl_->ErrorCode(), lat_us);
+            }
         }
-        server_->nprocessing.fetch_sub(1, std::memory_order_relaxed);
+        server_->EndRequest();
         delete req_;
         delete res_;
         delete cntl_;
@@ -151,6 +156,23 @@ private:
     uint64_t cid_;
     int64_t start_us_;
 };
+
+// Carries one parsed request to its user-code fiber.
+struct UserCallArgs {
+    Server::MethodProperty* mp;
+    Controller* cntl;
+    google::protobuf::Message* req;
+    google::protobuf::Message* res;
+    google::protobuf::Closure* done;
+};
+
+void* RunUserCall(void* arg) {
+    auto* a = (UserCallArgs*)arg;
+    a->mp->service->CallMethod(a->mp->method, a->cntl, a->req, a->res,
+                               a->done);
+    delete a;
+    return nullptr;
+}
 
 void SendErrorResponse(SocketId sid, uint64_t cid, int err,
                        const std::string& text) {
@@ -187,24 +209,24 @@ void ProcessTpuStdRequest(TpuStdMessage* msg, const rpc::RpcMeta& meta) {
                               req_meta.method_name());
         return;
     }
-    // Admission control (the "constant" limiter; reference
-    // ConcurrencyLimiter::OnRequested).
+    // Admission control (reference ConcurrencyLimiter::OnRequested —
+    // constant or gradient "auto" per ServerOptions).
     const int64_t cur =
         mp->status->concurrency.fetch_add(1, std::memory_order_relaxed) + 1;
-    if (mp->status->max_concurrency > 0 &&
-        cur > mp->status->max_concurrency) {
+    if (mp->status->limiter != nullptr &&
+        !mp->status->limiter->OnRequested(cur)) {
         mp->status->concurrency.fetch_sub(1, std::memory_order_relaxed);
         mp->status->nrejected.fetch_add(1, std::memory_order_relaxed);
         SendErrorResponse(sid, cid, TERR_LIMIT_EXCEEDED, "concurrency limit");
         return;
     }
-    server->nprocessing.fetch_add(1, std::memory_order_relaxed);
+    server->BeginRequest();
 
     // Split payload / attachment.
     const uint32_t att_size = meta.attachment_size();
     if ((size_t)att_size > msg->body.size()) {
         mp->status->concurrency.fetch_sub(1, std::memory_order_relaxed);
-        server->nprocessing.fetch_sub(1, std::memory_order_relaxed);
+        server->EndRequest();
         SendErrorResponse(sid, cid, TERR_REQUEST,
                           "attachment_size exceeds body");
         return;
@@ -233,9 +255,22 @@ void ProcessTpuStdRequest(TpuStdMessage* msg, const rpc::RpcMeta& meta) {
         done->Run();
         return;
     }
-    // Run the user method on this fiber (we are already on a per-message
-    // fiber; reference runs inline or via usercode backup pool).
-    mp->service->CallMethod(mp->method, cntl, req, res, done);
+    // User code runs on its OWN fiber, never this one: the last message of
+    // a read burst is processed inline on the connection's input fiber, so
+    // a slow handler here would head-of-line-block the connection — the
+    // backup request riding the same socket would not even be PARSED until
+    // the original finished (reference keeps user code off the input path:
+    // baidu_rpc_protocol.cpp:758,839-849, details/usercode_backup_pool.h).
+    if (server->options().usercode_inline) {
+        mp->service->CallMethod(mp->method, cntl, req, res, done);
+        return;
+    }
+    auto* uc = new UserCallArgs{mp, cntl, req, res, done};
+    fiber_t tid;
+    if (fiber_start_background(&tid, nullptr, RunUserCall, uc) != 0) {
+        delete uc;  // fall back inline (fiber system saturated/shut down)
+        mp->service->CallMethod(mp->method, cntl, req, res, done);
+    }
 }
 
 }  // namespace

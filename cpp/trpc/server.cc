@@ -4,6 +4,8 @@
 #include <unistd.h>
 
 #include "tbase/logging.h"
+#include "tbase/time.h"
+#include "tfiber/butex.h"
 #include "tfiber/fiber.h"
 #include "thttp/builtin_services.h"
 #include "tici/shm_link.h"
@@ -12,6 +14,10 @@
 
 namespace tpurpc {
 
+Server::Server() : messenger_(), acceptor_(&messenger_) {
+    join_butex_ = butex_create();
+}
+
 // Join in the destructor: a request fiber touches this server's method
 // map (stats in the done-closure) until nprocessing hits zero, so
 // destroying without draining is a use-after-free (the reference requires
@@ -19,6 +25,7 @@ namespace tpurpc {
 Server::~Server() {
     Stop();
     Join();
+    butex_destroy(join_butex_);
 }
 
 int Server::AddService(google::protobuf::Service* service) {
@@ -64,9 +71,21 @@ int Server::Start(int port, const ServerOptions* options) {
 int Server::StartNoListen(const ServerOptions* options) {
     if (started_) return -1;
     GlobalInitializeOrDie();
+    // Restart path: Stop() quiesces sockets but not user-code fibers —
+    // drain them before mutating per-method state (resetting a limiter
+    // under an in-flight done-closure would be a use-after-free).
+    Join();
     if (options != nullptr) options_ = *options;
     for (auto& kv : methods_) {
-        kv.second.status->max_concurrency = options_.max_concurrency;
+        if (options_.auto_concurrency) {
+            kv.second.status->limiter.reset(
+                new AutoConcurrencyLimiter(options_.auto_cl_options));
+        } else if (options_.max_concurrency > 0) {
+            kv.second.status->limiter.reset(
+                new ConstantConcurrencyLimiter(options_.max_concurrency));
+        } else {
+            kv.second.status->limiter.reset();  // restart may disable limits
+        }
     }
     messenger_.add_protocol(TpuStdProtocolIndex());
     messenger_.add_protocol(stream_internal::StreamProtocolIndex());
@@ -90,10 +109,31 @@ void Server::Stop() {
     started_ = false;
 }
 
+void Server::EndRequest() {
+    // Teardown-safe wake protocol: bump the butex word BEFORE the
+    // releasing decrement (the Server is pinned until nprocessing drops),
+    // capture the butex into a local, and after the decrement do only
+    // butex_wake_all on that local. A post-release word mutation could
+    // corrupt a recycled slot reused by a new butex; a stray wake is
+    // merely spurious (butex.cc pool contract).
+    void* jb = join_butex_;
+    butex_word(jb)->fetch_add(1, std::memory_order_release);
+    if (nprocessing.fetch_sub(1, std::memory_order_acq_rel) == 1) {
+        // `this` may be freed from here on.
+        butex_wake_all(jb);
+    }
+}
+
 void Server::Join() {
-    // Drain in-flight requests (reference Server::Join semantics).
-    while (nprocessing.load(std::memory_order_acquire) > 0) {
-        usleep(10000);
+    // Drain in-flight requests (reference Server::Join semantics). Butex
+    // parked, not polled; the short timeout is a backstop for the
+    // wake-before-wait race, re-resolved on re-check.
+    while (true) {
+        const int seq =
+            butex_word(join_butex_)->load(std::memory_order_acquire);
+        if (nprocessing.load(std::memory_order_acquire) <= 0) return;
+        const int64_t abst = monotonic_time_us() + 100 * 1000;
+        butex_wait(join_butex_, seq, &abst);
     }
 }
 

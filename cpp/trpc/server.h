@@ -18,30 +18,48 @@
 #include "thttp/http_protocol.h"
 #include "tnet/acceptor.h"
 #include "tnet/input_messenger.h"
+#include "trpc/concurrency_limiter.h"
 #include "tvar/latency_recorder.h"
 
 namespace tpurpc {
 
 // Per-method stats (reference src/brpc/details/method_status.h): latency
-// recorder + live concurrency, exposed as <service>_<method> in /vars.
+// recorder + live concurrency + admission limiter, exposed as
+// <service>_<method> in /vars.
 struct MethodStatus {
     LatencyRecorder latency;
     std::atomic<int64_t> concurrency{0};
     std::atomic<int64_t> nerror{0};
     std::atomic<int64_t> nrejected{0};
-    int max_concurrency = 0;  // 0 = unlimited ("constant" limiter)
+    // Null = unlimited. Constant or gradient "auto" per ServerOptions.
+    std::unique_ptr<ConcurrencyLimiter> limiter;
+    int64_t max_concurrency() const {
+        return limiter == nullptr ? 0 : limiter->MaxConcurrency();
+    }
 };
 
 struct ServerOptions {
-    // 0 = unlimited. The "constant" concurrency limiter; the gradient
-    // "auto" limiter (reference policy/auto_concurrency_limiter.cpp) comes
-    // with the robustness milestone.
+    // Constant per-method concurrency cap; 0 = unlimited. Ignored when
+    // auto_concurrency is set.
     int max_concurrency = 0;
+    // Gradient "auto" limiter (reference
+    // policy/auto_concurrency_limiter.cpp): tracks no-load latency and
+    // peak QPS, caps concurrency at Little's-law capacity + headroom,
+    // sheds the excess under overload.
+    bool auto_concurrency = false;
+    // Tuning for the auto limiter (tests tighten the windows).
+    AutoConcurrencyLimiter::Options auto_cl_options;
+    // Run user service methods inline on the per-message fiber instead of
+    // a fresh one. Default OFF: inline user code head-of-line-blocks the
+    // connection's input fiber, defeating backup requests and pipelining
+    // (reference never lets user code block the input path —
+    // baidu_rpc_protocol.cpp:758,839-849, details/usercode_backup_pool.h).
+    bool usercode_inline = false;
 };
 
 class Server {
 public:
-    Server() : messenger_(), acceptor_(&messenger_) {}
+    Server();
     ~Server();
 
     struct MethodProperty {
@@ -91,6 +109,12 @@ public:
     Acceptor* acceptor() { return &acceptor_; }
 
     std::atomic<int64_t> nprocessing{0};  // in-flight requests
+    // Admission + accounting for one request (called by protocol layers).
+    void BeginRequest() {
+        nprocessing.fetch_add(1, std::memory_order_relaxed);
+    }
+    // Last-touch of Server memory for a request fiber: wakes Join.
+    void EndRequest();
 
 private:
     InputMessenger messenger_;
@@ -101,6 +125,7 @@ private:
     std::map<std::string, MethodProperty> methods_;
     std::map<std::string, HttpHandler> http_exact_;
     std::map<std::string, HttpHandler> http_prefix_;  // key without "/*"
+    void* join_butex_ = nullptr;  // bumped when nprocessing drains to 0
 };
 
 }  // namespace tpurpc

@@ -137,8 +137,8 @@ int RunIciServer() {
     FLAGS_socket_send_buffer_size.set(1 << 20);
     FLAGS_socket_recv_buffer_size.set(1 << 20);
     if (IciBlockPool::Init() != 0) return 1;
-    static Server server;
     static EchoServiceImpl service;
+    static Server server;
     if (server.AddService(&service) != 0) return 1;
     EndPoint listen;
     str2endpoint("127.0.0.1:0", &listen);
@@ -148,7 +148,15 @@ int RunIciServer() {
     char buf[16];
     while (read(0, buf, sizeof(buf)) > 0) {
     }
-    return 0;
+    // Orderly stop, then _exit: running static destructors in a process
+    // whose dispatcher/timer/sampler/worker threads are still live races
+    // frees against those threads (observed as an exit-time UAF under
+    // ASan). Long-lived server processes skip static teardown by design;
+    // Stop+Join is the real shutdown.
+    server.Stop();
+    server.Join();
+    fflush(nullptr);
+    _exit(0);
 }
 
 // Spawn this binary as --ici-server; returns the child's pid and fills
@@ -225,8 +233,8 @@ int main(int argc, char** argv) {
     // loopback; production connections keep kernel autotuning (-1).
     FLAGS_socket_send_buffer_size.set(1 << 20);
     FLAGS_socket_recv_buffer_size.set(1 << 20);
-    Server server;
     EchoServiceImpl service;
+    Server server;
     if (server.AddService(&service) != 0) return 1;
 
     Channel channel;

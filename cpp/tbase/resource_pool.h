@@ -2,11 +2,15 @@
 // get/address/return — the "weak_ptr as integer" idiom underlying SocketId,
 // fiber ids and butex ids.
 //
-// Modeled on reference src/butil/resource_pool.h:97-118 (get_resource /
-// address_resource / return_resource over per-thread free chunks and a
-// two-level block table). Objects are NEVER destructed until process exit;
-// a returned slot is recycled to a later get_resource() call, and stale ids
-// are guarded by version schemes layered above (versioned_ref.h).
+// Modeled on reference src/butil/resource_pool.h:97-118 +
+// resource_pool_inl.h (get_resource / address_resource / return_resource
+// over PER-THREAD free chunks and a two-level block table). The hot paths
+// are thread-local: return_resource pushes onto this thread's free chunk
+// and get_resource pops it; only chunk transfer (one op per ~kChunkSize
+// recycles) and fresh-slot block growth touch a global mutex. Objects are
+// NEVER destructed until process exit; a returned slot is recycled to a
+// later get_resource() call, and stale ids are guarded by version schemes
+// layered above (versioned_ref.h).
 #pragma once
 
 #include <atomic>
@@ -23,6 +27,11 @@ class ResourcePool {
 public:
     static constexpr size_t BLOCK_NITEM = 256;
     static constexpr size_t MAX_BLOCKS = 1 << 16;
+    // TLS free-chunk sizing: a thread keeps at most kCacheCap recycled ids;
+    // above that it ships kChunkSize of them to the global pool in one
+    // locked op (amortized locking, reference free_chunk_nitem).
+    static constexpr size_t kChunkSize = 64;
+    static constexpr size_t kCacheCap = 2 * kChunkSize;
 
     static ResourcePool* singleton() {
         // Intentionally leaked: slots must outlive all static destructors.
@@ -33,16 +42,32 @@ public:
     // Get a free slot; *id receives its address. The object is NOT
     // re-constructed on reuse (same as the reference) — callers re-init.
     T* get_resource(ResourceId* id) {
+        LocalCache& tls = local_cache();
+        if (!tls.free_ids.empty()) {
+            const ResourceId rid = tls.free_ids.back();
+            tls.free_ids.pop_back();
+            *id = rid;
+            return unsafe_address(rid);
+        }
+        // Refill one chunk from the global free list.
         {
             std::lock_guard<std::mutex> g(free_mu_);
             if (!free_list_.empty()) {
-                ResourceId rid = free_list_.back();
-                free_list_.pop_back();
-                *id = rid;
-                return unsafe_address(rid);
+                const size_t take =
+                    free_list_.size() < kChunkSize ? free_list_.size()
+                                                   : kChunkSize;
+                tls.free_ids.assign(free_list_.end() - (long)take,
+                                    free_list_.end());
+                free_list_.resize(free_list_.size() - take);
             }
         }
-        // Allocate a new slot.
+        if (!tls.free_ids.empty()) {
+            const ResourceId rid = tls.free_ids.back();
+            tls.free_ids.pop_back();
+            *id = rid;
+            return unsafe_address(rid);
+        }
+        // Allocate a fresh slot (cold once the pool is warmed).
         std::lock_guard<std::mutex> g(grow_mu_);
         size_t n = nitem_.load(std::memory_order_relaxed);
         const size_t block_idx = n / BLOCK_NITEM;
@@ -68,8 +93,16 @@ public:
     }
 
     void return_resource(ResourceId id) {
-        std::lock_guard<std::mutex> g(free_mu_);
-        free_list_.push_back(id);
+        LocalCache& tls = local_cache();
+        tls.free_ids.push_back(id);
+        if (tls.free_ids.size() >= kCacheCap) {
+            // Ship one chunk to the global list; keep the rest local.
+            std::lock_guard<std::mutex> g(free_mu_);
+            free_list_.insert(free_list_.end(),
+                              tls.free_ids.end() - (long)kChunkSize,
+                              tls.free_ids.end());
+            tls.free_ids.resize(tls.free_ids.size() - kChunkSize);
+        }
     }
 
     size_t size() const { return nitem_.load(std::memory_order_relaxed); }
@@ -78,6 +111,30 @@ private:
     struct Block {
         T items[BLOCK_NITEM];
     };
+
+    // Per-thread free chunk. On thread exit the remainder is flushed to
+    // the (leaked) global pool so ids owned by a dying thread are not
+    // stranded.
+    struct LocalCache {
+        std::vector<ResourceId> free_ids;
+        ResourcePool* owner = nullptr;
+        ~LocalCache() {
+            if (owner != nullptr && !free_ids.empty()) {
+                std::lock_guard<std::mutex> g(owner->free_mu_);
+                owner->free_list_.insert(owner->free_list_.end(),
+                                         free_ids.begin(), free_ids.end());
+            }
+        }
+    };
+
+    LocalCache& local_cache() {
+        thread_local LocalCache tls;
+        if (tls.owner == nullptr) {
+            tls.owner = this;
+            tls.free_ids.reserve(kCacheCap);
+        }
+        return tls;
+    }
 
     ResourcePool() : blocks_(MAX_BLOCKS, nullptr) {}
 

@@ -3,6 +3,7 @@
 // test/flat_map_unittest.cpp, test/endpoint_unittest.cpp et al).
 #include <unistd.h>
 
+#include <atomic>
 #include <thread>
 #include <vector>
 
@@ -356,4 +357,59 @@ TEST(Misc, FastRandAndTime) {
     EXPECT_GT(t0, 0);
     EXPECT_GT(w0, 0);
     EXPECT_GT(ticks_per_us(), 0.0);
+}
+
+// ---------------- ResourcePool TLS free chunks ----------------
+// Reference resource_pool_inl.h: per-thread free chunks; a live id is
+// never handed to two owners concurrently.
+
+namespace {
+struct PoolItem {
+    std::atomic<int> owner{0};
+};
+}  // namespace
+
+TEST(ResourcePool, TlsChunksNoDoubleOwnership) {
+    std::atomic<bool> stop{false};
+    std::atomic<int> violations{0};
+    auto worker = [&](int me) {
+        std::vector<ResourceId> held;
+        uint64_t rng = (uint64_t)me * 2654435761u + 1;
+        while (!stop.load(std::memory_order_relaxed)) {
+            rng = rng * 6364136223846793005ull + 1442695040888963407ull;
+            if ((rng >> 33) % 2 == 0 || held.empty()) {
+                ResourceId id;
+                PoolItem* it = get_resource<PoolItem>(&id);
+                if (it == nullptr) continue;
+                int expected = 0;
+                if (!it->owner.compare_exchange_strong(expected, me)) {
+                    violations.fetch_add(1);  // someone else owns this slot!
+                }
+                held.push_back(id);
+            } else {
+                const ResourceId id = held.back();
+                held.pop_back();
+                PoolItem* it = address_resource<PoolItem>(id);
+                it->owner.store(0);
+                return_resource<PoolItem>(id);
+            }
+            if (held.size() > 300) {
+                for (ResourceId id : held) {
+                    address_resource<PoolItem>(id)->owner.store(0);
+                    return_resource<PoolItem>(id);
+                }
+                held.clear();
+            }
+        }
+        for (ResourceId id : held) {
+            address_resource<PoolItem>(id)->owner.store(0);
+            return_resource<PoolItem>(id);
+        }
+    };
+    std::vector<std::thread> threads;
+    for (int i = 1; i <= 4; ++i) threads.emplace_back(worker, i);
+    usleep(300 * 1000);
+    stop.store(true);
+    for (auto& t : threads) t.join();
+    EXPECT_EQ(violations.load(), 0);
 }

@@ -129,6 +129,57 @@ double run_round(benchpb::EchoService_Stub& stub, size_t attachment_bytes,
     return (double)t.n_elapsed() / 1e9;
 }
 
+// qps-vs-caller-fibers scaling sweep (reference docs/cn/benchmark.md:110
+// qps_vs_threadnum): N fibers issue SYNC 4KB echoes back-to-back for a
+// fixed wall-time slice; near-linear growth to 16 callers is the bar.
+struct ScaleCtx {
+    benchpb::EchoService_Stub* stub;
+    LatencyRecorder* lat;
+    std::atomic<bool>* stop;
+    std::atomic<int64_t>* calls;
+    IOBuf* filler;
+};
+
+void* ScaleCaller(void* arg) {
+    auto* c = (ScaleCtx*)arg;
+    while (!c->stop->load(std::memory_order_relaxed)) {
+        Controller cntl;
+        cntl.set_timeout_ms(10000);
+        benchpb::EchoRequest req;
+        benchpb::EchoResponse res;
+        req.set_send_ts_us(monotonic_time_us());
+        cntl.request_attachment().append(*c->filler);
+        c->stub->Echo(&cntl, &req, &res, nullptr);
+        if (!cntl.Failed()) {
+            *c->lat << (monotonic_time_us() - res.send_ts_us());
+            c->calls->fetch_add(1, std::memory_order_relaxed);
+        }
+    }
+    return nullptr;
+}
+
+// Runs one sweep level; returns qps and fills *p99_us.
+double RunScaleLevel(benchpb::EchoService_Stub& stub, int ncallers,
+                     int duration_ms, long long* p99_us) {
+    IOBuf filler;
+    filler.append(std::string(4096, 'e'));
+    LatencyRecorder lat;
+    std::atomic<bool> stop{false};
+    std::atomic<int64_t> calls{0};
+    ScaleCtx ctx{&stub, &lat, &stop, &calls, &filler};
+    std::vector<fiber_t> tids((size_t)ncallers);
+    const int64_t t0 = monotonic_time_us();
+    for (auto& tid : tids) {
+        fiber_start_background(&tid, nullptr, ScaleCaller, &ctx);
+    }
+    usleep(duration_ms * 1000);
+    stop.store(true, std::memory_order_relaxed);
+    for (auto tid : tids) fiber_join(tid, nullptr);
+    const double secs = (double)(monotonic_time_us() - t0) / 1e6;
+    *p99_us = (long long)lat.latency_percentile(0.99);
+    return (double)calls.load() / secs;
+}
+
 // Child mode for the cross-process benchmark/tests: a standalone echo
 // server with the ICI handshake enabled, port announced on stdout.
 // Exits when stdin reaches EOF (parent closed its pipe or died).
@@ -206,12 +257,14 @@ int main(int argc, char** argv) {
     bool use_ici = false;
     bool xproc = false;
     bool tail = false;
+    bool scale = false;
     const char* prof_path = nullptr;
     for (int i = 1; i < argc; ++i) {
         if (strcmp(argv[i], "--json") == 0) json = true;
         if (strcmp(argv[i], "--ici") == 0) use_ici = true;
         if (strcmp(argv[i], "--xproc") == 0) xproc = true;
         if (strcmp(argv[i], "--tail") == 0) tail = true;
+        if (strcmp(argv[i], "--scale") == 0) scale = true;
         if (strcmp(argv[i], "--ici-server") == 0) return RunIciServer();
         if (strcmp(argv[i], "--prof") == 0 && i + 1 < argc) {
             prof_path = argv[++i];
@@ -322,6 +375,32 @@ int main(int argc, char** argv) {
                    (long long)lat_b.latency_percentile(0.5),
                    (long long)lat_b.latency_percentile(0.99),
                    (long long)lat_b.latency_percentile(0.999));
+        }
+        return 0;
+    }
+
+    if (scale) {
+        // qps vs caller fibers (reference benchmark.md:110-124).
+        run_round(stub, 4096, 500, 16, nullptr, nullptr);  // warmup
+        const int levels[] = {1, 4, 16, 64};
+        double qps[4];
+        long long p99[4];
+        for (int i = 0; i < 4; ++i) {
+            qps[i] = RunScaleLevel(stub, levels[i], 1500, &p99[i]);
+        }
+        if (json) {
+            printf("{\"scale_qps_1\": %.0f, \"scale_qps_4\": %.0f, "
+                   "\"scale_qps_16\": %.0f, \"scale_qps_64\": %.0f, "
+                   "\"scale_p99_us_1\": %lld, \"scale_p99_us_4\": %lld, "
+                   "\"scale_p99_us_16\": %lld, \"scale_p99_us_64\": "
+                   "%lld}\n",
+                   qps[0], qps[1], qps[2], qps[3], p99[0], p99[1], p99[2],
+                   p99[3]);
+        } else {
+            for (int i = 0; i < 4; ++i) {
+                printf("callers %2d: %8.0f qps  p99 %lldus\n", levels[i],
+                       qps[i], p99[i]);
+            }
         }
         return 0;
     }

@@ -1,0 +1,59 @@
+#include "tbase/crc32c.h"
+
+namespace tpurpc {
+
+namespace {
+
+// 8 tables of 256 entries, built once (slice-by-8).
+struct Tables {
+    uint32_t t[8][256];
+    Tables() {
+        for (uint32_t i = 0; i < 256; ++i) {
+            uint32_t c = i;
+            for (int k = 0; k < 8; ++k) {
+                c = (c & 1) ? (0x82F63B78u ^ (c >> 1)) : (c >> 1);
+            }
+            t[0][i] = c;
+        }
+        for (int j = 1; j < 8; ++j) {
+            for (uint32_t i = 0; i < 256; ++i) {
+                t[j][i] = (t[j - 1][i] >> 8) ^ t[0][t[j - 1][i] & 0xff];
+            }
+        }
+    }
+};
+
+const Tables& tables() {
+    static const Tables tb;
+    return tb;
+}
+
+}  // namespace
+
+uint32_t crc32c_extend(uint32_t crc, const void* data, size_t n) {
+    const Tables& tb = tables();
+    const uint8_t* p = (const uint8_t*)data;
+    crc = ~crc;
+    while (n > 0 && ((uintptr_t)p & 7) != 0) {
+        crc = tb.t[0][(crc ^ *p++) & 0xff] ^ (crc >> 8);
+        --n;
+    }
+    while (n >= 8) {
+        uint64_t w;
+        __builtin_memcpy(&w, p, 8);
+        w ^= crc;
+        crc = tb.t[7][w & 0xff] ^ tb.t[6][(w >> 8) & 0xff] ^
+              tb.t[5][(w >> 16) & 0xff] ^ tb.t[4][(w >> 24) & 0xff] ^
+              tb.t[3][(w >> 32) & 0xff] ^ tb.t[2][(w >> 40) & 0xff] ^
+              tb.t[1][(w >> 48) & 0xff] ^ tb.t[0][(w >> 56) & 0xff];
+        p += 8;
+        n -= 8;
+    }
+    while (n > 0) {
+        crc = tb.t[0][(crc ^ *p++) & 0xff] ^ (crc >> 8);
+        --n;
+    }
+    return ~crc;
+}
+
+}  // namespace tpurpc

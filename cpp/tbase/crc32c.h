@@ -1,0 +1,23 @@
+// CRC32-C (Castagnoli, polynomial 0x1EDC6F41 reflected 0x82F63B78):
+// the frame checksum of the RPC layer.
+//
+// Reference: src/butil/crc32c.{h,cc} (hardware SSE4.2 path + table
+// fallback). Software slice-by-8 here; bulk data rides shared memory on
+// the target platform, so the checksum covers control frames where table
+// speed (~1-2 GB/s) is ample. An SSE4.2/PMULL fast path slots in behind
+// the same signature.
+#pragma once
+
+#include <cstddef>
+#include <cstdint>
+
+namespace tpurpc {
+
+// Extend a running crc with [data, data+n). Start with crc = 0.
+uint32_t crc32c_extend(uint32_t crc, const void* data, size_t n);
+
+inline uint32_t crc32c(const void* data, size_t n) {
+    return crc32c_extend(0, data, n);
+}
+
+}  // namespace tpurpc

@@ -12,6 +12,9 @@
 #include "bench_echo.pb.h"
 #include "tbase/endpoint.h"
 #include "tbase/fast_rand.h"
+#include "tbase/flags.h"
+#include "trpc/channel.h"
+#include "trpc/controller.h"
 #include "thttp/http_message.h"
 #include "trpc/server.h"
 #include "ttest/ttest.h"
@@ -355,4 +358,102 @@ TEST(HttpPortal, LivePortalOverTcp) {
     }
     server.Stop();
     server.Join();
+}
+
+// ---------------- rpcz ----------------
+// Reference: span.h:47-120 + builtin/rpcz_service.cpp — sampled RPCs leave
+// a span with a queue/process/write timeline, browsable at /rpcz; trace
+// ids propagate client -> server through the request meta.
+
+namespace {
+
+class RpczEchoService : public benchpb::EchoService {
+public:
+    void Echo(google::protobuf::RpcController* cntl_base,
+              const benchpb::EchoRequest* request,
+              benchpb::EchoResponse* response,
+              google::protobuf::Closure* done) override {
+        auto* cntl = static_cast<Controller*>(cntl_base);
+        response->set_send_ts_us(request->send_ts_us());
+        cntl->response_attachment().append(cntl->request_attachment());
+        done->Run();
+    }
+};
+
+std::string http_get(int port, const std::string& path) {
+    const int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+    sockaddr_in addr;
+    EndPoint ep;
+    str2endpoint("127.0.0.1", port, &ep);
+    endpoint2sockaddr(ep, &addr);
+    if (::connect(fd, (sockaddr*)&addr, sizeof(addr)) != 0) {
+        close(fd);
+        return "";
+    }
+    const std::string req =
+        "GET " + path + " HTTP/1.1\r\nHost: x\r\nConnection: close\r\n\r\n";
+    (void)!write(fd, req.data(), req.size());
+    std::string out;
+    char buf[8192];
+    ssize_t r;
+    while ((r = read(fd, buf, sizeof(buf))) > 0) out.append(buf, (size_t)r);
+    close(fd);
+    return out;
+}
+
+}  // namespace
+
+TEST(Rpcz, SampledSpansShowTimeline) {
+    DECLARE_bool(enable_rpcz);
+    FLAGS_enable_rpcz.set(true);
+    RpczEchoService service;
+    Server server;
+    ASSERT_EQ(0, server.AddService(&service));
+    EndPoint listen;
+    str2endpoint("127.0.0.1:0", &listen);
+    ASSERT_EQ(0, server.Start(listen, nullptr));
+    const int port = server.listened_port();
+
+    Channel ch;
+    EndPoint ep;
+    str2endpoint("127.0.0.1", port, &ep);
+    ASSERT_EQ(0, ch.Init(ep, nullptr));
+    benchpb::EchoService_Stub stub(&ch);
+    for (int i = 0; i < 5; ++i) {
+        Controller cntl;
+        cntl.set_timeout_ms(3000);
+        benchpb::EchoRequest req;
+        req.set_send_ts_us(1);
+        benchpb::EchoResponse res;
+        stub.Echo(&cntl, &req, &res, nullptr);
+        ASSERT_FALSE(cntl.Failed());
+    }
+    // The Collector dispatches on a ~50ms cadence; poll /rpcz until the
+    // spans land.
+    std::string page;
+    for (int i = 0; i < 60; ++i) {
+        page = http_get(port, "/rpcz");
+        if (page.find("SERVER") != std::string::npos &&
+            page.find("CLIENT") != std::string::npos) {
+            break;
+        }
+        usleep(50 * 1000);
+    }
+    FLAGS_enable_rpcz.set(false);
+    // Server span with the queue/process/write phase line.
+    EXPECT_TRUE(page.find("SERVER benchpb.EchoService.Echo") !=
+                std::string::npos);
+    EXPECT_TRUE(page.find("received +0us") != std::string::npos);
+    EXPECT_TRUE(page.find("process ") != std::string::npos);
+    EXPECT_TRUE(page.find("write ") != std::string::npos);
+    // Client span with the issue/send/response phases.
+    EXPECT_TRUE(page.find("CLIENT benchpb.EchoService.Echo") !=
+                std::string::npos);
+    EXPECT_TRUE(page.find("issued +0us") != std::string::npos);
+    // Trace propagation: the server span's trace id equals some client
+    // span's trace id (same trace string appears at least twice).
+    const size_t t0 = page.find("trace=");
+    ASSERT_TRUE(t0 != std::string::npos);
+    const std::string trace_tok = page.substr(t0, page.find(' ', t0) - t0);
+    EXPECT_TRUE(page.find(trace_tok, t0 + 1) != std::string::npos);
 }

@@ -10,6 +10,7 @@
 #include "thttp/http_protocol.h"
 #include "tnet/socket.h"
 #include "trpc/server.h"
+#include "trpc/span.h"
 #include "tvar/variable.h"
 
 namespace tpurpc {
@@ -26,12 +27,20 @@ void HandleIndex(Server*, const HttpRequest&, HttpResponse* res) {
         "/vars         exposed variables (/vars/<name> for one)\n"
         "/flags        runtime flags (/flags/<name>?setvalue=v to set)\n"
         "/connections  accepted connections\n"
+        "/rpcz         sampled per-RPC spans (enable_rpcz flag)\n"
         "/metrics      prometheus exposition\n");
 }
 
 void HandleHealth(Server*, const HttpRequest&, HttpResponse* res) {
     res->set_content_type("text/plain");
     res->Append("OK\n");
+}
+
+void HandleRpcz(Server*, const HttpRequest& req, HttpResponse* res) {
+    res->set_content_type("text/plain");
+    const std::string t = req.QueryParam("trace_id");
+    const uint64_t trace = t.empty() ? 0 : strtoull(t.c_str(), nullptr, 10);
+    res->Append(RenderRpcz(trace));
 }
 
 void HandleStatus(Server* server, const HttpRequest&, HttpResponse* res) {
@@ -194,6 +203,7 @@ void AddBuiltinHttpServices(Server* server) {
     server->RegisterHttpHandler("/flags", HandleFlags);
     server->RegisterHttpHandler("/flags/*", HandleFlags);
     server->RegisterHttpHandler("/connections", HandleConnections);
+    server->RegisterHttpHandler("/rpcz", HandleRpcz);
     server->RegisterHttpHandler("/metrics", HandleMetrics);
 }
 

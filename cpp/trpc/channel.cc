@@ -3,6 +3,7 @@
 #include <cstring>
 
 #include "tbase/errno.h"
+#include "tbase/fast_rand.h"
 #include "tbase/logging.h"
 #include "tbase/time.h"
 #include "tfiber/call_id.h"
@@ -10,7 +11,9 @@
 #include "trpc/lb_with_naming.h"
 #include "trpc/controller.h"
 #include "trpc/pb_compat.h"
+#include "trpc/compress.h"
 #include "trpc/policy_tpu_std.h"
+#include "trpc/span.h"
 #include "trpc/stream.h"
 
 namespace tpurpc {
@@ -123,10 +126,32 @@ void Channel::CallMethod(const google::protobuf::MethodDescriptor* method,
     void* unused;
     CHECK_EQ(id_lock(cid, &unused), 0);
 
+    if (IsRpczSampled()) {
+        auto* span = new Span;
+        span->kind = Span::CLIENT;
+        span->trace_id = fast_rand();
+        span->span_id = fast_rand();
+        span->method = method->full_name();
+        span->start_us = cntl->start_us_;
+        cntl->span_ = span;
+    }
+
     if (!SerializePbToIOBuf(*request, &cntl->request_buf_)) {
         cntl->SetFailed(TERR_REQUEST, "serialize request failed");
         cntl->EndRPC(cid);
         return;
+    }
+    // Compress ONCE here, not per-try: retries and backups re-send the
+    // same compressed bytes (reference compresses in CallMethod too).
+    if (cntl->request_compress_type() != COMPRESS_NONE) {
+        IOBuf compressed;
+        if (!CompressBody(cntl->request_compress_type(),
+                          cntl->request_buf_, &compressed)) {
+            cntl->SetFailed(TERR_REQUEST, "compress request failed");
+            cntl->EndRPC(cid);
+            return;
+        }
+        cntl->request_buf_.swap(compressed);
     }
 
     const int64_t timeout_ms =

@@ -1,0 +1,124 @@
+#include "trpc/compress.h"
+
+#include <zlib.h>
+
+#include <cstring>
+#include <string>
+
+#include "tbase/crc32c.h"
+#include "tbase/logging.h"
+
+namespace tpurpc {
+
+namespace {
+
+constexpr size_t kMaxDecompressed = 256u << 20;  // matches frame limit
+
+// Both paths stream IOBuf blocks straight into zlib — no flattening copy
+// of the (up to 256MB) payload on the RPC hot path.
+bool GzipCompress(const IOBuf& in, IOBuf* out) {
+    z_stream zs;
+    memset(&zs, 0, sizeof(zs));
+    // windowBits 15+16 = gzip wrapper (interoperable with `gzip`).
+    if (deflateInit2(&zs, Z_DEFAULT_COMPRESSION, Z_DEFLATED, 15 + 16, 8,
+                     Z_DEFAULT_STRATEGY) != Z_OK) {
+        return false;
+    }
+    char buf[16 * 1024];
+    const size_t nblocks = in.backing_block_num();
+    for (size_t i = 0; i <= nblocks; ++i) {
+        size_t len = 0;
+        const char* data = i < nblocks ? in.backing_block_data(i, &len)
+                                       : nullptr;
+        zs.next_in = (Bytef*)data;
+        zs.avail_in = (uInt)len;
+        const int flush = i == nblocks ? Z_FINISH : Z_NO_FLUSH;
+        int rc;
+        do {
+            zs.next_out = (Bytef*)buf;
+            zs.avail_out = sizeof(buf);
+            rc = deflate(&zs, flush);
+            if (rc == Z_STREAM_ERROR) {
+                deflateEnd(&zs);
+                return false;
+            }
+            out->append(buf, sizeof(buf) - zs.avail_out);
+        } while (zs.avail_in > 0 ||
+                 (flush == Z_FINISH && rc != Z_STREAM_END));
+    }
+    deflateEnd(&zs);
+    return true;
+}
+
+bool GzipDecompress(const IOBuf& in, IOBuf* out) {
+    z_stream zs;
+    memset(&zs, 0, sizeof(zs));
+    if (inflateInit2(&zs, 15 + 16) != Z_OK) return false;
+    char buf[16 * 1024];
+    size_t total = 0;
+    int rc = Z_OK;
+    const size_t nblocks = in.backing_block_num();
+    for (size_t i = 0; i < nblocks && rc != Z_STREAM_END; ++i) {
+        size_t len = 0;
+        const char* data = in.backing_block_data(i, &len);
+        zs.next_in = (Bytef*)data;
+        zs.avail_in = (uInt)len;
+        do {
+            zs.next_out = (Bytef*)buf;
+            zs.avail_out = sizeof(buf);
+            rc = inflate(&zs, Z_NO_FLUSH);
+            if (rc != Z_OK && rc != Z_STREAM_END) {
+                inflateEnd(&zs);
+                return false;  // corrupt stream
+            }
+            const size_t produced = sizeof(buf) - zs.avail_out;
+            total += produced;
+            if (total > kMaxDecompressed) {  // zip bomb guard
+                inflateEnd(&zs);
+                return false;
+            }
+            out->append(buf, produced);
+        } while (zs.avail_in > 0 && rc != Z_STREAM_END);
+    }
+    inflateEnd(&zs);
+    return rc == Z_STREAM_END;
+}
+
+}  // namespace
+
+uint32_t crc32c_iobuf(uint32_t crc, const IOBuf& buf) {
+    for (size_t i = 0; i < buf.backing_block_num(); ++i) {
+        size_t len = 0;
+        const char* data = buf.backing_block_data(i, &len);
+        crc = crc32c_extend(crc, data, len);
+    }
+    return crc;
+}
+
+bool CompressBody(int compress_type, const IOBuf& in, IOBuf* out) {
+    switch (compress_type) {
+        case COMPRESS_NONE:
+            out->append(in);
+            return true;
+        case COMPRESS_GZIP:
+            return GzipCompress(in, out);
+        default:
+            LOG(ERROR) << "unknown compress_type " << compress_type;
+            return false;
+    }
+}
+
+bool DecompressBody(int compress_type, const IOBuf& in, IOBuf* out) {
+    switch (compress_type) {
+        case COMPRESS_NONE:
+            out->append(in);
+            return true;
+        case COMPRESS_GZIP:
+            return GzipDecompress(in, out);
+        default:
+            LOG(ERROR) << "unknown compress_type " << compress_type;
+            return false;
+    }
+}
+
+}  // namespace tpurpc

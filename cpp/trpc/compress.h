@@ -1,0 +1,28 @@
+// Payload compression for the native protocol.
+//
+// Reference: src/brpc/policy/gzip_compress.{h,cpp} + src/brpc/compress.h
+// (a registry of compress handlers keyed by the wire's compress_type).
+// The wire declares compress_type in RpcMeta (rpc_meta.proto:4); only the
+// pb payload is compressed — attachments stay raw (zero-copy; same rule
+// as the reference's baidu_std).
+#pragma once
+
+#include "tbase/iobuf.h"
+
+namespace tpurpc {
+
+enum CompressType {
+    COMPRESS_NONE = 0,
+    COMPRESS_GZIP = 1,
+};
+
+// Compress/decompress `in` into `*out` (appended). Return false on error
+// (corrupt input, unknown type). Decompressed size is capped to guard
+// against zip bombs.
+bool CompressBody(int compress_type, const IOBuf& in, IOBuf* out);
+bool DecompressBody(int compress_type, const IOBuf& in, IOBuf* out);
+
+// crc32c over every byte of an IOBuf without flattening (frame checksum).
+uint32_t crc32c_iobuf(uint32_t crc, const IOBuf& buf);
+
+}  // namespace tpurpc

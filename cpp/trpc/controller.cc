@@ -6,6 +6,7 @@
 
 #include "rpc_meta.pb.h"
 #include "tbase/errno.h"
+#include "tbase/flags.h"
 #include "tbase/logging.h"
 #include "tbase/time.h"
 #include "tfiber/fiber.h"
@@ -14,11 +15,20 @@
 #include "trpc/lb_with_naming.h"
 #include "trpc/pb_compat.h"
 #include "trpc/policy_tpu_std.h"
+#include "tbase/crc32c.h"
+#include "trpc/compress.h"
+#include "trpc/span.h"
 #include "trpc/stream.h"
+
+DEFINE_bool(rpc_checksum, false,
+            "crc32c-protect tpu_std frame bodies (verified when present)");
 
 namespace tpurpc {
 
-Controller::~Controller() { delete excluded_; }
+Controller::~Controller() {
+    delete excluded_;
+    delete span_;  // non-null only if the RPC never reached EndRPC/submit
+}
 
 void Controller::Reset() {
     error_code_ = 0;
@@ -51,6 +61,8 @@ void Controller::Reset() {
     try_start_us_ = 0;
     request_code_ = 0;
     has_request_code_ = false;
+    request_compress_type_ = 0;
+    response_compress_type_ = 0;
     delete excluded_;
     excluded_ = nullptr;
     request_stream_ = INVALID_VREF_ID;
@@ -63,6 +75,7 @@ void Controller::Reset() {
     accepted_stream_window_ = 0;
     server_socket_ = INVALID_VREF_ID;
     server_ = nullptr;
+    span_ = nullptr;
 }
 
 void Controller::SetFailed(const std::string& reason) {
@@ -231,8 +244,29 @@ void Controller::IssueRPC() {
         req_meta->set_timeout_ms((deadline_us_ - monotonic_time_us()) / 1000);
     }
     if (log_id_ != 0) req_meta->set_log_id(log_id_);
+    if (span_ != nullptr) {
+        req_meta->set_trace_id(span_->trace_id);
+        req_meta->set_span_id(span_->span_id);
+        if (span_->parent_span_id != 0) {
+            req_meta->set_parent_span_id(span_->parent_span_id);
+        }
+        span_->remote_side = remote_side_;
+        span_->retries = current_try_;
+        if (current_try_ > 0) {
+            span_->Annotate("re-issued try " + std::to_string(current_try_) +
+                            " to " + endpoint2str(remote_side_));
+        }
+    }
     meta.set_correlation_id(current_cid_);
+    if (request_compress_type_ != COMPRESS_NONE) {
+        meta.set_compress_type(request_compress_type_);
+    }
     meta.set_attachment_size((uint32_t)request_attachment_.size());
+    if (FLAGS_rpc_checksum.get()) {
+        uint32_t crc = crc32c_iobuf(0, request_buf_);
+        crc = crc32c_iobuf(crc, request_attachment_);
+        meta.set_body_checksum(crc);
+    }
     if (request_stream_ != INVALID_VREF_ID) {
         auto* ss = meta.mutable_stream_settings();
         ss->set_stream_id(request_stream_);
@@ -242,6 +276,10 @@ void Controller::IssueRPC() {
     SerializePbToIOBuf(meta, &meta_buf);
     IOBuf frame;
     PackTpuStdFrame(&frame, meta_buf, request_buf_, request_attachment_);
+    if (span_ != nullptr) {
+        span_->request_bytes = (int64_t)frame.size();
+        span_->sent_us = monotonic_time_us();
+    }
     if (s->Write(&frame, current_cid_) != 0) {
         // Queue full or failed socket: deliver the error (may retry).
         id_error(current_cid_, errno != 0 ? errno : TERR_FAILED_SOCKET);
@@ -294,6 +332,12 @@ void Controller::MaybeIssueBackup() {
 
 void Controller::EndRPC(CallId locked_id) {
     latency_us_ = monotonic_time_us() - start_us_;
+    if (span_ != nullptr) {
+        span_->end_us = monotonic_time_us();
+        span_->error_code = error_code_;
+        Collector::singleton()->submit(span_);
+        span_ = nullptr;
+    }
     FeedbackToLB(error_code_);
     // A client stream that never got bound to a connection must be failed
     // here — EndRPC is the single funnel every termination path (success
@@ -345,9 +389,19 @@ void ProcessTpuStdResponse(TpuStdMessage* msg, const rpc::RpcMeta& meta) {
         id_unlock(cid);  // an abandoned try's late response
         return;
     }
+    if (cntl->span_ != nullptr) {
+        cntl->span_->received_us = monotonic_time_us();
+        cntl->span_->response_bytes = (int64_t)msg->body.size();
+    }
     const auto& rmeta = meta.response();
     if (rmeta.error_code() != 0) {
         cntl->SetFailed(rmeta.error_code(), "%s", rmeta.error_text().c_str());
+        cntl->EndRPC(cid);
+        return;
+    }
+    if (meta.has_body_checksum() &&
+        crc32c_iobuf(0, msg->body) != meta.body_checksum()) {
+        cntl->SetFailed(TERR_RESPONSE, "response body checksum mismatch");
         cntl->EndRPC(cid);
         return;
     }
@@ -363,6 +417,15 @@ void ProcessTpuStdResponse(TpuStdMessage* msg, const rpc::RpcMeta& meta) {
     msg->body.cutn(&payload, msg->body.size() - att_size);
     cntl->response_attachment().clear();
     cntl->response_attachment().swap(msg->body);
+    if (meta.compress_type() != COMPRESS_NONE) {
+        IOBuf raw;
+        if (!DecompressBody(meta.compress_type(), payload, &raw)) {
+            cntl->SetFailed(TERR_RESPONSE, "decompress response failed");
+            cntl->EndRPC(cid);
+            return;
+        }
+        payload.swap(raw);
+    }
     if (cntl->response_ != nullptr &&
         !ParsePbFromIOBuf(cntl->response_, payload)) {
         cntl->SetFailed(TERR_RESPONSE, "parse response failed");

@@ -51,6 +51,13 @@ public:
     // Attachment bytes carried outside the pb payload (zero-copy).
     IOBuf& request_attachment() { return request_attachment_; }
     IOBuf& response_attachment() { return response_attachment_; }
+    // Payload compression (reference set_request_compress_type /
+    // set_response_compress_type; see trpc/compress.h). Attachments stay
+    // raw. Client sets request_*; server handlers set response_*.
+    void set_request_compress_type(int t) { request_compress_type_ = t; }
+    int request_compress_type() const { return request_compress_type_; }
+    void set_response_compress_type(int t) { response_compress_type_ = t; }
+    int response_compress_type() const { return response_compress_type_; }
 
     // ---- results ----
     bool Failed() const override { return error_code_ != 0; }
@@ -181,6 +188,8 @@ private:
     int64_t try_start_us_;        // start of the current try (LB feedback)
     uint64_t request_code_;
     bool has_request_code_;
+    int request_compress_type_;
+    int response_compress_type_;
     class ExcludedServers* excluded_;  // servers tried by earlier attempts
 
     // --- streaming state ---
@@ -196,6 +205,13 @@ private:
 
     // --- server call state ---
     Server* server_;
+
+public:
+    // rpcz span of this RPC; null when unsampled. Client side: owned by
+    // the controller from CallMethod until EndRPC submits it (all touches
+    // run under the id lock). Server side: owned by the request pipeline
+    // (request fiber -> user fiber -> done closure, strictly sequential).
+    struct Span* span_ = nullptr;
 };
 
 }  // namespace tpurpc

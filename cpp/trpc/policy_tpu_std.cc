@@ -7,15 +7,22 @@
 
 #include "rpc_meta.pb.h"
 #include "tbase/errno.h"
+#include "tbase/fast_rand.h"
+#include "tbase/flags.h"
 #include "tbase/logging.h"
 #include "tbase/time.h"
 #include "thttp/http_protocol.h"
 #include "tici/shm_link.h"
 #include "tnet/input_messenger.h"
 #include "trpc/controller.h"
+#include "tbase/crc32c.h"
+#include "trpc/compress.h"
 #include "trpc/pb_compat.h"
 #include "trpc/server.h"
+#include "trpc/span.h"
 #include "trpc/stream.h"
+
+DECLARE_bool(rpc_checksum);
 
 namespace tpurpc {
 
@@ -96,6 +103,9 @@ public:
           start_us_(start_us) {}
 
     void Run() override {
+        if (cntl_->span_ != nullptr) {
+            cntl_->span_->process_end_us = monotonic_time_us();
+        }
         rpc::RpcMeta meta;
         auto* rmeta = meta.mutable_response();
         rmeta->set_error_code(cntl_->ErrorCode());
@@ -114,10 +124,22 @@ public:
                 rmeta->set_error_code(TERR_RESPONSE);
                 rmeta->set_error_text("serialize response failed");
                 payload.clear();
+            } else if (cntl_->response_compress_type() != COMPRESS_NONE) {
+                IOBuf compressed;
+                if (CompressBody(cntl_->response_compress_type(), payload,
+                                 &compressed)) {
+                    payload.swap(compressed);
+                    meta.set_compress_type(cntl_->response_compress_type());
+                }  // else: send uncompressed (compress_type stays unset)
             }
         }
         const IOBuf& att = cntl_->response_attachment();
         meta.set_attachment_size((uint32_t)att.size());
+        if (FLAGS_rpc_checksum.get()) {
+            uint32_t crc = crc32c_iobuf(0, payload);
+            crc = crc32c_iobuf(crc, att);
+            meta.set_body_checksum(crc);
+        }
         IOBuf meta_buf;
         SerializePbToIOBuf(meta, &meta_buf);
         IOBuf frame;
@@ -125,6 +147,12 @@ public:
         SocketUniquePtr s;
         if (Socket::AddressSocket(sid_, &s) == 0) {
             s->Write(&frame);
+        }
+        if (cntl_->span_ != nullptr) {
+            cntl_->span_->response_bytes = (int64_t)payload.size();
+            cntl_->span_->end_us = monotonic_time_us();
+            Collector::singleton()->submit(cntl_->span_);
+            cntl_->span_ = nullptr;
         }
         // Stats. EndRequest is the LAST touch of Server memory: it wakes
         // Server::Join, after which the Server may be destroyed.
@@ -168,6 +196,9 @@ struct UserCallArgs {
 
 void* RunUserCall(void* arg) {
     auto* a = (UserCallArgs*)arg;
+    if (a->cntl->span_ != nullptr) {
+        a->cntl->span_->process_start_us = monotonic_time_us();
+    }
     a->mp->service->CallMethod(a->mp->method, a->cntl, a->req, a->res,
                                a->done);
     delete a;
@@ -231,23 +262,64 @@ void ProcessTpuStdRequest(TpuStdMessage* msg, const rpc::RpcMeta& meta) {
                           "attachment_size exceeds body");
         return;
     }
+    if (meta.has_body_checksum() &&
+        crc32c_iobuf(0, msg->body) != meta.body_checksum()) {
+        mp->status->concurrency.fetch_sub(1, std::memory_order_relaxed);
+        server->EndRequest();
+        SendErrorResponse(sid, cid, TERR_REQUEST, "body checksum mismatch");
+        return;
+    }
     IOBuf payload;
     IOBuf attachment;
     const size_t payload_size = msg->body.size() - att_size;
     msg->body.cutn(&payload, payload_size);
     attachment.swap(msg->body);
+    if (meta.compress_type() != COMPRESS_NONE) {
+        IOBuf raw;
+        if (!DecompressBody(meta.compress_type(), payload, &raw)) {
+            mp->status->concurrency.fetch_sub(1, std::memory_order_relaxed);
+            server->EndRequest();
+            SendErrorResponse(sid, cid, TERR_REQUEST,
+                              "decompress request failed");
+            return;
+        }
+        payload.swap(raw);
+    }
 
+    const int64_t start_us = monotonic_time_us();
     auto* req = mp->service->GetRequestPrototype(mp->method).New();
     auto* res = mp->service->GetResponsePrototype(mp->method).New();
     auto* cntl = new Controller;
     cntl->InitServerSide(server, s->remote_side());
     cntl->set_server_socket(sid);
+    // Expose the request's compression to the handler (reference
+    // Controller::request_compress_type); the response defaults to none
+    // unless the handler opts in.
+    cntl->set_request_compress_type(meta.compress_type());
+    // rpcz: with rpcz locally enabled, an upstream-sampled trace is
+    // always continued (skipping the rate gate); otherwise the local gate
+    // may start one. A disabled server NEVER allocates spans — peers must
+    // not control that cost (reference span.h:236-240 enable_rpcz).
+    if (IsRpczEnabled() && (req_meta.has_trace_id() || IsRpczSampled())) {
+        auto* span = new Span;
+        span->kind = Span::SERVER;
+        span->trace_id =
+            req_meta.has_trace_id() ? req_meta.trace_id() : fast_rand();
+        span->parent_span_id =
+            req_meta.has_span_id() ? req_meta.span_id() : 0;
+        span->span_id = fast_rand();
+        span->method =
+            req_meta.service_name() + "." + req_meta.method_name();
+        span->remote_side = s->remote_side();
+        span->start_us = start_us;
+        span->request_bytes = (int64_t)payload_size + att_size;
+        cntl->span_ = span;
+    }
     if (meta.has_stream_settings()) {
         cntl->SetRemoteStream(meta.stream_settings().stream_id(),
                               meta.stream_settings().window_size());
     }
     cntl->request_attachment() = attachment;
-    const int64_t start_us = monotonic_time_us();
     auto* done = new SendResponseClosure(server, mp, cntl, req, res, sid, cid,
                                          start_us);
     if (!ParsePbFromIOBuf(req, payload)) {

@@ -1,0 +1,108 @@
+#include "trpc/span.h"
+
+#include <cinttypes>
+#include <cstdio>
+
+#include "tbase/errno.h"
+#include "tbase/flags.h"
+#include "tbase/time.h"
+
+// Live-settable through /flags (reference -enable_rpcz works the same).
+DEFINE_bool(enable_rpcz, false, "collect per-RPC spans, browse at /rpcz");
+
+namespace tpurpc {
+
+void Span::Annotate(const std::string& text) {
+    notes.push_back(Note{monotonic_time_us(), text});
+}
+
+void Span::dispatch() { SpanDB::singleton()->Add(std::move(*this)); }
+
+SpanDB* SpanDB::singleton() {
+    static SpanDB* db = new SpanDB;
+    return db;
+}
+
+void SpanDB::Add(Span&& s) {
+    std::lock_guard<std::mutex> g(mu_);
+    spans_.push_back(std::move(s));
+    while (spans_.size() > kCapacity) {
+        spans_.pop_front();
+    }
+}
+
+std::vector<Span> SpanDB::Recent(size_t limit, uint64_t trace_id) const {
+    std::lock_guard<std::mutex> g(mu_);
+    std::vector<Span> out;
+    for (auto it = spans_.rbegin(); it != spans_.rend() && out.size() < limit;
+         ++it) {
+        if (trace_id == 0 || it->trace_id == trace_id) {
+            out.push_back(*it);
+        }
+    }
+    return out;
+}
+
+bool IsRpczSampled() {
+    return FLAGS_enable_rpcz.get() && Collector::singleton()->sample();
+}
+
+bool IsRpczEnabled() { return FLAGS_enable_rpcz.get(); }
+
+std::string RenderRpcz(uint64_t trace_id_filter) {
+    const std::vector<Span> spans =
+        SpanDB::singleton()->Recent(trace_id_filter != 0 ? 256 : 64,
+                                    trace_id_filter);
+    std::string out;
+    char line[512];
+    snprintf(line, sizeof(line),
+             "rpcz: %zu span(s)%s  (enable with /flags/enable_rpcz"
+             "?setvalue=1; filter with /rpcz?trace_id=N)\n\n",
+             spans.size(), trace_id_filter != 0 ? " [filtered]" : "");
+    out += line;
+    for (const Span& s : spans) {
+        const int64_t total =
+            s.end_us > s.start_us ? s.end_us - s.start_us : 0;
+        snprintf(line, sizeof(line),
+                 "trace=%" PRIu64 " span=%" PRIu64 " parent=%" PRIu64
+                 " %s %s remote=%s total=%" PRId64 "us error=%d req=%" PRId64
+                 "B res=%" PRId64 "B retries=%d\n",
+                 s.trace_id, s.span_id, s.parent_span_id,
+                 s.kind == Span::SERVER ? "SERVER" : "CLIENT",
+                 s.method.c_str(), endpoint2str(s.remote_side).c_str(),
+                 total, s.error_code, s.request_bytes, s.response_bytes,
+                 s.retries);
+        out += line;
+        // Phase timeline, offsets from start. A phase whose timestamps
+        // were never reached (early failure paths) prints as 0, not a
+        // nonsense negative offset.
+        auto phase = [](int64_t from, int64_t to) -> int64_t {
+            return (from > 0 && to >= from) ? to - from : 0;
+        };
+        if (s.kind == Span::SERVER) {
+            snprintf(line, sizeof(line),
+                     "  received +0us  queued %" PRId64 "us  process %" PRId64
+                     "us  write %" PRId64 "us\n",
+                     phase(s.start_us, s.process_start_us),
+                     phase(s.process_start_us, s.process_end_us),
+                     phase(s.process_end_us, s.end_us));
+        } else {
+            snprintf(line, sizeof(line),
+                     "  issued +0us  sent %" PRId64 "us  response %" PRId64
+                     "us  done %" PRId64 "us\n",
+                     phase(s.start_us, s.sent_us),
+                     phase(s.sent_us, s.received_us),
+                     s.received_us > 0 ? phase(s.received_us, s.end_us)
+                                       : phase(s.sent_us, s.end_us));
+        }
+        out += line;
+        for (const Span::Note& n : s.notes) {
+            snprintf(line, sizeof(line), "  @%+" PRId64 "us %s\n",
+                     n.at_us - s.start_us, n.text.c_str());
+            out += line;
+        }
+    }
+    return out;
+}
+
+}  // namespace tpurpc

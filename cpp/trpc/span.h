@@ -1,0 +1,83 @@
+// rpcz spans: per-RPC phase timelines, sampled via the Collector and
+// browsable at /rpcz.
+//
+// Modeled on reference src/brpc/span.h:47-120 (Span with client/server
+// phase timestamps, trace/span/parent ids propagated through RpcMeta,
+// SpanDB storage, rendered by builtin/rpcz_service.cpp). Enabled by the
+// live flag -enable_rpcz (settable through /flags like the reference's
+// gflag).
+#pragma once
+
+#include <cstdint>
+#include <deque>
+#include <mutex>
+#include <string>
+#include <vector>
+
+#include "tbase/endpoint.h"
+#include "tvar/collector.h"
+
+namespace tpurpc {
+
+struct Span : public Collected {
+    enum Kind { CLIENT = 0, SERVER = 1 };
+
+    Kind kind = CLIENT;
+    uint64_t trace_id = 0;
+    uint64_t span_id = 0;
+    uint64_t parent_span_id = 0;
+    std::string method;
+    EndPoint remote_side;
+    int error_code = 0;
+    int64_t request_bytes = 0;
+    int64_t response_bytes = 0;
+    int retries = 0;  // client: re-issues (retry or backup) after the first
+
+    // Phase timestamps (monotonic us). Client: start -> sent ->
+    // response_received -> end. Server: received -> process_start ->
+    // process_end(=response send begins) -> end(=response queued).
+    int64_t start_us = 0;
+    int64_t sent_us = 0;
+    int64_t received_us = 0;
+    int64_t process_start_us = 0;
+    int64_t process_end_us = 0;
+    int64_t end_us = 0;
+
+    // Free-form annotations with timestamps (reference Span::Annotate).
+    struct Note {
+        int64_t at_us;
+        std::string text;
+    };
+    std::vector<Note> notes;
+
+    void Annotate(const std::string& text);
+
+    void dispatch() override;  // moves *this into the SpanDB
+};
+
+// Fixed-capacity store of recently completed spans (the reference keeps a
+// time-indexed SpanDB; a bounded ring is enough for a live portal).
+class SpanDB {
+public:
+    static SpanDB* singleton();
+
+    void Add(Span&& s);
+    // Newest-first snapshot; trace_id == 0 means all.
+    std::vector<Span> Recent(size_t limit, uint64_t trace_id = 0) const;
+
+private:
+    static constexpr size_t kCapacity = 512;
+    mutable std::mutex mu_;
+    std::deque<Span> spans_;
+};
+
+// True when this RPC should carry a span (flag on + sampling gate open).
+bool IsRpczSampled();
+// Flag alone (for continuing an upstream-sampled trace: the remote's
+// sampling decision is honored, but only while rpcz is locally enabled —
+// peers must not be able to force span allocation on a disabled server).
+bool IsRpczEnabled();
+// Render the /rpcz page (newest-first; trace filter optional).
+std::string RenderRpcz(uint64_t trace_id_filter);
+
+}  // namespace tpurpc

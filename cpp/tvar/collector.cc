@@ -1,0 +1,63 @@
+#include "tvar/collector.h"
+
+#include <thread>
+
+#include "tbase/time.h"
+
+namespace tpurpc {
+
+Collector* Collector::singleton() {
+    static Collector* c = new Collector;
+    return c;
+}
+
+Collector::Collector() {
+    std::thread([this] { Run(); }).detach();
+}
+
+bool Collector::sample() {
+    const int64_t now = monotonic_time_us();
+    const int64_t ws = window_start_us_.load(std::memory_order_relaxed);
+    if (now - ws >= 1000 * 1000) {
+        // New one-second window (benign race: worst case two resetters
+        // both zero the count — a few extra samples, never unbounded).
+        window_start_us_.store(now, std::memory_order_relaxed);
+        window_count_.store(0, std::memory_order_relaxed);
+    }
+    return window_count_.fetch_add(1, std::memory_order_relaxed) <
+           max_per_second_;
+}
+
+void Collector::submit(Collected* obj) {
+    Collected* old = head_.load(std::memory_order_relaxed);
+    do {
+        obj->next_ = old;
+    } while (!head_.compare_exchange_weak(old, obj,
+                                          std::memory_order_release,
+                                          std::memory_order_relaxed));
+}
+
+void Collector::Run() {
+    while (true) {
+        std::this_thread::sleep_for(std::chrono::milliseconds(50));
+        Collected* grabbed =
+            head_.exchange(nullptr, std::memory_order_acquire);
+        // Reverse to submission order.
+        Collected* rev = nullptr;
+        while (grabbed != nullptr) {
+            Collected* next = grabbed->next_;
+            grabbed->next_ = rev;
+            rev = grabbed;
+            grabbed = next;
+        }
+        while (rev != nullptr) {
+            Collected* next = rev->next_;
+            rev->dispatch();
+            delete rev;
+            ndispatched_.fetch_add(1, std::memory_order_relaxed);
+            rev = next;
+        }
+    }
+}
+
+}  // namespace tpurpc

@@ -1,0 +1,59 @@
+// Collector: the sampling pipeline for OBJECTS (not counters) — the
+// backbone of rpcz spans and rpc_dump.
+//
+// Modeled on reference src/bvar/collector.h:46-123 + collector.cpp:38 (a
+// global speed limit of ~N samples/second decides up-front whether an
+// expensive record is created at all; created records are pushed onto a
+// wait-free MPSC list and a background thread dispatches them out of the
+// request path). Here: sample() is the token gate, submit() the wait-free
+// push, and each Collected subclass implements dispatch() (runs on the
+// collector thread, which then deletes the object).
+#pragma once
+
+#include <atomic>
+#include <cstdint>
+
+namespace tpurpc {
+
+class Collected {
+public:
+    virtual ~Collected() = default;
+    // Runs on the collector background thread; the object is deleted
+    // right after.
+    virtual void dispatch() = 0;
+
+private:
+    friend class Collector;
+    Collected* next_ = nullptr;
+};
+
+class Collector {
+public:
+    // Intentionally leaked (process-lifetime background thread).
+    static Collector* singleton();
+
+    // Global speed gate: true at most max_samples_per_second() times per
+    // second (reference bvar_collector_max_pending_samples spirit).
+    // Callers create the expensive record only when this returns true.
+    bool sample();
+
+    // Hand off a record to the background dispatcher (wait-free push).
+    void submit(Collected* obj);
+
+    int64_t max_samples_per_second() const { return max_per_second_; }
+    int64_t ndispatched() const {
+        return ndispatched_.load(std::memory_order_relaxed);
+    }
+
+private:
+    Collector();
+    void Run();
+
+    std::atomic<Collected*> head_{nullptr};
+    std::atomic<int64_t> window_start_us_{0};
+    std::atomic<int64_t> window_count_{0};
+    std::atomic<int64_t> ndispatched_{0};
+    const int64_t max_per_second_ = 1000;
+};
+
+}  // namespace tpurpc

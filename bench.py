@@ -87,6 +87,7 @@ def main():
     ici, ici_n = median_rounds(["--json", "--ici"])
     xproc, _ = median_rounds(["--json", "--xproc"])
     tcp, _ = median_rounds(["--json"])
+    tcp_pooled, _ = median_rounds(["--json", "--pooled"])
 
     if ici is None or "mbps" not in ici:
         # Degraded fallback: loopback TCP only (tail still runs over TCP).
@@ -123,7 +124,8 @@ def main():
     for k in ("qps_4k", "p50_us_4k", "p99_us_4k"):
         if k in ici:
             out["ici_" + k] = ici[k]
-    for prefix, r in (("xproc_", xproc), ("tcp_", tcp)):
+    for prefix, r in (("xproc_", xproc), ("tcp_", tcp),
+                      ("tcp_pooled_", tcp_pooled)):
         if r is not None:
             for k in ("mbps", "qps_4k", "p99_us_4k"):
                 if k in r:

@@ -457,3 +457,71 @@ TEST(Rpcz, SampledSpansShowTimeline) {
     const std::string trace_tok = page.substr(t0, page.find(' ', t0) - t0);
     EXPECT_TRUE(page.find(trace_tok, t0 + 1) != std::string::npos);
 }
+
+// ---------------- HTTP-as-RPC + json2pb ----------------
+// Reference: policy/http_rpc_protocol.cpp:1790 + src/json2pb — POST
+// /Service/Method with an application/json body reaches the pb service
+// and answers json (`curl -d '{...}' host:port/EchoService/Echo`).
+
+namespace {
+
+std::string http_post(int port, const std::string& path,
+                      const std::string& body) {
+    const int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+    sockaddr_in addr;
+    EndPoint ep;
+    str2endpoint("127.0.0.1", port, &ep);
+    endpoint2sockaddr(ep, &addr);
+    if (::connect(fd, (sockaddr*)&addr, sizeof(addr)) != 0) {
+        close(fd);
+        return "";
+    }
+    char head[256];
+    snprintf(head, sizeof(head),
+             "POST %s HTTP/1.1\r\nHost: x\r\nContent-Type: application/json"
+             "\r\nContent-Length: %zu\r\nConnection: close\r\n\r\n",
+             path.c_str(), body.size());
+    std::string req = std::string(head) + body;
+    (void)!write(fd, req.data(), req.size());
+    std::string out;
+    char buf[8192];
+    ssize_t r;
+    while ((r = read(fd, buf, sizeof(buf))) > 0) out.append(buf, (size_t)r);
+    close(fd);
+    return out;
+}
+
+}  // namespace
+
+TEST(HttpRpc, JsonEchoRoundTrip) {
+    RpczEchoService service;
+    Server server;
+    ASSERT_EQ(0, server.AddService(&service));
+    EndPoint listen;
+    str2endpoint("127.0.0.1:0", &listen);
+    ASSERT_EQ(0, server.Start(listen, nullptr));
+    const int port = server.listened_port();
+
+    // Full service name and short name both route.
+    for (const char* path :
+         {"/benchpb.EchoService/Echo", "/EchoService/Echo"}) {
+        const std::string rsp =
+            http_post(port, path, "{\"send_ts_us\": 4242}");
+        EXPECT_TRUE(rsp.find("200 OK") != std::string::npos) << path;
+        EXPECT_TRUE(rsp.find("application/json") != std::string::npos);
+        EXPECT_TRUE(rsp.find("\"send_ts_us\"") != std::string::npos) << rsp;
+        EXPECT_TRUE(rsp.find("4242") != std::string::npos) << rsp;
+    }
+    // Unknown method: 404.
+    EXPECT_TRUE(http_post(port, "/EchoService/Nope", "{}").find("404") !=
+                std::string::npos);
+    // Malformed json: 400.
+    EXPECT_TRUE(http_post(port, "/EchoService/Echo", "{oops")
+                    .find("400") != std::string::npos);
+    // Empty body = default request: still answers.
+    EXPECT_TRUE(http_post(port, "/EchoService/Echo", "").find("200 OK") !=
+                std::string::npos);
+    // The per-method stats saw the calls.
+    const std::string status = http_get(port, "/status");
+    EXPECT_TRUE(status.find("benchpb.EchoService.Echo") != std::string::npos);
+}

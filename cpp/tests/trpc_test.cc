@@ -672,3 +672,130 @@ TEST(Compress, CorruptedFrameRejectedByChecksum) {
     // The service never ran.
     EXPECT_EQ(service.ncalls.load(), 0);
 }
+
+// ---------------- pooled / short connection modes ----------------
+// Reference: socket.cpp GetPooledSocket/GetShortSocket + controller.cpp
+// "NOT reuse pooled connection if this call fails and no response": one
+// in-flight RPC per pooled connection, returned on response, closed on
+// failure; short connections close after every call.
+
+#include "tnet/socket_map.h"
+
+TEST(Pooled, SequentialCallsReuseOneConnection) {
+    TestServer ts;
+    ASSERT_TRUE(ts.start());
+    Channel channel;
+    ChannelOptions opts;
+    opts.timeout_ms = 3000;
+    opts.connection_type = CONNECTION_TYPE_POOLED;
+    ASSERT_EQ(0, channel.Init(ts.ep, &opts));
+    test::EchoService_Stub stub(&channel);
+    for (int i = 0; i < 5; ++i) {
+        Controller cntl;
+        test::EchoRequest req;
+        req.set_message("pooled");
+        test::EchoResponse res;
+        stub.Echo(&cntl, &req, &res, nullptr);
+        ASSERT_FALSE(cntl.Failed());
+    }
+    // One pooled data connection total (returned between calls). The
+    // shared "main" socket never connects in pooled mode (it only carries
+    // identity), so accepted == 1.
+    EXPECT_EQ(ts.server.acceptor()->accepted_count(), 1);
+    EXPECT_EQ(SocketPool::singleton()->idle_count(ts.ep), 1u);
+}
+
+TEST(Pooled, ConcurrentCallsUseDistinctConnections) {
+    TestServer ts;
+    ASSERT_TRUE(ts.start());
+    Channel channel;
+    ChannelOptions opts;
+    opts.timeout_ms = 3000;
+    opts.connection_type = CONNECTION_TYPE_POOLED;
+    ASSERT_EQ(0, channel.Init(ts.ep, &opts));
+
+    struct Ctx {
+        Channel* ch;
+        std::atomic<int> ok{0};
+    } ctx{&channel, {}};
+    std::vector<fiber_t> tids(4);
+    for (auto& tid : tids) {
+        fiber_start_background(
+            &tid, nullptr,
+            [](void* arg) -> void* {
+                Ctx* c = (Ctx*)arg;
+                test::EchoService_Stub stub(c->ch);
+                Controller cntl;
+                test::EchoRequest req;
+                req.set_message("concurrent");
+                req.set_sleep_us(100 * 1000);  // overlap all four
+                test::EchoResponse res;
+                stub.Echo(&cntl, &req, &res, nullptr);
+                if (!cntl.Failed()) c->ok.fetch_add(1);
+                return nullptr;
+            },
+            &ctx);
+    }
+    for (auto tid : tids) fiber_join(tid, nullptr);
+    EXPECT_EQ(ctx.ok.load(), 4);
+    // Four overlapping calls -> four distinct pooled connections, all
+    // idle afterwards.
+    EXPECT_EQ(ts.server.acceptor()->accepted_count(), 4);
+    EXPECT_EQ(SocketPool::singleton()->idle_count(ts.ep), 4u);
+}
+
+TEST(Pooled, FailedCallDoesNotReuseConnection) {
+    TestServer ts;
+    ASSERT_TRUE(ts.start());
+    Channel channel;
+    ChannelOptions opts;
+    opts.timeout_ms = 100;
+    opts.max_retry = 0;
+    opts.connection_type = CONNECTION_TYPE_POOLED;
+    ASSERT_EQ(0, channel.Init(ts.ep, &opts));
+    test::EchoService_Stub stub(&channel);
+    {
+        Controller cntl;
+        test::EchoRequest req;
+        req.set_message("will-timeout");
+        req.set_sleep_us(400 * 1000);
+        test::EchoResponse res;
+        stub.Echo(&cntl, &req, &res, nullptr);
+        EXPECT_TRUE(cntl.Failed());
+    }
+    // The timed-out call's connection must NOT be pooled (an orphan
+    // response is still coming on it).
+    EXPECT_EQ(SocketPool::singleton()->idle_count(ts.ep), 0u);
+    // A fresh call works on a new connection.
+    for (int i = 0; i < 100; ++i) {  // wait out the orphan response
+        usleep(5000);
+    }
+    Controller cntl;
+    test::EchoRequest req;
+    req.set_message("after");
+    test::EchoResponse res;
+    stub.Echo(&cntl, &req, &res, nullptr);
+    EXPECT_FALSE(cntl.Failed());
+    EXPECT_EQ(res.message(), "after");
+}
+
+TEST(Short, FreshConnectionPerCall) {
+    TestServer ts;
+    ASSERT_TRUE(ts.start());
+    Channel channel;
+    ChannelOptions opts;
+    opts.timeout_ms = 3000;
+    opts.connection_type = CONNECTION_TYPE_SHORT;
+    ASSERT_EQ(0, channel.Init(ts.ep, &opts));
+    test::EchoService_Stub stub(&channel);
+    for (int i = 0; i < 3; ++i) {
+        Controller cntl;
+        test::EchoRequest req;
+        req.set_message("short");
+        test::EchoResponse res;
+        stub.Echo(&cntl, &req, &res, nullptr);
+        ASSERT_FALSE(cntl.Failed());
+    }
+    EXPECT_EQ(ts.server.acceptor()->accepted_count(), 3);
+    EXPECT_EQ(SocketPool::singleton()->idle_count(ts.ep), 0u);
+}

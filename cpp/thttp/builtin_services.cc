@@ -123,14 +123,19 @@ void HandleConnections(Server* server, const HttpRequest&,
     res->set_content_type("text/plain");
     char line[256];
     res->Append("socket_id            fd    remote              "
-                "unwritten_bytes\n");
+                "in_bytes     out_bytes    unwritten  age_s  idle_s\n");
+    const int64_t now = monotonic_time_us();
     for (SocketId id : server->acceptor()->connections()) {
         SocketUniquePtr s = SocketUniquePtr::FromId(id);
         if (!s) continue;
-        snprintf(line, sizeof(line), "%-20llu %-5d %-19s %lld\n",
+        snprintf(line, sizeof(line),
+                 "%-20llu %-5d %-19s %-12lld %-12lld %-10lld %-6lld %lld\n",
                  (unsigned long long)id, s->fd(),
                  endpoint2str(s->remote_side()).c_str(),
-                 (long long)s->unwritten_bytes());
+                 (long long)s->bytes_read(), (long long)s->bytes_written(),
+                 (long long)s->unwritten_bytes(),
+                 (long long)((now - s->created_us()) / 1000000),
+                 (long long)((now - s->last_active_us()) / 1000000));
         res->Append(line);
     }
 }

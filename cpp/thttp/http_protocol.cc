@@ -3,11 +3,15 @@
 #include <memory>
 
 #include "tbase/errno.h"
+#include "tbase/time.h"
 #include "tbase/logging.h"
 #include "thttp/http_message.h"
 #include "tnet/input_messenger.h"
 #include "tnet/protocol.h"
 #include "tnet/socket.h"
+#include "tfiber/fiber_sync.h"
+#include "trpc/controller.h"
+#include "trpc/json2pb.h"
 #include "trpc/server.h"
 
 namespace tpurpc {
@@ -39,6 +43,97 @@ ParseResult ParseHttp(IOBuf* source, Socket* s, bool read_eof, const void*) {
     return ParseResult::make_ok(msg);
 }
 
+// HTTP-as-RPC: POST /Service/Method with an application/json body is
+// transcoded to the pb service and answered as json (reference
+// policy/http_rpc_protocol.cpp:1790 + src/json2pb). Runs synchronously on
+// this (in-order) connection fiber: the done-closure is awaited, so async
+// handlers work too. Returns false if the path maps to no method.
+bool DispatchHttpRpc(Server* server, const HttpRequest& req,
+                     HttpResponse* res) {
+    Server::MethodProperty* mp = server->FindMethodByHttpPath(req.path);
+    if (mp == nullptr) return false;
+    res->set_content_type("application/json");
+    if (req.method != "POST" && req.method != "GET") {
+        res->status = 405;
+        res->body.clear();
+        res->Append("{\"error\":\"use POST (json body) or GET\"}\n");
+        return true;
+    }
+    // Admission + teardown accounting, same as the native protocol.
+    const int64_t cur =
+        mp->status->concurrency.fetch_add(1, std::memory_order_relaxed) + 1;
+    if (mp->status->limiter != nullptr &&
+        !mp->status->limiter->OnRequested(cur)) {
+        mp->status->concurrency.fetch_sub(1, std::memory_order_relaxed);
+        mp->status->nrejected.fetch_add(1, std::memory_order_relaxed);
+        res->status = 503;
+        res->Append("{\"error\":\"concurrency limit\"}\n");
+        return true;
+    }
+    server->BeginRequest();
+    const int64_t start_us = monotonic_time_us();
+
+    std::unique_ptr<google::protobuf::Message> pb_req(
+        mp->service->GetRequestPrototype(mp->method).New());
+    std::unique_ptr<google::protobuf::Message> pb_res(
+        mp->service->GetResponsePrototype(mp->method).New());
+    Controller cntl;
+    cntl.InitServerSide(server, EndPoint());
+    std::string err;
+    const std::string body = req.body.to_string();
+    // Error strings get embedded in a json body: strip the characters
+    // that would break its syntax.
+    auto json_safe = [](std::string s) {
+        for (char& ch : s) {
+            if (ch == '"' || ch == '\\' || ch == '\n' || ch == '\r') {
+                ch = ' ';
+            }
+        }
+        return s;
+    };
+    if (!body.empty() && !JsonToPb(body, pb_req.get(), &err)) {
+        res->status = 400;
+        res->Append("{\"error\":\"bad request json: " + json_safe(err) +
+                    "\"}\n");
+    } else {
+        // Await the done-closure (handlers may complete asynchronously).
+        CountdownEvent done_ev(1);
+        struct SignalClosure : google::protobuf::Closure {
+            CountdownEvent* ev;
+            void Run() override { ev->signal(); }  // NOT self-deleting
+        } done;
+        done.ev = &done_ev;
+        mp->service->CallMethod(mp->method, &cntl, pb_req.get(),
+                                pb_res.get(), &done);
+        done_ev.wait();
+        if (cntl.Failed()) {
+            res->status = 500;
+            res->Append("{\"error\":\"" + json_safe(cntl.ErrorText()) +
+                        "\"}\n");
+        } else {
+            std::string json;
+            if (!PbToJson(*pb_res, &json, &err)) {
+                res->status = 500;
+                res->Append("{\"error\":\"serialize response\"}\n");
+            } else {
+                res->Append(json);
+                res->Append("\n");
+            }
+        }
+    }
+    const int64_t lat_us = monotonic_time_us() - start_us;
+    mp->status->latency << lat_us;
+    mp->status->concurrency.fetch_sub(1, std::memory_order_relaxed);
+    if (res->status != 200) {
+        mp->status->nerror.fetch_add(1, std::memory_order_relaxed);
+    }
+    if (mp->status->limiter != nullptr) {
+        mp->status->limiter->OnResponded(res->status == 200 ? 0 : 1, lat_us);
+    }
+    server->EndRequest();
+    return true;
+}
+
 void ProcessHttp(InputMessageBase* msg_base) {
     std::unique_ptr<HttpInputMessage> msg((HttpInputMessage*)msg_base);
     SocketUniquePtr s = SocketUniquePtr::FromId(msg->socket_id);
@@ -56,12 +151,12 @@ void ProcessHttp(InputMessageBase* msg_base) {
         res.Append("no server bound to this port\n");
     } else {
         const HttpHandler* h = msg->server->FindHttpHandler(msg->req.path);
-        if (h == nullptr) {
+        if (h != nullptr) {
+            (*h)(msg->server, msg->req, &res);
+        } else if (!DispatchHttpRpc(msg->server, msg->req, &res)) {
             res.status = 404;
             res.set_content_type("text/plain");
             res.Append("404 not found: " + msg->req.path + "\n");
-        } else {
-            (*h)(msg->server, msg->req, &res);
         }
     }
     if (close_conn) res.SetHeader("Connection", "close");

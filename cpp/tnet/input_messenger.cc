@@ -75,9 +75,11 @@ void InputMessenger::OnNewMessages(Socket* s) {
                     ? s->transport()->Pump(&s->read_buf)
                     : s->read_buf.append_from_file_descriptor(s->fd(),
                                                               512 * 1024);
-            if (nr == 0) {
+            if (nr > 0) {
+                s->add_bytes_read(nr);
+            } else if (nr == 0) {
                 read_eof = true;
-            } else if (nr < 0) {
+            } else {
                 if (errno == EAGAIN || errno == EWOULDBLOCK) {
                     return;  // burst drained; next edge re-triggers
                 }

@@ -84,6 +84,10 @@ int Socket::Create(const SocketOptions& options, SocketId* id) {
     // the socket, which must still deliver the notification.
     s->on_recycle_ = options.on_recycle;
     s->recycle_arg_ = options.recycle_arg;
+    s->bytes_read_.store(0, std::memory_order_relaxed);
+    s->bytes_written_.store(0, std::memory_order_relaxed);
+    s->created_us_ = monotonic_time_us();
+    s->last_active_us_.store(s->created_us_, std::memory_order_relaxed);
     if (s->epollout_butex_ == nullptr) s->epollout_butex_ = butex_create();
     if (s->connect_butex_ == nullptr) s->connect_butex_ = butex_create();
 
@@ -468,6 +472,7 @@ bool Socket::FlushOnce(bool allow_block) {
             return true;
         }
         unwritten_bytes_.fetch_sub(nw, std::memory_order_relaxed);
+        add_bytes_written(nw);
         // Drop fully-written requests.
         while (inflight_index_ < inflight_batch_.size() &&
                inflight_batch_[inflight_index_]->data.empty()) {

@@ -22,6 +22,7 @@
 
 #include "tbase/endpoint.h"
 #include "tbase/iobuf.h"
+#include "tbase/time.h"
 #include "tbase/versioned_ref.h"
 #include "tnet/circuit_breaker.h"
 #include "tfiber/butex.h"
@@ -134,6 +135,28 @@ public:
         return unwritten_bytes_.load(std::memory_order_relaxed);
     }
 
+    // ---- per-socket stats (reference socket.h:127 SocketStat) ----
+    void add_bytes_read(int64_t n) {
+        bytes_read_.fetch_add(n, std::memory_order_relaxed);
+        last_active_us_.store(monotonic_time_us(),
+                              std::memory_order_relaxed);
+    }
+    void add_bytes_written(int64_t n) {
+        bytes_written_.fetch_add(n, std::memory_order_relaxed);
+        last_active_us_.store(monotonic_time_us(),
+                              std::memory_order_relaxed);
+    }
+    int64_t bytes_read() const {
+        return bytes_read_.load(std::memory_order_relaxed);
+    }
+    int64_t bytes_written() const {
+        return bytes_written_.load(std::memory_order_relaxed);
+    }
+    int64_t created_us() const { return created_us_; }
+    int64_t last_active_us() const {
+        return last_active_us_.load(std::memory_order_relaxed);
+    }
+
     // VersionedRefWithId hooks.
     void OnFailed();
     void OnRecycle();
@@ -199,6 +222,10 @@ private:
     CircuitBreaker circuit_breaker_;
     void (*on_recycle_)(void*, SocketId) = nullptr;
     void* recycle_arg_ = nullptr;
+    std::atomic<int64_t> bytes_read_{0};
+    std::atomic<int64_t> bytes_written_{0};
+    int64_t created_us_ = 0;
+    std::atomic<int64_t> last_active_us_{0};
 };
 
 }  // namespace tpurpc

@@ -1,8 +1,26 @@
 #include "tnet/socket_map.h"
 
+#include "tbase/flags.h"
+#include "tbase/time.h"
+#include "tfiber/fiber.h"
 #include "tnet/input_messenger.h"
 
+DEFINE_int32(max_pooled_connections_per_remote, 32,
+             "idle pooled connections kept per server");
+DEFINE_int32(pooled_idle_close_s, 30,
+             "close pooled connections idle this long; <=0 disables");
+
 namespace tpurpc {
+
+int CreateClientSocket(const EndPoint& remote, InputMessenger* messenger,
+                       SocketId* id) {
+    SocketOptions opts;
+    opts.fd = -1;  // connect on first write
+    opts.remote_side = remote;
+    opts.on_edge_triggered_events = &InputMessenger::OnNewMessages;
+    opts.user = messenger;
+    return Socket::Create(opts, id);
+}
 
 SocketMap* SocketMap::singleton() {
     static SocketMap* m = new SocketMap;
@@ -23,12 +41,7 @@ int SocketMap::GetOrCreate(const EndPoint& remote, InputMessenger* messenger,
         }
         map_.erase(it);
     }
-    SocketOptions opts;
-    opts.fd = -1;  // connect on first write
-    opts.remote_side = remote;
-    opts.on_edge_triggered_events = &InputMessenger::OnNewMessages;
-    opts.user = messenger;
-    if (Socket::Create(opts, id) != 0) return -1;
+    if (CreateClientSocket(remote, messenger, id) != 0) return -1;
     map_[remote] = *id;
     return 0;
 }
@@ -38,6 +51,101 @@ void SocketMap::Remove(const EndPoint& remote, SocketId expected_id) {
     auto it = map_.find(remote);
     if (it != map_.end() && it->second == expected_id) {
         map_.erase(it);
+    }
+}
+
+
+// ---------------- SocketPool ----------------
+
+SocketPool* SocketPool::singleton() {
+    static SocketPool* p = new SocketPool;
+    return p;
+}
+
+int SocketPool::Get(const EndPoint& remote, InputMessenger* messenger,
+                    SocketId* id) {
+    {
+        std::lock_guard<std::mutex> g(mu_);
+        auto it = pools_.find(remote);
+        if (it != pools_.end()) {
+            auto& idle = it->second;
+            while (!idle.empty()) {
+                const SocketId cand = idle.back().id;
+                idle.pop_back();
+                Socket* s = Socket::Address(cand);
+                if (s != nullptr) {
+                    s->Dereference();
+                    *id = cand;
+                    return 0;
+                }
+                // failed while idle: skip
+            }
+        }
+        if (!sweeping_ && FLAGS_pooled_idle_close_s.get() > 0) {
+            sweeping_ = true;
+            fiber_t tid;
+            auto* self = this;
+            if (fiber_start_background(
+                    &tid, nullptr,
+                    [](void* arg) -> void* {
+                        ((SocketPool*)arg)->SweepLoop();
+                        return nullptr;
+                    },
+                    self) != 0) {
+                sweeping_ = false;
+            }
+        }
+    }
+    return CreateClientSocket(remote, messenger, id);
+}
+
+void SocketPool::Return(SocketId id) {
+    SocketUniquePtr s = SocketUniquePtr::FromId(id);
+    if (!s) return;  // failed meanwhile: nothing to pool
+    std::lock_guard<std::mutex> g(mu_);
+    auto& idle = pools_[s->remote_side()];
+    if ((int)idle.size() >= FLAGS_max_pooled_connections_per_remote.get()) {
+        s->SetFailed();  // over capacity: close instead
+        return;
+    }
+    idle.push_back(IdleConn{id, monotonic_time_us()});
+}
+
+size_t SocketPool::idle_count(const EndPoint& remote) {
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = pools_.find(remote);
+    return it == pools_.end() ? 0 : it->second.size();
+}
+
+void SocketPool::SweepLoop() {
+    while (true) {
+        fiber_usleep(2 * 1000 * 1000);
+        const int64_t idle_limit_us =
+            (int64_t)FLAGS_pooled_idle_close_s.get() * 1000 * 1000;
+        if (idle_limit_us <= 0) continue;
+        const int64_t now = monotonic_time_us();
+        std::vector<SocketId> to_close;
+        {
+            std::lock_guard<std::mutex> g(mu_);
+            for (auto& kv : pools_) {
+                auto& idle = kv.second;
+                size_t w = 0;
+                for (size_t i = 0; i < idle.size(); ++i) {
+                    if (now - idle[i].returned_us > idle_limit_us) {
+                        to_close.push_back(idle[i].id);
+                    } else {
+                        idle[w++] = idle[i];
+                    }
+                }
+                idle.resize(w);
+            }
+            for (auto it = pools_.begin(); it != pools_.end();) {
+                it = it->second.empty() ? pools_.erase(it) : std::next(it);
+            }
+        }
+        for (SocketId id : to_close) {
+            Socket::SetFailedById(id);
+        }
     }
 }
 

@@ -13,6 +13,12 @@ namespace tpurpc {
 
 class InputMessenger;
 
+// Create a fresh client connection (connect-on-first-write) to `remote`
+// fed into `messenger` — the one place client SocketOptions are built
+// (SocketMap, SocketPool and short-lived connections all use it).
+int CreateClientSocket(const EndPoint& remote, InputMessenger* messenger,
+                       SocketId* id);
+
 class SocketMap {
 public:
     static SocketMap* singleton();
@@ -27,6 +33,40 @@ public:
 private:
     std::mutex mu_;
     std::map<EndPoint, SocketId> map_;
+};
+
+// Pooled ("pooled" connection mode) client sockets: one in-flight RPC per
+// connection at a time, returned to the per-remote idle pool after its
+// response arrives (reference src/brpc/socket.cpp SocketPool::GetSocket /
+// ReturnSocket; controller.cpp: a call that failed without a response
+// never reuses its pooled connection). An idle-close sweep fails pooled
+// connections unused for -pooled_idle_close_s (reference socket_map.h:204
+// idle-close thread).
+class SocketPool {
+public:
+    static SocketPool* singleton();
+
+    // Pop an idle healthy connection to `remote` or create a fresh one
+    // (connect-on-first-write). Returns 0 and sets *id.
+    int Get(const EndPoint& remote, InputMessenger* messenger, SocketId* id);
+    // Return a connection whose RPC received its response. Over-capacity
+    // or failed sockets are closed instead of pooled.
+    void Return(SocketId id);
+
+    // Test/portal introspection: idle connections pooled for `remote`.
+    size_t idle_count(const EndPoint& remote);
+
+private:
+    SocketPool() = default;
+    void SweepLoop();  // idle-close fiber
+
+    struct IdleConn {
+        SocketId id;
+        int64_t returned_us;
+    };
+    std::mutex mu_;
+    std::map<EndPoint, std::vector<IdleConn>> pools_;
+    bool sweeping_ = false;
 };
 
 }  // namespace tpurpc

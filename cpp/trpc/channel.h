@@ -19,10 +19,24 @@ namespace tpurpc {
 
 class LoadBalancerWithNaming;
 
+// How RPCs map onto connections (reference ConnectionType,
+// src/brpc/socket.cpp GetPooledSocket/GetShortSocket):
+//  - SINGLE: one shared connection per remote; responses correlate by id.
+//  - POOLED: one in-flight RPC per connection, pooled after its response —
+//    large payloads never head-of-line-block each other (the reference's
+//    2.3 GB/s headline configuration).
+//  - SHORT: fresh connection per call, closed after the response.
+enum ConnectionType {
+    CONNECTION_TYPE_SINGLE = 0,
+    CONNECTION_TYPE_POOLED = 1,
+    CONNECTION_TYPE_SHORT = 2,
+};
+
 struct ChannelOptions {
     int64_t timeout_ms = 500;   // same default as the reference
     int max_retry = 3;
     int64_t backup_request_ms = -1;  // <0 disabled
+    ConnectionType connection_type = CONNECTION_TYPE_SINGLE;
 };
 
 class Channel : public google::protobuf::RpcChannel {

@@ -63,6 +63,9 @@ void Controller::Reset() {
     has_request_code_ = false;
     request_compress_type_ = 0;
     response_compress_type_ = 0;
+    current_fly_sid_ = INVALID_VREF_ID;
+    unfinished_fly_sid_ = INVALID_VREF_ID;
+    reusable_fly_sid_ = INVALID_VREF_ID;
     delete excluded_;
     excluded_ = nullptr;
     request_stream_ = INVALID_VREF_ID;
@@ -131,6 +134,10 @@ int Controller::HandleError(CallId id, int error) {
         // (the original behind a backup request): only that call dies;
         // the current call may still complete the RPC.
         unfinished_cid_ = INVALID_CALL_ID;
+        if (unfinished_fly_sid_ != INVALID_VREF_ID) {
+            Socket::SetFailedById(unfinished_fly_sid_);
+            unfinished_fly_sid_ = INVALID_VREF_ID;
+        }
         return id_unlock(id);
     }
     if (id == current_cid_ && unfinished_cid_ != INVALID_CALL_ID &&
@@ -140,7 +147,18 @@ int Controller::HandleError(CallId id, int error) {
         // failing the whole RPC.
         current_cid_ = unfinished_cid_;
         unfinished_cid_ = INVALID_CALL_ID;
+        if (current_fly_sid_ != INVALID_VREF_ID) {
+            Socket::SetFailedById(current_fly_sid_);
+        }
+        current_fly_sid_ = unfinished_fly_sid_;
+        unfinished_fly_sid_ = INVALID_VREF_ID;
         return id_unlock(id);
+    }
+    // The failing try's dedicated connection is dead weight from here
+    // (retry opens a fresh one; terminal failure closes it in EndRPC).
+    if (current_fly_sid_ != INVALID_VREF_ID && is_retryable(error)) {
+        Socket::SetFailedById(current_fly_sid_);
+        current_fly_sid_ = INVALID_VREF_ID;
     }
     const int effective_max_retry =
         max_retry_ >= 0 ? max_retry_
@@ -227,6 +245,41 @@ void Controller::IssueRPC() {
         }
     }
     remote_side_ = s->remote_side();
+
+    // Connection selection (reference controller.cpp:1135-1173): pooled
+    // and short modes write on a dedicated connection instead of the
+    // shared main socket; the main socket still carries LB identity,
+    // circuit-breaker state and health checks.
+    // Streaming RPCs always ride the shared single connection: the
+    // stream binds to the connection that carried the establishing RPC,
+    // which must be neither pooled (a later RPC would interleave with
+    // stream frames) nor closed at EndRPC (reference streams ride the
+    // main socket for the same reason).
+    const ConnectionType ct = request_stream_ != INVALID_VREF_ID
+                                  ? CONNECTION_TYPE_SINGLE
+                                  : channel_->options().connection_type;
+    if (ct != CONNECTION_TYPE_SINGLE) {
+        SocketId fly = INVALID_VREF_ID;
+        int rc2;
+        if (ct == CONNECTION_TYPE_POOLED) {
+            rc2 = SocketPool::singleton()->Get(
+                s->remote_side(), Channel::client_messenger(), &fly);
+        } else {  // SHORT: fresh connection, closed after the response
+            rc2 = CreateClientSocket(s->remote_side(),
+                                     Channel::client_messenger(), &fly);
+        }
+        if (rc2 != 0) {
+            id_error(current_cid_, TERR_FAILED_SOCKET);
+            return;
+        }
+        SocketUniquePtr fly_ptr;
+        if (Socket::AddressSocket(fly, &fly_ptr) != 0) {
+            id_error(current_cid_, TERR_FAILED_SOCKET);
+            return;
+        }
+        current_fly_sid_ = fly;
+        s = std::move(fly_ptr);
+    }
 
     // Sender-side frame limit: the receiver rejects >256MB frames as a
     // PROTOCOL error (failing the whole connection); catch it here so only
@@ -324,14 +377,43 @@ void Controller::MaybeIssueBackup() {
     // no error — the locality-aware policy deprioritizes it; the breaker
     // sees no failure). The winner's stats land in EndRPC.
     unfinished_cid_ = current_cid_;
+    unfinished_fly_sid_ = current_fly_sid_;
+    current_fly_sid_ = INVALID_VREF_ID;
     FeedbackToLB(0);
     current_cid_ = next;
     ++current_try_;
     IssueRPC();
 }
 
+// Pooled mode returns response-delivering connections to the pool; every
+// other pooled/short connection of this RPC (abandoned original behind a
+// winning backup, timed-out try, short-lived conn) is closed — it may
+// carry an orphan in-flight response and must never serve another call.
+void Controller::ReleaseFlySockets() {
+    if (channel_ == nullptr) return;
+    const ConnectionType ct = channel_->options().connection_type;
+    if (ct == CONNECTION_TYPE_SINGLE) return;
+    if (reusable_fly_sid_ != INVALID_VREF_ID) {
+        if (ct == CONNECTION_TYPE_POOLED) {
+            SocketPool::singleton()->Return(reusable_fly_sid_);
+        } else {
+            Socket::SetFailedById(reusable_fly_sid_);
+        }
+        reusable_fly_sid_ = INVALID_VREF_ID;
+    }
+    if (current_fly_sid_ != INVALID_VREF_ID) {
+        Socket::SetFailedById(current_fly_sid_);
+        current_fly_sid_ = INVALID_VREF_ID;
+    }
+    if (unfinished_fly_sid_ != INVALID_VREF_ID) {
+        Socket::SetFailedById(unfinished_fly_sid_);
+        unfinished_fly_sid_ = INVALID_VREF_ID;
+    }
+}
+
 void Controller::EndRPC(CallId locked_id) {
     latency_us_ = monotonic_time_us() - start_us_;
+    ReleaseFlySockets();
     if (span_ != nullptr) {
         span_->end_us = monotonic_time_us();
         span_->error_code = error_code_;
@@ -392,6 +474,17 @@ void ProcessTpuStdResponse(TpuStdMessage* msg, const rpc::RpcMeta& meta) {
     if (cntl->span_ != nullptr) {
         cntl->span_->received_us = monotonic_time_us();
         cntl->span_->response_bytes = (int64_t)msg->body.size();
+    }
+    // Pooled/short: the connection that delivered THIS response is clean
+    // (no orphan response pending) and may be pooled again at EndRPC.
+    if (cid == cntl->current_cid_ &&
+        cntl->current_fly_sid_ != INVALID_VREF_ID) {
+        cntl->reusable_fly_sid_ = cntl->current_fly_sid_;
+        cntl->current_fly_sid_ = INVALID_VREF_ID;
+    } else if (cid == cntl->unfinished_cid_ &&
+               cntl->unfinished_fly_sid_ != INVALID_VREF_ID) {
+        cntl->reusable_fly_sid_ = cntl->unfinished_fly_sid_;
+        cntl->unfinished_fly_sid_ = INVALID_VREF_ID;
     }
     const auto& rmeta = meta.response();
     if (rmeta.error_code() != 0) {

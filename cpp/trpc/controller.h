@@ -151,6 +151,8 @@ private:
     // Report the finished try to the LB (latency + error feed the
     // locality-aware policy; reference Call::OnComplete controller.cpp:780).
     void FeedbackToLB(int error);
+    // Pool-return / close this RPC's pooled/short connections (EndRPC).
+    void ReleaseFlySockets();
 
     // --- shared fields ---
     int error_code_;
@@ -190,6 +192,14 @@ private:
     bool has_request_code_;
     int request_compress_type_;
     int response_compress_type_;
+    // Pooled/short connection of the current try and of the still-live
+    // original behind a backup (INVALID in single mode). A socket whose
+    // call received a response is moved to reusable_fly_sid_ and returned
+    // to the pool at EndRPC; anything else is closed (reference: a call
+    // that fails without a response never reuses its pooled connection).
+    SocketId current_fly_sid_;
+    SocketId unfinished_fly_sid_;
+    SocketId reusable_fly_sid_;
     class ExcludedServers* excluded_;  // servers tried by earlier attempts
 
     // --- streaming state ---

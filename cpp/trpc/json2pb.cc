@@ -1,0 +1,33 @@
+#include "trpc/json2pb.h"
+
+#include <google/protobuf/util/json_util.h>
+
+namespace tpurpc {
+
+bool JsonToPb(const std::string& json, google::protobuf::Message* msg,
+              std::string* error) {
+    google::protobuf::util::JsonParseOptions opts;
+    opts.ignore_unknown_fields = true;
+    const auto st =
+        google::protobuf::util::JsonStringToMessage(json, msg, opts);
+    if (!st.ok()) {
+        if (error != nullptr) *error = st.ToString();
+        return false;
+    }
+    return true;
+}
+
+bool PbToJson(const google::protobuf::Message& msg, std::string* json,
+              std::string* error) {
+    google::protobuf::util::JsonPrintOptions opts;
+    opts.preserve_proto_field_names = true;
+    const auto st =
+        google::protobuf::util::MessageToJsonString(msg, json, opts);
+    if (!st.ok()) {
+        if (error != nullptr) *error = st.ToString();
+        return false;
+    }
+    return true;
+}
+
+}  // namespace tpurpc

@@ -1,0 +1,25 @@
+// json <-> protobuf transcoding for HTTP-as-RPC.
+//
+// Reference: src/json2pb/ (json_to_pb.{h,cpp}, pb_to_json.{h,cpp}, ~2k LoC
+// of rapidjson glue). The modern protobuf runtime ships the same
+// capability as util/json_util; wrapping it keeps the surface identical
+// while dropping the hand-rolled codec.
+#pragma once
+
+#include <google/protobuf/message.h>
+
+#include <string>
+
+namespace tpurpc {
+
+// Lenient parse (unknown json fields ignored, like the reference's
+// json2pb). Returns false with *error set on malformed json / type
+// mismatches.
+bool JsonToPb(const std::string& json, google::protobuf::Message* msg,
+              std::string* error);
+
+// Serialize with original proto field names (not lowerCamel).
+bool PbToJson(const google::protobuf::Message& msg, std::string* json,
+              std::string* error);
+
+}  // namespace tpurpc

@@ -143,6 +143,39 @@ Server::MethodProperty* Server::FindMethod(const std::string& service_name,
     return it == methods_.end() ? nullptr : &it->second;
 }
 
+Server::MethodProperty* Server::FindMethodByHttpPath(
+    const std::string& path) {
+    // Expect exactly "/<service>/<method>".
+    if (path.size() < 4 || path[0] != '/') return nullptr;
+    const size_t slash = path.find('/', 1);
+    if (slash == std::string::npos || slash + 1 >= path.size() ||
+        path.find('/', slash + 1) != std::string::npos) {
+        return nullptr;
+    }
+    const std::string svc = path.substr(1, slash - 1);
+    const std::string method = path.substr(slash + 1);
+    // Full name first.
+    if (auto it = methods_.find(svc + "." + method); it != methods_.end()) {
+        return &it->second;
+    }
+    // Last-component service name ("EchoService" for "pkg.EchoService").
+    // Ambiguous short names (two packages sharing the component) resolve
+    // to nothing — silently picking one would misroute requests (the
+    // reference disables short-name access on ambiguity too).
+    const std::string suffix = "." + svc + "." + method;
+    MethodProperty* found = nullptr;
+    for (auto& kv : methods_) {
+        const std::string& key = kv.first;
+        if (key.size() > suffix.size() &&
+            key.compare(key.size() - suffix.size(), suffix.size(),
+                        suffix) == 0) {
+            if (found != nullptr) return nullptr;  // ambiguous
+            found = &kv.second;
+        }
+    }
+    return found;
+}
+
 void Server::RegisterHttpHandler(const std::string& path,
                                  HttpHandler handler) {
     if (started_) {

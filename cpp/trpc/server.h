@@ -92,6 +92,12 @@ public:
     // "ServiceName.MethodName" lookup (called by the protocol layer).
     MethodProperty* FindMethod(const std::string& service_name,
                                const std::string& method_name);
+    // "/Service/Method" lookup for HTTP-as-RPC (reference
+    // policy/http_rpc_protocol.cpp maps URLs to pb methods the same way):
+    // the service component matches the full name ("pkg.EchoService") or
+    // its last component ("EchoService"). Null when the path is not an
+    // RPC method.
+    MethodProperty* FindMethodByHttpPath(const std::string& path);
 
     // ---- HTTP portal (thttp/; reference src/brpc/builtin/) ----
     // Register a handler for an exact path, or a prefix when `path` ends

@@ -258,6 +258,7 @@ int main(int argc, char** argv) {
     bool xproc = false;
     bool tail = false;
     bool scale = false;
+    bool pooled = false;
     const char* prof_path = nullptr;
     for (int i = 1; i < argc; ++i) {
         if (strcmp(argv[i], "--json") == 0) json = true;
@@ -265,6 +266,7 @@ int main(int argc, char** argv) {
         if (strcmp(argv[i], "--xproc") == 0) xproc = true;
         if (strcmp(argv[i], "--tail") == 0) tail = true;
         if (strcmp(argv[i], "--scale") == 0) scale = true;
+        if (strcmp(argv[i], "--pooled") == 0) pooled = true;
         if (strcmp(argv[i], "--ici-server") == 0) return RunIciServer();
         if (strcmp(argv[i], "--prof") == 0 && i + 1 < argc) {
             prof_path = argv[++i];
@@ -293,6 +295,9 @@ int main(int argc, char** argv) {
     Channel channel;
     ChannelOptions copts;
     copts.timeout_ms = 10000;
+    // Pooled mode: one in-flight RPC per connection (the reference's
+    // multi-connection headline configuration, docs/cn/benchmark.md:104).
+    if (pooled) copts.connection_type = CONNECTION_TYPE_POOLED;
     if (xproc) {
         // Cross-process data plane: TCP handshake to the child, then the
         // shared-memory queue pair (tici/shm_link.h). The server runs in

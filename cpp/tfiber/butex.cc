@@ -164,7 +164,7 @@ int wait_pthread(Butex* b, int expected, const int64_t* abstime_us) {
         std::lock_guard<std::mutex> g(b->mu);
         if (b->value.load(std::memory_order_relaxed) != expected) {
             errno = EWOULDBLOCK;
-            return -1;
+            return EWOULDBLOCK;
         }
         b->enqueue(&w);
     }
@@ -179,7 +179,7 @@ int wait_pthread(Butex* b, int expected, const int64_t* abstime_us) {
                 std::unique_lock<std::mutex> g(b->mu);
                 if (b->erase(&w)) {
                     errno = ETIMEDOUT;
-                    return -1;
+                    return ETIMEDOUT;
                 }
                 g.unlock();
                 // A waker popped us: it WILL set pthread_word shortly; spin
@@ -230,7 +230,7 @@ int butex_wait(void* butex, int expected_value, const int64_t* abstime_us) {
     Butex* b = (Butex*)butex;
     if (b->value.load(std::memory_order_acquire) != expected_value) {
         errno = EWOULDBLOCK;
-        return -1;
+        return EWOULDBLOCK;
     }
     TaskGroup* g = TaskGroup::tls_group();
     if (g == nullptr || g->current() == nullptr) {
@@ -265,11 +265,11 @@ int butex_wait(void* butex, int expected_value, const int64_t* abstime_us) {
     const int st = w.state.load(std::memory_order_acquire);
     if (st == WAITER_TIMEDOUT) {
         errno = ETIMEDOUT;
-        return -1;
+        return ETIMEDOUT;
     }
     if (st == WAITER_CANCELLED) {
         errno = EWOULDBLOCK;
-        return -1;
+        return EWOULDBLOCK;
     }
     return 0;
 }

@@ -21,10 +21,14 @@ void butex_destroy(void* butex);
 // The 32-bit word (value is user-controlled).
 std::atomic<int>* butex_word(void* butex);
 
-// Park the caller until woken, iff *word == expected_value at publish time.
-// abstime (monotonic_time_us clock, microseconds) may be null for infinite.
-// Returns 0 when woken; -1 with errno EWOULDBLOCK if the value didn't match,
-// ETIMEDOUT on timeout.
+// Park until woken, the value changes, or `abstime_us` (absolute
+// monotonic; null = forever). Returns 0 when woken, else the POSITIVE
+// error code: ETIMEDOUT or EWOULDBLOCK (value already != expected).
+// errno is also set, but ONLY the return value is reliable: a fiber can
+// resume on a different worker thread, and compilers may cache the
+// (const) __errno_location() across the switch, making caller-side errno
+// reads address the old thread (same reasoning as the reference saving
+// errno across context switches, task_group.cpp:711).
 int butex_wait(void* butex, int expected_value, const int64_t* abstime_us);
 
 // Wake up to one / all waiters. Returns the number woken.

@@ -87,6 +87,19 @@ public:
         return 0;
     }
 
+    // Opt-in self-deletion for heap-allocated queues with two owners (the
+    // producer-side holder and the consumer run that delivers the stop
+    // iteration): each calls release() when done; the second delete()s.
+    // Solves the "who frees the queue" problem when the stop-delivered
+    // callback may destroy the producer-side holder while the consumer
+    // still touches queue members to retire (streaming RPC's rx queue).
+    void enable_self_release() { self_release_ = true; }
+    void release() {
+        if (owners_.fetch_sub(1, std::memory_order_acq_rel) == 1) {
+            delete this;
+        }
+    }
+
 private:
     struct Node {
         std::atomic<Node*> next{unlinked()};
@@ -166,7 +179,15 @@ private:
             }
         }
         if (saw_stop) {
+            // Capture BEFORE signaling: in join()-managed mode the joiner
+            // may destroy this queue the moment signal lands, so signal
+            // must be the consumer's last member touch. In self-release
+            // mode nobody joins-and-frees; release() (the consumer-side
+            // ownership drop) is then safe after the signal and runs once
+            // (the stop marker is consumed by exactly one run).
+            const bool self_rel = self_release_;
             join_event_.signal();
+            if (self_rel) release();
         }
     }
 
@@ -177,6 +198,8 @@ private:
     std::atomic<bool> stopping_{false};
     std::atomic<bool> stop_delivered_{false};
     CountdownEvent join_event_{1};
+    bool self_release_ = false;
+    std::atomic<int> owners_{2};
 };
 
 }  // namespace tpurpc

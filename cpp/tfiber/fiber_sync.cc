@@ -50,7 +50,7 @@ int FiberCond::wait_until(FiberMutex& mu, int64_t abstime_us) {
     mu.unlock();
     int rc = 0;
     const int64_t* abs_ptr = abstime_us > 0 ? &abstime_us : nullptr;
-    if (butex_wait(butex_, expected, abs_ptr) != 0 && errno == ETIMEDOUT) {
+    if (butex_wait(butex_, expected, abs_ptr) == ETIMEDOUT) {
         rc = ETIMEDOUT;
     }
     mu.lock();
@@ -97,7 +97,7 @@ int CountdownEvent::wait(const int64_t* abstime_us) {
     while (true) {
         const int v = w->load(std::memory_order_acquire);
         if (v <= 0) return 0;
-        if (butex_wait(butex_, v, abstime_us) != 0 && errno == ETIMEDOUT) {
+        if (butex_wait(butex_, v, abstime_us) == ETIMEDOUT) {
             return ETIMEDOUT;
         }
     }

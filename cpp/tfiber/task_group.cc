@@ -158,8 +158,19 @@ void TaskGroup::exit_current() {
     CHECK(false) << "dead fiber resumed";
 }
 
+// errno is thread-local, but a parked fiber can resume on a DIFFERENT
+// worker — and the compiler may legally CSE __errno_location() (declared
+// const) across the context switch, reading/writing the OLD worker's
+// errno after resume. Make errno effectively fiber-local by saving it
+// around the switch (reference task_group.cpp:711-712,794-795 "Save errno
+// so that errno is bthread-specific"), through noinline helpers so the
+// location is recomputed on the resuming thread.
+__attribute__((noinline)) static int read_errno_here() { return errno; }
+__attribute__((noinline)) static void write_errno_here(int v) { errno = v; }
+
 void TaskGroup::sched_park() {
     TaskMeta* m = cur_meta_;
+    const int saved_errno = read_errno_here();
     asan_before_jump(&m->asan_fake, worker_stack_base_,
                      worker_stack_size_);
     tf_jump_fcontext(&m->stack.context, main_ctx_, nullptr);
@@ -168,6 +179,7 @@ void TaskGroup::sched_park() {
     // callers go through TaskGroup::tls_group()). `m` lives on this fiber
     // stack and is still our own meta.
     asan_after_jump(m->asan_fake);
+    write_errno_here(saved_errno);
 }
 
 namespace {

@@ -49,7 +49,7 @@ ParseResult ParseHttp(IOBuf* source, Socket* s, bool read_eof, const void*) {
 // this (in-order) connection fiber: the done-closure is awaited, so async
 // handlers work too. Returns false if the path maps to no method.
 bool DispatchHttpRpc(Server* server, const HttpRequest& req,
-                     HttpResponse* res) {
+                     HttpResponse* res, const EndPoint& remote_side) {
     Server::MethodProperty* mp = server->FindMethodByHttpPath(req.path);
     if (mp == nullptr) return false;
     res->set_content_type("application/json");
@@ -59,33 +59,27 @@ bool DispatchHttpRpc(Server* server, const HttpRequest& req,
         res->Append("{\"error\":\"use POST (json body) or GET\"}\n");
         return true;
     }
-    // Admission + teardown accounting, same as the native protocol.
-    const int64_t cur =
-        mp->status->concurrency.fetch_add(1, std::memory_order_relaxed) + 1;
-    if (mp->status->limiter != nullptr &&
-        !mp->status->limiter->OnRequested(cur)) {
-        mp->status->concurrency.fetch_sub(1, std::memory_order_relaxed);
-        mp->status->nrejected.fetch_add(1, std::memory_order_relaxed);
+    // Admission + stats + Join accounting shared with the native protocol.
+    Server::MethodCallGuard guard(server, mp);
+    if (guard.rejected()) {
         res->status = 503;
         res->Append("{\"error\":\"concurrency limit\"}\n");
         return true;
     }
-    server->BeginRequest();
-    const int64_t start_us = monotonic_time_us();
 
     std::unique_ptr<google::protobuf::Message> pb_req(
         mp->service->GetRequestPrototype(mp->method).New());
     std::unique_ptr<google::protobuf::Message> pb_res(
         mp->service->GetResponsePrototype(mp->method).New());
     Controller cntl;
-    cntl.InitServerSide(server, EndPoint());
+    cntl.InitServerSide(server, remote_side);
     std::string err;
     const std::string body = req.body.to_string();
     // Error strings get embedded in a json body: strip the characters
     // that would break its syntax.
     auto json_safe = [](std::string s) {
         for (char& ch : s) {
-            if (ch == '"' || ch == '\\' || ch == '\n' || ch == '\r') {
+            if (ch == '"' || ch == '\\' || (unsigned char)ch < 0x20) {
                 ch = ' ';
             }
         }
@@ -121,16 +115,10 @@ bool DispatchHttpRpc(Server* server, const HttpRequest& req,
             }
         }
     }
-    const int64_t lat_us = monotonic_time_us() - start_us;
-    mp->status->latency << lat_us;
-    mp->status->concurrency.fetch_sub(1, std::memory_order_relaxed);
-    if (res->status != 200) {
-        mp->status->nerror.fetch_add(1, std::memory_order_relaxed);
-    }
-    if (mp->status->limiter != nullptr) {
-        mp->status->limiter->OnResponded(res->status == 200 ? 0 : 1, lat_us);
-    }
-    server->EndRequest();
+    // Feed the limiter/stats the RPC error (the same signal the native
+    // protocol uses), not the HTTP status.
+    guard.Finish(cntl.Failed() ? cntl.ErrorCode()
+                               : (res->status == 200 ? 0 : res->status));
     return true;
 }
 
@@ -153,7 +141,8 @@ void ProcessHttp(InputMessageBase* msg_base) {
         const HttpHandler* h = msg->server->FindHttpHandler(msg->req.path);
         if (h != nullptr) {
             (*h)(msg->server, msg->req, &res);
-        } else if (!DispatchHttpRpc(msg->server, msg->req, &res)) {
+        } else if (!DispatchHttpRpc(msg->server, msg->req, &res,
+                                    s->remote_side())) {
             res.status = 404;
             res.set_content_type("text/plain");
             res.Append("404 not found: " + msg->req.path + "\n");

@@ -89,18 +89,17 @@ namespace {
 // done-closure finishing one server call: serialize + respond + stats.
 class SendResponseClosure : public google::protobuf::Closure {
 public:
-    SendResponseClosure(Server* server, Server::MethodProperty* mp,
+    SendResponseClosure(Server* server, Server::MethodCallGuard* guard,
                         Controller* cntl, google::protobuf::Message* req,
                         google::protobuf::Message* res, SocketId sid,
-                        uint64_t cid, int64_t start_us)
+                        uint64_t cid)
         : server_(server),
-          mp_(mp),
+          guard_(guard),
           cntl_(cntl),
           req_(req),
           res_(res),
           sid_(sid),
-          cid_(cid),
-          start_us_(start_us) {}
+          cid_(cid) {}
 
     void Run() override {
         if (cntl_->span_ != nullptr) {
@@ -154,20 +153,10 @@ public:
             Collector::singleton()->submit(cntl_->span_);
             cntl_->span_ = nullptr;
         }
-        // Stats. EndRequest is the LAST touch of Server memory: it wakes
-        // Server::Join, after which the Server may be destroyed.
-        if (mp_ != nullptr) {
-            const int64_t lat_us = monotonic_time_us() - start_us_;
-            mp_->status->latency << lat_us;
-            mp_->status->concurrency.fetch_sub(1, std::memory_order_relaxed);
-            if (cntl_->Failed()) {
-                mp_->status->nerror.fetch_add(1, std::memory_order_relaxed);
-            }
-            if (mp_->status->limiter != nullptr) {
-                mp_->status->limiter->OnResponded(cntl_->ErrorCode(), lat_us);
-            }
-        }
-        server_->EndRequest();
+        // Stats + limiter + Join wakeup; Finish is the LAST touch of
+        // Server memory (the Server may be destroyed right after).
+        guard_->Finish(cntl_->ErrorCode());
+        delete guard_;
         delete req_;
         delete res_;
         delete cntl_;
@@ -176,13 +165,12 @@ public:
 
 private:
     Server* server_;
-    Server::MethodProperty* mp_;
+    Server::MethodCallGuard* guard_;
     Controller* cntl_;
     google::protobuf::Message* req_;
     google::protobuf::Message* res_;
     SocketId sid_;
     uint64_t cid_;
-    int64_t start_us_;
 };
 
 // Carries one parsed request to its user-code fiber.
@@ -242,30 +230,26 @@ void ProcessTpuStdRequest(TpuStdMessage* msg, const rpc::RpcMeta& meta) {
     }
     // Admission control (reference ConcurrencyLimiter::OnRequested —
     // constant or gradient "auto" per ServerOptions).
-    const int64_t cur =
-        mp->status->concurrency.fetch_add(1, std::memory_order_relaxed) + 1;
-    if (mp->status->limiter != nullptr &&
-        !mp->status->limiter->OnRequested(cur)) {
-        mp->status->concurrency.fetch_sub(1, std::memory_order_relaxed);
-        mp->status->nrejected.fetch_add(1, std::memory_order_relaxed);
+    auto* guard = new Server::MethodCallGuard(server, mp);
+    if (guard->rejected()) {
+        delete guard;
         SendErrorResponse(sid, cid, TERR_LIMIT_EXCEEDED, "concurrency limit");
         return;
     }
-    server->BeginRequest();
 
     // Split payload / attachment.
     const uint32_t att_size = meta.attachment_size();
     if ((size_t)att_size > msg->body.size()) {
-        mp->status->concurrency.fetch_sub(1, std::memory_order_relaxed);
-        server->EndRequest();
+        guard->Finish(TERR_REQUEST);
+        delete guard;
         SendErrorResponse(sid, cid, TERR_REQUEST,
                           "attachment_size exceeds body");
         return;
     }
     if (meta.has_body_checksum() &&
         crc32c_iobuf(0, msg->body) != meta.body_checksum()) {
-        mp->status->concurrency.fetch_sub(1, std::memory_order_relaxed);
-        server->EndRequest();
+        guard->Finish(TERR_REQUEST);
+        delete guard;
         SendErrorResponse(sid, cid, TERR_REQUEST, "body checksum mismatch");
         return;
     }
@@ -277,8 +261,8 @@ void ProcessTpuStdRequest(TpuStdMessage* msg, const rpc::RpcMeta& meta) {
     if (meta.compress_type() != COMPRESS_NONE) {
         IOBuf raw;
         if (!DecompressBody(meta.compress_type(), payload, &raw)) {
-            mp->status->concurrency.fetch_sub(1, std::memory_order_relaxed);
-            server->EndRequest();
+            guard->Finish(TERR_REQUEST);
+            delete guard;
             SendErrorResponse(sid, cid, TERR_REQUEST,
                               "decompress request failed");
             return;
@@ -320,8 +304,8 @@ void ProcessTpuStdRequest(TpuStdMessage* msg, const rpc::RpcMeta& meta) {
                               meta.stream_settings().window_size());
     }
     cntl->request_attachment() = attachment;
-    auto* done = new SendResponseClosure(server, mp, cntl, req, res, sid, cid,
-                                         start_us);
+    auto* done = new SendResponseClosure(server, guard, cntl, req, res, sid,
+                                         cid);
     if (!ParsePbFromIOBuf(req, payload)) {
         cntl->SetFailed(TERR_REQUEST, "parse request failed");
         done->Run();

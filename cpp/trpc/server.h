@@ -15,6 +15,7 @@
 #include <string>
 
 #include "tbase/endpoint.h"
+#include "tbase/time.h"
 #include "thttp/http_protocol.h"
 #include "tnet/acceptor.h"
 #include "tnet/input_messenger.h"
@@ -115,6 +116,53 @@ public:
     Acceptor* acceptor() { return &acceptor_; }
 
     std::atomic<int64_t> nprocessing{0};  // in-flight requests
+
+    // Per-method admission + accounting shared by every protocol
+    // (tpu_std, HTTP-as-RPC): one construction = one admission check; one
+    // Finish = stats + limiter feedback + Join accounting. Keeps the
+    // limiter/stat protocol in ONE place instead of per-protocol copies.
+    class MethodCallGuard {
+    public:
+        MethodCallGuard(Server* server, MethodProperty* mp)
+            : server_(server), mp_(mp) {
+            const int64_t cur = mp_->status->concurrency.fetch_add(
+                                    1, std::memory_order_relaxed) +
+                                1;
+            if (mp_->status->limiter != nullptr &&
+                !mp_->status->limiter->OnRequested(cur)) {
+                mp_->status->concurrency.fetch_sub(
+                    1, std::memory_order_relaxed);
+                mp_->status->nrejected.fetch_add(1,
+                                                 std::memory_order_relaxed);
+                rejected_ = true;
+                return;
+            }
+            server_->BeginRequest();
+            start_us_ = monotonic_time_us();
+        }
+        bool rejected() const { return rejected_; }
+        // Complete the call: record latency/errors, feed the limiter,
+        // wake Join. error_code 0 = success. Must be called exactly once
+        // unless rejected().
+        void Finish(int error_code) {
+            const int64_t lat_us = monotonic_time_us() - start_us_;
+            mp_->status->latency << lat_us;
+            mp_->status->concurrency.fetch_sub(1, std::memory_order_relaxed);
+            if (error_code != 0) {
+                mp_->status->nerror.fetch_add(1, std::memory_order_relaxed);
+            }
+            if (mp_->status->limiter != nullptr) {
+                mp_->status->limiter->OnResponded(error_code, lat_us);
+            }
+            server_->EndRequest();  // may free the Server: last touch
+        }
+
+    private:
+        Server* server_;
+        MethodProperty* mp_;
+        int64_t start_us_ = 0;
+        bool rejected_ = false;
+    };
     // Admission + accounting for one request (called by protocol layers).
     void BeginRequest() {
         nprocessing.fetch_add(1, std::memory_order_relaxed);

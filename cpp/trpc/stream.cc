@@ -151,13 +151,16 @@ void Stream::OnFailed() {
 }
 
 void Stream::OnRecycle() {
-    // Intentionally NOT deleted: a late consumer fiber (spawned by a push
-    // that raced stop()) may still touch the queue object after the last
-    // stream ref drops, and nobody can join from here (recycle runs on
-    // the consumer fiber itself). ~200 bytes leak per closed stream;
-    // the reference solves this with pooled versioned execution-queue ids
-    // (bthread execution_queue_address) — roadmap.
-    rx_queue = nullptr;
+    // Two-party release (ExecutionQueue::release): deletion happens only
+    // after BOTH the stop-delivering consumer run finished touching the
+    // queue AND no stream ref (hence no late execute()) remains — recycle
+    // is exactly that point on the stream side. (Previously leaked here;
+    // the reference solves the same lifetime with pooled versioned
+    // execution-queue ids, bthread/execution_queue.h.)
+    if (rx_queue != nullptr) {
+        rx_queue->release();
+        rx_queue = nullptr;
+    }
     if (writable_butex != nullptr) {
         butex_destroy(writable_butex);
         writable_butex = nullptr;
@@ -181,6 +184,7 @@ int NewStream(StreamId* id, const StreamOptions* options) {
     if (options != nullptr) st->options = *options;
     if (st->writable_butex == nullptr) st->writable_butex = butex_create();
     st->rx_queue = new ExecutionQueue<IOBuf>();
+    st->rx_queue->enable_self_release();
     st->rx_queue->start(&Stream::RxConsume, st);
     // The rx queue's stopped-iteration callback dereferences this ref.
     Stream* self = Stream::Address(*id);
@@ -227,47 +231,72 @@ int StreamAccept(StreamId* id, Controller* cntl,
 }
 
 int StreamWrite(StreamId id, IOBuf* data) {
-    StreamUniquePtr ptr = StreamUniquePtr::FromId(id);
-    Stream* st = ptr.get();
-    if (st == nullptr) {
-        errno = EINVAL;
+    // errno is assigned AFTER the VRefPtr releases: dropping the last ref
+    // runs the recycle chain, whose frees may clobber errno between the
+    // assignment and the caller's read.
+    int err = 0;
+    {
+        StreamUniquePtr ptr = StreamUniquePtr::FromId(id);
+        Stream* st = ptr.get();
+        if (st == nullptr) {
+            err = EINVAL;
+        } else if (!st->connected.load(std::memory_order_acquire)) {
+            err = st->close_seen.load(std::memory_order_relaxed) ? EPIPE
+                                                                 : EAGAIN;
+        } else if (st->writable_budget() < (int64_t)data->size()) {
+            err = EAGAIN;
+        } else {
+            st->written_bytes.fetch_add((int64_t)data->size(),
+                                        std::memory_order_relaxed);
+            st->SendFrameToPeer(FRAME_DATA, data);
+        }
+    }
+    if (err != 0) {
+        errno = err;
         return -1;
     }
-    if (!st->connected.load(std::memory_order_acquire)) {
-        errno = st->close_seen.load(std::memory_order_relaxed) ? EPIPE
-                                                               : EAGAIN;
-        return -1;
-    }
-    const int64_t sz = (int64_t)data->size();
-    if (st->writable_budget() < sz) {
-        errno = EAGAIN;
-        return -1;
-    }
-    st->written_bytes.fetch_add(sz, std::memory_order_relaxed);
-    st->SendFrameToPeer(FRAME_DATA, data);
     return 0;
 }
 
 int StreamWait(StreamId id, int64_t abstime_us) {
     while (true) {
-        StreamUniquePtr ptr = StreamUniquePtr::FromId(id);
-        Stream* st = ptr.get();
-        if (st == nullptr) {
-            errno = EINVAL;
-            return -1;
+        int err = 0;
+        bool timed_out = false;
+        {
+            StreamUniquePtr ptr = StreamUniquePtr::FromId(id);
+            Stream* st = ptr.get();
+            if (st == nullptr) {
+                err = EINVAL;
+            } else {
+                std::atomic<int>* word = butex_word(st->writable_butex);
+                const int expected =
+                    word->load(std::memory_order_acquire);
+                if (!st->connected.load(std::memory_order_acquire)) {
+                    err = EPIPE;
+                } else if (st->writable_budget() > 0) {
+                    return 0;
+                } else {
+                    const int64_t abst =
+                        abstime_us > 0
+                            ? abstime_us
+                            : monotonic_time_us() + (int64_t)3600e6;
+                    const int rc =
+                        butex_wait(st->writable_butex, expected, &abst);
+                    timed_out = rc == ETIMEDOUT && abstime_us > 0;
+                }
+            }
         }
-        std::atomic<int>* word = butex_word(st->writable_butex);
-        const int expected = word->load(std::memory_order_acquire);
-        if (!st->connected.load(std::memory_order_acquire)) {
-            errno = EPIPE;
-            return -1;
+        // Error code returned DIRECTLY (errno set best-effort only): the
+        // fiber may have resumed on another worker, where the caller's
+        // possibly-CSE'd errno location is the wrong thread's.
+        if (err != 0) {
+            errno = err;
+            return err;
         }
-        if (st->writable_budget() > 0) return 0;
-        const int64_t abst =
-            abstime_us > 0 ? abstime_us
-                           : monotonic_time_us() + (int64_t)3600e6;
-        const int rc = butex_wait(st->writable_butex, expected, &abst);
-        if (rc != 0 && errno == ETIMEDOUT && abstime_us > 0) return -1;
+        if (timed_out) {
+            errno = ETIMEDOUT;
+            return ETIMEDOUT;
+        }
     }
 }
 
@@ -414,6 +443,12 @@ void RegisterStreamProtocolOrDie() {
         p.parse = ParseStreamFrame;
         p.process = ProcessStreamFrame;
         p.name = "tpu_strm";
+        // STRM frames have no correlation ids: delivery order IS frame
+        // order, so processing must stay on the input fiber (a fiber per
+        // frame could enqueue the burst's last frame before its first).
+        // Cheap anyway: process just pushes into the stream's
+        // ExecutionQueue.
+        p.process_in_order = true;
         g_stream_protocol_index = RegisterProtocol(p);
     });
 }

@@ -69,7 +69,13 @@ int StreamAccept(StreamId* id, Controller* cntl,
 int StreamWrite(StreamId id, IOBuf* data);
 
 // Block the calling fiber until the stream is writable (or failed).
-// abstime_us 0 = wait forever. Returns 0 when (likely) writable.
+// abstime_us 0 = wait forever. Returns 0 when (likely) writable, else the
+// POSITIVE error code (EPIPE peer/local close, EINVAL dead id, ETIMEDOUT).
+// NOTE the direct return instead of the reference's -1+errno: a parked
+// fiber can resume on a different worker thread, and compilers legally
+// cache __errno_location() across calls — errno read by the CALLER after
+// a suspending call may address the old thread's errno. Suspending APIs
+// here therefore return their error code (errno is still set best-effort).
 int StreamWait(StreamId id, int64_t abstime_us);
 
 // Close: sends a CLOSE frame, fails the local stream; the peer's handler

@@ -124,3 +124,24 @@ TEST(Window, DeltaOverSamples) {
     a << 10;
     EXPECT_EQ(w.get_value(), 0);  // no samples yet
 }
+
+// ---------------- process variables ----------------
+// Reference: src/bvar/default_variables.cpp — process_* gauges at /vars.
+
+#include "tvar/default_variables.h"
+
+TEST(ProcessVars, ExposeAndRead) {
+    ExposeProcessVariables();
+    std::string v;
+    ASSERT_TRUE(Variable::describe_exposed("process_memory_resident_bytes",
+                                           &v));
+    EXPECT_GT(atoll(v.c_str()), 1024 * 1024);  // > 1MB resident
+    ASSERT_TRUE(Variable::describe_exposed("process_thread_count", &v));
+    EXPECT_GE(atoll(v.c_str()), 1);  // >=1: no hidden dep on worker startup
+    ASSERT_TRUE(Variable::describe_exposed("process_fd_count", &v));
+    EXPECT_GT(atoll(v.c_str()), 2);
+    ASSERT_TRUE(Variable::describe_exposed("process_uptime_seconds", &v));
+    EXPECT_GE(atoll(v.c_str()), 0);
+    ASSERT_TRUE(Variable::describe_exposed("process_cpu_user_ms", &v));
+    EXPECT_GE(atoll(v.c_str()), 0);
+}

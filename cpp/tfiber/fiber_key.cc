@@ -1,0 +1,204 @@
+#include "tfiber/fiber_key.h"
+
+#include <pthread.h>
+
+#include <cerrno>
+#include <mutex>
+#include <vector>
+
+#include "tfiber/task_group.h"
+#include "tfiber/task_meta.h"
+
+namespace tpurpc {
+
+namespace {
+
+constexpr uint32_t kMaxKeys = 256;
+
+// Global key registry: per-slot version (odd = in use) + dtor.
+struct KeyRegistry {
+    std::mutex mu;
+    uint32_t versions[kMaxKeys] = {};  // even = free, odd = live
+    void (*dtors[kMaxKeys])(void*) = {};
+    std::vector<uint32_t> free_slots;
+    uint32_t next_unused = 0;
+};
+KeyRegistry* registry() {
+    static KeyRegistry* r = new KeyRegistry;
+    return r;
+}
+
+// Per-fiber table: value + the key version it was written under.
+struct KeyTable {
+    struct Entry {
+        void* data = nullptr;
+        uint32_t version = 0;
+    };
+    std::vector<Entry> entries;
+};
+
+// Pool of recycled keytables (reference key.cpp:328 borrow_keytable /
+// return_keytable — reusing tables avoids an allocation per session).
+struct KeyTablePool {
+    std::mutex mu;
+    std::vector<KeyTable*> free_list;
+};
+KeyTablePool* kt_pool() {
+    static KeyTablePool* p = new KeyTablePool;
+    return p;
+}
+
+KeyTable* borrow_keytable() {
+    {
+        std::lock_guard<std::mutex> g(kt_pool()->mu);
+        if (!kt_pool()->free_list.empty()) {
+            KeyTable* kt = kt_pool()->free_list.back();
+            kt_pool()->free_list.pop_back();
+            return kt;
+        }
+    }
+    return new KeyTable;
+}
+
+// Pthread fallback cleanup: a real pthread TLS destructor runs the
+// keytable dtors when a NON-worker thread using FLS exits (the reference
+// installs the same for its pthread fallback; without it every
+// short-lived user thread would leak its table + values).
+pthread_key_t g_pthread_cleanup_key;
+pthread_once_t g_pthread_cleanup_once = PTHREAD_ONCE_INIT;
+void pthread_kt_cleanup(void* kt);
+void init_pthread_cleanup_key() {
+    pthread_key_create(&g_pthread_cleanup_key, pthread_kt_cleanup);
+}
+
+// The current execution context's keytable slot: the running fiber's
+// TaskMeta::local_storage, or a thread-local for plain pthreads.
+void** current_kt_slot() {
+    TaskGroup* g = TaskGroup::tls_group();
+    if (g != nullptr && g->current() != nullptr) {
+        return &g->current()->local_storage;
+    }
+    thread_local void* pthread_kt = nullptr;
+    return &pthread_kt;
+}
+
+bool on_fiber_worker_here() {
+    TaskGroup* g = TaskGroup::tls_group();
+    return g != nullptr && g->current() != nullptr;
+}
+
+}  // namespace
+
+int fiber_key_create(fiber_key_t* key, void (*dtor)(void*)) {
+    KeyRegistry* r = registry();
+    std::lock_guard<std::mutex> g(r->mu);
+    uint32_t slot;
+    if (!r->free_slots.empty()) {
+        slot = r->free_slots.back();
+        r->free_slots.pop_back();
+    } else if (r->next_unused < kMaxKeys) {
+        slot = r->next_unused++;
+    } else {
+        errno = ENOMEM;
+        return ENOMEM;
+    }
+    r->versions[slot] |= 1;  // live (odd)
+    r->dtors[slot] = dtor;
+    key->index = slot;
+    key->version = r->versions[slot];
+    return 0;
+}
+
+int fiber_key_delete(fiber_key_t key) {
+    KeyRegistry* r = registry();
+    std::lock_guard<std::mutex> g(r->mu);
+    if (key.index >= kMaxKeys || r->versions[key.index] != key.version) {
+        errno = EINVAL;
+        return EINVAL;
+    }
+    r->versions[key.index] += 1;  // even: free; stale reads fail
+    r->dtors[key.index] = nullptr;
+    r->free_slots.push_back(key.index);
+    return 0;
+}
+
+int fiber_setspecific(fiber_key_t key, void* data) {
+    if (key.index >= kMaxKeys || (key.version & 1) == 0) {
+        errno = EINVAL;
+        return EINVAL;
+    }
+    void** slot = current_kt_slot();
+    if (*slot == nullptr) {
+        *slot = borrow_keytable();
+        if (!on_fiber_worker_here()) {
+            // Register exit cleanup for this plain pthread's table.
+            pthread_once(&g_pthread_cleanup_once, init_pthread_cleanup_key);
+            pthread_setspecific(g_pthread_cleanup_key, *slot);
+        }
+    }
+    KeyTable* kt = (KeyTable*)*slot;
+    if (kt->entries.size() <= key.index) {
+        kt->entries.resize(key.index + 1);
+    }
+    kt->entries[key.index].data = data;
+    kt->entries[key.index].version = key.version;
+    return 0;
+}
+
+void* fiber_getspecific(fiber_key_t key) {
+    void** slot = current_kt_slot();
+    if (*slot == nullptr) return nullptr;
+    KeyTable* kt = (KeyTable*)*slot;
+    if (kt->entries.size() <= key.index) return nullptr;
+    const KeyTable::Entry& e = kt->entries[key.index];
+    // Stale key (deleted/recreated): this fiber's value was written under
+    // another key generation.
+    return e.version == key.version ? e.data : nullptr;
+}
+
+namespace fiber_internal {
+
+void return_keytable(void* raw) {
+    if (raw == nullptr) return;
+    KeyTable* kt = (KeyTable*)raw;
+    KeyRegistry* r = registry();
+    // Run destructors for values whose key is still live. Re-loop: a
+    // destructor may itself setspecific at an already-visited index
+    // (pthread_key semantics: up to PTHREAD_DESTRUCTOR_ITERATIONS
+    // passes; the reference keytable does the same).
+    for (int pass = 0; pass < 4; ++pass) {
+        bool any = false;
+        for (uint32_t i = 0; i < kt->entries.size(); ++i) {
+            KeyTable::Entry& e = kt->entries[i];
+            if (e.data == nullptr) continue;
+            void (*dtor)(void*) = nullptr;
+            {
+                std::lock_guard<std::mutex> g(r->mu);
+                if (i < kMaxKeys && r->versions[i] == e.version) {
+                    dtor = r->dtors[i];
+                }
+            }
+            void* data = e.data;
+            e.data = nullptr;
+            e.version = 0;
+            any = true;
+            if (dtor != nullptr) dtor(data);
+        }
+        if (!any) break;
+    }
+    kt->entries.clear();
+    std::lock_guard<std::mutex> g(kt_pool()->mu);
+    if (kt_pool()->free_list.size() < 1024) {
+        kt_pool()->free_list.push_back(kt);
+    } else {
+        delete kt;
+    }
+}
+
+}  // namespace fiber_internal
+
+namespace {
+void pthread_kt_cleanup(void* kt) { fiber_internal::return_keytable(kt); }
+}  // namespace
+
+}  // namespace tpurpc

@@ -1,0 +1,45 @@
+// Fiber-local storage: versioned keys + per-fiber keytables.
+//
+// Modeled on reference src/bthread/key.cpp (bthread_key_create /
+// bthread_setspecific / bthread_getspecific; KeyTable :328-373 with
+// borrow/return pooling so session data is reused across requests).
+// A key is (index, version): deleting a key bumps the slot's version so
+// stale keys read null instead of another user's data. Keytables are
+// created lazily on first setspecific, run destructors at fiber exit,
+// then return to a pool for reuse by later fibers.
+#pragma once
+
+#include <cstdint>
+
+namespace tpurpc {
+
+struct fiber_key_t {
+    uint32_t index = 0;
+    uint32_t version = 0;
+    bool operator==(const fiber_key_t& o) const {
+        return index == o.index && version == o.version;
+    }
+};
+constexpr fiber_key_t INVALID_FIBER_KEY = {0, 0};
+
+// Create a key; `dtor` (may be null) runs at fiber exit on each fiber's
+// non-null value. Returns 0, or ENOMEM when out of key slots.
+int fiber_key_create(fiber_key_t* key, void (*dtor)(void*));
+
+// Delete the key: values become unreachable immediately (getspecific on
+// the stale key returns null); their destructors do NOT run (same
+// contract as the reference bthread_key_delete / pthread_key_delete).
+int fiber_key_delete(fiber_key_t key);
+
+// Set/get this fiber's value for `key`. Outside a fiber worker, a
+// process-wide per-pthread fallback table is used (like the reference's
+// pthread fallback in bthread_setspecific).
+int fiber_setspecific(fiber_key_t key, void* data);
+void* fiber_getspecific(fiber_key_t key);
+
+namespace fiber_internal {
+// Run dtors + recycle the current fiber's keytable (fiber exit path).
+void return_keytable(void* kt);
+}  // namespace fiber_internal
+
+}  // namespace tpurpc

@@ -1,3 +1,4 @@
+#include "tfiber/fiber_key.h"
 #include "tfiber/task_group.h"
 
 #include <pthread.h>
@@ -146,6 +147,12 @@ void TaskGroup::fiber_entry(void* arg) {
     TaskMeta* m = (TaskMeta*)arg;
     asan_after_jump(m->asan_fake);
     m->ret = m->fn(m->arg);
+    // Fiber-local storage: run dtors + recycle the keytable (reference
+    // key.cpp return_keytable at task_runner end).
+    if (m->local_storage != nullptr) {
+        fiber_internal::return_keytable(m->local_storage);
+        m->local_storage = nullptr;
+    }
     TaskGroup::tls_group()->exit_current();
 }
 
@@ -328,6 +335,7 @@ static int start_fiber_impl(fiber_t* tid, const FiberAttr* attr,
     m->fn = fn;
     m->arg = arg;
     m->ret = nullptr;
+    m->local_storage = nullptr;  // fresh fiber: no inherited fiber-locals
     // Stale handle from the slot's previous tenant would hand ASan a freed
     // fake stack on this fiber's first switch-in.
     m->asan_fake = nullptr;

@@ -8,6 +8,7 @@
 #include "tfiber/butex.h"
 #include "tfiber/fiber.h"
 #include "thttp/builtin_services.h"
+#include "tvar/default_variables.h"
 #include "tici/shm_link.h"
 #include "trpc/policy_tpu_std.h"
 #include "trpc/stream.h"
@@ -87,6 +88,7 @@ int Server::StartNoListen(const ServerOptions* options) {
             kv.second.status->limiter.reset();  // restart may disable limits
         }
     }
+    ExposeProcessVariables();  // process_* gauges for /vars + /metrics
     messenger_.add_protocol(TpuStdProtocolIndex());
     messenger_.add_protocol(stream_internal::StreamProtocolIndex());
     // Any accepted TCP connection may upgrade itself to the shared-memory

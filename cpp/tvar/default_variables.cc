@@ -1,0 +1,177 @@
+// Process-level variables: cpu, memory, fds, threads, io — exposed at
+// /vars and scraped at /metrics.
+//
+// Modeled on reference src/bvar/default_variables.cpp:878 (PassiveStatus
+// readers over /proc/self). Registered once by ExposeProcessVariables()
+// (called from server startup); values are read lazily per scrape.
+#include "tvar/default_variables.h"
+
+#include <dirent.h>
+#include <unistd.h>
+
+#include <cinttypes>
+#include <cstdio>
+#include <cstring>
+#include <mutex>
+
+#include "tbase/time.h"
+#include "tvar/reducer.h"
+
+namespace tpurpc {
+
+namespace {
+
+struct ProcStat {
+    int64_t utime_ticks = 0;
+    int64_t stime_ticks = 0;
+    int64_t num_threads = 0;
+    int64_t vsize_bytes = 0;
+    int64_t rss_bytes = 0;
+};
+
+bool ReadProcStat(ProcStat* out) {
+    FILE* f = fopen("/proc/self/stat", "r");
+    if (f == nullptr) return false;
+    char buf[1024];
+    const size_t n = fread(buf, 1, sizeof(buf) - 1, f);
+    fclose(f);
+    if (n == 0) return false;
+    buf[n] = '\0';
+    // Field 2 (comm) may contain spaces: skip past the closing paren.
+    const char* p = strrchr(buf, ')');
+    if (p == nullptr) return false;
+    p += 2;  // skip ") "
+    // Fields from 3 (state) onward; utime=14 stime=15 num_threads=20
+    // vsize=23 rss=24 (1-based).
+    long long utime = 0, stime = 0, nthreads = 0, vsize = 0, rss = 0;
+    // state(3) + 10 ints to reach field 14.
+    int field = 3;
+    const char* q = p;
+    while (*q && field < 14) {
+        if (*q == ' ') ++field;
+        ++q;
+    }
+    if (sscanf(q, "%lld %lld", &utime, &stime) != 2) return false;
+    while (*q && field < 20) {
+        if (*q == ' ') ++field;
+        ++q;
+    }
+    if (sscanf(q, "%lld", &nthreads) != 1) return false;
+    while (*q && field < 23) {
+        if (*q == ' ') ++field;
+        ++q;
+    }
+    if (sscanf(q, "%lld %lld", &vsize, &rss) != 2) return false;
+    out->utime_ticks = utime;
+    out->stime_ticks = stime;
+    out->num_threads = nthreads;
+    out->vsize_bytes = vsize;
+    out->rss_bytes = rss * sysconf(_SC_PAGESIZE);
+    return true;
+}
+
+int64_t CountFds() {
+    DIR* d = opendir("/proc/self/fd");
+    if (d == nullptr) return -1;
+    int64_t n = 0;
+    while (readdir(d) != nullptr) ++n;
+    closedir(d);
+    return n > 2 ? n - 2 : 0;  // drop "." and ".."
+}
+
+bool ReadProcIo(int64_t* read_bytes, int64_t* write_bytes) {
+    FILE* f = fopen("/proc/self/io", "r");
+    if (f == nullptr) return false;
+    char line[128];
+    long long rb = -1, wb = -1;
+    while (fgets(line, sizeof(line), f) != nullptr) {
+        if (sscanf(line, "read_bytes: %lld", &rb) == 1) continue;
+        if (sscanf(line, "write_bytes: %lld", &wb) == 1) continue;
+    }
+    fclose(f);
+    *read_bytes = rb;
+    *write_bytes = wb;
+    return rb >= 0 && wb >= 0;
+}
+
+const int64_t g_start_us = monotonic_time_us();
+
+int64_t ticks_to_ms(int64_t ticks) {
+    static const long hz = sysconf(_SC_CLK_TCK);
+    return hz > 0 ? ticks * 1000 / hz : 0;
+}
+
+// One PassiveStatus per metric, all sharing the /proc readers.
+template <int64_t (*Fn)()>
+struct Gauge : public Variable {
+    std::string get_description() const override {
+        char buf[32];
+        snprintf(buf, sizeof(buf), "%" PRId64, Fn());
+        return buf;
+    }
+};
+
+// One /proc read shared by all gauges of a scrape (reference
+// CachedReader): values within a dump stay mutually consistent and a
+// 9-gauge scrape does 2 file opens, not 7.
+const ProcStat& cached_stat() {
+    static std::mutex mu;
+    static ProcStat cached;
+    static int64_t read_at_us = -1;
+    std::lock_guard<std::mutex> g(mu);
+    const int64_t now = monotonic_time_us();
+    if (read_at_us < 0 || now - read_at_us > 100 * 1000) {
+        ProcStat s;
+        if (ReadProcStat(&s)) cached = s;
+        read_at_us = now;
+    }
+    return cached;
+}
+
+struct ProcIo {
+    int64_t read_bytes = 0;
+    int64_t write_bytes = 0;
+};
+const ProcIo& cached_io() {
+    static std::mutex mu;
+    static ProcIo cached;
+    static int64_t read_at_us = -1;
+    std::lock_guard<std::mutex> g(mu);
+    const int64_t now = monotonic_time_us();
+    if (read_at_us < 0 || now - read_at_us > 100 * 1000) {
+        int64_t r = 0, w = 0;
+        if (ReadProcIo(&r, &w)) cached = ProcIo{r, w};
+        read_at_us = now;
+    }
+    return cached;
+}
+
+int64_t cpu_user_ms() { return ticks_to_ms(cached_stat().utime_ticks); }
+int64_t cpu_system_ms() { return ticks_to_ms(cached_stat().stime_ticks); }
+int64_t mem_resident() { return cached_stat().rss_bytes; }
+int64_t mem_virtual() { return cached_stat().vsize_bytes; }
+int64_t thread_count() { return cached_stat().num_threads; }
+int64_t fd_count() { return CountFds(); }
+int64_t uptime_s() { return (monotonic_time_us() - g_start_us) / 1000000; }
+int64_t io_read() { return cached_io().read_bytes; }
+int64_t io_write() { return cached_io().write_bytes; }
+
+}  // namespace
+
+void ExposeProcessVariables() {
+    static std::once_flag once;
+    std::call_once(once, [] {
+        // Intentionally leaked: process-lifetime variables.
+        (new Gauge<cpu_user_ms>())->expose("process_cpu_user_ms");
+        (new Gauge<cpu_system_ms>())->expose("process_cpu_system_ms");
+        (new Gauge<mem_resident>())->expose("process_memory_resident_bytes");
+        (new Gauge<mem_virtual>())->expose("process_memory_virtual_bytes");
+        (new Gauge<thread_count>())->expose("process_thread_count");
+        (new Gauge<fd_count>())->expose("process_fd_count");
+        (new Gauge<uptime_s>())->expose("process_uptime_seconds");
+        (new Gauge<io_read>())->expose("process_io_read_bytes");
+        (new Gauge<io_write>())->expose("process_io_write_bytes");
+    });
+}
+
+}  // namespace tpurpc

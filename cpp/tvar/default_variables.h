@@ -1,0 +1,10 @@
+// Process-level metrics (cpu/memory/fds/threads/io) — reference
+// src/bvar/default_variables.cpp. Idempotent; called at server startup so
+// /vars and /metrics are scrape-worthy out of the box.
+#pragma once
+
+namespace tpurpc {
+
+void ExposeProcessVariables();
+
+}  // namespace tpurpc

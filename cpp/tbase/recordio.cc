@@ -1,0 +1,79 @@
+#include "tbase/recordio.h"
+
+#include <arpa/inet.h>
+
+#include <cstring>
+#include <vector>
+
+#include "tbase/crc32c.h"
+
+namespace tpurpc {
+
+namespace {
+constexpr char kMagic[4] = {'T', 'R', 'E', 'C'};
+constexpr size_t kMaxRecord = 256u << 20;
+}  // namespace
+
+RecordWriter::RecordWriter(const std::string& path) {
+    f_ = fopen(path.c_str(), "ab");
+}
+
+RecordWriter::~RecordWriter() {
+    if (f_ != nullptr) fclose(f_);
+}
+
+bool RecordWriter::Write(const IOBuf& payload) {
+    if (f_ == nullptr) return false;
+    char header[12];
+    memcpy(header, kMagic, 4);
+    const uint32_t len = htonl((uint32_t)payload.size());
+    memcpy(header + 4, &len, 4);
+    uint32_t crc = 0;
+    for (size_t i = 0; i < payload.backing_block_num(); ++i) {
+        size_t blen = 0;
+        const char* data = payload.backing_block_data(i, &blen);
+        crc = crc32c_extend(crc, data, blen);
+    }
+    crc = htonl(crc);
+    memcpy(header + 8, &crc, 4);
+    if (fwrite(header, 1, sizeof(header), f_) != sizeof(header)) return false;
+    for (size_t i = 0; i < payload.backing_block_num(); ++i) {
+        size_t blen = 0;
+        const char* data = payload.backing_block_data(i, &blen);
+        if (fwrite(data, 1, blen, f_) != blen) return false;
+    }
+    return true;
+}
+
+void RecordWriter::Flush() {
+    if (f_ != nullptr) fflush(f_);
+}
+
+RecordReader::RecordReader(const std::string& path) {
+    f_ = fopen(path.c_str(), "rb");
+}
+
+RecordReader::~RecordReader() {
+    if (f_ != nullptr) fclose(f_);
+}
+
+bool RecordReader::Read(IOBuf* out) {
+    out->clear();
+    if (f_ == nullptr) return false;
+    char header[12];
+    if (fread(header, 1, sizeof(header), f_) != sizeof(header)) return false;
+    if (memcmp(header, kMagic, 4) != 0) return false;
+    uint32_t len, crc;
+    memcpy(&len, header + 4, 4);
+    memcpy(&crc, header + 8, 4);
+    len = ntohl(len);
+    crc = ntohl(crc);
+    if (len > kMaxRecord) return false;
+    std::vector<char> buf(len);
+    if (len > 0 && fread(buf.data(), 1, len, f_) != len) return false;
+    if (crc32c(buf.data(), len) != crc) return false;
+    out->append(buf.data(), len);
+    return true;
+}
+
+}  // namespace tpurpc

@@ -1,0 +1,47 @@
+// recordio: length-prefixed, crc32c-protected records in a file — the
+// storage format of rpc_dump / rpc_replay.
+//
+// Reference: src/butil/recordio.{h,cc} (record streams used by
+// brpc/rpc_dump.cpp and tools/rpc_replay). Format per record:
+//   "TREC" u32 length u32 crc32c(payload) payload[length]
+// A torn tail (partial final record) or corrupt crc terminates reading
+// cleanly rather than erroring mid-stream.
+#pragma once
+
+#include <cstdio>
+#include <string>
+
+#include "tbase/iobuf.h"
+
+namespace tpurpc {
+
+class RecordWriter {
+public:
+    // Appends to `path`. valid() false if the file cannot be opened.
+    explicit RecordWriter(const std::string& path);
+    ~RecordWriter();
+    bool valid() const { return f_ != nullptr; }
+
+    // Write one record; returns false on IO error.
+    bool Write(const IOBuf& payload);
+    void Flush();
+
+private:
+    FILE* f_ = nullptr;
+};
+
+class RecordReader {
+public:
+    explicit RecordReader(const std::string& path);
+    ~RecordReader();
+    bool valid() const { return f_ != nullptr; }
+
+    // Read the next record into *out (cleared first). Returns false at
+    // EOF, on a torn tail, or on a corrupt record.
+    bool Read(IOBuf* out);
+
+private:
+    FILE* f_ = nullptr;
+};
+
+}  // namespace tpurpc

@@ -473,3 +473,102 @@ TEST(FiberKey, PthreadFallbackOutsideWorkers) {
     EXPECT_EQ((void*)0xabcd, fiber_getspecific(key));
     fiber_key_delete(key);
 }
+
+// ---------------- rwlock + once ----------------
+// Reference: src/bthread/rwlock.cpp (writer-preferring) + bthread_once.
+
+TEST(FiberRWLock, ReadersShareWriterExcludes) {
+    FiberRWLock rw;
+    std::atomic<int> readers_in{0};
+    std::atomic<int> max_readers{0};
+    std::atomic<int64_t> counter{0};
+    std::atomic<bool> writer_saw_exclusive{true};
+
+    struct Ctx {
+        FiberRWLock* rw;
+        std::atomic<int>* readers_in;
+        std::atomic<int>* max_readers;
+        std::atomic<int64_t>* counter;
+        std::atomic<bool>* excl;
+    } ctx{&rw, &readers_in, &max_readers, &counter, &writer_saw_exclusive};
+
+    std::vector<fiber_t> tids;
+    for (int i = 0; i < 6; ++i) {
+        fiber_t tid;
+        fiber_start_background(
+            &tid, nullptr,
+            [](void* arg) -> void* {
+                Ctx* c = (Ctx*)arg;
+                for (int k = 0; k < 40; ++k) {
+                    c->rw->rdlock();
+                    const int in = c->readers_in->fetch_add(1) + 1;
+                    int mx = c->max_readers->load();
+                    while (in > mx &&
+                           !c->max_readers->compare_exchange_weak(mx, in)) {
+                    }
+                    if (in <= 0) c->excl->store(false);
+                    fiber_usleep(500);  // hold: readers must overlap
+                    c->readers_in->fetch_sub(1);
+                    c->rw->rdunlock();
+                }
+                return nullptr;
+            },
+            &ctx);
+        tids.push_back(tid);
+    }
+    for (int i = 0; i < 2; ++i) {
+        fiber_t tid;
+        fiber_start_background(
+            &tid, nullptr,
+            [](void* arg) -> void* {
+                Ctx* c = (Ctx*)arg;
+                for (int k = 0; k < 25; ++k) {
+                    c->rw->wrlock();
+                    // No reader may be inside while the writer holds.
+                    if (c->readers_in->load() != 0) c->excl->store(false);
+                    c->counter->fetch_add(1);
+                    c->rw->wrunlock();
+                }
+                return nullptr;
+            },
+            &ctx);
+        tids.push_back(tid);
+    }
+    for (auto tid : tids) fiber_join(tid, nullptr);
+    EXPECT_TRUE(writer_saw_exclusive.load());
+    EXPECT_EQ(counter.load(), 50);
+    EXPECT_GT(max_readers.load(), 1);  // readers actually overlapped
+}
+
+namespace {
+std::atomic<int> g_once_runs{0};
+void once_fn() {
+    usleep(20000);  // widen the race window
+    g_once_runs.fetch_add(1);
+}
+}  // namespace
+
+TEST(FiberOnce, RunsExactlyOnceAcrossFibers) {
+    FiberOnce once;
+    g_once_runs.store(0);
+    struct Ctx {
+        FiberOnce* once;
+        std::atomic<int> after{0};
+    } ctx{&once, {}};
+    std::vector<fiber_t> tids(8);
+    for (auto& tid : tids) {
+        fiber_start_background(
+            &tid, nullptr,
+            [](void* arg) -> void* {
+                Ctx* c = (Ctx*)arg;
+                c->once->call(once_fn);
+                // By the time call() returns, the fn has completed.
+                if (g_once_runs.load() == 1) c->after.fetch_add(1);
+                return nullptr;
+            },
+            &ctx);
+    }
+    for (auto tid : tids) fiber_join(tid, nullptr);
+    EXPECT_EQ(g_once_runs.load(), 1);
+    EXPECT_EQ(ctx.after.load(), 8);
+}

@@ -103,4 +103,76 @@ int CountdownEvent::wait(const int64_t* abstime_us) {
     }
 }
 
+
+// ---------------- FiberRWLock ----------------
+
+FiberRWLock::FiberRWLock() { state_butex_ = butex_create(); }
+FiberRWLock::~FiberRWLock() { butex_destroy(state_butex_); }
+
+void FiberRWLock::rdlock() {
+    // New readers funnel through writer_mu_: while a writer holds or
+    // waits on it, readers queue behind — writer preference.
+    writer_mu_.lock();
+    std::atomic<int>* w = butex_word(state_butex_);
+    while (true) {
+        int v = w->load(std::memory_order_acquire);
+        if (v >= 0) {
+            if (w->compare_exchange_weak(v, v + 1,
+                                         std::memory_order_acquire)) {
+                break;
+            }
+        } else {
+            butex_wait(state_butex_, v, nullptr);
+        }
+    }
+    writer_mu_.unlock();
+}
+
+void FiberRWLock::rdunlock() {
+    std::atomic<int>* w = butex_word(state_butex_);
+    if (w->fetch_sub(1, std::memory_order_release) == 1) {
+        butex_wake_all(state_butex_);  // last reader: wake a parked writer
+    }
+}
+
+void FiberRWLock::wrlock() {
+    writer_mu_.lock();  // serialize writers AND stop new readers
+    std::atomic<int>* w = butex_word(state_butex_);
+    while (true) {
+        int expected = 0;
+        if (w->compare_exchange_weak(expected, -1,
+                                     std::memory_order_acquire)) {
+            return;  // writer_mu_ stays held until wrunlock
+        }
+        butex_wait(state_butex_, expected, nullptr);
+    }
+}
+
+void FiberRWLock::wrunlock() {
+    butex_word(state_butex_)->store(0, std::memory_order_release);
+    butex_wake_all(state_butex_);
+    writer_mu_.unlock();
+}
+
+// ---------------- FiberOnce ----------------
+
+FiberOnce::FiberOnce() { butex_ = butex_create(); }
+FiberOnce::~FiberOnce() { butex_destroy(butex_); }
+
+void FiberOnce::call(void (*fn)()) {
+    std::atomic<int>* w = butex_word(butex_);
+    while (true) {
+        int v = w->load(std::memory_order_acquire);
+        if (v == 2) return;  // done
+        if (v == 0 &&
+            w->compare_exchange_strong(v, 1, std::memory_order_acq_rel)) {
+            fn();
+            w->store(2, std::memory_order_release);
+            butex_wake_all(butex_);
+            return;
+        }
+        if (v == 1) butex_wait(butex_, 1, nullptr);
+    }
+}
+
 }  // namespace tpurpc

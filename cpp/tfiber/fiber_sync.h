@@ -55,6 +55,39 @@ private:
     void* butex_;  // value = notification sequence number
 };
 
+// Writer-preferring reader/writer lock (reference src/bthread/rwlock.cpp):
+// readers share; a waiting writer blocks NEW readers so it can't starve.
+class FiberRWLock {
+public:
+    FiberRWLock();
+    ~FiberRWLock();
+    FiberRWLock(const FiberRWLock&) = delete;
+    FiberRWLock& operator=(const FiberRWLock&) = delete;
+
+    void rdlock();
+    void rdunlock();
+    void wrlock();
+    void wrunlock();
+
+private:
+    // state butex value: number of active readers; -1 = writer holds.
+    void* state_butex_;
+    // serializes writers and blocks new readers while a writer waits.
+    FiberMutex writer_mu_;
+};
+
+// One-time initialization usable from fibers (reference bthread_once):
+// concurrent callers block until the first caller's fn completes.
+class FiberOnce {
+public:
+    FiberOnce();
+    ~FiberOnce();
+    void call(void (*fn)());
+
+private:
+    void* butex_;  // 0 = not run, 1 = running, 2 = done
+};
+
 class CountdownEvent {
 public:
     explicit CountdownEvent(int initial = 1);

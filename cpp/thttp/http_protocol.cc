@@ -43,6 +43,17 @@ ParseResult ParseHttp(IOBuf* source, Socket* s, bool read_eof, const void*) {
     return ParseResult::make_ok(msg);
 }
 
+// Error strings embedded in json bodies: strip characters that would
+// break the syntax (quotes, backslashes, control bytes).
+static std::string json_safe_text(std::string s) {
+    for (char& ch : s) {
+        if (ch == '"' || ch == '\\' || (unsigned char)ch < 0x20) {
+            ch = ' ';
+        }
+    }
+    return s;
+}
+
 // HTTP-as-RPC: POST /Service/Method with an application/json body is
 // transcoded to the pb service and answered as json (reference
 // policy/http_rpc_protocol.cpp:1790 + src/json2pb). Runs synchronously on
@@ -73,21 +84,24 @@ bool DispatchHttpRpc(Server* server, const HttpRequest& req,
         mp->service->GetResponsePrototype(mp->method).New());
     Controller cntl;
     cntl.InitServerSide(server, remote_side);
+    if (server->options().interceptor != nullptr) {
+        int ierr = 0;
+        std::string ietext;
+        if (!server->options().interceptor->Accept(&cntl, &ierr, &ietext)) {
+            res->status = 403;
+            res->Append("{\"error\":\"" +
+                        (ietext.empty() ? std::string("rejected")
+                                        : json_safe_text(ietext)) +
+                        "\"}\n");
+            guard.Finish(ierr != 0 ? ierr : 403);
+            return true;
+        }
+    }
     std::string err;
     const std::string body = req.body.to_string();
-    // Error strings get embedded in a json body: strip the characters
-    // that would break its syntax.
-    auto json_safe = [](std::string s) {
-        for (char& ch : s) {
-            if (ch == '"' || ch == '\\' || (unsigned char)ch < 0x20) {
-                ch = ' ';
-            }
-        }
-        return s;
-    };
     if (!body.empty() && !JsonToPb(body, pb_req.get(), &err)) {
         res->status = 400;
-        res->Append("{\"error\":\"bad request json: " + json_safe(err) +
+        res->Append("{\"error\":\"bad request json: " + json_safe_text(err) +
                     "\"}\n");
     } else {
         // Await the done-closure (handlers may complete asynchronously).
@@ -102,7 +116,7 @@ bool DispatchHttpRpc(Server* server, const HttpRequest& req,
         done_ev.wait();
         if (cntl.Failed()) {
             res->status = 500;
-            res->Append("{\"error\":\"" + json_safe(cntl.ErrorText()) +
+            res->Append("{\"error\":\"" + json_safe_text(cntl.ErrorText()) +
                         "\"}\n");
         } else {
             std::string json;

@@ -8,6 +8,8 @@
 #include <unistd.h>
 
 #include <cerrno>
+#include <cstring>
+#include <string>
 
 #include "tbase/errno.h"
 #include "tbase/flags.h"
@@ -26,6 +28,14 @@ DEFINE_int32(socket_send_buffer_size, -1,
              "SO_SNDBUF per connection; -1 = kernel autotune");
 DEFINE_int32(socket_recv_buffer_size, -1,
              "SO_RCVBUF per connection; -1 = kernel autotune");
+// Reference details/health_check.cpp:51-107 OnAppHealthCheckDone: beyond
+// the TCP connect probe, require an APPLICATION-level answer before
+// reviving an isolated server (a listening-but-broken process must stay
+// isolated). Empty disables; servers in this framework always serve
+// /health on their RPC port.
+DEFINE_string(health_check_path, "",
+              "HTTP path probed (expects 200) before reviving a failed "
+              "server; empty = TCP connect probe only");
 
 namespace tpurpc {
 
@@ -156,6 +166,46 @@ static int ProbeConnect(const EndPoint& remote, int timeout_ms) {
     return rc;
 }
 
+// GET `path` and require a 200 within timeout_ms (one short-lived
+// connection; the socket being revived is not touched).
+static bool ProbeHttpHealth(const EndPoint& remote, const std::string& path,
+                            int timeout_ms) {
+    const int fd = ::socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK, 0);
+    if (fd < 0) return false;
+    sockaddr_in addr;
+    endpoint2sockaddr(remote, &addr);
+    int rc = ::connect(fd, (sockaddr*)&addr, sizeof(addr));
+    if (rc != 0 && errno != EINPROGRESS) {
+        close(fd);
+        return false;
+    }
+    pollfd pfd{fd, POLLOUT, 0};
+    if (rc != 0 && ::poll(&pfd, 1, timeout_ms) != 1) {
+        close(fd);
+        return false;
+    }
+    const std::string req =
+        "GET " + path + " HTTP/1.1\r\nHost: hc\r\nConnection: close\r\n\r\n";
+    if (send(fd, req.data(), req.size(), MSG_NOSIGNAL) !=
+        (ssize_t)req.size()) {
+        close(fd);
+        return false;
+    }
+    char buf[256];
+    size_t got = 0;
+    const int64_t deadline = monotonic_time_us() + timeout_ms * 1000;
+    while (got < 12 && monotonic_time_us() < deadline) {
+        pollfd rp{fd, POLLIN, 0};
+        if (::poll(&rp, 1, 50) != 1) continue;
+        const ssize_t r = recv(fd, buf + got, sizeof(buf) - got, 0);
+        if (r <= 0) break;
+        got += (size_t)r;
+    }
+    close(fd);
+    // "HTTP/1.1 200 ..."
+    return got >= 12 && memcmp(buf + 9, "200", 3) == 0;
+}
+
 void Socket::HealthCheckLoop() {
     const int64_t interval_us = (int64_t)health_check_interval_ms_ * 1000;
     // Breaker-tripped sockets stay isolated for a duration that doubles
@@ -173,6 +223,13 @@ void Socket::HealthCheckLoop() {
         // or event fiber can race the connection-state reset below.
         if (nref() > 1) continue;
         if (ProbeConnect(remote_side_, 200) != 0) continue;
+        // App-level probe (reference health_check.cpp:51-107): a process
+        // that accepts TCP but cannot answer stays isolated.
+        const std::string hc_path = FLAGS_health_check_path.get();
+        if (!hc_path.empty() &&
+            !ProbeHttpHealth(remote_side_, hc_path, 500)) {
+            continue;
+        }
         if (ReviveAfterHealthCheck() == 0) {
             // StopHealthCheck may have raced the probe window: a revived
             // socket nobody tracks anymore would leak alive forever. Undo.

@@ -18,6 +18,7 @@
 #include "tbase/crc32c.h"
 #include "trpc/compress.h"
 #include "trpc/pb_compat.h"
+#include "trpc/rpc_dump.h"
 #include "trpc/server.h"
 #include "trpc/span.h"
 #include "trpc/stream.h"
@@ -212,6 +213,12 @@ void SendErrorResponse(SocketId sid, uint64_t cid, int err,
 void ProcessTpuStdRequest(TpuStdMessage* msg, const rpc::RpcMeta& meta) {
     const SocketId sid = msg->socket_id;
     const uint64_t cid = meta.correlation_id();
+    // rpc_dump: capture the raw meta+body of sampled requests (reference
+    // rpc_dump.cpp via the bvar Collector; appending IOBufs only bumps
+    // block refcounts, so the hot path pays two flag/gate loads).
+    if (IsRpcDumpSampled()) {
+        SubmitRpcDump(msg->meta, msg->body);
+    }
     SocketUniquePtr s;
     if (Socket::AddressSocket(sid, &s) != 0) return;
     InputMessenger* m = (InputMessenger*)s->user();
@@ -280,6 +287,23 @@ void ProcessTpuStdRequest(TpuStdMessage* msg, const rpc::RpcMeta& meta) {
     // Controller::request_compress_type); the response defaults to none
     // unless the handler opts in.
     cntl->set_request_compress_type(meta.compress_type());
+    // Interceptor (reference interceptor.h:30 Interceptor::Accept runs
+    // before the service method; rejection answers the error directly).
+    if (server->options().interceptor != nullptr) {
+        int err = 0;
+        std::string etext;
+        if (!server->options().interceptor->Accept(cntl, &err, &etext)) {
+            guard->Finish(err != 0 ? err : TERR_REQUEST);
+            delete guard;
+            delete cntl;
+            delete req;
+            delete res;
+            SendErrorResponse(sid, cid, err != 0 ? err : TERR_REQUEST,
+                              etext.empty() ? "rejected by interceptor"
+                                            : etext);
+            return;
+        }
+    }
     // rpcz: with rpcz locally enabled, an upstream-sampled trace is
     // always continued (skipping the rate gate); otherwise the local gate
     // may start one. A disabled server NEVER allocates spans — peers must

@@ -1,0 +1,151 @@
+#include "trpc/rpc_dump.h"
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cstring>
+#include <mutex>
+
+#include "rpc_meta.pb.h"
+#include "tbase/flags.h"
+#include "tbase/logging.h"
+#include "tbase/recordio.h"
+#include "tbase/time.h"
+#include "trpc/pb_compat.h"
+#include "trpc/policy_tpu_std.h"
+#include "tvar/collector.h"
+
+DEFINE_bool(rpc_dump, false,
+            "sample live requests into -rpc_dump_dir (recordio)");
+DEFINE_string(rpc_dump_dir, "/tmp", "directory for rpc dump files");
+
+namespace tpurpc {
+
+namespace {
+
+// One writer per process, created lazily and re-opened when the live
+// -rpc_dump_dir flag changes (the reference cuts multiple files; one per
+// process per directory is enough here). Guarded by g_dump_mu.
+std::mutex g_dump_mu;
+RecordWriter* dump_writer() {
+    static RecordWriter* w = nullptr;
+    static std::string w_path;
+    const std::string path = RpcDumpFilePath();
+    if (w == nullptr || w_path != path) {
+        delete w;
+        w = new RecordWriter(path);
+        w_path = path;
+    }
+    return w;
+}
+
+struct SampledRequest : public Collected {
+    IOBuf payload;  // u32 meta_len + meta + body
+
+    void dispatch() override {
+        std::lock_guard<std::mutex> g(g_dump_mu);
+        RecordWriter* w = dump_writer();
+        if (w->valid()) {
+            w->Write(payload);
+            w->Flush();
+        }
+    }
+};
+
+}  // namespace
+
+std::string RpcDumpFilePath() {
+    return FLAGS_rpc_dump_dir.get() + "/requests." +
+           std::to_string(getpid()) + ".dump";
+}
+
+bool IsRpcDumpSampled() {
+    return FLAGS_rpc_dump.get() && Collector::singleton()->sample();
+}
+
+void SubmitRpcDump(const IOBuf& meta_bytes, const IOBuf& body) {
+    auto* s = new SampledRequest;
+    const uint32_t mlen = htonl((uint32_t)meta_bytes.size());
+    s->payload.append(&mlen, sizeof(mlen));
+    s->payload.append(meta_bytes);  // refcounted block refs, no copy
+    s->payload.append(body);
+    Collector::singleton()->submit(s);
+}
+
+int ReplayDumpFile(const std::string& path, const EndPoint& server,
+                   int times) {
+    RecordReader probe(path);
+    if (!probe.valid()) return -1;
+
+    const int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (fd < 0) return -1;
+    sockaddr_in addr;
+    endpoint2sockaddr(server, &addr);
+    if (::connect(fd, (sockaddr*)&addr, sizeof(addr)) != 0) {
+        close(fd);
+        return -1;
+    }
+    int ok = 0;
+    uint64_t next_cid = 1;
+    for (int round = 0; round < times; ++round) {
+        RecordReader reader(path);
+        IOBuf rec;
+        while (reader.Read(&rec)) {
+            uint32_t mlen = 0;
+            if (rec.size() < sizeof(mlen)) continue;
+            rec.cutn(&mlen, sizeof(mlen));
+            mlen = ntohl(mlen);
+            if ((size_t)mlen > rec.size()) continue;
+            IOBuf meta_bytes;
+            rec.cutn(&meta_bytes, mlen);
+            rpc::RpcMeta meta;
+            if (!ParsePbFromIOBuf(&meta, meta_bytes)) continue;
+            // Fresh correlation id per send: the recorded one belongs to
+            // a dead RPC (reference rpc_replay rewrites it the same way).
+            meta.set_correlation_id(next_cid++);
+            IOBuf new_meta;
+            SerializePbToIOBuf(meta, &new_meta);
+            IOBuf frame;
+            PackTpuStdFrame(&frame, new_meta, rec, IOBuf());
+            const std::string wire = frame.to_string();
+            if (send(fd, wire.data(), wire.size(), MSG_NOSIGNAL) !=
+                (ssize_t)wire.size()) {
+                close(fd);
+                return ok;
+            }
+            // Await one full response frame (12-byte header + body) and
+            // count it only when the response meta says success.
+            std::string got;
+            char buf[8192];
+            uint32_t body_size = 0, resp_meta_size = 0;
+            while (true) {
+                if (got.size() >= 12) {
+                    memcpy(&body_size, got.data() + 4, 4);
+                    memcpy(&resp_meta_size, got.data() + 8, 4);
+                    body_size = ntohl(body_size);
+                    resp_meta_size = ntohl(resp_meta_size);
+                    if (got.size() >= 12u + body_size) break;
+                }
+                const ssize_t r = recv(fd, buf, sizeof(buf), 0);
+                if (r <= 0) {
+                    close(fd);
+                    return ok;
+                }
+                got.append(buf, (size_t)r);
+            }
+            rpc::RpcMeta resp_meta;
+            if (resp_meta_size <= body_size &&
+                resp_meta.ParseFromArray(got.data() + 12,
+                                         (int)resp_meta_size) &&
+                resp_meta.response().error_code() == 0) {
+                ++ok;
+            }
+        }
+    }
+    close(fd);
+    return ok;
+}
+
+}  // namespace tpurpc

@@ -1,0 +1,36 @@
+// rpc_dump: sampled capture of live requests to recordio files, replayed
+// by tools/rpc_replay (and loadable by tools/rpc_press).
+//
+// Reference: src/brpc/rpc_dump.{h,cpp} (SampledRequest objects ride the
+// bvar Collector's sampling pipeline to a background dumper) +
+// tools/rpc_replay. Enable with the live flag -rpc_dump; files land in
+// -rpc_dump_dir as requests.<pid>.dump. Each record's payload is
+//   u32 meta_len, RpcMeta bytes (the original request meta), body bytes
+// so a replayer can rewrite the correlation id and resend the frame
+// verbatim.
+#pragma once
+
+#include <cstdint>
+#include <string>
+
+#include "tbase/endpoint.h"
+#include "tbase/iobuf.h"
+
+namespace tpurpc {
+
+// Capture hook (server side): called with the parsed request meta bytes +
+// body ONLY when dumping is on and the sampling gate opens. Cheap when
+// off (one flag load).
+bool IsRpcDumpSampled();
+void SubmitRpcDump(const IOBuf& meta_bytes, const IOBuf& body);
+
+// Replay `path` against `server` `times` times over one connection.
+// Returns the number of successful responses, or -1 when the file or the
+// connection is unusable. Used by tools/rpc_replay and tests.
+int ReplayDumpFile(const std::string& path, const EndPoint& server,
+                   int times);
+
+// Where the current process dumps (for tests/tools).
+std::string RpcDumpFilePath();
+
+}  // namespace tpurpc

@@ -39,6 +39,16 @@ struct MethodStatus {
     }
 };
 
+// Server-side admission hook running before user code (reference
+// src/brpc/interceptor.h:30): return false to reject the call with
+// `error_code`/`error_text` (e.g. auth, quota, request screening).
+class Interceptor {
+public:
+    virtual ~Interceptor() = default;
+    virtual bool Accept(const class Controller* cntl, int* error_code,
+                        std::string* error_text) = 0;
+};
+
 struct ServerOptions {
     // Constant per-method concurrency cap; 0 = unlimited. Ignored when
     // auto_concurrency is set.
@@ -56,6 +66,8 @@ struct ServerOptions {
     // (reference never lets user code block the input path —
     // baidu_rpc_protocol.cpp:758,839-849, details/usercode_backup_pool.h).
     bool usercode_inline = false;
+    // Not owned; must outlive the server. Null = accept everything.
+    Interceptor* interceptor = nullptr;
 };
 
 class Server {

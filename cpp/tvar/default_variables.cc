@@ -76,7 +76,8 @@ int64_t CountFds() {
     int64_t n = 0;
     while (readdir(d) != nullptr) ++n;
     closedir(d);
-    return n > 2 ? n - 2 : 0;  // drop "." and ".."
+    // Drop ".", ".." and the opendir() handle itself.
+    return n > 3 ? n - 3 : 0;
 }
 
 bool ReadProcIo(int64_t* read_bytes, int64_t* write_bytes) {
@@ -114,7 +115,7 @@ struct Gauge : public Variable {
 // One /proc read shared by all gauges of a scrape (reference
 // CachedReader): values within a dump stay mutually consistent and a
 // 9-gauge scrape does 2 file opens, not 7.
-const ProcStat& cached_stat() {
+ProcStat cached_stat() {
     static std::mutex mu;
     static ProcStat cached;
     static int64_t read_at_us = -1;
@@ -132,7 +133,7 @@ struct ProcIo {
     int64_t read_bytes = 0;
     int64_t write_bytes = 0;
 };
-const ProcIo& cached_io() {
+ProcIo cached_io() {
     static std::mutex mu;
     static ProcIo cached;
     static int64_t read_at_us = -1;

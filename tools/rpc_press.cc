@@ -1,0 +1,161 @@
+// rpc_press: target-QPS load generator (reference tools/rpc_press — we
+// drive the echo fixture service rather than dynamically-loaded protos;
+// the token-bucket pacing and latency reporting match the reference's
+// rdma_performance client.cpp:50-68).
+//
+//   rpc_press --server=ip:port [--qps=10000] [--duration_s=10]
+//             [--payload=4096] [--callers=8] [--pooled]
+//
+// Prints qps achieved + latency percentiles; --json for one JSON line.
+#include <unistd.h>
+
+#include <atomic>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "bench_echo.pb.h"
+#include "tbase/endpoint.h"
+#include "tbase/time.h"
+#include "tfiber/fiber.h"
+#include "trpc/channel.h"
+#include "trpc/controller.h"
+#include "tvar/latency_recorder.h"
+
+using namespace tpurpc;
+
+namespace {
+
+struct PressCtx {
+    benchpb::EchoService_Stub* stub;
+    LatencyRecorder* lat;
+    std::atomic<int64_t>* tokens;
+    std::atomic<bool>* stop;
+    std::atomic<int64_t>* sent;
+    std::atomic<int64_t>* failed;
+    IOBuf* filler;
+};
+
+void* PressCaller(void* arg) {
+    auto* c = (PressCtx*)arg;
+    while (!c->stop->load(std::memory_order_relaxed)) {
+        // Token bucket: each call consumes one token (reference
+        // rdma_performance client.cpp:68).
+        if (c->tokens->fetch_sub(1, std::memory_order_relaxed) <= 0) {
+            c->tokens->fetch_add(1, std::memory_order_relaxed);
+            fiber_usleep(200);
+            continue;
+        }
+        Controller cntl;
+        cntl.set_timeout_ms(5000);
+        benchpb::EchoRequest req;
+        benchpb::EchoResponse res;
+        req.set_send_ts_us(monotonic_time_us());
+        cntl.request_attachment().append(*c->filler);
+        c->stub->Echo(&cntl, &req, &res, nullptr);
+        if (cntl.Failed()) {
+            c->failed->fetch_add(1, std::memory_order_relaxed);
+        } else {
+            *c->lat << (monotonic_time_us() - res.send_ts_us());
+            c->sent->fetch_add(1, std::memory_order_relaxed);
+        }
+    }
+    return nullptr;
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+    std::string server_str;
+    long long qps = 10000;
+    int duration_s = 10;
+    int payload = 4096;
+    int callers = 8;
+    bool pooled = false;
+    bool json = false;
+    for (int i = 1; i < argc; ++i) {
+        if (strncmp(argv[i], "--server=", 9) == 0) server_str = argv[i] + 9;
+        if (strncmp(argv[i], "--qps=", 6) == 0) qps = atoll(argv[i] + 6);
+        if (strncmp(argv[i], "--duration_s=", 13) == 0) {
+            duration_s = atoi(argv[i] + 13);
+        }
+        if (strncmp(argv[i], "--payload=", 10) == 0) {
+            payload = atoi(argv[i] + 10);
+        }
+        if (strncmp(argv[i], "--callers=", 10) == 0) {
+            callers = atoi(argv[i] + 10);
+        }
+        if (strcmp(argv[i], "--pooled") == 0) pooled = true;
+        if (strcmp(argv[i], "--json") == 0) json = true;
+    }
+    if (server_str.empty()) {
+        fprintf(stderr,
+                "usage: rpc_press --server=ip:port [--qps=N] "
+                "[--duration_s=N] [--payload=N] [--callers=N] [--pooled] "
+                "[--json]\n");
+        return 1;
+    }
+    EndPoint server;
+    if (hostname2endpoint(server_str.c_str(), &server) != 0) {
+        fprintf(stderr, "bad server address: %s\n", server_str.c_str());
+        return 1;
+    }
+    Channel channel;
+    ChannelOptions copts;
+    copts.timeout_ms = 5000;
+    if (pooled) copts.connection_type = CONNECTION_TYPE_POOLED;
+    if (channel.Init(server, &copts) != 0) return 1;
+    benchpb::EchoService_Stub stub(&channel);
+
+    IOBuf filler;
+    filler.append(std::string((size_t)payload, 'p'));
+    LatencyRecorder lat;
+    std::atomic<int64_t> tokens{0};
+    std::atomic<bool> stop{false};
+    std::atomic<int64_t> sent{0};
+    std::atomic<int64_t> failed{0};
+    PressCtx ctx{&stub, &lat, &tokens, &stop, &sent, &failed, &filler};
+    std::vector<fiber_t> tids((size_t)callers);
+    for (auto& tid : tids) {
+        fiber_start_background(&tid, nullptr, PressCaller, &ctx);
+    }
+
+    // Refill the bucket in 10ms slices for the run duration.
+    const int64_t t0 = monotonic_time_us();
+    const int64_t end = t0 + (int64_t)duration_s * 1000 * 1000;
+    while (monotonic_time_us() < end) {
+        tokens.fetch_add(qps / 100 + 1, std::memory_order_relaxed);
+        // Cap the bucket to one second of budget (bursts after stalls).
+        int64_t cur = tokens.load(std::memory_order_relaxed);
+        if (cur > qps) {
+            tokens.fetch_sub(cur - qps, std::memory_order_relaxed);
+        }
+        usleep(10 * 1000);
+    }
+    stop.store(true, std::memory_order_relaxed);
+    for (auto tid : tids) fiber_join(tid, nullptr);
+    const double secs = (double)(monotonic_time_us() - t0) / 1e6;
+    const double achieved = (double)sent.load() / secs;
+    if (json) {
+        printf("{\"press_qps\": %.0f, \"press_target_qps\": %lld, "
+               "\"press_failed\": %lld, \"press_p50_us\": %lld, "
+               "\"press_p99_us\": %lld, \"press_p999_us\": %lld}\n",
+               achieved, qps, (long long)failed.load(),
+               (long long)lat.latency_percentile(0.5),
+               (long long)lat.latency_percentile(0.99),
+               (long long)lat.latency_percentile(0.999));
+    } else {
+        printf("sent %lld ok (%lld failed) in %.1fs: %.0f qps "
+               "(target %lld)\n",
+               (long long)sent.load(), (long long)failed.load(), secs,
+               achieved, qps);
+        printf("latency_us: p50 %lld  p99 %lld  p999 %lld  max %lld\n",
+               (long long)lat.latency_percentile(0.5),
+               (long long)lat.latency_percentile(0.99),
+               (long long)lat.latency_percentile(0.999),
+               (long long)lat.max_latency());
+    }
+    return 0;
+}

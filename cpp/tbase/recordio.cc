@@ -24,6 +24,11 @@ RecordWriter::~RecordWriter() {
 
 bool RecordWriter::Write(const IOBuf& payload) {
     if (f_ == nullptr) return false;
+    if (payload.size() > kMaxRecord) {
+        // Reject at write time: an oversized record would be accepted
+        // here but permanently truncate the stream on read.
+        return false;
+    }
     char header[12];
     memcpy(header, kMagic, 4);
     const uint32_t len = htonl((uint32_t)payload.size());

@@ -519,3 +519,91 @@ TEST(CircuitBreakerIntegration, IsolatesFailingServer) {
     healthy_srv.Stop();
     flaky_srv.Stop();
 }
+
+// ---------------- cluster recovery ----------------
+// Reference: cluster_recover_policy.{h,cpp} — after ALL servers go down,
+// traffic is gated while revivals trickle in (accept probability
+// usable/min_working), and flows fully once the usable count is stable.
+
+DECLARE_int32(cluster_recover_min_working_instances);
+DECLARE_int32(cluster_recover_hold_ms);
+
+TEST(ClusterRecovery, GatesTrafficWhileClusterRefills) {
+    FLAGS_ns_health_check_interval_ms.set(100);
+    FLAGS_cluster_recover_min_working_instances.set(2);
+    FLAGS_cluster_recover_hold_ms.set(400);
+    struct FlagsRestore {
+        ~FlagsRestore() {
+            FLAGS_cluster_recover_min_working_instances.set(0);
+            FLAGS_cluster_recover_hold_ms.set(1000);
+            FLAGS_ns_health_check_interval_ms.set(1000);
+        }
+    } restore;
+
+    // Two servers; both die; one comes back.
+    auto s1 = std::make_unique<TestServer>();
+    auto s2 = std::make_unique<TestServer>();
+    ASSERT_TRUE(s1->start());
+    ASSERT_TRUE(s2->start());
+    const EndPoint ep1 = s1->ep;
+    char url[128];
+    snprintf(url, sizeof(url), "list://%s,%s", endpoint2str(s1->ep).c_str(),
+             endpoint2str(s2->ep).c_str());
+    Channel ch;
+    ChannelOptions opts;
+    opts.timeout_ms = 1000;
+    opts.max_retry = 0;
+    ASSERT_EQ(0, ch.Init(url, "rr", &opts));
+    EXPECT_EQ(0, call_echo(&ch, "warm"));
+
+    s1.reset();
+    s2.reset();
+    // Drive calls until the LB notices both are gone (recovery arms).
+    for (int i = 0; i < 50; ++i) {
+        if (call_echo(&ch, "down") != 0) break;
+        usleep(10000);
+    }
+    int failed_while_down = 0;
+    for (int i = 0; i < 5; ++i) {
+        if (call_echo(&ch, "down") != 0) ++failed_while_down;
+    }
+    EXPECT_EQ(failed_while_down, 5);
+
+    // Revive ONE server on the same port: while recovering with
+    // usable=1 < min_working=2, roughly half the calls are gated.
+    TestServer revived;
+    ASSERT_EQ(0, revived.server.AddService(&revived.service));
+    ASSERT_EQ(0, revived.server.Start(ep1, nullptr));
+    // Wait for the health checker to revive the socket.
+    int first_ok = -1;
+    for (int i = 0; i < 100; ++i) {
+        if (call_echo(&ch, "probe") == 0) {
+            first_ok = i;
+            break;
+        }
+        usleep(20000);
+    }
+    ASSERT_GE(first_ok, 0);
+    int ok = 0, gated = 0;
+    for (int i = 0; i < 40; ++i) {
+        if (call_echo(&ch, "recovering") == 0) {
+            ++ok;
+        } else {
+            ++gated;
+        }
+    }
+    // Both outcomes must appear (accept probability = 1/2 per call).
+    EXPECT_GT(ok, 0);
+    EXPECT_GT(gated, 0);
+
+    // After the hold period with a stable usable count, the gate lifts.
+    usleep(600 * 1000);
+    for (int i = 0; i < 10 && call_echo(&ch, "post") != 0; ++i) {
+        usleep(50 * 1000);  // consume the stability check
+    }
+    int post_ok = 0;
+    for (int i = 0; i < 10; ++i) {
+        if (call_echo(&ch, "post") == 0) ++post_ok;
+    }
+    EXPECT_EQ(post_ok, 10);
+}

@@ -145,3 +145,42 @@ TEST(ProcessVars, ExposeAndRead) {
     ASSERT_TRUE(Variable::describe_exposed("process_cpu_user_ms", &v));
     EXPECT_GE(atoll(v.c_str()), 0);
 }
+
+// ---------------- labelled metrics ----------------
+// Reference: src/bvar/multi_dimension* — label-tuple-keyed series with
+// prometheus exposition.
+
+#include "tvar/multi_dimension.h"
+
+TEST(MultiDimension, SeriesAndPrometheusText) {
+    LabelledMetric<Adder<int64_t>> requests("test_requests_total",
+                                            {"method", "status"});
+    *requests.get_stats({"Echo", "ok"}) << 3;
+    *requests.get_stats({"Echo", "ok"}) << 2;
+    *requests.get_stats({"Echo", "error"}) << 1;
+    *requests.get_stats({"Stats", "ok"}) << 7;
+    EXPECT_EQ(requests.count_stats(), 3u);
+
+    const std::string text =
+        requests.prometheus_text("test_requests_total");
+    EXPECT_TRUE(text.find("test_requests_total{method=\"Echo\","
+                          "status=\"ok\"} 5") != std::string::npos)
+        << text;
+    EXPECT_TRUE(text.find("test_requests_total{method=\"Echo\","
+                          "status=\"error\"} 1") != std::string::npos);
+    EXPECT_TRUE(text.find("test_requests_total{method=\"Stats\","
+                          "status=\"ok\"} 7") != std::string::npos);
+
+    // Registered: the global /metrics dump includes the series.
+    const std::string all = DumpLabelledMetrics();
+    EXPECT_TRUE(all.find("test_requests_total{method=\"Stats\"") !=
+                std::string::npos);
+
+    // Series removal.
+    requests.delete_stats({"Stats", "ok"});
+    EXPECT_EQ(requests.count_stats(), 2u);
+
+    // /vars description lists series.
+    const std::string desc = requests.get_description();
+    EXPECT_TRUE(desc.find("2 series") != std::string::npos);
+}

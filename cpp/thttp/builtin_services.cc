@@ -11,6 +11,7 @@
 #include "tnet/socket.h"
 #include "trpc/server.h"
 #include "trpc/span.h"
+#include "tvar/multi_dimension.h"
 #include "tvar/variable.h"
 
 namespace tpurpc {
@@ -161,6 +162,8 @@ bool is_number(const std::string& s) {
 
 void HandleMetrics(Server*, const HttpRequest&, HttpResponse* res) {
     res->set_content_type("text/plain; version=0.0.4");
+    // Labelled series first (reference multi_dimension -> /brpc_metrics).
+    res->Append(DumpLabelledMetrics());
     for (const auto& kv : Variable::dump_exposed()) {
         const std::string& value = kv.second;
         const std::string name = sanitize_metric_name(kv.first);

@@ -222,12 +222,14 @@ void Socket::HealthCheckLoop() {
         // Only probe/revive once every other ref is gone: then no KeepWrite
         // or event fiber can race the connection-state reset below.
         if (nref() > 1) continue;
-        if (ProbeConnect(remote_side_, 200) != 0) continue;
-        // App-level probe (reference health_check.cpp:51-107): a process
-        // that accepts TCP but cannot answer stays isolated.
+        // App-level probe (reference health_check.cpp:51-107) subsumes
+        // the TCP connect probe — a process that accepts TCP but cannot
+        // answer stays isolated; without a configured path, the connect
+        // probe alone gates revival.
         const std::string hc_path = FLAGS_health_check_path.get();
-        if (!hc_path.empty() &&
-            !ProbeHttpHealth(remote_side_, hc_path, 500)) {
+        if (hc_path.empty()) {
+            if (ProbeConnect(remote_side_, 200) != 0) continue;
+        } else if (!ProbeHttpHealth(remote_side_, hc_path, 500)) {
             continue;
         }
         if (ReviveAfterHealthCheck() == 0) {

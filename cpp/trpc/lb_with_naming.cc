@@ -2,6 +2,7 @@
 
 #include <unordered_map>
 
+#include "tbase/fast_rand.h"
 #include "tbase/flags.h"
 #include "tbase/logging.h"
 #include "tbase/time.h"
@@ -12,6 +13,14 @@
 DEFINE_int32(ns_health_check_interval_ms, 1000,
              "Failed naming-resolved servers are probed this often and "
              "revived in place (0 disables)");
+// Reference cluster_recover_policy.cpp DefaultClusterRecoverPolicy: gate
+// traffic while a fully-down cluster revives one server at a time.
+DEFINE_int32(cluster_recover_min_working_instances, 0,
+             "enable cluster-recovery gating: while recovering, accept "
+             "with probability usable/this (0 disables)");
+DEFINE_int32(cluster_recover_hold_ms, 1000,
+             "recovery ends once the usable-server count has been stable "
+             "this long");
 
 namespace tpurpc {
 
@@ -201,6 +210,73 @@ void LoadBalancerWithNaming::OnServersChanged(
     const std::vector<SocketId>& removed) {
     if (!added.empty()) lb_->AddServersInBatch(added);
     if (!removed.empty()) lb_->RemoveServersInBatch(removed);
+    std::lock_guard<std::mutex> g(servers_mu_);
+    for (const ServerNode& s : added) server_ids_.push_back(s.id);
+    for (SocketId id : removed) {
+        for (size_t i = 0; i < server_ids_.size(); ++i) {
+            if (server_ids_[i] == id) {
+                server_ids_[i] = server_ids_.back();
+                server_ids_.pop_back();
+                break;
+            }
+        }
+    }
+}
+
+size_t LoadBalancerWithNaming::CountUsableServers() {
+    std::lock_guard<std::mutex> g(servers_mu_);
+    size_t usable = 0;
+    for (SocketId id : server_ids_) {
+        Socket* s = Socket::Address(id);
+        if (s != nullptr) {
+            s->Dereference();
+            ++usable;
+        }
+    }
+    return usable;
+}
+
+bool LoadBalancerWithNaming::RejectedByClusterRecovery() {
+    const int min_working =
+        FLAGS_cluster_recover_min_working_instances.get();
+    if (min_working <= 0 || !recovering_.load(std::memory_order_acquire)) {
+        return false;
+    }
+    const size_t usable = CountUsableServers();
+    {
+        std::lock_guard<std::mutex> g(recover_mu_);
+        const int64_t now = monotonic_time_us();
+        if (usable != last_usable_) {
+            last_usable_ = usable;
+            last_usable_change_us_ = now;
+        } else if (usable > 0 && last_usable_change_us_ != 0 &&
+                   now - last_usable_change_us_ >
+                       (int64_t)FLAGS_cluster_recover_hold_ms.get() * 1000) {
+            // Usable set stable long enough: the cluster has recovered.
+            recovering_.store(false, std::memory_order_release);
+            last_usable_ = 0;
+            last_usable_change_us_ = 0;
+            return false;
+        }
+    }
+    // Accept with probability usable/min_working (reference DoReject).
+    if (usable >= (size_t)min_working) return false;
+    return fast_rand_less_than((uint64_t)min_working) >= usable;
+}
+
+int LoadBalancerWithNaming::SelectServer(const SelectIn& in,
+                                         SelectOut* out) {
+    if (RejectedByClusterRecovery()) {
+        return EHOSTDOWN;  // held back while the cluster refills
+    }
+    const int rc = lb_->SelectServer(in, out);
+    if ((rc == EHOSTDOWN || rc == ENODATA) &&
+        FLAGS_cluster_recover_min_working_instances.get() > 0) {
+        // Every server is down: revivals trickle in one by one — start
+        // gating so the first one back is not crushed.
+        recovering_.store(true, std::memory_order_release);
+    }
+    return rc;
 }
 
 }  // namespace tpurpc

@@ -8,6 +8,7 @@
 #pragma once
 
 #include <map>
+#include <atomic>
 #include <memory>
 #include <mutex>
 #include <set>
@@ -79,9 +80,7 @@ public:
     // Returns 0 on success (unknown scheme/LB name: -1).
     int Init(const std::string& naming_url, const std::string& lb_name);
 
-    int SelectServer(const SelectIn& in, SelectOut* out) {
-        return lb_->SelectServer(in, out);
-    }
+    int SelectServer(const SelectIn& in, SelectOut* out);
     void Feedback(const LoadBalancer::CallInfo& info) {
         lb_->Feedback(info);
     }
@@ -91,8 +90,24 @@ public:
                           const std::vector<SocketId>& removed) override;
 
 private:
+    // Cluster recovery gating (reference cluster_recover_policy.{h,cpp}
+    // DefaultClusterRecoverPolicy): after ALL servers went down, servers
+    // revive one by one — sending the whole cluster's load to the first
+    // revived instance would knock it down again (circuit breaker) and
+    // the cluster could flap forever. While "recovering", a request is
+    // accepted with probability usable/min_working; recovery ends once
+    // the usable count has been stable for the hold period.
+    size_t CountUsableServers();
+    bool RejectedByClusterRecovery();
+
     std::unique_ptr<LoadBalancer> lb_;
     std::shared_ptr<NamingServiceThread> ns_thread_;
+    std::mutex servers_mu_;
+    std::vector<SocketId> server_ids_;  // mirror for usable counting
+    std::atomic<bool> recovering_{false};
+    std::mutex recover_mu_;
+    size_t last_usable_ = 0;
+    int64_t last_usable_change_us_ = 0;
 };
 
 }  // namespace tpurpc

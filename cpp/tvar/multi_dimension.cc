@@ -1,0 +1,54 @@
+#include "tvar/multi_dimension.h"
+
+#include <cstdlib>
+
+namespace tpurpc {
+
+namespace multi_dim_detail {
+
+bool numeric(const std::string& s) {
+    char* end = nullptr;
+    strtod(s.c_str(), &end);
+    return end != s.c_str() && *end == '\0' && !s.empty();
+}
+
+}  // namespace multi_dim_detail
+
+namespace {
+
+struct LabelledRegistry {
+    std::mutex mu;
+    std::map<std::string, MultiDimensionBase*> metrics;
+};
+LabelledRegistry* lreg() {
+    static LabelledRegistry* r = new LabelledRegistry;
+    return r;
+}
+
+}  // namespace
+
+void RegisterLabelledMetric(const std::string& name,
+                            MultiDimensionBase* m) {
+    std::lock_guard<std::mutex> g(lreg()->mu);
+    lreg()->metrics[name] = m;
+}
+
+void UnregisterLabelledMetric(const std::string& name) {
+    std::lock_guard<std::mutex> g(lreg()->mu);
+    lreg()->metrics.erase(name);
+}
+
+std::string DumpLabelledMetrics() {
+    std::map<std::string, MultiDimensionBase*> snapshot;
+    {
+        std::lock_guard<std::mutex> g(lreg()->mu);
+        snapshot = lreg()->metrics;
+    }
+    std::string out;
+    for (const auto& kv : snapshot) {
+        out += kv.second->prometheus_text(kv.first);
+    }
+    return out;
+}
+
+}  // namespace tpurpc

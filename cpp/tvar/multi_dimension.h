@@ -1,0 +1,161 @@
+// MultiDimension<T>: labelled metrics — a map of label-value tuples to an
+// underlying variable, exported to prometheus with label sets.
+//
+// Reference: src/bvar/multi_dimension{.h,_inl.h} (MultiDimension<bvar::T>
+// keyed by a label list, exposed through /brpc_metrics with
+// {label="value"} series). T is any Variable-like with get_description()
+// returning a number (Adder<int64_t>, LatencyRecorder, ...).
+#pragma once
+
+#include <map>
+#include <memory>
+#include <mutex>
+#include <sstream>
+#include <string>
+#include <vector>
+
+#include "tvar/variable.h"
+
+namespace tpurpc {
+
+namespace multi_dim_detail {
+bool numeric(const std::string& s);
+}  // namespace multi_dim_detail
+
+template <typename T>
+class MultiDimension : public Variable {
+public:
+    // labels: the dimension NAMES, fixed at construction
+    // (e.g. {"method", "peer"}).
+    explicit MultiDimension(std::vector<std::string> labels)
+        : labels_(std::move(labels)) {}
+    ~MultiDimension() override { hide(); }
+
+    // The stat for one label-value tuple (created on first use). The
+    // returned pointer lives as long as this MultiDimension.
+    T* get_stats(const std::vector<std::string>& values) {
+        std::lock_guard<std::mutex> g(mu_);
+        auto it = stats_.find(values);
+        if (it == stats_.end()) {
+            it = stats_.emplace(values, std::make_unique<T>()).first;
+        }
+        return it->second.get();
+    }
+
+    // Remove one series (e.g. a departed peer).
+    void delete_stats(const std::vector<std::string>& values) {
+        std::lock_guard<std::mutex> g(mu_);
+        stats_.erase(values);
+    }
+
+    size_t count_stats() const {
+        std::lock_guard<std::mutex> g(mu_);
+        return stats_.size();
+    }
+
+    const std::vector<std::string>& labels() const { return labels_; }
+
+    // /vars rendering: one line per series.
+    std::string get_description() const override {
+        std::ostringstream os;
+        std::lock_guard<std::mutex> g(mu_);
+        os << stats_.size() << " series";
+        for (const auto& kv : stats_) {
+            os << "\n  {" << label_pairs(kv.first)
+               << "} : " << kv.second->get_description();
+        }
+        return os.str();
+    }
+
+    // Prometheus exposition: name{l1="v1",...} value — one line per
+    // series whose description is numeric; composite descriptions (json
+    // objects) expand per field as name_field{labels}, the same scheme
+    // the /metrics handler uses for unlabelled composite vars.
+    std::string prometheus_text(const std::string& name) const {
+        std::ostringstream os;
+        std::lock_guard<std::mutex> g(mu_);
+        bool typed = false;
+        for (const auto& kv : stats_) {
+            const std::string value = kv.second->get_description();
+            const std::string lp = label_pairs(kv.first);
+            if (multi_dim_detail::numeric(value)) {
+                if (!typed) {
+                    os << "# TYPE " << name << " gauge\n";
+                    typed = true;
+                }
+                os << name << "{" << lp << "} " << value << "\n";
+                continue;
+            }
+            if (value.size() < 2 || value[0] != '{') continue;
+            size_t pos = 1;
+            while (pos < value.size()) {
+                const size_t kstart = value.find('"', pos);
+                if (kstart == std::string::npos) break;
+                const size_t kend = value.find('"', kstart + 1);
+                if (kend == std::string::npos) break;
+                const size_t colon = value.find(':', kend);
+                if (colon == std::string::npos) break;
+                size_t vend = value.find_first_of(",}", colon);
+                if (vend == std::string::npos) vend = value.size();
+                const std::string field =
+                    value.substr(kstart + 1, kend - kstart - 1);
+                const std::string fval =
+                    value.substr(colon + 1, vend - colon - 1);
+                if (multi_dim_detail::numeric(fval)) {
+                    os << name << "_" << field << "{" << lp << "} " << fval
+                       << "\n";
+                }
+                pos = vend + 1;
+            }
+        }
+        return os.str();
+    }
+
+private:
+    std::string label_pairs(const std::vector<std::string>& values) const {
+        std::ostringstream os;
+        for (size_t i = 0; i < labels_.size() && i < values.size(); ++i) {
+            if (i > 0) os << ",";
+            os << labels_[i] << "=\"" << values[i] << "\"";
+        }
+        return os.str();
+    }
+
+    std::vector<std::string> labels_;
+    mutable std::mutex mu_;
+    std::map<std::vector<std::string>, std::unique_ptr<T>> stats_;
+};
+
+// Registry of MultiDimension instances for the /metrics exporter (plain
+// Variables render through get_description; labelled ones need the
+// per-series exposition).
+class MultiDimensionBase {
+public:
+    virtual ~MultiDimensionBase() = default;
+    virtual std::string prometheus_text(const std::string& name) const = 0;
+};
+
+void RegisterLabelledMetric(const std::string& name, MultiDimensionBase* m);
+void UnregisterLabelledMetric(const std::string& name);
+// All registered labelled metrics rendered for /metrics.
+std::string DumpLabelledMetrics();
+
+template <typename T>
+class LabelledMetric : public MultiDimension<T>, public MultiDimensionBase {
+public:
+    LabelledMetric(const std::string& name, std::vector<std::string> labels)
+        : MultiDimension<T>(std::move(labels)), name_(name) {
+        this->expose(name);
+        RegisterLabelledMetric(name, this);
+    }
+    ~LabelledMetric() override { UnregisterLabelledMetric(name_); }
+
+    std::string prometheus_text(const std::string& name) const override {
+        return MultiDimension<T>::prometheus_text(name);
+    }
+
+private:
+    std::string name_;
+};
+
+}  // namespace tpurpc

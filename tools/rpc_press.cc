@@ -122,12 +122,19 @@ int main(int argc, char** argv) {
         fiber_start_background(&tid, nullptr, PressCaller, &ctx);
     }
 
-    // Refill the bucket in 10ms slices for the run duration.
+    // Refill by elapsed time (exact pacing for any target, including
+    // qps below the 100Hz refill cadence), bucket capped at one second
+    // of budget so stalls don't cause unbounded bursts.
     const int64_t t0 = monotonic_time_us();
     const int64_t end = t0 + (int64_t)duration_s * 1000 * 1000;
+    int64_t granted = 0;
     while (monotonic_time_us() < end) {
-        tokens.fetch_add(qps / 100 + 1, std::memory_order_relaxed);
-        // Cap the bucket to one second of budget (bursts after stalls).
+        const int64_t now = monotonic_time_us();
+        const int64_t should = (now - t0) * qps / 1000000;
+        if (should > granted) {
+            tokens.fetch_add(should - granted, std::memory_order_relaxed);
+            granted = should;
+        }
         int64_t cur = tokens.load(std::memory_order_relaxed);
         if (cur > qps) {
             tokens.fetch_sub(cur - qps, std::memory_order_relaxed);

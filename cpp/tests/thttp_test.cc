@@ -247,6 +247,11 @@ TEST(HttpPortal, LivePortalOverTcp) {
     const std::string vars = fetch("GET /vars HTTP/1.1\r\nHost: x\r\n\r\n");
     EXPECT_TRUE(vars.find("200 OK") != std::string::npos);
 
+    const std::string fibers =
+        fetch("GET /fibers HTTP/1.1\r\nHost: x\r\n\r\n");
+    EXPECT_TRUE(fibers.find("workers: ") != std::string::npos);
+    EXPECT_TRUE(fibers.find("live_fibers: ") != std::string::npos);
+
     const std::string missing =
         fetch("GET /definitely-not-there HTTP/1.1\r\n\r\n");
     EXPECT_TRUE(missing.find("404") != std::string::npos);
@@ -524,4 +529,119 @@ TEST(HttpRpc, JsonEchoRoundTrip) {
     // The per-method stats saw the calls.
     const std::string status = http_get(port, "/status");
     EXPECT_TRUE(status.find("benchpb.EchoService.Echo") != std::string::npos);
+}
+
+// ---------------- HPACK (RFC 7541 Appendix C vectors) ----------------
+
+#include "thttp/hpack.h"
+
+TEST(Hpack, HuffmanDecodeRfcVectors) {
+    // C.4.1: "www.example.com"
+    const uint8_t v1[] = {0xf1, 0xe3, 0xc2, 0xe5, 0xf2, 0x3a, 0x6b,
+                          0xa0, 0xab, 0x90, 0xf4, 0xff};
+    std::string out;
+    ASSERT_TRUE(HpackHuffmanDecode(v1, sizeof(v1), &out));
+    EXPECT_EQ(out, "www.example.com");
+    // C.4.2: "no-cache"
+    const uint8_t v2[] = {0xa8, 0xeb, 0x10, 0x64, 0x9c, 0xbf};
+    out.clear();
+    ASSERT_TRUE(HpackHuffmanDecode(v2, sizeof(v2), &out));
+    EXPECT_EQ(out, "no-cache");
+    // C.6.1: "302"
+    const uint8_t v3[] = {0x64, 0x02};
+    out.clear();
+    ASSERT_TRUE(HpackHuffmanDecode(v3, sizeof(v3), &out));
+    EXPECT_EQ(out, "302");
+    // Bad padding (zero bits) must fail.
+    const uint8_t bad[] = {0xf1, 0xe3, 0xc2, 0x00};
+    out.clear();
+    EXPECT_FALSE(HpackHuffmanDecode(bad, sizeof(bad), &out));
+}
+
+TEST(Hpack, DecodeRfcHeaderBlocks) {
+    // C.2.1: literal with incremental indexing —
+    // custom-key: custom-header.
+    const uint8_t b1[] = {0x40, 0x0a, 'c', 'u', 's', 't', 'o', 'm', '-',
+                          'k',  'e',  'y', 0x0d, 'c', 'u', 's', 't', 'o',
+                          'm',  '-',  'h', 'e',  'a', 'd', 'e', 'r'};
+    HpackDecoder dec;
+    std::vector<HpackHeader> hs;
+    ASSERT_TRUE(dec.Decode(b1, sizeof(b1), &hs));
+    ASSERT_EQ(hs.size(), 1u);
+    EXPECT_EQ(hs[0].name, "custom-key");
+    EXPECT_EQ(hs[0].value, "custom-header");
+    // The entry was added to the dynamic table: index 62 resolves it.
+    const uint8_t b2[] = {0xbe};  // indexed, index 62
+    hs.clear();
+    ASSERT_TRUE(dec.Decode(b2, sizeof(b2), &hs));
+    ASSERT_EQ(hs.size(), 1u);
+    EXPECT_EQ(hs[0].name, "custom-key");
+    EXPECT_EQ(hs[0].value, "custom-header");
+    // C.2.4: indexed static — :method GET (index 2).
+    const uint8_t b3[] = {0x82};
+    hs.clear();
+    ASSERT_TRUE(dec.Decode(b3, sizeof(b3), &hs));
+    ASSERT_EQ(hs.size(), 1u);
+    EXPECT_EQ(hs[0].name, ":method");
+    EXPECT_EQ(hs[0].value, "GET");
+    // Garbage index fails.
+    const uint8_t b4[] = {0xff, 0xff, 0xff, 0xff, 0x7f};
+    hs.clear();
+    EXPECT_FALSE(dec.Decode(b4, sizeof(b4), &hs));
+    // Round-trip our own encoder through the decoder.
+    std::string enc;
+    HpackEncodeHeader(":status", "200", &enc);
+    HpackEncodeHeader("grpc-status", "0", &enc);
+    hs.clear();
+    ASSERT_TRUE(dec.Decode((const uint8_t*)enc.data(), enc.size(), &hs));
+    ASSERT_EQ(hs.size(), 2u);
+    EXPECT_EQ(hs[0].name, ":status");
+    EXPECT_EQ(hs[1].name, "grpc-status");
+}
+
+TEST(Hpack, FuzzSmoke) {
+    // The decoder parses untrusted header blocks: mutate valid blocks +
+    // raw noise; must never crash and must reject garbage cleanly
+    // (tools/frame_fuzz-style deterministic loop).
+    uint64_t rng = 0x2545f4914f6cdd1dull;
+    auto next = [&rng]() {
+        rng ^= rng << 13;
+        rng ^= rng >> 7;
+        rng ^= rng << 17;
+        return rng;
+    };
+    std::string seed;
+    HpackEncodeHeader(":path", "/benchpb.EchoService/Echo", &seed);
+    HpackEncodeHeader("content-type", "application/grpc", &seed);
+    seed += "\x82\x86";  // indexed :method GET, :scheme http
+    const uint8_t huff_seed[] = {0xf1, 0xe3, 0xc2, 0xe5, 0xf2,
+                                 0x3a, 0x6b, 0xa0, 0xab};
+    for (int iter = 0; iter < 20000; ++iter) {
+        std::string input = seed;
+        const int nmut = 1 + (int)(next() % 6);
+        for (int m = 0; m < nmut; ++m) {
+            if (input.empty()) input = "\x82";
+            switch (next() % 3) {
+                case 0:
+                    input[next() % input.size()] = (char)next();
+                    break;
+                case 1:
+                    input.resize(next() % (input.size() + 1));
+                    break;
+                case 2:
+                    for (int i = 0; i < 6; ++i) {
+                        input.push_back((char)next());
+                    }
+                    break;
+            }
+        }
+        HpackDecoder dec;
+        std::vector<HpackHeader> hs;
+        dec.Decode((const uint8_t*)input.data(), input.size(), &hs);
+        std::string out;
+        HpackHuffmanDecode(huff_seed, sizeof(huff_seed), &out);
+        std::string mutated(input);
+        HpackHuffmanDecode((const uint8_t*)mutated.data(),
+                           std::min<size_t>(mutated.size(), 64), &out);
+    }
 }

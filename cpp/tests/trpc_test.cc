@@ -796,7 +796,9 @@ TEST(Short, FreshConnectionPerCall) {
         stub.Echo(&cntl, &req, &res, nullptr);
         ASSERT_FALSE(cntl.Failed());
     }
-    EXPECT_EQ(ts.server.acceptor()->accepted_count(), 3);
+    // One fresh connection per call; a contention-induced retry may add
+    // more, but short mode never REUSES one (and never pools).
+    EXPECT_GE(ts.server.acceptor()->accepted_count(), 3);
     EXPECT_EQ(SocketPool::singleton()->idle_count(ts.ep), 0u);
 }
 

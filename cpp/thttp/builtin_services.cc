@@ -8,6 +8,8 @@
 #include "tbase/flags.h"
 #include "thttp/http_message.h"
 #include "thttp/http_protocol.h"
+#include "tfiber/task_group.h"
+#include "tfiber/task_meta.h"
 #include "tnet/socket.h"
 #include "trpc/server.h"
 #include "trpc/span.h"
@@ -29,12 +31,27 @@ void HandleIndex(Server*, const HttpRequest&, HttpResponse* res) {
         "/flags        runtime flags (/flags/<name>?setvalue=v to set)\n"
         "/connections  accepted connections\n"
         "/rpcz         sampled per-RPC spans (enable_rpcz flag)\n"
+        "/fibers       fiber runtime introspection\n"
         "/metrics      prometheus exposition\n");
 }
 
 void HandleHealth(Server*, const HttpRequest&, HttpResponse* res) {
     res->set_content_type("text/plain");
     res->Append("OK\n");
+}
+
+// /fibers: live fiber-runtime introspection (reference /bthreads page;
+// full per-fiber stack unwinding — TaskTracer — is roadmap).
+void HandleFibers(Server*, const HttpRequest&, HttpResponse* res) {
+    res->set_content_type("text/plain");
+    TaskControl* c = TaskControl::singleton();
+    char line[256];
+    snprintf(line, sizeof(line),
+             "workers: %d\nlive_fibers: %lld\n"
+             "fiber_slots_allocated: %zu\n",
+             c->concurrency(), (long long)c->nfibers.load(),
+             ResourcePool<TaskMeta>::singleton()->size());
+    res->Append(line);
 }
 
 void HandleRpcz(Server*, const HttpRequest& req, HttpResponse* res) {
@@ -212,6 +229,7 @@ void AddBuiltinHttpServices(Server* server) {
     server->RegisterHttpHandler("/flags/*", HandleFlags);
     server->RegisterHttpHandler("/connections", HandleConnections);
     server->RegisterHttpHandler("/rpcz", HandleRpcz);
+    server->RegisterHttpHandler("/fibers", HandleFibers);
     server->RegisterHttpHandler("/metrics", HandleMetrics);
 }
 

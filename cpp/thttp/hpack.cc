@@ -1,0 +1,241 @@
+#include "thttp/hpack.h"
+
+#include <cctype>
+
+#include "thttp/hpack_tables.h"
+
+namespace tpurpc {
+
+namespace {
+
+constexpr size_t kMaxHeaderBytes = 256 * 1024;  // decoded-size guard
+
+// Huffman decode table built once: for each (state-less) walk we match
+// codes MSB-first. A flat map from (nbits,code) would be large; instead
+// build a binary trie over the canonical codes — 513 nodes max.
+struct HuffTrie {
+    struct Node {
+        int16_t child[2];
+        int16_t sym;  // -1 internal, 0..255 leaf, 256 EOS
+    };
+    std::vector<Node> nodes;
+
+    HuffTrie() {
+        nodes.push_back(Node{{-1, -1}, -1});
+        for (int sym = 0; sym <= 256; ++sym) {
+            const uint32_t code = hpack::kHuffman[sym].code;
+            const int nbits = hpack::kHuffman[sym].nbits;
+            int cur = 0;
+            for (int b = nbits - 1; b >= 0; --b) {
+                const int bit = (code >> b) & 1;
+                if (nodes[(size_t)cur].child[bit] < 0) {
+                    nodes[(size_t)cur].child[bit] = (int16_t)nodes.size();
+                    nodes.push_back(Node{{-1, -1}, -1});
+                }
+                cur = nodes[(size_t)cur].child[bit];
+            }
+            nodes[(size_t)cur].sym = (int16_t)sym;
+        }
+    }
+};
+
+const HuffTrie& huff_trie() {
+    static const HuffTrie t;
+    return t;
+}
+
+// Decode an HPACK varint (RFC 7541 §5.1) with `prefix_bits` in *p.
+// Advances *p; false on truncation/overflow.
+bool DecodeInt(const uint8_t** p, const uint8_t* end, int prefix_bits,
+               uint64_t* out) {
+    if (*p >= end) return false;
+    const uint8_t mask = (uint8_t)((1u << prefix_bits) - 1);
+    uint64_t v = (*(*p)++) & mask;
+    if (v < mask) {
+        *out = v;
+        return true;
+    }
+    int shift = 0;
+    while (*p < end) {
+        const uint8_t b = *(*p)++;
+        if (shift > 56) return false;  // overflow guard
+        v += (uint64_t)(b & 0x7f) << shift;
+        shift += 7;
+        if ((b & 0x80) == 0) {
+            *out = v;
+            return true;
+        }
+    }
+    return false;  // truncated continuation
+}
+
+bool DecodeString(const uint8_t** p, const uint8_t* end, std::string* out) {
+    if (*p >= end) return false;
+    const bool huffman = (**p & 0x80) != 0;
+    uint64_t len = 0;
+    if (!DecodeInt(p, end, 7, &len)) return false;
+    if (len > (uint64_t)(end - *p) || len > kMaxHeaderBytes) return false;
+    if (huffman) {
+        if (!HpackHuffmanDecode(*p, (size_t)len, out)) return false;
+    } else {
+        out->assign((const char*)*p, (size_t)len);
+    }
+    *p += len;
+    return out->size() <= kMaxHeaderBytes;
+}
+
+size_t entry_size(const HpackHeader& h) {
+    return h.name.size() + h.value.size() + 32;  // RFC 7541 §4.1
+}
+
+}  // namespace
+
+bool HpackHuffmanDecode(const uint8_t* data, size_t len, std::string* out) {
+    const HuffTrie& t = huff_trie();
+    int cur = 0;
+    int depth = 0;  // bits consumed since last symbol (for padding check)
+    for (size_t i = 0; i < len; ++i) {
+        for (int b = 7; b >= 0; --b) {
+            const int bit = (data[i] >> b) & 1;
+            const int16_t next = t.nodes[(size_t)cur].child[bit];
+            if (next < 0) return false;  // not a prefix of any code
+            cur = next;
+            ++depth;
+            const int16_t sym = t.nodes[(size_t)cur].sym;
+            if (sym >= 0) {
+                if (sym == 256) return false;  // EOS in stream = error
+                out->push_back((char)sym);
+                if (out->size() > kMaxHeaderBytes) return false;
+                cur = 0;
+                depth = 0;
+            }
+        }
+    }
+    // Padding must be < 8 bits of EOS prefix (all ones). Any node on the
+    // all-ones path is fine; a node reachable only via a 0 bit means the
+    // padding wasn't EOS bits.
+    if (depth >= 8) return false;
+    // Walk the all-ones path from root `depth` steps: must equal cur.
+    int check = 0;
+    for (int i = 0; i < depth; ++i) {
+        check = t.nodes[(size_t)check].child[1];
+        if (check < 0) return false;
+    }
+    return check == cur;
+}
+
+bool HpackDecoder::LookupIndex(uint64_t index, HpackHeader* h) const {
+    if (index == 0) return false;
+    if (index <= 61) {
+        h->name = hpack::kStaticTable[index - 1].name;
+        h->value = hpack::kStaticTable[index - 1].value;
+        return true;
+    }
+    const uint64_t di = index - 62;
+    if (di >= dynamic_.size()) return false;
+    *h = dynamic_[(size_t)di];
+    return true;
+}
+
+void HpackDecoder::InsertDynamic(const HpackHeader& h) {
+    const size_t sz = entry_size(h);
+    if (sz > capacity_) {
+        // Larger than the whole table: clears it (RFC 7541 §4.4).
+        dynamic_.clear();
+        dynamic_size_ = 0;
+        return;
+    }
+    dynamic_.push_front(h);
+    dynamic_size_ += sz;
+    EvictToFit();
+}
+
+void HpackDecoder::EvictToFit() {
+    while (dynamic_size_ > capacity_ && !dynamic_.empty()) {
+        dynamic_size_ -= entry_size(dynamic_.back());
+        dynamic_.pop_back();
+    }
+}
+
+bool HpackDecoder::Decode(const uint8_t* data, size_t len,
+                          std::vector<HpackHeader>* out) {
+    const uint8_t* p = data;
+    const uint8_t* end = data + len;
+    size_t total = 0;
+    while (p < end) {
+        const uint8_t b = *p;
+        if (b & 0x80) {
+            // Indexed header field.
+            uint64_t index;
+            if (!DecodeInt(&p, end, 7, &index)) return false;
+            HpackHeader h;
+            if (!LookupIndex(index, &h)) return false;
+            total += entry_size(h);
+            out->push_back(std::move(h));
+        } else if (b & 0x40) {
+            // Literal with incremental indexing.
+            uint64_t index;
+            if (!DecodeInt(&p, end, 6, &index)) return false;
+            HpackHeader h;
+            if (index > 0) {
+                if (!LookupIndex(index, &h)) return false;
+                h.value.clear();
+            } else if (!DecodeString(&p, end, &h.name)) {
+                return false;
+            }
+            if (!DecodeString(&p, end, &h.value)) return false;
+            InsertDynamic(h);
+            total += entry_size(h);
+            out->push_back(std::move(h));
+        } else if (b & 0x20) {
+            // Dynamic table size update.
+            uint64_t size;
+            if (!DecodeInt(&p, end, 5, &size)) return false;
+            if (size > max_capacity_) return false;
+            capacity_ = (size_t)size;
+            EvictToFit();
+        } else {
+            // Literal without indexing (0x00) / never indexed (0x10).
+            uint64_t index;
+            if (!DecodeInt(&p, end, 4, &index)) return false;
+            HpackHeader h;
+            if (index > 0) {
+                if (!LookupIndex(index, &h)) return false;
+                h.value.clear();
+            } else if (!DecodeString(&p, end, &h.name)) {
+                return false;
+            }
+            if (!DecodeString(&p, end, &h.value)) return false;
+            total += entry_size(h);
+            out->push_back(std::move(h));
+        }
+        if (total > kMaxHeaderBytes) return false;
+    }
+    return true;
+}
+
+void HpackEncodeHeader(const std::string& name, const std::string& value,
+                       std::string* out) {
+    // Literal never-indexed (0x10), 4-bit length prefixes, no Huffman.
+    auto put_len = [out](size_t n, uint8_t first, int prefix_bits) {
+        const uint8_t mask = (uint8_t)((1u << prefix_bits) - 1);
+        if (n < mask) {
+            out->push_back((char)(first | (uint8_t)n));
+            return;
+        }
+        out->push_back((char)(first | mask));
+        n -= mask;
+        while (n >= 0x80) {
+            out->push_back((char)(0x80 | (n & 0x7f)));
+            n >>= 7;
+        }
+        out->push_back((char)n);
+    };
+    out->push_back((char)0x10);
+    put_len(name.size(), 0x00, 7);
+    out->append(name);
+    put_len(value.size(), 0x00, 7);
+    out->append(value);
+}
+
+}  // namespace tpurpc

@@ -1,0 +1,54 @@
+// HPACK (RFC 7541): header decoding for the HTTP/2 server path, plus a
+// deliberately simple encoder.
+//
+// Reference: src/brpc/details/hpack.{h,cpp} (~1.7k LoC with an encoding
+// Huffman tree). Re-designed smaller: the DECODER is complete (static +
+// dynamic table, incremental indexing, table-size updates, canonical
+// Huffman via a flat decode walk); the ENCODER emits literal
+// never-indexed headers without Huffman — always legal HPACK, trading a
+// few bytes per response for zero encoder state to desynchronize.
+#pragma once
+
+#include <cstdint>
+#include <deque>
+#include <string>
+#include <vector>
+
+namespace tpurpc {
+
+struct HpackHeader {
+    std::string name;   // lowercase on decode (h2 requires lowercase)
+    std::string value;
+};
+
+class HpackDecoder {
+public:
+    // `max_dynamic_size` is OUR advertised SETTINGS_HEADER_TABLE_SIZE
+    // ceiling; the peer may shrink below it with a table-size update.
+    explicit HpackDecoder(size_t max_dynamic_size = 4096)
+        : capacity_(max_dynamic_size), max_capacity_(max_dynamic_size) {}
+
+    // Decode one complete header block; append to *out. Returns false on
+    // malformed input (connection error per RFC 7541 §5.2/§6).
+    bool Decode(const uint8_t* data, size_t len,
+                std::vector<HpackHeader>* out);
+
+private:
+    bool LookupIndex(uint64_t index, HpackHeader* h) const;
+    void InsertDynamic(const HpackHeader& h);
+    void EvictToFit();
+
+    size_t capacity_;
+    size_t max_capacity_;
+    size_t dynamic_size_ = 0;
+    std::deque<HpackHeader> dynamic_;  // front = most recent
+};
+
+// Literal never-indexed, no Huffman: stateless and always valid.
+void HpackEncodeHeader(const std::string& name, const std::string& value,
+                       std::string* out);
+
+// Exposed for tests/fuzzing.
+bool HpackHuffmanDecode(const uint8_t* data, size_t len, std::string* out);
+
+}  // namespace tpurpc

@@ -1,0 +1,714 @@
+#include "thttp/http2_protocol.h"
+
+#include <arpa/inet.h>
+
+#include <algorithm>
+#include <cctype>
+#include <cstring>
+#include <mutex>
+#include <map>
+#include <memory>
+#include <string>
+#include <vector>
+
+#include "tbase/errno.h"
+#include "tbase/logging.h"
+#include "tbase/time.h"
+#include "tfiber/butex.h"
+#include "tfiber/fiber.h"
+#include "tfiber/fiber_sync.h"
+#include "thttp/hpack.h"
+#include "thttp/http_message.h"
+#include "tnet/input_messenger.h"
+#include "tnet/protocol.h"
+#include "tnet/socket.h"
+#include "trpc/controller.h"
+#include "trpc/json2pb.h"
+#include "trpc/pb_compat.h"
+#include "trpc/server.h"
+
+namespace tpurpc {
+
+// Defined in http_protocol.cc (shared with HTTP/1): routes a non-RPC
+// request through the registered handlers / json transcoding.
+bool DispatchHttpRpc(Server* server, const HttpRequest& req,
+                     HttpResponse* res, const EndPoint& remote_side);
+
+namespace {
+
+constexpr char kPreface[] = "PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n";
+constexpr size_t kPrefaceLen = 24;
+constexpr size_t kFrameHeaderLen = 9;
+
+enum FrameType : uint8_t {
+    H2_DATA = 0x0,
+    H2_HEADERS = 0x1,
+    H2_PRIORITY = 0x2,
+    H2_RST_STREAM = 0x3,
+    H2_SETTINGS = 0x4,
+    H2_PUSH_PROMISE = 0x5,
+    H2_PING = 0x6,
+    H2_GOAWAY = 0x7,
+    H2_WINDOW_UPDATE = 0x8,
+    H2_CONTINUATION = 0x9,
+};
+
+constexpr uint8_t kFlagEndStream = 0x1;
+constexpr uint8_t kFlagEndHeaders = 0x4;
+constexpr uint8_t kFlagPadded = 0x8;
+constexpr uint8_t kFlagPriority = 0x20;
+constexpr uint8_t kFlagAck = 0x1;
+
+constexpr int64_t kDefaultWindow = 65535;
+constexpr uint32_t kMaxFrameSize = 16384;
+
+// Append a frame header + payload onto *out (no intermediate copies; the
+// DATA path appends body slices directly — IOBuf-native zero-copy DATA is
+// roadmap).
+void AppendFrame(std::string* out, uint8_t type, uint8_t flags,
+                 uint32_t stream, const char* payload, size_t len) {
+    out->reserve(out->size() + kFrameHeaderLen + len);
+    out->push_back((char)((len >> 16) & 0xff));
+    out->push_back((char)((len >> 8) & 0xff));
+    out->push_back((char)(len & 0xff));
+    out->push_back((char)type);
+    out->push_back((char)flags);
+    const uint32_t sid = htonl(stream & 0x7fffffffu);
+    out->append((const char*)&sid, 4);
+    out->append(payload, len);
+}
+
+std::string BuildFrame(uint8_t type, uint8_t flags, uint32_t stream,
+                       const std::string& payload) {
+    std::string f;
+    AppendFrame(&f, type, flags, stream, payload.data(), payload.size());
+    return f;
+}
+
+struct H2Stream {
+    std::vector<HpackHeader> headers;
+    IOBuf body;
+    bool end_stream = false;
+    bool dispatched = false;
+    int64_t send_window = kDefaultWindow;
+};
+
+// Per-connection session. Frame processing runs on the input fiber (the
+// protocol is in-order); response fibers touch only the window fields and
+// stream erasure — both under mu.
+struct H2Session {
+    HpackDecoder decoder;
+    std::map<uint32_t, H2Stream> streams;
+    std::mutex mu;
+    int64_t conn_send_window = kDefaultWindow;
+    int64_t peer_initial_window = kDefaultWindow;
+    void* window_butex = butex_create();
+    bool goaway = false;
+    uint32_t cont_stream = 0;  // nonzero: CONTINUATION expected
+    uint8_t cont_flags = 0;
+    std::string header_block;
+
+    ~H2Session() { butex_destroy(window_butex); }
+
+    void WakeWindowWaiters() {
+        butex_word(window_butex)->fetch_add(1, std::memory_order_release);
+        butex_wake_all(window_butex);
+    }
+};
+
+void DeleteSession(void* s) { delete (H2Session*)s; }
+
+H2Session* session_of(Socket* s) { return (H2Session*)s->conn_data(); }
+
+// ---------------- response writing ----------------
+
+std::string EncodeHeaderBlock(
+    const std::vector<std::pair<std::string, std::string>>& headers) {
+    std::string block;
+    for (const auto& kv : headers) {
+        HpackEncodeHeader(kv.first, kv.second, &block);
+    }
+    return block;
+}
+
+// Write HEADERS (+optional DATA chunks with flow control) + trailers.
+// Runs on a response fiber holding a socket ref; parks on the session
+// window butex when the send window is exhausted.
+void WriteResponse(
+    SocketId sid, uint32_t stream_id,
+    const std::vector<std::pair<std::string, std::string>>& headers,
+    const std::string& body,
+    const std::vector<std::pair<std::string, std::string>>& trailers) {
+    SocketUniquePtr s;
+    if (Socket::AddressSocket(sid, &s) != 0) return;
+    H2Session* sess = session_of(s.get());
+    if (sess == nullptr) return;
+
+    std::string out =
+        BuildFrame(H2_HEADERS, trailers.empty() && body.empty()
+                                   ? (uint8_t)(kFlagEndHeaders | kFlagEndStream)
+                                   : kFlagEndHeaders,
+                   stream_id, EncodeHeaderBlock(headers));
+    size_t sent = 0;
+    // A window-starving client must not pin this fiber (and its
+    // concurrency slot) forever: give up after a bounded stall and reset
+    // the stream (reference h2 has the same write-timeout escape).
+    const int64_t stall_deadline = monotonic_time_us() + 60 * 1000 * 1000;
+    while (sent < body.size()) {
+        // Flow control: consume min(available conn+stream window, frame
+        // cap); park until WINDOW_UPDATE when exhausted.
+        size_t n = 0;
+        bool stream_gone = false;
+        {
+            std::lock_guard<std::mutex> g(sess->mu);
+            auto it = sess->streams.find(stream_id);
+            if (it == sess->streams.end()) {
+                stream_gone = true;  // peer RST mid-response
+            } else {
+                const int64_t avail = std::min<int64_t>(
+                    sess->conn_send_window, it->second.send_window);
+                n = (size_t)std::max<int64_t>(
+                    0, std::min<int64_t>(
+                           avail, (int64_t)std::min<size_t>(
+                                      kMaxFrameSize, body.size() - sent)));
+                if (n > 0) {
+                    sess->conn_send_window -= (int64_t)n;
+                    it->second.send_window -= (int64_t)n;
+                }
+            }
+        }
+        if (stream_gone) return;
+        if (n == 0) {
+            // Flush what we have, then wait for a window update.
+            if (!out.empty()) {
+                IOBuf buf;
+                buf.append(out);
+                out.clear();
+                if (s->Write(&buf) != 0) return;
+            }
+            if (s->Failed()) return;
+            if (monotonic_time_us() >= stall_deadline) {
+                // Abort: RST_STREAM CANCEL, drop the stream, skip
+                // trailers (the stream is dead).
+                uint32_t code = htonl(8);
+                IOBuf rst;
+                rst.append(BuildFrame(H2_RST_STREAM, 0, stream_id,
+                                      std::string((const char*)&code, 4)));
+                s->Write(&rst);
+                std::lock_guard<std::mutex> g(sess->mu);
+                sess->streams.erase(stream_id);
+                return;
+            }
+            std::atomic<int>* word = butex_word(sess->window_butex);
+            const int expected = word->load(std::memory_order_acquire);
+            const int64_t abst = monotonic_time_us() + 10 * 1000 * 1000;
+            butex_wait(sess->window_butex, expected, &abst);
+            if (s->Failed()) return;
+            continue;
+        }
+        AppendFrame(&out, H2_DATA, 0, stream_id, body.data() + sent, n);
+        sent += n;
+        if (out.size() > 256 * 1024) {
+            IOBuf buf;
+            buf.append(out);
+            out.clear();
+            if (s->Write(&buf) != 0) return;
+        }
+    }
+    if (!trailers.empty()) {
+        out += BuildFrame(H2_HEADERS,
+                          (uint8_t)(kFlagEndHeaders | kFlagEndStream),
+                          stream_id, EncodeHeaderBlock(trailers));
+    } else if (!body.empty()) {
+        out += BuildFrame(H2_DATA, kFlagEndStream, stream_id, "");
+    }
+    if (!out.empty()) {
+        IOBuf buf;
+        buf.append(out);
+        s->Write(&buf);
+    }
+    std::lock_guard<std::mutex> g(sess->mu);
+    sess->streams.erase(stream_id);
+}
+
+// ---------------- request dispatch ----------------
+
+const std::string* FindHeader(const std::vector<HpackHeader>& hs,
+                              const char* name) {
+    for (const auto& h : hs) {
+        if (h.name == name) return &h.value;
+    }
+    return nullptr;
+}
+
+// gRPC unary call: 5-byte length-prefixed pb in, same out, grpc-status
+// trailers (reference src/brpc/grpc.{h,cpp} status mapping).
+struct GrpcCallCtx {
+    SocketId sid;
+    uint32_t stream_id;
+    Server::MethodProperty* mp;
+    Server::MethodCallGuard* guard;
+    std::unique_ptr<google::protobuf::Message> req;
+    std::unique_ptr<google::protobuf::Message> res;
+    Controller cntl;
+};
+
+void* RunGrpcCall(void* arg) {
+    std::unique_ptr<GrpcCallCtx> c((GrpcCallCtx*)arg);
+    struct SyncDone : google::protobuf::Closure {
+        CountdownEvent ev{1};
+        void Run() override { ev.signal(); }
+    } done;
+    c->mp->service->CallMethod(c->mp->method, &c->cntl, c->req.get(),
+                               c->res.get(), &done);
+    done.ev.wait();
+    std::string body;
+    std::vector<std::pair<std::string, std::string>> trailers;
+    if (c->cntl.Failed()) {
+        // grpc-status 2 (UNKNOWN) carries the application error.
+        trailers = {{"grpc-status", "2"},
+                    {"grpc-message", c->cntl.ErrorText()}};
+    } else {
+        std::string pb;
+        c->res->SerializeToString(&pb);
+        body.push_back('\0');  // uncompressed
+        const uint32_t len = htonl((uint32_t)pb.size());
+        body.append((const char*)&len, 4);
+        body += pb;
+        trailers = {{"grpc-status", "0"}};
+    }
+    WriteResponse(c->sid, c->stream_id,
+                  {{":status", "200"},
+                   {"content-type", "application/grpc"}},
+                  body, trailers);
+    c->guard->Finish(c->cntl.Failed() ? c->cntl.ErrorCode() : 0);
+    delete c->guard;
+    return nullptr;
+}
+
+void RespondGrpcError(SocketId sid, uint32_t stream_id, int code,
+                      const std::string& msg) {
+    WriteResponse(sid, stream_id,
+                  {{":status", "200"},
+                   {"content-type", "application/grpc"}},
+                  "",
+                  {{"grpc-status", std::to_string(code)},
+                   {"grpc-message", msg}});
+}
+
+// Plain h2 request -> the shared HTTP handler/json-RPC routing.
+struct PlainCallCtx {
+    SocketId sid;
+    uint32_t stream_id;
+    Server* server;
+    HttpRequest req;
+    EndPoint remote;
+};
+
+void* RunPlainCall(void* arg) {
+    std::unique_ptr<PlainCallCtx> c((PlainCallCtx*)arg);
+    HttpResponse res;
+    const HttpHandler* h = c->server->FindHttpHandler(c->req.path);
+    if (h != nullptr) {
+        (*h)(c->server, c->req, &res);
+    } else if (!DispatchHttpRpc(c->server, c->req, &res, c->remote)) {
+        res.status = 404;
+        res.set_content_type("text/plain");
+        res.Append("404 not found: " + c->req.path + "\n");
+    }
+    std::vector<std::pair<std::string, std::string>> headers;
+    headers.push_back({":status", std::to_string(res.status)});
+    for (const auto& kv : res.headers) {
+        std::string name = kv.first;
+        for (char& ch : name) ch = (char)tolower((unsigned char)ch);
+        if (name == "connection") continue;  // h2 forbids it
+        headers.push_back({name, kv.second});
+    }
+    WriteResponse(c->sid, c->stream_id, headers, res.body.to_string(), {});
+    return nullptr;
+}
+
+// Takes the request's headers+body by value (moved out of the stream
+// entry under the session mutex): the map entry may be erased by the
+// response fiber at any time after dispatch, so no H2Stream pointer may
+// be used here.
+void DispatchCompleteStream(Socket* s, H2Session* sess, uint32_t stream_id,
+                            std::vector<HpackHeader> req_headers,
+                            IOBuf req_body) {
+    InputMessenger* m = (InputMessenger*)s->user();
+    Server* server = m != nullptr ? (Server*)m->context : nullptr;
+    const std::string* path = FindHeader(req_headers, ":path");
+    const std::string* ct = FindHeader(req_headers, "content-type");
+    if (server == nullptr || path == nullptr) {
+        RespondGrpcError(s->id(), stream_id, 13, "no server bound");
+        return;
+    }
+    if (ct != nullptr && ct->compare(0, 16, "application/grpc") == 0) {
+        // gRPC: find the pb method, admission, parse, run on a fiber.
+        Server::MethodProperty* mp = server->FindMethodByHttpPath(*path);
+        if (mp == nullptr) {
+            RespondGrpcError(s->id(), stream_id, 12, "unimplemented");
+            return;
+        }
+        auto* guard = new Server::MethodCallGuard(server, mp);
+        if (guard->rejected()) {
+            delete guard;
+            RespondGrpcError(s->id(), stream_id, 8, "concurrency limit");
+            return;
+        }
+        if (req_body.size() < 5) {
+            guard->Finish(TERR_REQUEST);
+            delete guard;
+            RespondGrpcError(s->id(), stream_id, 3, "truncated message");
+            return;
+        }
+        char prefix[5];
+        req_body.cutn(prefix, 5);
+        if (prefix[0] != 0) {
+            guard->Finish(TERR_REQUEST);
+            delete guard;
+            RespondGrpcError(s->id(), stream_id, 12,
+                             "compressed grpc messages not supported");
+            return;
+        }
+        // Fix the 5-byte framing to the body: a unary call carries
+        // exactly ONE length-prefixed message (a second message or a
+        // mismatched length is a framing error, not a pb parse error).
+        uint32_t msg_len = 0;
+        memcpy(&msg_len, prefix + 1, 4);
+        msg_len = ntohl(msg_len);
+        if ((size_t)msg_len != req_body.size()) {
+            guard->Finish(TERR_REQUEST);
+            delete guard;
+            RespondGrpcError(s->id(), stream_id, 3,
+                             "grpc message framing mismatch");
+            return;
+        }
+        auto* ctx = new GrpcCallCtx;
+        ctx->sid = s->id();
+        ctx->stream_id = stream_id;
+        ctx->mp = mp;
+        ctx->guard = guard;
+        ctx->req.reset(mp->service->GetRequestPrototype(mp->method).New());
+        ctx->res.reset(mp->service->GetResponsePrototype(mp->method).New());
+        ctx->cntl.InitServerSide(server, s->remote_side());
+        if (!ParsePbFromIOBuf(ctx->req.get(), req_body)) {
+            guard->Finish(TERR_REQUEST);
+            delete guard;
+            delete ctx;
+            RespondGrpcError(s->id(), stream_id, 3, "bad request pb");
+            return;
+        }
+        fiber_t tid;
+        if (fiber_start_background(&tid, nullptr, RunGrpcCall, ctx) != 0) {
+            RunGrpcCall(ctx);  // degrade inline
+        }
+        return;
+    }
+    // Plain h2: adapt to the HTTP/1 routing (portal + json RPC).
+    auto* ctx = new PlainCallCtx;
+    ctx->sid = s->id();
+    ctx->stream_id = stream_id;
+    ctx->server = server;
+    ctx->remote = s->remote_side();
+    const std::string* method = FindHeader(req_headers, ":method");
+    ctx->req.method = method != nullptr ? *method : "GET";
+    const size_t q = path->find('?');
+    ctx->req.path = path->substr(0, q);
+    if (q != std::string::npos) ctx->req.query = path->substr(q + 1);
+    for (const auto& h : req_headers) {
+        if (!h.name.empty() && h.name[0] != ':') {
+            ctx->req.headers[h.name] = h.value;
+        }
+    }
+    ctx->req.body = std::move(req_body);
+    fiber_t tid;
+    if (fiber_start_background(&tid, nullptr, RunPlainCall, ctx) != 0) {
+        RunPlainCall(ctx);
+    }
+    (void)sess;
+}
+
+// ---------------- frame processing (input fiber, in order) ----------------
+
+struct H2FrameMessage : public InputMessageBase {
+    uint8_t type = 0;
+    uint8_t flags = 0;
+    uint32_t stream_id = 0;
+    IOBuf payload;
+    bool is_preface = false;
+};
+
+void SendRaw(Socket* s, const std::string& bytes) {
+    IOBuf buf;
+    buf.append(bytes);
+    s->Write(&buf);
+}
+
+ParseResult ParseH2(IOBuf* source, Socket* s, bool read_eof, const void*) {
+    (void)read_eof;
+    if (s == nullptr) return ParseResult::make(ParseError::TRY_OTHERS);
+    H2Session* sess = session_of(s);
+    if (sess == nullptr) {
+        // Sniff the client preface.
+        char head[kPrefaceLen];
+        const size_t n =
+            source->copy_to(head, std::min(source->size(), kPrefaceLen));
+        if (memcmp(head, kPreface, n) != 0) {
+            return ParseResult::make(ParseError::TRY_OTHERS);
+        }
+        if (n < kPrefaceLen) {
+            return ParseResult::make(ParseError::NOT_ENOUGH_DATA);
+        }
+        source->pop_front(kPrefaceLen);
+        auto* msg = new H2FrameMessage;
+        msg->is_preface = true;
+        return ParseResult::make_ok(msg);
+    }
+    if (source->size() < kFrameHeaderLen) {
+        return ParseResult::make(ParseError::NOT_ENOUGH_DATA);
+    }
+    char header[kFrameHeaderLen];
+    source->copy_to(header, kFrameHeaderLen);
+    const uint32_t len = ((uint32_t)(uint8_t)header[0] << 16) |
+                         ((uint32_t)(uint8_t)header[1] << 8) |
+                         (uint32_t)(uint8_t)header[2];
+    if (len > kMaxFrameSize + 255) {
+        return ParseResult::make(ParseError::ERROR);  // FRAME_SIZE_ERROR
+    }
+    if (source->size() < kFrameHeaderLen + len) {
+        return ParseResult::make(ParseError::NOT_ENOUGH_DATA);
+    }
+    source->pop_front(kFrameHeaderLen);
+    auto* msg = new H2FrameMessage;
+    msg->type = (uint8_t)header[3];
+    msg->flags = (uint8_t)header[4];
+    uint32_t sid;
+    memcpy(&sid, header + 5, 4);
+    msg->stream_id = ntohl(sid) & 0x7fffffffu;
+    source->cutn(&msg->payload, len);
+    return ParseResult::make_ok(msg);
+}
+
+void HandleHeaderBlockDone(Socket* s, H2Session* sess, uint32_t stream_id,
+                           uint8_t flags) {
+    std::vector<HpackHeader> headers;
+    if (!sess->decoder.Decode((const uint8_t*)sess->header_block.data(),
+                              sess->header_block.size(), &headers)) {
+        s->SetFailedWithError(TERR_REQUEST);  // COMPRESSION_ERROR
+        return;
+    }
+    sess->header_block.clear();
+    if (stream_id == 0 || sess->goaway) {
+        return;  // stream 0 carries no requests; draining after GOAWAY
+    }
+    const bool complete = (flags & kFlagEndStream) != 0;
+    {
+        std::lock_guard<std::mutex> g(sess->mu);
+        H2Stream& st = sess->streams[stream_id];
+        st.send_window = sess->peer_initial_window;
+        st.headers = std::move(headers);
+        st.end_stream = complete;
+        if (!complete) return;  // await DATA
+        st.dispatched = true;
+        headers = std::move(st.headers);  // move back out for dispatch
+    }
+    DispatchCompleteStream(s, sess, stream_id, std::move(headers), IOBuf());
+}
+
+void ProcessH2(InputMessageBase* raw) {
+    std::unique_ptr<H2FrameMessage> msg((H2FrameMessage*)raw);
+    SocketUniquePtr s = SocketUniquePtr::FromId(msg->socket_id);
+    if (!s) return;
+    H2Session* sess = session_of(s.get());
+
+    if (msg->is_preface) {
+        if (sess != nullptr) return;  // duplicate preface: ignore
+        sess = new H2Session;
+        s->set_conn_data(sess, DeleteSession);
+        // Our SETTINGS (defaults are fine) + immediately usable.
+        SendRaw(s.get(), BuildFrame(H2_SETTINGS, 0, 0, ""));
+        return;
+    }
+    if (sess == nullptr) return;
+
+    // CONTINUATION discipline: while a header block is open, only
+    // CONTINUATION for the same stream is legal.
+    if (sess->cont_stream != 0 && (msg->type != H2_CONTINUATION ||
+                                   msg->stream_id != sess->cont_stream)) {
+        s->SetFailedWithError(TERR_REQUEST);
+        return;
+    }
+
+    switch (msg->type) {
+        case H2_SETTINGS: {
+            if (msg->flags & kFlagAck) break;
+            const std::string p = msg->payload.to_string();
+            for (size_t off = 0; off + 6 <= p.size(); off += 6) {
+                uint16_t id;
+                uint32_t value;
+                memcpy(&id, p.data() + off, 2);
+                memcpy(&value, p.data() + off + 2, 4);
+                id = ntohs(id);
+                value = ntohl(value);
+                if (id == 0x4) {  // SETTINGS_INITIAL_WINDOW_SIZE
+                    std::lock_guard<std::mutex> g(sess->mu);
+                    const int64_t delta =
+                        (int64_t)value - sess->peer_initial_window;
+                    sess->peer_initial_window = value;
+                    for (auto& kv : sess->streams) {
+                        kv.second.send_window += delta;
+                    }
+                    sess->WakeWindowWaiters();
+                }
+            }
+            SendRaw(s.get(), BuildFrame(H2_SETTINGS, kFlagAck, 0, ""));
+            break;
+        }
+        case H2_PING: {
+            if (msg->flags & kFlagAck) break;
+            SendRaw(s.get(), BuildFrame(H2_PING, kFlagAck, 0,
+                                        msg->payload.to_string()));
+            break;
+        }
+        case H2_WINDOW_UPDATE: {
+            if (msg->payload.size() != 4) break;
+            uint32_t inc;
+            msg->payload.copy_to(&inc, 4);
+            inc = ntohl(inc) & 0x7fffffffu;
+            std::lock_guard<std::mutex> g(sess->mu);
+            if (msg->stream_id == 0) {
+                sess->conn_send_window += inc;
+            } else {
+                auto it = sess->streams.find(msg->stream_id);
+                if (it != sess->streams.end()) {
+                    it->second.send_window += inc;
+                }
+            }
+            sess->WakeWindowWaiters();
+            break;
+        }
+        case H2_HEADERS: {
+            IOBuf frag = std::move(msg->payload);
+            if (msg->flags & kFlagPadded) {
+                uint8_t pad;
+                if (frag.size() < 1) break;
+                frag.cutn(&pad, 1);
+                if ((size_t)pad > frag.size()) break;
+                IOBuf tmp;
+                frag.cutn(&tmp, frag.size() - pad);
+                frag.swap(tmp);
+            }
+            if (msg->flags & kFlagPriority) {
+                if (frag.size() < 5) break;
+                IOBuf drop;
+                frag.cutn(&drop, 5);
+            }
+            sess->header_block += frag.to_string();
+            if (msg->flags & kFlagEndHeaders) {
+                HandleHeaderBlockDone(s.get(), sess, msg->stream_id,
+                                      msg->flags);
+            } else {
+                sess->cont_stream = msg->stream_id;
+                sess->cont_flags = msg->flags;
+            }
+            break;
+        }
+        case H2_CONTINUATION: {
+            if (sess->cont_stream == 0) {
+                // CONTINUATION without an open header block: connection
+                // error (RFC 7540 §6.10) — accepting it would pollute
+                // the shared HPACK state.
+                s->SetFailedWithError(TERR_REQUEST);
+                return;
+            }
+            sess->header_block += msg->payload.to_string();
+            if (msg->flags & kFlagEndHeaders) {
+                const uint8_t hf = sess->cont_flags;
+                sess->cont_stream = 0;
+                HandleHeaderBlockDone(s.get(), sess, msg->stream_id, hf);
+            }
+            break;
+        }
+        case H2_DATA: {
+            const size_t sz = msg->payload.size();
+            IOBuf frag = std::move(msg->payload);
+            if (msg->flags & kFlagPadded) {
+                uint8_t pad;
+                if (frag.size() < 1) break;
+                frag.cutn(&pad, 1);
+                if ((size_t)pad > frag.size()) break;
+                IOBuf tmp;
+                frag.cutn(&tmp, frag.size() - pad);
+                frag.swap(tmp);
+            }
+            bool dispatch = false;
+            std::vector<HpackHeader> req_headers;
+            IOBuf req_body;
+            {
+                std::lock_guard<std::mutex> g(sess->mu);
+                auto it = sess->streams.find(msg->stream_id);
+                if (it == sess->streams.end()) break;  // reset/unknown
+                H2Stream& st = it->second;
+                if (st.dispatched) break;  // trailing DATA after dispatch
+                st.body.append(frag);
+                if (msg->flags & kFlagEndStream) {
+                    st.end_stream = true;
+                    st.dispatched = true;
+                    dispatch = true;
+                    req_headers = std::move(st.headers);
+                    req_body.swap(st.body);
+                }
+            }
+            // Receive-side flow control: replenish what we consumed
+            // (conn + stream), per-frame (simple and legal).
+            if (sz > 0) {
+                uint32_t inc = htonl((uint32_t)sz);
+                std::string p((const char*)&inc, 4);
+                std::string out = BuildFrame(H2_WINDOW_UPDATE, 0, 0, p);
+                if (!(msg->flags & kFlagEndStream)) {
+                    out += BuildFrame(H2_WINDOW_UPDATE, 0, msg->stream_id,
+                                      p);
+                }
+                SendRaw(s.get(), out);
+            }
+            if (dispatch) {
+                DispatchCompleteStream(s.get(), sess, msg->stream_id,
+                                       std::move(req_headers),
+                                       std::move(req_body));
+            }
+            break;
+        }
+        case H2_RST_STREAM: {
+            std::lock_guard<std::mutex> g(sess->mu);
+            sess->streams.erase(msg->stream_id);
+            break;
+        }
+        case H2_GOAWAY:
+            sess->goaway = true;
+            break;
+        case H2_PRIORITY:
+        default:
+            break;  // ignored
+    }
+}
+
+int g_h2_index = -1;
+
+}  // namespace
+
+void RegisterHttp2Protocol() {
+    if (g_h2_index >= 0) return;
+    Protocol p;
+    p.parse = ParseH2;
+    p.process = ProcessH2;
+    p.name = "h2c";
+    // Frame handling mutates per-connection session state: must run on
+    // the input fiber in frame order (user code is dispatched off it).
+    p.process_in_order = true;
+    g_h2_index = RegisterProtocol(p);
+}
+
+int Http2ProtocolIndex() { return g_h2_index; }
+
+}  // namespace tpurpc

@@ -1,0 +1,21 @@
+// HTTP/2 (h2c) server-side protocol + gRPC service dispatch.
+//
+// Reference: src/brpc/policy/http2_rpc_protocol.cpp:1844 + details/hpack.*
+// + src/brpc/grpc.{h,cpp}. Scope here (re-designed, not translated):
+// SERVER side over cleartext prior knowledge — the path real gRPC clients
+// (grpcio) and `curl --http2-prior-knowledge` use against in-cluster
+// services. Covered: connection preface, SETTINGS exchange/ack, HEADERS +
+// CONTINUATION with full HPACK decoding, DATA with both-direction flow
+// control (WINDOW_UPDATE), PING ack, RST_STREAM, GOAWAY; gRPC unary calls
+// (application/grpc content type, 5-byte length-prefixed messages,
+// grpc-status trailers) dispatch into the same pb services as tpu_std;
+// plain h2 requests route through the HTTP handler/json-RPC paths.
+// Client-side h2 and TLS/ALPN are roadmap.
+#pragma once
+
+namespace tpurpc {
+
+void RegisterHttp2Protocol();  // idempotent
+int Http2ProtocolIndex();
+
+}  // namespace tpurpc

@@ -43,6 +43,8 @@ ParseResult ParseHttp(IOBuf* source, Socket* s, bool read_eof, const void*) {
     return ParseResult::make_ok(msg);
 }
 
+}  // namespace
+
 // Error strings embedded in json bodies: strip characters that would
 // break the syntax (quotes, backslashes, control bytes).
 static std::string json_safe_text(std::string s) {
@@ -135,6 +137,9 @@ bool DispatchHttpRpc(Server* server, const HttpRequest& req,
                                : (res->status == 200 ? 0 : res->status));
     return true;
 }
+
+
+namespace {
 
 void ProcessHttp(InputMessageBase* msg_base) {
     std::unique_ptr<HttpInputMessage> msg((HttpInputMessage*)msg_base);

@@ -94,6 +94,8 @@ int Socket::Create(const SocketOptions& options, SocketId* id) {
     // the socket, which must still deliver the notification.
     s->on_recycle_ = options.on_recycle;
     s->recycle_arg_ = options.recycle_arg;
+    s->conn_data_ = nullptr;
+    s->conn_data_deleter_ = nullptr;
     s->bytes_read_.store(0, std::memory_order_relaxed);
     s->bytes_written_.store(0, std::memory_order_relaxed);
     s->created_us_ = monotonic_time_us();
@@ -287,6 +289,11 @@ void Socket::DropWriteRequest(WriteRequest* req) {
 void Socket::OnRecycle() {
     CloseFdAndDropQueued();
     read_buf.clear();
+    if (conn_data_ != nullptr) {
+        if (conn_data_deleter_ != nullptr) conn_data_deleter_(conn_data_);
+        conn_data_ = nullptr;
+        conn_data_deleter_ = nullptr;
+    }
     if (transport_ != nullptr) {
         if (owns_transport_) transport_->Release();
         transport_ = nullptr;

@@ -127,6 +127,14 @@ public:
     // ---- per-connection parsing state (owned by InputMessenger) ----
     IOPortal read_buf;
     int preferred_protocol_index = -1;
+    // Protocol-private per-connection state (e.g. the HTTP/2 session:
+    // HPACK context + stream table). Owned by the socket once set; the
+    // deleter runs at recycle. Set from the input fiber only.
+    void set_conn_data(void* data, void (*deleter)(void*)) {
+        conn_data_ = data;
+        conn_data_deleter_ = deleter;
+    }
+    void* conn_data() const { return conn_data_; }
     // Correlation of in-flight requests awaiting responses could hang off
     // here later (pipelined protocols).
 
@@ -226,6 +234,8 @@ private:
     std::atomic<int64_t> bytes_written_{0};
     int64_t created_us_ = 0;
     std::atomic<int64_t> last_active_us_{0};
+    void* conn_data_ = nullptr;
+    void (*conn_data_deleter_)(void*) = nullptr;
 };
 
 }  // namespace tpurpc

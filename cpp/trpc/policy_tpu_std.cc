@@ -11,6 +11,7 @@
 #include "tbase/flags.h"
 #include "tbase/logging.h"
 #include "tbase/time.h"
+#include "thttp/http2_protocol.h"
 #include "thttp/http_protocol.h"
 #include "tici/shm_link.h"
 #include "tnet/input_messenger.h"
@@ -386,6 +387,7 @@ void GlobalInitializeOrDie() {
         g_tpu_std_index = RegisterProtocol(p);
         stream_internal::RegisterStreamProtocolOrDie();
         RegisterIciHandshakeProtocol();
+        RegisterHttp2Protocol();
         RegisterHttpProtocol();
     });
 }

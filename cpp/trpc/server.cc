@@ -8,6 +8,7 @@
 #include "tfiber/butex.h"
 #include "tfiber/fiber.h"
 #include "thttp/builtin_services.h"
+#include "thttp/http2_protocol.h"
 #include "tvar/default_variables.h"
 #include "tici/shm_link.h"
 #include "trpc/policy_tpu_std.h"
@@ -96,7 +97,9 @@ int Server::StartNoListen(const ServerOptions* options) {
     messenger_.add_protocol(IciHandshakeProtocolIndex());
     // The observability portal rides the same port (reference
     // server.cpp:499 AddBuiltinServices — builtins are plain services on
-    // the one acceptor).
+    // the one acceptor). h2c must sniff BEFORE HTTP/1: the "PRI *
+    // HTTP/2.0" preface looks like a request line to an HTTP/1 parser.
+    messenger_.add_protocol(Http2ProtocolIndex());
     messenger_.add_protocol(HttpProtocolIndex());
     AddBuiltinHttpServices(this);
     messenger_.context = this;

@@ -3,7 +3,6 @@
 Layers (mirroring SURVEY.md's layer map, re-designed TPU-first):
   - C++ core (cpp/): zero-copy IOBuf, M:N fiber runtime, wait-free socket
     write path, framed protocols, client/server stacks, metrics, portal.
-  - brpc_tpu.runtime: ctypes bindings over the C ABI (libtpurpc.so).
   - brpc_tpu.parallel: the collective data plane — ParallelChannel /
     PartitionChannel fan-out lowered to XLA collectives over a jax Mesh.
 """

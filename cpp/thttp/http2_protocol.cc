@@ -61,6 +61,39 @@ constexpr uint8_t kFlagAck = 0x1;
 
 constexpr int64_t kDefaultWindow = 65535;
 constexpr uint32_t kMaxFrameSize = 16384;
+// Hardening caps on untrusted input (one connection must not be able to
+// buffer unbounded memory; same posture as the shm link's hostile-
+// descriptor checks and HPACK's kMaxHeaderBytes).
+constexpr size_t kMaxBodyBytes = 64u << 20;
+constexpr size_t kMaxHeaderBlock = 64u << 10;
+constexpr size_t kMaxStreams = 256;
+
+// Append a HEADERS frame, splitting into CONTINUATION frames when the
+// block exceeds the peer's max frame size (an oversize frame is a
+// connection error that would kill every stream).
+void AppendFrame(std::string* out, uint8_t type, uint8_t flags,
+                 uint32_t stream, const char* payload, size_t len);
+void AppendHeadersFrames(std::string* out, uint8_t flags, uint32_t stream,
+                         const std::string& block) {
+    if (block.size() <= kMaxFrameSize) {
+        AppendFrame(out, H2_HEADERS, flags, stream, block.data(),
+                    block.size());
+        return;
+    }
+    const uint8_t end_stream = flags & kFlagEndStream;
+    size_t off = 0;
+    AppendFrame(out, H2_HEADERS, end_stream, stream, block.data(),
+                kMaxFrameSize);
+    off += kMaxFrameSize;
+    while (off < block.size()) {
+        const size_t n = std::min<size_t>(kMaxFrameSize,
+                                          block.size() - off);
+        const bool last = off + n >= block.size();
+        AppendFrame(out, H2_CONTINUATION, last ? kFlagEndHeaders : 0,
+                    stream, block.data() + off, n);
+        off += n;
+    }
+}
 
 // Append a frame header + payload onto *out (no intermediate copies; the
 // DATA path appends body slices directly — IOBuf-native zero-copy DATA is
@@ -144,11 +177,12 @@ void WriteResponse(
     H2Session* sess = session_of(s.get());
     if (sess == nullptr) return;
 
-    std::string out =
-        BuildFrame(H2_HEADERS, trailers.empty() && body.empty()
-                                   ? (uint8_t)(kFlagEndHeaders | kFlagEndStream)
-                                   : kFlagEndHeaders,
-                   stream_id, EncodeHeaderBlock(headers));
+    std::string out;
+    AppendHeadersFrames(&out,
+                        trailers.empty() && body.empty()
+                            ? (uint8_t)(kFlagEndHeaders | kFlagEndStream)
+                            : kFlagEndHeaders,
+                        stream_id, EncodeHeaderBlock(headers));
     size_t sent = 0;
     // A window-starving client must not pin this fiber (and its
     // concurrency slot) forever: give up after a bounded stall and reset
@@ -216,9 +250,9 @@ void WriteResponse(
         }
     }
     if (!trailers.empty()) {
-        out += BuildFrame(H2_HEADERS,
-                          (uint8_t)(kFlagEndHeaders | kFlagEndStream),
-                          stream_id, EncodeHeaderBlock(trailers));
+        AppendHeadersFrames(&out,
+                            (uint8_t)(kFlagEndHeaders | kFlagEndStream),
+                            stream_id, EncodeHeaderBlock(trailers));
     } else if (!body.empty()) {
         out += BuildFrame(H2_DATA, kFlagEndStream, stream_id, "");
     }
@@ -253,6 +287,25 @@ struct GrpcCallCtx {
     Controller cntl;
 };
 
+// gRPC spec: grpc-message is percent-encoded (and h2 forbids CR/LF/NUL
+// in field values) — a raw multi-line error text would be a protocol
+// error that masks the application's failure detail.
+std::string PercentEncodeGrpcMessage(const std::string& s) {
+    std::string out;
+    out.reserve(s.size());
+    static const char* hex = "0123456789ABCDEF";
+    for (unsigned char ch : s) {
+        if (ch >= 0x20 && ch <= 0x7e && ch != '%') {
+            out.push_back((char)ch);
+        } else {
+            out.push_back('%');
+            out.push_back(hex[ch >> 4]);
+            out.push_back(hex[ch & 0xf]);
+        }
+    }
+    return out;
+}
+
 void* RunGrpcCall(void* arg) {
     std::unique_ptr<GrpcCallCtx> c((GrpcCallCtx*)arg);
     struct SyncDone : google::protobuf::Closure {
@@ -267,7 +320,8 @@ void* RunGrpcCall(void* arg) {
     if (c->cntl.Failed()) {
         // grpc-status 2 (UNKNOWN) carries the application error.
         trailers = {{"grpc-status", "2"},
-                    {"grpc-message", c->cntl.ErrorText()}};
+                    {"grpc-message",
+                     PercentEncodeGrpcMessage(c->cntl.ErrorText())}};
     } else {
         std::string pb;
         c->res->SerializeToString(&pb);
@@ -293,7 +347,7 @@ void RespondGrpcError(SocketId sid, uint32_t stream_id, int code,
                    {"content-type", "application/grpc"}},
                   "",
                   {{"grpc-status", std::to_string(code)},
-                   {"grpc-message", msg}});
+                   {"grpc-message", PercentEncodeGrpcMessage(msg)}});
 }
 
 // Plain h2 request -> the shared HTTP handler/json-RPC routing.
@@ -505,7 +559,21 @@ void HandleHeaderBlockDone(Socket* s, H2Session* sess, uint32_t stream_id,
     const bool complete = (flags & kFlagEndStream) != 0;
     {
         std::lock_guard<std::mutex> g(sess->mu);
-        H2Stream& st = sess->streams[stream_id];
+        auto it = sess->streams.find(stream_id);
+        if (it != sess->streams.end() && it->second.dispatched) {
+            // Duplicate HEADERS / request trailers after END_STREAM:
+            // already dispatched — dispatching again would double-run
+            // the method and interleave two responses on one stream.
+            return;
+        }
+        if (it == sess->streams.end() &&
+            sess->streams.size() >= kMaxStreams) {
+            s->SetFailedWithError(TERR_OVERCROWDED);  // stream flood
+            return;
+        }
+        H2Stream& st = it != sess->streams.end()
+                           ? it->second
+                           : sess->streams[stream_id];
         st.send_window = sess->peer_initial_window;
         st.headers = std::move(headers);
         st.end_stream = complete;
@@ -605,6 +673,10 @@ void ProcessH2(InputMessageBase* raw) {
                 frag.cutn(&drop, 5);
             }
             sess->header_block += frag.to_string();
+            if (sess->header_block.size() > kMaxHeaderBlock) {
+                s->SetFailedWithError(TERR_REQUEST);
+                return;
+            }
             if (msg->flags & kFlagEndHeaders) {
                 HandleHeaderBlockDone(s.get(), sess, msg->stream_id,
                                       msg->flags);
@@ -623,6 +695,10 @@ void ProcessH2(InputMessageBase* raw) {
                 return;
             }
             sess->header_block += msg->payload.to_string();
+            if (sess->header_block.size() > kMaxHeaderBlock) {
+                s->SetFailedWithError(TERR_REQUEST);
+                return;
+            }
             if (msg->flags & kFlagEndHeaders) {
                 const uint8_t hf = sess->cont_flags;
                 sess->cont_stream = 0;
@@ -643,30 +719,39 @@ void ProcessH2(InputMessageBase* raw) {
                 frag.swap(tmp);
             }
             bool dispatch = false;
+            bool known_stream = false;
             std::vector<HpackHeader> req_headers;
             IOBuf req_body;
             {
                 std::lock_guard<std::mutex> g(sess->mu);
                 auto it = sess->streams.find(msg->stream_id);
-                if (it == sess->streams.end()) break;  // reset/unknown
-                H2Stream& st = it->second;
-                if (st.dispatched) break;  // trailing DATA after dispatch
-                st.body.append(frag);
-                if (msg->flags & kFlagEndStream) {
-                    st.end_stream = true;
-                    st.dispatched = true;
-                    dispatch = true;
-                    req_headers = std::move(st.headers);
-                    req_body.swap(st.body);
+                if (it != sess->streams.end() && !it->second.dispatched) {
+                    known_stream = true;
+                    H2Stream& st = it->second;
+                    st.body.append(frag);
+                    if (st.body.size() > kMaxBodyBytes) {
+                        s->SetFailedWithError(TERR_OVERCROWDED);
+                        return;
+                    }
+                    if (msg->flags & kFlagEndStream) {
+                        st.end_stream = true;
+                        st.dispatched = true;
+                        dispatch = true;
+                        req_headers = std::move(st.headers);
+                        req_body.swap(st.body);
+                    }
                 }
             }
-            // Receive-side flow control: replenish what we consumed
-            // (conn + stream), per-frame (simple and legal).
+            // Receive-side flow control: ALWAYS replenish the connection
+            // window (even for unknown/reset streams — dropping those
+            // bytes silently shrinks the peer's view of the window until
+            // every upload on the connection wedges); the stream window
+            // only while the stream still consumes.
             if (sz > 0) {
                 uint32_t inc = htonl((uint32_t)sz);
                 std::string p((const char*)&inc, 4);
                 std::string out = BuildFrame(H2_WINDOW_UPDATE, 0, 0, p);
-                if (!(msg->flags & kFlagEndStream)) {
+                if (known_stream && !(msg->flags & kFlagEndStream)) {
                     out += BuildFrame(H2_WINDOW_UPDATE, 0, msg->stream_id,
                                       p);
                 }

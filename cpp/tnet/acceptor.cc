@@ -168,8 +168,12 @@ void Acceptor::OnNewConnections(Socket* listen_socket) {
         opts.recycle_arg = a;
         // Account BEFORE Create: the socket can fail+recycle (firing the
         // callback) before Create even returns; the liveness-checked
-        // insert below then skips the already-recycled id.
+        // insert below then skips the already-recycled id. The accepted
+        // counter too — a connection can serve a whole RPC between
+        // Create (epoll registration) and any later increment, so
+        // observers would otherwise see served > accepted.
         a->live_conns_.fetch_add(1, std::memory_order_acq_rel);
+        a->accepted_.fetch_add(1, std::memory_order_relaxed);
         SocketId id;
         if (Socket::Create(opts, &id) != 0) {
             // Create closed fd and fired the callback (which balanced the
@@ -197,7 +201,6 @@ void Acceptor::OnNewConnections(Socket* listen_socket) {
         if (listen_socket->Failed()) {
             Socket::SetFailedById(id);
         }
-        a->accepted_.fetch_add(1, std::memory_order_relaxed);
     }
 }
 

@@ -189,10 +189,14 @@ void Server::RegisterHttpHandler(const std::string& path,
         LOG(ERROR) << "RegisterHttpHandler(" << path << ") after Start";
         return;
     }
+    // First registration wins: user handlers are registered before Start,
+    // builtins during Start — so users can override/front-run builtin
+    // pages (rpc_view proxies them this way).
     if (path.size() >= 2 && path.compare(path.size() - 2, 2, "/*") == 0) {
-        http_prefix_[path.substr(0, path.size() - 2)] = std::move(handler);
+        http_prefix_.emplace(path.substr(0, path.size() - 2),
+                             std::move(handler));
     } else {
-        http_exact_[path] = std::move(handler);
+        http_exact_.emplace(path, std::move(handler));
     }
 }
 

@@ -1,0 +1,124 @@
+// rpc_view: proxy that renders another server's builtin portal pages
+// (reference tools/rpc_view — point a browser at a box that can't be
+// reached directly, or aggregate a remote server's /status /vars /rpcz).
+//
+//   rpc_view --server=ip:port [--port=8888]
+//
+// GET <path> on the view port fetches http://server<path> and relays the
+// body. The view server is a normal tpurpc Server, so it also serves its
+// OWN portal under /view-self/*.
+#include <netinet/in.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cstdio>
+#include <cstring>
+#include <string>
+
+#include "tbase/endpoint.h"
+#include "thttp/http_message.h"
+#include "trpc/server.h"
+
+using namespace tpurpc;
+
+namespace {
+
+EndPoint g_target;
+
+// Minimal blocking HTTP/1.1 GET (Connection: close).
+bool FetchFromTarget(const std::string& path, std::string* status_line,
+                     std::string* body) {
+    const int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (fd < 0) return false;
+    sockaddr_in addr;
+    endpoint2sockaddr(g_target, &addr);
+    if (::connect(fd, (sockaddr*)&addr, sizeof(addr)) != 0) {
+        close(fd);
+        return false;
+    }
+    const std::string req = "GET " + path +
+                            " HTTP/1.1\r\nHost: view\r\nConnection: "
+                            "close\r\n\r\n";
+    if (write(fd, req.data(), req.size()) != (ssize_t)req.size()) {
+        close(fd);
+        return false;
+    }
+    std::string raw;
+    char buf[8192];
+    ssize_t r;
+    while ((r = read(fd, buf, sizeof(buf))) > 0) raw.append(buf, (size_t)r);
+    close(fd);
+    const size_t eol = raw.find("\r\n");
+    const size_t hdr_end = raw.find("\r\n\r\n");
+    if (eol == std::string::npos || hdr_end == std::string::npos) {
+        return false;
+    }
+    *status_line = raw.substr(0, eol);
+    *body = raw.substr(hdr_end + 4);
+    return true;
+}
+
+void HandleProxy(Server*, const HttpRequest& req, HttpResponse* res) {
+    std::string status_line, body;
+    const std::string path =
+        req.query.empty() ? req.path : req.path + "?" + req.query;
+    if (!FetchFromTarget(path, &status_line, &body)) {
+        res->status = 502;
+        res->set_content_type("text/plain");
+        res->Append("cannot reach " + endpoint2str(g_target) + "\n");
+        return;
+    }
+    // "HTTP/1.1 200 OK" -> 200
+    const size_t sp = status_line.find(' ');
+    if (sp != std::string::npos) {
+        res->status = atoi(status_line.c_str() + sp + 1);
+    }
+    res->set_content_type("text/plain");
+    res->Append(body);
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+    std::string server_str;
+    int port = 8888;
+    for (int i = 1; i < argc; ++i) {
+        if (strncmp(argv[i], "--server=", 9) == 0) server_str = argv[i] + 9;
+        if (strncmp(argv[i], "--port=", 7) == 0) port = atoi(argv[i] + 7);
+    }
+    if (server_str.empty()) {
+        fprintf(stderr, "usage: rpc_view --server=ip:port [--port=N]\n");
+        return 1;
+    }
+    if (hostname2endpoint(server_str.c_str(), &g_target) != 0) {
+        fprintf(stderr, "bad server address: %s\n", server_str.c_str());
+        return 1;
+    }
+    Server server;
+    // Proxy the portal pages + everything else. User registrations are
+    // first-wins, so these front-run the view server's own builtins.
+    for (const char* p :
+         {"/", "/health", "/status", "/vars", "/flags", "/connections",
+          "/rpcz", "/fibers", "/metrics"}) {
+        server.RegisterHttpHandler(p, HandleProxy);
+    }
+    server.RegisterHttpHandler("/*", HandleProxy);
+    EndPoint listen;
+    str2endpoint("0.0.0.0", port, &listen);
+    if (server.Start(listen, nullptr) != 0) {
+        fprintf(stderr, "cannot listen on %d\n", port);
+        return 1;
+    }
+    printf("viewing %s on http://0.0.0.0:%d/ (e.g. /status, /vars, "
+           "/rpcz)\n",
+           endpoint2str(g_target).c_str(), server.listened_port());
+    fflush(stdout);
+    // Serve until stdin closes (same convention as echo_bench's child).
+    char buf[16];
+    while (read(0, buf, sizeof(buf)) > 0) {
+    }
+    server.Stop();
+    server.Join();
+    fflush(nullptr);
+    _exit(0);
+}

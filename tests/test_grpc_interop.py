@@ -97,3 +97,40 @@ def test_curl_http2_portal_and_json_rpc(server):
         capture_output=True, text=True, timeout=30, check=True,
     )
     assert "4242" in echo.stdout
+
+
+def test_grpcio_large_payload_flow_control(server, echo_pb):
+    """A 300KB response exceeds the 65535-byte initial h2 windows: the
+    server's DATA path must chunk frames and park on the client's
+    WINDOW_UPDATEs (the WriteResponse flow-control loop)."""
+    grpc = pytest.importorskip("grpc")
+    ch = grpc.insecure_channel(f"127.0.0.1:{server}")
+    stub = ch.unary_unary(
+        "/benchpb.EchoService/Echo",
+        request_serializer=echo_pb.EchoRequest.SerializeToString,
+        response_deserializer=echo_pb.EchoResponse.FromString,
+    )
+    blob = bytes(range(256)) * 1200  # 300KB, non-trivial content
+    res = stub(echo_pb.EchoRequest(send_ts_us=7, payload=blob), timeout=30)
+    assert res.payload == blob
+    ch.close()
+
+
+def test_curl_http2_large_json_response(server):
+    """Large json body over h2c exercises DATA chunking with curl's
+    flow control."""
+    import base64
+    import json as jsonlib
+    import tempfile
+    blob = b"x" * 200000
+    req = jsonlib.dumps(
+        {"send_ts_us": 1, "payload": base64.b64encode(blob).decode()})
+    with tempfile.NamedTemporaryFile("w", suffix=".json") as f:
+        f.write(req)
+        f.flush()
+        out = subprocess.run(
+            ["curl", "-sS", "--http2-prior-knowledge", "-d", f"@{f.name}",
+             f"http://127.0.0.1:{server}/EchoService/Echo"],
+            capture_output=True, text=True, timeout=60, check=True,
+        )
+    assert len(out.stdout) > 200000

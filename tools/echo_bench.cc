@@ -57,6 +57,9 @@ public:
             fiber_usleep(FLAGS_echo_slow_us.get());
         }
         response->set_send_ts_us(request->send_ts_us());
+        if (request->has_payload()) {
+            response->set_payload(request->payload());
+        }
         cntl->response_attachment().append(cntl->request_attachment());
         done->Run();
     }

@@ -390,6 +390,8 @@ TEST(Fiber, PingPongThroughput) {
 // fibers without leaking values ("session data reuse").
 
 #include "tfiber/fiber_key.h"
+#include "tfiber/task_group.h"
+#include "tfiber/task_meta.h"
 
 namespace {
 std::atomic<int> g_fls_dtor_runs{0};
@@ -571,4 +573,90 @@ TEST(FiberOnce, RunsExactlyOnceAcrossFibers) {
     for (auto tid : tids) fiber_join(tid, nullptr);
     EXPECT_EQ(g_once_runs.load(), 1);
     EXPECT_EQ(ctx.after.load(), 8);
+}
+
+// ---------------- worker tags ----------------
+// Reference: bthread_tag_t (types.h:37-39) — nonzero tags get an
+// ISOLATED worker pool; tagged work can neither starve nor be starved by
+// the default pool, and cross-pool wakeups land on the right pool.
+
+TEST(WorkerTags, TaggedFibersRunOnTheirOwnPool) {
+    struct Ctx {
+        std::atomic<int> ok{0};
+        std::atomic<int> wrong_pool{0};
+    } ctx;
+    FiberAttr tagged = FIBER_ATTR_NORMAL;
+    tagged.tag = 7;
+    std::vector<fiber_t> tids(6);
+    for (auto& tid : tids) {
+        fiber_start_background(
+            &tid, &tagged,
+            [](void* arg) -> void* {
+                Ctx* c = (Ctx*)arg;
+                TaskGroup* g = TaskGroup::tls_group();
+                if (g == nullptr ||
+                    g->control() != TaskControl::of_tag(7)) {
+                    c->wrong_pool.fetch_add(1);
+                }
+                fiber_usleep(2000);  // park + resume: still our pool
+                g = TaskGroup::tls_group();
+                if (g == nullptr ||
+                    g->control() != TaskControl::of_tag(7)) {
+                    c->wrong_pool.fetch_add(1);
+                    return nullptr;
+                }
+                c->ok.fetch_add(1);
+                return nullptr;
+            },
+            &ctx);
+    }
+    for (auto tid : tids) fiber_join(tid, nullptr);
+    EXPECT_EQ(ctx.ok.load(), 6);
+    EXPECT_EQ(ctx.wrong_pool.load(), 0);
+}
+
+TEST(WorkerTags, TaggedPoolNotStarvedByDefaultPool) {
+    // Saturate the DEFAULT pool with spinning fibers; a tagged fiber must
+    // still make progress promptly on its own workers.
+    std::atomic<bool> stop{false};
+    std::vector<fiber_t> hogs(16);
+    for (auto& tid : hogs) {
+        fiber_start_background(
+            &tid, nullptr,
+            [](void* arg) -> void* {
+                auto* s = (std::atomic<bool>*)arg;
+                while (!s->load(std::memory_order_relaxed)) {
+                    // busy spin with occasional yield: keeps default
+                    // workers saturated.
+                    for (volatile int i = 0; i < 20000; ++i) {
+                    }
+                    fiber_yield();
+                }
+                return nullptr;
+            },
+            &stop);
+    }
+    FiberAttr tagged = FIBER_ATTR_NORMAL;
+    tagged.tag = 9;
+    std::atomic<int64_t> latency_us{-1};
+    struct Ctx {
+        std::atomic<int64_t>* lat;
+        int64_t t0;
+    } ctx{&latency_us, monotonic_time_us()};
+    fiber_t tid;
+    fiber_start_background(
+        &tid, &tagged,
+        [](void* arg) -> void* {
+            Ctx* c = (Ctx*)arg;
+            c->lat->store(monotonic_time_us() - c->t0);
+            return nullptr;
+        },
+        &ctx);
+    fiber_join(tid, nullptr);
+    stop.store(true);
+    for (auto t : hogs) fiber_join(t, nullptr);
+    EXPECT_GE(latency_us.load(), 0);
+    // Scheduled on its own pool: starts quickly despite the saturated
+    // default pool (generous bound for the 1-core CI box).
+    EXPECT_LT(latency_us.load(), 200 * 1000);
 }

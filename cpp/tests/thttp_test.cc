@@ -249,6 +249,7 @@ TEST(HttpPortal, LivePortalOverTcp) {
 
     const std::string fibers =
         fetch("GET /fibers HTTP/1.1\r\nHost: x\r\n\r\n");
+    EXPECT_TRUE(fibers.find("pool tag=0") != std::string::npos);
     EXPECT_TRUE(fibers.find("workers: ") != std::string::npos);
     EXPECT_TRUE(fibers.find("live_fibers: ") != std::string::npos);
 

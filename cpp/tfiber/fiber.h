@@ -16,11 +16,15 @@ constexpr fiber_t INVALID_FIBER = 0;
 
 struct FiberAttr {
     int stack_type = 1;  // STACK_TYPE_NORMAL
+    // Worker tag (reference bthread_tag_t): 0 = the default pool;
+    // nonzero tags run on their own isolated worker pool, so tagged
+    // workloads cannot starve (or be starved by) the default pool.
+    int tag = 0;
 };
 
-constexpr FiberAttr FIBER_ATTR_NORMAL = {1};
-constexpr FiberAttr FIBER_ATTR_SMALL = {0};
-constexpr FiberAttr FIBER_ATTR_LARGE = {2};
+constexpr FiberAttr FIBER_ATTR_NORMAL = {1, 0};
+constexpr FiberAttr FIBER_ATTR_SMALL = {0, 0};
+constexpr FiberAttr FIBER_ATTR_LARGE = {2, 0};
 
 // Start a fiber. `urgent` hints the scheduler to run it ASAP (the caller of
 // start_background keeps running; reference bthread.h start_urgent vs

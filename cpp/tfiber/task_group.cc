@@ -5,6 +5,7 @@
 #include <unistd.h>
 
 #include <algorithm>
+#include <map>
 #include <cerrno>
 #include <thread>
 
@@ -20,6 +21,8 @@
 // cores+1 via FLAGS_bthread_concurrency; a fixed count would cap
 // throughput on many-core TPU-VM hosts).
 DEFINE_int32(fiber_worker_count, 0, "number of fiber worker pthreads");
+DEFINE_int32(fiber_tagged_worker_count, 2,
+             "worker pthreads per nonzero worker tag pool");
 
 namespace tpurpc {
 
@@ -191,7 +194,7 @@ void TaskGroup::sched_park() {
 
 namespace {
 void requeue_meta_cb(void* arg) {
-    TaskControl::singleton()->ready_to_run((TaskMeta*)arg);
+    fiber_requeue_meta((TaskMeta*)arg);
 }
 }  // namespace
 
@@ -216,14 +219,59 @@ TaskControl* TaskControl::singleton() {
     return c;
 }
 
+// Tags are bounded (reference validates against task_group_ntags the
+// same way): each pool is 2+ permanent pthreads, so an unvalidated
+// dynamic tag would leak threads without bound. Lock-free fast path via
+// a fixed atomic array — spawns on hot tagged pools must not contend on
+// a registry mutex.
+static constexpr int kMaxWorkerTag = 64;
+static std::atomic<TaskControl*> g_tag_pools[kMaxWorkerTag];
+
+TaskControl* TaskControl::of_tag(int tag) {
+    if (tag <= 0) {
+        LOG_IF(ERROR, tag < 0) << "invalid worker tag " << tag
+                               << "; using the default pool";
+        return singleton();
+    }
+    if (tag >= kMaxWorkerTag) {
+        LOG(ERROR) << "worker tag " << tag << " out of range (max "
+                   << kMaxWorkerTag - 1 << "); using the default pool";
+        return singleton();
+    }
+    TaskControl* c = g_tag_pools[tag].load(std::memory_order_acquire);
+    if (c != nullptr) return c;
+    static std::mutex* mu = new std::mutex;
+    std::lock_guard<std::mutex> g(*mu);
+    c = g_tag_pools[tag].load(std::memory_order_relaxed);
+    if (c != nullptr) return c;
+    c = new TaskControl;
+    c->tag_ = tag;
+    g_tag_pools[tag].store(c, std::memory_order_release);
+    return c;
+}
+
+void TaskControl::ForEachPool(void (*fn)(int tag, TaskControl* c,
+                                         void* arg),
+                              void* arg) {
+    fn(0, singleton(), arg);
+    for (int t = 1; t < kMaxWorkerTag; ++t) {
+        TaskControl* c = g_tag_pools[t].load(std::memory_order_acquire);
+        if (c != nullptr) fn(t, c, arg);
+    }
+}
+
 void TaskControl::ensure_started() {
     if (started_.load(std::memory_order_acquire)) return;
     std::lock_guard<std::mutex> g(start_mu_);
     if (started_.load(std::memory_order_relaxed)) return;
-    concurrency_ = FLAGS_fiber_worker_count.get();
-    if (concurrency_ <= 0) {
-        const unsigned hc = std::thread::hardware_concurrency();
-        concurrency_ = (int)std::max(4u, hc + 1);
+    if (tag_ != 0) {
+        concurrency_ = std::max(1, FLAGS_fiber_tagged_worker_count.get());
+    } else {
+        concurrency_ = FLAGS_fiber_worker_count.get();
+        if (concurrency_ <= 0) {
+            const unsigned hc = std::thread::hardware_concurrency();
+            concurrency_ = (int)std::max(4u, hc + 1);
+        }
     }
     groups_.reserve(concurrency_);
     for (int i = 0; i < concurrency_; ++i) {
@@ -247,7 +295,10 @@ void TaskControl::set_concurrency(int n) {
 
 void TaskControl::ready_to_run(TaskMeta* m) {
     TaskGroup* g = tls_task_group;
-    if (g != nullptr) {
+    // The local-queue shortcut is only valid on a worker of THIS pool: a
+    // tagged fiber woken from another pool's worker (or a plain pthread)
+    // must go through the remote queue of its own pool.
+    if (g != nullptr && g->control() == this) {
         g->ready_to_run(m);
     } else {
         ready_to_run_remote(m);
@@ -312,7 +363,8 @@ TaskMeta* fiber_meta_of(fiber_t tid) {
 }
 
 void fiber_requeue_meta(TaskMeta* m) {
-    TaskControl::singleton()->ready_to_run(m);
+    (m->control != nullptr ? m->control : TaskControl::singleton())
+        ->ready_to_run(m);
 }
 
 void fiber_requeue(fiber_t tid) {
@@ -322,7 +374,7 @@ void fiber_requeue(fiber_t tid) {
 
 static int start_fiber_impl(fiber_t* tid, const FiberAttr* attr,
                             void* (*fn)(void*), void* arg) {
-    TaskControl* c = TaskControl::singleton();
+    TaskControl* c = TaskControl::of_tag(attr != nullptr ? attr->tag : 0);
     c->ensure_started();
     ResourceId slot;
     TaskMeta* m = get_resource<TaskMeta>(&slot);
@@ -340,6 +392,7 @@ static int start_fiber_impl(fiber_t* tid, const FiberAttr* attr,
     // fake stack on this fiber's first switch-in.
     m->asan_fake = nullptr;
     m->stack_type = attr ? attr->stack_type : STACK_TYPE_NORMAL;
+    m->control = c;
     m->tid = ((fiber_t)m->version << 32) | (fiber_t)(slot + 1);
     if (!get_stack(&m->stack, m->stack_type, TaskGroup::fiber_entry)) {
         return_resource<TaskMeta>(slot);

@@ -53,6 +53,7 @@ public:
 
     TaskMeta* current() const { return cur_meta_; }
     int index() const { return index_; }
+    TaskControl* control() const { return control_; }
 
     // Steal interface for other groups.
     bool steal(TaskMeta** m) { return rq_.steal(m); }
@@ -88,6 +89,15 @@ private:
 class TaskControl {
 public:
     static TaskControl* singleton();
+    // Worker tags (reference bthread_tag_t, types.h:37-39): tag 0 is the
+    // default pool above; nonzero tags get their OWN isolated worker
+    // pool (queues, parking lot, workers) so latency-critical traffic
+    // cannot be starved by bulk work sharing the default pool. Pools are
+    // created on first use and live for the process.
+    static TaskControl* of_tag(int tag);
+    // Enumerate all live pools (default + tagged) for introspection.
+    static void ForEachPool(void (*fn)(int tag, TaskControl* c, void* arg),
+                            void* arg);
 
     // Idempotent; starts `concurrency` workers on first call.
     void ensure_started();
@@ -120,6 +130,7 @@ private:
     std::mutex remote_mu_;
     std::deque<TaskMeta*> remote_q_;
     ParkingLot parking_lot_;
+    int tag_ = 0;  // worker tag of this pool
 
     friend class TaskGroup;
 };

@@ -11,6 +11,7 @@
 
 namespace tpurpc {
 
+class TaskControl;
 class TaskGroup;
 
 struct TaskMeta {
@@ -32,6 +33,11 @@ struct TaskMeta {
 
     // Fiber-local storage (lazily created; reference bthread keytables).
     void* local_storage = nullptr;
+
+    // The worker pool this fiber belongs to (tag routing: a parked fiber
+    // must requeue to ITS pool, and cross-pool wakeups must not land on
+    // the waker's local queue).
+    TaskControl* control = nullptr;
 
     bool about_to_quit = false;
 

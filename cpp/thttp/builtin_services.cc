@@ -44,12 +44,18 @@ void HandleHealth(Server*, const HttpRequest&, HttpResponse* res) {
 // full per-fiber stack unwinding — TaskTracer — is roadmap).
 void HandleFibers(Server*, const HttpRequest&, HttpResponse* res) {
     res->set_content_type("text/plain");
-    TaskControl* c = TaskControl::singleton();
-    char line[256];
-    snprintf(line, sizeof(line),
-             "workers: %d\nlive_fibers: %lld\n"
-             "fiber_slots_allocated: %zu\n",
-             c->concurrency(), (long long)c->nfibers.load(),
+    TaskControl::ForEachPool(
+        [](int tag, TaskControl* c, void* arg) {
+            auto* r = (HttpResponse*)arg;
+            char line[256];
+            snprintf(line, sizeof(line),
+                     "pool tag=%d  workers: %d  live_fibers: %lld\n", tag,
+                     c->concurrency(), (long long)c->nfibers.load());
+            r->Append(line);
+        },
+        res);
+    char line[128];
+    snprintf(line, sizeof(line), "fiber_slots_allocated: %zu\n",
              ResourcePool<TaskMeta>::singleton()->size());
     res->Append(line);
 }

@@ -951,3 +951,46 @@ TEST(RpcDump, CaptureAndReplay) {
     EXPECT_EQ(ts.service.ncalls.load(), before + 10);
     unlink(dump_path.c_str());
 }
+
+// ---------------- server fiber tag ----------------
+// Reference: bthread_tag server option (example/bthread_tag_echo_c++) —
+// a server's user code runs on its own isolated worker pool.
+
+#include "tfiber/task_group.h"
+
+TEST(WorkerTags, ServerHandlersRunOnConfiguredPool) {
+    class PoolCheckService : public test::EchoService {
+    public:
+        void Echo(google::protobuf::RpcController*,
+                  const test::EchoRequest* req, test::EchoResponse* res,
+                  google::protobuf::Closure* done) override {
+            TaskGroup* g = TaskGroup::tls_group();
+            const bool right_pool =
+                g != nullptr && g->control() == TaskControl::of_tag(11);
+            res->set_message(right_pool ? req->message() : "WRONG-POOL");
+            done->Run();
+        }
+    };
+    PoolCheckService service;
+    Server server;
+    ASSERT_EQ(0, server.AddService(&service));
+    ServerOptions sopts;
+    sopts.fiber_tag = 11;
+    EndPoint listen;
+    str2endpoint("127.0.0.1:0", &listen);
+    ASSERT_EQ(0, server.Start(listen, &sopts));
+    EndPoint ep;
+    str2endpoint("127.0.0.1", server.listened_port(), &ep);
+    Channel ch;
+    ASSERT_EQ(0, ch.Init(ep, nullptr));
+    test::EchoService_Stub stub(&ch);
+    for (int i = 0; i < 4; ++i) {
+        Controller cntl;
+        test::EchoRequest req;
+        req.set_message("tagged");
+        test::EchoResponse res;
+        stub.Echo(&cntl, &req, &res, nullptr);
+        ASSERT_FALSE(cntl.Failed());
+        EXPECT_EQ(res.message(), "tagged");
+    }
+}

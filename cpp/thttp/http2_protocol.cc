@@ -454,7 +454,9 @@ void DispatchCompleteStream(Socket* s, H2Session* sess, uint32_t stream_id,
             return;
         }
         fiber_t tid;
-        if (fiber_start_background(&tid, nullptr, RunGrpcCall, ctx) != 0) {
+        FiberAttr attr = FIBER_ATTR_NORMAL;
+        attr.tag = server->options().fiber_tag;
+        if (fiber_start_background(&tid, &attr, RunGrpcCall, ctx) != 0) {
             RunGrpcCall(ctx);  // degrade inline
         }
         return;
@@ -477,7 +479,9 @@ void DispatchCompleteStream(Socket* s, H2Session* sess, uint32_t stream_id,
     }
     ctx->req.body = std::move(req_body);
     fiber_t tid;
-    if (fiber_start_background(&tid, nullptr, RunPlainCall, ctx) != 0) {
+    FiberAttr attr = FIBER_ATTR_NORMAL;
+    attr.tag = server->options().fiber_tag;
+    if (fiber_start_background(&tid, &attr, RunPlainCall, ctx) != 0) {
         RunPlainCall(ctx);
     }
     (void)sess;

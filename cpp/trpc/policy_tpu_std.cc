@@ -348,7 +348,9 @@ void ProcessTpuStdRequest(TpuStdMessage* msg, const rpc::RpcMeta& meta) {
     }
     auto* uc = new UserCallArgs{mp, cntl, req, res, done};
     fiber_t tid;
-    if (fiber_start_background(&tid, nullptr, RunUserCall, uc) != 0) {
+    FiberAttr attr = FIBER_ATTR_NORMAL;
+    attr.tag = server->options().fiber_tag;
+    if (fiber_start_background(&tid, &attr, RunUserCall, uc) != 0) {
         delete uc;  // fall back inline (fiber system saturated/shut down)
         mp->service->CallMethod(mp->method, cntl, req, res, done);
     }

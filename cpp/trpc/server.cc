@@ -78,6 +78,13 @@ int Server::StartNoListen(const ServerOptions* options) {
     // under an in-flight done-closure would be a use-after-free).
     Join();
     if (options != nullptr) options_ = *options;
+    if (options_.fiber_tag < 0 || options_.fiber_tag >= 64) {
+        // Validate ONCE here: the per-request of_tag fallback would lose
+        // the configured isolation silently and spam the log.
+        LOG(ERROR) << "ServerOptions::fiber_tag " << options_.fiber_tag
+                   << " out of range [0, 64)";
+        return -1;
+    }
     for (auto& kv : methods_) {
         if (options_.auto_concurrency) {
             kv.second.status->limiter.reset(

@@ -68,6 +68,14 @@ struct ServerOptions {
     bool usercode_inline = false;
     // Not owned; must outlive the server. Null = accept everything.
     Interceptor* interceptor = nullptr;
+    // Worker tag for user service code (reference bthread_tag server
+    // option / example/bthread_tag_echo_c++): 0 = default pool; nonzero
+    // isolates this server's pb handlers (tpu_std and gRPC/h2) on their
+    // own worker pool so they cannot starve (or be starved by) other
+    // work in the process. HTTP/1 portal/json handlers run inline on
+    // their connection fiber and are NOT retagged. Must be within
+    // [0, 64); Start fails otherwise.
+    int fiber_tag = 0;
 };
 
 class Server {

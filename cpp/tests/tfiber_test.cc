@@ -441,6 +441,8 @@ TEST(FiberKey, DeletedKeyReadsNull) {
     struct Ctx {
         fiber_key_t key;
         void* before = (void*)1;
+        void* stale = (void*)1;
+        int stale_set_rc = 0;
         void* after = (void*)1;
     } ctx{key};
     fiber_t tid;
@@ -451,10 +453,13 @@ TEST(FiberKey, DeletedKeyReadsNull) {
             fiber_setspecific(c->key, (void*)0x1234);
             c->before = fiber_getspecific(c->key);
             fiber_key_delete(c->key);
-            // (Using the DELETED key itself is undefined, as with
-            // pthread_key_delete — not asserted.) The load-bearing
-            // property: a RECREATED key on the same slot must never see
-            // the previous generation's value.
+            // The header's contract: a deleted key handle reads null and
+            // rejects writes (validated against the registry's current
+            // slot generation).
+            c->stale = fiber_getspecific(c->key);
+            c->stale_set_rc = fiber_setspecific(c->key, (void*)0x5678);
+            // And a RECREATED key on the same slot must never see the
+            // previous generation's value.
             fiber_key_t key2;
             fiber_key_create(&key2, nullptr);
             c->after = fiber_getspecific(key2);
@@ -464,6 +469,8 @@ TEST(FiberKey, DeletedKeyReadsNull) {
         &ctx);
     fiber_join(tid, nullptr);
     EXPECT_EQ(ctx.before, (void*)0x1234);
+    EXPECT_EQ(ctx.stale, nullptr);
+    EXPECT_EQ(ctx.stale_set_rc, EINVAL);
     EXPECT_EQ(ctx.after, nullptr);
 }
 

@@ -2,6 +2,7 @@
 
 #include <pthread.h>
 
+#include <atomic>
 #include <cerrno>
 #include <mutex>
 #include <vector>
@@ -15,10 +16,12 @@ namespace {
 
 constexpr uint32_t kMaxKeys = 256;
 
-// Global key registry: per-slot version (odd = in use) + dtor.
+// Global key registry: per-slot version (odd = in use) + dtor. Versions
+// are atomic so get/setspecific can validate a key handle against the
+// current generation without taking mu (mu guards create/delete only).
 struct KeyRegistry {
     std::mutex mu;
-    uint32_t versions[kMaxKeys] = {};  // even = free, odd = live
+    std::atomic<uint32_t> versions[kMaxKeys] = {};  // even = free, odd = live
     void (*dtors[kMaxKeys])(void*) = {};
     std::vector<uint32_t> free_slots;
     uint32_t next_unused = 0;
@@ -123,7 +126,9 @@ int fiber_key_delete(fiber_key_t key) {
 }
 
 int fiber_setspecific(fiber_key_t key, void* data) {
-    if (key.index >= kMaxKeys || (key.version & 1) == 0) {
+    if (key.index >= kMaxKeys || (key.version & 1) == 0 ||
+        registry()->versions[key.index].load(std::memory_order_acquire) !=
+            key.version) {
         errno = EINVAL;
         return EINVAL;
     }
@@ -146,13 +151,20 @@ int fiber_setspecific(fiber_key_t key, void* data) {
 }
 
 void* fiber_getspecific(fiber_key_t key) {
+    if (key.index >= kMaxKeys ||
+        registry()->versions[key.index].load(std::memory_order_acquire) !=
+            key.version) {
+        // Deleted key handle: reads after fiber_key_delete see null even
+        // though this fiber's entry still carries the old generation.
+        return nullptr;
+    }
     void** slot = current_kt_slot();
     if (*slot == nullptr) return nullptr;
     KeyTable* kt = (KeyTable*)*slot;
     if (kt->entries.size() <= key.index) return nullptr;
     const KeyTable::Entry& e = kt->entries[key.index];
-    // Stale key (deleted/recreated): this fiber's value was written under
-    // another key generation.
+    // Stale entry (deleted/recreated): this fiber's value was written
+    // under another key generation.
     return e.version == key.version ? e.data : nullptr;
 }
 

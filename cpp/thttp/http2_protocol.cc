@@ -121,7 +121,7 @@ std::string BuildFrame(uint8_t type, uint8_t flags, uint32_t stream,
 struct H2Stream {
     std::vector<HpackHeader> headers;
     IOBuf body;
-    bool end_stream = false;
+    bool has_headers = false;
     bool dispatched = false;
     int64_t send_window = kDefaultWindow;
 };
@@ -548,6 +548,26 @@ ParseResult ParseH2(IOBuf* source, Socket* s, bool read_eof, const void*) {
     return ParseResult::make_ok(msg);
 }
 
+// Strip PADDED framing in place. Malformed padding is a connection error
+// (RFC 7540 §6.2): for HEADERS, dropping the block would skip its HPACK
+// dynamic-table inserts and desynchronize the shared decoder.
+bool StripPadding(IOBuf* frag, Socket* s) {
+    uint8_t pad;
+    if (frag->size() < 1) {
+        s->SetFailedWithError(TERR_REQUEST);
+        return false;
+    }
+    frag->cutn(&pad, 1);
+    if ((size_t)pad > frag->size()) {
+        s->SetFailedWithError(TERR_REQUEST);
+        return false;
+    }
+    IOBuf tmp;
+    frag->cutn(&tmp, frag->size() - pad);
+    frag->swap(tmp);
+    return true;
+}
+
 void HandleHeaderBlockDone(Socket* s, H2Session* sess, uint32_t stream_id,
                            uint8_t flags) {
     std::vector<HpackHeader> headers;
@@ -561,6 +581,8 @@ void HandleHeaderBlockDone(Socket* s, H2Session* sess, uint32_t stream_id,
         return;  // stream 0 carries no requests; draining after GOAWAY
     }
     const bool complete = (flags & kFlagEndStream) != 0;
+    IOBuf body;
+    bool refuse = false;
     {
         std::lock_guard<std::mutex> g(sess->mu);
         auto it = sess->streams.find(stream_id);
@@ -572,20 +594,42 @@ void HandleHeaderBlockDone(Socket* s, H2Session* sess, uint32_t stream_id,
         }
         if (it == sess->streams.end() &&
             sess->streams.size() >= kMaxStreams) {
-            s->SetFailedWithError(TERR_OVERCROWDED);  // stream flood
-            return;
+            refuse = true;
+        } else {
+            H2Stream& st = it != sess->streams.end()
+                               ? it->second
+                               : sess->streams[stream_id];
+            if (!st.has_headers) {
+                st.send_window = sess->peer_initial_window;
+                st.headers = std::move(headers);
+                st.has_headers = true;
+                if (!complete) return;  // await DATA
+            } else {
+                // Second header block on an open stream = request
+                // trailers (RFC 7540 §8.1: must carry END_STREAM). Keep
+                // the original headers and dispatch with the DATA
+                // accumulated so far.
+                if (!complete) {
+                    s->SetFailedWithError(TERR_REQUEST);  // PROTOCOL_ERROR
+                    return;
+                }
+            }
+            st.dispatched = true;
+            headers = std::move(st.headers);  // move back for dispatch
+            body.swap(st.body);
         }
-        H2Stream& st = it != sess->streams.end()
-                           ? it->second
-                           : sess->streams[stream_id];
-        st.send_window = sess->peer_initial_window;
-        st.headers = std::move(headers);
-        st.end_stream = complete;
-        if (!complete) return;  // await DATA
-        st.dispatched = true;
-        headers = std::move(st.headers);  // move back out for dispatch
     }
-    DispatchCompleteStream(s, sess, stream_id, std::move(headers), IOBuf());
+    if (refuse) {
+        // Refuse just this stream (we advertised the limit in SETTINGS);
+        // killing the connection would fail every in-flight RPC of a
+        // legitimately concurrent client.
+        uint32_t code = htonl(0x7);  // REFUSED_STREAM
+        SendRaw(s, BuildFrame(H2_RST_STREAM, 0, stream_id,
+                              std::string((const char*)&code, 4)));
+        return;
+    }
+    DispatchCompleteStream(s, sess, stream_id, std::move(headers),
+                           std::move(body));
 }
 
 void ProcessH2(InputMessageBase* raw) {
@@ -598,8 +642,14 @@ void ProcessH2(InputMessageBase* raw) {
         if (sess != nullptr) return;  // duplicate preface: ignore
         sess = new H2Session;
         s->set_conn_data(sess, DeleteSession);
-        // Our SETTINGS (defaults are fine) + immediately usable.
-        SendRaw(s.get(), BuildFrame(H2_SETTINGS, 0, 0, ""));
+        // Advertise our concurrent-stream cap so well-behaved clients
+        // queue instead of tripping the kMaxStreams refusals.
+        uint16_t sid16 = htons(0x3);  // SETTINGS_MAX_CONCURRENT_STREAMS
+        uint32_t sval = htonl((uint32_t)kMaxStreams);
+        std::string sp;
+        sp.append((const char*)&sid16, 2);
+        sp.append((const char*)&sval, 4);
+        SendRaw(s.get(), BuildFrame(H2_SETTINGS, 0, 0, sp));
         return;
     }
     if (sess == nullptr) return;
@@ -662,17 +712,15 @@ void ProcessH2(InputMessageBase* raw) {
         }
         case H2_HEADERS: {
             IOBuf frag = std::move(msg->payload);
-            if (msg->flags & kFlagPadded) {
-                uint8_t pad;
-                if (frag.size() < 1) break;
-                frag.cutn(&pad, 1);
-                if ((size_t)pad > frag.size()) break;
-                IOBuf tmp;
-                frag.cutn(&tmp, frag.size() - pad);
-                frag.swap(tmp);
+            if ((msg->flags & kFlagPadded) &&
+                !StripPadding(&frag, s.get())) {
+                return;
             }
             if (msg->flags & kFlagPriority) {
-                if (frag.size() < 5) break;
+                if (frag.size() < 5) {
+                    s->SetFailedWithError(TERR_REQUEST);
+                    return;
+                }
                 IOBuf drop;
                 frag.cutn(&drop, 5);
             }
@@ -713,14 +761,9 @@ void ProcessH2(InputMessageBase* raw) {
         case H2_DATA: {
             const size_t sz = msg->payload.size();
             IOBuf frag = std::move(msg->payload);
-            if (msg->flags & kFlagPadded) {
-                uint8_t pad;
-                if (frag.size() < 1) break;
-                frag.cutn(&pad, 1);
-                if ((size_t)pad > frag.size()) break;
-                IOBuf tmp;
-                frag.cutn(&tmp, frag.size() - pad);
-                frag.swap(tmp);
+            if ((msg->flags & kFlagPadded) &&
+                !StripPadding(&frag, s.get())) {
+                return;
             }
             bool dispatch = false;
             bool known_stream = false;
@@ -738,7 +781,6 @@ void ProcessH2(InputMessageBase* raw) {
                         return;
                     }
                     if (msg->flags & kFlagEndStream) {
-                        st.end_stream = true;
                         st.dispatched = true;
                         dispatch = true;
                         req_headers = std::move(st.headers);

@@ -1,0 +1,164 @@
+"""Raw-socket HTTP/2 frame tests for server behaviors real clients don't
+exercise: request trailers, SETTINGS advertisement, malformed padding.
+
+Reference parity rows: /root/reference/src/brpc/policy/http2_rpc_protocol.cpp
+(trailer handling, SETTINGS exchange), RFC 7540 §6.2/§8.1.
+"""
+import json
+import socket
+import struct
+import subprocess
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+BUILD = REPO / "build"
+
+PREFACE = b"PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n"
+
+H2_DATA = 0x0
+H2_HEADERS = 0x1
+H2_SETTINGS = 0x4
+H2_GOAWAY = 0x7
+
+END_STREAM = 0x1
+END_HEADERS = 0x4
+PADDED = 0x8
+
+
+@pytest.fixture(scope="module")
+def server():
+    proc = subprocess.Popen(
+        [str(BUILD / "echo_bench"), "--ici-server"],
+        stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL,
+    )
+    port = int(proc.stdout.readline().split()[1])
+    yield port
+    proc.stdin.close()
+    proc.wait(timeout=20)
+
+
+def frame(ftype, flags, stream_id, payload=b""):
+    return (struct.pack(">I", len(payload))[1:] +
+            bytes([ftype, flags]) + struct.pack(">I", stream_id) + payload)
+
+
+def hpack_literal(name: bytes, value: bytes) -> bytes:
+    # Literal Header Field without Indexing — New Name (RFC 7541 §6.2.2),
+    # no Huffman. Lengths stay under 127 in these tests.
+    return b"\x00" + bytes([len(name)]) + name + bytes([len(value)]) + value
+
+
+def read_frames(sock, until_stream_end=False, timeout=10):
+    sock.settimeout(timeout)
+    buf = b""
+    frames = []
+    while True:
+        while len(buf) >= 9:
+            length = struct.unpack(">I", b"\x00" + buf[:3])[0]
+            if len(buf) < 9 + length:
+                break
+            ftype, flags = buf[3], buf[4]
+            sid = struct.unpack(">I", buf[5:9])[0] & 0x7FFFFFFF
+            frames.append((ftype, flags, sid, buf[9:9 + length]))
+            buf = buf[9 + length:]
+            if not until_stream_end:
+                return frames
+            if ftype in (H2_DATA, H2_HEADERS) and flags & END_STREAM:
+                return frames
+            if ftype == H2_GOAWAY:
+                return frames
+        try:
+            chunk = sock.recv(65536)
+        except socket.timeout:
+            return frames
+        if not chunk:
+            return frames
+        buf += chunk
+
+
+def connect(port):
+    s = socket.create_connection(("127.0.0.1", port), timeout=10)
+    s.sendall(PREFACE + frame(H2_SETTINGS, 0, 0))
+    return s
+
+
+def req_headers(path=b"/EchoService/Echo"):
+    return (hpack_literal(b":method", b"POST") +
+            hpack_literal(b":scheme", b"http") +
+            hpack_literal(b":path", path) +
+            hpack_literal(b":authority", b"test") +
+            hpack_literal(b"content-type", b"application/json"))
+
+
+def test_server_settings_advertises_max_streams(server):
+    s = connect(server)
+    frames = read_frames(s)
+    assert frames, "no SETTINGS from server"
+    ftype, flags, sid, payload = frames[0]
+    assert ftype == H2_SETTINGS and flags == 0 and sid == 0
+    settings = {}
+    for off in range(0, len(payload) - 5, 6):
+        k, v = struct.unpack(">HI", payload[off:off + 6])
+        settings[k] = v
+    assert settings.get(0x3) == 256  # SETTINGS_MAX_CONCURRENT_STREAMS
+    s.close()
+
+
+def test_request_trailers_preserve_headers_and_body(server):
+    """HEADERS (no END_STREAM) + DATA + trailer HEADERS (END_STREAM):
+    the request must dispatch with the original headers AND the
+    accumulated DATA body, not an empty body."""
+    s = connect(server)
+    read_frames(s)  # server SETTINGS
+    body = json.dumps({"send_ts_us": 90125}).encode()
+    s.sendall(frame(H2_HEADERS, END_HEADERS, 1, req_headers()))
+    s.sendall(frame(H2_DATA, 0, 1, body))
+    s.sendall(frame(H2_HEADERS, END_HEADERS | END_STREAM, 1,
+                    hpack_literal(b"x-checksum", b"na")))
+    frames = read_frames(s, until_stream_end=True)
+    resp_body = b"".join(p for t, f, sid, p in frames
+                         if t == H2_DATA and sid == 1)
+    assert b"90125" in resp_body
+    s.close()
+
+
+def test_malformed_padding_is_connection_error(server):
+    """A HEADERS frame whose pad length exceeds the fragment must kill
+    the connection (RFC 7540 §6.2) — not desynchronize HPACK."""
+    s = connect(server)
+    read_frames(s)
+    # PADDED flag, pad length byte says 200 but only 2 bytes follow.
+    s.sendall(frame(H2_HEADERS, END_HEADERS | END_STREAM | PADDED, 1,
+                    b"\xc8\x00\x00"))
+    frames = read_frames(s, until_stream_end=True, timeout=5)
+    # Connection must close (recv returns b"" => loop exits); any frames
+    # seen must not include a normal response on stream 1.
+    assert not any(t == H2_HEADERS and sid == 1 for t, f, sid, p in frames)
+    s.close()
+
+
+def test_stream_flood_gets_refused_not_connection_error(server):
+    """Opening more concurrent streams than advertised must RST the
+    excess stream (REFUSED_STREAM), leaving earlier streams usable."""
+    s = connect(server)
+    read_frames(s)
+    # Open 257 streams without END_STREAM (they all await DATA).
+    for i in range(257):
+        sid = 1 + 2 * i
+        s.sendall(frame(H2_HEADERS, END_HEADERS, sid, req_headers()))
+    frames = read_frames(s, until_stream_end=True, timeout=5)
+    rsts = [(sid, p) for t, f, sid, p in frames if t == 0x3]
+    assert rsts, "expected RST_STREAM for the stream beyond the cap"
+    sid, payload = rsts[0]
+    assert struct.unpack(">I", payload)[0] == 0x7  # REFUSED_STREAM
+    # The connection is still alive: finish stream 1 and get an echo.
+    body = json.dumps({"send_ts_us": 777}).encode()
+    s.sendall(frame(H2_DATA, END_STREAM, 1, body))
+    frames = read_frames(s, until_stream_end=True)
+    resp_body = b"".join(p for t, f, sid, p in frames
+                         if t == H2_DATA and sid == 1)
+    assert b"777" in resp_body
+    s.close()

@@ -8,6 +8,7 @@
 #include <new>
 
 #include "tbase/flags.h"
+#include "tbase/mpmc_queue.h"
 #include "tbase/logging.h"
 
 // 512 x 8KB = 4MB per thread: enough that a windowed stream of 1MB
@@ -44,6 +45,20 @@ struct TLSData {
 
 thread_local TLSData tls_data;
 
+// Cross-thread spillover: network pipelines allocate blocks on one thread
+// (parser/worker) and free them on another (writer/dispatcher), so TLS
+// caches fill where blocks die and run dry where they're born. A small
+// global lock-free ring rebalances; capacity bounds idle memory at
+// 1024 x 8KB = 8MB process-wide.
+MpmcBoundedQueue<IOBuf::Block*>* global_block_ring() {
+    static MpmcBoundedQueue<IOBuf::Block*>* r = [] {
+        auto* q = new MpmcBoundedQueue<IOBuf::Block*>;
+        CHECK_EQ(q->init(1024), 0);
+        return q;
+    }();
+    return r;
+}
+
 }  // namespace
 
 IOBuf::Block* IOBuf::create_block(size_t block_size) {
@@ -60,6 +75,21 @@ IOBuf::Block* IOBuf::create_block(size_t block_size) {
         b->size = 0;
         b->portal_next = nullptr;
         return b;
+    }
+    if (block_size == DEFAULT_BLOCK_SIZE) {
+        Block* b;
+        while (global_block_ring()->pop(&b)) {
+            if (b->dealloc != blockmem_deallocate) {
+                // Stale allocator generation (transport swapped the
+                // allocator): free for real and keep draining.
+                b->dealloc(b);
+                continue;
+            }
+            b->nshared.store(1, std::memory_order_relaxed);
+            b->size = 0;
+            b->portal_next = nullptr;
+            return b;
+        }
     }
     void* mem = blockmem_allocate(block_size);
     if (mem == nullptr) return nullptr;
@@ -78,11 +108,14 @@ void IOBuf::Block::dec_ref() {
         // Cache only blocks from the current allocator pair.
         const int32_t cache_cap = FLAGS_iobuf_tls_cache_blocks.get();
         if (total == DEFAULT_BLOCK_SIZE && dealloc == blockmem_deallocate &&
-            cache_cap > 0 && tls_data.num_cached < (size_t)cache_cap) {
-            portal_next = tls_data.cache_head;
-            tls_data.cache_head = this;
-            ++tls_data.num_cached;
-            return;
+            cache_cap > 0) {
+            if (tls_data.num_cached < (size_t)cache_cap) {
+                portal_next = tls_data.cache_head;
+                tls_data.cache_head = this;
+                ++tls_data.num_cached;
+                return;
+            }
+            if (global_block_ring()->push(this)) return;
         }
         dealloc(this);
     }

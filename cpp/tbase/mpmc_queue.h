@@ -1,0 +1,99 @@
+// Bounded lock-free MPMC ring (per-cell sequence numbers, the classic
+// Vyukov construction). Replaces the mutex+deque remote queue of
+// TaskControl: every non-worker fiber spawn and every cross-pool wake
+// used to take one global lock (reference keeps its remote queue behind
+// the group's own lock but pairs it with per-group sharding,
+// src/bthread/remote_task_queue.h — one shared lock-free ring gets the
+// same effect with less machinery).
+//
+// push/pop are wait-free in the common case (one CAS each); a full ring
+// returns false so callers can fall back (TaskControl keeps a tiny
+// mutexed overflow list — unbounded fiber bursts must never be dropped).
+#pragma once
+
+#include <atomic>
+#include <cstddef>
+#include <cstdint>
+#include <memory>
+
+namespace tpurpc {
+
+template <typename T>
+class MpmcBoundedQueue {
+public:
+    MpmcBoundedQueue() = default;
+    MpmcBoundedQueue(const MpmcBoundedQueue&) = delete;
+    MpmcBoundedQueue& operator=(const MpmcBoundedQueue&) = delete;
+
+    // capacity must be a power of two. Not thread-safe; call before use.
+    int init(size_t capacity) {
+        if (capacity < 2 || (capacity & (capacity - 1)) != 0) return -1;
+        cells_.reset(new Cell[capacity]);
+        mask_ = capacity - 1;
+        for (size_t i = 0; i < capacity; ++i) {
+            cells_[i].seq.store(i, std::memory_order_relaxed);
+        }
+        enqueue_pos_.store(0, std::memory_order_relaxed);
+        dequeue_pos_.store(0, std::memory_order_relaxed);
+        return 0;
+    }
+
+    bool push(T v) {
+        Cell* c;
+        size_t pos = enqueue_pos_.load(std::memory_order_relaxed);
+        for (;;) {
+            c = &cells_[pos & mask_];
+            const size_t seq = c->seq.load(std::memory_order_acquire);
+            const intptr_t dif = (intptr_t)seq - (intptr_t)pos;
+            if (dif == 0) {
+                if (enqueue_pos_.compare_exchange_weak(
+                        pos, pos + 1, std::memory_order_relaxed)) {
+                    break;
+                }
+            } else if (dif < 0) {
+                return false;  // full
+            } else {
+                pos = enqueue_pos_.load(std::memory_order_relaxed);
+            }
+        }
+        c->data = v;
+        c->seq.store(pos + 1, std::memory_order_release);
+        return true;
+    }
+
+    bool pop(T* v) {
+        Cell* c;
+        size_t pos = dequeue_pos_.load(std::memory_order_relaxed);
+        for (;;) {
+            c = &cells_[pos & mask_];
+            const size_t seq = c->seq.load(std::memory_order_acquire);
+            const intptr_t dif = (intptr_t)seq - (intptr_t)(pos + 1);
+            if (dif == 0) {
+                if (dequeue_pos_.compare_exchange_weak(
+                        pos, pos + 1, std::memory_order_relaxed)) {
+                    break;
+                }
+            } else if (dif < 0) {
+                return false;  // empty
+            } else {
+                pos = dequeue_pos_.load(std::memory_order_relaxed);
+            }
+        }
+        *v = c->data;
+        c->seq.store(pos + mask_ + 1, std::memory_order_release);
+        return true;
+    }
+
+private:
+    struct Cell {
+        std::atomic<size_t> seq{0};
+        T data;
+    };
+    static constexpr size_t kCacheLine = 64;
+    std::unique_ptr<Cell[]> cells_;
+    size_t mask_ = 0;
+    alignas(kCacheLine) std::atomic<size_t> enqueue_pos_{0};
+    alignas(kCacheLine) std::atomic<size_t> dequeue_pos_{0};
+};
+
+}  // namespace tpurpc

@@ -667,3 +667,153 @@ TEST(WorkerTags, TaggedPoolNotStarvedByDefaultPool) {
     // default pool (generous bound for the 1-core CI box).
     EXPECT_LT(latency_us.load(), 200 * 1000);
 }
+
+// ---------------- urgent scheduling + pool growth + remote queue ----------------
+// Reference: src/bthread/task_group.cpp start_foreground (run the new
+// bthread immediately, requeue the caller), TaskControl::add_workers,
+// remote_task_queue.h.
+
+#include "tbase/flags.h"
+#include "tbase/mpmc_queue.h"
+
+DECLARE_int32(fiber_tagged_worker_count);
+
+TEST(FiberUrgent, ChildRunsBeforeCallerResumes) {
+    // A single-worker tagged pool makes the ordering deterministic: the
+    // lone worker must run the urgent child before it can resume the
+    // requeued caller.
+    FLAGS_fiber_tagged_worker_count.set(1);
+    FiberAttr tagged = FIBER_ATTR_NORMAL;
+    tagged.tag = 11;  // fresh tag: pool starts now, with 1 worker
+    struct Ctx {
+        std::atomic<int> seq{0};
+        int child_at = -1;
+        int caller_resumed_at = -1;
+        FiberAttr attr;
+    } ctx;
+    ctx.attr = tagged;
+    fiber_t outer;
+    fiber_start_background(
+        &outer, &tagged,
+        [](void* arg) -> void* {
+            Ctx* c = (Ctx*)arg;
+            fiber_t child;
+            struct Inner {
+                Ctx* c;
+            } inner{c};
+            fiber_start_urgent(
+                &child, &c->attr,
+                [](void* a) -> void* {
+                    Ctx* c = ((Inner*)a)->c;
+                    c->child_at = c->seq.fetch_add(1);
+                    return nullptr;
+                },
+                &inner);
+            c->caller_resumed_at = c->seq.fetch_add(1);
+            fiber_join(child, nullptr);
+            return nullptr;
+        },
+        &ctx);
+    fiber_join(outer, nullptr);
+    FLAGS_fiber_tagged_worker_count.set(2);
+    ASSERT_GE(ctx.child_at, 0);
+    ASSERT_GE(ctx.caller_resumed_at, 0);
+    EXPECT_LT(ctx.child_at, ctx.caller_resumed_at);
+}
+
+TEST(TaskControlGrowth, SetConcurrencyAddsWorkersAfterStart) {
+    TaskControl* c = TaskControl::singleton();
+    c->ensure_started();
+    const int before = c->concurrency();
+    c->set_concurrency(before + 2);
+    EXPECT_EQ(c->concurrency(), before + 2);
+    // The grown pool still schedules: run a burst of fibers to completion.
+    std::atomic<int> done{0};
+    std::vector<fiber_t> tids(64);
+    for (auto& tid : tids) {
+        fiber_start_background(
+            &tid, nullptr,
+            [](void* arg) -> void* {
+                ((std::atomic<int>*)arg)->fetch_add(1);
+                return nullptr;
+            },
+            &done);
+    }
+    for (auto tid : tids) fiber_join(tid, nullptr);
+    EXPECT_EQ(done.load(), 64);
+    // Shrink is a documented no-op.
+    c->set_concurrency(1);
+    EXPECT_EQ(c->concurrency(), before + 2);
+}
+
+TEST(TaskControlGrowth, RemoteSpawnBurstFromPthreads) {
+    // Hammer the lock-free remote ring (and its overflow spill) from
+    // plain pthreads: every spawn goes through ready_to_run_remote.
+    std::atomic<int> done{0};
+    std::vector<std::thread> producers;
+    std::vector<std::vector<fiber_t>> tids(4, std::vector<fiber_t>(2000));
+    for (int t = 0; t < 4; ++t) {
+        producers.emplace_back([&, t] {
+            for (auto& tid : tids[t]) {
+                while (fiber_start_background(
+                           &tid, nullptr,
+                           [](void* arg) -> void* {
+                               ((std::atomic<int>*)arg)->fetch_add(1);
+                               return nullptr;
+                           },
+                           &done) != 0) {
+                    std::this_thread::yield();
+                }
+            }
+        });
+    }
+    for (auto& p : producers) p.join();
+    for (auto& v : tids) {
+        for (auto tid : v) fiber_join(tid, nullptr);
+    }
+    EXPECT_EQ(done.load(), 8000);
+}
+
+TEST(MpmcQueue, ConcurrentSumConserved) {
+    MpmcBoundedQueue<int> q;
+    ASSERT_EQ(0, q.init(256));
+    EXPECT_NE(0, q.init(100));  // non-power-of-two rejected
+    ASSERT_EQ(0, q.init(256));
+    constexpr int kPerProducer = 20000;
+    std::atomic<int64_t> popped_sum{0};
+    std::atomic<int> popped_n{0};
+    std::atomic<bool> done_producing{false};
+    std::vector<std::thread> threads;
+    for (int p = 0; p < 2; ++p) {
+        threads.emplace_back([&, p] {
+            for (int i = 0; i < kPerProducer; ++i) {
+                const int v = p * kPerProducer + i + 1;
+                while (!q.push(v)) std::this_thread::yield();
+            }
+        });
+    }
+    for (int cix = 0; cix < 2; ++cix) {
+        threads.emplace_back([&] {
+            int v;
+            while (true) {
+                if (q.pop(&v)) {
+                    popped_sum.fetch_add(v);
+                    popped_n.fetch_add(1);
+                } else if (done_producing.load() &&
+                           popped_n.load() == 2 * kPerProducer) {
+                    return;
+                } else {
+                    std::this_thread::yield();
+                }
+            }
+        });
+    }
+    threads[0].join();
+    threads[1].join();
+    done_producing.store(true);
+    threads[2].join();
+    threads[3].join();
+    const int64_t n = 2 * kPerProducer;
+    EXPECT_EQ(popped_n.load(), n);
+    EXPECT_EQ(popped_sum.load(), n * (n + 1) / 2);
+}

@@ -3,6 +3,7 @@
 #include <thread>
 #include <vector>
 
+#include "tbase/time.h"
 #include "tvar/latency_recorder.h"
 #include "tvar/percentile.h"
 #include "tvar/reducer.h"
@@ -183,4 +184,41 @@ TEST(MultiDimension, SeriesAndPrometheusText) {
     // /vars description lists series.
     const std::string desc = requests.get_description();
     EXPECT_TRUE(desc.find("2 series") != std::string::npos);
+}
+
+// ---------------- sampler off-lock execution ----------------
+
+TEST(Sampler, SlowSamplerDoesNotBlockRegistry) {
+    auto* sc = SamplerCollector::singleton();
+    std::atomic<bool> slow_started{false};
+    std::atomic<bool> release_slow{false};
+    const uint64_t slow_id = sc->add([&] {
+        slow_started.store(true);
+        while (!release_slow.load()) {
+            std::this_thread::sleep_for(std::chrono::milliseconds(5));
+        }
+    });
+    // Wait for the 1Hz collector to enter the slow sampler.
+    for (int i = 0; i < 400 && !slow_started.load(); ++i) {
+        std::this_thread::sleep_for(std::chrono::milliseconds(10));
+    }
+    ASSERT_TRUE(slow_started.load());
+    // While it spins OFF-lock, add+remove of other samplers return
+    // immediately (used to block on the global registry mutex).
+    const int64_t t0 = monotonic_time_us();
+    const uint64_t other = sc->add([] {});
+    sc->remove(other);
+    const int64_t elapsed_us = monotonic_time_us() - t0;
+    EXPECT_LT(elapsed_us, 500 * 1000);
+    // remove() of the RUNNING sampler must block until it finishes.
+    std::atomic<bool> removed{false};
+    std::thread remover([&] {
+        sc->remove(slow_id);
+        removed.store(true);
+    });
+    std::this_thread::sleep_for(std::chrono::milliseconds(100));
+    EXPECT_FALSE(removed.load());
+    release_slow.store(true);
+    remover.join();
+    EXPECT_TRUE(removed.load());
 }

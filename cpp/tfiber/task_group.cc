@@ -121,6 +121,14 @@ void TaskGroup::run_main_task() {
 
 TaskMeta* TaskGroup::wait_task() {
     while (true) {
+        // Urgent handoff runs before any queue: run_urgent parked its
+        // caller with `next_meta_` armed; the requeue hook has already
+        // republished the caller by the time we get here.
+        if (next_meta_ != nullptr) {
+            TaskMeta* m = next_meta_;
+            next_meta_ = nullptr;
+            return m;
+        }
         if (control_->stopped()) return nullptr;
         TaskMeta* m = nullptr;
         if (rq_.pop(&m)) return m;
@@ -212,7 +220,18 @@ void TaskGroup::ready_to_run(TaskMeta* m) {
     control_->parking_lot().signal(1);
 }
 
+void TaskGroup::run_urgent(TaskMeta* m) {
+    TaskMeta* self = cur_meta_;
+    next_meta_ = m;
+    set_remained(requeue_meta_cb, self);
+    sched_park();
+}
+
 // ---------------- TaskControl ----------------
+
+TaskControl::TaskControl() {
+    CHECK_EQ(remote_ring_.init(4096), 0);
+}
 
 TaskControl* TaskControl::singleton() {
     static TaskControl* c = new TaskControl;
@@ -264,33 +283,46 @@ void TaskControl::ensure_started() {
     if (started_.load(std::memory_order_acquire)) return;
     std::lock_guard<std::mutex> g(start_mu_);
     if (started_.load(std::memory_order_relaxed)) return;
+    int concurrency;
     if (tag_ != 0) {
-        concurrency_ = std::max(1, FLAGS_fiber_tagged_worker_count.get());
+        concurrency = std::max(1, FLAGS_fiber_tagged_worker_count.get());
     } else {
-        concurrency_ = FLAGS_fiber_worker_count.get();
-        if (concurrency_ <= 0) {
+        concurrency = FLAGS_fiber_worker_count.get();
+        if (concurrency <= 0) {
             const unsigned hc = std::thread::hardware_concurrency();
-            concurrency_ = (int)std::max(4u, hc + 1);
+            concurrency = (int)std::max(4u, hc + 1);
         }
     }
-    groups_.reserve(concurrency_);
-    for (int i = 0; i < concurrency_; ++i) {
-        groups_.push_back(new TaskGroup(this, i));
-    }
-    for (int i = 0; i < concurrency_; ++i) {
-        TaskGroup* tg = groups_[i];
+    add_workers_locked(concurrency);
+    started_.store(true, std::memory_order_release);
+}
+
+void TaskControl::add_workers_locked(int n) {
+    for (int i = 0; i < n; ++i) {
+        const size_t idx = ngroup_.load(std::memory_order_relaxed);
+        if (idx >= kMaxGroups) {
+            LOG(ERROR) << "worker pool is at its " << kMaxGroups
+                       << "-group capacity";
+            return;
+        }
+        TaskGroup* tg = new TaskGroup(this, (int)idx);
+        groups_[idx] = tg;
+        // Publish before the worker runs (steal_task scans [0, ngroup)).
+        ngroup_.store(idx + 1, std::memory_order_release);
         workers_.emplace_back([tg] { tg->run_main_task(); });
     }
-    started_.store(true, std::memory_order_release);
 }
 
 void TaskControl::set_concurrency(int n) {
     std::lock_guard<std::mutex> g(start_mu_);
     if (!started_.load(std::memory_order_relaxed)) {
         FLAGS_fiber_worker_count.set(n);
+        return;
     }
-    // Changing after start is not supported yet (reference supports
-    // add_workers; tracked as a TODO).
+    // Live growth (reference TaskControl::add_workers): a long-running
+    // server can scale its pool up; shrinking is not supported.
+    const int cur = (int)ngroup_.load(std::memory_order_relaxed);
+    if (n > cur) add_workers_locked(n - cur);
 }
 
 void TaskControl::ready_to_run(TaskMeta* m) {
@@ -306,23 +338,34 @@ void TaskControl::ready_to_run(TaskMeta* m) {
 }
 
 void TaskControl::ready_to_run_remote(TaskMeta* m) {
-    {
-        std::lock_guard<std::mutex> g(remote_mu_);
-        remote_q_.push_back(m);
+    if (!remote_ring_.push(m)) {
+        // Ring full: spill to the mutexed overflow list rather than
+        // spinning — fiber spawns must never be dropped or block.
+        std::lock_guard<std::mutex> g(overflow_mu_);
+        overflow_q_.push_back(m);
+        overflow_size_.fetch_add(1, std::memory_order_release);
     }
     parking_lot_.signal(1);
 }
 
 bool TaskControl::pop_remote(TaskMeta** m) {
-    std::lock_guard<std::mutex> g(remote_mu_);
-    if (remote_q_.empty()) return false;
-    *m = remote_q_.front();
-    remote_q_.pop_front();
-    return true;
+    // Overflow first: spilled fibers are the OLDEST — under sustained
+    // load the ring is never empty, so draining it first would starve
+    // the spill indefinitely (rough FIFO preserved this way).
+    if (overflow_size_.load(std::memory_order_acquire) != 0) {
+        std::lock_guard<std::mutex> g(overflow_mu_);
+        if (!overflow_q_.empty()) {
+            *m = overflow_q_.front();
+            overflow_q_.pop_front();
+            overflow_size_.fetch_sub(1, std::memory_order_release);
+            return true;
+        }
+    }
+    return remote_ring_.pop(m);
 }
 
 bool TaskControl::steal_task(TaskMeta** m, uint64_t* seed, int exclude) {
-    const size_t n = groups_.size();
+    const size_t n = ngroup_.load(std::memory_order_acquire);
     if (n <= 1) return false;
     // xorshift over group indices, starting at a pseudo-random offset.
     uint64_t s = *seed;
@@ -340,6 +383,10 @@ bool TaskControl::steal_task(TaskMeta** m, uint64_t* seed, int exclude) {
 }
 
 void TaskControl::stop_and_join() {
+    // start_mu_ serializes against set_concurrency growth: the workers_
+    // vector may otherwise reallocate mid-iteration, and a worker added
+    // after the loop passed its slot would never be joined.
+    std::lock_guard<std::mutex> g(start_mu_);
     stopped_.store(true, std::memory_order_release);
     parking_lot_.stop();
     for (auto& t : workers_) {
@@ -373,7 +420,8 @@ void fiber_requeue(fiber_t tid) {
 }
 
 static int start_fiber_impl(fiber_t* tid, const FiberAttr* attr,
-                            void* (*fn)(void*), void* arg) {
+                            void* (*fn)(void*), void* arg,
+                            bool urgent = false) {
     TaskControl* c = TaskControl::of_tag(attr != nullptr ? attr->tag : 0);
     c->ensure_started();
     ResourceId slot;
@@ -400,7 +448,13 @@ static int start_fiber_impl(fiber_t* tid, const FiberAttr* attr,
     }
     if (tid) *tid = m->tid;
     c->nfibers.fetch_add(1, std::memory_order_relaxed);
-    c->ready_to_run(m);
+    TaskGroup* g = tls_task_group;
+    if (urgent && g != nullptr && g->current() != nullptr &&
+        g->control() == c) {
+        g->run_urgent(m);  // runs m NOW; caller resumes via the queues
+    } else {
+        c->ready_to_run(m);
+    }
     return 0;
 }
 
@@ -411,10 +465,11 @@ int fiber_start_background(fiber_t* tid, const FiberAttr* attr,
 
 int fiber_start_urgent(fiber_t* tid, const FiberAttr* attr, void* (*fn)(void*),
                        void* arg) {
-    // Same queueing; urgency is a scheduling hint we don't separate yet
-    // (reference runs the new bthread immediately and requeues the caller,
-    // task_group.cpp sched_to path — tracked as a TODO).
-    return start_fiber_impl(tid, attr, fn, arg);
+    // Run-new-fiber-immediately (reference task_group.cpp
+    // start_foreground → sched_to): the new fiber takes this worker right
+    // away and the caller is requeued — the core latency trick for
+    // dispatching a just-parsed request before the parser fiber resumes.
+    return start_fiber_impl(tid, attr, fn, arg, /*urgent=*/true);
 }
 
 int fiber_join(fiber_t tid, void** ret) {

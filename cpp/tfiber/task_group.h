@@ -20,6 +20,7 @@
 #include <vector>
 
 #include "tfiber/context.h"
+#include "tbase/mpmc_queue.h"
 #include "tfiber/parking_lot.h"
 #include "tfiber/task_meta.h"
 #include "tfiber/work_stealing_queue.h"
@@ -51,6 +52,12 @@ public:
     // Enqueue a ready fiber from this worker thread.
     void ready_to_run(TaskMeta* m);
 
+    // Run `m` IMMEDIATELY on this worker and requeue the calling fiber
+    // (the reference's run-new-bthread-now start_foreground path,
+    // src/bthread/task_group.cpp sched_to) — must be called from the
+    // currently running fiber of this group.
+    void run_urgent(TaskMeta* m);
+
     TaskMeta* current() const { return cur_meta_; }
     int index() const { return index_; }
     TaskControl* control() const { return control_; }
@@ -73,6 +80,7 @@ private:
     int index_;
     WorkStealingQueue<TaskMeta*> rq_;
     fcontext_t main_ctx_ = nullptr;
+    TaskMeta* next_meta_ = nullptr;  // urgent handoff: run before queues
     TaskMeta* cur_meta_ = nullptr;
     void (*remained_fn_)(void*) = nullptr;
     void* remained_arg_ = nullptr;
@@ -101,8 +109,13 @@ public:
 
     // Idempotent; starts `concurrency` workers on first call.
     void ensure_started();
+    // Before start: sets the initial worker count. After start: grows the
+    // pool by starting additional workers (shrinking is not supported,
+    // matching the reference's add_workers-only semantics).
     void set_concurrency(int n);
-    int concurrency() const { return concurrency_; }
+    int concurrency() const {
+        return (int)ngroup_.load(std::memory_order_acquire);
+    }
 
     // Enqueue from any thread (worker: local queue; other: remote queue).
     void ready_to_run(TaskMeta* m);
@@ -119,16 +132,27 @@ public:
     std::atomic<int64_t> nfibers{0};  // live fibers (metrics)
 
 private:
-    TaskControl() = default;
+    TaskControl();
+
+    // Post-start growth: groups_ is a fixed array so steal_task can scan
+    // it lock-free while add_workers appends; ngroup_ is bumped (release)
+    // only after the new group is fully constructed.
+    static constexpr size_t kMaxGroups = 128;
+
+    void add_workers_locked(int n);  // start_mu_ held
 
     std::atomic<bool> started_{false};
     std::atomic<bool> stopped_{false};
     std::mutex start_mu_;
-    int concurrency_ = 0;
-    std::vector<TaskGroup*> groups_;
+    TaskGroup* groups_[kMaxGroups] = {};
+    std::atomic<size_t> ngroup_{0};
     std::vector<std::thread> workers_;
-    std::mutex remote_mu_;
-    std::deque<TaskMeta*> remote_q_;
+    // Remote queue: lock-free ring; overflow spills to a mutexed list
+    // (overflow_size_ lets consumers skip the lock when empty).
+    MpmcBoundedQueue<TaskMeta*> remote_ring_;
+    std::mutex overflow_mu_;
+    std::deque<TaskMeta*> overflow_q_;
+    std::atomic<size_t> overflow_size_{0};
     ParkingLot parking_lot_;
     int tag_ = 0;  // worker tag of this pool
 

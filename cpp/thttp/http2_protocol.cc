@@ -137,6 +137,7 @@ struct H2Session {
     int64_t peer_initial_window = kDefaultWindow;
     void* window_butex = butex_create();
     bool goaway = false;
+    uint32_t max_stream_id = 0;  // highest client stream ever opened
     uint32_t cont_stream = 0;  // nonzero: CONTINUATION expected
     uint8_t cont_flags = 0;
     std::string header_block;
@@ -592,10 +593,22 @@ void HandleHeaderBlockDone(Socket* s, H2Session* sess, uint32_t stream_id,
             // the method and interleave two responses on one stream.
             return;
         }
+        if (it == sess->streams.end() && stream_id <= sess->max_stream_id) {
+            // Reuse of a closed (erased) stream id: connection error per
+            // RFC 7540 §5.1.1 — the `dispatched` guard only lives as long
+            // as the entry; a hostile peer must not reopen the id after
+            // the response fiber erased it.
+            s->SetFailedWithError(TERR_REQUEST);
+            return;
+        }
         if (it == sess->streams.end() &&
             sess->streams.size() >= kMaxStreams) {
             refuse = true;
         } else {
+            if (it == sess->streams.end() &&
+                stream_id > sess->max_stream_id) {
+                sess->max_stream_id = stream_id;
+            }
             H2Stream& st = it != sess->streams.end()
                                ? it->second
                                : sess->streams[stream_id];

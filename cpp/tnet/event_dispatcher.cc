@@ -3,12 +3,17 @@
 #include <sys/epoll.h>
 #include <unistd.h>
 
+#include <algorithm>
 #include <cerrno>
 
 #include "tbase/flags.h"
 #include "tbase/logging.h"
 
-DEFINE_int32(event_dispatcher_num, 1, "number of epoll loops");
+// 0 = auto: one loop per ~4 cores, capped at 4 (the reference defaults to
+// 1, which serializes all sockets through a single epoll loop — the main
+// reason its multi-connection mode needs explicit tuning; multi-core TPU-VM
+// hosts have cores to spare for I/O).
+DEFINE_int32(event_dispatcher_num, 0, "number of epoll loops; 0 = auto");
 
 namespace tpurpc {
 
@@ -103,6 +108,10 @@ EventDispatcher& EventDispatcher::GetGlobalDispatcher(int fd) {
     static Dispatchers* d = [] {
         auto* dd = new Dispatchers;
         int n = FLAGS_event_dispatcher_num.get();
+        if (n == 0) {
+            const unsigned hc = std::thread::hardware_concurrency();
+            n = (int)std::min(4u, std::max(1u, hc / 4));
+        }
         if (n < 1) n = 1;
         for (int i = 0; i < n; ++i) dd->list.push_back(new EventDispatcher);
         return dd;

@@ -196,16 +196,22 @@ static bool ProbeHttpHealth(const EndPoint& remote, const std::string& path,
     char buf[256];
     size_t got = 0;
     const int64_t deadline = monotonic_time_us() + timeout_ms * 1000;
-    while (got < 12 && monotonic_time_us() < deadline) {
+    // Read until the status line is complete (first CRLF) — byte offsets
+    // must not be assumed: "HTTP/1.0 200" and reason-phrase-less replies
+    // are legal and gate revival just the same.
+    while (got < sizeof(buf) - 1 && monotonic_time_us() < deadline &&
+           memchr(buf, '\n', got) == nullptr) {
         pollfd rp{fd, POLLIN, 0};
         if (::poll(&rp, 1, 50) != 1) continue;
-        const ssize_t r = recv(fd, buf + got, sizeof(buf) - got, 0);
+        const ssize_t r = recv(fd, buf + got, sizeof(buf) - 1 - got, 0);
         if (r <= 0) break;
         got += (size_t)r;
     }
     close(fd);
-    // "HTTP/1.1 200 ..."
-    return got >= 12 && memcmp(buf + 9, "200", 3) == 0;
+    buf[got] = '\0';
+    int status = 0;
+    if (sscanf(buf, "HTTP/%*d.%*d %d", &status) != 1) return false;
+    return status >= 200 && status < 300;
 }
 
 void Socket::HealthCheckLoop() {

@@ -5,7 +5,12 @@
 #include "tfiber/fiber.h"
 #include "tnet/input_messenger.h"
 
-DEFINE_int32(max_pooled_connections_per_remote, 32,
+// Must comfortably exceed the expected per-server concurrency: a caller
+// that can't find an idle pooled connection creates a fresh one, and
+// Return() CLOSES it when the pool is at capacity — an undersized cap
+// turns pooled mode into connect-per-call (the reference's
+// max_connection_pool_size defaults to 100 for the same reason).
+DEFINE_int32(max_pooled_connections_per_remote, 128,
              "idle pooled connections kept per server");
 DEFINE_int32(pooled_idle_close_s, 30,
              "close pooled connections idle this long; <=0 disables");

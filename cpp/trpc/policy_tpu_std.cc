@@ -350,7 +350,11 @@ void ProcessTpuStdRequest(TpuStdMessage* msg, const rpc::RpcMeta& meta) {
     fiber_t tid;
     FiberAttr attr = FIBER_ATTR_NORMAL;
     attr.tag = server->options().fiber_tag;
-    if (fiber_start_background(&tid, &attr, RunUserCall, uc) != 0) {
+    // Urgent: the handler takes this worker NOW and the input fiber is
+    // requeued (it has at most a read-EAGAIN left in a single-request
+    // burst) — shaving a queue round-trip off dispatch latency, like the
+    // reference's run-bthread-immediately ProcessEvent/usercode spawns.
+    if (fiber_start_urgent(&tid, &attr, RunUserCall, uc) != 0) {
         delete uc;  // fall back inline (fiber system saturated/shut down)
         mp->service->CallMethod(mp->method, cntl, req, res, done);
     }

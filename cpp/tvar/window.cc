@@ -16,26 +16,62 @@ SamplerCollector::SamplerCollector() {
 uint64_t SamplerCollector::add(SampleFn fn) {
     std::lock_guard<std::mutex> g(mu_);
     const uint64_t id = next_id_++;
-    fns_.emplace_back(id, std::move(fn));
+    fns_.emplace_back(id, std::make_shared<SampleFn>(std::move(fn)));
     return id;
 }
 
 void SamplerCollector::remove(uint64_t id) {
-    std::lock_guard<std::mutex> g(mu_);
+    std::unique_lock<std::mutex> g(mu_);
     for (size_t i = 0; i < fns_.size(); ++i) {
         if (fns_[i].first == id) {
             fns_[i] = std::move(fns_.back());
             fns_.pop_back();
-            return;
+            break;
         }
     }
+    // remove() from INSIDE a sampler callback (a Window destroyed on the
+    // collector thread itself): waiting would self-deadlock, and it's
+    // already safe — this call can only be the running sampler's own
+    // frame, which won't run again after the erase above.
+    if (std::this_thread::get_id() == collector_tid_) return;
+    // The sampler may be mid-execution off-lock; its owner is about to be
+    // destroyed, so wait it out (Run() re-checks liveness under mu_
+    // before each call, so after this wait it can never start again).
+    cv_.wait(g, [&] { return running_id_ != id; });
 }
 
 void SamplerCollector::Run() {
+    {
+        std::lock_guard<std::mutex> g(mu_);
+        collector_tid_ = std::this_thread::get_id();
+    }
     while (true) {
         std::this_thread::sleep_for(std::chrono::seconds(1));
-        std::lock_guard<std::mutex> g(mu_);
-        for (auto& p : fns_) p.second();
+        std::vector<std::pair<uint64_t, std::shared_ptr<SampleFn>>> snap;
+        {
+            std::lock_guard<std::mutex> g(mu_);
+            snap = fns_;  // shared_ptr copies: fns stay alive off-lock
+        }
+        for (auto& p : snap) {
+            {
+                std::lock_guard<std::mutex> g(mu_);
+                bool alive = false;
+                for (auto& f : fns_) {
+                    if (f.first == p.first) {
+                        alive = true;
+                        break;
+                    }
+                }
+                if (!alive) continue;  // removed since the snapshot
+                running_id_ = p.first;
+            }
+            (*p.second)();
+            {
+                std::lock_guard<std::mutex> g(mu_);
+                running_id_ = 0;
+            }
+            cv_.notify_all();
+        }
     }
 }
 

@@ -5,17 +5,24 @@
 // Window<R> shows the delta of reducer R over the last N seconds.
 #pragma once
 
+#include <condition_variable>
 #include <cstdint>
 #include <deque>
 #include <functional>
+#include <memory>
 #include <mutex>
 #include <sstream>
+#include <thread>
 
 #include "tvar/variable.h"
 
 namespace tpurpc {
 
-// Background 1Hz sampling service.
+// Background 1Hz sampling service. Samplers run OFF the registry lock
+// (reference sampler.cpp keeps its linked samplers unlocked the same
+// way): a slow PassiveStatus callback must not stall registration or the
+// other windows. remove() blocks until the removed sampler is not (and
+// will never again be) running, so Window destruction stays safe.
 class SamplerCollector {
 public:
     static SamplerCollector* singleton();
@@ -28,8 +35,11 @@ private:
     SamplerCollector();
     void Run();
     std::mutex mu_;
-    std::vector<std::pair<uint64_t, SampleFn>> fns_;
+    std::condition_variable cv_;
+    std::vector<std::pair<uint64_t, std::shared_ptr<SampleFn>>> fns_;
     uint64_t next_id_ = 1;
+    uint64_t running_id_ = 0;  // sampler currently executing off-lock
+    std::thread::id collector_tid_;  // set once by Run()
 };
 
 // Window over a reducer-like R (requires R::get_value() returning T and

@@ -162,3 +162,22 @@ def test_stream_flood_gets_refused_not_connection_error(server):
                          if t == H2_DATA and sid == 1)
     assert b"777" in resp_body
     s.close()
+
+
+def test_closed_stream_id_reuse_is_connection_error(server):
+    """After a stream completes and is erased server-side, HEADERS on the
+    same id must be treated as a connection error (RFC 7540 §5.1.1), not
+    dispatched as a fresh request."""
+    s = connect(server)
+    read_frames(s)
+    body = json.dumps({"send_ts_us": 1}).encode()
+    s.sendall(frame(H2_HEADERS, END_HEADERS, 5, req_headers()))
+    s.sendall(frame(H2_DATA, END_STREAM, 5, body))
+    frames = read_frames(s, until_stream_end=True)
+    assert any(t == H2_DATA and sid == 5 for t, f, sid, p in frames)
+    # Reopen the same id.
+    s.sendall(frame(H2_HEADERS, END_HEADERS, 5, req_headers()))
+    s.sendall(frame(H2_DATA, END_STREAM, 5, body))
+    frames = read_frames(s, until_stream_end=True, timeout=5)
+    assert not any(t == H2_DATA and sid == 5 for t, f, sid, p in frames)
+    s.close()

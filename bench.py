@@ -15,6 +15,7 @@ MEDIAN (plus min/max spread for the record). Also included:
   - scale_*: qps vs caller fibers 1/4/16/64 (reference benchmark.md:110).
 """
 import json
+import sys
 import statistics
 import subprocess
 from pathlib import Path
@@ -74,6 +75,29 @@ def median_rounds(args, reps=REPS):
     return combined, len(runs)
 
 
+def device_path():
+    """Framed payloads host->HBM->host through the C++ wire path on the
+    real chip (brpc_tpu/device_path.py). Subprocess + timeout: the first
+    touch of a tunneled TPU backend can hang."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-m", "brpc_tpu.device_path", "4", "5"],
+            capture_output=True, text=True, timeout=300, cwd=str(REPO),
+        )
+    except subprocess.TimeoutExpired:
+        return None
+    if proc.returncode != 0:
+        return None
+    for line in proc.stdout.splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                continue
+    return None
+
+
 def main():
     try:
         build()
@@ -112,6 +136,7 @@ def main():
     tail = run_tool("echo_bench", ["--json", "--tail"], timeout=600)
     scale = run_tool("echo_bench", ["--json", "--scale", "--ici"],
                      timeout=600)
+    device = device_path()
 
     mbps = float(ici["mbps"])
     out = {
@@ -134,6 +159,8 @@ def main():
         out.update(tail)
     if scale is not None:
         out.update(scale)
+    if device is not None:
+        out.update(device)
     print(json.dumps(out))
 
 

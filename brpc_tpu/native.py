@@ -1,0 +1,122 @@
+"""ctypes bridge to the C++ framework (libtpurpc.so).
+
+This is how the JAX side drives the FRAMEWORK's own code — tpu_std
+framing (cpp/trpc/policy_tpu_std.cc), crc32c (cpp/tbase/crc32c.cc), and
+registered-memory staging buffers (cpp/tici/block_pool.cc) — instead of a
+Python re-implementation. dryrun_multichip and the device-path benchmark
+both route every payload through these entry points, so a C++ framing or
+checksum regression fails the multi-chip validation.
+
+Reference parity: the RDMA build's block_pool.h hands registered memory
+to the transport; here the same pool stages bytes that jax.device_put
+DMAs to HBM.
+"""
+from __future__ import annotations
+
+import ctypes
+from pathlib import Path
+
+import numpy as np
+
+_REPO = Path(__file__).resolve().parent.parent
+_LIB = None
+
+
+def lib() -> ctypes.CDLL:
+    global _LIB
+    if _LIB is None:
+        so = _REPO / "build" / "libtpurpc.so"
+        if not so.exists():
+            raise FileNotFoundError(
+                f"{so} not built; run cmake/ninja first (bench.py build())"
+            )
+        L = ctypes.CDLL(str(so))
+        L.tpurpc_global_init.restype = ctypes.c_int
+        L.tpurpc_crc32c.restype = ctypes.c_uint32
+        L.tpurpc_crc32c.argtypes = [ctypes.c_uint32, ctypes.c_void_p,
+                                    ctypes.c_size_t]
+        L.tpurpc_block_alloc.restype = ctypes.c_void_p
+        L.tpurpc_block_alloc.argtypes = [ctypes.c_size_t]
+        L.tpurpc_block_free.argtypes = [ctypes.c_void_p]
+        L.tpurpc_block_is_registered.restype = ctypes.c_int
+        L.tpurpc_block_is_registered.argtypes = [ctypes.c_void_p]
+        L.tpurpc_frame.restype = ctypes.c_long
+        L.tpurpc_frame.argtypes = [ctypes.c_uint64, ctypes.c_void_p,
+                                   ctypes.c_size_t, ctypes.c_void_p,
+                                   ctypes.c_size_t]
+        L.tpurpc_unframe.restype = ctypes.c_long
+        L.tpurpc_unframe.argtypes = [
+            ctypes.c_void_p, ctypes.c_size_t,
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_size_t),
+            ctypes.POINTER(ctypes.c_size_t),
+        ]
+        if L.tpurpc_global_init() != 0:
+            raise RuntimeError("tpurpc_global_init failed")
+        _LIB = L
+    return _LIB
+
+
+def crc32c(data: bytes | np.ndarray, init: int = 0) -> int:
+    buf = np.ascontiguousarray(data).view(np.uint8) if isinstance(
+        data, np.ndarray) else np.frombuffer(data, dtype=np.uint8)
+    return int(lib().tpurpc_crc32c(
+        init, buf.ctypes.data_as(ctypes.c_void_p), buf.nbytes))
+
+
+class PoolBuffer:
+    """A staging buffer carved from the registered ICI block pool,
+    exposed to numpy/JAX zero-copy via the buffer protocol."""
+
+    def __init__(self, nbytes: int):
+        self._ptr = lib().tpurpc_block_alloc(nbytes)
+        if not self._ptr:
+            raise MemoryError(f"pool alloc of {nbytes} bytes failed")
+        self.nbytes = nbytes
+        self.registered = bool(
+            lib().tpurpc_block_is_registered(self._ptr))
+        self.array = np.ctypeslib.as_array(
+            ctypes.cast(self._ptr, ctypes.POINTER(ctypes.c_uint8)),
+            shape=(nbytes,),
+        )
+
+    def free(self):
+        if self._ptr:
+            lib().tpurpc_block_free(self._ptr)
+            self._ptr = None
+            self.array = None
+
+
+def frame(correlation_id: int, payload: np.ndarray,
+          out: np.ndarray | None = None) -> np.ndarray:
+    """tpu_std-frame `payload` (any contiguous array) via the C++
+    framework; returns a uint8 view of the frame (in `out` if given)."""
+    pay = np.ascontiguousarray(payload).view(np.uint8).reshape(-1)
+    cap = pay.nbytes + 1024
+    if out is None:
+        out = np.empty(cap, dtype=np.uint8)
+    elif out.nbytes < cap:
+        raise ValueError("out buffer too small")
+    n = lib().tpurpc_frame(
+        correlation_id, pay.ctypes.data_as(ctypes.c_void_p), pay.nbytes,
+        out.ctypes.data_as(ctypes.c_void_p), out.nbytes)
+    if n < 0:
+        raise ValueError("tpurpc_frame failed")
+    return out[:n]
+
+
+def unframe(buf: np.ndarray) -> tuple[int, np.ndarray, int]:
+    """Parse + checksum-verify ONE frame via the C++ framework.
+    Returns (correlation_id, payload bytes (a view into buf), consumed)."""
+    b = np.ascontiguousarray(buf).view(np.uint8).reshape(-1)
+    cid = ctypes.c_uint64()
+    off = ctypes.c_size_t()
+    length = ctypes.c_size_t()
+    n = lib().tpurpc_unframe(
+        b.ctypes.data_as(ctypes.c_void_p), b.nbytes,
+        ctypes.byref(cid), ctypes.byref(off), ctypes.byref(length))
+    if n == -1:
+        raise ValueError("incomplete frame")
+    if n < 0:
+        raise ValueError("corrupt frame (bad magic/meta/crc32c)")
+    return int(cid.value), b[off.value:off.value + length.value], int(n)

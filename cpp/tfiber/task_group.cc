@@ -298,6 +298,7 @@ void TaskControl::ensure_started() {
 }
 
 void TaskControl::add_workers_locked(int n) {
+    if (stopped_.load(std::memory_order_relaxed)) return;
     for (int i = 0; i < n; ++i) {
         const size_t idx = ngroup_.load(std::memory_order_relaxed);
         if (idx >= kMaxGroups) {
@@ -349,19 +350,30 @@ void TaskControl::ready_to_run_remote(TaskMeta* m) {
 }
 
 bool TaskControl::pop_remote(TaskMeta** m) {
-    // Overflow first: spilled fibers are the OLDEST — under sustained
-    // load the ring is never empty, so draining it first would starve
-    // the spill indefinitely (rough FIFO preserved this way).
-    if (overflow_size_.load(std::memory_order_acquire) != 0) {
-        std::lock_guard<std::mutex> g(overflow_mu_);
-        if (!overflow_q_.empty()) {
-            *m = overflow_q_.front();
-            overflow_q_.pop_front();
-            overflow_size_.fetch_sub(1, std::memory_order_release);
-            return true;
+    // Ring first: ring entries are OLDER than anything spilled (spills
+    // only happen when the ring is full). To keep the spill from
+    // starving while the ring stays busy, each successful pop migrates a
+    // bounded batch of spilled fibers into the freed ring slots — they
+    // land BEHIND the remaining ring entries, preserving rough FIFO,
+    // and both queues make progress under sustained saturation.
+    if (remote_ring_.pop(m)) {
+        if (overflow_size_.load(std::memory_order_acquire) != 0) {
+            std::lock_guard<std::mutex> g(overflow_mu_);
+            for (int i = 0; i < 64 && !overflow_q_.empty(); ++i) {
+                if (!remote_ring_.push(overflow_q_.front())) break;
+                overflow_q_.pop_front();
+                overflow_size_.fetch_sub(1, std::memory_order_release);
+            }
         }
+        return true;
     }
-    return remote_ring_.pop(m);
+    if (overflow_size_.load(std::memory_order_acquire) == 0) return false;
+    std::lock_guard<std::mutex> g(overflow_mu_);
+    if (overflow_q_.empty()) return false;
+    *m = overflow_q_.front();
+    overflow_q_.pop_front();
+    overflow_size_.fetch_sub(1, std::memory_order_release);
+    return true;
 }
 
 bool TaskControl::steal_task(TaskMeta** m, uint64_t* seed, int exclude) {
@@ -383,13 +395,21 @@ bool TaskControl::steal_task(TaskMeta** m, uint64_t* seed, int exclude) {
 }
 
 void TaskControl::stop_and_join() {
-    // start_mu_ serializes against set_concurrency growth: the workers_
-    // vector may otherwise reallocate mid-iteration, and a worker added
-    // after the loop passed its slot would never be joined.
-    std::lock_guard<std::mutex> g(start_mu_);
-    stopped_.store(true, std::memory_order_release);
-    parking_lot_.stop();
-    for (auto& t : workers_) {
+    // Snapshot the workers under start_mu_ (serializing against
+    // set_concurrency growth), but JOIN outside it: a fiber on a worker
+    // may itself be blocked in set_concurrency on start_mu_, and joining
+    // that worker while holding the lock would deadlock. Once stopped_
+    // is set, add_workers_locked refuses to grow, so the snapshot is
+    // complete.
+    std::vector<std::thread> to_join;
+    {
+        std::lock_guard<std::mutex> g(start_mu_);
+        stopped_.store(true, std::memory_order_release);
+        parking_lot_.stop();
+        to_join = std::move(workers_);
+        workers_.clear();
+    }
+    for (auto& t : to_join) {
         if (t.joinable()) t.join();
     }
 }

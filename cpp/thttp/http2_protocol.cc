@@ -605,8 +605,9 @@ void HandleHeaderBlockDone(Socket* s, H2Session* sess, uint32_t stream_id,
             sess->streams.size() >= kMaxStreams) {
             refuse = true;
         } else {
-            if (it == sess->streams.end() &&
-                stream_id > sess->max_stream_id) {
+            if (it == sess->streams.end()) {
+                // New stream: necessarily > max_stream_id (the reuse
+                // guard above failed the connection otherwise).
                 sess->max_stream_id = stream_id;
             }
             H2Stream& st = it != sess->streams.end()

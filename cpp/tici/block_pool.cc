@@ -186,6 +186,31 @@ void* IciBlockPool::AllocateSharedBlock() {
     return nullptr;
 }
 
+void* IciBlockPool::AllocateRegistered(size_t n) {
+    PoolState& p = pool();
+    std::lock_guard<std::mutex> g(p.mu);
+    if (p.regions.empty()) return nullptr;
+    n = (n + 4095) & ~(size_t)4095;  // page-align carve for DMA
+    if (n > p.region_step) {
+        // One-off oversized region of its own.
+        void* mem = mmap(nullptr, n, PROT_READ | PROT_WRITE,
+                         MAP_PRIVATE | MAP_ANONYMOUS, -1, 0);
+        if (mem == MAP_FAILED) return nullptr;
+        p.regions.push_back(Region{(char*)mem, n});
+        // Keep the carve pointer on the PREVIOUS region: this one is
+        // fully consumed by the chunk.
+        std::swap(p.regions[p.regions.size() - 2],
+                  p.regions[p.regions.size() - 1]);
+        return mem;
+    }
+    if (p.carve_offset + n > p.regions.back().size) {
+        if (!grow_locked(p)) return nullptr;
+    }
+    void* b = p.regions.back().base + p.carve_offset;
+    p.carve_offset += n;
+    return b;
+}
+
 bool IciBlockPool::Contains(const void* ptr) {
     PoolState& p = pool();
     std::lock_guard<std::mutex> g(p.mu);

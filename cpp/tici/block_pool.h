@@ -36,6 +36,14 @@ public:
     // null when none is free (bounce buffers for the cross-process send
     // path, which must be peer-visible). Deallocate() as usual.
     static void* AllocateSharedBlock();
+
+    // A large contiguous chunk carved from registered region memory —
+    // staging buffers for device DMA (the JAX device-path benchmark
+    // device_puts straight out of these). Carve-only: chunks live for the
+    // process (free is a no-op); intended for long-lived transfer
+    // arenas, like the reference's GB-step RDMA regions
+    // (/root/reference/src/brpc/rdma/block_pool.cpp RegisterMemory).
+    static void* AllocateRegistered(size_t n);
     // Deallocator for bounce blocks: same routing as Deallocate, but a
     // DISTINCT function pointer so IOBuf::Block::dec_ref bypasses the TLS
     // block cache (bounce blocks must return to the shared freelist where

@@ -1,0 +1,89 @@
+#include "trpc/c_api.h"
+
+#include <cstring>
+
+#include "rpc_meta.pb.h"
+#include "tbase/crc32c.h"
+#include "tbase/iobuf.h"
+#include "tici/block_pool.h"
+#include "trpc/pb_compat.h"
+#include "trpc/policy_tpu_std.h"
+
+namespace {
+constexpr char kMagic[4] = {'T', 'R', 'P', 'C'};
+constexpr size_t kHeaderLen = 12;  // "TRPC" + u32be body + u32be meta
+}  // namespace
+
+extern "C" {
+
+int tpurpc_global_init() {
+    tpurpc::GlobalInitializeOrDie();
+    return tpurpc::IciBlockPool::Init() == 0 ? 0 : -1;
+}
+
+uint32_t tpurpc_crc32c(uint32_t init, const void* data, size_t n) {
+    return tpurpc::crc32c_extend(init, (const char*)data, n);
+}
+
+void* tpurpc_block_alloc(size_t n) {
+    if (tpurpc::IciBlockPool::initialized()) {
+        void* p = tpurpc::IciBlockPool::AllocateRegistered(n);
+        if (p != nullptr) return p;
+    }
+    return malloc(n);
+}
+
+void tpurpc_block_free(void* p) {
+    // Registered chunks are carve-only (process-lifetime staging arenas);
+    // only malloc fallbacks are freed.
+    if (!tpurpc::IciBlockPool::Contains(p)) free(p);
+}
+
+int tpurpc_block_is_registered(const void* p) {
+    return tpurpc::IciBlockPool::Contains(p) ? 1 : 0;
+}
+
+long tpurpc_frame(uint64_t correlation_id, const void* payload, size_t n,
+                  void* out, size_t out_cap) {
+    tpurpc::rpc::RpcMeta meta;
+    meta.set_correlation_id(correlation_id);
+    meta.set_attachment_size((uint32_t)n);
+    meta.set_body_checksum(
+        tpurpc::crc32c_extend(0, (const char*)payload, n));
+    tpurpc::IOBuf meta_buf;
+    if (!tpurpc::SerializePbToIOBuf(meta, &meta_buf)) return -1;
+    tpurpc::IOBuf frame, attachment;
+    attachment.append(payload, n);
+    tpurpc::PackTpuStdFrame(&frame, meta_buf, tpurpc::IOBuf(), attachment);
+    if (frame.size() > out_cap) return -1;
+    frame.copy_to(out, frame.size());
+    return (long)frame.size();
+}
+
+long tpurpc_unframe(const void* buf, size_t n, uint64_t* cid,
+                    size_t* payload_off, size_t* payload_len) {
+    const char* p = (const char*)buf;
+    if (n < kHeaderLen) return -1;
+    if (memcmp(p, kMagic, 4) != 0) return -2;
+    uint32_t body_be, meta_be;
+    memcpy(&body_be, p + 4, 4);
+    memcpy(&meta_be, p + 8, 4);
+    const uint32_t body_size = __builtin_bswap32(body_be);
+    const uint32_t meta_size = __builtin_bswap32(meta_be);
+    if (meta_size > body_size || body_size > (256u << 20)) return -2;
+    if (n < kHeaderLen + body_size) return -1;
+    tpurpc::rpc::RpcMeta meta;
+    if (!meta.ParseFromArray(p + kHeaderLen, (int)meta_size)) return -2;
+    const size_t off = kHeaderLen + meta_size;
+    const size_t len = body_size - meta_size;
+    if (meta.has_body_checksum() &&
+        tpurpc::crc32c_extend(0, p + off, len) != meta.body_checksum()) {
+        return -2;
+    }
+    if (cid != nullptr) *cid = meta.correlation_id();
+    if (payload_off != nullptr) *payload_off = off;
+    if (payload_len != nullptr) *payload_len = len;
+    return (long)(kHeaderLen + body_size);
+}
+
+}  // extern "C"

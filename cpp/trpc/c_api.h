@@ -1,0 +1,47 @@
+// C ABI surface of the framework: the pieces the Python/JAX side drives
+// directly (ctypes over libtpurpc.so), so the multi-chip dryrun and the
+// device-path benchmark exercise the FRAMEWORK's bytes — real tpu_std
+// framing (policy_tpu_std.cc), real crc32c (tbase/crc32c.cc), staging
+// buffers from the registered-memory ICI block pool (tici/block_pool.cc)
+// — instead of a Python re-implementation.
+//
+// Reference parity: this plays the role the RDMA-registered IOBuf
+// allocator plays in /root/reference/src/brpc/rdma/block_pool.h — the
+// transport pool hands out the memory payloads are framed into, and the
+// device DMA (jax.device_put on this side, ibv_post_send there) reads
+// straight from it.
+#pragma once
+
+#include <stddef.h>
+#include <stdint.h>
+
+extern "C" {
+
+// One-time framework init (protocol registry + ICI block pool). Returns 0.
+int tpurpc_global_init();
+
+// The framework's crc32c (slice-by-8, RFC 3720 polynomial).
+uint32_t tpurpc_crc32c(uint32_t init, const void* data, size_t n);
+
+// Registered-memory staging buffers from the ICI block pool.
+void* tpurpc_block_alloc(size_t n);
+void tpurpc_block_free(void* p);
+// 1 if p lies inside the registered region (diagnostic for tests).
+int tpurpc_block_is_registered(const void* p);
+
+// Frame `payload` as one tpu_std frame: "TRPC" header + RpcMeta
+// {correlation_id, body_checksum=crc32c(payload)} + payload as raw
+// attachment. Writes into out[0..out_cap). Returns the frame size in
+// bytes, or -1 if out_cap is too small.
+long tpurpc_frame(uint64_t correlation_id, const void* payload, size_t n,
+                  void* out, size_t out_cap);
+
+// Parse ONE frame at buf[0..n): verifies the header, meta, and
+// body_checksum. On success returns bytes consumed and sets *cid,
+// *payload_off, *payload_len (payload bytes live at buf+*payload_off).
+// Returns -1 if more bytes are needed, -2 if the frame is corrupt
+// (bad magic/bounds/meta/checksum).
+long tpurpc_unframe(const void* buf, size_t n, uint64_t* cid,
+                    size_t* payload_off, size_t* payload_len);
+
+}  // extern "C"

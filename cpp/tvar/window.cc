@@ -14,9 +14,11 @@ SamplerCollector::SamplerCollector() {
 }
 
 uint64_t SamplerCollector::add(SampleFn fn) {
+    auto e = std::make_shared<Entry>();
+    e->fn = std::move(fn);
     std::lock_guard<std::mutex> g(mu_);
     const uint64_t id = next_id_++;
-    fns_.emplace_back(id, std::make_shared<SampleFn>(std::move(fn)));
+    fns_.emplace_back(id, std::move(e));
     return id;
 }
 
@@ -24,6 +26,7 @@ void SamplerCollector::remove(uint64_t id) {
     std::unique_lock<std::mutex> g(mu_);
     for (size_t i = 0; i < fns_.size(); ++i) {
         if (fns_[i].first == id) {
+            fns_[i].second->alive.store(false, std::memory_order_release);
             fns_[i] = std::move(fns_.back());
             fns_.pop_back();
             break;
@@ -47,25 +50,23 @@ void SamplerCollector::Run() {
     }
     while (true) {
         std::this_thread::sleep_for(std::chrono::seconds(1));
-        std::vector<std::pair<uint64_t, std::shared_ptr<SampleFn>>> snap;
+        std::vector<std::pair<uint64_t, std::shared_ptr<Entry>>> snap;
         {
             std::lock_guard<std::mutex> g(mu_);
-            snap = fns_;  // shared_ptr copies: fns stay alive off-lock
+            snap = fns_;  // shared_ptr copies: entries stay alive off-lock
         }
         for (auto& p : snap) {
             {
+                // alive + running_id_ flip under ONE mu hold so remove()
+                // can't slip between them; the O(1) atomic replaces a
+                // registry scan per sampler.
                 std::lock_guard<std::mutex> g(mu_);
-                bool alive = false;
-                for (auto& f : fns_) {
-                    if (f.first == p.first) {
-                        alive = true;
-                        break;
-                    }
+                if (!p.second->alive.load(std::memory_order_acquire)) {
+                    continue;  // removed since the snapshot
                 }
-                if (!alive) continue;  // removed since the snapshot
                 running_id_ = p.first;
             }
-            (*p.second)();
+            p.second->fn();
             {
                 std::lock_guard<std::mutex> g(mu_);
                 running_id_ = 0;

@@ -5,6 +5,7 @@
 // Window<R> shows the delta of reducer R over the last N seconds.
 #pragma once
 
+#include <atomic>
 #include <condition_variable>
 #include <cstdint>
 #include <deque>
@@ -32,11 +33,16 @@ public:
     void remove(uint64_t id);
 
 private:
+    struct Entry {
+        std::atomic<bool> alive{true};
+        SampleFn fn;
+    };
+
     SamplerCollector();
     void Run();
     std::mutex mu_;
     std::condition_variable cv_;
-    std::vector<std::pair<uint64_t, std::shared_ptr<SampleFn>>> fns_;
+    std::vector<std::pair<uint64_t, std::shared_ptr<Entry>>> fns_;
     uint64_t next_id_ = 1;
     uint64_t running_id_ = 0;  // sampler currently executing off-lock
     std::thread::id collector_tid_;  // set once by Run()

@@ -1,0 +1,64 @@
+#include "thttp/h2_frames.h"
+
+#include <arpa/inet.h>
+
+#include <algorithm>
+
+#include "thttp/hpack.h"
+
+namespace tpurpc {
+namespace h2 {
+
+void AppendFrame(std::string* out, uint8_t type, uint8_t flags,
+                 uint32_t stream, const char* payload, size_t len) {
+    out->reserve(out->size() + kFrameHeaderLen + len);
+    out->push_back((char)((len >> 16) & 0xff));
+    out->push_back((char)((len >> 8) & 0xff));
+    out->push_back((char)(len & 0xff));
+    out->push_back((char)type);
+    out->push_back((char)flags);
+    const uint32_t sid = htonl(stream & 0x7fffffffu);
+    out->append((const char*)&sid, 4);
+    out->append(payload, len);
+}
+
+std::string BuildFrame(uint8_t type, uint8_t flags, uint32_t stream,
+                       const std::string& payload) {
+    std::string f;
+    AppendFrame(&f, type, flags, stream, payload.data(), payload.size());
+    return f;
+}
+
+void AppendHeadersFrames(std::string* out, uint8_t flags, uint32_t stream,
+                         const std::string& block) {
+    if (block.size() <= kMaxFrameSize) {
+        AppendFrame(out, H2_HEADERS, flags, stream, block.data(),
+                    block.size());
+        return;
+    }
+    const uint8_t end_stream = flags & kFlagEndStream;
+    size_t off = 0;
+    AppendFrame(out, H2_HEADERS, end_stream, stream, block.data(),
+                kMaxFrameSize);
+    off += kMaxFrameSize;
+    while (off < block.size()) {
+        const size_t n =
+            std::min<size_t>(kMaxFrameSize, block.size() - off);
+        const bool last = off + n >= block.size();
+        AppendFrame(out, H2_CONTINUATION, last ? kFlagEndHeaders : 0,
+                    stream, block.data() + off, n);
+        off += n;
+    }
+}
+
+std::string EncodeHeaderBlock(
+    const std::vector<std::pair<std::string, std::string>>& headers) {
+    std::string block;
+    for (const auto& kv : headers) {
+        HpackEncodeHeader(kv.first, kv.second, &block);
+    }
+    return block;
+}
+
+}  // namespace h2
+}  // namespace tpurpc

@@ -1,0 +1,59 @@
+// HTTP/2 frame constants + builders shared by the server protocol
+// (http2_protocol.cc) and the client session (http2_client.cc).
+// RFC 7540 §4/§6; reference: the framing half of
+// /root/reference/src/brpc/policy/http2_rpc_protocol.cpp and http2.h.
+#pragma once
+
+#include <cstddef>
+#include <cstdint>
+#include <string>
+#include <utility>
+#include <vector>
+
+namespace tpurpc {
+namespace h2 {
+
+constexpr char kPreface[] = "PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n";
+constexpr size_t kPrefaceLen = 24;
+constexpr size_t kFrameHeaderLen = 9;
+
+enum FrameType : uint8_t {
+    H2_DATA = 0x0,
+    H2_HEADERS = 0x1,
+    H2_PRIORITY = 0x2,
+    H2_RST_STREAM = 0x3,
+    H2_SETTINGS = 0x4,
+    H2_PUSH_PROMISE = 0x5,
+    H2_PING = 0x6,
+    H2_GOAWAY = 0x7,
+    H2_WINDOW_UPDATE = 0x8,
+    H2_CONTINUATION = 0x9,
+};
+
+constexpr uint8_t kFlagEndStream = 0x1;
+constexpr uint8_t kFlagEndHeaders = 0x4;
+constexpr uint8_t kFlagPadded = 0x8;
+constexpr uint8_t kFlagPriority = 0x20;
+constexpr uint8_t kFlagAck = 0x1;
+
+constexpr int64_t kDefaultWindow = 65535;
+constexpr uint32_t kMaxFrameSize = 16384;
+
+// Append one frame (header + payload) onto *out.
+void AppendFrame(std::string* out, uint8_t type, uint8_t flags,
+                 uint32_t stream, const char* payload, size_t len);
+
+std::string BuildFrame(uint8_t type, uint8_t flags, uint32_t stream,
+                       const std::string& payload);
+
+// HEADERS split into CONTINUATION frames when the block exceeds the max
+// frame size (an oversize frame is a connection error).
+void AppendHeadersFrames(std::string* out, uint8_t flags, uint32_t stream,
+                         const std::string& block);
+
+// HPACK-encode a header list (literal-without-indexing; both sides).
+std::string EncodeHeaderBlock(
+    const std::vector<std::pair<std::string, std::string>>& headers);
+
+}  // namespace h2
+}  // namespace tpurpc

@@ -1,0 +1,593 @@
+#include "thttp/http2_client.h"
+
+#include <arpa/inet.h>
+
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <vector>
+
+#include "tbase/errno.h"
+#include "tbase/logging.h"
+#include "tbase/time.h"
+#include "tfiber/butex.h"
+#include "thttp/h2_frames.h"
+#include "thttp/hpack.h"
+#include "tnet/input_messenger.h"
+#include "tnet/protocol.h"
+#include "trpc/controller.h"
+
+namespace tpurpc {
+
+using namespace h2;
+
+namespace {
+
+constexpr size_t kMaxRespBody = 64u << 20;
+constexpr size_t kMaxHeaderBlock = 64u << 10;
+
+int g_h2_client_index = -1;
+
+// Per-connection client session, installed as the socket's conn_data
+// BEFORE the first write, so response parsing can claim the bytes.
+struct H2ClientSession {
+    std::mutex mu;
+    HpackDecoder decoder;           // response header blocks
+    uint32_t next_stream_id = 1;    // odd, increasing (RFC 7540 §5.1.1)
+    bool preface_sent = false;
+    int64_t conn_send_window = kDefaultWindow;
+    int64_t peer_initial_window = kDefaultWindow;
+    void* window_butex = butex_create();
+
+    struct RespStream {
+        uint64_t cid;
+        std::vector<HpackHeader> headers;   // response HEADERS
+        std::vector<HpackHeader> trailers;  // trailing HEADERS
+        IOBuf body;
+        bool has_headers = false;
+        int64_t send_window = kDefaultWindow;
+    };
+    std::map<uint32_t, RespStream> streams;
+
+    uint32_t cont_stream = 0;  // CONTINUATION expected for this stream
+    uint8_t cont_flags = 0;
+    std::string header_block;
+
+    ~H2ClientSession() { butex_destroy(window_butex); }
+
+    void WakeWindowWaiters() {
+        butex_word(window_butex)->fetch_add(1, std::memory_order_release);
+        butex_wake_all(window_butex);
+    }
+};
+
+void FailAllStreams(H2ClientSession* sess, int error);
+
+// Runs at socket recycle (last ref dropped — no fiber can still touch
+// the connection): pending calls learn their connection died here; until
+// then their RPC timeouts cover the gap, like tpu_std responses on a
+// dead socket.
+void DeleteClientSession(void* s) {
+    auto* sess = (H2ClientSession*)s;
+    FailAllStreams(sess, TERR_FAILED_SOCKET);
+    delete sess;
+}
+
+H2ClientSession* client_session_of(Socket* s) {
+    // Only sockets we marked at send time carry a client session; the
+    // preferred-protocol check makes the conn_data cast safe (a server
+    // h2 socket stores an H2Session under a different protocol index).
+    if (s->preferred_protocol_index != g_h2_client_index) return nullptr;
+    return (H2ClientSession*)s->conn_data();
+}
+
+const std::string* FindHeader(const std::vector<HpackHeader>& hs,
+                              const char* name) {
+    for (const auto& h : hs) {
+        if (h.name == name) return &h.value;
+    }
+    return nullptr;
+}
+
+// Fail every pending stream of the session (connection died / GOAWAY).
+void FailAllStreams(H2ClientSession* sess, int error) {
+    std::vector<uint64_t> cids;
+    {
+        std::lock_guard<std::mutex> g(sess->mu);
+        for (auto& kv : sess->streams) cids.push_back(kv.second.cid);
+        sess->streams.clear();
+    }
+    for (uint64_t cid : cids) {
+        CompleteClientUnaryResponse(cid, error, "h2 connection failed",
+                                    nullptr);
+    }
+}
+
+// ---------------- response completion ----------------
+
+// Map grpc-status (trailers) / :status to the RPC result and finish.
+void CompleteStream(H2ClientSession::RespStream&& st) {
+    const std::string* status = FindHeader(st.headers, ":status");
+    // Trailers-only responses put grpc-status in the first (only) block.
+    const std::string* grpc_status = FindHeader(st.trailers, "grpc-status");
+    if (grpc_status == nullptr) {
+        grpc_status = FindHeader(st.headers, "grpc-status");
+    }
+    const std::string* grpc_msg = FindHeader(st.trailers, "grpc-message");
+    if (grpc_msg == nullptr) {
+        grpc_msg = FindHeader(st.headers, "grpc-message");
+    }
+    if (status != nullptr && *status != "200") {
+        CompleteClientUnaryResponse(st.cid, TERR_RESPONSE,
+                                    "h2 :status " + *status, nullptr);
+        return;
+    }
+    if (grpc_status != nullptr && *grpc_status != "0") {
+        CompleteClientUnaryResponse(
+            st.cid, TERR_RESPONSE,
+            "grpc-status " + *grpc_status +
+                (grpc_msg != nullptr ? ": " + *grpc_msg : std::string()),
+            nullptr);
+        return;
+    }
+    // gRPC unary body: 1-byte compressed flag + u32be length + pb.
+    if (st.body.size() < 5) {
+        CompleteClientUnaryResponse(st.cid, TERR_RESPONSE,
+                                    "short grpc response body", nullptr);
+        return;
+    }
+    char prefix[5];
+    st.body.cutn(prefix, 5);
+    if (prefix[0] != 0) {
+        CompleteClientUnaryResponse(st.cid, TERR_RESPONSE,
+                                    "compressed grpc response unsupported",
+                                    nullptr);
+        return;
+    }
+    uint32_t len;
+    memcpy(&len, prefix + 1, 4);
+    len = ntohl(len);
+    if ((size_t)len != st.body.size()) {
+        CompleteClientUnaryResponse(st.cid, TERR_RESPONSE,
+                                    "grpc length prefix mismatch", nullptr);
+        return;
+    }
+    CompleteClientUnaryResponse(st.cid, 0, "", &st.body);
+}
+
+// ---------------- frame processing (input fiber, in order) ----------------
+
+class H2ClientFrame : public InputMessageBase {
+public:
+    uint8_t type = 0;
+    uint8_t flags = 0;
+    uint32_t stream_id = 0;
+    IOBuf payload;
+};
+
+void HandleHeaderBlockDone(Socket* s, H2ClientSession* sess,
+                           uint32_t stream_id, uint8_t flags) {
+    std::vector<HpackHeader> headers;
+    if (!sess->decoder.Decode((const uint8_t*)sess->header_block.data(),
+                              sess->header_block.size(), &headers)) {
+        s->SetFailedWithError(TERR_RESPONSE);  // COMPRESSION_ERROR
+        return;
+    }
+    sess->header_block.clear();
+    if (stream_id == 0) return;
+    const bool complete = (flags & kFlagEndStream) != 0;
+    H2ClientSession::RespStream done;
+    bool finish = false;
+    {
+        std::lock_guard<std::mutex> g(sess->mu);
+        auto it = sess->streams.find(stream_id);
+        if (it == sess->streams.end()) return;  // canceled/unknown
+        H2ClientSession::RespStream& st = it->second;
+        if (!st.has_headers) {
+            st.headers = std::move(headers);
+            st.has_headers = true;
+        } else {
+            st.trailers = std::move(headers);
+        }
+        if (complete) {
+            done = std::move(st);
+            sess->streams.erase(it);
+            finish = true;
+        }
+    }
+    if (finish) CompleteStream(std::move(done));
+}
+
+void ProcessH2ClientFrame(InputMessageBase* raw) {
+    std::unique_ptr<H2ClientFrame> msg((H2ClientFrame*)raw);
+    SocketUniquePtr s = SocketUniquePtr::FromId(msg->socket_id);
+    if (!s) return;
+    H2ClientSession* sess = client_session_of(s.get());
+    if (sess == nullptr) return;
+
+    // CONTINUATION discipline (same as the server side).
+    if (sess->cont_stream != 0 && (msg->type != H2_CONTINUATION ||
+                                   msg->stream_id != sess->cont_stream)) {
+        s->SetFailedWithError(TERR_RESPONSE);
+        return;
+    }
+
+    switch (msg->type) {
+        case H2_SETTINGS: {
+            if (msg->flags & kFlagAck) break;
+            const std::string p = msg->payload.to_string();
+            for (size_t off = 0; off + 6 <= p.size(); off += 6) {
+                uint16_t id;
+                uint32_t value;
+                memcpy(&id, p.data() + off, 2);
+                memcpy(&value, p.data() + off + 2, 4);
+                id = ntohs(id);
+                value = ntohl(value);
+                if (id == 0x4) {  // SETTINGS_INITIAL_WINDOW_SIZE
+                    std::lock_guard<std::mutex> g(sess->mu);
+                    const int64_t delta =
+                        (int64_t)value - sess->peer_initial_window;
+                    sess->peer_initial_window = value;
+                    for (auto& kv : sess->streams) {
+                        kv.second.send_window += delta;
+                    }
+                    sess->WakeWindowWaiters();
+                }
+            }
+            IOBuf ack;
+            ack.append(BuildFrame(H2_SETTINGS, kFlagAck, 0, ""));
+            s->Write(&ack);
+            break;
+        }
+        case H2_PING: {
+            if (msg->flags & kFlagAck) break;
+            IOBuf ack;
+            ack.append(BuildFrame(H2_PING, kFlagAck, 0,
+                                  msg->payload.to_string()));
+            s->Write(&ack);
+            break;
+        }
+        case H2_WINDOW_UPDATE: {
+            if (msg->payload.size() != 4) break;
+            uint32_t inc;
+            msg->payload.copy_to(&inc, 4);
+            inc = ntohl(inc) & 0x7fffffffu;
+            std::lock_guard<std::mutex> g(sess->mu);
+            if (msg->stream_id == 0) {
+                sess->conn_send_window += inc;
+            } else {
+                auto it = sess->streams.find(msg->stream_id);
+                if (it != sess->streams.end()) {
+                    it->second.send_window += inc;
+                }
+            }
+            sess->WakeWindowWaiters();
+            break;
+        }
+        case H2_HEADERS: {
+            IOBuf frag = std::move(msg->payload);
+            if (msg->flags & kFlagPadded) {
+                uint8_t pad;
+                if (frag.size() < 1 || ((void)frag.cutn(&pad, 1),
+                                        (size_t)pad > frag.size())) {
+                    s->SetFailedWithError(TERR_RESPONSE);
+                    return;
+                }
+                IOBuf tmp;
+                frag.cutn(&tmp, frag.size() - pad);
+                frag.swap(tmp);
+            }
+            if (msg->flags & kFlagPriority) {
+                if (frag.size() < 5) {
+                    s->SetFailedWithError(TERR_RESPONSE);
+                    return;
+                }
+                IOBuf drop;
+                frag.cutn(&drop, 5);
+            }
+            sess->header_block += frag.to_string();
+            if (sess->header_block.size() > kMaxHeaderBlock) {
+                s->SetFailedWithError(TERR_RESPONSE);
+                return;
+            }
+            if (msg->flags & kFlagEndHeaders) {
+                HandleHeaderBlockDone(s.get(), sess, msg->stream_id,
+                                      msg->flags);
+            } else {
+                sess->cont_stream = msg->stream_id;
+                sess->cont_flags = msg->flags;
+            }
+            break;
+        }
+        case H2_CONTINUATION: {
+            if (sess->cont_stream == 0) {
+                s->SetFailedWithError(TERR_RESPONSE);
+                return;
+            }
+            sess->header_block += msg->payload.to_string();
+            if (sess->header_block.size() > kMaxHeaderBlock) {
+                s->SetFailedWithError(TERR_RESPONSE);
+                return;
+            }
+            if (msg->flags & kFlagEndHeaders) {
+                const uint8_t hf = sess->cont_flags;
+                sess->cont_stream = 0;
+                HandleHeaderBlockDone(s.get(), sess, msg->stream_id, hf);
+            }
+            break;
+        }
+        case H2_DATA: {
+            const size_t sz = msg->payload.size();
+            IOBuf frag = std::move(msg->payload);
+            if (msg->flags & kFlagPadded) {
+                uint8_t pad;
+                if (frag.size() < 1 || ((void)frag.cutn(&pad, 1),
+                                        (size_t)pad > frag.size())) {
+                    s->SetFailedWithError(TERR_RESPONSE);
+                    return;
+                }
+                IOBuf tmp;
+                frag.cutn(&tmp, frag.size() - pad);
+                frag.swap(tmp);
+            }
+            H2ClientSession::RespStream done;
+            bool finish = false;
+            bool known = false;
+            {
+                std::lock_guard<std::mutex> g(sess->mu);
+                auto it = sess->streams.find(msg->stream_id);
+                if (it != sess->streams.end()) {
+                    known = true;
+                    it->second.body.append(frag);
+                    if (it->second.body.size() > kMaxRespBody) {
+                        s->SetFailedWithError(TERR_RESPONSE);
+                        return;
+                    }
+                    if (msg->flags & kFlagEndStream) {
+                        done = std::move(it->second);
+                        sess->streams.erase(it);
+                        finish = true;
+                    }
+                }
+            }
+            // Replenish receive windows (conn always; stream while open).
+            if (sz > 0) {
+                uint32_t inc = htonl((uint32_t)sz);
+                std::string p((const char*)&inc, 4);
+                std::string out = BuildFrame(H2_WINDOW_UPDATE, 0, 0, p);
+                if (known && !finish) {
+                    out += BuildFrame(H2_WINDOW_UPDATE, 0, msg->stream_id,
+                                      p);
+                }
+                IOBuf buf;
+                buf.append(out);
+                s->Write(&buf);
+            }
+            if (finish) CompleteStream(std::move(done));
+            break;
+        }
+        case H2_RST_STREAM: {
+            uint64_t cid = 0;
+            {
+                std::lock_guard<std::mutex> g(sess->mu);
+                auto it = sess->streams.find(msg->stream_id);
+                if (it == sess->streams.end()) break;
+                cid = it->second.cid;
+                sess->streams.erase(it);
+            }
+            CompleteClientUnaryResponse(cid, TERR_RESPONSE,
+                                        "stream reset by server", nullptr);
+            break;
+        }
+        case H2_GOAWAY:
+            FailAllStreams(sess, TERR_FAILED_SOCKET);
+            // Fail the connection too: new RPCs must not open streams on
+            // a draining peer (they'd hang until their deadline — the
+            // server ignores ids above last_stream_id). The channel
+            // re-creates its pinned connection on the next call.
+            s->SetFailedWithError(TERR_FAILED_SOCKET);
+            break;
+        default:
+            break;
+    }
+}
+
+ParseResult ParseH2ClientFrames(IOBuf* source, Socket* socket,
+                                bool read_eof, const void* arg) {
+    if (client_session_of(socket) == nullptr) {
+        return ParseResult::make(ParseError::TRY_OTHERS);
+    }
+    if (source->size() < kFrameHeaderLen) {
+        return ParseResult::make(ParseError::NOT_ENOUGH_DATA);
+    }
+    char header[kFrameHeaderLen];
+    source->copy_to(header, kFrameHeaderLen);
+    const uint32_t len = ((uint32_t)(uint8_t)header[0] << 16) |
+                         ((uint32_t)(uint8_t)header[1] << 8) |
+                         (uint32_t)(uint8_t)header[2];
+    if (len > kMaxFrameSize + 255) {
+        return ParseResult::make(ParseError::ERROR);
+    }
+    if (source->size() < kFrameHeaderLen + len) {
+        return ParseResult::make(ParseError::NOT_ENOUGH_DATA);
+    }
+    source->pop_front(kFrameHeaderLen);
+    auto* msg = new H2ClientFrame;
+    msg->type = (uint8_t)header[3];
+    msg->flags = (uint8_t)header[4];
+    uint32_t sid;
+    memcpy(&sid, header + 5, 4);
+    msg->stream_id = ntohl(sid) & 0x7fffffffu;
+    source->cutn(&msg->payload, len);
+    return ParseResult::make_ok(msg);
+}
+
+}  // namespace
+
+// ---------------- send path ----------------
+
+int H2ClientSendUnary(Socket* s, uint64_t cid, const std::string& grpc_path,
+                      const std::string& authority, const IOBuf& request_pb,
+                      int64_t deadline_us) {
+    if (g_h2_client_index < 0) return -1;
+    H2ClientSession* sess = client_session_of(s);
+    std::string out;
+    if (sess == nullptr) {
+        // First RPC on this connection: install the session + preface.
+        // IssueRPC serializes per-socket via the CallId lock only for one
+        // call; two fibers may race here, so install under a plain
+        // compare: set_conn_data is not atomic — but both racers run on
+        // the SAME channel's first calls, which the SocketMap serializes
+        // through connect-on-first-write ordering. Guard anyway with a
+        // session-level mutex via double-checked conn_data.
+        static std::mutex install_mu;
+        std::lock_guard<std::mutex> g(install_mu);
+        sess = client_session_of(s);
+        if (sess == nullptr) {
+            sess = new H2ClientSession;
+            s->set_conn_data(sess, DeleteClientSession);
+            s->preferred_protocol_index = g_h2_client_index;
+        }
+    }
+    // HEADERS: gRPC request pseudo-headers + metadata (built before the
+    // lock; the block itself doesn't depend on the stream id).
+    std::vector<std::pair<std::string, std::string>> headers = {
+        {":method", "POST"},
+        {":scheme", "http"},
+        {":path", grpc_path},
+        {":authority", authority.empty() ? "tpurpc" : authority},
+        {"content-type", "application/grpc"},
+        {"te", "trailers"},
+    };
+    if (deadline_us > 0) {
+        const int64_t remain_ms =
+            (deadline_us - monotonic_time_us()) / 1000;
+        if (remain_ms > 0) {
+            headers.emplace_back("grpc-timeout",
+                                 std::to_string(remain_ms) + "m");
+        }
+    }
+
+    uint32_t stream_id;
+    {
+        // Allocate the stream id AND queue preface+HEADERS under ONE mu
+        // hold: ids must hit the wire in increasing order (RFC 7540
+        // §5.1.1 — a reordered HEADERS is a connection error) and the
+        // preface must precede everything. Socket::Write never blocks,
+        // so holding mu across it is safe; DATA goes out separately
+        // below (inter-stream DATA interleaving is legal).
+        std::lock_guard<std::mutex> g(sess->mu);
+        if (!sess->preface_sent) {
+            out.append(kPreface, kPrefaceLen);
+            out += BuildFrame(H2_SETTINGS, 0, 0, "");
+            sess->preface_sent = true;
+        }
+        stream_id = sess->next_stream_id;
+        sess->next_stream_id += 2;
+        auto& st = sess->streams[stream_id];
+        st.cid = cid;
+        st.send_window = sess->peer_initial_window;
+        AppendHeadersFrames(&out, kFlagEndHeaders, stream_id,
+                            EncodeHeaderBlock(headers));
+        IOBuf hb;
+        hb.append(out);
+        out.clear();
+        if (s->Write(&hb, cid) != 0) {
+            sess->streams.erase(stream_id);
+            return -1;
+        }
+    }
+
+    // Cleanup for send-side failures below: drop our stream entry and
+    // RST it so the server releases its half-open state too.
+    auto abort_stream = [&]() {
+        {
+            std::lock_guard<std::mutex> g(sess->mu);
+            sess->streams.erase(stream_id);
+        }
+        uint32_t code = htonl(0x8);  // CANCEL
+        IOBuf rst;
+        rst.append(BuildFrame(H2_RST_STREAM, 0, stream_id,
+                              std::string((const char*)&code, 4)));
+        s->Write(&rst);
+    };
+
+    // DATA: 5-byte gRPC prefix + pb, chunked to the frame cap. Unary
+    // requests are bounded by the peer's default 64KB window in practice;
+    // larger bodies park on WINDOW_UPDATE below.
+    std::string body;
+    body.push_back('\0');
+    const uint32_t len = htonl((uint32_t)request_pb.size());
+    body.append((const char*)&len, 4);
+    body += request_pb.to_string();
+
+    size_t sent = 0;
+    const int64_t stall_deadline =
+        deadline_us > 0 ? deadline_us
+                        : monotonic_time_us() + 60 * 1000 * 1000;
+    while (sent < body.size()) {
+        // Snapshot before the window check (lost-wakeup guard — see the
+        // server's WriteResponse loop).
+        std::atomic<int>* word = butex_word(sess->window_butex);
+        const int expected = word->load(std::memory_order_acquire);
+        size_t n = 0;
+        {
+            std::lock_guard<std::mutex> g(sess->mu);
+            auto it = sess->streams.find(stream_id);
+            if (it == sess->streams.end()) return -1;  // already failed
+            const int64_t avail = std::min<int64_t>(
+                sess->conn_send_window, it->second.send_window);
+            n = (size_t)std::max<int64_t>(
+                0, std::min<int64_t>(
+                       avail, (int64_t)std::min<size_t>(
+                                  kMaxFrameSize, body.size() - sent)));
+            if (n > 0) {
+                sess->conn_send_window -= (int64_t)n;
+                it->second.send_window -= (int64_t)n;
+            }
+        }
+        if (n == 0) {
+            if (!out.empty()) {
+                IOBuf buf;
+                buf.append(out);
+                out.clear();
+                if (s->Write(&buf) != 0) {
+                    abort_stream();
+                    return -1;
+                }
+            }
+            if (s->Failed() || monotonic_time_us() >= stall_deadline) {
+                abort_stream();
+                return -1;
+            }
+            const int64_t abst = monotonic_time_us() + 1000 * 1000;
+            butex_wait(sess->window_butex, expected, &abst);
+            continue;
+        }
+        const bool last = sent + n >= body.size();
+        AppendFrame(&out, H2_DATA, last ? kFlagEndStream : 0, stream_id,
+                    body.data() + sent, n);
+        sent += n;
+    }
+    IOBuf buf;
+    buf.append(out);
+    if (s->Write(&buf, cid) != 0) {
+        abort_stream();
+        return -1;
+    }
+    return 0;
+}
+
+void RegisterHttp2ClientProtocol() {
+    if (g_h2_client_index >= 0) return;
+    Protocol p;
+    p.parse = ParseH2ClientFrames;
+    p.process = ProcessH2ClientFrame;
+    p.name = "h2c-client";
+    p.process_in_order = true;  // shared HPACK decoder + session state
+    g_h2_client_index = RegisterProtocol(p);
+}
+
+int Http2ClientProtocolIndex() { return g_h2_client_index; }
+
+}  // namespace tpurpc

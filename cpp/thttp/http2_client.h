@@ -1,0 +1,33 @@
+// Client half of HTTP/2: outbound h2c sessions + the gRPC unary client.
+//
+// The framework can CALL gRPC servers (grpcio et al.), not just serve
+// them: Channel{options.protocol="grpc"} routes Controller::IssueRPC
+// here, which multiplexes unary calls as h2 streams over the channel's
+// connection. Reference parity: the client half of
+// /root/reference/src/brpc/policy/http2_rpc_protocol.cpp
+// (PackH2Request/H2UnsentRequest, stream id allocation, SETTINGS/flow
+// control) + grpc.{h,cpp} status mapping.
+#pragma once
+
+#include <cstdint>
+#include <string>
+
+#include "tbase/iobuf.h"
+#include "tnet/socket.h"
+
+namespace tpurpc {
+
+// Send one gRPC unary request on `s` as a new h2 stream (client preface
+// + SETTINGS on first use of the connection). The response completes the
+// RPC via CompleteClientUnaryResponse(cid, ...). `grpc_path` is
+// "/package.Service/Method". Returns 0 on success (frames queued).
+int H2ClientSendUnary(Socket* s, uint64_t cid, const std::string& grpc_path,
+                      const std::string& authority, const IOBuf& request_pb,
+                      int64_t deadline_us);
+
+// Registered at GlobalInitializeOrDie: parses/processes server->client h2
+// frames on sockets carrying an h2 client session.
+void RegisterHttp2ClientProtocol();
+int Http2ClientProtocolIndex();
+
+}  // namespace tpurpc

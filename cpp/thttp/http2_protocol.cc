@@ -1,5 +1,7 @@
 #include "thttp/http2_protocol.h"
 
+#include "thttp/h2_frames.h"
+
 #include <arpa/inet.h>
 
 #include <algorithm>
@@ -34,89 +36,16 @@ namespace tpurpc {
 bool DispatchHttpRpc(Server* server, const HttpRequest& req,
                      HttpResponse* res, const EndPoint& remote_side);
 
+using namespace h2;  // frame constants + builders (thttp/h2_frames.h)
+
 namespace {
 
-constexpr char kPreface[] = "PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n";
-constexpr size_t kPrefaceLen = 24;
-constexpr size_t kFrameHeaderLen = 9;
-
-enum FrameType : uint8_t {
-    H2_DATA = 0x0,
-    H2_HEADERS = 0x1,
-    H2_PRIORITY = 0x2,
-    H2_RST_STREAM = 0x3,
-    H2_SETTINGS = 0x4,
-    H2_PUSH_PROMISE = 0x5,
-    H2_PING = 0x6,
-    H2_GOAWAY = 0x7,
-    H2_WINDOW_UPDATE = 0x8,
-    H2_CONTINUATION = 0x9,
-};
-
-constexpr uint8_t kFlagEndStream = 0x1;
-constexpr uint8_t kFlagEndHeaders = 0x4;
-constexpr uint8_t kFlagPadded = 0x8;
-constexpr uint8_t kFlagPriority = 0x20;
-constexpr uint8_t kFlagAck = 0x1;
-
-constexpr int64_t kDefaultWindow = 65535;
-constexpr uint32_t kMaxFrameSize = 16384;
 // Hardening caps on untrusted input (one connection must not be able to
 // buffer unbounded memory; same posture as the shm link's hostile-
 // descriptor checks and HPACK's kMaxHeaderBytes).
 constexpr size_t kMaxBodyBytes = 64u << 20;
 constexpr size_t kMaxHeaderBlock = 64u << 10;
 constexpr size_t kMaxStreams = 256;
-
-// Append a HEADERS frame, splitting into CONTINUATION frames when the
-// block exceeds the peer's max frame size (an oversize frame is a
-// connection error that would kill every stream).
-void AppendFrame(std::string* out, uint8_t type, uint8_t flags,
-                 uint32_t stream, const char* payload, size_t len);
-void AppendHeadersFrames(std::string* out, uint8_t flags, uint32_t stream,
-                         const std::string& block) {
-    if (block.size() <= kMaxFrameSize) {
-        AppendFrame(out, H2_HEADERS, flags, stream, block.data(),
-                    block.size());
-        return;
-    }
-    const uint8_t end_stream = flags & kFlagEndStream;
-    size_t off = 0;
-    AppendFrame(out, H2_HEADERS, end_stream, stream, block.data(),
-                kMaxFrameSize);
-    off += kMaxFrameSize;
-    while (off < block.size()) {
-        const size_t n = std::min<size_t>(kMaxFrameSize,
-                                          block.size() - off);
-        const bool last = off + n >= block.size();
-        AppendFrame(out, H2_CONTINUATION, last ? kFlagEndHeaders : 0,
-                    stream, block.data() + off, n);
-        off += n;
-    }
-}
-
-// Append a frame header + payload onto *out (no intermediate copies; the
-// DATA path appends body slices directly — IOBuf-native zero-copy DATA is
-// roadmap).
-void AppendFrame(std::string* out, uint8_t type, uint8_t flags,
-                 uint32_t stream, const char* payload, size_t len) {
-    out->reserve(out->size() + kFrameHeaderLen + len);
-    out->push_back((char)((len >> 16) & 0xff));
-    out->push_back((char)((len >> 8) & 0xff));
-    out->push_back((char)(len & 0xff));
-    out->push_back((char)type);
-    out->push_back((char)flags);
-    const uint32_t sid = htonl(stream & 0x7fffffffu);
-    out->append((const char*)&sid, 4);
-    out->append(payload, len);
-}
-
-std::string BuildFrame(uint8_t type, uint8_t flags, uint32_t stream,
-                       const std::string& payload) {
-    std::string f;
-    AppendFrame(&f, type, flags, stream, payload.data(), payload.size());
-    return f;
-}
 
 struct H2Stream {
     std::vector<HpackHeader> headers;
@@ -156,15 +85,6 @@ H2Session* session_of(Socket* s) { return (H2Session*)s->conn_data(); }
 
 // ---------------- response writing ----------------
 
-std::string EncodeHeaderBlock(
-    const std::vector<std::pair<std::string, std::string>>& headers) {
-    std::string block;
-    for (const auto& kv : headers) {
-        HpackEncodeHeader(kv.first, kv.second, &block);
-    }
-    return block;
-}
-
 // Write HEADERS (+optional DATA chunks with flow control) + trailers.
 // Runs on a response fiber holding a socket ref; parks on the session
 // window butex when the send window is exhausted.
@@ -192,6 +112,13 @@ void WriteResponse(
     while (sent < body.size()) {
         // Flow control: consume min(available conn+stream window, frame
         // cap); park until WINDOW_UPDATE when exhausted.
+        // Butex snapshot BEFORE the window check: an update landing
+        // between check and wait changes the word, so the wait returns
+        // immediately instead of losing the wakeup (checked-then-waited
+        // is the classic lost-wakeup race; one miss here stalls the
+        // response for the full wait timeout).
+        std::atomic<int>* word = butex_word(sess->window_butex);
+        const int expected = word->load(std::memory_order_acquire);
         size_t n = 0;
         bool stream_gone = false;
         {
@@ -234,8 +161,6 @@ void WriteResponse(
                 sess->streams.erase(stream_id);
                 return;
             }
-            std::atomic<int>* word = butex_word(sess->window_butex);
-            const int expected = word->load(std::memory_order_acquire);
             const int64_t abst = monotonic_time_us() + 10 * 1000 * 1000;
             butex_wait(sess->window_butex, expected, &abst);
             if (s->Failed()) return;

@@ -10,6 +10,7 @@
 #include "tbase/logging.h"
 #include "tbase/time.h"
 #include "tfiber/fiber.h"
+#include "tnet/tls.h"
 
 namespace tpurpc {
 
@@ -166,6 +167,14 @@ void Acceptor::OnNewConnections(Socket* listen_socket) {
         opts.user = a->messenger_;
         opts.on_recycle = &Acceptor::ConnRecycled;
         opts.recycle_arg = a;
+        if (a->tls_) {
+            opts.transport = NewTlsServerTransport(fd);
+            if (opts.transport == nullptr) {
+                close(fd);
+                continue;
+            }
+            opts.owns_transport = true;
+        }
         // Account BEFORE Create: the socket can fail+recycle (firing the
         // callback) before Create even returns; the liveness-checked
         // insert below then skips the already-recycled id. The accepted

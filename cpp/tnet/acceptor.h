@@ -42,6 +42,10 @@ public:
     int64_t accepted_count() const {
         return accepted_.load(std::memory_order_relaxed);
     }
+
+    // Wrap every accepted connection in a server-side TLS transport
+    // (requires TlsServerInit first; set before StartAccept).
+    void set_tls(bool on) { tls_ = on; }
     // Live accepted connections (for /connections).
     std::vector<SocketId> connections();
 
@@ -60,6 +64,7 @@ private:
     // the recycle callback; listen_live_ covers the listen socket itself.
     std::atomic<int64_t> live_conns_{0};
     std::atomic<bool> listen_live_{false};
+    bool tls_ = false;
     void* quiesce_butex_ = nullptr;
 };
 

@@ -17,6 +17,7 @@
 #include "tbase/time.h"
 #include "tfiber/call_id.h"
 #include "tnet/event_dispatcher.h"
+#include "tnet/tls.h"
 #include "tnet/transport.h"
 
 DEFINE_int64(socket_max_unwritten_bytes, 64 * 1024 * 1024,
@@ -60,7 +61,11 @@ static void ApplySocketBufferSizes(int fd) {
 int Socket::Create(const SocketOptions& options, SocketId* id) {
     Socket* s = nullptr;
     if (VersionedRefWithId<Socket>::Create(id, &s) != 0) {
-        if (options.fd >= 0) close(options.fd);
+        if (options.transport != nullptr && options.owns_transport) {
+            options.transport->Release();  // a TLS transport owns the fd
+        } else if (options.fd >= 0) {
+            close(options.fd);
+        }
         // Keep the fires-exactly-once contract even when no slot was ever
         // allocated (callers pre-account and rely on the callback to undo).
         if (options.on_recycle != nullptr) {
@@ -88,6 +93,9 @@ int Socket::Create(const SocketOptions& options, SocketId* id) {
     s->read_buf.clear();
     s->preferred_protocol_index = -1;
     s->health_check_interval_ms_ = options.health_check_interval_ms;
+    s->tls_ = options.tls;
+    s->tls_alpn_ = options.tls_alpn;
+    s->tls_sni_ = options.tls_sni;
     s->hc_stop_.store(false, std::memory_order_relaxed);
     s->circuit_breaker_.ResetAll();
     // Install before any failure path below: AddConsumer failure recycles
@@ -651,6 +659,22 @@ int Socket::ConnectIfNot() {
         local_side_ = sockaddr2endpoint(local);
     }
     d.UnregisterEpollOut(id(), sock, true);
+    if (tls_) {
+        // Wrap the freshly connected fd BEFORE fd_ becomes visible, so
+        // every write/read path sees the transport together with the fd.
+        TransportEndpoint* t =
+            NewTlsClientTransport(sock, tls_alpn_, tls_sni_);
+        if (t == nullptr) {
+            d.RemoveConsumer(sock);
+            close(sock);
+            connecting_.store(false, std::memory_order_release);
+            word->fetch_add(1, std::memory_order_release);
+            butex_wake_all(connect_butex_);
+            return -1;
+        }
+        transport_ = t;
+        owns_transport_ = true;
+    }
     fd_.store(sock, std::memory_order_release);
     connecting_.store(false, std::memory_order_release);
     word->fetch_add(1, std::memory_order_release);

@@ -19,6 +19,7 @@
 #include <atomic>
 #include <cstdint>
 #include <vector>
+#include <string>
 
 #include "tbase/endpoint.h"
 #include "tbase/iobuf.h"
@@ -52,6 +53,12 @@ struct SocketOptions {
     // src/brpc/details/health_check.cpp — ids held by load balancers stay
     // valid across failures). 0 disables.
     int health_check_interval_ms = 0;
+    // Client-side TLS: after connect, wrap the fd in a TLS transport
+    // (tnet/tls.h) negotiating `tls_alpn` (e.g. "h2") with SNI
+    // `tls_sni`. Requires libssl at runtime.
+    bool tls = false;
+    std::string tls_alpn;
+    std::string tls_sni;
     // Invoked exactly once when the socket's last ref drops and the slot
     // recycles (reference SocketUser::BeforeRecycled). This is how an
     // Acceptor learns no event/processing fiber can still be touching a
@@ -226,6 +233,9 @@ private:
     std::atomic<bool> connecting_{false};
     void* connect_butex_ = nullptr;
     int health_check_interval_ms_ = 0;
+    bool tls_ = false;
+    std::string tls_alpn_;
+    std::string tls_sni_;
     std::atomic<bool> hc_stop_{false};
     CircuitBreaker circuit_breaker_;
     void (*on_recycle_)(void*, SocketId) = nullptr;

@@ -1,0 +1,41 @@
+// TLS transport: SSL/TLS as a TransportEndpoint over the raw fd, with
+// ALPN (h2) negotiation. OpenSSL 3 is reached via dlopen(libssl.so.3) +
+// hand-declared prototypes — this image ships the runtime library but
+// not the dev headers, and the libssl C ABI is stable. When libssl is
+// absent, TlsAvailable() is false and TLS-configured servers/channels
+// fail Init cleanly.
+//
+// Reference parity: /root/reference/src/brpc/details/ssl_helper.cpp
+// (CreateClientSSLContext/CreateServerSSLContext, ALPN in
+// server.cpp/ssl_helper) — re-shaped as a transport so every protocol
+// (h2, HTTP/1, gRPC) rides it unchanged, the way the RDMA endpoint
+// slots under the socket.
+#pragma once
+
+#include <string>
+
+#include "tnet/transport.h"
+
+namespace tpurpc {
+
+bool TlsAvailable();
+
+// Process-wide server TLS context from PEM files. Returns 0, or -1
+// (missing libssl / bad cert). ALPN: advertises+selects "h2" and
+// "http/1.1" (the h2-before-HTTP/1 sniff order of the InputMessenger
+// then routes either result; nothing needs the negotiated name).
+int TlsServerInit(const std::string& cert_pem_path,
+                  const std::string& key_pem_path);
+
+// Wrap an accepted fd in a server-side TLS session (handshake driven
+// lazily by Pump/CutFromIOBufList). Null on failure.
+TransportEndpoint* NewTlsServerTransport(int fd);
+
+// Wrap a connected client fd; `alpn` e.g. "h2" (empty = no ALPN),
+// `sni` the server name (empty = none). Certificate verification is
+// OFF by default (self-signed test rigs; the reference's default is
+// VERIFY_NONE too).
+TransportEndpoint* NewTlsClientTransport(int fd, const std::string& alpn,
+                                         const std::string& sni);
+
+}  // namespace tpurpc

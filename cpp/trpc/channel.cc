@@ -8,6 +8,7 @@
 #include "tbase/time.h"
 #include "tfiber/call_id.h"
 #include "tici/shm_link.h"
+#include "tnet/tls.h"
 #include "trpc/lb_with_naming.h"
 #include "trpc/controller.h"
 #include "trpc/pb_compat.h"
@@ -33,7 +34,54 @@ int Channel::Init(const EndPoint& server, const ChannelOptions* options) {
     GlobalInitializeOrDie();
     server_ep_ = server;
     if (options != nullptr) options_ = *options;
+    // grpc and TLS channels pin their OWN connection: the endpoint-keyed
+    // SocketMap/SocketPool sockets are shared with tpu_std channels, and
+    // installing an h2 session (or a TLS wrap) on a shared socket would
+    // corrupt the other protocol's traffic to the same server.
+    if (options_.tls || options_.protocol == "grpc") {
+        if (options_.tls && !TlsAvailable()) {
+            LOG(ERROR) << "ChannelOptions::tls set but libssl is missing";
+            return -1;
+        }
+        if (CreateOwnedPinnedSocket(&pinned_socket_) != 0) return -1;
+        owns_pinned_ = true;
+    }
     return 0;
+}
+
+int Channel::CreateOwnedPinnedSocket(SocketId* sid) {
+    SocketOptions sopts;
+    sopts.fd = -1;  // connect-on-first-write
+    sopts.remote_side = server_ep_;
+    sopts.on_edge_triggered_events = &InputMessenger::OnNewMessages;
+    sopts.user = client_messenger();
+    if (options_.tls) {
+        sopts.tls = true;
+        sopts.tls_alpn = options_.protocol == "grpc" ? "h2" : "";
+        sopts.tls_sni = options_.tls_sni;
+    }
+    if (Socket::Create(sopts, sid) != 0) {
+        LOG(ERROR) << "pinned client socket creation failed";
+        return -1;
+    }
+    return 0;
+}
+
+SocketId Channel::AcquirePinnedSocket() {
+    const SocketId sid = pinned_socket_;
+    if (sid == INVALID_VREF_ID) return sid;
+    {
+        SocketUniquePtr probe;
+        if (Socket::AddressSocket(sid, &probe) == 0) return sid;  // live
+    }
+    if (!owns_pinned_) return sid;  // caller's socket: its death is final
+    std::lock_guard<std::mutex> g(pin_mu_);
+    // Re-check: another fiber may have recreated while we waited.
+    if (pinned_socket_ != sid) return pinned_socket_;
+    SocketId fresh;
+    if (CreateOwnedPinnedSocket(&fresh) != 0) return pinned_socket_;
+    pinned_socket_ = fresh;
+    return fresh;
 }
 
 int Channel::Init(const char* server_addr_and_port,
@@ -138,6 +186,17 @@ void Channel::CallMethod(const google::protobuf::MethodDescriptor* method,
 
     if (!SerializePbToIOBuf(*request, &cntl->request_buf_)) {
         cntl->SetFailed(TERR_REQUEST, "serialize request failed");
+        cntl->EndRPC(cid);
+        return;
+    }
+    // gRPC framing carries its own compressed-flag + grpc-encoding
+    // negotiation, which this client doesn't speak yet — sending our
+    // gzip bytes with flag 0 would make the server parse gzip as raw pb.
+    // Fail loudly instead of corrupting.
+    if (options_.protocol == "grpc" &&
+        cntl->request_compress_type() != COMPRESS_NONE) {
+        cntl->SetFailed(TERR_REQUEST,
+                        "request compression unsupported on grpc channels");
         cntl->EndRPC(cid);
         return;
     }

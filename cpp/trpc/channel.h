@@ -37,6 +37,15 @@ struct ChannelOptions {
     int max_retry = 3;
     int64_t backup_request_ms = -1;  // <0 disabled
     ConnectionType connection_type = CONNECTION_TYPE_SINGLE;
+    // Wire protocol of this channel: "tpu_std" (native framed) or "grpc"
+    // (gRPC unary over h2c — the client half of thttp/http2_client.h;
+    // reference ChannelOptions::protocol, src/brpc/channel.h).
+    std::string protocol = "tpu_std";
+    // TLS to the server (tnet/tls.h; ALPN "h2" when protocol is "grpc").
+    // The channel pins one TLS connection (single-connection semantics;
+    // pooled/short don't apply). Init fails when libssl is unavailable.
+    bool tls = false;
+    std::string tls_sni;
 };
 
 class Channel : public google::protobuf::RpcChannel {
@@ -75,12 +84,20 @@ public:
     static InputMessenger* client_messenger();
 
     SocketId pinned_socket() const { return pinned_socket_; }
+    // Pinned socket for the next call; when the channel CREATED its pin
+    // (grpc/TLS channels) and the connection died (peer GOAWAY, network),
+    // a fresh one replaces it here — the channel survives reconnects.
+    SocketId AcquirePinnedSocket();
 
 private:
+    int CreateOwnedPinnedSocket(SocketId* sid);
+
     EndPoint server_ep_;
     ChannelOptions options_;
     std::shared_ptr<LoadBalancerWithNaming> lb_;
     SocketId pinned_socket_ = INVALID_VREF_ID;
+    bool owns_pinned_ = false;  // created by Init (not InitWithSocketId)
+    std::mutex pin_mu_;         // guards pinned_socket_ recreation
 };
 
 }  // namespace tpurpc

@@ -6,6 +6,7 @@
 
 #include "rpc_meta.pb.h"
 #include "tbase/errno.h"
+#include "thttp/http2_client.h"
 #include "tbase/flags.h"
 #include "tbase/logging.h"
 #include "tbase/time.h"
@@ -230,7 +231,7 @@ void Controller::IssueRPC() {
         if (excluded_ == nullptr) excluded_ = new ExcludedServers;
         excluded_->Add(s->id());
     } else {
-        SocketId sid = channel_->pinned_socket();
+        SocketId sid = channel_->AcquirePinnedSocket();
         if (sid == INVALID_VREF_ID &&
             SocketMap::singleton()->GetOrCreate(channel_->server(),
                                                 Channel::client_messenger(),
@@ -255,9 +256,16 @@ void Controller::IssueRPC() {
     // which must be neither pooled (a later RPC would interleave with
     // stream frames) nor closed at EndRPC (reference streams ride the
     // main socket for the same reason).
-    const ConnectionType ct = request_stream_ != INVALID_VREF_ID
-                                  ? CONNECTION_TYPE_SINGLE
-                                  : channel_->options().connection_type;
+    // grpc channels always ride their pinned h2 connection: pooled/short
+    // fly sockets come from endpoint-keyed shared pools that tpu_std
+    // channels use too, and an h2 session installed there would corrupt
+    // the other protocol's traffic (h2 multiplexes concurrent calls on
+    // one connection anyway — pooling adds nothing).
+    const ConnectionType ct =
+        request_stream_ != INVALID_VREF_ID ||
+                channel_->options().protocol == "grpc"
+            ? CONNECTION_TYPE_SINGLE
+            : channel_->options().connection_type;
     if (ct != CONNECTION_TYPE_SINGLE) {
         SocketId fly = INVALID_VREF_ID;
         int rc2;
@@ -286,6 +294,24 @@ void Controller::IssueRPC() {
     // this one RPC fails (also guards the uint32 length field).
     if (request_buf_.size() + request_attachment_.size() > (200u << 20)) {
         id_error(current_cid_, TERR_REQUEST);
+        return;
+    }
+
+    if (channel_->options().protocol == "grpc") {
+        // gRPC over h2c: the h2 client session multiplexes this call as
+        // a new stream; the response completes the RPC via
+        // CompleteClientUnaryResponse (thttp/http2_client.cc). Retry,
+        // backup, timeout, and LB machinery above are protocol-agnostic.
+        if (span_ != nullptr) {
+            span_->sent_us = monotonic_time_us();
+        }
+        const std::string path = "/" + method_->service()->full_name() +
+                                 "/" + method_->name();
+        if (H2ClientSendUnary(s.get(), current_cid_, path,
+                              endpoint2str(remote_side_), request_buf_,
+                              deadline_us_) != 0) {
+            id_error(current_cid_, errno != 0 ? errno : TERR_FAILED_SOCKET);
+        }
         return;
     }
 
@@ -535,6 +561,41 @@ void ProcessTpuStdResponse(TpuStdMessage* msg, const rpc::RpcMeta& meta) {
                 meta.stream_settings().window_size()) == 0) {
             cntl->set_request_stream_bound();
         }
+    }
+    cntl->EndRPC(cid);
+}
+
+void CompleteClientUnaryResponse(uint64_t cid, int error_code,
+                                 const std::string& error_text,
+                                 IOBuf* payload_pb) {
+    void* data = nullptr;
+    if (id_lock_range(cid, &data) != 0) {
+        return;  // finished or stale beyond the live range: drop
+    }
+    Controller* cntl = (Controller*)data;
+    if (cid != cntl->current_cid_ && cid != cntl->unfinished_cid_) {
+        id_unlock(cid);  // an abandoned try's late response
+        return;
+    }
+    if (cntl->span_ != nullptr) {
+        cntl->span_->received_us = monotonic_time_us();
+        cntl->span_->response_bytes =
+            payload_pb != nullptr ? (int64_t)payload_pb->size() : 0;
+    }
+    if (cid == cntl->current_cid_ &&
+        cntl->current_fly_sid_ != INVALID_VREF_ID) {
+        cntl->reusable_fly_sid_ = cntl->current_fly_sid_;
+        cntl->current_fly_sid_ = INVALID_VREF_ID;
+    } else if (cid == cntl->unfinished_cid_ &&
+               cntl->unfinished_fly_sid_ != INVALID_VREF_ID) {
+        cntl->reusable_fly_sid_ = cntl->unfinished_fly_sid_;
+        cntl->unfinished_fly_sid_ = INVALID_VREF_ID;
+    }
+    if (error_code != 0) {
+        cntl->SetFailed(error_code, "%s", error_text.c_str());
+    } else if (cntl->response_ != nullptr && payload_pb != nullptr &&
+               !ParsePbFromIOBuf(cntl->response_, *payload_pb)) {
+        cntl->SetFailed(TERR_RESPONSE, "parse response failed");
     }
     cntl->EndRPC(cid);
 }

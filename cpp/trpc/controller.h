@@ -128,6 +128,9 @@ private:
     friend class Server;
     friend void ProcessTpuStdResponse(class TpuStdMessage* msg,
                                       const rpc::RpcMeta& meta);
+    friend void CompleteClientUnaryResponse(uint64_t cid, int error_code,
+                                            const std::string& error_text,
+                                            IOBuf* payload_pb);
 
 public:
     // Arm a backup request for this call at the given delay (overrides
@@ -223,5 +226,14 @@ public:
     // (request fiber -> user fiber -> done closure, strictly sequential).
     struct Span* span_ = nullptr;
 };
+
+// Generic client-side unary completion for protocols that frame outside
+// tpu_std (h2/gRPC): locks `cid` (ranged, so backup winners work), moves
+// the delivering pooled connection to reusable, records the error or
+// parses `payload_pb` into the response message, and EndRPCs. Safe to
+// call with a stale/finished cid (drops silently, like a late response).
+void CompleteClientUnaryResponse(uint64_t cid, int error_code,
+                                 const std::string& error_text,
+                                 IOBuf* payload_pb);
 
 }  // namespace tpurpc

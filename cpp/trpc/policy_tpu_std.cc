@@ -1,6 +1,7 @@
 #include "trpc/policy_tpu_std.h"
 
 #include <arpa/inet.h>
+#include <csignal>
 
 #include <cstring>
 #include <mutex>
@@ -11,6 +12,7 @@
 #include "tbase/flags.h"
 #include "tbase/logging.h"
 #include "tbase/time.h"
+#include "thttp/http2_client.h"
 #include "thttp/http2_protocol.h"
 #include "thttp/http_protocol.h"
 #include "tici/shm_link.h"
@@ -386,6 +388,17 @@ void ProcessTpuStdMessage(InputMessageBase* raw) {
 void GlobalInitializeOrDie() {
     static std::once_flag once;
     std::call_once(once, [] {
+        // A peer closing mid-write must surface as EPIPE from the write,
+        // not kill the process (reference global.cpp:333-337 ignores
+        // SIGPIPE the same way; first bitten here by SSL_write on a
+        // connection curl had already torn down). Respect a handler the
+        // application installed itself.
+        struct sigaction oldact;
+        if (sigaction(SIGPIPE, nullptr, &oldact) != 0 ||
+            (oldact.sa_handler == nullptr &&
+             oldact.sa_sigaction == nullptr)) {
+            CHECK(SIG_ERR != signal(SIGPIPE, SIG_IGN));
+        }
         Protocol p;
         p.parse = ParseTpuStdMessage;
         p.process = ProcessTpuStdMessage;
@@ -394,6 +407,7 @@ void GlobalInitializeOrDie() {
         stream_internal::RegisterStreamProtocolOrDie();
         RegisterIciHandshakeProtocol();
         RegisterHttp2Protocol();
+        RegisterHttp2ClientProtocol();
         RegisterHttpProtocol();
     });
 }

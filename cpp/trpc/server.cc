@@ -1,5 +1,7 @@
 #include "trpc/server.h"
 
+#include "tnet/tls.h"
+
 #include <google/protobuf/descriptor.h>
 #include <unistd.h>
 
@@ -55,6 +57,14 @@ int Server::AddService(google::protobuf::Service* service) {
 
 int Server::Start(const EndPoint& ep, const ServerOptions* options) {
     if (StartNoListen(options) != 0) return -1;
+    if (!options_.tls_cert_path.empty() || !options_.tls_key_path.empty()) {
+        if (TlsServerInit(options_.tls_cert_path, options_.tls_key_path) !=
+            0) {
+            started_ = false;
+            return -1;
+        }
+        acceptor_.set_tls(true);
+    }
     if (acceptor_.StartAccept(ep) != 0) {
         LOG(ERROR) << "listen failed on " << endpoint2str(ep);
         started_ = false;

@@ -76,6 +76,14 @@ struct ServerOptions {
     // their connection fiber and are NOT retagged. Must be within
     // [0, 64); Start fails otherwise.
     int fiber_tag = 0;
+    // TLS: PEM cert chain + private key. When both are set, every
+    // accepted connection is wrapped in a TLS transport (tnet/tls.h)
+    // with ALPN (h2 preferred, http/1.1 fallback) — gRPC-over-TLS and
+    // HTTPS portal ride it unchanged. Start fails if libssl is missing
+    // or the files don't load. Reference: ServerOptions::ssl_options
+    // (src/brpc/server.h) + details/ssl_helper.cpp.
+    std::string tls_cert_path;
+    std::string tls_key_path;
 };
 
 class Server {

@@ -1,0 +1,88 @@
+"""The framework's gRPC CLIENT against a REAL grpcio server.
+
+VERDICT item: the framework must be able to CALL gRPC servers, not just
+serve grpcio clients. tools/grpc_echo_client.cc drives the client stack
+(Channel protocol="grpc" -> thttp/http2_client.cc) against a grpcio
+server started here. Reference parity: the client half of
+src/brpc/policy/http2_rpc_protocol.cpp + example/grpc_c++/client.cpp.
+"""
+import subprocess
+import sys
+from concurrent import futures
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+BUILD = REPO / "build"
+
+grpc = pytest.importorskip("grpc")
+
+
+@pytest.fixture(scope="module")
+def echo_pb(tmp_path_factory):
+    out = tmp_path_factory.mktemp("pb")
+    subprocess.run(
+        ["protoc", f"--proto_path={REPO}/tools/proto",
+         f"--python_out={out}", f"{REPO}/tools/proto/bench_echo.proto"],
+        check=True,
+    )
+    sys.path.insert(0, str(out))
+    import bench_echo_pb2  # noqa: E402
+    return bench_echo_pb2
+
+
+@pytest.fixture(scope="module")
+def grpcio_server(echo_pb):
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=8))
+
+    def echo(request_bytes, context):
+        req = echo_pb.EchoRequest.FromString(request_bytes)
+        res = echo_pb.EchoResponse(
+            send_ts_us=req.send_ts_us, payload=req.payload)
+        return res.SerializeToString()
+
+    handler = grpc.method_handlers_generic_handler(
+        "benchpb.EchoService",
+        {"Echo": grpc.unary_unary_rpc_method_handler(
+            echo,
+            request_deserializer=lambda b: b,
+            response_serializer=lambda b: b,
+        )},
+    )
+    server.add_generic_rpc_handlers((handler,))
+    port = server.add_insecure_port("127.0.0.1:0")
+    server.start()
+    yield port
+    server.stop(grace=None)
+
+
+def run_client(port, *args):
+    return subprocess.run(
+        [str(BUILD / "grpc_echo_client"), f"127.0.0.1:{port}",
+         *[str(a) for a in args]],
+        capture_output=True, text=True, timeout=60,
+    )
+
+
+def test_cpp_client_calls_real_grpcio_server(grpcio_server):
+    proc = run_client(grpcio_server, 777)
+    assert proc.returncode == 0, proc.stderr
+    assert proc.stdout.strip() == "OK 777 0"
+
+
+def test_cpp_client_many_sequential_calls(grpcio_server):
+    proc = run_client(grpcio_server, 1000, 0, 20)
+    assert proc.returncode == 0, proc.stderr
+    lines = proc.stdout.strip().splitlines()
+    assert len(lines) == 20
+    assert lines[-1] == "OK 1019 0"
+
+
+def test_cpp_client_large_payload_flow_control(grpcio_server):
+    """300KB payload both directions exceeds the 65535 initial windows:
+    the client must chunk DATA by the send window and replenish the
+    receive window for grpcio's response frames."""
+    proc = run_client(grpcio_server, 5, 300 * 1024)
+    assert proc.returncode == 0, proc.stderr
+    assert proc.stdout.strip() == f"OK 5 {300 * 1024}"

@@ -186,6 +186,9 @@ double RunScaleLevel(benchpb::EchoService_Stub& stub, int ncallers,
 // Child mode for the cross-process benchmark/tests: a standalone echo
 // server with the ICI handshake enabled, port announced on stdout.
 // Exits when stdin reaches EOF (parent closed its pipe or died).
+const char* g_tls_cert = nullptr;
+const char* g_tls_key = nullptr;
+
 int RunIciServer() {
     prctl(PR_SET_PDEATHSIG, SIGKILL);  // die with the parent
     FLAGS_socket_send_buffer_size.set(1 << 20);
@@ -194,9 +197,14 @@ int RunIciServer() {
     static EchoServiceImpl service;
     static Server server;
     if (server.AddService(&service) != 0) return 1;
+    ServerOptions sopts;
+    if (g_tls_cert != nullptr && g_tls_key != nullptr) {
+        sopts.tls_cert_path = g_tls_cert;
+        sopts.tls_key_path = g_tls_key;
+    }
     EndPoint listen;
     str2endpoint("127.0.0.1:0", &listen);
-    if (server.Start(listen, nullptr) != 0) return 1;
+    if (server.Start(listen, &sopts) != 0) return 1;
     printf("PORT %d\n", server.listened_port());
     fflush(stdout);
     char buf[16];
@@ -263,6 +271,7 @@ int main(int argc, char** argv) {
     bool scale = false;
     bool pooled = false;
     const char* prof_path = nullptr;
+    bool ici_server = false;
     for (int i = 1; i < argc; ++i) {
         if (strcmp(argv[i], "--json") == 0) json = true;
         if (strcmp(argv[i], "--ici") == 0) use_ici = true;
@@ -270,11 +279,18 @@ int main(int argc, char** argv) {
         if (strcmp(argv[i], "--tail") == 0) tail = true;
         if (strcmp(argv[i], "--scale") == 0) scale = true;
         if (strcmp(argv[i], "--pooled") == 0) pooled = true;
-        if (strcmp(argv[i], "--ici-server") == 0) return RunIciServer();
+        if (strcmp(argv[i], "--ici-server") == 0) ici_server = true;
+        if (strcmp(argv[i], "--tls-cert") == 0 && i + 1 < argc) {
+            g_tls_cert = argv[++i];
+        }
+        if (strcmp(argv[i], "--tls-key") == 0 && i + 1 < argc) {
+            g_tls_key = argv[++i];
+        }
         if (strcmp(argv[i], "--prof") == 0 && i + 1 < argc) {
             prof_path = argv[++i];
         }
     }
+    if (ici_server) return RunIciServer();
     // Spawn the cross-process server BEFORE any framework threads exist
     // (fork after the dispatcher/fiber workers start is unsafe).
     int xproc_port = 0;

@@ -1,0 +1,80 @@
+// gRPC client driver: calls ANY gRPC server's /benchpb.EchoService/Echo
+// over h2c using the framework's client stack (Channel protocol="grpc" ->
+// thttp/http2_client.cc). Used by tests/test_grpc_client_interop.py
+// against a real grpcio server; doubles as example/grpc_c++ client parity
+// (/root/reference/example/grpc_c++/client.cpp).
+//
+// Usage: grpc_echo_client HOST:PORT [send_ts_us] [payload_bytes] [count]
+//                         [--tls]
+// Prints "OK <send_ts_us> <payload_size>" per call; exit 0 iff all
+// succeed. --tls: gRPC over TLS with ALPN h2 (self-signed servers
+// accepted; verification off, like the reference default).
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+
+#include "bench_echo.pb.h"
+#include "tbase/endpoint.h"
+#include "trpc/channel.h"
+#include "trpc/controller.h"
+
+using namespace tpurpc;
+
+int main(int argc, char** argv) {
+    if (argc < 2) {
+        fprintf(stderr,
+                "usage: %s HOST:PORT [send_ts_us] [payload_bytes] [count]\n",
+                argv[0]);
+        return 2;
+    }
+    bool tls = false;
+    for (int i = 2; i < argc; ++i) {
+        if (strcmp(argv[i], "--tls") == 0) tls = true;
+    }
+    const int64_t ts =
+        argc > 2 && strcmp(argv[2], "--tls") != 0 ? atoll(argv[2]) : 12345;
+    const long payload_bytes =
+        argc > 3 && strcmp(argv[3], "--tls") != 0 ? atol(argv[3]) : 0;
+    const int count =
+        argc > 4 && strcmp(argv[4], "--tls") != 0 ? atoi(argv[4]) : 1;
+
+    EndPoint ep;
+    if (str2endpoint(argv[1], &ep) != 0) {
+        fprintf(stderr, "bad endpoint %s\n", argv[1]);
+        return 2;
+    }
+    Channel ch;
+    ChannelOptions opts;
+    opts.protocol = "grpc";
+    opts.timeout_ms = 15000;
+    opts.tls = tls;
+    if (ch.Init(ep, &opts) != 0) {
+        fprintf(stderr, "channel init failed\n");
+        return 1;
+    }
+    benchpb::EchoService_Stub stub(&ch);
+    for (int i = 0; i < count; ++i) {
+        Controller cntl;
+        benchpb::EchoRequest req;
+        req.set_send_ts_us(ts + i);
+        if (payload_bytes > 0) {
+            req.set_payload(std::string((size_t)payload_bytes, 'p'));
+        }
+        benchpb::EchoResponse res;
+        stub.Echo(&cntl, &req, &res, nullptr);
+        if (cntl.Failed()) {
+            fprintf(stderr, "call %d failed: %d %s\n", i, cntl.ErrorCode(),
+                    cntl.ErrorText().c_str());
+            return 1;
+        }
+        if (res.send_ts_us() != ts + i ||
+            (long)res.payload().size() != payload_bytes) {
+            fprintf(stderr, "call %d echoed wrong values\n", i);
+            return 1;
+        }
+        printf("OK %lld %zu\n", (long long)res.send_ts_us(),
+               res.payload().size());
+    }
+    return 0;
+}

@@ -337,6 +337,17 @@ void Socket::CloseFdAndDropQueued() {
         if (transport_ == nullptr) close(fd);
     }
     if (transport_ != nullptr) transport_->Close();
+    // Pipelined calls whose replies will never arrive (same fiber-spawn
+    // discipline as DropWriteRequest: the id's error handler runs user
+    // completion code).
+    for (const PipelinedInfo& pi : ResetPipelinedInfo()) {
+        if (pi.id_wait == 0) continue;
+        fiber_t tid;
+        if (fiber_start_background(&tid, nullptr, id_error_fiber,
+                                   (void*)(uintptr_t)pi.id_wait) != 0) {
+            id_error(pi.id_wait, TERR_FAILED_SOCKET);
+        }
+    }
     for (size_t i = inflight_index_; i < inflight_batch_.size(); ++i) {
         DropWriteRequest(inflight_batch_[i]);
     }

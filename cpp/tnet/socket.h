@@ -18,8 +18,10 @@
 
 #include <atomic>
 #include <cstdint>
-#include <vector>
+#include <deque>
+#include <mutex>
 #include <string>
+#include <vector>
 
 #include "tbase/endpoint.h"
 #include "tbase/iobuf.h"
@@ -142,8 +144,48 @@ public:
         conn_data_deleter_ = deleter;
     }
     void* conn_data() const { return conn_data_; }
-    // Correlation of in-flight requests awaiting responses could hang off
-    // here later (pipelined protocols).
+
+    // ---- pipelined-response correlation ----
+    // For protocols without correlation ids on the wire (redis,
+    // memcache): each sender pushes {expected reply count, its CallId}
+    // BEFORE writing, in write order; the response parser pops FIFO to
+    // know whose replies it is reading (reference socket.h:532
+    // PushPipelinedInfo / PopPipelinedInfo / GivebackPipelinedInfo).
+    struct PipelinedInfo {
+        uint32_t count = 0;    // replies this request expects
+        uint64_t id_wait = 0;  // CallId to complete
+    };
+    void PushPipelinedInfo(const PipelinedInfo& pi) {
+        std::lock_guard<std::mutex> g(pipeline_mu_);
+        pipeline_q_.push_back(pi);
+    }
+    bool PopPipelinedInfo(PipelinedInfo* pi) {
+        std::lock_guard<std::mutex> g(pipeline_mu_);
+        if (pipeline_q_.empty()) return false;
+        *pi = pipeline_q_.front();
+        pipeline_q_.pop_front();
+        return true;
+    }
+    // Un-push after a failed write (the entry must not shift correlation
+    // for later callers). True if it was still queued.
+    bool RemovePipelinedInfo(uint64_t id_wait) {
+        std::lock_guard<std::mutex> g(pipeline_mu_);
+        for (auto it = pipeline_q_.begin(); it != pipeline_q_.end(); ++it) {
+            if (it->id_wait == id_wait) {
+                pipeline_q_.erase(it);
+                return true;
+            }
+        }
+        return false;
+    }
+    // Fail every queued pipelined call (connection died) and clear.
+    std::vector<PipelinedInfo> ResetPipelinedInfo() {
+        std::lock_guard<std::mutex> g(pipeline_mu_);
+        std::vector<PipelinedInfo> out(pipeline_q_.begin(),
+                                       pipeline_q_.end());
+        pipeline_q_.clear();
+        return out;
+    }
 
     // Bytes queued but not yet written (back-pressure signal).
     int64_t unwritten_bytes() const {
@@ -246,6 +288,8 @@ private:
     std::atomic<int64_t> last_active_us_{0};
     void* conn_data_ = nullptr;
     void (*conn_data_deleter_)(void*) = nullptr;
+    std::mutex pipeline_mu_;
+    std::deque<PipelinedInfo> pipeline_q_;
 };
 
 }  // namespace tpurpc

@@ -34,11 +34,13 @@ int Channel::Init(const EndPoint& server, const ChannelOptions* options) {
     GlobalInitializeOrDie();
     server_ep_ = server;
     if (options != nullptr) options_ = *options;
-    // grpc and TLS channels pin their OWN connection: the endpoint-keyed
-    // SocketMap/SocketPool sockets are shared with tpu_std channels, and
-    // installing an h2 session (or a TLS wrap) on a shared socket would
-    // corrupt the other protocol's traffic to the same server.
-    if (options_.tls || options_.protocol == "grpc") {
+    // grpc/redis and TLS channels pin their OWN connection: the
+    // endpoint-keyed SocketMap/SocketPool sockets are shared with
+    // tpu_std channels, and installing an h2/redis session (or a TLS
+    // wrap) on a shared socket would corrupt the other protocol's
+    // traffic to the same server.
+    if (options_.tls || options_.protocol == "grpc" ||
+        options_.protocol == "redis") {
         if (options_.tls && !TlsAvailable()) {
             LOG(ERROR) << "ChannelOptions::tls set but libssl is missing";
             return -1;

@@ -21,6 +21,7 @@
 #include "tbase/crc32c.h"
 #include "trpc/compress.h"
 #include "trpc/pb_compat.h"
+#include "trpc/redis.h"
 #include "trpc/rpc_dump.h"
 #include "trpc/server.h"
 #include "trpc/span.h"
@@ -409,6 +410,7 @@ void GlobalInitializeOrDie() {
         RegisterHttp2Protocol();
         RegisterHttp2ClientProtocol();
         RegisterHttpProtocol();
+        RegisterRedisProtocols();
     });
 }
 

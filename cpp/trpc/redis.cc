@@ -1,0 +1,542 @@
+#include "trpc/redis.h"
+
+#include <algorithm>
+#include <cctype>
+#include <cstring>
+#include <mutex>
+
+#include "tbase/errno.h"
+#include "tbase/logging.h"
+#include "tbase/time.h"
+#include "tfiber/call_id.h"
+#include "tfiber/timer_thread.h"
+#include "tnet/input_messenger.h"
+#include "tnet/protocol.h"
+#include "tnet/socket.h"
+#include "trpc/channel.h"
+#include "trpc/controller.h"
+#include "trpc/server.h"
+
+namespace tpurpc {
+
+namespace {
+
+// Hardening caps on untrusted RESP input.
+constexpr size_t kMaxArgs = 1024;
+constexpr size_t kMaxBulk = 64u << 20;
+constexpr size_t kMaxArrayElems = 64u << 10;
+constexpr int kMaxReplyDepth = 8;
+
+int g_redis_server_index = -1;
+int g_redis_client_index = -1;
+
+// ---- flat-buffer RESP scanner ----
+// Parsing works on a flattened copy of the buffered bytes; RESP values
+// are small in practice and the copy is bounded by what the peer has
+// actually sent (the caps above bound memory).
+
+struct Scan {
+    const char* p;
+    size_t n;
+    size_t off = 0;
+    // When a scan returns need-more, the minimum ABSOLUTE byte count that
+    // could complete it (0 = unknown, "more than n"). Lets the driver
+    // avoid re-flattening a large buffer on every partial arrival of a
+    // big bulk value.
+    size_t need = 0;
+
+    bool line(std::string* out) {  // reads to CRLF, excluding it
+        const char* crlf = (const char*)memmem(p + off, n - off, "\r\n", 2);
+        if (crlf == nullptr) {
+            need = n + 1;
+            return false;
+        }
+        out->assign(p + off, (size_t)(crlf - (p + off)));
+        off = (size_t)(crlf - p) + 2;
+        return true;
+    }
+    bool bytes(size_t len, std::string* out) {
+        if (n - off < len + 2) {
+            need = off + len + 2;
+            return false;
+        }
+        out->assign(p + off, len);
+        if (p[off + len] != '\r' || p[off + len + 1] != '\n') return false;
+        off += len + 2;
+        return true;
+    }
+};
+
+bool parse_int(const std::string& s, int64_t* out) {
+    if (s.empty()) return false;
+    errno = 0;
+    char* end = nullptr;
+    const long long v = strtoll(s.c_str(), &end, 10);
+    if (errno != 0 || end != s.c_str() + s.size()) return false;
+    *out = v;
+    return true;
+}
+
+// 1 = parsed, 0 = need more, -1 = corrupt.
+int scan_reply(Scan* sc, RedisReply* out, int depth) {
+    if (depth > kMaxReplyDepth) return -1;
+    if (sc->off >= sc->n) return 0;
+    const char tag = sc->p[sc->off];
+    std::string l;
+    const size_t start = sc->off;
+    ++sc->off;
+    if (!sc->line(&l)) {
+        sc->off = start;
+        return 0;
+    }
+    switch (tag) {
+        case '+':
+            out->type = RedisReply::STATUS;
+            out->str = std::move(l);
+            return 1;
+        case '-':
+            out->type = RedisReply::ERROR;
+            out->str = std::move(l);
+            return 1;
+        case ':': {
+            int64_t v;
+            if (!parse_int(l, &v)) return -1;
+            out->type = RedisReply::INTEGER;
+            out->integer = v;
+            return 1;
+        }
+        case '$': {
+            int64_t len;
+            if (!parse_int(l, &len)) return -1;
+            if (len == -1) {
+                out->type = RedisReply::NIL;
+                return 1;
+            }
+            if (len < 0 || (size_t)len > kMaxBulk) return -1;
+            if (!sc->bytes((size_t)len, &out->str)) {
+                // Distinguish need-more from the missing-CRLF corruption:
+                // if the buffer HAS the bytes but no CRLF terminator, the
+                // bytes() false with enough data means corrupt.
+                if (sc->n - sc->off >= (size_t)len + 2) return -1;
+                sc->off = start;
+                return 0;
+            }
+            out->type = RedisReply::STRING;
+            return 1;
+        }
+        case '*': {
+            int64_t cnt;
+            if (!parse_int(l, &cnt)) return -1;
+            if (cnt == -1) {
+                out->type = RedisReply::NIL;
+                return 1;
+            }
+            if (cnt < 0 || (size_t)cnt > kMaxArrayElems) return -1;
+            out->type = RedisReply::ARRAY;
+            out->elements.resize((size_t)cnt);
+            for (int64_t i = 0; i < cnt; ++i) {
+                const int rc =
+                    scan_reply(sc, &out->elements[(size_t)i], depth + 1);
+                if (rc != 1) {
+                    if (rc == 0) sc->off = start;
+                    out->elements.clear();
+                    return rc;
+                }
+            }
+            return 1;
+        }
+        default:
+            return -1;
+    }
+}
+
+// 1 = parsed, 0 = need more, -1 = corrupt / not RESP.
+int scan_command(Scan* sc, std::vector<std::string>* args) {
+    if (sc->off >= sc->n) return 0;
+    if (sc->p[sc->off] != '*') return -1;  // inline commands unsupported
+    const size_t start = sc->off;
+    ++sc->off;
+    std::string l;
+    if (!sc->line(&l)) {
+        sc->off = start;
+        return 0;
+    }
+    int64_t cnt;
+    if (!parse_int(l, &cnt) || cnt < 1 || (size_t)cnt > kMaxArgs) return -1;
+    args->clear();
+    args->reserve((size_t)cnt);
+    for (int64_t i = 0; i < cnt; ++i) {
+        if (sc->off >= sc->n) {
+            sc->off = start;
+            return 0;
+        }
+        if (sc->p[sc->off] != '$') return -1;
+        ++sc->off;
+        if (!sc->line(&l)) {
+            sc->off = start;
+            return 0;
+        }
+        int64_t len;
+        if (!parse_int(l, &len) || len < 0 || (size_t)len > kMaxBulk) {
+            return -1;
+        }
+        std::string arg;
+        if (!sc->bytes((size_t)len, &arg)) {
+            if (sc->n - sc->off >= (size_t)len + 2) return -1;
+            sc->off = start;
+            return 0;
+        }
+        args->push_back(std::move(arg));
+    }
+    return 1;
+}
+
+}  // namespace
+
+// ---------------- public codec ----------------
+
+void RedisSerializeCommand(const std::vector<std::string>& args,
+                           IOBuf* out) {
+    std::string s;
+    s += "*" + std::to_string(args.size()) + "\r\n";
+    for (const auto& a : args) {
+        s += "$" + std::to_string(a.size()) + "\r\n";
+        s += a;
+        s += "\r\n";
+    }
+    out->append(s);
+}
+
+namespace {
+
+// Windowed scan driver: flatten a 64KB prefix first; only when the value
+// provably continues past the window AND the buffer could complete it is
+// the full buffer flattened (once). Kills the quadratic re-copy a large
+// bulk would otherwise cost as it arrives chunk by chunk: while
+// incomplete, the `need` hint turns every retry into a cheap 64KB copy +
+// size compare.
+template <typename ScanFn>
+int WindowedScan(IOBuf* source, ScanFn&& fn, size_t* consumed) {
+    constexpr size_t kWindow = 64u << 10;
+    const size_t total = source->size();
+    const size_t limit = std::min(total, kWindow);
+    std::string flat;
+    flat.resize(limit);
+    source->copy_to(&flat[0], limit);
+    Scan sc{flat.data(), limit};
+    int rc = fn(&sc);
+    if (rc == 0 && limit < total) {
+        if (sc.need > limit + 1 && sc.need > total) {
+            return 0;  // a bulk that hasn't fully arrived: cheap retry
+        }
+        flat.resize(total);
+        source->copy_to(&flat[0], total);
+        Scan full{flat.data(), total};
+        rc = fn(&full);
+        sc = full;
+    }
+    if (rc == 1) *consumed = sc.off;
+    return rc;
+}
+
+}  // namespace
+
+int RedisParseReply(IOBuf* source, RedisReply* out) {
+    size_t consumed = 0;
+    const int rc = WindowedScan(
+        source, [&](Scan* sc) { return scan_reply(sc, out, 0); },
+        &consumed);
+    if (rc == 1) source->pop_front(consumed);
+    return rc;
+}
+
+void RedisSerializeReply(const RedisReply& r, std::string* out) {
+    switch (r.type) {
+        case RedisReply::NIL:
+            *out += "$-1\r\n";
+            return;
+        case RedisReply::STATUS:
+            *out += "+" + r.str + "\r\n";
+            return;
+        case RedisReply::ERROR:
+            *out += "-" + r.str + "\r\n";
+            return;
+        case RedisReply::INTEGER:
+            *out += ":" + std::to_string(r.integer) + "\r\n";
+            return;
+        case RedisReply::STRING:
+            *out += "$" + std::to_string(r.str.size()) + "\r\n";
+            *out += r.str;
+            *out += "\r\n";
+            return;
+        case RedisReply::ARRAY:
+            *out += "*" + std::to_string(r.elements.size()) + "\r\n";
+            for (const auto& e : r.elements) RedisSerializeReply(e, out);
+            return;
+    }
+}
+
+// ---------------- request/service ----------------
+
+void RedisRequest::AddCommand(const std::vector<std::string>& args) {
+    RedisSerializeCommand(args, &wire_);
+    ++ncommands_;
+}
+
+void RedisService::AddCommandHandler(const std::string& name,
+                                     RedisCommandHandler* handler) {
+    std::string key = name;
+    for (char& c : key) c = (char)toupper((unsigned char)c);
+    handlers_[key].reset(handler);
+}
+
+RedisCommandHandler* RedisService::FindCommandHandler(
+    const std::string& name) const {
+    std::string key = name;
+    for (char& c : key) c = (char)toupper((unsigned char)c);
+    auto it = handlers_.find(key);
+    return it == handlers_.end() ? nullptr : it->second.get();
+}
+
+// ---------------- server protocol ----------------
+
+namespace {
+
+class RedisCommandMsg : public InputMessageBase {
+public:
+    std::vector<std::string> args;
+};
+
+ParseResult ParseRedisCommand(IOBuf* source, Socket* socket, bool read_eof,
+                              const void* arg) {
+    char head;
+    if (source->copy_to(&head, 1) == 1 && head != '*') {
+        return ParseResult::make(ParseError::TRY_OTHERS);
+    }
+    auto msg = std::make_unique<RedisCommandMsg>();
+    size_t consumed = 0;
+    const int rc = WindowedScan(
+        source, [&](Scan* sc) { return scan_command(sc, &msg->args); },
+        &consumed);
+    if (rc == 0) return ParseResult::make(ParseError::NOT_ENOUGH_DATA);
+    if (rc < 0) return ParseResult::make(ParseError::ERROR);
+    source->pop_front(consumed);
+    return ParseResult::make_ok(msg.release());
+}
+
+// In-order inline processing: pipelined replies leave in command order.
+void ProcessRedisCommand(InputMessageBase* raw) {
+    std::unique_ptr<RedisCommandMsg> msg((RedisCommandMsg*)raw);
+    SocketUniquePtr s = SocketUniquePtr::FromId(msg->socket_id);
+    if (!s) return;
+    auto* messenger = (InputMessenger*)s->user();
+    Server* server =
+        messenger != nullptr ? (Server*)messenger->context : nullptr;
+    RedisService* service =
+        server != nullptr ? server->redis_service() : nullptr;
+    RedisReply reply;
+    if (service == nullptr) {
+        reply.type = RedisReply::ERROR;
+        reply.str = "ERR this server has no redis service";
+    } else if (msg->args.empty()) {
+        reply.type = RedisReply::ERROR;
+        reply.str = "ERR empty command";
+    } else {
+        RedisCommandHandler* h = service->FindCommandHandler(msg->args[0]);
+        if (h == nullptr) {
+            reply.type = RedisReply::ERROR;
+            reply.str = "ERR unknown command '" + msg->args[0] + "'";
+        } else {
+            h->Run(msg->args, &reply);
+        }
+    }
+    std::string out;
+    RedisSerializeReply(reply, &out);
+    IOBuf buf;
+    buf.append(out);
+    // One Write per reply: the socket's wait-free queue coalesces — the
+    // KeepWrite fiber gathers up to 64 queued replies into one writev —
+    // so a pipelined burst still leaves in few syscalls.
+    s->Write(&buf);
+}
+
+// ---------------- client protocol ----------------
+
+struct RedisCallCtx {
+    Controller* cntl;
+    RedisResponse* response;
+    uint32_t expected;
+};
+
+int RedisOnError(CallId id, void* data, int error) {
+    auto* ctx = (RedisCallCtx*)data;
+    ctx->cntl->SetFailed(error, "redis call failed: %s", terror(error));
+    return id_unlock_and_destroy(id);
+}
+
+// Per-connection client state: the batch currently being assembled +
+// a mutex ordering {PushPipelinedInfo, Write} pairs across callers.
+struct RedisClientSession {
+    std::mutex send_mu;
+    bool cur_active = false;
+    Socket::PipelinedInfo cur;
+    std::vector<RedisReply> acc;
+};
+
+// Runs at socket recycle. The batch currently being ASSEMBLED was
+// already popped out of the socket's pipelined queue, so the
+// CloseFdAndDropQueued drain never sees it — its caller is failed here.
+void DeleteRedisClientSession(void* p) {
+    auto* sess = (RedisClientSession*)p;
+    if (sess->cur_active && sess->cur.id_wait != 0) {
+        id_error(sess->cur.id_wait, TERR_FAILED_SOCKET);
+    }
+    delete sess;
+}
+
+RedisClientSession* redis_session_of(Socket* s) {
+    if (s->preferred_protocol_index != g_redis_client_index) return nullptr;
+    return (RedisClientSession*)s->conn_data();
+}
+
+class RedisReplyMsg : public InputMessageBase {
+public:
+    RedisReply reply;
+};
+
+ParseResult ParseRedisReplyMsg(IOBuf* source, Socket* socket,
+                               bool read_eof, const void* arg) {
+    if (redis_session_of(socket) == nullptr) {
+        return ParseResult::make(ParseError::TRY_OTHERS);
+    }
+    auto msg = std::make_unique<RedisReplyMsg>();
+    const int rc = RedisParseReply(source, &msg->reply);
+    if (rc == 0) return ParseResult::make(ParseError::NOT_ENOUGH_DATA);
+    if (rc < 0) return ParseResult::make(ParseError::ERROR);
+    return ParseResult::make_ok(msg.release());
+}
+
+void ProcessRedisReplyMsg(InputMessageBase* raw) {
+    std::unique_ptr<RedisReplyMsg> msg((RedisReplyMsg*)raw);
+    SocketUniquePtr s = SocketUniquePtr::FromId(msg->socket_id);
+    if (!s) return;
+    RedisClientSession* sess = redis_session_of(s.get());
+    if (sess == nullptr) return;
+    if (!sess->cur_active) {
+        if (!s->PopPipelinedInfo(&sess->cur)) {
+            // A reply nobody asked for: the correlation is gone; the
+            // connection cannot be trusted further.
+            s->SetFailedWithError(TERR_RESPONSE);
+            return;
+        }
+        sess->cur_active = true;
+        sess->acc.clear();
+    }
+    sess->acc.push_back(std::move(msg->reply));
+    if (sess->acc.size() < sess->cur.count) return;
+    // Batch complete: hand the replies to the caller.
+    const CallId cid = sess->cur.id_wait;
+    std::vector<RedisReply> replies;
+    replies.swap(sess->acc);
+    sess->cur_active = false;
+    void* data = nullptr;
+    if (id_lock(cid, &data) != 0) return;  // timed out meanwhile: drop
+    auto* ctx = (RedisCallCtx*)data;
+    ctx->response->mutable_replies()->swap(replies);
+    id_unlock_and_destroy(cid);
+}
+
+void RedisTimeoutCb(void* arg) {
+    id_error((CallId)(uintptr_t)arg, TERR_RPC_TIMEDOUT);
+}
+
+}  // namespace
+
+void RedisCall(Channel* channel, Controller* cntl,
+               const RedisRequest& request, RedisResponse* response) {
+    response->Clear();
+    if (request.command_count() == 0) {
+        cntl->SetFailed(TERR_REQUEST, "empty redis request");
+        return;
+    }
+    RedisCallCtx ctx{cntl, response, (uint32_t)request.command_count()};
+    CallId cid;
+    if (id_create(&cid, &ctx, RedisOnError) != 0) {
+        cntl->SetFailed(TERR_INTERNAL, "id_create failed");
+        return;
+    }
+    const int64_t timeout_ms = cntl->timeout_ms() >= 0
+                                   ? cntl->timeout_ms()
+                                   : channel->options().timeout_ms;
+    TimerId tt = INVALID_TIMER_ID;
+    if (timeout_ms > 0) {
+        tt = TimerThread::singleton()->schedule(
+            RedisTimeoutCb, (void*)(uintptr_t)cid,
+            monotonic_time_us() + timeout_ms * 1000);
+    }
+    const SocketId sid = channel->AcquirePinnedSocket();
+    SocketUniquePtr s;
+    if (sid == INVALID_VREF_ID || Socket::AddressSocket(sid, &s) != 0) {
+        id_error(cid, TERR_FAILED_SOCKET);
+    } else {
+        RedisClientSession* sess = redis_session_of(s.get());
+        if (sess == nullptr) {
+            static std::mutex install_mu;
+            std::lock_guard<std::mutex> g(install_mu);
+            sess = redis_session_of(s.get());
+            if (sess == nullptr) {
+                sess = new RedisClientSession;
+                s->set_conn_data(sess, DeleteRedisClientSession);
+                s->preferred_protocol_index = g_redis_client_index;
+            }
+        }
+        IOBuf wire;
+        wire.append(request.wire());
+        int write_errno = 0;
+        {
+            // Info order MUST equal wire order across concurrent callers.
+            std::lock_guard<std::mutex> g(sess->send_mu);
+            s->PushPipelinedInfo(
+                {(uint32_t)request.command_count(), cid});
+            if (s->Write(&wire, cid) != 0) {
+                // Write's early-return paths (failed socket,
+                // EOVERCROWDED) notify NOBODY: un-push our entry so
+                // later callers' correlation doesn't shift, and fail
+                // the call ourselves.
+                write_errno = errno != 0 ? errno : TERR_FAILED_SOCKET;
+                s->RemovePipelinedInfo(cid);
+            }
+        }
+        if (write_errno != 0) id_error(cid, write_errno);
+        // Drop the socket ref BEFORE waiting: a dead connection only
+        // error-notifies its pipelined waiters at recycle (nref==0) —
+        // holding the ref across the wait would deadlock that path
+        // (Controller::IssueRPC releases before waiting too).
+        s.reset();
+    }
+    id_join(cid);
+    if (tt != INVALID_TIMER_ID) {
+        TimerThread::singleton()->unschedule(tt, false);
+    }
+}
+
+void RegisterRedisProtocols() {
+    if (g_redis_server_index >= 0) return;
+    Protocol srv;
+    srv.parse = ParseRedisCommand;
+    srv.process = ProcessRedisCommand;
+    srv.name = "redis-server";
+    srv.process_in_order = true;  // pipelined replies leave in order
+    g_redis_server_index = RegisterProtocol(srv);
+    Protocol cli;
+    cli.parse = ParseRedisReplyMsg;
+    cli.process = ProcessRedisReplyMsg;
+    cli.name = "redis-client";
+    cli.process_in_order = true;  // batch assembly is per-connection state
+    g_redis_client_index = RegisterProtocol(cli);
+}
+
+int RedisServerProtocolIndex() { return g_redis_server_index; }
+int RedisClientProtocolIndex() { return g_redis_client_index; }
+
+}  // namespace tpurpc

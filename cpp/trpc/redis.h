@@ -1,0 +1,122 @@
+// Redis (RESP2) protocol: client + server, pipelined on one connection.
+//
+// Reference parity: src/brpc/policy/redis_protocol.cpp (the canonical
+// consumer of Socket's pipelined-info correlation, socket.h:532) +
+// src/brpc/redis.{h,cpp} (RedisRequest/RedisResponse/RedisReply and the
+// server-side RedisService command handlers).
+//
+// Client: a Channel with options.protocol="redis"; one RedisRequest may
+// carry N commands (one pipelined batch, N replies in order). Concurrent
+// callers on the same connection correlate via the socket's FIFO
+// pipelined-info queue.
+// Server: Server::set_redis_service(RedisService*) serves RESP on the
+// same port as every other protocol (sniffed by the leading '*').
+#pragma once
+
+#include <cstdint>
+#include <map>
+#include <memory>
+#include <string>
+#include <vector>
+
+#include "tbase/iobuf.h"
+
+namespace tpurpc {
+
+class Channel;
+class Controller;
+
+// One RESP value (reply side).
+struct RedisReply {
+    enum Type {
+        NIL,      // $-1
+        STATUS,   // +OK
+        ERROR,    // -ERR ...
+        INTEGER,  // :123
+        STRING,   // $N bulk
+        ARRAY,    // *N
+    };
+    Type type = NIL;
+    std::string str;     // STATUS/ERROR/STRING payload
+    int64_t integer = 0;
+    std::vector<RedisReply> elements;  // ARRAY
+
+    bool is_error() const { return type == ERROR; }
+};
+
+// A pipelined batch of commands.
+class RedisRequest {
+public:
+    // AddCommand("SET", "key", "value") — arguments are sent verbatim as
+    // bulk strings (binary-safe).
+    void AddCommand(const std::vector<std::string>& args);
+    size_t command_count() const { return ncommands_; }
+    const IOBuf& wire() const { return wire_; }
+    void Clear() {
+        wire_.clear();
+        ncommands_ = 0;
+    }
+
+private:
+    IOBuf wire_;
+    size_t ncommands_ = 0;
+};
+
+class RedisResponse {
+public:
+    size_t reply_count() const { return replies_.size(); }
+    const RedisReply& reply(size_t i) const { return replies_[i]; }
+    std::vector<RedisReply>* mutable_replies() { return &replies_; }
+    void Clear() { replies_.clear(); }
+
+private:
+    std::vector<RedisReply> replies_;
+};
+
+// Execute one pipelined batch on `channel` (protocol must be "redis").
+// Synchronous; cntl carries timeout/error. All commands of the batch
+// share the connection write atomically (one pipelined unit).
+void RedisCall(Channel* channel, Controller* cntl,
+               const RedisRequest& request, RedisResponse* response);
+
+// ---- server side ----
+
+// Handler for one command name (uppercased). Fill *out; return value is
+// the reply (errors via out->type = ERROR).
+class RedisCommandHandler {
+public:
+    virtual ~RedisCommandHandler() = default;
+    virtual void Run(const std::vector<std::string>& args,
+                     RedisReply* out) = 0;
+};
+
+// Command table the server dispatches RESP arrays into (reference
+// RedisService, src/brpc/redis.h). Unknown commands get -ERR.
+class RedisService {
+public:
+    virtual ~RedisService() = default;
+    // Takes ownership of the handler.
+    void AddCommandHandler(const std::string& name,
+                           RedisCommandHandler* handler);
+    RedisCommandHandler* FindCommandHandler(const std::string& name) const;
+
+private:
+    std::map<std::string, std::unique_ptr<RedisCommandHandler>> handlers_;
+};
+
+// ---- codec (exposed for tests/fuzzing) ----
+
+// Serialize one command as a RESP array of bulk strings.
+void RedisSerializeCommand(const std::vector<std::string>& args, IOBuf* out);
+// Parse ONE reply from `source`. Returns 1 = parsed (consumed), 0 = need
+// more bytes (source untouched), -1 = protocol corruption.
+int RedisParseReply(IOBuf* source, RedisReply* out);
+// Serialize one reply.
+void RedisSerializeReply(const RedisReply& r, std::string* out);
+
+// Protocol registration (GlobalInitializeOrDie).
+void RegisterRedisProtocols();
+int RedisServerProtocolIndex();
+int RedisClientProtocolIndex();
+
+}  // namespace tpurpc

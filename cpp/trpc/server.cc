@@ -14,6 +14,7 @@
 #include "tvar/default_variables.h"
 #include "tici/shm_link.h"
 #include "trpc/policy_tpu_std.h"
+#include "trpc/redis.h"
 #include "trpc/stream.h"
 
 namespace tpurpc {
@@ -118,6 +119,9 @@ int Server::StartNoListen(const ServerOptions* options) {
     // HTTP/2.0" preface looks like a request line to an HTTP/1 parser.
     messenger_.add_protocol(Http2ProtocolIndex());
     messenger_.add_protocol(HttpProtocolIndex());
+    // RESP rides the same port too (leading '*' never collides with the
+    // other magics).
+    messenger_.add_protocol(RedisServerProtocolIndex());
     AddBuiltinHttpServices(this);
     messenger_.context = this;
     started_ = true;

@@ -143,6 +143,12 @@ public:
     }
     Acceptor* acceptor() { return &acceptor_; }
 
+    // ---- redis service (trpc/redis.h; reference src/brpc/redis.h) ----
+    // Serve RESP commands on the same port (sniffed by the leading '*').
+    // Not owned; must outlive the server. Set before Start.
+    void set_redis_service(class RedisService* rs) { redis_service_ = rs; }
+    class RedisService* redis_service() const { return redis_service_; }
+
     std::atomic<int64_t> nprocessing{0};  // in-flight requests
 
     // Per-method admission + accounting shared by every protocol
@@ -201,6 +207,7 @@ public:
 private:
     InputMessenger messenger_;
     Acceptor acceptor_;
+    class RedisService* redis_service_ = nullptr;
     ServerOptions options_;
     bool started_ = false;
     bool listening_ = false;

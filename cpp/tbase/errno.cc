@@ -17,6 +17,7 @@ const char* terror(int code) {
         case TERR_LIMIT_EXCEEDED: return "Concurrency limit exceeded";
         case TERR_CLOSE: return "Connection closed";
         case TERR_INTERNAL: return "Internal error";
+        case TERR_AUTH: return "Authentication failed";
         default: return strerror(code);
     }
 }

@@ -17,6 +17,7 @@ enum RpcErrno {
     TERR_LIMIT_EXCEEDED = 4008,  // concurrency limiter rejected
     TERR_CLOSE = 4009,           // connection closed by user
     TERR_INTERNAL = 4010,
+    TERR_AUTH = 4011,            // authentication failed
 };
 
 const char* terror(int code);

@@ -24,84 +24,13 @@ using namespace tpurpc;
 
 namespace {
 
-// Tiny in-memory key-value redis service (GET/SET/DEL/PING/ECHO).
-class KvHandler : public RedisCommandHandler {
-public:
-    enum Op { GET, SET, DEL, PING, ECHO };
-    KvHandler(Op op, std::map<std::string, std::string>* kv,
-              FiberMutex* mu)
-        : op_(op), kv_(kv), mu_(mu) {}
-
-    void Run(const std::vector<std::string>& args,
-             RedisReply* out) override {
-        switch (op_) {
-            case PING:
-                out->type = RedisReply::STATUS;
-                out->str = "PONG";
-                return;
-            case ECHO:
-                if (args.size() != 2) break;
-                out->type = RedisReply::STRING;
-                out->str = args[1];
-                return;
-            case SET:
-                if (args.size() != 3) break;
-                {
-                    mu_->lock();
-                    (*kv_)[args[1]] = args[2];
-                    mu_->unlock();
-                }
-                out->type = RedisReply::STATUS;
-                out->str = "OK";
-                return;
-            case GET: {
-                if (args.size() != 2) break;
-                mu_->lock();
-                auto it = kv_->find(args[1]);
-                const bool found = it != kv_->end();
-                if (found) out->str = it->second;
-                mu_->unlock();
-                out->type = found ? RedisReply::STRING : RedisReply::NIL;
-                return;
-            }
-            case DEL: {
-                if (args.size() != 2) break;
-                mu_->lock();
-                const size_t n = kv_->erase(args[1]);
-                mu_->unlock();
-                out->type = RedisReply::INTEGER;
-                out->integer = (int64_t)n;
-                return;
-            }
-        }
-        out->type = RedisReply::ERROR;
-        out->str = "ERR wrong number of arguments";
-    }
-
-private:
-    Op op_;
-    std::map<std::string, std::string>* kv_;
-    FiberMutex* mu_;
-};
-
 struct RedisTestServer {
-    std::map<std::string, std::string> kv;
-    FiberMutex mu;
     RedisService service;
     Server server;
     EndPoint ep;
 
     bool start() {
-        service.AddCommandHandler("GET",
-                                  new KvHandler(KvHandler::GET, &kv, &mu));
-        service.AddCommandHandler("SET",
-                                  new KvHandler(KvHandler::SET, &kv, &mu));
-        service.AddCommandHandler("DEL",
-                                  new KvHandler(KvHandler::DEL, &kv, &mu));
-        service.AddCommandHandler("PING",
-                                  new KvHandler(KvHandler::PING, &kv, &mu));
-        service.AddCommandHandler("ECHO",
-                                  new KvHandler(KvHandler::ECHO, &kv, &mu));
+        service.AddBasicKvCommands();
         server.set_redis_service(&service);
         EndPoint listen;
         str2endpoint("127.0.0.1:0", &listen);

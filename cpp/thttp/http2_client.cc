@@ -428,7 +428,8 @@ ParseResult ParseH2ClientFrames(IOBuf* source, Socket* socket,
 
 int H2ClientSendUnary(Socket* s, uint64_t cid, const std::string& grpc_path,
                       const std::string& authority, const IOBuf& request_pb,
-                      int64_t deadline_us) {
+                      int64_t deadline_us,
+                      const std::string& authorization) {
     if (g_h2_client_index < 0) return -1;
     H2ClientSession* sess = client_session_of(s);
     std::string out;
@@ -459,6 +460,9 @@ int H2ClientSendUnary(Socket* s, uint64_t cid, const std::string& grpc_path,
         {"content-type", "application/grpc"},
         {"te", "trailers"},
     };
+    if (!authorization.empty()) {
+        headers.emplace_back("authorization", authorization);
+    }
     if (deadline_us > 0) {
         const int64_t remain_ms =
             (deadline_us - monotonic_time_us()) / 1000;

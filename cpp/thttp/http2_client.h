@@ -23,7 +23,8 @@ namespace tpurpc {
 // "/package.Service/Method". Returns 0 on success (frames queued).
 int H2ClientSendUnary(Socket* s, uint64_t cid, const std::string& grpc_path,
                       const std::string& authority, const IOBuf& request_pb,
-                      int64_t deadline_us);
+                      int64_t deadline_us,
+                      const std::string& authorization = "");
 
 // Registered at GlobalInitializeOrDie: parses/processes server->client h2
 // frames on sockets carrying an h2 client session.

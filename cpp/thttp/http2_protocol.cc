@@ -24,6 +24,7 @@
 #include "tnet/input_messenger.h"
 #include "tnet/protocol.h"
 #include "tnet/socket.h"
+#include "trpc/auth.h"
 #include "trpc/controller.h"
 #include "trpc/json2pb.h"
 #include "trpc/pb_compat.h"
@@ -324,6 +325,21 @@ void DispatchCompleteStream(Socket* s, H2Session* sess, uint32_t stream_id,
         return;
     }
     if (ct != nullptr && ct->compare(0, 16, "application/grpc") == 0) {
+        // Authentication: gRPC presents the credential per-call in the
+        // `authorization` header (trpc/auth.h); mismatch is grpc-status
+        // 16 UNAUTHENTICATED.
+        if (server->options().auth != nullptr) {
+            const std::string* authz =
+                FindHeader(req_headers, "authorization");
+            AuthContext actx;
+            if (authz == nullptr ||
+                server->options().auth->VerifyCredential(
+                    *authz, s->remote_side(), &actx) != 0) {
+                RespondGrpcError(s->id(), stream_id, 16,
+                                 "authentication failed");
+                return;
+            }
+        }
         // gRPC: find the pb method, admission, parse, run on a fiber.
         Server::MethodProperty* mp = server->FindMethodByHttpPath(*path);
         if (mp == nullptr) {

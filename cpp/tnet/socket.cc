@@ -7,6 +7,7 @@
 #include <sys/socket.h>
 #include <unistd.h>
 
+#include <algorithm>
 #include <cerrno>
 #include <cstring>
 #include <string>
@@ -110,6 +111,9 @@ int Socket::Create(const SocketOptions& options, SocketId* id) {
     s->last_active_us_.store(s->created_us_, std::memory_order_relaxed);
     if (s->epollout_butex_ == nullptr) s->epollout_butex_ = butex_create();
     if (s->connect_butex_ == nullptr) s->connect_butex_ = butex_create();
+    if (s->auth_butex_ == nullptr) s->auth_butex_ = butex_create();
+    s->auth_state_.store(0, std::memory_order_relaxed);
+    s->auth_user_.clear();
 
     if (options.fd >= 0) {
         make_non_blocking(options.fd);
@@ -136,6 +140,8 @@ void Socket::OnFailed() {
     butex_wake_all(epollout_butex_);
     butex_word(connect_butex_)->fetch_add(1, std::memory_order_release);
     butex_wake_all(connect_butex_);
+    butex_word(auth_butex_)->fetch_add(1, std::memory_order_release);
+    butex_wake_all(auth_butex_);
     // Health check: keep the slot alive with our own ref and probe until
     // the remote answers, then Revive the SAME id (reference
     // src/brpc/details/health_check.cpp:140 HealthCheckTask).
@@ -270,6 +276,8 @@ int Socket::ReviveAfterHealthCheck() {
     connecting_.store(false, std::memory_order_relaxed);
     local_side_ = EndPoint();
     circuit_breaker_.Reset();  // fresh windows for the revived server
+    auth_state_.store(0, std::memory_order_relaxed);  // re-authenticate
+    auth_user_.clear();
     const int rc = Revive();
     if (rc == 0) {
         LOG(INFO) << "Revived socket id=" << id()
@@ -572,6 +580,26 @@ bool Socket::FlushOnce(bool allow_block) {
             ++consumed;
         }
     }
+}
+
+int Socket::WaitAuthenticated(int64_t abstime_us) {
+    std::atomic<int>* word = butex_word(auth_butex_);
+    while (true) {
+        const int st = auth_state_.load(std::memory_order_acquire);
+        if (st == 2 || st == 0) break;  // done, or aborted (re-fight)
+        if (Failed()) return -1;
+        const int expected = word->load(std::memory_order_acquire);
+        const int st2 = auth_state_.load(std::memory_order_acquire);
+        if (st2 == 2 || st2 == 0) break;
+        if (abstime_us > 0 && monotonic_time_us() >= abstime_us) return -1;
+        const int64_t slice =
+            abstime_us > 0
+                ? std::min<int64_t>(abstime_us,
+                                    monotonic_time_us() + 200 * 1000)
+                : monotonic_time_us() + 200 * 1000;
+        butex_wait(auth_butex_, expected, &slice);
+    }
+    return Failed() ? -1 : 0;
 }
 
 int Socket::WaitEpollOut() {

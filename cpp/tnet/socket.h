@@ -166,6 +166,61 @@ public:
         pipeline_q_.pop_front();
         return true;
     }
+    // ---- auth fight (reference socket.h:515 FightAuthentication) ----
+    // First caller on a fresh connection wins the right to attach the
+    // credential; everyone else waits for its outcome. States: 0 none,
+    // 1 in progress (one writer is authenticating), 2 done.
+    // Returns: 0 = caller must attach the credential, 1 = already done.
+    int FightAuthentication() {
+        int expect = 0;
+        if (auth_state_.compare_exchange_strong(
+                expect, 1, std::memory_order_acq_rel)) {
+            return 0;
+        }
+        return 1;
+    }
+    // Park until the in-flight authentication RESOLVES: done (state 2),
+    // or aborted back to none (state 0 — the caller should re-fight).
+    // Returns 0 on resolution, -1 on socket failure or timeout.
+    int WaitAuthenticated(int64_t abstime_us);
+    // The fight winner's call died without a processed response
+    // (credential generation failed, timeout, retry): release the fight
+    // so another caller can authenticate — otherwise the shared
+    // connection wedges with every later call parked behind state 1.
+    // No-op unless authentication is still in progress.
+    void AbortAuthentication() {
+        int expect = 1;
+        if (auth_state_.compare_exchange_strong(
+                expect, 0, std::memory_order_acq_rel)) {
+            butex_word(auth_butex_)->fetch_add(1,
+                                               std::memory_order_release);
+            butex_wake_all(auth_butex_);
+        }
+    }
+    // The authenticating call's response arrived: connection is trusted.
+    // Exactly one caller transitions (via the transient publishing state
+    // 3) and writes the user; races (e.g. two client response fibers)
+    // collapse to the first winner.
+    void SetAuthenticated(const std::string& user) {
+        for (int from : {1, 0}) {
+            int expect = from;
+            if (auth_state_.compare_exchange_strong(
+                    expect, 3, std::memory_order_acq_rel)) {
+                auth_user_ = user;
+                auth_state_.store(2, std::memory_order_release);
+                butex_word(auth_butex_)->fetch_add(
+                    1, std::memory_order_release);
+                butex_wake_all(auth_butex_);
+                return;
+            }
+        }
+    }
+    bool authenticated() const {
+        return auth_state_.load(std::memory_order_acquire) == 2;
+    }
+    // Server side: the verified peer identity ("" before verification).
+    const std::string& auth_user() const { return auth_user_; }
+
     // Un-push after a failed write (the entry must not shift correlation
     // for later callers). True if it was still queued.
     bool RemovePipelinedInfo(uint64_t id_wait) {
@@ -274,6 +329,9 @@ private:
     std::atomic<int> error_code_{0};
     std::atomic<bool> connecting_{false};
     void* connect_butex_ = nullptr;
+    void* auth_butex_ = nullptr;
+    std::atomic<int> auth_state_{0};
+    std::string auth_user_;
     int health_check_interval_ms_ = 0;
     bool tls_ = false;
     std::string tls_alpn_;

@@ -1,0 +1,48 @@
+// Authenticator: connection-level credential exchange.
+//
+// Reference parity: src/brpc/authenticator.h (GenerateCredential /
+// VerifyCredential / AuthContext) + the Protocol `verify` hook
+// (src/brpc/protocol.h:77-172) + the Socket auth fight
+// (src/brpc/socket.h:515 FightAuthentication): on a shared connection
+// the FIRST request carries the credential exactly once; concurrent
+// first-writers wait for its outcome instead of re-authenticating.
+//
+// tpu_std carries the credential in RpcMeta.auth_data (first message of
+// the connection); gRPC carries it in the `authorization` header
+// (per-request, the h2 idiom).
+#pragma once
+
+#include <string>
+
+#include "tbase/endpoint.h"
+
+namespace tpurpc {
+
+// What a verified credential resolved to (attached to the connection).
+class AuthContext {
+public:
+    const std::string& user() const { return user_; }
+    void set_user(const std::string& u) { user_ = u; }
+
+private:
+    std::string user_;
+};
+
+class Authenticator {
+public:
+    virtual ~Authenticator() = default;
+
+    // Client: fill `auth_str` with the credential to present. Return 0;
+    // nonzero fails the RPC before anything is sent.
+    virtual int GenerateCredential(std::string* auth_str) const = 0;
+
+    // Server: verify a presented credential. Return 0 to accept (and
+    // optionally fill `out_ctx`); nonzero rejects — the request is
+    // refused and the connection is failed (tpu_std) or the call gets
+    // UNAUTHENTICATED (gRPC).
+    virtual int VerifyCredential(const std::string& auth_str,
+                                 const EndPoint& client_addr,
+                                 AuthContext* out_ctx) const = 0;
+};
+
+}  // namespace tpurpc

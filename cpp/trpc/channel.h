@@ -46,6 +46,10 @@ struct ChannelOptions {
     // pooled/short don't apply). Init fails when libssl is unavailable.
     bool tls = false;
     std::string tls_sni;
+    // Credential presenter (trpc/auth.h). Not owned; must outlive the
+    // channel. tpu_std: first message of each connection (auth fight);
+    // grpc: `authorization` header per request.
+    const class Authenticator* auth = nullptr;
 };
 
 class Channel : public google::protobuf::RpcChannel {

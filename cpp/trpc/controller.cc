@@ -1,3 +1,4 @@
+#include "trpc/auth.h"
 #include "trpc/controller.h"
 
 #include <google/protobuf/descriptor.h>
@@ -67,6 +68,7 @@ void Controller::Reset() {
     current_fly_sid_ = INVALID_VREF_ID;
     unfinished_fly_sid_ = INVALID_VREF_ID;
     reusable_fly_sid_ = INVALID_VREF_ID;
+    auth_fight_sid_ = INVALID_VREF_ID;
     delete excluded_;
     excluded_ = nullptr;
     request_stream_ = INVALID_VREF_ID;
@@ -305,14 +307,60 @@ void Controller::IssueRPC() {
         if (span_ != nullptr) {
             span_->sent_us = monotonic_time_us();
         }
+        std::string authorization;
+        if (channel_->options().auth != nullptr &&
+            channel_->options().auth->GenerateCredential(&authorization) !=
+                0) {
+            id_error(current_cid_, TERR_AUTH);
+            return;
+        }
         const std::string path = "/" + method_->service()->full_name() +
                                  "/" + method_->name();
         if (H2ClientSendUnary(s.get(), current_cid_, path,
                               endpoint2str(remote_side_), request_buf_,
-                              deadline_us_) != 0) {
+                              deadline_us_, authorization) != 0) {
             id_error(current_cid_, errno != 0 ? errno : TERR_FAILED_SOCKET);
         }
         return;
+    }
+
+    // tpu_std auth fight (reference socket.h:515): the first caller on a
+    // fresh connection attaches the credential; concurrent first-writers
+    // wait for its outcome instead of re-authenticating. A PREVIOUS try
+    // of this RPC that won the fight but died releases it first so this
+    // try (or another caller) can re-fight.
+    if (auth_fight_sid_ != INVALID_VREF_ID) {
+        SocketUniquePtr prev;
+        if (Socket::AddressSocket(auth_fight_sid_, &prev) == 0) {
+            prev->AbortAuthentication();
+        }
+        auth_fight_sid_ = INVALID_VREF_ID;
+    }
+    std::string auth_data;
+    bool send_auth = false;
+    if (channel_->options().auth != nullptr) {
+        while (!s->authenticated()) {
+            if (s->FightAuthentication() == 0) {
+                if (channel_->options().auth->GenerateCredential(
+                        &auth_data) != 0) {
+                    s->AbortAuthentication();
+                    id_error(current_cid_, TERR_AUTH);
+                    return;
+                }
+                send_auth = true;
+                auth_fight_sid_ = s->id();
+                break;
+            }
+            if (s->WaitAuthenticated(deadline_us_) != 0) {
+                // Distinguish a dead connection from a slow/wedged
+                // authenticator for the caller's diagnosis.
+                id_error(current_cid_, s->Failed() ? TERR_FAILED_SOCKET
+                                                   : TERR_RPC_TIMEDOUT);
+                return;
+            }
+            // Resolved: either authenticated (loop exits) or the winner
+            // aborted (loop re-fights).
+        }
     }
 
     rpc::RpcMeta meta;
@@ -337,6 +385,9 @@ void Controller::IssueRPC() {
         }
     }
     meta.set_correlation_id(current_cid_);
+    if (send_auth) {
+        meta.set_auth_data(auth_data);
+    }
     if (request_compress_type_ != COMPRESS_NONE) {
         meta.set_compress_type(request_compress_type_);
     }
@@ -439,6 +490,17 @@ void Controller::ReleaseFlySockets() {
 
 void Controller::EndRPC(CallId locked_id) {
     latency_us_ = monotonic_time_us() - start_us_;
+    // A failed auth-carrying call releases the fight it won (success
+    // paths already resolved it via SetAuthenticated on the response).
+    if (auth_fight_sid_ != INVALID_VREF_ID) {
+        if (Failed()) {
+            SocketUniquePtr s;
+            if (Socket::AddressSocket(auth_fight_sid_, &s) == 0) {
+                s->AbortAuthentication();
+            }
+        }
+        auth_fight_sid_ = INVALID_VREF_ID;
+    }
     ReleaseFlySockets();
     if (span_ != nullptr) {
         span_->end_us = monotonic_time_us();
@@ -513,6 +575,17 @@ void ProcessTpuStdResponse(TpuStdMessage* msg, const rpc::RpcMeta& meta) {
         cntl->unfinished_fly_sid_ = INVALID_VREF_ID;
     }
     const auto& rmeta = meta.response();
+    // Any NON-auth-error response proves the server accepted this
+    // connection's credential: release the auth-fight waiters (a bad
+    // credential fails the connection instead, waking them with an
+    // error).
+    if (rmeta.error_code() != TERR_AUTH) {
+        SocketUniquePtr rs;
+        if (Socket::AddressSocket(msg->socket_id, &rs) == 0 &&
+            !rs->authenticated()) {
+            rs->SetAuthenticated("");
+        }
+    }
     if (rmeta.error_code() != 0) {
         cntl->SetFailed(rmeta.error_code(), "%s", rmeta.error_text().c_str());
         cntl->EndRPC(cid);

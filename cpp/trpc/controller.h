@@ -203,6 +203,10 @@ private:
     SocketId current_fly_sid_;
     SocketId unfinished_fly_sid_;
     SocketId reusable_fly_sid_;
+    // Socket whose auth fight THIS RPC's current try won (tpu_std);
+    // aborted on retry/terminal failure so the connection can't wedge
+    // with waiters parked behind a dead authenticator.
+    SocketId auth_fight_sid_;
     class ExcludedServers* excluded_;  // servers tried by earlier attempts
 
     // --- streaming state ---

@@ -17,6 +17,7 @@
 #include "thttp/http_protocol.h"
 #include "tici/shm_link.h"
 #include "tnet/input_messenger.h"
+#include "trpc/auth.h"
 #include "trpc/controller.h"
 #include "tbase/crc32c.h"
 #include "trpc/compress.h"
@@ -229,6 +230,21 @@ void ProcessTpuStdRequest(TpuStdMessage* msg, const rpc::RpcMeta& meta) {
     Server* server = m != nullptr ? (Server*)m->context : nullptr;
     if (server == nullptr) {
         return;  // no server bound (shutting down)
+    }
+    // Connection-level authentication (the Protocol `verify` hook,
+    // reference protocol.h:77-172): the FIRST request must carry a valid
+    // credential; the connection is trusted afterwards. Bad credentials
+    // fail the whole connection, not just the call.
+    if (server->options().auth != nullptr && !s->authenticated()) {
+        AuthContext actx;
+        if (!meta.has_auth_data() ||
+            server->options().auth->VerifyCredential(
+                meta.auth_data(), s->remote_side(), &actx) != 0) {
+            SendErrorResponse(sid, cid, TERR_AUTH, "authentication failed");
+            s->SetFailedWithError(TERR_AUTH);
+            return;
+        }
+        s->SetAuthenticated(actx.user());
     }
     const auto& req_meta = meta.request();
     Server::MethodProperty* mp =

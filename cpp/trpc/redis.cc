@@ -9,10 +9,12 @@
 #include "tbase/logging.h"
 #include "tbase/time.h"
 #include "tfiber/call_id.h"
+#include "tfiber/fiber_sync.h"
 #include "tfiber/timer_thread.h"
 #include "tnet/input_messenger.h"
 #include "tnet/protocol.h"
 #include "tnet/socket.h"
+#include "trpc/auth.h"
 #include "trpc/channel.h"
 #include "trpc/controller.h"
 #include "trpc/server.h"
@@ -283,6 +285,89 @@ void RedisRequest::AddCommand(const std::vector<std::string>& args) {
     ++ncommands_;
 }
 
+struct RedisService::KvState {
+    FiberMutex mu;
+    std::map<std::string, std::string> map;
+};
+
+namespace {
+
+// PING/ECHO/GET/SET/DEL over a shared map (the starter command set).
+class BasicKvHandler : public RedisCommandHandler {
+public:
+    enum Op { PING, ECHO, GET, SET, DEL };
+    BasicKvHandler(Op op, RedisService::KvState* kv) : op_(op), kv_(kv) {}
+
+    void Run(const std::vector<std::string>& args,
+             RedisReply* out) override {
+        switch (op_) {
+            case PING:
+                out->type = RedisReply::STATUS;
+                out->str = "PONG";
+                return;
+            case ECHO:
+                if (args.size() != 2) break;
+                out->type = RedisReply::STRING;
+                out->str = args[1];
+                return;
+            case SET:
+                if (args.size() != 3) break;
+                {
+                    kv_->mu.lock();
+                    kv_->map[args[1]] = args[2];
+                    kv_->mu.unlock();
+                }
+                out->type = RedisReply::STATUS;
+                out->str = "OK";
+                return;
+            case GET: {
+                if (args.size() != 2) break;
+                kv_->mu.lock();
+                auto it = kv_->map.find(args[1]);
+                const bool found = it != kv_->map.end();
+                if (found) out->str = it->second;
+                kv_->mu.unlock();
+                out->type = found ? RedisReply::STRING : RedisReply::NIL;
+                return;
+            }
+            case DEL: {
+                if (args.size() != 2) break;
+                kv_->mu.lock();
+                const size_t n = kv_->map.erase(args[1]);
+                kv_->mu.unlock();
+                out->type = RedisReply::INTEGER;
+                out->integer = (int64_t)n;
+                return;
+            }
+        }
+        out->type = RedisReply::ERROR;
+        out->str = "ERR wrong number of arguments";
+    }
+
+private:
+    Op op_;
+    RedisService::KvState* kv_;
+};
+
+}  // namespace
+
+RedisService::RedisService() = default;
+RedisService::~RedisService() = default;
+
+void RedisService::AddBasicKvCommands() {
+    if (kv_ == nullptr) kv_.reset(new KvState);
+    AddCommandHandler("PING", new BasicKvHandler(BasicKvHandler::PING,
+                                                 kv_.get()));
+    AddCommandHandler("ECHO", new BasicKvHandler(BasicKvHandler::ECHO,
+                                                 kv_.get()));
+    AddCommandHandler("GET",
+                      new BasicKvHandler(BasicKvHandler::GET, kv_.get()));
+    AddCommandHandler("SET",
+                      new BasicKvHandler(BasicKvHandler::SET, kv_.get()));
+    AddCommandHandler("DEL",
+                      new BasicKvHandler(BasicKvHandler::DEL, kv_.get()));
+}
+
 void RedisService::AddCommandHandler(const std::string& name,
                                      RedisCommandHandler* handler) {
     std::string key = name;
@@ -335,6 +420,36 @@ void ProcessRedisCommand(InputMessageBase* raw) {
     RedisService* service =
         server != nullptr ? server->redis_service() : nullptr;
     RedisReply reply;
+    // ServerOptions::auth covers RESP too (the server's auth promise
+    // must not have a side door): unauthenticated connections may only
+    // run the standard `AUTH <credential>` command; everything else gets
+    // -NOAUTH (the real redis convention).
+    if (server != nullptr && server->options().auth != nullptr &&
+        !s->authenticated() && service != nullptr && !msg->args.empty()) {
+        std::string cmd = msg->args[0];
+        for (char& c : cmd) c = (char)toupper((unsigned char)c);
+        if (cmd == "AUTH" && msg->args.size() == 2) {
+            AuthContext actx;
+            if (server->options().auth->VerifyCredential(
+                    msg->args[1], s->remote_side(), &actx) == 0) {
+                s->SetAuthenticated(actx.user());
+                reply.type = RedisReply::STATUS;
+                reply.str = "OK";
+            } else {
+                reply.type = RedisReply::ERROR;
+                reply.str = "ERR invalid credential";
+            }
+        } else {
+            reply.type = RedisReply::ERROR;
+            reply.str = "NOAUTH Authentication required";
+        }
+        std::string out;
+        RedisSerializeReply(reply, &out);
+        IOBuf buf;
+        buf.append(out);
+        s->Write(&buf);
+        return;
+    }
     if (service == nullptr) {
         reply.type = RedisReply::ERROR;
         reply.str = "ERR this server has no redis service";
@@ -365,7 +480,6 @@ void ProcessRedisCommand(InputMessageBase* raw) {
 struct RedisCallCtx {
     Controller* cntl;
     RedisResponse* response;
-    uint32_t expected;
 };
 
 int RedisOnError(CallId id, void* data, int error) {
@@ -459,7 +573,7 @@ void RedisCall(Channel* channel, Controller* cntl,
         cntl->SetFailed(TERR_REQUEST, "empty redis request");
         return;
     }
-    RedisCallCtx ctx{cntl, response, (uint32_t)request.command_count()};
+    RedisCallCtx ctx{cntl, response};
     CallId cid;
     if (id_create(&cid, &ctx, RedisOnError) != 0) {
         cntl->SetFailed(TERR_INTERNAL, "id_create failed");

@@ -94,14 +94,24 @@ public:
 // RedisService, src/brpc/redis.h). Unknown commands get -ERR.
 class RedisService {
 public:
-    virtual ~RedisService() = default;
+    RedisService();  // out-of-line: KvState is incomplete here
+    virtual ~RedisService();
     // Takes ownership of the handler.
     void AddCommandHandler(const std::string& name,
                            RedisCommandHandler* handler);
     RedisCommandHandler* FindCommandHandler(const std::string& name) const;
 
+    // Register a built-in in-memory KV command set — PING, ECHO, GET,
+    // SET, DEL over a service-owned map (fiber-safe). The demo/example
+    // backend (reference example/redis_c++/redis_server.cpp ships the
+    // same starter set); real applications add their own handlers.
+    void AddBasicKvCommands();
+
+    struct KvState;  // public: the built-in handlers reach it
+
 private:
     std::map<std::string, std::unique_ptr<RedisCommandHandler>> handlers_;
+    std::unique_ptr<KvState> kv_;  // backs AddBasicKvCommands
 };
 
 // ---- codec (exposed for tests/fuzzing) ----

@@ -84,6 +84,11 @@ struct ServerOptions {
     // (src/brpc/server.h) + details/ssl_helper.cpp.
     std::string tls_cert_path;
     std::string tls_key_path;
+    // Credential verifier (trpc/auth.h). Not owned; must outlive the
+    // server. tpu_std connections must authenticate on their first
+    // request (bad credentials fail the connection); gRPC calls present
+    // the `authorization` header and get UNAUTHENTICATED on mismatch.
+    const class Authenticator* auth = nullptr;
 };
 
 class Server {

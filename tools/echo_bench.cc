@@ -191,48 +191,6 @@ double RunScaleLevel(benchpb::EchoService_Stub& stub, int ncallers,
 const char* g_tls_cert = nullptr;
 const char* g_tls_key = nullptr;
 
-// Minimal in-memory KV for the RESP interop tests (tests/test_redis_raw.py
-// speaks raw RESP to this server the way redis-cli would).
-class BenchKvHandler : public RedisCommandHandler {
-public:
-    enum Op { GET, SET, PING };
-    BenchKvHandler(Op op, std::map<std::string, std::string>* kv,
-                   FiberMutex* mu)
-        : op_(op), kv_(kv), mu_(mu) {}
-    void Run(const std::vector<std::string>& args,
-             RedisReply* out) override {
-        if (op_ == PING) {
-            out->type = RedisReply::STATUS;
-            out->str = "PONG";
-            return;
-        }
-        if (op_ == SET && args.size() == 3) {
-            mu_->lock();
-            (*kv_)[args[1]] = args[2];
-            mu_->unlock();
-            out->type = RedisReply::STATUS;
-            out->str = "OK";
-            return;
-        }
-        if (op_ == GET && args.size() == 2) {
-            mu_->lock();
-            auto it = kv_->find(args[1]);
-            const bool found = it != kv_->end();
-            if (found) out->str = it->second;
-            mu_->unlock();
-            out->type = found ? RedisReply::STRING : RedisReply::NIL;
-            return;
-        }
-        out->type = RedisReply::ERROR;
-        out->str = "ERR wrong number of arguments";
-    }
-
-private:
-    Op op_;
-    std::map<std::string, std::string>* kv_;
-    FiberMutex* mu_;
-};
-
 int RunIciServer() {
     prctl(PR_SET_PDEATHSIG, SIGKILL);  // die with the parent
     FLAGS_socket_send_buffer_size.set(1 << 20);
@@ -241,15 +199,8 @@ int RunIciServer() {
     static EchoServiceImpl service;
     static Server server;
     if (server.AddService(&service) != 0) return 1;
-    static std::map<std::string, std::string> kv;
-    static FiberMutex kv_mu;
     static RedisService redis;
-    redis.AddCommandHandler(
-        "PING", new BenchKvHandler(BenchKvHandler::PING, &kv, &kv_mu));
-    redis.AddCommandHandler(
-        "SET", new BenchKvHandler(BenchKvHandler::SET, &kv, &kv_mu));
-    redis.AddCommandHandler(
-        "GET", new BenchKvHandler(BenchKvHandler::GET, &kv, &kv_mu));
+    redis.AddBasicKvCommands();
     server.set_redis_service(&redis);
     ServerOptions sopts;
     if (g_tls_cert != nullptr && g_tls_key != nullptr) {

@@ -1,0 +1,41 @@
+#include "tbase/symbolize.h"
+
+#include <cxxabi.h>
+#include <dlfcn.h>
+
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+
+namespace tpurpc {
+
+std::string SymbolizePc(uintptr_t pc) {
+    // The sampled PC is the RETURN address side for frame entries; keep
+    // it as-is (leaf PCs are exact, call sites land inside the caller).
+    Dl_info info;
+    if (dladdr((void*)pc, &info) != 0) {
+        if (info.dli_sname != nullptr) {
+            int status = 0;
+            char* demangled = abi::__cxa_demangle(info.dli_sname, nullptr,
+                                                  nullptr, &status);
+            std::string out = status == 0 && demangled != nullptr
+                                  ? demangled
+                                  : info.dli_sname;
+            free(demangled);
+            return out;
+        }
+        if (info.dli_fname != nullptr) {
+            const char* base = strrchr(info.dli_fname, '/');
+            char buf[256];
+            snprintf(buf, sizeof(buf), "%s+0x%lx",
+                     base != nullptr ? base + 1 : info.dli_fname,
+                     (unsigned long)(pc - (uintptr_t)info.dli_fbase));
+            return buf;
+        }
+    }
+    char buf[32];
+    snprintf(buf, sizeof(buf), "0x%lx", (unsigned long)pc);
+    return buf;
+}
+
+}  // namespace tpurpc

@@ -1,0 +1,18 @@
+// In-process symbolization for the /hotspots portal: PC -> demangled
+// function name via dladdr (the framework is a shared library with
+// default visibility, so its functions carry dynamic symbols) with a
+// module+offset fallback. Replaces the offline tools/symbolize_prof.py
+// step for the portal path (reference hotspots_service.cpp bundles
+// pprof's symbolization for the same reason: profiles must be readable
+// where they're taken).
+#pragma once
+
+#include <cstdint>
+#include <string>
+
+namespace tpurpc {
+
+// "Namespace::Function()" | "module.so+0x1234" | "0xdeadbeef".
+std::string SymbolizePc(uintptr_t pc);
+
+}  // namespace tpurpc

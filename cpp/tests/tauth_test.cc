@@ -249,3 +249,55 @@ TEST(AuthRedis, NoauthUntilAuthCommand) {
     EXPECT_EQ(res.reply(2).str, "OK");
     EXPECT_EQ(res.reply(3).str, "PONG");
 }
+
+#include <netinet/in.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+TEST(AuthHttp, JsonTranscodingRequiresAuthorization) {
+    // The json door honors ServerOptions::auth too: bare POST is 401,
+    // with the credential in `authorization` it runs.
+    CountingAuth server_auth("open-sesame");
+    AuthServer ts;
+    ASSERT_TRUE(ts.start(&server_auth));
+    auto fetch = [&](const std::string& req_str) {
+        const int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+        sockaddr_in addr;
+        endpoint2sockaddr(ts.ep, &addr);
+        if (::connect(fd, (sockaddr*)&addr, sizeof(addr)) != 0) {
+            ::close(fd);
+            return std::string("connect-failed");
+        }
+        (void)!::send(fd, req_str.data(), req_str.size(), 0);
+        std::string out;
+        char buf[4096];
+        ssize_t r;
+        while ((r = ::recv(fd, buf, sizeof(buf), 0)) > 0) {
+            out.append(buf, (size_t)r);
+            if (out.find("\r\n\r\n") != std::string::npos &&
+                out.find("}") != std::string::npos) {
+                break;
+            }
+        }
+        ::close(fd);
+        return out;
+    };
+    const std::string body = "{\"message\": \"sesame\"}";
+    char req[512];
+    snprintf(req, sizeof(req),
+             "POST /EchoService/Echo HTTP/1.1\r\nHost: x\r\n"
+             "Content-Type: application/json\r\nContent-Length: %zu\r\n"
+             "\r\n%s",
+             body.size(), body.c_str());
+    const std::string denied = fetch(req);
+    EXPECT_NE(denied.find("401"), std::string::npos);
+    snprintf(req, sizeof(req),
+             "POST /EchoService/Echo HTTP/1.1\r\nHost: x\r\n"
+             "Authorization: open-sesame\r\n"
+             "Content-Type: application/json\r\nContent-Length: %zu\r\n"
+             "\r\n%s",
+             body.size(), body.c_str());
+    const std::string ok = fetch(req);
+    EXPECT_NE(ok.find("200"), std::string::npos);
+    EXPECT_NE(ok.find("sesame"), std::string::npos);
+}

@@ -1,0 +1,115 @@
+#include "tfiber/contention_profiler.h"
+
+#include <algorithm>
+#include <atomic>
+#include <cstdio>
+#include <vector>
+
+#include "tbase/symbolize.h"
+
+namespace tpurpc {
+
+namespace {
+
+// Open-addressed fixed table keyed by call-site PC. Collisions past the
+// probe limit fall into the overflow slot (reported as "other").
+constexpr size_t kSlots = 512;  // power of two
+constexpr size_t kProbes = 8;
+
+struct Slot {
+    std::atomic<uintptr_t> pc{0};
+    std::atomic<int64_t> count{0};
+    std::atomic<int64_t> wait_us{0};
+};
+
+Slot g_slots[kSlots];
+Slot g_overflow;
+
+}  // namespace
+
+void RecordContention(uintptr_t site_pc, int64_t wait_us) {
+    size_t h = (site_pc >> 2) * 0x9E3779B97F4A7C15ull;
+    for (size_t i = 0; i < kProbes; ++i) {
+        Slot& s = g_slots[(h + i) & (kSlots - 1)];
+        uintptr_t cur = s.pc.load(std::memory_order_acquire);
+        if (cur == 0) {
+            // Claim; a racer claiming the same slot for a different pc
+            // just moves on to the next probe.
+            if (!s.pc.compare_exchange_strong(cur, site_pc,
+                                              std::memory_order_acq_rel)) {
+                if (cur != site_pc) continue;
+            }
+            cur = site_pc;
+        }
+        if (cur == site_pc) {
+            s.count.fetch_add(1, std::memory_order_relaxed);
+            s.wait_us.fetch_add(wait_us, std::memory_order_relaxed);
+            return;
+        }
+    }
+    g_overflow.count.fetch_add(1, std::memory_order_relaxed);
+    g_overflow.wait_us.fetch_add(wait_us, std::memory_order_relaxed);
+}
+
+std::string ContentionProfileText(size_t topn) {
+    struct Row {
+        uintptr_t pc;
+        int64_t count;
+        int64_t wait_us;
+    };
+    std::vector<Row> rows;
+    int64_t total_count = 0, total_wait = 0;
+    for (Slot& s : g_slots) {
+        const uintptr_t pc = s.pc.load(std::memory_order_acquire);
+        if (pc == 0) continue;
+        const int64_t c = s.count.load(std::memory_order_relaxed);
+        const int64_t w = s.wait_us.load(std::memory_order_relaxed);
+        if (c == 0) continue;
+        rows.push_back({pc, c, w});
+        total_count += c;
+        total_wait += w;
+    }
+    const int64_t oc = g_overflow.count.load(std::memory_order_relaxed);
+    total_count += oc;
+    total_wait += g_overflow.wait_us.load(std::memory_order_relaxed);
+    std::sort(rows.begin(), rows.end(), [](const Row& a, const Row& b) {
+        return a.wait_us > b.wait_us;
+    });
+    if (rows.size() > topn) rows.resize(topn);
+    std::string out;
+    char line[512];
+    snprintf(line, sizeof(line),
+             "fiber-mutex contention: %lld contended acquisitions, "
+             "%lld us total wait\n\n%12s %14s  %s\n",
+             (long long)total_count, (long long)total_wait, "count",
+             "wait_us", "lock call site");
+    out += line;
+    for (const Row& r : rows) {
+        snprintf(line, sizeof(line), "%12lld %14lld  %s\n",
+                 (long long)r.count, (long long)r.wait_us,
+                 SymbolizePc(r.pc).c_str());
+        out += line;
+    }
+    if (oc > 0) {
+        snprintf(line, sizeof(line), "%12lld %14s  (other sites)\n",
+                 (long long)oc, "-");
+        out += line;
+    }
+    return out;
+}
+
+void ResetContentionProfile() {
+    // Counters only — the pc claims stay. Zeroing pc would let a racing
+    // recorder (which already matched this slot) add its wait to a slot
+    // a DIFFERENT call site then claims, misattributing the time. Sites
+    // are bounded (kSlots) and long-lived by nature, so keeping claims
+    // costs nothing.
+    for (Slot& s : g_slots) {
+        s.count.store(0, std::memory_order_relaxed);
+        s.wait_us.store(0, std::memory_order_relaxed);
+    }
+    g_overflow.count.store(0, std::memory_order_relaxed);
+    g_overflow.wait_us.store(0, std::memory_order_relaxed);
+}
+
+}  // namespace tpurpc

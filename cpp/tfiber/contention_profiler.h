@@ -1,0 +1,28 @@
+// Fiber-mutex contention profiler: every CONTENDED FiberMutex::lock
+// records its call site + wait time into a fixed lock-free table; the
+// /hotspots/contention portal page renders the symbolized top sites.
+//
+// Reference parity: the bthread mutex contention profiler
+// (src/bthread/mutex.cpp contention hooks feeding
+// builtin/hotspots_service.cpp's contention view). Recording costs one
+// hash probe + two atomic adds, and only on the already-slow contended
+// path — uncontended locks never touch it.
+#pragma once
+
+#include <cstddef>
+#include <cstdint>
+#include <string>
+
+namespace tpurpc {
+
+// Called by FiberMutex::lock after a contended acquisition.
+void RecordContention(uintptr_t site_pc, int64_t wait_us);
+
+// Symbolized text report of the top-N wait sites (plus totals).
+std::string ContentionProfileText(size_t topn = 30);
+
+// Zero all counters (each /hotspots/contention view starts a fresh
+// observation window).
+void ResetContentionProfile();
+
+}  // namespace tpurpc

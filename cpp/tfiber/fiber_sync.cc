@@ -2,6 +2,9 @@
 
 #include <cerrno>
 
+#include "tbase/time.h"
+#include "tfiber/contention_profiler.h"
+
 namespace tpurpc {
 
 // ---------------- FiberMutex ----------------
@@ -23,10 +26,15 @@ void FiberMutex::lock() {
                                    std::memory_order_relaxed)) {
         return;
     }
-    // Contended: advertise waiters (state 2) and park.
+    // Contended: advertise waiters (state 2) and park. The wait is
+    // charged to the caller's PC for /hotspots/contention (reference
+    // bthread/mutex.cpp contention hooks) — only this slow path pays.
+    const int64_t t0 = monotonic_time_us();
     while (w->exchange(2, std::memory_order_acquire) != 0) {
         butex_wait(butex_, 2, nullptr);
     }
+    RecordContention((uintptr_t)__builtin_return_address(0),
+                     monotonic_time_us() - t0);
 }
 
 void FiberMutex::unlock() {

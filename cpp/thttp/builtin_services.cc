@@ -1,11 +1,18 @@
 #include "thttp/builtin_services.h"
 
+#include <algorithm>
 #include <cctype>
+#include <map>
+#include <vector>
 #include <cstdio>
 #include <cstdlib>
 #include <string>
 
+#include "tbase/cpu_profiler.h"
 #include "tbase/flags.h"
+#include "tbase/symbolize.h"
+#include "tfiber/contention_profiler.h"
+#include "tfiber/fiber.h"
 #include "thttp/http_message.h"
 #include "thttp/http_protocol.h"
 #include "tfiber/task_group.h"
@@ -32,12 +39,93 @@ void HandleIndex(Server*, const HttpRequest&, HttpResponse* res) {
         "/connections  accepted connections\n"
         "/rpcz         sampled per-RPC spans (enable_rpcz flag)\n"
         "/fibers       fiber runtime introspection\n"
+        "/hotspots     profiling (/hotspots/cpu?seconds=N, "
+        "/hotspots/contention)\n"
         "/metrics      prometheus exposition\n");
 }
 
 void HandleHealth(Server*, const HttpRequest&, HttpResponse* res) {
     res->set_content_type("text/plain");
     res->Append("OK\n");
+}
+
+// ---------------- /hotspots (reference hotspots_service.cpp) ----------------
+
+void HandleHotspotsIndex(Server*, const HttpRequest&, HttpResponse* res) {
+    res->set_content_type("text/plain");
+    res->Append(
+        "profiling\n"
+        "\n"
+        "/hotspots/cpu?seconds=N   sample all threads for N seconds\n"
+        "                          (default 2, max 30) and show the\n"
+        "                          symbolized flat profile\n"
+        "/hotspots/contention      fiber-mutex wait sites since the\n"
+        "                          last view (?reset=1 to only clear)\n");
+}
+
+// /hotspots/cpu: in-server profile run + symbolization. Samples every
+// running thread via SIGPROF for `seconds`, then aggregates leaf PCs and
+// renders function names (tbase/symbolize.h) — no offline step.
+void HandleHotspotsCpu(Server*, const HttpRequest& req, HttpResponse* res) {
+    res->set_content_type("text/plain");
+    int seconds = atoi(req.QueryParam("seconds").c_str());
+    if (seconds <= 0) seconds = 2;
+    if (seconds > 30) seconds = 30;
+    if (StartCpuProfiler() != 0) {
+        res->status = 503;
+        res->Append("another profile run is in progress\n");
+        return;
+    }
+    fiber_usleep((int64_t)seconds * 1000 * 1000);
+    const std::string dump = StopCpuProfilerToString();
+    // Dump: "pc fp1 fp2...\n" per sample until the "--- maps ---" line.
+    std::map<uintptr_t, int64_t> by_leaf;
+    int64_t nsamples = 0;
+    size_t pos = 0;
+    while (pos < dump.size()) {
+        size_t eol = dump.find('\n', pos);
+        if (eol == std::string::npos) eol = dump.size();
+        if (dump.compare(pos, 3, "---") == 0) break;
+        const uintptr_t leaf = strtoull(dump.c_str() + pos, nullptr, 16);
+        if (leaf != 0) {
+            ++nsamples;
+            ++by_leaf[leaf];
+        }
+        pos = eol + 1;
+    }
+    std::vector<std::pair<int64_t, uintptr_t>> top;
+    top.reserve(by_leaf.size());
+    for (const auto& kv : by_leaf) top.push_back({kv.second, kv.first});
+    std::sort(top.rbegin(), top.rend());
+    if (top.size() > 40) top.resize(40);
+    char line[512];
+    snprintf(line, sizeof(line),
+             "cpu profile: %lld samples over %ds (997Hz, all threads)\n\n"
+             "%8s %6s  %s\n",
+             (long long)nsamples, seconds, "samples", "%", "function");
+    res->Append(line);
+    for (const auto& e : top) {
+        snprintf(line, sizeof(line), "%8lld %5.1f%%  %s\n",
+                 (long long)e.first,
+                 nsamples > 0 ? 100.0 * (double)e.first / (double)nsamples
+                              : 0.0,
+                 SymbolizePc(e.second).c_str());
+        res->Append(line);
+    }
+}
+
+void HandleHotspotsContention(Server*, const HttpRequest& req,
+                              HttpResponse* res) {
+    res->set_content_type("text/plain");
+    if (req.QueryParam("reset") == "1") {
+        ResetContentionProfile();
+        res->Append("contention counters reset\n");
+        return;
+    }
+    res->Append(ContentionProfileText());
+    // Each view starts a fresh window (matches the reference's
+    // per-request contention observation).
+    ResetContentionProfile();
 }
 
 // /fibers: live fiber-runtime introspection (reference /bthreads page;
@@ -236,6 +324,10 @@ void AddBuiltinHttpServices(Server* server) {
     server->RegisterHttpHandler("/connections", HandleConnections);
     server->RegisterHttpHandler("/rpcz", HandleRpcz);
     server->RegisterHttpHandler("/fibers", HandleFibers);
+    server->RegisterHttpHandler("/hotspots", HandleHotspotsIndex);
+    server->RegisterHttpHandler("/hotspots/cpu", HandleHotspotsCpu);
+    server->RegisterHttpHandler("/hotspots/contention",
+                                HandleHotspotsContention);
     server->RegisterHttpHandler("/metrics", HandleMetrics);
 }
 

@@ -11,6 +11,7 @@
 #include "tnet/socket.h"
 #include "tfiber/fiber_sync.h"
 #include "trpc/controller.h"
+#include "trpc/auth.h"
 #include "trpc/json2pb.h"
 #include "trpc/server.h"
 
@@ -66,6 +67,23 @@ bool DispatchHttpRpc(Server* server, const HttpRequest& req,
     Server::MethodProperty* mp = server->FindMethodByHttpPath(req.path);
     if (mp == nullptr) return false;
     res->set_content_type("application/json");
+    // ServerOptions::auth covers the json transcoding door too (the RPC
+    // methods it guards on tpu_std/gRPC/redis must not be callable bare
+    // over HTTP): the `authorization` header carries the credential,
+    // like the gRPC path. Portal pages stay open — they don't run user
+    // service code.
+    if (server->options().auth != nullptr) {
+        const std::string* authz = req.FindHeader("authorization");
+        AuthContext actx;
+        if (authz == nullptr ||
+            server->options().auth->VerifyCredential(
+                *authz, remote_side, &actx) != 0) {
+            res->status = 401;
+            res->body.clear();
+            res->Append("{\"error\":\"authentication failed\"}\n");
+            return true;
+        }
+    }
     if (req.method != "POST" && req.method != "GET") {
         res->status = 405;
         res->body.clear();

@@ -817,3 +817,44 @@ TEST(MpmcQueue, ConcurrentSumConserved) {
     EXPECT_EQ(popped_n.load(), n);
     EXPECT_EQ(popped_sum.load(), n * (n + 1) / 2);
 }
+
+// ---------------- TaskTracer (reference bthread/task_tracer.h) ----------------
+
+#include "tfiber/task_tracer.h"
+
+TEST(TaskTracer, ParkedFiberStackShowsParkSite) {
+    // A fiber parked in fiber_usleep: its dumped stack must contain its
+    // park site (sched_park / usleep frames) and its body function.
+    std::atomic<bool> parked{false};
+    std::atomic<bool> release{false};
+    struct Ctx {
+        std::atomic<bool>* parked;
+        std::atomic<bool>* release;
+    } ctx{&parked, &release};
+    fiber_t tid;
+    fiber_start_background(
+        &tid, nullptr,
+        [](void* arg) -> void* {
+            Ctx* c = (Ctx*)arg;
+            c->parked->store(true);
+            while (!c->release->load()) {
+                fiber_usleep(50 * 1000);
+            }
+            return nullptr;
+        },
+        &ctx);
+    while (!parked.load()) fiber_usleep(1000);
+    fiber_usleep(20 * 1000);  // let it reach the park
+    const std::string dump = DumpFiberStacks();
+    release.store(true);
+    fiber_join(tid, nullptr);
+    EXPECT_NE(dump.find("live fiber"), std::string::npos);
+    EXPECT_NE(dump.find("[suspended]"), std::string::npos);
+    // The park site: the saved RIP points into the suspend machinery
+    // (sched_park is the direct tf_jump_fcontext caller; usleep frames
+    // follow on the fp chain).
+    const bool has_park =
+        dump.find("sched_park") != std::string::npos ||
+        dump.find("usleep") != std::string::npos;
+    EXPECT_TRUE(has_park);
+}

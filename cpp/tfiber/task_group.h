@@ -125,6 +125,16 @@ public:
     bool steal_task(TaskMeta** m, uint64_t* seed, int exclude_index);
     bool pop_remote(TaskMeta** m);
 
+    // Currently-running fibers of this pool (racy snapshot; TaskTracer
+    // diagnostics only).
+    void CollectRunning(std::vector<const TaskMeta*>* out) const {
+        const size_t n = ngroup_.load(std::memory_order_acquire);
+        for (size_t i = 0; i < n; ++i) {
+            const TaskMeta* m = groups_[i]->current();
+            if (m != nullptr) out->push_back(m);
+        }
+    }
+
     ParkingLot& parking_lot() { return parking_lot_; }
     bool stopped() const { return stopped_.load(std::memory_order_acquire); }
     void stop_and_join();

@@ -1,0 +1,117 @@
+#include "tfiber/task_tracer.h"
+
+#include <sys/uio.h>
+#include <unistd.h>
+
+#include <cstdio>
+#include <vector>
+
+#include "tbase/resource_pool.h"
+#include "tbase/symbolize.h"
+#include "tfiber/butex.h"
+#include "tfiber/task_group.h"
+#include "tfiber/task_meta.h"
+
+namespace tpurpc {
+
+namespace {
+
+// Register layout at a saved context SP (cpp/tfiber/context.S):
+constexpr size_t kSavedRbpOff = 0x30;
+constexpr size_t kSavedRipOff = 0x38;
+
+// Fault-safe read of a word from our own address space: a stack being
+// concurrently recycled/unmapped returns false instead of SIGSEGV.
+bool SafeReadWord(uintptr_t addr, uintptr_t* out) {
+    if (addr == 0 || (addr & 7) != 0) return false;
+    iovec local{out, sizeof(*out)};
+    iovec remote{(void*)addr, sizeof(*out)};
+    return process_vm_readv(getpid(), &local, 1, &remote, 1, 0) ==
+           (ssize_t)sizeof(*out);
+}
+
+bool InStack(uintptr_t p, uintptr_t lo, uintptr_t hi) {
+    return p >= lo && p + 16 <= hi;
+}
+
+}  // namespace
+
+std::string DumpFiberStacks(size_t max_frames_per_fiber) {
+    // Fibers on a CPU right now: their saved context is stale garbage.
+    std::vector<const TaskMeta*> running;
+    TaskControl::ForEachPool(
+        [](int, TaskControl* c, void* arg) {
+            c->CollectRunning((std::vector<const TaskMeta*>*)arg);
+        },
+        &running);
+
+    std::string out;
+    char line[256];
+    auto* pool = ResourcePool<TaskMeta>::singleton();
+    const size_t nslots = pool->size();
+    size_t nlive = 0;
+    for (size_t slot = 0; slot < nslots; ++slot) {
+        TaskMeta* m = address_resource<TaskMeta>((ResourceId)slot);
+        if (m == nullptr || m->version_butex == nullptr ||
+            m->tid == INVALID_FIBER) {
+            continue;
+        }
+        // Live = the slot's current version matches the tid's version
+        // (a recycled slot moved past it).
+        const uint32_t tid_version = (uint32_t)(m->tid >> 32);
+        if ((uint32_t)butex_word(m->version_butex)
+                ->load(std::memory_order_acquire) != tid_version) {
+            continue;
+        }
+        ++nlive;
+        bool is_running = false;
+        for (const TaskMeta* r : running) {
+            if (r == m) {
+                is_running = true;
+                break;
+            }
+        }
+        snprintf(line, sizeof(line), "fiber %llu  %s\n",
+                 (unsigned long long)m->tid,
+                 is_running ? "[running]" : "[suspended]");
+        out += line;
+        if (is_running) continue;
+        // Snapshot the racy fields once; bounds-check everything.
+        const uintptr_t lo = (uintptr_t)m->stack.base;
+        const uintptr_t hi = lo + m->stack.size;
+        const uintptr_t ctx = (uintptr_t)m->stack.context;
+        if (!InStack(ctx, lo, hi)) {
+            out += "    <no saved context>\n";
+            continue;
+        }
+        uintptr_t rip = 0, rbp = 0;
+        if (!SafeReadWord(ctx + kSavedRipOff, &rip) ||
+            !SafeReadWord(ctx + kSavedRbpOff, &rbp)) {
+            out += "    <stack read failed>\n";
+            continue;
+        }
+        size_t depth = 0;
+        while (rip != 0 && depth < max_frames_per_fiber) {
+            snprintf(line, sizeof(line), "    #%zu 0x%llx %s\n", depth,
+                     (unsigned long long)rip, SymbolizePc(rip).c_str());
+            out += line;
+            ++depth;
+            // Frame-pointer chain: [rbp]=caller rbp, [rbp+8]=return pc.
+            if (!InStack(rbp, lo, hi)) break;
+            uintptr_t next_rbp = 0, next_rip = 0;
+            if (!SafeReadWord(rbp, &next_rbp) ||
+                !SafeReadWord(rbp + 8, &next_rip)) {
+                break;
+            }
+            // The chain must move UP the stack or it's garbage/looping.
+            if (next_rbp <= rbp && next_rbp != 0) break;
+            rip = next_rip;
+            rbp = next_rbp;
+        }
+        if (depth == 0) out += "    <unwalkable>\n";
+    }
+    snprintf(line, sizeof(line), "%zu live fiber(s)\n", nlive);
+    return line + out;
+}
+
+}  // namespace tpurpc

@@ -1,0 +1,22 @@
+// TaskTracer: live stack dumps of suspended fibers for /fibers?st=1.
+//
+// Reference parity: src/bthread/task_tracer.h:36-108 (signal+libunwind
+// stack capture of live bthreads). This tracer walks the SAVED context
+// of parked fibers instead: every switch-out stores the fiber's SP
+// (context.S documents the register layout at that SP), the build keeps
+// frame pointers (-fno-omit-frame-pointer), and all memory reads go
+// through process_vm_readv so racing resumes/stack recycling can never
+// fault the server — a torn read just ends that fiber's walk early.
+// Fibers currently ON a CPU are reported as running, without frames
+// (their saved context is stale by definition).
+#pragma once
+
+#include <cstddef>
+#include <string>
+
+namespace tpurpc {
+
+// Text dump: one block per live fiber — tid, state, symbolized frames.
+std::string DumpFiberStacks(size_t max_frames_per_fiber = 16);
+
+}  // namespace tpurpc

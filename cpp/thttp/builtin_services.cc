@@ -17,6 +17,7 @@
 #include "thttp/http_protocol.h"
 #include "tfiber/task_group.h"
 #include "tfiber/task_meta.h"
+#include "tfiber/task_tracer.h"
 #include "tnet/socket.h"
 #include "trpc/server.h"
 #include "trpc/span.h"
@@ -128,9 +129,9 @@ void HandleHotspotsContention(Server*, const HttpRequest& req,
     ResetContentionProfile();
 }
 
-// /fibers: live fiber-runtime introspection (reference /bthreads page;
-// full per-fiber stack unwinding — TaskTracer — is roadmap).
-void HandleFibers(Server*, const HttpRequest&, HttpResponse* res) {
+// /fibers: live fiber-runtime introspection; ?st=1 adds per-fiber stack
+// dumps (TaskTracer — reference /bthreads?st=1, bthread/task_tracer.h).
+void HandleFibers(Server*, const HttpRequest& req, HttpResponse* res) {
     res->set_content_type("text/plain");
     TaskControl::ForEachPool(
         [](int tag, TaskControl* c, void* arg) {
@@ -146,6 +147,10 @@ void HandleFibers(Server*, const HttpRequest&, HttpResponse* res) {
     snprintf(line, sizeof(line), "fiber_slots_allocated: %zu\n",
              ResourcePool<TaskMeta>::singleton()->size());
     res->Append(line);
+    if (req.QueryParam("st") == "1") {
+        res->Append("\n");
+        res->Append(DumpFiberStacks());
+    }
 }
 
 void HandleRpcz(Server*, const HttpRequest& req, HttpResponse* res) {

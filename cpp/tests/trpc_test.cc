@@ -994,3 +994,164 @@ TEST(WorkerTags, ServerHandlersRunOnConfiguredPool) {
         EXPECT_EQ(res.message(), "tagged");
     }
 }
+
+// ---------------- pluggable retry/backup + timeout limiter + snappy ----------------
+// Reference: retry_policy.h:28-112, backup_request_policy.h,
+// policy/timeout_concurrency_limiter.*, policy/snappy_compress.cpp.
+
+#include "trpc/compress.h"
+#include "trpc/concurrency_limiter.h"
+#include "trpc/retry_policy.h"
+
+namespace {
+
+class CountingRetryPolicy : public RetryPolicy {
+public:
+    explicit CountingRetryPolicy(bool allow, int64_t backoff_ms = 0)
+        : allow_(allow), backoff_ms_(backoff_ms) {}
+    bool DoRetry(const Controller* cntl) const override {
+        consulted_.fetch_add(1);
+        last_error_ = cntl->ErrorCode();
+        return allow_;
+    }
+    int64_t BackoffMs(const Controller*) const override {
+        return backoff_ms_;
+    }
+    int consulted() const { return consulted_.load(); }
+    int last_error() const { return last_error_; }
+
+private:
+    bool allow_;
+    int64_t backoff_ms_;
+    mutable std::atomic<int> consulted_{0};
+    mutable int last_error_ = 0;
+};
+
+}  // namespace
+
+TEST(RetryPolicy, PolicyDecidesAndSeesTheError) {
+    // Dead port: every try fails with a connection error. A vetoing
+    // policy is consulted ONCE and the RPC fails after the first try.
+    Channel ch;
+    ChannelOptions opts;
+    opts.timeout_ms = 5000;
+    opts.max_retry = 3;
+    CountingRetryPolicy veto(false);
+    opts.retry_policy = &veto;
+    ASSERT_EQ(0, ch.Init("127.0.0.1:1", &opts));  // nothing listens on 1
+    test::EchoService_Stub stub(&ch);
+    Controller cntl;
+    test::EchoRequest req;
+    req.set_message("x");
+    test::EchoResponse res;
+    stub.Echo(&cntl, &req, &res, nullptr);
+    EXPECT_TRUE(cntl.Failed());
+    EXPECT_EQ(veto.consulted(), 1);
+    EXPECT_NE(veto.last_error(), 0);
+}
+
+TEST(RetryPolicy, FixedBackoffDelaysRetries) {
+    Channel ch;
+    ChannelOptions opts;
+    opts.timeout_ms = 10000;
+    opts.max_retry = 2;
+    CountingRetryPolicy backoff(true, 80);
+    opts.retry_policy = &backoff;
+    ASSERT_EQ(0, ch.Init("127.0.0.1:1", &opts));
+    test::EchoService_Stub stub(&ch);
+    Controller cntl;
+    test::EchoRequest req;
+    req.set_message("x");
+    test::EchoResponse res;
+    const int64_t t0 = monotonic_time_us();
+    stub.Echo(&cntl, &req, &res, nullptr);
+    const int64_t elapsed_ms = (monotonic_time_us() - t0) / 1000;
+    EXPECT_TRUE(cntl.Failed());
+    EXPECT_EQ(backoff.consulted(), 3);  // initial + 2 retries, all failed
+    // 2 backoffs of 80ms must be observable (connect failures themselves
+    // are instant on loopback).
+    EXPECT_GE(elapsed_ms, 150);
+}
+
+TEST(BackupPolicy, PolicyProvidesDelayAndCanVeto) {
+    struct VetoBackupPolicy : public BackupRequestPolicy {
+        int64_t GetDelayMs(const Controller*) const override { return 2; }
+        bool DoBackup(const Controller*) const override {
+            vetoed.fetch_add(1);
+            return false;
+        }
+        mutable std::atomic<int> vetoed{0};
+    } policy;
+    TestServer ts;
+    ASSERT_TRUE(ts.start());
+    Channel ch;
+    ChannelOptions opts;
+    opts.timeout_ms = 5000;
+    opts.backup_request_policy = &policy;
+    ASSERT_EQ(0, ch.Init(ts.ep, &opts));
+    test::EchoService_Stub stub(&ch);
+    Controller cntl;
+    test::EchoRequest req;
+    req.set_message("hedge");
+    req.set_sleep_us(20 * 1000);  // slower than the 2ms backup delay
+    test::EchoResponse res;
+    stub.Echo(&cntl, &req, &res, nullptr);
+    ASSERT_FALSE(cntl.Failed());
+    EXPECT_EQ(res.message(), "hedge");
+    // The timer fired and the policy vetoed the hedge: exactly one call
+    // reached the server.
+    EXPECT_GE(policy.vetoed.load(), 1);
+    EXPECT_EQ(ts.service.ncalls.load(), 1);
+}
+
+TEST(TimeoutLimiter, RejectsWhenQueueWaitExceedsBudget) {
+    TimeoutConcurrencyLimiter::Options opt;
+    opt.timeout_ms = 10;
+    opt.min_concurrency = 2;
+    TimeoutConcurrencyLimiter lim(opt);
+    // Teach it ~5ms per request.
+    for (int i = 0; i < 50; ++i) lim.OnResponded(0, 5000);
+    EXPECT_GE(lim.avg_latency_us(), 4000);
+    EXPECT_TRUE(lim.OnRequested(1));   // within min_concurrency
+    EXPECT_TRUE(lim.OnRequested(2));
+    // 3 queued x 5ms > 10ms budget: shed.
+    EXPECT_FALSE(lim.OnRequested(3));
+    // Failures must not poison the estimate.
+    lim.OnResponded(42, 10 * 1000 * 1000);
+    EXPECT_LT(lim.avg_latency_us(), 10000);
+}
+
+TEST(Snappy, RoundtripAndWireEcho) {
+    if (!SnappyAvailable()) {
+        fprintf(stderr, "libsnappy absent; skipping\n");
+        return;
+    }
+    IOBuf in, compressed, out;
+    std::string payload;
+    for (int i = 0; i < 5000; ++i) payload += "snappy wire data ";
+    in.append(payload);
+    ASSERT_TRUE(CompressBody(COMPRESS_SNAPPY, in, &compressed));
+    EXPECT_LT(compressed.size(), in.size());
+    ASSERT_TRUE(DecompressBody(COMPRESS_SNAPPY, compressed, &out));
+    EXPECT_EQ(out.to_string(), payload);
+    // Corrupt stream rejected.
+    IOBuf bad, dummy;
+    bad.append("not snappy at all");
+    EXPECT_FALSE(DecompressBody(COMPRESS_SNAPPY, bad, &dummy));
+
+    // End to end: snappy-compressed request AND response over tpu_std.
+    TestServer ts;
+    ASSERT_TRUE(ts.start());
+    Channel ch;
+    ASSERT_EQ(0, ch.Init(ts.ep, nullptr));
+    test::EchoService_Stub stub(&ch);
+    Controller cntl;
+    cntl.set_request_compress_type(COMPRESS_SNAPPY);
+    cntl.set_response_compress_type(COMPRESS_SNAPPY);
+    test::EchoRequest req;
+    req.set_message(payload);
+    test::EchoResponse res;
+    stub.Echo(&cntl, &req, &res, nullptr);
+    ASSERT_FALSE(cntl.Failed());
+    EXPECT_EQ(res.message(), payload);
+}

@@ -12,6 +12,7 @@
 #include "trpc/lb_with_naming.h"
 #include "trpc/controller.h"
 #include "trpc/pb_compat.h"
+#include "trpc/retry_policy.h"
 #include "trpc/compress.h"
 #include "trpc/policy_tpu_std.h"
 #include "trpc/span.h"
@@ -224,10 +225,13 @@ void Channel::CallMethod(const google::protobuf::MethodDescriptor* method,
     }
     // Backup request timer (reference controller.cpp:344-358): fires
     // before the deadline, re-issues on a second call id, first response
-    // wins. Requires retry budget (a backup consumes one retry).
-    const int64_t backup_ms = cntl->backup_request_ms_ >= 0
-                                  ? cntl->backup_request_ms_
-                                  : options_.backup_request_ms;
+    // wins. Requires retry budget (a backup consumes one retry). A
+    // pluggable policy (retry_policy.h) decides the delay per call.
+    const int64_t backup_ms =
+        options_.backup_request_policy != nullptr
+            ? options_.backup_request_policy->GetDelayMs(cntl)
+            : (cntl->backup_request_ms_ >= 0 ? cntl->backup_request_ms_
+                                             : options_.backup_request_ms);
     if (backup_ms >= 0 && (timeout_ms <= 0 || backup_ms < timeout_ms)) {
         cntl->backup_timer_ = TimerThread::singleton()->schedule(
             &Controller::HandleBackupThunk, (void*)(uintptr_t)cid,

@@ -50,6 +50,11 @@ struct ChannelOptions {
     // channel. tpu_std: first message of each connection (auth fight);
     // grpc: `authorization` header per request.
     const class Authenticator* auth = nullptr;
+    // Retry/backup pluggability (trpc/retry_policy.h; not owned). Null =
+    // the default policy (connection errors retry immediately) / the
+    // fixed backup_request_ms above.
+    const class RetryPolicy* retry_policy = nullptr;
+    const class BackupRequestPolicy* backup_request_policy = nullptr;
 };
 
 class Channel : public google::protobuf::RpcChannel {

@@ -1,5 +1,6 @@
 #include "trpc/compress.h"
 
+#include <dlfcn.h>
 #include <zlib.h>
 
 #include <cstring>
@@ -95,6 +96,85 @@ uint32_t crc32c_iobuf(uint32_t crc, const IOBuf& buf) {
     return crc;
 }
 
+// ---- snappy via dlopen (reference policy/snappy_compress.cpp) ----
+// The image ships libsnappy.so.1 but not its headers; the snappy-c ABI
+// (4 functions, plain C) is declared here and resolved at runtime. When
+// the library is absent, snappy compression fails cleanly.
+
+struct SnappyApi {
+    // snappy_status: 0 ok, 1 invalid input, 2 buffer too small.
+    int (*compress)(const char* input, size_t input_len, char* out,
+                    size_t* out_len);
+    int (*uncompress)(const char* in, size_t in_len, char* out,
+                      size_t* out_len);
+    size_t (*max_compressed_length)(size_t source_len);
+    int (*uncompressed_length)(const char* in, size_t in_len,
+                               size_t* result);
+};
+
+const SnappyApi* snappy_api() {
+    static const SnappyApi* api = []() -> const SnappyApi* {
+        void* h = dlopen("libsnappy.so.1", RTLD_NOW);
+        if (h == nullptr) h = dlopen("libsnappy.so", RTLD_NOW);
+        if (h == nullptr) return nullptr;
+        auto* a = new SnappyApi;
+        a->compress = (decltype(a->compress))dlsym(h, "snappy_compress");
+        a->uncompress =
+            (decltype(a->uncompress))dlsym(h, "snappy_uncompress");
+        a->max_compressed_length = (decltype(a->max_compressed_length))dlsym(
+            h, "snappy_max_compressed_length");
+        a->uncompressed_length = (decltype(a->uncompressed_length))dlsym(
+            h, "snappy_uncompressed_length");
+        if (a->compress == nullptr || a->uncompress == nullptr ||
+            a->max_compressed_length == nullptr ||
+            a->uncompressed_length == nullptr) {
+            dlclose(h);
+            delete a;
+            return nullptr;
+        }
+        return a;
+    }();
+    return api;
+}
+
+// snappy-c wants contiguous buffers (no streaming interface): flatten.
+bool SnappyCompress(const IOBuf& in, IOBuf* out) {
+    const SnappyApi* a = snappy_api();
+    if (a == nullptr) {
+        LOG(ERROR) << "snappy requested but libsnappy is not available";
+        return false;
+    }
+    const std::string flat = in.to_string();
+    std::string buf;
+    size_t out_len = a->max_compressed_length(flat.size());
+    buf.resize(out_len);
+    if (a->compress(flat.data(), flat.size(), &buf[0], &out_len) != 0) {
+        return false;
+    }
+    out->append(buf.data(), out_len);
+    return true;
+}
+
+bool SnappyDecompress(const IOBuf& in, IOBuf* out) {
+    const SnappyApi* a = snappy_api();
+    if (a == nullptr) return false;
+    const std::string flat = in.to_string();
+    size_t out_len = 0;
+    if (a->uncompressed_length(flat.data(), flat.size(), &out_len) != 0 ||
+        out_len > kMaxDecompressed) {
+        return false;  // corrupt or bomb
+    }
+    std::string buf;
+    buf.resize(out_len);
+    if (a->uncompress(flat.data(), flat.size(), &buf[0], &out_len) != 0) {
+        return false;
+    }
+    out->append(buf.data(), out_len);
+    return true;
+}
+
+bool SnappyAvailable() { return snappy_api() != nullptr; }
+
 bool CompressBody(int compress_type, const IOBuf& in, IOBuf* out) {
     switch (compress_type) {
         case COMPRESS_NONE:
@@ -102,6 +182,8 @@ bool CompressBody(int compress_type, const IOBuf& in, IOBuf* out) {
             return true;
         case COMPRESS_GZIP:
             return GzipCompress(in, out);
+        case COMPRESS_SNAPPY:
+            return SnappyCompress(in, out);
         default:
             LOG(ERROR) << "unknown compress_type " << compress_type;
             return false;
@@ -115,6 +197,8 @@ bool DecompressBody(int compress_type, const IOBuf& in, IOBuf* out) {
             return true;
         case COMPRESS_GZIP:
             return GzipDecompress(in, out);
+        case COMPRESS_SNAPPY:
+            return SnappyDecompress(in, out);
         default:
             LOG(ERROR) << "unknown compress_type " << compress_type;
             return false;

@@ -14,7 +14,13 @@ namespace tpurpc {
 enum CompressType {
     COMPRESS_NONE = 0,
     COMPRESS_GZIP = 1,
+    // snappy via the runtime library (dlopen'd; reference
+    // policy/snappy_compress.cpp). Check SnappyAvailable() on images
+    // without libsnappy.
+    COMPRESS_SNAPPY = 2,
 };
+
+bool SnappyAvailable();
 
 // Compress/decompress `in` into `*out` (appended). Return false on error
 // (corrupt input, unknown type). Decompressed size is capped to guard

@@ -46,6 +46,55 @@ private:
     std::atomic<int64_t> max_;
 };
 
+// "timeout": admit only requests that can plausibly finish within the
+// budget — with `current` requests ahead and an EMA of the per-request
+// latency, a newcomer whose queue wait alone would exceed `timeout_ms`
+// is rejected now instead of timing out later (reference
+// policy/timeout_concurrency_limiter.{h,cpp}).
+class TimeoutConcurrencyLimiter : public ConcurrencyLimiter {
+public:
+    struct Options {
+        int64_t timeout_ms = 100;    // the latency budget to protect
+        int64_t min_concurrency = 2;  // always admit up to this many
+        double alpha = 0.25;          // latency EMA smoothing
+    };
+
+    TimeoutConcurrencyLimiter() : TimeoutConcurrencyLimiter(Options()) {}
+    explicit TimeoutConcurrencyLimiter(const Options& opt) : opt_(opt) {}
+
+    bool OnRequested(int64_t current) override {
+        if (current <= opt_.min_concurrency) return true;
+        const int64_t avg = avg_latency_us_.load(std::memory_order_relaxed);
+        if (avg <= 0) return true;  // no estimate yet
+        return current * avg <= opt_.timeout_ms * 1000;
+    }
+
+    void OnResponded(int error_code, int64_t latency_us) override {
+        if (error_code != 0) return;  // failures don't teach latency
+        int64_t cur = avg_latency_us_.load(std::memory_order_relaxed);
+        const int64_t next =
+            cur <= 0 ? latency_us
+                     : (int64_t)(cur * (1 - opt_.alpha) +
+                                 latency_us * opt_.alpha);
+        avg_latency_us_.store(next, std::memory_order_relaxed);
+    }
+
+    int64_t MaxConcurrency() const override {
+        const int64_t avg = avg_latency_us_.load(std::memory_order_relaxed);
+        if (avg <= 0) return 0;  // unlimited until measured
+        return std::max(opt_.min_concurrency,
+                        opt_.timeout_ms * 1000 / avg);
+    }
+
+    int64_t avg_latency_us() const {
+        return avg_latency_us_.load(std::memory_order_relaxed);
+    }
+
+private:
+    const Options opt_;
+    std::atomic<int64_t> avg_latency_us_{0};
+};
+
 // "auto": the gradient limiter.
 class AutoConcurrencyLimiter : public ConcurrencyLimiter {
 public:

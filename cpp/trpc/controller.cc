@@ -16,6 +16,7 @@
 #include "trpc/channel.h"
 #include "trpc/lb_with_naming.h"
 #include "trpc/pb_compat.h"
+#include "trpc/retry_policy.h"
 #include "trpc/policy_tpu_std.h"
 #include "tbase/crc32c.h"
 #include "trpc/compress.h"
@@ -130,6 +131,15 @@ static bool is_retryable(int error) {
     }
 }
 
+bool DefaultRetryPolicy::DoRetry(const Controller* cntl) const {
+    return is_retryable(cntl->ErrorCode());
+}
+
+const DefaultRetryPolicy* DefaultRetryPolicy::instance() {
+    static const DefaultRetryPolicy p;
+    return &p;
+}
+
 int Controller::HandleError(CallId id, int error) {
     // Runs with the id locked.
     if (id != current_cid_ && id == unfinished_cid_ && is_retryable(error)) {
@@ -167,19 +177,52 @@ int Controller::HandleError(CallId id, int error) {
         max_retry_ >= 0 ? max_retry_
                         : (channel_ ? channel_->options().max_retry : 0);
     FeedbackToLB(error);  // per-try completion (the retry is a new pick)
-    if (is_retryable(error) && current_try_ < effective_max_retry &&
+    // Pluggable retry decision (reference retry_policy.h:28-68): the
+    // policy inspects the failed try's error on the controller.
+    const RetryPolicy* rp =
+        channel_ != nullptr && channel_->options().retry_policy != nullptr
+            ? channel_->options().retry_policy
+            : DefaultRetryPolicy::instance();
+    SetFailed(error, "%s", terror(error));
+    if (rp->DoRetry(this) && current_try_ < effective_max_retry &&
         (deadline_us_ == 0 || monotonic_time_us() < deadline_us_)) {
-        ++current_try_;
         const CallId next = id_next_version(current_cid_);
         if (next != INVALID_CALL_ID) {
+            ++current_try_;
             current_cid_ = next;
-            IssueRPC();
+            const int64_t backoff_ms = rp->BackoffMs(this);
+            error_code_ = 0;  // a later try owns the final verdict
+            error_text_.clear();
+            if (backoff_ms > 0 &&
+                (deadline_us_ == 0 ||
+                 monotonic_time_us() + backoff_ms * 1000 < deadline_us_)) {
+                // Issue after the backoff; the timer holds only the NEW
+                // cid value (stale-safe, like every other timer here).
+                TimerThread::singleton()->schedule(
+                    &Controller::HandleBackoffThunk,
+                    (void*)(uintptr_t)current_cid_,
+                    monotonic_time_us() + backoff_ms * 1000);
+            } else {
+                IssueRPC();
+            }
             return id_unlock(id);
         }
     }
-    SetFailed(error, "%s", terror(error));
     EndRPC(id);
     return 0;
+}
+
+// Backoff expiry: re-issue the already-bumped try (the id value alone is
+// carried; a completed/canceled RPC makes the lock fail harmlessly).
+void Controller::HandleBackoffThunk(void* arg) {
+    const CallId cid = (CallId)(uintptr_t)arg;
+    void* data = nullptr;
+    if (id_lock_range(cid, &data) != 0) return;
+    auto* cntl = (Controller*)data;
+    if (cid == cntl->current_cid_) {
+        cntl->IssueRPC();
+    }
+    id_unlock(cid);
 }
 
 void Controller::FeedbackToLB(int error) {
@@ -439,6 +482,11 @@ void Controller::MaybeIssueBackup() {
     // Runs with the id locked.
     if (Failed() || canceled_ || unfinished_cid_ != INVALID_CALL_ID) {
         return;  // already failed / already one backup out
+    }
+    if (channel_ != nullptr &&
+        channel_->options().backup_request_policy != nullptr &&
+        !channel_->options().backup_request_policy->DoBackup(this)) {
+        return;  // the policy vetoed hedging this call
     }
     const int effective_max_retry =
         max_retry_ >= 0 ? max_retry_

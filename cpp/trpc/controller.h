@@ -151,6 +151,7 @@ private:
     // while the original stays live — first response wins.
     static void HandleBackupThunk(void* arg);  // arg = base CallId value
     void MaybeIssueBackup();                   // runs with the id locked
+    static void HandleBackoffThunk(void* arg);  // arg = retry's CallId
     // Report the finished try to the LB (latency + error feed the
     // locality-aware policy; reference Call::OnComplete controller.cpp:780).
     void FeedbackToLB(int error);

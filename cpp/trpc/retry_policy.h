@@ -1,0 +1,76 @@
+// Pluggable retry + backup-request policies.
+//
+// Reference parity: src/brpc/retry_policy.h:28-112 (RetryPolicy::DoRetry
+// + RpcRetryPolicyWithFixedBackoff/JitteredBackoff) and
+// src/brpc/backup_request_policy.h. The default behavior (connection-
+// level errors retry immediately, no backoff) is DefaultRetryPolicy;
+// channels override via ChannelOptions::retry_policy /
+// backup_request_policy (not owned, must outlive the channel).
+#pragma once
+
+#include <cstdint>
+
+#include "tbase/fast_rand.h"
+
+namespace tpurpc {
+
+class Controller;
+
+class RetryPolicy {
+public:
+    virtual ~RetryPolicy() = default;
+    // Called with the failed try's error set on `cntl` (ErrorCode()/
+    // ErrorText()); true = retry (budget and deadline permitting).
+    virtual bool DoRetry(const Controller* cntl) const = 0;
+    // Delay before the retry is issued; 0 = immediate. Skipped when the
+    // backoff would cross the RPC deadline (the retry then goes out
+    // immediately, matching the reference's DoRetryWithBackoff guard).
+    virtual int64_t BackoffMs(const Controller* cntl) const { return 0; }
+};
+
+// The framework default: connection-level failures retry, server-side
+// errors / timeouts don't (reference DefaultRetryPolicy).
+class DefaultRetryPolicy : public RetryPolicy {
+public:
+    bool DoRetry(const Controller* cntl) const override;
+    static const DefaultRetryPolicy* instance();
+};
+
+class RetryPolicyWithFixedBackoff : public DefaultRetryPolicy {
+public:
+    explicit RetryPolicyWithFixedBackoff(int64_t backoff_ms)
+        : backoff_ms_(backoff_ms) {}
+    int64_t BackoffMs(const Controller*) const override {
+        return backoff_ms_;
+    }
+
+private:
+    int64_t backoff_ms_;
+};
+
+class RetryPolicyWithJitteredBackoff : public DefaultRetryPolicy {
+public:
+    RetryPolicyWithJitteredBackoff(int64_t min_ms, int64_t max_ms)
+        : min_ms_(min_ms), max_ms_(max_ms < min_ms ? min_ms : max_ms) {}
+    int64_t BackoffMs(const Controller*) const override {
+        return min_ms_ + (int64_t)(fast_rand() %
+                                   (uint64_t)(max_ms_ - min_ms_ + 1));
+    }
+
+private:
+    int64_t min_ms_;
+    int64_t max_ms_;
+};
+
+// Backup requests: when and whether to hedge (reference
+// backup_request_policy.h). GetDelayMs < 0 disables for this call.
+class BackupRequestPolicy {
+public:
+    virtual ~BackupRequestPolicy() = default;
+    virtual int64_t GetDelayMs(const Controller* cntl) const = 0;
+    // Consulted when the timer fires; false skips the backup (e.g. load
+    // shedding).
+    virtual bool DoBackup(const Controller* cntl) const { return true; }
+};
+
+}  // namespace tpurpc

@@ -97,7 +97,10 @@ int Server::StartNoListen(const ServerOptions* options) {
         return -1;
     }
     for (auto& kv : methods_) {
-        if (options_.auto_concurrency) {
+        if (options_.timeout_concurrency) {
+            kv.second.status->limiter.reset(
+                new TimeoutConcurrencyLimiter(options_.timeout_cl_options));
+        } else if (options_.auto_concurrency) {
             kv.second.status->limiter.reset(
                 new AutoConcurrencyLimiter(options_.auto_cl_options));
         } else if (options_.max_concurrency > 0) {

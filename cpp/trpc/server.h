@@ -60,6 +60,11 @@ struct ServerOptions {
     bool auto_concurrency = false;
     // Tuning for the auto limiter (tests tighten the windows).
     AutoConcurrencyLimiter::Options auto_cl_options;
+    // "timeout" limiter (reference policy/timeout_concurrency_limiter):
+    // reject requests whose queue wait alone would blow the latency
+    // budget. Takes precedence over auto/constant when set.
+    bool timeout_concurrency = false;
+    TimeoutConcurrencyLimiter::Options timeout_cl_options;
     // Run user service methods inline on the per-message fiber instead of
     // a fresh one. Default OFF: inline user code head-of-line-blocks the
     // connection's input fiber, defeating backup requests and pipelining

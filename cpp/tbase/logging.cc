@@ -1,5 +1,7 @@
 #include "tbase/logging.h"
 
+#include "tbase/time.h"
+
 #include <unistd.h>
 
 #include <atomic>
@@ -68,4 +70,19 @@ LogMessage::~LogMessage() {
     if (severity_ >= LOG_FATAL) abort();
 }
 
+}  // namespace tpurpc
+
+namespace tpurpc {
+namespace logging_internal {
+
+bool PassEverySecond(std::atomic<int64_t>* last_us) {
+    const int64_t now = monotonic_time_us();
+    int64_t prev = last_us->load(std::memory_order_relaxed);
+    if (now - prev < 1000 * 1000) return false;
+    // One winner per second; losers stay suppressed.
+    return last_us->compare_exchange_strong(prev, now,
+                                            std::memory_order_relaxed);
+}
+
+}  // namespace logging_internal
 }  // namespace tpurpc

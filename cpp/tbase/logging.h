@@ -8,6 +8,7 @@
 // portal can capture recent logs later.
 #pragma once
 
+#include <atomic>
 #include <cstdint>
 #include <cstdlib>
 #include <functional>
@@ -53,6 +54,11 @@ public:
     void operator&(std::ostream&) {}
 };
 
+namespace logging_internal {
+// True at most once per second per call site (stamp = last pass, us).
+bool PassEverySecond(std::atomic<int64_t>* last_us);
+}  // namespace logging_internal
+
 }  // namespace tpurpc
 
 #define TPURPC_LOG_STREAM(severity)                                       \
@@ -66,6 +72,30 @@ public:
 
 #define LOG_IF(severity, cond) \
     !(cond) ? (void)0 : ::tpurpc::LogMessageVoidify() & TPURPC_LOG_STREAM(severity)
+
+// Rate-limited variants (reference butil/logging.h LOG_EVERY_N /
+// LOG_EVERY_SECOND): error storms on hot paths must not become a
+// throughput hazard of their own. Each occurrence site gets its own
+// static counter/stamp; the check is one relaxed atomic op when
+// suppressed.
+#define LOG_EVERY_N(severity, n)                                          \
+    static ::std::atomic<uint64_t> TPURPC_CAT_(tpurpc_logn_, __LINE__){0}; \
+    (TPURPC_CAT_(tpurpc_logn_, __LINE__).fetch_add(                        \
+         1, ::std::memory_order_relaxed) %                                 \
+         (uint64_t)(n) !=                                                  \
+     0)                                                                    \
+        ? (void)0                                                          \
+        : ::tpurpc::LogMessageVoidify() & TPURPC_LOG_STREAM(severity)
+
+#define LOG_EVERY_SECOND(severity)                                         \
+    static ::std::atomic<int64_t> TPURPC_CAT_(tpurpc_logs_, __LINE__){0};  \
+    !::tpurpc::logging_internal::PassEverySecond(                          \
+        &TPURPC_CAT_(tpurpc_logs_, __LINE__))                              \
+        ? (void)0                                                          \
+        : ::tpurpc::LogMessageVoidify() & TPURPC_LOG_STREAM(severity)
+
+#define TPURPC_CAT2_(a, b) a##b
+#define TPURPC_CAT_(a, b) TPURPC_CAT2_(a, b)
 
 #define CHECK(cond)                                                         \
     (cond) ? (void)0                                                        \

@@ -413,3 +413,24 @@ TEST(ResourcePool, TlsChunksNoDoubleOwnership) {
     for (auto& t : threads) t.join();
     EXPECT_EQ(violations.load(), 0);
 }
+
+TEST(Logging, RateLimitedMacros) {
+    // Compile + semantics: LOG_EVERY_N passes on iterations 0, n, 2n...
+    // and LOG_EVERY_SECOND at most once per second (asserted via the
+    // sink capture).
+    std::atomic<int> captured{0};
+    SetLogSink([&](int, const char*, int, const std::string&) {
+        captured.fetch_add(1);
+        return true;  // suppress stderr
+    });
+    for (int i = 0; i < 10; ++i) {
+        LOG_EVERY_N(ERROR, 5) << "every-5 " << i;
+    }
+    EXPECT_EQ(captured.load(), 2);  // i=0 and i=5
+    captured.store(0);
+    for (int i = 0; i < 100; ++i) {
+        LOG_EVERY_SECOND(ERROR) << "every-second " << i;
+    }
+    EXPECT_EQ(captured.load(), 1);
+    SetLogSink(nullptr);
+}

@@ -1155,3 +1155,106 @@ TEST(Snappy, RoundtripAndWireEcho) {
     ASSERT_FALSE(cntl.Failed());
     EXPECT_EQ(res.message(), payload);
 }
+
+// ---------------- usercode backup pool ----------------
+// Reference details/usercode_backup_pool.h:46-77: pthread-BLOCKING user
+// handlers beyond the threshold run on an isolated pool so they cannot
+// occupy every default worker and starve the IO fibers.
+
+DECLARE_int32(usercode_backup_threshold);
+
+namespace {
+class BlockingEchoImpl : public test::EchoService {
+public:
+    void Echo(google::protobuf::RpcController*,
+              const test::EchoRequest* request, test::EchoResponse* response,
+              google::protobuf::Closure* done) override {
+        if (request->sleep_us() > 0) {
+            // BLOCKS the worker pthread (not a fiber park) — the hazard
+            // the backup pool exists for.
+            ::usleep((useconds_t)request->sleep_us());
+        }
+        TaskGroup* g = TaskGroup::tls_group();
+        const bool on_default =
+            g != nullptr && g->control() == TaskControl::singleton();
+        response->set_message(request->message() +
+                              (on_default ? "@default" : "@backup"));
+        done->Run();
+    }
+};
+}  // namespace
+
+TEST(UsercodeBackupPool, BlockingHandlersDontStarveTheIoPath) {
+    // With MORE pthread-blocking handlers in flight than default
+    // workers, the overflow must move to the isolated backup pool so
+    // the default pool's IO fibers (parsing, portal, responses) stay
+    // live. Without the isolation every default worker would be stuck
+    // in ::usleep and even /health would stall for the handler time.
+    const int32_t old_threshold = FLAGS_usercode_backup_threshold.get();
+    FLAGS_usercode_backup_threshold.set(2);
+    BlockingEchoImpl service;
+    Server server;
+    ASSERT_EQ(0, server.AddService(&service));
+    EndPoint listen;
+    str2endpoint("127.0.0.1:0", &listen);
+    ASSERT_EQ(0, server.Start(listen, nullptr));
+    EndPoint ep;
+    str2endpoint("127.0.0.1", server.listened_port(), &ep);
+    Channel ch;
+    ChannelOptions copts;
+    copts.timeout_ms = 10000;
+    ASSERT_EQ(0, ch.Init(ep, &copts));
+
+    // Saturate: more pthread-blocking calls than default workers.
+    const int nblockers = fiber_get_worker_count() + 4;
+    struct Ctx {
+        Channel* ch;
+        std::atomic<int> ok{0};
+        std::atomic<int> on_backup{0};
+    } ctx{&ch, {}, {}};
+    std::vector<fiber_t> tids((size_t)nblockers);
+    for (auto& tid : tids) {
+        fiber_start_background(
+            &tid, nullptr,
+            [](void* arg) -> void* {
+                Ctx* c = (Ctx*)arg;
+                test::EchoService_Stub stub(c->ch);
+                Controller cntl;
+                test::EchoRequest req;
+                req.set_message("blocker");
+                req.set_sleep_us(400 * 1000);
+                test::EchoResponse res;
+                stub.Echo(&cntl, &req, &res, nullptr);
+                if (!cntl.Failed()) {
+                    c->ok.fetch_add(1);
+                    if (res.message().find("@backup") != std::string::npos) {
+                        c->on_backup.fetch_add(1);
+                    }
+                }
+                return nullptr;
+            },
+            &ctx);
+    }
+    fiber_usleep(80 * 1000);  // let the blockers occupy their workers
+    // The IO path must still answer promptly: /health runs inline on a
+    // default-pool input fiber (no usercode spawn).
+    const int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+    sockaddr_in addr;
+    endpoint2sockaddr(ep, &addr);
+    ASSERT_EQ(0, ::connect(fd, (sockaddr*)&addr, sizeof(addr)));
+    const char hreq[] = "GET /health HTTP/1.1\r\nHost: x\r\n\r\n";
+    const int64_t t0 = monotonic_time_us();
+    (void)!::send(fd, hreq, sizeof(hreq) - 1, 0);
+    char buf[512];
+    const ssize_t r = ::recv(fd, buf, sizeof(buf), 0);
+    const int64_t health_ms = (monotonic_time_us() - t0) / 1000;
+    ::close(fd);
+    ASSERT_GT(r, 0);
+    EXPECT_NE(std::string(buf, (size_t)r).find("200"), std::string::npos);
+    EXPECT_LT(health_ms, 200);  // all-workers-blocked would wait ~400ms
+    for (auto tid : tids) fiber_join(tid, nullptr);
+    EXPECT_EQ(ctx.ok.load(), nblockers);
+    // The overflow really went to the isolated pool.
+    EXPECT_GE(ctx.on_backup.load(), nblockers - 2);
+    FLAGS_usercode_backup_threshold.set(old_threshold);
+}

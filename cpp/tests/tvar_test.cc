@@ -222,3 +222,60 @@ TEST(Sampler, SlowSamplerDoesNotBlockRegistry) {
     remover.join();
     EXPECT_TRUE(removed.load());
 }
+
+// ---------------- percentile accuracy + collector gate ----------------
+// VERDICT depth: quantile error bounds for the log-histogram, and the
+// Collector's global sampling rate gate.
+
+#include "tvar/collector.h"
+
+TEST(Percentile, QuantileAccuracyBounds) {
+    // Uniform 1..100000us through a LatencyRecorder: the log-histogram's
+    // bucket resolution bounds relative error; assert every headline
+    // quantile lands within 15% of the true value.
+    LatencyRecorder lat;
+    for (int i = 1; i <= 100000; ++i) lat << i;
+    struct Case {
+        double q;
+        int64_t truth;
+    } cases[] = {{0.5, 50000}, {0.9, 90000}, {0.99, 99000},
+                 {0.999, 99900}};
+    for (const Case& c : cases) {
+        const int64_t got = lat.latency_percentile(c.q);
+        const double rel =
+            (double)(got > c.truth ? got - c.truth : c.truth - got) /
+            (double)c.truth;
+        EXPECT_LT(rel, 0.15);
+    }
+    // Monotone: higher quantiles never report lower values.
+    EXPECT_LE(lat.latency_percentile(0.5), lat.latency_percentile(0.9));
+    EXPECT_LE(lat.latency_percentile(0.9), lat.latency_percentile(0.99));
+    EXPECT_LE(lat.latency_percentile(0.99),
+              lat.latency_percentile(0.999));
+}
+
+TEST(Collector, RateGateCapsSamples) {
+    // Hammer the gate: within one second it must admit at most
+    // max_samples_per_second (+ a small burst slack), however many
+    // threads ask.
+    auto* c = Collector::singleton();
+    const int64_t cap = c->max_samples_per_second();
+    ASSERT_GT(cap, 0);
+    std::atomic<int64_t> admitted{0};
+    std::vector<std::thread> threads;
+    std::atomic<bool> stop{false};
+    for (int t = 0; t < 3; ++t) {
+        threads.emplace_back([&] {
+            while (!stop.load()) {
+                if (c->sample()) admitted.fetch_add(1);
+            }
+        });
+    }
+    std::this_thread::sleep_for(std::chrono::milliseconds(500));
+    stop.store(true);
+    for (auto& t : threads) t.join();
+    // Half a second of hammering: no more than ~one second's budget
+    // (generous slack for window boundaries).
+    EXPECT_LE(admitted.load(), cap + cap / 2);
+    EXPECT_GT(admitted.load(), 0);
+}

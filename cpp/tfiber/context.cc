@@ -21,6 +21,7 @@ __attribute__((no_sanitize_address))
 #endif
 fcontext_t
 tf_make_fcontext(void* stack_base, size_t size, void (*fn)(void*)) {
+#if defined(__x86_64__)
     // Stack grows down. Align the top to 16 bytes.
     uintptr_t top = ((uintptr_t)stack_base + size) & ~(uintptr_t)15;
     // Reserve the saved-register frame (0x40 bytes, layout in context.S)
@@ -42,6 +43,21 @@ tf_make_fcontext(void* stack_base, size_t size, void (*fn)(void*)) {
     slots[7] = (uint64_t)(void*)fn;  // rip
     slots[8] = (uint64_t)(void*)fiber_entry_returned;
     return (fcontext_t)sp;
+#elif defined(__aarch64__)
+    // Layout in context_aarch64.S: 0xa0-byte frame, x30 (resume pc) at
+    // +0x98. The entry fn receives the jump's arg in x0 and must never
+    // return (x29=0 terminates unwinds; a stray ret jumps to 0 and
+    // faults loudly rather than corrupting).
+    (void)fiber_entry_returned;
+    uintptr_t top = ((uintptr_t)stack_base + size) & ~(uintptr_t)15;
+    uintptr_t sp = top - 0xa0;
+    uint64_t* slots = (uint64_t*)sp;
+    for (int i = 0; i < 0xa0 / 8; ++i) slots[i] = 0;
+    slots[0x98 / 8] = (uint64_t)(void*)fn;  // x30: first jump enters fn
+    return (fcontext_t)sp;
+#else
+#error "unsupported architecture: add a context_<arch>.S variant"
+#endif
 }
 
 }  // namespace tpurpc

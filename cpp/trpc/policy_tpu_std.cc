@@ -30,6 +30,12 @@
 
 DECLARE_bool(rpc_checksum);
 
+// Reference details/usercode_backup_pool.h: above this many in-flight
+// user handlers, new ones run on an isolated worker pool (tag 63) so
+// pthread-blocking user code cannot starve the IO path. <=0 disables.
+DEFINE_int32(usercode_backup_threshold, 512,
+             "in-flight user handlers before overflow is isolated");
+
 namespace tpurpc {
 
 namespace {
@@ -186,16 +192,32 @@ struct UserCallArgs {
     google::protobuf::Message* req;
     google::protobuf::Message* res;
     google::protobuf::Closure* done;
+    bool counted_default = false;  // holds a default-pool inflight count
 };
+
+// Usercode overload isolation (reference details/usercode_backup_pool.h
+// TooManyUserCode): when too many user handlers occupy the DEFAULT pool
+// — the hazard being handlers that BLOCK their worker pthread — the
+// excess is routed to a reserved isolated tag pool so blocked user code
+// can never consume every default worker and starve the IO fibers under
+// it. Only default-pool residents are counted: once they drain below
+// the threshold, new handlers use the default pool's free workers again
+// instead of queueing behind the isolated backlog.
+std::atomic<int64_t> g_usercode_default_inflight{0};
+constexpr int kUsercodeBackupTag = 63;  // reserved for the backup pool
 
 void* RunUserCall(void* arg) {
     auto* a = (UserCallArgs*)arg;
     if (a->cntl->span_ != nullptr) {
         a->cntl->span_->process_start_us = monotonic_time_us();
     }
+    const bool counted = a->counted_default;
     a->mp->service->CallMethod(a->mp->method, a->cntl, a->req, a->res,
                                a->done);
     delete a;
+    if (counted) {
+        g_usercode_default_inflight.fetch_sub(1, std::memory_order_relaxed);
+    }
     return nullptr;
 }
 
@@ -369,12 +391,30 @@ void ProcessTpuStdRequest(TpuStdMessage* msg, const rpc::RpcMeta& meta) {
     fiber_t tid;
     FiberAttr attr = FIBER_ATTR_NORMAL;
     attr.tag = server->options().fiber_tag;
+    const int32_t backup_at = FLAGS_usercode_backup_threshold.get();
+    if (attr.tag == 0 && backup_at > 0) {
+        const int64_t inflight = g_usercode_default_inflight.fetch_add(
+                                     1, std::memory_order_relaxed) +
+                                 1;
+        if (inflight > backup_at) {
+            g_usercode_default_inflight.fetch_sub(
+                1, std::memory_order_relaxed);
+            attr.tag = kUsercodeBackupTag;  // overflow: isolated pool
+        } else {
+            uc->counted_default = true;
+        }
+    }
     // Urgent: the handler takes this worker NOW and the input fiber is
     // requeued (it has at most a read-EAGAIN left in a single-request
     // burst) — shaving a queue round-trip off dispatch latency, like the
     // reference's run-bthread-immediately ProcessEvent/usercode spawns.
     if (fiber_start_urgent(&tid, &attr, RunUserCall, uc) != 0) {
+        const bool counted = uc->counted_default;
         delete uc;  // fall back inline (fiber system saturated/shut down)
+        if (counted) {
+            g_usercode_default_inflight.fetch_sub(
+                1, std::memory_order_relaxed);
+        }
         mp->service->CallMethod(mp->method, cntl, req, res, done);
     }
 }

@@ -229,7 +229,10 @@ void SerializeHttpResponse(HttpResponse* res, IOBuf* out) {
              res->reason.empty() ? HttpReasonPhrase(res->status)
                                  : res->reason.c_str());
     out->append(line);
-    if (res->headers.find("Content-Length") == res->headers.end()) {
+    if (res->headers.find("Content-Length") == res->headers.end() &&
+        res->headers.find("Transfer-Encoding") == res->headers.end()) {
+        // Content-Length alongside Transfer-Encoding is illegal (RFC
+        // 9112 §6.2); chunked responses carry their own framing.
         snprintf(line, sizeof(line), "Content-Length: %zu\r\n",
                  res->body.size());
         out->append(line);

@@ -16,6 +16,9 @@
 #include <map>
 #include <string>
 
+#include <functional>
+#include <memory>
+
 #include "tbase/iobuf.h"
 
 namespace tpurpc {
@@ -46,11 +49,19 @@ struct HttpRequest {
                            bool* found = nullptr) const;
 };
 
+class ProgressiveAttachment;
+
 struct HttpResponse {
     int status = 200;
     std::string reason;  // "" = canonical for status
     std::map<std::string, std::string, CaseLess> headers;
     IOBuf body;
+    // Progressive body (thttp/progressive_attachment.h): when a handler
+    // sets this, the framework sends the header block with
+    // Transfer-Encoding: chunked, invokes the callback with the writer,
+    // and skips `body` — chunks flow until ProgressiveAttachment::Close.
+    std::function<void(std::shared_ptr<ProgressiveAttachment>)>
+        start_progressive;
 
     void SetHeader(const std::string& k, const std::string& v) {
         headers[k] = v;

@@ -6,6 +6,7 @@
 #include "tbase/time.h"
 #include "tbase/logging.h"
 #include "thttp/http_message.h"
+#include "thttp/progressive_attachment.h"
 #include "tnet/input_messenger.h"
 #include "tnet/protocol.h"
 #include "tnet/socket.h"
@@ -186,6 +187,21 @@ void ProcessHttp(InputMessageBase* msg_base) {
         }
     }
     if (close_conn) res.SetHeader("Connection", "close");
+    // Progressive body (thttp/progressive_attachment.h): chunked header
+    // block now; the handler's callback owns the writer from here and
+    // streams until Close. Requires a chunked-capable peer.
+    if (res.start_progressive && msg->req.version_minor >= 1 &&
+        msg->req.method != "HEAD") {
+        res.SetHeader("Transfer-Encoding", "chunked");
+        res.headers.erase("Content-Length");
+        res.body.clear();
+        IOBuf out;
+        SerializeHttpResponse(&res, &out);
+        s->Write(&out);
+        auto pa = std::make_shared<ProgressiveAttachment>(s->id());
+        res.start_progressive(std::move(pa));
+        return;  // keep-alive continues after the terminating chunk
+    }
     // HEAD: headers (incl. the Content-Length the body WOULD have), no
     // body bytes (RFC 9110 §9.3.2 — sending them desyncs keep-alive).
     if (msg->req.method == "HEAD") {

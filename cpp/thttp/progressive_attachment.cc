@@ -1,0 +1,35 @@
+#include "thttp/progressive_attachment.h"
+
+#include <cstdio>
+
+namespace tpurpc {
+
+int ProgressiveAttachment::Write(const IOBuf& data) {
+    if (closed_.load(std::memory_order_acquire) || data.empty()) {
+        return closed_.load(std::memory_order_acquire) ? -1 : 0;
+    }
+    SocketUniquePtr s;
+    if (Socket::AddressSocket(sid_, &s) != 0) return -1;
+    char head[32];
+    const int n = snprintf(head, sizeof(head), "%zx\r\n", data.size());
+    IOBuf chunk;
+    chunk.append(head, (size_t)n);
+    chunk.append(data);
+    chunk.append("\r\n", 2);
+    return s->Write(&chunk);
+}
+
+void ProgressiveAttachment::Close() {
+    bool expect = false;
+    if (!closed_.compare_exchange_strong(expect, true,
+                                         std::memory_order_acq_rel)) {
+        return;
+    }
+    SocketUniquePtr s;
+    if (Socket::AddressSocket(sid_, &s) != 0) return;
+    IOBuf last;
+    last.append("0\r\n\r\n", 5);
+    s->Write(&last);
+}
+
+}  // namespace tpurpc

@@ -1,0 +1,52 @@
+// ProgressiveAttachment: stream an HTTP/1.1 response body in chunks
+// AFTER the handler returned — server push, SSE, long downloads.
+//
+// Reference parity: src/brpc/progressive_attachment.{h,cpp} (+
+// docs/en/server_push.md): the handler detaches a progressive writer
+// from the response; the framework sends the header block with
+// Transfer-Encoding: chunked, and every Write() becomes one chunk on
+// the wire (the socket's ordered write queue keeps framing intact under
+// concurrent writers). Close() sends the terminating chunk; the
+// connection then continues keep-alive as usual.
+//
+// Usage (inside an HTTP handler):
+//   res->start_progressive = [](ProgressiveAttachmentPtr pa) {
+//       fiber... { pa->Write("chunk"); ...; pa->Close(); }
+//   };
+#pragma once
+
+#include <atomic>
+#include <memory>
+#include <string>
+
+#include "tbase/iobuf.h"
+#include "tnet/socket.h"
+
+namespace tpurpc {
+
+class ProgressiveAttachment {
+public:
+    explicit ProgressiveAttachment(SocketId sid) : sid_(sid) {}
+    ~ProgressiveAttachment() { Close(); }
+
+    // Send one chunk now. Returns 0, or -1 (connection dead / closed).
+    int Write(const IOBuf& data);
+    int Write(const std::string& data) {
+        IOBuf buf;
+        buf.append(data);
+        return Write(buf);
+    }
+
+    // Terminating 0-chunk; idempotent. The connection stays keep-alive.
+    void Close();
+
+    SocketId socket_id() const { return sid_; }
+
+private:
+    SocketId sid_;
+    std::atomic<bool> closed_{false};
+};
+
+using ProgressiveAttachmentPtr = std::shared_ptr<ProgressiveAttachment>;
+
+}  // namespace tpurpc

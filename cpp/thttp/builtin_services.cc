@@ -1,5 +1,7 @@
 #include "thttp/builtin_services.h"
 
+#include <malloc.h>
+
 #include <algorithm>
 #include <cctype>
 #include <map>
@@ -39,7 +41,9 @@ void HandleIndex(Server*, const HttpRequest&, HttpResponse* res) {
         "/flags        runtime flags (/flags/<name>?setvalue=v to set)\n"
         "/connections  accepted connections\n"
         "/rpcz         sampled per-RPC spans (enable_rpcz flag)\n"
-        "/fibers       fiber runtime introspection\n"
+        "/fibers       fiber runtime introspection (?st=1: stacks)\n"
+        "/version      build identification\n"
+        "/memory       allocator statistics\n"
         "/hotspots     profiling (/hotspots/cpu?seconds=N, "
         "/hotspots/contention)\n"
         "/metrics      prometheus exposition\n");
@@ -48,6 +52,33 @@ void HandleIndex(Server*, const HttpRequest&, HttpResponse* res) {
 void HandleHealth(Server*, const HttpRequest&, HttpResponse* res) {
     res->set_content_type("text/plain");
     res->Append("OK\n");
+}
+
+void HandleVersion(Server*, const HttpRequest&, HttpResponse* res) {
+    res->set_content_type("text/plain");
+    res->Append("tpu-rpc 1.0 (bRPC-capability TPU-native framework)\n");
+}
+
+// /memory: allocator + pool stats (reference builtin/memory_service).
+void HandleMemory(Server*, const HttpRequest&, HttpResponse* res) {
+    res->set_content_type("text/plain");
+    char line[256];
+#if defined(__GLIBC__)
+    struct mallinfo2 mi = mallinfo2();
+    snprintf(line, sizeof(line),
+             "malloc arena: %zu\nin use: %zu\nfree chunks: %zu\n"
+             "mmap'd: %zu\n",
+             (size_t)mi.arena, (size_t)mi.uordblks, (size_t)mi.fordblks,
+             (size_t)mi.hblkhd);
+    res->Append(line);
+#endif
+    snprintf(line, sizeof(line),
+             "iobuf tls cached blocks (this thread): %zu\n",
+             IOBuf::tls_cached_blocks());
+    res->Append(line);
+    snprintf(line, sizeof(line), "fiber slots allocated: %zu\n",
+             ResourcePool<TaskMeta>::singleton()->size());
+    res->Append(line);
 }
 
 // ---------------- /hotspots (reference hotspots_service.cpp) ----------------
@@ -329,6 +360,8 @@ void AddBuiltinHttpServices(Server* server) {
     server->RegisterHttpHandler("/connections", HandleConnections);
     server->RegisterHttpHandler("/rpcz", HandleRpcz);
     server->RegisterHttpHandler("/fibers", HandleFibers);
+    server->RegisterHttpHandler("/version", HandleVersion);
+    server->RegisterHttpHandler("/memory", HandleMemory);
     server->RegisterHttpHandler("/hotspots", HandleHotspotsIndex);
     server->RegisterHttpHandler("/hotspots/cpu", HandleHotspotsCpu);
     server->RegisterHttpHandler("/hotspots/contention",

@@ -28,6 +28,7 @@ static void default_blockmem_deallocate(void* p) { free(p); }
 
 void* (*IOBuf::blockmem_allocate)(size_t) = default_blockmem_allocate;
 void (*IOBuf::blockmem_deallocate)(void*) = default_blockmem_deallocate;
+bool (*IOBuf::blockmem_cache_veto)(const void*) = nullptr;
 
 namespace {
 
@@ -108,7 +109,8 @@ void IOBuf::Block::dec_ref() {
         // Cache only blocks from the current allocator pair.
         const int32_t cache_cap = FLAGS_iobuf_tls_cache_blocks.get();
         if (total == DEFAULT_BLOCK_SIZE && dealloc == blockmem_deallocate &&
-            cache_cap > 0) {
+            cache_cap > 0 &&
+            (blockmem_cache_veto == nullptr || !blockmem_cache_veto(this))) {
             if (tls_data.num_cached < (size_t)cache_cap) {
                 portal_next = tls_data.cache_head;
                 tls_data.cache_head = this;

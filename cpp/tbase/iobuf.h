@@ -34,6 +34,13 @@ public:
     // transfer-registered memory.
     static void* (*blockmem_allocate)(size_t);
     static void (*blockmem_deallocate)(void*);
+    // Optional cache veto: when set and returning true for a block's
+    // memory, dec_ref bypasses the TLS/global block caches and frees
+    // through blockmem_deallocate directly. The registered pool installs
+    // one so SHARED-region blocks return to its peer-visible freelist
+    // under cross-process pressure instead of migrating into per-thread
+    // caches where AllocateSharedBlock can't reach them.
+    static bool (*blockmem_cache_veto)(const void*);
 
     // Refcounted block. Lives in memory returned by blockmem_allocate; the
     // header is placed at the front, payload follows. Each block remembers

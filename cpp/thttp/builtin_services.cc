@@ -63,7 +63,7 @@ void HandleVersion(Server*, const HttpRequest&, HttpResponse* res) {
 void HandleMemory(Server*, const HttpRequest&, HttpResponse* res) {
     res->set_content_type("text/plain");
     char line[256];
-#if defined(__GLIBC__)
+#if defined(__GLIBC__) && defined(__GLIBC_PREREQ) && __GLIBC_PREREQ(2, 33)
     struct mallinfo2 mi = mallinfo2();
     snprintf(line, sizeof(line),
              "malloc arena: %zu\nin use: %zu\nfree chunks: %zu\n"

@@ -34,6 +34,14 @@ struct PoolState {
     // pool has ever overflowed into anonymous regions).
     std::vector<void*> freelist_shared;
     std::vector<void*> freelist_other;
+    // Bounce reserve: the TAIL of the primary is carved exclusively by
+    // AllocateSharedBlock, with its own freelist — general traffic must
+    // not be able to strand the cross-process copy path's memory in
+    // per-thread caches (bounce blocks themselves bypass caches via the
+    // DeallocateShared dealloc pointer, so this band self-recycles).
+    std::vector<void*> freelist_bounce;
+    size_t bounce_reserve = 8u << 20;
+    size_t bounce_carve = 0;  // into the reserved band
     size_t region_step = 64u << 20;
     size_t carve_offset = 0;       // into regions.back()
     std::atomic<size_t> live{0};
@@ -46,11 +54,35 @@ struct PoolState {
         const char* c = (const char*)ptr;
         return shm_base != nullptr && c >= shm_base && c < shm_base + shm_size;
     }
+    bool in_bounce_band(const void* ptr) const {
+        const char* c = (const char*)ptr;
+        return shm_base != nullptr &&
+               c >= shm_base + (shm_size - bounce_reserve) &&
+               c < shm_base + shm_size;
+    }
+    // General carve limit within the CURRENT back region.
+    size_t carve_limit() const {
+        const Region& r = regions.back();
+        return r.base == shm_base ? r.size - bounce_reserve : r.size;
+    }
 };
 
 PoolState& pool() {
     static PoolState p;
     return p;
+}
+
+// Cross-process pressure: set when AllocateSharedBlock runs dry; while
+// set, dec_ref routes SHARED-region blocks straight back to the pool
+// (IOBuf::blockmem_cache_veto) instead of per-thread caches, refilling
+// freelist_shared until the watermark clears it. Keeps the hot path at
+// one relaxed load when the shm transport isn't starved.
+std::atomic<bool> g_shared_pressure{false};
+constexpr size_t kSharedRefillWatermark = 256;
+
+bool shared_cache_veto(const void* p) {
+    return g_shared_pressure.load(std::memory_order_relaxed) &&
+           pool().in_shared(p);
 }
 
 void unlink_shm_at_exit() {
@@ -130,8 +162,7 @@ void* IciBlockPool::Allocate(size_t n) {
             p.live.fetch_add(1, std::memory_order_relaxed);
             return b;
         }
-        if (p.regions.empty() ||
-            p.carve_offset + n > p.regions.back().size) {
+        if (p.regions.empty() || p.carve_offset + n > p.carve_limit()) {
             if (!grow_locked(p)) return nullptr;
         }
         void* b = p.regions.back().base + p.carve_offset;
@@ -153,8 +184,17 @@ void IciBlockPool::Deallocate(void* b) {
         const char* c = (const char*)b;
         for (const Region& r : p.regions) {
             if (c >= r.base && c < r.base + r.size) {
-                (p.in_shared(b) ? p.freelist_shared : p.freelist_other)
-                    .push_back(b);
+                if (p.in_bounce_band(b)) {
+                    p.freelist_bounce.push_back(b);
+                } else if (p.in_shared(b)) {
+                    p.freelist_shared.push_back(b);
+                    if (p.freelist_shared.size() >= kSharedRefillWatermark) {
+                        g_shared_pressure.store(
+                            false, std::memory_order_relaxed);
+                    }
+                } else {
+                    p.freelist_other.push_back(b);
+                }
                 p.live.fetch_sub(1, std::memory_order_relaxed);
                 return;
             }
@@ -169,20 +209,48 @@ void* IciBlockPool::AllocateSharedBlock() {
     PoolState& p = pool();
     std::lock_guard<std::mutex> g(p.mu);
     if (p.shm_base == nullptr) return nullptr;
+    // A successful allocation means starvation is over: unlatch the
+    // pressure flag here (the freelist watermark alone is unreachable
+    // for small pools, and a latched flag would disable the TLS block
+    // caches forever).
+    g_shared_pressure.store(false, std::memory_order_relaxed);
+    // The reserved band first: its blocks recycle through
+    // freelist_bounce only (never via per-thread caches), so the bounce
+    // path can't be starved by general traffic. In-flight bounce data
+    // is bounded by the descriptor rings (kDepth slots x 8KB per pipe),
+    // so the reserve covers the bounce workload structurally; the
+    // pressure fallback below is belt-and-braces for many-link setups.
+    if (!p.freelist_bounce.empty()) {
+        void* b = p.freelist_bounce.back();
+        p.freelist_bounce.pop_back();
+        p.live.fetch_add(1, std::memory_order_relaxed);
+        return b;
+    }
+    if (p.bounce_carve + IOBuf::DEFAULT_BLOCK_SIZE <= p.bounce_reserve) {
+        void* b =
+            p.shm_base + (p.shm_size - p.bounce_reserve) + p.bounce_carve;
+        p.bounce_carve += IOBuf::DEFAULT_BLOCK_SIZE;
+        p.live.fetch_add(1, std::memory_order_relaxed);
+        return b;
+    }
+    // Reserve exhausted (more than 8MB of bounce data in flight): fall
+    // back to the general shared freelist / carve.
     if (!p.freelist_shared.empty()) {
         void* b = p.freelist_shared.back();
         p.freelist_shared.pop_back();
         p.live.fetch_add(1, std::memory_order_relaxed);
         return b;
     }
-    // Carve only while the carve pointer is still inside the primary.
     if (!p.regions.empty() && p.regions.back().base == p.shm_base &&
-        p.carve_offset + IOBuf::DEFAULT_BLOCK_SIZE <= p.regions.back().size) {
+        p.carve_offset + IOBuf::DEFAULT_BLOCK_SIZE <= p.carve_limit()) {
         void* b = p.regions.back().base + p.carve_offset;
         p.carve_offset += IOBuf::DEFAULT_BLOCK_SIZE;
         p.live.fetch_add(1, std::memory_order_relaxed);
         return b;
     }
+    // Dry: shared blocks are circulating in per-thread caches. Raise the
+    // pressure flag so dec_ref routes them back here; callers retry.
+    g_shared_pressure.store(true, std::memory_order_relaxed);
     return nullptr;
 }
 
@@ -203,7 +271,7 @@ void* IciBlockPool::AllocateRegistered(size_t n) {
                   p.regions[p.regions.size() - 1]);
         return mem;
     }
-    if (p.carve_offset + n > p.regions.back().size) {
+    if (p.carve_offset + n > p.carve_limit()) {
         if (!grow_locked(p)) return nullptr;
     }
     void* b = p.regions.back().base + p.carve_offset;
@@ -245,6 +313,11 @@ int IciBlockPool::Init(size_t region_bytes) {
     {
         std::lock_guard<std::mutex> g(p.mu);
         p.region_step = region_bytes < (1u << 20) ? (1u << 20) : region_bytes;
+        // The bounce reserve must fit INSIDE the primary (a reserve >=
+        // the region would underflow carve_limit into an unbounded carve
+        // — heap corruption): cap it at a quarter of the region.
+        p.bounce_reserve =
+            std::min(p.bounce_reserve, p.region_step / 4);
         // Primary region: shared (cross-process transferable). Fall back
         // to anonymous when /dev/shm is unavailable — in-process links
         // still work, cross-process connects will refuse.
@@ -263,6 +336,7 @@ int IciBlockPool::Init(size_t region_bytes) {
     // reverse mix is safe — free() on a pool block is not).
     IOBuf::blockmem_deallocate = &IciBlockPool::Deallocate;
     IOBuf::blockmem_allocate = &IciBlockPool::Allocate;
+    IOBuf::blockmem_cache_veto = &shared_cache_veto;
     return 0;
 }
 

@@ -191,7 +191,14 @@ ssize_t IciEndpoint::Pump(IOPortal* dst) {
         }
         while (tail != head) {
             const Pipe::Desc& d = p->ring[tail % Pipe::kDepth];
-            dst->append(d.block->data + d.offset, d.length);
+            // Zero-copy receive: same address space, so the "DMA" is a
+            // reference — append_ref takes its own block ref (the
+            // parser's cutn then moves pointers, never bytes). The
+            // producer's ring ref releases independently via `released`;
+            // disjoint byte ranges make concurrent tail-appends to a
+            // shared TLS block benign. The cross-process shm link keeps
+            // the copy (separate address spaces = a real transfer).
+            dst->append_ref({d.offset, d.length, d.block});
             received += d.length;
             ++tail;
             p->tail.store(tail, std::memory_order_release);

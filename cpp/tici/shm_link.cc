@@ -241,11 +241,27 @@ ssize_t ShmIciEndpoint::CutFromIOBufList(IOBuf* const* pieces, size_t count) {
                 // cache / freelist may hand back an overflow-region block
                 // the peer can't see.
                 void* mem = IciBlockPool::AllocateSharedBlock();
+                if (mem == nullptr && posted > 0) {
+                    // Descriptors already written must not sit behind a
+                    // reclaim wait: publish them now; the caller's
+                    // normal backpressure retries the rest.
+                    break;
+                }
                 if (mem == nullptr) {
-                    // This thread's TLS block cache may be sitting on
-                    // shared-region blocks; flush it and retry once.
+                    // Shared blocks are circulating through per-thread
+                    // caches; the failed call raised the pool's pressure
+                    // flag (block_pool.cc), which reroutes them back to
+                    // the shared freelist as they free. Flush our own
+                    // cache and give the rest a short grace to drain.
+                    // (Blocks parked in IDLE threads' caches stay out of
+                    // reach — the dedicated bounce band exists precisely
+                    // so that worst case is bounded to ring-depth bytes.)
                     IOBuf::flush_tls_cache();
-                    mem = IciBlockPool::AllocateSharedBlock();
+                    for (int spin = 0;
+                         spin < 50 && mem == nullptr; ++spin) {
+                        mem = IciBlockPool::AllocateSharedBlock();
+                        if (mem == nullptr) fiber_usleep(1000);
+                    }
                 }
                 if (mem == nullptr) {
                     if (posted > 0) break;  // publish what we have

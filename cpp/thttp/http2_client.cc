@@ -90,6 +90,11 @@ const std::string* FindHeader(const std::vector<HpackHeader>& hs,
 }
 
 // Fail every pending stream of the session (connection died / GOAWAY).
+// Errors go through id_error, which QUEUES when the id is locked: this
+// can run at socket recycle on the stack of whoever dropped the last
+// ref — including the RPC's own IssueRPC, which HOLDS the id lock
+// (blocking on it here deadlocked: IssueRPC -> Dereference -> OnRecycle
+// -> DeleteClientSession -> this -> id_lock_range on the same id).
 void FailAllStreams(H2ClientSession* sess, int error) {
     std::vector<uint64_t> cids;
     {
@@ -98,8 +103,7 @@ void FailAllStreams(H2ClientSession* sess, int error) {
         sess->streams.clear();
     }
     for (uint64_t cid : cids) {
-        CompleteClientUnaryResponse(cid, error, "h2 connection failed",
-                                    nullptr);
+        id_error(cid, error);
     }
 }
 
@@ -375,8 +379,11 @@ void ProcessH2ClientFrame(InputMessageBase* raw) {
                 cid = it->second.cid;
                 sess->streams.erase(it);
             }
-            CompleteClientUnaryResponse(cid, TERR_RESPONSE,
-                                        "stream reset by server", nullptr);
+            // id_error (queues under a held lock): the id may be locked
+            // by its sender parked mid-send on flow control; blocking
+            // this in-order input fiber on it would stall the whole
+            // connection's frame processing.
+            id_error(cid, TERR_RESPONSE);
             break;
         }
         case H2_GOAWAY:

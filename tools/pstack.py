@@ -1,0 +1,73 @@
+#!/usr/bin/env python3
+"""pstack.py PID — userspace stack of every thread via ptrace + the
+frame-pointer chain (the tree builds with -fno-omit-frame-pointer), and
+addr2line against /proc/PID/maps. No gdb required (this image has none);
+plays the role of the reference's builtin/threads pstack page."""
+import ctypes, os, re, struct, subprocess, sys
+
+libc = ctypes.CDLL("libc.so.6", use_errno=True)
+PTRACE_ATTACH, PTRACE_DETACH, PTRACE_GETREGS = 16, 17, 12
+
+class user_regs(ctypes.Structure):
+    _fields_ = [(n, ctypes.c_ulonglong) for n in (
+        "r15","r14","r13","r12","rbp","rbx","r11","r10","r9","r8","rax",
+        "rcx","rdx","rsi","rdi","orig_rax","rip","cs","eflags","rsp","ss",
+        "fs_base","gs_base","ds","es","fs","gs")]
+
+def ptrace(req, pid, addr=0, data=0):
+    libc.ptrace.restype = ctypes.c_long
+    libc.ptrace.argtypes = [ctypes.c_long]*4
+    return libc.ptrace(req, pid, addr, data)
+
+def read_word(pid, addr):
+    try:
+        with open(f"/proc/{pid}/mem", "rb") as f:
+            f.seek(addr)
+            return struct.unpack("<Q", f.read(8))[0]
+    except Exception:
+        return None
+
+def load_maps(pid):
+    maps = []
+    for line in open(f"/proc/{pid}/maps"):
+        m = re.match(r"([0-9a-f]+)-([0-9a-f]+) r-x. ([0-9a-f]+) \S+ \d+\s+(\S+)", line)
+        if m and m.group(4).startswith("/"):
+            maps.append((int(m.group(1),16), int(m.group(2),16), int(m.group(3),16), m.group(4)))
+    return maps
+
+def symbolize(maps, pc):
+    for lo, hi, off, path in maps:
+        if lo <= pc < hi:
+            rel = pc - lo + off
+            try:
+                out = subprocess.run(["addr2line","-Cfe",path,hex(rel)],
+                                     capture_output=True,text=True,timeout=10).stdout.split("\n")
+                fn = out[0].strip()
+                if fn and fn != "??":
+                    return f"{fn} [{os.path.basename(path)}]"
+            except Exception:
+                pass
+            return f"{os.path.basename(path)}+{hex(rel)}"
+    return hex(pc)
+
+def main(pid):
+    maps = load_maps(pid)
+    for tid in sorted(int(t) for t in os.listdir(f"/proc/{pid}/task")):
+        if ptrace(PTRACE_ATTACH, tid) != 0:
+            print(f"tid {tid}: attach failed"); continue
+        os.waitpid(tid, 0)
+        regs = user_regs()
+        ptrace(PTRACE_GETREGS, tid, 0, ctypes.addressof(regs))
+        print(f"--- tid {tid}")
+        pc, bp, depth = regs.rip, regs.rbp, 0
+        while pc and depth < 24:
+            print(f"  #{depth} {hex(pc)} {symbolize(maps, pc)}")
+            if not bp or bp > 2**63: break
+            new_pc = read_word(pid, bp + 8)
+            new_bp = read_word(pid, bp)
+            if not new_pc or new_bp is None or (new_bp and new_bp <= bp): break
+            pc, bp, depth = new_pc, new_bp, depth + 1
+        ptrace(PTRACE_DETACH, tid)
+
+if __name__ == "__main__":
+    main(int(sys.argv[1]))

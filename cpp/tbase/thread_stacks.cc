@@ -1,0 +1,129 @@
+#include "tbase/thread_stacks.h"
+
+#include <dirent.h>
+#include <signal.h>
+#include <sys/syscall.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <cstdio>
+#include <ucontext.h>
+#include <cstdlib>
+#include <cstring>
+#include <mutex>
+#include <vector>
+
+#include "tbase/symbolize.h"
+#include "tbase/time.h"
+
+namespace tpurpc {
+
+namespace {
+
+constexpr size_t kMaxFrames = 32;
+
+// One collection at a time; the handler writes into the active slot.
+struct Capture {
+    std::atomic<int> pending_tid{0};  // tid the handler should serve
+    std::atomic<bool> done{false};
+    uintptr_t frames[kMaxFrames];
+    size_t nframes = 0;
+};
+
+Capture g_capture;
+std::mutex g_dump_mu;
+
+void StackSignalHandler(int, siginfo_t*, void* ucv) {
+    const int me = (int)syscall(SYS_gettid);
+    if (g_capture.pending_tid.load(std::memory_order_acquire) != me) {
+        return;  // stale/misrouted signal
+    }
+    // Walk our own frame pointers starting from the signal context.
+    size_t n = 0;
+#if defined(__x86_64__)
+    auto* uc = (ucontext_t*)ucv;
+    uintptr_t pc = (uintptr_t)uc->uc_mcontext.gregs[REG_RIP];
+    uintptr_t bp = (uintptr_t)uc->uc_mcontext.gregs[REG_RBP];
+    while (pc != 0 && n < kMaxFrames) {
+        g_capture.frames[n++] = pc;
+        if (bp == 0 || (bp & 7) != 0) break;
+        const uintptr_t next_bp = *(uintptr_t*)bp;
+        const uintptr_t next_pc = *(uintptr_t*)(bp + 8);
+        if (next_bp <= bp) break;  // must move up the stack
+        bp = next_bp;
+        pc = next_pc;
+    }
+#else
+    (void)ucv;
+#endif
+    g_capture.nframes = n;
+    g_capture.done.store(true, std::memory_order_release);
+}
+
+}  // namespace
+
+std::string DumpThreadStacks(size_t max_frames) {
+    std::lock_guard<std::mutex> g(g_dump_mu);
+
+    struct sigaction sa, old_sa;
+    memset(&sa, 0, sizeof(sa));
+    sa.sa_sigaction = StackSignalHandler;
+    sa.sa_flags = SA_SIGINFO | SA_RESTART;
+    sigemptyset(&sa.sa_mask);
+    if (sigaction(SIGURG, &sa, &old_sa) != 0) {
+        return "sigaction failed\n";
+    }
+
+    // Snapshot tids first (threads may come and go mid-dump).
+    std::vector<int> tids;
+    if (DIR* d = opendir("/proc/self/task")) {
+        while (dirent* e = readdir(d)) {
+            const int tid = atoi(e->d_name);
+            if (tid > 0) tids.push_back(tid);
+        }
+        closedir(d);
+    }
+
+    const int self = (int)syscall(SYS_gettid);
+    const pid_t pid = getpid();
+    std::string out;
+    char line[512];
+    snprintf(line, sizeof(line), "%zu thread(s)\n", tids.size());
+    out += line;
+    for (int tid : tids) {
+        snprintf(line, sizeof(line), "--- thread %d%s\n", tid,
+                 tid == self ? " (collector)" : "");
+        out += line;
+        if (tid == self) continue;  // our own stack is this function
+        g_capture.done.store(false, std::memory_order_relaxed);
+        g_capture.nframes = 0;
+        g_capture.pending_tid.store(tid, std::memory_order_release);
+        if (syscall(SYS_tgkill, pid, tid, SIGURG) != 0) {
+            out += "    <gone>\n";
+            continue;
+        }
+        const int64_t deadline = monotonic_time_us() + 200 * 1000;
+        while (!g_capture.done.load(std::memory_order_acquire) &&
+               monotonic_time_us() < deadline) {
+            usleep(200);
+        }
+        g_capture.pending_tid.store(0, std::memory_order_release);
+        if (!g_capture.done.load(std::memory_order_acquire)) {
+            out += "    <no response (uninterruptible?)>\n";
+            continue;
+        }
+        const size_t n =
+            g_capture.nframes < max_frames ? g_capture.nframes : max_frames;
+        for (size_t i = 0; i < n; ++i) {
+            snprintf(line, sizeof(line), "    #%zu 0x%llx %s\n", i,
+                     (unsigned long long)g_capture.frames[i],
+                     SymbolizePc(g_capture.frames[i]).c_str());
+            out += line;
+        }
+        if (n == 0) out += "    <unwalkable>\n";
+    }
+    sigaction(SIGURG, &old_sa, nullptr);
+    return out;
+}
+
+}  // namespace tpurpc

@@ -1,0 +1,20 @@
+// In-process pthread stack dumps for the /threads portal page.
+//
+// Reference parity: src/brpc/builtin/threads_service.cpp shells out to
+// pstack; this image has no debugger, so we self-inspect: every task in
+// /proc/self/task gets SIGURG'd, the handler walks ITS OWN frame-pointer
+// chain (the tree builds with -fno-omit-frame-pointer) into a per-thread
+// slot, and the collector symbolizes the PCs (tbase/symbolize.h).
+#pragma once
+
+#include <cstddef>
+#include <string>
+
+namespace tpurpc {
+
+// Symbolized stacks of every thread in the process. Bounded wait;
+// threads that don't respond (blocked in uninterruptible syscalls)
+// report as such.
+std::string DumpThreadStacks(size_t max_frames = 24);
+
+}  // namespace tpurpc

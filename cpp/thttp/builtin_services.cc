@@ -13,6 +13,7 @@
 #include "tbase/cpu_profiler.h"
 #include "tbase/flags.h"
 #include "tbase/symbolize.h"
+#include "tbase/thread_stacks.h"
 #include "tfiber/contention_profiler.h"
 #include "tfiber/fiber.h"
 #include "thttp/http_message.h"
@@ -42,6 +43,7 @@ void HandleIndex(Server*, const HttpRequest&, HttpResponse* res) {
         "/connections  accepted connections\n"
         "/rpcz         sampled per-RPC spans (enable_rpcz flag)\n"
         "/fibers       fiber runtime introspection (?st=1: stacks)\n"
+        "/threads      pthread stack dump\n"
         "/version      build identification\n"
         "/memory       allocator statistics\n"
         "/hotspots     profiling (/hotspots/cpu?seconds=N, "
@@ -52,6 +54,13 @@ void HandleIndex(Server*, const HttpRequest&, HttpResponse* res) {
 void HandleHealth(Server*, const HttpRequest&, HttpResponse* res) {
     res->set_content_type("text/plain");
     res->Append("OK\n");
+}
+
+// /threads: pthread stack dump (reference builtin/threads_service.cpp
+// runs pstack; we self-inspect via SIGURG + the fp chain).
+void HandleThreads(Server*, const HttpRequest&, HttpResponse* res) {
+    res->set_content_type("text/plain");
+    res->Append(DumpThreadStacks());
 }
 
 void HandleVersion(Server*, const HttpRequest&, HttpResponse* res) {
@@ -360,6 +369,7 @@ void AddBuiltinHttpServices(Server* server) {
     server->RegisterHttpHandler("/connections", HandleConnections);
     server->RegisterHttpHandler("/rpcz", HandleRpcz);
     server->RegisterHttpHandler("/fibers", HandleFibers);
+    server->RegisterHttpHandler("/threads", HandleThreads);
     server->RegisterHttpHandler("/version", HandleVersion);
     server->RegisterHttpHandler("/memory", HandleMemory);
     server->RegisterHttpHandler("/hotspots", HandleHotspotsIndex);

@@ -1,5 +1,7 @@
 #include "tbase/cpu_profiler.h"
 
+#include "tbase/stack_walk.h"
+
 #include <signal.h>
 #include <stdio.h>
 #include <string.h>
@@ -24,37 +26,7 @@ std::atomic<size_t> g_nsamples{0};
 std::atomic<bool> g_running{false};
 struct sigaction g_old_action;
 
-#if defined(__x86_64__)
-inline uintptr_t context_pc(ucontext_t* uc) {
-    return (uintptr_t)uc->uc_mcontext.gregs[REG_RIP];
-}
-inline uintptr_t context_fp(ucontext_t* uc) {
-    return (uintptr_t)uc->uc_mcontext.gregs[REG_RBP];
-}
-#elif defined(__aarch64__)
-inline uintptr_t context_pc(ucontext_t* uc) {
-    return (uintptr_t)uc->uc_mcontext.pc;
-}
-inline uintptr_t context_fp(ucontext_t* uc) {
-    return (uintptr_t)uc->uc_mcontext.regs[29];
-}
-#else
-inline uintptr_t context_pc(ucontext_t*) { return 0; }
-inline uintptr_t context_fp(ucontext_t*) { return 0; }
-#endif
-
-// Reads [fp, fp+16) safely via process_vm_readv (a syscall — async-
-// signal-safe, and it simply fails on unmapped addresses instead of
-// faulting; the build may omit frame pointers so RBP can hold anything).
-bool safe_read_frame(uintptr_t fp, uintptr_t out[2]) {
-    iovec local{out, 2 * sizeof(uintptr_t)};
-    iovec remote{(void*)fp, 2 * sizeof(uintptr_t)};
-    return process_vm_readv(getpid(), &local, 1, &remote, 1, 0) ==
-           (ssize_t)(2 * sizeof(uintptr_t));
-}
-
-// Frame-pointer walk with safe reads; fibers run on mmap'd stacks so we
-// only trust monotonically-increasing frame pointers within a 1MB span.
+// Frame capture via the shared hardened walker (tbase/stack_walk.h).
 void prof_handler(int, siginfo_t*, void* ucv) {
     if (!g_running.load(std::memory_order_relaxed)) return;
     const size_t i = g_nsamples.fetch_add(1, std::memory_order_relaxed);
@@ -62,24 +34,10 @@ void prof_handler(int, siginfo_t*, void* ucv) {
         g_nsamples.store(kMaxSamples, std::memory_order_relaxed);
         return;
     }
-    ucontext_t* uc = (ucontext_t*)ucv;
     uintptr_t* row = g_samples + i * kDepth;
-    row[0] = context_pc(uc);
-    uintptr_t fp = context_fp(uc);
-    const uintptr_t lo = fp;
-    const uintptr_t hi = fp + (1u << 20);
-    int d = 1;
-    while (d < kDepth && fp >= lo && fp < hi && (fp & 7) == 0) {
-        uintptr_t frame[2];
-        if (!safe_read_frame(fp, frame)) break;
-        const uintptr_t next_fp = frame[0];
-        const uintptr_t ret = frame[1];
-        if (ret < 4096) break;
-        row[d++] = ret;
-        if (next_fp <= fp) break;
-        fp = next_fp;
-    }
-    while (d < kDepth) row[d++] = 0;
+    const size_t n =
+        stack_walk::walk((ucontext_t*)ucv, row, (size_t)kDepth);
+    for (size_t d = n; d < (size_t)kDepth; ++d) row[d] = 0;
 }
 
 int write_profile(FILE* f) {

@@ -1,0 +1,73 @@
+// Shared async-signal-safe frame-pointer walking for the samplers that
+// interrupt arbitrary threads (cpu_profiler.cc SIGPROF, thread_stacks.cc
+// SIGURG). One hardened implementation: per-arch signal-context
+// accessors, process_vm_readv frame reads (a build may omit frame
+// pointers anywhere — the register can hold ANYTHING, and a raw
+// dereference inside a signal handler would crash the process), and a
+// monotonic 1MB span bound against loops/garbage.
+#pragma once
+
+#include <signal.h>
+#include <sys/uio.h>
+#include <ucontext.h>
+#include <unistd.h>
+
+#include <cstddef>
+#include <cstdint>
+
+namespace tpurpc {
+namespace stack_walk {
+
+#if defined(__x86_64__)
+inline uintptr_t context_pc(ucontext_t* uc) {
+    return (uintptr_t)uc->uc_mcontext.gregs[REG_RIP];
+}
+inline uintptr_t context_fp(ucontext_t* uc) {
+    return (uintptr_t)uc->uc_mcontext.gregs[REG_RBP];
+}
+#elif defined(__aarch64__)
+inline uintptr_t context_pc(ucontext_t* uc) {
+    return (uintptr_t)uc->uc_mcontext.pc;
+}
+inline uintptr_t context_fp(ucontext_t* uc) {
+    return (uintptr_t)uc->uc_mcontext.regs[29];
+}
+#else
+inline uintptr_t context_pc(ucontext_t*) { return 0; }
+inline uintptr_t context_fp(ucontext_t*) { return 0; }
+#endif
+
+// Reads [fp, fp+16) via process_vm_readv — async-signal-safe, fails on
+// unmapped addresses instead of faulting.
+inline bool safe_read_frame(uintptr_t fp, uintptr_t out[2]) {
+    iovec local{out, 2 * sizeof(uintptr_t)};
+    iovec remote{(void*)fp, 2 * sizeof(uintptr_t)};
+    return process_vm_readv(getpid(), &local, 1, &remote, 1, 0) ==
+           (ssize_t)(2 * sizeof(uintptr_t));
+}
+
+// Walk from a signal context into frames[0..max); returns frame count.
+// Fibers run on mmap'd stacks, so only monotonically-increasing frame
+// pointers within a 1MB span are trusted.
+inline size_t walk(ucontext_t* uc, uintptr_t* frames, size_t max) {
+    if (max == 0) return 0;
+    size_t n = 0;
+    frames[n++] = context_pc(uc);
+    uintptr_t fp = context_fp(uc);
+    const uintptr_t lo = fp;
+    const uintptr_t hi = fp + (1u << 20);
+    while (n < max && fp >= lo && fp < hi && (fp & 7) == 0 && fp != 0) {
+        uintptr_t frame[2];
+        if (!safe_read_frame(fp, frame)) break;
+        const uintptr_t next_fp = frame[0];
+        const uintptr_t ret_pc = frame[1];
+        if (ret_pc == 0) break;
+        frames[n++] = ret_pc;
+        if (next_fp <= fp) break;
+        fp = next_fp;
+    }
+    return n;
+}
+
+}  // namespace stack_walk
+}  // namespace tpurpc

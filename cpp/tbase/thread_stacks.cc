@@ -7,12 +7,12 @@
 
 #include <atomic>
 #include <cstdio>
-#include <ucontext.h>
 #include <cstdlib>
 #include <cstring>
 #include <mutex>
 #include <vector>
 
+#include "tbase/stack_walk.h"
 #include "tbase/symbolize.h"
 #include "tbase/time.h"
 
@@ -22,41 +22,39 @@ namespace {
 
 constexpr size_t kMaxFrames = 32;
 
-// One collection at a time; the handler writes into the active slot.
+// One collection at a time. `round` is the stale-handler guard: a
+// handler whose delivery outlived its collection window (thread was
+// off-CPU past the deadline) sees a bumped round and writes nothing —
+// without it, the late handler would race the NEXT thread's capture
+// (torn frames, misattributed stacks).
 struct Capture {
-    std::atomic<int> pending_tid{0};  // tid the handler should serve
+    std::atomic<uint64_t> round{0};
+    std::atomic<int> pending_tid{0};
     std::atomic<bool> done{false};
     uintptr_t frames[kMaxFrames];
-    size_t nframes = 0;
+    std::atomic<size_t> nframes{0};
 };
 
 Capture g_capture;
 std::mutex g_dump_mu;
 
 void StackSignalHandler(int, siginfo_t*, void* ucv) {
+    const uint64_t my_round =
+        g_capture.round.load(std::memory_order_acquire);
     const int me = (int)syscall(SYS_gettid);
     if (g_capture.pending_tid.load(std::memory_order_acquire) != me) {
         return;  // stale/misrouted signal
     }
-    // Walk our own frame pointers starting from the signal context.
-    size_t n = 0;
-#if defined(__x86_64__)
-    auto* uc = (ucontext_t*)ucv;
-    uintptr_t pc = (uintptr_t)uc->uc_mcontext.gregs[REG_RIP];
-    uintptr_t bp = (uintptr_t)uc->uc_mcontext.gregs[REG_RBP];
-    while (pc != 0 && n < kMaxFrames) {
-        g_capture.frames[n++] = pc;
-        if (bp == 0 || (bp & 7) != 0) break;
-        const uintptr_t next_bp = *(uintptr_t*)bp;
-        const uintptr_t next_pc = *(uintptr_t*)(bp + 8);
-        if (next_bp <= bp) break;  // must move up the stack
-        bp = next_bp;
-        pc = next_pc;
+    uintptr_t local[kMaxFrames];
+    const size_t n =
+        stack_walk::walk((ucontext_t*)ucv, local, kMaxFrames);
+    // Publish only if the collector still waits for THIS round.
+    if (g_capture.round.load(std::memory_order_acquire) != my_round ||
+        g_capture.pending_tid.load(std::memory_order_acquire) != me) {
+        return;
     }
-#else
-    (void)ucv;
-#endif
-    g_capture.nframes = n;
+    memcpy(g_capture.frames, local, n * sizeof(uintptr_t));
+    g_capture.nframes.store(n, std::memory_order_release);
     g_capture.done.store(true, std::memory_order_release);
 }
 
@@ -86,6 +84,9 @@ std::string DumpThreadStacks(size_t max_frames) {
 
     const int self = (int)syscall(SYS_gettid);
     const pid_t pid = getpid();
+    // This may run on a fiber whose worker carries other queued work:
+    // bound the page's total cost, not just each thread's.
+    const int64_t total_deadline = monotonic_time_us() + 1000 * 1000;
     std::string out;
     char line[512];
     snprintf(line, sizeof(line), "%zu thread(s)\n", tids.size());
@@ -95,25 +96,33 @@ std::string DumpThreadStacks(size_t max_frames) {
                  tid == self ? " (collector)" : "");
         out += line;
         if (tid == self) continue;  // our own stack is this function
+        if (monotonic_time_us() >= total_deadline) {
+            out += "    <dump budget exhausted>\n";
+            continue;
+        }
+        g_capture.round.fetch_add(1, std::memory_order_acq_rel);
         g_capture.done.store(false, std::memory_order_relaxed);
-        g_capture.nframes = 0;
+        g_capture.nframes.store(0, std::memory_order_relaxed);
         g_capture.pending_tid.store(tid, std::memory_order_release);
         if (syscall(SYS_tgkill, pid, tid, SIGURG) != 0) {
             out += "    <gone>\n";
             continue;
         }
-        const int64_t deadline = monotonic_time_us() + 200 * 1000;
+        const int64_t deadline = monotonic_time_us() + 100 * 1000;
         while (!g_capture.done.load(std::memory_order_acquire) &&
                monotonic_time_us() < deadline) {
             usleep(200);
         }
         g_capture.pending_tid.store(0, std::memory_order_release);
         if (!g_capture.done.load(std::memory_order_acquire)) {
+            // Invalidate the round so a late handler writes nothing.
+            g_capture.round.fetch_add(1, std::memory_order_acq_rel);
             out += "    <no response (uninterruptible?)>\n";
             continue;
         }
-        const size_t n =
-            g_capture.nframes < max_frames ? g_capture.nframes : max_frames;
+        const size_t captured =
+            g_capture.nframes.load(std::memory_order_acquire);
+        const size_t n = captured < max_frames ? captured : max_frames;
         for (size_t i = 0; i < n; ++i) {
             snprintf(line, sizeof(line), "    #%zu 0x%llx %s\n", i,
                      (unsigned long long)g_capture.frames[i],

@@ -50,6 +50,7 @@ bool Flag<int32_t>::SetString(const std::string& s) {
     if (end == s.c_str() || *end != '\0') return false;
     if (validator_ && !validator_((int32_t)v)) return false;
     value_.store((int32_t)v, std::memory_order_relaxed);
+    NotifyChanged();
     return true;
 }
 
@@ -60,6 +61,7 @@ bool Flag<int64_t>::SetString(const std::string& s) {
     if (end == s.c_str() || *end != '\0') return false;
     if (validator_ && !validator_((int64_t)v)) return false;
     value_.store((int64_t)v, std::memory_order_relaxed);
+    NotifyChanged();
     return true;
 }
 
@@ -75,6 +77,7 @@ bool Flag<bool>::SetString(const std::string& s) {
     }
     if (validator_ && !validator_(v)) return false;
     value_.store(v, std::memory_order_relaxed);
+    NotifyChanged();
     return true;
 }
 
@@ -85,6 +88,7 @@ bool Flag<double>::SetString(const std::string& s) {
     if (end == s.c_str() || *end != '\0') return false;
     if (validator_ && !validator_(v)) return false;
     value_.store(v, std::memory_order_relaxed);
+    NotifyChanged();
     return true;
 }
 

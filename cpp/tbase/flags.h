@@ -29,11 +29,23 @@ public:
     virtual std::string GetString() const = 0;
     // Returns false if parsing/validation failed.
     virtual bool SetString(const std::string& value) = 0;
+    // Invoked after every successful set (typed or by-string): lets a
+    // subsystem re-apply derived state on live flag mutation (e.g. the
+    // fault-injection plan re-parses when chaos_* flags change).
+    void set_on_change(std::function<void()> cb) {
+        on_change_ = std::move(cb);
+    }
+
+protected:
+    void NotifyChanged() {
+        if (on_change_) on_change_();
+    }
 
 private:
     const char* name_;
     const char* desc_;
     const char* type_;
+    std::function<void()> on_change_;
 };
 
 // Global registry.
@@ -54,6 +66,7 @@ public:
     void set(T v) {
         if (!validator_ || validator_(v)) {
             value_.store(v, std::memory_order_relaxed);
+            NotifyChanged();
         }
     }
     void set_validator(std::function<bool(T)> v) { validator_ = std::move(v); }
@@ -78,8 +91,11 @@ public:
         return value_;
     }
     void set(const std::string& v) {
-        std::lock_guard<std::mutex> g(mu_);
-        value_ = v;
+        {
+            std::lock_guard<std::mutex> g(mu_);
+            value_ = v;
+        }
+        NotifyChanged();  // outside mu_: the hook may read the flag
     }
     std::string GetString() const override { return get(); }
     bool SetString(const std::string& s) override {

@@ -22,17 +22,37 @@ namespace {
 
 constexpr size_t kMaxFrames = 32;
 
-// One collection at a time. `round` is the stale-handler guard: a
-// handler whose delivery outlived its collection window (thread was
-// off-CPU past the deadline) sees a bumped round and writes nothing —
-// without it, the late handler would race the NEXT thread's capture
-// (torn frames, misattributed stacks).
+// One collection at a time. Stale-handler protocol (a handler whose
+// delivery outlived its 100ms window — thread off-CPU — must not tear a
+// LATER round's capture):
+//  1. CLAIM: the handler CASes pending_tid from its own tid to the
+//     negated value. The collector publishes each round's tid exactly
+//     once, so exactly one handler can claim a round; a handler whose
+//     round already ended sees a different pending_tid and bows out
+//     before touching shared frames. (The earlier check-then-write had a
+//     TOCTOU hole between the re-check and the memcpy.)
+//  2. Per-round buffer slot: frames go into slots[round & 1], so a
+//     claimed writer suspended across ONE round boundary scribbles on
+//     the previous slot, not the one the next round reads. (Parity
+//     repeats every two rounds — the seqlock below covers the rest.)
+//  3. Round-stamped publication: completion is `done_round == round`
+//     (not a bool reset each round), so a late store can never signal a
+//     round it didn't capture.
+//  4. Per-slot seqlock: the handler brackets its write with gen
+//     increments (odd = writing); the collector copies the frames and
+//     accepts them only if gen was even and unchanged across the copy.
+//     A stale writer suspended PAST two rounds (same slot parity) can
+//     therefore still collide, but the collector detects the tear and
+//     reports <no response> instead of printing garbage.
 struct Capture {
     std::atomic<uint64_t> round{0};
     std::atomic<int> pending_tid{0};
-    std::atomic<bool> done{false};
-    uintptr_t frames[kMaxFrames];
-    std::atomic<size_t> nframes{0};
+    std::atomic<uint64_t> done_round{0};  // last round fully published
+    struct Slot {
+        std::atomic<uint32_t> gen{0};  // seqlock: odd while being written
+        uintptr_t frames[kMaxFrames];
+        std::atomic<size_t> nframes{0};
+    } slots[2];
 };
 
 Capture g_capture;
@@ -42,20 +62,23 @@ void StackSignalHandler(int, siginfo_t*, void* ucv) {
     const uint64_t my_round =
         g_capture.round.load(std::memory_order_acquire);
     const int me = (int)syscall(SYS_gettid);
-    if (g_capture.pending_tid.load(std::memory_order_acquire) != me) {
-        return;  // stale/misrouted signal
+    // CLAIM this round (step 1 above).
+    int expect = me;
+    if (!g_capture.pending_tid.compare_exchange_strong(
+            expect, -me, std::memory_order_acq_rel)) {
+        return;  // stale/misrouted signal: another round owns the buffer
     }
     uintptr_t local[kMaxFrames];
     const size_t n =
         stack_walk::walk((ucontext_t*)ucv, local, kMaxFrames);
-    // Publish only if the collector still waits for THIS round.
-    if (g_capture.round.load(std::memory_order_acquire) != my_round ||
-        g_capture.pending_tid.load(std::memory_order_acquire) != me) {
-        return;
-    }
-    memcpy(g_capture.frames, local, n * sizeof(uintptr_t));
-    g_capture.nframes.store(n, std::memory_order_release);
-    g_capture.done.store(true, std::memory_order_release);
+    Capture::Slot& slot = g_capture.slots[my_round & 1];
+    slot.gen.fetch_add(1, std::memory_order_acq_rel);  // odd: writing
+    memcpy(slot.frames, local, n * sizeof(uintptr_t));
+    slot.nframes.store(n, std::memory_order_relaxed);
+    slot.gen.fetch_add(1, std::memory_order_acq_rel);  // even: done
+    // Publish: only the collector's current round counts (step 3); a
+    // stale round number is simply never observed as done.
+    g_capture.done_round.store(my_round, std::memory_order_release);
 }
 
 }  // namespace
@@ -100,33 +123,52 @@ std::string DumpThreadStacks(size_t max_frames) {
             out += "    <dump budget exhausted>\n";
             continue;
         }
-        g_capture.round.fetch_add(1, std::memory_order_acq_rel);
-        g_capture.done.store(false, std::memory_order_relaxed);
-        g_capture.nframes.store(0, std::memory_order_relaxed);
+        const uint64_t round =
+            g_capture.round.fetch_add(1, std::memory_order_acq_rel) + 1;
+        Capture::Slot& slot = g_capture.slots[round & 1];
+        slot.nframes.store(0, std::memory_order_relaxed);
+        // Seqlock baseline: this round's ONE legitimate writer must move
+        // gen to exactly base+2; any other final value means a stale
+        // handler also wrote the slot (before, between or after) and the
+        // capture is discarded below.
+        const uint32_t gen_base = slot.gen.load(std::memory_order_acquire);
+        // Publishing the tid opens the round's single claim slot.
         g_capture.pending_tid.store(tid, std::memory_order_release);
         if (syscall(SYS_tgkill, pid, tid, SIGURG) != 0) {
+            g_capture.pending_tid.store(0, std::memory_order_release);
             out += "    <gone>\n";
             continue;
         }
         const int64_t deadline = monotonic_time_us() + 100 * 1000;
-        while (!g_capture.done.load(std::memory_order_acquire) &&
+        while (g_capture.done_round.load(std::memory_order_acquire) !=
+                   round &&
                monotonic_time_us() < deadline) {
             usleep(200);
         }
+        // Close the claim window (no-op if the handler already claimed:
+        // its CAS flipped pending_tid to -tid).
         g_capture.pending_tid.store(0, std::memory_order_release);
-        if (!g_capture.done.load(std::memory_order_acquire)) {
-            // Invalidate the round so a late handler writes nothing.
-            g_capture.round.fetch_add(1, std::memory_order_acq_rel);
+        if (g_capture.done_round.load(std::memory_order_acquire) != round) {
             out += "    <no response (uninterruptible?)>\n";
             continue;
         }
-        const size_t captured =
-            g_capture.nframes.load(std::memory_order_acquire);
+        // Seqlock read: copy out, then verify no (stale) writer touched
+        // the slot during the copy.
+        const uint32_t g1 = slot.gen.load(std::memory_order_acquire);
+        size_t captured = slot.nframes.load(std::memory_order_acquire);
+        if (captured > kMaxFrames) captured = kMaxFrames;
+        uintptr_t copied[kMaxFrames];
+        memcpy(copied, slot.frames, captured * sizeof(uintptr_t));
+        const uint32_t g2 = slot.gen.load(std::memory_order_acquire);
+        if ((g1 & 1) != 0 || g1 != g2 || g1 != gen_base + 2) {
+            out += "    <no response (torn capture discarded)>\n";
+            continue;
+        }
         const size_t n = captured < max_frames ? captured : max_frames;
         for (size_t i = 0; i < n; ++i) {
             snprintf(line, sizeof(line), "    #%zu 0x%llx %s\n", i,
-                     (unsigned long long)g_capture.frames[i],
-                     SymbolizePc(g_capture.frames[i]).c_str());
+                     (unsigned long long)copied[i],
+                     SymbolizePc(copied[i]).c_str());
             out += line;
         }
         if (n == 0) out += "    <unwalkable>\n";

@@ -2,14 +2,24 @@
 // options.protocol="grpc" calling our own gRPC-capable h2 server in
 // loopback — plus error mapping and multiplexed concurrency.
 // Reference parity: client half of src/brpc/policy/http2_rpc_protocol.cpp.
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <poll.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
 #include <atomic>
 #include <string>
+#include <thread>
 #include <vector>
 
 #include "echo.pb.h"
 #include "tbase/endpoint.h"
+#include "tbase/time.h"
 #include "tfiber/fiber.h"
 #include "tfiber/fiber_sync.h"
+#include "thttp/h2_frames.h"
+#include "thttp/hpack.h"
 #include "trpc/channel.h"
 #include "trpc/controller.h"
 #include "trpc/server.h"
@@ -166,6 +176,95 @@ TEST(GrpcClient, LargeResponseFlowControl) {
     stub.Echo(&cntl, &req, &res, nullptr);
     ASSERT_FALSE(cntl.Failed());
     EXPECT_EQ(res.message().size(), 300u * 1024);
+}
+
+TEST(GrpcClient, EarlyTrailersOnlyResponseDoesNotStallInputFiber) {
+    // Regression for the h2-client input-fiber deadlock: a sender parked
+    // on flow control (>64KB request vs the default 65535 window) HOLDS
+    // the CallId lock; an early trailers-only response used to complete
+    // the stream INLINE on the in-order input fiber, which then blocked
+    // in id_lock_range — wedging frame processing (including the very
+    // WINDOW_UPDATEs that would unpark the sender) until the sender's
+    // 1s flow-control tick rescued it. Fixed: completion runs on a
+    // background fiber; the input fiber keeps processing, so the whole
+    // RPC resolves as soon as the server answers (~the 300ms scripted
+    // delay below), not after a ≥1s stall.
+    const int lfd = ::socket(AF_INET, SOCK_STREAM, 0);
+    ASSERT_GE(lfd, 0);
+    int one = 1;
+    setsockopt(lfd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in addr;
+    memset(&addr, 0, sizeof(addr));
+    addr.sin_family = AF_INET;
+    addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+    ASSERT_EQ(0, ::bind(lfd, (sockaddr*)&addr, sizeof(addr)));
+    ASSERT_EQ(0, ::listen(lfd, 1));
+    socklen_t alen = sizeof(addr);
+    ASSERT_EQ(0, getsockname(lfd, (sockaddr*)&addr, &alen));
+    const int port = ntohs(addr.sin_port);
+
+    // Scripted raw h2 server: drain the request burst, then answer
+    // stream 1 with trailers-only (grpc-status 8) and open the windows.
+    std::thread raw_server([lfd] {
+        const int cfd = ::accept(lfd, nullptr, nullptr);
+        if (cfd < 0) return;
+        int oone = 1;
+        setsockopt(cfd, IPPROTO_TCP, TCP_NODELAY, &oone, sizeof(oone));
+        auto drain_for = [cfd](int ms) {
+            const int64_t end = tpurpc::monotonic_time_us() + ms * 1000ll;
+            char buf[16384];
+            while (tpurpc::monotonic_time_us() < end) {
+                pollfd p{cfd, POLLIN, 0};
+                if (::poll(&p, 1, 20) == 1) {
+                    if (::recv(cfd, buf, sizeof(buf), 0) == 0) return false;
+                }
+            }
+            return true;
+        };
+        if (!drain_for(300)) {  // client parks after ~64KB of DATA
+            close(cfd);
+            return;
+        }
+        using namespace tpurpc::h2;
+        std::string out = BuildFrame(H2_SETTINGS, 0, 0, "");
+        AppendHeadersFrames(
+            &out, kFlagEndHeaders | kFlagEndStream, 1,
+            EncodeHeaderBlock({{":status", "200"},
+                               {"content-type", "application/grpc"},
+                               {"grpc-status", "8"},
+                               {"grpc-message", "early-trailers"}}));
+        // Windows the parked sender is waiting for: processing them is
+        // exactly what a blocked input fiber could not do.
+        uint32_t inc = htonl(1u << 20);
+        const std::string p((const char*)&inc, 4);
+        out += BuildFrame(H2_WINDOW_UPDATE, 0, 0, p);
+        out += BuildFrame(H2_WINDOW_UPDATE, 0, 1, p);
+        (void)!send(cfd, out.data(), out.size(), MSG_NOSIGNAL);
+        drain_for(3000);  // absorb whatever the client still sends
+        close(cfd);
+    });
+
+    Channel ch;
+    ChannelOptions opts = grpc_options();
+    opts.timeout_ms = 5000;
+    opts.max_retry = 0;  // a re-issued try would park on the window again
+    EndPoint ep;
+    str2endpoint("127.0.0.1", port, &ep);
+    ASSERT_EQ(0, ch.Init(ep, &opts));
+    test::EchoService_Stub stub(&ch);
+    Controller cntl;
+    test::EchoRequest req;
+    req.set_message(std::string(300 * 1024, 'x'));  // >64KB: parks
+    test::EchoResponse res;
+    const int64_t t0 = monotonic_time_us();
+    stub.Echo(&cntl, &req, &res, nullptr);
+    const int64_t elapsed_ms = (monotonic_time_us() - t0) / 1000;
+    EXPECT_TRUE(cntl.Failed());
+    // Unfixed, the input fiber wedges until the sender's 1s rescue tick
+    // (and compounding retries could ride it to the full deadline).
+    EXPECT_LT(elapsed_ms, 800);
+    raw_server.join();
+    close(lfd);
 }
 
 TEST(GrpcClient, ReconnectsAfterServerRestart) {

@@ -825,6 +825,40 @@ TEST(Progressive, ChunkedBodyStreamsAfterHandlerReturns) {
     EXPECT_NE(health.find("OK"), std::string::npos);
 }
 
+TEST(Progressive, Http10AndHeadGetFailedWriterNotSilence) {
+    // HTTP/1.0 (and HEAD) can't carry chunked streams. The handler that
+    // committed to one must LEARN that — it gets its callback invoked
+    // with an already-dead writer whose Write returns -1 — instead of
+    // the server silently answering 200 with an empty body and leaking
+    // the handler's expectation.
+    PortalServer ps;
+    std::atomic<int> cb_invoked{0};
+    std::atomic<int> write_rc{0};
+    ps.server.RegisterHttpHandler(
+        "/stream10", [&](Server*, const HttpRequest&, HttpResponse* res) {
+            res->set_content_type("text/plain");
+            res->start_progressive = [&](ProgressiveAttachmentPtr pa) {
+                cb_invoked.fetch_add(1);
+                write_rc.store(pa->Write("never-delivered"));
+            };
+        });
+    ASSERT_TRUE(ps.start());
+    const std::string resp =
+        ps.fetch("GET /stream10 HTTP/1.0\r\nHost: x\r\n\r\n");
+    // Callback ran inline (ProcessHttp invokes it before responding).
+    EXPECT_EQ(cb_invoked.load(), 1);
+    EXPECT_EQ(write_rc.load(), -1);  // the writer is stillborn
+    // The response is a plain (non-chunked) answer, not a hung stream.
+    EXPECT_EQ(resp.find("Transfer-Encoding: chunked"), std::string::npos);
+    EXPECT_NE(resp.find("HTTP/1.1 200"), std::string::npos);
+
+    // HEAD to the same handler: same notification, headers-only reply.
+    const std::string head =
+        ps.fetch("HEAD /stream10 HTTP/1.1\r\nHost: x\r\n\r\n");
+    EXPECT_EQ(cb_invoked.load(), 2);
+    EXPECT_EQ(head.find("Transfer-Encoding: chunked"), std::string::npos);
+}
+
 TEST(Threads, PortalDumpsRealPthreadStacks) {
     PortalServer ps;
     ASSERT_TRUE(ps.start());

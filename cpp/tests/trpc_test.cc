@@ -995,6 +995,32 @@ TEST(WorkerTags, ServerHandlersRunOnConfiguredPool) {
     }
 }
 
+TEST(WorkerTags, BackupPoolTagRejected) {
+    // Tag 63 is reserved for usercode overload isolation
+    // (kUsercodeBackupTag, policy_tpu_std.h): a user server there would
+    // share the overflow pool and defeat the isolation. Start must
+    // reject it instead of silently sharing.
+    class NopService : public test::EchoService {
+    public:
+        void Echo(google::protobuf::RpcController*, const test::EchoRequest*,
+                  test::EchoResponse*,
+                  google::protobuf::Closure* done) override {
+            done->Run();
+        }
+    };
+    NopService service;
+    Server server;
+    ASSERT_EQ(0, server.AddService(&service));
+    ServerOptions sopts;
+    sopts.fiber_tag = kUsercodeBackupTag;
+    EndPoint listen;
+    str2endpoint("127.0.0.1:0", &listen);
+    EXPECT_NE(0, server.Start(listen, &sopts));
+    // An adjacent, unreserved tag still works.
+    sopts.fiber_tag = kUsercodeBackupTag - 1;
+    ASSERT_EQ(0, server.Start(listen, &sopts));
+}
+
 // ---------------- pluggable retry/backup + timeout limiter + snappy ----------------
 // Reference: retry_policy.h:28-112, backup_request_policy.h,
 // policy/timeout_concurrency_limiter.*, policy/snappy_compress.cpp.

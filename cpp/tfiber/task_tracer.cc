@@ -16,9 +16,18 @@ namespace tpurpc {
 
 namespace {
 
-// Register layout at a saved context SP (cpp/tfiber/context.S):
-constexpr size_t kSavedRbpOff = 0x30;
-constexpr size_t kSavedRipOff = 0x38;
+// Frame-pointer / resume-pc offsets at a saved context SP, per arch:
+// x86-64 (context.S, 0x40-byte frame): rbp at 0x30, rip at 0x38.
+// aarch64 (context_aarch64.S, 0xa0-byte frame): x29 at 0x90, x30 at
+// 0x98 — the x86 offsets would read d12/d13 (callee-saved FP regs) as
+// fp/pc and make every /fibers?st=1 walk garbage.
+#if defined(__aarch64__)
+constexpr size_t kSavedRbpOff = 0x90;  // x29
+constexpr size_t kSavedRipOff = 0x98;  // x30 (resume pc)
+#else
+constexpr size_t kSavedRbpOff = 0x30;  // rbp
+constexpr size_t kSavedRipOff = 0x38;  // rip
+#endif
 
 // Fault-safe read of a word from our own address space: a stack being
 // concurrently recycled/unmapped returns false instead of SIGSEGV.

@@ -8,6 +8,8 @@
 #include <vector>
 #include <cstdio>
 #include <cstdlib>
+#include <cstring>
+#include <mutex>
 #include <string>
 
 #include "tbase/cpu_profiler.h"
@@ -21,11 +23,14 @@
 #include "tfiber/task_group.h"
 #include "tfiber/task_meta.h"
 #include "tfiber/task_tracer.h"
+#include "tnet/fault_injection.h"
 #include "tnet/socket.h"
 #include "trpc/server.h"
 #include "trpc/span.h"
 #include "tvar/multi_dimension.h"
 #include "tvar/variable.h"
+
+DECLARE_bool(chaos_enabled);
 
 namespace tpurpc {
 
@@ -48,6 +53,7 @@ void HandleIndex(Server*, const HttpRequest&, HttpResponse* res) {
         "/memory       allocator statistics\n"
         "/hotspots     profiling (/hotspots/cpu?seconds=N, "
         "/hotspots/contention)\n"
+        "/chaos        fault injection (?enable=1&seed=N&plan=...&peers=...)\n"
         "/metrics      prometheus exposition\n");
 }
 
@@ -297,6 +303,87 @@ void HandleConnections(Server* server, const HttpRequest&,
     }
 }
 
+// /chaos: live fault-injection control + observation
+// (tnet/fault_injection.h). All mutations go through the chaos_* flags
+// (SetFlagValue), so /flags, the command line and this page always
+// agree; the flags' on-change hooks re-apply the plan atomically.
+//   GET /chaos                     -> current config + injection counters
+//   GET /chaos?enable=1&seed=42&plan=drop%3D0.01&peers=ip:port  -> apply
+//   GET /chaos?enable=0            -> disable (plan kept)
+//   GET /chaos?reset=1             -> zero the injection counters
+void HandleChaos(Server*, const HttpRequest& req, HttpResponse* res) {
+    res->set_content_type("text/plain");
+    // Validate EVERYTHING before mutating ANYTHING: a request rejected
+    // with 400 must leave the live configuration untouched (and
+    // StringFlag::SetString accepts any string, so plan/peers need
+    // explicit validation — Reconfigure would otherwise fail closed
+    // silently behind a 200).
+    struct Param {
+        const char* flag;
+        const char* name;
+        bool present = false;
+        std::string value;
+    } params[] = {{"chaos_plan", "plan", false, ""},
+                  {"chaos_peers", "peers", false, ""},
+                  {"chaos_seed", "seed", false, ""},
+                  {"chaos_enabled", "enable", false, ""}};
+    for (Param& p : params) {
+        p.value = req.QueryParam(p.name, &p.present);
+    }
+    auto reject = [&](const Param& p) {
+        res->status = 400;
+        res->Append(std::string("bad ") + p.name + ": '" + p.value +
+                    "' (nothing applied)\n");
+    };
+    for (const Param& p : params) {
+        if (!p.present) continue;
+        bool ok = true;
+        if (strcmp(p.name, "plan") == 0) {
+            ok = FaultInjection::ValidatePlan(p.value);
+        } else if (strcmp(p.name, "peers") == 0) {
+            ok = FaultInjection::ValidatePeers(p.value);
+        } else if (strcmp(p.name, "seed") == 0) {
+            char* end = nullptr;
+            (void)strtoll(p.value.c_str(), &end, 10);
+            ok = end != p.value.c_str() && *end == '\0';
+        } else {  // enable
+            ok = p.value == "0" || p.value == "1" || p.value == "true" ||
+                 p.value == "false";
+        }
+        if (!ok) {
+            reject(p);
+            return;
+        }
+    }
+    // Atomic apply: if chaos is ALREADY running, each per-flag
+    // on-change hook would re-enable against a half-applied request
+    // (new plan + old peers), so force-disable first and restore the
+    // right enable state LAST — serialized against concurrent /chaos
+    // requests (two interleaved applies could otherwise commit a mixed
+    // config or resurrect a healed plan).
+    static std::mutex chaos_apply_mu;
+    std::lock_guard<std::mutex> apply_guard(chaos_apply_mu);
+    const bool config_change =
+        params[0].present || params[1].present || params[2].present;
+    const bool was_enabled = FLAGS_chaos_enabled.get();
+    if (config_change && was_enabled && !params[3].present) {
+        // No explicit enable in the request: keep the previous state.
+        params[3].present = true;
+        params[3].value = "1";
+    }
+    if (config_change) SetFlagValue("chaos_enabled", "0");
+    for (const Param& p : params) {
+        if (p.present && !SetFlagValue(p.flag, p.value)) {
+            reject(p);  // unreachable after validation; belt-and-braces
+            return;
+        }
+    }
+    if (req.QueryParam("reset") == "1") {
+        FaultInjection::ResetCounters();
+    }
+    res->Append(FaultInjection::DebugString());
+}
+
 // Prometheus text exposition: every exposed numeric var becomes a gauge
 // (reference builtin/prometheus_metrics_service.cpp:244 does the same
 // name-sanitize + filter).
@@ -376,6 +463,7 @@ void AddBuiltinHttpServices(Server* server) {
     server->RegisterHttpHandler("/hotspots/cpu", HandleHotspotsCpu);
     server->RegisterHttpHandler("/hotspots/contention",
                                 HandleHotspotsContention);
+    server->RegisterHttpHandler("/chaos", HandleChaos);
     server->RegisterHttpHandler("/metrics", HandleMetrics);
 }
 

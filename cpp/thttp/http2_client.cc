@@ -11,6 +11,7 @@
 #include "tbase/logging.h"
 #include "tbase/time.h"
 #include "tfiber/butex.h"
+#include "tfiber/fiber.h"
 #include "thttp/h2_frames.h"
 #include "thttp/hpack.h"
 #include "tnet/input_messenger.h"
@@ -159,6 +160,35 @@ void CompleteStream(H2ClientSession::RespStream&& st) {
     CompleteClientUnaryResponse(st.cid, 0, "", &st.body);
 }
 
+void* CompleteStreamThunk(void* arg) {
+    auto* st = (H2ClientSession::RespStream*)arg;
+    CompleteStream(std::move(*st));
+    delete st;
+    return nullptr;
+}
+
+// Hand the completion to a background fiber — NEVER complete inline from
+// the in-order input fiber. CompleteClientUnaryResponse blocks in
+// id_lock_range; the lock may be held by this very stream's SENDER parked
+// on h2 flow control (H2ClientSendUnary waits for WINDOW_UPDATEs that
+// only this input fiber can deliver). Observed deadlock: early
+// trailers-only response to a >64KB request — the response completes
+// while the sender still holds the CallId lock waiting for window that
+// never comes (the server already finished the stream). Same discipline
+// as Socket::CloseFdAndDropQueued's id_error fiber hand-off.
+void CompleteStreamInBackground(H2ClientSession::RespStream&& st) {
+    auto* heap = new H2ClientSession::RespStream(std::move(st));
+    fiber_t tid;
+    if (fiber_start_background(&tid, nullptr, CompleteStreamThunk, heap) !=
+        0) {
+        // Out of fibers: inline is the lesser evil (the deadlock needs a
+        // concurrently parked sender; a fiber-exhausted process has
+        // bigger problems and the RPC deadline still bounds it).
+        CompleteStream(std::move(*heap));
+        delete heap;
+    }
+}
+
 // ---------------- frame processing (input fiber, in order) ----------------
 
 class H2ClientFrame : public InputMessageBase {
@@ -199,7 +229,7 @@ void HandleHeaderBlockDone(Socket* s, H2ClientSession* sess,
             finish = true;
         }
     }
-    if (finish) CompleteStream(std::move(done));
+    if (finish) CompleteStreamInBackground(std::move(done));
 }
 
 void ProcessH2ClientFrame(InputMessageBase* raw) {
@@ -367,7 +397,7 @@ void ProcessH2ClientFrame(InputMessageBase* raw) {
                 buf.append(out);
                 s->Write(&buf);
             }
-            if (finish) CompleteStream(std::move(done));
+            if (finish) CompleteStreamInBackground(std::move(done));
             break;
         }
         case H2_RST_STREAM: {

@@ -190,8 +190,19 @@ void ProcessHttp(InputMessageBase* msg_base) {
     // Progressive body (thttp/progressive_attachment.h): chunked header
     // block now; the handler's callback owns the writer from here and
     // streams until Close. Requires a chunked-capable peer.
-    if (res.start_progressive && msg->req.version_minor >= 1 &&
-        msg->req.method != "HEAD") {
+    const bool can_chunk =
+        msg->req.version_minor >= 1 && msg->req.method != "HEAD";
+    if (res.start_progressive && !can_chunk) {
+        // HTTP/1.0 or HEAD can't carry the stream — but the handler
+        // already committed to one. Hand it an already-dead writer
+        // (every Write returns -1) instead of silently sending an empty
+        // 200 it never learns about; the plain response below still
+        // answers the request.
+        auto cb = std::move(res.start_progressive);
+        res.start_progressive = nullptr;
+        cb(std::make_shared<ProgressiveAttachment>(INVALID_VREF_ID));
+    }
+    if (res.start_progressive && can_chunk) {
         res.SetHeader("Transfer-Encoding", "chunked");
         res.headers.erase("Content-Length");
         res.body.clear();

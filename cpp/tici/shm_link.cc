@@ -21,7 +21,9 @@
 #include "tbase/logging.h"
 #include "tbase/time.h"
 #include "tfiber/butex.h"
+#include "tfiber/fiber.h"
 #include "tici/block_pool.h"
+#include "tnet/fault_injection.h"
 #include "tnet/input_messenger.h"
 
 namespace tpurpc {
@@ -123,9 +125,11 @@ void ReleasePeerPool(const char* name) {
 ShmIciEndpoint* ShmIciEndpoint::Create(int tcp_fd, void* ctrl_mapping,
                                        size_t ctrl_size, bool is_client,
                                        const char* peer_pool_name,
-                                       const PeerPool& peer_pool) {
+                                       const PeerPool& peer_pool,
+                                       const EndPoint& peer) {
     auto* e = new ShmIciEndpoint;
     e->tcp_fd_ = tcp_fd;
+    e->peer_ep_ = peer;
     e->ctrl_ = (ShmLinkCtrl*)ctrl_mapping;
     e->ctrl_size_ = ctrl_size;
     e->out_ = is_client ? &e->ctrl_->c2s : &e->ctrl_->s2c;
@@ -218,14 +222,48 @@ ssize_t ShmIciEndpoint::CutFromIOBufList(IOBuf* const* pieces, size_t count) {
     if (pending_bytes == 0) {
         return 0;  // all-empty pieces: match writev-on-empty semantics
     }
+    // Chaos seam (tnet/fault_injection.h), scoped by the link's peer.
+    FaultAction fault;
+    size_t post_cap = (size_t)-1;
+    bool corrupt_next = false;
+    if (__builtin_expect(fault_injection_enabled(), 0)) {
+        fault = FaultInjection::Decide(FaultOp::kWrite, peer_ep_,
+                                       pending_bytes);
+        switch (fault.kind) {
+            case FaultAction::kReset:
+                errno = ECONNRESET;
+                return -1;
+            case FaultAction::kDelay:
+                // Safe to park: with chaos enabled, Socket::FlushOnce
+                // routes every write through the KeepWrite fiber.
+                fiber_usleep(fault.delay_us);
+                break;
+            case FaultAction::kDrop:
+                for (size_t i = 0; i < count; ++i) {
+                    pieces[i]->pop_front(pieces[i]->size());
+                }
+                return (ssize_t)pending_bytes;  // claimed, never posted
+            case FaultAction::kShort:
+                post_cap = fault.max_bytes > 0 ? fault.max_bytes : 1;
+                break;
+            case FaultAction::kCorrupt:
+                // Force the first fragment through the bounce path so
+                // the flip lands in OUR copy, never in a shared source
+                // block.
+                corrupt_next = true;
+                break;
+            default:
+                break;
+        }
+    }
     for (size_t i = 0; i < count && head < limit; ++i) {
         IOBuf* buf = pieces[i];
-        while (head < limit && !buf->empty()) {
+        while (head < limit && !buf->empty() && (size_t)posted < post_cap) {
             ShmPipe::Desc& d = p->ring[head % ShmPipe::kDepth];
             size_t flen = 0;
             const char* fdata = buf->backing_block_data(0, &flen);
             uint64_t off;
-            if (IciBlockPool::OffsetOf(fdata, &off)) {
+            if (!corrupt_next && IciBlockPool::OffsetOf(fdata, &off)) {
                 // Zero-copy: the bytes already live in our registered
                 // (shared) region; post the offset and hold the block ref
                 // until the peer's consumed counter passes it.
@@ -285,6 +323,10 @@ ssize_t ShmIciEndpoint::CutFromIOBufList(IOBuf* const* pieces, size_t count) {
                     flen < (size_t)b->cap ? flen : (size_t)b->cap;
                 buf->copy_to(b->data, n, 0);
                 buf->pop_front(n);
+                if (corrupt_next && n > 0) {
+                    b->data[fault.aux % n] ^= 0x20;  // our bounce copy
+                    corrupt_next = false;
+                }
                 d.off = boff;
                 d.len = (uint32_t)n;
                 sbuf_[head % ShmPipe::kDepth] = b;
@@ -350,6 +392,20 @@ ssize_t ShmIciEndpoint::Pump(IOPortal* dst) {
     butex_word(writable_butex_)->fetch_add(1, std::memory_order_release);
     butex_wake_all(writable_butex_);
 
+    // Chaos seam: inbound faults on the resolved descriptor payloads.
+    FaultAction fault;
+    if (__builtin_expect(fault_injection_enabled(), 0)) {
+        fault = FaultInjection::Decide(FaultOp::kRead, peer_ep_, 0);
+        if (fault.kind == FaultAction::kReset) {
+            tcp_eof_.store(true, std::memory_order_release);
+            errno = ECONNRESET;
+            return -1;
+        }
+        if (fault.kind == FaultAction::kDelay) {
+            fiber_usleep(fault.delay_us);
+        }
+    }
+
     // 3. Receive: resolve descriptors against the peer's registered
     //    memory and copy once into dst (the "DMA").
     ShmPipe* p = in_;
@@ -383,10 +439,36 @@ ssize_t ShmIciEndpoint::Pump(IOPortal* dst) {
                 errno = TERR_REQUEST;
                 return -1;
             }
-            dst->append(peer_base_ + d.off, d.len);
+            if (fault.kind == FaultAction::kDrop) {
+                // Consume without delivering: the bytes vanish (the
+                // sender's credits are still returned).
+            } else if (fault.kind == FaultAction::kCorrupt &&
+                       received == 0 && d.len > 0) {
+                // Flip one byte of the first fragment via a copy window
+                // (the peer's pool is mapped read-only).
+                char window[512];
+                const size_t wn =
+                    d.len < sizeof(window) ? d.len : sizeof(window);
+                memcpy(window, peer_base_ + d.off, wn);
+                window[fault.aux % wn] ^= 0x20;
+                dst->append(window, wn);
+                if (d.len > wn) {
+                    dst->append(peer_base_ + d.off + wn, d.len - wn);
+                }
+            } else {
+                dst->append(peer_base_ + d.off, d.len);
+            }
             received += d.len;
             ++tail;
             p->tail.store(tail, std::memory_order_release);
+            if (fault.kind == FaultAction::kShort) {
+                // Short read: deliver only this first descriptor; the
+                // rest stays ring-buffered for the next pump.
+                if (p->tx_waiting.load(std::memory_order_acquire) != 0) {
+                    SendDoorbell();
+                }
+                return received;
+            }
         }
         // Consumed -> credits freed on the peer: ring its doorbell if its
         // writer parked (piggybacked-ACK wakeup).
@@ -573,8 +655,9 @@ int IciConnect(const EndPoint& server, InputMessenger* messenger,
     shm_unlink(link_name);
 
     // 5. Endpoint + socket: the TCP fd doubles as the socket's event fd.
-    ShmIciEndpoint* ep = ShmIciEndpoint::Create(
-        fd, mem, sizeof(ShmLinkCtrl), /*is_client=*/true, rsp.pool_name, pp);
+    ShmIciEndpoint* ep =
+        ShmIciEndpoint::Create(fd, mem, sizeof(ShmLinkCtrl),
+                               /*is_client=*/true, rsp.pool_name, pp, server);
     SocketOptions opts;
     opts.fd = fd;
     opts.remote_side = server;
@@ -704,7 +787,7 @@ void ProcessIciHandshake(InputMessageBase* msg_base) {
     // doorbell bytes must be drained by Pump, not parsed as a protocol.
     ShmIciEndpoint* ep = ShmIciEndpoint::Create(
         s->fd(), ctrl_mem, sizeof(ShmLinkCtrl), /*is_client=*/false,
-        req.pool_name, pp);
+        req.pool_name, pp, s->remote_side());
     s->InstallTransport(ep);
     snprintf(rsp.pool_name, sizeof(rsp.pool_name), "%s",
              IciBlockPool::shm_name());

@@ -138,11 +138,15 @@ public:
 
     // Build one side. Takes ownership of tcp_fd and of the ctrl mapping;
     // acquires a ref on the peer pool (released in Release()).
-    // `is_client`: which pipe this side produces into.
+    // `is_client`: which pipe this side produces into. `peer` is the
+    // remote's endpoint (server address on the client side, ephemeral
+    // peer address on the server side) — used for per-peer
+    // fault-injection scoping (tnet/fault_injection.h).
     static ShmIciEndpoint* Create(int tcp_fd, void* ctrl_mapping,
                                   size_t ctrl_size, bool is_client,
                                   const char* peer_pool_name,
-                                  const shm_internal::PeerPool& peer_pool);
+                                  const shm_internal::PeerPool& peer_pool,
+                                  const EndPoint& peer);
 
 private:
     ShmIciEndpoint() = default;
@@ -152,6 +156,7 @@ private:
     void SendDoorbell();
 
     int tcp_fd_ = -1;
+    EndPoint peer_ep_;  // fault-injection scoping identity
     shm_internal::ShmLinkCtrl* ctrl_ = nullptr;
     size_t ctrl_size_ = 0;
     shm_internal::ShmPipe* out_ = nullptr;

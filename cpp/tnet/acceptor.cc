@@ -10,6 +10,7 @@
 #include "tbase/logging.h"
 #include "tbase/time.h"
 #include "tfiber/fiber.h"
+#include "tnet/fault_injection.h"
 #include "tnet/tls.h"
 
 namespace tpurpc {
@@ -159,6 +160,18 @@ void Acceptor::OnNewConnections(Socket* listen_socket) {
                 continue;
             }
             return;
+        }
+        // Chaos: accept-time connection refusal — the peer sees an
+        // immediate close (EOF/RST), exercising its connect retry and
+        // health-check paths. (The remote here is the peer's ephemeral
+        // address, so per-peer plans usually scope this via an empty
+        // peers filter.)
+        if (__builtin_expect(fault_injection_enabled(), 0) &&
+            FaultInjection::Decide(FaultOp::kAccept, sockaddr2endpoint(peer),
+                                   0)
+                    .kind == FaultAction::kRefuse) {
+            close(fd);
+            continue;
         }
         SocketOptions opts;
         opts.fd = fd;

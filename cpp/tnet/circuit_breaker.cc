@@ -4,6 +4,7 @@
 
 #include "tbase/errno.h"
 #include "tbase/flags.h"
+#include "tvar/reducer.h"
 
 // Defaults shaped like the reference's (src/brpc/circuit_breaker.cpp
 // flags circuit_breaker_short_window_size/..._error_percent etc.).
@@ -49,7 +50,12 @@ bool CircuitBreaker::OnCallEnd(int error_code, int64_t latency_us) {
     const bool error = error_code != 0;
     bool ok = short_.OnCallEnd(error);
     ok = long_.OnCallEnd(error) && ok;
-    if (!ok) MarkAsBroken();
+    if (!ok && MarkAsBroken()) {
+        // Per-process isolation count, observable in /vars and /metrics
+        // (the mesh chaos soak asserts on it).
+        static LazyAdder isolations("rpc_circuit_breaker_isolations");
+        *isolations << 1;
+    }
     return ok;
 }
 

@@ -78,12 +78,15 @@ public:
     // check). error_code 0 = success.
     bool OnCallEnd(int error_code, int64_t latency_us);
 
-    void MarkAsBroken() {
+    // Returns true for the ONE caller that transitioned this episode.
+    bool MarkAsBroken() {
         // exchange: concurrent trippers in the same episode must count it
         // once or the backoff doubling overshoots.
         if (!broken_.exchange(true, std::memory_order_acq_rel)) {
             isolated_times_.fetch_add(1, std::memory_order_relaxed);
+            return true;
         }
+        return false;
     }
 
     // How long the node should stay isolated before the health checker may

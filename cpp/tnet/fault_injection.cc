@@ -1,0 +1,338 @@
+#include "tnet/fault_injection.h"
+
+#include <algorithm>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <vector>
+
+#include "tbase/doubly_buffered_data.h"
+#include "tbase/flags.h"
+#include "tbase/logging.h"
+#include "tvar/reducer.h"
+
+// The whole chaos configuration is flag-driven so it can be set on the
+// command line (--flagfile-less: flags parse via SetFlagValue), through
+// /flags, or through the /chaos portal page — all three converge on
+// FaultInjection::Reconfigure() via the flags' on-change hooks.
+DEFINE_bool(chaos_enabled, false,
+            "master switch for transport fault injection (the only check "
+            "on the I/O hot path)");
+DEFINE_int64(chaos_seed, 1,
+             "seed of the deterministic injection sequence; replaying a "
+             "seed against the same call sequence reproduces the same "
+             "faults");
+DEFINE_string(chaos_plan, "",
+              "comma list of kind=probability[:param] entries; kinds: "
+              "drop, delay (param = microseconds, default 2000), short, "
+              "corrupt, reset (read/write ops) and refuse "
+              "(accept/connect); e.g. "
+              "'drop=0.01,delay=0.05:2000,corrupt=0.001,refuse=0.1'");
+DEFINE_string(chaos_peers, "",
+              "comma list of ip:port remote endpoints the plan applies "
+              "to; empty = all peers. Non-matching traffic neither "
+              "injects nor consumes a decision tick");
+
+namespace tpurpc {
+
+namespace fault_internal {
+std::atomic<bool> g_chaos_on{false};
+}  // namespace fault_internal
+
+namespace {
+
+// splitmix64: the canonical 64-bit mixer — decision n is mix(seed + n*phi).
+inline uint64_t splitmix64(uint64_t x) {
+    x += 0x9e3779b97f4a7c15ull;
+    x = (x ^ (x >> 30)) * 0xbf58476d1ce4e5b9ull;
+    x = (x ^ (x >> 27)) * 0x94d049bb133111ebull;
+    return x ^ (x >> 31);
+}
+
+inline double to_unit(uint64_t r) {
+    return (double)(r >> 11) * 0x1.0p-53;  // uniform [0, 1)
+}
+
+// Kind -> name, indexed by FaultAction::Kind (tvar suffixes AND the
+// /chaos page lines — one table so they can never desynchronize).
+const char* const kKindNames[FaultAction::kKindCount] = {
+    "none", "delay", "short", "drop", "corrupt", "reset", "refuse"};
+
+struct FaultPlan {
+    // Read/write fault probabilities (selected by one uniform draw over
+    // cumulative ranges, so at most one fault fires per operation).
+    double drop = 0.0;
+    double delay = 0.0;
+    double short_io = 0.0;
+    double corrupt = 0.0;
+    double reset = 0.0;
+    // Accept/connect-time probability.
+    double refuse = 0.0;
+    int64_t delay_us = 2000;
+    std::vector<EndPoint> peers;  // empty = every peer
+
+    bool Matches(const EndPoint& peer) const {
+        if (peers.empty()) return true;
+        for (const EndPoint& p : peers) {
+            if (p == peer) return true;
+        }
+        return false;
+    }
+};
+
+struct Engine {
+    DoublyBufferedData<FaultPlan> plan;
+    std::atomic<uint64_t> seed{1};
+    std::atomic<uint64_t> seq{0};  // decision counter (determinism core)
+    Adder<int64_t> injected[FaultAction::kKindCount];
+    Adder<int64_t> ndecisions;
+
+    Engine() {
+        for (int k = FaultAction::kDelay; k < FaultAction::kKindCount; ++k) {
+            injected[k].expose(std::string("chaos_injected_") +
+                               kKindNames[k]);
+        }
+        ndecisions.expose("chaos_decisions");
+    }
+};
+
+Engine& engine() {
+    // Leaked singleton: seams may consult it during static teardown of
+    // server objects (same immortality rule as the shm peer-pool
+    // registry).
+    static Engine* e = new Engine;
+    return *e;
+}
+
+bool parse_double(const char* s, const char* end, double* out) {
+    if (s == end) return false;  // empty probability ("drop=") rejects
+    char* e = nullptr;
+    *out = strtod(s, &e);
+    return e == end && *out >= 0.0 && *out <= 1.0;
+}
+
+// "drop=0.01,delay=0.05:2000,short=0.1,corrupt=0.001,reset=0.01,refuse=0.1"
+bool ParsePlan(const std::string& text, FaultPlan* plan) {
+    size_t pos = 0;
+    while (pos < text.size()) {
+        size_t comma = text.find(',', pos);
+        if (comma == std::string::npos) comma = text.size();
+        const std::string entry = text.substr(pos, comma - pos);
+        pos = comma + 1;
+        if (entry.empty()) continue;
+        const size_t eq = entry.find('=');
+        if (eq == std::string::npos) return false;
+        const std::string kind = entry.substr(0, eq);
+        std::string value = entry.substr(eq + 1);
+        std::string param_str;
+        const size_t colon = value.find(':');
+        if (colon != std::string::npos) {
+            param_str = value.substr(colon + 1);
+            value.resize(colon);
+            if (param_str.empty()) return false;  // trailing ':'
+        }
+        double prob = 0.0;
+        if (!parse_double(value.c_str(), value.c_str() + value.size(),
+                          &prob)) {
+            return false;
+        }
+        // Only delay takes a :param (microseconds); junk like "5ms" or a
+        // param on another kind must REJECT, not silently half-apply
+        // (the /chaos page promises validate-before-mutate).
+        if (!param_str.empty() && kind != "delay") return false;
+        if (kind == "drop") {
+            plan->drop = prob;
+        } else if (kind == "delay") {
+            plan->delay = prob;
+            if (!param_str.empty()) {
+                char* end = nullptr;
+                const long long us = strtoll(param_str.c_str(), &end, 10);
+                if (end == param_str.c_str() || *end != '\0' || us <= 0) {
+                    return false;
+                }
+                plan->delay_us = us;
+            }
+        } else if (kind == "short") {
+            plan->short_io = prob;
+        } else if (kind == "corrupt") {
+            plan->corrupt = prob;
+        } else if (kind == "reset") {
+            plan->reset = prob;
+        } else if (kind == "refuse") {
+            plan->refuse = prob;
+        } else {
+            return false;
+        }
+    }
+    return true;
+}
+
+bool ParsePeers(const std::string& text, std::vector<EndPoint>* peers) {
+    size_t pos = 0;
+    while (pos < text.size()) {
+        size_t comma = text.find(',', pos);
+        if (comma == std::string::npos) comma = text.size();
+        const std::string entry = text.substr(pos, comma - pos);
+        pos = comma + 1;
+        if (entry.empty()) continue;
+        EndPoint ep;
+        if (str2endpoint(entry.c_str(), &ep) != 0) return false;
+        peers->push_back(ep);
+    }
+    return true;
+}
+
+// Install the on-change hooks AFTER the flags above are constructed
+// (top-down order within this TU guarantees that).
+struct HookInstaller {
+    HookInstaller() {
+        // Seed/plan changes start a fresh deterministic sequence (and
+        // zero the counters for replay comparison); enable/peers edits
+        // must NOT — healing with enable=0 keeps the run's counters
+        // readable.
+        FLAGS_chaos_enabled.set_on_change(&FaultInjection::Reconfigure);
+        FLAGS_chaos_seed.set_on_change(
+            &FaultInjection::ReconfigureAndReset);
+        FLAGS_chaos_plan.set_on_change(
+            &FaultInjection::ReconfigureAndReset);
+        FLAGS_chaos_peers.set_on_change(&FaultInjection::Reconfigure);
+    }
+} g_hook_installer;
+
+}  // namespace
+
+bool FaultInjection::ValidatePlan(const std::string& plan) {
+    FaultPlan scratch;
+    return ParsePlan(plan, &scratch);
+}
+
+bool FaultInjection::ValidatePeers(const std::string& peers) {
+    std::vector<EndPoint> scratch;
+    return ParsePeers(peers, &scratch);
+}
+
+void FaultInjection::Reconfigure() {
+    Engine& e = engine();
+    FaultPlan parsed;
+    if (!ParsePlan(FLAGS_chaos_plan.get(), &parsed)) {
+        LOG(ERROR) << "chaos_plan unparsable: '" << FLAGS_chaos_plan.get()
+                   << "'; fault injection disabled";
+        fault_internal::g_chaos_on.store(false, std::memory_order_release);
+        return;
+    }
+    if (!ParsePeers(FLAGS_chaos_peers.get(), &parsed.peers)) {
+        LOG(ERROR) << "chaos_peers unparsable: '" << FLAGS_chaos_peers.get()
+                   << "'; fault injection disabled";
+        fault_internal::g_chaos_on.store(false, std::memory_order_release);
+        return;
+    }
+    e.plan.Modify([&](FaultPlan& p) {
+        p = parsed;
+        return true;
+    });
+    e.seed.store((uint64_t)FLAGS_chaos_seed.get(),
+                 std::memory_order_release);
+    // Enable LAST so no decision runs against a half-applied plan.
+    fault_internal::g_chaos_on.store(FLAGS_chaos_enabled.get(),
+                                     std::memory_order_release);
+}
+
+void FaultInjection::ReconfigureAndReset() {
+    // Disable while swapping so no decision interleaves between the
+    // counter reset and the re-apply (a tick against the old sequence
+    // would break seed replay).
+    fault_internal::g_chaos_on.store(false, std::memory_order_release);
+    Engine& e = engine();
+    // Quiesce in-flight Decide calls: each one holds a DoublyBufferedData
+    // read scope for its whole body (including the seq tick), and Modify
+    // serializes with every reader — after this no-op barrier, a fiber
+    // that slipped past the enabled gate has finished its tick, so the
+    // fresh sequence really does start at decision 0.
+    e.plan.Modify([](FaultPlan&) { return true; });
+    e.seq.store(0, std::memory_order_release);
+    ResetCounters();
+    Reconfigure();
+}
+
+FaultAction FaultInjection::Decide(FaultOp op, const EndPoint& peer,
+                                   size_t len) {
+    FaultAction action;
+    Engine& e = engine();
+    DoublyBufferedData<FaultPlan>::ScopedPtr p;
+    if (e.plan.Read(&p) != 0) return action;
+    // Scope check BEFORE consuming a tick: unrelated traffic must not
+    // shift the replayed sequence.
+    if (!p->Matches(peer)) return action;
+    const uint64_t n = e.seq.fetch_add(1, std::memory_order_relaxed);
+    const uint64_t r =
+        splitmix64(e.seed.load(std::memory_order_relaxed) +
+                   n * 0x9e3779b97f4a7c15ull);
+    const double u = to_unit(r);
+    e.ndecisions << 1;
+    if (op == FaultOp::kAccept || op == FaultOp::kConnect) {
+        if (u < p->refuse) action.kind = FaultAction::kRefuse;
+    } else {
+        double acc = 0.0;
+        if (u < (acc += p->drop)) {
+            action.kind = FaultAction::kDrop;
+        } else if (u < (acc += p->delay)) {
+            action.kind = FaultAction::kDelay;
+            action.delay_us = p->delay_us;
+        } else if (u < (acc += p->short_io)) {
+            action.kind = FaultAction::kShort;
+            // Cap to a deterministic fraction of the operation (at least
+            // one byte so progress invariants hold).
+            const uint64_t r2 = splitmix64(r);
+            action.max_bytes = len > 1 ? 1 + (size_t)(r2 % (len - 1)) : 1;
+        } else if (u < (acc += p->corrupt)) {
+            action.kind = FaultAction::kCorrupt;
+            action.aux = splitmix64(r ^ 0xc0ffee);
+        } else if (u < (acc += p->reset)) {
+            action.kind = FaultAction::kReset;
+        }
+    }
+    if (action.kind != FaultAction::kNone) {
+        e.injected[action.kind] << 1;
+    }
+    return action;
+}
+
+int64_t FaultInjection::injected_count(FaultAction::Kind k) {
+    if (k <= FaultAction::kNone || k >= FaultAction::kKindCount) return 0;
+    return engine().injected[k].get_value();
+}
+
+int64_t FaultInjection::decisions() { return engine().ndecisions.get_value(); }
+
+void FaultInjection::ResetCounters() {
+    Engine& e = engine();
+    for (int k = FaultAction::kDelay; k < FaultAction::kKindCount; ++k) {
+        e.injected[k].reset();
+    }
+    e.ndecisions.reset();
+}
+
+std::string FaultInjection::DebugString() {
+    Engine& e = engine();
+    std::string out;
+    char line[256];
+    snprintf(line, sizeof(line), "enabled %d\n",
+             fault_injection_enabled() ? 1 : 0);
+    out += line;
+    snprintf(line, sizeof(line), "seed %lld\n",
+             (long long)e.seed.load(std::memory_order_relaxed));
+    out += line;
+    out += "plan " + FLAGS_chaos_plan.get() + "\n";
+    out += "peers " + FLAGS_chaos_peers.get() + "\n";
+    snprintf(line, sizeof(line), "decisions %lld\n",
+             (long long)e.ndecisions.get_value());
+    out += line;
+    for (int k = FaultAction::kDelay; k < FaultAction::kKindCount; ++k) {
+        snprintf(line, sizeof(line), "injected_%s %lld\n", kKindNames[k],
+                 (long long)e.injected[k].get_value());
+        out += line;
+    }
+    return out;
+}
+
+}  // namespace tpurpc

@@ -1,0 +1,111 @@
+// Deterministic, seeded fault-injection seam for the transport layer.
+//
+// The robustness stack (circuit breaker, health check, retries, backup
+// requests, EOVERCROWDED, crc32c body checksums) is only proven if it is
+// exercised adversarially. This layer lets the transport seams — fd
+// read/write (tnet/socket.cc, tnet/input_messenger.cc), TLS
+// (tnet/tls.cc), shared-memory links (tici/shm_link.cc) and accept/
+// connect time — consult one process-wide fault plan and inject drops,
+// delays, short reads/writes, payload corruption, connection resets and
+// refusals.
+//
+// Design rules:
+//  - Zero overhead when disabled: seams gate on `fault_injection_enabled()`,
+//    a single relaxed atomic load; nothing else runs.
+//  - Deterministic: decision n of a (seed, plan) pair is a pure function
+//    of n (splitmix64 over a monotone counter). Replaying the same seed
+//    against the same call sequence reproduces the same injection
+//    sequence — asserted by ttest FaultInjection.DeterministicReplay.
+//  - Per-peer scoping: the plan may name remote endpoints; traffic to
+//    other peers neither injects nor consumes a decision tick, so
+//    unrelated connections do not perturb the replayed sequence.
+//  - Live toggling: the chaos_* flags (tbase/flags) re-apply on every
+//    set (on-change hook), and the /chaos portal page
+//    (thttp/builtin_services.cc) drives them over HTTP.
+//  - Observable: every injection bumps a tvar Adder exported as
+//    chaos_injected_<kind> (visible in /vars, /metrics and /chaos).
+#pragma once
+
+#include <atomic>
+#include <cstddef>
+#include <cstdint>
+#include <string>
+
+#include "tbase/endpoint.h"
+
+namespace tpurpc {
+
+// Where in the transport a decision is being made.
+enum class FaultOp {
+    kWrite = 0,    // outbound bytes (fd writev / TLS write / shm post)
+    kRead = 1,     // inbound bytes (fd read / TLS read / shm pump)
+    kAccept = 2,   // server accept time
+    kConnect = 3,  // client connect time
+};
+
+// What the consulting seam should do.
+struct FaultAction {
+    enum Kind {
+        kNone = 0,
+        kDelay,    // sleep delay_us, then proceed normally
+        kShort,    // cap this I/O to max_bytes (short read/write)
+        kDrop,     // claim success but discard the bytes
+        kCorrupt,  // flip one byte of the payload (crc32c's job to catch)
+        kReset,    // fail the operation with ECONNRESET
+        kRefuse,   // refuse the connection (accept/connect only)
+        kKindCount  // sentinel (counter array size)
+    };
+    Kind kind = kNone;
+    int64_t delay_us = 0;   // kDelay
+    size_t max_bytes = 0;   // kShort: cap for this operation
+    uint64_t aux = 0;       // kCorrupt: deterministic byte-position seed
+};
+
+namespace fault_internal {
+// The one hot-path word. Seams read it inline; everything behind it is
+// out-of-line in fault_injection.cc.
+extern std::atomic<bool> g_chaos_on;
+}  // namespace fault_internal
+
+// Hot-path gate: one relaxed load, no function call when disabled.
+inline bool fault_injection_enabled() {
+    return fault_internal::g_chaos_on.load(std::memory_order_relaxed);
+}
+
+class FaultInjection {
+public:
+    // Decide the fault (if any) for one operation of `len` bytes against
+    // `peer`. Only call when fault_injection_enabled().
+    static FaultAction Decide(FaultOp op, const EndPoint& peer, size_t len);
+
+    // Re-read the chaos_* flags into the live plan (the chaos_enabled /
+    // chaos_peers on-change hook). Does NOT touch the decision counter
+    // or the injection counters — disabling after a run must leave the
+    // counters readable for the replay-diff workflow.
+    static void Reconfigure();
+
+    // Reconfigure() plus a fresh deterministic sequence: resets the
+    // decision counter AND the injection counters (the chaos_seed /
+    // chaos_plan on-change hook — re-applying a seed replays from
+    // decision 0, and two runs of the same seed are directly
+    // comparable).
+    static void ReconfigureAndReset();
+
+    // True when the strings would parse (Reconfigure fails closed —
+    // disables injection — on unparsable input; callers that want to
+    // REJECT instead, like the /chaos page, validate first).
+    static bool ValidatePlan(const std::string& plan);
+    static bool ValidatePeers(const std::string& peers);
+
+    // Current config + counters, one "key value" pair per line (the
+    // /chaos page body; also convenient for tests).
+    static std::string DebugString();
+
+    // Counters (injected_count is also exported via the
+    // chaos_injected_<kind> tvars).
+    static int64_t injected_count(FaultAction::Kind k);
+    static int64_t decisions();
+    static void ResetCounters();
+};
+
+}  // namespace tpurpc

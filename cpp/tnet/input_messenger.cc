@@ -1,15 +1,60 @@
 #include "tnet/input_messenger.h"
 
+#include <sys/socket.h>
+
+#include <algorithm>
 #include <cerrno>
 
 #include "tbase/errno.h"
 #include "tbase/logging.h"
 #include "tfiber/fiber.h"
+#include "tnet/fault_injection.h"
 #include "tnet/transport.h"
 
 namespace tpurpc {
 
 namespace {
+
+constexpr size_t kReadBurst = 512 * 1024;
+
+// Chaos seam for the plain-fd read path (transports consult the
+// injection layer inside their own Pump implementations). Same contract
+// as append_from_file_descriptor: >0 bytes made progress, 0 EOF, -1 with
+// errno (EAGAIN = drained).
+ssize_t ChaosReadFromFd(Socket* s) {
+    const FaultAction fa =
+        FaultInjection::Decide(FaultOp::kRead, s->remote_side(), kReadBurst);
+    switch (fa.kind) {
+        case FaultAction::kReset:
+            errno = ECONNRESET;
+            return -1;
+        case FaultAction::kDelay:
+            fiber_usleep(fa.delay_us);
+            break;
+        case FaultAction::kShort:
+            return s->read_buf.append_from_file_descriptor(
+                s->fd(), std::max<size_t>(1, fa.max_bytes));
+        case FaultAction::kDrop: {
+            // Read and discard: bytes vanish from the stream (the peer
+            // believes they arrived). Returning r > 0 with nothing
+            // appended just reports progress to the caller's loop.
+            char tmp[4096];
+            const ssize_t r = recv(s->fd(), tmp, sizeof(tmp), 0);
+            return r;
+        }
+        case FaultAction::kCorrupt: {
+            char tmp[4096];
+            const ssize_t r = recv(s->fd(), tmp, sizeof(tmp), 0);
+            if (r <= 0) return r;
+            tmp[fa.aux % (uint64_t)r] ^= 0x20;
+            s->read_buf.append(tmp, (size_t)r);
+            return r;
+        }
+        default:
+            break;
+    }
+    return s->read_buf.append_from_file_descriptor(s->fd(), kReadBurst);
+}
 
 struct ProcessArgs {
     InputMessageBase* msg;
@@ -70,11 +115,15 @@ void InputMessenger::OnNewMessages(Socket* s) {
             // ICI transport sockets pump their completion queue (identical
             // nr semantics); fd sockets readv (reference
             // input_messenger.cpp:416 checks _rdma_state the same way).
-            const ssize_t nr =
-                s->transport() != nullptr
-                    ? s->transport()->Pump(&s->read_buf)
-                    : s->read_buf.append_from_file_descriptor(s->fd(),
-                                                              512 * 1024);
+            ssize_t nr;
+            if (s->transport() != nullptr) {
+                nr = s->transport()->Pump(&s->read_buf);
+            } else if (__builtin_expect(fault_injection_enabled(), 0)) {
+                nr = ChaosReadFromFd(s);
+            } else {
+                nr = s->read_buf.append_from_file_descriptor(s->fd(),
+                                                             kReadBurst);
+            }
             if (nr > 0) {
                 s->add_bytes_read(nr);
             } else if (nr == 0) {

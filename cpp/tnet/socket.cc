@@ -18,8 +18,10 @@
 #include "tbase/time.h"
 #include "tfiber/call_id.h"
 #include "tnet/event_dispatcher.h"
+#include "tnet/fault_injection.h"
 #include "tnet/tls.h"
 #include "tnet/transport.h"
+#include "tvar/reducer.h"
 
 DEFINE_int64(socket_max_unwritten_bytes, 64 * 1024 * 1024,
              "write backlog limit before EOVERCROWDED back-pressure");
@@ -40,6 +42,10 @@ DEFINE_string(health_check_path, "",
               "server; empty = TCP connect probe only");
 
 namespace tpurpc {
+
+// Health-check revivals, observable in /vars and /metrics (the mesh
+// chaos soak asserts on it).
+static LazyAdder g_hc_revives("rpc_health_check_revives");
 
 static int make_non_blocking(int fd) {
     const int flags = fcntl(fd, F_GETFL, 0);
@@ -280,6 +286,7 @@ int Socket::ReviveAfterHealthCheck() {
     auth_user_.clear();
     const int rc = Revive();
     if (rc == 0) {
+        *g_hc_revives << 1;
         LOG(INFO) << "Revived socket id=" << id()
                   << " remote=" << endpoint2str(remote_side_);
     }
@@ -503,6 +510,15 @@ void Socket::DrainWriteQueue() {
 // (queue balanced) or the socket failed; false when it should continue
 // (only with allow_block=false on EAGAIN).
 bool Socket::FlushOnce(bool allow_block) {
+    // Chaos mode routes EVERY write through the KeepWrite fiber: the
+    // inline flush runs on the caller's fiber, possibly under its locks
+    // (h2 senders hold the session mutex across Socket::Write), where an
+    // injected delay's fiber_usleep could park and unlock a std::mutex
+    // from another thread (UB). In the KeepWrite fiber every seam —
+    // including the TLS/shm transports' own — may sleep safely.
+    if (__builtin_expect(fault_injection_enabled(), 0) && !allow_block) {
+        return false;  // caller spawns KeepWrite
+    }
     int64_t& consumed = writer_consumed_;
     while (true) {
         // Refill the owned batch.
@@ -542,14 +558,77 @@ bool Socket::FlushOnce(bool allow_block) {
              i < inflight_batch_.size() && npieces < 64; ++i) {
             pieces[npieces++] = &inflight_batch_[i]->data;
         }
+        // Chaos seam (tnet/fault_injection.h): one flag load when
+        // disabled; when a fault fires it replaces or perturbs this
+        // round's writev. Plain-fd sockets only — TLS and shm transports
+        // consult the injection layer inside their own
+        // CutFromIOBufList/Pump (stacking both seams would double-count
+        // decisions and double the effective fault rate), mirroring the
+        // transport()==nullptr gate on the read path.
+        ssize_t nw = 0;
+        bool fault_io = false;
+        if (__builtin_expect(fault_injection_enabled(), 0) &&
+            transport_ == nullptr) {
+            size_t total = 0;
+            for (size_t i = 0; i < npieces; ++i) total += pieces[i]->size();
+            const FaultAction fa =
+                FaultInjection::Decide(FaultOp::kWrite, remote_side_, total);
+            switch (fa.kind) {
+                case FaultAction::kReset:
+                    SetFailedWithError(ECONNRESET);
+                    DrainWriteQueue();
+                    return true;
+                case FaultAction::kDelay:
+                    // Safe: chaos mode runs every flush on the
+                    // KeepWrite fiber (see the gate at the top).
+                    fiber_usleep(fa.delay_us);
+                    break;
+                case FaultAction::kDrop:
+                    // Claim success, discard the bytes: the peer sees a
+                    // truncated stream (parse error / stall) and this
+                    // side's RPCs ride their timeouts.
+                    for (size_t i = 0; i < npieces; ++i) {
+                        pieces[i]->pop_front(pieces[i]->size());
+                    }
+                    nw = (ssize_t)total;
+                    fault_io = true;
+                    break;
+                case FaultAction::kShort:
+                case FaultAction::kCorrupt: {
+                    // Write a bounded copied prefix (flipping one byte
+                    // for kCorrupt — never mutate the shared IOBuf
+                    // blocks in place) and let the normal partial-write
+                    // machinery handle the remainder.
+                    char tmp[2048];
+                    IOBuf* first = pieces[0];
+                    size_t n = std::min(first->size(), sizeof(tmp));
+                    if (fa.kind == FaultAction::kShort && fa.max_bytes > 0) {
+                        n = std::min(n, fa.max_bytes);
+                    }
+                    n = first->copy_to(tmp, n);
+                    if (n == 0) break;
+                    if (fa.kind == FaultAction::kCorrupt) {
+                        tmp[fa.aux % n] ^= 0x20;
+                    }
+                    const ssize_t w = ::write(fd(), tmp, n);
+                    if (w > 0) first->pop_front((size_t)w);
+                    nw = w;
+                    fault_io = true;
+                    break;
+                }
+                default:
+                    break;
+            }
+        }
         // Data plane: ICI queue pair when plugged (the RdmaEndpoint
         // bypass — reference socket.cpp checks _rdma_state on the write
         // path), else the fd.
-        const ssize_t nw =
-            transport_ != nullptr
-                ? transport_->CutFromIOBufList(pieces, npieces)
-                : IOBuf::cut_multiple_into_file_descriptor(fd(), pieces,
-                                                           npieces);
+        if (!fault_io) {
+            nw = transport_ != nullptr
+                     ? transport_->CutFromIOBufList(pieces, npieces)
+                     : IOBuf::cut_multiple_into_file_descriptor(fd(), pieces,
+                                                                npieces);
+        }
         if (nw < 0) {
             if (errno == EAGAIN || errno == EWOULDBLOCK) {
                 if (!allow_block) return false;  // caller spawns KeepWrite
@@ -630,6 +709,15 @@ int Socket::ConnectIfNot() {
             butex_wait(connect_butex_, v, &abst);
         }
         return (fd() >= 0 && !Failed()) ? 0 : -1;
+    }
+    // Chaos: connect-time refusal — the client-side mirror of the
+    // acceptor's refuse (exercises retry + LB re-selection).
+    if (__builtin_expect(fault_injection_enabled(), 0) &&
+        FaultInjection::Decide(FaultOp::kConnect, remote_side_, 0).kind ==
+            FaultAction::kRefuse) {
+        connecting_.store(false, std::memory_order_release);
+        errno = ECONNREFUSED;
+        return -1;
     }
     const int sock = ::socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK, 0);
     if (sock < 0) {

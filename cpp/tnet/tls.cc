@@ -1,7 +1,9 @@
 #include "tnet/tls.h"
 
 #include <dlfcn.h>
+#include <netinet/in.h>
 #include <poll.h>
+#include <sys/socket.h>
 #include <unistd.h>
 
 #include <algorithm>
@@ -9,8 +11,11 @@
 #include <cstring>
 #include <mutex>
 
+#include "tbase/endpoint.h"
 #include "tbase/logging.h"
 #include "tbase/time.h"
+#include "tfiber/fiber.h"
+#include "tnet/fault_injection.h"
 
 namespace tpurpc {
 
@@ -163,7 +168,16 @@ SSL_CTX* client_ctx() {
 class TlsTransport : public TransportEndpoint {
 public:
     TlsTransport(SSL* ssl, int fd, SslApi* api)
-        : ssl_(ssl), fd_(fd), api_(api) {}
+        : ssl_(ssl), fd_(fd), api_(api) {
+        // Remote identity for per-peer fault-injection scoping; best
+        // effort (an unconnected fd leaves it empty = matches only
+        // unscoped plans).
+        sockaddr_in peer;
+        socklen_t plen = sizeof(peer);
+        if (getpeername(fd, (sockaddr*)&peer, &plen) == 0) {
+            remote_ = sockaddr2endpoint(peer);
+        }
+    }
 
     ~TlsTransport() override {
         if (ssl_ != nullptr) api_->ssl_free(ssl_);
@@ -184,6 +198,43 @@ public:
     }
 
     ssize_t CutFromIOBufList(IOBuf* const* pieces, size_t count) override {
+        // Chaos: faults on the PLAINTEXT side of the record layer, so a
+        // corrupt byte arrives MAC-valid and only the application-level
+        // crc32c can catch it (exactly the property under test).
+        // Decided (and slept) BEFORE taking ssl_mu_: fiber_usleep may
+        // resume on another worker thread, and unlocking a std::mutex
+        // from a non-owner thread is UB (pieces are owned by the single
+        // elected writer, so touching them here is safe).
+        FaultAction fault;
+        size_t fault_budget = 0;  // kShort: plaintext bytes still allowed
+        if (__builtin_expect(fault_injection_enabled(), 0)) {
+            size_t total_len = 0;
+            for (size_t i = 0; i < count; ++i) total_len += pieces[i]->size();
+            fault = FaultInjection::Decide(FaultOp::kWrite, remote_,
+                                           total_len);
+            switch (fault.kind) {
+                case FaultAction::kReset:
+                    errno = ECONNRESET;
+                    return -1;
+                case FaultAction::kDelay:
+                    // Safe to park: with chaos enabled, Socket::FlushOnce
+                    // routes every write through the KeepWrite fiber
+                    // (no caller locks on that stack).
+                    fiber_usleep(fault.delay_us);
+                    break;
+                case FaultAction::kDrop: {
+                    for (size_t i = 0; i < count; ++i) {
+                        pieces[i]->pop_front(pieces[i]->size());
+                    }
+                    return (ssize_t)total_len;
+                }
+                case FaultAction::kShort:
+                    fault_budget = fault.max_bytes > 0 ? fault.max_bytes : 1;
+                    break;
+                default:
+                    break;
+            }
+        }
         // SSL* is not thread-safe; the KeepWrite fiber and the input
         // fiber (Pump) can run concurrently.
         std::lock_guard<std::mutex> g(ssl_mu_);
@@ -193,7 +244,20 @@ public:
         for (size_t i = 0; i < count; ++i) {
             IOBuf* piece = pieces[i];
             while (!piece->empty()) {
-                const size_t n = piece->copy_to(chunk, sizeof(chunk));
+                size_t n = piece->copy_to(chunk, sizeof(chunk));
+                if (fault.kind == FaultAction::kShort) {
+                    if (fault_budget == 0) {
+                        // Short write: report what went through (or
+                        // EAGAIN so the writer parks and retries).
+                        if (total > 0) return total;
+                        errno = EAGAIN;
+                        return -1;
+                    }
+                    n = std::min(n, fault_budget);
+                }
+                if (fault.kind == FaultAction::kCorrupt && total == 0) {
+                    chunk[fault.aux % n] ^= 0x20;
+                }
                 api_->err_clear();  // see WantMore()
                 const int w = api_->ssl_write(ssl_, chunk, (int)n);
                 if (w <= 0) {
@@ -206,6 +270,9 @@ public:
                 }
                 piece->pop_front((size_t)w);
                 total += w;
+                if (fault.kind == FaultAction::kShort) {
+                    fault_budget -= std::min(fault_budget, (size_t)w);
+                }
             }
         }
         return total;
@@ -230,16 +297,40 @@ public:
     }
 
     ssize_t Pump(IOPortal* dst) override {
+        // Chaos: inbound faults on the decrypted plaintext. Decided (and
+        // slept) BEFORE ssl_mu_ — see CutFromIOBufList.
+        FaultAction fault;
+        if (__builtin_expect(fault_injection_enabled(), 0)) {
+            fault = FaultInjection::Decide(FaultOp::kRead, remote_, 16384);
+            if (fault.kind == FaultAction::kReset) {
+                errno = ECONNRESET;
+                return -1;
+            }
+            if (fault.kind == FaultAction::kDelay) {
+                fiber_usleep(fault.delay_us);
+            }
+        }
         std::lock_guard<std::mutex> g(ssl_mu_);
         if (!DriveHandshake()) return -1;
         ssize_t total = 0;
         char buf[16384];
         while (true) {
             api_->err_clear();  // see WantMore()
-            const int r = api_->ssl_read(ssl_, buf, sizeof(buf));
+            int want = sizeof(buf);
+            if (fault.kind == FaultAction::kShort) {
+                want = (int)std::min<size_t>(
+                    sizeof(buf), fault.max_bytes > 0 ? fault.max_bytes : 1);
+            }
+            const int r = api_->ssl_read(ssl_, buf, want);
             if (r > 0) {
-                dst->append(buf, (size_t)r);
+                if (fault.kind == FaultAction::kCorrupt && total == 0) {
+                    buf[fault.aux % (uint64_t)r] ^= 0x20;
+                }
+                if (fault.kind != FaultAction::kDrop) {
+                    dst->append(buf, (size_t)r);
+                }
                 total += r;
+                if (fault.kind == FaultAction::kShort) return total;
                 continue;
             }
             const int err = api_->get_error(ssl_, r);
@@ -308,6 +399,7 @@ private:
     SSL* ssl_;
     int fd_;
     SslApi* api_;
+    EndPoint remote_;  // per-peer fault-injection scoping
     std::mutex ssl_mu_;
     std::atomic<short> want_events_{0};  // POLLIN/POLLOUT of last WANT_*
     bool established_ = false;

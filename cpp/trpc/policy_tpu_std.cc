@@ -204,7 +204,8 @@ struct UserCallArgs {
 // the threshold, new handlers use the default pool's free workers again
 // instead of queueing behind the isolated backlog.
 std::atomic<int64_t> g_usercode_default_inflight{0};
-constexpr int kUsercodeBackupTag = 63;  // reserved for the backup pool
+// kUsercodeBackupTag (policy_tpu_std.h): tag 63, reserved for this pool;
+// Server::Start enforces the reservation.
 
 void* RunUserCall(void* arg) {
     auto* a = (UserCallArgs*)arg;

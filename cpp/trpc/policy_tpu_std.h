@@ -34,6 +34,13 @@ void PackTpuStdFrame(IOBuf* out, const IOBuf& meta_pb, const IOBuf& payload,
 // GlobalInitializeOrDie).
 int TpuStdProtocolIndex();
 
+// Worker-pool tag reserved for usercode overload isolation (the backup
+// pool that absorbs excess blocking handlers — policy_tpu_std.cc
+// TooManyUserCode analog). Server::Start rejects user configurations
+// naming it: a user server sharing the overflow pool would silently
+// defeat the isolation.
+constexpr int kUsercodeBackupTag = 63;
+
 // One-time registration of built-in protocols (reference
 // GlobalInitializeOrDie, src/brpc/global.cpp:364-626).
 void GlobalInitializeOrDie();

@@ -96,6 +96,15 @@ int Server::StartNoListen(const ServerOptions* options) {
                    << " out of range [0, 64)";
         return -1;
     }
+    if (options_.fiber_tag == kUsercodeBackupTag) {
+        // Tag 63 is the usercode overload-isolation backup pool
+        // (policy_tpu_std.h): a user server running there would share
+        // workers with every overflowing blocking handler in the
+        // process — silently defeating the isolation for both.
+        LOG(ERROR) << "ServerOptions::fiber_tag " << kUsercodeBackupTag
+                   << " is reserved for the usercode backup pool";
+        return -1;
+    }
     for (auto& kv : methods_) {
         if (options_.timeout_concurrency) {
             kv.second.status->limiter.reset(

@@ -225,6 +225,37 @@ public:
     Miner() : Reducer<T, MinOp>(std::numeric_limits<T>::max()) {}
 };
 
+// A process-lifetime counter whose tvar registration happens on FIRST
+// USE, never at static-init time (the variable registry must not be
+// entered from static constructors), and whose storage is leaked so
+// static-teardown-time increments stay safe. Declare at namespace
+// scope: `static LazyAdder g_foo("my_counter");  *g_foo << 1;`.
+class LazyAdder {
+public:
+    constexpr explicit LazyAdder(const char* name) : name_(name) {}
+
+    Adder<int64_t>& operator*() {
+        Adder<int64_t>* a = adder_.load(std::memory_order_acquire);
+        if (__builtin_expect(a == nullptr, 0)) {
+            auto* fresh = new Adder<int64_t>;
+            Adder<int64_t>* expected = nullptr;
+            if (adder_.compare_exchange_strong(expected, fresh,
+                                               std::memory_order_acq_rel)) {
+                fresh->expose(name_);
+                a = fresh;
+            } else {
+                delete fresh;  // lost the race; expected holds the winner
+                a = expected;
+            }
+        }
+        return *a;
+    }
+
+private:
+    const char* name_;
+    std::atomic<Adder<int64_t>*> adder_{nullptr};
+};
+
 // PassiveStatus: value computed on read (reference src/bvar/passive_status.h).
 template <typename T>
 class PassiveStatus : public Variable {

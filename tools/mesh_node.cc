@@ -1,0 +1,303 @@
+// mesh_node: one member of the multi-process full-mesh chaos soak
+// (tests/test_chaos_soak.py drives 8 of these).
+//
+// Each node is BOTH a server and a client of every peer:
+//  - a tpu_std echo Server on 127.0.0.1:--port (with the whole builtin
+//    portal: /vars, /chaos, /connections, ...);
+//  - an LB channel over "file://<peers>" with the rr balancer —
+//    naming-service membership, circuit breaker, health-checked server
+//    sockets, retries: the standard client-robustness stack;
+//  - one shared-memory ICI link per peer (tici/shm_link.h) carrying the
+//    mesh echo traffic, re-established by a maintenance fiber when a
+//    peer dies and comes back.
+//
+// Invariant instrumented here and asserted by the soak: every issued
+// RPC terminates (sync calls + a final outstanding==0 check), under
+// peer kill, partition (fault injection via each node's /chaos page)
+// and heal.
+//
+// stdin protocol (like echo_bench --ici-server): "stop\n" stops traffic
+// and prints one "REPORT {json}" line; EOF shuts the node down
+// (Stop+Join, then _exit(0) — exit code 0 only after a clean quiesce).
+#include <signal.h>
+#include <sys/prctl.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <cstdio>
+#include <cstring>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <vector>
+
+#include "bench_echo.pb.h"
+#include "tbase/endpoint.h"
+#include "tbase/flags.h"
+#include "tbase/logging.h"
+#include "tbase/time.h"
+#include "tfiber/fiber.h"
+#include "tici/block_pool.h"
+#include "tici/shm_link.h"
+#include "trpc/channel.h"
+#include "trpc/controller.h"
+#include "trpc/server.h"
+
+using namespace tpurpc;
+
+namespace {
+
+class EchoServiceImpl : public benchpb::EchoService {
+public:
+    void Echo(google::protobuf::RpcController* cntl_base,
+              const benchpb::EchoRequest* request,
+              benchpb::EchoResponse* response,
+              google::protobuf::Closure* done) override {
+        Controller* cntl = static_cast<Controller*>(cntl_base);
+        response->set_send_ts_us(request->send_ts_us());
+        cntl->response_attachment().append(cntl->request_attachment());
+        done->Run();
+    }
+};
+
+struct Counters {
+    std::atomic<int64_t> lb_issued{0}, lb_ok{0}, lb_failed{0};
+    std::atomic<int64_t> shm_issued{0}, shm_ok{0}, shm_failed{0};
+    std::atomic<int64_t> outstanding{0};
+    std::atomic<int64_t> reconnects{0};
+};
+
+// One shm link to a peer; the channel is replaced on reconnect (a
+// Channel pins one socket for its lifetime).
+struct PeerLink {
+    EndPoint ep;
+    std::mutex mu;
+    std::shared_ptr<Channel> ch;  // null until connected
+};
+
+struct NodeState {
+    std::vector<std::unique_ptr<PeerLink>> links;
+    std::unique_ptr<Channel> lb_channel;
+    Counters counters;
+    std::atomic<bool> stop{false};
+};
+
+bool DoEcho(Channel* ch, int64_t timeout_ms, const std::string& payload) {
+    benchpb::EchoService_Stub stub(ch);
+    Controller cntl;
+    cntl.set_timeout_ms(timeout_ms);
+    benchpb::EchoRequest req;
+    benchpb::EchoResponse res;
+    req.set_send_ts_us(monotonic_time_us());
+    cntl.request_attachment().append(payload);
+    stub.Echo(&cntl, &req, &res, nullptr);  // sync: termination is proven
+    return !cntl.Failed();
+}
+
+void* LbTrafficFiber(void* arg) {
+    auto* st = (NodeState*)arg;
+    const std::string payload(128, 'b');
+    while (!st->stop.load(std::memory_order_relaxed)) {
+        st->counters.outstanding.fetch_add(1);
+        st->counters.lb_issued.fetch_add(1);
+        if (DoEcho(st->lb_channel.get(), 800, payload)) {
+            st->counters.lb_ok.fetch_add(1);
+        } else {
+            st->counters.lb_failed.fetch_add(1);
+        }
+        st->counters.outstanding.fetch_sub(1);
+        fiber_usleep(3000);
+    }
+    return nullptr;
+}
+
+void* ShmTrafficFiber(void* arg) {
+    auto* st = (NodeState*)arg;
+    const std::string payload(128, 's');
+    size_t next = 0;
+    while (!st->stop.load(std::memory_order_relaxed)) {
+        if (st->links.empty()) break;
+        PeerLink& link = *st->links[next++ % st->links.size()];
+        std::shared_ptr<Channel> ch;
+        {
+            std::lock_guard<std::mutex> g(link.mu);
+            ch = link.ch;
+        }
+        if (ch != nullptr) {
+            st->counters.outstanding.fetch_add(1);
+            st->counters.shm_issued.fetch_add(1);
+            if (DoEcho(ch.get(), 800, payload)) {
+                st->counters.shm_ok.fetch_add(1);
+            } else {
+                st->counters.shm_failed.fetch_add(1);
+            }
+            st->counters.outstanding.fetch_sub(1);
+        }
+        fiber_usleep(3000);
+    }
+    return nullptr;
+}
+
+// Keeps the mesh connected: (re-)establishes any link whose pinned
+// socket died — a killed peer that comes back on the same port rejoins
+// the mesh here.
+void* LinkMaintenanceFiber(void* arg) {
+    auto* st = (NodeState*)arg;
+    while (!st->stop.load(std::memory_order_relaxed)) {
+        for (auto& lp : st->links) {
+            if (st->stop.load(std::memory_order_relaxed)) break;
+            PeerLink& link = *lp;
+            bool dead;
+            {
+                std::lock_guard<std::mutex> g(link.mu);
+                if (link.ch == nullptr) {
+                    dead = true;
+                } else {
+                    SocketUniquePtr s =
+                        SocketUniquePtr::FromId(link.ch->pinned_socket());
+                    dead = !s || s->Failed();
+                }
+            }
+            if (!dead) continue;
+            auto fresh = std::make_shared<Channel>();
+            ChannelOptions copts;
+            copts.timeout_ms = 800;
+            copts.max_retry = 0;  // the maintenance loop IS the retry
+            if (fresh->InitIci(link.ep, &copts) == 0) {
+                std::lock_guard<std::mutex> g(link.mu);
+                const bool was_connected = link.ch != nullptr;
+                link.ch = std::move(fresh);
+                if (was_connected) st->counters.reconnects.fetch_add(1);
+            }
+        }
+        fiber_usleep(300 * 1000);
+    }
+    return nullptr;
+}
+
+void PrintReport(int id, int port, const Counters& c) {
+    printf(
+        "REPORT {\"id\": %d, \"port\": %d, \"lb_issued\": %lld, "
+        "\"lb_ok\": %lld, \"lb_failed\": %lld, \"shm_issued\": %lld, "
+        "\"shm_ok\": %lld, \"shm_failed\": %lld, \"outstanding\": %lld, "
+        "\"reconnects\": %lld}\n",
+        id, port, (long long)c.lb_issued.load(), (long long)c.lb_ok.load(),
+        (long long)c.lb_failed.load(), (long long)c.shm_issued.load(),
+        (long long)c.shm_ok.load(), (long long)c.shm_failed.load(),
+        (long long)c.outstanding.load(), (long long)c.reconnects.load());
+    fflush(stdout);
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+    prctl(PR_SET_PDEATHSIG, SIGKILL);  // die with the driving pytest
+    int port = 0, id = 0;
+    const char* peers_file = nullptr;
+    for (int i = 1; i < argc; ++i) {
+        if (strcmp(argv[i], "--port") == 0 && i + 1 < argc) {
+            port = atoi(argv[++i]);
+        } else if (strcmp(argv[i], "--id") == 0 && i + 1 < argc) {
+            id = atoi(argv[++i]);
+        } else if (strcmp(argv[i], "--peers") == 0 && i + 1 < argc) {
+            peers_file = argv[++i];
+        } else if (strcmp(argv[i], "--flag") == 0 && i + 1 < argc) {
+            // --flag name=value: soak-tuned knobs (breaker windows,
+            // health-check cadence, ...) without bespoke plumbing.
+            std::string kv = argv[++i];
+            const size_t eq = kv.find('=');
+            if (eq == std::string::npos ||
+                !SetFlagValue(kv.substr(0, eq), kv.substr(eq + 1))) {
+                fprintf(stderr, "bad --flag %s\n", kv.c_str());
+                return 2;
+            }
+        }
+    }
+    if (port <= 0 || peers_file == nullptr) {
+        fprintf(stderr,
+                "usage: mesh_node --port N --peers FILE [--id K] "
+                "[--flag name=value]...\n");
+        return 2;
+    }
+    if (IciBlockPool::Init() != 0) {
+        fprintf(stderr, "IciBlockPool::Init failed\n");
+        return 1;
+    }
+
+    static EchoServiceImpl service;
+    static Server server;
+    if (server.AddService(&service) != 0) return 1;
+    EndPoint listen;
+    str2endpoint("127.0.0.1", port, &listen);
+    if (server.Start(listen, nullptr) != 0) {
+        fprintf(stderr, "listen failed on port %d\n", port);
+        return 1;
+    }
+
+    static NodeState st;
+    // Naming-service membership: the rr LB channel resolves the same
+    // file every node shares; its sockets carry circuit breakers and
+    // health checks (FLAGS_ns_health_check_interval_ms).
+    st.lb_channel.reset(new Channel);
+    ChannelOptions lopts;
+    lopts.timeout_ms = 800;
+    lopts.max_retry = 2;
+    const std::string url = std::string("file://") + peers_file;
+    if (st.lb_channel->Init(url.c_str(), "rr", &lopts) != 0) {
+        fprintf(stderr, "LB channel init failed for %s\n", url.c_str());
+        return 1;
+    }
+    // Mesh links: one shm channel per peer (self excluded).
+    {
+        FILE* f = fopen(peers_file, "r");
+        if (f == nullptr) return 1;
+        char line[128];
+        while (fgets(line, sizeof(line), f) != nullptr) {
+            EndPoint ep;
+            char* nl = strchr(line, '\n');
+            if (nl != nullptr) *nl = '\0';
+            if (line[0] == '\0' || str2endpoint(line, &ep) != 0) continue;
+            if (ep.port == port) continue;  // self
+            auto link = std::make_unique<PeerLink>();
+            link->ep = ep;
+            st.links.push_back(std::move(link));
+        }
+        fclose(f);
+    }
+
+    std::vector<fiber_t> fibers;
+    fiber_t tid;
+    if (fiber_start_background(&tid, nullptr, LinkMaintenanceFiber, &st) ==
+        0) {
+        fibers.push_back(tid);
+    }
+    if (fiber_start_background(&tid, nullptr, LbTrafficFiber, &st) == 0) {
+        fibers.push_back(tid);
+    }
+    if (fiber_start_background(&tid, nullptr, ShmTrafficFiber, &st) == 0) {
+        fibers.push_back(tid);
+    }
+
+    printf("READY %d\n", port);
+    fflush(stdout);
+
+    // Control loop: "stop" -> quiesce traffic + report; EOF -> exit.
+    char cmd[64];
+    while (fgets(cmd, sizeof(cmd), stdin) != nullptr) {
+        if (strncmp(cmd, "stop", 4) == 0) {
+            st.stop.store(true, std::memory_order_relaxed);
+            for (fiber_t t : fibers) fiber_join(t, nullptr);
+            fibers.clear();
+            PrintReport(id, port, st.counters);
+        } else if (strncmp(cmd, "report", 6) == 0) {
+            PrintReport(id, port, st.counters);
+        }
+    }
+    // EOF: orderly shutdown. Stop traffic if "stop" never arrived.
+    st.stop.store(true, std::memory_order_relaxed);
+    for (fiber_t t : fibers) fiber_join(t, nullptr);
+    server.Stop();
+    server.Join();  // quiesces sockets: a leak would hang (pytest timeout)
+    fflush(nullptr);
+    _exit(0);  // skip static dtors (long-lived server discipline)
+}

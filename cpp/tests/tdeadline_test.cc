@@ -1,0 +1,766 @@
+// Deadline propagation, cancellation cascade, and retry budgets
+// (ISSUE 2): expired-on-arrival shedding, budget-aware admission,
+// hop-to-hop deadline inheritance, NotifyOnCancel exactly-once,
+// client->server cancel + downstream cascade, retry/backup throttling,
+// and the backoff-vs-deadline guards.
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <poll.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <climits>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <functional>
+#include <string>
+#include <thread>
+
+#include "echo.pb.h"
+#include "rpc_meta.pb.h"
+#include "tbase/errno.h"
+#include "tbase/time.h"
+#include "thttp/h2_frames.h"
+#include "thttp/hpack.h"
+#include "tfiber/fiber.h"
+#include "tfiber/fiber_sync.h"
+#include "trpc/channel.h"
+#include "trpc/controller.h"
+#include "trpc/pb_compat.h"
+#include "trpc/policy_tpu_std.h"
+#include "trpc/retry_policy.h"
+#include "trpc/server.h"
+#include "ttest/ttest.h"
+#include "tvar/variable.h"
+
+using namespace tpurpc;
+
+namespace {
+
+// Current value of a counter tvar (0 when not yet exposed); tests always
+// compare deltas — the registry is process-global across the suite.
+int64_t VarValue(const char* name) {
+    std::string desc;
+    if (!Variable::describe_exposed(name, &desc)) return 0;
+    return strtoll(desc.c_str(), nullptr, 10);
+}
+
+class EchoImpl : public test::EchoService {
+public:
+    void Echo(google::protobuf::RpcController* cntl_base,
+              const test::EchoRequest* request, test::EchoResponse* response,
+              google::protobuf::Closure* done) override {
+        Controller* cntl = static_cast<Controller*>(cntl_base);
+        if (request->sleep_us() > 0) {
+            fiber_usleep(request->sleep_us());
+        }
+        response->set_message(request->message());
+        (void)cntl;
+        ncalls.fetch_add(1, std::memory_order_relaxed);
+        done->Run();
+    }
+    std::atomic<int> ncalls{0};
+};
+
+struct DeadlineServer {
+    EchoImpl service;
+    Server server;
+    EndPoint ep;
+
+    bool start(const ServerOptions* opts = nullptr) {
+        if (server.AddService(&service) != 0) return false;
+        EndPoint listen;
+        str2endpoint("127.0.0.1:0", &listen);
+        if (server.Start(listen, opts) != 0) return false;
+        str2endpoint("127.0.0.1", server.listened_port(), &ep);
+        return true;
+    }
+};
+
+struct CountClosure : google::protobuf::Closure {
+    explicit CountClosure(std::atomic<int>* n) : n_(n) {}
+    void Run() override { n_->fetch_add(1, std::memory_order_relaxed); }
+    std::atomic<int>* n_;
+};
+
+struct SignalDone : google::protobuf::Closure {
+    CountdownEvent ev{1};
+    void Run() override { ev.signal(); }
+};
+
+}  // namespace
+
+// ---------------- retry budget ----------------
+
+TEST(RetryBudget, TokenBucketSemantics) {
+    RetryBudget b;
+    b.Configure(2, 0.5);
+    EXPECT_TRUE(b.enabled());
+    EXPECT_TRUE(b.Withdraw());
+    EXPECT_TRUE(b.Withdraw());
+    EXPECT_FALSE(b.Withdraw());  // burst of 2 spent
+    b.OnSuccess();               // +0.5: still below a whole token
+    EXPECT_FALSE(b.Withdraw());
+    b.OnSuccess();  // +0.5: one whole token available
+    EXPECT_TRUE(b.Withdraw());
+    // Refill is clamped at the burst cap.
+    for (int i = 0; i < 100; ++i) b.OnSuccess();
+    EXPECT_EQ(b.tokens(), 2);
+    // tokens <= 0 disables throttling entirely.
+    RetryBudget off;
+    off.Configure(0, 0.1);
+    EXPECT_FALSE(off.enabled());
+    for (int i = 0; i < 10; ++i) EXPECT_TRUE(off.Withdraw());
+}
+
+TEST(RetryBudget, ExhaustionStopsRetries) {
+    // Dead port: every try fails with ECONNREFUSED (retryable). The
+    // budget, not max_retry, is the binding constraint.
+    Channel channel;
+    ChannelOptions opts;
+    opts.timeout_ms = 2000;
+    opts.max_retry = 5;
+    opts.retry_budget_tokens = 2;
+    opts.retry_budget_ratio = 0.0;
+    ASSERT_EQ(channel.Init("127.0.0.1:1", &opts), 0);
+    test::EchoService_Stub stub(&channel);
+
+    const int64_t exhausted_before = VarValue("rpc_retry_budget_exhausted");
+    {
+        Controller cntl;
+        test::EchoRequest req;
+        req.set_message("doomed");
+        test::EchoResponse res;
+        stub.Echo(&cntl, &req, &res, nullptr);
+        EXPECT_TRUE(cntl.Failed());
+        EXPECT_EQ(cntl.retried_count(), 2);  // 2 tokens, not max_retry=5
+    }
+    {
+        Controller cntl;
+        test::EchoRequest req;
+        req.set_message("doomed2");
+        test::EchoResponse res;
+        stub.Echo(&cntl, &req, &res, nullptr);
+        EXPECT_TRUE(cntl.Failed());
+        EXPECT_EQ(cntl.retried_count(), 0);  // bucket dry: no re-issues
+    }
+    EXPECT_GE(VarValue("rpc_retry_budget_exhausted") - exhausted_before, 2);
+}
+
+namespace {
+// Sleeps when armed (and disarms): the original call hangs, the backup
+// (or the next call) completes fast — the existing backup-request shape.
+class SleepWhenArmedImpl : public test::EchoService {
+public:
+    void Echo(google::protobuf::RpcController*, const test::EchoRequest* req,
+              test::EchoResponse* res,
+              google::protobuf::Closure* done) override {
+        ncalls.fetch_add(1, std::memory_order_relaxed);
+        if (sleep_next.exchange(false, std::memory_order_acq_rel)) {
+            fiber_usleep(300 * 1000);
+        }
+        res->set_message(req->message());
+        done->Run();
+    }
+    std::atomic<bool> sleep_next{false};
+    std::atomic<int> ncalls{0};
+};
+}  // namespace
+
+TEST(RetryBudget, ExhaustionVetoesBackupRequests) {
+    SleepWhenArmedImpl service;
+    Server server;
+    ASSERT_EQ(server.AddService(&service), 0);
+    EndPoint listen;
+    str2endpoint("127.0.0.1:0", &listen);
+    ASSERT_EQ(server.Start(listen, nullptr), 0);
+    EndPoint ep;
+    str2endpoint("127.0.0.1", server.listened_port(), &ep);
+
+    Channel channel;
+    ChannelOptions opts;
+    opts.timeout_ms = 2000;
+    opts.max_retry = 1;
+    opts.retry_budget_tokens = 1;  // exactly one re-issue in the bucket
+    opts.retry_budget_ratio = 0.0;
+    ASSERT_EQ(channel.Init(ep, &opts), 0);
+    test::EchoService_Stub stub(&channel);
+
+    // Call 1: original sleeps 300ms, the backup (token 1) wins fast.
+    service.sleep_next.store(true, std::memory_order_release);
+    {
+        Controller cntl;
+        cntl.set_backup_request_ms(20);
+        test::EchoRequest req;
+        req.set_message("hedged");
+        test::EchoResponse res;
+        stub.Echo(&cntl, &req, &res, nullptr);
+        ASSERT_FALSE(cntl.Failed());
+        EXPECT_LT(cntl.latency_us(), 250 * 1000);
+    }
+    // Call 2: bucket dry — the backup is vetoed, we pay the full sleep.
+    service.sleep_next.store(true, std::memory_order_release);
+    {
+        Controller cntl;
+        cntl.set_backup_request_ms(20);
+        test::EchoRequest req;
+        req.set_message("unhedged");
+        test::EchoResponse res;
+        stub.Echo(&cntl, &req, &res, nullptr);
+        ASSERT_FALSE(cntl.Failed());
+        EXPECT_GE(cntl.latency_us(), 280 * 1000);
+    }
+    EXPECT_EQ(service.ncalls.load(), 3);  // 2 originals + 1 backup only
+}
+
+// ---------------- backoff vs deadline (satellite) ----------------
+
+TEST(Backoff, CrossingDeadlineIssuesImmediately) {
+    // A backoff that would overshoot the deadline is skipped: the retry
+    // goes out NOW (reference DoRetryWithBackoff guard). A dead port
+    // fails each try instantly, so the whole call finishes far inside
+    // the deadline despite a 5s nominal backoff.
+    RetryPolicyWithFixedBackoff policy(5000);
+    Channel channel;
+    ChannelOptions opts;
+    opts.timeout_ms = 800;
+    opts.max_retry = 2;
+    opts.retry_policy = &policy;
+    ASSERT_EQ(channel.Init("127.0.0.1:1", &opts), 0);
+    test::EchoService_Stub stub(&channel);
+
+    Controller cntl;
+    test::EchoRequest req;
+    req.set_message("x");
+    test::EchoResponse res;
+    const int64_t t0 = monotonic_time_us();
+    stub.Echo(&cntl, &req, &res, nullptr);
+    const int64_t elapsed_us = monotonic_time_us() - t0;
+    EXPECT_TRUE(cntl.Failed());
+    EXPECT_NE(cntl.ErrorCode(), TERR_RPC_TIMEDOUT);  // refused, not hung
+    EXPECT_EQ(cntl.retried_count(), 2);
+    EXPECT_LT(elapsed_us, 600 * 1000);  // never waited a 5s backoff
+}
+
+TEST(Backoff, HonoredWhenItFitsTheDeadline) {
+    // A backoff that fits really is waited out (regression guard for the
+    // HandleBackoffThunk timer path).
+    RetryPolicyWithFixedBackoff policy(80);
+    Channel channel;
+    ChannelOptions opts;
+    opts.timeout_ms = 2000;
+    opts.max_retry = 1;
+    opts.retry_policy = &policy;
+    ASSERT_EQ(channel.Init("127.0.0.1:1", &opts), 0);
+    test::EchoService_Stub stub(&channel);
+
+    Controller cntl;
+    test::EchoRequest req;
+    req.set_message("x");
+    test::EchoResponse res;
+    const int64_t t0 = monotonic_time_us();
+    stub.Echo(&cntl, &req, &res, nullptr);
+    const int64_t elapsed_us = monotonic_time_us() - t0;
+    EXPECT_TRUE(cntl.Failed());
+    EXPECT_EQ(cntl.retried_count(), 1);
+    EXPECT_GE(elapsed_us, 75 * 1000);  // the backoff was honored
+}
+
+TEST(Backoff, DeadlineWinsOverHangingRetry) {
+    // First connection is closed instantly (EOF -> retryable), the retry
+    // waits out its backoff, reconnects — and then the server goes
+    // silent. The armed deadline timer must fail the RPC with
+    // TERR_RPC_TIMEDOUT on time; the hazard is hanging forever on a try
+    // issued from the backoff path.
+    const int listener = ::socket(AF_INET, SOCK_STREAM, 0);
+    ASSERT_GE(listener, 0);
+    sockaddr_in addr;
+    memset(&addr, 0, sizeof(addr));
+    addr.sin_family = AF_INET;
+    addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+    addr.sin_port = 0;
+    ASSERT_EQ(::bind(listener, (sockaddr*)&addr, sizeof(addr)), 0);
+    ASSERT_EQ(::listen(listener, 8), 0);
+    socklen_t alen = sizeof(addr);
+    ASSERT_EQ(::getsockname(listener, (sockaddr*)&addr, &alen), 0);
+    const int port = ntohs(addr.sin_port);
+
+    std::atomic<bool> stop{false};
+    std::atomic<int> naccepts{0};
+    // Plain pthread acceptor: close the first connection, hold the rest
+    // open silently (blackhole).
+    std::thread acceptor([&] {
+        int held[8];
+        int nheld = 0;
+        while (!stop.load(std::memory_order_acquire)) {
+            pollfd pfd{listener, POLLIN, 0};
+            if (::poll(&pfd, 1, 50) != 1) continue;
+            const int fd = ::accept(listener, nullptr, nullptr);
+            if (fd < 0) continue;
+            if (naccepts.fetch_add(1, std::memory_order_relaxed) == 0) {
+                ::close(fd);  // EOF: the client's first try dies fast
+            } else if (nheld < 8) {
+                held[nheld++] = fd;  // blackhole: never respond
+            } else {
+                ::close(fd);
+            }
+        }
+        for (int i = 0; i < nheld; ++i) ::close(held[i]);
+    });
+
+    RetryPolicyWithFixedBackoff policy(100);
+    Channel channel;
+    ChannelOptions opts;
+    opts.timeout_ms = 400;
+    opts.max_retry = 3;
+    opts.retry_policy = &policy;
+    char addr_str[32];
+    snprintf(addr_str, sizeof(addr_str), "127.0.0.1:%d", port);
+    ASSERT_EQ(channel.Init(addr_str, &opts), 0);
+    test::EchoService_Stub stub(&channel);
+
+    Controller cntl;
+    test::EchoRequest req;
+    req.set_message("x");
+    test::EchoResponse res;
+    const int64_t t0 = monotonic_time_us();
+    stub.Echo(&cntl, &req, &res, nullptr);
+    const int64_t elapsed_us = monotonic_time_us() - t0;
+    EXPECT_TRUE(cntl.Failed());
+    EXPECT_EQ(cntl.ErrorCode(), TERR_RPC_TIMEDOUT);
+    // Not hung: finished within ~the deadline (+ scheduling slack).
+    EXPECT_LT(elapsed_us, 2000 * 1000);
+    EXPECT_GE(elapsed_us, 350 * 1000);
+
+    stop.store(true, std::memory_order_release);
+    acceptor.join();
+    ::close(listener);
+}
+
+// ---------------- server-side deadline ----------------
+
+TEST(Deadline, ExpiredOnArrivalIsShedBeforeHandler) {
+    DeadlineServer ts;
+    ASSERT_TRUE(ts.start());
+
+    // Hand-craft a request whose propagated remaining budget is already
+    // <= 0 (a real client only produces this when it has given up — the
+    // wire shape of an expired-on-arrival request).
+    rpc::RpcMeta meta;
+    auto* rmeta = meta.mutable_request();
+    rmeta->set_service_name("test.EchoService");
+    rmeta->set_method_name("Echo");
+    rmeta->set_timeout_ms(0);
+    meta.set_correlation_id(12345);
+    test::EchoRequest req;
+    req.set_message("expired");
+    IOBuf meta_buf, payload;
+    ASSERT_TRUE(SerializePbToIOBuf(meta, &meta_buf));
+    ASSERT_TRUE(SerializePbToIOBuf(req, &payload));
+    IOBuf frame;
+    PackTpuStdFrame(&frame, meta_buf, payload, IOBuf());
+    const std::string wire = frame.to_string();
+
+    const int64_t expired_before = VarValue("rpc_server_expired_requests");
+
+    const int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+    ASSERT_GE(fd, 0);
+    sockaddr_in addr;
+    endpoint2sockaddr(ts.ep, &addr);
+    ASSERT_EQ(::connect(fd, (sockaddr*)&addr, sizeof(addr)), 0);
+    ASSERT_EQ((ssize_t)wire.size(),
+              ::send(fd, wire.data(), wire.size(), 0));
+    // Read the error response frame: 12-byte header + body.
+    std::string in;
+    char buf[4096];
+    while (in.size() < 12) {
+        const ssize_t r = ::recv(fd, buf, sizeof(buf), 0);
+        ASSERT_GT(r, 0);
+        in.append(buf, (size_t)r);
+    }
+    uint32_t body_size = 0, meta_size = 0;
+    memcpy(&body_size, in.data() + 4, 4);
+    memcpy(&meta_size, in.data() + 8, 4);
+    body_size = ntohl(body_size);
+    meta_size = ntohl(meta_size);
+    while (in.size() < 12 + body_size) {
+        const ssize_t r = ::recv(fd, buf, sizeof(buf), 0);
+        ASSERT_GT(r, 0);
+        in.append(buf, (size_t)r);
+    }
+    ::close(fd);
+    rpc::RpcMeta res_meta;
+    IOBuf res_meta_buf;
+    res_meta_buf.append(in.substr(12, meta_size));
+    ASSERT_TRUE(ParsePbFromIOBuf(&res_meta, res_meta_buf));
+    EXPECT_EQ(res_meta.response().error_code(), TERR_RPC_TIMEDOUT);
+    // The handler never ran and the shed is observable in /vars.
+    EXPECT_EQ(ts.service.ncalls.load(), 0);
+    EXPECT_GE(VarValue("rpc_server_expired_requests") - expired_before, 1);
+}
+
+TEST(Deadline, BudgetBelowServiceTimeIsShedAtAdmission) {
+    // TimeoutConcurrencyLimiter integration: once the EMA has learned
+    // the ~30ms service time, a request arriving with an 8ms budget is
+    // rejected before it costs a handler.
+    ServerOptions sopts;
+    sopts.timeout_concurrency = true;
+    sopts.timeout_cl_options.timeout_ms = 500;
+    DeadlineServer ts;
+    ASSERT_TRUE(ts.start(&sopts));
+    Channel channel;
+    ChannelOptions opts;
+    opts.timeout_ms = 1000;
+    ASSERT_EQ(channel.Init(ts.ep, &opts), 0);
+    test::EchoService_Stub stub(&channel);
+
+    for (int i = 0; i < 3; ++i) {  // teach the EMA the service time
+        Controller cntl;
+        test::EchoRequest req;
+        req.set_message("warm");
+        req.set_sleep_us(30 * 1000);
+        test::EchoResponse res;
+        stub.Echo(&cntl, &req, &res, nullptr);
+        ASSERT_FALSE(cntl.Failed());
+    }
+    const int ncalls_before = ts.service.ncalls.load();
+    const int64_t shed_before = VarValue("rpc_server_shed_requests");
+
+    Controller cntl;
+    cntl.set_timeout_ms(8);  // well below the learned ~30ms
+    test::EchoRequest req;
+    req.set_message("doomed");
+    req.set_sleep_us(30 * 1000);
+    test::EchoResponse res;
+    const int64_t t0 = monotonic_time_us();
+    stub.Echo(&cntl, &req, &res, nullptr);
+    const int64_t elapsed_us = monotonic_time_us() - t0;
+    EXPECT_TRUE(cntl.Failed());
+    EXPECT_LT(elapsed_us, 25 * 1000);  // shed cheaply, not executed
+    EXPECT_EQ(ts.service.ncalls.load(), ncalls_before);
+    EXPECT_GE(VarValue("rpc_server_shed_requests") - shed_before, 1);
+}
+
+TEST(Deadline, ExpiredOnArrivalIsShedOnH2Grpc) {
+    // The gRPC/h2 analog of the tpu_std expired-shed: a stream whose
+    // grpc-timeout parses to 0 is answered with grpc-status 4
+    // (DEADLINE_EXCEEDED) before admission or user code.
+    DeadlineServer ts;
+    ASSERT_TRUE(ts.start());
+    const int64_t expired_before = VarValue("rpc_server_expired_requests");
+
+    const int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+    ASSERT_GE(fd, 0);
+    sockaddr_in addr;
+    endpoint2sockaddr(ts.ep, &addr);
+    ASSERT_EQ(::connect(fd, (sockaddr*)&addr, sizeof(addr)), 0);
+    std::string out(h2::kPreface, h2::kPrefaceLen);
+    out += h2::BuildFrame(h2::H2_SETTINGS, 0, 0, "");
+    const std::vector<std::pair<std::string, std::string>> hdrs = {
+        {":method", "POST"},
+        {":scheme", "http"},
+        {":path", "/test.EchoService/Echo"},
+        {":authority", "t"},
+        {"content-type", "application/grpc"},
+        {"te", "trailers"},
+        {"grpc-timeout", "0m"},
+    };
+    h2::AppendHeadersFrames(
+        &out, (uint8_t)(h2::kFlagEndHeaders | h2::kFlagEndStream), 1,
+        h2::EncodeHeaderBlock(hdrs));
+    ASSERT_EQ((ssize_t)out.size(), ::send(fd, out.data(), out.size(), 0));
+
+    // Read frames until a HEADERS block carries grpc-status (the server
+    // sends response HEADERS, then trailers; one shared decoder).
+    HpackDecoder dec;
+    std::string grpc_status;
+    std::string in;
+    const int64_t read_deadline = monotonic_time_us() + 3 * 1000 * 1000;
+    while (grpc_status.empty() && monotonic_time_us() < read_deadline) {
+        pollfd pfd{fd, POLLIN, 0};
+        if (::poll(&pfd, 1, 100) != 1) continue;
+        char buf[4096];
+        const ssize_t r = ::recv(fd, buf, sizeof(buf), 0);
+        if (r <= 0) break;
+        in.append(buf, (size_t)r);
+        while (in.size() >= h2::kFrameHeaderLen) {
+            const uint32_t len = ((uint32_t)(uint8_t)in[0] << 16) |
+                                 ((uint32_t)(uint8_t)in[1] << 8) |
+                                 (uint32_t)(uint8_t)in[2];
+            if (in.size() < h2::kFrameHeaderLen + len) break;
+            const uint8_t type = (uint8_t)in[3];
+            if (type == h2::H2_HEADERS) {
+                std::vector<HpackHeader> decoded;
+                ASSERT_TRUE(dec.Decode(
+                    (const uint8_t*)in.data() + h2::kFrameHeaderLen, len,
+                    &decoded));
+                for (const auto& h : decoded) {
+                    if (h.name == "grpc-status") grpc_status = h.value;
+                }
+            }
+            in.erase(0, h2::kFrameHeaderLen + len);
+        }
+    }
+    ::close(fd);
+    EXPECT_EQ(grpc_status, "4");  // DEADLINE_EXCEEDED
+    EXPECT_EQ(ts.service.ncalls.load(), 0);
+    EXPECT_GE(VarValue("rpc_server_expired_requests") - expired_before, 1);
+}
+
+// ---------------- hop-to-hop inheritance ----------------
+
+namespace {
+// Chains to itself: request message = hops left; records the remaining
+// upstream budget observed at each hop. The downstream channel's own
+// timeout is huge — only inheritance can cap it.
+class ChainImpl : public test::EchoService {
+public:
+    void Echo(google::protobuf::RpcController* cntl_base,
+              const test::EchoRequest* request, test::EchoResponse* response,
+              google::protobuf::Closure* done) override {
+        Controller* cntl = static_cast<Controller*>(cntl_base);
+        const int hops = atoi(request->message().c_str());
+        const int64_t remaining = cntl->remaining_server_budget_us();
+        if (hops >= 0 && hops < 3) {
+            budgets[hops].store(remaining == INT64_MAX ? -1 : remaining,
+                                std::memory_order_relaxed);
+        }
+        if (hops > 0) {
+            Channel ch;
+            ChannelOptions opts;
+            opts.timeout_ms = 10 * 1000;  // inheritance must tighten this
+            if (ch.Init(self, &opts) == 0) {
+                test::EchoService_Stub stub(&ch);
+                Controller down;
+                test::EchoRequest dreq;
+                dreq.set_message(std::to_string(hops - 1));
+                test::EchoResponse dres;
+                stub.Echo(&down, &dreq, &dres, nullptr);
+            }
+        }
+        response->set_message("done");
+        done->Run();
+    }
+    std::atomic<int64_t> budgets[3] = {};
+    EndPoint self;
+};
+}  // namespace
+
+TEST(Deadline, ThreeHopChainInheritsRemainingBudget) {
+    ChainImpl service;
+    Server server;
+    ASSERT_EQ(server.AddService(&service), 0);
+    EndPoint listen;
+    str2endpoint("127.0.0.1:0", &listen);
+    ASSERT_EQ(server.Start(listen, nullptr), 0);
+    str2endpoint("127.0.0.1", server.listened_port(), &service.self);
+
+    Channel channel;
+    ChannelOptions opts;
+    opts.timeout_ms = 400;  // the only deadline anywhere in the chain
+    ASSERT_EQ(channel.Init(service.self, &opts), 0);
+    test::EchoService_Stub stub(&channel);
+
+    Controller cntl;
+    test::EchoRequest req;
+    req.set_message("2");  // hops: 2 -> 1 -> 0
+    test::EchoResponse res;
+    stub.Echo(&cntl, &req, &res, nullptr);
+    ASSERT_FALSE(cntl.Failed());
+
+    const int64_t b2 = service.budgets[2].load();  // first hop
+    const int64_t b1 = service.budgets[1].load();
+    const int64_t b0 = service.budgets[0].load();  // last hop
+    // Every hop saw a REAL deadline (not the 10s channel timeout), and
+    // the budget only shrinks down the chain.
+    ASSERT_GT(b2, 0);
+    ASSERT_GT(b1, 0);
+    ASSERT_GT(b0, 0);
+    EXPECT_LE(b2, 400 * 1000);
+    EXPECT_LE(b1, b2);
+    EXPECT_LE(b0, b1);
+}
+
+// ---------------- cancellation ----------------
+
+TEST(Cancel, NotifyOnCancelRunsExactlyOnceWithoutCancel) {
+    DeadlineServer ts;
+    ASSERT_TRUE(ts.start());
+    Channel channel;
+    ASSERT_EQ(channel.Init(ts.ep, nullptr), 0);
+    test::EchoService_Stub stub(&channel);
+
+    std::atomic<int> fired{0};
+    CountClosure closure(&fired);
+    Controller cntl;
+    cntl.NotifyOnCancel(&closure);
+    test::EchoRequest req;
+    req.set_message("ok");
+    test::EchoResponse res;
+    stub.Echo(&cntl, &req, &res, nullptr);
+    ASSERT_FALSE(cntl.Failed());
+    EXPECT_EQ(fired.load(), 1);  // fired by EndRPC despite no cancel
+
+    // And on plain destruction of a controller that never issued a call.
+    std::atomic<int> fired2{0};
+    CountClosure closure2(&fired2);
+    {
+        Controller unused;
+        unused.NotifyOnCancel(&closure2);
+    }
+    EXPECT_EQ(fired2.load(), 1);
+}
+
+namespace {
+// Parks until canceled (or 2s); reports what it observed.
+class CancelWatchImpl : public test::EchoService {
+public:
+    void Echo(google::protobuf::RpcController* cntl_base,
+              const test::EchoRequest* request, test::EchoResponse* response,
+              google::protobuf::Closure* done) override {
+        Controller* cntl = static_cast<Controller*>(cntl_base);
+        entered.fetch_add(1, std::memory_order_release);
+        for (int i = 0; i < 400; ++i) {
+            if (cntl->IsCanceled()) {
+                saw_cancel.fetch_add(1, std::memory_order_relaxed);
+                break;
+            }
+            fiber_usleep(5 * 1000);
+        }
+        response->set_message(request->message());
+        done->Run();
+    }
+    std::atomic<int> entered{0};
+    std::atomic<int> saw_cancel{0};
+};
+
+bool WaitFor(const std::function<bool()>& pred, int64_t timeout_ms) {
+    const int64_t deadline = monotonic_time_us() + timeout_ms * 1000;
+    while (monotonic_time_us() < deadline) {
+        if (pred()) return true;
+        usleep(5 * 1000);
+    }
+    return pred();
+}
+}  // namespace
+
+TEST(Cancel, StartCancelReachesTheServer) {
+    CancelWatchImpl service;
+    Server server;
+    ASSERT_EQ(server.AddService(&service), 0);
+    EndPoint listen;
+    str2endpoint("127.0.0.1:0", &listen);
+    ASSERT_EQ(server.Start(listen, nullptr), 0);
+    EndPoint ep;
+    str2endpoint("127.0.0.1", server.listened_port(), &ep);
+
+    Channel channel;
+    ChannelOptions opts;
+    opts.timeout_ms = 5000;
+    ASSERT_EQ(channel.Init(ep, &opts), 0);
+    test::EchoService_Stub stub(&channel);
+
+    std::atomic<int> notified{0};
+    CountClosure closure(&notified);
+    Controller cntl;
+    cntl.NotifyOnCancel(&closure);
+    test::EchoRequest req;
+    req.set_message("cancel-me");
+    test::EchoResponse res;
+    SignalDone done;
+    stub.Echo(&cntl, &req, &res, &done);
+    ASSERT_TRUE(WaitFor([&] { return service.entered.load() >= 1; }, 2000));
+
+    cntl.StartCancel();
+    done.ev.wait();
+    EXPECT_TRUE(cntl.Failed());
+    EXPECT_EQ(cntl.ErrorCode(), ECANCELED);
+    EXPECT_EQ(notified.load(), 1);
+    // The wire CANCEL marked the server-side controller canceled, so the
+    // handler bailed out of its park loop early.
+    EXPECT_TRUE(WaitFor([&] { return service.saw_cancel.load() >= 1; },
+                        2000));
+    server.Stop();
+    server.Join();
+}
+
+namespace {
+// Front tier: relays to the back tier synchronously; records the
+// downstream verdict so the cascade is observable.
+class RelayImpl : public test::EchoService {
+public:
+    void Echo(google::protobuf::RpcController*, const test::EchoRequest* req,
+              test::EchoResponse* res,
+              google::protobuf::Closure* done) override {
+        Channel ch;
+        ChannelOptions opts;
+        opts.timeout_ms = 5000;
+        if (ch.Init(backend, &opts) == 0) {
+            test::EchoService_Stub stub(&ch);
+            Controller down;
+            test::EchoRequest dreq;
+            dreq.set_message(req->message());
+            test::EchoResponse dres;
+            stub.Echo(&down, &dreq, &dres, nullptr);
+            downstream_error.store(down.ErrorCode(),
+                                   std::memory_order_release);
+        }
+        res->set_message("relayed");
+        done->Run();
+    }
+    EndPoint backend;
+    std::atomic<int> downstream_error{-1};
+};
+}  // namespace
+
+TEST(Cancel, CascadesThroughTwoHops) {
+    // client -> relay -> watcher. Canceling the client call cancels the
+    // relay's server context, which cascades into its in-flight
+    // downstream call (ECANCELED), which wire-cancels the watcher.
+    CancelWatchImpl watcher;
+    Server back;
+    ASSERT_EQ(back.AddService(&watcher), 0);
+    EndPoint listen;
+    str2endpoint("127.0.0.1:0", &listen);
+    ASSERT_EQ(back.Start(listen, nullptr), 0);
+
+    RelayImpl relay;
+    str2endpoint("127.0.0.1", back.listened_port(), &relay.backend);
+    Server front;
+    ASSERT_EQ(front.AddService(&relay), 0);
+    str2endpoint("127.0.0.1:0", &listen);
+    ASSERT_EQ(front.Start(listen, nullptr), 0);
+    EndPoint front_ep;
+    str2endpoint("127.0.0.1", front.listened_port(), &front_ep);
+
+    Channel channel;
+    ChannelOptions opts;
+    opts.timeout_ms = 5000;
+    ASSERT_EQ(channel.Init(front_ep, &opts), 0);
+    test::EchoService_Stub stub(&channel);
+
+    Controller cntl;
+    test::EchoRequest req;
+    req.set_message("cascade");
+    test::EchoResponse res;
+    SignalDone done;
+    stub.Echo(&cntl, &req, &res, &done);
+    ASSERT_TRUE(WaitFor([&] { return watcher.entered.load() >= 1; }, 2000));
+
+    cntl.StartCancel();
+    done.ev.wait();
+    EXPECT_TRUE(cntl.Failed());
+    EXPECT_EQ(cntl.ErrorCode(), ECANCELED);
+    // The relay's downstream call was canceled by the cascade...
+    EXPECT_TRUE(WaitFor(
+        [&] { return relay.downstream_error.load() == ECANCELED; }, 3000));
+    // ...and the cancel propagated one more hop over the wire.
+    EXPECT_TRUE(WaitFor([&] { return watcher.saw_cancel.load() >= 1; },
+                        2000));
+    front.Stop();
+    front.Join();
+    back.Stop();
+    back.Join();
+}

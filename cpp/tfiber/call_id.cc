@@ -199,4 +199,11 @@ bool id_exists(CallId id) {
     return valid_locked(s, id);
 }
 
+bool id_exists_range(CallId id) {
+    IdSlot* s = resolve(id);
+    if (s == nullptr) return false;
+    std::lock_guard<std::mutex> g(s->mu);
+    return valid_range(s, id);
+}
+
 }  // namespace tpurpc

@@ -51,5 +51,9 @@ CallId id_next_version(CallId id);
 
 // True while the id (this version) is live.
 bool id_exists(CallId id);
+// True while ANY version of the id's RPC is live — the existence analog
+// of id_lock_range / id_error (a retried call's ORIGINAL id value stays
+// range-live, and range-valid errors still reach it).
+bool id_exists_range(CallId id);
 
 }  // namespace tpurpc

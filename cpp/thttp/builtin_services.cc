@@ -217,13 +217,15 @@ void HandleStatus(Server* server, const HttpRequest&, HttpResponse* res) {
         snprintf(line, sizeof(line),
                  "%s\n"
                  "  count: %lld  qps: %lld  concurrency: %lld/%lld"
-                 "  errors: %lld  rejected: %lld\n"
+                 "  errors: %lld  rejected: %lld"
+                 "  expired: %lld  shed: %lld\n"
                  "  latency_us: p50 %lld  p99 %lld  p999 %lld  max %lld\n",
                  kv.first.c_str(), (long long)st.latency.count(),
                  (long long)st.latency.qps(),
                  (long long)st.concurrency.load(),
                  (long long)st.max_concurrency(),  // 0 = unlimited
                  (long long)st.nerror.load(), (long long)st.nrejected.load(),
+                 (long long)st.nexpired.load(), (long long)st.nshed.load(),
                  (long long)st.latency.latency_percentile(0.5),
                  (long long)st.latency.latency_percentile(0.99),
                  (long long)st.latency.latency_percentile(0.999),

@@ -2,6 +2,7 @@
 
 #include <arpa/inet.h>
 
+#include <algorithm>
 #include <cstring>
 #include <map>
 #include <mutex>
@@ -461,6 +462,31 @@ ParseResult ParseH2ClientFrames(IOBuf* source, Socket* socket,
 
 }  // namespace
 
+void H2ClientCancel(SocketId sid, uint64_t cid) {
+    SocketUniquePtr s;
+    if (Socket::AddressSocket(sid, &s) != 0) return;
+    H2ClientSession* sess = client_session_of(s.get());
+    if (sess == nullptr) return;
+    uint32_t stream_id = 0;
+    {
+        std::lock_guard<std::mutex> g(sess->mu);
+        for (auto it = sess->streams.begin(); it != sess->streams.end();
+             ++it) {
+            if (it->second.cid == cid) {
+                stream_id = it->first;
+                sess->streams.erase(it);
+                break;
+            }
+        }
+    }
+    if (stream_id == 0) return;  // already completed / never sent
+    uint32_t code = htonl(0x8);  // CANCEL
+    IOBuf rst;
+    rst.append(BuildFrame(H2_RST_STREAM, 0, stream_id,
+                          std::string((const char*)&code, 4)));
+    s->Write(&rst);
+}
+
 // ---------------- send path ----------------
 
 int H2ClientSendUnary(Socket* s, uint64_t cid, const std::string& grpc_path,
@@ -501,11 +527,31 @@ int H2ClientSendUnary(Socket* s, uint64_t cid, const std::string& grpc_path,
         headers.emplace_back("authorization", authorization);
     }
     if (deadline_us > 0) {
-        const int64_t remain_ms =
-            (deadline_us - monotonic_time_us()) / 1000;
-        if (remain_ms > 0) {
-            headers.emplace_back("grpc-timeout",
-                                 std::to_string(remain_ms) + "m");
+        const int64_t remain_us = deadline_us - monotonic_time_us();
+        if (remain_us > 0) {
+            // Floor at 1ms while budget remains (see the tpu_std stamp
+            // in IssueRPC: 0 means "already expired"). The gRPC spec
+            // caps the value at 8 digits — upscale the unit for huge
+            // deadlines (truncation only SHRINKS the budget: safe).
+            const int64_t remain_ms =
+                remain_us < 1000 ? 1 : remain_us / 1000;
+            std::string gt;
+            if (remain_ms <= 99999999) {
+                gt = std::to_string(remain_ms) + "m";
+            } else if (remain_ms / 1000 <= 99999999) {
+                gt = std::to_string(remain_ms / 1000) + "S";
+            } else if (remain_ms / 60000 <= 99999999) {
+                gt = std::to_string(remain_ms / 60000) + "M";
+            } else {
+                gt = std::to_string(std::min<int64_t>(
+                         99999999, remain_ms / 3600000)) +
+                     "H";
+            }
+            headers.emplace_back("grpc-timeout", gt);
+        } else {
+            // Budget already spent: say so explicitly ("1n" parses to 0)
+            // so the server sheds instead of executing for nobody.
+            headers.emplace_back("grpc-timeout", "1n");
         }
     }
 

@@ -26,6 +26,11 @@ int H2ClientSendUnary(Socket* s, uint64_t cid, const std::string& grpc_path,
                       int64_t deadline_us,
                       const std::string& authorization = "");
 
+// Cancel the in-flight unary call `cid` on the h2 client session of
+// `sid`: RST_STREAM(CANCEL) the matching stream and drop its response
+// state. No-op when the call already completed or the socket is gone.
+void H2ClientCancel(SocketId sid, uint64_t cid);
+
 // Registered at GlobalInitializeOrDie: parses/processes server->client h2
 // frames on sockets carrying an h2 client session.
 void RegisterHttp2ClientProtocol();

@@ -14,6 +14,7 @@
 #include <vector>
 
 #include "tbase/errno.h"
+#include "tbase/flags.h"
 #include "tbase/logging.h"
 #include "tbase/time.h"
 #include "tfiber/butex.h"
@@ -29,6 +30,13 @@
 #include "trpc/json2pb.h"
 #include "trpc/pb_compat.h"
 #include "trpc/server.h"
+#include "trpc/server_call.h"
+
+// A window-starving client must not pin a response fiber (and its
+// concurrency slot) forever; the stream's own grpc-timeout bounds the
+// stall further when it is tighter.
+DEFINE_int32(h2_server_stall_timeout_ms, 60000,
+             "give up on a window-starved h2 response after this stall");
 
 namespace tpurpc {
 
@@ -88,12 +96,15 @@ H2Session* session_of(Socket* s) { return (H2Session*)s->conn_data(); }
 
 // Write HEADERS (+optional DATA chunks with flow control) + trailers.
 // Runs on a response fiber holding a socket ref; parks on the session
-// window butex when the send window is exhausted.
+// window butex when the send window is exhausted. `deadline_us` (0 =
+// none) bounds the stall abort further: past the stream's own deadline
+// the client has given up, so parking longer only pins the fiber.
 void WriteResponse(
     SocketId sid, uint32_t stream_id,
     const std::vector<std::pair<std::string, std::string>>& headers,
     const std::string& body,
-    const std::vector<std::pair<std::string, std::string>>& trailers) {
+    const std::vector<std::pair<std::string, std::string>>& trailers,
+    int64_t deadline_us = 0) {
     SocketUniquePtr s;
     if (Socket::AddressSocket(sid, &s) != 0) return;
     H2Session* sess = session_of(s.get());
@@ -106,10 +117,15 @@ void WriteResponse(
                             : kFlagEndHeaders,
                         stream_id, EncodeHeaderBlock(headers));
     size_t sent = 0;
-    // A window-starving client must not pin this fiber (and its
-    // concurrency slot) forever: give up after a bounded stall and reset
-    // the stream (reference h2 has the same write-timeout escape).
-    const int64_t stall_deadline = monotonic_time_us() + 60 * 1000 * 1000;
+    // Give up after a bounded stall and reset the stream (reference h2
+    // has the same write-timeout escape); the stream's parsed
+    // grpc-timeout caps it when tighter.
+    int64_t stall_deadline =
+        monotonic_time_us() +
+        (int64_t)FLAGS_h2_server_stall_timeout_ms.get() * 1000;
+    if (deadline_us > 0 && deadline_us < stall_deadline) {
+        stall_deadline = deadline_us;
+    }
     while (sent < body.size()) {
         // Flow control: consume min(available conn+stream window, frame
         // cap); park until WINDOW_UPDATE when exhausted.
@@ -162,7 +178,10 @@ void WriteResponse(
                 sess->streams.erase(stream_id);
                 return;
             }
-            const int64_t abst = monotonic_time_us() + 10 * 1000 * 1000;
+            // Never park past the stall deadline (a 10s wait quantum
+            // would overshoot a tight per-stream deadline by seconds).
+            const int64_t abst = std::min(
+                monotonic_time_us() + 10 * 1000 * 1000, stall_deadline);
             butex_wait(sess->window_butex, expected, &abst);
             if (s->Failed()) return;
             continue;
@@ -202,6 +221,27 @@ const std::string* FindHeader(const std::vector<HpackHeader>& hs,
     return nullptr;
 }
 
+// gRPC "grpc-timeout" header: ASCII digits + one unit suffix. Returns
+// the timeout in microseconds, or -1 on parse error (reference
+// src/brpc/grpc.cpp ParseH2Timeout).
+int64_t ParseGrpcTimeoutUs(const std::string& v) {
+    if (v.size() < 2 || v.size() > 9) return -1;  // spec: <= 8 digits
+    int64_t num = 0;
+    for (size_t i = 0; i + 1 < v.size(); ++i) {
+        if (v[i] < '0' || v[i] > '9') return -1;
+        num = num * 10 + (v[i] - '0');
+    }
+    switch (v.back()) {
+        case 'H': return num * 3600 * 1000000;
+        case 'M': return num * 60 * 1000000;
+        case 'S': return num * 1000000;
+        case 'm': return num * 1000;
+        case 'u': return num;
+        case 'n': return num / 1000;
+        default: return -1;
+    }
+}
+
 // gRPC unary call: 5-byte length-prefixed pb in, same out, grpc-status
 // trailers (reference src/brpc/grpc.{h,cpp} status mapping).
 struct GrpcCallCtx {
@@ -233,14 +273,42 @@ std::string PercentEncodeGrpcMessage(const std::string& s) {
     return out;
 }
 
+void RespondGrpcError(SocketId sid, uint32_t stream_id, int code,
+                      const std::string& msg);
+
 void* RunGrpcCall(void* arg) {
     std::unique_ptr<GrpcCallCtx> c((GrpcCallCtx*)arg);
+    // One teardown for every exit path: deregister from the cancel
+    // registry, destroy the cancelable id, settle admission accounting.
+    const auto finish = [&](int error_code) {
+        server_call::Unregister(c->sid, c->stream_id);
+        c->cntl.DestroyServerCallId();
+        c->guard->Finish(error_code);
+        delete c->guard;
+    };
+    // Expiry re-check on the handler fiber: the deadline may have passed
+    // while this call waited for dispatch (grpc-status 4 =
+    // DEADLINE_EXCEEDED).
+    if (c->cntl.has_server_deadline() &&
+        monotonic_time_us() >= c->cntl.server_deadline_us()) {
+        c->mp->status->nexpired.fetch_add(1, std::memory_order_relaxed);
+        server_call::CountExpired();
+        RespondGrpcError(c->sid, c->stream_id, 4,
+                         "deadline expired before handler dispatch");
+        finish(TERR_RPC_TIMEDOUT);
+        return nullptr;
+    }
     struct SyncDone : google::protobuf::Closure {
         CountdownEvent ev{1};
         void Run() override { ev.signal(); }
     } done;
-    c->mp->service->CallMethod(c->mp->method, &c->cntl, c->req.get(),
-                               c->res.get(), &done);
+    {
+        // Publish the server call for the handler's downstream calls
+        // (deadline inheritance + cancel cascade).
+        ServerCallScope scope(&c->cntl);
+        c->mp->service->CallMethod(c->mp->method, &c->cntl, c->req.get(),
+                                   c->res.get(), &done);
+    }
     done.ev.wait();
     std::string body;
     std::vector<std::pair<std::string, std::string>> trailers;
@@ -261,9 +329,8 @@ void* RunGrpcCall(void* arg) {
     WriteResponse(c->sid, c->stream_id,
                   {{":status", "200"},
                    {"content-type", "application/grpc"}},
-                  body, trailers);
-    c->guard->Finish(c->cntl.Failed() ? c->cntl.ErrorCode() : 0);
-    delete c->guard;
+                  body, trailers, c->cntl.server_deadline_us());
+    finish(c->cntl.Failed() ? c->cntl.ErrorCode() : 0);
     return nullptr;
 }
 
@@ -346,10 +413,34 @@ void DispatchCompleteStream(Socket* s, H2Session* sess, uint32_t stream_id,
             RespondGrpcError(s->id(), stream_id, 12, "unimplemented");
             return;
         }
-        auto* guard = new Server::MethodCallGuard(server, mp);
+        // Server-side deadline from grpc-timeout (the h2 analog of the
+        // tpu_std timeout_ms meta): shed expired-on-arrival requests
+        // before admission with grpc-status 4 (DEADLINE_EXCEEDED).
+        const int64_t arrival_us = monotonic_time_us();
+        int64_t deadline_us = 0;
+        const std::string* gt = FindHeader(req_headers, "grpc-timeout");
+        if (gt != nullptr) {
+            const int64_t t_us = ParseGrpcTimeoutUs(*gt);
+            if (t_us == 0) {
+                mp->status->nexpired.fetch_add(1,
+                                               std::memory_order_relaxed);
+                server_call::CountExpired();
+                RespondGrpcError(s->id(), stream_id, 4,
+                                 "deadline already expired on arrival");
+                return;
+            }
+            if (t_us > 0) deadline_us = arrival_us + t_us;
+        }
+        auto* guard = new Server::MethodCallGuard(
+            server, mp, deadline_us > 0 ? deadline_us - arrival_us : -1);
         if (guard->rejected()) {
+            const bool shed = guard->shed();
             delete guard;
-            RespondGrpcError(s->id(), stream_id, 8, "concurrency limit");
+            if (shed) server_call::CountShed();
+            RespondGrpcError(s->id(), stream_id, 8,
+                             shed ? "remaining deadline budget below "
+                                    "observed service time"
+                                  : "concurrency limit");
             return;
         }
         if (req_body.size() < 5) {
@@ -388,12 +479,22 @@ void DispatchCompleteStream(Socket* s, H2Session* sess, uint32_t stream_id,
         ctx->req.reset(mp->service->GetRequestPrototype(mp->method).New());
         ctx->res.reset(mp->service->GetResponsePrototype(mp->method).New());
         ctx->cntl.InitServerSide(server, s->remote_side());
+        ctx->cntl.set_server_deadline_us(deadline_us);
         if (!ParsePbFromIOBuf(ctx->req.get(), req_body)) {
             guard->Finish(TERR_REQUEST);
             delete guard;
             delete ctx;
             RespondGrpcError(s->id(), stream_id, 3, "bad request pb");
             return;
+        }
+        // Cancelable handle keyed by the h2 stream id: RST_STREAM and
+        // connection death deliver the cancel; RunGrpcCall tears both
+        // down on every exit path.
+        CallId scid = INVALID_CALL_ID;
+        if (id_create(&scid, &ctx->cntl,
+                      &Controller::HandleServerCancelThunk) == 0) {
+            ctx->cntl.set_server_call_id(scid);
+            server_call::Register(s->id(), stream_id, scid);
         }
         fiber_t tid;
         FiberAttr attr = FIBER_ATTR_NORMAL;
@@ -766,6 +867,10 @@ void ProcessH2(InputMessageBase* raw) {
             break;
         }
         case H2_RST_STREAM: {
+            // The peer abandoned the stream: cancel the in-flight gRPC
+            // call so its handler can stop early (cascading into any
+            // downstream calls it issued), then drop the stream state.
+            server_call::Cancel(s->id(), msg->stream_id);
             std::lock_guard<std::mutex> g(sess->mu);
             sess->streams.erase(msg->stream_id);
             break;

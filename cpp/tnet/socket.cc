@@ -140,7 +140,20 @@ int Socket::Create(const SocketOptions& options, SocketId* id) {
     return 0;
 }
 
+namespace {
+std::atomic<Socket::FailureObserver> g_failure_observer{nullptr};
+}  // namespace
+
+void Socket::set_failure_observer(FailureObserver ob) {
+    g_failure_observer.store(ob, std::memory_order_release);
+}
+
 void Socket::OnFailed() {
+    // Upper-layer notification first: in-flight server calls on this
+    // connection should learn of the death before the health-check
+    // machinery starts resurrecting it.
+    FailureObserver ob = g_failure_observer.load(std::memory_order_acquire);
+    if (ob != nullptr) ob(id());
     // Wake anything parked on this socket so it observes the failure.
     butex_word(epollout_butex_)->fetch_add(1, std::memory_order_release);
     butex_wake_all(epollout_butex_);

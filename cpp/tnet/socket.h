@@ -110,6 +110,13 @@ public:
     // ---- failure / health check ----
     int SetFailedWithError(int error_code);
     int error_code() const { return error_code_.load(std::memory_order_acquire); }
+    // Process-wide failure observer, invoked once per socket from
+    // OnFailed (the winning SetFailed). Lets upper layers react to
+    // connection death without tnet depending on them (the RPC layer
+    // cancels in-flight server calls here). The observer may run under
+    // arbitrary locks — it must not run user code inline.
+    using FailureObserver = void (*)(SocketId);
+    static void set_failure_observer(FailureObserver ob);
     // Stop the revive loop (set when the naming layer removes this server
     // for good; the health-check fiber then drops its ref and the socket
     // recycles).

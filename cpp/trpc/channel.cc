@@ -15,12 +15,34 @@
 #include "trpc/retry_policy.h"
 #include "trpc/compress.h"
 #include "trpc/policy_tpu_std.h"
+#include "trpc/server_call.h"
 #include "trpc/span.h"
 #include "trpc/stream.h"
+
+#include "tbase/flags.h"
+
+// Default retry budget (gRPC retry-throttling shape; channel.h
+// ChannelOptions::retry_budget_*): the burst bounds re-issues under a
+// correlated failure, the ratio lets healthy traffic earn them back.
+// tokens <= 0 disables throttling process-wide.
+DEFINE_int32(rpc_retry_budget_tokens, 100,
+             "per-channel retry/backup burst tokens (<=0 disables)");
+DEFINE_double(rpc_retry_budget_ratio, 0.1,
+              "retry budget tokens earned back per successful RPC");
 
 namespace tpurpc {
 
 Channel::~Channel() = default;
+
+void Channel::ConfigureRetryBudget() {
+    const int64_t tokens = options_.retry_budget_tokens >= 0
+                               ? options_.retry_budget_tokens
+                               : FLAGS_rpc_retry_budget_tokens.get();
+    const double ratio = options_.retry_budget_ratio >= 0
+                             ? options_.retry_budget_ratio
+                             : FLAGS_rpc_retry_budget_ratio.get();
+    retry_budget_.Configure(tokens, ratio);
+}
 
 InputMessenger* Channel::client_messenger() {
     static InputMessenger* m = [] {
@@ -35,6 +57,7 @@ int Channel::Init(const EndPoint& server, const ChannelOptions* options) {
     GlobalInitializeOrDie();
     server_ep_ = server;
     if (options != nullptr) options_ = *options;
+    ConfigureRetryBudget();
     // grpc/redis and TLS channels pin their OWN connection: the
     // endpoint-keyed SocketMap/SocketPool sockets are shared with
     // tpu_std channels, and installing an h2/redis session (or a TLS
@@ -100,6 +123,7 @@ int Channel::Init(const char* server_addr_and_port,
 int Channel::InitWithSocketId(SocketId sid, const ChannelOptions* options) {
     GlobalInitializeOrDie();
     if (options != nullptr) options_ = *options;
+    ConfigureRetryBudget();
     SocketUniquePtr s;
     if (Socket::AddressSocket(sid, &s) != 0) {
         LOG(ERROR) << "InitWithSocketId: dead socket id=" << sid;
@@ -125,6 +149,7 @@ int Channel::Init(const char* naming_url, const char* lb_name,
                   const ChannelOptions* options) {
     GlobalInitializeOrDie();
     if (options != nullptr) options_ = *options;
+    ConfigureRetryBudget();
     // Plain "ip:port" with an LB name degenerates to single-server.
     if (strstr(naming_url, "://") == nullptr) {
         return Init(naming_url, options);
@@ -220,8 +245,28 @@ void Channel::CallMethod(const google::protobuf::MethodDescriptor* method,
         cntl->timeout_ms_ >= 0 ? cntl->timeout_ms_ : options_.timeout_ms;
     if (timeout_ms > 0) {
         cntl->deadline_us_ = cntl->start_us_ + timeout_ms * 1000;
+    }
+    // Hop-to-hop deadline inheritance: a call issued inside a server
+    // handler never outlives its upstream caller's patience — the
+    // deadline is capped at the upstream remaining budget (which IssueRPC
+    // then forwards downstream as the remaining-time meta), and the call
+    // registers with the server call so an upstream cancel cascades into
+    // it.
+    Controller* parent = CurrentServerCall();
+    if (parent != nullptr && parent->has_server_deadline()) {
+        const int64_t upstream = parent->server_deadline_us();
+        if (cntl->deadline_us_ == 0 || upstream < cntl->deadline_us_) {
+            cntl->deadline_us_ = upstream;
+        }
+    }
+    if (cntl->deadline_us_ > 0) {
         cntl->timeout_timer_ = TimerThread::singleton()->schedule(
             HandleTimeoutCb, (void*)(uintptr_t)cid, cntl->deadline_us_);
+    }
+    if (parent != nullptr && !parent->AddChildCall(cid)) {
+        // The upstream call was canceled before this one even started:
+        // queue the cancel on the locked id; it is delivered at unlock.
+        id_error(cid, ECANCELED);
     }
     // Backup request timer (reference controller.cpp:344-358): fires
     // before the deadline, re-issues on a second call id, first response
@@ -232,7 +277,16 @@ void Channel::CallMethod(const google::protobuf::MethodDescriptor* method,
             ? options_.backup_request_policy->GetDelayMs(cntl)
             : (cntl->backup_request_ms_ >= 0 ? cntl->backup_request_ms_
                                              : options_.backup_request_ms);
-    if (backup_ms >= 0 && (timeout_ms <= 0 || backup_ms < timeout_ms)) {
+    // Compare against the EFFECTIVE deadline (the inherited cap may be
+    // tighter than the configured timeout): hedging past — or without —
+    // remaining budget is pure waste, so a deadline that leaves less
+    // than the hedge delay (including one already expired) suppresses
+    // the timer; only a truly deadline-less call hedges unconditionally.
+    const bool has_deadline = cntl->deadline_us_ > 0;
+    const int64_t effective_timeout_ms =
+        has_deadline ? (cntl->deadline_us_ - cntl->start_us_) / 1000 : 0;
+    if (backup_ms >= 0 &&
+        (!has_deadline || backup_ms < effective_timeout_ms)) {
         cntl->backup_timer_ = TimerThread::singleton()->schedule(
             &Controller::HandleBackupThunk, (void*)(uintptr_t)cid,
             cntl->start_us_ + backup_ms * 1000);

@@ -14,6 +14,7 @@
 
 #include "tbase/endpoint.h"
 #include "tnet/input_messenger.h"
+#include "trpc/retry_policy.h"
 
 namespace tpurpc {
 
@@ -55,6 +56,13 @@ struct ChannelOptions {
     // fixed backup_request_ms above.
     const class RetryPolicy* retry_policy = nullptr;
     const class BackupRequestPolicy* backup_request_policy = nullptr;
+    // Retry budget (retry_policy.h RetryBudget): burst tokens and the
+    // per-success refill ratio consulted by every re-issue (retry AND
+    // backup request). -1 = use the -rpc_retry_budget_tokens /
+    // -rpc_retry_budget_ratio flag defaults; tokens 0 disables
+    // throttling for this channel.
+    int64_t retry_budget_tokens = -1;
+    double retry_budget_ratio = -1.0;
 };
 
 class Channel : public google::protobuf::RpcChannel {
@@ -98,8 +106,13 @@ public:
     // a fresh one replaces it here — the channel survives reconnects.
     SocketId AcquirePinnedSocket();
 
+    // Per-channel re-issue throttle (configured at Init from
+    // ChannelOptions / the rpc_retry_budget_* flags).
+    RetryBudget& retry_budget() { return retry_budget_; }
+
 private:
     int CreateOwnedPinnedSocket(SocketId* sid);
+    void ConfigureRetryBudget();
 
     EndPoint server_ep_;
     ChannelOptions options_;
@@ -107,6 +120,7 @@ private:
     SocketId pinned_socket_ = INVALID_VREF_ID;
     bool owns_pinned_ = false;  // created by Init (not InitWithSocketId)
     std::mutex pin_mu_;         // guards pinned_socket_ recreation
+    RetryBudget retry_budget_;
 };
 
 }  // namespace tpurpc

@@ -16,6 +16,8 @@
 #include <cstdint>
 #include <mutex>
 
+#include "tbase/time.h"
+
 namespace tpurpc {
 
 class ConcurrencyLimiter {
@@ -24,6 +26,12 @@ public:
     // current = concurrency AFTER this request was counted in. True =
     // admit.
     virtual bool OnRequested(int64_t current) = 0;
+    // Deadline-aware admission, consulted IN ADDITION to OnRequested for
+    // requests carrying a propagated deadline: `remaining_us` is the
+    // budget the client has left. False = the request cannot plausibly
+    // finish inside its budget — shed it now, before it costs a handler
+    // (the caller accounts it as rpc_server_shed_requests).
+    virtual bool AdmitWithBudget(int64_t remaining_us) { return true; }
     // Every admitted request reports its outcome.
     virtual void OnResponded(int error_code, int64_t latency_us) = 0;
     virtual int64_t MaxConcurrency() const = 0;
@@ -57,6 +65,11 @@ public:
         int64_t timeout_ms = 100;    // the latency budget to protect
         int64_t min_concurrency = 2;  // always admit up to this many
         double alpha = 0.25;          // latency EMA smoothing
+        // Budget-shed escape hatch: with no fresh success sample in this
+        // long, AdmitWithBudget admits one probe — a shed request never
+        // executes, so without probes a stale-high EMA could latch the
+        // method into shedding 100% of deadline-carrying traffic forever.
+        int64_t probe_interval_ms = 1000;
     };
 
     TimeoutConcurrencyLimiter() : TimeoutConcurrencyLimiter(Options()) {}
@@ -69,6 +82,27 @@ public:
         return current * avg <= opt_.timeout_ms * 1000;
     }
 
+    // A request whose remaining client budget is below even ONE observed
+    // service time is doomed: the client will have hung up before the
+    // response exists. Rejecting here costs a map lookup; executing it
+    // costs a full handler that nobody reads.
+    bool AdmitWithBudget(int64_t remaining_us) override {
+        const int64_t avg = avg_latency_us_.load(std::memory_order_relaxed);
+        if (avg <= 0 || remaining_us >= avg) return true;
+        // Probe escape: if nothing has executed recently (e.g. every
+        // request is being shed against an estimate from a past latency
+        // incident), admit one request per probe interval so the EMA can
+        // re-learn the CURRENT service time and un-latch.
+        const int64_t now = monotonic_time_us();
+        int64_t last = last_sample_us_.load(std::memory_order_relaxed);
+        if (now - last > opt_.probe_interval_ms * 1000 &&
+            last_sample_us_.compare_exchange_strong(
+                last, now, std::memory_order_relaxed)) {
+            return true;
+        }
+        return false;
+    }
+
     void OnResponded(int error_code, int64_t latency_us) override {
         if (error_code != 0) return;  // failures don't teach latency
         int64_t cur = avg_latency_us_.load(std::memory_order_relaxed);
@@ -77,6 +111,8 @@ public:
                      : (int64_t)(cur * (1 - opt_.alpha) +
                                  latency_us * opt_.alpha);
         avg_latency_us_.store(next, std::memory_order_relaxed);
+        last_sample_us_.store(monotonic_time_us(),
+                              std::memory_order_relaxed);
     }
 
     int64_t MaxConcurrency() const override {
@@ -93,6 +129,8 @@ public:
 private:
     const Options opt_;
     std::atomic<int64_t> avg_latency_us_{0};
+    // Last execution sample (or granted probe) — the anti-latch clock.
+    std::atomic<int64_t> last_sample_us_{0};
 };
 
 // "auto": the gradient limiter.

@@ -3,7 +3,11 @@
 
 #include <google/protobuf/descriptor.h>
 
+#include <algorithm>
 #include <cstdarg>
+#include <cstdint>
+
+#include "tvar/reducer.h"
 
 #include "rpc_meta.pb.h"
 #include "tbase/errno.h"
@@ -26,20 +30,30 @@
 DEFINE_bool(rpc_checksum, false,
             "crc32c-protect tpu_std frame bodies (verified when present)");
 
+#include "trpc/server_call.h"
+
 namespace tpurpc {
 
+// Client-side re-issue observability: the chaos soak bounds total
+// re-issues (retries + backups) against the configured retry budget.
+static LazyAdder g_client_retries("rpc_client_retries");
+static LazyAdder g_client_backups("rpc_client_backup_requests");
+static LazyAdder g_budget_exhausted("rpc_retry_budget_exhausted");
+
 Controller::~Controller() {
+    RunCancelClosure();  // contract: an unfired closure still runs once
     delete excluded_;
     delete span_;  // non-null only if the RPC never reached EndRPC/submit
 }
 
 void Controller::Reset() {
+    RunCancelClosure();  // reuse ends the previous RPC: fire if unfired
     error_code_ = 0;
     error_text_.clear();
     timeout_ms_ = -1;   // -1: use the channel default
     max_retry_ = -1;
     log_id_ = 0;
-    canceled_ = false;
+    canceled_.store(false, std::memory_order_relaxed);
     request_attachment_.clear();
     response_attachment_.clear();
     remote_side_ = EndPoint();
@@ -82,6 +96,12 @@ void Controller::Reset() {
     accepted_stream_window_ = 0;
     server_socket_ = INVALID_VREF_ID;
     server_ = nullptr;
+    server_deadline_us_ = 0;
+    server_call_id_ = INVALID_CALL_ID;
+    {
+        std::lock_guard<std::mutex> g(child_mu_);
+        child_calls_.clear();
+    }
     span_ = nullptr;
 }
 
@@ -101,10 +121,143 @@ void Controller::SetFailed(int error_code, const char* fmt, ...) {
 }
 
 void Controller::StartCancel() {
-    canceled_ = true;
+    canceled_.store(true, std::memory_order_release);
     if (correlation_id_ != INVALID_CALL_ID) {
+        // HandleError(ECANCELED) sends the wire CANCEL for the in-flight
+        // tries under the id lock and finishes the RPC.
         id_error(correlation_id_, ECANCELED);
     }
+}
+
+void Controller::NotifyOnCancel(google::protobuf::Closure* closure) {
+    if (closure == nullptr) return;
+    if (canceled_.load(std::memory_order_acquire)) {
+        closure->Run();  // already canceled: notify immediately
+        return;
+    }
+    google::protobuf::Closure* prev =
+        on_cancel_.exchange(closure, std::memory_order_acq_rel);
+    if (prev != nullptr) {
+        prev->Run();  // replaced: the displaced closure still runs once
+    }
+    if (canceled_.load(std::memory_order_acquire)) {
+        RunCancelClosure();  // lost a race with a concurrent cancel
+    }
+}
+
+void Controller::RunCancelClosure() {
+    google::protobuf::Closure* c =
+        on_cancel_.exchange(nullptr, std::memory_order_acq_rel);
+    if (c != nullptr) c->Run();
+}
+
+bool Controller::AddChildCall(CallId cid) {
+    std::lock_guard<std::mutex> g(child_mu_);
+    if (canceled_.load(std::memory_order_acquire)) return false;
+    // Children are never individually deregistered (id_error on a
+    // completed id is a free no-op), so a long-lived handler issuing
+    // thousands of sequential calls would grow this without bound:
+    // compact the dead ids once the list gets big. RANGE existence, not
+    // strict: a child that retried (version bump) is still live and
+    // still cancelable through its original id value.
+    if (child_calls_.size() >= 256) {
+        child_calls_.erase(
+            std::remove_if(child_calls_.begin(), child_calls_.end(),
+                           [](CallId c) { return !id_exists_range(c); }),
+            child_calls_.end());
+    }
+    child_calls_.push_back(cid);
+    return true;
+}
+
+// ---------------- server-side cancellation ----------------
+
+int64_t Controller::remaining_server_budget_us() const {
+    if (server_deadline_us_ <= 0) return INT64_MAX;
+    return server_deadline_us_ - monotonic_time_us();
+}
+
+namespace {
+// Deferred cascade delivery (plain CallId VALUES: stale-safe, never
+// touches the possibly-already-freed parent controller).
+void* CancelChildrenFiber(void* arg) {
+    auto* children = (std::vector<CallId>*)arg;
+    for (CallId c : *children) {
+        id_error(c, ECANCELED);
+    }
+    delete children;
+    return nullptr;
+}
+void CancelChildrenTimerCb(void* arg) { CancelChildrenFiber(arg); }
+}  // namespace
+
+void Controller::HandleServerCancel() {
+    if (canceled_.exchange(true, std::memory_order_acq_rel)) {
+        return;  // duplicate delivery (second CANCEL meta, RST + death)
+    }
+    server_call::CountCanceled();
+    RunCancelClosure();
+    // Cascade into the handler's downstream calls. canceled_ was set
+    // BEFORE taking child_mu_, so a racing AddChildCall either landed in
+    // the swapped list or observes canceled_ and self-cancels.
+    //
+    // Delivery happens OFF this fiber: we run under the server-call id
+    // lock, and a child's inline completion can re-enter the SERVER
+    // call's done closure (async proxy handlers), whose
+    // DestroyServerCallId would then block on the very lock this fiber
+    // holds — a self-deadlock. A fresh fiber (timer thread as backstop)
+    // takes the child ids by value, so the parent may die freely.
+    auto* children = new std::vector<CallId>;
+    {
+        std::lock_guard<std::mutex> g(child_mu_);
+        children->swap(child_calls_);
+    }
+    if (children->empty()) {
+        delete children;
+        return;
+    }
+    fiber_t tid;
+    if (fiber_start_background(&tid, nullptr, CancelChildrenFiber,
+                               children) != 0) {
+        TimerThread::singleton()->schedule(CancelChildrenTimerCb, children,
+                                           monotonic_time_us());
+    }
+}
+
+int Controller::HandleServerCancelThunk(CallId id, void* data, int) {
+    ((Controller*)data)->HandleServerCancel();
+    return id_unlock(id);  // the call stays live; done destroys the id
+}
+
+void Controller::DestroyServerCallId() {
+    if (server_call_id_ == INVALID_CALL_ID) return;
+    void* unused;
+    // Serializes behind an in-flight cancel delivery (the thunk holds the
+    // lock while touching this controller), then drops any still-queued
+    // cancels — the response is already on its way out.
+    if (id_lock(server_call_id_, &unused) == 0) {
+        id_unlock_and_destroy(server_call_id_);
+    }
+    server_call_id_ = INVALID_CALL_ID;
+}
+
+void Controller::SendWireCancel() {
+    if (channel_ == nullptr) return;
+    const bool grpc = channel_->options().protocol == "grpc";
+    const auto send_one = [&](CallId cid, SocketId fly_sid) {
+        if (cid == INVALID_CALL_ID) return;
+        SocketId sid = fly_sid;
+        if (sid == INVALID_VREF_ID) sid = current_server_id_;
+        if (sid == INVALID_VREF_ID) sid = single_server_id_;
+        if (sid == INVALID_VREF_ID) return;
+        if (grpc) {
+            H2ClientCancel(sid, cid);
+        } else {
+            SendTpuStdCancel(sid, cid);
+        }
+    };
+    send_one(current_cid_, current_fly_sid_);
+    send_one(unfinished_cid_, unfinished_fly_sid_);
 }
 
 // ---------------- client call machinery ----------------
@@ -167,6 +320,14 @@ int Controller::HandleError(CallId id, int error) {
         unfinished_fly_sid_ = INVALID_VREF_ID;
         return id_unlock(id);
     }
+    // Cancellation (StartCancel, or the cascade from a canceled upstream
+    // server call): tell the server(s) to stop working on the in-flight
+    // tries before finishing locally — the whole point of the cascade is
+    // that an abandoned call frees CPU all the way down.
+    if (error == ECANCELED) {
+        canceled_.store(true, std::memory_order_release);
+        SendWireCancel();
+    }
     // The failing try's dedicated connection is dead weight from here
     // (retry opens a fresh one; terminal failure closes it in EndRPC).
     if (current_fly_sid_ != INVALID_VREF_ID && is_retryable(error)) {
@@ -186,26 +347,41 @@ int Controller::HandleError(CallId id, int error) {
     SetFailed(error, "%s", terror(error));
     if (rp->DoRetry(this) && current_try_ < effective_max_retry &&
         (deadline_us_ == 0 || monotonic_time_us() < deadline_us_)) {
-        const CallId next = id_next_version(current_cid_);
-        if (next != INVALID_CALL_ID) {
-            ++current_try_;
-            current_cid_ = next;
-            const int64_t backoff_ms = rp->BackoffMs(this);
-            error_code_ = 0;  // a later try owns the final verdict
-            error_text_.clear();
-            if (backoff_ms > 0 &&
-                (deadline_us_ == 0 ||
-                 monotonic_time_us() + backoff_ms * 1000 < deadline_us_)) {
-                // Issue after the backoff; the timer holds only the NEW
-                // cid value (stale-safe, like every other timer here).
-                TimerThread::singleton()->schedule(
-                    &Controller::HandleBackoffThunk,
-                    (void*)(uintptr_t)current_cid_,
-                    monotonic_time_us() + backoff_ms * 1000);
-            } else {
-                IssueRPC();
+        // Retry throttling (gRPC-style retry budget, channel.h): under a
+        // correlated failure every caller retrying independently is the
+        // retry storm that amplifies overload — once the per-channel
+        // bucket is dry, fail now with the try's own error instead.
+        if (channel_ != nullptr && !channel_->retry_budget().Withdraw()) {
+            *g_budget_exhausted << 1;
+        } else {
+            const CallId next = id_next_version(current_cid_);
+            if (next == INVALID_CALL_ID && channel_ != nullptr) {
+                // The re-issue never went out: the token goes back.
+                channel_->retry_budget().Refund();
             }
-            return id_unlock(id);
+            if (next != INVALID_CALL_ID) {
+                ++current_try_;
+                current_cid_ = next;
+                *g_client_retries << 1;
+                const int64_t backoff_ms = rp->BackoffMs(this);
+                error_code_ = 0;  // a later try owns the final verdict
+                error_text_.clear();
+                if (backoff_ms > 0 &&
+                    (deadline_us_ == 0 ||
+                     monotonic_time_us() + backoff_ms * 1000 <
+                         deadline_us_)) {
+                    // Issue after the backoff; the timer holds only the
+                    // NEW cid value (stale-safe, like every other timer
+                    // here).
+                    TimerThread::singleton()->schedule(
+                        &Controller::HandleBackoffThunk,
+                        (void*)(uintptr_t)current_cid_,
+                        monotonic_time_us() + backoff_ms * 1000);
+                } else {
+                    IssueRPC();
+                }
+                return id_unlock(id);
+            }
         }
     }
     EndRPC(id);
@@ -411,7 +587,15 @@ void Controller::IssueRPC() {
     req_meta->set_service_name(method_->service()->full_name());
     req_meta->set_method_name(method_->name());
     if (deadline_us_ > 0) {
-        req_meta->set_timeout_ms((deadline_us_ - monotonic_time_us()) / 1000);
+        // Remaining budget, floored at 1ms while any budget truly
+        // remains: plain /1000 truncation would stamp a live sub-ms
+        // budget as 0, which the server rejects as expired-on-arrival.
+        // 0 is reserved for "the deadline has really passed" (the server
+        // sheds without executing).
+        const int64_t remaining_us = deadline_us_ - monotonic_time_us();
+        req_meta->set_timeout_ms(
+            remaining_us > 0 ? std::max<int64_t>(1, remaining_us / 1000)
+                             : 0);
     }
     if (log_id_ != 0) req_meta->set_log_id(log_id_);
     if (span_ != nullptr) {
@@ -494,8 +678,18 @@ void Controller::MaybeIssueBackup() {
     if (current_try_ >= effective_max_retry) {
         return;  // backup consumes retry budget (reference semantics)
     }
+    // Hedging is a re-issue too: an exhausted retry budget vetoes the
+    // backup (under overload, doubling the traffic is the last thing the
+    // fleet needs — same rationale as the retry path).
+    if (channel_ != nullptr && !channel_->retry_budget().Withdraw()) {
+        *g_budget_exhausted << 1;
+        return;
+    }
     const CallId next = id_next_version(current_cid_);
-    if (next == INVALID_CALL_ID) return;
+    if (next == INVALID_CALL_ID) {
+        if (channel_ != nullptr) channel_->retry_budget().Refund();
+        return;
+    }
     // The original call STAYS live (ranged id): record it so its response
     // can still win and its socket errors fail only it. Feed the LB a
     // slow-but-ok data point for the original's server (elapsed latency,
@@ -507,6 +701,7 @@ void Controller::MaybeIssueBackup() {
     FeedbackToLB(0);
     current_cid_ = next;
     ++current_try_;
+    *g_client_backups << 1;
     IssueRPC();
 }
 
@@ -538,6 +733,15 @@ void Controller::ReleaseFlySockets() {
 
 void Controller::EndRPC(CallId locked_id) {
     latency_us_ = monotonic_time_us() - start_us_;
+    // The RPC is over: an unfired NotifyOnCancel closure runs now
+    // (protobuf contract — exactly once whether or not canceled).
+    RunCancelClosure();
+    // A success refills the retry budget by the configured ratio (the
+    // gRPC token-bucket shape: sustained failure drains it, recovery
+    // earns re-issue capacity back).
+    if (channel_ != nullptr && error_code_ == 0) {
+        channel_->retry_budget().OnSuccess();
+    }
     // A failed auth-carrying call releases the fight it won (success
     // paths already resolved it via SetAuthenticated on the response).
     if (auth_fight_sid_ != INVALID_VREF_ID) {

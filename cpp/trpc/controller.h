@@ -13,7 +13,9 @@
 #include <google/protobuf/service.h>
 
 #include <atomic>
+#include <mutex>
 #include <string>
+#include <vector>
 
 #include "tbase/endpoint.h"
 #include "tbase/iobuf.h"
@@ -76,8 +78,15 @@ public:
     // ---- protobuf::RpcController surface ----
     void Reset() override;
     void StartCancel() override;
-    bool IsCanceled() const override { return canceled_; }
-    void NotifyOnCancel(google::protobuf::Closure*) override {}
+    bool IsCanceled() const override {
+        return canceled_.load(std::memory_order_acquire);
+    }
+    // Register `closure` to run when this call is canceled. Protobuf
+    // contract: the closure runs EXACTLY once, whether or not
+    // cancellation ever happens — an unfired closure runs at EndRPC /
+    // Reset / destruction. Server side it may run on the connection's
+    // input fiber, so it must be fast and must not block.
+    void NotifyOnCancel(google::protobuf::Closure* closure) override;
 
     // ---- server side ----
     bool is_server_side() const { return server_ != nullptr; }
@@ -87,6 +96,26 @@ public:
         server_ = server;
         remote_side_ = remote;
     }
+    // ---- server-side deadline (the client's propagated remaining
+    // budget, parsed from tpu_std timeout_ms / h2 grpc-timeout) ----
+    void set_server_deadline_us(int64_t d) { server_deadline_us_ = d; }
+    bool has_server_deadline() const { return server_deadline_us_ > 0; }
+    int64_t server_deadline_us() const { return server_deadline_us_; }
+    // Remaining budget of this server call; INT64_MAX when the client
+    // sent no deadline. May be <= 0 (already expired).
+    int64_t remaining_server_budget_us() const;
+    // ---- server-side cancellation (trpc/server_call.h registry) ----
+    // The cancelable handle of this server call; its on_error handler is
+    // HandleServerCancelThunk. Destroyed by the done closure.
+    void set_server_call_id(CallId id) { server_call_id_ = id; }
+    CallId server_call_id() const { return server_call_id_; }
+    void DestroyServerCallId();
+    // Mark this server call canceled: runs the NotifyOnCancel closure and
+    // cascades ECANCELED into every downstream call the handler issued
+    // under this context (stale-safe: completed children drop it).
+    // Idempotent.
+    void HandleServerCancel();
+    static int HandleServerCancelThunk(CallId id, void* data, int error);
 
     // ---- streaming plumbing (see trpc/stream.h) ----
     // Client: StreamCreate records the local stream to announce in the
@@ -157,6 +186,16 @@ private:
     void FeedbackToLB(int error);
     // Pool-return / close this RPC's pooled/short connections (EndRPC).
     void ReleaseFlySockets();
+    // Best-effort wire CANCEL for the in-flight tries (tpu_std CANCEL
+    // meta / h2 RST_STREAM) so the server stops burning CPU on a call
+    // nobody waits for. Runs with the id locked.
+    void SendWireCancel();
+    // Run-once delivery of the NotifyOnCancel closure.
+    void RunCancelClosure();
+    // Downstream call registration for the cancellation cascade: returns
+    // false when this (server-side) controller is already canceled — the
+    // caller then cancels the fresh call instead of registering it.
+    bool AddChildCall(CallId cid);
 
     // --- shared fields ---
     int error_code_;
@@ -164,7 +203,12 @@ private:
     int64_t timeout_ms_;
     int max_retry_;
     int64_t log_id_;
-    bool canceled_;
+    // Written by the cancel paths (client StartCancel; server: CANCEL
+    // meta / RST_STREAM / connection death on the input fiber) and read
+    // by the handler's fiber via IsCanceled().
+    std::atomic<bool> canceled_{false};
+    // NotifyOnCancel closure; exchanged to null on the (single) run.
+    std::atomic<google::protobuf::Closure*> on_cancel_{nullptr};
     IOBuf request_attachment_;
     IOBuf response_attachment_;
     EndPoint remote_side_;
@@ -223,6 +267,15 @@ private:
 
     // --- server call state ---
     Server* server_;
+    // Absolute deadline propagated by the client (0 = none).
+    int64_t server_deadline_us_ = 0;
+    // Cancelable handle registered in server_call::Register.
+    CallId server_call_id_ = INVALID_CALL_ID;
+    // Downstream calls issued by the handler under this server context
+    // (CallId VALUES only — cancellation via id_error is stale-safe, so
+    // completed children need no deregistration).
+    std::mutex child_mu_;
+    std::vector<CallId> child_calls_;
 
 public:
     // rpcz span of this RPC; null when unsampled. Client side: owned by

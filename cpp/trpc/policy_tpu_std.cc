@@ -25,6 +25,7 @@
 #include "trpc/redis.h"
 #include "trpc/rpc_dump.h"
 #include "trpc/server.h"
+#include "trpc/server_call.h"
 #include "trpc/span.h"
 #include "trpc/stream.h"
 
@@ -77,6 +78,20 @@ ParseResult ParseTpuStdMessage(IOBuf* source, Socket* socket, bool read_eof,
     source->cutn(&msg->meta, meta_size);
     source->cutn(&msg->body, body_size - meta_size);
     return ParseResult::make_ok(msg);
+}
+
+void SendTpuStdCancel(SocketId sid, uint64_t cid) {
+    rpc::RpcMeta meta;
+    meta.set_correlation_id(cid);
+    meta.set_cancel(true);
+    IOBuf meta_buf;
+    SerializePbToIOBuf(meta, &meta_buf);
+    IOBuf frame;
+    PackTpuStdFrame(&frame, meta_buf, IOBuf(), IOBuf());
+    SocketUniquePtr s;
+    if (Socket::AddressSocket(sid, &s) == 0) {
+        s->Write(&frame);
+    }
 }
 
 void PackTpuStdFrame(IOBuf* out, const IOBuf& meta_pb, const IOBuf& payload,
@@ -165,6 +180,12 @@ public:
             Collector::singleton()->submit(cntl_->span_);
             cntl_->span_ = nullptr;
         }
+        // Cancellation teardown: deregister BEFORE destroying the id so
+        // no new cancel can find a dying handle; DestroyServerCallId
+        // serializes behind any in-flight cancel delivery (the thunk
+        // holds the id lock while touching the controller).
+        server_call::Unregister(sid_, cid_);
+        cntl_->DestroyServerCallId();
         // Stats + limiter + Join wakeup; Finish is the LAST touch of
         // Server memory (the Server may be destroyed right after).
         guard_->Finish(cntl_->ErrorCode());
@@ -207,14 +228,44 @@ std::atomic<int64_t> g_usercode_default_inflight{0};
 // kUsercodeBackupTag (policy_tpu_std.h): tag 63, reserved for this pool;
 // Server::Start enforces the reservation.
 
+// Last line of the expired-shed defense: the deadline may pass while the
+// request waits for a handler fiber (queueing under overload is exactly
+// when budgets die). True = the caller must run `done` WITHOUT invoking
+// the service method.
+bool ShedIfExpired(Server::MethodProperty* mp, Controller* cntl) {
+    if (!cntl->has_server_deadline() ||
+        monotonic_time_us() < cntl->server_deadline_us()) {
+        return false;
+    }
+    mp->status->nexpired.fetch_add(1, std::memory_order_relaxed);
+    server_call::CountExpired();
+    cntl->SetFailed(TERR_RPC_TIMEDOUT,
+                    "deadline expired before handler dispatch");
+    return true;
+}
+
+// Invoke the service method with the fiber-local server-call context
+// published (Channel::CallMethod inside the handler inherits the
+// remaining deadline and registers for the cancel cascade through it).
+void CallUserMethod(Server::MethodProperty* mp, Controller* cntl,
+                    google::protobuf::Message* req,
+                    google::protobuf::Message* res,
+                    google::protobuf::Closure* done) {
+    if (ShedIfExpired(mp, cntl)) {
+        done->Run();
+        return;
+    }
+    ServerCallScope scope(cntl);
+    mp->service->CallMethod(mp->method, cntl, req, res, done);
+}
+
 void* RunUserCall(void* arg) {
     auto* a = (UserCallArgs*)arg;
     if (a->cntl->span_ != nullptr) {
         a->cntl->span_->process_start_us = monotonic_time_us();
     }
     const bool counted = a->counted_default;
-    a->mp->service->CallMethod(a->mp->method, a->cntl, a->req, a->res,
-                               a->done);
+    CallUserMethod(a->mp, a->cntl, a->req, a->res, a->done);
     delete a;
     if (counted) {
         g_usercode_default_inflight.fetch_sub(1, std::memory_order_relaxed);
@@ -278,12 +329,41 @@ void ProcessTpuStdRequest(TpuStdMessage* msg, const rpc::RpcMeta& meta) {
                               req_meta.method_name());
         return;
     }
+    // Server-side deadline: the meta carries the client's REMAINING
+    // budget at send time (IssueRPC stamps (deadline - now)/1000, so a
+    // caller that has already given up stamps <= 0). Shed expired
+    // requests here — before admission, before parse, before a handler
+    // fiber — executing them is pure waste the client will never read.
+    const int64_t arrival_us = monotonic_time_us();
+    int64_t deadline_us = 0;
+    if (req_meta.has_timeout_ms()) {
+        if (req_meta.timeout_ms() <= 0) {
+            mp->status->nexpired.fetch_add(1, std::memory_order_relaxed);
+            server_call::CountExpired();
+            SendErrorResponse(sid, cid, TERR_RPC_TIMEDOUT,
+                              "deadline already expired on arrival");
+            return;
+        }
+        deadline_us = arrival_us + req_meta.timeout_ms() * 1000;
+    }
     // Admission control (reference ConcurrencyLimiter::OnRequested —
-    // constant or gradient "auto" per ServerOptions).
-    auto* guard = new Server::MethodCallGuard(server, mp);
+    // constant or gradient "auto" per ServerOptions). The remaining
+    // budget rides along so the timeout limiter can shed requests that
+    // cannot finish in time (AdmitWithBudget).
+    auto* guard = new Server::MethodCallGuard(
+        server, mp, deadline_us > 0 ? deadline_us - arrival_us : -1);
     if (guard->rejected()) {
+        const bool shed = guard->shed();
         delete guard;
-        SendErrorResponse(sid, cid, TERR_LIMIT_EXCEEDED, "concurrency limit");
+        if (shed) {
+            server_call::CountShed();
+            SendErrorResponse(sid, cid, TERR_LIMIT_EXCEEDED,
+                              "remaining deadline budget below observed "
+                              "service time");
+        } else {
+            SendErrorResponse(sid, cid, TERR_LIMIT_EXCEEDED,
+                              "concurrency limit");
+        }
         return;
     }
 
@@ -326,6 +406,7 @@ void ProcessTpuStdRequest(TpuStdMessage* msg, const rpc::RpcMeta& meta) {
     auto* cntl = new Controller;
     cntl->InitServerSide(server, s->remote_side());
     cntl->set_server_socket(sid);
+    cntl->set_server_deadline_us(deadline_us);
     // Expose the request's compression to the handler (reference
     // Controller::request_compress_type); the response defaults to none
     // unless the handler opts in.
@@ -371,6 +452,15 @@ void ProcessTpuStdRequest(TpuStdMessage* msg, const rpc::RpcMeta& meta) {
                               meta.stream_settings().window_size());
     }
     cntl->request_attachment() = attachment;
+    // Cancelable handle: a tpu_std CANCEL meta, an h2 RST, or this
+    // connection's death reaches the controller through the registry
+    // (trpc/server_call.h); the done closure tears both down. Every path
+    // from here runs the done closure, so the registration cannot leak.
+    CallId scid = INVALID_CALL_ID;
+    if (id_create(&scid, cntl, &Controller::HandleServerCancelThunk) == 0) {
+        cntl->set_server_call_id(scid);
+        server_call::Register(sid, cid, scid);
+    }
     auto* done = new SendResponseClosure(server, guard, cntl, req, res, sid,
                                          cid);
     if (!ParsePbFromIOBuf(req, payload)) {
@@ -385,7 +475,7 @@ void ProcessTpuStdRequest(TpuStdMessage* msg, const rpc::RpcMeta& meta) {
     // the original finished (reference keeps user code off the input path:
     // baidu_rpc_protocol.cpp:758,839-849, details/usercode_backup_pool.h).
     if (server->options().usercode_inline) {
-        mp->service->CallMethod(mp->method, cntl, req, res, done);
+        CallUserMethod(mp, cntl, req, res, done);
         return;
     }
     auto* uc = new UserCallArgs{mp, cntl, req, res, done};
@@ -416,7 +506,7 @@ void ProcessTpuStdRequest(TpuStdMessage* msg, const rpc::RpcMeta& meta) {
             g_usercode_default_inflight.fetch_sub(
                 1, std::memory_order_relaxed);
         }
-        mp->service->CallMethod(mp->method, cntl, req, res, done);
+        CallUserMethod(mp, cntl, req, res, done);
     }
 }
 
@@ -434,6 +524,12 @@ void ProcessTpuStdMessage(InputMessageBase* raw) {
         if (Socket::AddressSocket(msg->socket_id, &s) == 0) {
             s->SetFailedWithError(TERR_REQUEST);
         }
+        return;
+    }
+    if (meta.cancel()) {
+        // Cancel notification: mark the in-flight server call canceled
+        // (stale-safe — a completed call's registry entry is gone).
+        server_call::Cancel(msg->socket_id, meta.correlation_id());
         return;
     }
     if (meta.has_request()) {
@@ -457,6 +553,10 @@ void GlobalInitializeOrDie() {
              oldact.sa_sigaction == nullptr)) {
             CHECK(SIG_ERR != signal(SIGPIPE, SIG_IGN));
         }
+        // Connection death cancels the server calls still in flight on
+        // it (the observer hops to a fresh fiber before running any
+        // cancellation, so SetFailed's callers never execute user code).
+        Socket::set_failure_observer(&server_call::OnSocketFailed);
         Protocol p;
         p.parse = ParseTpuStdMessage;
         p.process = ProcessTpuStdMessage;

@@ -13,6 +13,7 @@
 #pragma once
 
 #include "tnet/protocol.h"
+#include "tnet/socket.h"
 
 namespace tpurpc {
 
@@ -33,6 +34,10 @@ void PackTpuStdFrame(IOBuf* out, const IOBuf& meta_pb, const IOBuf& payload,
 // Registered index of the tpu_std protocol (valid after
 // GlobalInitializeOrDie).
 int TpuStdProtocolIndex();
+
+// Best-effort CANCEL notification for the in-flight call `cid` on `sid`
+// (a meta-only frame with `cancel` set; the receiver drops unknown ids).
+void SendTpuStdCancel(SocketId sid, uint64_t cid);
 
 // Worker-pool tag reserved for usercode overload isolation (the backup
 // pool that absorbs excess blocking handlers — policy_tpu_std.cc

@@ -8,6 +8,8 @@
 // backup_request_policy (not owned, must outlive the channel).
 #pragma once
 
+#include <algorithm>
+#include <atomic>
 #include <cstdint>
 
 #include "tbase/fast_rand.h"
@@ -60,6 +62,66 @@ public:
 private:
     int64_t min_ms_;
     int64_t max_ms_;
+};
+
+// Per-channel retry throttling (the gRPC "retry budget" / retry
+// throttling shape): a token bucket holding up to `max_tokens` tokens,
+// drained one token per RE-ISSUE (retry or backup request) and refilled
+// by `token_ratio` tokens per success. Under a correlated failure every
+// channel quickly exhausts its burst and stops re-issuing — the
+// retry-storm amplification "RPC Considered Harmful" warns about is
+// bounded at (burst + ratio * successes) instead of (max_retry *
+// failures). Lock-free; tokens are tracked in milli-tokens so
+// fractional ratios accumulate exactly.
+class RetryBudget {
+public:
+    RetryBudget() = default;
+    // max_tokens <= 0 disables throttling (Withdraw always grants).
+    void Configure(int64_t max_tokens, double token_ratio) {
+        max_milli_ = max_tokens * 1000;
+        ratio_milli_ = (int64_t)(token_ratio * 1000.0);
+        tokens_milli_.store(max_milli_ > 0 ? max_milli_ : 0,
+                            std::memory_order_relaxed);
+    }
+    bool enabled() const { return max_milli_ > 0; }
+    // Take one token for a re-issue; false = budget exhausted, do not
+    // re-issue.
+    bool Withdraw() {
+        if (max_milli_ <= 0) return true;
+        int64_t cur = tokens_milli_.load(std::memory_order_relaxed);
+        while (cur >= 1000) {
+            if (tokens_milli_.compare_exchange_weak(
+                    cur, cur - 1000, std::memory_order_relaxed)) {
+                return true;
+            }
+        }
+        return false;
+    }
+    // Return a withdrawn token whose re-issue never went out (e.g. the
+    // call-id version bump failed after Withdraw).
+    void Refund() { DepositMilli(1000); }
+    // A completed success earns `token_ratio` tokens back (capped).
+    void OnSuccess() { DepositMilli(ratio_milli_); }
+    int64_t tokens() const {
+        return tokens_milli_.load(std::memory_order_relaxed) / 1000;
+    }
+
+private:
+    void DepositMilli(int64_t amount) {
+        if (max_milli_ <= 0 || amount <= 0) return;
+        int64_t cur = tokens_milli_.load(std::memory_order_relaxed);
+        while (cur < max_milli_) {
+            const int64_t next = std::min(max_milli_, cur + amount);
+            if (tokens_milli_.compare_exchange_weak(
+                    cur, next, std::memory_order_relaxed)) {
+                return;
+            }
+        }
+    }
+
+    int64_t max_milli_ = 0;
+    int64_t ratio_milli_ = 0;
+    std::atomic<int64_t> tokens_milli_{0};
 };
 
 // Backup requests: when and whether to hedge (reference

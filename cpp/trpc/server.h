@@ -32,6 +32,12 @@ struct MethodStatus {
     std::atomic<int64_t> concurrency{0};
     std::atomic<int64_t> nerror{0};
     std::atomic<int64_t> nrejected{0};
+    // Deadline accounting (the /status expired/shed columns): requests
+    // whose propagated deadline had already passed before handler
+    // dispatch, and requests shed because their remaining budget was
+    // below the observed service time (AdmitWithBudget).
+    std::atomic<int64_t> nexpired{0};
+    std::atomic<int64_t> nshed{0};
     // Null = unlimited. Constant or gradient "auto" per ServerOptions.
     std::unique_ptr<ConcurrencyLimiter> limiter;
     int64_t max_concurrency() const {
@@ -167,13 +173,19 @@ public:
     // limiter/stat protocol in ONE place instead of per-protocol copies.
     class MethodCallGuard {
     public:
-        MethodCallGuard(Server* server, MethodProperty* mp)
+        // remaining_budget_us: the request's propagated remaining
+        // deadline budget, or -1 when the client sent none. Budget-aware
+        // limiters (TimeoutConcurrencyLimiter::AdmitWithBudget) shed
+        // requests that cannot finish in time; such rejections are
+        // accounted as `shed` rather than `rejected`.
+        MethodCallGuard(Server* server, MethodProperty* mp,
+                        int64_t remaining_budget_us = -1)
             : server_(server), mp_(mp) {
             const int64_t cur = mp_->status->concurrency.fetch_add(
                                     1, std::memory_order_relaxed) +
                                 1;
-            if (mp_->status->limiter != nullptr &&
-                !mp_->status->limiter->OnRequested(cur)) {
+            ConcurrencyLimiter* lim = mp_->status->limiter.get();
+            if (lim != nullptr && !lim->OnRequested(cur)) {
                 mp_->status->concurrency.fetch_sub(
                     1, std::memory_order_relaxed);
                 mp_->status->nrejected.fetch_add(1,
@@ -181,10 +193,22 @@ public:
                 rejected_ = true;
                 return;
             }
+            if (lim != nullptr && remaining_budget_us >= 0 &&
+                !lim->AdmitWithBudget(remaining_budget_us)) {
+                mp_->status->concurrency.fetch_sub(
+                    1, std::memory_order_relaxed);
+                mp_->status->nshed.fetch_add(1, std::memory_order_relaxed);
+                rejected_ = true;
+                shed_ = true;
+                return;
+            }
             server_->BeginRequest();
             start_us_ = monotonic_time_us();
         }
         bool rejected() const { return rejected_; }
+        // Rejection was budget-based shedding (the request could not
+        // have finished inside its remaining deadline).
+        bool shed() const { return shed_; }
         // Complete the call: record latency/errors, feed the limiter,
         // wake Join. error_code 0 = success. Must be called exactly once
         // unless rejected().
@@ -206,6 +230,7 @@ public:
         MethodProperty* mp_;
         int64_t start_us_ = 0;
         bool rejected_ = false;
+        bool shed_ = false;
     };
     // Admission + accounting for one request (called by protocol layers).
     void BeginRequest() {

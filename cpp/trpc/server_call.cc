@@ -1,0 +1,133 @@
+#include "trpc/server_call.h"
+
+#include <cerrno>
+#include <map>
+#include <mutex>
+#include <utility>
+#include <vector>
+
+#include "tbase/time.h"
+#include "tfiber/fiber.h"
+#include "tfiber/fiber_key.h"
+#include "tfiber/timer_thread.h"
+#include "tvar/reducer.h"
+
+namespace tpurpc {
+
+namespace {
+
+fiber_key_t g_current_call_key = INVALID_FIBER_KEY;
+std::once_flag g_key_once;
+
+void EnsureKey() {
+    // No destructor: the scope object owns the value's lifetime; the
+    // fiber-local slot only ever holds borrowed pointers.
+    std::call_once(g_key_once,
+                   [] { fiber_key_create(&g_current_call_key, nullptr); });
+}
+
+}  // namespace
+
+Controller* CurrentServerCall() {
+    EnsureKey();
+    return (Controller*)fiber_getspecific(g_current_call_key);
+}
+
+ServerCallScope::ServerCallScope(Controller* cntl) {
+    EnsureKey();
+    prev_ = (Controller*)fiber_getspecific(g_current_call_key);
+    fiber_setspecific(g_current_call_key, cntl);
+}
+
+ServerCallScope::~ServerCallScope() {
+    fiber_setspecific(g_current_call_key, prev_);
+}
+
+namespace server_call {
+
+namespace {
+
+// (socket, wire key) -> server-call CallId. An std::map ordered by the
+// pair gives CancelAllOnSocket a cheap per-socket range scan. One global
+// mutex: every op is a few map touches with no user code under the lock
+// (cancel delivery happens through id_error AFTER the lock drops).
+std::mutex g_mu;
+std::map<std::pair<SocketId, uint64_t>, CallId> g_calls;
+
+static LazyAdder g_expired("rpc_server_expired_requests");
+static LazyAdder g_shed("rpc_server_shed_requests");
+static LazyAdder g_canceled("rpc_server_canceled_calls");
+
+void* CancelAllFiber(void* arg) {
+    CancelAllOnSocket((SocketId)(uintptr_t)arg);
+    return nullptr;
+}
+void CancelAllTimerCb(void* arg) { CancelAllFiber(arg); }
+
+}  // namespace
+
+void Register(SocketId sid, uint64_t key, CallId scid) {
+    std::lock_guard<std::mutex> g(g_mu);
+    g_calls[{sid, key}] = scid;
+}
+
+void Unregister(SocketId sid, uint64_t key) {
+    std::lock_guard<std::mutex> g(g_mu);
+    g_calls.erase({sid, key});
+}
+
+void Cancel(SocketId sid, uint64_t key) {
+    CallId scid = INVALID_CALL_ID;
+    {
+        std::lock_guard<std::mutex> g(g_mu);
+        auto it = g_calls.find({sid, key});
+        if (it == g_calls.end()) return;  // already finished: drop
+        scid = it->second;
+        // Leave the entry: the done closure owns its removal, and a
+        // duplicate cancel is a stale-safe no-op on the id.
+    }
+    id_error(scid, ECANCELED);
+}
+
+void CancelAllOnSocket(SocketId sid) {
+    std::vector<CallId> scids;
+    {
+        std::lock_guard<std::mutex> g(g_mu);
+        auto it = g_calls.lower_bound({sid, 0});
+        while (it != g_calls.end() && it->first.first == sid) {
+            scids.push_back(it->second);
+            it = g_calls.erase(it);
+        }
+    }
+    for (CallId scid : scids) {
+        id_error(scid, ECANCELED);
+    }
+}
+
+void OnSocketFailed(SocketId sid) {
+    {
+        // Fast path: most failed sockets (client conns, idle server
+        // conns) have nothing registered — don't pay a fiber for them.
+        std::lock_guard<std::mutex> g(g_mu);
+        auto it = g_calls.lower_bound({sid, 0});
+        if (it == g_calls.end() || it->first.first != sid) return;
+    }
+    fiber_t tid;
+    if (fiber_start_background(&tid, nullptr, CancelAllFiber,
+                               (void*)(uintptr_t)sid) != 0) {
+        // NEVER inline: OnFailed may run under arbitrary locks and the
+        // cascade runs user closures. The timer thread is lock-free
+        // context; EndRPC already keeps user done closures off it.
+        TimerThread::singleton()->schedule(CancelAllTimerCb,
+                                           (void*)(uintptr_t)sid,
+                                           monotonic_time_us());
+    }
+}
+
+void CountExpired() { *g_expired << 1; }
+void CountShed() { *g_shed << 1; }
+void CountCanceled() { *g_canceled << 1; }
+
+}  // namespace server_call
+
+}  // namespace tpurpc

@@ -1,0 +1,76 @@
+// Server-side call context plumbing: the fiber-local "current server
+// call", and the registry that maps in-flight server calls to cancelable
+// handles.
+//
+// Two jobs, both serving the end-to-end deadline/cancellation story:
+//
+//  1. Hop-to-hop inheritance: while a user handler runs, a ServerCallScope
+//     publishes its server-side Controller in fiber-local storage.
+//     Channel::CallMethod consults CurrentServerCall() so a downstream
+//     call issued inside the handler caps its deadline at the upstream
+//     remaining budget and registers for cancel propagation.
+//
+//  2. Cancellation cascade: every dispatched server call mints a CallId
+//     (tfiber/call_id.h) whose on_error handler cancels the server-side
+//     Controller. The registry maps (socket, wire key) -> that CallId so
+//     a tpu_std CANCEL meta, an h2 RST_STREAM, or connection death can
+//     deliver the cancel; CallId versioning makes every delivery path
+//     stale-safe against the response having already finished (the same
+//     hazard discipline as RPC timers holding only id VALUES).
+#pragma once
+
+#include <cstdint>
+
+#include "tfiber/call_id.h"
+#include "tnet/socket.h"
+
+namespace tpurpc {
+
+class Controller;
+
+// The server-side Controller of the call whose handler is running on this
+// fiber (or pthread), or null outside a handler. Valid only for the
+// synchronous extent of the handler body — a handler that defers work to
+// another fiber must capture what it needs itself.
+Controller* CurrentServerCall();
+
+// RAII publisher for CurrentServerCall (nests: restores the previous
+// value, so a handler that issues a local loopback call which dispatches
+// inline keeps both contexts straight).
+class ServerCallScope {
+public:
+    explicit ServerCallScope(Controller* cntl);
+    ~ServerCallScope();
+    ServerCallScope(const ServerCallScope&) = delete;
+    ServerCallScope& operator=(const ServerCallScope&) = delete;
+
+private:
+    Controller* prev_;
+};
+
+namespace server_call {
+
+// Registry of cancelable in-flight server calls. `key` is the wire
+// identity of the call on its connection: the tpu_std correlation id, or
+// the h2 stream id (one protocol per connection, so the spaces never
+// collide on one socket).
+void Register(SocketId sid, uint64_t key, CallId scid);
+void Unregister(SocketId sid, uint64_t key);
+// Cancel one call (stale-safe no-op when it already completed).
+void Cancel(SocketId sid, uint64_t key);
+// Cancel everything still in flight on a dead connection.
+void CancelAllOnSocket(SocketId sid);
+// Socket failure observer (installed by GlobalInitializeOrDie): hops to a
+// fresh fiber before cancelling — Socket::SetFailed may run under
+// arbitrary locks and cancellation runs user NotifyOnCancel closures.
+void OnSocketFailed(SocketId sid);
+
+// Shared observability counters (single LazyAdder per name; the tpu_std
+// and h2 paths both feed them).
+void CountExpired();   // rpc_server_expired_requests
+void CountShed();      // rpc_server_shed_requests
+void CountCanceled();  // rpc_server_canceled_calls
+
+}  // namespace server_call
+
+}  // namespace tpurpc

@@ -73,18 +73,24 @@ def _var(port, name):
 
 
 class Node:
-    def __init__(self, binary, port, idx, peers_file):
+    def __init__(self, binary, port, idx, peers_file, flags=NODE_FLAGS,
+                 extra_args=()):
         self.port = port
         self.idx = idx
         self.proc = subprocess.Popen(
             [str(binary), "--port", str(port), "--id", str(idx), "--peers",
              str(peers_file)]
-            + [arg for f in NODE_FLAGS for arg in ("--flag", f)],
+            + list(extra_args)
+            + [arg for f in flags for arg in ("--flag", f)],
             stdin=subprocess.PIPE,
             stdout=subprocess.PIPE,
             stderr=subprocess.DEVNULL,
         )
         self._buf = b""
+
+    def send(self, line):
+        self.proc.stdin.write(line.encode() + b"\n")
+        self.proc.stdin.flush()
 
     def _readline(self, deadline):
         while b"\n" not in self._buf:
@@ -232,6 +238,131 @@ def test_mesh_chaos_soak(cpp_build, tmp_path):
 
         # Clean teardown: exit 0 requires Server::Join to quiesce every
         # socket — leaks show up as a hang (timeout) or non-zero exit.
+        for n in nodes:
+            assert n.shutdown() == 0, "node %d unclean exit" % n.idx
+    finally:
+        for n in nodes:
+            try:
+                n.proc.kill()
+            except OSError:
+                pass
+
+
+def test_deadline_budget_soak(cpp_build, tmp_path):
+    """Delay-heavy phase: deadline propagation + retry budgets (ISSUE 2).
+
+    Three nodes; mid-run every handler starts sleeping 50 ms while a
+    stale-traffic fiber issues budget-starved calls (1 ms / 30 ms
+    deadlines, both below the learned ~50 ms service time -> shed by the
+    TimeoutConcurrencyLimiter at admission), a raw probe fiber sends
+    handcrafted frames stamped timeout_ms=0 (the wire shape of a client
+    that already gave up -> expired-on-arrival shed), and one node gets
+    reset-chaos on its client side to provoke retries against the
+    configured retry budget.
+
+    Asserted:
+      * expired requests are SHED, not executed (rpc_server_expired_requests
+        / rpc_server_shed_requests grow; stale executions stay a minority);
+      * total re-issues stay within the configured retry budget
+        (burst + ratio * successes, per channel) and
+        rpc_retry_budget_exhausted is observable;
+      * zero lost completions on every plane, clean exit 0.
+    """
+    num = 3
+    budget_tokens = 20
+    budget_ratio = 0.1
+    binary = cpp_build / "mesh_node"
+    assert binary.exists(), "mesh_node not built"
+    ports = _free_ports(num)
+    peers_file = tmp_path / "mesh_members"
+    peers_file.write_text("".join("127.0.0.1:%d\n" % p for p in ports))
+
+    flags = NODE_FLAGS + [
+        "rpc_retry_budget_tokens=%d" % budget_tokens,
+        "rpc_retry_budget_ratio=%g" % budget_ratio,
+        # Every stale call fails BY DESIGN (that's the point of the
+        # phase); with the soak-tightened breaker windows those errors
+        # would isolate healthy servers and starve the shed counters.
+        # Breaker isolate/revive cycles are the kill+partition soak's
+        # subject, not this one's.
+        "enable_circuit_breaker=false",
+    ]
+    nodes = [
+        Node(binary, ports[i], i, peers_file, flags=flags,
+             extra_args=("--timeout_cl_ms", "800"))
+        for i in range(num)
+    ]
+    try:
+        for n in nodes:
+            assert n.wait_ready(), "node %d never became ready" % n.idx
+
+        time.sleep(2.0)  # healthy traffic; EMA learns the fast latency
+
+        # --- delay-heavy phase -----------------------------------------
+        for n in nodes:
+            n.send("delay 50 30")
+        # Reset-chaos on node 2's client side: connection-level failures
+        # are retryable, so its channels retry until the budget is dry.
+        others = ",".join(
+            "127.0.0.1:%d" % p for i, p in enumerate(ports) if i != 2)
+        _chaos(ports[2], enable=1, seed=4242, plan="reset=0.3",
+               peers=others)
+
+        # Shedding and budget exhaustion become observable within the
+        # phase (bounded poll beats a fixed sleep on a loaded host).
+        deadline = time.time() + 30.0
+        expired = shed = exhausted = 0
+        while time.time() < deadline:
+            expired = sum(
+                _var(p, "rpc_server_expired_requests") for p in ports)
+            shed = sum(_var(p, "rpc_server_shed_requests") for p in ports)
+            exhausted = sum(
+                _var(p, "rpc_retry_budget_exhausted") for p in ports)
+            if expired >= 5 and shed >= 5 and exhausted >= 1:
+                break
+            time.sleep(1.0)
+        assert expired >= 5, "expired-on-arrival requests were not shed"
+        assert shed >= 5, "budget-below-service-time requests were not shed"
+        assert exhausted >= 1, "retry budget never exhausted under chaos"
+
+        # --- heal + drain ----------------------------------------------
+        _chaos(ports[2], enable=0)
+        for n in nodes:
+            n.send("delay 0 0")
+        time.sleep(1.5)
+
+        # Read per-process re-issue counters BEFORE stopping traffic
+        # is unnecessary — the processes (and /vars) stay alive until
+        # shutdown; reports first, then vars.
+        reports = []
+        for n in nodes:
+            rep = n.stop_and_report()
+            assert rep is not None, "node %d produced no report" % n.idx
+            reports.append(rep)
+
+        for i, rep in enumerate(reports):
+            # Zero lost completions on every plane, stale included.
+            assert rep["outstanding"] == 0, rep
+            assert rep["lb_issued"] == rep["lb_ok"] + rep["lb_failed"], rep
+            assert rep["shm_issued"] == rep["shm_ok"] + rep["shm_failed"], rep
+            assert rep["stale_issued"] == (
+                rep["stale_ok"] + rep["stale_failed"]), rep
+            # The server dropped (expired/shed) most stale calls instead
+            # of executing work nobody reads.
+            assert rep["stale_issued"] > 20, rep
+            assert rep["stale_executed"] <= rep["stale_issued"] // 2, rep
+            # Re-issues bounded by the configured budget: one LB channel
+            # + (num-1) shm channels per node, each reconnect is a fresh
+            # channel (fresh burst), plus ratio * successes earned back.
+            ok = rep["lb_ok"] + rep["shm_ok"] + rep["stale_ok"]
+            channels = 1 + (num - 1) + rep["reconnects"]
+            bound = channels * budget_tokens + budget_ratio * ok + 50
+            reissues = (_var(ports[i], "rpc_client_retries")
+                        + _var(ports[i], "rpc_client_backup_requests"))
+            assert reissues <= bound, (
+                "node %d re-issued %d times, budget bound %.0f (%s)"
+                % (i, reissues, bound, rep))
+
         for n in nodes:
             assert n.shutdown() == 0, "node %d unclean exit" % n.idx
     finally:

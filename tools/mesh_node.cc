@@ -19,8 +19,18 @@
 // stdin protocol (like echo_bench --ici-server): "stop\n" stops traffic
 // and prints one "REPORT {json}" line; EOF shuts the node down
 // (Stop+Join, then _exit(0) — exit code 0 only after a clean quiesce).
+//
+// Delay-heavy phase (the deadline/budget soak): "delay H S\n" makes the
+// echo handler sleep H ms and turns on a stale-traffic fiber issuing
+// budget-starved calls (1 ms and S ms deadlines) marked req.stale; the
+// handler counts executed stale requests so the soak can assert the
+// server SHED them (expired / budget-below-service-time) instead of
+// executing work nobody will read. "--timeout_cl_ms N" enables the
+// server's TimeoutConcurrencyLimiter for the budget-shed path.
+#include <netinet/in.h>
 #include <signal.h>
 #include <sys/prctl.h>
+#include <sys/socket.h>
 #include <unistd.h>
 
 #include <atomic>
@@ -32,6 +42,7 @@
 #include <vector>
 
 #include "bench_echo.pb.h"
+#include "rpc_meta.pb.h"
 #include "tbase/endpoint.h"
 #include "tbase/flags.h"
 #include "tbase/logging.h"
@@ -41,11 +52,19 @@
 #include "tici/shm_link.h"
 #include "trpc/channel.h"
 #include "trpc/controller.h"
+#include "trpc/pb_compat.h"
+#include "trpc/policy_tpu_std.h"
 #include "trpc/server.h"
 
 using namespace tpurpc;
 
 namespace {
+
+// Delay-phase knobs (stdin "delay H S"): handler sleep + stale-call
+// budget. Stale executions are the soak's proof of (non-)shedding.
+std::atomic<int> g_handler_delay_ms{0};
+std::atomic<int> g_stale_budget_ms{0};
+std::atomic<int64_t> g_stale_executed{0};
 
 class EchoServiceImpl : public benchpb::EchoService {
 public:
@@ -54,6 +73,13 @@ public:
               benchpb::EchoResponse* response,
               google::protobuf::Closure* done) override {
         Controller* cntl = static_cast<Controller*>(cntl_base);
+        if (request->stale()) {
+            g_stale_executed.fetch_add(1, std::memory_order_relaxed);
+        }
+        const int delay_ms = g_handler_delay_ms.load(std::memory_order_relaxed);
+        if (delay_ms > 0) {
+            fiber_usleep((int64_t)delay_ms * 1000);
+        }
         response->set_send_ts_us(request->send_ts_us());
         cntl->response_attachment().append(cntl->request_attachment());
         done->Run();
@@ -63,6 +89,8 @@ public:
 struct Counters {
     std::atomic<int64_t> lb_issued{0}, lb_ok{0}, lb_failed{0};
     std::atomic<int64_t> shm_issued{0}, shm_ok{0}, shm_failed{0};
+    std::atomic<int64_t> stale_issued{0}, stale_ok{0}, stale_failed{0};
+    std::atomic<int64_t> expired_probes{0};
     std::atomic<int64_t> outstanding{0};
     std::atomic<int64_t> reconnects{0};
 };
@@ -138,6 +166,113 @@ void* ShmTrafficFiber(void* arg) {
     return nullptr;
 }
 
+// Delay-phase client: issues budget-starved calls against the LB plane.
+// Two flavors per round, a 1 ms deadline (the minimum the stamp floor
+// produces) and a g_stale_budget_ms deadline — both positive but below
+// the handler-delay-taught service time, so the
+// TimeoutConcurrencyLimiter's budget check sheds them at admission.
+// Both fail client-side fast; the invariant is that the server did not
+// EXECUTE them (g_stale_executed stays low).
+void* StaleTrafficFiber(void* arg) {
+    auto* st = (NodeState*)arg;
+    while (!st->stop.load(std::memory_order_relaxed)) {
+        const int budget_ms = g_stale_budget_ms.load(std::memory_order_relaxed);
+        if (budget_ms <= 0) {
+            fiber_usleep(20 * 1000);
+            continue;
+        }
+        const int64_t budgets[2] = {1, budget_ms};
+        for (int k = 0; k < 2; ++k) {
+            if (st->stop.load(std::memory_order_relaxed)) break;
+            st->counters.outstanding.fetch_add(1);
+            st->counters.stale_issued.fetch_add(1);
+            benchpb::EchoService_Stub stub(st->lb_channel.get());
+            Controller cntl;
+            cntl.set_timeout_ms(budgets[k]);
+            cntl.set_max_retry(0);  // a doomed call must not re-issue
+            benchpb::EchoRequest req;
+            benchpb::EchoResponse res;
+            req.set_send_ts_us(monotonic_time_us());
+            req.set_stale(true);
+            stub.Echo(&cntl, &req, &res, nullptr);  // sync: terminates
+            if (cntl.Failed()) {
+                st->counters.stale_failed.fetch_add(1);
+            } else {
+                st->counters.stale_ok.fetch_add(1);
+            }
+            st->counters.outstanding.fetch_sub(1);
+        }
+        fiber_usleep(15 * 1000);
+    }
+    return nullptr;
+}
+
+// Delay-phase raw probe: handcrafted tpu_std frames stamped
+// timeout_ms=0 — the wire shape of "the client already gave up" (a
+// conforming client floors live budgets at 1 ms, so 0 only appears when
+// the deadline truly passed). The server must reject these BEFORE
+// admission, parse, or user code (rpc_server_expired_requests); they
+// can never reach the handler, so g_stale_executed is structurally
+// untouched by them.
+void* ExpiredProbeFiber(void* arg) {
+    auto* st = (NodeState*)arg;
+    int fd = -1;
+    uint64_t probe_cid = 1;
+    while (!st->stop.load(std::memory_order_relaxed)) {
+        if (g_stale_budget_ms.load(std::memory_order_relaxed) <= 0 ||
+            st->links.empty()) {
+            if (fd >= 0) {
+                close(fd);
+                fd = -1;
+            }
+            fiber_usleep(20 * 1000);
+            continue;
+        }
+        if (fd < 0) {
+            fd = ::socket(AF_INET, SOCK_STREAM, 0);
+            sockaddr_in addr;
+            endpoint2sockaddr(st->links[0]->ep, &addr);
+            if (fd < 0 ||
+                ::connect(fd, (sockaddr*)&addr, sizeof(addr)) != 0) {
+                if (fd >= 0) {
+                    close(fd);
+                    fd = -1;
+                }
+                fiber_usleep(100 * 1000);
+                continue;
+            }
+        }
+        rpc::RpcMeta meta;
+        auto* rm = meta.mutable_request();
+        rm->set_service_name("benchpb.EchoService");
+        rm->set_method_name("Echo");
+        rm->set_timeout_ms(0);  // expired on arrival, by construction
+        meta.set_correlation_id(probe_cid++);
+        benchpb::EchoRequest req;
+        req.set_stale(true);
+        IOBuf meta_buf, payload;
+        SerializePbToIOBuf(meta, &meta_buf);
+        SerializePbToIOBuf(req, &payload);
+        IOBuf frame;
+        PackTpuStdFrame(&frame, meta_buf, payload, IOBuf());
+        const std::string wire = frame.to_string();
+        if (::send(fd, wire.data(), wire.size(), MSG_NOSIGNAL) !=
+            (ssize_t)wire.size()) {
+            close(fd);
+            fd = -1;
+            continue;
+        }
+        st->counters.expired_probes.fetch_add(1);
+        // Drain the error responses without blocking the worker.
+        char drain[4096];
+        while (::recv(fd, drain, sizeof(drain), MSG_DONTWAIT) > 0) {
+        }
+        fiber_usleep(30 * 1000);
+    }
+    if (fd >= 0) close(fd);
+    return nullptr;
+}
+
 // Keeps the mesh connected: (re-)establishes any link whose pinned
 // socket died — a killed peer that comes back on the same port rejoins
 // the mesh here.
@@ -179,11 +314,18 @@ void PrintReport(int id, int port, const Counters& c) {
     printf(
         "REPORT {\"id\": %d, \"port\": %d, \"lb_issued\": %lld, "
         "\"lb_ok\": %lld, \"lb_failed\": %lld, \"shm_issued\": %lld, "
-        "\"shm_ok\": %lld, \"shm_failed\": %lld, \"outstanding\": %lld, "
-        "\"reconnects\": %lld}\n",
+        "\"shm_ok\": %lld, \"shm_failed\": %lld, "
+        "\"stale_issued\": %lld, \"stale_ok\": %lld, "
+        "\"stale_failed\": %lld, \"stale_executed\": %lld, "
+        "\"expired_probes\": %lld, "
+        "\"outstanding\": %lld, \"reconnects\": %lld}\n",
         id, port, (long long)c.lb_issued.load(), (long long)c.lb_ok.load(),
         (long long)c.lb_failed.load(), (long long)c.shm_issued.load(),
         (long long)c.shm_ok.load(), (long long)c.shm_failed.load(),
+        (long long)c.stale_issued.load(), (long long)c.stale_ok.load(),
+        (long long)c.stale_failed.load(),
+        (long long)g_stale_executed.load(),
+        (long long)c.expired_probes.load(),
         (long long)c.outstanding.load(), (long long)c.reconnects.load());
     fflush(stdout);
 }
@@ -193,6 +335,7 @@ void PrintReport(int id, int port, const Counters& c) {
 int main(int argc, char** argv) {
     prctl(PR_SET_PDEATHSIG, SIGKILL);  // die with the driving pytest
     int port = 0, id = 0;
+    int timeout_cl_ms = 0;
     const char* peers_file = nullptr;
     for (int i = 1; i < argc; ++i) {
         if (strcmp(argv[i], "--port") == 0 && i + 1 < argc) {
@@ -201,6 +344,8 @@ int main(int argc, char** argv) {
             id = atoi(argv[++i]);
         } else if (strcmp(argv[i], "--peers") == 0 && i + 1 < argc) {
             peers_file = argv[++i];
+        } else if (strcmp(argv[i], "--timeout_cl_ms") == 0 && i + 1 < argc) {
+            timeout_cl_ms = atoi(argv[++i]);
         } else if (strcmp(argv[i], "--flag") == 0 && i + 1 < argc) {
             // --flag name=value: soak-tuned knobs (breaker windows,
             // health-check cadence, ...) without bespoke plumbing.
@@ -229,7 +374,14 @@ int main(int argc, char** argv) {
     if (server.AddService(&service) != 0) return 1;
     EndPoint listen;
     str2endpoint("127.0.0.1", port, &listen);
-    if (server.Start(listen, nullptr) != 0) {
+    ServerOptions sopts;
+    if (timeout_cl_ms > 0) {
+        // Budget-aware admission: requests whose propagated remaining
+        // deadline is below the observed service time are shed cheaply.
+        sopts.timeout_concurrency = true;
+        sopts.timeout_cl_options.timeout_ms = timeout_cl_ms;
+    }
+    if (server.Start(listen, timeout_cl_ms > 0 ? &sopts : nullptr) != 0) {
         fprintf(stderr, "listen failed on port %d\n", port);
         return 1;
     }
@@ -277,11 +429,19 @@ int main(int argc, char** argv) {
     if (fiber_start_background(&tid, nullptr, ShmTrafficFiber, &st) == 0) {
         fibers.push_back(tid);
     }
+    if (fiber_start_background(&tid, nullptr, StaleTrafficFiber, &st) == 0) {
+        fibers.push_back(tid);
+    }
+    if (fiber_start_background(&tid, nullptr, ExpiredProbeFiber, &st) == 0) {
+        fibers.push_back(tid);
+    }
 
     printf("READY %d\n", port);
     fflush(stdout);
 
-    // Control loop: "stop" -> quiesce traffic + report; EOF -> exit.
+    // Control loop: "stop" -> quiesce traffic + report; "delay H S" ->
+    // delay-heavy phase (handler sleeps H ms, stale fiber issues S-ms
+    // budget calls; 0 0 = back to normal); EOF -> exit.
     char cmd[64];
     while (fgets(cmd, sizeof(cmd), stdin) != nullptr) {
         if (strncmp(cmd, "stop", 4) == 0) {
@@ -291,6 +451,14 @@ int main(int argc, char** argv) {
             PrintReport(id, port, st.counters);
         } else if (strncmp(cmd, "report", 6) == 0) {
             PrintReport(id, port, st.counters);
+        } else if (strncmp(cmd, "delay", 5) == 0) {
+            int h = 0, s_ms = 0;
+            if (sscanf(cmd + 5, "%d %d", &h, &s_ms) == 2) {
+                g_handler_delay_ms.store(h, std::memory_order_relaxed);
+                g_stale_budget_ms.store(s_ms, std::memory_order_relaxed);
+                printf("DELAY_OK %d %d\n", h, s_ms);
+                fflush(stdout);
+            }
         }
     }
     // EOF: orderly shutdown. Stop traffic if "stop" never arrived.

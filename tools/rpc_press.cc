@@ -5,6 +5,12 @@
 //
 //   rpc_press --server=ip:port [--qps=10000] [--duration_s=10]
 //             [--payload=4096] [--callers=8] [--pooled]
+//             [--timeout_ms=5000]
+//
+// --timeout_ms sets the per-request deadline (propagated to the server
+// as the remaining-budget meta): tiny values drive the server's
+// expired-shed and budget-shed paths from the load tool — watch
+// rpc_server_expired_requests / rpc_server_shed_requests in its /vars.
 //
 // Prints qps achieved + latency percentiles; --json for one JSON line.
 #include <unistd.h>
@@ -36,6 +42,7 @@ struct PressCtx {
     std::atomic<int64_t>* sent;
     std::atomic<int64_t>* failed;
     IOBuf* filler;
+    int64_t timeout_ms;
 };
 
 void* PressCaller(void* arg) {
@@ -49,7 +56,7 @@ void* PressCaller(void* arg) {
             continue;
         }
         Controller cntl;
-        cntl.set_timeout_ms(5000);
+        cntl.set_timeout_ms(c->timeout_ms);
         benchpb::EchoRequest req;
         benchpb::EchoResponse res;
         req.set_send_ts_us(monotonic_time_us());
@@ -73,11 +80,18 @@ int main(int argc, char** argv) {
     int duration_s = 10;
     int payload = 4096;
     int callers = 8;
+    long long timeout_ms = 5000;
     bool pooled = false;
     bool json = false;
     for (int i = 1; i < argc; ++i) {
         if (strncmp(argv[i], "--server=", 9) == 0) server_str = argv[i] + 9;
         if (strncmp(argv[i], "--qps=", 6) == 0) qps = atoll(argv[i] + 6);
+        if (strncmp(argv[i], "--timeout_ms=", 13) == 0) {
+            timeout_ms = atoll(argv[i] + 13);
+        }
+        if (strncmp(argv[i], "-timeout_ms=", 12) == 0) {
+            timeout_ms = atoll(argv[i] + 12);
+        }
         if (strncmp(argv[i], "--duration_s=", 13) == 0) {
             duration_s = atoi(argv[i] + 13);
         }
@@ -94,7 +108,7 @@ int main(int argc, char** argv) {
         fprintf(stderr,
                 "usage: rpc_press --server=ip:port [--qps=N] "
                 "[--duration_s=N] [--payload=N] [--callers=N] [--pooled] "
-                "[--json]\n");
+                "[--timeout_ms=N] [--json]\n");
         return 1;
     }
     EndPoint server;
@@ -104,7 +118,7 @@ int main(int argc, char** argv) {
     }
     Channel channel;
     ChannelOptions copts;
-    copts.timeout_ms = 5000;
+    copts.timeout_ms = timeout_ms;
     if (pooled) copts.connection_type = CONNECTION_TYPE_POOLED;
     if (channel.Init(server, &copts) != 0) return 1;
     benchpb::EchoService_Stub stub(&channel);
@@ -116,7 +130,8 @@ int main(int argc, char** argv) {
     std::atomic<bool> stop{false};
     std::atomic<int64_t> sent{0};
     std::atomic<int64_t> failed{0};
-    PressCtx ctx{&stub, &lat, &tokens, &stop, &sent, &failed, &filler};
+    PressCtx ctx{&stub, &lat,    &tokens, &stop,
+                 &sent, &failed, &filler, timeout_ms};
     std::vector<fiber_t> tids((size_t)callers);
     for (auto& tid : tids) {
         fiber_start_background(&tid, nullptr, PressCaller, &ctx);

@@ -15,9 +15,15 @@ MEDIAN (plus min/max spread for the record). Also included:
   - scale_*: qps vs caller fibers 1/4/16/64 (reference benchmark.md:110).
 """
 import json
+import os
+import select
+import socket
 import sys
 import statistics
 import subprocess
+import tempfile
+import time
+import urllib.request
 from pathlib import Path
 
 REPO = Path(__file__).resolve().parent
@@ -98,6 +104,78 @@ def device_path():
     return None
 
 
+def series_scrape():
+    """Time-series trajectory for the BENCH record: boot one mesh_node,
+    drive it with rpc_press --metrics_csv, then scrape the server's own
+    /vars?series= ring — both the client-side per-second qps/p99 rows and
+    the server-side 60s qps ring land in the JSON (trends, not just one
+    number)."""
+    node = BUILD / "mesh_node"
+    press = BUILD / "rpc_press"
+    if not node.exists() or not press.exists():
+        return None
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    proc = None
+    try:
+        with tempfile.TemporaryDirectory() as td:
+            peers = Path(td) / "peers"
+            peers.write_text("127.0.0.1:%d\n" % port)
+            csv = Path(td) / "press.csv"
+            proc = subprocess.Popen(
+                [str(node), "--port", str(port), "--peers", str(peers)],
+                stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+                stderr=subprocess.DEVNULL,
+            )
+            deadline = time.time() + 20.0
+            buf = b""
+            while b"READY" not in buf:
+                remain = deadline - time.time()
+                if remain <= 0:
+                    return None
+                r, _, _ = select.select([proc.stdout], [], [], remain)
+                if not r:
+                    return None
+                chunk = os.read(proc.stdout.fileno(), 4096)
+                if not chunk:
+                    return None
+                buf += chunk
+            subprocess.run(
+                [str(press), "--server=127.0.0.1:%d" % port, "--qps=500",
+                 "--duration_s=4", "--payload=128", "--callers=4",
+                 "--metrics_csv=%s" % csv],
+                capture_output=True, timeout=60,
+            )
+            time.sleep(1.2)  # let the 1Hz series sampler tick once more
+            url = ("http://127.0.0.1:%d/vars?series="
+                   "benchpb_EchoService_Echo_qps" % port)
+            with urllib.request.urlopen(url, timeout=5) as r:
+                ring = json.loads(r.read().decode())
+            out = {}
+            rows = [r for r in csv.read_text().splitlines()[1:] if r]
+            if rows:
+                cols = [r.split(",") for r in rows]
+                out["press_qps_series"] = [int(float(c[1])) for c in cols]
+                out["press_p99_us_series"] = [int(float(c[3])) for c in cols]
+            second = ring.get("second", [])
+            if second:
+                out["server_qps_series_tail"] = [
+                    int(v) for v in second[-10:]]
+            return out or None
+    except Exception:
+        return None
+    finally:
+        if proc is not None:
+            try:
+                proc.stdin.close()
+                proc.wait(timeout=10)
+            except Exception:
+                proc.kill()
+                proc.wait()  # reap: no zombie holding the port
+
+
 def main():
     try:
         build()
@@ -137,6 +215,7 @@ def main():
     scale = run_tool("echo_bench", ["--json", "--scale", "--ici"],
                      timeout=600)
     device = device_path()
+    series = series_scrape()
 
     mbps = float(ici["mbps"])
     out = {
@@ -161,6 +240,8 @@ def main():
         out.update(scale)
     if device is not None:
         out.update(device)
+    if series is not None:
+        out.update(series)
     print(json.dumps(out))
 
 

@@ -1,0 +1,380 @@
+// Mesh-wide observability (ISSUE 4): SeriesRing rollover under a fake
+// clock (append() IS the clock), prometheus summary exposition for
+// LatencyRecorder (+ labelled families), the flag->var bridge, and span
+// annotation attachment on the shed/cancel/retry paths.
+#include <unistd.h>
+
+#include <atomic>
+#include <cstdlib>
+#include <cstring>
+#include <functional>
+#include <string>
+
+#include "echo.pb.h"
+#include "tbase/endpoint.h"
+#include "tbase/flags.h"
+#include "tbase/time.h"
+#include "tfiber/fiber.h"
+#include "tfiber/fiber_sync.h"
+#include "trpc/channel.h"
+#include "trpc/controller.h"
+#include "trpc/server.h"
+#include "trpc/server_call.h"
+#include "trpc/span.h"
+#include "ttest/ttest.h"
+#include "tvar/default_variables.h"
+#include "tvar/latency_recorder.h"
+#include "tvar/multi_dimension.h"
+#include "tvar/reducer.h"
+#include "tvar/series.h"
+#include "tvar/variable.h"
+
+using namespace tpurpc;
+
+DECLARE_bool(enable_rpcz);
+
+namespace {
+
+bool WaitUntil(const std::function<bool()>& pred, int64_t timeout_ms) {
+    const int64_t deadline = monotonic_time_us() + timeout_ms * 1000;
+    while (monotonic_time_us() < deadline) {
+        if (pred()) return true;
+        usleep(5 * 1000);
+    }
+    return pred();
+}
+
+}  // namespace
+
+// ---------------- SeriesRing: fake-clock rollover ----------------
+// append() is the clock (1 call = 1 second), so boundary behavior is
+// driven deterministically — no sleeping, no real time.
+
+TEST(SeriesRing, SecondBoundaryRollsIntoMinute) {
+    SeriesRing r;
+    for (int i = 0; i < 59; ++i) r.append(10.0);
+    // 59 ticks: second ring filling, minute ring untouched.
+    EXPECT_EQ(r.ticks(), 59);
+    std::vector<double> m = r.minutes();
+    for (double v : m) EXPECT_EQ(v, 0.0);
+    // The 60th tick folds mean(last 60 seconds) into the minute ring.
+    r.append(70.0);  // 59x10 + 1x70 -> mean 11
+    m = r.minutes();
+    EXPECT_EQ(m.back(), 11.0);
+    // Second ring keeps rolling: 60 more ticks -> second minute entry.
+    for (int i = 0; i < 60; ++i) r.append(5.0);
+    m = r.minutes();
+    EXPECT_EQ(m.back(), 5.0);
+    EXPECT_EQ(m[m.size() - 2], 11.0);
+}
+
+TEST(SeriesRing, MinuteBoundaryRollsIntoHour) {
+    SeriesRing r;
+    // One full hour of ticks at a constant value.
+    for (int i = 0; i < 3600; ++i) r.append(3.0);
+    std::vector<double> h = r.hours();
+    EXPECT_EQ(h.back(), 3.0);
+    for (size_t i = 0; i + 1 < h.size(); ++i) EXPECT_EQ(h[i], 0.0);
+    // A second hour at a different value: second hour entry, first keeps.
+    for (int i = 0; i < 3600; ++i) r.append(9.0);
+    h = r.hours();
+    EXPECT_EQ(h.back(), 9.0);
+    EXPECT_EQ(h[h.size() - 2], 3.0);
+}
+
+TEST(SeriesRing, UnrollIsOldestFirstAndZeroPadded) {
+    SeriesRing r;
+    for (int i = 1; i <= 70; ++i) r.append((double)i);
+    const std::vector<double> s = r.seconds();
+    ASSERT_EQ((int)s.size(), SeriesRing::kSeconds);
+    // 70 ticks through a 60-slot ring: oldest surviving value is 11.
+    EXPECT_EQ(s.front(), 11.0);
+    EXPECT_EQ(s.back(), 70.0);
+    for (size_t i = 1; i < s.size(); ++i) EXPECT_EQ(s[i], s[i - 1] + 1.0);
+    // A short series zero-pads at the FRONT (fixed 60-point shape).
+    SeriesRing fresh;
+    fresh.append(42.0);
+    const std::vector<double> f = fresh.seconds();
+    ASSERT_EQ((int)f.size(), SeriesRing::kSeconds);
+    EXPECT_EQ(f.front(), 0.0);
+    EXPECT_EQ(f.back(), 42.0);
+}
+
+TEST(SeriesCollector, ExposedVarGrowsARing) {
+    Status<int64_t> st(7);
+    st.expose("obs_series_probe");
+    auto* sc = SeriesCollector::singleton();
+    sc->Tick();
+    sc->Tick();
+    const std::string json = sc->SeriesJson("obs_series_probe");
+    ASSERT_TRUE(!json.empty());
+    EXPECT_TRUE(json.find("\"name\":\"obs_series_probe\"") !=
+                std::string::npos);
+    // The per-second ring is always exactly 60 points; the probe's
+    // constant value occupies the tail.
+    const size_t sec = json.find("\"second\":[");
+    ASSERT_TRUE(sec != std::string::npos);
+    const size_t end = json.find("]", sec);
+    const std::string ring = json.substr(sec + 10, end - sec - 10);
+    int commas = 0;
+    for (char c : ring) commas += c == ',';
+    EXPECT_EQ(commas, 59);
+    EXPECT_TRUE(ring.size() >= 2 &&
+                ring.compare(ring.size() - 2, 2, ",7") == 0)
+        << ring;
+    st.hide();
+}
+
+// ---------------- prometheus exposition ----------------
+
+TEST(Prometheus, LatencyRecorderIsARealSummary) {
+    LatencyRecorder lat;
+    for (int i = 1; i <= 1000; ++i) lat << i;
+    lat.expose("obs_test_latency");
+    const std::string dump = Variable::dump_prometheus();
+    EXPECT_TRUE(dump.find("# TYPE obs_test_latency summary\n") !=
+                std::string::npos);
+    EXPECT_TRUE(dump.find("obs_test_latency{quantile=\"0.5\"} ") !=
+                std::string::npos);
+    EXPECT_TRUE(dump.find("obs_test_latency{quantile=\"0.999\"} ") !=
+                std::string::npos);
+    EXPECT_TRUE(dump.find("obs_test_latency_count 1000\n") !=
+                std::string::npos);
+    // _sum is the cumulative sum of recorded values: 1+..+1000.
+    EXPECT_TRUE(dump.find("obs_test_latency_sum 500500\n") !=
+                std::string::npos);
+    // The flat JSON-parsed gauges are gone.
+    EXPECT_TRUE(dump.find("obs_test_latency_avg_us") == std::string::npos);
+    lat.hide();
+}
+
+TEST(Prometheus, PlainCountersStayGauges) {
+    Adder<int64_t> a;
+    a << 12345678;
+    a.expose("obs_test_counter");
+    const std::string dump = Variable::dump_prometheus();
+    EXPECT_TRUE(dump.find("# TYPE obs_test_counter gauge\n"
+                          "obs_test_counter 12345678\n") !=
+                std::string::npos);
+    a.hide();
+}
+
+TEST(Prometheus, LabelledLatencyKeepsLabelsAndSummaryShape) {
+    LabelledMetric<LatencyRecorder> lat("obs_req_latency", {"method"});
+    *lat.get_stats({"Echo"}) << 100 << 200 << 300;
+    *lat.get_stats({"Stats"}) << 50;
+    const std::string text = lat.prometheus_text("obs_req_latency");
+    EXPECT_TRUE(text.find("# TYPE obs_req_latency summary\n") == 0) << text;
+    EXPECT_TRUE(text.find("obs_req_latency{method=\"Echo\","
+                          "quantile=\"0.5\"} ") != std::string::npos);
+    EXPECT_TRUE(text.find("obs_req_latency_count{method=\"Echo\"} 3") !=
+                std::string::npos);
+    EXPECT_TRUE(text.find("obs_req_latency_count{method=\"Stats\"} 1") !=
+                std::string::npos);
+    // Exactly ONE TYPE line for the whole family.
+    EXPECT_EQ((int)std::string::npos, (int)text.find("# TYPE", 7));
+}
+
+// ---------------- flag -> var bridge ----------------
+
+TEST(FlagBridge, FlagsAreScrapeableVars) {
+    ExposeFlagVariables();
+    std::string v;
+    // Bool flags render 0/1 (scrapeable), reflecting live mutation.
+    ASSERT_TRUE(Variable::describe_exposed("flag_enable_rpcz", &v));
+    const std::string before = v;
+    EXPECT_TRUE(v == "0" || v == "1");
+    const bool old = FLAGS_enable_rpcz.get();
+    ASSERT_TRUE(SetFlagValue("enable_rpcz", old ? "false" : "true"));
+    ASSERT_TRUE(Variable::describe_exposed("flag_enable_rpcz", &v));
+    EXPECT_NE(v, before);
+    FLAGS_enable_rpcz.set(old);
+    // Numeric flags pass through as numbers -> gauges at /metrics.
+    ASSERT_TRUE(Variable::describe_exposed("flag_rpcz_stitch_timeout_ms",
+                                           &v));
+    EXPECT_GT(atoll(v.c_str()), 0);
+    const std::string dump = Variable::dump_prometheus();
+    EXPECT_TRUE(dump.find("# TYPE flag_rpcz_stitch_timeout_ms gauge") !=
+                std::string::npos);
+}
+
+// ---------------- span annotations (shed / cancel / retry) ----------------
+
+namespace {
+
+// All notes of the spans matching `trace` currently in the SpanDB.
+std::string NotesForTrace(uint64_t trace, Span::Kind* kind_of_first_match,
+                          const char* needle) {
+    std::string all;
+    for (const Span& s : SpanDB::singleton()->Recent(256, trace)) {
+        for (const Span::Note& n : s.notes) {
+            all += n.text + "\n";
+            if (kind_of_first_match != nullptr &&
+                strstr(n.text.c_str(), needle) != nullptr) {
+                *kind_of_first_match = s.kind;
+                kind_of_first_match = nullptr;  // keep the first
+            }
+        }
+    }
+    return all;
+}
+
+bool TraceHasNote(uint64_t trace, const char* needle) {
+    return NotesForTrace(trace, nullptr, needle).find(needle) !=
+           std::string::npos;
+}
+
+struct RpczOn {
+    bool old;
+    RpczOn() : old(FLAGS_enable_rpcz.get()) {
+        FLAGS_enable_rpcz.set(true);
+        // A prior test may have drained the Collector's 1000/s sampling
+        // window this very second; idle past it so the first sample()
+        // here opens a fresh window and the span is deterministic.
+        usleep(1100 * 1000);
+    }
+    ~RpczOn() { FLAGS_enable_rpcz.set(old); }
+};
+
+class ParkUntilCanceledImpl : public test::EchoService {
+public:
+    void Echo(google::protobuf::RpcController* cntl_base,
+              const test::EchoRequest* request, test::EchoResponse* response,
+              google::protobuf::Closure* done) override {
+        Controller* cntl = static_cast<Controller*>(cntl_base);
+        entered.fetch_add(1, std::memory_order_release);
+        for (int i = 0; i < 400; ++i) {
+            if (cntl->IsCanceled()) break;
+            fiber_usleep(5 * 1000);
+        }
+        response->set_message(request->message());
+        done->Run();
+    }
+    std::atomic<int> entered{0};
+};
+
+struct SignalDone : google::protobuf::Closure {
+    CountdownEvent ev{1};
+    void Run() override { ev.signal(); }
+};
+
+}  // namespace
+
+TEST(SpanAnnotations, RetryAndBudgetExhaustionLandOnTheSpan) {
+    RpczOn rpcz;
+    // Dead port: retryable failures. Budget of 1 -> one re-issue, then
+    // the bucket runs dry and the exhaustion is annotated.
+    Channel channel;
+    ChannelOptions opts;
+    opts.timeout_ms = 2000;
+    opts.max_retry = 5;
+    opts.retry_budget_tokens = 1;
+    opts.retry_budget_ratio = 0.0;
+    ASSERT_EQ(channel.Init("127.0.0.1:1", &opts), 0);
+    test::EchoService_Stub stub(&channel);
+    Controller cntl;
+    test::EchoRequest req;
+    req.set_message("doomed");
+    test::EchoResponse res;
+    stub.Echo(&cntl, &req, &res, nullptr);
+    EXPECT_TRUE(cntl.Failed());
+    const uint64_t trace = cntl.trace_id();
+    ASSERT_NE(trace, 0u);
+    // Spans flow through the Collector's background dispatcher.
+    ASSERT_TRUE(WaitUntil(
+        [&] { return !SpanDB::singleton()->Recent(8, trace).empty(); },
+        3000));
+    EXPECT_TRUE(TraceHasNote(trace, "re-issued try 1"))
+        << NotesForTrace(trace, nullptr, "");
+    EXPECT_TRUE(TraceHasNote(trace, "retry budget exhausted"))
+        << NotesForTrace(trace, nullptr, "");
+    EXPECT_TRUE(TraceHasNote(trace, "failed: "))
+        << NotesForTrace(trace, nullptr, "");
+}
+
+TEST(SpanAnnotations, CanceledServerCallAnnotated) {
+    RpczOn rpcz;
+    ParkUntilCanceledImpl service;
+    Server server;
+    ASSERT_EQ(server.AddService(&service), 0);
+    EndPoint listen;
+    str2endpoint("127.0.0.1:0", &listen);
+    ASSERT_EQ(server.Start(listen, nullptr), 0);
+    EndPoint ep;
+    str2endpoint("127.0.0.1", server.listened_port(), &ep);
+
+    Channel channel;
+    ChannelOptions opts;
+    opts.timeout_ms = 5000;
+    ASSERT_EQ(channel.Init(ep, &opts), 0);
+    test::EchoService_Stub stub(&channel);
+    Controller cntl;
+    test::EchoRequest req;
+    req.set_message("cancel-me");
+    test::EchoResponse res;
+    SignalDone done;
+    stub.Echo(&cntl, &req, &res, &done);
+    const uint64_t trace = cntl.trace_id();
+    ASSERT_NE(trace, 0u);
+    ASSERT_TRUE(
+        WaitUntil([&] { return service.entered.load() >= 1; }, 3000));
+    cntl.StartCancel();
+    done.ev.wait();
+    // Client span: the cancel verdict; server span: the delivered
+    // cascade — both under ONE trace id.
+    ASSERT_TRUE(WaitUntil(
+        [&] {
+            return TraceHasNote(trace, "canceled: upstream gave up") &&
+                   TraceHasNote(trace, "canceled: wire CANCEL");
+        },
+        3000));
+    Span::Kind kind = Span::CLIENT;
+    NotesForTrace(trace, &kind, "canceled: upstream gave up");
+    EXPECT_EQ(kind, Span::SERVER);
+    server.Stop();
+    server.Join();
+}
+
+TEST(SpanAnnotations, ExpiredDownstreamShedAnnotatedOnClientSpan) {
+    RpczOn rpcz;
+    // A healthy echo server...
+    ParkUntilCanceledImpl service;  // parks only until canceled/400 loops
+    Server server;
+    ASSERT_EQ(server.AddService(&service), 0);
+    EndPoint listen;
+    str2endpoint("127.0.0.1:0", &listen);
+    ASSERT_EQ(server.Start(listen, nullptr), 0);
+    EndPoint ep;
+    str2endpoint("127.0.0.1", server.listened_port(), &ep);
+
+    // ...called under an upstream server context whose budget is ALREADY
+    // spent: the downstream request is stamped timeout_ms=0, the server
+    // sheds it on arrival, and the verdict is annotated on the client
+    // span (the shed hop itself never allocates one — that is the point:
+    // the stitched view still shows WHY).
+    Controller upstream;
+    upstream.InitServerSide(nullptr, EndPoint());
+    upstream.set_server_deadline_us(monotonic_time_us() - 50 * 1000);
+    uint64_t trace = 0;
+    {
+        ServerCallScope scope(&upstream);
+        Channel channel;
+        ChannelOptions opts;
+        opts.timeout_ms = 2000;
+        opts.max_retry = 0;
+        ASSERT_EQ(channel.Init(ep, &opts), 0);
+        test::EchoService_Stub stub(&channel);
+        Controller cntl;
+        test::EchoRequest req;
+        req.set_message("stale");
+        test::EchoResponse res;
+        stub.Echo(&cntl, &req, &res, nullptr);
+        EXPECT_TRUE(cntl.Failed());
+        trace = cntl.trace_id();
+    }
+    ASSERT_NE(trace, 0u);
+    ASSERT_TRUE(WaitUntil([&] { return TraceHasNote(trace, "failed: "); },
+                          3000));
+    server.Stop();
+    server.Join();
+}

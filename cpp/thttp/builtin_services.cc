@@ -25,9 +25,10 @@
 #include "tfiber/task_tracer.h"
 #include "tnet/fault_injection.h"
 #include "tnet/socket.h"
+#include "trpc/rpcz_stitch.h"
 #include "trpc/server.h"
 #include "trpc/span.h"
-#include "tvar/multi_dimension.h"
+#include "tvar/series.h"
 #include "tvar/variable.h"
 
 DECLARE_bool(chaos_enabled);
@@ -43,10 +44,14 @@ void HandleIndex(Server*, const HttpRequest&, HttpResponse* res) {
         "\n"
         "/health       liveness\n"
         "/status       per-method stats\n"
-        "/vars         exposed variables (/vars/<name> for one)\n"
+        "/vars         exposed variables (/vars/<name> for one;\n"
+        "              ?series=<name> 60s/60min/24h ring as JSON)\n"
         "/flags        runtime flags (/flags/<name>?setvalue=v to set)\n"
         "/connections  accepted connections\n"
-        "/rpcz         sampled per-RPC spans (enable_rpcz flag)\n"
+        "/rpcz         sampled per-RPC spans (enable_rpcz flag;\n"
+        "              ?trace_id=N filter, &format=json machine form)\n"
+        "/rpcz/trace/<id>  ONE cross-host stitched timeline for a trace\n"
+        "              (fans out over -rpcz_peers + known remotes)\n"
         "/fibers       fiber runtime introspection (?st=1: stacks)\n"
         "/threads      pthread stack dump\n"
         "/version      build identification\n"
@@ -200,10 +205,34 @@ void HandleFibers(Server*, const HttpRequest& req, HttpResponse* res) {
 }
 
 void HandleRpcz(Server*, const HttpRequest& req, HttpResponse* res) {
-    res->set_content_type("text/plain");
     const std::string t = req.QueryParam("trace_id");
     const uint64_t trace = t.empty() ? 0 : strtoull(t.c_str(), nullptr, 10);
+    if (req.QueryParam("format") == "json") {
+        // Machine-readable spans — what the cross-host stitcher scrapes.
+        res->set_content_type("application/json");
+        res->Append(RenderRpczJson(trace));
+        return;
+    }
+    res->set_content_type("text/plain");
     res->Append(RenderRpcz(trace));
+}
+
+// /rpcz/trace/<id>: ONE stitched timeline for a trace — fans out over
+// -rpcz_peers + SocketMap remotes, merges every host's spans, normalizes
+// clocks via the parent-child send/recv envelopes.
+void HandleRpczTrace(Server*, const HttpRequest& req, HttpResponse* res) {
+    res->set_content_type("text/plain");
+    const char* prefix = "/rpcz/trace/";
+    uint64_t trace = 0;
+    if (req.path.size() > strlen(prefix)) {
+        trace = strtoull(req.path.c_str() + strlen(prefix), nullptr, 10);
+    }
+    if (trace == 0) {
+        res->status = 400;
+        res->Append("usage: /rpcz/trace/<trace_id>\n");
+        return;
+    }
+    res->Append(RenderStitchedTrace(trace));
 }
 
 void HandleStatus(Server* server, const HttpRequest&, HttpResponse* res) {
@@ -235,8 +264,29 @@ void HandleStatus(Server* server, const HttpRequest&, HttpResponse* res) {
 }
 
 void HandleVars(Server*, const HttpRequest& req, HttpResponse* res) {
+    // /vars?series=<name> -> the variable's 60s/60min/24h ring as JSON.
+    bool has_series = false;
+    const std::string series = req.QueryParam("series", &has_series);
+    if (has_series) {
+        const std::string json =
+            SeriesCollector::singleton()->SeriesJson(series);
+        if (json.empty()) {
+            res->status = 404;
+            res->set_content_type("text/plain");
+            res->Append("no series for: " + series +
+                        " (series exist for numeric vars and composite "
+                        "fields, e.g. <name>_qps; sampling starts with the "
+                        "first server)\n");
+            return;
+        }
+        res->set_content_type("application/json");
+        res->Append(json);
+        return;
+    }
     res->set_content_type("text/plain");
-    // /vars/<name> -> one variable.
+    // /vars/<name> -> one variable. Stays STRICTLY "name : value" — the
+    // soaks (and any script) parse this line; trends live in the list
+    // view sparklines and /vars?series=.
     if (req.path.size() > 6 && req.path.compare(0, 6, "/vars/") == 0) {
         const std::string name = req.path.substr(6);
         std::string value;
@@ -249,7 +299,14 @@ void HandleVars(Server*, const HttpRequest& req, HttpResponse* res) {
         return;
     }
     for (const auto& kv : Variable::dump_exposed()) {
-        res->Append(kv.first + " : " + kv.second + "\n");
+        res->Append(kv.first + " : " + kv.second);
+        // Inline sparkline: the last minute of the var's per-second ring.
+        const std::string spark =
+            SeriesCollector::singleton()->SparklineFor(kv.first);
+        if (!spark.empty()) {
+            res->Append("  " + spark);
+        }
+        res->Append("\n");
     }
 }
 
@@ -386,63 +443,15 @@ void HandleChaos(Server*, const HttpRequest& req, HttpResponse* res) {
     res->Append(FaultInjection::DebugString());
 }
 
-// Prometheus text exposition: every exposed numeric var becomes a gauge
-// (reference builtin/prometheus_metrics_service.cpp:244 does the same
-// name-sanitize + filter).
-std::string sanitize_metric_name(std::string name) {
-    for (char& c : name) {
-        if (!isalnum((unsigned char)c) && c != '_' && c != ':') c = '_';
-    }
-    if (!name.empty() && isdigit((unsigned char)name[0])) {
-        name.insert(name.begin(), '_');
-    }
-    return name;
-}
-
-bool is_number(const std::string& s) {
-    char* end = nullptr;
-    strtod(s.c_str(), &end);
-    return end != s.c_str() && *end == '\0' && !s.empty();
-}
-
+// Prometheus text exposition: one registry-wide dump through the
+// Variable prometheus hooks — plain numerics as gauges, LatencyRecorders
+// as REAL summary families (quantile labels + _sum/_count), labelled
+// MultiDimensions with their label sets. Names are sanitized once,
+// centrally (tvar/variable.cc SanitizeMetricName); the JSON-description
+// substring parser that used to live here is gone.
 void HandleMetrics(Server*, const HttpRequest&, HttpResponse* res) {
     res->set_content_type("text/plain; version=0.0.4");
-    // Labelled series first (reference multi_dimension -> /brpc_metrics).
-    res->Append(DumpLabelledMetrics());
-    for (const auto& kv : Variable::dump_exposed()) {
-        const std::string& value = kv.second;
-        const std::string name = sanitize_metric_name(kv.first);
-        if (is_number(value)) {
-            res->Append("# TYPE " + name + " gauge\n");
-            res->Append(name + " " + value + "\n");
-            continue;
-        }
-        // Composite vars (LatencyRecorder) dump as a flat JSON object of
-        // numeric fields: expand each as <name>_<field> (reference
-        // prometheus_metrics_service.cpp emits latency_recorder series
-        // the same way).
-        if (value.size() < 2 || value[0] != '{') continue;
-        size_t pos = 1;
-        while (pos < value.size()) {
-            const size_t kstart = value.find('"', pos);
-            if (kstart == std::string::npos) break;
-            const size_t kend = value.find('"', kstart + 1);
-            if (kend == std::string::npos) break;
-            const size_t colon = value.find(':', kend);
-            if (colon == std::string::npos) break;
-            size_t vend = value.find_first_of(",}", colon);
-            if (vend == std::string::npos) vend = value.size();
-            const std::string field = value.substr(kstart + 1, kend - kstart - 1);
-            const std::string fval = value.substr(colon + 1, vend - colon - 1);
-            if (is_number(fval)) {
-                const std::string mname =
-                    name + "_" + sanitize_metric_name(field);
-                res->Append("# TYPE " + mname + " gauge\n");
-                res->Append(mname + " " + fval + "\n");
-            }
-            pos = vend + 1;
-        }
-    }
+    res->Append(Variable::dump_prometheus());
 }
 
 }  // namespace
@@ -457,6 +466,7 @@ void AddBuiltinHttpServices(Server* server) {
     server->RegisterHttpHandler("/flags/*", HandleFlags);
     server->RegisterHttpHandler("/connections", HandleConnections);
     server->RegisterHttpHandler("/rpcz", HandleRpcz);
+    server->RegisterHttpHandler("/rpcz/trace/*", HandleRpczTrace);
     server->RegisterHttpHandler("/fibers", HandleFibers);
     server->RegisterHttpHandler("/threads", HandleThreads);
     server->RegisterHttpHandler("/version", HandleVersion);

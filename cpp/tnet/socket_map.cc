@@ -59,6 +59,14 @@ void SocketMap::Remove(const EndPoint& remote, SocketId expected_id) {
     }
 }
 
+std::vector<EndPoint> SocketMap::endpoints() {
+    std::lock_guard<std::mutex> g(mu_);
+    std::vector<EndPoint> out;
+    out.reserve(map_.size());
+    for (const auto& kv : map_) out.push_back(kv.first);
+    return out;
+}
+
 
 // ---------------- SocketPool ----------------
 

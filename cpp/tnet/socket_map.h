@@ -5,6 +5,7 @@
 
 #include <map>
 #include <mutex>
+#include <vector>
 
 #include "tbase/endpoint.h"
 #include "tnet/socket.h"
@@ -29,6 +30,11 @@ public:
                     SocketId* id);
     // Drop the cached socket (e.g. after SetFailed).
     void Remove(const EndPoint& remote, SocketId expected_id);
+
+    // Every remote this process holds a shared client connection to —
+    // the rpcz stitcher's peer discovery (these are real serving ports,
+    // unlike accepted connections' ephemeral remote ports).
+    std::vector<EndPoint> endpoints();
 
 private:
     std::mutex mu_;

@@ -202,14 +202,30 @@ void Channel::CallMethod(const google::protobuf::MethodDescriptor* method,
     void* unused;
     CHECK_EQ(id_lock(cid, &unused), 0);
 
-    if (IsRpczSampled()) {
+    // rpcz: a call issued inside a sampled server handler CONTINUES the
+    // upstream trace (cross-host stitching needs the parent link — the
+    // downstream hop's server span points back at THIS client span);
+    // outside a handler the local sampling gate may start a fresh trace.
+    // Contract (same as the deadline-inheritance deref below): the
+    // upstream controller — and thus its span — is valid only until the
+    // handler runs done->Run(); a handler must not issue calls under
+    // this scope after completing its own response.
+    Controller* up = CurrentServerCall();
+    Span* upspan = up != nullptr && IsRpczEnabled() ? up->span_ : nullptr;
+    if (upspan != nullptr || IsRpczSampled()) {
         auto* span = new Span;
         span->kind = Span::CLIENT;
-        span->trace_id = fast_rand();
+        if (upspan != nullptr) {
+            span->trace_id = upspan->trace_id;
+            span->parent_span_id = upspan->span_id;
+        } else {
+            span->trace_id = fast_rand();
+        }
         span->span_id = fast_rand();
         span->method = method->full_name();
         span->start_us = cntl->start_us_;
         cntl->span_ = span;
+        cntl->sampled_trace_id_ = span->trace_id;
     }
 
     if (!SerializePbToIOBuf(*request, &cntl->request_buf_)) {

@@ -29,6 +29,7 @@
 
 DEFINE_bool(rpc_checksum, false,
             "crc32c-protect tpu_std frame bodies (verified when present)");
+DECLARE_bool(chaos_enabled);
 
 #include "trpc/server_call.h"
 
@@ -103,6 +104,7 @@ void Controller::Reset() {
         child_calls_.clear();
     }
     span_ = nullptr;
+    sampled_trace_id_ = 0;
 }
 
 void Controller::SetFailed(const std::string& reason) {
@@ -326,6 +328,9 @@ int Controller::HandleError(CallId id, int error) {
     // that an abandoned call frees CPU all the way down.
     if (error == ECANCELED) {
         canceled_.store(true, std::memory_order_release);
+        if (span_ != nullptr) {
+            span_->Annotate("canceled: wire CANCEL sent to in-flight tries");
+        }
         SendWireCancel();
     }
     // The failing try's dedicated connection is dead weight from here
@@ -353,6 +358,10 @@ int Controller::HandleError(CallId id, int error) {
         // bucket is dry, fail now with the try's own error instead.
         if (channel_ != nullptr && !channel_->retry_budget().Withdraw()) {
             *g_budget_exhausted << 1;
+            if (span_ != nullptr) {
+                span_->Annotate(
+                    "retry budget exhausted: failing with this try's error");
+            }
         } else {
             const CallId next = id_next_version(current_cid_);
             if (next == INVALID_CALL_ID && channel_ != nullptr) {
@@ -683,6 +692,9 @@ void Controller::MaybeIssueBackup() {
     // fleet needs — same rationale as the retry path).
     if (channel_ != nullptr && !channel_->retry_budget().Withdraw()) {
         *g_budget_exhausted << 1;
+        if (span_ != nullptr) {
+            span_->Annotate("retry budget exhausted: backup request vetoed");
+        }
         return;
     }
     const CallId next = id_next_version(current_cid_);
@@ -755,6 +767,15 @@ void Controller::EndRPC(CallId locked_id) {
     }
     ReleaseFlySockets();
     if (span_ != nullptr) {
+        if (error_code_ != 0) {
+            // The terminal verdict rides the span so a stitched timeline
+            // shows WHY a hop died (shed, expired, canceled, refused)
+            // even when the downstream produced no span of its own.
+            span_->Annotate("failed: " + error_text_);
+            if (FLAGS_chaos_enabled.get()) {
+                span_->Annotate("note: local chaos injection is enabled");
+            }
+        }
         span_->end_us = monotonic_time_us();
         span_->error_code = error_code_;
         Collector::singleton()->submit(span_);

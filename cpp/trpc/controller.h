@@ -75,6 +75,11 @@ public:
     // The correlation id of this RPC (join it to wait for async calls).
     CallId call_id() const { return correlation_id_; }
 
+    // Trace id of this call's rpcz span (0 = unsampled). Survives EndRPC
+    // (the span itself is handed to the SpanDB) so a caller can chase the
+    // call across the mesh at /rpcz/trace/<id>.
+    uint64_t trace_id() const { return sampled_trace_id_; }
+
     // ---- protobuf::RpcController surface ----
     void Reset() override;
     void StartCancel() override;
@@ -283,6 +288,8 @@ public:
     // run under the id lock). Server side: owned by the request pipeline
     // (request fiber -> user fiber -> done closure, strictly sequential).
     struct Span* span_ = nullptr;
+    // The span's trace id, retained past span submission (trace_id()).
+    uint64_t sampled_trace_id_ = 0;
 };
 
 // Generic client-side unary completion for protocols that frame outside

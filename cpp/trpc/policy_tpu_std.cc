@@ -131,6 +131,13 @@ public:
     void Run() override {
         if (cntl_->span_ != nullptr) {
             cntl_->span_->process_end_us = monotonic_time_us();
+            // Annotated HERE, not in the cancel delivery path: the span is
+            // owned by this strictly-sequential pipeline, and the cancel
+            // thunk may race with span submission below.
+            if (cntl_->IsCanceled()) {
+                cntl_->span_->Annotate(
+                    "canceled: upstream gave up (cascade delivered)");
+            }
         }
         rpc::RpcMeta meta;
         auto* rmeta = meta.mutable_response();
@@ -239,6 +246,10 @@ bool ShedIfExpired(Server::MethodProperty* mp, Controller* cntl) {
     }
     mp->status->nexpired.fetch_add(1, std::memory_order_relaxed);
     server_call::CountExpired();
+    if (cntl->span_ != nullptr) {
+        cntl->span_->Annotate(
+            "deadline shed: expired before handler dispatch");
+    }
     cntl->SetFailed(TERR_RPC_TIMEDOUT,
                     "deadline expired before handler dispatch");
     return true;

@@ -1,0 +1,625 @@
+#include "trpc/rpcz_stitch.h"
+
+#include <fcntl.h>
+#include <poll.h>
+#include <strings.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <cctype>
+#include <cerrno>
+#include <cinttypes>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <map>
+#include <set>
+#include <vector>
+
+#include "tbase/endpoint.h"
+#include "tbase/flags.h"
+#include "tbase/time.h"
+#include "tnet/socket_map.h"
+#include "trpc/span.h"
+
+// Mesh membership for the stitcher ("ip:port,ip:port"). SocketMap remotes
+// ride along automatically; this flag covers nodes this process never
+// called (and is what the soaks set).
+DEFINE_string(rpcz_peers, "",
+              "comma-separated ip:port portals to stitch traces from");
+DEFINE_int32(rpcz_stitch_timeout_ms, 1000,
+             "TOTAL budget for one /rpcz/trace peer fan-out");
+
+namespace tpurpc {
+
+namespace {
+
+// One span as the stitcher sees it — local spans converted, remote spans
+// parsed back from RenderRpczJson output. Notes arrive pre-formatted
+// ("+123us text") because cross-host at_us values are meaningless raw.
+struct StitchSpan {
+    std::string host;
+    uint64_t trace_id = 0;
+    uint64_t span_id = 0;
+    uint64_t parent_span_id = 0;
+    bool server = false;
+    std::string method;
+    std::string remote;
+    int error_code = 0;
+    int retries = 0;
+    int64_t request_bytes = 0;
+    int64_t response_bytes = 0;
+    int64_t start_us = 0, sent_us = 0, received_us = 0;
+    int64_t process_start_us = 0, process_end_us = 0, end_us = 0;
+    std::vector<std::string> notes;
+};
+
+// ---------------- minimal HTTP/1.1 GET ----------------
+
+// Blocking (poll-paced) GET against a portal; the whole exchange must
+// finish inside `deadline_us`. Returns false on any failure. Runs on the
+// handler's fiber — worst case it parks one worker pthread for the
+// timeout, the same cost class as /hotspots/cpu.
+bool HttpGet(const EndPoint& ep, const std::string& path,
+             int64_t deadline_us, std::string* body) {
+    const int fd = ::socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK, 0);
+    if (fd < 0) return false;
+    sockaddr_in addr;
+    endpoint2sockaddr(ep, &addr);
+    auto remaining_ms = [deadline_us]() -> int {
+        const int64_t r = (deadline_us - monotonic_time_us()) / 1000;
+        return r > 0 ? (int)r : 0;
+    };
+    if (::connect(fd, (sockaddr*)&addr, sizeof(addr)) != 0) {
+        if (errno != EINPROGRESS) {
+            close(fd);
+            return false;
+        }
+        pollfd p{fd, POLLOUT, 0};
+        if (poll(&p, 1, remaining_ms()) != 1) {
+            close(fd);
+            return false;
+        }
+        int err = 0;
+        socklen_t len = sizeof(err);
+        if (getsockopt(fd, SOL_SOCKET, SO_ERROR, &err, &len) != 0 ||
+            err != 0) {
+            close(fd);
+            return false;
+        }
+    }
+    const std::string req = "GET " + path +
+                            " HTTP/1.1\r\nHost: " + endpoint2str(ep) +
+                            "\r\nConnection: close\r\n\r\n";
+    size_t sent = 0;
+    while (sent < req.size()) {
+        const ssize_t n =
+            ::send(fd, req.data() + sent, req.size() - sent, MSG_NOSIGNAL);
+        if (n > 0) {
+            sent += (size_t)n;
+            continue;
+        }
+        if (n < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
+            pollfd p{fd, POLLOUT, 0};
+            if (poll(&p, 1, remaining_ms()) != 1) {
+                close(fd);
+                return false;
+            }
+            continue;
+        }
+        close(fd);
+        return false;
+    }
+    std::string buf;
+    size_t header_end = std::string::npos;
+    int64_t content_length = -1;
+    // Bound BOTH time and size on the read side: a misconfigured peer
+    // that streams forever must cost at most the deadline, never the
+    // heap (the deadline is re-checked every iteration, not only on
+    // EAGAIN).
+    constexpr size_t kMaxBody = 16u << 20;
+    while (true) {
+        if (monotonic_time_us() >= deadline_us || buf.size() > kMaxBody) {
+            close(fd);
+            return false;
+        }
+        char chunk[8192];
+        const ssize_t n = ::recv(fd, chunk, sizeof(chunk), 0);
+        if (n > 0) {
+            buf.append(chunk, (size_t)n);
+        } else if (n == 0) {
+            break;  // EOF (we asked for Connection: close)
+        } else if (errno == EAGAIN || errno == EWOULDBLOCK) {
+            pollfd p{fd, POLLIN, 0};
+            if (poll(&p, 1, remaining_ms()) != 1) {
+                close(fd);
+                return false;
+            }
+            continue;
+        } else {
+            close(fd);
+            return false;
+        }
+        if (header_end == std::string::npos) {
+            header_end = buf.find("\r\n\r\n");
+            if (header_end != std::string::npos) {
+                // Status + Content-Length (the portal always sets it).
+                if (buf.compare(0, 9, "HTTP/1.1 ") != 0 ||
+                    buf.compare(9, 3, "200") != 0) {
+                    close(fd);
+                    return false;
+                }
+                const char* needle = "content-length:";
+                for (size_t pos = 0; pos < header_end;) {
+                    size_t eol = buf.find("\r\n", pos);
+                    if (eol == std::string::npos || eol > header_end) break;
+                    if (eol - pos > strlen(needle) &&
+                        strncasecmp(buf.c_str() + pos, needle,
+                                    strlen(needle)) == 0) {
+                        content_length =
+                            atoll(buf.c_str() + pos + strlen(needle));
+                    }
+                    pos = eol + 2;
+                }
+            }
+        }
+        if (header_end != std::string::npos && content_length >= 0 &&
+            buf.size() >= header_end + 4 + (size_t)content_length) {
+            break;  // full body buffered
+        }
+    }
+    close(fd);
+    if (header_end == std::string::npos) return false;
+    if (content_length < 0) {
+        *body = buf.substr(header_end + 4);
+    } else if (buf.size() >= header_end + 4 + (size_t)content_length) {
+        *body = buf.substr(header_end + 4, (size_t)content_length);
+    } else {
+        return false;  // truncated
+    }
+    return true;
+}
+
+// ---------------- RenderRpczJson parser ----------------
+// Parses exactly the shape span.cc emits (flat span objects with string /
+// integer values and a flat notes string array) — not a general JSON
+// parser, but tolerant of unknown keys so the two sides can evolve.
+
+struct Scanner {
+    const std::string& s;
+    size_t p = 0;
+    explicit Scanner(const std::string& str) : s(str) {}
+    void ws() {
+        while (p < s.size() && isspace((unsigned char)s[p])) ++p;
+    }
+    bool eat(char c) {
+        ws();
+        if (p < s.size() && s[p] == c) {
+            ++p;
+            return true;
+        }
+        return false;
+    }
+    bool peek(char c) {
+        ws();
+        return p < s.size() && s[p] == c;
+    }
+    bool string(std::string* out) {
+        ws();
+        if (p >= s.size() || s[p] != '"') return false;
+        ++p;
+        out->clear();
+        while (p < s.size() && s[p] != '"') {
+            if (s[p] == '\\' && p + 1 < s.size()) {
+                const char e = s[p + 1];
+                if (e == 'u' && p + 5 < s.size()) {
+                    out->push_back('?');  // control chars: lossy is fine
+                    p += 6;
+                    continue;
+                }
+                out->push_back(e == 'n' ? '\n' : e == 't' ? '\t' : e);
+                p += 2;
+                continue;
+            }
+            out->push_back(s[p++]);
+        }
+        return eat('"');
+    }
+    bool number(int64_t* out) {
+        ws();
+        char* end = nullptr;
+        const long long v = strtoll(s.c_str() + p, &end, 10);
+        if (end == s.c_str() + p) return false;
+        *out = v;
+        p = (size_t)(end - s.c_str());
+        return true;
+    }
+    // Skip one value of any supported shape (unknown keys).
+    bool skip_value() {
+        ws();
+        if (peek('"')) {
+            std::string tmp;
+            return string(&tmp);
+        }
+        if (eat('[')) {
+            if (eat(']')) return true;
+            do {
+                if (!skip_value()) return false;
+            } while (eat(','));
+            return eat(']');
+        }
+        if (eat('{')) {
+            if (eat('}')) return true;
+            do {
+                std::string k;
+                if (!string(&k) || !eat(':') || !skip_value()) return false;
+            } while (eat(','));
+            return eat('}');
+        }
+        int64_t tmp;
+        return number(&tmp);
+    }
+};
+
+bool ParseSpanObject(Scanner& sc, StitchSpan* out) {
+    if (!sc.eat('{')) return false;
+    if (sc.eat('}')) return true;
+    do {
+        std::string key;
+        if (!sc.string(&key) || !sc.eat(':')) return false;
+        if (key == "trace_id" || key == "span_id" ||
+            key == "parent_span_id") {
+            std::string v;
+            if (!sc.string(&v)) return false;
+            const uint64_t id = strtoull(v.c_str(), nullptr, 10);
+            if (key == "trace_id") out->trace_id = id;
+            if (key == "span_id") out->span_id = id;
+            if (key == "parent_span_id") out->parent_span_id = id;
+        } else if (key == "kind") {
+            std::string v;
+            if (!sc.string(&v)) return false;
+            out->server = v == "SERVER";
+        } else if (key == "method") {
+            if (!sc.string(&out->method)) return false;
+        } else if (key == "remote") {
+            if (!sc.string(&out->remote)) return false;
+        } else if (key == "notes") {
+            if (!sc.eat('[')) return false;
+            if (!sc.eat(']')) {
+                do {
+                    std::string n;
+                    if (!sc.string(&n)) return false;
+                    out->notes.push_back(std::move(n));
+                } while (sc.eat(','));
+                if (!sc.eat(']')) return false;
+            }
+        } else {
+            int64_t v = 0;
+            if (sc.peek('"') || sc.peek('[') || sc.peek('{')) {
+                if (!sc.skip_value()) return false;
+            } else if (sc.number(&v)) {
+                if (key == "error_code") out->error_code = (int)v;
+                else if (key == "retries") out->retries = (int)v;
+                else if (key == "request_bytes") out->request_bytes = v;
+                else if (key == "response_bytes") out->response_bytes = v;
+                else if (key == "start_us") out->start_us = v;
+                else if (key == "sent_us") out->sent_us = v;
+                else if (key == "received_us") out->received_us = v;
+                else if (key == "process_start_us") out->process_start_us = v;
+                else if (key == "process_end_us") out->process_end_us = v;
+                else if (key == "end_us") out->end_us = v;
+            } else {
+                return false;
+            }
+        }
+    } while (sc.eat(','));
+    return sc.eat('}');
+}
+
+bool ParseRpczJson(const std::string& body,
+                   std::vector<StitchSpan>* spans) {
+    Scanner sc(body);
+    if (!sc.eat('{')) return false;
+    std::string host;
+    bool ok = true;
+    do {
+        std::string key;
+        if (!sc.string(&key) || !sc.eat(':')) return false;
+        if (key == "host") {
+            if (!sc.string(&host)) return false;
+        } else if (key == "spans") {
+            if (!sc.eat('[')) return false;
+            if (!sc.eat(']')) {
+                do {
+                    StitchSpan s;
+                    if (!ParseSpanObject(sc, &s)) return false;
+                    spans->push_back(std::move(s));
+                } while (sc.eat(','));
+                if (!sc.eat(']')) return false;
+            }
+        } else if (!sc.skip_value()) {
+            return false;
+        }
+    } while (sc.eat(','));
+    for (StitchSpan& s : *spans) s.host = host;
+    return ok && sc.eat('}');
+}
+
+// ---------------- collection ----------------
+
+void FormatNote(const Span::Note& n, int64_t span_start,
+                std::vector<std::string>* out) {
+    char buf[64];
+    snprintf(buf, sizeof(buf), "%+" PRId64 "us ", n.at_us - span_start);
+    out->push_back(buf + n.text);
+}
+
+void CollectLocal(uint64_t trace_id, std::vector<StitchSpan>* out) {
+    for (const Span& s : SpanDB::singleton()->Recent(256, trace_id)) {
+        StitchSpan t;
+        t.host = RpczHost();
+        t.trace_id = s.trace_id;
+        t.span_id = s.span_id;
+        t.parent_span_id = s.parent_span_id;
+        t.server = s.kind == Span::SERVER;
+        t.method = s.method;
+        t.remote = endpoint2str(s.remote_side);
+        t.error_code = s.error_code;
+        t.retries = s.retries;
+        t.request_bytes = s.request_bytes;
+        t.response_bytes = s.response_bytes;
+        t.start_us = s.start_us;
+        t.sent_us = s.sent_us;
+        t.received_us = s.received_us;
+        t.process_start_us = s.process_start_us;
+        t.process_end_us = s.process_end_us;
+        t.end_us = s.end_us;
+        for (const Span::Note& n : s.notes) {
+            FormatNote(n, s.start_us, &t.notes);
+        }
+        out->push_back(std::move(t));
+    }
+}
+
+std::vector<EndPoint> StitchPeers() {
+    std::set<std::string> seen;
+    std::vector<EndPoint> out;
+    auto add = [&](const EndPoint& ep) {
+        if (ep.port <= 0) return;  // unix / unset: no portal to query
+        const std::string key = endpoint2str(ep);
+        if (key == RpczHost()) return;  // self: already collected locally
+        if (seen.insert(key).second) out.push_back(ep);
+    };
+    const std::string flag = FLAGS_rpcz_peers.get();
+    size_t pos = 0;
+    while (pos <= flag.size()) {
+        const size_t c = flag.find(',', pos);
+        const size_t end = c == std::string::npos ? flag.size() : c;
+        if (end > pos) {
+            EndPoint ep;
+            if (str2endpoint(flag.substr(pos, end - pos).c_str(), &ep) ==
+                0) {
+                add(ep);
+            }
+        }
+        pos = end + 1;
+    }
+    for (const EndPoint& ep : SocketMap::singleton()->endpoints()) {
+        add(ep);
+    }
+    return out;
+}
+
+// ---------------- tree + rendering ----------------
+
+struct RenderCtx {
+    std::vector<StitchSpan> spans;
+    std::multimap<uint64_t, size_t> children;  // parent_span_id -> index
+    std::vector<bool> placed;
+    std::string out;
+};
+
+int64_t SpanDuration(const StitchSpan& s) {
+    return s.end_us > s.start_us ? s.end_us - s.start_us : 0;
+}
+
+void RenderSpan(RenderCtx& ctx, size_t idx, int64_t offset, int depth);
+
+// Children of `idx`, displayed with clock normalization: a SERVER child
+// on another host is anchored into its parent CLIENT span's sent/recv
+// envelope; same-host children inherit the parent's offset.
+void RenderChildren(RenderCtx& ctx, size_t idx, int64_t offset, int depth) {
+    const StitchSpan& parent = ctx.spans[idx];
+    std::vector<std::pair<int64_t, std::pair<size_t, int64_t>>> ordered;
+    auto range = ctx.children.equal_range(parent.span_id);
+    for (auto it = range.first; it != range.second; ++it) {
+        const size_t ci = it->second;
+        if (ctx.placed[ci]) continue;
+        const StitchSpan& child = ctx.spans[ci];
+        int64_t child_offset;
+        if (child.host == parent.host) {
+            child_offset = offset;  // same clock
+        } else {
+            // Anchor into the parent's wire envelope: the child's span
+            // must nest inside [parent.sent, parent.received]; the RTT
+            // residue splits evenly between the two wire directions.
+            const int64_t psent =
+                parent.sent_us > 0 ? parent.sent_us : parent.start_us;
+            const int64_t precv = parent.received_us > 0
+                                      ? parent.received_us
+                                      : parent.end_us;
+            int64_t wire = (precv - psent) - SpanDuration(child);
+            if (wire < 0) wire = 0;
+            child_offset = (psent + offset + wire / 2) - child.start_us;
+        }
+        ordered.push_back(
+            {child.start_us + child_offset, {ci, child_offset}});
+    }
+    std::sort(ordered.begin(), ordered.end());
+    for (const auto& o : ordered) {
+        RenderSpan(ctx, o.second.first, o.second.second, depth + 1);
+    }
+}
+
+void RenderSpan(RenderCtx& ctx, size_t idx, int64_t offset, int depth) {
+    if (depth > 32) return;  // corrupt parentage: refuse to recurse forever
+    ctx.placed[idx] = true;
+    const StitchSpan& s = ctx.spans[idx];
+    const std::string indent((size_t)depth * 4, ' ');
+    char line[512];
+    snprintf(line, sizeof(line),
+             "%s%s%s %s @%s  start=+%" PRId64 "us total=%" PRId64
+             "us err=%d req=%" PRId64 "B res=%" PRId64 "B%s\n",
+             indent.c_str(), depth > 0 ? "\\_ " : "",
+             s.server ? "SERVER" : "CLIENT", s.method.c_str(),
+             s.host.c_str(), s.start_us + offset, SpanDuration(s),
+             s.error_code, s.request_bytes, s.response_bytes,
+             s.retries > 0 ? "  [re-issued]" : "");
+    ctx.out += line;
+    auto phase = [](int64_t from, int64_t to) -> int64_t {
+        return (from > 0 && to >= from) ? to - from : 0;
+    };
+    if (s.server) {
+        // Per-hop breakdown: queue (received -> handler fiber), process
+        // (handler body), write (response serialize+send).
+        snprintf(line, sizeof(line),
+                 "%s      queue=%" PRId64 "us process=%" PRId64
+                 "us write=%" PRId64 "us\n",
+                 indent.c_str(), phase(s.start_us, s.process_start_us),
+                 phase(s.process_start_us, s.process_end_us),
+                 phase(s.process_end_us, s.end_us));
+        ctx.out += line;
+    } else {
+        // Wire time of this hop: the envelope minus the (single) server
+        // child's span — only meaningful when that child was stitched in.
+        int64_t child_total = -1;
+        auto range = ctx.children.equal_range(s.span_id);
+        for (auto it = range.first; it != range.second; ++it) {
+            if (ctx.spans[it->second].server) {
+                child_total = SpanDuration(ctx.spans[it->second]);
+                break;
+            }
+        }
+        const int64_t psent = s.sent_us > 0 ? s.sent_us : s.start_us;
+        const int64_t precv =
+            s.received_us > 0 ? s.received_us : s.end_us;
+        if (child_total >= 0) {
+            int64_t wire = (precv - psent) - child_total;
+            if (wire < 0) wire = 0;
+            snprintf(line, sizeof(line),
+                     "%s      issue=%" PRId64 "us wire=%" PRId64
+                     "us (rtt residue) downstream=%" PRId64 "us\n",
+                     indent.c_str(), phase(s.start_us, s.sent_us), wire,
+                     child_total);
+        } else {
+            snprintf(line, sizeof(line),
+                     "%s      issue=%" PRId64 "us wait=%" PRId64
+                     "us done=%" PRId64 "us\n",
+                     indent.c_str(), phase(s.start_us, s.sent_us),
+                     phase(s.sent_us, precv), phase(precv, s.end_us));
+        }
+        ctx.out += line;
+    }
+    for (const std::string& n : s.notes) {
+        ctx.out += indent + "      @" + n + "\n";
+    }
+    RenderChildren(ctx, idx, offset, depth);
+}
+
+}  // namespace
+
+std::string RenderStitchedTrace(uint64_t trace_id) {
+    RenderCtx ctx;
+    CollectLocal(trace_id, &ctx.spans);
+    const std::vector<EndPoint> peers = StitchPeers();
+    int peers_ok = 0, peers_failed = 0;
+    char path[128];
+    snprintf(path, sizeof(path), "/rpcz?format=json&trace_id=%" PRIu64,
+             trace_id);
+    // ONE shared budget for the whole fan-out (a per-peer budget would
+    // stack N dead peers into N timeouts), split FAIRLY as it is spent:
+    // each peer gets remaining/peers_left, so one black-holed peer early
+    // in the list cannot starve the healthy peers behind it of their
+    // share. Healthy portals answer in microseconds and return the
+    // unused share to the pool.
+    const int64_t fanout_deadline =
+        monotonic_time_us() +
+        (int64_t)FLAGS_rpcz_stitch_timeout_ms.get() * 1000;
+    for (size_t i = 0; i < peers.size(); ++i) {
+        const int64_t now = monotonic_time_us();
+        const int64_t remaining =
+            fanout_deadline > now ? fanout_deadline - now : 0;
+        const int64_t deadline =
+            now + remaining / (int64_t)(peers.size() - i);
+        std::string body;
+        std::vector<StitchSpan> remote;
+        if (HttpGet(peers[i], path, deadline, &body) &&
+            ParseRpczJson(body, &remote)) {
+            ++peers_ok;
+            for (StitchSpan& s : remote) ctx.spans.push_back(std::move(s));
+        } else {
+            ++peers_failed;
+        }
+    }
+    // Dedup (a peer may also appear in -rpcz_peers AND SocketMap; a span
+    // must render once).
+    {
+        std::set<std::pair<std::string, uint64_t>> seen;
+        std::vector<StitchSpan> uniq;
+        for (StitchSpan& s : ctx.spans) {
+            if (s.trace_id != trace_id) continue;
+            if (seen.insert({s.host, s.span_id}).second) {
+                uniq.push_back(std::move(s));
+            }
+        }
+        ctx.spans.swap(uniq);
+    }
+    char head[256];
+    snprintf(head, sizeof(head),
+             "stitched trace %" PRIu64 ": %zu span(s), peers queried: %zu "
+             "(ok %d, failed %d)\n"
+             "host clocks normalized via parent-child send/recv envelopes; "
+             "times relative to trace start\n\n",
+             trace_id, ctx.spans.size(), peers.size(), peers_ok,
+             peers_failed);
+    std::string out = head;
+    if (ctx.spans.empty()) {
+        out += "no spans for this trace (rpcz disabled, evicted, or wrong "
+               "id; check -rpcz_peers covers the mesh)\n";
+        return out;
+    }
+    ctx.placed.assign(ctx.spans.size(), false);
+    std::set<uint64_t> ids;
+    for (size_t i = 0; i < ctx.spans.size(); ++i) {
+        ctx.children.emplace(ctx.spans[i].parent_span_id, i);
+        ids.insert(ctx.spans[i].span_id);
+    }
+    // Roots: no parent, or the parent span was never collected. Roots
+    // render at offset -start (trace time zero); orphan subtrees fall
+    // back to the same anchoring.
+    std::vector<std::pair<int64_t, size_t>> roots;
+    for (size_t i = 0; i < ctx.spans.size(); ++i) {
+        const StitchSpan& s = ctx.spans[i];
+        if (s.parent_span_id == 0 || ids.count(s.parent_span_id) == 0) {
+            roots.push_back({s.start_us, i});
+        }
+    }
+    std::sort(roots.begin(), roots.end());
+    for (const auto& r : roots) {
+        if (!ctx.placed[r.second]) {
+            RenderSpan(ctx, r.second, -ctx.spans[r.second].start_us, 0);
+            ctx.out += "\n";
+        }
+    }
+    // Orphans with a dangling parent inside a cycle (never placed).
+    for (size_t i = 0; i < ctx.spans.size(); ++i) {
+        if (!ctx.placed[i]) {
+            RenderSpan(ctx, i, -ctx.spans[i].start_us, 0);
+        }
+    }
+    out += ctx.out;
+    return out;
+}
+
+}  // namespace tpurpc

@@ -1,0 +1,34 @@
+// Cross-host rpcz trace stitching: fan out over the mesh's portals,
+// collect every node's spans for one trace_id, and render a single
+// parent-child timeline with per-hop queue/process/wire breakdown and
+// clock-skew normalization.
+//
+// Peers come from two sources: the explicit -rpcz_peers flag
+// ("ip:port,ip:port", the mesh membership), plus every remote this
+// process holds a shared client connection to (SocketMap — those are
+// serving ports, so their portals answer /rpcz). Each peer is queried
+// with a plain HTTP/1.1 GET /rpcz?format=json&trace_id=N under ONE
+// shared -rpcz_stitch_timeout_ms budget for the whole fan-out: however
+// many peers are dead or partitioned, the page costs at most one
+// timeout and renders whatever was collected.
+//
+// Clock-skew normalization: monotonic clocks are per-process, so a
+// server span's raw timestamps are meaningless next to its parent
+// client span's. The parent-child send/recv envelope fixes that: the
+// server's [start..end] must nest inside the client's [sent..received];
+// the wire residue ((received-sent) - (end-start)) splits evenly between
+// the two directions, anchoring the child's clock to the parent's
+// (children on the SAME host as their parent share its clock and just
+// inherit the offset).
+#pragma once
+
+#include <cstdint>
+#include <string>
+
+namespace tpurpc {
+
+// The /rpcz/trace/<id> page: collect (local + peers) and render. Blocks
+// the calling fiber for at most -rpcz_stitch_timeout_ms total.
+std::string RenderStitchedTrace(uint64_t trace_id);
+
+}  // namespace tpurpc

@@ -12,8 +12,10 @@
 #include "thttp/builtin_services.h"
 #include "thttp/http2_protocol.h"
 #include "tvar/default_variables.h"
+#include "tvar/series.h"
 #include "tici/shm_link.h"
 #include "trpc/policy_tpu_std.h"
+#include "trpc/span.h"
 #include "trpc/redis.h"
 #include "trpc/stream.h"
 
@@ -72,6 +74,20 @@ int Server::Start(const EndPoint& ep, const ServerOptions* options) {
         return -1;
     }
     listening_ = true;
+    // Host identity for cross-host trace stitching (first server wins).
+    // A wildcard bind would make every node report "0.0.0.0:port" — the
+    // stitcher keys clock ownership and self-exclusion on this string,
+    // so substitute the machine's hostname to keep it unique per host.
+    EndPoint self = ep;
+    self.port = acceptor_.listened_port();
+    if (self.ip.s_addr == 0) {
+        char hostname[256] = "localhost";
+        gethostname(hostname, sizeof(hostname) - 1);
+        SetRpczHost(std::string(hostname) + ":" +
+                    std::to_string(self.port));
+    } else {
+        SetRpczHost(endpoint2str(self));
+    }
     return 0;
 }
 
@@ -120,6 +136,9 @@ int Server::StartNoListen(const ServerOptions* options) {
         }
     }
     ExposeProcessVariables();  // process_* gauges for /vars + /metrics
+    ExposeFlagVariables();     // flag_* bridge: flag flips are scrapeable
+    // Per-variable 60s/60min/24h rings behind /vars?series= (1Hz tick).
+    SeriesCollector::singleton()->Enable();
     messenger_.add_protocol(TpuStdProtocolIndex());
     messenger_.add_protocol(stream_internal::StreamProtocolIndex());
     // Any accepted TCP connection may upgrade itself to the shared-memory

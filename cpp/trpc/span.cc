@@ -1,5 +1,7 @@
 #include "trpc/span.h"
 
+#include <unistd.h>
+
 #include <cinttypes>
 #include <cstdio>
 
@@ -48,6 +50,93 @@ bool IsRpczSampled() {
 }
 
 bool IsRpczEnabled() { return FLAGS_enable_rpcz.get(); }
+
+namespace {
+// Fallback identity (no server started yet): hostname + pid — unique
+// across machines AND across processes on one machine, since the
+// stitcher keys clock ownership on exact string equality.
+std::string* rpcz_host() {
+    static std::string* h = [] {
+        char hostname[256] = "localhost";
+        gethostname(hostname, sizeof(hostname) - 1);
+        return new std::string(std::string(hostname) + ":pid:" +
+                               std::to_string(getpid()));
+    }();
+    return h;
+}
+}  // namespace
+
+void SetRpczHost(const std::string& host) {
+    static bool set = false;
+    if (set) return;  // first server wins (a process restart re-Starts)
+    *rpcz_host() = host;
+    set = true;
+}
+
+const std::string& RpczHost() { return *rpcz_host(); }
+
+namespace {
+std::string JsonEscape(const std::string& s) {
+    std::string out;
+    out.reserve(s.size() + 8);
+    for (char c : s) {
+        if (c == '"' || c == '\\') {
+            out += '\\';
+            out += c;
+        } else if ((unsigned char)c < 0x20) {
+            char buf[8];
+            snprintf(buf, sizeof(buf), "\\u%04x", (unsigned char)c);
+            out += buf;
+        } else {
+            out += c;
+        }
+    }
+    return out;
+}
+}  // namespace
+
+std::string RenderRpczJson(uint64_t trace_id_filter) {
+    const std::vector<Span> spans =
+        SpanDB::singleton()->Recent(trace_id_filter != 0 ? 256 : 64,
+                                    trace_id_filter);
+    std::string out = "{\"host\":\"" + JsonEscape(RpczHost()) +
+                      "\",\"spans\":[";
+    char buf[512];
+    bool first = true;
+    for (const Span& s : spans) {
+        if (!first) out += ",";
+        first = false;
+        // uint64 ids go out as STRINGS: JSON doubles lose integers above
+        // 2^53 and span ids use the full 64 bits.
+        snprintf(buf, sizeof(buf),
+                 "{\"trace_id\":\"%" PRIu64 "\",\"span_id\":\"%" PRIu64
+                 "\",\"parent_span_id\":\"%" PRIu64 "\",\"kind\":\"%s\","
+                 "\"error_code\":%d,\"retries\":%d,"
+                 "\"request_bytes\":%" PRId64 ",\"response_bytes\":%" PRId64
+                 ",\"start_us\":%" PRId64 ",\"sent_us\":%" PRId64
+                 ",\"received_us\":%" PRId64 ",\"process_start_us\":%" PRId64
+                 ",\"process_end_us\":%" PRId64 ",\"end_us\":%" PRId64,
+                 s.trace_id, s.span_id, s.parent_span_id,
+                 s.kind == Span::SERVER ? "SERVER" : "CLIENT", s.error_code,
+                 s.retries, s.request_bytes, s.response_bytes, s.start_us,
+                 s.sent_us, s.received_us, s.process_start_us,
+                 s.process_end_us, s.end_us);
+        out += buf;
+        out += ",\"method\":\"" + JsonEscape(s.method) + "\"";
+        out += ",\"remote\":\"" + JsonEscape(endpoint2str(s.remote_side)) +
+               "\"";
+        out += ",\"notes\":[";
+        for (size_t i = 0; i < s.notes.size(); ++i) {
+            if (i > 0) out += ",";
+            snprintf(buf, sizeof(buf), "%+" PRId64 "us ",
+                     s.notes[i].at_us - s.start_us);
+            out += "\"" + JsonEscape(buf + s.notes[i].text) + "\"";
+        }
+        out += "]}";
+    }
+    out += "]}";
+    return out;
+}
 
 std::string RenderRpcz(uint64_t trace_id_filter) {
     const std::vector<Span> spans =

@@ -57,6 +57,8 @@ struct Span : public Collected {
 
 // Fixed-capacity store of recently completed spans (the reference keeps a
 // time-indexed SpanDB; a bounded ring is enough for a live portal).
+// Capacity sized so a trace survives several seconds of full-rate
+// background sampling before the stitcher scrapes it.
 class SpanDB {
 public:
     static SpanDB* singleton();
@@ -66,7 +68,7 @@ public:
     std::vector<Span> Recent(size_t limit, uint64_t trace_id = 0) const;
 
 private:
-    static constexpr size_t kCapacity = 512;
+    static constexpr size_t kCapacity = 4096;
     mutable std::mutex mu_;
     std::deque<Span> spans_;
 };
@@ -79,5 +81,14 @@ bool IsRpczSampled();
 bool IsRpczEnabled();
 // Render the /rpcz page (newest-first; trace filter optional).
 std::string RenderRpcz(uint64_t trace_id_filter);
+// Machine-readable spans for the cross-host stitcher:
+// {"host":"ip:port","spans":[{...}]} — consumed by
+// /rpcz?format=json&trace_id=N and parsed back by trpc/rpcz_stitch.cc.
+std::string RenderRpczJson(uint64_t trace_id_filter);
+
+// This process's identity in stitched traces ("ip:port" of the serving
+// portal). Set once by the first Server::Start; defaults to "pid:<n>".
+void SetRpczHost(const std::string& host);
+const std::string& RpczHost();
 
 }  // namespace tpurpc

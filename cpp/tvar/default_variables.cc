@@ -13,7 +13,9 @@
 #include <cstdio>
 #include <cstring>
 #include <mutex>
+#include <set>
 
+#include "tbase/flags.h"
 #include "tbase/time.h"
 #include "tvar/reducer.h"
 
@@ -158,6 +160,40 @@ int64_t io_read() { return cached_io().read_bytes; }
 int64_t io_write() { return cached_io().write_bytes; }
 
 }  // namespace
+
+namespace {
+
+// One bridge variable per flag (VERDICT gap: flag flips were invisible
+// to scrapes). Bools render 0/1 so prometheus picks them up; numeric
+// flags pass through; string flags stay /vars-only (non-numeric
+// descriptions are skipped by the exporter).
+struct FlagVariable : public Variable {
+    explicit FlagVariable(FlagBase* f) : flag(f) {}
+    std::string get_description() const override {
+        const std::string v = flag->GetString();
+        if (strcmp(flag->type(), "bool") == 0) {
+            return v == "true" ? "1" : "0";
+        }
+        return v;
+    }
+    FlagBase* flag;
+};
+
+}  // namespace
+
+void ExposeFlagVariables() {
+    // Tracks what is already bridged so restarts / late-registered flags
+    // are handled without duplicates (expose() would retake the name
+    // anyway, but the old bridge object would leak its registry slot).
+    static std::mutex mu;
+    static std::set<std::string>* bridged = new std::set<std::string>;
+    std::lock_guard<std::mutex> g(mu);
+    for (FlagBase* f : ListFlags()) {
+        if (!bridged->insert(f->name()).second) continue;
+        // Intentionally leaked: flags are process-lifetime.
+        (new FlagVariable(f))->expose(std::string("flag_") + f->name());
+    }
+}
 
 void ExposeProcessVariables() {
     static std::once_flag once;

@@ -7,4 +7,11 @@ namespace tpurpc {
 
 void ExposeProcessVariables();
 
+// Flag→var bridge: every registered runtime flag becomes a
+// `flag_<name>` PassiveStatus in /vars (bools render 0/1, numerics pass
+// through — both scrape-able at /metrics; strings stay /vars-only), so a
+// live flag flip is visible alongside the metrics it changes. Idempotent
+// and re-runnable (later registrations picked up on the next call).
+void ExposeFlagVariables();
+
 }  // namespace tpurpc

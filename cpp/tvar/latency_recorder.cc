@@ -62,6 +62,54 @@ int64_t LatencyRecorder::latency_percentile(double q) const {
 
 int64_t LatencyRecorder::max_latency() const { return window_delta().max; }
 
+std::vector<std::pair<std::string, double>> LatencyRecorder::numeric_fields()
+    const {
+    const Snap d = window_delta();
+    return {
+        {"_qps", (double)qps()},
+        {"_avg_us", (double)(d.count > 0 ? d.sum / d.count : 0)},
+        {"_p50", (double)d.hist.quantile(0.5)},
+        {"_p90", (double)d.hist.quantile(0.9)},
+        {"_p99", (double)d.hist.quantile(0.99)},
+        {"_p999", (double)d.hist.quantile(0.999)},
+        {"_max", (double)d.max},
+        {"_count", (double)count()},
+    };
+}
+
+void LatencyRecorder::prometheus_text(const std::string& name,
+                                      std::string* out) const {
+    const Snap d = window_delta();
+    std::ostringstream os;
+    os << "# TYPE " << name << " summary\n";
+    const double qs[] = {0.5, 0.9, 0.99, 0.999};
+    const char* qlabels[] = {"0.5", "0.9", "0.99", "0.999"};
+    for (int i = 0; i < 4; ++i) {
+        os << name << "{quantile=\"" << qlabels[i] << "\"} "
+           << d.hist.quantile(qs[i]) << "\n";
+    }
+    os << name << "_sum " << sum() << "\n";
+    os << name << "_count " << count() << "\n";
+    *out += os.str();
+}
+
+const char* LatencyRecorder::prometheus_labelled_samples(
+    const std::string& name, const std::string& labels,
+    std::string* out) const {
+    const Snap d = window_delta();
+    std::ostringstream os;
+    const double qs[] = {0.5, 0.9, 0.99, 0.999};
+    const char* qlabels[] = {"0.5", "0.9", "0.99", "0.999"};
+    for (int i = 0; i < 4; ++i) {
+        os << name << "{" << labels << ",quantile=\"" << qlabels[i] << "\"} "
+           << d.hist.quantile(qs[i]) << "\n";
+    }
+    os << name << "_sum{" << labels << "} " << sum() << "\n";
+    os << name << "_count{" << labels << "} " << count() << "\n";
+    *out += os.str();
+    return "summary";
+}
+
 std::string LatencyRecorder::get_description() const {
     const Snap d = window_delta();
     std::ostringstream os;

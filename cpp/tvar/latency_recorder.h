@@ -45,6 +45,9 @@ public:
     }
 
     int64_t count() const { return count_.load(std::memory_order_relaxed); }
+    // Cumulative sum of recorded latencies (us) — the prometheus summary
+    // `_sum` (monotonic, like `_count`; quantiles stay windowed).
+    int64_t sum() const { return sum_.load(std::memory_order_relaxed); }
 
     // Window stats (over the last window_size seconds).
     int64_t qps() const;
@@ -55,6 +58,20 @@ public:
     // One window_delta() snapshot for all fields: 1/6 the cost of deriving
     // each independently, and the JSON is internally consistent.
     std::string get_description() const override;
+
+    // Per-field values for time-series sampling (name_qps, name_p99, ...)
+    // without re-parsing the JSON description.
+    std::vector<std::pair<std::string, double>> numeric_fields()
+        const override;
+
+    // A real prometheus summary family: quantile-labelled samples +
+    // cumulative `_sum`/`_count` — replaces the flat `_field` gauges the
+    // exporter used to parse out of the JSON description.
+    void prometheus_text(const std::string& name,
+                         std::string* out) const override;
+    const char* prometheus_labelled_samples(const std::string& name,
+                                            const std::string& labels,
+                                            std::string* out) const override;
 
     // Expose under a family name (like the reference's
     // LatencyRecorder::expose creating name_latency, name_qps, ...).

@@ -1,18 +1,6 @@
 #include "tvar/multi_dimension.h"
 
-#include <cstdlib>
-
 namespace tpurpc {
-
-namespace multi_dim_detail {
-
-bool numeric(const std::string& s) {
-    char* end = nullptr;
-    strtod(s.c_str(), &end);
-    return end != s.c_str() && *end == '\0' && !s.empty();
-}
-
-}  // namespace multi_dim_detail
 
 namespace {
 

@@ -18,10 +18,6 @@
 
 namespace tpurpc {
 
-namespace multi_dim_detail {
-bool numeric(const std::string& s);
-}  // namespace multi_dim_detail
-
 template <typename T>
 class MultiDimension : public Variable {
 public:
@@ -67,48 +63,35 @@ public:
         return os.str();
     }
 
-    // Prometheus exposition: name{l1="v1",...} value — one line per
-    // series whose description is numeric; composite descriptions (json
-    // objects) expand per field as name_field{labels}, the same scheme
-    // the /metrics handler uses for unlabelled composite vars.
+    // Prometheus exposition: one TYPE line for the family, then each
+    // label tuple's samples via the stat's own labelled-sample hook — a
+    // labelled Adder stays a gauge, a labelled LatencyRecorder a proper
+    // summary (no JSON re-parsing).
     std::string prometheus_text(const std::string& name) const {
-        std::ostringstream os;
         std::lock_guard<std::mutex> g(mu_);
-        bool typed = false;
+        std::string samples;
+        const char* type = nullptr;
         for (const auto& kv : stats_) {
-            const std::string value = kv.second->get_description();
-            const std::string lp = label_pairs(kv.first);
-            if (multi_dim_detail::numeric(value)) {
-                if (!typed) {
-                    os << "# TYPE " << name << " gauge\n";
-                    typed = true;
-                }
-                os << name << "{" << lp << "} " << value << "\n";
-                continue;
-            }
-            if (value.size() < 2 || value[0] != '{') continue;
-            size_t pos = 1;
-            while (pos < value.size()) {
-                const size_t kstart = value.find('"', pos);
-                if (kstart == std::string::npos) break;
-                const size_t kend = value.find('"', kstart + 1);
-                if (kend == std::string::npos) break;
-                const size_t colon = value.find(':', kend);
-                if (colon == std::string::npos) break;
-                size_t vend = value.find_first_of(",}", colon);
-                if (vend == std::string::npos) vend = value.size();
-                const std::string field =
-                    value.substr(kstart + 1, kend - kstart - 1);
-                const std::string fval =
-                    value.substr(colon + 1, vend - colon - 1);
-                if (multi_dim_detail::numeric(fval)) {
-                    os << name << "_" << field << "{" << lp << "} " << fval
-                       << "\n";
-                }
-                pos = vend + 1;
-            }
+            type = kv.second->prometheus_labelled_samples(
+                name, label_pairs(kv.first), &samples);
         }
-        return os.str();
+        if (samples.empty() || type == nullptr) return "";
+        return "# TYPE " + name + " " + type + "\n" + samples;
+    }
+
+    // Exported through the registry-wide /metrics dump too (a
+    // MultiDimension is itself an exposed Variable).
+    void prometheus_text(const std::string& name,
+                         std::string* out) const override {
+        *out += prometheus_text(name);
+    }
+
+    // A labelled series of label-tuples makes no sense — the series
+    // sampler skips MultiDimension (per-tuple rings would need per-tuple
+    // names; the flat stats remain visible via /vars).
+    std::vector<std::pair<std::string, double>> numeric_fields()
+        const override {
+        return {};
     }
 
 private:

@@ -1,0 +1,153 @@
+#include "tvar/series.h"
+
+#include <cmath>
+#include <sstream>
+
+#include "tbase/flags.h"
+#include "tvar/variable.h"
+#include "tvar/window.h"
+
+// Live-togglable (reference -bvar_save_series, on by default): the rings
+// cost one dump of every exposed variable per second.
+DEFINE_bool(tvar_save_series, true,
+            "sample every exposed variable into 60s/60min/24h rings");
+
+namespace tpurpc {
+
+void SeriesRing::append(double v) {
+    second_[nsecond_ % kSeconds] = v;
+    ++nsecond_;
+    if (nsecond_ % kSeconds == 0) {
+        double sum = 0;
+        for (double s : second_) sum += s;
+        minute_[nminute_ % kMinutes] = sum / kSeconds;
+        ++nminute_;
+        if (nminute_ % kMinutes == 0) {
+            sum = 0;
+            for (double m : minute_) sum += m;
+            hour_[nhour_ % kHours] = sum / kMinutes;
+            ++nhour_;
+        }
+    }
+}
+
+std::vector<double> SeriesRing::unroll(const double* ring, int cap,
+                                       int64_t n) {
+    std::vector<double> out((size_t)cap, 0.0);
+    // Oldest-first: when the ring wrapped, the entry at n % cap is the
+    // oldest; before that, entries [0, n) are already in order.
+    const int64_t start = n >= cap ? n % cap : 0;
+    const int64_t filled = n >= cap ? cap : n;
+    const int64_t pad = cap - filled;
+    for (int64_t i = 0; i < filled; ++i) {
+        out[(size_t)(pad + i)] = ring[(start + i) % cap];
+    }
+    return out;
+}
+
+namespace {
+void AppendJsonArray(std::ostringstream& os, const std::vector<double>& v) {
+    os << "[";
+    for (size_t i = 0; i < v.size(); ++i) {
+        if (i > 0) os << ",";
+        // JSON has no Inf/NaN literal — a non-finite sample (e.g. a
+        // 0/0-ratio PassiveStatus) must not make the whole ring
+        // unparseable; 0 keeps the trend readable.
+        os << (std::isfinite(v[i]) ? FormatMetricValue(v[i]) : "0");
+    }
+    os << "]";
+}
+}  // namespace
+
+std::string SeriesRing::ToJson(const std::string& name) const {
+    std::ostringstream os;
+    os << "{\"name\":\"" << name << "\",\"ticks\":" << nsecond_
+       << ",\"second\":";
+    AppendJsonArray(os, seconds());
+    os << ",\"minute\":";
+    AppendJsonArray(os, minutes());
+    os << ",\"hour\":";
+    AppendJsonArray(os, hours());
+    os << "}";
+    return os.str();
+}
+
+std::string SeriesRing::Sparkline(int n) const {
+    static const char* kBlocks[] = {"▁", "▂", "▃", "▄",
+                                    "▅", "▆", "▇", "█"};
+    if (n > kSeconds) n = kSeconds;
+    const std::vector<double> all = seconds();
+    const std::vector<double> tail(all.end() - n, all.end());
+    double lo = tail[0], hi = tail[0];
+    for (double v : tail) {
+        if (v < lo) lo = v;
+        if (v > hi) hi = v;
+    }
+    std::string out;
+    for (double v : tail) {
+        const int idx =
+            hi > lo ? (int)((v - lo) / (hi - lo) * 7.0 + 0.5) : 0;
+        out += kBlocks[idx < 0 ? 0 : (idx > 7 ? 7 : idx)];
+    }
+    return out;
+}
+
+SeriesCollector* SeriesCollector::singleton() {
+    static SeriesCollector* c = new SeriesCollector;
+    return c;
+}
+
+void SeriesCollector::Enable() {
+    {
+        std::lock_guard<std::mutex> g(mu_);
+        if (enabled_) return;
+        enabled_ = true;
+    }
+    SamplerCollector::singleton()->add(
+        [this] { Tick(); });  // process-lifetime: never removed
+}
+
+void SeriesCollector::Tick() {
+    if (!FLAGS_tvar_save_series.get()) return;
+    // Read all variables first (under the registry lock, like any /vars
+    // dump), then update rings_ under mu_ only — the two locks are never
+    // held together.
+    std::vector<std::pair<std::string, double>> obs;
+    Variable::for_each_exposed(
+        [&obs](const std::string& name, const Variable* v) {
+            for (const auto& f : v->numeric_fields()) {
+                obs.emplace_back(name + f.first, f.second);
+            }
+        });
+    std::lock_guard<std::mutex> g(mu_);
+    for (const auto& o : obs) {
+        auto it = rings_.find(o.first);
+        if (it == rings_.end()) {
+            if (rings_.size() >= kMaxSeries) continue;  // cardinality cap
+            it = rings_.emplace(o.first, SeriesRing()).first;
+        }
+        it->second.append(o.second);
+    }
+}
+
+std::string SeriesCollector::SeriesJson(const std::string& name) const {
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = rings_.find(name);
+    return it == rings_.end() ? "" : it->second.ToJson(name);
+}
+
+std::string SeriesCollector::SparklineFor(const std::string& name) const {
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = rings_.find(name);
+    return it == rings_.end() ? "" : it->second.Sparkline();
+}
+
+std::vector<std::string> SeriesCollector::Names() const {
+    std::lock_guard<std::mutex> g(mu_);
+    std::vector<std::string> out;
+    out.reserve(rings_.size());
+    for (const auto& kv : rings_) out.push_back(kv.first);
+    return out;
+}
+
+}  // namespace tpurpc

@@ -1,0 +1,92 @@
+// Time-series rings for every exposed variable — bvar "detail" series.
+//
+// Modeled on reference src/bvar/detail/series.h (Series<T>: per-second
+// ring of 60, rolling into per-minute 60 and per-hour 24, appended by the
+// 1Hz sampler thread). Instantaneous /vars values answer "what is it
+// NOW"; these rings answer "what was it over the last minute/hour/day",
+// which is what post-hoc debugging of a soak actually needs. Rendered as
+// /vars?series=<name> JSON and as inline sparklines on the /vars page.
+//
+// The ring itself is tick-driven — append() IS the clock (one call = one
+// second) — so boundary rollover is testable under a fake clock by just
+// calling append() N times.
+#pragma once
+
+#include <cstdint>
+#include <map>
+#include <mutex>
+#include <string>
+#include <vector>
+
+namespace tpurpc {
+
+class SeriesRing {
+public:
+    static constexpr int kSeconds = 60;
+    static constexpr int kMinutes = 60;
+    static constexpr int kHours = 24;
+
+    // One per-second observation. Every 60th append folds the mean of the
+    // last 60 seconds into the minute ring; every 60th minute entry folds
+    // the mean of the last 60 minutes into the hour ring.
+    void append(double v);
+
+    int64_t ticks() const { return nsecond_; }
+
+    // Oldest-first, zero-padded to the full ring length (a scrape always
+    // sees exactly 60/60/24 points).
+    std::vector<double> seconds() const { return unroll(second_, kSeconds, nsecond_); }
+    std::vector<double> minutes() const { return unroll(minute_, kMinutes, nminute_); }
+    std::vector<double> hours() const { return unroll(hour_, kHours, nhour_); }
+
+    // {"name":..., "ticks":N, "second":[...], "minute":[...], "hour":[...]}
+    std::string ToJson(const std::string& name) const;
+
+    // Unicode sparkline of the last `n` seconds (portal inline rendering).
+    std::string Sparkline(int n = kSeconds) const;
+
+private:
+    static std::vector<double> unroll(const double* ring, int cap,
+                                      int64_t n);
+
+    double second_[kSeconds] = {};
+    double minute_[kMinutes] = {};
+    double hour_[kHours] = {};
+    int64_t nsecond_ = 0;  // total appends; position = nsecond_ % 60
+    int64_t nminute_ = 0;
+    int64_t nhour_ = 0;
+};
+
+// Global per-variable series store, fed once per second by the
+// SamplerCollector: every exposed variable's numeric_fields() land in a
+// ring named <var><suffix>. Gated by -tvar_save_series (live-togglable).
+class SeriesCollector {
+public:
+    static SeriesCollector* singleton();
+
+    // Idempotent: registers the 1Hz tick with the SamplerCollector.
+    void Enable();
+
+    // One sampling tick (normally driven by the sampler thread; tests
+    // drive it directly). Skips work when -tvar_save_series is false.
+    void Tick();
+
+    // JSON for one series, or empty when unknown.
+    std::string SeriesJson(const std::string& name) const;
+    // Sparkline for the ring exactly named `name` ("" when absent) —
+    // the /vars page decorates plain numeric vars with this.
+    std::string SparklineFor(const std::string& name) const;
+    // All known series names (the /vars?series= index).
+    std::vector<std::string> Names() const;
+
+private:
+    SeriesCollector() = default;
+    // Bounded: a runaway label cardinality must not eat the heap.
+    static constexpr size_t kMaxSeries = 1024;
+
+    mutable std::mutex mu_;
+    std::map<std::string, SeriesRing> rings_;
+    bool enabled_ = false;
+};
+
+}  // namespace tpurpc

@@ -1,5 +1,9 @@
 #include "tvar/variable.h"
 
+#include <cctype>
+#include <cmath>
+#include <cstdio>
+#include <cstdlib>
 #include <map>
 
 namespace tpurpc {
@@ -65,6 +69,84 @@ std::vector<std::pair<std::string, std::string>> Variable::dump_exposed() {
         out.emplace_back(kv.first, kv.second->get_description());
     }
     return out;
+}
+
+void Variable::for_each_exposed(
+    const std::function<void(const std::string&, const Variable*)>& fn) {
+    Registry* r = registry();
+    std::lock_guard<std::mutex> g(r->mu);
+    for (auto& kv : r->vars) fn(kv.first, kv.second);
+}
+
+std::vector<std::pair<std::string, double>> Variable::numeric_fields() const {
+    std::vector<std::pair<std::string, double>> out;
+    const std::string desc = get_description();
+    if (IsNumericLiteral(desc)) {
+        out.emplace_back("", strtod(desc.c_str(), nullptr));
+    }
+    return out;
+}
+
+void Variable::prometheus_text(const std::string& name,
+                               std::string* out) const {
+    for (const auto& f : numeric_fields()) {
+        const std::string mname =
+            f.first.empty() ? name : name + SanitizeMetricName(f.first);
+        *out += "# TYPE " + mname + " gauge\n";
+        *out += mname + " " + FormatMetricValue(f.second) + "\n";
+    }
+}
+
+const char* Variable::prometheus_labelled_samples(const std::string& name,
+                                                  const std::string& labels,
+                                                  std::string* out) const {
+    for (const auto& f : numeric_fields()) {
+        const std::string mname =
+            f.first.empty() ? name : name + SanitizeMetricName(f.first);
+        *out += mname + "{" + labels + "} " + FormatMetricValue(f.second) +
+                "\n";
+    }
+    return "gauge";
+}
+
+std::string Variable::dump_prometheus() {
+    std::string out;
+    for_each_exposed([&out](const std::string& name, const Variable* v) {
+        v->prometheus_text(SanitizeMetricName(name), &out);
+    });
+    return out;
+}
+
+std::string SanitizeMetricName(std::string name) {
+    for (char& c : name) {
+        if (!isalnum((unsigned char)c) && c != '_' && c != ':') c = '_';
+    }
+    if (!name.empty() && isdigit((unsigned char)name[0])) {
+        name.insert(name.begin(), '_');
+    }
+    return name;
+}
+
+bool IsNumericLiteral(const std::string& s) {
+    char* end = nullptr;
+    strtod(s.c_str(), &end);
+    return end != s.c_str() && *end == '\0' && !s.empty();
+}
+
+std::string FormatMetricValue(double v) {
+    // Range-check BEFORE the cast (double->long long outside the
+    // representable range is UB), and map non-finite values to the
+    // prometheus canonical spellings instead of printf's "inf"/"nan".
+    if (!std::isfinite(v)) {
+        return v != v ? "NaN" : (v > 0 ? "+Inf" : "-Inf");
+    }
+    char buf[64];
+    if (v > -9.0e15 && v < 9.0e15 && v == (double)(long long)v) {
+        snprintf(buf, sizeof(buf), "%lld", (long long)v);
+    } else {
+        snprintf(buf, sizeof(buf), "%.17g", v);
+    }
+    return buf;
 }
 
 }  // namespace tpurpc

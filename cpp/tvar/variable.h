@@ -6,8 +6,10 @@
 // prometheus exporter render it.
 #pragma once
 
+#include <functional>
 #include <mutex>
 #include <string>
+#include <utility>
 #include <vector>
 
 namespace tpurpc {
@@ -28,15 +30,55 @@ public:
     // Render current value as text (the /vars format).
     virtual std::string get_description() const = 0;
 
+    // Numeric sub-values of this variable, as (suffix, value) pairs —
+    // the time-series sampler and the default prometheus exposition both
+    // consume this. Default: {("", v)} when get_description() is a plain
+    // number, empty otherwise. Composite variables (LatencyRecorder)
+    // override to yield one entry per field ({"_qps", ...}, ...).
+    virtual std::vector<std::pair<std::string, double>> numeric_fields()
+        const;
+
+    // Prometheus text exposition of this variable under (sanitized)
+    // `name`, appended to *out — TYPE line(s) included. Default: one
+    // gauge per numeric field. LatencyRecorder overrides to emit a real
+    // summary family; MultiDimension emits one sample per label tuple.
+    virtual void prometheus_text(const std::string& name,
+                                 std::string* out) const;
+
+    // One labelled series of family `name`: append sample lines only (no
+    // TYPE line), merging `labels` (`k="v",...`) into each sample's label
+    // set; returns the family type for the caller's single TYPE line.
+    // Default: one gauge sample per numeric field. Used by MultiDimension
+    // so a labelled LatencyRecorder stays a well-formed summary.
+    virtual const char* prometheus_labelled_samples(const std::string& name,
+                                                    const std::string& labels,
+                                                    std::string* out) const;
+
     // Registry queries.
     static std::vector<std::string> list_exposed();
     // Returns false if no such variable.
     static bool describe_exposed(const std::string& name, std::string* out);
     // name -> description for every exposed variable.
     static std::vector<std::pair<std::string, std::string>> dump_exposed();
+    // Visit every exposed variable under the registry lock (callbacks
+    // must not re-enter the registry).
+    static void for_each_exposed(
+        const std::function<void(const std::string&, const Variable*)>& fn);
+    // The whole registry in prometheus text exposition format — the ONE
+    // sanitize + render path behind /metrics.
+    static std::string dump_prometheus();
 
 private:
     std::string name_;
 };
+
+// Central metric-name sanitization: prometheus names must match
+// [a-zA-Z_:][a-zA-Z0-9_:]* — every exporter path goes through here.
+std::string SanitizeMetricName(std::string name);
+// True when `s` parses fully as a number.
+bool IsNumericLiteral(const std::string& s);
+// Render a sample value: integral doubles print without an exponent
+// (counters stay "1000000", not "1e+06"), the rest as %.17g.
+std::string FormatMetricValue(double v);
 
 }  // namespace tpurpc

@@ -1,0 +1,152 @@
+"""Prometheus exposition lint (ISSUE 4 satellite): boots one server,
+scrapes /metrics, and checks the text-format contract in pure Python
+(promtool-style):
+
+  * metric names match [a-zA-Z_:][a-zA-Z0-9_:]*;
+  * every sample's family has a preceding # TYPE line (with _sum/_count
+    resolving to their summary stem), and no family declares TYPE twice;
+  * summaries are well-formed: quantile-labelled samples plus _sum and
+    _count, quantile values non-decreasing within a label set.
+
+Also asserts the /vars?series= ring endpoint returns the fixed 60-point
+per-second shape (the fake-clock rollover proof lives in the C++ suite).
+"""
+import json
+import re
+import time
+
+from test_chaos_soak import Node, _free_ports, _http_get
+
+NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{([^}]*)\})?\s+"
+    r"(-?[0-9.eE+-]+|NaN|[+-]Inf)$")
+LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+def _lint_exposition(text):
+    """Returns (families, errors): families maps name -> type."""
+    families = {}
+    errors = []
+    samples = []
+    for i, line in enumerate(text.splitlines(), 1):
+        if not line.strip():
+            continue
+        if line.startswith("#"):
+            parts = line.split()
+            if len(parts) >= 2 and parts[1] == "TYPE":
+                if len(parts) != 4:
+                    errors.append("line %d: malformed TYPE: %r" % (i, line))
+                    continue
+                name, mtype = parts[2], parts[3]
+                if not NAME_RE.match(name):
+                    errors.append("line %d: bad family name %r" % (i, name))
+                if mtype not in ("gauge", "counter", "summary",
+                                 "histogram", "untyped"):
+                    errors.append("line %d: bad type %r" % (i, mtype))
+                if name in families:
+                    errors.append("line %d: duplicate TYPE for %r"
+                                  % (i, name))
+                families[name] = mtype
+            continue
+        m = SAMPLE_RE.match(line)
+        if not m:
+            errors.append("line %d: malformed sample: %r" % (i, line))
+            continue
+        name, labels = m.group(1), m.group(3) or ""
+        if not NAME_RE.match(name):
+            errors.append("line %d: bad metric name %r" % (i, name))
+        # TYPE must precede the sample, resolving summary suffixes.
+        family = name
+        if family not in families:
+            for suffix in ("_sum", "_count"):
+                stem = name[: -len(suffix)] if name.endswith(suffix) else None
+                if stem and families.get(stem) == "summary":
+                    family = stem
+                    break
+        if family not in families:
+            errors.append("line %d: sample %r has no preceding TYPE"
+                          % (i, name))
+        samples.append((name, dict(LABEL_RE.findall(labels)),
+                        m.group(4), i))
+    # Summary shape: quantiles non-decreasing per label set, _sum/_count
+    # present.
+    for fam, mtype in families.items():
+        if mtype != "summary":
+            continue
+        groups = {}
+        has_sum = has_count = False
+        for name, labels, value, i in samples:
+            if name == fam + "_sum":
+                has_sum = True
+            if name == fam + "_count":
+                has_count = True
+            if name == fam and "quantile" in labels:
+                key = tuple(sorted(
+                    (k, v) for k, v in labels.items() if k != "quantile"))
+                groups.setdefault(key, []).append(
+                    (float(labels["quantile"]), float(value), i))
+        if not has_sum or not has_count:
+            errors.append("summary %r missing _sum/_count" % fam)
+        if not groups:
+            errors.append("summary %r has no quantile samples" % fam)
+        for key, qs in groups.items():
+            qs.sort()
+            vals = [v for _, v, _ in qs]
+            if any(b < a for a, b in zip(vals, vals[1:])):
+                errors.append("summary %r quantiles not monotone: %r"
+                              % (fam, qs))
+    return families, errors
+
+
+def test_metrics_exposition_lint(cpp_build, tmp_path):
+    binary = cpp_build / "mesh_node"
+    assert binary.exists(), "mesh_node not built"
+    (port,) = _free_ports(1)
+    peers_file = tmp_path / "peers"
+    peers_file.write_text("127.0.0.1:%d\n" % port)
+    node = Node(binary, port, 0, peers_file)
+    try:
+        assert node.wait_ready(), "node never became ready"
+        # Let traffic + the 1Hz series sampler produce real data.
+        time.sleep(2.5)
+
+        text = _http_get(port, "/metrics")
+        families, errors = _lint_exposition(text)
+        assert not errors, "exposition lint failed:\n" + "\n".join(errors)
+        # The method LatencyRecorder must export a REAL summary family
+        # now, not flat _field gauges parsed out of JSON.
+        assert families.get("benchpb_EchoService_Echo") == "summary", \
+            sorted(families)
+        assert "benchpb_EchoService_Echo_p50" not in families
+        # Flag->var bridge: flags are scrape-able alongside metrics.
+        assert families.get("flag_enable_rpcz") == "gauge", sorted(families)
+        assert re.search(r"^flag_enable_rpcz [01]$", text, re.M), text[:500]
+
+        # /vars?series= returns the fixed 60/60/24-point ring shape.
+        # Poll: on a loaded host the 1Hz sampler may lag a little before
+        # the ring tail shows a non-zero uptime.
+        deadline = time.time() + 20.0
+        while True:
+            ring = json.loads(
+                _http_get(port, "/vars?series=process_uptime_seconds"))
+            if ring["ticks"] >= 2 and ring["second"][-1] >= 1:
+                break
+            assert time.time() < deadline, ring
+            time.sleep(0.5)
+        assert len(ring["second"]) == 60, ring
+        assert len(ring["minute"]) == 60
+        assert len(ring["hour"]) == 24
+        # Unknown series 404s with guidance instead of a silent empty.
+        try:
+            _http_get(port, "/vars?series=no_such_series_name")
+            assert False, "expected 404"
+        except Exception:
+            pass
+
+        assert node.shutdown() == 0, "unclean exit"
+    finally:
+        try:
+            node.proc.kill()
+        except OSError:
+            pass

@@ -1,0 +1,102 @@
+"""Cross-host rpcz trace stitching (ISSUE 4 tentpole acceptance).
+
+Three mesh_node processes with rpcz enabled. A client request fans
+through 3 hops across the 3 processes (node0 client -> node1 server,
+whose handler calls -> node2), all under ONE trace id; /rpcz/trace/<id>
+on node0 must return a single stitched timeline containing every hop's
+spans with correct parentage. A second chain under a deliberately
+starved deadline (handler delay > budget) must show the shed hop's
+annotation in the stitched view.
+"""
+import time
+
+from test_chaos_soak import NODE_FLAGS, Node, _free_ports, _http_get
+
+
+def _read_chain(node, timeout=20.0):
+    """Next 'CHAIN trace=<id> err=<code>' line -> (trace, err)."""
+    deadline = time.time() + timeout
+    while True:
+        line = node._readline(deadline)
+        assert line is not None, "no CHAIN line from node %d" % node.idx
+        if line.startswith("CHAIN "):
+            fields = dict(kv.split("=") for kv in line.split()[1:])
+            return int(fields["trace"]), int(fields["err"])
+
+
+def test_stitched_trace_across_three_processes(cpp_build, tmp_path):
+    binary = cpp_build / "mesh_node"
+    assert binary.exists(), "mesh_node not built"
+    num = 3
+    ports = _free_ports(num)
+    eps = ["127.0.0.1:%d" % p for p in ports]
+    peers_file = tmp_path / "mesh_members"
+    peers_file.write_text("".join(e + "\n" for e in eps))
+
+    flags = NODE_FLAGS + [
+        "enable_rpcz=true",
+        # Full membership: the stitcher must reach nodes this process
+        # never called itself (node0 has no connection to node2).
+        "rpcz_peers=%s" % ",".join(eps),
+    ]
+    nodes = [Node(binary, ports[i], i, peers_file, flags=flags)
+             for i in range(num)]
+    try:
+        for n in nodes:
+            assert n.wait_ready(), "node %d never became ready" % n.idx
+        time.sleep(1.0)  # background traffic warms connections
+
+        # --- happy chain: 0 -> 1 -> 2 under one trace -----------------
+        nodes[0].send("chain 3000 %s %s" % (eps[1], eps[2]))
+        trace, err = _read_chain(nodes[0])
+        assert err == 0, "chain failed with %d" % err
+        assert trace != 0, "root call was not sampled (enable_rpcz?)"
+        time.sleep(0.5)  # spans flow through the collector (50ms cadence)
+
+        stitched = _http_get(ports[0], "/rpcz/trace/%d" % trace, timeout=15)
+        # Every hop's host appears: client span on node0, server+client
+        # on node1, server on node2.
+        for e in eps:
+            assert "@" + e in stitched, (e, stitched)
+        assert stitched.count("SERVER") >= 2, stitched
+        assert stitched.count("CLIENT") >= 2, stitched
+        # Correct parentage: three nested children under the root span
+        # (server@1 under client@0, client@1 under server@1, server@2
+        # under client@1) — each child line carries the tree marker.
+        assert stitched.count("\\_ ") >= 3, stitched
+        # The deepest hop's span (server on node2) is a child, reached
+        # only through stitching (node0 never talked to node2).
+        assert ("SERVER benchpb.EchoService.Echo @" + eps[2]) in stitched, \
+            stitched
+        # Per-hop breakdown rendered for server spans.
+        assert "queue=" in stitched and "process=" in stitched, stitched
+
+        # --- starved chain: node1 sleeps past the budget --------------
+        nodes[1].send("delay 60 0")
+        deadline = time.time() + 10.0
+        while True:
+            line = nodes[1]._readline(deadline)
+            assert line is not None, "no DELAY_OK from node 1"
+            if line.startswith("DELAY_OK"):
+                break
+        nodes[0].send("chain 40 %s %s" % (eps[1], eps[2]))
+        trace2, err2 = _read_chain(nodes[0])
+        assert err2 != 0, "40ms budget should not survive a 60ms hop"
+        assert trace2 != 0
+        time.sleep(0.7)  # node1's handler finishes + collector dispatch
+
+        stitched2 = _http_get(ports[0], "/rpcz/trace/%d" % trace2,
+                              timeout=15)
+        # The deliberately starved hop shows its annotation in the
+        # stitched timeline (shed downstream / expired budget verdict).
+        assert "failed:" in stitched2, stitched2
+        nodes[1].send("delay 0 0")
+
+        for n in nodes:
+            assert n.shutdown() == 0, "node %d unclean exit" % n.idx
+    finally:
+        for n in nodes:
+            try:
+                n.proc.kill()
+            except OSError:
+                pass

@@ -80,6 +80,42 @@ public:
         if (delay_ms > 0) {
             fiber_usleep((int64_t)delay_ms * 1000);
         }
+        // Chain forwarding (rpcz stitch soak): pop the head endpoint and
+        // call it with the tail FROM INSIDE this handler — the downstream
+        // call inherits the remaining deadline, registers for the cancel
+        // cascade, and continues this request's trace (its client span
+        // parents on this hop's server span).
+        if (request->chain_size() > 0) {
+            EndPoint next;
+            if (str2endpoint(request->chain(0).c_str(), &next) != 0) {
+                cntl->SetFailed(22, "bad chain endpoint %s",
+                                request->chain(0).c_str());
+            } else {
+                Channel ch;
+                ChannelOptions copts;
+                copts.timeout_ms = 2000;  // capped at the inherited budget
+                copts.max_retry = 0;
+                if (ch.Init(next, &copts) != 0) {
+                    cntl->SetFailed(22, "chain channel init failed");
+                } else {
+                    benchpb::EchoService_Stub stub(&ch);
+                    Controller dcntl;
+                    benchpb::EchoRequest dreq;
+                    benchpb::EchoResponse dres;
+                    dreq.set_send_ts_us(monotonic_time_us());
+                    for (int i = 1; i < request->chain_size(); ++i) {
+                        dreq.add_chain(request->chain(i));
+                    }
+                    stub.Echo(&dcntl, &dreq, &dres, nullptr);  // sync
+                    if (dcntl.Failed()) {
+                        cntl->SetFailed(dcntl.ErrorCode(),
+                                        "downstream %s: %s",
+                                        request->chain(0).c_str(),
+                                        dcntl.ErrorText().c_str());
+                    }
+                }
+            }
+        }
         response->set_send_ts_us(request->send_ts_us());
         cntl->response_attachment().append(cntl->request_attachment());
         done->Run();
@@ -310,6 +346,48 @@ void* LinkMaintenanceFiber(void* arg) {
     return nullptr;
 }
 
+// One root call of the stitch soak ("chain T ep1 ep2..." on stdin): Echo
+// to ep1 with chain=[ep2...] under a T-ms deadline, then print the trace
+// id so the driving test can fetch /rpcz/trace/<id>. Runs on a fiber
+// (sync RPC) — the stdin loop stays responsive.
+struct ChainArgs {
+    int64_t timeout_ms = 1000;
+    std::vector<std::string> eps;
+};
+
+void* ChainCallFiber(void* arg) {
+    std::unique_ptr<ChainArgs> a((ChainArgs*)arg);
+    EndPoint first;
+    if (a->eps.empty() || str2endpoint(a->eps[0].c_str(), &first) != 0) {
+        printf("CHAIN trace=0 err=22\n");
+        fflush(stdout);
+        return nullptr;
+    }
+    Channel ch;
+    ChannelOptions copts;
+    copts.timeout_ms = a->timeout_ms;
+    copts.max_retry = 0;
+    if (ch.Init(first, &copts) != 0) {
+        printf("CHAIN trace=0 err=112\n");
+        fflush(stdout);
+        return nullptr;
+    }
+    benchpb::EchoService_Stub stub(&ch);
+    Controller cntl;
+    cntl.set_timeout_ms(a->timeout_ms);
+    benchpb::EchoRequest req;
+    benchpb::EchoResponse res;
+    req.set_send_ts_us(monotonic_time_us());
+    for (size_t i = 1; i < a->eps.size(); ++i) {
+        req.add_chain(a->eps[i]);
+    }
+    stub.Echo(&cntl, &req, &res, nullptr);  // sync: trace id is final
+    printf("CHAIN trace=%llu err=%d\n",
+           (unsigned long long)cntl.trace_id(), cntl.ErrorCode());
+    fflush(stdout);
+    return nullptr;
+}
+
 void PrintReport(int id, int port, const Counters& c) {
     printf(
         "REPORT {\"id\": %d, \"port\": %d, \"lb_issued\": %lld, "
@@ -441,8 +519,9 @@ int main(int argc, char** argv) {
 
     // Control loop: "stop" -> quiesce traffic + report; "delay H S" ->
     // delay-heavy phase (handler sleeps H ms, stale fiber issues S-ms
-    // budget calls; 0 0 = back to normal); EOF -> exit.
-    char cmd[64];
+    // budget calls; 0 0 = back to normal); "chain T ep..." -> one chained
+    // echo under a T-ms deadline (prints CHAIN trace=<id>); EOF -> exit.
+    char cmd[256];
     while (fgets(cmd, sizeof(cmd), stdin) != nullptr) {
         if (strncmp(cmd, "stop", 4) == 0) {
             st.stop.store(true, std::memory_order_relaxed);
@@ -451,6 +530,20 @@ int main(int argc, char** argv) {
             PrintReport(id, port, st.counters);
         } else if (strncmp(cmd, "report", 6) == 0) {
             PrintReport(id, port, st.counters);
+        } else if (strncmp(cmd, "chain", 5) == 0) {
+            auto* a = new ChainArgs;
+            char* save = nullptr;
+            strtok_r(cmd, " \n", &save);  // "chain"
+            char* tok = strtok_r(nullptr, " \n", &save);
+            if (tok != nullptr) a->timeout_ms = atoll(tok);
+            while ((tok = strtok_r(nullptr, " \n", &save)) != nullptr) {
+                if (*tok != '\0') a->eps.push_back(tok);
+            }
+            fiber_t ct;
+            if (fiber_start_background(&ct, nullptr, ChainCallFiber, a) !=
+                0) {
+                ChainCallFiber(a);
+            }
         } else if (strncmp(cmd, "delay", 5) == 0) {
             int h = 0, s_ms = 0;
             if (sscanf(cmd + 5, "%d %d", &h, &s_ms) == 2) {

@@ -5,14 +5,18 @@
 //
 //   rpc_press --server=ip:port [--qps=10000] [--duration_s=10]
 //             [--payload=4096] [--callers=8] [--pooled]
-//             [--timeout_ms=5000]
+//             [--timeout_ms=5000] [--metrics_csv=path]
 //
 // --timeout_ms sets the per-request deadline (propagated to the server
 // as the remaining-budget meta): tiny values drive the server's
 // expired-shed and budget-shed paths from the load tool — watch
 // rpc_server_expired_requests / rpc_server_shed_requests in its /vars.
 //
-// Prints qps achieved + latency percentiles; --json for one JSON line.
+// While running, one stats line per second (interval qps + windowed
+// p50/p99/p999); --metrics_csv=<path> appends the same row per interval
+// as CSV (elapsed_s,qps,p50_us,p99_us,p999_us,failed_total) — the BENCH
+// trajectory input. Prints qps achieved + latency percentiles at the
+// end; --json for one JSON line.
 #include <unistd.h>
 
 #include <atomic>
@@ -83,7 +87,11 @@ int main(int argc, char** argv) {
     long long timeout_ms = 5000;
     bool pooled = false;
     bool json = false;
+    const char* metrics_csv = nullptr;
     for (int i = 1; i < argc; ++i) {
+        if (strncmp(argv[i], "--metrics_csv=", 14) == 0) {
+            metrics_csv = argv[i] + 14;
+        }
         if (strncmp(argv[i], "--server=", 9) == 0) server_str = argv[i] + 9;
         if (strncmp(argv[i], "--qps=", 6) == 0) qps = atoll(argv[i] + 6);
         if (strncmp(argv[i], "--timeout_ms=", 13) == 0) {
@@ -137,12 +145,44 @@ int main(int argc, char** argv) {
         fiber_start_background(&tid, nullptr, PressCaller, &ctx);
     }
 
+    // Per-interval scrape sink (--metrics_csv): one appended row per
+    // second feeds the BENCH trajectory.
+    FILE* csv = nullptr;
+    if (metrics_csv != nullptr) {
+        const bool fresh = access(metrics_csv, F_OK) != 0;
+        csv = fopen(metrics_csv, "a");
+        if (csv != nullptr && fresh) {
+            fprintf(csv, "elapsed_s,qps,p50_us,p99_us,p999_us,failed\n");
+        }
+    }
+
     // Refill by elapsed time (exact pacing for any target, including
     // qps below the 100Hz refill cadence), bucket capped at one second
     // of budget so stalls don't cause unbounded bursts.
     const int64_t t0 = monotonic_time_us();
     const int64_t end = t0 + (int64_t)duration_s * 1000 * 1000;
     int64_t granted = 0;
+    int64_t next_report_us = t0 + 1000 * 1000;
+    int64_t last_sent = 0;
+    const auto report = [&](int64_t now) {
+        const int64_t total_sent = sent.load(std::memory_order_relaxed);
+        const int64_t iqps = total_sent - last_sent;
+        last_sent = total_sent;
+        const long long elapsed_s = (now - t0) / 1000000;
+        const long long p50 = lat.latency_percentile(0.5);
+        const long long p99 = lat.latency_percentile(0.99);
+        const long long p999 = lat.latency_percentile(0.999);
+        const long long nfailed = failed.load(std::memory_order_relaxed);
+        printf("t=%llds qps=%lld p50=%lldus p99=%lldus p999=%lldus "
+               "failed=%lld\n",
+               elapsed_s, (long long)iqps, p50, p99, p999, nfailed);
+        fflush(stdout);
+        if (csv != nullptr) {
+            fprintf(csv, "%lld,%lld,%lld,%lld,%lld,%lld\n", elapsed_s,
+                    (long long)iqps, p50, p99, p999, nfailed);
+            fflush(csv);
+        }
+    };
     while (monotonic_time_us() < end) {
         const int64_t now = monotonic_time_us();
         const int64_t should = (now - t0) * qps / 1000000;
@@ -154,8 +194,16 @@ int main(int argc, char** argv) {
         if (cur > qps) {
             tokens.fetch_sub(cur - qps, std::memory_order_relaxed);
         }
+        if (now >= next_report_us) {
+            next_report_us += 1000 * 1000;
+            report(now);
+        }
         usleep(10 * 1000);
     }
+    // The loop exits AT the deadline, so the last interval would
+    // otherwise never be reported — an N-second run must yield N rows.
+    report(monotonic_time_us());
+    if (csv != nullptr) fclose(csv);
     stop.store(true, std::memory_order_relaxed);
     for (auto tid : tids) fiber_join(tid, nullptr);
     const double secs = (double)(monotonic_time_us() - t0) / 1e6;

@@ -18,6 +18,7 @@ const char* terror(int code) {
         case TERR_CLOSE: return "Connection closed";
         case TERR_INTERNAL: return "Internal error";
         case TERR_AUTH: return "Authentication failed";
+        case TERR_DRAINING: return "Server draining (planned shutdown)";
         default: return strerror(code);
     }
 }

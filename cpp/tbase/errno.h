@@ -18,6 +18,11 @@ enum RpcErrno {
     TERR_CLOSE = 4009,           // connection closed by user
     TERR_INTERNAL = 4010,
     TERR_AUTH = 4011,            // authentication failed
+    // The peer is draining (planned shutdown GOAWAY) and provably did
+    // not process the call: retriable on another connection WITHOUT
+    // consuming retry budget (re-issuing cannot amplify load on a
+    // server that is going away).
+    TERR_DRAINING = 4012,
 };
 
 const char* terror(int code);

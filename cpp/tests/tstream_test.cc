@@ -5,11 +5,15 @@
 // a deterministic fuzz loop over both frame parsers (reference
 // test/fuzzing/ fuzz_* harnesses).
 #include <netinet/in.h>
+#include <poll.h>
+#include <sys/socket.h>
 #include <unistd.h>
 
 #include <atomic>
+#include <memory>
 #include <mutex>
 #include <string>
+#include <thread>
 #include <vector>
 
 #include "echo.pb.h"
@@ -18,6 +22,8 @@
 #include "tbase/time.h"
 #include "tfiber/fiber.h"
 #include "tfiber/fiber_sync.h"
+#include "thttp/http_message.h"
+#include "thttp/progressive_attachment.h"
 #include "tnet/protocol.h"
 #include "trpc/channel.h"
 #include "trpc/controller.h"
@@ -369,4 +375,93 @@ TEST(StreamFuzz, ParsersSurviveMutatedFrames) {
             }
         }
     }
+}
+
+// ---------------- progressive body vs. graceful drain ----------------
+
+TEST(Stream, ProgressiveBodySurvivesGracefulStop) {
+    // Regression (zero-downtime lifecycle): a chunked HTTP body still
+    // being written AFTER its handler returned must count against
+    // Server::Join draining. Before the ProgressiveAttachment close
+    // hook fed Server::EndRequest, GracefulStop saw nprocessing == 0
+    // the moment the handler returned and hard-closed the connection
+    // mid-chunk — the client got a truncated stream instead of the
+    // terminating 0-chunk.
+    std::atomic<bool> writer_closed{false};
+    Server server;
+    server.RegisterHttpHandler(
+        "/prog",
+        [&writer_closed](Server*, const HttpRequest&, HttpResponse* res) {
+            res->set_content_type("text/plain");
+            res->start_progressive =
+                [&writer_closed](std::shared_ptr<ProgressiveAttachment> pa) {
+                    struct Args {
+                        std::shared_ptr<ProgressiveAttachment> pa;
+                        std::atomic<bool>* closed;
+                    };
+                    auto* a = new Args{std::move(pa), &writer_closed};
+                    fiber_t tid;
+                    if (fiber_start_background(
+                            &tid, nullptr,
+                            [](void* raw) -> void* {
+                                std::unique_ptr<Args> a((Args*)raw);
+                                for (int i = 0; i < 3; ++i) {
+                                    fiber_usleep(100 * 1000);
+                                    a->pa->Write("chunk-" +
+                                                 std::to_string(i) + ";");
+                                }
+                                a->pa->Close();
+                                a->closed->store(
+                                    true, std::memory_order_release);
+                                return nullptr;
+                            },
+                            a) != 0) {
+                        delete a;
+                    }
+                };
+        });
+    EndPoint listen;
+    str2endpoint("127.0.0.1:0", &listen);
+    ASSERT_EQ(0, server.Start(listen, nullptr));
+
+    // Raw HTTP/1.1 client reading the chunked stream on a thread.
+    const int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+    ASSERT_GE(fd, 0);
+    sockaddr_in addr;
+    EndPoint ep;
+    str2endpoint("127.0.0.1", server.listened_port(), &ep);
+    endpoint2sockaddr(ep, &addr);
+    ASSERT_EQ(0, ::connect(fd, (sockaddr*)&addr, sizeof(addr)));
+    const std::string get = "GET /prog HTTP/1.1\r\nHost: t\r\n\r\n";
+    ASSERT_EQ((ssize_t)get.size(),
+              ::send(fd, get.data(), get.size(), MSG_NOSIGNAL));
+    std::string received;
+    std::mutex received_mu;
+    std::thread reader([fd, &received, &received_mu] {
+        const int64_t deadline = monotonic_time_us() + 4 * 1000 * 1000;
+        char buf[4096];
+        while (monotonic_time_us() < deadline) {
+            struct pollfd p {
+                fd, POLLIN, 0
+            };
+            if (::poll(&p, 1, 50) != 1) continue;
+            const ssize_t n = ::recv(fd, buf, sizeof(buf), 0);
+            if (n <= 0) break;
+            std::lock_guard<std::mutex> g(received_mu);
+            received.append(buf, (size_t)n);
+            if (received.find("0\r\n\r\n") != std::string::npos) break;
+        }
+    });
+
+    usleep(80 * 1000);  // headers are out; the writer fiber is mid-stream
+    server.GracefulStop(3000);
+    // The drain waited for the progressive writer to Close.
+    EXPECT_TRUE(writer_closed.load(std::memory_order_acquire));
+    reader.join();
+    close(fd);
+    std::lock_guard<std::mutex> g(received_mu);
+    // Full body delivered: every chunk AND the terminating 0-chunk.
+    EXPECT_NE(received.find("chunk-0;"), std::string::npos) << received;
+    EXPECT_NE(received.find("chunk-2;"), std::string::npos) << received;
+    EXPECT_NE(received.find("0\r\n\r\n"), std::string::npos) << received;
 }

@@ -238,7 +238,10 @@ void HandleRpczTrace(Server*, const HttpRequest& req, HttpResponse* res) {
 void HandleStatus(Server* server, const HttpRequest&, HttpResponse* res) {
     res->set_content_type("text/plain");
     char line[512];
-    snprintf(line, sizeof(line), "nprocessing: %lld\n\n",
+    // Lifecycle state first: "draining: 1" means a graceful shutdown or
+    // rebalance announced GOAWAYs and clients are steering away.
+    snprintf(line, sizeof(line), "draining: %d\nnprocessing: %lld\n\n",
+             server->draining() ? 1 : 0,
              (long long)server->nprocessing.load());
     res->Append(line);
     for (const auto& kv : server->methods()) {

@@ -410,21 +410,76 @@ void ProcessH2ClientFrame(InputMessageBase* raw) {
                 cid = it->second.cid;
                 sess->streams.erase(it);
             }
+            // REFUSED_STREAM (RFC 9113 §8.7) guarantees the server did
+            // no processing: retriable on another connection without
+            // spending retry budget (a draining server refuses streams
+            // that raced its GOAWAY). Every other code means unknown
+            // progress — plain TERR_RESPONSE, budget applies.
+            uint32_t rst_code = 0;
+            if (msg->payload.size() >= 4) {
+                msg->payload.copy_to(&rst_code, 4);
+                rst_code = ntohl(rst_code);
+            }
             // id_error (queues under a held lock): the id may be locked
             // by its sender parked mid-send on flow control; blocking
             // this in-order input fiber on it would stall the whole
             // connection's frame processing.
-            id_error(cid, TERR_RESPONSE);
+            id_error(cid, rst_code == 0x7 ? TERR_DRAINING : TERR_RESPONSE);
             break;
         }
-        case H2_GOAWAY:
-            FailAllStreams(sess, TERR_FAILED_SOCKET);
-            // Fail the connection too: new RPCs must not open streams on
-            // a draining peer (they'd hang until their deadline — the
-            // server ignores ids above last_stream_id). The channel
-            // re-creates its pinned connection on the next call.
-            s->SetFailedWithError(TERR_FAILED_SOCKET);
+        case H2_GOAWAY: {
+            // Planned drain, not death — but ONLY for NO_ERROR. An error
+            // GOAWAY (ENHANCE_YOUR_CALM, PROTOCOL_ERROR, ...) is the
+            // server rejecting us: treat it like connection death so the
+            // retries it causes DO consume budget (a shedding server
+            // must not receive a budget-free re-issue storm).
+            uint32_t last_id = 0;
+            uint32_t error_code = 0;
+            if (msg->payload.size() >= 8) {
+                uint32_t words[2];
+                msg->payload.copy_to(words, 8);
+                last_id = ntohl(words[0]) & 0x7fffffffu;
+                error_code = ntohl(words[1]);
+            } else if (msg->payload.size() >= 4) {
+                msg->payload.copy_to(&last_id, 4);
+                last_id = ntohl(last_id) & 0x7fffffffu;
+            }
+            if (error_code != 0) {
+                FailAllStreams(sess, TERR_FAILED_SOCKET);
+                s->SetFailedWithError(TERR_FAILED_SOCKET);
+                break;
+            }
+            // NO_ERROR: the server promises to answer every stream at or
+            // below last-stream-id — those stay pending and complete
+            // normally. Streams above it were provably NOT processed:
+            // fail them as TERR_DRAINING, which is retriable on another
+            // connection WITHOUT consuming retry budget (re-issuing
+            // cannot load a server that is leaving). The socket is
+            // marked draining (not failed) so the channel re-creates its
+            // pinned connection for new calls while the old one
+            // finishes; the server's eventual close fails whatever is
+            // left through DeleteClientSession.
+            std::vector<uint64_t> unprocessed;
+            {
+                std::lock_guard<std::mutex> g(sess->mu);
+                for (auto it = sess->streams.begin();
+                     it != sess->streams.end();) {
+                    if (it->first > last_id) {
+                        unprocessed.push_back(it->second.cid);
+                        it = sess->streams.erase(it);
+                    } else {
+                        ++it;
+                    }
+                }
+            }
+            s->SetDraining();
+            // id_error queues under a held id lock (same discipline as
+            // RST_STREAM above): never block this in-order input fiber.
+            for (uint64_t cid : unprocessed) {
+                id_error(cid, TERR_DRAINING);
+            }
             break;
+        }
         default:
             break;
     }

@@ -76,6 +76,12 @@ struct H2Session {
     void* window_butex = butex_create();
     bool goaway = false;
     uint32_t max_stream_id = 0;  // highest client stream ever opened
+    // Set (under mu) when WE sent a GOAWAY while draining: streams above
+    // goaway_last are never dispatched — the client provably gets no
+    // response for them and fails them as retriable-elsewhere, so
+    // executing them here would double-run the method.
+    bool goaway_sent = false;
+    uint32_t goaway_last = 0;
     uint32_t cont_stream = 0;  // nonzero: CONTINUATION expected
     uint8_t cont_flags = 0;
     std::string header_block;
@@ -627,7 +633,22 @@ void HandleHeaderBlockDone(Socket* s, H2Session* sess, uint32_t stream_id,
     IOBuf body;
     bool refuse = false;
     {
-        std::lock_guard<std::mutex> g(sess->mu);
+        std::unique_lock<std::mutex> g(sess->mu);
+        if (sess->goaway_sent && stream_id > sess->goaway_last) {
+            // Draining: this stream raced our GOAWAY. A peer whose write
+            // beat its read of the announcement is NOT covered by its
+            // own "fail ids above last-stream-id" rule (it processed the
+            // GOAWAY before opening this stream id) — an explicit
+            // REFUSED_STREAM tells it promptly that the stream was
+            // provably not processed, instead of letting the call burn
+            // its whole deadline on a drain-only (SIGUSR2) server that
+            // never closes the connection.
+            g.unlock();
+            uint32_t code = htonl(0x7);  // REFUSED_STREAM
+            SendRaw(s, BuildFrame(H2_RST_STREAM, 0, stream_id,
+                                  std::string((const char*)&code, 4)));
+            return;
+        }
         auto it = sess->streams.find(stream_id);
         if (it != sess->streams.end() && it->second.dispatched) {
             // Duplicate HEADERS / request trailers after END_STREAM:
@@ -887,6 +908,27 @@ void ProcessH2(InputMessageBase* raw) {
 int g_h2_index = -1;
 
 }  // namespace
+
+int H2ServerSendGoaway(Socket* s) {
+    H2Session* sess = session_of(s);
+    if (sess == nullptr) return -1;  // no h2 session on this connection
+    uint32_t last;
+    {
+        // last-stream-id and the dispatch gate flip under ONE mu hold:
+        // every stream dispatched before this point has id <= last (and
+        // will be answered); every later one is dropped by the gate in
+        // HandleHeaderBlockDone — so the client's "fail ids above last"
+        // rule never races a stream we actually executed.
+        std::lock_guard<std::mutex> g(sess->mu);
+        last = sess->max_stream_id;
+        sess->goaway_sent = true;
+        sess->goaway_last = last;
+    }
+    uint32_t payload[2] = {htonl(last), htonl(0)};  // NO_ERROR
+    SendRaw(s, BuildFrame(H2_GOAWAY, 0, 0,
+                          std::string((const char*)payload, 8)));
+    return 0;
+}
 
 void RegisterHttp2Protocol() {
     if (g_h2_index >= 0) return;

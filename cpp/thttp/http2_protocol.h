@@ -18,4 +18,13 @@ namespace tpurpc {
 void RegisterHttp2Protocol();  // idempotent
 int Http2ProtocolIndex();
 
+// Graceful drain: send a real GOAWAY (NO_ERROR) on this server-side h2
+// connection with last-stream-id = the highest stream ever opened by the
+// peer. Streams at or below the advertised id are still served to
+// completion; later streams are ignored (the client fails them as
+// retriable-on-another-connection without consuming retry budget).
+// Returns 0 when the frame was queued, -1 when the socket carries no h2
+// session. Called by Server::StartDraining.
+int H2ServerSendGoaway(class Socket* s);
+
 }  // namespace tpurpc

@@ -166,6 +166,11 @@ void ProcessHttp(InputMessageBase* msg_base) {
     if (!s) return;
     HttpResponse res;
     const bool close_conn = [&] {
+        // Draining server (graceful shutdown): HTTP/1 has no unsolicited
+        // server frame, so the drain announcement rides the next
+        // response as `Connection: close` — the client re-connects
+        // elsewhere (or gets refused once the listener stops).
+        if (msg->server != nullptr && msg->server->draining()) return true;
         const std::string* conn = msg->req.FindHeader("Connection");
         if (conn != nullptr) {
             return conn->find("close") != std::string::npos;
@@ -210,8 +215,23 @@ void ProcessHttp(InputMessageBase* msg_base) {
         SerializeHttpResponse(&res, &out);
         s->Write(&out);
         auto pa = std::make_shared<ProgressiveAttachment>(s->id());
+        // The chunked body outlives this handler: count it as in-flight
+        // work so Server::Join / GracefulStop drain waits for Close()
+        // instead of truncating the stream mid-chunk (Stop fails the
+        // connection, dropping queued chunks).
+        if (msg->server != nullptr) {
+            msg->server->BeginRequest();
+            pa->set_on_close(
+                [](void* arg) { ((Server*)arg)->EndRequest(); },
+                msg->server);
+        }
+        // The headers just sent advertised Connection: close (draining
+        // server or client request): the stream's Close() must actually
+        // close, or a read-until-EOF client blocks on the open socket.
+        if (close_conn) pa->set_close_connection_on_close();
         res.start_progressive(std::move(pa));
-        return;  // keep-alive continues after the terminating chunk
+        return;  // without close_conn, keep-alive continues after the
+                 // terminating chunk
     }
     // HEAD: headers (incl. the Content-Length the body WOULD have), no
     // body bytes (RFC 9110 §9.3.2 — sending them desyncs keep-alive).

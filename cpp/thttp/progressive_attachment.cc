@@ -2,6 +2,9 @@
 
 #include <cstdio>
 
+#include "tbase/errno.h"
+#include "tfiber/fiber.h"
+
 namespace tpurpc {
 
 int ProgressiveAttachment::Write(const IOBuf& data) {
@@ -25,11 +28,35 @@ void ProgressiveAttachment::Close() {
                                          std::memory_order_acq_rel)) {
         return;
     }
-    SocketUniquePtr s;
-    if (Socket::AddressSocket(sid_, &s) != 0) return;
-    IOBuf last;
-    last.append("0\r\n\r\n", 5);
-    s->Write(&last);
+    {
+        SocketUniquePtr s;
+        if (Socket::AddressSocket(sid_, &s) == 0) {
+            IOBuf last;
+            last.append("0\r\n\r\n", 5);
+            s->Write(&last);
+            if (close_conn_) {
+                // The header block promised Connection: close — honor it
+                // (mirrors the plain-response path in http_protocol.cc):
+                // bounded wait for the queued chunks to reach the wire,
+                // then fail the socket, which closes the fd.
+                for (int i = 0; i < 200 && s->unwritten_bytes() > 0 &&
+                                !s->Failed();
+                     ++i) {
+                    fiber_usleep(1000);
+                }
+                s->SetFailedWithError(TERR_EOF);
+            }
+        }
+    }
+    // Exactly once, AFTER the terminating chunk is queued: the stream no
+    // longer holds the server's in-flight count (Join may return and the
+    // Server may be torn down right after — last touch discipline).
+    if (on_close_ != nullptr) {
+        auto cb = on_close_;
+        void* arg = on_close_arg_;
+        on_close_ = nullptr;
+        cb(arg);
+    }
 }
 
 }  // namespace tpurpc

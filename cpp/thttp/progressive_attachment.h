@@ -37,14 +37,36 @@ public:
         return Write(buf);
     }
 
-    // Terminating 0-chunk; idempotent. The connection stays keep-alive.
+    // Terminating 0-chunk; idempotent. The connection stays keep-alive
+    // unless set_close_connection_on_close was requested.
     void Close();
 
+    // The response that started this stream advertised Connection:
+    // close (e.g. the server is draining): after the terminating chunk
+    // is flushed, Close() fails the socket so read-until-EOF clients
+    // see the promised EOF instead of blocking on a keep-alive that
+    // will never speak again. Set before the handler's callback runs.
+    void set_close_connection_on_close() { close_conn_ = true; }
+
     SocketId socket_id() const { return sid_; }
+
+    // Lifecycle accounting hook, fired exactly once from the closing
+    // Close() (the destructor closes too). The HTTP layer registers
+    // Server::EndRequest here so a chunked body still streaming AFTER
+    // its handler returned counts against Server::Join / GracefulStop
+    // draining — without it, a graceful restart would truncate the
+    // stream mid-chunk. Set before the handler's callback runs.
+    void set_on_close(void (*cb)(void*), void* arg) {
+        on_close_ = cb;
+        on_close_arg_ = arg;
+    }
 
 private:
     SocketId sid_;
     std::atomic<bool> closed_{false};
+    bool close_conn_ = false;
+    void (*on_close_)(void*) = nullptr;
+    void* on_close_arg_ = nullptr;
 };
 
 using ProgressiveAttachmentPtr = std::shared_ptr<ProgressiveAttachment>;

@@ -39,6 +39,7 @@ int Acceptor::StartAccept(const EndPoint& ep) {
     opts.user = this;
     opts.on_recycle = &Acceptor::ListenRecycled;
     opts.recycle_arg = this;
+    paused_.store(false, std::memory_order_release);  // restart path
     listen_live_.store(true, std::memory_order_release);
     if (Socket::Create(opts, &listen_id_) != 0) {
         // Socket::Create owns (and closed) listen_fd on failure; the
@@ -141,9 +142,23 @@ std::vector<SocketId> Acceptor::connections() {
     return std::vector<SocketId>(conn_ids_.begin(), conn_ids_.end());
 }
 
+void Acceptor::ResumeAccept() {
+    paused_.store(false, std::memory_order_release);
+    // Re-kick the accept loop: connections that completed their TCP
+    // handshake in the backlog while paused produced no NEW edge event.
+    if (listen_id_ != INVALID_VREF_ID) {
+        Socket::OnInputEventById(listen_id_);
+    }
+}
+
 void Acceptor::OnNewConnections(Socket* listen_socket) {
     Acceptor* a = (Acceptor*)listen_socket->user();
     while (!listen_socket->Failed()) {
+        if (a->paused_.load(std::memory_order_acquire)) {
+            // Drain gate: leave the backlog in the kernel. ResumeAccept
+            // re-kicks this loop.
+            return;
+        }
         sockaddr_in peer;
         socklen_t plen = sizeof(peer);
         const int fd = accept4(listen_socket->fd(), (sockaddr*)&peer, &plen,

@@ -38,6 +38,19 @@ public:
     void StopAccept();
     int listened_port() const { return listened_port_; }
 
+    // Graceful-drain accept gate: stop ACCEPTING without closing the
+    // listening fd — the port stays bound (no thundering re-bind race on
+    // restart) and TCP handshakes still land in the kernel backlog, so a
+    // connect-probe health check keeps passing while the process drains.
+    // Resume kicks the accept loop once so backlogged connections queued
+    // while paused are picked up (edge-triggered epoll would otherwise
+    // strand them until the NEXT connection arrives).
+    void PauseAccept() { paused_.store(true, std::memory_order_release); }
+    void ResumeAccept();
+    bool accept_paused() const {
+        return paused_.load(std::memory_order_acquire);
+    }
+
     // # connections accepted (metrics / tests).
     int64_t accepted_count() const {
         return accepted_.load(std::memory_order_relaxed);
@@ -64,6 +77,7 @@ private:
     // the recycle callback; listen_live_ covers the listen socket itself.
     std::atomic<int64_t> live_conns_{0};
     std::atomic<bool> listen_live_{false};
+    std::atomic<bool> paused_{false};
     bool tls_ = false;
     void* quiesce_butex_ = nullptr;
 };

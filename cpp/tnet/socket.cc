@@ -104,6 +104,7 @@ int Socket::Create(const SocketOptions& options, SocketId* id) {
     s->tls_alpn_ = options.tls_alpn;
     s->tls_sni_ = options.tls_sni;
     s->hc_stop_.store(false, std::memory_order_relaxed);
+    s->draining_.store(false, std::memory_order_relaxed);
     s->circuit_breaker_.ResetAll();
     // Install before any failure path below: AddConsumer failure recycles
     // the socket, which must still deliver the notification.
@@ -297,6 +298,9 @@ int Socket::ReviveAfterHealthCheck() {
     circuit_breaker_.Reset();  // fresh windows for the revived server
     auth_state_.store(0, std::memory_order_relaxed);  // re-authenticate
     auth_user_.clear();
+    // The drain announcement belonged to the previous (now restarted)
+    // process: the revived server serves anew, so LBs must pick it again.
+    draining_.store(false, std::memory_order_relaxed);
     const int rc = Revive();
     if (rc == 0) {
         *g_hc_revives << 1;

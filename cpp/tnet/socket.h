@@ -128,6 +128,18 @@ public:
     // the client stack after each call, isolation = SetFailed + revive.
     CircuitBreaker& circuit_breaker() { return circuit_breaker_; }
 
+    // ---- draining (zero-downtime lifecycle) ----
+    // The peer announced a planned shutdown (tpu_std GOAWAY meta / h2
+    // GOAWAY): the connection stays LIVE — in-flight calls complete
+    // normally — but new calls must steer away (load balancers skip
+    // draining nodes; pinned channels re-create their connection).
+    // Cleared on slot reuse (Create) and on health-check revive: the
+    // restarted process serves anew.
+    void SetDraining() { draining_.store(true, std::memory_order_release); }
+    bool Draining() const {
+        return draining_.load(std::memory_order_acquire);
+    }
+
     // Plugged data-plane transport (ICI), or null for the fd path.
     TransportEndpoint* transport() const { return transport_; }
     // Upgrade a live connection to a transport data plane (server side of
@@ -344,6 +356,7 @@ private:
     std::string tls_alpn_;
     std::string tls_sni_;
     std::atomic<bool> hc_stop_{false};
+    std::atomic<bool> draining_{false};
     CircuitBreaker circuit_breaker_;
     void (*on_recycle_)(void*, SocketId) = nullptr;
     void* recycle_arg_ = nullptr;

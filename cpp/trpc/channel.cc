@@ -98,7 +98,13 @@ SocketId Channel::AcquirePinnedSocket() {
     if (sid == INVALID_VREF_ID) return sid;
     {
         SocketUniquePtr probe;
-        if (Socket::AddressSocket(sid, &probe) == 0) return sid;  // live
+        if (Socket::AddressSocket(sid, &probe) == 0) {
+            // A DRAINING pin (peer sent GOAWAY) is replaced like a dead
+            // one — but only for channel-owned pins: the old connection
+            // stays alive so its in-flight streams complete; it dies
+            // when the drained server closes it.
+            if (!owns_pinned_ || !probe->Draining()) return sid;  // live
+        }
     }
     if (!owns_pinned_) return sid;  // caller's socket: its death is final
     std::lock_guard<std::mutex> g(pin_mu_);

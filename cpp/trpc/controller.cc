@@ -40,6 +40,11 @@ namespace tpurpc {
 static LazyAdder g_client_retries("rpc_client_retries");
 static LazyAdder g_client_backups("rpc_client_backup_requests");
 static LazyAdder g_budget_exhausted("rpc_retry_budget_exhausted");
+// Drain steering: new calls routed around a draining server (LB skip),
+// and re-issues of calls a draining server provably never processed.
+// Both are budget-free — the rolling-restart soak asserts zero retry
+// tokens spent across a full mesh restart.
+static LazyAdder g_drain_reroutes("rpc_client_drain_reroutes");
 
 Controller::~Controller() {
     RunCancelClosure();  // contract: an unfired closure still runs once
@@ -280,6 +285,7 @@ static bool is_retryable(int error) {
         case ECONNRESET:
         case EPIPE:
         case EHOSTDOWN:  // LB found only failed servers; retry re-selects
+        case TERR_DRAINING:  // peer draining, call provably unprocessed
             return true;
         default:
             return false;
@@ -352,11 +358,22 @@ int Controller::HandleError(CallId id, int error) {
     SetFailed(error, "%s", terror(error));
     if (rp->DoRetry(this) && current_try_ < effective_max_retry &&
         (deadline_us_ == 0 || monotonic_time_us() < deadline_us_)) {
+        // Draining peers are a special retry class: the server announced
+        // a planned shutdown and provably never processed this try, so
+        // re-issuing elsewhere cannot amplify load — it spends NO budget
+        // token (the zero-downtime contract: a rolling restart costs no
+        // retry budget and trips no breaker).
+        const bool budget_free = (error == TERR_DRAINING);
+        if (budget_free && span_ != nullptr) {
+            span_->Annotate("server draining, re-routed");
+        }
+        if (budget_free) *g_drain_reroutes << 1;
         // Retry throttling (gRPC-style retry budget, channel.h): under a
         // correlated failure every caller retrying independently is the
         // retry storm that amplifies overload — once the per-channel
         // bucket is dry, fail now with the try's own error instead.
-        if (channel_ != nullptr && !channel_->retry_budget().Withdraw()) {
+        if (!budget_free && channel_ != nullptr &&
+            !channel_->retry_budget().Withdraw()) {
             *g_budget_exhausted << 1;
             if (span_ != nullptr) {
                 span_->Annotate(
@@ -364,7 +381,8 @@ int Controller::HandleError(CallId id, int error) {
             }
         } else {
             const CallId next = id_next_version(current_cid_);
-            if (next == INVALID_CALL_ID && channel_ != nullptr) {
+            if (next == INVALID_CALL_ID && !budget_free &&
+                channel_ != nullptr) {
                 // The re-issue never went out: the token goes back.
                 channel_->retry_budget().Refund();
             }
@@ -455,6 +473,14 @@ void Controller::IssueRPC() {
         if (rc != 0) {
             id_error(current_cid_, rc);
             return;
+        }
+        if (out.skipped_draining) {
+            // A draining node was passed over for this pick: visible in
+            // stitched traces and countable mesh-wide.
+            *g_drain_reroutes << 1;
+            if (span_ != nullptr) {
+                span_->Annotate("server draining, re-routed");
+            }
         }
         s = std::move(out.ptr);
         current_server_id_ = s->id();

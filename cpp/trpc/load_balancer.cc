@@ -53,6 +53,29 @@ int SelectFromList(const std::vector<ServerNode>& list, size_t start,
                    const SelectIn& in, SelectOut* out) {
     const size_t n = list.size();
     if (n == 0) return ENODATA;
+    bool saw_draining = false;
+    for (size_t i = 0; i < n; ++i) {
+        const ServerNode& node = list[(start + i) % n];
+        if (in.excluded != nullptr && in.excluded->IsExcluded(node.id)) {
+            continue;
+        }
+        Socket* s = Socket::Address(node.id);
+        if (s == nullptr) continue;
+        if (s->Draining()) {
+            // Peer announced a graceful shutdown: steer new calls away
+            // (the whole point of the GOAWAY — the reroute costs no
+            // retry token and trips no breaker).
+            saw_draining = true;
+            s->Dereference();
+            continue;
+        }
+        out->ptr = SocketUniquePtr(s);
+        out->skipped_draining = saw_draining;
+        return 0;
+    }
+    // Fallback 1: every non-draining candidate is excluded/failed — a
+    // draining server still SERVES (it only asked politely); better that
+    // than failing the call or re-hitting an already-tried server.
     for (size_t i = 0; i < n; ++i) {
         const ServerNode& node = list[(start + i) % n];
         if (in.excluded != nullptr && in.excluded->IsExcluded(node.id)) {
@@ -63,9 +86,10 @@ int SelectFromList(const std::vector<ServerNode>& list, size_t start,
         out->ptr = SocketUniquePtr(s);
         return 0;
     }
-    // Everything excluded/failed: as a last resort allow an excluded-but-
-    // live server (better to retry a tried server than to fail outright —
-    // reference round_robin_load_balancer.cpp falls back the same way).
+    // Fallback 2: everything excluded/failed: as a last resort allow an
+    // excluded-but-live server (better to retry a tried server than to
+    // fail outright — reference round_robin_load_balancer.cpp falls back
+    // the same way).
     for (size_t i = 0; i < n; ++i) {
         const ServerNode& node = list[(start + i) % n];
         Socket* s = Socket::Address(node.id);
@@ -185,12 +209,19 @@ public:
         if (sched.empty()) return ENODATA;
         const size_t n = sched.size();
         size_t start = next_.fetch_add(1, std::memory_order_relaxed) % n;
+        bool saw_draining = false;
         for (size_t i = 0; i < n; ++i) {
             const ServerNode& node = ptr->list[sched[(start + i) % n]];
             if (in.excluded && in.excluded->IsExcluded(node.id)) continue;
             Socket* s = Socket::Address(node.id);
             if (s == nullptr) continue;
+            if (s->Draining()) {
+                saw_draining = true;
+                s->Dereference();
+                continue;
+            }
             out->ptr = SocketUniquePtr(s);
+            out->skipped_draining = saw_draining;
             return 0;
         }
         return SelectFromList(ptr->list, start, in, out);
@@ -283,8 +314,14 @@ public:
         HashRing::Point probe{h, 0};
         auto it = std::lower_bound(ring.begin(), ring.end(), probe);
         const size_t start = it == ring.end() ? 0 : it - ring.begin();
-        // Walk the ring until a live, non-excluded server is found.
+        // Walk the ring until a live, non-excluded, non-draining server
+        // is found. Draining nodes are skipped exactly like failed ones —
+        // a draining ring member's keys flow to its ring successor, the
+        // same redistribution a removal would cause — but remembered as
+        // a better fallback than an excluded (already-tried) server.
         SocketId last_live = INVALID_VREF_ID;
+        SocketId last_draining = INVALID_VREF_ID;
+        bool saw_draining = false;
         for (size_t i = 0; i < ring.size(); ++i) {
             const SocketId id = ring[(start + i) % ring.size()].id;
             Socket* s = Socket::Address(id);
@@ -294,8 +331,24 @@ public:
                 s->Dereference();
                 continue;
             }
+            if (s->Draining()) {
+                if (last_draining == INVALID_VREF_ID) last_draining = id;
+                saw_draining = true;
+                s->Dereference();
+                continue;
+            }
             out->ptr = SocketUniquePtr(s);
+            out->skipped_draining = saw_draining;
             return 0;
+        }
+        // Draining beats excluded: it still serves and was not yet tried
+        // by this RPC.
+        if (last_draining != INVALID_VREF_ID) {
+            Socket* s = Socket::Address(last_draining);
+            if (s != nullptr) {
+                out->ptr = SocketUniquePtr(s);
+                return 0;
+            }
         }
         if (last_live != INVALID_VREF_ID) {
             Socket* s = Socket::Address(last_live);
@@ -357,12 +410,26 @@ public:
         double weights[kMaxInline];
         const size_t n = std::min(list.size(), (size_t)kMaxInline);
         double total = 0;
+        bool saw_draining = false;
         {
             std::lock_guard<std::mutex> g(stats_mu_);
             for (size_t i = 0; i < n; ++i) {
                 const SocketId id = list[i].id;
                 double w = 0;
-                if (!(in.excluded && in.excluded->IsExcluded(id))) {
+                bool draining = false;
+                {
+                    // Draining nodes get weight 0 (steered away like
+                    // excluded ones); liveness itself is still resolved
+                    // at pick time below.
+                    Socket* probe = Socket::Address(id);
+                    if (probe != nullptr) {
+                        draining = probe->Draining();
+                        probe->Dereference();
+                    }
+                }
+                if (draining) {
+                    saw_draining = true;
+                } else if (!(in.excluded && in.excluded->IsExcluded(id))) {
                     auto it = stats_.find(id);
                     if (it != stats_.end()) {
                         const int64_t lat =
@@ -401,6 +468,7 @@ public:
                 Socket* s = Socket::Address(list[i].id);
                 if (s != nullptr) {
                     out->ptr = SocketUniquePtr(s);
+                    out->skipped_draining = saw_draining;
                     OnPicked(list[i].id);
                     return 0;
                 }

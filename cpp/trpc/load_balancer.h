@@ -52,6 +52,11 @@ struct SelectOut {
     // On success the chosen server with a held ref (guaranteed alive and
     // non-failed at selection time).
     SocketUniquePtr ptr;
+    // At least one live-but-DRAINING server (peer announced a graceful
+    // shutdown) was passed over to pick `ptr`. The controller annotates
+    // the call's span ("server draining, re-routed") so reroutes are
+    // visible in stitched traces.
+    bool skipped_draining = false;
 };
 
 // A server as registered by the naming layer: stable socket id + weight

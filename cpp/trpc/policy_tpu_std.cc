@@ -28,6 +28,7 @@
 #include "trpc/server_call.h"
 #include "trpc/span.h"
 #include "trpc/stream.h"
+#include "tvar/reducer.h"
 
 DECLARE_bool(rpc_checksum);
 
@@ -44,6 +45,10 @@ constexpr char kMagic[4] = {'T', 'R', 'P', 'C'};
 constexpr size_t kHeaderLen = 12;
 int g_tpu_std_index = -1;
 }  // namespace
+
+// Drain announcements received from peers (a GOAWAY meta marked this
+// client's connection draining).
+static LazyAdder g_drain_notices("rpc_client_drain_notices");
 
 int TpuStdProtocolIndex() { return g_tpu_std_index; }
 
@@ -78,6 +83,16 @@ ParseResult ParseTpuStdMessage(IOBuf* source, Socket* socket, bool read_eof,
     source->cutn(&msg->meta, meta_size);
     source->cutn(&msg->body, body_size - meta_size);
     return ParseResult::make_ok(msg);
+}
+
+void SendTpuStdGoaway(Socket* s) {
+    rpc::RpcMeta meta;
+    meta.set_goaway(true);
+    IOBuf meta_buf;
+    SerializePbToIOBuf(meta, &meta_buf);
+    IOBuf frame;
+    PackTpuStdFrame(&frame, meta_buf, IOBuf(), IOBuf());
+    s->Write(&frame);
 }
 
 void SendTpuStdCancel(SocketId sid, uint64_t cid) {
@@ -534,6 +549,20 @@ void ProcessTpuStdMessage(InputMessageBase* raw) {
         SocketUniquePtr s;
         if (Socket::AddressSocket(msg->socket_id, &s) == 0) {
             s->SetFailedWithError(TERR_REQUEST);
+        }
+        return;
+    }
+    if (meta.goaway()) {
+        // Drain announcement (the tpu_std GOAWAY): the peer is shutting
+        // down deliberately. Mark the connection draining — in-flight
+        // calls on it complete normally (the server keeps serving through
+        // its drain window); NEW calls steer away (LB skips draining
+        // nodes, pinned channels re-create).
+        SocketUniquePtr s;
+        if (Socket::AddressSocket(msg->socket_id, &s) == 0 &&
+            !s->Draining()) {
+            s->SetDraining();
+            *g_drain_notices << 1;
         }
         return;
     }

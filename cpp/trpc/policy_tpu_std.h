@@ -39,6 +39,12 @@ int TpuStdProtocolIndex();
 // (a meta-only frame with `cancel` set; the receiver drops unknown ids).
 void SendTpuStdCancel(SocketId sid, uint64_t cid);
 
+// Drain announcement (the tpu_std GOAWAY): a meta-only frame with
+// `goaway` set, queued on `s`. The receiving client marks the socket
+// draining — in-flight calls complete, new calls steer away. Sent by
+// Server::StartDraining on every live tpu_std connection.
+void SendTpuStdGoaway(Socket* s);
+
 // Worker-pool tag reserved for usercode overload isolation (the backup
 // pool that absorbs excess blocking handlers — policy_tpu_std.cc
 // TooManyUserCode analog). Server::Start rejects user configurations

@@ -5,8 +5,16 @@
 #include <google/protobuf/descriptor.h>
 #include <unistd.h>
 
+#include <csignal>
+#include <cstring>
+
+#include <algorithm>
+#include <cstdint>
+
+#include "tbase/flags.h"
 #include "tbase/logging.h"
 #include "tbase/time.h"
+#include "tvar/reducer.h"
 #include "tfiber/butex.h"
 #include "tfiber/fiber.h"
 #include "thttp/builtin_services.h"
@@ -19,7 +27,51 @@
 #include "trpc/redis.h"
 #include "trpc/stream.h"
 
+// Reference -graceful_quit_on_SIGTERM (src/brpc/server.cpp): a SIGTERM
+// triggers a graceful drain+quit instead of an abrupt death. SIGUSR2
+// additionally requests a drain WITHOUT quitting (rebalance: shed
+// traffic, keep answering health checks and the portal).
+DEFINE_bool(graceful_quit_on_sigterm, false,
+            "SIGTERM gracefully drains and quits the server; SIGUSR2 "
+            "drains without quitting");
+
 namespace tpurpc {
+
+// Drain observability (the rolling-restart soak asserts on these):
+// rpc_server_draining is a 0/1 gauge; goaways counts drain
+// announcements broadcast to live connections; drained_inflight counts
+// requests that completed inside a GracefulStop drain window.
+static LazyAdder g_drain_goaways("rpc_server_drain_goaways_sent");
+static LazyAdder g_drained_inflight("rpc_server_drained_inflight");
+static Status<int64_t>* DrainingGauge() {
+    static Status<int64_t>* g = [] {
+        auto* s = new Status<int64_t>(0);
+        s->expose("rpc_server_draining");
+        return s;
+    }();
+    return g;
+}
+
+// ---- -graceful_quit_on_sigterm signal plumbing ----
+// sig_atomic_t flags only; all real work happens on whoever polls.
+namespace {
+volatile std::sig_atomic_t g_asked_to_quit = 0;
+volatile std::sig_atomic_t g_asked_to_drain = 0;
+void HandleQuitSignal(int) { g_asked_to_quit = 1; }
+void HandleDrainSignal(int) { g_asked_to_drain = 1; }
+}  // namespace
+
+void InstallGracefulQuitSignalsOrDie() {
+    struct sigaction sa;
+    memset(&sa, 0, sizeof(sa));
+    sa.sa_handler = HandleQuitSignal;
+    CHECK_EQ(sigaction(SIGTERM, &sa, nullptr), 0);
+    sa.sa_handler = HandleDrainSignal;
+    CHECK_EQ(sigaction(SIGUSR2, &sa, nullptr), 0);
+}
+
+bool IsAskedToQuit() { return g_asked_to_quit != 0; }
+bool IsAskedToDrain() { return g_asked_to_drain != 0; }
 
 Server::Server() : messenger_(), acceptor_(&messenger_) {
     join_butex_ = butex_create();
@@ -155,15 +207,134 @@ int Server::StartNoListen(const ServerOptions* options) {
     messenger_.add_protocol(RedisServerProtocolIndex());
     AddBuiltinHttpServices(this);
     messenger_.context = this;
+    if (FLAGS_graceful_quit_on_sigterm.get()) {
+        InstallGracefulQuitSignalsOrDie();
+    }
+    draining_.store(false, std::memory_order_release);  // restart path
     started_ = true;
     listening_ = false;
     return 0;
+}
+
+void Server::StartDraining() {
+    if (!started_) return;
+    if (draining_.exchange(true, std::memory_order_acq_rel)) {
+        return;  // already draining
+    }
+    DrainingGauge()->set_value(1);
+    // Broadcast the drain announcement on every live accepted
+    // connection, in that connection's own protocol. Requests already
+    // in flight — and ones racing the announcement — are still served;
+    // peers steer NEW calls away (budget-free, breaker-free).
+    int64_t sent = 0;
+    for (SocketId id : acceptor_.connections()) {
+        SocketUniquePtr s;
+        if (Socket::AddressSocket(id, &s) != 0) continue;
+        if (s->preferred_protocol_index == TpuStdProtocolIndex()) {
+            SendTpuStdGoaway(s.get());
+            ++sent;
+        } else if (s->preferred_protocol_index == Http2ProtocolIndex()) {
+            if (H2ServerSendGoaway(s.get()) == 0) ++sent;
+        }
+        // HTTP/1.1 has no unsolicited server frame: those connections
+        // learn from the Connection: close on their next response
+        // (http_protocol.cc checks server->draining()). Connections
+        // that never sent a byte have no protocol yet — nothing to say.
+    }
+    if (sent > 0) *g_drain_goaways << sent;
+    LOG(INFO) << "Server draining: " << sent
+              << " GOAWAY announcements sent, nprocessing="
+              << nprocessing.load(std::memory_order_acquire);
+}
+
+void Server::GracefulStop(int64_t max_drain_ms) {
+    if (!started_) return;
+    if (max_drain_ms < 0) max_drain_ms = 0;
+    const int64_t deadline = monotonic_time_us() + max_drain_ms * 1000;
+    // 1. Stop ACCEPTING without closing the listening fd: no new
+    //    connections, but the port stays bound and connect-probe health
+    //    checks still pass while we drain.
+    if (listening_) acceptor_.PauseAccept();
+    // 2. Announce the drain (GOAWAY broadcast + draining flag).
+    const int64_t inflight_at_start =
+        nprocessing.load(std::memory_order_acquire);
+    StartDraining();
+    // 3. Drain, bounded by max_drain_ms. Each in-flight request is also
+    //    bounded by its own propagated deadline: expired work is shed by
+    //    the deadline machinery, never executed into the void. A linger
+    //    window after reaching zero catches requests that raced the
+    //    GOAWAY (written by a peer before it processed the
+    //    announcement) — they are served too, so a rolling restart
+    //    completes every call instead of stranding the race window.
+    const int64_t linger_us =
+        std::min<int64_t>(200 * 1000, max_drain_ms * 1000 / 4 + 1);
+    while (monotonic_time_us() < deadline) {
+        JoinUntil(deadline);
+        if (nprocessing.load(std::memory_order_acquire) > 0) {
+            continue;  // deadline interrupted the wait; loop re-checks
+        }
+        const int64_t begun = nbegun_.load(std::memory_order_acquire);
+        const int64_t linger_end =
+            std::min(deadline, monotonic_time_us() + linger_us);
+        while (monotonic_time_us() < linger_end &&
+               nbegun_.load(std::memory_order_acquire) == begun) {
+            fiber_usleep(10 * 1000);
+        }
+        if (nbegun_.load(std::memory_order_acquire) == begun &&
+            nprocessing.load(std::memory_order_acquire) <= 0) {
+            break;  // drained AND quiet for a full linger window
+        }
+    }
+    const int64_t remaining = nprocessing.load(std::memory_order_acquire);
+    const int64_t drained = inflight_at_start - remaining;
+    if (drained > 0) *g_drained_inflight << drained;
+    if (remaining > 0) {
+        LOG(WARNING) << "GracefulStop: drain window (" << max_drain_ms
+                     << "ms) expired with " << remaining
+                     << " requests still in flight; stopping hard";
+    }
+    // 4. Flush queued response bytes: a response that finished its
+    //    handler but still sits in a socket's write queue would be
+    //    dropped by the hard close below — the one failure mode that
+    //    turns a "drained" restart into a client-visible error.
+    const int64_t flush_deadline = monotonic_time_us() + 500 * 1000;
+    for (SocketId id : acceptor_.connections()) {
+        SocketUniquePtr s;
+        if (Socket::AddressSocket(id, &s) != 0) continue;
+        while (s->unwritten_bytes() > 0 && !s->Failed() &&
+               monotonic_time_us() < flush_deadline) {
+            fiber_usleep(2 * 1000);
+        }
+    }
+    // 5. Hard teardown (unbounded Join: request fibers hold pointers
+    //    into this Server; the drain above makes the wait short). Stop
+    //    clears the draining flag and gauge.
+    Stop();
+    Join();
+}
+
+void Server::RunUntilAskedToQuit(int64_t max_drain_ms) {
+    bool drained = false;
+    while (!IsAskedToQuit()) {
+        if (!drained && IsAskedToDrain()) {
+            StartDraining();
+            drained = true;
+        }
+        usleep(50 * 1000);  // plain thread sleep: callable off-fiber
+    }
+    GracefulStop(max_drain_ms);
 }
 
 void Server::Stop() {
     if (!started_) return;
     if (listening_) acceptor_.StopAccept();
     started_ = false;
+    // A drain-only server (StartDraining without GracefulStop) that is
+    // stopped the plain way must not report rpc_server_draining=1
+    // forever — the gauge is process-global, the flag per-instance.
+    if (draining_.exchange(false, std::memory_order_acq_rel)) {
+        DrainingGauge()->set_value(0);
+    }
 }
 
 void Server::EndRequest() {
@@ -181,15 +352,21 @@ void Server::EndRequest() {
     }
 }
 
-void Server::Join() {
+void Server::Join() { JoinUntil(INT64_MAX); }
+
+void Server::JoinUntil(int64_t abs_deadline_us) {
     // Drain in-flight requests (reference Server::Join semantics). Butex
     // parked, not polled; the short timeout is a backstop for the
-    // wake-before-wait race, re-resolved on re-check.
+    // wake-before-wait race, re-resolved on re-check. Returns early —
+    // possibly with requests still in flight — once `abs_deadline_us`
+    // passes (the bounded drain of GracefulStop).
     while (true) {
         const int seq =
             butex_word(join_butex_)->load(std::memory_order_acquire);
         if (nprocessing.load(std::memory_order_acquire) <= 0) return;
-        const int64_t abst = monotonic_time_us() + 100 * 1000;
+        const int64_t now = monotonic_time_us();
+        if (now >= abs_deadline_us) return;
+        const int64_t abst = std::min(abs_deadline_us, now + 100 * 1000);
         butex_wait(join_butex_, seq, &abst);
     }
 }

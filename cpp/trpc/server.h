@@ -121,6 +121,34 @@ public:
     void Stop();
     void Join();
 
+    // ---- zero-downtime lifecycle (reference Server::Stop/Join draining
+    // + -graceful_quit_on_sigterm) ----
+    // Planned shutdown, end to end: pause the acceptor (listening fd
+    // stays open — connect-probe health checks keep passing), broadcast
+    // a drain announcement on every live connection (tpu_std GOAWAY
+    // meta; h2 GOAWAY with last-stream-id; HTTP/1.1 answers with
+    // Connection: close), serve in-flight AND racing requests to
+    // completion bounded by `max_drain_ms` (each request is further
+    // bounded by its own propagated deadline — expired work is shed, not
+    // executed), flush queued response bytes, then Stop+Join. tvars:
+    // rpc_server_draining (gauge), rpc_server_drain_goaways_sent,
+    // rpc_server_drained_inflight.
+    void GracefulStop(int64_t max_drain_ms = 5000);
+    // Drain-only (the SIGUSR2 behavior): announce the drain and mark the
+    // server draining but KEEP accepting and serving — operators can
+    // still scrape /status //vars, and health checks still answer, while
+    // clients steer new traffic away. Idempotent.
+    void StartDraining();
+    bool draining() const {
+        return draining_.load(std::memory_order_acquire);
+    }
+
+    // Signal-driven lifecycle for tools (-graceful_quit_on_sigterm):
+    // blocks until SIGTERM, then GracefulStop(max_drain_ms) and returns.
+    // A SIGUSR2 received meanwhile triggers StartDraining() without
+    // quitting. Requires the flag (Start installs the handlers).
+    void RunUntilAskedToQuit(int64_t max_drain_ms = 5000);
+
     int listened_port() const { return acceptor_.listened_port(); }
     const ServerOptions& options() const { return options_; }
 
@@ -235,6 +263,10 @@ public:
     // Admission + accounting for one request (called by protocol layers).
     void BeginRequest() {
         nprocessing.fetch_add(1, std::memory_order_relaxed);
+        // Monotonic admission counter: GracefulStop's linger loop uses
+        // it to tell "drained and quiet" apart from "drained but a
+        // racing request just arrived".
+        nbegun_.fetch_add(1, std::memory_order_relaxed);
     }
     // Last-touch of Server memory for a request fiber: wakes Join.
     void EndRequest();
@@ -250,6 +282,21 @@ private:
     std::map<std::string, HttpHandler> http_exact_;
     std::map<std::string, HttpHandler> http_prefix_;  // key without "/*"
     void* join_butex_ = nullptr;  // bumped when nprocessing drains to 0
+    std::atomic<bool> draining_{false};
+    std::atomic<int64_t> nbegun_{0};  // total requests ever admitted
+    // Join with an absolute deadline (INT64_MAX = wait forever); the
+    // drain phase of GracefulStop is bounded, the final teardown is not
+    // (request fibers hold pointers into this Server).
+    void JoinUntil(int64_t abs_deadline_us);
 };
+
+// -graceful_quit_on_sigterm plumbing. The handlers only set flags (never
+// run shutdown from signal context): poll IsAskedToQuit/IsAskedToDrain
+// from a fiber/thread and call Server::GracefulStop there — or use
+// Server::RunUntilAskedToQuit which does exactly that. Installed
+// automatically by Server::Start when -graceful_quit_on_sigterm is on.
+void InstallGracefulQuitSignalsOrDie();
+bool IsAskedToQuit();   // SIGTERM seen (graceful quit requested)
+bool IsAskedToDrain();  // SIGUSR2 seen (drain-only requested)
 
 }  // namespace tpurpc

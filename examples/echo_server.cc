@@ -1,12 +1,17 @@
 // The canonical echo server (reference example/echo_c++/server.cpp):
 // one pb service on one port, with the observability portal, gRPC/h2,
 // HTTP-as-RPC json, and RESP riding the same listener. Optional flags:
-//   echo_server [port] [--auto-concurrency]
+//   echo_server [port] [--auto-concurrency] [--graceful]
+// --graceful turns on -graceful_quit_on_sigterm: SIGTERM drains (GOAWAY
+// broadcast, in-flight requests complete, then quit with code 0) and
+// SIGUSR2 drains without quitting — the operator-facing zero-downtime
+// path, no code required.
 #include <cstdio>
 #include <cstring>
 #include <unistd.h>
 
 #include "bench_echo.pb.h"
+#include "tbase/flags.h"
 #include "trpc/controller.h"
 #include "trpc/redis.h"
 #include "trpc/server.h"
@@ -34,6 +39,8 @@ int main(int argc, char** argv) {
     for (int i = 1; i < argc; ++i) {
         if (strcmp(argv[i], "--auto-concurrency") == 0) {
             options.auto_concurrency = true;
+        } else if (strcmp(argv[i], "--graceful") == 0) {
+            SetFlagValue("graceful_quit_on_sigterm", "true");
         } else {
             port = atoi(argv[i]);
         }
@@ -54,5 +61,10 @@ int main(int argc, char** argv) {
            "  curl -d '{\"send_ts_us\":1}' http://127.0.0.1:%d/EchoService/Echo\n",
            server.listened_port(), server.listened_port(),
            server.listened_port(), server.listened_port());
-    while (true) pause();  // Ctrl-C to exit
+    // With --graceful: SIGTERM drains (in-flight requests finish, peers
+    // steer away on the GOAWAY) and returns here for a code-0 exit;
+    // SIGUSR2 drains without quitting. Without the flag this blocks
+    // forever (Ctrl-C to exit) — same loop either way.
+    server.RunUntilAskedToQuit(/*max_drain_ms=*/5000);
+    return 0;
 }

@@ -1,0 +1,160 @@
+"""Rolling-restart mesh soak (ISSUE 5 capstone): zero-downtime lifecycle.
+
+Eight `mesh_node` processes (LB/naming plane only) serve sustained
+echo traffic to each other. Every node is then restarted IN SEQUENCE
+via SIGTERM with -graceful_quit_on_sigterm: the node announces a drain
+(tpu_std GOAWAY on every live connection), keeps serving through its
+drain window while peers steer new calls away (budget-free,
+breaker-free), completes its in-flight work (Server::GracefulStop),
+reports, and exits 0. A fresh incarnation takes the port back and the
+peers' health checks revive their sockets.
+
+Asserted invariants — strictly stronger than the chaos soak's
+"recovered" bar:
+  * ZERO failed completions, on every incarnation of every node
+    (dying incarnations report before exiting; survivors at the end);
+  * ZERO retry-budget tokens spent: no retries, no backups,
+    rpc_retry_budget_exhausted == 0 — drain reroutes are budget-free
+    by design, so a full-mesh rolling restart costs nothing;
+  * every restarted node showed "draining: 1" on /status and sent
+    GOAWAYs (rpc_server_drain_goaways_sent > 0);
+  * graceful exit 0 for every SIGTERMed incarnation;
+  * the mesh kept doing useful work throughout (total ok calls grows).
+"""
+import json
+import signal
+import time
+
+from test_chaos_soak import Node, _free_ports, _http_get, _var
+
+NUM_NODES = 8
+
+FLAGS = [
+    "ns_health_check_interval_ms=200",
+    "graceful_quit_on_sigterm=true",
+]
+# --traffic_delay_ms keeps the zero-retry invariant honest: without it,
+# the first node's traffic races the last node's listen() and the
+# resulting connect-refusals would spend retry tokens at t=0.
+EXTRA_ARGS = ("--lb_only", "--drain_ms", "1200",
+              "--traffic_delay_ms", "2000")
+
+
+def _wait_line(node, prefix, timeout):
+    deadline = time.time() + timeout
+    while True:
+        line = node._readline(deadline)
+        if line is None:
+            return None
+        if line.startswith(prefix):
+            return line
+
+
+def _assert_clean(rep, who):
+    assert rep["outstanding"] == 0, (who, rep)
+    assert rep["lb_issued"] == rep["lb_ok"] + rep["lb_failed"], (who, rep)
+    assert rep["lb_failed"] == 0, (
+        "%s saw failed completions during the rolling restart: %r"
+        % (who, rep))
+    assert rep["reissues"] == 0, (
+        "%s spent retry-budget tokens (%d re-issues): %r"
+        % (who, rep["reissues"], rep))
+    assert rep["budget_exhausted"] == 0, (who, rep)
+
+
+def test_rolling_restart_zero_downtime(cpp_build, tmp_path):
+    binary = cpp_build / "mesh_node"
+    assert binary.exists(), "mesh_node not built"
+    ports = _free_ports(NUM_NODES)
+    peers_file = tmp_path / "mesh_members"
+    peers_file.write_text("".join("127.0.0.1:%d\n" % p for p in ports))
+
+    def spawn(i):
+        return Node(binary, ports[i], i, peers_file, flags=FLAGS,
+                    extra_args=EXTRA_ARGS)
+
+    nodes = [spawn(i) for i in range(NUM_NODES)]
+    dying_reports = []
+    try:
+        for n in nodes:
+            assert n.wait_ready(), "node %d never became ready" % n.idx
+        time.sleep(3.5)  # traffic-start delay + steady-state warmup
+
+        # --- restart every node in sequence, under load ---------------
+        for i in range(NUM_NODES):
+            n = nodes[i]
+            n.proc.send_signal(signal.SIGTERM)
+            assert _wait_line(n, "DRAINING", 10.0) is not None, (
+                "node %d never announced its drain" % i)
+
+            # While the node serves through its drain window, /status
+            # must show the draining state and the GOAWAY broadcast
+            # must be visible in /vars.
+            saw_status = False
+            goaways_live = 0
+            deadline = time.time() + 5.0
+            while time.time() < deadline:
+                try:
+                    status = _http_get(ports[i], "/status", timeout=1.0)
+                except Exception:
+                    break  # already stopped: the REPORT assert covers it
+                if "draining: 1" in status:
+                    saw_status = True
+                    goaways_live = _var(
+                        ports[i], "rpc_server_drain_goaways_sent")
+                    if goaways_live > 0:
+                        break
+                time.sleep(0.03)
+            assert saw_status, (
+                "/status never showed draining: 1 on node %d" % i)
+
+            # The dying incarnation reports after its GracefulStop:
+            # nothing lost, nothing re-issued, GOAWAYs actually sent.
+            line = _wait_line(n, "REPORT ", 30.0)
+            assert line is not None, "node %d produced no exit report" % i
+            rep = json.loads(line[len("REPORT "):])
+            _assert_clean(rep, "dying node %d" % i)
+            assert rep["goaways_sent"] > 0, (
+                "node %d drained without sending GOAWAYs: %r" % (i, rep))
+            dying_reports.append(rep)
+            assert n.proc.wait(timeout=30) == 0, (
+                "node %d unclean graceful exit" % i)
+
+            # Fresh incarnation on the same port; peers' health checks
+            # revive their sockets (200ms cadence) and traffic resumes.
+            nodes[i] = spawn(i)
+            assert nodes[i].wait_ready(), "node %d restart failed" % i
+            time.sleep(1.0)
+
+        time.sleep(1.0)  # full mesh settles after the last restart
+
+        # --- final drain + invariants ---------------------------------
+        reports = []
+        for n in nodes:
+            rep = n.stop_and_report()
+            assert rep is not None, "node %d produced no report" % n.idx
+            reports.append(rep)
+
+        total_ok = 0
+        for i, rep in enumerate(reports):
+            _assert_clean(rep, "final node %d" % i)
+            total_ok += rep["lb_ok"]
+        for rep in dying_reports:
+            total_ok += rep["lb_ok"]
+        # The mesh kept serving across all eight restarts.
+        assert total_ok > 200, (dying_reports, reports)
+        # The drain was actually exercised client-side: peers received
+        # GOAWAY notices and rerouted around draining nodes.
+        notices = sum(r["drain_notices"] for r in reports + dying_reports)
+        reroutes = sum(r["drain_reroutes"] for r in reports + dying_reports)
+        assert notices >= 1, (dying_reports, reports)
+        assert reroutes >= 1, (dying_reports, reports)
+
+        for n in nodes:
+            assert n.shutdown() == 0, "node %d unclean exit" % n.idx
+    finally:
+        for n in nodes:
+            try:
+                n.proc.kill()
+            except OSError:
+                pass

@@ -35,6 +35,7 @@
 
 #include <atomic>
 #include <cstdio>
+#include <cstdlib>
 #include <cstring>
 #include <memory>
 #include <mutex>
@@ -55,6 +56,7 @@
 #include "trpc/pb_compat.h"
 #include "trpc/policy_tpu_std.h"
 #include "trpc/server.h"
+#include "tvar/variable.h"
 
 using namespace tpurpc;
 
@@ -65,6 +67,14 @@ namespace {
 std::atomic<int> g_handler_delay_ms{0};
 std::atomic<int> g_stale_budget_ms{0};
 std::atomic<int64_t> g_stale_executed{0};
+// --traffic_delay_ms: traffic fibers idle this long after launch so a
+// whole mesh can finish listening first. The rolling-restart soak needs
+// it: a connect-refused burst at startup would spend retry-budget
+// tokens the soak asserts are NEVER spent.
+std::atomic<int> g_traffic_delay_ms{0};
+
+struct NodeState;
+void TrafficStartDelay(NodeState* st);
 
 class EchoServiceImpl : public benchpb::EchoService {
 public:
@@ -144,7 +154,42 @@ struct NodeState {
     std::unique_ptr<Channel> lb_channel;
     Counters counters;
     std::atomic<bool> stop{false};
+    // Traffic fibers, joinable from EITHER the stdin "stop" path or the
+    // SIGTERM graceful-quit watcher — the exchange guard keeps the join
+    // single-shot (double fiber_join is UB).
+    std::vector<fiber_t> traffic_fibers;
+    std::atomic<bool> fibers_joined{false};
+    // Tells the GracefulQuitWatcher fiber to exit: it holds raw pointers
+    // to main()'s stack-local Server/NodeState, so the stdin-EOF
+    // teardown must stop and JOIN it before those objects die.
+    std::atomic<bool> watcher_stop{false};
+
+    void StopTraffic() {
+        stop.store(true, std::memory_order_relaxed);
+        if (!fibers_joined.exchange(true, std::memory_order_acq_rel)) {
+            for (fiber_t t : traffic_fibers) fiber_join(t, nullptr);
+        }
+    }
 };
+
+void TrafficStartDelay(NodeState* st) {
+    const int64_t until =
+        monotonic_time_us() +
+        (int64_t)g_traffic_delay_ms.load(std::memory_order_relaxed) * 1000;
+    while (monotonic_time_us() < until &&
+           !st->stop.load(std::memory_order_relaxed)) {
+        fiber_usleep(20 * 1000);
+    }
+}
+
+// In-process numeric tvar read (the REPORT line carries re-issue and
+// drain counters so the rolling-restart soak can assert on DYING
+// incarnations whose portal is gone by assertion time).
+int64_t VarInt(const char* name) {
+    std::string v;
+    if (!Variable::describe_exposed(name, &v)) return 0;
+    return atoll(v.c_str());
+}
 
 bool DoEcho(Channel* ch, int64_t timeout_ms, const std::string& payload) {
     benchpb::EchoService_Stub stub(ch);
@@ -160,6 +205,7 @@ bool DoEcho(Channel* ch, int64_t timeout_ms, const std::string& payload) {
 
 void* LbTrafficFiber(void* arg) {
     auto* st = (NodeState*)arg;
+    TrafficStartDelay(st);
     const std::string payload(128, 'b');
     while (!st->stop.load(std::memory_order_relaxed)) {
         st->counters.outstanding.fetch_add(1);
@@ -177,6 +223,7 @@ void* LbTrafficFiber(void* arg) {
 
 void* ShmTrafficFiber(void* arg) {
     auto* st = (NodeState*)arg;
+    TrafficStartDelay(st);
     const std::string payload(128, 's');
     size_t next = 0;
     while (!st->stop.load(std::memory_order_relaxed)) {
@@ -389,6 +436,11 @@ void* ChainCallFiber(void* arg) {
 }
 
 void PrintReport(int id, int port, const Counters& c) {
+    // Client re-issue + drain counters ride the report so the soak can
+    // assert "zero retry tokens spent" even for an incarnation that is
+    // about to exit (its /vars portal dies with it).
+    const long long reissues =
+        VarInt("rpc_client_retries") + VarInt("rpc_client_backup_requests");
     printf(
         "REPORT {\"id\": %d, \"port\": %d, \"lb_issued\": %lld, "
         "\"lb_ok\": %lld, \"lb_failed\": %lld, \"shm_issued\": %lld, "
@@ -396,7 +448,10 @@ void PrintReport(int id, int port, const Counters& c) {
         "\"stale_issued\": %lld, \"stale_ok\": %lld, "
         "\"stale_failed\": %lld, \"stale_executed\": %lld, "
         "\"expired_probes\": %lld, "
-        "\"outstanding\": %lld, \"reconnects\": %lld}\n",
+        "\"outstanding\": %lld, \"reconnects\": %lld, "
+        "\"reissues\": %lld, \"budget_exhausted\": %lld, "
+        "\"drain_reroutes\": %lld, \"drain_notices\": %lld, "
+        "\"goaways_sent\": %lld}\n",
         id, port, (long long)c.lb_issued.load(), (long long)c.lb_ok.load(),
         (long long)c.lb_failed.load(), (long long)c.shm_issued.load(),
         (long long)c.shm_ok.load(), (long long)c.shm_failed.load(),
@@ -404,8 +459,56 @@ void PrintReport(int id, int port, const Counters& c) {
         (long long)c.stale_failed.load(),
         (long long)g_stale_executed.load(),
         (long long)c.expired_probes.load(),
-        (long long)c.outstanding.load(), (long long)c.reconnects.load());
+        (long long)c.outstanding.load(), (long long)c.reconnects.load(),
+        reissues, (long long)VarInt("rpc_retry_budget_exhausted"),
+        (long long)VarInt("rpc_client_drain_reroutes"),
+        (long long)VarInt("rpc_client_drain_notices"),
+        (long long)VarInt("rpc_server_drain_goaways_sent"));
     fflush(stdout);
+}
+
+// SIGTERM/SIGUSR2 watcher (the -graceful_quit_on_sigterm wiring): a
+// plain fiber polling the signal flags — never shutdown work in signal
+// context. SIGUSR2 = drain-only (announce + keep serving, so operators
+// can watch /status flip to draining: 1); SIGTERM = the zero-downtime
+// exit used by the rolling-restart soak:
+//   announce -> serve through the drain window (peers steer away) ->
+//   stop own client traffic -> GracefulStop -> REPORT -> _exit(0).
+struct QuitWatchArgs {
+    Server* server;
+    NodeState* st;
+    int id;
+    int port;
+    int drain_ms;
+};
+
+void* GracefulQuitWatcher(void* arg) {
+    std::unique_ptr<QuitWatchArgs> a((QuitWatchArgs*)arg);
+    bool announced = false;
+    while (!IsAskedToQuit()) {
+        if (a->st->watcher_stop.load(std::memory_order_acquire)) {
+            return nullptr;  // main() is tearing down; our pointers die
+        }
+        if (!announced && IsAskedToDrain()) {
+            a->server->StartDraining();
+            announced = true;
+            printf("DRAINING\n");
+            fflush(stdout);
+        }
+        fiber_usleep(20 * 1000);
+    }
+    a->server->StartDraining();
+    if (!announced) {
+        printf("DRAINING\n");
+        fflush(stdout);
+    }
+    fiber_usleep((int64_t)a->drain_ms * 1000);
+    a->st->StopTraffic();  // our own in-flight client calls complete
+    a->server->GracefulStop(2000);
+    PrintReport(a->id, a->port, a->st->counters);
+    fflush(nullptr);
+    _exit(0);
+    return nullptr;
 }
 
 }  // namespace
@@ -414,6 +517,8 @@ int main(int argc, char** argv) {
     prctl(PR_SET_PDEATHSIG, SIGKILL);  // die with the driving pytest
     int port = 0, id = 0;
     int timeout_cl_ms = 0;
+    int drain_ms = 1200;
+    bool lb_only = false;
     const char* peers_file = nullptr;
     for (int i = 1; i < argc; ++i) {
         if (strcmp(argv[i], "--port") == 0 && i + 1 < argc) {
@@ -424,6 +529,21 @@ int main(int argc, char** argv) {
             peers_file = argv[++i];
         } else if (strcmp(argv[i], "--timeout_cl_ms") == 0 && i + 1 < argc) {
             timeout_cl_ms = atoi(argv[++i]);
+        } else if (strcmp(argv[i], "--drain_ms") == 0 && i + 1 < argc) {
+            // SIGTERM grace window: announce, then keep serving this long
+            // before the final GracefulStop (rolling restarts observe
+            // /status draining:1 during it).
+            drain_ms = atoi(argv[++i]);
+        } else if (strcmp(argv[i], "--traffic_delay_ms") == 0 &&
+                   i + 1 < argc) {
+            g_traffic_delay_ms.store(atoi(argv[++i]),
+                                     std::memory_order_relaxed);
+        } else if (strcmp(argv[i], "--lb_only") == 0) {
+            // Rolling-restart soak mode: only the naming/LB plane runs.
+            // The shm-ICI links die hard when a peer exits (no drain
+            // protocol on the queue pair yet) — the zero-failed-
+            // completions invariant is an LB-plane contract.
+            lb_only = true;
         } else if (strcmp(argv[i], "--flag") == 0 && i + 1 < argc) {
             // --flag name=value: soak-tuned knobs (breaker windows,
             // health-check cadence, ...) without bespoke plumbing.
@@ -439,7 +559,11 @@ int main(int argc, char** argv) {
     if (port <= 0 || peers_file == nullptr) {
         fprintf(stderr,
                 "usage: mesh_node --port N --peers FILE [--id K] "
-                "[--flag name=value]...\n");
+                "[--lb_only] [--drain_ms N] [--timeout_cl_ms N] "
+                "[--flag name=value]...\n"
+                "  with --flag graceful_quit_on_sigterm=true: SIGTERM "
+                "drains gracefully and exits 0; SIGUSR2 drains without "
+                "quitting\n");
         return 2;
     }
     if (IciBlockPool::Init() != 0) {
@@ -478,7 +602,7 @@ int main(int argc, char** argv) {
         return 1;
     }
     // Mesh links: one shm channel per peer (self excluded).
-    {
+    if (!lb_only) {
         FILE* f = fopen(peers_file, "r");
         if (f == nullptr) return 1;
         char line[128];
@@ -495,23 +619,41 @@ int main(int argc, char** argv) {
         fclose(f);
     }
 
-    std::vector<fiber_t> fibers;
+    std::vector<fiber_t>& fibers = st.traffic_fibers;
     fiber_t tid;
-    if (fiber_start_background(&tid, nullptr, LinkMaintenanceFiber, &st) ==
-        0) {
+    if (!lb_only &&
+        fiber_start_background(&tid, nullptr, LinkMaintenanceFiber, &st) ==
+            0) {
         fibers.push_back(tid);
     }
     if (fiber_start_background(&tid, nullptr, LbTrafficFiber, &st) == 0) {
         fibers.push_back(tid);
     }
-    if (fiber_start_background(&tid, nullptr, ShmTrafficFiber, &st) == 0) {
-        fibers.push_back(tid);
+    if (!lb_only) {
+        if (fiber_start_background(&tid, nullptr, ShmTrafficFiber, &st) ==
+            0) {
+            fibers.push_back(tid);
+        }
+        if (fiber_start_background(&tid, nullptr, StaleTrafficFiber, &st) ==
+            0) {
+            fibers.push_back(tid);
+        }
+        if (fiber_start_background(&tid, nullptr, ExpiredProbeFiber, &st) ==
+            0) {
+            fibers.push_back(tid);
+        }
     }
-    if (fiber_start_background(&tid, nullptr, StaleTrafficFiber, &st) == 0) {
-        fibers.push_back(tid);
-    }
-    if (fiber_start_background(&tid, nullptr, ExpiredProbeFiber, &st) == 0) {
-        fibers.push_back(tid);
+    // Signal-driven zero-downtime lifecycle (active when the
+    // -graceful_quit_on_sigterm flag installed the handlers at Start).
+    fiber_t quit_watcher;
+    bool have_quit_watcher = true;
+    {
+        auto* qa = new QuitWatchArgs{&server, &st, id, port, drain_ms};
+        if (fiber_start_background(&quit_watcher, nullptr,
+                                   GracefulQuitWatcher, qa) != 0) {
+            delete qa;
+            have_quit_watcher = false;
+        }
     }
 
     printf("READY %d\n", port);
@@ -524,9 +666,7 @@ int main(int argc, char** argv) {
     char cmd[256];
     while (fgets(cmd, sizeof(cmd), stdin) != nullptr) {
         if (strncmp(cmd, "stop", 4) == 0) {
-            st.stop.store(true, std::memory_order_relaxed);
-            for (fiber_t t : fibers) fiber_join(t, nullptr);
-            fibers.clear();
+            st.StopTraffic();
             PrintReport(id, port, st.counters);
         } else if (strncmp(cmd, "report", 6) == 0) {
             PrintReport(id, port, st.counters);
@@ -554,9 +694,16 @@ int main(int argc, char** argv) {
             }
         }
     }
-    // EOF: orderly shutdown. Stop traffic if "stop" never arrived.
-    st.stop.store(true, std::memory_order_relaxed);
-    for (fiber_t t : fibers) fiber_join(t, nullptr);
+    // EOF: orderly shutdown. Stop traffic if "stop" never arrived. The
+    // quit watcher holds pointers to the stack-local server/state: stop
+    // and join it FIRST. (If a SIGTERM raced us, the join blocks until
+    // the watcher's own GracefulStop path _exits the process — also
+    // orderly.)
+    if (have_quit_watcher) {
+        st.watcher_stop.store(true, std::memory_order_release);
+        fiber_join(quit_watcher, nullptr);
+    }
+    st.StopTraffic();
     server.Stop();
     server.Join();  // quiesces sockets: a leak would hang (pytest timeout)
     fflush(nullptr);

@@ -17,6 +17,7 @@
 // as CSV (elapsed_s,qps,p50_us,p99_us,p999_us,failed_total) — the BENCH
 // trajectory input. Prints qps achieved + latency percentiles at the
 // end; --json for one JSON line.
+#include <signal.h>
 #include <unistd.h>
 
 #include <atomic>
@@ -48,6 +49,12 @@ struct PressCtx {
     IOBuf* filler;
     int64_t timeout_ms;
 };
+
+// Ctrl-C / SIGINT: finish the current interval cleanly — flush the final
+// p50/p99/p999 line and --metrics_csv row, join the callers, print the
+// summary — instead of dying mid-write with a torn CSV.
+volatile sig_atomic_t g_sigint = 0;
+void OnSigint(int) { g_sigint = 1; }
 
 void* PressCaller(void* arg) {
     auto* c = (PressCtx*)arg;
@@ -183,7 +190,8 @@ int main(int argc, char** argv) {
             fflush(csv);
         }
     };
-    while (monotonic_time_us() < end) {
+    signal(SIGINT, OnSigint);  // clean early stop (full final report)
+    while (monotonic_time_us() < end && !g_sigint) {
         const int64_t now = monotonic_time_us();
         const int64_t should = (now - t0) * qps / 1000000;
         if (should > granted) {
@@ -200,8 +208,10 @@ int main(int argc, char** argv) {
         }
         usleep(10 * 1000);
     }
-    // The loop exits AT the deadline, so the last interval would
-    // otherwise never be reported — an N-second run must yield N rows.
+    // The loop exits AT the deadline (or on SIGINT), so the last
+    // interval would otherwise never be reported — an N-second run must
+    // yield N rows, and an interrupted run must still end with a
+    // complete row rather than a torn write.
     report(monotonic_time_us());
     if (csv != nullptr) fclose(csv);
     stop.store(true, std::memory_order_relaxed);

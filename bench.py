@@ -13,6 +13,17 @@ MEDIAN (plus min/max spread for the record). Also included:
   - tail_*: the backup-request tail benchmark (reference benchmark.md:
     126-206 — 2% slow handlers; p99 with backups ≈ backup_ms + p50).
   - scale_*: qps vs caller fibers 1/4/16/64 (reference benchmark.md:110).
+  - perf-attribution scrape (ISSUE 6): dispatcher/scheduler counters,
+    /status?format=json method stats, and cpu+heap profile snapshots
+    saved under profiles/ with their paths committed into the JSON so a
+    regression links to evidence.
+
+Regression gate:
+  bench.py --compare BENCH_rPREV.json [--current BENCH_rCUR.json]
+           [--strict] [--threshold 0.15]
+prints per-metric deltas vs the previous round (running the bench first
+unless --current names an existing JSON) and exits non-zero past the
+threshold ONLY with --strict — the verify flow runs it non-fatal.
 """
 import json
 import os
@@ -104,6 +115,75 @@ def device_path():
     return None
 
 
+def perf_attrib_scrape(port):
+    """ISSUE 6: scrape the performance-attribution surfaces of a node
+    under load — dispatcher/scheduler families, machine-readable method
+    status, and cpu+heap profile snapshots (paths land in the BENCH json
+    so a regression links to evidence)."""
+    out = {}
+    # Sample aggressively for the snapshot window; restore the node's
+    # OWN prior interval afterwards even if a scrape step dies (the cpu
+    # profile fetch is the likeliest to time out).
+    prev_interval = None
+    try:
+        flag = _http(port, "/flags/heap_profiler_sample_bytes")
+        prev_interval = int(flag.split(" = ")[1].split()[0])
+    except Exception:
+        pass
+    try:
+        _http(port, "/flags/heap_profiler_sample_bytes?setvalue=16384")
+        status = json.loads(_http(port, "/status?format=json"))
+        methods = status.get("methods", {})
+        if methods:
+            name, st = sorted(methods.items())[0]
+            out["status_json_method"] = name
+            out["status_json_qps"] = st.get("qps", 0)
+        metrics = _http(port, "/metrics")
+        for family, key in (
+            ("rpc_dispatcher_epoll_waits", "dispatcher_epoll_waits"),
+            ("rpc_dispatcher_events", "dispatcher_events"),
+            ("rpc_scheduler_steals", "scheduler_steals"),
+            ("rpc_socket_write_batch_bytes_count", "socket_write_batches"),
+        ):
+            total = 0.0
+            for line in metrics.splitlines():
+                if line.startswith(family + "{") or \
+                        line.startswith(family + " "):
+                    try:
+                        total += float(line.rsplit(" ", 1)[1])
+                    except ValueError:
+                        pass
+            out[key] = int(total)
+        profdir = REPO / "profiles"
+        profdir.mkdir(exist_ok=True)
+        heap = _http(port, "/hotspots/heap?raw=1", timeout=20)
+        if "--- maps ---" in heap:
+            path = profdir / "bench_heap_latest.prof"
+            path.write_text(heap)
+            out["heap_profile_path"] = str(path.relative_to(REPO))
+        cpu = _http(port, "/hotspots/cpu?seconds=1", timeout=30)
+        if "cpu profile:" in cpu:
+            path = profdir / "bench_cpu_latest.prof"
+            path.write_text(cpu)
+            out["cpu_profile_path"] = str(path.relative_to(REPO))
+    except Exception:
+        pass
+    finally:
+        if prev_interval is not None:
+            try:
+                _http(port, "/flags/heap_profiler_sample_bytes?setvalue=%d"
+                      % prev_interval)
+            except Exception:
+                pass
+    return out
+
+
+def _http(port, path, timeout=5):
+    url = "http://127.0.0.1:%d%s" % (port, path)
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.read().decode()
+
+
 def series_scrape():
     """Time-series trajectory for the BENCH record: boot one mesh_node,
     drive it with rpc_press --metrics_csv, then scrape the server's own
@@ -153,7 +233,7 @@ def series_scrape():
                    "benchpb_EchoService_Echo_qps" % port)
             with urllib.request.urlopen(url, timeout=5) as r:
                 ring = json.loads(r.read().decode())
-            out = {}
+            out = perf_attrib_scrape(port)
             rows = [r for r in csv.read_text().splitlines()[1:] if r]
             if rows:
                 cols = [r.split(",") for r in rows]
@@ -176,7 +256,115 @@ def series_scrape():
                 proc.wait()  # reap: no zombie holding the port
 
 
+# Compare-mode metric directions: latency-ish keys regress UP, the rest
+# (throughput/qps/counts) regress DOWN. Non-numeric values, series
+# arrays, evidence paths, and derived ratios are skipped — as are the
+# raw attribution ACTIVITY counters (epoll waits, steals, write
+# batches, point-in-time qps): they are context for reading a
+# regression, not quality metrics with a better-direction (write
+# coalescing LOWERS socket_write_batches at identical throughput and
+# must not flag as a regression).
+_SKIP_KEYS = {"metric", "unit", "vs_baseline", "reps", "error",
+              "status_json_method", "heap_profile_path",
+              "cpu_profile_path", "dispatcher_epoll_waits",
+              "dispatcher_events", "scheduler_steals",
+              "socket_write_batches", "status_json_qps"}
+
+
+def _lower_is_better(key):
+    return any(t in key for t in
+               ("p50", "p90", "p99", "p999", "_us", "latency"))
+
+
+def compare_benches(prev_path, cur_path, strict, threshold):
+    """Per-metric delta report between two BENCH jsons. Returns the exit
+    code: non-zero only when --strict and a regression beyond
+    `threshold` exists."""
+    def load_bench(path):
+        data = json.loads(Path(path).read_text())
+        # Committed BENCH_rNN.json files are driver wrappers with the
+        # metrics line in "tail"; a raw bench.py line parses directly.
+        if isinstance(data.get("tail"), str):
+            start = data["tail"].find("{")
+            if start >= 0:
+                data = json.loads(data["tail"][start:])
+        return data
+
+    prev = load_bench(prev_path)
+    cur = load_bench(cur_path)
+    rows = []
+    regressions = []
+    for key in sorted(set(prev) & set(cur)):
+        if key in _SKIP_KEYS or key.endswith("_series") or \
+                key.endswith("_series_tail"):
+            continue
+        pv, cv = prev[key], cur[key]
+        if not isinstance(pv, (int, float)) or \
+                not isinstance(cv, (int, float)):
+            continue
+        if pv == 0:
+            delta = 0.0 if cv == 0 else float("inf")
+        else:
+            delta = (cv - pv) / abs(pv)
+        worse = -delta if _lower_is_better(key) else delta
+        flag = ""
+        if worse < -threshold:
+            flag = "REGRESSION"
+            regressions.append(key)
+        elif worse > threshold:
+            flag = "improved"
+        rows.append((key, pv, cv, delta, flag))
+    print("regression gate: %s -> %s  (threshold %.0f%%, %s)"
+          % (prev_path, cur_path, threshold * 100,
+             "strict" if strict else "report-only"))
+    print("%-28s %14s %14s %9s  %s"
+          % ("metric", "prev", "cur", "delta", ""))
+    for key, pv, cv, delta, flag in rows:
+        print("%-28s %14g %14g %8.1f%%  %s"
+              % (key, pv, cv, delta * 100, flag))
+    for evidence in ("cpu_profile_path", "heap_profile_path"):
+        if cur.get(evidence):
+            print("evidence: %s = %s" % (evidence, cur[evidence]))
+    if regressions:
+        print("%d regression(s): %s" % (len(regressions),
+                                        ", ".join(regressions)))
+        return 1 if strict else 0
+    print("no regressions past threshold")
+    return 0
+
+
+def _arg_value(argv, name):
+    if name in argv:
+        i = argv.index(name)
+        if i + 1 < len(argv):
+            return argv[i + 1]
+    return None
+
+
 def main():
+    argv = sys.argv[1:]
+    prev_path = _arg_value(argv, "--compare")
+    if prev_path is not None:
+        cur_path = _arg_value(argv, "--current")
+        threshold = float(_arg_value(argv, "--threshold") or 0.15)
+        strict = "--strict" in argv
+        if cur_path is None:
+            # No current json: run the bench now, save, then gate.
+            import io
+            from contextlib import redirect_stdout
+            buf = io.StringIO()
+            with redirect_stdout(buf):
+                run_bench()
+            line = buf.getvalue().strip().splitlines()[-1]
+            cur = Path(tempfile.gettempdir()) / "BENCH_current.json"
+            cur.write_text(line + "\n")
+            print(line)
+            cur_path = str(cur)
+        sys.exit(compare_benches(prev_path, cur_path, strict, threshold))
+    run_bench()
+
+
+def run_bench():
     try:
         build()
     except Exception:

@@ -1,0 +1,534 @@
+#include "tbase/heap_profiler.h"
+
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include <algorithm>
+#include <atomic>
+#include <map>
+#include <mutex>
+#include <new>
+#include <unordered_map>
+#include <vector>
+
+#include "tbase/flags.h"
+#include "tbase/stack_walk.h"
+#include "tbase/symbolize.h"
+
+// Sample one allocation stack every this many operator-new bytes
+// (deterministic per-thread countdown). 0 disables sampling; deletes
+// then cost one relaxed load. Live-togglable via /flags.
+DEFINE_int64(heap_profiler_sample_bytes, 512 * 1024,
+             "heap profiler: sample one allocation stack every N "
+             "allocated bytes; 0 disables");
+// Offsets the FIRST sample of each thread by seed%interval bytes so a
+// test can phase-shift the deterministic sample set; 0 = sample after a
+// full interval.
+DEFINE_int64(heap_profiler_sample_seed, 0,
+             "heap profiler: initial countdown offset (bytes)");
+
+namespace tpurpc {
+namespace heap_prof {
+
+namespace {
+
+constexpr int kDepth = 8;       // frames kept per sampled stack
+constexpr size_t kMaxStacks = 4096;  // distinct-stack table bound
+constexpr int kShards = 64;     // live-pointer table sharding
+
+// All hot-path globals are constant-initialized PODs/atomics: the
+// interposed operator new runs during OTHER TUs' static init, long
+// before this TU's flag objects construct. Until the flag-sync object
+// below runs, g_interval is 0 and sampling is off — exactly right for
+// early allocations.
+std::atomic<int64_t> g_interval{0};
+std::atomic<int64_t> g_seed{0};
+std::atomic<int64_t> g_nlive{0};  // live sampled pointers, process-wide
+
+// Per-thread state. Trivially-initialized thread_locals only: a ctor
+// would recurse through operator new during TLS init.
+thread_local int64_t tls_countdown = -1;  // -1: derive from flags
+thread_local bool tls_in_hook = false;    // reentrancy guard
+
+struct StackKey {
+    uintptr_t pc[kDepth];
+    bool operator<(const StackKey& o) const {
+        return memcmp(pc, o.pc, sizeof(pc)) < 0;
+    }
+};
+
+// Atomics so the delete path can decrement without the table lock
+// (std::map nodes are address-stable).
+struct StackStat {
+    std::atomic<int64_t> live_bytes{0};
+    std::atomic<int64_t> live_count{0};
+    std::atomic<int64_t> growth_bytes{0};
+    std::atomic<int64_t> growth_count{0};
+};
+
+struct StackTable {
+    std::mutex mu;
+    std::map<StackKey, StackStat> stacks;
+    StackStat overflow;  // everything past kMaxStacks lands here
+};
+
+StackTable* stack_table() {
+    // First call happens under tls_in_hook (the nested `new` of the
+    // table itself must not re-enter sampling).
+    static StackTable* t = new StackTable;
+    return t;
+}
+
+struct LiveRec {
+    size_t size;
+    StackStat* stat;
+};
+
+// Sharded live-pointer table. The per-shard `filter` is a 64-bit mini
+// bloom over the shard's live pointers: the delete hot path (every
+// operator delete in the process while any sample is live) is one
+// relaxed load + bit test in the overwhelmingly common not-sampled
+// case. Bits only clear when the shard empties — with a few hundred
+// live samples the filter stays sparse.
+struct Shard {
+    std::mutex mu;
+    std::atomic<uint64_t> filter{0};
+    std::unordered_map<void*, LiveRec> live;
+};
+
+Shard* shards() {
+    static Shard* s = new Shard[kShards];
+    return s;
+}
+
+inline uint64_t ptr_hash(void* p) {
+    return (uint64_t)(uintptr_t)p * 0x9E3779B97F4A7C15ull;
+}
+inline int shard_of(uint64_t h) { return (int)((h >> 8) & (kShards - 1)); }
+inline uint64_t filter_bit(uint64_t h) { return 1ull << ((h >> 14) & 63); }
+
+// Capture + record ONE sampled allocation. Runs with tls_in_hook set:
+// the map/node allocations below bypass sampling.
+// noinline + the always_inline wrappers below pin the frame layout at
+// every optimization level: walk_current's caller chain is exactly
+// [RecordAlloc, operator new, <real allocation site>...], which is what
+// the skip=2 below assumes.
+__attribute__((noinline)) void RecordAlloc(void* p, size_t size) {
+    uintptr_t frames[kDepth];
+    // skip=2 drops RecordAlloc + the operator new wrapper; the leaf of
+    // the recorded stack is the real allocation site.
+    size_t n = stack_walk::walk_current(frames, (size_t)kDepth, 2);
+    StackKey key;
+    memset(&key, 0, sizeof(key));
+    for (size_t i = 0; i < n; ++i) key.pc[i] = frames[i];
+
+    StackTable* st = stack_table();
+    StackStat* stat;
+    {
+        std::lock_guard<std::mutex> g(st->mu);
+        auto it = st->stacks.find(key);
+        if (it != st->stacks.end()) {
+            stat = &it->second;
+        } else if (st->stacks.size() < kMaxStacks) {
+            stat = &st->stacks[key];
+        } else {
+            stat = &st->overflow;
+        }
+    }
+    stat->live_bytes.fetch_add((int64_t)size, std::memory_order_relaxed);
+    stat->live_count.fetch_add(1, std::memory_order_relaxed);
+    stat->growth_bytes.fetch_add((int64_t)size, std::memory_order_relaxed);
+    stat->growth_count.fetch_add(1, std::memory_order_relaxed);
+
+    const uint64_t h = ptr_hash(p);
+    Shard& sh = shards()[shard_of(h)];
+    {
+        std::lock_guard<std::mutex> g(sh.mu);
+        sh.live[p] = LiveRec{size, stat};
+    }
+    sh.filter.fetch_or(filter_bit(h), std::memory_order_relaxed);
+    g_nlive.fetch_add(1, std::memory_order_release);
+}
+
+__attribute__((always_inline)) inline void MaybeSample(void* p,
+                                                       size_t size) {
+    const int64_t interval = g_interval.load(std::memory_order_relaxed);
+    if (interval <= 0 || p == nullptr) return;
+    if (tls_in_hook) return;
+    int64_t cd = tls_countdown;
+    if (cd < 0) {
+        const int64_t seed = g_seed.load(std::memory_order_relaxed);
+        cd = interval - (seed > 0 ? seed % interval : 0);
+        if (cd <= 0) cd = 1;
+    }
+    cd -= (int64_t)size;
+    if (cd > 0) {
+        tls_countdown = cd;
+        return;
+    }
+    tls_countdown = interval;  // deterministic: always a full interval
+    tls_in_hook = true;
+    RecordAlloc(p, size);
+    tls_in_hook = false;
+}
+
+inline void MaybeUnsample(void* p) {
+    if (p == nullptr) return;
+    if (g_nlive.load(std::memory_order_acquire) == 0) return;
+    // The bookkeeping below frees unordered_map nodes through operator
+    // delete; without the guard that nested delete could hash into the
+    // shard whose mutex we hold.
+    if (tls_in_hook) return;
+    const uint64_t h = ptr_hash(p);
+    Shard& sh = shards()[shard_of(h)];
+    if ((sh.filter.load(std::memory_order_relaxed) & filter_bit(h)) == 0) {
+        return;  // definitely not a sampled pointer
+    }
+    tls_in_hook = true;
+    {
+        std::lock_guard<std::mutex> g(sh.mu);
+        auto it = sh.live.find(p);
+        if (it != sh.live.end()) {
+            it->second.stat->live_bytes.fetch_sub(
+                (int64_t)it->second.size, std::memory_order_relaxed);
+            it->second.stat->live_count.fetch_sub(1,
+                                                  std::memory_order_relaxed);
+            sh.live.erase(it);
+            if (sh.live.empty()) {
+                sh.filter.store(0, std::memory_order_relaxed);
+            }
+            g_nlive.fetch_sub(1, std::memory_order_release);
+        }
+    }
+    tls_in_hook = false;
+}
+
+// Mirror the flags into the POD globals at this TU's static init (flags
+// above construct first — same TU, in order) and on every live /flags
+// mutation.
+struct FlagSync {
+    FlagSync() {
+        g_interval.store(FLAGS_heap_profiler_sample_bytes.get(),
+                         std::memory_order_relaxed);
+        g_seed.store(FLAGS_heap_profiler_sample_seed.get(),
+                     std::memory_order_relaxed);
+        FLAGS_heap_profiler_sample_bytes.set_on_change([] {
+            g_interval.store(FLAGS_heap_profiler_sample_bytes.get(),
+                             std::memory_order_relaxed);
+        });
+        FLAGS_heap_profiler_sample_seed.set_on_change([] {
+            g_seed.store(FLAGS_heap_profiler_sample_seed.get(),
+                         std::memory_order_relaxed);
+        });
+    }
+} g_flag_sync;
+
+#ifndef __has_feature
+#define __has_feature(x) 0  // gcc signals ASan via __SANITIZE_ADDRESS__
+#endif
+#if defined(__SANITIZE_ADDRESS__) || __has_feature(address_sanitizer)
+constexpr bool kInterposed = false;
+#else
+constexpr bool kInterposed = true;
+#endif
+
+// Public dump/reset APIs allocate (vectors, strings) while holding the
+// table/shard locks; without this guard one of those allocations could
+// cross the sample threshold and re-enter RecordAlloc on the SAME
+// non-recursive mutex. Sampling is suspended for the calling thread.
+struct HookGuard {
+    bool prev;
+    HookGuard() : prev(tls_in_hook) { tls_in_hook = true; }
+    ~HookGuard() { tls_in_hook = prev; }
+};
+
+struct Row {
+    StackKey key;
+    int64_t bytes;
+    int64_t count;
+};
+
+std::vector<Row> SnapshotRows(bool growth) {
+    std::vector<Row> rows;
+    StackTable* st = stack_table();
+    std::lock_guard<std::mutex> g(st->mu);
+    rows.reserve(st->stacks.size() + 1);
+    auto push = [&](const StackKey& key, const StackStat& s) {
+        const int64_t b = growth
+                              ? s.growth_bytes.load(std::memory_order_relaxed)
+                              : s.live_bytes.load(std::memory_order_relaxed);
+        const int64_t c = growth
+                              ? s.growth_count.load(std::memory_order_relaxed)
+                              : s.live_count.load(std::memory_order_relaxed);
+        if (b > 0 || c > 0) rows.push_back(Row{key, b, c});
+    };
+    for (const auto& kv : st->stacks) push(kv.first, kv.second);
+    StackKey zero;
+    memset(&zero, 0, sizeof(zero));
+    push(zero, st->overflow);
+    std::sort(rows.begin(), rows.end(), [](const Row& a, const Row& b) {
+        return a.bytes > b.bytes;
+    });
+    return rows;
+}
+
+void AppendMaps(std::string* out) {
+    out->append("--- maps ---\n");
+    FILE* maps = fopen("/proc/self/maps", "r");
+    if (maps != nullptr) {
+        char buf[4096];
+        size_t nr;
+        while ((nr = fread(buf, 1, sizeof(buf), maps)) > 0) {
+            out->append(buf, nr);
+        }
+        fclose(maps);
+    }
+}
+
+}  // namespace
+}  // namespace heap_prof
+
+bool HeapProfilerActive() {
+    return heap_prof::kInterposed &&
+           heap_prof::g_interval.load(std::memory_order_relaxed) > 0;
+}
+
+HeapProfilerStats GetHeapProfilerStats() {
+    heap_prof::HookGuard guard;
+    HeapProfilerStats out;
+    heap_prof::StackTable* st = heap_prof::stack_table();
+    std::lock_guard<std::mutex> g(st->mu);
+    auto fold = [&](const heap_prof::StackStat& s) {
+        out.live_bytes += s.live_bytes.load(std::memory_order_relaxed);
+        out.live_count += s.live_count.load(std::memory_order_relaxed);
+        out.growth_bytes += s.growth_bytes.load(std::memory_order_relaxed);
+        out.growth_count += s.growth_count.load(std::memory_order_relaxed);
+    };
+    for (const auto& kv : st->stacks) fold(kv.second);
+    fold(st->overflow);
+    out.stacks = (int64_t)st->stacks.size();
+    return out;
+}
+
+std::string HeapProfileRaw(bool growth) {
+    heap_prof::HookGuard guard;
+    const std::vector<heap_prof::Row> rows = heap_prof::SnapshotRows(growth);
+    int64_t total = 0;
+    for (const auto& r : rows) total += r.bytes;
+    std::string out;
+    char line[512];
+    snprintf(line, sizeof(line),
+             "%s profile: %zu stacks, %lld sampled %s bytes "
+             "(interval %lld, deterministic countdown)\n",
+             growth ? "growth" : "heap", rows.size(), (long long)total,
+             growth ? "allocated" : "live",
+             (long long)heap_prof::g_interval.load(std::memory_order_relaxed));
+    out += line;
+    for (const auto& r : rows) {
+        snprintf(line, sizeof(line), "%lld %lld @", (long long)r.bytes,
+                 (long long)r.count);
+        out += line;
+        for (int d = 0; d < heap_prof::kDepth && r.key.pc[d] != 0; ++d) {
+            snprintf(line, sizeof(line), " %lx", (unsigned long)r.key.pc[d]);
+            out += line;
+        }
+        if (r.key.pc[0] == 0) out += " 0";  // the overflow bucket
+        out += "\n";
+    }
+    heap_prof::AppendMaps(&out);
+    return out;
+}
+
+std::string HeapProfileSymbolized(bool growth, int top_n) {
+    heap_prof::HookGuard guard;
+    std::vector<heap_prof::Row> rows = heap_prof::SnapshotRows(growth);
+    int64_t total = 0, total_count = 0;
+    for (const auto& r : rows) {
+        total += r.bytes;
+        total_count += r.count;
+    }
+    std::string out;
+    char line[512];
+    snprintf(line, sizeof(line),
+             "%s profile: %zu stacks, %lld sampled %s bytes in %lld "
+             "allocations (sample interval %lld bytes)\n",
+             growth ? "growth" : "heap", rows.size(), (long long)total,
+             growth ? "allocated" : "live", (long long)total_count,
+             (long long)heap_prof::g_interval.load(std::memory_order_relaxed));
+    out += line;
+    if (!heap_prof::kInterposed) {
+        out += "(allocator interposition compiled out under ASan)\n";
+        return out;
+    }
+    if (rows.empty()) {
+        out += growth ? "(no sampled allocations since reset)\n"
+                      : "(no sampled allocations live)\n";
+        return out;
+    }
+    out += "\n       bytes  allocs  stack (leaf first)\n";
+    if ((int)rows.size() > top_n) rows.resize((size_t)top_n);
+    for (const auto& r : rows) {
+        if (r.key.pc[0] == 0) {
+            snprintf(line, sizeof(line), "%12lld %7lld  [stack-table overflow]\n",
+                     (long long)r.bytes, (long long)r.count);
+            out += line;
+            continue;
+        }
+        snprintf(line, sizeof(line), "%12lld %7lld  %s\n", (long long)r.bytes,
+                 (long long)r.count, SymbolizePc(r.key.pc[0]).c_str());
+        out += line;
+        for (int d = 1; d < heap_prof::kDepth && r.key.pc[d] != 0; ++d) {
+            snprintf(line, sizeof(line), "%12s %7s  %s\n", "", "",
+                     SymbolizePc(r.key.pc[d]).c_str());
+            out += line;
+        }
+    }
+    return out;
+}
+
+void ResetHeapGrowth() {
+    heap_prof::HookGuard guard;
+    heap_prof::StackTable* st = heap_prof::stack_table();
+    std::lock_guard<std::mutex> g(st->mu);
+    for (auto& kv : st->stacks) {
+        kv.second.growth_bytes.store(0, std::memory_order_relaxed);
+        kv.second.growth_count.store(0, std::memory_order_relaxed);
+    }
+    st->overflow.growth_bytes.store(0, std::memory_order_relaxed);
+    st->overflow.growth_count.store(0, std::memory_order_relaxed);
+}
+
+void ResetHeapProfilerForTest() {
+    using namespace heap_prof;
+    {
+        HookGuard guard;
+        // Shards first (drop live records), then ZERO the stack stats in
+        // place. The map nodes are never freed: a concurrently-sampling
+        // thread may hold a StackStat* it resolved under st->mu before we
+        // got here, so clear()ing the map would be a use-after-free. Nodes
+        // are address-stable and bounded by kMaxStacks; zeroed rows are
+        // filtered out of every dump (b > 0 || c > 0), so the views come
+        // back empty all the same.
+        Shard* sh = shards();
+        for (int i = 0; i < kShards; ++i) {
+            std::lock_guard<std::mutex> g(sh[i].mu);
+            sh[i].live.clear();
+            sh[i].filter.store(0, std::memory_order_relaxed);
+        }
+        g_nlive.store(0, std::memory_order_release);
+        StackTable* st = stack_table();
+        std::lock_guard<std::mutex> g(st->mu);
+        auto zero = [](StackStat& s) {
+            s.live_bytes.store(0, std::memory_order_relaxed);
+            s.live_count.store(0, std::memory_order_relaxed);
+            s.growth_bytes.store(0, std::memory_order_relaxed);
+            s.growth_count.store(0, std::memory_order_relaxed);
+        };
+        for (auto& kv : st->stacks) zero(kv.second);
+        zero(st->overflow);
+    }
+    tls_countdown = -1;
+}
+
+}  // namespace tpurpc
+
+// ---------------- allocator interposition ----------------
+// Global operator new/delete replacements, exported from the framework
+// shared library and therefore interposed for every C++ allocation in
+// the process (the reference relies on tcmalloc linkage for the same
+// effect). Compiled out under ASan: its runtime owns these symbols and
+// its allocator must not be half-bypassed.
+
+#if !defined(__SANITIZE_ADDRESS__) && !__has_feature(address_sanitizer)
+
+namespace {
+
+__attribute__((always_inline)) inline void* tpurpc_alloc(size_t size) {
+    void* p = malloc(size != 0 ? size : 1);
+    tpurpc::heap_prof::MaybeSample(p, size);
+    return p;
+}
+
+__attribute__((always_inline)) inline void* tpurpc_alloc_aligned(
+    size_t size, size_t align) {
+    if (align < sizeof(void*)) align = sizeof(void*);
+    void* p = nullptr;
+    if (posix_memalign(&p, align, size != 0 ? size : 1) != 0) return nullptr;
+    tpurpc::heap_prof::MaybeSample(p, size);
+    return p;
+}
+
+inline void tpurpc_free(void* p) {
+    tpurpc::heap_prof::MaybeUnsample(p);
+    free(p);
+}
+
+// Throwing operator new must run the std::new_handler loop ([new.delete
+// .single]p4): give an installed handler the chance to release memory
+// and retry; only throw once no handler is left.
+template <typename Alloc>
+__attribute__((always_inline)) inline void* alloc_with_handler(
+    Alloc alloc) {
+    for (;;) {
+        void* p = alloc();
+        if (p != nullptr) return p;
+        std::new_handler h = std::get_new_handler();
+        if (h == nullptr) throw std::bad_alloc();
+        h();
+    }
+}
+
+}  // namespace
+
+void* operator new(size_t size) {
+    return alloc_with_handler([size] { return tpurpc_alloc(size); });
+}
+void* operator new[](size_t size) {
+    return alloc_with_handler([size] { return tpurpc_alloc(size); });
+}
+void* operator new(size_t size, const std::nothrow_t&) noexcept {
+    return tpurpc_alloc(size);
+}
+void* operator new[](size_t size, const std::nothrow_t&) noexcept {
+    return tpurpc_alloc(size);
+}
+void* operator new(size_t size, std::align_val_t al) {
+    return alloc_with_handler(
+        [size, al] { return tpurpc_alloc_aligned(size, (size_t)al); });
+}
+void* operator new[](size_t size, std::align_val_t al) {
+    return alloc_with_handler(
+        [size, al] { return tpurpc_alloc_aligned(size, (size_t)al); });
+}
+void* operator new(size_t size, std::align_val_t al,
+                   const std::nothrow_t&) noexcept {
+    return tpurpc_alloc_aligned(size, (size_t)al);
+}
+void* operator new[](size_t size, std::align_val_t al,
+                     const std::nothrow_t&) noexcept {
+    return tpurpc_alloc_aligned(size, (size_t)al);
+}
+
+void operator delete(void* p) noexcept { tpurpc_free(p); }
+void operator delete[](void* p) noexcept { tpurpc_free(p); }
+void operator delete(void* p, size_t) noexcept { tpurpc_free(p); }
+void operator delete[](void* p, size_t) noexcept { tpurpc_free(p); }
+void operator delete(void* p, const std::nothrow_t&) noexcept {
+    tpurpc_free(p);
+}
+void operator delete[](void* p, const std::nothrow_t&) noexcept {
+    tpurpc_free(p);
+}
+void operator delete(void* p, std::align_val_t) noexcept { tpurpc_free(p); }
+void operator delete[](void* p, std::align_val_t) noexcept {
+    tpurpc_free(p);
+}
+void operator delete(void* p, size_t, std::align_val_t) noexcept {
+    tpurpc_free(p);
+}
+void operator delete[](void* p, size_t, std::align_val_t) noexcept {
+    tpurpc_free(p);
+}
+
+#endif  // !ASan

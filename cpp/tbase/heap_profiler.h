@@ -1,0 +1,62 @@
+// Sampling heap profiler — the engine behind /hotspots/heap and
+// /hotspots/growth (reference: gperftools tcmalloc's sampling profiler
+// behind brpc's heap_profiler portal; here we own the sampler so the
+// framework has no external allocator dependency).
+//
+// operator new/delete are interposed process-wide (heap_profiler.cc):
+// every `-heap_profiler_sample_bytes` allocated bytes, ONE allocation's
+// stack is captured with tbase/stack_walk.h and attributed. Two views:
+//   live   — sampled bytes currently allocated, by stack (leaks, caches)
+//   growth — cumulative sampled bytes allocated since the last reset
+//            (churn: who allocates, even if they free promptly)
+// Sampling is a deterministic per-thread byte countdown (no RNG): a
+// fixed seed + the same allocation sequence reproduce the same sample
+// set, which is what makes the profiler testable.
+//
+// Raw dump format (tools/symbolize_prof.py understands it):
+//   heap profile: <stacks> stacks, <bytes> sampled live bytes ...
+//   <bytes> <count> @ <pc1> <pc2> ...
+//   --- maps ---
+//   <copy of /proc/self/maps>
+//
+// Direct malloc()/free() callers bypass operator new and are NOT
+// sampled (IOBuf block pools keep their own accounting in /memory).
+// Under ASan the interposers are compiled out (ASan owns the allocator)
+// and both views report empty.
+#pragma once
+
+#include <cstdint>
+#include <string>
+
+namespace tpurpc {
+
+struct HeapProfilerStats {
+    int64_t live_bytes = 0;    // sampled bytes still allocated
+    int64_t live_count = 0;    // sampled allocations still allocated
+    int64_t growth_bytes = 0;  // sampled bytes allocated since reset
+    int64_t growth_count = 0;
+    int64_t stacks = 0;        // distinct stacks in the table
+};
+
+// Sampling is on (interval > 0) and the interposers are compiled in.
+bool HeapProfilerActive();
+
+HeapProfilerStats GetHeapProfilerStats();
+
+// Raw pprof-style text (stacks + maps) for offline symbolization.
+// growth=false: live bytes by stack; growth=true: cumulative since reset.
+std::string HeapProfileRaw(bool growth);
+
+// In-server symbolized rendering (tbase/symbolize.h, like /hotspots/cpu):
+// top `top_n` stacks by bytes, one indented frame list each.
+std::string HeapProfileSymbolized(bool growth, int top_n = 40);
+
+// Zero the cumulative growth counters (the /hotspots/growth?reset=1
+// action); live attribution is untouched.
+void ResetHeapGrowth();
+
+// Tests only: drop every table AND restart the calling thread's sample
+// countdown so a fixed allocation sequence reproduces exactly.
+void ResetHeapProfilerForTest();
+
+}  // namespace tpurpc

@@ -46,6 +46,38 @@ inline bool safe_read_frame(uintptr_t fp, uintptr_t out[2]) {
            (ssize_t)(2 * sizeof(uintptr_t));
 }
 
+// Walk the CALLING thread's own frame chain (no signal context) —
+// the allocation-site capture path of the heap profiler
+// (tbase/heap_profiler.cc). Same hardening as walk(): safe frame reads
+// (a sampled allocation can come from foreign code built without frame
+// pointers) and the monotonic 1MB span bound. `skip` drops the
+// innermost frames (the profiler's own bookkeeping). noinline so the
+// first captured frame is a REAL caller, not an inlining artifact.
+__attribute__((noinline)) inline size_t walk_current(uintptr_t* frames,
+                                                     size_t max,
+                                                     size_t skip = 0) {
+    if (max == 0) return 0;
+    uintptr_t fp = (uintptr_t)__builtin_frame_address(0);
+    size_t n = 0;
+    const uintptr_t lo = fp;
+    const uintptr_t hi = fp + (1u << 20);
+    while (n < max && fp >= lo && fp < hi && (fp & 7) == 0 && fp != 0) {
+        uintptr_t frame[2];
+        if (!safe_read_frame(fp, frame)) break;
+        const uintptr_t next_fp = frame[0];
+        const uintptr_t ret_pc = frame[1];
+        if (ret_pc == 0) break;
+        if (skip > 0) {
+            --skip;
+        } else {
+            frames[n++] = ret_pc;
+        }
+        if (next_fp <= fp) break;
+        fp = next_fp;
+    }
+    return n;
+}
+
 // Walk from a signal context into frames[0..max); returns frame count.
 // Fibers run on mmap'd stacks, so only monotonically-increasing frame
 // pointers within a 1MB span are trusted.

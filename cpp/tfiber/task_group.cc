@@ -16,6 +16,8 @@
 #include "tbase/resource_pool.h"
 #include "tfiber/butex.h"
 #include "tfiber/timer_thread.h"
+#include "tvar/multi_dimension.h"
+#include "tvar/reducer.h"
 
 // 0 = auto: hardware_concurrency + 1, min 4 (the reference defaults to
 // cores+1 via FLAGS_bthread_concurrency; a fixed count would cap
@@ -28,6 +30,30 @@ namespace tpurpc {
 
 namespace {
 thread_local TaskGroup* tls_task_group = nullptr;
+
+// Scheduler telemetry families, one series per worker pool
+// ({pool="tag"}). Created on first pool start (runtime, never
+// static-init); the /loops builtin and the series rings read them.
+LabelledMetric<IntCell>* sched_steals() {
+    static auto* m =
+        new LabelledMetric<IntCell>("rpc_scheduler_steals", {"pool"});
+    return m;
+}
+LabelledMetric<IntCell>* sched_remote_overflows() {
+    static auto* m = new LabelledMetric<IntCell>(
+        "rpc_scheduler_remote_overflows", {"pool"});
+    return m;
+}
+LabelledMetric<IntCell>* sched_urgent() {
+    static auto* m = new LabelledMetric<IntCell>(
+        "rpc_scheduler_urgent_handoffs", {"pool"});
+    return m;
+}
+LabelledMetric<IntCell>* sched_rq_highwater() {
+    static auto* m = new LabelledMetric<IntCell>(
+        "rpc_scheduler_runqueue_highwater", {"pool"});
+    return m;
+}
 }  // namespace
 
 TaskGroup* TaskGroup::tls_group() { return tls_task_group; }
@@ -217,12 +243,20 @@ void TaskGroup::ready_to_run(TaskMeta* m) {
         control_->ready_to_run_remote(m);
         return;
     }
+    // Run-queue depth high-water: a sustained climb means admission
+    // outruns dispatch (the ROADMAP item-4 signature). One relaxed load
+    // + compare in the common (not-a-new-max) case.
+    if (control_->rq_highwater_cell_ != nullptr) {
+        control_->rq_highwater_cell_->update_max(
+            (int64_t)rq_.volatile_size());
+    }
     control_->parking_lot().signal(1);
 }
 
 void TaskGroup::run_urgent(TaskMeta* m) {
     TaskMeta* self = cur_meta_;
     next_meta_ = m;
+    if (control_->urgent_cell_ != nullptr) control_->urgent_cell_->add(1);
     set_remained(requeue_meta_cb, self);
     sched_park();
 }
@@ -293,8 +327,32 @@ void TaskControl::ensure_started() {
             concurrency = (int)std::max(4u, hc + 1);
         }
     }
+    // Telemetry cells before the first worker runs: the hot paths
+    // null-check but never lock the family mutex.
+    const std::string pool = std::to_string(tag_);
+    steals_cell_ = sched_steals()->get_stats({pool});
+    remote_overflow_cell_ = sched_remote_overflows()->get_stats({pool});
+    urgent_cell_ = sched_urgent()->get_stats({pool});
+    rq_highwater_cell_ = sched_rq_highwater()->get_stats({pool});
     add_workers_locked(concurrency);
     started_.store(true, std::memory_order_release);
+}
+
+int64_t TaskControl::steals() const {
+    return steals_cell_ != nullptr ? steals_cell_->get() : 0;
+}
+int64_t TaskControl::remote_overflows() const {
+    return remote_overflow_cell_ != nullptr ? remote_overflow_cell_->get()
+                                            : 0;
+}
+int64_t TaskControl::urgent_handoffs() const {
+    return urgent_cell_ != nullptr ? urgent_cell_->get() : 0;
+}
+int64_t TaskControl::runqueue_highwater() const {
+    return rq_highwater_cell_ != nullptr ? rq_highwater_cell_->get() : 0;
+}
+void TaskControl::reset_runqueue_highwater() {
+    if (rq_highwater_cell_ != nullptr) rq_highwater_cell_->set(0);
 }
 
 void TaskControl::add_workers_locked(int n) {
@@ -342,9 +400,14 @@ void TaskControl::ready_to_run_remote(TaskMeta* m) {
     if (!remote_ring_.push(m)) {
         // Ring full: spill to the mutexed overflow list rather than
         // spinning — fiber spawns must never be dropped or block.
-        std::lock_guard<std::mutex> g(overflow_mu_);
-        overflow_q_.push_back(m);
-        overflow_size_.fetch_add(1, std::memory_order_release);
+        {
+            std::lock_guard<std::mutex> g(overflow_mu_);
+            overflow_q_.push_back(m);
+            overflow_size_.fetch_add(1, std::memory_order_release);
+        }
+        if (remote_overflow_cell_ != nullptr) {
+            remote_overflow_cell_->add(1);
+        }
     }
     parking_lot_.signal(1);
 }
@@ -389,7 +452,10 @@ bool TaskControl::steal_task(TaskMeta** m, uint64_t* seed, int exclude) {
     for (size_t i = 0; i < n; ++i) {
         const size_t idx = (start + i) % n;
         if ((int)idx == exclude) continue;
-        if (groups_[idx]->steal(m)) return true;
+        if (groups_[idx]->steal(m)) {
+            if (steals_cell_ != nullptr) steals_cell_->add(1);
+            return true;
+        }
     }
     return false;
 }

@@ -28,6 +28,7 @@
 namespace tpurpc {
 
 class TaskControl;
+class IntCell;
 
 class TaskGroup {
 public:
@@ -139,6 +140,17 @@ public:
     bool stopped() const { return stopped_.load(std::memory_order_acquire); }
     void stop_and_join();
 
+    // ---- scheduler telemetry (ISSUE 6; the /loops builtin) ----
+    // Labelled families rpc_scheduler_{steals,remote_overflows,
+    // urgent_handoffs,runqueue_highwater}{pool="tag"}. Cells are created
+    // at pool start; the hot paths update through raw pointers (relaxed
+    // atomics) and are no-ops before then.
+    int64_t steals() const;
+    int64_t remote_overflows() const;
+    int64_t urgent_handoffs() const;
+    int64_t runqueue_highwater() const;
+    void reset_runqueue_highwater();  // /loops?reset=1
+
     std::atomic<int64_t> nfibers{0};  // live fibers (metrics)
 
 private:
@@ -165,6 +177,12 @@ private:
     std::atomic<size_t> overflow_size_{0};
     ParkingLot parking_lot_;
     int tag_ = 0;  // worker tag of this pool
+    // Telemetry cells (null until ensure_started creates this pool's
+    // label tuple).
+    IntCell* steals_cell_ = nullptr;
+    IntCell* remote_overflow_cell_ = nullptr;
+    IntCell* urgent_cell_ = nullptr;
+    IntCell* rq_highwater_cell_ = nullptr;
 
     friend class TaskGroup;
 };

@@ -14,7 +14,9 @@
 
 #include "tbase/cpu_profiler.h"
 #include "tbase/flags.h"
+#include "tbase/heap_profiler.h"
 #include "tbase/symbolize.h"
+#include "tnet/event_dispatcher.h"
 #include "tbase/thread_stacks.h"
 #include "tfiber/contention_profiler.h"
 #include "tfiber/fiber.h"
@@ -43,11 +45,12 @@ void HandleIndex(Server*, const HttpRequest&, HttpResponse* res) {
         "tpu-rpc server portal\n"
         "\n"
         "/health       liveness\n"
-        "/status       per-method stats\n"
+        "/status       per-method stats (?format=json machine form)\n"
         "/vars         exposed variables (/vars/<name> for one;\n"
         "              ?series=<name> 60s/60min/24h ring as JSON)\n"
         "/flags        runtime flags (/flags/<name>?setvalue=v to set)\n"
-        "/connections  accepted connections\n"
+        "/connections  accepted connections + per-socket I/O attribution\n"
+        "/loops        event-dispatcher + fiber-scheduler telemetry\n"
         "/rpcz         sampled per-RPC spans (enable_rpcz flag;\n"
         "              ?trace_id=N filter, &format=json machine form)\n"
         "/rpcz/trace/<id>  ONE cross-host stitched timeline for a trace\n"
@@ -56,8 +59,9 @@ void HandleIndex(Server*, const HttpRequest&, HttpResponse* res) {
         "/threads      pthread stack dump\n"
         "/version      build identification\n"
         "/memory       allocator statistics\n"
-        "/hotspots     profiling (/hotspots/cpu?seconds=N, "
-        "/hotspots/contention)\n"
+        "/hotspots     profiling (/hotspots/cpu?seconds=N,\n"
+        "              /hotspots/heap, /hotspots/growth,\n"
+        "              /hotspots/contention)\n"
         "/chaos        fault injection (?enable=1&seed=N&plan=...&peers=...)\n"
         "/metrics      prometheus exposition\n");
 }
@@ -111,6 +115,12 @@ void HandleHotspotsIndex(Server*, const HttpRequest&, HttpResponse* res) {
         "/hotspots/cpu?seconds=N   sample all threads for N seconds\n"
         "                          (default 2, max 30) and show the\n"
         "                          symbolized flat profile\n"
+        "/hotspots/heap            sampled LIVE bytes by allocation\n"
+        "                          stack (-heap_profiler_sample_bytes;\n"
+        "                          ?raw=1 for the offline-symbolizable\n"
+        "                          dump with /proc/self/maps)\n"
+        "/hotspots/growth          cumulative sampled allocations since\n"
+        "                          the last ?reset=1 (churn view)\n"
         "/hotspots/contention      fiber-mutex wait sites since the\n"
         "                          last view (?reset=1 to only clear)\n");
 }
@@ -164,6 +174,104 @@ void HandleHotspotsCpu(Server*, const HttpRequest& req, HttpResponse* res) {
                  SymbolizePc(e.second).c_str());
         res->Append(line);
     }
+}
+
+// /hotspots/heap and /hotspots/growth: the sampling heap profiler
+// (tbase/heap_profiler.h). Default view symbolizes in-server like
+// /hotspots/cpu; ?raw=1 returns the pprof-style dump (stacks + maps)
+// for tools/symbolize_prof.py.
+void HandleHotspotsHeap(Server*, const HttpRequest& req, HttpResponse* res) {
+    res->set_content_type("text/plain");
+    if (!HeapProfilerActive()) {
+        res->Append(
+            "heap profiler is off — set -heap_profiler_sample_bytes > 0\n"
+            "(e.g. /flags/heap_profiler_sample_bytes?setvalue=524288)\n");
+        return;
+    }
+    if (req.QueryParam("raw") == "1") {
+        res->Append(HeapProfileRaw(/*growth=*/false));
+        return;
+    }
+    res->Append(HeapProfileSymbolized(/*growth=*/false));
+}
+
+void HandleHotspotsGrowth(Server*, const HttpRequest& req,
+                          HttpResponse* res) {
+    res->set_content_type("text/plain");
+    if (req.QueryParam("reset") == "1") {
+        ResetHeapGrowth();
+        res->Append("growth counters reset\n");
+        return;
+    }
+    if (!HeapProfilerActive()) {
+        res->Append(
+            "heap profiler is off — set -heap_profiler_sample_bytes > 0\n");
+        return;
+    }
+    if (req.QueryParam("raw") == "1") {
+        res->Append(HeapProfileRaw(/*growth=*/true));
+        return;
+    }
+    res->Append(HeapProfileSymbolized(/*growth=*/true));
+}
+
+// /loops: where event-loop and scheduler cycles go — per-epoll-loop
+// wake/dispatch telemetry and per-worker-pool scheduling counters
+// (ISSUE 6). The same numbers are exported as labelled families
+// (rpc_dispatcher_*, rpc_scheduler_*) on /metrics and as
+// /vars?series=<family>_<label>_<value> rings. ?reset=1 clears the
+// run-queue high-waters (counters stay cumulative).
+void HandleLoops(Server*, const HttpRequest& req, HttpResponse* res) {
+    res->set_content_type("text/plain");
+    if (req.QueryParam("reset") == "1") {
+        TaskControl::ForEachPool(
+            [](int, TaskControl* c, void*) {
+                c->reset_runqueue_highwater();
+            },
+            nullptr);
+        res->Append("run-queue high-waters reset\n");
+        return;
+    }
+    res->Append(
+        "event dispatchers (epoll loops)\n"
+        "loop  epoll_waits   events      ev/wake p50/p99   "
+        "wake->dispatch us p50/p99/max\n");
+    EventDispatcher::ForEachLoop(
+        [](int idx, const EventDispatcher::LoopStats& st, void* arg) {
+            auto* r = (HttpResponse*)arg;
+            char line[256];
+            snprintf(line, sizeof(line),
+                     "%-5d %-13lld %-11lld %lld/%lld%*s%lld/%lld/%lld\n",
+                     idx, (long long)st.epoll_waits, (long long)st.events,
+                     (long long)st.events_per_wake->latency_percentile(0.5),
+                     (long long)st.events_per_wake->latency_percentile(0.99),
+                     10, "",
+                     (long long)st.wake_to_dispatch_us->latency_percentile(
+                         0.5),
+                     (long long)st.wake_to_dispatch_us->latency_percentile(
+                         0.99),
+                     (long long)st.wake_to_dispatch_us->max_latency());
+            r->Append(line);
+        },
+        res);
+    res->Append(
+        "\nfiber scheduler pools\n"
+        "pool  workers  live_fibers  steals      remote_overflows  "
+        "urgent_handoffs  runq_highwater\n");
+    TaskControl::ForEachPool(
+        [](int tag, TaskControl* c, void* arg) {
+            auto* r = (HttpResponse*)arg;
+            char line[256];
+            snprintf(line, sizeof(line),
+                     "%-5d %-8d %-12lld %-11lld %-17lld %-16lld %lld\n",
+                     tag, c->concurrency(), (long long)c->nfibers.load(),
+                     (long long)c->steals(),
+                     (long long)c->remote_overflows(),
+                     (long long)c->urgent_handoffs(),
+                     (long long)c->runqueue_highwater());
+            r->Append(line);
+        },
+        res);
 }
 
 void HandleHotspotsContention(Server*, const HttpRequest& req,
@@ -235,7 +343,40 @@ void HandleRpczTrace(Server*, const HttpRequest& req, HttpResponse* res) {
     res->Append(RenderStitchedTrace(trace));
 }
 
-void HandleStatus(Server* server, const HttpRequest&, HttpResponse* res) {
+void HandleStatus(Server* server, const HttpRequest& req,
+                  HttpResponse* res) {
+    // ?format=json: the machine form — bench.py and the soak tests
+    // consume per-method MethodStatus without scraping the text table.
+    // Method names are pb identifiers + '_', so no JSON escaping needed.
+    if (req.QueryParam("format") == "json") {
+        res->set_content_type("application/json");
+        std::ostringstream os;
+        os << "{\"draining\":" << (server->draining() ? 1 : 0)
+           << ",\"nprocessing\":" << server->nprocessing.load()
+           << ",\"methods\":{";
+        bool first = true;
+        for (const auto& kv : server->methods()) {
+            const MethodStatus& st = *kv.second.status;
+            if (!first) os << ",";
+            first = false;
+            os << "\"" << kv.first << "\":{"
+               << "\"count\":" << st.latency.count()
+               << ",\"qps\":" << st.latency.qps()
+               << ",\"concurrency\":" << st.concurrency.load()
+               << ",\"max_concurrency\":" << st.max_concurrency()
+               << ",\"errors\":" << st.nerror.load()
+               << ",\"rejected\":" << st.nrejected.load()
+               << ",\"expired\":" << st.nexpired.load()
+               << ",\"shed\":" << st.nshed.load() << ",\"latency_us\":{"
+               << "\"p50\":" << st.latency.latency_percentile(0.5)
+               << ",\"p99\":" << st.latency.latency_percentile(0.99)
+               << ",\"p999\":" << st.latency.latency_percentile(0.999)
+               << ",\"max\":" << st.latency.max_latency() << "}}";
+        }
+        os << "}}";
+        res->Append(os.str());
+        return;
+    }
     res->set_content_type("text/plain");
     char line[512];
     // Lifecycle state first: "draining: 1" means a graceful shutdown or
@@ -343,22 +484,40 @@ void HandleFlags(Server*, const HttpRequest& req, HttpResponse* res) {
     }
 }
 
+// /connections: per-socket I/O attribution (ISSUE 6). in_Bps/out_Bps
+// are scrape-to-scrape rates (Socket::ScrapeIoRates — first scrape
+// averages since creation); avg/max_batch attribute writev coalescing;
+// q_hiwater is the deepest write backlog; crowded counts EOVERCROWDED
+// rejections on this connection.
 void HandleConnections(Server* server, const HttpRequest&,
                        HttpResponse* res) {
     res->set_content_type("text/plain");
-    char line[256];
-    res->Append("socket_id            fd    remote              "
-                "in_bytes     out_bytes    unwritten  age_s  idle_s\n");
+    char line[400];
+    res->Append(
+        "socket_id            fd    remote              "
+        "in_bytes     out_bytes    in_Bps       out_Bps      "
+        "wr_batches  avg_batch  max_batch  unwritten  q_hiwater  "
+        "crowded  age_s  idle_s\n");
     const int64_t now = monotonic_time_us();
     for (SocketId id : server->acceptor()->connections()) {
         SocketUniquePtr s = SocketUniquePtr::FromId(id);
         if (!s) continue;
+        const Socket::IoRates rates = s->ScrapeIoRates(now);
+        const int64_t nbatch = s->write_batches();
+        const int64_t avg_batch =
+            nbatch > 0 ? s->bytes_written() / nbatch : 0;
         snprintf(line, sizeof(line),
-                 "%-20llu %-5d %-19s %-12lld %-12lld %-10lld %-6lld %lld\n",
+                 "%-20llu %-5d %-19s %-12lld %-12lld %-12.0f %-12.0f "
+                 "%-11lld %-10lld %-10lld %-10lld %-10lld %-8lld %-6lld "
+                 "%lld\n",
                  (unsigned long long)id, s->fd(),
                  endpoint2str(s->remote_side()).c_str(),
                  (long long)s->bytes_read(), (long long)s->bytes_written(),
+                 rates.in_bps, rates.out_bps, (long long)nbatch,
+                 (long long)avg_batch, (long long)s->max_write_batch_bytes(),
                  (long long)s->unwritten_bytes(),
+                 (long long)s->queued_write_highwater(),
+                 (long long)s->overcrowded_incidents(),
                  (long long)((now - s->created_us()) / 1000000),
                  (long long)((now - s->last_active_us()) / 1000000));
         res->Append(line);
@@ -476,6 +635,9 @@ void AddBuiltinHttpServices(Server* server) {
     server->RegisterHttpHandler("/memory", HandleMemory);
     server->RegisterHttpHandler("/hotspots", HandleHotspotsIndex);
     server->RegisterHttpHandler("/hotspots/cpu", HandleHotspotsCpu);
+    server->RegisterHttpHandler("/hotspots/heap", HandleHotspotsHeap);
+    server->RegisterHttpHandler("/hotspots/growth", HandleHotspotsGrowth);
+    server->RegisterHttpHandler("/loops", HandleLoops);
     server->RegisterHttpHandler("/hotspots/contention",
                                 HandleHotspotsContention);
     server->RegisterHttpHandler("/chaos", HandleChaos);

@@ -5,9 +5,12 @@
 
 #include <algorithm>
 #include <cerrno>
+#include <string>
 
 #include "tbase/flags.h"
 #include "tbase/logging.h"
+#include "tbase/time.h"
+#include "tvar/multi_dimension.h"
 
 // 0 = auto: one loop per ~4 cores, capped at 4 (the reference defaults to
 // 1, which serializes all sockets through a single epoll loop — the main
@@ -20,9 +23,38 @@ namespace tpurpc {
 namespace {
 // epoll_data carries the SocketId; EPOLLOUT interest is encoded in the
 // registration mode only.
+
+// Labelled telemetry families, one series per loop ({loop="N"}).
+// Process-lifetime, created on first dispatcher construction (runtime,
+// never static-init).
+LabelledMetric<IntCell>* loop_waits() {
+    static auto* m =
+        new LabelledMetric<IntCell>("rpc_dispatcher_epoll_waits", {"loop"});
+    return m;
+}
+LabelledMetric<IntCell>* loop_events() {
+    static auto* m =
+        new LabelledMetric<IntCell>("rpc_dispatcher_events", {"loop"});
+    return m;
+}
+LabelledMetric<LatencyRecorder>* loop_events_per_wake() {
+    static auto* m = new LabelledMetric<LatencyRecorder>(
+        "rpc_dispatcher_events_per_wake", {"loop"});
+    return m;
+}
+LabelledMetric<LatencyRecorder>* loop_wake_us() {
+    static auto* m = new LabelledMetric<LatencyRecorder>(
+        "rpc_dispatcher_wake_to_dispatch_us", {"loop"});
+    return m;
+}
 }  // namespace
 
-EventDispatcher::EventDispatcher() {
+EventDispatcher::EventDispatcher(int index) : index_(index) {
+    const std::string loop = std::to_string(index);
+    waits_cell_ = loop_waits()->get_stats({loop});
+    events_cell_ = loop_events()->get_stats({loop});
+    events_per_wake_ = loop_events_per_wake()->get_stats({loop});
+    wake_us_ = loop_wake_us()->get_stats({loop});
     epfd_ = epoll_create1(EPOLL_CLOEXEC);
     CHECK_GE(epfd_, 0) << "epoll_create1 failed";
     thread_ = std::thread([this] { Run(); });
@@ -86,6 +118,13 @@ void EventDispatcher::Run() {
             if (errno == EINTR) continue;
             break;  // epfd closed
         }
+        // Hot-loop telemetry: two counter adds per wake; the recorders
+        // and the second clock read only run when events were delivered.
+        waits_cell_->add(1);
+        if (n == 0) continue;
+        const int64_t t0 = monotonic_time_us();
+        events_cell_->add(n);
+        *events_per_wake_ << n;
         for (int i = 0; i < n; ++i) {
             const SocketId id = events[i].data.u64;
             if (events[i].events & (EPOLLOUT | EPOLLERR | EPOLLHUP)) {
@@ -95,6 +134,10 @@ void EventDispatcher::Run() {
                 Socket::OnInputEventById(id);
             }
         }
+        // Wake→dispatch: how long a readiness burst takes to hand off to
+        // fibers — when this climbs with events_per_wake, the loop is the
+        // bottleneck (the per-core sharding argument of ROADMAP item 4).
+        *wake_us_ << (monotonic_time_us() - t0);
     }
 }
 
@@ -102,6 +145,7 @@ namespace {
 struct Dispatchers {
     std::vector<EventDispatcher*> list;
 };
+std::atomic<Dispatchers*> g_dispatchers{nullptr};
 }  // namespace
 
 EventDispatcher& EventDispatcher::GetGlobalDispatcher(int fd) {
@@ -113,10 +157,38 @@ EventDispatcher& EventDispatcher::GetGlobalDispatcher(int fd) {
             n = (int)std::min(4u, std::max(1u, hc / 4));
         }
         if (n < 1) n = 1;
-        for (int i = 0; i < n; ++i) dd->list.push_back(new EventDispatcher);
+        for (int i = 0; i < n; ++i) {
+            dd->list.push_back(new EventDispatcher(i));
+        }
+        g_dispatchers.store(dd, std::memory_order_release);
         return dd;
     }();
     return *d->list[(size_t)fd % d->list.size()];
+}
+
+void EventDispatcher::ForEachLoop(void (*fn)(int, const LoopStats&, void*),
+                                  void* arg) {
+    Dispatchers* d = g_dispatchers.load(std::memory_order_acquire);
+    if (d == nullptr) return;
+    for (size_t i = 0; i < d->list.size(); ++i) {
+        const EventDispatcher* ed = d->list[i];
+        LoopStats st;
+        st.epoll_waits = ed->waits_cell_->get();
+        st.events = ed->events_cell_->get();
+        st.events_per_wake = ed->events_per_wake_;
+        st.wake_to_dispatch_us = ed->wake_us_;
+        fn((int)i, st, arg);
+    }
+}
+
+int64_t EventDispatcher::TotalEpollWaits() {
+    int64_t total = 0;
+    ForEachLoop(
+        [](int, const LoopStats& st, void* arg) {
+            *(int64_t*)arg += st.epoll_waits;
+        },
+        &total);
+    return total;
 }
 
 void EventDispatcher::StopAll() {

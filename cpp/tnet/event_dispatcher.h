@@ -6,6 +6,11 @@
 // ignored). Sharded by fd across `event_dispatcher_num` loops. Each loop
 // runs on a dedicated pthread (the reference wraps it in a bthread; the
 // callbacks here immediately hand off to fibers, which is what matters).
+//
+// Telemetry (ISSUE 6): every loop exports labelled families —
+// rpc_dispatcher_epoll_waits / _events (counters, {loop=N}),
+// rpc_dispatcher_events_per_wake and _wake_to_dispatch_us (summaries) —
+// rendered on /loops and fed into the /vars?series= rings.
 #pragma once
 
 #include <atomic>
@@ -13,6 +18,8 @@
 #include <vector>
 
 #include "tnet/socket.h"
+#include "tvar/latency_recorder.h"
+#include "tvar/reducer.h"
 
 namespace tpurpc {
 
@@ -31,13 +38,36 @@ public:
     static EventDispatcher& GetGlobalDispatcher(int fd);
     static void StopAll();
 
+    // ---- per-loop telemetry (the /loops builtin) ----
+    struct LoopStats {
+        int64_t epoll_waits = 0;  // epoll_wait returns (incl. timeouts)
+        int64_t events = 0;       // readiness events delivered
+        const LatencyRecorder* events_per_wake = nullptr;
+        const LatencyRecorder* wake_to_dispatch_us = nullptr;
+    };
+    // Visits every live loop in index order; no-op before the first
+    // dispatcher exists.
+    static void ForEachLoop(void (*fn)(int index, const LoopStats&,
+                                       void* arg),
+                            void* arg);
+    // Sum of epoll_waits across loops (tests).
+    static int64_t TotalEpollWaits();
+
 private:
-    EventDispatcher();
+    explicit EventDispatcher(int index);
     ~EventDispatcher();
     void Run();
 
     int epfd_ = -1;
+    int index_ = 0;
     std::atomic<bool> stop_{false};
+    // Telemetry cells live in process-lifetime labelled families; the
+    // loop updates through raw pointers (relaxed atomics / recorder
+    // adds) so the hot path never touches the family mutex.
+    IntCell* waits_cell_ = nullptr;
+    IntCell* events_cell_ = nullptr;
+    LatencyRecorder* events_per_wake_ = nullptr;
+    LatencyRecorder* wake_us_ = nullptr;
     std::thread thread_;
 
     friend EventDispatcher* global_dispatchers();

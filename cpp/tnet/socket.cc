@@ -21,6 +21,7 @@
 #include "tnet/fault_injection.h"
 #include "tnet/tls.h"
 #include "tnet/transport.h"
+#include "tvar/latency_recorder.h"
 #include "tvar/reducer.h"
 
 DEFINE_int64(socket_max_unwritten_bytes, 64 * 1024 * 1024,
@@ -46,6 +47,31 @@ namespace tpurpc {
 // Health-check revivals, observable in /vars and /metrics (the mesh
 // chaos soak asserts on it).
 static LazyAdder g_hc_revives("rpc_health_check_revives");
+
+// Process-wide I/O attribution families (ISSUE 6): writev batch sizes
+// as a real summary (small batches at high QPS = the write-coalescing
+// opportunity of ROADMAP item 4), EOVERCROWDED incidents, and the
+// biggest write backlog any connection reached. Per-connection views
+// live on /connections.
+static LazyAdder g_eovercrowded("rpc_socket_eovercrowded");
+
+static LatencyRecorder* write_batch_recorder() {
+    static LatencyRecorder* r = [] {
+        auto* x = new LatencyRecorder;
+        x->expose("rpc_socket_write_batch_bytes");
+        return x;
+    }();
+    return r;
+}
+
+static IntCell* queued_write_highwater_cell() {
+    static IntCell* c = [] {
+        auto* x = new IntCell;
+        x->expose("rpc_socket_queued_write_highwater");
+        return x;
+    }();
+    return c;
+}
 
 static int make_non_blocking(int fd) {
     const int flags = fcntl(fd, F_GETFL, 0);
@@ -114,6 +140,13 @@ int Socket::Create(const SocketOptions& options, SocketId* id) {
     s->conn_data_deleter_ = nullptr;
     s->bytes_read_.store(0, std::memory_order_relaxed);
     s->bytes_written_.store(0, std::memory_order_relaxed);
+    s->nwrite_batches_.store(0, std::memory_order_relaxed);
+    s->max_write_batch_.store(0, std::memory_order_relaxed);
+    s->queued_highwater_.store(0, std::memory_order_relaxed);
+    s->novercrowded_.store(0, std::memory_order_relaxed);
+    s->rate_scrape_us_.store(0, std::memory_order_relaxed);
+    s->rate_scrape_in_.store(0, std::memory_order_relaxed);
+    s->rate_scrape_out_.store(0, std::memory_order_relaxed);
     s->created_us_ = monotonic_time_us();
     s->last_active_us_.store(s->created_us_, std::memory_order_relaxed);
     if (s->epollout_butex_ == nullptr) s->epollout_butex_ = butex_create();
@@ -412,6 +445,8 @@ int Socket::Write(IOBuf* data, uint64_t notify_id) {
     const int64_t sz = (int64_t)data->size();
     if (unwritten_bytes_.load(std::memory_order_relaxed) + sz >
         FLAGS_socket_max_unwritten_bytes.get()) {
+        novercrowded_.fetch_add(1, std::memory_order_relaxed);
+        *g_eovercrowded << 1;
         errno = TERR_OVERCROWDED;
         return -1;
     }
@@ -419,7 +454,14 @@ int Socket::Write(IOBuf* data, uint64_t notify_id) {
     req->notify_id = notify_id;
     req->data.swap(*data);
     req->next.store(WriteRequest::unlinked(), std::memory_order_relaxed);
-    unwritten_bytes_.fetch_add(sz, std::memory_order_relaxed);
+    const int64_t queued =
+        unwritten_bytes_.fetch_add(sz, std::memory_order_relaxed) + sz;
+    // Queued-write high-water: how deep the backlog got before the
+    // writer caught up (per-socket + the process-wide gauge).
+    if (queued > queued_highwater_.load(std::memory_order_relaxed)) {
+        queued_highwater_.store(queued, std::memory_order_relaxed);
+        queued_write_highwater_cell()->update_max(queued);
+    }
     WriteRequest* old = write_head_.exchange(req, std::memory_order_acq_rel);
     req->next.store(old, std::memory_order_release);
     if (write_pending_.fetch_add(1, std::memory_order_acq_rel) != 0) {
@@ -668,6 +710,14 @@ bool Socket::FlushOnce(bool allow_block) {
         }
         unwritten_bytes_.fetch_sub(nw, std::memory_order_relaxed);
         add_bytes_written(nw);
+        if (nw > 0) {
+            // Write-batch attribution: one writev round = one batch.
+            nwrite_batches_.fetch_add(1, std::memory_order_relaxed);
+            if (nw > max_write_batch_.load(std::memory_order_relaxed)) {
+                max_write_batch_.store(nw, std::memory_order_relaxed);
+            }
+            *write_batch_recorder() << nw;
+        }
         // Drop fully-written requests.
         while (inflight_index_ < inflight_batch_.size() &&
                inflight_batch_[inflight_index_]->data.empty()) {

@@ -288,6 +288,48 @@ public:
         return last_active_us_.load(std::memory_order_relaxed);
     }
 
+    // ---- per-connection I/O attribution (ISSUE 6; /connections) ----
+    int64_t write_batches() const {
+        return nwrite_batches_.load(std::memory_order_relaxed);
+    }
+    int64_t max_write_batch_bytes() const {
+        return max_write_batch_.load(std::memory_order_relaxed);
+    }
+    int64_t queued_write_highwater() const {
+        return queued_highwater_.load(std::memory_order_relaxed);
+    }
+    int64_t overcrowded_incidents() const {
+        return novercrowded_.load(std::memory_order_relaxed);
+    }
+    // In/out bytes-per-second since the PREVIOUS call (or since creation
+    // on the first): /connections computes scrape-to-scrape rates with
+    // no per-socket sampler thread. Concurrent scrapes race benignly
+    // (one of them sees a shorter window).
+    struct IoRates {
+        double in_bps = 0;
+        double out_bps = 0;
+    };
+    IoRates ScrapeIoRates(int64_t now_us) {
+        const int64_t in = bytes_read();
+        const int64_t out = bytes_written();
+        const int64_t prev_us = rate_scrape_us_.exchange(
+            now_us, std::memory_order_relaxed);
+        const int64_t prev_in =
+            rate_scrape_in_.exchange(in, std::memory_order_relaxed);
+        const int64_t prev_out =
+            rate_scrape_out_.exchange(out, std::memory_order_relaxed);
+        const int64_t base_us = prev_us != 0 ? prev_us : created_us_;
+        const double dt = (double)(now_us - base_us) / 1e6;
+        IoRates r;
+        if (dt > 0) {
+            r.in_bps = (double)(in - (prev_us != 0 ? prev_in : 0)) / dt;
+            r.out_bps = (double)(out - (prev_us != 0 ? prev_out : 0)) / dt;
+            if (r.in_bps < 0) r.in_bps = 0;    // slot-reuse race
+            if (r.out_bps < 0) r.out_bps = 0;
+        }
+        return r;
+    }
+
     // VersionedRefWithId hooks.
     void OnFailed();
     void OnRecycle();
@@ -364,6 +406,14 @@ private:
     std::atomic<int64_t> bytes_written_{0};
     int64_t created_us_ = 0;
     std::atomic<int64_t> last_active_us_{0};
+    // I/O attribution (reset on slot reuse, like the byte counters).
+    std::atomic<int64_t> nwrite_batches_{0};
+    std::atomic<int64_t> max_write_batch_{0};
+    std::atomic<int64_t> queued_highwater_{0};
+    std::atomic<int64_t> novercrowded_{0};
+    std::atomic<int64_t> rate_scrape_us_{0};
+    std::atomic<int64_t> rate_scrape_in_{0};
+    std::atomic<int64_t> rate_scrape_out_{0};
     void* conn_data_ = nullptr;
     void (*conn_data_deleter_)(void*) = nullptr;
     std::mutex pipeline_mu_;

@@ -86,15 +86,37 @@ public:
         *out += prometheus_text(name);
     }
 
-    // A labelled series of label-tuples makes no sense — the series
-    // sampler skips MultiDimension (per-tuple rings would need per-tuple
-    // names; the flat stats remain visible via /vars).
+    // Per-tuple series: each label tuple becomes a "_<label>_<value>"
+    // suffix so labelled families feed the 60s/60min/24h rings —
+    // /vars?series=rpc_dispatcher_epoll_waits_loop_0 answers "what did
+    // loop 0 do over the last minute". Bounded at kMaxSeriesTuples
+    // tuples (the dispatcher/scheduler/connection families this exists
+    // for are low-cardinality by construction; a runaway peer-labelled
+    // family must not flood the SeriesCollector, which caps globally
+    // too).
     std::vector<std::pair<std::string, double>> numeric_fields()
         const override {
-        return {};
+        std::vector<std::pair<std::string, double>> out;
+        std::lock_guard<std::mutex> g(mu_);
+        size_t ntuples = 0;
+        for (const auto& kv : stats_) {
+            if (++ntuples > kMaxSeriesTuples) break;
+            std::string suffix;
+            for (size_t i = 0; i < labels_.size() && i < kv.first.size();
+                 ++i) {
+                suffix += "_" + labels_[i] + "_" + kv.first[i];
+            }
+            suffix = SanitizeMetricName(suffix);
+            for (const auto& f : kv.second->numeric_fields()) {
+                out.emplace_back(suffix + f.first, f.second);
+            }
+        }
+        return out;
     }
 
 private:
+    static constexpr size_t kMaxSeriesTuples = 16;
+
     std::string label_pairs(const std::vector<std::string>& values) const {
         std::ostringstream os;
         for (size_t i = 0; i < labels_.size() && i < values.size(); ++i) {

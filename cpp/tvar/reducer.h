@@ -256,6 +256,35 @@ private:
     std::atomic<Adder<int64_t>*> adder_{nullptr};
 };
 
+// IntCell: one lock-free atomic int64 behind the Variable interface —
+// default-constructible (usable as the T of a MultiDimension family)
+// and cheap enough to update from scheduler/event-loop hot paths where
+// even a Reducer's uncontended TLS-cell lock is too much. The writer
+// holds the cell pointer (get_stats once, then relaxed atomics).
+class IntCell : public Variable {
+public:
+    IntCell() = default;
+    ~IntCell() override { hide(); }
+    void add(int64_t d) { v_.fetch_add(d, std::memory_order_relaxed); }
+    void set(int64_t x) { v_.store(x, std::memory_order_relaxed); }
+    // Monotonic high-water update (run-queue depth, queued-write bytes).
+    void update_max(int64_t x) {
+        int64_t cur = v_.load(std::memory_order_relaxed);
+        while (x > cur &&
+               !v_.compare_exchange_weak(cur, x, std::memory_order_relaxed)) {
+        }
+    }
+    int64_t get() const { return v_.load(std::memory_order_relaxed); }
+    std::string get_description() const override {
+        std::ostringstream os;
+        os << get();
+        return os.str();
+    }
+
+private:
+    std::atomic<int64_t> v_{0};
+};
+
 // PassiveStatus: value computed on read (reference src/bvar/passive_status.h).
 template <typename T>
 class PassiveStatus : public Variable {

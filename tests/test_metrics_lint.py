@@ -122,6 +122,25 @@ def test_metrics_exposition_lint(cpp_build, tmp_path):
         # Flag->var bridge: flags are scrape-able alongside metrics.
         assert families.get("flag_enable_rpcz") == "gauge", sorted(families)
         assert re.search(r"^flag_enable_rpcz [01]$", text, re.M), text[:500]
+        # ISSUE 6 attribution families: dispatcher/scheduler counters as
+        # labelled gauges, distributions as labelled summaries, and the
+        # socket write-batch summary — all must pass the same lint.
+        assert families.get("rpc_dispatcher_epoll_waits") == "gauge", \
+            sorted(families)
+        assert families.get("rpc_dispatcher_events") == "gauge"
+        assert families.get("rpc_dispatcher_events_per_wake") == "summary"
+        assert families.get("rpc_dispatcher_wake_to_dispatch_us") == \
+            "summary"
+        assert families.get("rpc_scheduler_steals") == "gauge"
+        assert families.get("rpc_scheduler_remote_overflows") == "gauge"
+        assert families.get("rpc_scheduler_urgent_handoffs") == "gauge"
+        assert families.get("rpc_scheduler_runqueue_highwater") == "gauge"
+        assert families.get("rpc_socket_write_batch_bytes") == "summary"
+        assert re.search(
+            r'^rpc_dispatcher_epoll_waits\{loop="0"\} \d+$', text, re.M), \
+            text[:500]
+        assert re.search(
+            r'^rpc_scheduler_steals\{pool="0"\} \d+$', text, re.M)
 
         # /vars?series= returns the fixed 60/60/24-point ring shape.
         # Poll: on a loaded host the 1Hz sampler may lag a little before
@@ -137,6 +156,11 @@ def test_metrics_exposition_lint(cpp_build, tmp_path):
         assert len(ring["second"]) == 60, ring
         assert len(ring["minute"]) == 60
         assert len(ring["hour"]) == 24
+        # Labelled families feed per-tuple rings (ISSUE 6): the loop-0
+        # dispatcher counter has its own series.
+        disp_ring = json.loads(
+            _http_get(port, "/vars?series=rpc_dispatcher_epoll_waits_loop_0"))
+        assert len(disp_ring["second"]) == 60, disp_ring
         # Unknown series 404s with guidance instead of a silent empty.
         try:
             _http_get(port, "/vars?series=no_such_series_name")

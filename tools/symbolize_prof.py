@@ -1,11 +1,18 @@
 #!/usr/bin/env python3
-"""Symbolize a tpurpc cpu_profiler dump (see cpp/tbase/cpu_profiler.h).
+"""Symbolize a tpurpc profiler dump (see cpp/tbase/cpu_profiler.h and
+cpp/tbase/heap_profiler.h).
 
 Usage: symbolize_prof.py PROFILE [--tree]
 
-Prints a flat profile (sample count per function, descending). With
---tree, also prints the top caller->callee edges from the captured
-frame-pointer backtraces.
+Accepts both dump formats:
+  * cpu:   one "pc fp1 fp2 ..." hex line per sample (weight 1 each)
+  * heap/growth:  "<bytes> <count> @ pc1 pc2 ..." weighted stack lines
+    (the /hotspots/heap?raw=1 and /hotspots/growth?raw=1 responses)
+
+Prints a flat profile (weight per function, descending). With --tree,
+also prints the top caller->callee edges from the captured backtraces.
+When addr2line yields no symbol (stripped binary, JIT region), falls
+back to module+0x<offset> so every address stays attributable offline.
 """
 import bisect
 import subprocess
@@ -15,18 +22,37 @@ from pathlib import Path
 
 
 def load(path):
+    """Returns (samples, maps, weighted): samples are (weight, [pcs])."""
     samples = []
     maps = []
     in_maps = False
+    weighted = False
     for line in Path(path).read_text().splitlines():
         if line.startswith("--- maps ---"):
             in_maps = True
             continue
         if in_maps:
             maps.append(line)
-        elif line.strip():
-            samples.append([int(x, 16) for x in line.split()])
-    return samples, maps
+            continue
+        if not line.strip():
+            continue
+        if line.startswith(("heap profile:", "growth profile:")):
+            weighted = True
+            continue
+        if " @ " in line or " @" == line[-2:]:
+            head, _, stack = line.partition("@")
+            parts = head.split()
+            weight = int(parts[0]) if parts else 0
+            pcs = [int(x, 16) for x in stack.split()]
+            # The heap dump's stack-table overflow bucket is a single
+            # pc of 0 — keep its weight so totals match the header
+            # (Symbolizer names addr 0 "[stack-table overflow]").
+            if pcs:
+                samples.append((weight, pcs))
+                weighted = True
+        else:
+            samples.append((1, [int(x, 16) for x in line.split()]))
+    return samples, maps, weighted
 
 
 def parse_maps(maps):
@@ -76,41 +102,49 @@ class Symbolizer:
             except Exception:
                 out = []
             funcs = out[0::2]
+            # Offline fallback: module+0x<file offset> — stable across
+            # runs of the same binary, greppable in objdump output.
+            def fallback(a):
+                return "%s+0x%x" % (Path(path).name, a - start + off)
             for a, fn in zip(mod_addrs, funcs):
-                name = fn if fn and fn != "??" else Path(path).name + "+?"
-                self.cache[a] = name
+                self.cache[a] = fn if fn and fn != "??" else fallback(a)
             for a in mod_addrs:
-                self.cache.setdefault(a, Path(path).name + "+?")
+                self.cache.setdefault(a, fallback(a))
 
     def name(self, addr):
+        if addr == 0:
+            return "[stack-table overflow]"
         return self.cache.get(addr, "??")
 
 
 def main():
     prof = sys.argv[1]
     tree = "--tree" in sys.argv
-    samples, maps = load(prof)
+    samples, maps, weighted = load(prof)
     if not samples:
         print("no samples")
         return
     sym = Symbolizer(parse_maps(maps))
-    all_addrs = {a for row in samples for a in row}
+    all_addrs = {a for _, row in samples for a in row if a}
     sym.resolve_batch(sorted(all_addrs))
 
-    flat = Counter(sym.name(row[0]) for row in samples)
-    total = len(samples)
-    print(f"== flat profile ({total} samples) ==")
+    unit = "bytes" if weighted else "samples"
+    flat = Counter()
+    for w, row in samples:
+        flat[sym.name(row[0])] += w
+    total = sum(flat.values())
+    print(f"== flat profile ({total} {unit}) ==")
     for name, n in flat.most_common(40):
-        print(f"{n:8d} {100.0 * n / total:5.1f}%  {name}")
+        print(f"{n:12d} {100.0 * n / max(total, 1):5.1f}%  {name}")
 
     if tree:
         edges = Counter()
-        for row in samples:
+        for w, row in samples:
             for i in range(len(row) - 1):
-                edges[(sym.name(row[i + 1]), sym.name(row[i]))] += 1
-        print("\n== top edges (caller -> callee) ==")
+                edges[(sym.name(row[i + 1]), sym.name(row[i]))] += w
+        print(f"\n== top edges (caller -> callee, {unit}) ==")
         for (caller, callee), n in edges.most_common(30):
-            print(f"{n:8d}  {caller} -> {callee}")
+            print(f"{n:12d}  {caller} -> {callee}")
 
 
 if __name__ == "__main__":

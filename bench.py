@@ -142,6 +142,11 @@ def perf_attrib_scrape(port):
         for family, key in (
             ("rpc_dispatcher_epoll_waits", "dispatcher_epoll_waits"),
             ("rpc_dispatcher_events", "dispatcher_events"),
+            ("rpc_dispatcher_wakeups", "dispatcher_wakeups"),
+            ("rpc_dispatcher_inline_dispatches", "inline_dispatches"),
+            ("rpc_dispatcher_inline_overflows", "inline_overflows"),
+            ("rpc_server_inline_handlers", "inline_handlers"),
+            ("rpc_socket_coalesced_writes", "coalesced_writes"),
             ("rpc_scheduler_steals", "scheduler_steals"),
             ("rpc_socket_write_batch_bytes_count", "socket_write_batches"),
         ):
@@ -222,9 +227,18 @@ def series_scrape():
                 if not chunk:
                     return None
                 buf += chunk
+            # Generator config mirrored into the BENCH record (ISSUE 7):
+            # a qps number is only comparable round-to-round if the load
+            # shape that produced it is pinned alongside it.
+            press_cfg = {"press_gen_threads": 2, "press_gen_callers": 4,
+                         "press_gen_qps": 500, "press_gen_payload": 128}
             subprocess.run(
-                [str(press), "--server=127.0.0.1:%d" % port, "--qps=500",
-                 "--duration_s=4", "--payload=128", "--callers=4",
+                [str(press), "--server=127.0.0.1:%d" % port,
+                 "--qps=%d" % press_cfg["press_gen_qps"],
+                 "--duration_s=4",
+                 "--payload=%d" % press_cfg["press_gen_payload"],
+                 "--callers=%d" % press_cfg["press_gen_callers"],
+                 "--press_threads=%d" % press_cfg["press_gen_threads"],
                  "--metrics_csv=%s" % csv],
                 capture_output=True, timeout=60,
             )
@@ -243,6 +257,11 @@ def series_scrape():
             if second:
                 out["server_qps_series_tail"] = [
                     int(v) for v in second[-10:]]
+            # Attach the generator config only to a real scrape: a fully
+            # failed one must still return None (record skipped), not a
+            # metrics-free dict of press_gen_* constants.
+            if out:
+                out.update(press_cfg)
             return out or None
     except Exception:
         return None
@@ -267,8 +286,12 @@ def series_scrape():
 _SKIP_KEYS = {"metric", "unit", "vs_baseline", "reps", "error",
               "status_json_method", "heap_profile_path",
               "cpu_profile_path", "dispatcher_epoll_waits",
-              "dispatcher_events", "scheduler_steals",
-              "socket_write_batches", "status_json_qps"}
+              "dispatcher_events", "dispatcher_wakeups",
+              "inline_dispatches", "inline_overflows", "inline_handlers",
+              "coalesced_writes", "scheduler_steals",
+              "socket_write_batches", "status_json_qps",
+              "press_gen_threads", "press_gen_callers", "press_gen_qps",
+              "press_gen_payload"}
 
 
 def _lower_is_better(key):

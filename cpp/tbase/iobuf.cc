@@ -503,6 +503,17 @@ std::string IOBuf::to_string() const {
     return s;
 }
 
+const void* IOBuf::fetch(void* aux, size_t n) const {
+    if (n > nbytes_) return nullptr;
+    if (n == 0) return aux;
+    const BlockRef& r = ref_at(0);
+    if (r.length >= n) {
+        return r.block->data + r.offset;
+    }
+    copy_to(aux, n);
+    return aux;
+}
+
 int IOBuf::front_byte() const {
     if (empty()) return -1;
     const BlockRef& r = ref_at(0);

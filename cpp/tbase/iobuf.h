@@ -104,6 +104,13 @@ public:
     size_t copy_to(void* buf, size_t n, size_t pos = 0) const;
     size_t copy_to(std::string* s, size_t n = (size_t)-1, size_t pos = 0) const;
     std::string to_string() const;
+    // Contiguous view of the first n bytes WITHOUT consuming: returns a
+    // pointer into the first block when it already holds n contiguous
+    // bytes (the common case — a readv lands whole headers in one block),
+    // else copies them into `aux` (caller-provided, >= n bytes) and
+    // returns aux. nullptr when size() < n. The zero-cut header peek of
+    // protocol fast paths (reference butil::IOBuf::fetch).
+    const void* fetch(void* aux, size_t n) const;
     // First byte, or -1 when empty.
     int front_byte() const;
 

@@ -214,6 +214,14 @@ __attribute__((noinline)) static void write_errno_here(int v) { errno = v; }
 
 void TaskGroup::sched_park() {
     TaskMeta* m = cur_meta_;
+    // A parked fiber may resume on a DIFFERENT pthread: flush + detach
+    // the thread-local batching scopes (park hooks first — the write-
+    // coalescing flush may spawn fibers whose wake signals then ride the
+    // batcher's own flush). Without this, a mid-round park would strand
+    // deferred work on the old thread and dangle its thread-local
+    // pointers.
+    run_park_hooks();
+    WakeBatcher::FlushCurrent();
     const int saved_errno = read_errno_here();
     asan_before_jump(&m->asan_fake, worker_stack_base_,
                      worker_stack_size_);
@@ -224,6 +232,83 @@ void TaskGroup::sched_park() {
     // stack and is still our own meta.
     asan_after_jump(m->asan_fake);
     write_errno_here(saved_errno);
+}
+
+// ---------------- park hooks + wake batching (ISSUE 7) ----------------
+
+namespace {
+constexpr int kMaxParkHooks = 4;
+std::atomic<void (*)()> g_park_hooks[kMaxParkHooks];
+std::atomic<int> g_npark_hooks{0};
+
+thread_local WakeBatcher* g_wake_batcher = nullptr;
+}  // namespace
+
+void register_park_hook(void (*fn)()) {
+    const int n = g_npark_hooks.load(std::memory_order_acquire);
+    for (int i = 0; i < n; ++i) {
+        if (g_park_hooks[i].load(std::memory_order_relaxed) == fn) return;
+    }
+    static std::mutex* mu = new std::mutex;
+    std::lock_guard<std::mutex> g(*mu);
+    const int cur = g_npark_hooks.load(std::memory_order_relaxed);
+    for (int i = 0; i < cur; ++i) {
+        if (g_park_hooks[i].load(std::memory_order_relaxed) == fn) return;
+    }
+    CHECK_LT(cur, kMaxParkHooks) << "too many park hooks";
+    g_park_hooks[cur].store(fn, std::memory_order_relaxed);
+    g_npark_hooks.store(cur + 1, std::memory_order_release);
+}
+
+void run_park_hooks() {
+    const int n = g_npark_hooks.load(std::memory_order_acquire);
+    for (int i = 0; i < n; ++i) {
+        g_park_hooks[i].load(std::memory_order_relaxed)();
+    }
+}
+
+WakeBatcher::WakeBatcher() {
+    if (g_wake_batcher == nullptr) {
+        g_wake_batcher = this;
+        armed_ = true;
+    }
+}
+
+WakeBatcher::~WakeBatcher() {
+    if (!armed_) return;
+    Flush();
+    if (g_wake_batcher == this) g_wake_batcher = nullptr;
+}
+
+void WakeBatcher::Flush() {
+    for (int i = 0; i < npools_; ++i) {
+        pools_[i]->parking_lot().signal(counts_[i]);
+    }
+    npools_ = 0;
+}
+
+bool WakeBatcher::TryBatch(TaskControl* c, int n) {
+    WakeBatcher* b = g_wake_batcher;
+    if (b == nullptr) return false;
+    for (int i = 0; i < b->npools_; ++i) {
+        if (b->pools_[i] == c) {
+            b->counts_[i] += n;
+            return true;
+        }
+    }
+    if (b->npools_ >= kMaxPools) return false;
+    b->pools_[b->npools_] = c;
+    b->counts_[b->npools_] = n;
+    ++b->npools_;
+    return true;
+}
+
+void WakeBatcher::FlushCurrent() {
+    WakeBatcher* b = g_wake_batcher;
+    if (b == nullptr) return;
+    b->Flush();
+    b->armed_ = false;
+    g_wake_batcher = nullptr;
 }
 
 namespace {
@@ -250,7 +335,9 @@ void TaskGroup::ready_to_run(TaskMeta* m) {
         control_->rq_highwater_cell_->update_max(
             (int64_t)rq_.volatile_size());
     }
-    control_->parking_lot().signal(1);
+    if (!WakeBatcher::TryBatch(control_, 1)) {
+        control_->parking_lot().signal(1);
+    }
 }
 
 void TaskGroup::run_urgent(TaskMeta* m) {
@@ -409,7 +496,9 @@ void TaskControl::ready_to_run_remote(TaskMeta* m) {
             remote_overflow_cell_->add(1);
         }
     }
-    parking_lot_.signal(1);
+    if (!WakeBatcher::TryBatch(this, 1)) {
+        parking_lot_.signal(1);
+    }
 }
 
 bool TaskControl::pop_remote(TaskMeta** m) {

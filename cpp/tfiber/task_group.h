@@ -192,4 +192,48 @@ TaskMeta* fiber_meta_of(fiber_t tid);         // nullptr if stale
 void fiber_requeue(fiber_t tid);              // ready_to_run if still alive
 void fiber_requeue_meta(TaskMeta* m);
 
+// Park hooks (ISSUE 7): run by sched_park on the parking fiber, BEFORE
+// the context switch. Upper layers (tnet) that keep thread-local
+// batching state across a dispatch round register a flush here so a
+// fiber that parks mid-round can never strand that state on the old
+// thread. Registration is idempotent per fn and must happen before the
+// state is first armed; hooks are process-lifetime.
+void register_park_hook(void (*fn)());
+void run_park_hooks();
+
+// Batched parking-lot signals (ISSUE 7): while a batcher is armed on the
+// current thread, every ready_to_run defers its futex wake into the
+// batcher; Flush() issues ONE signal(n) per pool. The input messenger
+// arms one per readiness burst, so completing 64 RPC responses costs one
+// futex syscall instead of 64. Queues are pushed eagerly — only the
+// *wakeup* of parked workers is batched, so running workers still steal
+// mid-round; a flush is bounded by one cut round.
+//
+// Safety: TaskGroup::sched_park flushes-and-detaches the armed batcher
+// before any fiber switch — a park mid-round can never strand deferred
+// signals on the old thread.
+class WakeBatcher {
+public:
+    WakeBatcher();   // arms on this thread (no-op when nested)
+    ~WakeBatcher();  // Flush + disarm
+    WakeBatcher(const WakeBatcher&) = delete;
+    WakeBatcher& operator=(const WakeBatcher&) = delete;
+
+    // Signal everything accumulated; stays armed for the next round.
+    void Flush();
+
+    // Called by the scheduler's wake paths: true = the signal was
+    // absorbed into the active batcher; false = caller must signal now.
+    static bool TryBatch(TaskControl* c, int n);
+    // sched_park hook: flush + detach the batcher armed on this thread.
+    static void FlushCurrent();
+
+private:
+    static constexpr int kMaxPools = 4;
+    TaskControl* pools_[kMaxPools];
+    int counts_[kMaxPools];
+    int npools_ = 0;
+    bool armed_ = false;
+};
+
 }  // namespace tpurpc

@@ -26,6 +26,7 @@
 #include "tfiber/task_meta.h"
 #include "tfiber/task_tracer.h"
 #include "tnet/fault_injection.h"
+#include "tnet/input_messenger.h"
 #include "tnet/socket.h"
 #include "trpc/rpcz_stitch.h"
 #include "trpc/server.h"
@@ -234,15 +235,18 @@ void HandleLoops(Server*, const HttpRequest& req, HttpResponse* res) {
     }
     res->Append(
         "event dispatchers (epoll loops)\n"
-        "loop  epoll_waits   events      ev/wake p50/p99   "
-        "wake->dispatch us p50/p99/max\n");
+        "loop  cpu   epoll_waits   events      wakeups  batch  "
+        "ev/wake p50/p99   wake->dispatch us p50/p99/max\n");
     EventDispatcher::ForEachLoop(
         [](int idx, const EventDispatcher::LoopStats& st, void* arg) {
             auto* r = (HttpResponse*)arg;
             char line[256];
             snprintf(line, sizeof(line),
-                     "%-5d %-13lld %-11lld %lld/%lld%*s%lld/%lld/%lld\n",
-                     idx, (long long)st.epoll_waits, (long long)st.events,
+                     "%-5d %-5d %-13lld %-11lld %-8lld %-6lld "
+                     "%lld/%lld%*s%lld/%lld/%lld\n",
+                     idx, st.cpu, (long long)st.epoll_waits,
+                     (long long)st.events, (long long)st.wakeups,
+                     (long long)st.batch_capacity,
                      (long long)st.events_per_wake->latency_percentile(0.5),
                      (long long)st.events_per_wake->latency_percentile(0.99),
                      10, "",
@@ -254,6 +258,22 @@ void HandleLoops(Server*, const HttpRequest& req, HttpResponse* res) {
             r->Append(line);
         },
         res);
+    {
+        // Run-to-completion dispatch (ISSUE 7): messages processed on the
+        // input fiber, budget overflows that fanned out, and server
+        // handlers that ran inline. tests/test_raw_speed.py asserts
+        // inline_dispatches goes nonzero under echo load.
+        char line[192];
+        snprintf(line, sizeof(line),
+                 "\nrun-to-completion dispatch\n"
+                 "inline_dispatches: %lld  inline_overflows: %lld  "
+                 "inline_handlers: %lld  coalesced_writes: %lld\n",
+                 (long long)inline_dispatch::dispatches(),
+                 (long long)inline_dispatch::overflows(),
+                 (long long)inline_dispatch::handler_inlines(),
+                 (long long)SocketCoalescedWrites());
+        res->Append(line);
+    }
     res->Append(
         "\nfiber scheduler pools\n"
         "pool  workers  live_fibers  steals      remote_overflows  "

@@ -1,10 +1,14 @@
 #include "tnet/event_dispatcher.h"
 
+#include <pthread.h>
+#include <sched.h>
 #include <sys/epoll.h>
+#include <sys/eventfd.h>
 #include <unistd.h>
 
 #include <algorithm>
 #include <cerrno>
+#include <cstring>
 #include <string>
 
 #include "tbase/flags.h"
@@ -17,12 +21,21 @@
 // reason its multi-connection mode needs explicit tuning; multi-core TPU-VM
 // hosts have cores to spare for I/O).
 DEFINE_int32(event_dispatcher_num, 0, "number of epoll loops; 0 = auto");
+// Per-core sharded loops: loop i is pinned to the i-th CPU of this list.
+// An I/O loop that stays on one core keeps its socket/epoll state in one
+// cache and never migrates mid-burst — the run-to-completion half of the
+// sharded-loop design (ROADMAP item 4). Read once at loop start.
+DEFINE_string(event_dispatcher_affinity, "",
+              "comma-separated CPUs or ranges (e.g. \"0-3\" or \"0,2,4\") "
+              "pinning epoll loop i to the i-th entry; empty = no pinning");
 
 namespace tpurpc {
 
 namespace {
 // epoll_data carries the SocketId; EPOLLOUT interest is encoded in the
-// registration mode only.
+// registration mode only. The wakeup eventfd is registered with this
+// sentinel (never a valid SocketId: VRef ids have a bounded slot part).
+constexpr uint64_t kWakeupData = ~0ull;
 
 // Labelled telemetry families, one series per loop ({loop="N"}).
 // Process-lifetime, created on first dispatcher construction (runtime,
@@ -37,6 +50,11 @@ LabelledMetric<IntCell>* loop_events() {
         new LabelledMetric<IntCell>("rpc_dispatcher_events", {"loop"});
     return m;
 }
+LabelledMetric<IntCell>* loop_wakeups() {
+    static auto* m =
+        new LabelledMetric<IntCell>("rpc_dispatcher_wakeups", {"loop"});
+    return m;
+}
 LabelledMetric<LatencyRecorder>* loop_events_per_wake() {
     static auto* m = new LabelledMetric<LatencyRecorder>(
         "rpc_dispatcher_events_per_wake", {"loop"});
@@ -47,27 +65,94 @@ LabelledMetric<LatencyRecorder>* loop_wake_us() {
         "rpc_dispatcher_wake_to_dispatch_us", {"loop"});
     return m;
 }
+
+// "0-3,8,10-11" -> {0,1,2,3,8,10,11}. Malformed entries are skipped with
+// a log line rather than failing startup (affinity is an optimization).
+std::vector<int> ParseCpuList(const std::string& spec) {
+    std::vector<int> cpus;
+    size_t pos = 0;
+    while (pos < spec.size()) {
+        size_t comma = spec.find(',', pos);
+        if (comma == std::string::npos) comma = spec.size();
+        const std::string tok = spec.substr(pos, comma - pos);
+        pos = comma + 1;
+        if (tok.empty()) continue;
+        char* end = nullptr;
+        const long lo = strtol(tok.c_str(), &end, 10);
+        long hi = lo;
+        if (end != nullptr && *end == '-') {
+            hi = strtol(end + 1, &end, 10);
+        }
+        if (end == nullptr || *end != '\0' || lo < 0 || hi < lo ||
+            hi >= 4096) {
+            LOG(ERROR) << "bad -event_dispatcher_affinity entry: " << tok;
+            continue;
+        }
+        for (long c = lo; c <= hi; ++c) cpus.push_back((int)c);
+    }
+    return cpus;
+}
 }  // namespace
 
 EventDispatcher::EventDispatcher(int index) : index_(index) {
     const std::string loop = std::to_string(index);
     waits_cell_ = loop_waits()->get_stats({loop});
     events_cell_ = loop_events()->get_stats({loop});
+    wakeups_cell_ = loop_wakeups()->get_stats({loop});
     events_per_wake_ = loop_events_per_wake()->get_stats({loop});
     wake_us_ = loop_wake_us()->get_stats({loop});
     epfd_ = epoll_create1(EPOLL_CLOEXEC);
     CHECK_GE(epfd_, 0) << "epoll_create1 failed";
+    // Stop/wake channel: an eventfd IN the epoll set, so the loop can
+    // block in epoll_wait indefinitely (no idle tick) and still wake
+    // promptly. EFD_NONBLOCK: the drain read must never stall the loop.
+    wakeup_fd_ = eventfd(0, EFD_NONBLOCK | EFD_CLOEXEC);
+    CHECK_GE(wakeup_fd_, 0) << "eventfd failed";
+    epoll_event evt;
+    evt.events = EPOLLIN;
+    evt.data.u64 = kWakeupData;
+    CHECK_EQ(epoll_ctl(epfd_, EPOLL_CTL_ADD, wakeup_fd_, &evt), 0)
+        << "registering wakeup eventfd failed";
+    const std::vector<int> cpus =
+        ParseCpuList(FLAGS_event_dispatcher_affinity.get());
+    if (!cpus.empty()) {
+        pinned_cpu_ = cpus[(size_t)index % cpus.size()];
+    }
     thread_ = std::thread([this] { Run(); });
+    if (pinned_cpu_ >= 0) {
+        cpu_set_t set;
+        CPU_ZERO(&set);
+        CPU_SET(pinned_cpu_, &set);
+        // pthread_* returns the error code (errno stays untouched).
+        const int rc = pthread_setaffinity_np(thread_.native_handle(),
+                                              sizeof(set), &set);
+        if (rc != 0) {
+            LOG(ERROR) << "pinning epoll loop " << index_ << " to cpu "
+                       << pinned_cpu_ << " failed: " << strerror(rc);
+            pinned_cpu_ = -1;
+        }
+    }
 }
 
 EventDispatcher::~EventDispatcher() {
     stop_.store(true, std::memory_order_release);
+    Wakeup();
+    if (thread_.joinable()) thread_.join();
     if (epfd_ >= 0) {
-        // Wake the loop by closing; epoll_wait returns EBADF.
         close(epfd_);
         epfd_ = -1;
     }
-    if (thread_.joinable()) thread_.join();
+    if (wakeup_fd_ >= 0) {
+        close(wakeup_fd_);
+        wakeup_fd_ = -1;
+    }
+}
+
+void EventDispatcher::Wakeup() {
+    const uint64_t one = 1;
+    if (write(wakeup_fd_, &one, sizeof(one)) < 0 && errno != EAGAIN) {
+        PLOG(ERROR) << "eventfd wakeup write failed";
+    }
 }
 
 int EventDispatcher::AddConsumer(SocketId id, int fd) {
@@ -109,23 +194,38 @@ int EventDispatcher::RemoveConsumer(int fd) {
 }
 
 void EventDispatcher::Run() {
-    epoll_event events[64];
+    // Adaptive batch: starts small (one cache line of events covers the
+    // common case), doubles whenever a wake fills the whole array —
+    // events_per_wake saturating at the array size means readiness was
+    // truncated and the loop paid an extra epoll_wait per burst.
+    std::vector<epoll_event> events(
+        (size_t)batch_capacity_.load(std::memory_order_relaxed));
+    constexpr size_t kMaxBatch = 4096;
     while (!stop_.load(std::memory_order_acquire)) {
-        const int epfd = epfd_;
-        if (epfd < 0) break;
-        const int n = epoll_wait(epfd, events, 64, 100 /*ms*/);
+        // Block until readiness or an eventfd kick — idle loops cost
+        // nothing (the old loop woke every 100 ms unconditionally).
+        const int n = epoll_wait(epfd_, events.data(), (int)events.size(),
+                                 -1);
         if (n < 0) {
             if (errno == EINTR) continue;
-            break;  // epfd closed
+            PLOG(ERROR) << "epoll_wait failed on loop " << index_;
+            break;
         }
-        // Hot-loop telemetry: two counter adds per wake; the recorders
+        // Hot-loop telemetry: one counter add per wake; the recorders
         // and the second clock read only run when events were delivered.
         waits_cell_->add(1);
         if (n == 0) continue;
         const int64_t t0 = monotonic_time_us();
-        events_cell_->add(n);
-        *events_per_wake_ << n;
+        int ndispatched = 0;
         for (int i = 0; i < n; ++i) {
+            if (events[i].data.u64 == kWakeupData) {
+                uint64_t drained;
+                while (read(wakeup_fd_, &drained, sizeof(drained)) > 0) {
+                }
+                wakeups_cell_->add(1);
+                continue;
+            }
+            ++ndispatched;
             const SocketId id = events[i].data.u64;
             if (events[i].events & (EPOLLOUT | EPOLLERR | EPOLLHUP)) {
                 Socket::OnOutputEventById(id);
@@ -134,10 +234,20 @@ void EventDispatcher::Run() {
                 Socket::OnInputEventById(id);
             }
         }
-        // Wake→dispatch: how long a readiness burst takes to hand off to
-        // fibers — when this climbs with events_per_wake, the loop is the
-        // bottleneck (the per-core sharding argument of ROADMAP item 4).
-        *wake_us_ << (monotonic_time_us() - t0);
+        if (ndispatched > 0) {
+            events_cell_->add(ndispatched);
+            *events_per_wake_ << ndispatched;
+            // Wake→dispatch: how long a readiness burst takes to hand off
+            // to fibers — when this climbs with events_per_wake, the loop
+            // is the bottleneck (the per-core sharding argument of
+            // ROADMAP item 4).
+            *wake_us_ << (monotonic_time_us() - t0);
+        }
+        if ((size_t)n == events.size() && events.size() < kMaxBatch) {
+            events.resize(events.size() * 2);
+            batch_capacity_.store((int64_t)events.size(),
+                                  std::memory_order_relaxed);
+        }
     }
 }
 
@@ -175,6 +285,10 @@ void EventDispatcher::ForEachLoop(void (*fn)(int, const LoopStats&, void*),
         LoopStats st;
         st.epoll_waits = ed->waits_cell_->get();
         st.events = ed->events_cell_->get();
+        st.wakeups = ed->wakeups_cell_->get();
+        st.batch_capacity =
+            ed->batch_capacity_.load(std::memory_order_relaxed);
+        st.cpu = ed->pinned_cpu_;
         st.events_per_wake = ed->events_per_wake_;
         st.wake_to_dispatch_us = ed->wake_us_;
         fn((int)i, st, arg);

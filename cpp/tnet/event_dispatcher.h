@@ -7,8 +7,17 @@
 // runs on a dedicated pthread (the reference wraps it in a bthread; the
 // callbacks here immediately hand off to fibers, which is what matters).
 //
+// Raw-speed round (ISSUE 7):
+//  - loops block in epoll_wait with NO timeout: an eventfd registered in
+//    the epoll set delivers stop/wake (the old implementation closed the
+//    epoll fd and relied on EBADF, and woke every 100 ms even when idle);
+//  - optional CPU pinning via -event_dispatcher_affinity so a loop's
+//    cache footprint stays on one core (run-to-completion sharding);
+//  - the event batch grows adaptively (64 -> 4096) when a wake saturates
+//    it, so bursty sockets drain in one epoll_wait round.
+//
 // Telemetry (ISSUE 6): every loop exports labelled families —
-// rpc_dispatcher_epoll_waits / _events (counters, {loop=N}),
+// rpc_dispatcher_epoll_waits / _events / _wakeups (counters, {loop=N}),
 // rpc_dispatcher_events_per_wake and _wake_to_dispatch_us (summaries) —
 // rendered on /loops and fed into the /vars?series= rings.
 #pragma once
@@ -40,8 +49,11 @@ public:
 
     // ---- per-loop telemetry (the /loops builtin) ----
     struct LoopStats {
-        int64_t epoll_waits = 0;  // epoll_wait returns (incl. timeouts)
+        int64_t epoll_waits = 0;  // epoll_wait returns (blocking waits)
         int64_t events = 0;       // readiness events delivered
+        int64_t wakeups = 0;      // eventfd wakes (stop/cross-thread kicks)
+        int64_t batch_capacity = 0;  // current adaptive event-array size
+        int cpu = -1;                // pinned CPU, -1 = unpinned
         const LatencyRecorder* events_per_wake = nullptr;
         const LatencyRecorder* wake_to_dispatch_us = nullptr;
     };
@@ -57,15 +69,23 @@ private:
     explicit EventDispatcher(int index);
     ~EventDispatcher();
     void Run();
+    // Write the eventfd so a blocking epoll_wait returns promptly.
+    void Wakeup();
 
     int epfd_ = -1;
+    int wakeup_fd_ = -1;  // eventfd registered in epfd_ (sentinel data)
     int index_ = 0;
+    int pinned_cpu_ = -1;
     std::atomic<bool> stop_{false};
+    // Adaptive batch size, written by the loop thread only; atomic so
+    // ForEachLoop can read it racily for /loops.
+    std::atomic<int64_t> batch_capacity_{64};
     // Telemetry cells live in process-lifetime labelled families; the
     // loop updates through raw pointers (relaxed atomics / recorder
     // adds) so the hot path never touches the family mutex.
     IntCell* waits_cell_ = nullptr;
     IntCell* events_cell_ = nullptr;
+    IntCell* wakeups_cell_ = nullptr;
     LatencyRecorder* events_per_wake_ = nullptr;
     LatencyRecorder* wake_us_ = nullptr;
     std::thread thread_;

@@ -7,12 +7,43 @@
 // last-successful protocol first then the others; QueueMessage (:194-234)
 // spawns a fiber per message, keeping the LAST message inline for cache
 // locality.
+//
+// Raw-speed round (ISSUE 7): the pump is now a run-to-completion
+// dispatcher. Per readiness burst it (a) arms an inline budget — small
+// messages of inline-safe protocols process ON the input fiber instead of
+// spawning a fiber each (budget exhausted -> the old fan-out, so large
+// bursts still parallelize); (b) arms a WakeBatcher so the burst's fiber
+// wakeups cost one futex signal per pool per round; (c) arms a
+// WriteCoalesceScope so responses written during the round merge into one
+// writev per socket; (d) uses Protocol::peek to classify sticky
+// connections' frames from contiguous header bytes — no cutn, no
+// re-parse loop while a partial frame trickles in.
 #pragma once
 
 #include "tnet/protocol.h"
 #include "tnet/socket.h"
 
 namespace tpurpc {
+
+// Run-to-completion inline budget (ISSUE 7). Thread-local, armed by the
+// messenger per readiness burst; protocol/RPC layers consult it to decide
+// inline-vs-fiber. Zeroed on fiber park (a parked round is over).
+namespace inline_dispatch {
+// True while the current thread is inside an armed messenger round.
+bool RoundArmed();
+// Consume one budget unit for a message of `nbytes`; false when no round
+// is armed, the budget is spent, or the message exceeds
+// -inline_dispatch_max_bytes.
+bool Acquire(size_t nbytes);
+// Give back the unit Acquire consumed (the layer above decided to fan
+// out after all — e.g. a request whose method is not inline-safe).
+void Refund();
+// Telemetry for /loops + tests.
+int64_t dispatches();        // messages processed run-to-completion
+int64_t overflows();         // inline-eligible messages past the budget
+int64_t handler_inlines();   // server handlers run on the input fiber
+void CountHandlerInline();   // called by the RPC layer's inline path
+}  // namespace inline_dispatch
 
 class InputMessenger {
 public:

@@ -43,6 +43,10 @@ public:
     // Socket the message arrived on (id; Address() to use).
     uint64_t socket_id = 0;
     int protocol_index = -1;
+    // Wire size of this message (header + body), set by parse(). The
+    // run-to-completion dispatcher uses it as the "small message" gate
+    // (-inline_dispatch_max_bytes); 0 = unknown (never inlined).
+    size_t byte_size = 0;
 };
 
 struct Protocol {
@@ -61,6 +65,26 @@ struct Protocol {
     // earlier burst messages onto fibers would let responses overtake
     // each other on one connection.
     bool process_in_order = false;
+    // Run-to-completion hint (ISSUE 7): process() is cheap and does not
+    // block, so small messages may run inline on the input fiber instead
+    // of spawning a processing fiber — subject to the per-wake
+    // -inline_dispatch_budget (input_messenger.h). Server-side handlers
+    // additionally gate on their method's inline-safe flag
+    // (Server::SetMethodInlineSafe).
+    bool inline_safe = false;
+
+    // ---- zero-cut parse fast path (optional, ISSUE 7) ----
+    // Fixed header length `peek` wants to inspect; 0 disables the fast
+    // path for this protocol.
+    uint32_t peek_len = 0;
+    // Classify a sticky connection's next frame from its first peek_len
+    // contiguous bytes WITHOUT consuming anything. Returns the total
+    // frame size in bytes (>= peek_len; the messenger then waits for the
+    // whole frame and calls parse exactly once), 0 when the header is
+    // not this protocol's (re-sniff / TRY_OTHERS), or -1 when the header
+    // is corrupt (fail the connection). Skips the cutn + re-parse loop
+    // the slow path pays on every partial read.
+    int64_t (*peek)(const char* hdr, Socket* socket) = nullptr;
 };
 
 // Global registry (reference global.cpp:416-601 registers all protocols at

@@ -17,6 +17,7 @@
 #include "tbase/logging.h"
 #include "tbase/time.h"
 #include "tfiber/call_id.h"
+#include "tfiber/task_group.h"
 #include "tnet/event_dispatcher.h"
 #include "tnet/fault_injection.h"
 #include "tnet/tls.h"
@@ -125,6 +126,7 @@ int Socket::Create(const SocketOptions& options, SocketId* id) {
     s->connecting_.store(false, std::memory_order_relaxed);
     s->read_buf.clear();
     s->preferred_protocol_index = -1;
+    s->pending_frame_bytes = 0;
     s->health_check_interval_ms_ = options.health_check_interval_ms;
     s->tls_ = options.tls;
     s->tls_alpn_ = options.tls_alpn;
@@ -325,6 +327,7 @@ int Socket::ReviveAfterHealthCheck() {
     nevent_.store(0, std::memory_order_relaxed);
     read_buf.clear();
     preferred_protocol_index = -1;
+    pending_frame_bytes = 0;
     error_code_.store(0, std::memory_order_relaxed);
     connecting_.store(false, std::memory_order_relaxed);
     local_side_ = EndPoint();
@@ -467,9 +470,88 @@ int Socket::Write(IOBuf* data, uint64_t notify_id) {
     if (write_pending_.fetch_add(1, std::memory_order_acq_rel) != 0) {
         return 0;  // an active writer owns the queue
     }
-    // Elected the writer.
+    // Elected the writer. Inside a coalescing round, hold the flush: later
+    // responses of this round pile onto the queue and leave in ONE writev
+    // when the scope flushes (chaos mode keeps the per-write KeepWrite
+    // discipline — its seams may sleep and must own their fiber).
+    if (!__builtin_expect(fault_injection_enabled(), 0) &&
+        WriteCoalesceScope::TryDefer(this)) {
+        return 0;
+    }
     StartKeepWriteIfNeeded();
     return 0;
+}
+
+// ---------------- write coalescing (ISSUE 7) ----------------
+
+// Deferred-then-flushed elections: nonzero under load is the proof the
+// run-to-completion path is merging same-socket responses.
+static LazyAdder g_coalesced_writes("rpc_socket_coalesced_writes");
+
+int64_t SocketCoalescedWrites() {
+    return (*g_coalesced_writes).get_value();
+}
+
+namespace {
+thread_local WriteCoalesceScope* g_write_scope = nullptr;
+}  // namespace
+
+WriteCoalesceScope::WriteCoalesceScope() {
+    // One-time: flush-and-detach on fiber park (the parked fiber may
+    // resume on another pthread; see task_group.h park hooks).
+    static const bool hook_registered = [] {
+        register_park_hook(&WriteCoalesceScope::FlushCurrent);
+        return true;
+    }();
+    (void)hook_registered;
+    if (g_write_scope == nullptr) {
+        g_write_scope = this;
+        armed_ = true;
+    }
+}
+
+WriteCoalesceScope::~WriteCoalesceScope() {
+    if (!armed_) return;
+    FlushDeferred();
+    // sched_park may have detached us (flushing on the old thread); only
+    // clear the slot we still own.
+    if (g_write_scope == this) g_write_scope = nullptr;
+}
+
+void WriteCoalesceScope::FlushDeferred() {
+    for (int i = 0; i < nsockets_; ++i) {
+        Socket* s = sockets_[i];
+        // The deferred election is still ours: flush (inline first, then
+        // a KeepWrite fiber for leftovers) or drain if the socket died
+        // mid-round — exactly KeepWriteThunk's failed-socket duty.
+        if (s->Failed()) {
+            s->DrainWriteQueue();
+        } else {
+            s->StartKeepWriteIfNeeded();
+        }
+        s->Dereference();
+    }
+    nsockets_ = 0;
+}
+
+bool WriteCoalesceScope::TryDefer(Socket* s) {
+    WriteCoalesceScope* scope = g_write_scope;
+    if (scope == nullptr || scope->nsockets_ >= kMaxSockets) return false;
+    // Only the ELECTED writer reaches here, and it stays elected until
+    // the flush — the same socket can never be deferred twice in one
+    // round, so no duplicate scan is needed.
+    s->AddRef();
+    scope->sockets_[scope->nsockets_++] = s;
+    *g_coalesced_writes << 1;
+    return true;
+}
+
+void WriteCoalesceScope::FlushCurrent() {
+    WriteCoalesceScope* scope = g_write_scope;
+    if (scope == nullptr) return;
+    scope->FlushDeferred();
+    scope->armed_ = false;
+    g_write_scope = nullptr;
 }
 
 void Socket::StartKeepWriteIfNeeded() {

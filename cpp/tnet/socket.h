@@ -155,6 +155,11 @@ public:
     // ---- per-connection parsing state (owned by InputMessenger) ----
     IOPortal read_buf;
     int preferred_protocol_index = -1;
+    // Zero-cut fast path (Protocol::peek): total bytes of the frame the
+    // peeked header announced, 0 when no peek is outstanding. While set,
+    // the messenger skips parse entirely until the whole frame arrived —
+    // no re-peek, no re-parse per partial read. Input-fiber-only.
+    int64_t pending_frame_bytes = 0;
     // Protocol-private per-connection state (e.g. the HTTP/2 session:
     // HPACK context + stream table). Owned by the socket once set; the
     // deleter runs at recycle. Set from the input fiber only.
@@ -337,6 +342,7 @@ public:
 private:
     friend class VersionedRefWithId<Socket>;
     friend class EventDispatcher;
+    friend class WriteCoalesceScope;
 
     struct WriteRequest {
         std::atomic<WriteRequest*> next{nullptr};
@@ -418,6 +424,51 @@ private:
     void (*conn_data_deleter_)(void*) = nullptr;
     std::mutex pipeline_mu_;
     std::deque<PipelinedInfo> pipeline_q_;
+};
+
+// Process-wide count of write elections deferred into a coalescing scope
+// (the rpc_socket_coalesced_writes tvar; /loops + tests read it here).
+int64_t SocketCoalescedWrites();
+
+// Write coalescing across one dispatch round (ISSUE 7): while a scope is
+// armed on the current thread, a Socket::Write that wins the writer
+// election DEFERS its flush — the request sits in the wait-free queue and
+// the elected-writer role transfers to the scope. FlushDeferred() (called
+// at the end of each messenger cut round, and by the scope destructor)
+// then flushes each deferred socket once, so every response queued on the
+// same connection during the round leaves in a single writev
+// (rpc_socket_write_batch_bytes grows; rpc_socket_coalesced_writes counts
+// deferred elections). Cross-request coalescing on pooled connections
+// works the same way: the round's scope spans all sockets it wrote to.
+//
+// Safety: the scope is registered in a thread-local; TaskGroup::sched_park
+// flushes-and-detaches it before any fiber switch, so a handler that
+// (illegally, per the inline-safe contract) parks mid-round can never
+// strand deferred writes on the old thread or leave a dangling pointer.
+class WriteCoalesceScope {
+public:
+    WriteCoalesceScope();   // arms on this thread (no-op when nested)
+    ~WriteCoalesceScope();  // FlushDeferred + disarm
+    WriteCoalesceScope(const WriteCoalesceScope&) = delete;
+    WriteCoalesceScope& operator=(const WriteCoalesceScope&) = delete;
+
+    // Flush every deferred socket now; the scope stays armed for the
+    // next round.
+    void FlushDeferred();
+
+    // Called by the elected writer in Socket::Write: true = the flush
+    // was deferred into the active scope (a reference is held until the
+    // flush). False when no scope is armed or it is full.
+    static bool TryDefer(Socket* s);
+    // sched_park hook: flush + detach whatever scope is armed on this
+    // thread (the parking fiber may resume on another thread).
+    static void FlushCurrent();
+
+private:
+    static constexpr int kMaxSockets = 8;
+    Socket* sockets_[kMaxSockets];  // AddRef'd until flushed
+    int nsockets_ = 0;
+    bool armed_ = false;  // this instance owns the thread slot
 };
 
 }  // namespace tpurpc

@@ -82,9 +82,13 @@ int SocketPool::Get(const EndPoint& remote, InputMessenger* messenger,
         auto it = pools_.find(remote);
         if (it != pools_.end()) {
             auto& idle = it->second;
+            // FIFO: take the LEAST recently returned member so load
+            // round-robins across the pool (and thus across the epoll
+            // loops its fds shard onto) instead of convoying on the
+            // hottest socket.
             while (!idle.empty()) {
-                const SocketId cand = idle.back().id;
-                idle.pop_back();
+                const SocketId cand = idle.front().id;
+                idle.pop_front();
                 Socket* s = Socket::Address(cand);
                 if (s != nullptr) {
                     s->Dereference();

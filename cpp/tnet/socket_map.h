@@ -3,6 +3,7 @@
 // src/brpc/socket_map.h:82-150 (SocketMapInsert/Remove keyed by endpoint).
 #pragma once
 
+#include <deque>
 #include <map>
 #include <mutex>
 #include <vector>
@@ -48,12 +49,20 @@ private:
 // never reuses its pooled connection). An idle-close sweep fails pooled
 // connections unused for -pooled_idle_close_s (reference socket_map.h:204
 // idle-close thread).
+//
+// Selection is FIFO (pop-front / return-push-back), so consecutive calls
+// ROUND-ROBIN through the pool members instead of convoying on the most
+// recently returned socket: sockets shard across the epoll loops by fd,
+// and the old LIFO stack kept re-dispatching the whole pooled load onto
+// the one or two hottest fds — the direct cause of pooled-TCP QPS
+// landing below single-connection in BENCH_r05 (ISSUE 7).
 class SocketPool {
 public:
     static SocketPool* singleton();
 
-    // Pop an idle healthy connection to `remote` or create a fresh one
-    // (connect-on-first-write). Returns 0 and sets *id.
+    // Pop the least-recently-used idle healthy connection to `remote` or
+    // create a fresh one (connect-on-first-write). Returns 0 and sets
+    // *id.
     int Get(const EndPoint& remote, InputMessenger* messenger, SocketId* id);
     // Return a connection whose RPC received its response. Over-capacity
     // or failed sockets are closed instead of pooled.
@@ -71,7 +80,7 @@ private:
         int64_t returned_us;
     };
     std::mutex mu_;
-    std::map<EndPoint, std::vector<IdleConn>> pools_;
+    std::map<EndPoint, std::deque<IdleConn>> pools_;
     bool sweeping_ = false;
 };
 

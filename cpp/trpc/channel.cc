@@ -62,9 +62,11 @@ int Channel::Init(const EndPoint& server, const ChannelOptions* options) {
     // endpoint-keyed SocketMap/SocketPool sockets are shared with
     // tpu_std channels, and installing an h2/redis session (or a TLS
     // wrap) on a shared socket would corrupt the other protocol's
-    // traffic to the same server.
+    // traffic to the same server. pin_connection opts into the same
+    // ownership for plain tpu_std (per-channel connections that shard
+    // across the epoll loops — load generators, ISSUE 7).
     if (options_.tls || options_.protocol == "grpc" ||
-        options_.protocol == "redis") {
+        options_.protocol == "redis" || options_.pin_connection) {
         if (options_.tls && !TlsAvailable()) {
             LOG(ERROR) << "ChannelOptions::tls set but libssl is missing";
             return -1;

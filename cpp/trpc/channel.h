@@ -63,6 +63,15 @@ struct ChannelOptions {
     // throttling for this channel.
     int64_t retry_budget_tokens = -1;
     double retry_budget_ratio = -1.0;
+    // Give this channel its OWN connection instead of the process-wide
+    // endpoint-keyed SocketMap socket (which every single-mode channel to
+    // the same server shares). N channels with pin_connection then drive
+    // N connections that shard across the epoll loops by fd — how a load
+    // generator scales past one event loop (rpc_press --press_threads,
+    // ISSUE 7). Single-server init only; ignored with an LB, and
+    // pointless with POOLED/SHORT connection_type (those override the
+    // pinned socket with a fly connection per call).
+    bool pin_connection = false;
 };
 
 class Channel : public google::protobuf::RpcChannel {

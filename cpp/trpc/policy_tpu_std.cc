@@ -62,8 +62,8 @@ ParseResult ParseTpuStdMessage(IOBuf* source, Socket* socket, bool read_eof,
         }
         return ParseResult::make(ParseError::NOT_ENOUGH_DATA);
     }
-    char header[kHeaderLen];
-    source->copy_to(header, kHeaderLen);
+    char aux[kHeaderLen];
+    const char* header = (const char*)source->fetch(aux, kHeaderLen);
     if (memcmp(header, kMagic, 4) != 0) {
         return ParseResult::make(ParseError::TRY_OTHERS);
     }
@@ -82,7 +82,25 @@ ParseResult ParseTpuStdMessage(IOBuf* source, Socket* socket, bool read_eof,
     auto* msg = new TpuStdMessage;
     source->cutn(&msg->meta, meta_size);
     source->cutn(&msg->body, body_size - meta_size);
+    msg->byte_size = kHeaderLen + body_size;  // inline-dispatch size gate
     return ParseResult::make_ok(msg);
+}
+
+// Zero-cut fast path (ISSUE 7): classify the next frame of a sticky
+// connection from the 12 contiguous header bytes — the messenger then
+// waits for the announced frame size and calls parse exactly once, so a
+// partially-arrived message costs no cutn and no re-parse per read.
+int64_t PeekTpuStdFrame(const char* hdr, Socket*) {
+    if (memcmp(hdr, kMagic, 4) != 0) return 0;  // re-sniff
+    uint32_t body_size, meta_size;
+    memcpy(&body_size, hdr + 4, 4);
+    memcpy(&meta_size, hdr + 8, 4);
+    body_size = ntohl(body_size);
+    meta_size = ntohl(meta_size);
+    if (meta_size > body_size || body_size > (256u << 20)) {
+        return -1;  // corrupt: fail the connection
+    }
+    return (int64_t)kHeaderLen + body_size;
 }
 
 void SendTpuStdGoaway(Socket* s) {
@@ -494,13 +512,22 @@ void ProcessTpuStdRequest(TpuStdMessage* msg, const rpc::RpcMeta& meta) {
         done->Run();
         return;
     }
-    // User code runs on its OWN fiber, never this one: the last message of
-    // a read burst is processed inline on the connection's input fiber, so
-    // a slow handler here would head-of-line-block the connection — the
-    // backup request riding the same socket would not even be PARSED until
-    // the original finished (reference keeps user code off the input path:
-    // baidu_rpc_protocol.cpp:758,839-849, details/usercode_backup_pool.h).
-    if (server->options().usercode_inline) {
+    // User code normally runs on its OWN fiber, never this one: a slow
+    // handler on the input fiber would head-of-line-block the connection —
+    // the backup request riding the same socket would not even be PARSED
+    // until the original finished (reference keeps user code off the input
+    // path: baidu_rpc_protocol.cpp:758,839-849,
+    // details/usercode_backup_pool.h).
+    //
+    // Run-to-completion exception (ISSUE 7): a method flagged inline-safe
+    // (Server::SetMethodInlineSafe — its handler promises to be cheap and
+    // to NEVER block) runs right here. On the input fiber that means
+    // read -> parse -> handler -> response write in one go, with the
+    // response joining the round's coalesced writev.
+    const bool method_inline =
+        mp->inline_safe.load(std::memory_order_relaxed);
+    if (server->options().usercode_inline || method_inline) {
+        if (method_inline) inline_dispatch::CountHandlerInline();
         CallUserMethod(mp, cntl, req, res, done);
         return;
     }
@@ -521,11 +548,23 @@ void ProcessTpuStdRequest(TpuStdMessage* msg, const rpc::RpcMeta& meta) {
             uc->counted_default = true;
         }
     }
-    // Urgent: the handler takes this worker NOW and the input fiber is
-    // requeued (it has at most a read-EAGAIN left in a single-request
-    // burst) — shaving a queue round-trip off dispatch latency, like the
-    // reference's run-bthread-immediately ProcessEvent/usercode spawns.
-    if (fiber_start_urgent(&tid, &attr, RunUserCall, uc) != 0) {
+    // Mid-burst (running on the input fiber with MORE bytes already read
+    // and waiting in the cut loop): spawn in the BACKGROUND — an urgent
+    // handoff would park the input fiber and serialize the whole burst
+    // behind this handler. Give the budget unit back; this message fanned
+    // out after all. The last/solo message of a wake (read_buf drained —
+    // the classic single-request case) keeps the urgent path: the handler
+    // takes this worker NOW, the input fiber has at most a read-EAGAIN
+    // left (the reference's run-bthread-immediately ProcessEvent/usercode
+    // spawns). read_buf is input-fiber-owned, and RoundArmed() is only
+    // true ON the input fiber, so the read is race-free.
+    const bool mid_burst =
+        inline_dispatch::RoundArmed() && !s->read_buf.empty();
+    if (mid_burst) inline_dispatch::Refund();
+    const int spawn_rc =
+        mid_burst ? fiber_start_background(&tid, &attr, RunUserCall, uc)
+                  : fiber_start_urgent(&tid, &attr, RunUserCall, uc);
+    if (spawn_rc != 0) {
         const bool counted = uc->counted_default;
         delete uc;  // fall back inline (fiber system saturated/shut down)
         if (counted) {
@@ -601,6 +640,14 @@ void GlobalInitializeOrDie() {
         p.parse = ParseTpuStdMessage;
         p.process = ProcessTpuStdMessage;
         p.name = "tpu_std";
+        // Run-to-completion (ISSUE 7): small frames process on the input
+        // fiber (responses complete RPCs; requests still fan their
+        // handler out unless the method is flagged inline-safe), and the
+        // 12-byte header peek skips the cut/re-parse loop on sticky
+        // connections.
+        p.inline_safe = true;
+        p.peek = PeekTpuStdFrame;
+        p.peek_len = kHeaderLen;
         g_tpu_std_index = RegisterProtocol(p);
         stream_internal::RegisterStreamProtocolOrDie();
         RegisterIciHandshakeProtocol();

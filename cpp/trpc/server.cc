@@ -377,6 +377,19 @@ Server::MethodProperty* Server::FindMethod(const std::string& service_name,
     return it == methods_.end() ? nullptr : &it->second;
 }
 
+int Server::SetMethodInlineSafe(const std::string& service_full_name,
+                                const std::string& method_name,
+                                bool inline_safe) {
+    MethodProperty* mp = FindMethod(service_full_name, method_name);
+    if (mp == nullptr) {
+        LOG(ERROR) << "SetMethodInlineSafe: no method " << service_full_name
+                   << "." << method_name;
+        return -1;
+    }
+    mp->inline_safe.store(inline_safe, std::memory_order_relaxed);
+    return 0;
+}
+
 Server::MethodProperty* Server::FindMethodByHttpPath(
     const std::string& path) {
     // Expect exactly "/<service>/<method>".

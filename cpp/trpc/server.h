@@ -111,10 +111,29 @@ public:
         google::protobuf::Service* service = nullptr;
         const google::protobuf::MethodDescriptor* method = nullptr;
         std::unique_ptr<MethodStatus> status;
+        // Run-to-completion opt-in (ISSUE 7): the handler promises to be
+        // cheap and to NEVER block (no sync downstream calls, no
+        // fiber_usleep, no lock waits) — small requests then run it ON
+        // the connection's input fiber with the response joining the
+        // round's coalesced writev. A handler that parks anyway stays
+        // correct (the scheduler flushes the round's batching scopes on
+        // park) but head-of-line-blocks its connection. Atomic: toggled
+        // at runtime (e.g. a soak's delay phase) while input fibers read
+        // it; relaxed is enough — a momentarily stale read just picks
+        // the other (also correct) dispatch path.
+        std::atomic<bool> inline_safe{false};
     };
 
     // Does NOT take ownership (reference SERVER_DOESNT_OWN_SERVICE default).
     int AddService(google::protobuf::Service* service);
+
+    // Flag "pkg.Service.Method" (AddService key format) inline-safe; see
+    // MethodProperty::inline_safe for the contract. May be toggled at
+    // runtime (e.g. off while a soak injects handler delays). Returns 0,
+    // or -1 when the method is unknown.
+    int SetMethodInlineSafe(const std::string& service_full_name,
+                            const std::string& method_name,
+                            bool inline_safe = true);
 
     int Start(const EndPoint& ep, const ServerOptions* options);
     int Start(int port, const ServerOptions* options);  // 0 = ephemeral

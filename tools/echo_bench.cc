@@ -199,6 +199,9 @@ int RunIciServer() {
     static EchoServiceImpl service;
     static Server server;
     if (server.AddService(&service) != 0) return 1;
+    // Echo never blocks in server mode (no tail injection here):
+    // run-to-completion dispatch is safe.
+    server.SetMethodInlineSafe("benchpb.EchoService", "Echo");
     static RedisService redis;
     redis.AddBasicKvCommands();
     server.set_redis_service(&redis);
@@ -363,6 +366,16 @@ int main(int argc, char** argv) {
         if (channel.Init(ep, &copts) != 0) return 1;
     }
     benchpb::EchoService_Stub stub(&channel);
+
+    // Run-to-completion (ISSUE 7): the echo handler is cheap and
+    // non-blocking, so flag it inline-safe — small requests run on the
+    // input fiber and their responses coalesce into one writev per
+    // burst. NOT in tail mode: there the handler sleeps (the injected
+    // long tail), which would head-of-line-block the connection and
+    // defeat the backup request riding the same socket.
+    if (!tail) {
+        server.SetMethodInlineSafe("benchpb.EchoService", "Echo");
+    }
 
     if (tail) {
         // Backup-request tail benchmark (reference benchmark.md:126-206):

@@ -519,6 +519,7 @@ int main(int argc, char** argv) {
     int timeout_cl_ms = 0;
     int drain_ms = 1200;
     bool lb_only = false;
+    bool inline_echo = false;
     const char* peers_file = nullptr;
     for (int i = 1; i < argc; ++i) {
         if (strcmp(argv[i], "--port") == 0 && i + 1 < argc) {
@@ -538,6 +539,14 @@ int main(int argc, char** argv) {
                    i + 1 < argc) {
             g_traffic_delay_ms.store(atoi(argv[++i]),
                                      std::memory_order_relaxed);
+        } else if (strcmp(argv[i], "--inline_echo") == 0) {
+            // Run-to-completion soak mode (ISSUE 7): flag the echo
+            // method inline-safe so small requests run on the input
+            // fiber. OFF by default — this node's handler can be told to
+            // sleep ("delay") and to chain downstream calls, both of
+            // which violate the inline-safe contract; the delay command
+            // clears the flag for its phase.
+            inline_echo = true;
         } else if (strcmp(argv[i], "--lb_only") == 0) {
             // Rolling-restart soak mode: only the naming/LB plane runs.
             // The shm-ICI links die hard when a peer exits (no drain
@@ -559,8 +568,8 @@ int main(int argc, char** argv) {
     if (port <= 0 || peers_file == nullptr) {
         fprintf(stderr,
                 "usage: mesh_node --port N --peers FILE [--id K] "
-                "[--lb_only] [--drain_ms N] [--timeout_cl_ms N] "
-                "[--flag name=value]...\n"
+                "[--lb_only] [--inline_echo] [--drain_ms N] "
+                "[--timeout_cl_ms N] [--flag name=value]...\n"
                 "  with --flag graceful_quit_on_sigterm=true: SIGTERM "
                 "drains gracefully and exits 0; SIGUSR2 drains without "
                 "quitting\n");
@@ -574,6 +583,9 @@ int main(int argc, char** argv) {
     static EchoServiceImpl service;
     static Server server;
     if (server.AddService(&service) != 0) return 1;
+    if (inline_echo) {
+        server.SetMethodInlineSafe("benchpb.EchoService", "Echo");
+    }
     EndPoint listen;
     str2endpoint("127.0.0.1", port, &listen);
     ServerOptions sopts;
@@ -687,6 +699,12 @@ int main(int argc, char** argv) {
         } else if (strncmp(cmd, "delay", 5) == 0) {
             int h = 0, s_ms = 0;
             if (sscanf(cmd + 5, "%d %d", &h, &s_ms) == 2) {
+                // A sleeping handler must never run on the input fiber:
+                // suspend run-to-completion for the delay phase.
+                if (inline_echo) {
+                    server.SetMethodInlineSafe("benchpb.EchoService",
+                                               "Echo", h <= 0);
+                }
                 g_handler_delay_ms.store(h, std::memory_order_relaxed);
                 g_stale_budget_ms.store(s_ms, std::memory_order_relaxed);
                 printf("DELAY_OK %d %d\n", h, s_ms);

@@ -4,8 +4,15 @@
 // rdma_performance client.cpp:50-68).
 //
 //   rpc_press --server=ip:port [--qps=10000] [--duration_s=10]
-//             [--payload=4096] [--callers=8] [--pooled]
-//             [--timeout_ms=5000] [--metrics_csv=path]
+//             [--payload=4096] [--callers=8] [--press_threads=1]
+//             [--pooled] [--timeout_ms=5000] [--metrics_csv=path]
+//
+// --press_threads=N drives N independent pinned channels (one connection
+// each, callers spread round-robin), so the generator scales past a
+// single event loop / input fiber — at high connection counts the SERVER
+// must be the bottleneck, not this tool (ISSUE 7). The generator config
+// rides the --json line (press_threads/press_callers/...) so BENCH
+// records say how the load was made.
 //
 // --timeout_ms sets the per-request deadline (propagated to the server
 // as the remaining-budget meta): tiny values drive the server's
@@ -24,6 +31,7 @@
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
+#include <memory>
 #include <string>
 #include <vector>
 
@@ -91,6 +99,7 @@ int main(int argc, char** argv) {
     int duration_s = 10;
     int payload = 4096;
     int callers = 8;
+    int press_threads = 1;
     long long timeout_ms = 5000;
     bool pooled = false;
     bool json = false;
@@ -98,6 +107,9 @@ int main(int argc, char** argv) {
     for (int i = 1; i < argc; ++i) {
         if (strncmp(argv[i], "--metrics_csv=", 14) == 0) {
             metrics_csv = argv[i] + 14;
+        }
+        if (strncmp(argv[i], "--press_threads=", 16) == 0) {
+            press_threads = atoi(argv[i] + 16);
         }
         if (strncmp(argv[i], "--server=", 9) == 0) server_str = argv[i] + 9;
         if (strncmp(argv[i], "--qps=", 6) == 0) qps = atoll(argv[i] + 6);
@@ -122,8 +134,9 @@ int main(int argc, char** argv) {
     if (server_str.empty()) {
         fprintf(stderr,
                 "usage: rpc_press --server=ip:port [--qps=N] "
-                "[--duration_s=N] [--payload=N] [--callers=N] [--pooled] "
-                "[--timeout_ms=N] [--json]\n");
+                "[--duration_s=N] [--payload=N] [--callers=N] "
+                "[--press_threads=N] [--pooled] [--timeout_ms=N] "
+                "[--json]\n");
         return 1;
     }
     EndPoint server;
@@ -131,12 +144,27 @@ int main(int argc, char** argv) {
         fprintf(stderr, "bad server address: %s\n", server_str.c_str());
         return 1;
     }
-    Channel channel;
+    if (press_threads < 1) press_threads = 1;
+    if (callers < press_threads) callers = press_threads;
     ChannelOptions copts;
     copts.timeout_ms = timeout_ms;
     if (pooled) copts.connection_type = CONNECTION_TYPE_POOLED;
-    if (channel.Init(server, &copts) != 0) return 1;
-    benchpb::EchoService_Stub stub(&channel);
+    // Multi-channel generator: each channel pins its own connection so
+    // the N connections shard across the server's (and this tool's)
+    // epoll loops; a single shared SocketMap socket would serialize all
+    // callers through one input fiber. NOT in pooled mode: pooled calls
+    // ride fly sockets from the shared per-endpoint pool (the pin would
+    // be bypassed and just leak one idle connection per channel) and the
+    // pool's FIFO rotation already spreads load across connections.
+    copts.pin_connection = press_threads > 1 && !pooled;
+    std::vector<std::unique_ptr<Channel>> channels;
+    std::vector<std::unique_ptr<benchpb::EchoService_Stub>> stubs;
+    for (int i = 0; i < press_threads; ++i) {
+        channels.emplace_back(new Channel);
+        if (channels.back()->Init(server, &copts) != 0) return 1;
+        stubs.emplace_back(
+            new benchpb::EchoService_Stub(channels.back().get()));
+    }
 
     IOBuf filler;
     filler.append(std::string((size_t)payload, 'p'));
@@ -145,11 +173,18 @@ int main(int argc, char** argv) {
     std::atomic<bool> stop{false};
     std::atomic<int64_t> sent{0};
     std::atomic<int64_t> failed{0};
-    PressCtx ctx{&stub, &lat,    &tokens, &stop,
-                 &sent, &failed, &filler, timeout_ms};
+    // One ctx per channel; callers spread round-robin across them.
+    std::vector<PressCtx> ctxs;
+    ctxs.reserve((size_t)press_threads);
+    for (int i = 0; i < press_threads; ++i) {
+        ctxs.push_back(PressCtx{stubs[(size_t)i].get(), &lat, &tokens,
+                                &stop, &sent, &failed, &filler,
+                                timeout_ms});
+    }
     std::vector<fiber_t> tids((size_t)callers);
-    for (auto& tid : tids) {
-        fiber_start_background(&tid, nullptr, PressCaller, &ctx);
+    for (size_t i = 0; i < tids.size(); ++i) {
+        fiber_start_background(&tids[i], nullptr, PressCaller,
+                               &ctxs[i % ctxs.size()]);
     }
 
     // Per-interval scrape sink (--metrics_csv): one appended row per
@@ -219,18 +254,24 @@ int main(int argc, char** argv) {
     const double secs = (double)(monotonic_time_us() - t0) / 1e6;
     const double achieved = (double)sent.load() / secs;
     if (json) {
+        // Generator config rides along so BENCH records are
+        // reproducible: the same qps from 1 vs 16 connections stresses
+        // completely different server paths.
         printf("{\"press_qps\": %.0f, \"press_target_qps\": %lld, "
                "\"press_failed\": %lld, \"press_p50_us\": %lld, "
-               "\"press_p99_us\": %lld, \"press_p999_us\": %lld}\n",
+               "\"press_p99_us\": %lld, \"press_p999_us\": %lld, "
+               "\"press_threads\": %d, \"press_callers\": %d, "
+               "\"press_payload\": %d, \"press_pooled\": %d}\n",
                achieved, qps, (long long)failed.load(),
                (long long)lat.latency_percentile(0.5),
                (long long)lat.latency_percentile(0.99),
-               (long long)lat.latency_percentile(0.999));
+               (long long)lat.latency_percentile(0.999), press_threads,
+               callers, payload, pooled ? 1 : 0);
     } else {
         printf("sent %lld ok (%lld failed) in %.1fs: %.0f qps "
-               "(target %lld)\n",
+               "(target %lld, %d channels x %d callers)\n",
                (long long)sent.load(), (long long)failed.load(), secs,
-               achieved, qps);
+               achieved, qps, press_threads, callers);
         printf("latency_us: p50 %lld  p99 %lld  p999 %lld  max %lld\n",
                (long long)lat.latency_percentile(0.5),
                (long long)lat.latency_percentile(0.99),

@@ -189,6 +189,32 @@ def _http(port, path, timeout=5):
         return r.read().decode()
 
 
+def _spawn_node_ready(node, port, peers, extra_args=(), timeout_s=20.0):
+    """Boot one mesh_node and wait for its READY line. Returns
+    (proc, ready): the caller always owns proc teardown (its finally
+    reaps it whether or not READY ever arrived)."""
+    proc = subprocess.Popen(
+        [str(node), "--port", str(port), "--peers", str(peers)]
+        + list(extra_args),
+        stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL,
+    )
+    deadline = time.time() + timeout_s
+    buf = b""
+    while b"READY" not in buf:
+        remain = deadline - time.time()
+        if remain <= 0:
+            return proc, False
+        r, _, _ = select.select([proc.stdout], [], [], remain)
+        if not r:
+            return proc, False
+        chunk = os.read(proc.stdout.fileno(), 4096)
+        if not chunk:
+            return proc, False
+        buf += chunk
+    return proc, True
+
+
 def series_scrape():
     """Time-series trajectory for the BENCH record: boot one mesh_node,
     drive it with rpc_press --metrics_csv, then scrape the server's own
@@ -209,24 +235,9 @@ def series_scrape():
             peers = Path(td) / "peers"
             peers.write_text("127.0.0.1:%d\n" % port)
             csv = Path(td) / "press.csv"
-            proc = subprocess.Popen(
-                [str(node), "--port", str(port), "--peers", str(peers)],
-                stdin=subprocess.PIPE, stdout=subprocess.PIPE,
-                stderr=subprocess.DEVNULL,
-            )
-            deadline = time.time() + 20.0
-            buf = b""
-            while b"READY" not in buf:
-                remain = deadline - time.time()
-                if remain <= 0:
-                    return None
-                r, _, _ = select.select([proc.stdout], [], [], remain)
-                if not r:
-                    return None
-                chunk = os.read(proc.stdout.fileno(), 4096)
-                if not chunk:
-                    return None
-                buf += chunk
+            proc, ready = _spawn_node_ready(node, port, peers)
+            if not ready:
+                return None
             # Generator config mirrored into the BENCH record (ISSUE 7):
             # a qps number is only comparable round-to-round if the load
             # shape that produced it is pinned alongside it.
@@ -275,6 +286,69 @@ def series_scrape():
                 proc.wait()  # reap: no zombie holding the port
 
 
+def qos_isolation_scrape():
+    """QoS isolation trajectory (ISSUE 8): boot one mesh_node with
+    tenant quotas, run one mixed-tenant press where bronze floods at 8x
+    its quota while gold trickles at high priority, and record gold's
+    qps/p99 plus bronze's shed count — the BENCH record then tracks
+    whether isolation holds round over round (gold_p99 is a real
+    lower-is-better metric for --compare; bronze counters are context).
+    """
+    node = BUILD / "mesh_node"
+    press = BUILD / "rpc_press"
+    if not node.exists() or not press.exists():
+        return None
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    proc = None
+    try:
+        with tempfile.TemporaryDirectory() as td:
+            peers = Path(td) / "peers"
+            peers.write_text("127.0.0.1:%d\n" % port)
+            proc, ready = _spawn_node_ready(
+                node, port, peers,
+                ["--flag", "rpc_qos_enabled=true", "--flag",
+                 "rpc_tenant_quotas=bronze:qps=250,burst=50,w=1,conc=4;"
+                 "gold:w=8"])
+            if not ready:
+                return None
+            res = subprocess.run(
+                [str(press), "--server=127.0.0.1:%d" % port,
+                 "--tenants=gold:1:7,bronze:10:1", "--qps=2200",
+                 "--duration_s=3", "--callers=12", "--max_retry=0",
+                 "--payload=128", "--json"],
+                capture_output=True, timeout=60, text=True,
+            )
+            line = None
+            for ln in reversed(res.stdout.splitlines()):
+                if ln.startswith("{"):
+                    line = json.loads(ln)
+                    break
+            if line is None or "press_tenants" not in line:
+                return None
+            gold = line["press_tenants"].get("gold", {})
+            bronze = line["press_tenants"].get("bronze", {})
+            return {
+                "qos_gold_qps": int(gold.get("qps", 0)),
+                "qos_gold_p99_us": int(gold.get("p99_us", 0)),
+                "qos_gold_failed": int(gold.get("failed", 0)),
+                "qos_bronze_qps": int(bronze.get("qps", 0)),
+                "qos_bronze_shed": int(bronze.get("shed", 0)),
+            }
+    except Exception:
+        return None
+    finally:
+        if proc is not None:
+            try:
+                proc.stdin.close()
+                proc.wait(timeout=10)
+            except Exception:
+                proc.kill()
+                proc.wait()
+
+
 # Compare-mode metric directions: latency-ish keys regress UP, the rest
 # (throughput/qps/counts) regress DOWN. Non-numeric values, series
 # arrays, evidence paths, and derived ratios are skipped — as are the
@@ -291,7 +365,11 @@ _SKIP_KEYS = {"metric", "unit", "vs_baseline", "reps", "error",
               "coalesced_writes", "scheduler_steals",
               "socket_write_batches", "status_json_qps",
               "press_gen_threads", "press_gen_callers", "press_gen_qps",
-              "press_gen_payload"}
+              "press_gen_payload",
+              # QoS context counters: bronze's achieved volumes depend on
+              # the flood shape and how hard it is shed, not on code
+              # quality — gold qps/p99 are the compared isolation metrics.
+              "qos_bronze_shed", "qos_bronze_qps", "qos_gold_failed"}
 
 
 def _lower_is_better(key):
@@ -427,6 +505,7 @@ def run_bench():
                      timeout=600)
     device = device_path()
     series = series_scrape()
+    qos = qos_isolation_scrape()
 
     mbps = float(ici["mbps"])
     out = {
@@ -453,6 +532,8 @@ def run_bench():
         out.update(device)
     if series is not None:
         out.update(series)
+    if qos is not None:
+        out.update(qos)
     print(json.dumps(out))
 
 

@@ -19,6 +19,8 @@ const char* terror(int code) {
         case TERR_INTERNAL: return "Internal error";
         case TERR_AUTH: return "Authentication failed";
         case TERR_DRAINING: return "Server draining (planned shutdown)";
+        case TERR_OVERLOAD:
+            return "Overloaded, shed by priority (retry after backoff)";
         default: return strerror(code);
     }
 }

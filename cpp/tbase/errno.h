@@ -23,6 +23,14 @@ enum RpcErrno {
     // consuming retry budget (re-issuing cannot amplify load on a
     // server that is going away).
     TERR_DRAINING = 4012,
+    // Priority-aware overload shed (multi-tenant QoS tier): the server
+    // rejected or evicted this request under overload — tenant rate
+    // quota dry, fair-queue high-water crossed, or a higher-priority
+    // arrival took its place. Retriable, with the server-suggested
+    // backoff from the response meta (jittered client-side), and it
+    // SPENDS retry budget: overload re-issues amplify load, so they are
+    // never free (contrast TERR_DRAINING).
+    TERR_OVERLOAD = 4013,
 };
 
 const char* terror(int code);

@@ -1,0 +1,491 @@
+// Multi-tenant QoS unit tests (ISSUE 8): DRR fairness math, token-bucket
+// refill, priority shed ordering, rendezvous-hash subset stability under
+// add/remove, overload error mapping, and the per-priority probe
+// regression in TimeoutConcurrencyLimiter::AdmitWithBudget.
+//
+// Everything here is protobuf-free: the suite also links into the
+// standalone (toolchain-less container) harness alongside tnet_test.
+#include <unistd.h>
+
+#include <algorithm>
+#include <atomic>
+#include <set>
+#include <string>
+#include <vector>
+
+#include "tbase/errno.h"
+#include "tbase/flags.h"
+#include "tbase/time.h"
+#include "trpc/concurrency_limiter.h"
+#include "trpc/qos.h"
+#include "ttest/ttest.h"
+
+using namespace tpurpc;
+
+namespace {
+
+// Test dispatch units: record service/shed order through plain statics
+// (Pop/Enqueue run on this thread only in these tests).
+std::vector<std::string>* g_ran_order = nullptr;
+std::vector<std::string>* g_shed_order = nullptr;
+int64_t g_last_shed_backoff = 0;
+
+struct TestItem {
+    std::string tag;
+};
+
+void RunCb(void* arg) {
+    auto* it = (TestItem*)arg;
+    if (g_ran_order != nullptr) g_ran_order->push_back(it->tag);
+    delete it;
+}
+
+void ShedCb(void* arg, int64_t backoff_ms) {
+    auto* it = (TestItem*)arg;
+    if (g_shed_order != nullptr) g_shed_order->push_back(it->tag);
+    g_last_shed_backoff = backoff_ms;
+    delete it;
+}
+
+QosDispatcher::Item MakeItem(const std::string& tag) {
+    QosDispatcher::Item item;
+    item.run = RunCb;
+    item.shed = ShedCb;
+    item.arg = new TestItem{tag};
+    return item;
+}
+
+// Pop everything currently poppable, running each item's run callback.
+int DrainAll(QosDispatcher* q) {
+    int n = 0;
+    QosDispatcher::Item it;
+    QosDispatcher::TenantState* t;
+    int p;
+    while (q->Pop(&it, &t, &p)) {
+        it.run(it.arg);
+        q->OnDone(t, 10);
+        ++n;
+    }
+    return n;
+}
+
+}  // namespace
+
+TEST(Qos, ParseQuotaSpec) {
+    std::map<std::string, TenantQuota> q;
+    EXPECT_TRUE(ParseQuotaSpec(
+        "bronze:qps=300,burst=64,w=1,conc=8;gold:w=8", &q));
+    ASSERT_EQ(q.size(), 2u);
+    EXPECT_EQ((int64_t)q["bronze"].qps, 300);
+    EXPECT_EQ(q["bronze"].burst, 64);
+    EXPECT_EQ(q["bronze"].weight, 1);
+    EXPECT_EQ(q["bronze"].max_concurrency, 8);
+    EXPECT_EQ(q["gold"].weight, 8);
+    EXPECT_EQ((int64_t)q["gold"].qps, 0);  // unlimited
+    // Malformed entries are reported but the valid part still lands.
+    std::map<std::string, TenantQuota> q2;
+    EXPECT_FALSE(ParseQuotaSpec("ok:w=2;borked;also:nope=1", &q2));
+    EXPECT_EQ(q2["ok"].weight, 2);
+}
+
+TEST(Qos, ClampPriority) {
+    EXPECT_EQ(ClampPriority(-5), kMinPriority);
+    EXPECT_EQ(ClampPriority(99), kMaxPriority);
+    EXPECT_EQ(ClampPriority(3), 3);
+}
+
+TEST(Qos, PriorityFromHeaderStrictParse) {
+    // Garbage in x-tpu-priority must land in the DEFAULT class, never
+    // class 0 (maximally sheddable).
+    EXPECT_EQ(PriorityFromHeader(nullptr), kDefaultPriority);
+    std::string s = "high";
+    EXPECT_EQ(PriorityFromHeader(&s), kDefaultPriority);
+    s = "3x";
+    EXPECT_EQ(PriorityFromHeader(&s), kDefaultPriority);
+    s = "";
+    EXPECT_EQ(PriorityFromHeader(&s), kDefaultPriority);
+    s = "6";
+    EXPECT_EQ(PriorityFromHeader(&s), 6);
+    s = "99";
+    EXPECT_EQ(PriorityFromHeader(&s), kMaxPriority);
+    s = "-2";
+    EXPECT_EQ(PriorityFromHeader(&s), kMinPriority);
+}
+
+TEST(Qos, ExplicitQuotaSurvivesConfigure) {
+    // SetTenantQuota before Start must survive the Start-time flag
+    // apply (Configure), and override the flag for the same tenant.
+    QosDispatcher q;
+    q.SetTenantQuota("cfg_gold", TenantQuota{100, 0, 5, 0});
+    std::map<std::string, TenantQuota> flag;
+    flag["cfg_bronze"] = TenantQuota{250, 0, 1, 0};
+    flag["cfg_gold"] = TenantQuota{7, 0, 1, 0};  // loses to the explicit
+    q.Configure(flag, false);
+    auto* g = q.Acquire("cfg_gold");
+    EXPECT_EQ(g->weight.load(std::memory_order_relaxed), 5);
+    EXPECT_EQ((int64_t)g->quota.qps, 100);
+    auto* b = q.Acquire("cfg_bronze");
+    EXPECT_EQ((int64_t)b->quota.qps, 250);
+    EXPECT_TRUE(q.enabled());
+}
+
+TEST(Qos, TokenBucketRefill) {
+    TokenBucket b;
+    b.Configure(100, 10);  // 100/s, burst 10
+    const int64_t t0 = monotonic_time_us();
+    int64_t wait_ms = 0;
+    for (int i = 0; i < 10; ++i) {
+        EXPECT_TRUE(b.TryWithdraw(t0, &wait_ms));
+    }
+    EXPECT_FALSE(b.TryWithdraw(t0, &wait_ms));
+    EXPECT_GE(wait_ms, 1);  // suggested come-back time
+    // 50ms at 100/s = 5 tokens accrued.
+    const int64_t t1 = t0 + 50 * 1000;
+    for (int i = 0; i < 5; ++i) {
+        EXPECT_TRUE(b.TryWithdraw(t1, &wait_ms));
+    }
+    EXPECT_FALSE(b.TryWithdraw(t1, &wait_ms));
+    // A long idle stretch refills to burst, never beyond.
+    const int64_t t2 = t1 + 10 * 1000 * 1000;
+    for (int i = 0; i < 10; ++i) {
+        EXPECT_TRUE(b.TryWithdraw(t2, &wait_ms));
+    }
+    EXPECT_FALSE(b.TryWithdraw(t2, &wait_ms));
+    // Unconfigured bucket admits everything.
+    TokenBucket open_bucket;
+    EXPECT_TRUE(open_bucket.TryWithdraw(t0, &wait_ms));
+}
+
+TEST(Qos, DrrFairnessMath) {
+    QosDispatcher q;
+    q.SetTenantQuota("drrA", TenantQuota{0, 0, 8, 0});
+    q.SetTenantQuota("drrB", TenantQuota{0, 0, 1, 0});
+    auto* ta = q.Acquire("drrA");
+    auto* tb = q.Acquire("drrB");
+    for (int i = 0; i < 40; ++i) {
+        EXPECT_TRUE(q.Enqueue(ta, kDefaultPriority, MakeItem("A")));
+        EXPECT_TRUE(q.Enqueue(tb, kDefaultPriority, MakeItem("B")));
+    }
+    std::vector<std::string> order;
+    g_ran_order = &order;
+    QosDispatcher::Item it;
+    QosDispatcher::TenantState* owner;
+    int prio;
+    for (int i = 0; i < 18; ++i) {
+        ASSERT_TRUE(q.Pop(&it, &owner, &prio));
+        it.run(it.arg);
+        q.OnDone(owner, 10);
+    }
+    // Deficit round robin, cost 1, weights 8:1 — each full round serves
+    // 8 A then 1 B.
+    int a = 0, b = 0;
+    for (const auto& tag : order) (tag == "A" ? a : b)++;
+    EXPECT_EQ(a, 16);
+    EXPECT_EQ(b, 2);
+    // And the LAST of the first nine is the B turn (A's quantum first).
+    EXPECT_EQ(order[8], "B");
+    g_ran_order = nullptr;
+    DrainAll(&q);
+}
+
+TEST(Qos, StrictPriorityAcrossLevels) {
+    QosDispatcher q;
+    auto* t = q.Acquire("prio_tenant");
+    EXPECT_TRUE(q.Enqueue(t, 1, MakeItem("low")));
+    EXPECT_TRUE(q.Enqueue(t, 6, MakeItem("high")));
+    EXPECT_TRUE(q.Enqueue(t, 4, MakeItem("mid")));
+    std::vector<std::string> order;
+    g_ran_order = &order;
+    EXPECT_EQ(DrainAll(&q), 3);
+    ASSERT_EQ(order.size(), 3u);
+    EXPECT_EQ(order[0], "high");
+    EXPECT_EQ(order[1], "mid");
+    EXPECT_EQ(order[2], "low");
+    g_ran_order = nullptr;
+}
+
+TEST(Qos, PriorityShedOrdering) {
+    SetFlagValue("rpc_fair_queue_highwater", "4");
+    {
+        QosDispatcher q;
+        auto* lo = q.Acquire("shed_lo");
+        auto* hi = q.Acquire("shed_hi");
+        for (int i = 0; i < 4; ++i) {
+            EXPECT_TRUE(
+                q.Enqueue(lo, 1, MakeItem("lo" + std::to_string(i))));
+        }
+        std::vector<std::string> shed;
+        g_shed_order = &shed;
+        // High-priority arrival to a full queue: the NEWEST low-priority
+        // item is evicted (TERR_OVERLOAD + backoff), the newcomer gets
+        // its slot.
+        EXPECT_TRUE(q.Enqueue(hi, 6, MakeItem("hi0")));
+        ASSERT_EQ(shed.size(), 1u);
+        EXPECT_EQ(shed[0], "lo3");  // LIFO shed of the flooder
+        EXPECT_GE(g_last_shed_backoff, 1);
+        EXPECT_EQ(q.queue_depth(), 4);
+        // A low-priority arrival with nothing below it sheds ITSELF.
+        EXPECT_FALSE(q.Enqueue(lo, 1, MakeItem("lo_new")));
+        ASSERT_EQ(shed.size(), 2u);
+        EXPECT_EQ(shed[1], "lo_new");
+        // Same-priority arrival cannot evict its own class either.
+        EXPECT_FALSE(q.Enqueue(hi, 1, MakeItem("hi_low_class")));
+        EXPECT_EQ(shed.size(), 3u);
+        // EvictOneBelow (the concurrency-limiter relief path): a prio-6
+        // caller can shed one queued prio-1 item.
+        EXPECT_TRUE(q.EvictOneBelow(6));
+        EXPECT_EQ(shed.size(), 4u);
+        EXPECT_FALSE(q.EvictOneBelow(1));  // nothing strictly below 1 left
+        // Per-tenant shed counters landed on the owners.
+        EXPECT_GE(lo->shed->get(), 3);
+        g_shed_order = nullptr;
+        DrainAll(&q);
+    }
+    SetFlagValue("rpc_fair_queue_highwater", "1024");
+}
+
+TEST(Qos, ConcurrencyShareGatesDispatch) {
+    QosDispatcher q;
+    q.SetTenantQuota("conc_t", TenantQuota{0, 0, 1, 2});
+    auto* t = q.Acquire("conc_t");
+    // Direct dispatch honors the share...
+    EXPECT_TRUE(q.TryDirectDispatch(t));
+    EXPECT_TRUE(q.TryDirectDispatch(t));
+    EXPECT_FALSE(q.TryDirectDispatch(t));  // over the share: must queue
+    // ...and the queue holds the tenant while it is saturated.
+    EXPECT_TRUE(q.Enqueue(t, kDefaultPriority, MakeItem("queued")));
+    QosDispatcher::Item it;
+    QosDispatcher::TenantState* owner;
+    int prio;
+    EXPECT_FALSE(q.Pop(&it, &owner, &prio));  // share exhausted
+    q.OnDone(t, 10);                          // one handler finished
+    ASSERT_TRUE(q.Pop(&it, &owner, &prio));   // now it dispatches
+    it.run(it.arg);
+    q.OnDone(owner, 10);
+    q.OnDone(t, 10);
+    EXPECT_EQ(t->inflight.load(), 0);
+}
+
+TEST(Qos, DirectDispatchRequiresEmptyQueue) {
+    QosDispatcher q;
+    auto* t = q.Acquire("gate_t");
+    EXPECT_TRUE(q.TryDirectDispatch(t));  // empty queue: fast path legal
+    q.OnDone(t, 5);
+    EXPECT_TRUE(q.Enqueue(t, kDefaultPriority, MakeItem("x")));
+    // With anything queued the fast path yields to fairness.
+    EXPECT_FALSE(q.TryDirectDispatch(t));
+    DrainAll(&q);
+    EXPECT_TRUE(q.TryDirectDispatch(t));
+    q.OnDone(t, 5);
+}
+
+TEST(Qos, TenantCardinalityFoldsIntoOther) {
+    SetFlagValue("rpc_max_tenants", "4");
+    {
+        QosDispatcher q;
+        q.SetTenantQuota("known", TenantQuota{0, 0, 3, 0});
+        for (int i = 0; i < 4; ++i) {
+            q.Acquire("card_" + std::to_string(i));
+        }
+        // Past the cap, unknown names fold into "other"...
+        auto* o1 = q.Acquire("card_freshly_minted");
+        auto* o2 = q.Acquire("card_another_one");
+        EXPECT_EQ(o1, o2);
+        EXPECT_EQ(o1->name, "other");
+        // ...but configured tenants always get their own slot.
+        auto* k = q.Acquire("known");
+        EXPECT_EQ(k->name, "known");
+        EXPECT_EQ(k->quota.weight, 3);
+    }
+    SetFlagValue("rpc_max_tenants", "64");
+}
+
+TEST(Qos, RendezvousSubsetStability) {
+    std::vector<std::string> keys;
+    for (int i = 0; i < 10; ++i) {
+        keys.push_back("10.0.0." + std::to_string(i) + ":8000");
+    }
+    const uint64_t seed = 42;
+    const size_t k = 4;
+    auto pick = RendezvousSubset(seed, keys, k);
+    ASSERT_EQ(pick.size(), k);
+    std::set<std::string> chosen;
+    for (size_t idx : pick) chosen.insert(keys[idx]);
+    EXPECT_EQ(chosen.size(), k);
+    // Same inputs -> same subset (determinism).
+    auto pick2 = RendezvousSubset(seed, keys, k);
+    std::set<std::string> chosen2;
+    for (size_t idx : pick2) chosen2.insert(keys[idx]);
+    EXPECT_TRUE(chosen == chosen2);
+    // Removing a NON-member changes nothing.
+    std::vector<std::string> without_nonmember;
+    for (const auto& key : keys) {
+        if (chosen.count(key) == 0 && without_nonmember.size() + chosen.size()
+                                          < keys.size()) {
+            continue;  // drop the first non-member
+        }
+        without_nonmember.push_back(key);
+    }
+    // (rebuild precisely: all keys minus one non-member)
+    without_nonmember.clear();
+    bool dropped = false;
+    for (const auto& key : keys) {
+        if (!dropped && chosen.count(key) == 0) {
+            dropped = true;
+            continue;
+        }
+        without_nonmember.push_back(key);
+    }
+    std::set<std::string> after_nm;
+    for (size_t idx : RendezvousSubset(seed, without_nonmember, k)) {
+        after_nm.insert(without_nonmember[idx]);
+    }
+    EXPECT_TRUE(after_nm == chosen);
+    // Removing a MEMBER pulls in exactly one replacement; every other
+    // choice stays put (the HRW property the whole design rides on).
+    std::vector<std::string> without_member;
+    dropped = false;
+    std::string dropped_member;
+    for (const auto& key : keys) {
+        if (!dropped && chosen.count(key) != 0) {
+            dropped = true;
+            dropped_member = key;
+            continue;
+        }
+        without_member.push_back(key);
+    }
+    std::set<std::string> after_m;
+    for (size_t idx : RendezvousSubset(seed, without_member, k)) {
+        after_m.insert(without_member[idx]);
+    }
+    EXPECT_EQ(after_m.size(), k);
+    EXPECT_EQ(after_m.count(dropped_member), 0u);
+    size_t kept = 0;
+    for (const auto& key : chosen) kept += after_m.count(key);
+    EXPECT_EQ(kept, k - 1);  // one replacement, three survivors
+    // k >= n returns everything.
+    auto all = RendezvousSubset(seed, keys, 100);
+    EXPECT_EQ(all.size(), keys.size());
+    // Different seeds draw different subsets (different clients spread
+    // over the fleet) — with 210 possible 4-subsets a collision across
+    // ten seeds is astronomically unlikely to hit ALL of them.
+    int distinct = 0;
+    for (uint64_t s2 = 1; s2 <= 10; ++s2) {
+        std::set<std::string> c2;
+        for (size_t idx : RendezvousSubset(s2, keys, k)) {
+            c2.insert(keys[idx]);
+        }
+        if (c2 != chosen) ++distinct;
+    }
+    EXPECT_GT(distinct, 0);
+}
+
+TEST(Qos, OverloadErrorMapping) {
+    // TERR_OVERLOAD is its own retriable class: distinct code, distinct
+    // operator-facing text (the soak greps for it), not the limiter's
+    // plain TERR_LIMIT_EXCEEDED and not the budget-free TERR_DRAINING.
+    EXPECT_EQ(TERR_OVERLOAD, 4013);
+    const std::string text = terror(TERR_OVERLOAD);
+    EXPECT_NE(text.find("Overload"), std::string::npos);
+    EXPECT_NE(text, terror(TERR_LIMIT_EXCEEDED));
+    EXPECT_NE(text, terror(TERR_DRAINING));
+}
+
+TEST(Qos, TimeoutLimiterProbePerPriority) {
+    // Regression (ISSUE 8 satellite): the 1s probe escape hatch used to
+    // be one global clock per method — a low-priority class's probe
+    // consumed it and a latched high-priority class could never
+    // re-measure. Now each priority class probes independently.
+    TimeoutConcurrencyLimiter::Options opt;
+    opt.timeout_ms = 100;
+    opt.probe_interval_ms = 50;
+    TimeoutConcurrencyLimiter lim(opt);
+    // Teach a huge service time: every budget below it is doomed.
+    lim.OnResponded(0, 500 * 1000);
+    EXPECT_GT(lim.avg_latency_us(), 100 * 1000);
+    // Inside the probe interval everything sheds (fresh success sample).
+    EXPECT_FALSE(lim.AdmitWithBudget(1000, 1));
+    EXPECT_FALSE(lim.AdmitWithBudget(1000, 7));
+    usleep(60 * 1000);  // past the probe interval
+    // Class 1 probes...
+    EXPECT_TRUE(lim.AdmitWithBudget(1000, 1));
+    // ...and class 7 STILL probes (its own clock — the old global clock
+    // returned false here).
+    EXPECT_TRUE(lim.AdmitWithBudget(1000, 7));
+    // Each class's probe is consumed for the next interval.
+    EXPECT_FALSE(lim.AdmitWithBudget(1000, 1));
+    EXPECT_FALSE(lim.AdmitWithBudget(1000, 7));
+    // Ample budget always admits, probe or not.
+    EXPECT_TRUE(lim.AdmitWithBudget(1000 * 1000, 3));
+}
+
+TEST(Qos, DrainerServesQueuedItems) {
+    // End-to-end through the real drainer fiber: enqueue, let the
+    // drainer pop + run, verify completion accounting.
+    QosDispatcher q;
+    q.StartDrainer();
+    auto* t = q.Acquire("drained_t");
+    static std::atomic<int> ran{0};
+    struct Counted {
+        QosDispatcher* q;
+        QosDispatcher::TenantState* t;
+    };
+    QosDispatcher::Item item;
+    item.run = [](void* arg) {
+        auto* c = (Counted*)arg;
+        ran.fetch_add(1);
+        c->q->OnDone(c->t, 100);
+        delete c;
+    };
+    item.shed = [](void* arg, int64_t) { delete (Counted*)arg; };
+    for (int i = 0; i < 5; ++i) {
+        item.arg = new Counted{&q, t};
+        q.Enqueue(t, kDefaultPriority, item);
+    }
+    const int64_t deadline = monotonic_time_us() + 2 * 1000 * 1000;
+    while (ran.load() < 5 && monotonic_time_us() < deadline) {
+        usleep(5 * 1000);
+    }
+    EXPECT_EQ(ran.load(), 5);
+    EXPECT_EQ(q.queue_depth(), 0);
+    EXPECT_EQ(t->inflight.load(), 0);
+    q.StopDrainer();
+}
+
+TEST(Qos, StopDrainerShedsEvenWhenNeverStarted) {
+    // Regression: a runtime-enabled tier racing Stop (drainer never
+    // started) must still answer its queued items — each holds a
+    // counted admission, and leaking one hangs Server::Join.
+    QosDispatcher q;
+    auto* t = q.Acquire("never_started_t");
+    std::vector<std::string> shed;
+    g_shed_order = &shed;
+    for (int i = 0; i < 2; ++i) {
+        EXPECT_TRUE(q.Enqueue(t, 3, MakeItem("orphan")));
+    }
+    q.StopDrainer();
+    EXPECT_EQ(shed.size(), 2u);
+    EXPECT_EQ(q.queue_depth(), 0);
+    g_shed_order = nullptr;
+}
+
+TEST(Qos, StopDrainerShedsBacklog) {
+    QosDispatcher q;
+    // No drainer running: queued items must still be answered (shed) at
+    // StopDrainer so admission accounting can never leak.
+    q.StartDrainer();
+    auto* t = q.Acquire("stop_t");
+    // Saturate the tenant's concurrency share so queued items stay put.
+    q.SetTenantQuota("stop_t", TenantQuota{0, 0, 1, 1});
+    EXPECT_TRUE(q.TryDirectDispatch(t));  // holds the single share
+    std::vector<std::string> shed;
+    g_shed_order = &shed;
+    for (int i = 0; i < 3; ++i) {
+        EXPECT_TRUE(q.Enqueue(t, 2, MakeItem("parked")));
+    }
+    q.StopDrainer();
+    EXPECT_EQ(shed.size(), 3u);
+    EXPECT_EQ(q.queue_depth(), 0);
+    g_shed_order = nullptr;
+    q.OnDone(t, 5);
+}

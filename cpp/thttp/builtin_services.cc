@@ -52,6 +52,9 @@ void HandleIndex(Server*, const HttpRequest&, HttpResponse* res) {
         "/flags        runtime flags (/flags/<name>?setvalue=v to set)\n"
         "/connections  accepted connections + per-socket I/O attribution\n"
         "/loops        event-dispatcher + fiber-scheduler telemetry\n"
+        "/tenants      multi-tenant QoS: quotas, fair-queue depth,\n"
+        "              per-tenant admitted/shed/queued/p99\n"
+        "              (?format=json machine form)\n"
         "/rpcz         sampled per-RPC spans (enable_rpcz flag;\n"
         "              ?trace_id=N filter, &format=json machine form)\n"
         "/rpcz/trace/<id>  ONE cross-host stitched timeline for a trace\n"
@@ -625,6 +628,22 @@ void HandleChaos(Server*, const HttpRequest& req, HttpResponse* res) {
     res->Append(FaultInjection::DebugString());
 }
 
+// /tenants: the multi-tenant QoS tier (ISSUE 8) — configured quotas,
+// live fair-queue depth, and per-tenant admitted/shed/queued counters
+// with the served-latency p99. The same numbers ride /metrics as the
+// labelled rpc_tenant_* families; ?format=json is what the overload
+// soak asserts on.
+void HandleTenants(Server* server, const HttpRequest& req,
+                   HttpResponse* res) {
+    if (req.QueryParam("format") == "json") {
+        res->set_content_type("application/json");
+        res->Append(server->qos()->DescribeJson());
+        return;
+    }
+    res->set_content_type("text/plain");
+    res->Append(server->qos()->DescribeText());
+}
+
 // Prometheus text exposition: one registry-wide dump through the
 // Variable prometheus hooks — plain numerics as gauges, LatencyRecorders
 // as REAL summary families (quantile labels + _sum/_count), labelled
@@ -658,6 +677,7 @@ void AddBuiltinHttpServices(Server* server) {
     server->RegisterHttpHandler("/hotspots/heap", HandleHotspotsHeap);
     server->RegisterHttpHandler("/hotspots/growth", HandleHotspotsGrowth);
     server->RegisterHttpHandler("/loops", HandleLoops);
+    server->RegisterHttpHandler("/tenants", HandleTenants);
     server->RegisterHttpHandler("/hotspots/contention",
                                 HandleHotspotsContention);
     server->RegisterHttpHandler("/chaos", HandleChaos);

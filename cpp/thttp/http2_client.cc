@@ -546,8 +546,8 @@ void H2ClientCancel(SocketId sid, uint64_t cid) {
 
 int H2ClientSendUnary(Socket* s, uint64_t cid, const std::string& grpc_path,
                       const std::string& authority, const IOBuf& request_pb,
-                      int64_t deadline_us,
-                      const std::string& authorization) {
+                      int64_t deadline_us, const std::string& authorization,
+                      const std::string& tenant, int priority) {
     if (g_h2_client_index < 0) return -1;
     H2ClientSession* sess = client_session_of(s);
     std::string out;
@@ -580,6 +580,14 @@ int H2ClientSendUnary(Socket* s, uint64_t cid, const std::string& grpc_path,
     };
     if (!authorization.empty()) {
         headers.emplace_back("authorization", authorization);
+    }
+    // QoS identity (ISSUE 8): the h2 spelling of the tpu_std meta's
+    // tenant/priority pair.
+    if (!tenant.empty()) {
+        headers.emplace_back("x-tpu-tenant", tenant);
+    }
+    if (priority >= 0) {
+        headers.emplace_back("x-tpu-priority", std::to_string(priority));
     }
     if (deadline_us > 0) {
         const int64_t remain_us = deadline_us - monotonic_time_us();

@@ -20,11 +20,14 @@ namespace tpurpc {
 // Send one gRPC unary request on `s` as a new h2 stream (client preface
 // + SETTINGS on first use of the connection). The response completes the
 // RPC via CompleteClientUnaryResponse(cid, ...). `grpc_path` is
-// "/package.Service/Method". Returns 0 on success (frames queued).
+// "/package.Service/Method". QoS identity rides as x-tpu-tenant /
+// x-tpu-priority headers (empty/negative = omitted). Returns 0 on
+// success (frames queued).
 int H2ClientSendUnary(Socket* s, uint64_t cid, const std::string& grpc_path,
                       const std::string& authority, const IOBuf& request_pb,
                       int64_t deadline_us,
-                      const std::string& authorization = "");
+                      const std::string& authorization = "",
+                      const std::string& tenant = "", int priority = -1);
 
 // Cancel the in-flight unary call `cid` on the h2 client session of
 // `sid`: RST_STREAM(CANCEL) the matching stream and drop its response

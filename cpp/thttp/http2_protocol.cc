@@ -258,6 +258,11 @@ struct GrpcCallCtx {
     std::unique_ptr<google::protobuf::Message> req;
     std::unique_ptr<google::protobuf::Message> res;
     Controller cntl;
+    // Multi-tenant accounting (ISSUE 8): x-tpu-tenant/x-tpu-priority
+    // identity parsed at dispatch; completion reports to the QoS tier.
+    QosDispatcher* qos = nullptr;
+    QosDispatcher::TenantState* qos_tenant = nullptr;
+    int64_t qos_start_us = 0;
 };
 
 // gRPC spec: grpc-message is percent-encoded (and h2 forbids CR/LF/NUL
@@ -289,6 +294,12 @@ void* RunGrpcCall(void* arg) {
     const auto finish = [&](int error_code) {
         server_call::Unregister(c->sid, c->stream_id);
         c->cntl.DestroyServerCallId();
+        // Per-tenant completion BEFORE Finish (which is the last legal
+        // touch of Server memory).
+        if (c->qos_tenant != nullptr) {
+            c->qos->OnDone(c->qos_tenant,
+                           monotonic_time_us() - c->qos_start_us);
+        }
         c->guard->Finish(error_code);
         delete c->guard;
     };
@@ -437,12 +448,38 @@ void DispatchCompleteStream(Socket* s, H2Session* sess, uint32_t stream_id,
             }
             if (t_us > 0) deadline_us = arrival_us + t_us;
         }
+        // QoS identity + rate quota (ISSUE 8): the h2 spelling of the
+        // tpu_std tenant/priority meta. Quota sheds answer grpc-status 8
+        // (RESOURCE_EXHAUSTED) with the suggested backoff in the message
+        // — the h2 analog of TERR_OVERLOAD + backoff_ms. The weighted-
+        // fair dispatch queue itself is a native-protocol (tpu_std)
+        // feature; h2 gets identity, quotas, and per-tenant accounting.
+        QosDispatcher* qos = server->qos();
+        const std::string* xt = FindHeader(req_headers, "x-tpu-tenant");
+        const int priority =
+            PriorityFromHeader(FindHeader(req_headers, "x-tpu-priority"));
+        QosDispatcher::TenantState* tstate = nullptr;
+        if (qos->enabled()) {
+            tstate = qos->Acquire(xt != nullptr ? *xt : "");
+            int64_t backoff_ms = 0;
+            if (!qos->AdmitQps(tstate, arrival_us, &backoff_ms)) {
+                RespondGrpcError(s->id(), stream_id, 8,
+                                 "tenant over its qps quota; retry after " +
+                                     std::to_string(backoff_ms) + "ms");
+                return;
+            }
+        }
         auto* guard = new Server::MethodCallGuard(
-            server, mp, deadline_us > 0 ? deadline_us - arrival_us : -1);
+            server, mp, deadline_us > 0 ? deadline_us - arrival_us : -1,
+            priority);
         if (guard->rejected()) {
             const bool shed = guard->shed();
             delete guard;
-            if (shed) server_call::CountShed();
+            if (shed) {
+                server_call::CountShed();
+            } else if (tstate != nullptr) {
+                qos->CountShed(tstate);
+            }
             RespondGrpcError(s->id(), stream_id, 8,
                              shed ? "remaining deadline budget below "
                                     "observed service time"
@@ -486,12 +523,23 @@ void DispatchCompleteStream(Socket* s, H2Session* sess, uint32_t stream_id,
         ctx->res.reset(mp->service->GetResponsePrototype(mp->method).New());
         ctx->cntl.InitServerSide(server, s->remote_side());
         ctx->cntl.set_server_deadline_us(deadline_us);
+        if (xt != nullptr) ctx->cntl.set_tenant(*xt);
+        ctx->cntl.set_priority(priority);
         if (!ParsePbFromIOBuf(ctx->req.get(), req_body)) {
             guard->Finish(TERR_REQUEST);
             delete guard;
             delete ctx;
             RespondGrpcError(s->id(), stream_id, 3, "bad request pb");
             return;
+        }
+        // Tenant accounting starts only past the LAST early-return:
+        // every BeginServed must reach RunGrpcCall's finish/OnDone, or
+        // the tenant's concurrency share leaks and eventually bricks it.
+        if (tstate != nullptr) {
+            qos->BeginServed(tstate);
+            ctx->qos = qos;
+            ctx->qos_tenant = tstate;
+            ctx->qos_start_us = arrival_us;
         }
         // Cancelable handle keyed by the h2 stream id: RST_STREAM and
         // connection death deliver the cancel; RunGrpcCall tears both

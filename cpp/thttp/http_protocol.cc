@@ -91,13 +91,37 @@ bool DispatchHttpRpc(Server* server, const HttpRequest& req,
         res->Append("{\"error\":\"use POST (json body) or GET\"}\n");
         return true;
     }
+    // QoS identity + rate quota (ISSUE 8): the x-tpu-tenant /
+    // x-tpu-priority headers class json-door traffic exactly like the
+    // native protocols; quota sheds answer 429 with Retry-After.
+    QosDispatcher* qos = server->qos();
+    const std::string* xt = req.FindHeader("x-tpu-tenant");
+    const int priority =
+        PriorityFromHeader(req.FindHeader("x-tpu-priority"));
+    QosDispatcher::TenantState* tstate = nullptr;
+    const int64_t arrival_us = monotonic_time_us();
+    if (qos->enabled()) {
+        tstate = qos->Acquire(xt != nullptr ? *xt : "");
+        int64_t backoff_ms = 0;
+        if (!qos->AdmitQps(tstate, arrival_us, &backoff_ms)) {
+            res->status = 429;
+            res->headers["Retry-After"] =
+                std::to_string((backoff_ms + 999) / 1000);
+            res->Append("{\"error\":\"tenant over its qps quota\","
+                        "\"backoff_ms\":" +
+                        std::to_string(backoff_ms) + "}\n");
+            return true;
+        }
+    }
     // Admission + stats + Join accounting shared with the native protocol.
-    Server::MethodCallGuard guard(server, mp);
+    Server::MethodCallGuard guard(server, mp, -1, priority);
     if (guard.rejected()) {
-        res->status = 503;
+        if (tstate != nullptr) qos->CountShed(tstate);
+        res->status = qos->enabled() ? 429 : 503;
         res->Append("{\"error\":\"concurrency limit\"}\n");
         return true;
     }
+    if (tstate != nullptr) qos->BeginServed(tstate);
 
     std::unique_ptr<google::protobuf::Message> pb_req(
         mp->service->GetRequestPrototype(mp->method).New());
@@ -105,6 +129,8 @@ bool DispatchHttpRpc(Server* server, const HttpRequest& req,
         mp->service->GetResponsePrototype(mp->method).New());
     Controller cntl;
     cntl.InitServerSide(server, remote_side);
+    if (xt != nullptr) cntl.set_tenant(*xt);
+    cntl.set_priority(priority);
     if (server->options().interceptor != nullptr) {
         int ierr = 0;
         std::string ietext;
@@ -114,6 +140,9 @@ bool DispatchHttpRpc(Server* server, const HttpRequest& req,
                         (ietext.empty() ? std::string("rejected")
                                         : json_safe_text(ietext)) +
                         "\"}\n");
+            if (tstate != nullptr) {
+                qos->OnDone(tstate, monotonic_time_us() - arrival_us);
+            }
             guard.Finish(ierr != 0 ? ierr : 403);
             return true;
         }
@@ -150,8 +179,11 @@ bool DispatchHttpRpc(Server* server, const HttpRequest& req,
             }
         }
     }
-    // Feed the limiter/stats the RPC error (the same signal the native
-    // protocol uses), not the HTTP status.
+    // Per-tenant completion, then feed the limiter/stats the RPC error
+    // (the same signal the native protocol uses), not the HTTP status.
+    if (tstate != nullptr) {
+        qos->OnDone(tstate, monotonic_time_us() - arrival_us);
+    }
     guard.Finish(cntl.Failed() ? cntl.ErrorCode()
                                : (res->status == 200 ? 0 : res->status));
     return true;

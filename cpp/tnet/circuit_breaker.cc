@@ -36,10 +36,15 @@ void CircuitBreaker::Reset() {
 // Client-local conditions must not count against the server: a cancelled
 // RPC or local write back-pressure says nothing about remote health, and
 // feeding them in would isolate healthy servers (reference feeds only
-// server-attributable codes into the breaker).
+// server-attributable codes into the breaker). A QoS overload shed
+// (TERR_OVERLOAD) is excluded too: it is the server WORKING as designed
+// — isolating it would tear down the shared connection for every tenant
+// (including the protected ones) and amplify the very storm being shed;
+// steering happens through the LB feedback/backoff instead.
 static bool ClientLocalError(int error_code) {
     return error_code == ECANCELED || error_code == TERR_OVERCROWDED ||
-           error_code == TERR_BACKUP_REQUEST;
+           error_code == TERR_BACKUP_REQUEST ||
+           error_code == TERR_OVERLOAD;
 }
 
 bool CircuitBreaker::OnCallEnd(int error_code, int64_t latency_us) {

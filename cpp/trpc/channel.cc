@@ -283,6 +283,19 @@ void Channel::CallMethod(const google::protobuf::MethodDescriptor* method,
             cntl->deadline_us_ = upstream;
         }
     }
+    // QoS identity inheritance (ISSUE 8): a child call issued inside a
+    // handler carries its upstream's tenant + priority unless the
+    // handler set its own — the whole downstream tree of a low-priority
+    // request stays sheddable, and a tenant's quota follows its traffic
+    // through the mesh (same shape as the deadline cap above).
+    if (parent != nullptr) {
+        if (cntl->tenant().empty() && !parent->tenant().empty()) {
+            cntl->set_tenant(parent->tenant());
+        }
+        if (!cntl->has_priority() && parent->has_priority()) {
+            cntl->set_priority(parent->priority());
+        }
+    }
     if (cntl->deadline_us_ > 0) {
         cntl->timeout_timer_ = TimerThread::singleton()->schedule(
             HandleTimeoutCb, (void*)(uintptr_t)cid, cntl->deadline_us_);

@@ -30,8 +30,15 @@ public:
     // requests carrying a propagated deadline: `remaining_us` is the
     // budget the client has left. False = the request cannot plausibly
     // finish inside its budget — shed it now, before it costs a handler
-    // (the caller accounts it as rpc_server_shed_requests).
-    virtual bool AdmitWithBudget(int64_t remaining_us) { return true; }
+    // (the caller accounts it as rpc_server_shed_requests). `priority`
+    // is the request's QoS shed class (qos.h): budget-aware limiters
+    // keep per-priority probe state so one class's probes can't starve
+    // another's recovery.
+    virtual bool AdmitWithBudget(int64_t remaining_us, int priority = 0) {
+        (void)remaining_us;
+        (void)priority;
+        return true;
+    }
     // Every admitted request reports its outcome.
     virtual void OnResponded(int error_code, int64_t latency_us) = 0;
     virtual int64_t MaxConcurrency() const = 0;
@@ -86,18 +93,32 @@ public:
     // service time is doomed: the client will have hung up before the
     // response exists. Rejecting here costs a map lookup; executing it
     // costs a full handler that nobody reads.
-    bool AdmitWithBudget(int64_t remaining_us) override {
+    bool AdmitWithBudget(int64_t remaining_us, int priority = 0) override {
         const int64_t avg = avg_latency_us_.load(std::memory_order_relaxed);
         if (avg <= 0 || remaining_us >= avg) return true;
         // Probe escape: if nothing has executed recently (e.g. every
         // request is being shed against an estimate from a past latency
         // incident), admit one request per probe interval so the EMA can
-        // re-learn the CURRENT service time and un-latch.
+        // re-learn the CURRENT service time and un-latch. The probe
+        // clock is PER PRIORITY CLASS (ISSUE 8 bugfix): with a single
+        // global clock, a flooding low-priority tenant's requests kept
+        // winning the probe CAS, so a latched high-priority class could
+        // never re-measure while the low-priority probes were being
+        // shed downstream — exactly the starvation the QoS tier exists
+        // to prevent. A fresh success still un-latches every class at
+        // once (the EMA is shared).
         const int64_t now = monotonic_time_us();
-        int64_t last = last_sample_us_.load(std::memory_order_relaxed);
+        const int slot = priority < 0 ? 0
+                         : priority >= kProbeSlots ? kProbeSlots - 1
+                                                   : priority;
+        const int64_t last_success =
+            last_sample_us_.load(std::memory_order_relaxed);
+        int64_t last_probe =
+            last_probe_us_[slot].load(std::memory_order_relaxed);
+        const int64_t last = std::max(last_success, last_probe);
         if (now - last > opt_.probe_interval_ms * 1000 &&
-            last_sample_us_.compare_exchange_strong(
-                last, now, std::memory_order_relaxed)) {
+            last_probe_us_[slot].compare_exchange_strong(
+                last_probe, now, std::memory_order_relaxed)) {
             return true;
         }
         return false;
@@ -127,10 +148,16 @@ public:
     }
 
 private:
+    // One probe clock per priority class (see AdmitWithBudget).
+    static constexpr int kProbeSlots = 8;  // == qos.h kNumPriorities
+
     const Options opt_;
     std::atomic<int64_t> avg_latency_us_{0};
-    // Last execution sample (or granted probe) — the anti-latch clock.
+    // Last execution sample — shared anti-latch clock (a success means
+    // the EMA is fresh for everyone).
     std::atomic<int64_t> last_sample_us_{0};
+    // Last granted probe per priority class.
+    std::atomic<int64_t> last_probe_us_[kProbeSlots] = {};
 };
 
 // "auto": the gradient limiter.

@@ -86,6 +86,9 @@ void Controller::Reset() {
     has_request_code_ = false;
     request_compress_type_ = 0;
     response_compress_type_ = 0;
+    tenant_.clear();
+    priority_ = -1;
+    suggested_backoff_ms_ = 0;
     current_fly_sid_ = INVALID_VREF_ID;
     unfinished_fly_sid_ = INVALID_VREF_ID;
     reusable_fly_sid_ = INVALID_VREF_ID;
@@ -286,6 +289,11 @@ static bool is_retryable(int error) {
         case EPIPE:
         case EHOSTDOWN:  // LB found only failed servers; retry re-selects
         case TERR_DRAINING:  // peer draining, call provably unprocessed
+        // Priority-aware overload shed: the server never ran the
+        // handler, so a re-issue (elsewhere, after the suggested
+        // backoff) is safe — but it SPENDS retry budget, because under
+        // overload re-issues amplify the very load being shed.
+        case TERR_OVERLOAD:
             return true;
         default:
             return false;
@@ -390,7 +398,19 @@ int Controller::HandleError(CallId id, int error) {
                 ++current_try_;
                 current_cid_ = next;
                 *g_client_retries << 1;
-                const int64_t backoff_ms = rp->BackoffMs(this);
+                int64_t backoff_ms = rp->BackoffMs(this);
+                // An overloaded server suggested when to come back:
+                // honor it with jitter in [s/2, s] — synchronized
+                // retries arriving exactly at s would re-create the
+                // thundering herd the backoff exists to spread. The
+                // policy's own (longer) backoff wins if larger.
+                if (error == TERR_OVERLOAD && suggested_backoff_ms_ > 0) {
+                    const int64_t s = suggested_backoff_ms_;
+                    backoff_ms = std::max<int64_t>(
+                        backoff_ms,
+                        s / 2 + (int64_t)(fast_rand() %
+                                          (uint64_t)(s / 2 + 1)));
+                }
                 error_code_ = 0;  // a later try owns the final verdict
                 error_text_.clear();
                 if (backoff_ms > 0 &&
@@ -572,7 +592,8 @@ void Controller::IssueRPC() {
                                  "/" + method_->name();
         if (H2ClientSendUnary(s.get(), current_cid_, path,
                               endpoint2str(remote_side_), request_buf_,
-                              deadline_us_, authorization) != 0) {
+                              deadline_us_, authorization, tenant_,
+                              priority_) != 0) {
             id_error(current_cid_, errno != 0 ? errno : TERR_FAILED_SOCKET);
         }
         return;
@@ -633,6 +654,11 @@ void Controller::IssueRPC() {
                              : 0);
     }
     if (log_id_ != 0) req_meta->set_log_id(log_id_);
+    // QoS identity: resolved (explicit or inherited) by CallMethod; an
+    // unset pair costs no meta bytes and the server classes the call as
+    // the default tenant/priority.
+    if (!tenant_.empty()) req_meta->set_tenant(tenant_);
+    if (priority_ >= 0) req_meta->set_priority(priority_);
     if (span_ != nullptr) {
         req_meta->set_trace_id(span_->trace_id);
         req_meta->set_span_id(span_->span_id);
@@ -886,6 +912,19 @@ void ProcessTpuStdResponse(TpuStdMessage* msg, const rpc::RpcMeta& meta) {
         }
     }
     if (rmeta.error_code() != 0) {
+        if (rmeta.error_code() == TERR_OVERLOAD) {
+            // Priority-aware shed: the handler never ran. Stash the
+            // server-suggested backoff, then route through the ERROR
+            // funnel (we hold the id lock — HandleError's contract), so
+            // the standard retry machinery applies: budget token spent,
+            // jittered backoff honored, LB re-selects away from the
+            // overloaded server via ExcludedServers.
+            if (rmeta.has_backoff_ms()) {
+                cntl->set_suggested_backoff_ms(rmeta.backoff_ms());
+            }
+            cntl->HandleError(cid, TERR_OVERLOAD);
+            return;
+        }
         cntl->SetFailed(rmeta.error_code(), "%s", rmeta.error_text().c_str());
         cntl->EndRPC(cid);
         return;

@@ -50,6 +50,25 @@ public:
         request_code_ = code;
         has_request_code_ = true;
     }
+    // ---- multi-tenant QoS identity (ISSUE 8) ----
+    // Client side: stamped into the request meta (tpu_std tenant/
+    // priority fields; x-tpu-tenant/x-tpu-priority h2 headers). Server
+    // side: parsed from the wire. Unset values inherit from the upstream
+    // server call (Channel::CallMethod), so identity propagates
+    // hop-to-hop alongside the deadline/trace context.
+    void set_tenant(const std::string& t) { tenant_ = t; }
+    const std::string& tenant() const { return tenant_; }
+    // Priority class 0..7 (0 = most sheddable). Unset (-1) resolves to
+    // the upstream call's class, else the middle class (qos.h
+    // kDefaultPriority).
+    void set_priority(int p) { priority_ = p; }
+    int priority() const { return priority_; }
+    bool has_priority() const { return priority_ >= 0; }
+    // Server-suggested backoff attached to a TERR_OVERLOAD shed; on the
+    // client it steers the retry delay (jittered), on the server the
+    // response path copies it into the response meta.
+    void set_suggested_backoff_ms(int64_t ms) { suggested_backoff_ms_ = ms; }
+    int64_t suggested_backoff_ms() const { return suggested_backoff_ms_; }
     // Attachment bytes carried outside the pb payload (zero-copy).
     IOBuf& request_attachment() { return request_attachment_; }
     IOBuf& response_attachment() { return response_attachment_; }
@@ -245,6 +264,10 @@ private:
     bool has_request_code_;
     int request_compress_type_;
     int response_compress_type_;
+    // QoS identity (shared by both sides; see the accessors above).
+    std::string tenant_;
+    int priority_;  // -1 = unset
+    int64_t suggested_backoff_ms_;
     // Pooled/short connection of the current try and of the still-live
     // original behind a backup (INVALID in single mode). A socket whose
     // call received a response is moved to reusable_fly_sid_ and returned

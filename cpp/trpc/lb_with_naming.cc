@@ -9,6 +9,7 @@
 #include "tfiber/butex.h"
 #include "tfiber/fiber.h"
 #include "trpc/channel.h"
+#include "trpc/qos.h"
 
 DEFINE_int32(ns_health_check_interval_ms, 1000,
              "Failed naming-resolved servers are probed this often and "
@@ -21,6 +22,20 @@ DEFINE_int32(cluster_recover_min_working_instances, 0,
 DEFINE_int32(cluster_recover_hold_ms, 1000,
              "recovery ends once the usable-server count has been stable "
              "this long");
+// Deterministic subsetting (ISSUE 8): each client talks to a
+// rendezvous-hashed subset of the naming set instead of full-meshing
+// every server (fleet-scale connection count drops from clients *
+// servers to clients * subset_size). 0 disables.
+DEFINE_int32(subset_size, 0,
+             "deterministic client subsetting: connect to this many "
+             "servers of the naming set (0 = all)");
+DEFINE_int32(min_subset, 0,
+             "recompute/fall back to the full set when fewer than this "
+             "many subset members are live (0 = half of -subset_size, "
+             "rounded up)");
+DEFINE_int64(subset_seed, 0,
+             "rendezvous seed for -subset_size (0 = random per process; "
+             "fixed values make subsets reproducible for tests)");
 
 namespace tpurpc {
 
@@ -196,6 +211,11 @@ int LoadBalancerWithNaming::Init(const std::string& naming_url,
         LOG(ERROR) << "unknown load balancer: " << lb_name;
         return -1;
     }
+    // Per-client rendezvous identity: every client fleet member draws a
+    // DIFFERENT subset (that is what spreads load), unless a fixed
+    // -subset_seed pins it for reproducibility.
+    const int64_t seed_flag = FLAGS_subset_seed.get();
+    subset_seed_ = seed_flag != 0 ? (uint64_t)seed_flag : fast_rand();
     ns_thread_ = NamingServiceThread::GetOrCreate(naming_url);
     if (!ns_thread_) return -1;
     ns_thread_->AddWatcher(this);
@@ -208,8 +228,31 @@ int LoadBalancerWithNaming::Init(const std::string& naming_url,
 void LoadBalancerWithNaming::OnServersChanged(
     const std::vector<ServerNode>& added,
     const std::vector<SocketId>& removed) {
-    if (!added.empty()) lb_->AddServersInBatch(added);
-    if (!removed.empty()) lb_->RemoveServersInBatch(removed);
+    if (FLAGS_subset_size.get() > 0) {
+        // Subsetting layer: track the FULL naming set here; ApplySubset
+        // diffs the rendezvous-chosen members into the LB policy.
+        {
+            std::lock_guard<std::mutex> g(subset_mu_);
+            for (const ServerNode& s : added) all_nodes_[s.id] = s;
+            for (SocketId id : removed) {
+                all_nodes_.erase(id);
+                if (in_lb_.erase(id) != 0) lb_->RemoveServer(id);
+            }
+        }
+        ApplySubset(false);
+    } else {
+        if (!added.empty()) lb_->AddServersInBatch(added);
+        if (!removed.empty()) lb_->RemoveServersInBatch(removed);
+        std::lock_guard<std::mutex> g(subset_mu_);
+        for (const ServerNode& s : added) {
+            all_nodes_[s.id] = s;
+            in_lb_.insert(s.id);
+        }
+        for (SocketId id : removed) {
+            all_nodes_.erase(id);
+            in_lb_.erase(id);
+        }
+    }
     std::lock_guard<std::mutex> g(servers_mu_);
     for (const ServerNode& s : added) server_ids_.push_back(s.id);
     for (SocketId id : removed) {
@@ -221,6 +264,109 @@ void LoadBalancerWithNaming::OnServersChanged(
             }
         }
     }
+}
+
+std::vector<SocketId> LoadBalancerWithNaming::CurrentLbMembers() const {
+    std::lock_guard<std::mutex> g(subset_mu_);
+    return std::vector<SocketId>(in_lb_.begin(), in_lb_.end());
+}
+
+void LoadBalancerWithNaming::ApplySubset(bool force_full) {
+    const int k = FLAGS_subset_size.get();
+    std::lock_guard<std::mutex> g(subset_mu_);
+    // Live = addressable and not draining; the ring of candidates the
+    // rendezvous hash scores. Keys come from registration-time endpoints
+    // so every fleet member scores the same server identically.
+    std::vector<SocketId> live_ids;
+    std::vector<std::string> live_keys;
+    for (const auto& [id, node] : all_nodes_) {
+        Socket* s = Socket::Address(id);
+        if (s == nullptr) continue;
+        const bool draining = s->Draining();
+        s->Dereference();
+        if (draining) continue;
+        live_ids.push_back(id);
+        live_keys.push_back(endpoint2str(node.ep));
+    }
+    const int eff_min = FLAGS_min_subset.get() > 0
+                            ? FLAGS_min_subset.get()
+                            : (k + 1) / 2;
+    std::set<SocketId> desired;
+    if (force_full || k <= 0 || (int)all_nodes_.size() <= k ||
+        (int)live_ids.size() < eff_min) {
+        // Full-set fallback: too few live members to subset (or a retry
+        // already burned through the subset) — better to spread over
+        // everything than to hammer the survivors.
+        for (const auto& [id, node] : all_nodes_) desired.insert(id);
+        subset_full_ = true;
+    } else {
+        // Rendezvous over the LIVE members only: a dead/draining chosen
+        // member is replaced by the next-highest scorer while every
+        // other choice stays put (HRW stability).
+        for (size_t idx :
+             RendezvousSubset(subset_seed_, live_keys, (size_t)k)) {
+            desired.insert(live_ids[idx]);
+        }
+        subset_full_ = false;
+    }
+    // Diff into the LB policy; in_lb_ itself is simply replaced below.
+    for (SocketId id : desired) {
+        if (in_lb_.count(id) == 0) {
+            auto it = all_nodes_.find(id);
+            if (it != all_nodes_.end()) lb_->AddServer(it->second);
+        }
+    }
+    for (SocketId id : in_lb_) {
+        if (desired.count(id) == 0) lb_->RemoveServer(id);
+    }
+    in_lb_ = std::move(desired);
+}
+
+void LoadBalancerWithNaming::MaybeRefreshSubset(const SelectIn& in) {
+    if (FLAGS_subset_size.get() <= 0) return;
+    // A retry that already tried every subset member must reach BEYOND
+    // the subset instead of re-hitting tried servers: pin the full set
+    // for now (the next healthy refresh shrinks back).
+    bool force_full = false;
+    {
+        std::lock_guard<std::mutex> g(subset_mu_);
+        if (in.excluded != nullptr && !subset_full_ &&
+            in.excluded->size() >= (int)in_lb_.size()) {
+            force_full = true;
+        }
+    }
+    if (!force_full) {
+        // Rate-limited health sweep: recompute only when the LIVE
+        // subset shrank below the floor (kill/drain of chosen members
+        // must spread load over the fallback set, not the survivors).
+        const int64_t now = monotonic_time_us();
+        int64_t last = last_subset_check_us_.load(std::memory_order_relaxed);
+        if (now - last < 20 * 1000) return;
+        if (!last_subset_check_us_.compare_exchange_strong(
+                last, now, std::memory_order_relaxed)) {
+            return;  // another selector is checking this tick
+        }
+        int live = 0;
+        int eff_min;
+        {
+            std::lock_guard<std::mutex> g(subset_mu_);
+            const int k = FLAGS_subset_size.get();
+            eff_min = FLAGS_min_subset.get() > 0 ? FLAGS_min_subset.get()
+                                                 : (k + 1) / 2;
+            for (SocketId id : in_lb_) {
+                Socket* s = Socket::Address(id);
+                if (s == nullptr) continue;
+                const bool draining = s->Draining();
+                s->Dereference();
+                if (!draining) ++live;
+            }
+            // A full-set LB with everything healthy should shrink back
+            // to the subset; a healthy subset needs nothing.
+            if (!subset_full_ && live >= eff_min) return;
+        }
+        (void)eff_min;
+    }
+    ApplySubset(force_full);
 }
 
 size_t LoadBalancerWithNaming::CountUsableServers() {
@@ -269,6 +415,9 @@ int LoadBalancerWithNaming::SelectServer(const SelectIn& in,
     if (RejectedByClusterRecovery()) {
         return EHOSTDOWN;  // held back while the cluster refills
     }
+    // Deterministic subsetting upkeep (no-op unless -subset_size is on):
+    // shrink-detection, excluded-exhaustion fallback, full-set recovery.
+    MaybeRefreshSubset(in);
     const int rc = lb_->SelectServer(in, out);
     if ((rc == EHOSTDOWN || rc == ENODATA) &&
         FLAGS_cluster_recover_min_working_instances.get() > 0) {

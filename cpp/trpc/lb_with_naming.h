@@ -72,7 +72,15 @@ private:
 };
 
 // What Channel::Init(naming_url, lb_name) creates: LB fed by a (shared)
-// naming thread.
+// naming thread — through the deterministic-subsetting layer when
+// -subset_size is on (ISSUE 8): the LB then holds only this client's
+// rendezvous-hashed subset of the naming set, so a fleet of millions of
+// clients doesn't full-mesh every server. The subset is stable under
+// node churn (HRW scores are per-member), recomputed when draining
+// marks or member death shrink the LIVE subset below -min_subset
+// (never hammer the survivors), and falls back to the full set when a
+// retry has already excluded every subset member or too few members
+// are live at all.
 class LoadBalancerWithNaming : public NamingServiceThread::Watcher {
 public:
     ~LoadBalancerWithNaming() override;
@@ -89,6 +97,9 @@ public:
     void OnServersChanged(const std::vector<ServerNode>& added,
                           const std::vector<SocketId>& removed) override;
 
+    // Introspection for tests: ids currently fed to the LB policy.
+    std::vector<SocketId> CurrentLbMembers() const;
+
 private:
     // Cluster recovery gating (reference cluster_recover_policy.{h,cpp}
     // DefaultClusterRecoverPolicy): after ALL servers went down, servers
@@ -100,10 +111,26 @@ private:
     size_t CountUsableServers();
     bool RejectedByClusterRecovery();
 
+    // ---- deterministic subsetting (ISSUE 8) ----
+    // Recompute the desired member set (subset or full-set fallback)
+    // and diff it into lb_. force_full pins the full set for this pass
+    // (a retry excluded every subset member).
+    void ApplySubset(bool force_full);
+    // Cheap per-select health check, rate-limited: recomputes when the
+    // live subset shrank below the floor.
+    void MaybeRefreshSubset(const SelectIn& in);
+
     std::unique_ptr<LoadBalancer> lb_;
     std::shared_ptr<NamingServiceThread> ns_thread_;
     std::mutex servers_mu_;
     std::vector<SocketId> server_ids_;  // mirror for usable counting
+
+    mutable std::mutex subset_mu_;
+    std::map<SocketId, ServerNode> all_nodes_;  // full naming set
+    std::set<SocketId> in_lb_;                  // what lb_ holds now
+    uint64_t subset_seed_ = 0;
+    bool subset_full_ = true;  // lb_ currently holds the full set
+    std::atomic<int64_t> last_subset_check_us_{0};
     std::atomic<bool> recovering_{false};
     std::mutex recover_mu_;
     size_t last_usable_ = 0;

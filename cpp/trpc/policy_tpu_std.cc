@@ -161,6 +161,20 @@ public:
           sid_(sid),
           cid_(cid) {}
 
+    // Multi-tenant accounting (ISSUE 8): `counted` becomes true once the
+    // request is admitted to service (direct dispatch or fair-queue
+    // pop) — only then does Run() report completion to the QoS tier. A
+    // queued item shed before service runs this closure with counted
+    // still false: its shed was already counted at the eviction site,
+    // and its latency must not pollute the tenant's served-p99.
+    void set_qos(QosDispatcher* qos, QosDispatcher::TenantState* tenant,
+                 int64_t start_us) {
+        qos_ = qos;
+        qos_tenant_ = tenant;
+        qos_start_us_ = start_us;
+    }
+    void set_qos_counted() { qos_counted_ = true; }
+
     void Run() override {
         if (cntl_->span_ != nullptr) {
             cntl_->span_->process_end_us = monotonic_time_us();
@@ -177,6 +191,12 @@ public:
         rmeta->set_error_code(cntl_->ErrorCode());
         if (cntl_->Failed()) {
             rmeta->set_error_text(cntl_->ErrorText());
+            // Overload sheds tell the client when to come back; the
+            // client jitters the value and spends a retry token.
+            if (cntl_->ErrorCode() == TERR_OVERLOAD &&
+                cntl_->suggested_backoff_ms() > 0) {
+                rmeta->set_backoff_ms(cntl_->suggested_backoff_ms());
+            }
         }
         meta.set_correlation_id(cid_);
         if (cntl_->accepted_stream() != INVALID_VREF_ID) {
@@ -226,6 +246,12 @@ public:
         // holds the id lock while touching the controller).
         server_call::Unregister(sid_, cid_);
         cntl_->DestroyServerCallId();
+        // Per-tenant completion BEFORE Finish: OnDone touches the
+        // Server's QoS tier, and Finish must stay the LAST touch.
+        if (qos_tenant_ != nullptr && qos_counted_) {
+            qos_->OnDone(qos_tenant_,
+                         monotonic_time_us() - qos_start_us_);
+        }
         // Stats + limiter + Join wakeup; Finish is the LAST touch of
         // Server memory (the Server may be destroyed right after).
         guard_->Finish(cntl_->ErrorCode());
@@ -244,6 +270,10 @@ private:
     google::protobuf::Message* res_;
     SocketId sid_;
     uint64_t cid_;
+    QosDispatcher* qos_ = nullptr;
+    QosDispatcher::TenantState* qos_tenant_ = nullptr;
+    int64_t qos_start_us_ = 0;
+    bool qos_counted_ = false;
 };
 
 // Carries one parsed request to its user-code fiber.
@@ -317,11 +347,36 @@ void* RunUserCall(void* arg) {
     return nullptr;
 }
 
+// Usercode overflow-isolation routing shared by the direct and queued
+// dispatch paths: count default-pool residents, overflow past the
+// threshold onto the reserved backup tag.
+FiberAttr UserCallAttr(Server* server, UserCallArgs* uc) {
+    FiberAttr attr = FIBER_ATTR_NORMAL;
+    attr.tag = server->options().fiber_tag;
+    const int32_t backup_at = FLAGS_usercode_backup_threshold.get();
+    if (attr.tag == 0 && backup_at > 0) {
+        const int64_t inflight = g_usercode_default_inflight.fetch_add(
+                                     1, std::memory_order_relaxed) +
+                                 1;
+        if (inflight > backup_at) {
+            g_usercode_default_inflight.fetch_sub(
+                1, std::memory_order_relaxed);
+            attr.tag = kUsercodeBackupTag;  // overflow: isolated pool
+        } else {
+            uc->counted_default = true;
+        }
+    }
+    return attr;
+}
+
 void SendErrorResponse(SocketId sid, uint64_t cid, int err,
-                       const std::string& text) {
+                       const std::string& text, int64_t backoff_ms = 0) {
     rpc::RpcMeta meta;
     meta.mutable_response()->set_error_code(err);
     meta.mutable_response()->set_error_text(text);
+    if (backoff_ms > 0) {
+        meta.mutable_response()->set_backoff_ms(backoff_ms);
+    }
     meta.set_correlation_id(cid);
     IOBuf meta_buf;
     SerializePbToIOBuf(meta, &meta_buf);
@@ -331,6 +386,66 @@ void SendErrorResponse(SocketId sid, uint64_t cid, int err,
     if (Socket::AddressSocket(sid, &s) == 0) {
         s->Write(&frame);
     }
+}
+
+// ---- fair-queue dispatch units (ISSUE 8) ----
+// A request parked in the weighted-fair queue, ready for either service
+// (drainer pop -> background handler fiber) or a priority shed.
+struct QueuedCall {
+    Server* server;
+    Server::MethodProperty* mp;
+    Controller* cntl;
+    google::protobuf::Message* req;
+    google::protobuf::Message* res;
+    SendResponseClosure* done;
+};
+
+void RunQueuedCall(void* arg) {
+    auto* qd = (QueuedCall*)arg;
+    // Popped = admitted (the dispatcher accounted it): completions now
+    // report to the QoS tier.
+    qd->done->set_qos_counted();
+    auto* uc = new UserCallArgs{qd->mp, qd->cntl, qd->req, qd->res,
+                                qd->done};
+    FiberAttr attr = UserCallAttr(qd->server, uc);
+    fiber_t tid;
+    // Always BACKGROUND from the drainer: an urgent handoff would park
+    // the drainer fiber behind this handler and serialize the queue.
+    if (fiber_start_background(&tid, &attr, RunUserCall, uc) != 0) {
+        const bool counted = uc->counted_default;
+        delete uc;
+        if (counted) {
+            g_usercode_default_inflight.fetch_sub(
+                1, std::memory_order_relaxed);
+        }
+        // Fiber system saturated/shutting down — the overload case
+        // itself. Running the handler INLINE here would head-of-line-
+        // block the single drainer fiber and stall every queued tenant
+        // (the opposite of the isolation guarantee): shed instead. The
+        // closure still settles accounting (it was counted at pop).
+        qd->cntl->set_suggested_backoff_ms(
+            qd->server->qos()->SuggestedBackoffMs());
+        qd->cntl->SetFailed(TERR_OVERLOAD,
+                            "no worker fiber available for dispatch");
+        qd->done->Run();
+    }
+    delete qd;
+}
+
+void ShedQueuedCall(void* arg, int64_t backoff_ms) {
+    auto* qd = (QueuedCall*)arg;
+    // The closure answers TERR_OVERLOAD (+ suggested backoff in the
+    // response meta) and settles admission/stats/cancel-registry — the
+    // same single funnel a served request uses.
+    qd->cntl->set_suggested_backoff_ms(backoff_ms);
+    qd->cntl->SetFailed(TERR_OVERLOAD,
+                        "shed under overload: evicted from the fair "
+                        "queue (lowest priority first)");
+    if (qd->cntl->span_ != nullptr) {
+        qd->cntl->span_->Annotate("overload shed: evicted from fair queue");
+    }
+    qd->done->Run();
+    delete qd;
 }
 
 void ProcessTpuStdRequest(TpuStdMessage* msg, const rpc::RpcMeta& meta) {
@@ -390,12 +505,45 @@ void ProcessTpuStdRequest(TpuStdMessage* msg, const rpc::RpcMeta& meta) {
         }
         deadline_us = arrival_us + req_meta.timeout_ms() * 1000;
     }
+    // Multi-tenant QoS stage 1 (ISSUE 8): identity + rate quota. The
+    // tenant's token bucket answers BEFORE admission, parse, or any
+    // allocation — a flooding tenant is shed at the cost of one bucket
+    // CAS, with TERR_OVERLOAD and a computed "come back in N ms" that
+    // the client jitters while spending retry budget.
+    QosDispatcher* qos = server->qos();
+    const bool qos_on = qos->enabled();
+    QosDispatcher::TenantState* tstate = nullptr;
+    const int priority = ClampPriority(
+        req_meta.has_priority() ? req_meta.priority() : kDefaultPriority);
+    if (qos_on) {
+        tstate = qos->Acquire(req_meta.tenant());
+        int64_t backoff_ms = 0;
+        if (!qos->AdmitQps(tstate, arrival_us, &backoff_ms)) {
+            SendErrorResponse(sid, cid, TERR_OVERLOAD,
+                              "tenant '" + tstate->name +
+                                  "' over its qps quota",
+                              backoff_ms);
+            return;
+        }
+    }
     // Admission control (reference ConcurrencyLimiter::OnRequested —
     // constant or gradient "auto" per ServerOptions). The remaining
     // budget rides along so the timeout limiter can shed requests that
-    // cannot finish in time (AdmitWithBudget).
+    // cannot finish in time (AdmitWithBudget probes per priority class).
     auto* guard = new Server::MethodCallGuard(
-        server, mp, deadline_us > 0 ? deadline_us - arrival_us : -1);
+        server, mp, deadline_us > 0 ? deadline_us - arrival_us : -1,
+        priority);
+    if (guard->rejected() && !guard->shed() && qos_on &&
+        qos->EvictOneBelow(priority)) {
+        // Priority-aware relief: a lower-priority queued request was
+        // evicted (answered TERR_OVERLOAD); this request takes its place
+        // with the concurrency check waived — net concurrency unchanged,
+        // lowest priority shed first instead of first-come-first-served.
+        delete guard;
+        guard = new Server::MethodCallGuard(
+            server, mp, deadline_us > 0 ? deadline_us - arrival_us : -1,
+            priority, /*forced=*/true);
+    }
     if (guard->rejected()) {
         const bool shed = guard->shed();
         delete guard;
@@ -404,6 +552,15 @@ void ProcessTpuStdRequest(TpuStdMessage* msg, const rpc::RpcMeta& meta) {
             SendErrorResponse(sid, cid, TERR_LIMIT_EXCEEDED,
                               "remaining deadline budget below observed "
                               "service time");
+        } else if (qos_on) {
+            // Overload, and nothing below this priority to evict: shed
+            // with the retriable-with-backoff error so well-behaved
+            // clients spread their re-issues.
+            qos->CountShed(tstate);
+            SendErrorResponse(sid, cid, TERR_OVERLOAD,
+                              "overloaded: concurrency limit, no lower-"
+                              "priority work to shed",
+                              qos->SuggestedBackoffMs());
         } else {
             SendErrorResponse(sid, cid, TERR_LIMIT_EXCEEDED,
                               "concurrency limit");
@@ -455,6 +612,11 @@ void ProcessTpuStdRequest(TpuStdMessage* msg, const rpc::RpcMeta& meta) {
     // Controller::request_compress_type); the response defaults to none
     // unless the handler opts in.
     cntl->set_request_compress_type(meta.compress_type());
+    // QoS identity on the call context: handler-issued child calls
+    // inherit it (Channel::CallMethod), so a tenant's class follows its
+    // traffic through the mesh.
+    if (req_meta.has_tenant()) cntl->set_tenant(req_meta.tenant());
+    cntl->set_priority(priority);
     // Interceptor (reference interceptor.h:30 Interceptor::Accept runs
     // before the service method; rejection answers the error directly).
     if (server->options().interceptor != nullptr) {
@@ -507,10 +669,32 @@ void ProcessTpuStdRequest(TpuStdMessage* msg, const rpc::RpcMeta& meta) {
     }
     auto* done = new SendResponseClosure(server, guard, cntl, req, res, sid,
                                          cid);
+    if (qos_on) done->set_qos(qos, tstate, arrival_us);
     if (!ParsePbFromIOBuf(req, payload)) {
         cntl->SetFailed(TERR_REQUEST, "parse request failed");
         done->Run();
         return;
+    }
+    // Multi-tenant QoS stage 3 (ISSUE 8): the weighted-fair dispatch
+    // queue sits in front of handler spawn. Uncontended (queue empty,
+    // tenant under its concurrency share) the request dispatches
+    // DIRECTLY below — the PR-6 inline fast path stays legal exactly
+    // then, so fairness never regresses the raw-speed win on
+    // uncontended sockets. Contended, the request parks under
+    // (priority, tenant-DRR) and the drainer fiber spawns handlers in
+    // fair order; past the high-water the lowest-priority queued
+    // request is shed first.
+    if (qos_on) {
+        if (!qos->TryDirectDispatch(tstate)) {
+            auto* qd = new QueuedCall{server, mp, cntl, req, res, done};
+            QosDispatcher::Item item;
+            item.run = RunQueuedCall;
+            item.shed = ShedQueuedCall;
+            item.arg = qd;
+            qos->Enqueue(tstate, priority, item);
+            return;
+        }
+        done->set_qos_counted();
     }
     // User code normally runs on its OWN fiber, never this one: a slow
     // handler on the input fiber would head-of-line-block the connection —
@@ -533,21 +717,7 @@ void ProcessTpuStdRequest(TpuStdMessage* msg, const rpc::RpcMeta& meta) {
     }
     auto* uc = new UserCallArgs{mp, cntl, req, res, done};
     fiber_t tid;
-    FiberAttr attr = FIBER_ATTR_NORMAL;
-    attr.tag = server->options().fiber_tag;
-    const int32_t backup_at = FLAGS_usercode_backup_threshold.get();
-    if (attr.tag == 0 && backup_at > 0) {
-        const int64_t inflight = g_usercode_default_inflight.fetch_add(
-                                     1, std::memory_order_relaxed) +
-                                 1;
-        if (inflight > backup_at) {
-            g_usercode_default_inflight.fetch_sub(
-                1, std::memory_order_relaxed);
-            attr.tag = kUsercodeBackupTag;  // overflow: isolated pool
-        } else {
-            uc->counted_default = true;
-        }
-    }
+    FiberAttr attr = UserCallAttr(server, uc);
     // Mid-burst (running on the input fiber with MORE bytes already read
     // and waiting in the cut loop): spawn in the BACKGROUND — an urgent
     // handoff would park the input fiber and serialize the whole burst

@@ -1,0 +1,657 @@
+#include "trpc/qos.h"
+
+#include <algorithm>
+#include <cstdlib>
+#include <sstream>
+
+#include "tbase/flags.h"
+#include "tbase/logging.h"
+#include "tbase/time.h"
+#include "tfiber/butex.h"
+
+// The multi-tenant QoS tier is OFF by default: with no quotas configured
+// and the flag off, a request pays one relaxed load and the dispatch
+// path is byte-identical to the raw-speed round. Quotas configured via
+// Server::SetTenantQuota or -rpc_tenant_quotas enable it implicitly.
+DEFINE_bool(rpc_qos_enabled, false,
+            "enable the multi-tenant fair-dispatch/overload tier even "
+            "with no per-tenant quotas configured");
+DEFINE_string(rpc_tenant_quotas, "",
+              "per-tenant quotas: 'name:qps=300,burst=64,w=1,conc=8;...' "
+              "(qps/conc 0 = unlimited; w = weighted-fair share)");
+DEFINE_int32(rpc_fair_queue_highwater, 1024,
+             "fair dispatch queue depth that triggers lowest-priority-"
+             "first shedding");
+DEFINE_int32(rpc_overload_backoff_ms, 50,
+             "server-suggested client backoff attached to TERR_OVERLOAD "
+             "sheds (rate-quota sheds compute their own from the refill "
+             "time)");
+DEFINE_int32(rpc_max_tenants, 64,
+             "distinct tenant label values tracked; newcomers beyond "
+             "this fold into the 'other' tenant (metric-cardinality "
+             "bound)");
+
+namespace tpurpc {
+
+namespace {
+
+// Labelled per-tenant families ({tenant="name"}), process-lifetime,
+// created on first QoS use (runtime, never static-init) — the same
+// pattern as the dispatcher's per-loop families.
+LabelledMetric<IntCell>* tenant_admitted() {
+    static auto* m =
+        new LabelledMetric<IntCell>("rpc_tenant_admitted", {"tenant"});
+    return m;
+}
+LabelledMetric<IntCell>* tenant_shed() {
+    static auto* m =
+        new LabelledMetric<IntCell>("rpc_tenant_shed", {"tenant"});
+    return m;
+}
+LabelledMetric<IntCell>* tenant_queued() {
+    static auto* m =
+        new LabelledMetric<IntCell>("rpc_tenant_queued", {"tenant"});
+    return m;
+}
+LabelledMetric<LatencyRecorder>* tenant_latency() {
+    static auto* m = new LabelledMetric<LatencyRecorder>(
+        "rpc_tenant_latency_us", {"tenant"});
+    return m;
+}
+
+// Process-wide overload accounting (the soak's cross-tenant asserts).
+LazyAdder g_overload_sheds("rpc_server_overload_sheds");
+LazyAdder g_overload_evictions("rpc_server_overload_evictions");
+
+uint64_t mix64(uint64_t k) {
+    k ^= k >> 33;
+    k *= 0xff51afd7ed558ccdULL;
+    k ^= k >> 33;
+    k *= 0xc4ceb9fe1a85ec53ULL;
+    k ^= k >> 33;
+    return k;
+}
+
+uint64_t hash_key(uint64_t seed, const std::string& s) {
+    uint64_t h = seed;
+    for (char c : s) h = mix64(h ^ (uint8_t)c);
+    return mix64(h);
+}
+
+}  // namespace
+
+// ---------------- quota spec ----------------
+
+bool ParseQuotaSpec(const std::string& spec,
+                    std::map<std::string, TenantQuota>* out) {
+    bool clean = true;
+    size_t pos = 0;
+    while (pos < spec.size()) {
+        size_t semi = spec.find(';', pos);
+        if (semi == std::string::npos) semi = spec.size();
+        const std::string entry = spec.substr(pos, semi - pos);
+        pos = semi + 1;
+        if (entry.empty()) continue;
+        const size_t colon = entry.find(':');
+        if (colon == std::string::npos || colon == 0) {
+            clean = false;
+            continue;
+        }
+        const std::string name = entry.substr(0, colon);
+        TenantQuota q;
+        size_t kpos = colon + 1;
+        while (kpos < entry.size()) {
+            size_t comma = entry.find(',', kpos);
+            if (comma == std::string::npos) comma = entry.size();
+            const std::string kv = entry.substr(kpos, comma - kpos);
+            kpos = comma + 1;
+            const size_t eq = kv.find('=');
+            if (eq == std::string::npos) {
+                if (!kv.empty()) clean = false;
+                continue;
+            }
+            const std::string k = kv.substr(0, eq);
+            const char* v = kv.c_str() + eq + 1;
+            char* end = nullptr;
+            const double num = strtod(v, &end);
+            if (end == v || *end != '\0') {
+                clean = false;
+                continue;
+            }
+            if (k == "qps") {
+                q.qps = num;
+            } else if (k == "burst") {
+                q.burst = (int64_t)num;
+            } else if (k == "w" || k == "weight") {
+                q.weight = std::max(1, (int)num);
+            } else if (k == "conc") {
+                q.max_concurrency = (int64_t)num;
+            } else {
+                clean = false;
+            }
+        }
+        (*out)[name] = q;
+    }
+    return clean;
+}
+
+// ---------------- token bucket ----------------
+
+void TokenBucket::Configure(double rate_per_s, int64_t burst) {
+    rate_milli_per_s_.store(
+        rate_per_s > 0 ? (int64_t)(rate_per_s * 1000) : 0,
+        std::memory_order_relaxed);
+    if (burst <= 0) {
+        burst = std::max<int64_t>((int64_t)(rate_per_s / 10), 8);
+    }
+    burst_milli_.store(burst * 1000, std::memory_order_relaxed);
+    tokens_milli_.store(burst * 1000, std::memory_order_relaxed);
+    last_refill_us_.store(monotonic_time_us(), std::memory_order_relaxed);
+}
+
+void TokenBucket::RefillLocked(int64_t now_us) {
+    const int64_t last = last_refill_us_.load(std::memory_order_relaxed);
+    const int64_t elapsed_us = now_us - last;
+    if (elapsed_us < 1000) return;  // sub-ms refills round to nothing
+    std::lock_guard<std::mutex> g(refill_mu_);
+    const int64_t last2 = last_refill_us_.load(std::memory_order_relaxed);
+    if (now_us - last2 < 1000) return;  // another admitter refilled
+    const int64_t add_milli =
+        (now_us - last2) *
+        rate_milli_per_s_.load(std::memory_order_relaxed) / 1000000;
+    if (add_milli <= 0) return;
+    last_refill_us_.store(now_us, std::memory_order_relaxed);
+    const int64_t burst = burst_milli_.load(std::memory_order_relaxed);
+    int64_t cur = tokens_milli_.load(std::memory_order_relaxed);
+    while (cur < burst) {
+        const int64_t next = std::min(burst, cur + add_milli);
+        if (tokens_milli_.compare_exchange_weak(cur, next,
+                                                std::memory_order_relaxed)) {
+            break;
+        }
+    }
+}
+
+bool TokenBucket::TryWithdraw(int64_t now_us, int64_t* wait_ms) {
+    const int64_t rate = rate_milli_per_s_.load(std::memory_order_relaxed);
+    if (rate <= 0) return true;
+    RefillLocked(now_us);
+    int64_t cur = tokens_milli_.load(std::memory_order_relaxed);
+    while (cur >= 1000) {
+        if (tokens_milli_.compare_exchange_weak(cur, cur - 1000,
+                                                std::memory_order_relaxed)) {
+            return true;
+        }
+    }
+    if (wait_ms != nullptr) {
+        // Time until one whole token accrues at the configured rate,
+        // clamped to something a client can reasonably sleep.
+        const int64_t deficit_milli = 1000 - std::max<int64_t>(cur, 0);
+        int64_t ms = deficit_milli * 1000 / std::max<int64_t>(rate, 1);
+        *wait_ms = std::min<int64_t>(std::max<int64_t>(ms, 1), 2000);
+    }
+    return false;
+}
+
+// ---------------- rendezvous subsetting ----------------
+
+std::vector<size_t> RendezvousSubset(uint64_t seed,
+                                     const std::vector<std::string>& keys,
+                                     size_t k) {
+    std::vector<size_t> out;
+    if (k == 0 || keys.empty()) return out;
+    if (keys.size() <= k) {
+        out.resize(keys.size());
+        for (size_t i = 0; i < keys.size(); ++i) out[i] = i;
+        return out;
+    }
+    std::vector<std::pair<uint64_t, size_t>> scored;
+    scored.reserve(keys.size());
+    for (size_t i = 0; i < keys.size(); ++i) {
+        scored.emplace_back(hash_key(seed, keys[i]), i);
+    }
+    // Top-k by score; ties broken by index for determinism.
+    std::partial_sort(scored.begin(), scored.begin() + k, scored.end(),
+                      [](const std::pair<uint64_t, size_t>& a,
+                         const std::pair<uint64_t, size_t>& b) {
+                          return a.first != b.first ? a.first > b.first
+                                                    : a.second < b.second;
+                      });
+    out.reserve(k);
+    for (size_t i = 0; i < k; ++i) out.push_back(scored[i].second);
+    return out;
+}
+
+// ---------------- QosDispatcher ----------------
+
+QosDispatcher::QosDispatcher() { wake_butex_ = butex_create(); }
+
+QosDispatcher::~QosDispatcher() {
+    StopDrainer();
+    butex_destroy(wake_butex_);
+}
+
+namespace {
+// Apply a quota onto a live tenant: the display copy under the
+// registry's exclusive lock, the dispatch-gating fields as atomics.
+void ApplyQuota(QosDispatcher::TenantState* t, const TenantQuota& q) {
+    t->quota = q;
+    t->weight.store(std::max(1, q.weight), std::memory_order_relaxed);
+    t->max_concurrency.store(q.max_concurrency, std::memory_order_relaxed);
+    t->bucket.Configure(q.qps, q.burst);
+}
+}  // namespace
+
+void QosDispatcher::Configure(const std::map<std::string, TenantQuota>& quotas,
+                              bool force_enable) {
+    std::unique_lock<std::shared_mutex> g(tenants_mu_);
+    // Merged view: the flag's quotas, with explicit SetTenantQuota
+    // entries layered on top — "explicit calls override the flag per
+    // tenant", including calls made BEFORE Start.
+    configured_ = quotas;
+    for (const auto& [name, q] : explicit_) configured_[name] = q;
+    for (const auto& [name, q] : configured_) {
+        auto it = tenants_.find(name);
+        if (it != tenants_.end()) ApplyQuota(it->second.get(), q);
+    }
+    enabled_.store(force_enable || !configured_.empty(),
+                   std::memory_order_release);
+}
+
+void QosDispatcher::SetTenantQuota(const std::string& tenant,
+                                   const TenantQuota& q) {
+    std::unique_lock<std::shared_mutex> g(tenants_mu_);
+    const std::string name = tenant.empty() ? "default" : tenant;
+    explicit_[name] = q;
+    configured_[name] = q;
+    auto it = tenants_.find(name);
+    if (it != tenants_.end()) ApplyQuota(it->second.get(), q);
+    enabled_.store(true, std::memory_order_release);
+}
+
+QosDispatcher::TenantState* QosDispatcher::Acquire(
+    const std::string& tenant) {
+    std::string name = tenant.empty() ? "default" : tenant;
+    {
+        // Fast path: the tenant exists (every request after the first) —
+        // a shared lock keeps the admission paths of the sharded event
+        // loops from serializing on this registry.
+        std::shared_lock<std::shared_mutex> g(tenants_mu_);
+        auto it = tenants_.find(name);
+        if (it != tenants_.end()) return it->second.get();
+    }
+    std::unique_lock<std::shared_mutex> g(tenants_mu_);
+    auto it = tenants_.find(name);
+    if (it == tenants_.end()) {
+        // Cardinality bound: an attacker minting fresh tenant names per
+        // request must not flood the metric registry or the DRR table.
+        // Known (configured) tenants always get their own slot.
+        if ((int64_t)tenants_.size() >=
+                (int64_t)FLAGS_rpc_max_tenants.get() &&
+            configured_.find(name) == configured_.end() &&
+            name != "other") {
+            name = "other";
+            it = tenants_.find(name);
+        }
+    }
+    if (it == tenants_.end()) {
+        auto st = std::make_unique<TenantState>();
+        st->name = name;
+        auto cit = configured_.find(name);
+        if (cit != configured_.end()) ApplyQuota(st.get(), cit->second);
+        st->admitted = tenant_admitted()->get_stats({name});
+        st->shed = tenant_shed()->get_stats({name});
+        st->queued = tenant_queued()->get_stats({name});
+        st->latency = tenant_latency()->get_stats({name});
+        it = tenants_.emplace(name, std::move(st)).first;
+    }
+    return it->second.get();
+}
+
+bool QosDispatcher::AdmitQps(TenantState* t, int64_t now_us,
+                             int64_t* backoff_ms) {
+    if (t->bucket.TryWithdraw(now_us, backoff_ms)) return true;
+    CountShed(t);
+    return false;
+}
+
+bool QosDispatcher::TryDirectDispatch(TenantState* t) {
+    if (depth_.load(std::memory_order_relaxed) != 0) {
+        return false;  // fairness first: join the queue behind the others
+    }
+    const int64_t maxc = t->max_concurrency.load(std::memory_order_relaxed);
+    if (maxc > 0) {
+        const int64_t cur =
+            t->inflight.fetch_add(1, std::memory_order_relaxed) + 1;
+        if (cur > maxc) {
+            t->inflight.fetch_sub(1, std::memory_order_relaxed);
+            return false;  // over its share: queue (drainer re-checks)
+        }
+    } else {
+        t->inflight.fetch_add(1, std::memory_order_relaxed);
+    }
+    t->admitted->add(1);
+    return true;
+}
+
+void QosDispatcher::BeginServed(TenantState* t) {
+    t->inflight.fetch_add(1, std::memory_order_relaxed);
+    t->admitted->add(1);
+}
+
+void QosDispatcher::OnDone(TenantState* t, int64_t latency_us) {
+    t->inflight.fetch_sub(1, std::memory_order_relaxed);
+    *t->latency << latency_us;
+    // A freed concurrency share may unblock this tenant's queued work.
+    if (depth_.load(std::memory_order_relaxed) > 0) WakeDrainer();
+}
+
+void QosDispatcher::CountShed(TenantState* t) {
+    t->shed->add(1);
+    *g_overload_sheds << 1;
+}
+
+int64_t QosDispatcher::SuggestedBackoffMs() const {
+    return std::max(1, FLAGS_rpc_overload_backoff_ms.get());
+}
+
+bool QosDispatcher::EvictLowestLocked(int limit_prio,
+                                      std::vector<Item>* out_shed,
+                                      std::vector<TenantState*>* out_owners) {
+    for (int p = kMinPriority; p < limit_prio; ++p) {
+        Level& lvl = levels_[p];
+        if (lvl.active.empty()) continue;
+        // The deepest queue at this level sheds first: under a flood
+        // that is the flooder, so a polite same-priority tenant keeps
+        // its (short) backlog.
+        TenantState* victim = nullptr;
+        for (TenantState* t : lvl.active) {
+            if (t->q[p].empty()) continue;
+            if (victim == nullptr || t->q[p].size() > victim->q[p].size()) {
+                victim = t;
+            }
+        }
+        if (victim == nullptr) continue;
+        // Newest first (LIFO shed): the oldest queued request is closest
+        // to being served; the newest has waited least and its client
+        // retries latest.
+        out_shed->push_back(victim->q[p].back());
+        out_owners->push_back(victim);
+        victim->q[p].pop_back();
+        victim->queued->add(-1);
+        depth_.fetch_sub(1, std::memory_order_relaxed);
+        return true;
+    }
+    return false;
+}
+
+bool QosDispatcher::Enqueue(TenantState* t, int priority, const Item& item) {
+    const int p = ClampPriority(priority);
+    std::vector<Item> to_shed;
+    std::vector<TenantState*> shed_owners;
+    bool self_shed = false;
+    {
+        std::lock_guard<std::mutex> g(mu_);
+        if (stop_.load(std::memory_order_acquire)) {
+            self_shed = true;  // draining dispatcher: answer, don't hold
+        } else {
+            const int64_t hw =
+                std::max(1, FLAGS_rpc_fair_queue_highwater.get());
+            if (depth_.load(std::memory_order_relaxed) >= hw &&
+                !EvictLowestLocked(p, &to_shed, &shed_owners)) {
+                self_shed = true;  // nothing below this priority: shed self
+            }
+        }
+        if (!self_shed) {
+            t->q[p].push_back(item);
+            t->queued->add(1);
+            depth_.fetch_add(1, std::memory_order_relaxed);
+            if (!t->in_active[p]) {
+                levels_[p].active.push_back(t);
+                t->in_active[p] = true;
+            }
+        }
+    }
+    const int64_t backoff = SuggestedBackoffMs();
+    for (size_t i = 0; i < to_shed.size(); ++i) {
+        CountShed(shed_owners[i]);
+        *g_overload_evictions << 1;
+        to_shed[i].shed(to_shed[i].arg, backoff);
+    }
+    if (self_shed) {
+        CountShed(t);
+        item.shed(item.arg, backoff);
+        return false;
+    }
+    WakeDrainer();
+    return true;
+}
+
+bool QosDispatcher::EvictOneBelow(int priority) {
+    std::vector<Item> to_shed;
+    std::vector<TenantState*> owners;
+    {
+        std::lock_guard<std::mutex> g(mu_);
+        if (!EvictLowestLocked(ClampPriority(priority), &to_shed, &owners)) {
+            return false;
+        }
+    }
+    const int64_t backoff = SuggestedBackoffMs();
+    CountShed(owners[0]);
+    *g_overload_evictions << 1;
+    to_shed[0].shed(to_shed[0].arg, backoff);
+    return true;
+}
+
+bool QosDispatcher::PopLocked(Item* out, TenantState** owner,
+                              int* priority) {
+    for (int p = kMaxPriority; p >= kMinPriority; --p) {
+        Level& lvl = levels_[p];
+        // Bounded walk: each active tenant is visited at most twice per
+        // call (once for a possible rotation, once for service) before
+        // we conclude the level is drained or concurrency-blocked.
+        size_t walk = lvl.active.size() * 2 + 2;
+        while (!lvl.active.empty() && walk-- > 0) {
+            TenantState* t = lvl.active.front();
+            if (t->q[p].empty()) {
+                lvl.active.pop_front();
+                t->in_active[p] = false;
+                t->deficit[p] = 0;
+                continue;
+            }
+            const int64_t maxc =
+                t->max_concurrency.load(std::memory_order_relaxed);
+            if (maxc > 0 &&
+                t->inflight.load(std::memory_order_relaxed) >= maxc) {
+                // Over its concurrency share: rotate so the other
+                // tenants at this level aren't blocked behind it
+                // (OnDone re-wakes the drainer when a share frees).
+                lvl.active.pop_front();
+                lvl.active.push_back(t);
+                continue;
+            }
+            // DRR: a fresh turn grants `weight` cost-1 service slots;
+            // the tenant keeps the head until they're spent.
+            if (t->deficit[p] <= 0) {
+                t->deficit[p] = t->weight.load(std::memory_order_relaxed);
+            }
+            *out = t->q[p].front();
+            t->q[p].pop_front();
+            t->queued->add(-1);
+            depth_.fetch_sub(1, std::memory_order_relaxed);
+            if (--t->deficit[p] <= 0 || t->q[p].empty()) {
+                lvl.active.pop_front();
+                lvl.active.push_back(t);
+                t->deficit[p] = std::max(t->deficit[p], 0);
+            }
+            *owner = t;
+            *priority = p;
+            return true;
+        }
+    }
+    return false;
+}
+
+bool QosDispatcher::Pop(Item* out, TenantState** owner, int* priority) {
+    std::lock_guard<std::mutex> g(mu_);
+    if (!PopLocked(out, owner, priority)) return false;
+    // Popped = admitted to service: same accounting as direct dispatch.
+    (*owner)->inflight.fetch_add(1, std::memory_order_relaxed);
+    (*owner)->admitted->add(1);
+    return true;
+}
+
+void QosDispatcher::WakeDrainer() {
+    butex_word(wake_butex_)->fetch_add(1, std::memory_order_release);
+    butex_wake_all(wake_butex_);
+}
+
+void* QosDispatcher::DrainerThunk(void* arg) {
+    ((QosDispatcher*)arg)->DrainerLoop();
+    return nullptr;
+}
+
+void QosDispatcher::DrainerLoop() {
+    while (true) {
+        const int seq =
+            butex_word(wake_butex_)->load(std::memory_order_acquire);
+        Item it;
+        TenantState* t = nullptr;
+        int p = 0;
+        if (Pop(&it, &t, &p)) {
+            // run() spawns the handler in the BACKGROUND (never inline:
+            // user code on this fiber would serialize the whole queue
+            // behind one handler).
+            it.run(it.arg);
+            continue;
+        }
+        if (stop_.load(std::memory_order_acquire)) return;
+        // Backstop timeout covers the wake-before-wait race exactly like
+        // Server::JoinUntil; the wake path is the enqueue/OnDone bump.
+        const int64_t abst = monotonic_time_us() + 100 * 1000;
+        butex_wait(wake_butex_, seq, &abst);
+    }
+}
+
+void QosDispatcher::StartDrainer() {
+    std::lock_guard<std::mutex> g(drainer_mu_);
+    if (drainer_running_) return;
+    stop_.store(false, std::memory_order_release);
+    if (fiber_start_background(&drainer_, nullptr, DrainerThunk, this) ==
+        0) {
+        drainer_running_ = true;
+    } else {
+        LOG(ERROR) << "QoS drainer fiber failed to start";
+    }
+}
+
+void QosDispatcher::StopDrainer() {
+    bool was_running;
+    {
+        std::lock_guard<std::mutex> g(drainer_mu_);
+        was_running = drainer_running_;
+        drainer_running_ = false;
+    }
+    stop_.store(true, std::memory_order_release);
+    if (was_running) {
+        WakeDrainer();
+        fiber_join(drainer_, nullptr);
+    }
+    // Shed everything still queued — even when the drainer never ran
+    // (a runtime-enabled tier racing Stop): each item holds a counted
+    // admission (BeginRequest), and leaking one would hang Server::Join
+    // forever.
+    while (true) {
+        std::vector<Item> items;
+        std::vector<TenantState*> owners;
+        {
+            std::lock_guard<std::mutex> g(mu_);
+            for (int p = kMinPriority; p <= kMaxPriority; ++p) {
+                for (TenantState* t : levels_[p].active) {
+                    while (!t->q[p].empty()) {
+                        items.push_back(t->q[p].front());
+                        owners.push_back(t);
+                        t->q[p].pop_front();
+                        t->queued->add(-1);
+                        depth_.fetch_sub(1, std::memory_order_relaxed);
+                    }
+                    t->in_active[p] = false;
+                    t->deficit[p] = 0;
+                }
+                levels_[p].active.clear();
+            }
+        }
+        if (items.empty()) break;
+        for (size_t i = 0; i < items.size(); ++i) {
+            CountShed(owners[i]);
+            items[i].shed(items[i].arg, SuggestedBackoffMs());
+        }
+    }
+}
+
+std::string QosDispatcher::DescribeText() const {
+    std::ostringstream os;
+    os << "multi-tenant QoS: "
+       << (enabled() ? "enabled" : "disabled (set -rpc_qos_enabled or "
+                                   "-rpc_tenant_quotas)")
+       << "\nfair queue depth: " << queue_depth()
+       << " (highwater " << FLAGS_rpc_fair_queue_highwater.get() << ")\n\n";
+    char line[256];
+    snprintf(line, sizeof(line),
+             "%-16s %6s %8s %6s %6s %9s %10s %10s %8s %10s\n", "tenant",
+             "weight", "qps_cap", "burst", "conc", "inflight", "admitted",
+             "shed", "queued", "p99_us");
+    os << line;
+    std::shared_lock<std::shared_mutex> g(tenants_mu_);
+    for (const auto& [name, t] : tenants_) {
+        snprintf(line, sizeof(line),
+                 "%-16s %6d %8.0f %6lld %6lld %9lld %10lld %10lld %8lld "
+                 "%10lld\n",
+                 name.c_str(),
+                 t->weight.load(std::memory_order_relaxed), t->quota.qps,
+                 (long long)t->quota.burst,
+                 (long long)t->max_concurrency.load(
+                     std::memory_order_relaxed),
+                 (long long)t->inflight.load(std::memory_order_relaxed),
+                 (long long)t->admitted->get(), (long long)t->shed->get(),
+                 (long long)t->queued->get(),
+                 (long long)t->latency->latency_percentile(0.99));
+        os << line;
+    }
+    return os.str();
+}
+
+std::string QosDispatcher::DescribeJson() const {
+    std::ostringstream os;
+    os << "{\"enabled\":" << (enabled() ? 1 : 0)
+       << ",\"queue_depth\":" << queue_depth() << ",\"tenants\":{";
+    std::shared_lock<std::shared_mutex> g(tenants_mu_);
+    bool first = true;
+    for (const auto& [name, t] : tenants_) {
+        if (!first) os << ",";
+        first = false;
+        // Tenant names reaching here are header/meta strings: strip the
+        // two JSON-breaking characters instead of trusting the wire.
+        std::string safe = name;
+        for (char& c : safe) {
+            if (c == '"' || c == '\\' || (unsigned char)c < 0x20) c = '_';
+        }
+        os << "\"" << safe << "\":{"
+           << "\"weight\":" << t->weight.load(std::memory_order_relaxed)
+           << ",\"qps_cap\":" << (int64_t)t->quota.qps
+           << ",\"max_concurrency\":"
+           << t->max_concurrency.load(std::memory_order_relaxed)
+           << ",\"inflight\":"
+           << t->inflight.load(std::memory_order_relaxed)
+           << ",\"admitted\":" << t->admitted->get()
+           << ",\"shed\":" << t->shed->get()
+           << ",\"queued\":" << t->queued->get()
+           << ",\"p50_us\":" << t->latency->latency_percentile(0.5)
+           << ",\"p99_us\":" << t->latency->latency_percentile(0.99)
+           << ",\"count\":" << t->latency->count() << "}";
+    }
+    os << "}}";
+    return os.str();
+}
+
+}  // namespace tpurpc

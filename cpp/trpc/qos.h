@@ -1,0 +1,285 @@
+// Multi-tenant QoS: per-tenant quotas, weighted-fair dispatch, and
+// priority-aware overload shedding (ISSUE 8, ROADMAP item 3).
+//
+// The reference's admission tier (auto_concurrency_limiter) bounds TOTAL
+// concurrency but is tenant-blind: one flooding tenant drives the
+// limiter into shedding everyone. This tier sits in front of handler
+// spawn and makes graceful degradation mean "low priority sheds first,
+// high-priority p99 stays flat":
+//
+//  * TokenBucket — per-tenant QPS quota (milli-token precision, refilled
+//    by elapsed monotonic time, bounded burst).
+//  * QosDispatcher — per-server: tenant registry (quota + inflight +
+//    labelled tvars), a weighted-fair dispatch queue (strict priority
+//    levels, deficit-round-robin across tenants within a level), and
+//    priority-aware shedding when the queue crosses its high-water or
+//    the concurrency limiter rejects (evict lowest-priority-first, never
+//    first-come-first-served collapse). Shed responses carry
+//    TERR_OVERLOAD plus a server-suggested backoff the client honors
+//    with jitter while SPENDING retry budget (no free re-issue storms).
+//  * RendezvousSubset — deterministic client-side subsetting (HRW hash)
+//    so huge client fleets don't full-mesh every server; stable under
+//    node churn (removing one member only pulls in the next-highest
+//    scorer). Used by LoadBalancerWithNaming under every LB policy.
+//
+// Everything here is protobuf-free by design: the whole tier links into
+// the standalone (toolchain-less) tnet/tvar test harness and is unit-
+// tested in cpp/tests/tqos_test.cc.
+#pragma once
+
+#include <atomic>
+#include <cstdint>
+#include <cstdlib>
+#include <deque>
+#include <map>
+#include <mutex>
+#include <shared_mutex>
+#include <string>
+#include <vector>
+
+#include "tfiber/fiber.h"
+#include "tvar/latency_recorder.h"
+#include "tvar/multi_dimension.h"
+#include "tvar/reducer.h"
+
+namespace tpurpc {
+
+// Priority classes carried on the wire (tpu_std RpcRequestMeta.priority /
+// the x-tpu-priority header): 0 = most sheddable, 7 = most protected.
+// Out-of-range wire values are clamped, absent ones default to the
+// middle so "no priority set" is neither privileged nor doomed.
+constexpr int kMinPriority = 0;
+constexpr int kMaxPriority = 7;
+constexpr int kNumPriorities = kMaxPriority - kMinPriority + 1;
+constexpr int kDefaultPriority = 4;
+
+inline int ClampPriority(int64_t p) {
+    if (p < kMinPriority) return kMinPriority;
+    if (p > kMaxPriority) return kMaxPriority;
+    return (int)p;
+}
+
+// The x-tpu-priority header, strictly parsed: absent or non-numeric
+// values get the DEFAULT class, not 0 — garbage in a header must not
+// silently make a request maximally sheddable.
+inline int PriorityFromHeader(const std::string* v) {
+    if (v == nullptr || v->empty()) return kDefaultPriority;
+    char* end = nullptr;
+    const long p = strtol(v->c_str(), &end, 10);
+    if (end == v->c_str() || *end != '\0') return kDefaultPriority;
+    return ClampPriority(p);
+}
+
+// Per-tenant quota. qps <= 0 means "no rate cap"; max_concurrency <= 0
+// means "no concurrency share cap"; weight is the DRR share of dispatch
+// slots under contention (relative to other tenants at the same
+// priority level).
+struct TenantQuota {
+    double qps = 0;            // admitted requests/second (0 = unlimited)
+    int64_t burst = 0;         // bucket depth; 0 = max(qps/10, 8)
+    int weight = 1;            // weighted-fair dispatch share
+    int64_t max_concurrency = 0;  // concurrent handlers (0 = unlimited)
+};
+
+// "tenant:qps=300,burst=64,w=1,conc=8;other:w=8" -> quotas. Unknown keys
+// and malformed entries are skipped (returns false if ANYTHING was
+// skipped, so flag validation can complain while still applying the
+// valid part).
+bool ParseQuotaSpec(const std::string& spec,
+                    std::map<std::string, TenantQuota>* out);
+
+// Monotonic-time token bucket (milli-token precision so fractional
+// refill accumulates exactly). Thread-safe; one CAS per admit.
+// Configure may be called at runtime under traffic (re-quota): the rate
+// and burst are atomics read relaxed by concurrent admitters.
+class TokenBucket {
+public:
+    TokenBucket() = default;
+    // rate_per_s <= 0 disables (TryWithdraw always grants).
+    void Configure(double rate_per_s, int64_t burst);
+    bool enabled() const {
+        return rate_milli_per_s_.load(std::memory_order_relaxed) > 0;
+    }
+    // Take one token at `now_us`; false = dry. On false, *wait_ms is the
+    // suggested wait until a token accrues (>= 1).
+    bool TryWithdraw(int64_t now_us, int64_t* wait_ms);
+    int64_t tokens() const {
+        return tokens_milli_.load(std::memory_order_relaxed) / 1000;
+    }
+
+private:
+    void RefillLocked(int64_t now_us);
+
+    std::atomic<int64_t> rate_milli_per_s_{0};  // milli-tokens/second
+    std::atomic<int64_t> burst_milli_{0};
+    std::atomic<int64_t> tokens_milli_{0};
+    std::atomic<int64_t> last_refill_us_{0};
+    std::mutex refill_mu_;  // refill is rare (>= 1ms granularity)
+};
+
+// Rendezvous (highest-random-weight) subsetting: pick k of `keys`
+// deterministically for this `seed`. Stable under churn: each member's
+// score depends only on (seed, key), so removing one chosen member pulls
+// in exactly the next-highest scorer and every other choice stays put.
+// Returns indexes into `keys` (unordered).
+std::vector<size_t> RendezvousSubset(uint64_t seed,
+                                     const std::vector<std::string>& keys,
+                                     size_t k);
+
+// The per-server multi-tenant dispatch tier. All entry points are
+// thread-safe; the drainer is one fiber parked on a butex.
+class QosDispatcher {
+public:
+    // One queued dispatch unit. `run` dispatches the handler (ownership
+    // of arg passes to it); `shed` answers TERR_OVERLOAD with the given
+    // suggested backoff and releases arg. Exactly one of the two is
+    // invoked for every enqueued item, always outside the queue lock.
+    struct Item {
+        void (*run)(void* arg) = nullptr;
+        void (*shed)(void* arg, int64_t backoff_ms) = nullptr;
+        void* arg = nullptr;
+    };
+
+    struct TenantState {
+        std::string name;
+        // Display copy of the configured quota (written under the
+        // registry lock; /tenants reads under it too). The fields the
+        // DISPATCH paths read are the atomics below, so a runtime
+        // re-quota never races the hot path.
+        TenantQuota quota;
+        TokenBucket bucket;
+        std::atomic<int> weight{1};
+        std::atomic<int64_t> max_concurrency{0};
+        std::atomic<int64_t> inflight{0};
+        // Labelled tvar cells (family instances owned process-wide).
+        IntCell* admitted = nullptr;
+        IntCell* shed = nullptr;
+        IntCell* queued = nullptr;
+        LatencyRecorder* latency = nullptr;
+
+        // ---- DRR state, all guarded by QosDispatcher::mu_ ----
+        std::deque<Item> q[kNumPriorities];
+        bool in_active[kNumPriorities] = {};
+        int deficit[kNumPriorities] = {};
+    };
+
+    QosDispatcher();
+    ~QosDispatcher();
+
+    // (Re)configure from parsed quotas; force_enable turns the tier on
+    // even with no quotas (every tenant then gets the default weight-1
+    // unlimited quota — fairness and priority shedding still apply).
+    void Configure(const std::map<std::string, TenantQuota>& quotas,
+                   bool force_enable);
+    // Set/replace one tenant's quota (Server::SetTenantQuota; callable
+    // at runtime). Enables the tier.
+    void SetTenantQuota(const std::string& tenant, const TenantQuota& q);
+
+    bool enabled() const { return enabled_.load(std::memory_order_acquire); }
+
+    // Tenant handle for one request ("" maps to "default"; past
+    // -rpc_max_tenants distinct names, the overflow tenant "other"
+    // absorbs newcomers so a cardinality attack can't flood the metric
+    // registry). The pointer lives as long as the dispatcher.
+    TenantState* Acquire(const std::string& tenant);
+
+    // Stage 1 — rate quota: one token at `now`; false = shed NOW with
+    // TERR_OVERLOAD and the returned suggested backoff (also counted on
+    // the tenant's shed tvar).
+    bool AdmitQps(TenantState* t, int64_t now_us, int64_t* backoff_ms);
+
+    // Stage 3a — uncontended fast path: true when the fair queue is
+    // empty AND `t` is under its concurrency share; the request is
+    // accounted (inflight + admitted) and the caller dispatches directly
+    // (the PR-6 inline path stays legal exactly here).
+    bool TryDirectDispatch(TenantState* t);
+    // Same accounting without the queue-empty gate — protocols that
+    // don't ride the fair queue (h2/HTTP) still get per-tenant
+    // accounting and concurrency visibility.
+    void BeginServed(TenantState* t);
+
+    // Stage 3b — fair queue: enqueue under (priority, tenant-DRR). Past
+    // the high-water the LOWEST-priority queued item below `priority` is
+    // evicted (its shed callback runs) to make room; with nothing lower,
+    // the newcomer itself is shed. Returns false when the newcomer was
+    // shed synchronously.
+    bool Enqueue(TenantState* t, int priority, const Item& item);
+
+    // Priority-aware relief for concurrency-limiter rejections: evict
+    // ONE queued item of priority strictly below `priority` (its shed
+    // callback runs). True = evicted (the caller may force-admit the
+    // higher-priority request in its place).
+    bool EvictOneBelow(int priority);
+
+    // Handler completion for every admitted (direct or popped) request:
+    // inflight decrement, latency feed, drainer wake (a freed
+    // concurrency share may unblock a queued tenant).
+    void OnDone(TenantState* t, int64_t latency_us);
+
+    // Count a shed that happened outside the queue (qps quota, limiter
+    // reject without eviction relief).
+    void CountShed(TenantState* t);
+
+    // Suggested backoff for queue/limiter sheds (-rpc_overload_backoff_ms).
+    int64_t SuggestedBackoffMs() const;
+
+    // Drainer lifecycle (Server::StartNoListen / Server::Stop). Stop
+    // sheds everything still queued so admission accounting drains.
+    void StartDrainer();
+    void StopDrainer();
+
+    int64_t queue_depth() const {
+        return depth_.load(std::memory_order_relaxed);
+    }
+
+    // Pop one item in strict-priority + DRR order. Returns false when
+    // the queue is empty or every queued tenant is over its concurrency
+    // share. On success the item is accounted like a direct dispatch.
+    // Public for tests; the drainer is the production caller.
+    bool Pop(Item* out, TenantState** owner, int* priority);
+
+    // /tenants portal renderings.
+    std::string DescribeText() const;
+    std::string DescribeJson() const;
+
+private:
+    struct Level {
+        std::deque<TenantState*> active;  // tenants with queued items
+    };
+
+    bool PopLocked(Item* out, TenantState** owner, int* priority);
+    // Evict one item from the lowest non-empty level strictly below
+    // `limit_prio`, from the tenant with the deepest queue there (the
+    // flooder sheds first). Appends the item to *out_shed.
+    bool EvictLowestLocked(int limit_prio, std::vector<Item>* out_shed,
+                           std::vector<TenantState*>* out_owners);
+    void WakeDrainer();
+    static void* DrainerThunk(void* arg);
+    void DrainerLoop();
+
+    std::atomic<bool> enabled_{false};
+
+    // Reader-heavy registry: every request resolves its tenant here, so
+    // lookups take the lock shared; only tenant creation / re-quota /
+    // the /tenants page take it exclusive.
+    mutable std::shared_mutex tenants_mu_;
+    std::map<std::string, std::unique_ptr<TenantState>> tenants_;
+    // Quota templates applied to tenants on first Acquire. configured_
+    // is the merged view (flag ∪ explicit, explicit wins); explicit_
+    // remembers SetTenantQuota calls so a later Configure (flag apply
+    // at Start / restart) can never silently drop them.
+    std::map<std::string, TenantQuota> configured_;
+    std::map<std::string, TenantQuota> explicit_;
+
+    mutable std::mutex mu_;  // queue + DRR state
+    Level levels_[kNumPriorities];
+    std::atomic<int64_t> depth_{0};
+
+    void* wake_butex_ = nullptr;
+    fiber_t drainer_ = 0;
+    bool drainer_running_ = false;  // guarded by drainer_mu_
+    std::mutex drainer_mu_;
+    std::atomic<bool> stop_{false};
+};
+
+}  // namespace tpurpc

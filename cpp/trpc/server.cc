@@ -34,6 +34,8 @@
 DEFINE_bool(graceful_quit_on_sigterm, false,
             "SIGTERM gracefully drains and quits the server; SIGUSR2 "
             "drains without quitting");
+DECLARE_bool(rpc_qos_enabled);
+DECLARE_string(rpc_tenant_quotas);
 
 namespace tpurpc {
 
@@ -187,6 +189,23 @@ int Server::StartNoListen(const ServerOptions* options) {
             kv.second.status->limiter.reset();  // restart may disable limits
         }
     }
+    // Multi-tenant QoS (ISSUE 8): quotas from the flag (explicit
+    // SetTenantQuota calls made before Start survive — Configure only
+    // overwrites tenants the flag names), drainer for the fair queue.
+    {
+        std::map<std::string, TenantQuota> quotas;
+        const std::string spec = FLAGS_rpc_tenant_quotas.get();
+        if (!spec.empty() && !ParseQuotaSpec(spec, &quotas)) {
+            LOG(ERROR) << "malformed entries in -rpc_tenant_quotas '"
+                       << spec << "' (valid part applied)";
+        }
+        if (!quotas.empty() || FLAGS_rpc_qos_enabled.get()) {
+            qos_.Configure(quotas, FLAGS_rpc_qos_enabled.get());
+        }
+    }
+    if (qos_.enabled()) {
+        qos_.StartDrainer();
+    }
     ExposeProcessVariables();  // process_* gauges for /vars + /metrics
     ExposeFlagVariables();     // flag_* bridge: flag flips are scrapeable
     // Per-variable 60s/60min/24h rings behind /vars?series= (1Hz tick).
@@ -329,6 +348,10 @@ void Server::Stop() {
     if (!started_) return;
     if (listening_) acceptor_.StopAccept();
     started_ = false;
+    // Stop the fair-queue drainer and shed everything still queued:
+    // each queued item holds a counted admission, so leaking one would
+    // hang Join below forever.
+    qos_.StopDrainer();
     // A drain-only server (StartDraining without GracefulStop) that is
     // stopped the plain way must not report rpc_server_draining=1
     // forever — the gauge is process-global, the flag per-instance.

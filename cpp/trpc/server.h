@@ -20,6 +20,7 @@
 #include "tnet/acceptor.h"
 #include "tnet/input_messenger.h"
 #include "trpc/concurrency_limiter.h"
+#include "trpc/qos.h"
 #include "tvar/latency_recorder.h"
 
 namespace tpurpc {
@@ -135,6 +136,21 @@ public:
                             const std::string& method_name,
                             bool inline_safe = true);
 
+    // ---- multi-tenant QoS (ISSUE 8; trpc/qos.h) ----
+    // Set/replace one tenant's quota (QPS rate, burst, weighted-fair
+    // share, concurrency share). Enables the QoS tier for this server;
+    // callable before or after Start (the dispatch-gating fields are
+    // atomics, so a runtime re-quota is safe under traffic). The
+    // -rpc_tenant_quotas flag configures the same thing at
+    // StartNoListen; explicit calls override the flag per tenant. A
+    // call that enables the tier on an already-running server also
+    // starts the fair-queue drainer.
+    void SetTenantQuota(const std::string& tenant, const TenantQuota& quota) {
+        qos_.SetTenantQuota(tenant, quota);
+        if (started_) qos_.StartDrainer();
+    }
+    QosDispatcher* qos() { return &qos_; }
+
     int Start(const EndPoint& ep, const ServerOptions* options);
     int Start(int port, const ServerOptions* options);  // 0 = ephemeral
     void Stop();
@@ -224,15 +240,21 @@ public:
         // deadline budget, or -1 when the client sent none. Budget-aware
         // limiters (TimeoutConcurrencyLimiter::AdmitWithBudget) shed
         // requests that cannot finish in time; such rejections are
-        // accounted as `shed` rather than `rejected`.
+        // accounted as `shed` rather than `rejected`. `priority` is the
+        // request's QoS class (budget limiters probe per class);
+        // `forced` skips the OnRequested concurrency check — used when
+        // the QoS tier evicted a lower-priority queued request to make
+        // room, so net concurrency is unchanged (budget shedding still
+        // applies: eviction can't make a doomed request finish in time).
         MethodCallGuard(Server* server, MethodProperty* mp,
-                        int64_t remaining_budget_us = -1)
+                        int64_t remaining_budget_us = -1,
+                        int priority = 0, bool forced = false)
             : server_(server), mp_(mp) {
             const int64_t cur = mp_->status->concurrency.fetch_add(
                                     1, std::memory_order_relaxed) +
                                 1;
             ConcurrencyLimiter* lim = mp_->status->limiter.get();
-            if (lim != nullptr && !lim->OnRequested(cur)) {
+            if (lim != nullptr && !forced && !lim->OnRequested(cur)) {
                 mp_->status->concurrency.fetch_sub(
                     1, std::memory_order_relaxed);
                 mp_->status->nrejected.fetch_add(1,
@@ -241,7 +263,7 @@ public:
                 return;
             }
             if (lim != nullptr && remaining_budget_us >= 0 &&
-                !lim->AdmitWithBudget(remaining_budget_us)) {
+                !lim->AdmitWithBudget(remaining_budget_us, priority)) {
                 mp_->status->concurrency.fetch_sub(
                     1, std::memory_order_relaxed);
                 mp_->status->nshed.fetch_add(1, std::memory_order_relaxed);
@@ -293,6 +315,10 @@ public:
 private:
     InputMessenger messenger_;
     Acceptor acceptor_;
+    // Multi-tenant fair dispatch + overload shedding (trpc/qos.h).
+    // Disabled (and bypassed) until quotas are configured or
+    // -rpc_qos_enabled is on.
+    QosDispatcher qos_;
     class RedisService* redis_service_ = nullptr;
     ServerOptions options_;
     bool started_ = false;

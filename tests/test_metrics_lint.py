@@ -105,7 +105,11 @@ def test_metrics_exposition_lint(cpp_build, tmp_path):
     (port,) = _free_ports(1)
     peers_file = tmp_path / "peers"
     peers_file.write_text("127.0.0.1:%d\n" % port)
-    node = Node(binary, port, 0, peers_file)
+    # QoS on (ISSUE 8): the node's self-echo traffic then populates the
+    # per-tenant labelled families for the lint below.
+    from test_chaos_soak import NODE_FLAGS
+    node = Node(binary, port, 0, peers_file,
+                flags=NODE_FLAGS + ["rpc_qos_enabled=true"])
     try:
         assert node.wait_ready(), "node never became ready"
         # Let traffic + the 1Hz series sampler produce real data.
@@ -141,6 +145,17 @@ def test_metrics_exposition_lint(cpp_build, tmp_path):
             text[:500]
         assert re.search(
             r'^rpc_scheduler_steals\{pool="0"\} \d+$', text, re.M)
+        # ISSUE 8 multi-tenant families: per-tenant counters as labelled
+        # gauges, the served-latency distribution as a labelled summary —
+        # same lint, same per-tuple series rings.
+        assert families.get("rpc_tenant_admitted") == "gauge", \
+            sorted(families)
+        assert families.get("rpc_tenant_shed") == "gauge"
+        assert families.get("rpc_tenant_queued") == "gauge"
+        assert families.get("rpc_tenant_latency_us") == "summary"
+        assert re.search(
+            r'^rpc_tenant_admitted\{tenant="default"\} \d+$', text, re.M), \
+            text[:500]
 
         # /vars?series= returns the fixed 60/60/24-point ring shape.
         # Poll: on a loaded host the 1Hz sampler may lag a little before

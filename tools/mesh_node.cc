@@ -191,10 +191,19 @@ int64_t VarInt(const char* name) {
     return atoll(v.c_str());
 }
 
+// QoS identity of this node's own traffic (--tenant/--priority): the
+// mesh's background load can then be classed against foreground load in
+// the overload soak (unset = the default tenant/priority class).
+std::string g_tenant;
+std::atomic<int> g_priority{-1};
+
 bool DoEcho(Channel* ch, int64_t timeout_ms, const std::string& payload) {
     benchpb::EchoService_Stub stub(ch);
     Controller cntl;
     cntl.set_timeout_ms(timeout_ms);
+    if (!g_tenant.empty()) cntl.set_tenant(g_tenant);
+    const int prio = g_priority.load(std::memory_order_relaxed);
+    if (prio >= 0) cntl.set_priority(prio);
     benchpb::EchoRequest req;
     benchpb::EchoResponse res;
     req.set_send_ts_us(monotonic_time_us());
@@ -530,6 +539,10 @@ int main(int argc, char** argv) {
             peers_file = argv[++i];
         } else if (strcmp(argv[i], "--timeout_cl_ms") == 0 && i + 1 < argc) {
             timeout_cl_ms = atoi(argv[++i]);
+        } else if (strcmp(argv[i], "--tenant") == 0 && i + 1 < argc) {
+            g_tenant = argv[++i];
+        } else if (strcmp(argv[i], "--priority") == 0 && i + 1 < argc) {
+            g_priority.store(atoi(argv[++i]), std::memory_order_relaxed);
         } else if (strcmp(argv[i], "--drain_ms") == 0 && i + 1 < argc) {
             // SIGTERM grace window: announce, then keep serving this long
             // before the final GracefulStop (rolling restarts observe
@@ -569,7 +582,8 @@ int main(int argc, char** argv) {
         fprintf(stderr,
                 "usage: mesh_node --port N --peers FILE [--id K] "
                 "[--lb_only] [--inline_echo] [--drain_ms N] "
-                "[--timeout_cl_ms N] [--flag name=value]...\n"
+                "[--timeout_cl_ms N] [--tenant NAME] [--priority 0..7] "
+                "[--flag name=value]...\n"
                 "  with --flag graceful_quit_on_sigterm=true: SIGTERM "
                 "drains gracefully and exits 0; SIGUSR2 drains without "
                 "quitting\n");

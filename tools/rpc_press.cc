@@ -6,6 +6,8 @@
 //   rpc_press --server=ip:port [--qps=10000] [--duration_s=10]
 //             [--payload=4096] [--callers=8] [--press_threads=1]
 //             [--pooled] [--timeout_ms=5000] [--metrics_csv=path]
+//             [--tenant=name] [--priority=0..7]
+//             [--tenants=a:8,b:1  or  a:8:7,b:1:1]
 //
 // --press_threads=N drives N independent pinned channels (one connection
 // each, callers spread round-robin), so the generator scales past a
@@ -19,11 +21,21 @@
 // expired-shed and budget-shed paths from the load tool — watch
 // rpc_server_expired_requests / rpc_server_shed_requests in its /vars.
 //
+// Multi-tenant QoS (ISSUE 8): --tenant/--priority stamp every request's
+// identity meta; --tenants=name:weight[:priority],... runs a MIXED load
+// where the target --qps splits across tenants by weight (callers too)
+// — the overload-isolation soak's shape: one flooding low-priority
+// tenant plus a steady high-priority one, in one process. Responses
+// carrying TERR_OVERLOAD count as `shed` separately from other
+// failures. With more than one tenant, --metrics_csv appends one row
+// per tenant per interval (tenant column; the aggregate row says
+// "all") and --json adds a per-tenant breakdown.
+//
 // While running, one stats line per second (interval qps + windowed
 // p50/p99/p999); --metrics_csv=<path> appends the same row per interval
-// as CSV (elapsed_s,qps,p50_us,p99_us,p999_us,failed_total) — the BENCH
-// trajectory input. Prints qps achieved + latency percentiles at the
-// end; --json for one JSON line.
+// as CSV (elapsed_s,qps,p50_us,p99_us,p999_us,failed_total,tenant) —
+// the BENCH trajectory input. Prints qps achieved + latency percentiles
+// at the end; --json for one JSON line.
 #include <signal.h>
 #include <unistd.h>
 
@@ -37,6 +49,7 @@
 
 #include "bench_echo.pb.h"
 #include "tbase/endpoint.h"
+#include "tbase/errno.h"
 #include "tbase/time.h"
 #include "tfiber/fiber.h"
 #include "trpc/channel.h"
@@ -47,13 +60,26 @@ using namespace tpurpc;
 
 namespace {
 
+// One traffic class of the generator: its own pacing bucket and stats,
+// so per-tenant isolation is measurable from the CLIENT side too.
+struct TenantGen {
+    std::string name;       // empty = no identity stamped
+    int priority = -1;      // <0 = unset
+    int weight = 1;
+    long long qps = 0;      // this tenant's share of the target
+    LatencyRecorder lat;
+    std::atomic<int64_t> tokens{0};
+    std::atomic<int64_t> sent{0};
+    std::atomic<int64_t> failed{0};
+    std::atomic<int64_t> shed{0};  // TERR_OVERLOAD rejections
+    int64_t granted = 0;
+    int64_t last_sent = 0;  // interval reporting
+};
+
 struct PressCtx {
     benchpb::EchoService_Stub* stub;
-    LatencyRecorder* lat;
-    std::atomic<int64_t>* tokens;
+    TenantGen* gen;
     std::atomic<bool>* stop;
-    std::atomic<int64_t>* sent;
-    std::atomic<int64_t>* failed;
     IOBuf* filler;
     int64_t timeout_ms;
 };
@@ -66,29 +92,62 @@ void OnSigint(int) { g_sigint = 1; }
 
 void* PressCaller(void* arg) {
     auto* c = (PressCtx*)arg;
+    TenantGen* g = c->gen;
     while (!c->stop->load(std::memory_order_relaxed)) {
         // Token bucket: each call consumes one token (reference
         // rdma_performance client.cpp:68).
-        if (c->tokens->fetch_sub(1, std::memory_order_relaxed) <= 0) {
-            c->tokens->fetch_add(1, std::memory_order_relaxed);
+        if (g->tokens.fetch_sub(1, std::memory_order_relaxed) <= 0) {
+            g->tokens.fetch_add(1, std::memory_order_relaxed);
             fiber_usleep(200);
             continue;
         }
         Controller cntl;
         cntl.set_timeout_ms(c->timeout_ms);
+        if (!g->name.empty()) cntl.set_tenant(g->name);
+        if (g->priority >= 0) cntl.set_priority(g->priority);
         benchpb::EchoRequest req;
         benchpb::EchoResponse res;
         req.set_send_ts_us(monotonic_time_us());
         cntl.request_attachment().append(*c->filler);
         c->stub->Echo(&cntl, &req, &res, nullptr);
         if (cntl.Failed()) {
-            c->failed->fetch_add(1, std::memory_order_relaxed);
+            g->failed.fetch_add(1, std::memory_order_relaxed);
+            if (cntl.ErrorCode() == TERR_OVERLOAD) {
+                g->shed.fetch_add(1, std::memory_order_relaxed);
+            }
         } else {
-            *c->lat << (monotonic_time_us() - res.send_ts_us());
-            c->sent->fetch_add(1, std::memory_order_relaxed);
+            g->lat << (monotonic_time_us() - res.send_ts_us());
+            g->sent.fetch_add(1, std::memory_order_relaxed);
         }
     }
     return nullptr;
+}
+
+// "--tenants=a:8,b:1" or "a:8:7,b:1:1" -> name:weight[:priority] specs.
+bool ParseTenantsSpec(const char* spec, int default_priority,
+                      std::vector<std::unique_ptr<TenantGen>>* gens) {
+    std::string s(spec);
+    size_t pos = 0;
+    while (pos < s.size()) {
+        size_t comma = s.find(',', pos);
+        if (comma == std::string::npos) comma = s.size();
+        const std::string entry = s.substr(pos, comma - pos);
+        pos = comma + 1;
+        if (entry.empty()) continue;
+        const size_t c1 = entry.find(':');
+        if (c1 == std::string::npos || c1 == 0) return false;
+        auto g = std::make_unique<TenantGen>();
+        g->name = entry.substr(0, c1);
+        g->priority = default_priority;
+        const size_t c2 = entry.find(':', c1 + 1);
+        g->weight = atoi(entry.c_str() + c1 + 1);
+        if (g->weight <= 0) return false;
+        if (c2 != std::string::npos) {
+            g->priority = atoi(entry.c_str() + c2 + 1);
+        }
+        gens->push_back(std::move(g));
+    }
+    return !gens->empty();
 }
 
 }  // namespace
@@ -104,6 +163,10 @@ int main(int argc, char** argv) {
     bool pooled = false;
     bool json = false;
     const char* metrics_csv = nullptr;
+    const char* tenants_spec = nullptr;
+    std::string tenant;
+    int priority = -1;
+    int max_retry = -1;  // <0 = channel default (3)
     for (int i = 1; i < argc; ++i) {
         if (strncmp(argv[i], "--metrics_csv=", 14) == 0) {
             metrics_csv = argv[i] + 14;
@@ -128,6 +191,20 @@ int main(int argc, char** argv) {
         if (strncmp(argv[i], "--callers=", 10) == 0) {
             callers = atoi(argv[i] + 10);
         }
+        if (strncmp(argv[i], "--tenant=", 9) == 0) tenant = argv[i] + 9;
+        if (strncmp(argv[i], "--priority=", 11) == 0) {
+            priority = atoi(argv[i] + 11);
+        }
+        // --max_retry=0 makes every shed/failure a FINAL failure: the
+        // generator then emits its raw offered load instead of
+        // throttling itself on overload backoffs — what an overload
+        // soak needs to hold a flood at Nx capacity.
+        if (strncmp(argv[i], "--max_retry=", 12) == 0) {
+            max_retry = atoi(argv[i] + 12);
+        }
+        if (strncmp(argv[i], "--tenants=", 10) == 0) {
+            tenants_spec = argv[i] + 10;
+        }
         if (strcmp(argv[i], "--pooled") == 0) pooled = true;
         if (strcmp(argv[i], "--json") == 0) json = true;
     }
@@ -136,7 +213,8 @@ int main(int argc, char** argv) {
                 "usage: rpc_press --server=ip:port [--qps=N] "
                 "[--duration_s=N] [--payload=N] [--callers=N] "
                 "[--press_threads=N] [--pooled] [--timeout_ms=N] "
-                "[--json]\n");
+                "[--max_retry=N] [--tenant=NAME] [--priority=0..7] "
+                "[--tenants=a:8,b:1 | a:8:7,b:1:1] [--json]\n");
         return 1;
     }
     EndPoint server;
@@ -144,10 +222,40 @@ int main(int argc, char** argv) {
         fprintf(stderr, "bad server address: %s\n", server_str.c_str());
         return 1;
     }
+    // Traffic classes: one per --tenants entry, or the single
+    // (possibly anonymous) --tenant/--priority class.
+    std::vector<std::unique_ptr<TenantGen>> gens;
+    if (tenants_spec != nullptr) {
+        if (!ParseTenantsSpec(tenants_spec, priority, &gens)) {
+            fprintf(stderr, "bad --tenants spec: %s\n", tenants_spec);
+            return 1;
+        }
+    } else {
+        auto g = std::make_unique<TenantGen>();
+        g->name = tenant;
+        g->priority = priority;
+        gens.push_back(std::move(g));
+    }
+    // Split the target qps (and below, the callers) by weight.
+    long long wsum = 0;
+    for (const auto& g : gens) wsum += g->weight;
+    // Every class gets at least 1 qps (the max(1,...) floors can make
+    // the shares sum past --qps at tiny targets — a silent zero-rate
+    // tenant would be worse than a slightly-over-target run).
+    long long qps_left = qps;
+    for (size_t i = 0; i < gens.size(); ++i) {
+        gens[i]->qps = i + 1 == gens.size()
+                           ? std::max<long long>(1, qps_left)
+                           : std::max<long long>(1, qps * gens[i]->weight /
+                                                        wsum);
+        qps_left -= gens[i]->qps;
+    }
     if (press_threads < 1) press_threads = 1;
     if (callers < press_threads) callers = press_threads;
+    if (callers < (int)gens.size()) callers = (int)gens.size();
     ChannelOptions copts;
     copts.timeout_ms = timeout_ms;
+    if (max_retry >= 0) copts.max_retry = max_retry;
     if (pooled) copts.connection_type = CONNECTION_TYPE_POOLED;
     // Multi-channel generator: each channel pins its own connection so
     // the N connections shard across the server's (and this tool's)
@@ -168,74 +276,127 @@ int main(int argc, char** argv) {
 
     IOBuf filler;
     filler.append(std::string((size_t)payload, 'p'));
-    LatencyRecorder lat;
-    std::atomic<int64_t> tokens{0};
     std::atomic<bool> stop{false};
-    std::atomic<int64_t> sent{0};
-    std::atomic<int64_t> failed{0};
-    // One ctx per channel; callers spread round-robin across them.
+    // Caller -> tenant assignment by weight (every tenant gets at least
+    // one caller), channels round-robin underneath.
+    std::vector<TenantGen*> assignment;
+    for (auto& g : gens) assignment.push_back(g.get());
+    while ((int)assignment.size() < callers) {
+        // Repeat tenants proportionally to weight until callers filled.
+        long long best = -1;
+        TenantGen* pick = gens[0].get();
+        for (auto& g : gens) {
+            long long have = 0;
+            for (TenantGen* a : assignment) have += (a == g.get());
+            // Deficit = desired share minus current share (scaled).
+            const long long deficit =
+                (long long)g->weight * (long long)assignment.size() -
+                have * wsum;
+            if (deficit > best) {
+                best = deficit;
+                pick = g.get();
+            }
+        }
+        assignment.push_back(pick);
+    }
     std::vector<PressCtx> ctxs;
-    ctxs.reserve((size_t)press_threads);
-    for (int i = 0; i < press_threads; ++i) {
-        ctxs.push_back(PressCtx{stubs[(size_t)i].get(), &lat, &tokens,
-                                &stop, &sent, &failed, &filler,
+    ctxs.reserve((size_t)callers);
+    for (int i = 0; i < callers; ++i) {
+        ctxs.push_back(PressCtx{stubs[(size_t)(i % press_threads)].get(),
+                                assignment[(size_t)i], &stop, &filler,
                                 timeout_ms});
     }
     std::vector<fiber_t> tids((size_t)callers);
     for (size_t i = 0; i < tids.size(); ++i) {
-        fiber_start_background(&tids[i], nullptr, PressCaller,
-                               &ctxs[i % ctxs.size()]);
+        fiber_start_background(&tids[i], nullptr, PressCaller, &ctxs[i]);
     }
 
     // Per-interval scrape sink (--metrics_csv): one appended row per
-    // second feeds the BENCH trajectory.
+    // second feeds the BENCH trajectory; mixed-tenant runs add one row
+    // per tenant per interval (tenant column).
     FILE* csv = nullptr;
     if (metrics_csv != nullptr) {
         const bool fresh = access(metrics_csv, F_OK) != 0;
         csv = fopen(metrics_csv, "a");
         if (csv != nullptr && fresh) {
-            fprintf(csv, "elapsed_s,qps,p50_us,p99_us,p999_us,failed\n");
+            fprintf(csv,
+                    "elapsed_s,qps,p50_us,p99_us,p999_us,failed,tenant\n");
         }
     }
 
     // Refill by elapsed time (exact pacing for any target, including
-    // qps below the 100Hz refill cadence), bucket capped at one second
-    // of budget so stalls don't cause unbounded bursts.
+    // qps below the 100Hz refill cadence), per tenant class; buckets
+    // capped at one second of budget so stalls don't cause unbounded
+    // bursts.
     const int64_t t0 = monotonic_time_us();
     const int64_t end = t0 + (int64_t)duration_s * 1000 * 1000;
-    int64_t granted = 0;
     int64_t next_report_us = t0 + 1000 * 1000;
-    int64_t last_sent = 0;
+    int64_t agg_last_sent = 0;
     const auto report = [&](int64_t now) {
-        const int64_t total_sent = sent.load(std::memory_order_relaxed);
-        const int64_t iqps = total_sent - last_sent;
-        last_sent = total_sent;
+        int64_t total_sent = 0, total_failed = 0;
+        for (auto& g : gens) {
+            total_sent += g->sent.load(std::memory_order_relaxed);
+            total_failed += g->failed.load(std::memory_order_relaxed);
+        }
+        const int64_t iqps = total_sent - agg_last_sent;
+        agg_last_sent = total_sent;
         const long long elapsed_s = (now - t0) / 1000000;
-        const long long p50 = lat.latency_percentile(0.5);
-        const long long p99 = lat.latency_percentile(0.99);
-        const long long p999 = lat.latency_percentile(0.999);
-        const long long nfailed = failed.load(std::memory_order_relaxed);
+        // Aggregate percentiles: single-class runs report that class;
+        // mixed runs report the first (it also gets per-tenant rows).
+        long long p50 = 0, p99 = 0, p999 = 0;
+        {
+            int64_t cnt = 0;
+            for (auto& g : gens) {
+                // Use the class with the most samples as the headline.
+                if (g->lat.count() > cnt) {
+                    cnt = g->lat.count();
+                    p50 = g->lat.latency_percentile(0.5);
+                    p99 = g->lat.latency_percentile(0.99);
+                    p999 = g->lat.latency_percentile(0.999);
+                }
+            }
+        }
         printf("t=%llds qps=%lld p50=%lldus p99=%lldus p999=%lldus "
                "failed=%lld\n",
-               elapsed_s, (long long)iqps, p50, p99, p999, nfailed);
+               elapsed_s, (long long)iqps, p50, p99, p999,
+               (long long)total_failed);
         fflush(stdout);
         if (csv != nullptr) {
-            fprintf(csv, "%lld,%lld,%lld,%lld,%lld,%lld\n", elapsed_s,
-                    (long long)iqps, p50, p99, p999, nfailed);
+            fprintf(csv, "%lld,%lld,%lld,%lld,%lld,%lld,all\n", elapsed_s,
+                    (long long)iqps, p50, p99, p999,
+                    (long long)total_failed);
+            if (gens.size() > 1) {
+                for (auto& g : gens) {
+                    const int64_t s = g->sent.load(std::memory_order_relaxed);
+                    fprintf(csv, "%lld,%lld,%lld,%lld,%lld,%lld,%s\n",
+                            elapsed_s, (long long)(s - g->last_sent),
+                            (long long)g->lat.latency_percentile(0.5),
+                            (long long)g->lat.latency_percentile(0.99),
+                            (long long)g->lat.latency_percentile(0.999),
+                            (long long)g->failed.load(
+                                std::memory_order_relaxed),
+                            g->name.empty() ? "default" : g->name.c_str());
+                    g->last_sent = s;
+                }
+            }
             fflush(csv);
         }
     };
     signal(SIGINT, OnSigint);  // clean early stop (full final report)
     while (monotonic_time_us() < end && !g_sigint) {
         const int64_t now = monotonic_time_us();
-        const int64_t should = (now - t0) * qps / 1000000;
-        if (should > granted) {
-            tokens.fetch_add(should - granted, std::memory_order_relaxed);
-            granted = should;
-        }
-        int64_t cur = tokens.load(std::memory_order_relaxed);
-        if (cur > qps) {
-            tokens.fetch_sub(cur - qps, std::memory_order_relaxed);
+        for (auto& g : gens) {
+            const int64_t should = (now - t0) * g->qps / 1000000;
+            if (should > g->granted) {
+                g->tokens.fetch_add(should - g->granted,
+                                    std::memory_order_relaxed);
+                g->granted = should;
+            }
+            int64_t cur = g->tokens.load(std::memory_order_relaxed);
+            if (cur > g->qps) {
+                g->tokens.fetch_sub(cur - g->qps,
+                                    std::memory_order_relaxed);
+            }
         }
         if (now >= next_report_us) {
             next_report_us += 1000 * 1000;
@@ -252,31 +413,75 @@ int main(int argc, char** argv) {
     stop.store(true, std::memory_order_relaxed);
     for (auto tid : tids) fiber_join(tid, nullptr);
     const double secs = (double)(monotonic_time_us() - t0) / 1e6;
-    const double achieved = (double)sent.load() / secs;
+    int64_t total_sent = 0, total_failed = 0, total_shed = 0;
+    for (auto& g : gens) {
+        total_sent += g->sent.load();
+        total_failed += g->failed.load();
+        total_shed += g->shed.load();
+    }
+    const double achieved = (double)total_sent / secs;
+    // Headline percentiles from the largest class (see report()).
+    const TenantGen* head = gens[0].get();
+    for (auto& g : gens) {
+        if (g->lat.count() > head->lat.count()) head = g.get();
+    }
     if (json) {
         // Generator config rides along so BENCH records are
         // reproducible: the same qps from 1 vs 16 connections stresses
         // completely different server paths.
         printf("{\"press_qps\": %.0f, \"press_target_qps\": %lld, "
-               "\"press_failed\": %lld, \"press_p50_us\": %lld, "
+               "\"press_failed\": %lld, \"press_shed\": %lld, "
+               "\"press_p50_us\": %lld, "
                "\"press_p99_us\": %lld, \"press_p999_us\": %lld, "
                "\"press_threads\": %d, \"press_callers\": %d, "
-               "\"press_payload\": %d, \"press_pooled\": %d}\n",
-               achieved, qps, (long long)failed.load(),
-               (long long)lat.latency_percentile(0.5),
-               (long long)lat.latency_percentile(0.99),
-               (long long)lat.latency_percentile(0.999), press_threads,
-               callers, payload, pooled ? 1 : 0);
+               "\"press_payload\": %d, \"press_pooled\": %d",
+               achieved, qps, (long long)total_failed,
+               (long long)total_shed,
+               (long long)head->lat.latency_percentile(0.5),
+               (long long)head->lat.latency_percentile(0.99),
+               (long long)head->lat.latency_percentile(0.999),
+               press_threads, callers, payload, pooled ? 1 : 0);
+        if (gens.size() > 1 || !gens[0]->name.empty()) {
+            printf(", \"press_tenants\": {");
+            for (size_t i = 0; i < gens.size(); ++i) {
+                const auto& g = gens[i];
+                printf("%s\"%s\": {\"qps\": %.0f, \"target_qps\": %lld, "
+                       "\"priority\": %d, \"sent\": %lld, "
+                       "\"failed\": %lld, \"shed\": %lld, "
+                       "\"p50_us\": %lld, \"p99_us\": %lld}",
+                       i == 0 ? "" : ", ",
+                       g->name.empty() ? "default" : g->name.c_str(),
+                       (double)g->sent.load() / secs, g->qps, g->priority,
+                       (long long)g->sent.load(),
+                       (long long)g->failed.load(),
+                       (long long)g->shed.load(),
+                       (long long)g->lat.latency_percentile(0.5),
+                       (long long)g->lat.latency_percentile(0.99));
+            }
+            printf("}");
+        }
+        printf("}\n");
     } else {
-        printf("sent %lld ok (%lld failed) in %.1fs: %.0f qps "
+        printf("sent %lld ok (%lld failed, %lld shed) in %.1fs: %.0f qps "
                "(target %lld, %d channels x %d callers)\n",
-               (long long)sent.load(), (long long)failed.load(), secs,
-               achieved, qps, press_threads, callers);
+               (long long)total_sent, (long long)total_failed,
+               (long long)total_shed, secs, achieved, qps, press_threads,
+               callers);
         printf("latency_us: p50 %lld  p99 %lld  p999 %lld  max %lld\n",
-               (long long)lat.latency_percentile(0.5),
-               (long long)lat.latency_percentile(0.99),
-               (long long)lat.latency_percentile(0.999),
-               (long long)lat.max_latency());
+               (long long)head->lat.latency_percentile(0.5),
+               (long long)head->lat.latency_percentile(0.99),
+               (long long)head->lat.latency_percentile(0.999),
+               (long long)head->lat.max_latency());
+        for (auto& g : gens) {
+            if (gens.size() <= 1) break;
+            printf("  tenant %-12s prio=%d target=%lld qps=%.0f "
+                   "sent=%lld failed=%lld shed=%lld p99=%lldus\n",
+                   g->name.empty() ? "default" : g->name.c_str(),
+                   g->priority, (long long)g->qps,
+                   (double)g->sent.load() / secs, (long long)g->sent.load(),
+                   (long long)g->failed.load(), (long long)g->shed.load(),
+                   (long long)g->lat.latency_percentile(0.99));
+        }
     }
     return 0;
 }

@@ -93,12 +93,14 @@ def median_rounds(args, reps=REPS):
 
 
 def device_path():
-    """Framed payloads host->HBM->host through the C++ wire path on the
-    real chip (brpc_tpu/device_path.py). Subprocess + timeout: the first
-    touch of a tunneled TPU backend can hang."""
+    """Framed payloads host->HBM->host through the pipelined DMA staging
+    ring (brpc_tpu/device_path.py, ISSUE 9): depth-4 ring, 1MB chunks,
+    serial-vs-pipelined interleaved medians. Subprocess + timeout: the
+    first touch of a tunneled TPU backend can hang."""
     try:
         proc = subprocess.run(
-            [sys.executable, "-m", "brpc_tpu.device_path", "4", "5"],
+            [sys.executable, "-m", "brpc_tpu.device_path",
+             "8", "12", "4", "1020"],
             capture_output=True, text=True, timeout=300, cwd=str(REPO),
         )
     except subprocess.TimeoutExpired:
@@ -369,7 +371,20 @@ _SKIP_KEYS = {"metric", "unit", "vs_baseline", "reps", "error",
               # QoS context counters: bronze's achieved volumes depend on
               # the flood shape and how hard it is shed, not on code
               # quality — gold qps/p99 are the compared isolation metrics.
-              "qos_bronze_shed", "qos_bronze_qps", "qos_gold_failed"}
+              "qos_bronze_shed", "qos_bronze_qps", "qos_gold_failed",
+              # Device ring (ISSUE 9): device_path_gbps is THE compared
+              # metric. device_path_mbps is the RETIRED pre-ring key —
+              # skip-keyed so the MB/s -> GB/s unit change never flags as
+              # a regression against old records; ring shape/efficiency
+              # numbers are run context (overlap_eff depends on host core
+              # availability, not code quality), and booleans are not
+              # magnitudes.
+              "device_path_mbps", "device_path_serial_gbps",
+              "device_path_overlap_eff", "device_path_ring_depth",
+              "device_path_chunk_bytes", "device_path_inflight_highwater",
+              "device_path_ok", "device_path_registered_staging",
+              "device_path_cores", "pool_desc_calls", "pool_desc_bytes",
+              "pool_desc_zero_copy"}
 
 
 def _lower_is_better(key):
@@ -503,6 +518,11 @@ def run_bench():
     tail = run_tool("echo_bench", ["--json", "--tail"], timeout=600)
     scale = run_tool("echo_bench", ["--json", "--scale", "--ici"],
                      timeout=600)
+    # One-sided descriptor round (ISSUE 9): attachments as pool
+    # references over the in-process ici link; pool_desc_mbps is the
+    # logical rate, pool_desc_zero_copy the server-side proof.
+    pool_desc = run_tool("echo_bench", ["--json", "--ici", "--pool-desc"],
+                         timeout=300)
     device = device_path()
     series = series_scrape()
     qos = qos_isolation_scrape()
@@ -528,6 +548,8 @@ def run_bench():
         out.update(tail)
     if scale is not None:
         out.update(scale)
+    if pool_desc is not None:
+        out.update(pool_desc)
     if device is not None:
         out.update(device)
     if series is not None:

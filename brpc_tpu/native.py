@@ -40,10 +40,36 @@ def lib() -> ctypes.CDLL:
         L.tpurpc_block_free.argtypes = [ctypes.c_void_p]
         L.tpurpc_block_is_registered.restype = ctypes.c_int
         L.tpurpc_block_is_registered.argtypes = [ctypes.c_void_p]
+        L.tpurpc_slab_allocated.restype = ctypes.c_long
+        L.tpurpc_slab_recycled.restype = ctypes.c_long
+        L.tpurpc_pool_id.restype = ctypes.c_uint64
+        L.tpurpc_ring_create.restype = ctypes.c_void_p
+        L.tpurpc_ring_create.argtypes = [ctypes.c_uint32, ctypes.c_size_t]
+        L.tpurpc_ring_destroy.argtypes = [ctypes.c_void_p]
+        L.tpurpc_ring_acquire.restype = ctypes.c_int
+        L.tpurpc_ring_acquire.argtypes = [ctypes.c_void_p, ctypes.c_long]
+        L.tpurpc_ring_complete.restype = ctypes.c_int
+        L.tpurpc_ring_complete.argtypes = [ctypes.c_void_p, ctypes.c_uint32]
+        L.tpurpc_ring_slot.restype = ctypes.c_void_p
+        L.tpurpc_ring_slot.argtypes = [ctypes.c_void_p, ctypes.c_uint32]
+        L.tpurpc_ring_slot_bytes.restype = ctypes.c_size_t
+        L.tpurpc_ring_slot_bytes.argtypes = [ctypes.c_void_p]
+        L.tpurpc_ring_depth.restype = ctypes.c_uint32
+        L.tpurpc_ring_depth.argtypes = [ctypes.c_void_p]
+        L.tpurpc_ring_registered.restype = ctypes.c_int
+        L.tpurpc_ring_registered.argtypes = [ctypes.c_void_p]
+        L.tpurpc_ring_inflight_highwater.restype = ctypes.c_uint64
+        L.tpurpc_ring_inflight_highwater.argtypes = [ctypes.c_void_p]
         L.tpurpc_frame.restype = ctypes.c_long
         L.tpurpc_frame.argtypes = [ctypes.c_uint64, ctypes.c_void_p,
                                    ctypes.c_size_t, ctypes.c_void_p,
                                    ctypes.c_size_t]
+        L.tpurpc_frame_in_place.restype = ctypes.c_long
+        L.tpurpc_frame_in_place.argtypes = [
+            ctypes.c_uint64, ctypes.c_void_p, ctypes.c_size_t,
+            ctypes.c_size_t, ctypes.POINTER(ctypes.c_size_t),
+            ctypes.POINTER(ctypes.c_uint32),
+        ]
         L.tpurpc_unframe.restype = ctypes.c_long
         L.tpurpc_unframe.argtypes = [
             ctypes.c_void_p, ctypes.c_size_t,
@@ -87,11 +113,86 @@ class PoolBuffer:
             self.array = None
 
 
+def pool_id() -> int:
+    """Descriptor identity of this process's shared pool (0 = none)."""
+    return int(lib().tpurpc_pool_id())
+
+
+def slab_counters() -> tuple[int, int]:
+    """(live slab slots, recycled-allocation count) — the zero-copy /
+    recycle evidence the device-ring tests assert on."""
+    L = lib()
+    return int(L.tpurpc_slab_allocated()), int(L.tpurpc_slab_recycled())
+
+
+class DeviceStagingRing:
+    """Depth-N ring of registered staging slots (C++ DeviceStagingRing):
+    the pipelined device path stages chunk i+1 while chunk i computes
+    and chunk i-1 drains. acquire() hands slots out in FIFO order and
+    blocks while all are in flight; complete() releases them."""
+
+    def __init__(self, depth: int, slot_bytes: int):
+        self._ptr = lib().tpurpc_ring_create(depth, slot_bytes)
+        if not self._ptr:
+            raise MemoryError(
+                f"ring create ({depth} x {slot_bytes}B) failed")
+        self.depth = int(lib().tpurpc_ring_depth(self._ptr))
+        self.slot_bytes = int(lib().tpurpc_ring_slot_bytes(self._ptr))
+        self.registered = bool(lib().tpurpc_ring_registered(self._ptr))
+        self.slots = []
+        for i in range(self.depth):
+            p = lib().tpurpc_ring_slot(self._ptr, i)
+            self.slots.append(np.ctypeslib.as_array(
+                ctypes.cast(p, ctypes.POINTER(ctypes.c_uint8)),
+                shape=(self.slot_bytes,)))
+
+    def acquire(self, timeout_us: int = -1) -> int:
+        slot = int(lib().tpurpc_ring_acquire(self._ptr, timeout_us))
+        if slot < 0:
+            raise TimeoutError("ring acquire timed out")
+        return slot
+
+    def complete(self, slot: int) -> None:
+        if lib().tpurpc_ring_complete(self._ptr, slot) != 0:
+            raise ValueError(f"slot {slot} not in flight")
+
+    @property
+    def inflight_highwater(self) -> int:
+        return int(lib().tpurpc_ring_inflight_highwater(self._ptr))
+
+    def close(self) -> None:
+        if self._ptr:
+            lib().tpurpc_ring_destroy(self._ptr)
+            self._ptr = None
+            self.slots = []
+
+
+def _within(buf: np.ndarray, payload: np.ndarray) -> bool:
+    b0 = buf.ctypes.data
+    p0 = payload.ctypes.data
+    return b0 <= p0 and p0 + payload.nbytes <= b0 + buf.nbytes
+
+
 def frame(correlation_id: int, payload: np.ndarray,
           out: np.ndarray | None = None) -> np.ndarray:
     """tpu_std-frame `payload` (any contiguous array) via the C++
-    framework; returns a uint8 view of the frame (in `out` if given)."""
+    framework; returns a uint8 view of the frame (in `out` if given).
+
+    Fast path (ISSUE 9 satellite): when `payload` is itself a view INTO
+    `out` (already staged inside the destination pool buffer, at offset
+    >= 64), the payload bytes are NOT copied — the header+meta is
+    written in place right before them and the returned frame view ends
+    exactly at the payload's end."""
     pay = np.ascontiguousarray(payload).view(np.uint8).reshape(-1)
+    if out is not None and _within(out, pay):
+        off = pay.ctypes.data - out.ctypes.data
+        if off >= IN_PLACE_HEADROOM:
+            frame_off, n, _ = frame_in_place(correlation_id, out, off,
+                                             pay.nbytes)
+            return out[frame_off:frame_off + n]
+        # Payload sits too close to the buffer start for an in-place
+        # header: fall through to the copy path (tpurpc_frame memmoves
+        # overlapping sources safely).
     cap = pay.nbytes + 1024
     if out is None:
         out = np.empty(cap, dtype=np.uint8)
@@ -103,6 +204,29 @@ def frame(correlation_id: int, payload: np.ndarray,
     if n < 0:
         raise ValueError("tpurpc_frame failed")
     return out[:n]
+
+
+# Staging offset leaving room for header+meta of any in-place frame
+# (12-byte header + ~30B meta pb, rounded way up).
+IN_PLACE_HEADROOM = 64
+
+
+def frame_in_place(correlation_id: int, buf: np.ndarray, payload_off: int,
+                   payload_len: int) -> tuple[int, int, int]:
+    """Frame a payload that already resides at buf[payload_off:...]:
+    writes header+meta right-justified before it (no payload memcpy).
+    Returns (frame_off, frame_len, payload_crc32c) — the crc is the one
+    embedded in the frame meta, handed back so the caller can verify
+    round-tripped payload bytes without re-parsing."""
+    b = buf.view(np.uint8).reshape(-1)
+    frame_off = ctypes.c_size_t()
+    crc = ctypes.c_uint32()
+    n = lib().tpurpc_frame_in_place(
+        correlation_id, b.ctypes.data_as(ctypes.c_void_p), payload_off,
+        payload_len, ctypes.byref(frame_off), ctypes.byref(crc))
+    if n < 0:
+        raise ValueError("tpurpc_frame_in_place failed (headroom < meta)")
+    return int(frame_off.value), int(n), int(crc.value)
 
 
 def unframe(buf: np.ndarray) -> tuple[int, np.ndarray, int]:

@@ -19,6 +19,7 @@
 #include <string>
 
 #include "bench_echo.pb.h"
+#include "tbase/crc32c.h"
 #include "tbase/endpoint.h"
 #include "tici/block_pool.h"
 #include "tici/shm_link.h"
@@ -146,6 +147,54 @@ TEST(ShmXproc, EchoAcrossProcesses) {
     for (size_t i = 0; i < big.size(); i += 4096) big[i] = (char)('a' + (i / 4096) % 26);
     ASSERT_EQ(0, DoEcho(ch, big, &echoed));
     EXPECT_TRUE(echoed == big);
+    child.Shutdown();
+}
+
+TEST(ShmXproc, PoolDescriptorHandoffIsZeroCopy) {
+    // One-sided descriptor across REAL process boundaries (ISSUE 9b):
+    // the attachment bytes stay in OUR pool; the server resolves the
+    // (pool_id, offset, len, crc) meta against its handshake-time
+    // mapping of that pool and answers with the crc it computed from
+    // the in-place view — 'inline=0' in the verdict proves no payload
+    // bytes crossed the wire beside the descriptor.
+    ASSERT_EQ(0, IciBlockPool::Init());
+    ServerChild child;
+    ASSERT_TRUE(child.Spawn());
+    EndPoint ep;
+    str2endpoint("127.0.0.1", child.port, &ep);
+    Channel ch;
+    ChannelOptions copts;
+    copts.timeout_ms = 3000;
+    ASSERT_EQ(0, ch.InitIci(ep, &copts));
+    benchpb::EchoService_Stub stub(&ch);
+
+    const size_t kBytes = 200000;
+    const size_t live0 = IciBlockPool::slab_allocated();
+    for (int round = 0; round < 3; ++round) {
+        IOBuf att;
+        char* data = nullptr;
+        ASSERT_TRUE(
+            IciBlockPool::AllocatePoolAttachment(kBytes, &att, &data));
+        for (size_t i = 0; i < kBytes; ++i) {
+            data[i] = (char)((i * 131 + round) >> 2);
+        }
+        const uint32_t crc = crc32c_extend(0, data, kBytes);
+        Controller cntl;
+        cntl.set_timeout_ms(3000);
+        cntl.set_request_pool_attachment(std::move(att));
+        ASSERT_TRUE(cntl.has_request_pool_attachment());
+        benchpb::EchoRequest req;
+        benchpb::EchoResponse res;
+        req.set_send_ts_us(round);
+        stub.Echo(&cntl, &req, &res, nullptr);
+        ASSERT_FALSE(cntl.Failed());
+        char expect[96];
+        snprintf(expect, sizeof(expect), "crc32c=%08x len=%zu inline=0",
+                 crc, kBytes);
+        EXPECT_EQ(std::string(expect), res.payload());
+    }
+    // Completion returned every pinned block to this pool's slab class.
+    EXPECT_EQ(live0, IciBlockPool::slab_allocated());
     child.Shutdown();
 }
 

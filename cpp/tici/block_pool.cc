@@ -6,9 +6,12 @@
 #include <unistd.h>
 
 #include <atomic>
+#include <chrono>
+#include <condition_variable>
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
+#include <map>
 #include <mutex>
 #include <vector>
 
@@ -306,6 +309,403 @@ bool IciBlockPool::OffsetOf(const void* ptr, uint64_t* offset) {
     return true;
 }
 
+// ---------------- slab-class registered allocator (ISSUE 9c) ----------------
+
+namespace {
+
+// Size classes: 8K covers descriptor/meta staging, 1M the default device
+// chunk, 4M jumbo chunks. Arena size is chosen so one carve amortizes
+// ~16 slots of the class (one central-mutex touch per 16 allocations
+// even with a cold cache).
+constexpr size_t kSlabClassBytes[] = {8u << 10, 64u << 10, 256u << 10,
+                                      1u << 20, 4u << 20};
+constexpr int kSlabClasses =
+    (int)(sizeof(kSlabClassBytes) / sizeof(kSlabClassBytes[0]));
+constexpr int kTlsSlotsPerClass = 8;
+
+// One registered arena, chopped into slots of a single class. The arena
+// table is append-only and scanned lock-free (count published with
+// release/acquire) — FreeSlab derives the class of a pointer from it on
+// every TLS-cache overflow without touching any mutex.
+struct SlabArena {
+    char* base;
+    size_t size;
+    int cls;
+};
+SlabArena g_arenas[256];
+std::atomic<uint32_t> g_arena_count{0};
+// Serializes appends only (two CLASSES can grow arenas concurrently
+// under their own class mutexes); readers stay lock-free.
+std::mutex g_arena_append_mu;
+
+// Per-class central state: freelist + carve cursor, each class behind
+// its OWN mutex so concurrent traffic in different classes never
+// serializes (and same-class traffic mostly stays in the TLS cache).
+struct SlabClass {
+    std::mutex mu;
+    std::vector<void*> freelist;
+    char* carve_base = nullptr;
+    size_t carve_off = 0;
+    size_t carve_size = 0;
+};
+SlabClass& slab_class(int cls) {
+    static SlabClass* classes = new SlabClass[kSlabClasses];
+    return classes[cls];
+}
+
+std::atomic<size_t> g_slab_live{0};
+std::atomic<size_t> g_slab_recycled{0};
+std::atomic<size_t> g_slab_mutex_acquisitions{0};
+
+int slab_class_of(size_t n) {
+    for (int c = 0; c < kSlabClasses; ++c) {
+        if (n <= kSlabClassBytes[c]) return c;
+    }
+    return -1;
+}
+
+int arena_class_of(const void* p) {
+    const uint32_t count = g_arena_count.load(std::memory_order_acquire);
+    const char* c = (const char*)p;
+    for (uint32_t i = 0; i < count; ++i) {
+        if (c >= g_arenas[i].base && c < g_arenas[i].base + g_arenas[i].size) {
+            return g_arenas[i].cls;
+        }
+    }
+    return -1;
+}
+
+// Per-thread slot cache. On thread exit the destructor drains every
+// cached slot back to its class freelist so no registered memory is
+// stranded in dead threads.
+struct TlsSlabCache {
+    void* slots[kSlabClasses][kTlsSlotsPerClass];
+    int n[kSlabClasses] = {};
+
+    ~TlsSlabCache() {
+        for (int c = 0; c < kSlabClasses; ++c) {
+            if (n[c] == 0) continue;
+            SlabClass& sc = slab_class(c);
+            g_slab_mutex_acquisitions.fetch_add(1,
+                                                std::memory_order_relaxed);
+            std::lock_guard<std::mutex> g(sc.mu);
+            for (int i = 0; i < n[c]; ++i) sc.freelist.push_back(slots[c][i]);
+            n[c] = 0;
+        }
+    }
+};
+thread_local TlsSlabCache g_tls_slabs;
+
+}  // namespace
+
+int IciBlockPool::SlabClassOf(size_t n) { return slab_class_of(n); }
+size_t IciBlockPool::slab_class_bytes(int cls) {
+    return cls >= 0 && cls < kSlabClasses ? kSlabClassBytes[cls] : 0;
+}
+size_t IciBlockPool::slab_allocated() {
+    return g_slab_live.load(std::memory_order_relaxed);
+}
+size_t IciBlockPool::slab_recycled() {
+    return g_slab_recycled.load(std::memory_order_relaxed);
+}
+size_t IciBlockPool::slab_mutex_acquisitions() {
+    return g_slab_mutex_acquisitions.load(std::memory_order_relaxed);
+}
+
+void* IciBlockPool::AllocateSlab(size_t n) {
+    const int cls = slab_class_of(n);
+    if (cls < 0) {
+        // Above the largest class: one-off registered carve (no recycle).
+        return AllocateRegistered(n);
+    }
+    // 1. TLS cache: the steady-state path, no locks at all.
+    TlsSlabCache& tls = g_tls_slabs;
+    if (tls.n[cls] > 0) {
+        void* p = tls.slots[cls][--tls.n[cls]];
+        g_slab_live.fetch_add(1, std::memory_order_relaxed);
+        g_slab_recycled.fetch_add(1, std::memory_order_relaxed);
+        return p;
+    }
+    // 2. Class freelist / arena carve under the CLASS mutex.
+    SlabClass& sc = slab_class(cls);
+    g_slab_mutex_acquisitions.fetch_add(1, std::memory_order_relaxed);
+    std::lock_guard<std::mutex> g(sc.mu);
+    if (!sc.freelist.empty()) {
+        void* p = sc.freelist.back();
+        sc.freelist.pop_back();
+        g_slab_live.fetch_add(1, std::memory_order_relaxed);
+        g_slab_recycled.fetch_add(1, std::memory_order_relaxed);
+        return p;
+    }
+    const size_t slot = kSlabClassBytes[cls];
+    if (sc.carve_base == nullptr || sc.carve_off + slot > sc.carve_size) {
+        // New arena: a large aligned registered slab (~16 slots, min 1
+        // region-friendly chunk) carved from the pool's regions, then
+        // published append-only for lock-free class lookup.
+        const size_t arena_bytes = slot * 16;
+        char* base = (char*)AllocateRegistered(arena_bytes);
+        if (base == nullptr) return nullptr;
+        {
+            std::lock_guard<std::mutex> ag(g_arena_append_mu);
+            const uint32_t idx =
+                g_arena_count.load(std::memory_order_relaxed);
+            if (idx < sizeof(g_arenas) / sizeof(g_arenas[0])) {
+                g_arenas[idx] = SlabArena{base, arena_bytes, cls};
+                g_arena_count.store(idx + 1, std::memory_order_release);
+            } else {
+                // Lookup table full: still carve from this arena (the
+                // memory is valid registered pool) — its slots just
+                // won't recycle (FreeSlab can't classify them), which
+                // beats leaking a full arena per cache miss forever.
+                LOG_EVERY_N(ERROR, 1000)
+                    << "IciBlockPool: slab arena table full; class "
+                    << cls << " slots from this arena will not recycle";
+            }
+        }
+        sc.carve_base = base;
+        sc.carve_off = 0;
+        sc.carve_size = arena_bytes;
+    }
+    void* p = sc.carve_base + sc.carve_off;
+    sc.carve_off += slot;
+    g_slab_live.fetch_add(1, std::memory_order_relaxed);
+    return p;
+}
+
+void IciBlockPool::FreeSlab(void* p) {
+    if (p == nullptr) return;
+    const int cls = arena_class_of(p);
+    if (cls < 0) return;  // oversized/non-slab carve: process lifetime
+    g_slab_live.fetch_sub(1, std::memory_order_relaxed);
+    TlsSlabCache& tls = g_tls_slabs;
+    if (tls.n[cls] < kTlsSlotsPerClass) {
+        tls.slots[cls][tls.n[cls]++] = p;
+        return;
+    }
+    SlabClass& sc = slab_class(cls);
+    g_slab_mutex_acquisitions.fetch_add(1, std::memory_order_relaxed);
+    std::lock_guard<std::mutex> g(sc.mu);
+    sc.freelist.push_back(p);
+}
+
+bool IciBlockPool::AllocatePoolAttachment(size_t n, IOBuf* out,
+                                          char** data) {
+    const size_t total = n + offsetof(IOBuf::Block, data);
+    const int cls = slab_class_of(total);
+    if (cls < 0) return false;
+    void* mem = AllocateSlab(total);
+    if (mem == nullptr) return false;
+    uint64_t off = 0;
+    if (!OffsetOf(mem, &off)) {
+        // Slab arena landed in an overflow (non-shared) region: not
+        // descriptor-eligible. Recycle and let the caller fall back.
+        FreeSlab(mem);
+        return false;
+    }
+    auto* b = new (mem) IOBuf::Block;
+    b->nshared.store(1, std::memory_order_relaxed);
+    b->size = (uint32_t)n;
+    b->cap = (uint32_t)(kSlabClassBytes[cls] -
+                        offsetof(IOBuf::Block, data));
+    b->portal_next = nullptr;
+    // Custom deallocator: the last dec_ref recycles the slot into its
+    // slab class (never the TLS block cache — dealloc differs from the
+    // installed pair, so dec_ref frees directly through it).
+    b->dealloc = &IciBlockPool::FreeSlab;
+    IOBuf::BlockRef ref;
+    ref.offset = 0;
+    ref.length = (uint32_t)n;
+    ref.block = b;
+    out->clear();
+    // append_ref takes its own reference; drop ours so the IOBuf holds
+    // the only one and its release recycles the slot.
+    out->append_ref(ref);
+    b->dec_ref();
+    *data = b->data;
+    return true;
+}
+
+// ---------------- pool registry (ISSUE 9b) ----------------
+
+namespace pool_registry {
+
+namespace {
+struct Mapping {
+    const char* base;
+    size_t size;
+};
+// Immortal (same teardown-order rationale as the shm_link peer-pool
+// registry: resolution can run from Socket recycling during exit).
+std::mutex& reg_mu() {
+    static std::mutex* mu = new std::mutex;
+    return *mu;
+}
+std::map<uint64_t, Mapping>& reg() {
+    static auto* m = new std::map<uint64_t, Mapping>;
+    return *m;
+}
+std::atomic<uint64_t> g_resolves{0};
+std::atomic<uint64_t> g_resolve_failures{0};
+}  // namespace
+
+uint64_t IdFromName(const char* name) {
+    uint64_t h = 1469598103934665603ull;  // FNV-1a 64
+    for (const char* c = name; *c != '\0'; ++c) {
+        h ^= (uint64_t)(unsigned char)*c;
+        h *= 1099511628211ull;
+    }
+    return h != 0 ? h : 1;  // 0 is reserved for "no pool"
+}
+
+void Register(uint64_t id, const char* base, size_t size) {
+    if (id == 0 || base == nullptr) return;
+    std::lock_guard<std::mutex> g(reg_mu());
+    reg()[id] = Mapping{base, size};
+}
+
+void Unregister(uint64_t id) {
+    std::lock_guard<std::mutex> g(reg_mu());
+    reg().erase(id);
+}
+
+bool Resolve(uint64_t id, const char** base, size_t* size) {
+    std::lock_guard<std::mutex> g(reg_mu());
+    auto it = reg().find(id);
+    if (it == reg().end()) {
+        g_resolve_failures.fetch_add(1, std::memory_order_relaxed);
+        return false;
+    }
+    g_resolves.fetch_add(1, std::memory_order_relaxed);
+    *base = it->second.base;
+    *size = it->second.size;
+    return true;
+}
+
+uint64_t resolves() { return g_resolves.load(std::memory_order_relaxed); }
+uint64_t resolve_failures() {
+    return g_resolve_failures.load(std::memory_order_relaxed);
+}
+
+}  // namespace pool_registry
+
+uint64_t IciBlockPool::pool_id() {
+    PoolState& p = pool();
+    if (p.shm_name[0] == '\0') return 0;
+    return pool_registry::IdFromName(p.shm_name);
+}
+
+// ---------------- device staging ring (ISSUE 9a) ----------------
+
+namespace {
+struct RingSync {
+    std::mutex mu;
+    std::condition_variable cv;
+};
+}  // namespace
+
+DeviceStagingRing* DeviceStagingRing::Create(uint32_t depth,
+                                             size_t slot_bytes) {
+    if (depth == 0 || depth > 1024 || slot_bytes == 0) return nullptr;
+    auto* r = new DeviceStagingRing;
+    r->depth_ = depth;
+    r->slot_bytes_ = slot_bytes;
+    r->slots_ = new char*[depth];
+    r->slot_kind_ = new uint8_t[depth]();
+    r->done_ = new bool[depth]();
+    r->mu_ = new RingSync;
+    r->registered_ = true;
+    const bool slab_sized = IciBlockPool::SlabClassOf(slot_bytes) >= 0;
+    for (uint32_t i = 0; i < depth; ++i) {
+        char* s = (char*)IciBlockPool::AllocateSlab(slot_bytes);
+        uint8_t kind = slab_sized ? 0 : 2;  // slab vs carve-only chunk
+        if (s == nullptr) {
+            // Pool dry/uninitialized: plain aligned memory keeps the ring
+            // usable (the benchmark reports registered=false honestly).
+            s = (char*)aligned_alloc(4096, (slot_bytes + 4095) & ~4095ul);
+            kind = 1;
+        }
+        if (s == nullptr) {
+            r->depth_ = i;  // free only what was built
+            delete r;
+            return nullptr;
+        }
+        r->slots_[i] = s;
+        r->slot_kind_[i] = kind;
+        r->registered_ = r->registered_ && IciBlockPool::Contains(s);
+    }
+    return r;
+}
+
+DeviceStagingRing::~DeviceStagingRing() {
+    for (uint32_t i = 0; i < depth_; ++i) {
+        switch (slot_kind_[i]) {
+            case 0:
+                IciBlockPool::FreeSlab(slots_[i]);
+                break;
+            case 1:
+                free(slots_[i]);
+                break;
+            default:
+                break;  // carve-only registered chunk: process lifetime
+        }
+    }
+    delete[] slots_;
+    delete[] slot_kind_;
+    delete[] done_;
+    delete (RingSync*)mu_;
+}
+
+int DeviceStagingRing::Acquire(int64_t timeout_us) {
+    RingSync* sync = (RingSync*)mu_;
+    std::unique_lock<std::mutex> lk(sync->mu);
+    const auto window_free = [this] {
+        return head_.load(std::memory_order_relaxed) -
+                   tail_.load(std::memory_order_relaxed) <
+               depth_;
+    };
+    if (timeout_us < 0) {
+        sync->cv.wait(lk, window_free);
+    } else if (!sync->cv.wait_for(lk, std::chrono::microseconds(timeout_us),
+                                  window_free)) {
+        return -1;
+    }
+    const uint64_t seq = head_.fetch_add(1, std::memory_order_relaxed);
+    const uint32_t inflight =
+        (uint32_t)(seq + 1 - tail_.load(std::memory_order_relaxed));
+    if (inflight > highwater_.load(std::memory_order_relaxed)) {
+        highwater_.store(inflight, std::memory_order_relaxed);
+    }
+    return (int)(seq % depth_);
+}
+
+int DeviceStagingRing::Complete(uint32_t slot) {
+    RingSync* sync = (RingSync*)mu_;
+    std::lock_guard<std::mutex> lk(sync->mu);
+    const uint64_t head = head_.load(std::memory_order_relaxed);
+    uint64_t tail = tail_.load(std::memory_order_relaxed);
+    // `slot` must name an in-flight acquire: within [tail, head) and not
+    // already marked done.
+    bool inflight = false;
+    for (uint64_t i = tail; i < head; ++i) {
+        if ((uint32_t)(i % depth_) == slot) {
+            inflight = !done_[slot];
+            break;
+        }
+    }
+    if (!inflight) return -1;
+    done_[slot] = true;
+    completed_.fetch_add(1, std::memory_order_relaxed);
+    // FIFO reuse: advance the reusable frontier only over a contiguous
+    // prefix of completed slots (out-of-order completes wait here).
+    while (tail < head && done_[tail % depth_]) {
+        done_[tail % depth_] = false;
+        ++tail;
+    }
+    tail_.store(tail, std::memory_order_relaxed);
+    sync->cv.notify_all();
+    return 0;
+}
+
 int IciBlockPool::Init(size_t region_bytes) {
     PoolState& p = pool();
     bool expected = false;
@@ -325,6 +725,13 @@ int IciBlockPool::Init(size_t region_bytes) {
             p.inited.store(false);
             return -1;
         }
+    }
+    // Publish our own pool under its descriptor id: in-process loopback
+    // links (and any handler resolving a descriptor we posted to
+    // ourselves) resolve against the same registry peers use.
+    if (pool().shm_name[0] != '\0') {
+        pool_registry::Register(pool_registry::IdFromName(pool().shm_name),
+                                pool().shm_base, pool().shm_size);
     }
     // From here on every new IOBuf block is transferable memory (the
     // TLS block cache only recycles blocks whose deallocator matches the

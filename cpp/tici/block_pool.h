@@ -17,6 +17,7 @@
 // Contains() via the region list.
 #pragma once
 
+#include <atomic>
 #include <cstddef>
 #include <cstdint>
 
@@ -54,6 +55,40 @@ public:
     // overflow).
     static bool Contains(const void* p);
 
+    // ---- slab-class registered allocator (ISSUE 9c) ----
+    // Recyclable registered memory in size classes (8K/64K/256K/1M/4M).
+    // Each class carves large aligned slab ARENAS out of the registered
+    // regions and chops them into fixed slots; freed slots recycle
+    // through a per-thread slot cache in front of a per-class freelist
+    // (its own mutex), so descriptor/staging traffic never bounces on
+    // the pool's central mutex. Requests above the largest class fall
+    // back to AllocateRegistered (carve-only, process lifetime).
+    static void* AllocateSlab(size_t n);
+    // Recycles p into its class (TLS cache first). p MUST come from
+    // AllocateSlab; non-slab pool pointers are ignored (carve-only).
+    static void FreeSlab(void* p);
+    // Class index serving n bytes, or -1 when n exceeds the largest
+    // class (tests + sizing diagnostics).
+    static int SlabClassOf(size_t n);
+    static size_t slab_class_bytes(int cls);
+    // Counters: live slots, frees that found a cache/freelist home, and
+    // class-mutex acquisitions (the contention diagnostic the per-thread
+    // cache is meant to keep near zero on steady-state traffic).
+    static size_t slab_allocated();
+    static size_t slab_recycled();
+    static size_t slab_mutex_acquisitions();
+
+    // Build a single-block IOBuf of n writable bytes inside the SHARED
+    // registered pool — the eligible shape for one-sided descriptors
+    // (Controller::set_request_pool_attachment): one contiguous ref a
+    // single (offset, len) can name. The block wraps a slab slot
+    // (placement-new IOBuf::Block header, FreeSlab deallocator), so the
+    // last release recycles the slot into its class. Returns false when
+    // n exceeds the largest slab class or the slab landed outside the
+    // shared primary (caller falls back to inline attachment bytes).
+    static bool AllocatePoolAttachment(size_t n, class IOBuf* out,
+                                       char** data);
+
     // ---- cross-process registration (the shared primary region) ----
     // Name of the shm segment backing the primary region ("" when the
     // pool fell back to anonymous memory). Peers shm_open this name
@@ -65,9 +100,100 @@ public:
     // i.e. the bytes at p can be posted to a peer zero-copy.
     static bool OffsetOf(const void* p, uint64_t* offset);
 
+    // Stable identity of this process's shared primary region (FNV-1a of
+    // the shm name; 0 when the pool is anonymous/process-local). The
+    // pool_id of one-sided descriptors posted from this pool.
+    static uint64_t pool_id();
+
     static bool initialized();
     static size_t allocated_blocks();  // live default-size blocks
     static size_t free_blocks();       // freelist depth
+};
+
+// ---- pool registry (one-sided descriptors, ISSUE 9b) ----
+// Maps pool_id -> a mapping of that pool in THIS process's address
+// space: the local pool (registered at IciBlockPool::Init) and every
+// peer pool mapped during an ICI handshake (shm_link AcquirePeerPool).
+// A receiver resolves a wire (pool_id, offset, len) descriptor here and
+// reads the bytes in place — the one-sided read of the transfer.
+namespace pool_registry {
+uint64_t IdFromName(const char* name);  // FNV-1a 64 over the shm name
+void Register(uint64_t id, const char* base, size_t size);
+void Unregister(uint64_t id);
+// True + the mapped span when id is known. The span stays valid while
+// the mapping is held (local pool: process lifetime; peer pools: while
+// any link to that peer lives — the Socket holding the descriptor's
+// connection holds the link, so resolution during request processing is
+// safe).
+bool Resolve(uint64_t id, const char** base, size_t* size);
+// Resolution stats (tests + /vars).
+uint64_t resolves();
+uint64_t resolve_failures();
+}  // namespace pool_registry
+
+// ---- device staging ring (ISSUE 9a) ----
+// A depth-N ring of registered staging slots driving the pipelined
+// device data path: slot i holds chunk i's framed bytes while H2D of
+// chunk i+1, the on-device integrity kernel on chunk i, and D2H of
+// chunk i-1 overlap. Slots are handed out in strict FIFO order
+// (Acquire blocks while the oldest slot is still in flight) and become
+// reusable only when every predecessor has completed — the same
+// released_-counter protocol as the shm/ici descriptor rings, which is
+// what makes out-of-order Complete() calls safe under many threads.
+//
+// Thread contract: plain std::mutex/condvar (NOT fibers) — the ring is
+// driven from Python threads through the C ABI.
+class DeviceStagingRing {
+public:
+    // Slots come from AllocateSlab: registered memory, recycled on
+    // destroy. Returns null when depth/slot_bytes is zero or the pool
+    // has no memory.
+    static DeviceStagingRing* Create(uint32_t depth, size_t slot_bytes);
+    ~DeviceStagingRing();
+
+    // Next slot in FIFO order; blocks up to timeout_us (<0 = forever)
+    // while all depth slots are in flight. Returns the slot index or -1
+    // on timeout.
+    int Acquire(int64_t timeout_us);
+    // Mark slot done. Out-of-order completes are held; the slot is
+    // reusable once all earlier acquires completed. Returns 0, or -1
+    // for an index that is not currently in flight.
+    int Complete(uint32_t slot);
+
+    char* slot(uint32_t i) { return slots_[i % depth_]; }
+    uint32_t depth() const { return depth_; }
+    size_t slot_bytes() const { return slot_bytes_; }
+    bool registered() const { return registered_; }
+    uint64_t acquires() const {
+        return head_.load(std::memory_order_relaxed);
+    }
+    uint64_t completes() const {
+        return completed_.load(std::memory_order_relaxed);
+    }
+    // Highest number of slots ever simultaneously in flight (ordering
+    // tests: never exceeds depth).
+    uint32_t inflight_highwater() const {
+        return highwater_.load(std::memory_order_relaxed);
+    }
+
+private:
+    DeviceStagingRing() = default;
+
+    void* mu_ = nullptr;  // std::mutex + condvar behind an opaque ptr
+    char** slots_ = nullptr;
+    // How each slot was obtained (0 = slab class / recyclable, 1 =
+    // malloc fallback, 2 = carve-only registered chunk): ~Ring must
+    // route each pointer back to the right deallocator.
+    uint8_t* slot_kind_ = nullptr;
+    bool* done_ = nullptr;
+    uint32_t depth_ = 0;
+    size_t slot_bytes_ = 0;
+    bool registered_ = false;
+    // Counters mutate under mu_ but are read lock-free by the accessors.
+    std::atomic<uint64_t> head_{0};       // acquired count
+    std::atomic<uint64_t> tail_{0};       // contiguously-completed count
+    std::atomic<uint64_t> completed_{0};  // total completes
+    std::atomic<uint32_t> highwater_{0};
 };
 
 }  // namespace tpurpc

@@ -102,6 +102,17 @@ int AcquirePeerPool(const char* name, size_t size, PeerPool* out) {
     close(fd);
     if (mem == MAP_FAILED) return -1;
     pools[name] = PeerPoolEntry{(char*)mem, size, 1};
+    // One-sided descriptors (ISSUE 9): mapping a peer pool IS the
+    // memory registration descriptors resolve against — publish it
+    // under the peer's pool id so a (pool_id, offset, len) meta field
+    // from this peer reads in place. Our OWN pool (an in-process
+    // loopback handshake maps it too) keeps its Init-time registration:
+    // overwriting it with this transient mapping would let a later
+    // link teardown unregister the local pool for good.
+    const uint64_t id = pool_registry::IdFromName(name);
+    if (id != IciBlockPool::pool_id()) {
+        pool_registry::Register(id, (char*)mem, size);
+    }
     out->base = (char*)mem;
     out->size = size;
     return 0;
@@ -113,6 +124,10 @@ void ReleasePeerPool(const char* name) {
     auto it = pools.find(name);
     if (it == pools.end()) return;
     if (--it->second.refs == 0) {
+        const uint64_t id = pool_registry::IdFromName(name);
+        if (id != IciBlockPool::pool_id()) {
+            pool_registry::Unregister(id);
+        }
         munmap(it->second.base, it->second.size);
         pools.erase(it);
     }
@@ -675,6 +690,15 @@ int IciConnect(const EndPoint& server, InputMessenger* messenger,
                       "leaking endpoint";
         return -1;
     }
+    {
+        // Descriptor scope: responses/requests on this connection may
+        // reference exactly the server pool the handshake mapped.
+        SocketUniquePtr created;
+        if (Socket::AddressSocket(*id, &created) == 0) {
+            created->set_peer_pool_id(
+                pool_registry::IdFromName(rsp.pool_name));
+        }
+    }
     return 0;
 }
 
@@ -789,6 +813,9 @@ void ProcessIciHandshake(InputMessageBase* msg_base) {
         s->fd(), ctrl_mem, sizeof(ShmLinkCtrl), /*is_client=*/false,
         req.pool_name, pp, s->remote_side());
     s->InstallTransport(ep);
+    // Descriptor scope: this connection may reference exactly the pool
+    // its handshake mapped.
+    s->set_peer_pool_id(pool_registry::IdFromName(req.pool_name));
     snprintf(rsp.pool_name, sizeof(rsp.pool_name), "%s",
              IciBlockPool::shm_name());
     rsp.pool_size = IciBlockPool::shm_size();

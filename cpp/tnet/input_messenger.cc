@@ -54,6 +54,11 @@ LazyAdder* handler_adder() {
     static auto* a = new LazyAdder("rpc_server_inline_handlers");
     return a;
 }
+LazyAdder* desc_exempt_adder() {
+    static auto* a =
+        new LazyAdder("rpc_dispatcher_descriptor_exempt_bytes");
+    return a;
+}
 
 void ResetOnPark() {
     g_budget = 0;
@@ -113,6 +118,12 @@ int64_t dispatches() { return (**dispatches_adder()).get_value(); }
 int64_t overflows() { return (**overflows_adder()).get_value(); }
 int64_t handler_inlines() { return (**handler_adder()).get_value(); }
 void CountHandlerInline() { **handler_adder() << 1; }
+void ExemptDescriptorBytes(size_t nbytes) {
+    **desc_exempt_adder() << (int64_t)nbytes;
+}
+int64_t descriptor_exempt_bytes() {
+    return (**desc_exempt_adder()).get_value();
+}
 
 }  // namespace inline_dispatch
 

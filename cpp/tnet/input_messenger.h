@@ -43,6 +43,15 @@ int64_t dispatches();        // messages processed run-to-completion
 int64_t overflows();         // inline-eligible messages past the budget
 int64_t handler_inlines();   // server handlers run on the input fiber
 void CountHandlerInline();   // called by the RPC layer's inline path
+// One-sided descriptor exemption (ISSUE 9): a pool-descriptor message's
+// LOGICAL payload (the referenced pool bytes) is exempt from the inline
+// byte budget — only its wire bytes (header + meta) were charged by
+// Acquire, because the referenced bytes never pass through the message
+// path (they are mapped in place, not copied). Called by the RPC layer
+// when it resolves a descriptor, so /loops can show how many logical
+// bytes rode the run-to-completion path budget-free.
+void ExemptDescriptorBytes(size_t nbytes);
+int64_t descriptor_exempt_bytes();
 }  // namespace inline_dispatch
 
 class InputMessenger {

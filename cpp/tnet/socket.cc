@@ -142,6 +142,8 @@ int Socket::Create(const SocketOptions& options, SocketId* id) {
     s->conn_data_deleter_ = nullptr;
     s->bytes_read_.store(0, std::memory_order_relaxed);
     s->bytes_written_.store(0, std::memory_order_relaxed);
+    s->descriptor_bytes_read_.store(0, std::memory_order_relaxed);
+    s->peer_pool_id_.store(0, std::memory_order_relaxed);
     s->nwrite_batches_.store(0, std::memory_order_relaxed);
     s->max_write_batch_.store(0, std::memory_order_relaxed);
     s->queued_highwater_.store(0, std::memory_order_relaxed);

@@ -288,6 +288,30 @@ public:
     int64_t bytes_written() const {
         return bytes_written_.load(std::memory_order_relaxed);
     }
+    // One-sided descriptor attribution (ISSUE 9): logical payload bytes
+    // this connection delivered by REFERENCE (pool descriptors resolved
+    // against a mapped peer pool) — they never crossed the fd/ring, so
+    // bytes_read misses them, but they ARE this connection's data-plane
+    // throughput. /connections adds them to the in-rate so the device
+    // seam's GB/s is visible per connection.
+    void add_descriptor_bytes_read(int64_t n) {
+        descriptor_bytes_read_.fetch_add(n, std::memory_order_relaxed);
+    }
+    int64_t descriptor_bytes_read() const {
+        return descriptor_bytes_read_.load(std::memory_order_relaxed);
+    }
+    // The ONE peer pool this connection's ICI handshake mapped (0 =
+    // none). Descriptor resolution is bound to it: a request on this
+    // connection may only reference the pool its handshake registered
+    // (or, on an in-process link, this process's own pool) — a global
+    // registry hit alone must never be enough, or any connection could
+    // read any mapped tenant's pool memory.
+    void set_peer_pool_id(uint64_t id) {
+        peer_pool_id_.store(id, std::memory_order_relaxed);
+    }
+    uint64_t peer_pool_id() const {
+        return peer_pool_id_.load(std::memory_order_relaxed);
+    }
     int64_t created_us() const { return created_us_; }
     int64_t last_active_us() const {
         return last_active_us_.load(std::memory_order_relaxed);
@@ -315,7 +339,10 @@ public:
         double out_bps = 0;
     };
     IoRates ScrapeIoRates(int64_t now_us) {
-        const int64_t in = bytes_read();
+        // Logical in-bytes: fd/ring bytes PLUS descriptor-referenced
+        // bytes delivered in place (ISSUE 9) — the connection's true
+        // data-plane rate.
+        const int64_t in = bytes_read() + descriptor_bytes_read();
         const int64_t out = bytes_written();
         const int64_t prev_us = rate_scrape_us_.exchange(
             now_us, std::memory_order_relaxed);
@@ -410,6 +437,8 @@ private:
     void* recycle_arg_ = nullptr;
     std::atomic<int64_t> bytes_read_{0};
     std::atomic<int64_t> bytes_written_{0};
+    std::atomic<int64_t> descriptor_bytes_read_{0};
+    std::atomic<uint64_t> peer_pool_id_{0};
     int64_t created_us_ = 0;
     std::atomic<int64_t> last_active_us_{0};
     // I/O attribution (reset on slot reuse, like the byte counters).

@@ -1,6 +1,7 @@
 #include "trpc/c_api.h"
 
 #include <cstring>
+#include <string>
 
 #include "rpc_meta.pb.h"
 #include "tbase/crc32c.h"
@@ -27,37 +28,141 @@ uint32_t tpurpc_crc32c(uint32_t init, const void* data, size_t n) {
 
 void* tpurpc_block_alloc(size_t n) {
     if (tpurpc::IciBlockPool::initialized()) {
-        void* p = tpurpc::IciBlockPool::AllocateRegistered(n);
+        // Slab classes first (recyclable registered slots); oversized
+        // requests fall through to carve-only registered chunks inside
+        // AllocateSlab.
+        void* p = tpurpc::IciBlockPool::AllocateSlab(n);
         if (p != nullptr) return p;
     }
     return malloc(n);
 }
 
 void tpurpc_block_free(void* p) {
-    // Registered chunks are carve-only (process-lifetime staging arenas);
-    // only malloc fallbacks are freed.
-    if (!tpurpc::IciBlockPool::Contains(p)) free(p);
+    if (tpurpc::IciBlockPool::Contains(p)) {
+        // Slab slots recycle into their class freelist; carve-only
+        // chunks are process-lifetime (FreeSlab ignores them).
+        tpurpc::IciBlockPool::FreeSlab(p);
+        return;
+    }
+    free(p);
 }
 
 int tpurpc_block_is_registered(const void* p) {
     return tpurpc::IciBlockPool::Contains(p) ? 1 : 0;
 }
 
+long tpurpc_slab_allocated() {
+    return (long)tpurpc::IciBlockPool::slab_allocated();
+}
+
+long tpurpc_slab_recycled() {
+    return (long)tpurpc::IciBlockPool::slab_recycled();
+}
+
+uint64_t tpurpc_pool_id() { return tpurpc::IciBlockPool::pool_id(); }
+
+void* tpurpc_ring_create(uint32_t depth, size_t slot_bytes) {
+    return tpurpc::DeviceStagingRing::Create(depth, slot_bytes);
+}
+
+void tpurpc_ring_destroy(void* ring) {
+    delete (tpurpc::DeviceStagingRing*)ring;
+}
+
+int tpurpc_ring_acquire(void* ring, long timeout_us) {
+    return ((tpurpc::DeviceStagingRing*)ring)->Acquire(timeout_us);
+}
+
+int tpurpc_ring_complete(void* ring, uint32_t slot) {
+    return ((tpurpc::DeviceStagingRing*)ring)->Complete(slot);
+}
+
+void* tpurpc_ring_slot(void* ring, uint32_t slot) {
+    return ((tpurpc::DeviceStagingRing*)ring)->slot(slot);
+}
+
+size_t tpurpc_ring_slot_bytes(void* ring) {
+    return ((tpurpc::DeviceStagingRing*)ring)->slot_bytes();
+}
+
+uint32_t tpurpc_ring_depth(void* ring) {
+    return ((tpurpc::DeviceStagingRing*)ring)->depth();
+}
+
+int tpurpc_ring_registered(void* ring) {
+    return ((tpurpc::DeviceStagingRing*)ring)->registered() ? 1 : 0;
+}
+
+uint64_t tpurpc_ring_inflight_highwater(void* ring) {
+    return ((tpurpc::DeviceStagingRing*)ring)->inflight_highwater();
+}
+
+namespace {
+
+// Serialize the one-frame meta for (cid, payload crc). Returns false on
+// a serialization failure (can't happen for this fixed shape).
+bool frame_meta(uint64_t cid, size_t n, uint32_t crc, std::string* out) {
+    tpurpc::rpc::RpcMeta meta;
+    meta.set_correlation_id(cid);
+    meta.set_attachment_size((uint32_t)n);
+    meta.set_body_checksum(crc);
+    return meta.SerializeToString(out);
+}
+
+void write_frame_header(char* dst, size_t meta_size, size_t payload_len) {
+    memcpy(dst, kMagic, 4);
+    const uint32_t body = __builtin_bswap32((uint32_t)(meta_size +
+                                                       payload_len));
+    const uint32_t msz = __builtin_bswap32((uint32_t)meta_size);
+    memcpy(dst + 4, &body, 4);
+    memcpy(dst + 8, &msz, 4);
+}
+
+}  // namespace
+
 long tpurpc_frame(uint64_t correlation_id, const void* payload, size_t n,
                   void* out, size_t out_cap) {
-    tpurpc::rpc::RpcMeta meta;
-    meta.set_correlation_id(correlation_id);
-    meta.set_attachment_size((uint32_t)n);
-    meta.set_body_checksum(
-        tpurpc::crc32c_extend(0, (const char*)payload, n));
-    tpurpc::IOBuf meta_buf;
-    if (!tpurpc::SerializePbToIOBuf(meta, &meta_buf)) return -1;
-    tpurpc::IOBuf frame, attachment;
-    attachment.append(payload, n);
-    tpurpc::PackTpuStdFrame(&frame, meta_buf, tpurpc::IOBuf(), attachment);
-    if (frame.size() > out_cap) return -1;
-    frame.copy_to(out, frame.size());
-    return (long)frame.size();
+    std::string meta_str;
+    if (!frame_meta(correlation_id, n,
+                    tpurpc::crc32c_extend(0, (const char*)payload, n),
+                    &meta_str)) {
+        return -1;
+    }
+    const size_t frame_len = kHeaderLen + meta_str.size() + n;
+    if (frame_len > out_cap) return -1;
+    char* o = (char*)out;
+    char* att_pos = o + kHeaderLen + meta_str.size();
+    // Payload placement FIRST (memmove: the source may overlap the
+    // header/meta region about to be written). When the payload already
+    // sits exactly at the frame's attachment position — staged in place
+    // inside the destination pool buffer — the copy is skipped entirely:
+    // the frame costs a header+meta write and the crc read only.
+    if ((const char*)payload != att_pos) {
+        memmove(att_pos, payload, n);
+    }
+    write_frame_header(o, meta_str.size(), n);
+    memcpy(o + kHeaderLen, meta_str.data(), meta_str.size());
+    return (long)frame_len;
+}
+
+long tpurpc_frame_in_place(uint64_t correlation_id, void* buf,
+                           size_t payload_off, size_t payload_len,
+                           size_t* frame_off, uint32_t* crc_out) {
+    char* b = (char*)buf;
+    const uint32_t crc =
+        tpurpc::crc32c_extend(0, b + payload_off, payload_len);
+    if (crc_out != nullptr) *crc_out = crc;
+    std::string meta_str;
+    if (!frame_meta(correlation_id, payload_len, crc, &meta_str)) {
+        return -1;
+    }
+    const size_t prefix = kHeaderLen + meta_str.size();
+    if (payload_off < prefix) return -1;  // not enough header room
+    const size_t start = payload_off - prefix;
+    write_frame_header(b + start, meta_str.size(), payload_len);
+    memcpy(b + start + kHeaderLen, meta_str.data(), meta_str.size());
+    if (frame_off != nullptr) *frame_off = start;
+    return (long)(prefix + payload_len);
 }
 
 long tpurpc_unframe(const void* buf, size_t n, uint64_t* cid,

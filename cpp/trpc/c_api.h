@@ -23,18 +23,60 @@ int tpurpc_global_init();
 // The framework's crc32c (slice-by-8, RFC 3720 polynomial).
 uint32_t tpurpc_crc32c(uint32_t init, const void* data, size_t n);
 
-// Registered-memory staging buffers from the ICI block pool.
+// Registered-memory staging buffers from the ICI block pool. Allocation
+// routes through the slab-class allocator (recyclable; ISSUE 9c) for
+// class-sized requests and falls back to carve-only registered chunks
+// above the largest class.
 void* tpurpc_block_alloc(size_t n);
 void tpurpc_block_free(void* p);
 // 1 if p lies inside the registered region (diagnostic for tests).
 int tpurpc_block_is_registered(const void* p);
 
+// Slab-class allocator stats (zero-copy / recycle proof for tests).
+long tpurpc_slab_allocated();
+long tpurpc_slab_recycled();
+// Identity of this process's shared pool (the pool_id of one-sided
+// descriptors); 0 when the pool is anonymous.
+uint64_t tpurpc_pool_id();
+
+// ---- device staging ring (ISSUE 9a) ----
+// A depth-N ring of registered staging slots for the pipelined device
+// data path (see tici/block_pool.h DeviceStagingRing). Acquire hands
+// out slots in FIFO order, blocking up to timeout_us (<0 = forever)
+// while all slots are in flight; Complete releases them (out-of-order
+// completes are held until the predecessors finish).
+void* tpurpc_ring_create(uint32_t depth, size_t slot_bytes);
+void tpurpc_ring_destroy(void* ring);
+int tpurpc_ring_acquire(void* ring, long timeout_us);
+int tpurpc_ring_complete(void* ring, uint32_t slot);
+void* tpurpc_ring_slot(void* ring, uint32_t slot);
+size_t tpurpc_ring_slot_bytes(void* ring);
+uint32_t tpurpc_ring_depth(void* ring);
+int tpurpc_ring_registered(void* ring);
+uint64_t tpurpc_ring_inflight_highwater(void* ring);
+
 // Frame `payload` as one tpu_std frame: "TRPC" header + RpcMeta
 // {correlation_id, body_checksum=crc32c(payload)} + payload as raw
 // attachment. Writes into out[0..out_cap). Returns the frame size in
-// bytes, or -1 if out_cap is too small.
+// bytes, or -1 if out_cap is too small. When `payload` ALREADY sits at
+// the frame's attachment position inside `out` (exact aliasing), the
+// payload memcpy is skipped — header + meta write + crc only.
 long tpurpc_frame(uint64_t correlation_id, const void* payload, size_t n,
                   void* out, size_t out_cap);
+
+// In-place framing for pool-resident payloads (ISSUE 9 satellite): the
+// payload ALREADY lives at buf[payload_off .. payload_off+payload_len);
+// the header + meta are written right-justified immediately before it,
+// so the finished frame occupies buf[*frame_off .. payload_off+
+// payload_len) with NO payload copy. Requires payload_off >= the
+// header+meta size (~64 bytes is always enough). Returns the frame
+// length, sets *frame_off, and (when non-null) *crc_out = the crc32c
+// embedded in the meta — so callers can verify round-tripped payload
+// bytes without re-parsing the frame. Returns -1 when the prefix space
+// is too small.
+long tpurpc_frame_in_place(uint64_t correlation_id, void* buf,
+                           size_t payload_off, size_t payload_len,
+                           size_t* frame_off, uint32_t* crc_out);
 
 // Parse ONE frame at buf[0..n): verifies the header, meta, and
 // body_checksum. On success returns bytes consumed and sets *cid,

@@ -19,6 +19,7 @@
 #include "tnet/socket_map.h"
 #include "trpc/channel.h"
 #include "trpc/lb_with_naming.h"
+#include "tici/block_pool.h"
 #include "trpc/pb_compat.h"
 #include "trpc/retry_policy.h"
 #include "trpc/policy_tpu_std.h"
@@ -45,6 +46,41 @@ static LazyAdder g_budget_exhausted("rpc_retry_budget_exhausted");
 // Both are budget-free — the rolling-restart soak asserts zero retry
 // tokens spent across a full mesh restart.
 static LazyAdder g_drain_reroutes("rpc_client_drain_reroutes");
+// One-sided descriptor sends (ISSUE 9): calls whose attachment crossed
+// the wire as a (pool_id, offset, len, crc) reference — and the logical
+// bytes that never entered the frame/copy path because of it.
+static LazyAdder g_pool_desc_sends("rpc_pool_descriptor_sends");
+static LazyAdder g_pool_desc_bytes("rpc_pool_descriptor_send_bytes");
+// Ineligible set_request_pool_attachment calls folded back to the
+// inline path (multi-block or non-shared memory).
+static LazyAdder g_pool_desc_fallbacks("rpc_pool_descriptor_fallbacks");
+
+void Controller::set_request_pool_attachment(IOBuf&& buf) {
+    // Eligibility is decided HERE, once, not per retry: the bytes must
+    // be one contiguous block ref inside the shared registered pool so
+    // a single (offset, len) names them all. Anything else falls back
+    // to the inline attachment — same payload on the wire, just copied.
+    uint64_t off = 0;
+    size_t flen = 0;
+    const char* data =
+        buf.backing_block_num() == 1 ? buf.backing_block_data(0, &flen)
+                                     : nullptr;
+    if (data != nullptr && flen == buf.size() &&
+        IciBlockPool::OffsetOf(data, &off) &&
+        IciBlockPool::pool_id() != 0) {
+        // Stash the resolved descriptor (crc computed ONCE — retries
+        // re-send the same reference without re-reading the bytes).
+        pool_attachment_.data = data;
+        pool_attachment_.length = flen;
+        pool_attachment_.pool_id = IciBlockPool::pool_id();
+        pool_attachment_.offset = off;
+        pool_attachment_.crc32c = crc32c_extend(0, data, flen);
+        request_pool_buf_ = std::move(buf);
+        return;
+    }
+    *g_pool_desc_fallbacks << 1;
+    request_attachment_.append(std::move(buf));
+}
 
 Controller::~Controller() {
     RunCancelClosure();  // contract: an unfired closure still runs once
@@ -62,6 +98,8 @@ void Controller::Reset() {
     canceled_.store(false, std::memory_order_relaxed);
     request_attachment_.clear();
     response_attachment_.clear();
+    request_pool_buf_.clear();
+    pool_attachment_ = PoolAttachment();
     remote_side_ = EndPoint();
     local_side_ = EndPoint();
     latency_us_ = 0;
@@ -680,6 +718,19 @@ void Controller::IssueRPC() {
         meta.set_compress_type(request_compress_type_);
     }
     meta.set_attachment_size((uint32_t)request_attachment_.size());
+    // One-sided pool attachment (ISSUE 9): the frame carries ONLY the
+    // header + meta (+ inline payload pb); the attachment crosses the
+    // seam as a block reference the receiver maps in place. The pinned
+    // block (request_pool_buf_) is released at EndRPC.
+    if (!request_pool_buf_.empty()) {
+        auto* pd = meta.mutable_pool_attachment();
+        pd->set_pool_id(pool_attachment_.pool_id);
+        pd->set_offset(pool_attachment_.offset);
+        pd->set_length(pool_attachment_.length);
+        pd->set_crc32c(pool_attachment_.crc32c);
+        *g_pool_desc_sends << 1;
+        *g_pool_desc_bytes << (int64_t)pool_attachment_.length;
+    }
     if (FLAGS_rpc_checksum.get()) {
         uint32_t crc = crc32c_iobuf(0, request_buf_);
         crc = crc32c_iobuf(crc, request_attachment_);
@@ -797,6 +848,12 @@ void Controller::ReleaseFlySockets() {
 
 void Controller::EndRPC(CallId locked_id) {
     latency_us_ = monotonic_time_us() - start_us_;
+    // One-sided completion (ISSUE 9): the response (or terminal failure)
+    // means the peer will never again read our posted descriptor —
+    // release the pinned block back to the owner's pool. This is the
+    // descriptor analog of the shm ring's released_-counter advance.
+    request_pool_buf_.clear();
+    pool_attachment_ = PoolAttachment();
     // The RPC is over: an unfired NotifyOnCancel closure runs now
     // (protobuf contract — exactly once whether or not canceled).
     RunCancelClosure();

@@ -72,6 +72,46 @@ public:
     // Attachment bytes carried outside the pb payload (zero-copy).
     IOBuf& request_attachment() { return request_attachment_; }
     IOBuf& response_attachment() { return response_attachment_; }
+
+    // ---- one-sided pool attachment (ISSUE 9) ----
+    // Client: send `buf` as a (pool_id, offset, len, crc32c) descriptor
+    // instead of inline frame bytes. Eligible when buf is one contiguous
+    // block inside this process's SHARED registered pool (any IOBuf
+    // block is, after IciBlockPool::Init, until it spills past the
+    // primary region); ineligible bytes fall back to the inline
+    // attachment transparently. The framework holds the block ref until
+    // the RPC completes, then releases it back to the owner's pool —
+    // the completion of the one-sided transfer. Descriptors only
+    // resolve on ici/shm links whose HANDSHAKE mapped our pool: the
+    // receiver binds resolution to the connection's registered peer
+    // pool (Socket::peer_pool_id), so a plain-TCP peer — or any
+    // connection naming a pool that is not its own — answers
+    // TERR_REQUEST.
+    void set_request_pool_attachment(IOBuf&& buf);
+    bool has_request_pool_attachment() const {
+        return !request_pool_buf_.empty();
+    }
+    // Server: the resolved zero-copy view of a descriptor attachment —
+    // bytes read IN PLACE from the receiver's mapping of the sender's
+    // pool. Valid until the done closure runs; handlers must not retain
+    // it past the response.
+    struct PoolAttachment {
+        const char* data = nullptr;
+        uint64_t length = 0;
+        uint64_t pool_id = 0;
+        uint64_t offset = 0;
+        uint32_t crc32c = 0;
+    };
+    const PoolAttachment& request_pool_attachment() const {
+        return pool_attachment_;
+    }
+    bool has_request_pool_attachment_view() const {
+        return pool_attachment_.data != nullptr;
+    }
+    // Server-protocol internal: install the resolved view.
+    void SetRequestPoolAttachmentView(const PoolAttachment& view) {
+        pool_attachment_ = view;
+    }
     // Payload compression (reference set_request_compress_type /
     // set_response_compress_type; see trpc/compress.h). Attachments stay
     // raw. Client sets request_*; server handlers set response_*.
@@ -235,6 +275,11 @@ private:
     std::atomic<google::protobuf::Closure*> on_cancel_{nullptr};
     IOBuf request_attachment_;
     IOBuf response_attachment_;
+    // One-sided descriptor state: the pinned pool block (client; one
+    // contiguous ref — released at EndRPC, returning the block to the
+    // owner's pool) and the resolved in-place view (server).
+    IOBuf request_pool_buf_;
+    PoolAttachment pool_attachment_;
     EndPoint remote_side_;
     EndPoint local_side_;
     int64_t latency_us_;

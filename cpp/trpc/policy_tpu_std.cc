@@ -15,6 +15,7 @@
 #include "thttp/http2_client.h"
 #include "thttp/http2_protocol.h"
 #include "thttp/http_protocol.h"
+#include "tici/block_pool.h"
 #include "tici/shm_link.h"
 #include "tnet/input_messenger.h"
 #include "trpc/auth.h"
@@ -49,6 +50,12 @@ int g_tpu_std_index = -1;
 // Drain announcements received from peers (a GOAWAY meta marked this
 // client's connection draining).
 static LazyAdder g_drain_notices("rpc_client_drain_notices");
+// One-sided descriptor resolution (ISSUE 9): attachments delivered as
+// in-place views of a mapped sender pool — zero bytes copied.
+static LazyAdder g_pool_desc_resolves("rpc_pool_descriptor_resolves");
+static LazyAdder g_pool_desc_resolve_bytes(
+    "rpc_pool_descriptor_resolve_bytes");
+static LazyAdder g_pool_desc_rejects("rpc_pool_descriptor_rejects");
 
 int TpuStdProtocolIndex() { return g_tpu_std_index; }
 
@@ -600,6 +607,69 @@ void ProcessTpuStdRequest(TpuStdMessage* msg, const rpc::RpcMeta& meta) {
         }
         payload.swap(raw);
     }
+    // One-sided pool attachment (ISSUE 9b): the meta names (pool_id,
+    // offset, len, crc) in the SENDER's registered pool; resolve it
+    // against our mapping of that pool (registered at the ICI
+    // handshake) and hand the handler an in-place view — the payload
+    // bytes are never copied host-side. Unknown pool = the sender used
+    // descriptors on a link whose handshake never mapped its pool
+    // (plain TCP): fail the call, not the connection.
+    Controller::PoolAttachment pool_view;
+    if (meta.has_pool_attachment()) {
+        const auto& pd = meta.pool_attachment();
+        // Scope check BEFORE the registry: a connection may only
+        // reference the pool its OWN handshake mapped (or, on an
+        // in-process transport link, this process's pool). The global
+        // registry alone must never authorize — any connection could
+        // otherwise name another tenant's mapped pool, or a plain-TCP
+        // peer this server's own, and read memory it was never handed.
+        const bool in_scope =
+            pd.pool_id() != 0 &&
+            (pd.pool_id() == s->peer_pool_id() ||
+             (s->transport() != nullptr &&
+              pd.pool_id() == IciBlockPool::pool_id()));
+        const char* pool_base = nullptr;
+        size_t pool_size = 0;
+        if (!in_scope ||
+            !pool_registry::Resolve(pd.pool_id(), &pool_base,
+                                    &pool_size) ||
+            pd.offset() > pool_size ||
+            pd.length() > pool_size - pd.offset()) {
+            *g_pool_desc_rejects << 1;
+            guard->Finish(TERR_REQUEST);
+            delete guard;
+            SendErrorResponse(sid, cid, TERR_REQUEST,
+                              "unresolvable pool descriptor (sender pool "
+                              "not mapped on this link, or out of "
+                              "bounds)");
+            return;
+        }
+        if (pd.has_crc32c() &&
+            crc32c_extend(0, pool_base + pd.offset(), pd.length()) !=
+                pd.crc32c()) {
+            *g_pool_desc_rejects << 1;
+            guard->Finish(TERR_REQUEST);
+            delete guard;
+            SendErrorResponse(sid, cid, TERR_REQUEST,
+                              "pool descriptor crc32c mismatch");
+            return;
+        }
+        pool_view.data = pool_base + pd.offset();
+        pool_view.length = pd.length();
+        pool_view.pool_id = pd.pool_id();
+        pool_view.offset = pd.offset();
+        pool_view.crc32c = pd.crc32c();
+        *g_pool_desc_resolves << 1;
+        *g_pool_desc_resolve_bytes << (int64_t)pd.length();
+        // The logical payload is exempt from the inline-dispatch byte
+        // budget (only the tiny wire frame was charged — the referenced
+        // bytes never pass through the message path), and it IS this
+        // connection's data-plane throughput: attribute it.
+        if (inline_dispatch::RoundArmed()) {
+            inline_dispatch::ExemptDescriptorBytes(pd.length());
+        }
+        s->add_descriptor_bytes_read((int64_t)pd.length());
+    }
 
     const int64_t start_us = monotonic_time_us();
     auto* req = mp->service->GetRequestPrototype(mp->method).New();
@@ -658,6 +728,9 @@ void ProcessTpuStdRequest(TpuStdMessage* msg, const rpc::RpcMeta& meta) {
                               meta.stream_settings().window_size());
     }
     cntl->request_attachment() = attachment;
+    if (pool_view.data != nullptr) {
+        cntl->SetRequestPoolAttachmentView(pool_view);
+    }
     // Cancelable handle: a tpu_std CANCEL meta, an h2 RST, or this
     // connection's death reaches the controller through the registry
     // (trpc/server_call.h); the done closure tears both down. Every path

@@ -384,7 +384,11 @@ _SKIP_KEYS = {"metric", "unit", "vs_baseline", "reps", "error",
               "device_path_chunk_bytes", "device_path_inflight_highwater",
               "device_path_ok", "device_path_registered_staging",
               "device_path_cores", "pool_desc_calls", "pool_desc_bytes",
-              "pool_desc_zero_copy"}
+              "pool_desc_zero_copy",
+              # Lease leak gauges (ISSUE 10): evidence, not a rate — a
+              # healthy round records pinned_after == 0; reaped counts
+              # chaos/crash reclamations, so neither is a compare metric.
+              "pool_desc_pinned_after", "pool_desc_reaped"}
 
 
 def _lower_is_better(key):

@@ -106,10 +106,22 @@ class _ChunkPipeline:
         self.ok = True
         self.dev_checks = []
 
+    # Never park forever on the ring (ISSUE 10c): a wedged device stream
+    # (lost completion, dead driver) must surface as an error, not a hung
+    # Python thread. 30s >> any sane per-chunk latency; on timeout the
+    # ring is poisoned so every OTHER thread parked on it unblocks too.
+    ACQUIRE_TIMEOUT_US = 30_000_000
+
     def _launch(self, k):
         import jax
         from brpc_tpu import native
-        slot = self.ring.acquire()
+        try:
+            slot = self.ring.acquire(self.ACQUIRE_TIMEOUT_US)
+        except TimeoutError:
+            self.ring.abort()
+            raise RuntimeError(
+                "staging-ring acquire timed out (lost completion or "
+                "wedged device stream); ring aborted") from None
         sa = self.ring.slots[slot]
         clen = self.chunk_bytes
         if self.copy_mode:

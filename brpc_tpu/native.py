@@ -50,6 +50,12 @@ def lib() -> ctypes.CDLL:
         L.tpurpc_ring_acquire.argtypes = [ctypes.c_void_p, ctypes.c_long]
         L.tpurpc_ring_complete.restype = ctypes.c_int
         L.tpurpc_ring_complete.argtypes = [ctypes.c_void_p, ctypes.c_uint32]
+        L.tpurpc_ring_abort.argtypes = [ctypes.c_void_p]
+        L.tpurpc_ring_aborted.restype = ctypes.c_int
+        L.tpurpc_ring_aborted.argtypes = [ctypes.c_void_p]
+        L.tpurpc_lease_pinned.restype = ctypes.c_uint64
+        L.tpurpc_lease_reaped.restype = ctypes.c_uint64
+        L.tpurpc_pool_epoch.restype = ctypes.c_uint64
         L.tpurpc_ring_slot.restype = ctypes.c_void_p
         L.tpurpc_ring_slot.argtypes = [ctypes.c_void_p, ctypes.c_uint32]
         L.tpurpc_ring_slot_bytes.restype = ctypes.c_size_t
@@ -118,6 +124,23 @@ def pool_id() -> int:
     return int(lib().tpurpc_pool_id())
 
 
+def pool_epoch() -> int:
+    """Current generation of this process's pool mapping (epoch fence)."""
+    return int(lib().tpurpc_pool_epoch())
+
+
+def lease_counters() -> tuple[int, int]:
+    """(live pinned blocks, reaped pins) — the leak evidence bench.py
+    records after every round (a healthy round ends pinned == 0)."""
+    L = lib()
+    return int(L.tpurpc_lease_pinned()), int(L.tpurpc_lease_reaped())
+
+
+class RingAbortedError(RuntimeError):
+    """The staging ring was poisoned (device stream error / shutdown):
+    parked acquires unblock with this instead of wedging forever."""
+
+
 def slab_counters() -> tuple[int, int]:
     """(live slab slots, recycled-allocation count) — the zero-copy /
     recycle evidence the device-ring tests assert on."""
@@ -148,6 +171,8 @@ class DeviceStagingRing:
 
     def acquire(self, timeout_us: int = -1) -> int:
         slot = int(lib().tpurpc_ring_acquire(self._ptr, timeout_us))
+        if slot == -2:
+            raise RingAbortedError("ring aborted (poisoned)")
         if slot < 0:
             raise TimeoutError("ring acquire timed out")
         return slot
@@ -155,6 +180,15 @@ class DeviceStagingRing:
     def complete(self, slot: int) -> None:
         if lib().tpurpc_ring_complete(self._ptr, slot) != 0:
             raise ValueError(f"slot {slot} not in flight")
+
+    def abort(self) -> None:
+        """Poison the ring: every parked and future acquire raises
+        RingAbortedError immediately (device-error escape hatch)."""
+        lib().tpurpc_ring_abort(self._ptr)
+
+    @property
+    def aborted(self) -> bool:
+        return bool(lib().tpurpc_ring_aborted(self._ptr))
 
     @property
     def inflight_highwater(self) -> int:

@@ -21,6 +21,8 @@ const char* terror(int code) {
         case TERR_DRAINING: return "Server draining (planned shutdown)";
         case TERR_OVERLOAD:
             return "Overloaded, shed by priority (retry after backoff)";
+        case TERR_STALE_EPOCH:
+            return "Stale pool descriptor epoch (remap and retry)";
         default: return strerror(code);
     }
 }

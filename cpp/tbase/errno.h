@@ -31,6 +31,16 @@ enum RpcErrno {
     // SPENDS retry budget: overload re-issues amplify load, so they are
     // never free (contrast TERR_DRAINING).
     TERR_OVERLOAD = 4013,
+    // Stale zero-copy reference (pool epoch fence, ISSUE 10): a
+    // one-sided PoolDescriptor was minted under a pool generation the
+    // receiver's mapping no longer matches (peer remapped/restarted, or
+    // the pin was reclaimed after its lease expired). Fails ONLY the
+    // call — the connection and both processes stay healthy — and is
+    // retriable: the re-issue (or the link re-handshake underneath it)
+    // re-registers the current generation. Excluded from circuit-
+    // breaker error accounting like TERR_OVERLOAD: the server fencing
+    // a stale reference is the server working as designed.
+    TERR_STALE_EPOCH = 4014,
 };
 
 const char* terror(int code);

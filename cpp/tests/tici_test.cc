@@ -17,9 +17,11 @@
 #include "tbase/iobuf.h"
 #include "tbase/errno.h"
 #include "tbase/fast_rand.h"
+#include "tbase/flags.h"
 #include "tbase/time.h"
 #include "tfiber/fiber.h"
 #include "tfiber/fiber_sync.h"
+#include "tici/block_lease.h"
 #include "tici/block_pool.h"
 #include "tici/ici_link.h"
 #include "tnet/socket.h"
@@ -464,6 +466,359 @@ TEST(PoolDescriptor, RpcZeroCopyOverIciLink) {
     cs.reset();
     server.Stop();
     server.Join();
+}
+
+// ---------------- block leases + epoch fencing (ISSUE 10) ----------------
+
+TEST(BlockLease, ExactlyOnceReleaseAndExpiryReap) {
+    ASSERT_EQ(0, IciBlockPool::Init());
+    const size_t live0 = IciBlockPool::slab_allocated();
+
+    // Pin -> exactly-once release: the second Release is a counted
+    // no-op, never a double free (the EndRPC/retry/backup guarantee).
+    IOBuf att;
+    char* data = nullptr;
+    ASSERT_TRUE(IciBlockPool::AllocatePoolAttachment(10000, &att, &data));
+    const uint64_t pinned0 = block_lease::pinned();
+    const uint64_t lease = block_lease::Pin(std::move(att));
+    ASSERT_NE(0ull, lease);
+    EXPECT_TRUE(block_lease::Alive(lease));
+    EXPECT_EQ(pinned0 + 1, block_lease::pinned());
+    EXPECT_EQ(live0 + 1, IciBlockPool::slab_allocated());
+    EXPECT_TRUE(block_lease::Release(lease));
+    EXPECT_FALSE(block_lease::Release(lease));  // exactly once
+    EXPECT_FALSE(block_lease::Alive(lease));
+    EXPECT_EQ(pinned0, block_lease::pinned());
+    EXPECT_EQ(live0, IciBlockPool::slab_allocated());
+
+    // Expiry reap: an armed lease whose deadline passed is reclaimed by
+    // the reaper; a later (late) Release finds nothing.
+    IOBuf att2;
+    ASSERT_TRUE(IciBlockPool::AllocatePoolAttachment(10000, &att2, &data));
+    const uint64_t l2 = block_lease::Pin(std::move(att2));
+    block_lease::Arm(l2, /*call_id=*/42,
+                     monotonic_time_us() - 10 * 1000 * 1000, /*peer=*/0);
+    const uint64_t reaped0 = block_lease::expired_reaped();
+    EXPECT_GE(block_lease::ReapExpired(monotonic_time_us()), (size_t)1);
+    EXPECT_EQ(reaped0 + 1, block_lease::expired_reaped());
+    EXPECT_FALSE(block_lease::Alive(l2));
+    EXPECT_FALSE(block_lease::Release(l2));  // reaper got there first
+    EXPECT_EQ(live0, IciBlockPool::slab_allocated());
+
+    // A fresh (never-Armed) pin carries the DEFAULT lifetime from the
+    // moment of the pin — alive now, reapable once -pool_lease_default_ms
+    // passes: there is no unreapable pin state, even when the owner
+    // dies before Arm.
+    IOBuf att3;
+    ASSERT_TRUE(IciBlockPool::AllocatePoolAttachment(10000, &att3, &data));
+    const uint64_t l3 = block_lease::Pin(std::move(att3));
+    EXPECT_EQ((size_t)0, block_lease::ReapExpired(monotonic_time_us()));
+    EXPECT_TRUE(block_lease::Alive(l3));
+    EXPECT_GE(block_lease::ReapExpired(monotonic_time_us() +
+                                       (int64_t)3600e6),
+              (size_t)1);  // way past the default window
+    EXPECT_FALSE(block_lease::Alive(l3));
+    EXPECT_FALSE(block_lease::Release(l3));
+    EXPECT_EQ(live0, IciBlockPool::slab_allocated());
+}
+
+TEST(BlockLease, BackupTryHoldsBothPeersEntitled) {
+    ASSERT_EQ(0, IciBlockPool::Init());
+    const size_t live0 = IciBlockPool::slab_allocated();
+    char* data = nullptr;
+    IOBuf att;
+    ASSERT_TRUE(IciBlockPool::AllocatePoolAttachment(8000, &att, &data));
+    const uint64_t l = block_lease::Pin(std::move(att));
+    const int64_t dl = monotonic_time_us() + (int64_t)60e6;
+    // Try 1 posts on socket 111; the backup try ADDS socket 222.
+    ASSERT_TRUE(block_lease::Arm(l, 1, dl, 111, /*add_peer=*/false));
+    ASSERT_TRUE(block_lease::Arm(l, 1, dl, 222, /*add_peer=*/true));
+    // The backup's peer dies: the ORIGINAL try's server may still be
+    // reading the block — the pin must survive.
+    EXPECT_EQ((size_t)0, block_lease::ReleasePeer(222));
+    EXPECT_TRUE(block_lease::Alive(l));
+    // Once the last entitled peer is gone, the pin frees.
+    EXPECT_EQ((size_t)1, block_lease::ReleasePeer(111));
+    EXPECT_FALSE(block_lease::Alive(l));
+    EXPECT_EQ(live0, IciBlockPool::slab_allocated());
+
+    // A RETRY (add_peer=false) replaces the key: the old socket's death
+    // then frees nothing.
+    IOBuf att2;
+    ASSERT_TRUE(IciBlockPool::AllocatePoolAttachment(8000, &att2, &data));
+    const uint64_t l2 = block_lease::Pin(std::move(att2));
+    ASSERT_TRUE(block_lease::Arm(l2, 2, dl, 111, false));
+    ASSERT_TRUE(block_lease::Arm(l2, 2, dl, 333, false));
+    EXPECT_EQ((size_t)0, block_lease::ReleasePeer(111));
+    EXPECT_TRUE(block_lease::Alive(l2));
+    EXPECT_EQ((size_t)1, block_lease::ReleasePeer(333));
+    EXPECT_EQ(live0, IciBlockPool::slab_allocated());
+}
+
+TEST(BlockLease, PeerDeathReleasesOnlyThatPeersPins) {
+    ASSERT_EQ(0, IciBlockPool::Init());
+    const size_t live0 = IciBlockPool::slab_allocated();
+    char* data = nullptr;
+    IOBuf a1, a2;
+    ASSERT_TRUE(IciBlockPool::AllocatePoolAttachment(8000, &a1, &data));
+    ASSERT_TRUE(IciBlockPool::AllocatePoolAttachment(8000, &a2, &data));
+    const uint64_t l1 = block_lease::Pin(std::move(a1));
+    const uint64_t l2 = block_lease::Pin(std::move(a2));
+    block_lease::Arm(l1, 1, monotonic_time_us() + (int64_t)60e6, 111);
+    block_lease::Arm(l2, 2, monotonic_time_us() + (int64_t)60e6, 222);
+    // Peer 111 dies: exactly its pin is reclaimed.
+    EXPECT_EQ((size_t)1, block_lease::ReleasePeer(111));
+    EXPECT_FALSE(block_lease::Alive(l1));
+    EXPECT_TRUE(block_lease::Alive(l2));
+    EXPECT_EQ((size_t)0, block_lease::ReleasePeer(111));  // idempotent
+    EXPECT_TRUE(block_lease::Release(l2));
+    EXPECT_EQ(live0, IciBlockPool::slab_allocated());
+}
+
+TEST(BlockLease, ControllerReuseReleasesExactlyOnce) {
+    ASSERT_EQ(0, IciBlockPool::Init());
+    const size_t live0 = IciBlockPool::slab_allocated();
+    Controller cntl;
+    IOBuf att;
+    char* data = nullptr;
+    ASSERT_TRUE(IciBlockPool::AllocatePoolAttachment(12000, &att, &data));
+    cntl.set_request_pool_attachment(std::move(att));
+    ASSERT_TRUE(cntl.has_request_pool_attachment());
+    const uint64_t lease = cntl.pool_lease_id();
+    ASSERT_NE(0ull, lease);
+    EXPECT_EQ(live0 + 1, IciBlockPool::slab_allocated());
+    const uint64_t released0 = block_lease::released();
+    cntl.Reset();  // reuse ends the previous RPC: the pin must go
+    EXPECT_FALSE(cntl.has_request_pool_attachment());
+    EXPECT_EQ(released0 + 1, block_lease::released());
+    EXPECT_EQ(live0, IciBlockPool::slab_allocated());
+    cntl.Reset();  // second Reset: no double release
+    EXPECT_EQ(released0 + 1, block_lease::released());
+    EXPECT_FALSE(block_lease::Release(lease));
+}
+
+TEST(PoolEpoch, StaleEpochFailsOnlyTheCallNotTheConnection) {
+    ASSERT_EQ(0, IciBlockPool::Init());
+    PoolDescEchoService service;
+    Server server;
+    ASSERT_EQ(0, server.AddService(&service));
+    ASSERT_EQ(0, server.StartNoListen(nullptr));
+
+    IciLink& link = *IciLink::Create();
+    SocketOptions sopts;
+    sopts.fd = link.second()->event_fd();
+    sopts.transport = link.second();
+    sopts.owns_transport = true;
+    sopts.on_edge_triggered_events = InputMessenger::OnNewMessages;
+    sopts.user = server.messenger();
+    SocketId server_sid;
+    ASSERT_EQ(0, Socket::Create(sopts, &server_sid));
+    SocketOptions copts;
+    copts.fd = link.first()->event_fd();
+    copts.transport = link.first();
+    copts.owns_transport = true;
+    copts.on_edge_triggered_events = InputMessenger::OnNewMessages;
+    copts.user = Channel::client_messenger();
+    SocketId client_sid;
+    ASSERT_EQ(0, Socket::Create(copts, &client_sid));
+    Channel channel;
+    ChannelOptions chopts;
+    chopts.timeout_ms = 5000;
+    ASSERT_EQ(0, channel.InitWithSocketId(client_sid, &chopts));
+    test::EchoService_Stub stub(&channel);
+
+    const size_t live0 = IciBlockPool::slab_allocated();
+    const uint64_t my_pool = IciBlockPool::pool_id();
+    const uint64_t real_epoch = IciBlockPool::pool_epoch();
+
+    // Fence the mapping at a future generation: the in-flight
+    // descriptor (minted under real_epoch) must fail with the
+    // RETRIABLE stale error — and ONLY the call.
+    pool_registry::SetEpoch(my_pool, real_epoch + 7);
+    {
+        IOBuf att;
+        char* data = nullptr;
+        ASSERT_TRUE(
+            IciBlockPool::AllocatePoolAttachment(20000, &att, &data));
+        memset(data, 'e', 20000);
+        Controller cntl;
+        cntl.set_max_retry(0);  // deterministic: observe the raw fence
+        cntl.set_request_pool_attachment(std::move(att));
+        test::EchoRequest req;
+        test::EchoResponse res;
+        req.set_message("stale");
+        stub.Echo(&cntl, &req, &res, nullptr);
+        ASSERT_TRUE(cntl.Failed());
+        EXPECT_EQ(TERR_STALE_EPOCH, cntl.ErrorCode());
+    }
+    // The pin was released (EndRPC) despite the failure.
+    EXPECT_EQ(live0, IciBlockPool::slab_allocated());
+
+    // Restore the mapping's generation: the SAME connection serves the
+    // next descriptor — a stale fence never wedges or kills the link.
+    pool_registry::SetEpoch(my_pool, real_epoch);
+    {
+        IOBuf att;
+        char* data = nullptr;
+        ASSERT_TRUE(
+            IciBlockPool::AllocatePoolAttachment(20000, &att, &data));
+        memset(data, 'f', 20000);
+        const uint32_t crc = crc32c_extend(0, data, 20000);
+        Controller cntl;
+        cntl.set_request_pool_attachment(std::move(att));
+        test::EchoRequest req;
+        test::EchoResponse res;
+        req.set_message("fresh");
+        stub.Echo(&cntl, &req, &res, nullptr);
+        ASSERT_FALSE(cntl.Failed());
+        EXPECT_EQ(std::to_string(crc), res.message());
+    }
+    EXPECT_EQ(live0, IciBlockPool::slab_allocated());
+
+    SocketUniquePtr cs;
+    ASSERT_EQ(0, Socket::AddressSocket(client_sid, &cs));
+    cs->SetFailedWithError(TERR_CLOSE);
+    cs.reset();
+    server.Stop();
+    server.Join();
+}
+
+TEST(PoolChaos, LeakedPinIsReapedAndStaleInjectionIsRetriable) {
+    ASSERT_EQ(0, IciBlockPool::Init());
+    PoolDescEchoService service;
+    Server server;
+    ASSERT_EQ(0, server.AddService(&service));
+    ASSERT_EQ(0, server.StartNoListen(nullptr));
+
+    IciLink& link = *IciLink::Create();
+    SocketOptions sopts;
+    sopts.fd = link.second()->event_fd();
+    sopts.transport = link.second();
+    sopts.owns_transport = true;
+    sopts.on_edge_triggered_events = InputMessenger::OnNewMessages;
+    sopts.user = server.messenger();
+    SocketId server_sid;
+    ASSERT_EQ(0, Socket::Create(sopts, &server_sid));
+    SocketOptions copts;
+    copts.fd = link.first()->event_fd();
+    copts.transport = link.first();
+    copts.owns_transport = true;
+    copts.on_edge_triggered_events = InputMessenger::OnNewMessages;
+    copts.user = Channel::client_messenger();
+    SocketId client_sid;
+    ASSERT_EQ(0, Socket::Create(copts, &client_sid));
+    Channel channel;
+    ChannelOptions chopts;
+    chopts.timeout_ms = 5000;
+    ASSERT_EQ(0, channel.InitWithSocketId(client_sid, &chopts));
+    test::EchoService_Stub stub(&channel);
+
+    const size_t live0 = IciBlockPool::slab_allocated();
+
+    // chaos_pool pool_leak=1: EndRPC "forgets" the release; the reaper
+    // must reclaim the orphaned pin (the leaked-pin simulation of the
+    // soak, deterministic at probability 1).
+    ASSERT_TRUE(SetFlagValue("chaos_plan", "pool_leak=1"));
+    ASSERT_TRUE(SetFlagValue("chaos_enabled", "1"));
+    {
+        IOBuf att;
+        char* data = nullptr;
+        ASSERT_TRUE(
+            IciBlockPool::AllocatePoolAttachment(16000, &att, &data));
+        memset(data, 'l', 16000);
+        Controller cntl;
+        cntl.set_timeout_ms(2000);
+        cntl.set_request_pool_attachment(std::move(att));
+        test::EchoRequest req;
+        test::EchoResponse res;
+        req.set_message("leak");
+        stub.Echo(&cntl, &req, &res, nullptr);
+        ASSERT_FALSE(cntl.Failed());
+    }
+    // The pin leaked past EndRPC...
+    EXPECT_EQ(live0 + 1, IciBlockPool::slab_allocated());
+    // ...and the reaper reclaims it once the lease (deadline + grace)
+    // expires — slab live provably returns to baseline.
+    EXPECT_GE(block_lease::ReapExpired(monotonic_time_us() +
+                                       (int64_t)3600e6),
+              (size_t)1);
+    EXPECT_EQ(live0, IciBlockPool::slab_allocated());
+
+    // chaos_pool pool_stale=1: every resolve answers the retriable
+    // stale-epoch fence; the connection survives.
+    ASSERT_TRUE(SetFlagValue("chaos_plan", "pool_stale=1"));
+    {
+        IOBuf att;
+        char* data = nullptr;
+        ASSERT_TRUE(
+            IciBlockPool::AllocatePoolAttachment(16000, &att, &data));
+        memset(data, 's', 16000);
+        Controller cntl;
+        cntl.set_max_retry(0);
+        cntl.set_request_pool_attachment(std::move(att));
+        test::EchoRequest req;
+        test::EchoResponse res;
+        req.set_message("stale");
+        stub.Echo(&cntl, &req, &res, nullptr);
+        ASSERT_TRUE(cntl.Failed());
+        EXPECT_EQ(TERR_STALE_EPOCH, cntl.ErrorCode());
+    }
+    ASSERT_TRUE(SetFlagValue("chaos_enabled", "0"));
+    ASSERT_TRUE(SetFlagValue("chaos_plan", ""));
+    // Healed: the same connection carries a clean descriptor echo.
+    {
+        IOBuf att;
+        char* data = nullptr;
+        ASSERT_TRUE(
+            IciBlockPool::AllocatePoolAttachment(16000, &att, &data));
+        memset(data, 'h', 16000);
+        const uint32_t crc = crc32c_extend(0, data, 16000);
+        Controller cntl;
+        cntl.set_request_pool_attachment(std::move(att));
+        test::EchoRequest req;
+        test::EchoResponse res;
+        req.set_message("healed");
+        stub.Echo(&cntl, &req, &res, nullptr);
+        ASSERT_FALSE(cntl.Failed());
+        EXPECT_EQ(std::to_string(crc), res.message());
+    }
+    EXPECT_EQ(live0, IciBlockPool::slab_allocated());
+
+    SocketUniquePtr cs;
+    ASSERT_EQ(0, Socket::AddressSocket(client_sid, &cs));
+    cs->SetFailedWithError(TERR_CLOSE);
+    cs.reset();
+    server.Stop();
+    server.Join();
+}
+
+TEST(DeviceStagingRing, AbortUnblocksParkedAcquireAndTimeoutHolds) {
+    ASSERT_EQ(0, IciBlockPool::Init());
+    DeviceStagingRing* ring = DeviceStagingRing::Create(1, 8192);
+    ASSERT_TRUE(ring != nullptr);
+    ASSERT_EQ(0, ring->Acquire(-1));  // window now full
+    // Deadline honored: a bounded Acquire on a full window times out
+    // instead of wedging (the lost-completion escape).
+    const int64_t t0 = monotonic_time_us();
+    EXPECT_EQ(-1, ring->Acquire(50 * 1000));
+    EXPECT_GE(monotonic_time_us() - t0, (int64_t)45 * 1000);
+    // Non-blocking try.
+    EXPECT_EQ(-1, ring->Acquire(0));
+
+    // A parked Acquire is unblocked by Abort with -2 (not a timeout).
+    std::atomic<int> parked_result{123};
+    std::thread waiter([&] {
+        parked_result.store(ring->Acquire(10 * 1000 * 1000));
+    });
+    usleep(50 * 1000);  // let the waiter park
+    ring->Abort();
+    waiter.join();
+    EXPECT_EQ(-2, parked_result.load());
+    EXPECT_TRUE(ring->aborted());
+    // Future acquires fail fast; in-flight completes still settle.
+    EXPECT_EQ(-2, ring->Acquire(-1));
+    EXPECT_EQ(0, ring->Complete(0));
+    delete ring;
 }
 
 // ---------------- full RPC over the link ----------------

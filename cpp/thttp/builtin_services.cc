@@ -25,6 +25,8 @@
 #include "tfiber/task_group.h"
 #include "tfiber/task_meta.h"
 #include "tfiber/task_tracer.h"
+#include "tici/block_lease.h"
+#include "tici/block_pool.h"
 #include "tnet/fault_injection.h"
 #include "tnet/input_messenger.h"
 #include "tnet/socket.h"
@@ -67,6 +69,9 @@ void HandleIndex(Server*, const HttpRequest&, HttpResponse* res) {
         "              /hotspots/heap, /hotspots/growth,\n"
         "              /hotspots/contention)\n"
         "/chaos        fault injection (?enable=1&seed=N&plan=...&peers=...)\n"
+        "/pools        zero-copy pool state: live pinned-block leases,\n"
+        "              per-class slab occupancy, mapped peer pools +\n"
+        "              epochs (?format=json machine form)\n"
         "/metrics      prometheus exposition\n");
 }
 
@@ -628,6 +633,76 @@ void HandleChaos(Server*, const HttpRequest& req, HttpResponse* res) {
     res->Append(FaultInjection::DebugString());
 }
 
+// /pools: the zero-copy pool data path (ISSUE 10) — live pinned-block
+// leases (the crash-safety ledger: a pin with no live RPC is a leak the
+// reaper will reclaim), per-class slab occupancy, and every mapped pool
+// with its epoch (the stale-descriptor fence). ?format=json is what the
+// chaos soak asserts on (pinned back to 0, survivors' epochs intact).
+void HandlePools(Server*, const HttpRequest& req, HttpResponse* res) {
+    char line[192];
+    if (req.QueryParam("format") == "json") {
+        res->set_content_type("application/json");
+        std::string out;
+        // The header's format literals alone exceed 192 chars; its own
+        // buffer is sized for them plus eleven 20-digit numbers.
+        char head[512];
+        snprintf(head, sizeof(head),
+                 "{\"pool_id\": %llu, \"pool_epoch\": %llu, "
+                 "\"pinned\": %llu, \"pins_total\": %llu, "
+                 "\"released\": %llu, \"lease_expired\": %llu, "
+                 "\"peer_released\": %llu, \"slab_live\": %zu, "
+                 "\"slab_recycled\": %zu, \"pool_resolves\": %llu, "
+                 "\"pool_resolve_failures\": %llu, \"classes\": [",
+                 (unsigned long long)IciBlockPool::pool_id(),
+                 (unsigned long long)IciBlockPool::pool_epoch(),
+                 (unsigned long long)block_lease::pinned(),
+                 (unsigned long long)block_lease::pins_total(),
+                 (unsigned long long)block_lease::released(),
+                 (unsigned long long)block_lease::expired_reaped(),
+                 (unsigned long long)block_lease::peer_released(),
+                 IciBlockPool::slab_allocated(),
+                 IciBlockPool::slab_recycled(),
+                 (unsigned long long)pool_registry::resolves(),
+                 (unsigned long long)pool_registry::resolve_failures());
+        out += head;
+        for (int c = 0; IciBlockPool::slab_class_bytes(c) != 0; ++c) {
+            const auto st = IciBlockPool::slab_class_stat(c);
+            snprintf(line, sizeof(line),
+                     "%s{\"bytes\": %zu, \"live\": %zu, \"free\": %zu, "
+                     "\"carved\": %zu}",
+                     c == 0 ? "" : ", ",
+                     IciBlockPool::slab_class_bytes(c), st.live,
+                     st.freelist, st.carved);
+            out += line;
+        }
+        out += "]}";
+        res->Append(out);
+        return;
+    }
+    res->set_content_type("text/plain");
+    snprintf(line, sizeof(line), "pool_id %llu\npool_epoch %llu\n",
+             (unsigned long long)IciBlockPool::pool_id(),
+             (unsigned long long)IciBlockPool::pool_epoch());
+    res->Append(line);
+    res->Append("-- pinned-block leases --\n");
+    res->Append(block_lease::DebugString());
+    res->Append("-- slab classes (live/free/carved) --\n");
+    for (int c = 0; IciBlockPool::slab_class_bytes(c) != 0; ++c) {
+        const auto st = IciBlockPool::slab_class_stat(c);
+        snprintf(line, sizeof(line), "class %7zuB live=%zu free=%zu "
+                 "carved=%zu\n",
+                 IciBlockPool::slab_class_bytes(c), st.live, st.freelist,
+                 st.carved);
+        res->Append(line);
+    }
+    res->Append("-- mapped pools (descriptor resolution scope) --\n");
+    res->Append(pool_registry::DebugString());
+    snprintf(line, sizeof(line), "resolves %llu\nresolve_failures %llu\n",
+             (unsigned long long)pool_registry::resolves(),
+             (unsigned long long)pool_registry::resolve_failures());
+    res->Append(line);
+}
+
 // /tenants: the multi-tenant QoS tier (ISSUE 8) — configured quotas,
 // live fair-queue depth, and per-tenant admitted/shed/queued counters
 // with the served-latency p99. The same numbers ride /metrics as the
@@ -658,6 +733,9 @@ void HandleMetrics(Server*, const HttpRequest&, HttpResponse* res) {
 }  // namespace
 
 void AddBuiltinHttpServices(Server* server) {
+    // The /pools + /metrics pages report the lease families even on a
+    // server that never pinned a block (0 is data; absent is not).
+    block_lease::ExposeVars();
     server->RegisterHttpHandler("/", HandleIndex);
     server->RegisterHttpHandler("/health", HandleHealth);
     server->RegisterHttpHandler("/status", HandleStatus);
@@ -681,6 +759,7 @@ void AddBuiltinHttpServices(Server* server) {
     server->RegisterHttpHandler("/hotspots/contention",
                                 HandleHotspotsContention);
     server->RegisterHttpHandler("/chaos", HandleChaos);
+    server->RegisterHttpHandler("/pools", HandlePools);
     server->RegisterHttpHandler("/metrics", HandleMetrics);
 }
 

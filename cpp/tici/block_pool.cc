@@ -18,6 +18,7 @@
 #include "tbase/iobuf.h"
 #include "tbase/logging.h"
 #include "tbase/fast_rand.h"
+#include "tnet/fault_injection.h"
 
 namespace tpurpc {
 
@@ -356,6 +357,9 @@ SlabClass& slab_class(int cls) {
 std::atomic<size_t> g_slab_live{0};
 std::atomic<size_t> g_slab_recycled{0};
 std::atomic<size_t> g_slab_mutex_acquisitions{0};
+// Per-class occupancy for /pools (relaxed: diagnostic, not invariant).
+std::atomic<size_t> g_class_live[kSlabClasses] = {};
+std::atomic<size_t> g_class_carved[kSlabClasses] = {};
 
 int slab_class_of(size_t n) {
     for (int c = 0; c < kSlabClasses; ++c) {
@@ -412,6 +416,17 @@ size_t IciBlockPool::slab_mutex_acquisitions() {
     return g_slab_mutex_acquisitions.load(std::memory_order_relaxed);
 }
 
+IciBlockPool::SlabClassStat IciBlockPool::slab_class_stat(int cls) {
+    SlabClassStat st;
+    if (cls < 0 || cls >= kSlabClasses) return st;
+    st.live = g_class_live[cls].load(std::memory_order_relaxed);
+    st.carved = g_class_carved[cls].load(std::memory_order_relaxed);
+    SlabClass& sc = slab_class(cls);
+    std::lock_guard<std::mutex> g(sc.mu);
+    st.freelist = sc.freelist.size();
+    return st;
+}
+
 void* IciBlockPool::AllocateSlab(size_t n) {
     const int cls = slab_class_of(n);
     if (cls < 0) {
@@ -423,6 +438,7 @@ void* IciBlockPool::AllocateSlab(size_t n) {
     if (tls.n[cls] > 0) {
         void* p = tls.slots[cls][--tls.n[cls]];
         g_slab_live.fetch_add(1, std::memory_order_relaxed);
+        g_class_live[cls].fetch_add(1, std::memory_order_relaxed);
         g_slab_recycled.fetch_add(1, std::memory_order_relaxed);
         return p;
     }
@@ -434,6 +450,7 @@ void* IciBlockPool::AllocateSlab(size_t n) {
         void* p = sc.freelist.back();
         sc.freelist.pop_back();
         g_slab_live.fetch_add(1, std::memory_order_relaxed);
+        g_class_live[cls].fetch_add(1, std::memory_order_relaxed);
         g_slab_recycled.fetch_add(1, std::memory_order_relaxed);
         return p;
     }
@@ -469,6 +486,8 @@ void* IciBlockPool::AllocateSlab(size_t n) {
     void* p = sc.carve_base + sc.carve_off;
     sc.carve_off += slot;
     g_slab_live.fetch_add(1, std::memory_order_relaxed);
+    g_class_live[cls].fetch_add(1, std::memory_order_relaxed);
+    g_class_carved[cls].fetch_add(1, std::memory_order_relaxed);
     return p;
 }
 
@@ -477,6 +496,7 @@ void IciBlockPool::FreeSlab(void* p) {
     const int cls = arena_class_of(p);
     if (cls < 0) return;  // oversized/non-slab carve: process lifetime
     g_slab_live.fetch_sub(1, std::memory_order_relaxed);
+    g_class_live[cls].fetch_sub(1, std::memory_order_relaxed);
     TlsSlabCache& tls = g_tls_slabs;
     if (tls.n[cls] < kTlsSlotsPerClass) {
         tls.slots[cls][tls.n[cls]++] = p;
@@ -533,6 +553,7 @@ namespace {
 struct Mapping {
     const char* base;
     size_t size;
+    uint64_t epoch;
 };
 // Immortal (same teardown-order rationale as the shm_link peer-pool
 // registry: resolution can run from Socket recycling during exit).
@@ -557,10 +578,11 @@ uint64_t IdFromName(const char* name) {
     return h != 0 ? h : 1;  // 0 is reserved for "no pool"
 }
 
-void Register(uint64_t id, const char* base, size_t size) {
+void Register(uint64_t id, const char* base, size_t size,
+              uint64_t epoch) {
     if (id == 0 || base == nullptr) return;
     std::lock_guard<std::mutex> g(reg_mu());
-    reg()[id] = Mapping{base, size};
+    reg()[id] = Mapping{base, size, epoch != 0 ? epoch : 1};
 }
 
 void Unregister(uint64_t id) {
@@ -568,7 +590,22 @@ void Unregister(uint64_t id) {
     reg().erase(id);
 }
 
-bool Resolve(uint64_t id, const char** base, size_t* size) {
+void SetEpoch(uint64_t id, uint64_t epoch) {
+    std::lock_guard<std::mutex> g(reg_mu());
+    auto it = reg().find(id);
+    if (it != reg().end()) it->second.epoch = epoch != 0 ? epoch : 1;
+}
+
+void RaiseEpoch(uint64_t id, uint64_t epoch) {
+    std::lock_guard<std::mutex> g(reg_mu());
+    auto it = reg().find(id);
+    if (it != reg().end() && epoch > it->second.epoch) {
+        it->second.epoch = epoch;
+    }
+}
+
+bool Resolve(uint64_t id, const char** base, size_t* size,
+             uint64_t* epoch) {
     std::lock_guard<std::mutex> g(reg_mu());
     auto it = reg().find(id);
     if (it == reg().end()) {
@@ -578,7 +615,23 @@ bool Resolve(uint64_t id, const char** base, size_t* size) {
     g_resolves.fetch_add(1, std::memory_order_relaxed);
     *base = it->second.base;
     *size = it->second.size;
+    if (epoch != nullptr) *epoch = it->second.epoch;
     return true;
+}
+
+std::string DebugString() {
+    std::string out;
+    char line[128];
+    std::lock_guard<std::mutex> g(reg_mu());
+    for (const auto& kv : reg()) {
+        snprintf(line, sizeof(line),
+                 "pool %llu size=%zu epoch=%llu local=%d\n",
+                 (unsigned long long)kv.first, kv.second.size,
+                 (unsigned long long)kv.second.epoch,
+                 kv.first == IciBlockPool::pool_id() ? 1 : 0);
+        out += line;
+    }
+    return out;
 }
 
 uint64_t resolves() { return g_resolves.load(std::memory_order_relaxed); }
@@ -592,6 +645,28 @@ uint64_t IciBlockPool::pool_id() {
     PoolState& p = pool();
     if (p.shm_name[0] == '\0') return 0;
     return pool_registry::IdFromName(p.shm_name);
+}
+
+// ---------------- epoch fencing (ISSUE 10b) ----------------
+
+namespace {
+// 1 once the pool exists; bumped on remap/restart events. A descriptor
+// minted under epoch N is only honored while the mapping is at N.
+std::atomic<uint64_t> g_pool_epoch{1};
+}  // namespace
+
+uint64_t IciBlockPool::pool_epoch() {
+    return g_pool_epoch.load(std::memory_order_acquire);
+}
+
+uint64_t IciBlockPool::BumpEpoch() {
+    const uint64_t e =
+        g_pool_epoch.fetch_add(1, std::memory_order_acq_rel) + 1;
+    // Keep the in-process registry honest: handlers resolving our OWN
+    // descriptors (loopback links) must see the new generation too.
+    const uint64_t id = pool_id();
+    if (id != 0) pool_registry::SetEpoch(id, e);
+    return e;
 }
 
 // ---------------- device staging ring (ISSUE 9a) ----------------
@@ -658,16 +733,23 @@ DeviceStagingRing::~DeviceStagingRing() {
 int DeviceStagingRing::Acquire(int64_t timeout_us) {
     RingSync* sync = (RingSync*)mu_;
     std::unique_lock<std::mutex> lk(sync->mu);
-    const auto window_free = [this] {
-        return head_.load(std::memory_order_relaxed) -
-                   tail_.load(std::memory_order_relaxed) <
-               depth_;
+    // Wake on EITHER a free slot or an abort: a poisoned ring (device
+    // stream error, shutdown) must unblock parked Python threads
+    // immediately instead of letting them wedge to their timeout.
+    const auto ready = [this] {
+        return aborted_.load(std::memory_order_relaxed) ||
+               head_.load(std::memory_order_relaxed) -
+                       tail_.load(std::memory_order_relaxed) <
+                   depth_;
     };
     if (timeout_us < 0) {
-        sync->cv.wait(lk, window_free);
+        sync->cv.wait(lk, ready);
     } else if (!sync->cv.wait_for(lk, std::chrono::microseconds(timeout_us),
-                                  window_free)) {
+                                  ready)) {
         return -1;
+    }
+    if (aborted_.load(std::memory_order_relaxed)) {
+        return -2;
     }
     const uint64_t seq = head_.fetch_add(1, std::memory_order_relaxed);
     const uint32_t inflight =
@@ -678,7 +760,30 @@ int DeviceStagingRing::Acquire(int64_t timeout_us) {
     return (int)(seq % depth_);
 }
 
+void DeviceStagingRing::Abort() {
+    RingSync* sync = (RingSync*)mu_;
+    {
+        std::lock_guard<std::mutex> lk(sync->mu);
+        aborted_.store(true, std::memory_order_release);
+    }
+    sync->cv.notify_all();
+}
+
 int DeviceStagingRing::Complete(uint32_t slot) {
+    // Chaos seam (chaos_pool, ISSUE 10d): a delayed or dropped device
+    // completion — the ring analog of a lost DMA interrupt. Decided
+    // OUTSIDE the ring mutex; plain usleep, this path runs on Python /
+    // driver threads, never fibers. A dropped complete leaves the
+    // window stuck: Acquire's timeout (or Abort) is the proven escape.
+    if (__builtin_expect(fault_injection_enabled(), 0)) {
+        const FaultAction fault =
+            FaultInjection::Decide(FaultOp::kRingComplete, EndPoint(), 0);
+        if (fault.kind == FaultAction::kDelay) {
+            usleep((useconds_t)fault.delay_us);
+        } else if (fault.kind == FaultAction::kDrop) {
+            return 0;  // claimed done, never completed
+        }
+    }
     RingSync* sync = (RingSync*)mu_;
     std::lock_guard<std::mutex> lk(sync->mu);
     const uint64_t head = head_.load(std::memory_order_relaxed);
@@ -731,7 +836,8 @@ int IciBlockPool::Init(size_t region_bytes) {
     // ourselves) resolve against the same registry peers use.
     if (pool().shm_name[0] != '\0') {
         pool_registry::Register(pool_registry::IdFromName(pool().shm_name),
-                                pool().shm_base, pool().shm_size);
+                                pool().shm_base, pool().shm_size,
+                                pool_epoch());
     }
     // From here on every new IOBuf block is transferable memory (the
     // TLS block cache only recycles blocks whose deallocator matches the

@@ -20,6 +20,7 @@
 #include <atomic>
 #include <cstddef>
 #include <cstdint>
+#include <string>
 
 namespace tpurpc {
 
@@ -77,6 +78,15 @@ public:
     static size_t slab_allocated();
     static size_t slab_recycled();
     static size_t slab_mutex_acquisitions();
+    // Per-class occupancy (the /pools page): live slots, freelist depth
+    // (central list only — TLS-cached slots count as live-capable but
+    // not listed), and slots carved so far.
+    struct SlabClassStat {
+        size_t live = 0;
+        size_t freelist = 0;
+        size_t carved = 0;
+    };
+    static SlabClassStat slab_class_stat(int cls);
 
     // Build a single-block IOBuf of n writable bytes inside the SHARED
     // registered pool — the eligible shape for one-sided descriptors
@@ -105,6 +115,20 @@ public:
     // pool_id of one-sided descriptors posted from this pool.
     static uint64_t pool_id();
 
+    // ---- epoch fencing (ISSUE 10b) ----
+    // Generation of this process's pool mapping: 1 at Init, bumped on
+    // any create/remap/restart event (and by chaos/tests). Descriptors
+    // carry the epoch they were minted under (RpcMeta.pool_attachment.
+    // pool_epoch); a receiver resolving against a mapping whose epoch
+    // differs fails ONLY that call with the retriable TERR_STALE_EPOCH —
+    // a stale reference must never take down the connection or the
+    // process, just trigger a re-handshake/remap upstream.
+    static uint64_t pool_epoch();
+    // Bump the local pool's generation (simulated remap/restart; also
+    // re-stamps the pool's own registry entry so in-process resolution
+    // stays consistent). Returns the new epoch.
+    static uint64_t BumpEpoch();
+
     static bool initialized();
     static size_t allocated_blocks();  // live default-size blocks
     static size_t free_blocks();       // freelist depth
@@ -118,17 +142,34 @@ public:
 // reads the bytes in place — the one-sided read of the transfer.
 namespace pool_registry {
 uint64_t IdFromName(const char* name);  // FNV-1a 64 over the shm name
-void Register(uint64_t id, const char* base, size_t size);
+// `epoch` is the pool generation this mapping was made under (learned
+// from the owner at handshake; the local pool registers its own).
+void Register(uint64_t id, const char* base, size_t size,
+              uint64_t epoch = 1);
 void Unregister(uint64_t id);
+// Re-stamp a mapping's generation without remapping (the local pool's
+// BumpEpoch, and chaos-driven staleness in tests). Absolute write —
+// test hook; production paths use RaiseEpoch.
+void SetEpoch(uint64_t id, uint64_t epoch);
+// Monotonic re-stamp: only raises the mapping's generation. The
+// handshake path uses this — a slow/racing link whose response was
+// written before the owner's bump must not REGRESS the epoch (stale
+// descriptors would then pass the fence again).
+void RaiseEpoch(uint64_t id, uint64_t epoch);
 // True + the mapped span when id is known. The span stays valid while
 // the mapping is held (local pool: process lifetime; peer pools: while
 // any link to that peer lives — the Socket holding the descriptor's
 // connection holds the link, so resolution during request processing is
-// safe).
-bool Resolve(uint64_t id, const char** base, size_t* size);
+// safe). `epoch` (when non-null) receives the mapping's generation for
+// the caller's stale-descriptor fence.
+bool Resolve(uint64_t id, const char** base, size_t* size,
+             uint64_t* epoch = nullptr);
 // Resolution stats (tests + /vars).
 uint64_t resolves();
 uint64_t resolve_failures();
+// One "pool <id> size=<n> epoch=<e> local=<0|1>" line per mapping (the
+// /pools page body).
+std::string DebugString();
 }  // namespace pool_registry
 
 // ---- device staging ring (ISSUE 9a) ----
@@ -151,14 +192,26 @@ public:
     static DeviceStagingRing* Create(uint32_t depth, size_t slot_bytes);
     ~DeviceStagingRing();
 
-    // Next slot in FIFO order; blocks up to timeout_us (<0 = forever)
-    // while all depth slots are in flight. Returns the slot index or -1
-    // on timeout.
+    // Next slot in FIFO order; blocks up to timeout_us (<0 = forever,
+    // 0 = non-blocking try) while all depth slots are in flight.
+    // Returns the slot index, -1 on timeout, or -2 once the ring is
+    // aborted (waiters unblock immediately — the deadline/cancellation
+    // contract of ISSUE 10c).
     int Acquire(int64_t timeout_us);
     // Mark slot done. Out-of-order completes are held; the slot is
     // reusable once all earlier acquires completed. Returns 0, or -1
-    // for an index that is not currently in flight.
+    // for an index that is not currently in flight. Chaos may delay or
+    // drop a complete (chaos_pool ring_delay/ring_drop): a dropped
+    // complete returns 0 but never advances the window — exactly the
+    // lost-completion failure Acquire's timeout path must survive.
     int Complete(uint32_t slot);
+    // Poison the ring (device stream error / shutdown): every parked and
+    // future Acquire returns -2 instead of wedging a Python thread
+    // forever; in-flight Completes still settle accounting.
+    void Abort();
+    bool aborted() const {
+        return aborted_.load(std::memory_order_acquire);
+    }
 
     char* slot(uint32_t i) { return slots_[i % depth_]; }
     uint32_t depth() const { return depth_; }
@@ -194,6 +247,7 @@ private:
     std::atomic<uint64_t> tail_{0};       // contiguously-completed count
     std::atomic<uint64_t> completed_{0};  // total completes
     std::atomic<uint32_t> highwater_{0};
+    std::atomic<bool> aborted_{false};    // poisoned: Acquire returns -2
 };
 
 }  // namespace tpurpc

@@ -70,7 +70,8 @@ bool valid_shm_name(const char* name) {
     return strnlen(name, 64) < 64;
 }
 
-int AcquirePeerPool(const char* name, size_t size, PeerPool* out) {
+int AcquirePeerPool(const char* name, size_t size, uint64_t epoch,
+                    PeerPool* out) {
     if (!valid_shm_name(name) || size == 0 || size > (4ull << 30)) {
         errno = EINVAL;
         return -1;
@@ -84,6 +85,18 @@ int AcquirePeerPool(const char* name, size_t size, PeerPool* out) {
             return -1;
         }
         ++it->second.refs;
+        // A later link re-announcing a NEWER generation re-stamps the
+        // shared mapping: the owner remapped/restarted parts of its
+        // pool, and descriptors minted before the bump must now fence.
+        // Monotonic (RaiseEpoch): a slow handshake whose response was
+        // written BEFORE the owner's bump must not regress the epoch
+        // and re-admit genuinely stale descriptors.
+        if (epoch != 0) {
+            const uint64_t id = pool_registry::IdFromName(name);
+            if (id != IciBlockPool::pool_id()) {
+                pool_registry::RaiseEpoch(id, epoch);
+            }
+        }
         out->base = it->second.base;
         out->size = it->second.size;
         return 0;
@@ -111,7 +124,8 @@ int AcquirePeerPool(const char* name, size_t size, PeerPool* out) {
     // link teardown unregister the local pool for good.
     const uint64_t id = pool_registry::IdFromName(name);
     if (id != IciBlockPool::pool_id()) {
-        pool_registry::Register(id, (char*)mem, size);
+        pool_registry::Register(id, (char*)mem, size,
+                                epoch != 0 ? epoch : 1);
     }
     out->base = (char*)mem;
     out->size = size;
@@ -632,10 +646,11 @@ int IciConnect(const EndPoint& server, InputMessenger* messenger,
     HandshakeRequest req;
     memset(&req, 0, sizeof(req));
     memcpy(req.magic, "TICI", 4);
-    req.version = 1;
+    req.version = shm_internal::kIciHandshakeVersion;
     snprintf(req.pool_name, sizeof(req.pool_name), "%s",
              IciBlockPool::shm_name());
     req.pool_size = IciBlockPool::shm_size();
+    req.pool_epoch = IciBlockPool::pool_epoch();
     snprintf(req.link_name, sizeof(req.link_name), "%s", link_name);
     req.link_size = sizeof(ShmLinkCtrl);
     if (write_all_timeout(fd, &req, sizeof(req), timeout_ms) != 0) {
@@ -659,10 +674,11 @@ int IciConnect(const EndPoint& server, InputMessenger* messenger,
     }
     rsp.pool_name[sizeof(rsp.pool_name) - 1] = '\0';
 
-    // 4. Map the server's registered memory.
+    // 4. Map the server's registered memory (recording its announced
+    //    pool generation for the stale-descriptor fence).
     PeerPool pp;
-    if (shm_internal::AcquirePeerPool(rsp.pool_name, rsp.pool_size, &pp) !=
-        0) {
+    if (shm_internal::AcquirePeerPool(rsp.pool_name, rsp.pool_size,
+                                      rsp.pool_epoch, &pp) != 0) {
         close(fd);
         return fail("map server pool");
     }
@@ -749,7 +765,8 @@ void ProcessIciHandshake(InputMessageBase* msg_base) {
     PeerPool pp{nullptr, 0};
     int err = 0;
     do {
-        if (req.version != 1 || req.link_size != sizeof(ShmLinkCtrl) ||
+        if (req.version != shm_internal::kIciHandshakeVersion ||
+            req.link_size != sizeof(ShmLinkCtrl) ||
             !shm_internal::valid_shm_name(req.link_name)) {
             err = TERR_REQUEST;  // version/ABI mismatch or bad shm name
             break;
@@ -787,7 +804,7 @@ void ProcessIciHandshake(InputMessageBase* msg_base) {
         }
         std::atomic_thread_fence(std::memory_order_acquire);
         if (shm_internal::AcquirePeerPool(req.pool_name, req.pool_size,
-                                          &pp) != 0) {
+                                          req.pool_epoch, &pp) != 0) {
             err = errno != 0 ? errno : ENOENT;
             break;
         }
@@ -819,6 +836,7 @@ void ProcessIciHandshake(InputMessageBase* msg_base) {
     snprintf(rsp.pool_name, sizeof(rsp.pool_name), "%s",
              IciBlockPool::shm_name());
     rsp.pool_size = IciBlockPool::shm_size();
+    rsp.pool_epoch = IciBlockPool::pool_epoch();
     if (write_all_timeout(s->fd(), &rsp, sizeof(rsp), 1000) != 0) {
         s->SetFailedWithError(TERR_FAILED_SOCKET);
         return;

@@ -90,6 +90,16 @@ struct ShmLinkCtrl {
 
 // Handshake frames exchanged over the TCP connection before the data
 // plane starts (the ProcessHandshakeAtClient/AtServer analog).
+//
+// Version 2 (ISSUE 10): the structs grew a raw pool_epoch field, which
+// changes their SIZE — and the exchange is a fixed-size raw read, so a
+// version-1 peer would either starve the parser (shorter request) or
+// leave trailing bytes to be mis-sniffed (longer one). The bumped
+// version makes the mismatch an explicit clean rejection instead; the
+// "epoch 0 = fence disabled" escape below is for same-size forward
+// compatibility only.
+constexpr uint32_t kIciHandshakeVersion = 2;
+
 struct HandshakeRequest {
     char magic[4];  // "TICI"
     uint32_t version;
@@ -97,6 +107,11 @@ struct HandshakeRequest {
     uint64_t pool_size;
     char link_name[64];  // control segment (created by client)
     uint64_t link_size;
+    // Pool generation at handshake time (epoch fencing, ISSUE 10b): the
+    // receiver records it on the mapping; descriptors carrying a
+    // different epoch are fenced with TERR_STALE_EPOCH. 0 from
+    // pre-epoch binaries = fence disabled for that peer.
+    uint64_t pool_epoch;
 };
 
 struct HandshakeResponse {
@@ -104,6 +119,7 @@ struct HandshakeResponse {
     uint32_t status;     // 0 = ok, else terrno
     char pool_name[64];  // server's pool shm segment
     uint64_t pool_size;
+    uint64_t pool_epoch;  // server pool generation (see HandshakeRequest)
 };
 
 // Process-global registry of mapped peer pools (one mapping per peer
@@ -112,7 +128,10 @@ struct PeerPool {
     char* base;
     size_t size;
 };
-int AcquirePeerPool(const char* name, size_t size, PeerPool* out);
+// `epoch` is the owner's pool generation announced in the handshake
+// (registered with the mapping for the stale-descriptor fence).
+int AcquirePeerPool(const char* name, size_t size, uint64_t epoch,
+                    PeerPool* out);
 void ReleasePeerPool(const char* name);
 // True when `name` is a safe single-component shm name ("/x...").
 bool valid_shm_name(const char* name);

@@ -41,10 +41,14 @@ void CircuitBreaker::Reset() {
 // — isolating it would tear down the shared connection for every tenant
 // (including the protected ones) and amplify the very storm being shed;
 // steering happens through the LB feedback/backoff instead.
+// TERR_STALE_EPOCH likewise: an epoch fence rejecting one stale
+// descriptor proves the server is protecting itself correctly, not
+// failing.
 static bool ClientLocalError(int error_code) {
     return error_code == ECANCELED || error_code == TERR_OVERCROWDED ||
            error_code == TERR_BACKUP_REQUEST ||
-           error_code == TERR_OVERLOAD;
+           error_code == TERR_OVERLOAD ||
+           error_code == TERR_STALE_EPOCH;
 }
 
 bool CircuitBreaker::OnCallEnd(int error_code, int64_t latency_us) {

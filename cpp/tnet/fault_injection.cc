@@ -25,9 +25,12 @@ DEFINE_int64(chaos_seed, 1,
 DEFINE_string(chaos_plan, "",
               "comma list of kind=probability[:param] entries; kinds: "
               "drop, delay (param = microseconds, default 2000), short, "
-              "corrupt, reset (read/write ops) and refuse "
-              "(accept/connect); e.g. "
-              "'drop=0.01,delay=0.05:2000,corrupt=0.001,refuse=0.1'");
+              "corrupt, reset (read/write ops), refuse "
+              "(accept/connect), and the zero-copy pool seams "
+              "pool_corrupt, pool_stale (descriptor resolve), "
+              "pool_leak (pinned-block release), ring_delay (param = "
+              "microseconds), ring_drop (staging-ring completes); e.g. "
+              "'drop=0.01,delay=0.05:2000,pool_stale=0.2,ring_drop=0.1'");
 DEFINE_string(chaos_peers, "",
               "comma list of ip:port remote endpoints the plan applies "
               "to; empty = all peers. Non-matching traffic neither "
@@ -56,7 +59,8 @@ inline double to_unit(uint64_t r) {
 // Kind -> name, indexed by FaultAction::Kind (tvar suffixes AND the
 // /chaos page lines — one table so they can never desynchronize).
 const char* const kKindNames[FaultAction::kKindCount] = {
-    "none", "delay", "short", "drop", "corrupt", "reset", "refuse"};
+    "none",    "delay", "short",  "drop",
+    "corrupt", "reset", "refuse", "stale_epoch"};
 
 struct FaultPlan {
     // Read/write fault probabilities (selected by one uniform draw over
@@ -68,7 +72,16 @@ struct FaultPlan {
     double reset = 0.0;
     // Accept/connect-time probability.
     double refuse = 0.0;
+    // Zero-copy pool/ring seams (ISSUE 10d): descriptor-resolve crc
+    // corruption and stale-epoch injection, leaked-pin simulation at
+    // release, delayed/dropped staging-ring completes.
+    double pool_corrupt = 0.0;
+    double pool_stale = 0.0;
+    double pool_leak = 0.0;
+    double ring_delay = 0.0;
+    double ring_drop = 0.0;
     int64_t delay_us = 2000;
+    int64_t ring_delay_us = 2000;
     std::vector<EndPoint> peers;  // empty = every peer
 
     bool Matches(const EndPoint& peer) const {
@@ -136,22 +149,27 @@ bool ParsePlan(const std::string& text, FaultPlan* plan) {
                           &prob)) {
             return false;
         }
-        // Only delay takes a :param (microseconds); junk like "5ms" or a
-        // param on another kind must REJECT, not silently half-apply
-        // (the /chaos page promises validate-before-mutate).
-        if (!param_str.empty() && kind != "delay") return false;
+        // Only the delay kinds take a :param (microseconds); junk like
+        // "5ms" or a param on another kind must REJECT, not silently
+        // half-apply (the /chaos page promises validate-before-mutate).
+        if (!param_str.empty() && kind != "delay" && kind != "ring_delay") {
+            return false;
+        }
+        const auto parse_us = [&](int64_t* out) {
+            if (param_str.empty()) return true;
+            char* end = nullptr;
+            const long long us = strtoll(param_str.c_str(), &end, 10);
+            if (end == param_str.c_str() || *end != '\0' || us <= 0) {
+                return false;
+            }
+            *out = us;
+            return true;
+        };
         if (kind == "drop") {
             plan->drop = prob;
         } else if (kind == "delay") {
             plan->delay = prob;
-            if (!param_str.empty()) {
-                char* end = nullptr;
-                const long long us = strtoll(param_str.c_str(), &end, 10);
-                if (end == param_str.c_str() || *end != '\0' || us <= 0) {
-                    return false;
-                }
-                plan->delay_us = us;
-            }
+            if (!parse_us(&plan->delay_us)) return false;
         } else if (kind == "short") {
             plan->short_io = prob;
         } else if (kind == "corrupt") {
@@ -160,6 +178,17 @@ bool ParsePlan(const std::string& text, FaultPlan* plan) {
             plan->reset = prob;
         } else if (kind == "refuse") {
             plan->refuse = prob;
+        } else if (kind == "pool_corrupt") {
+            plan->pool_corrupt = prob;
+        } else if (kind == "pool_stale") {
+            plan->pool_stale = prob;
+        } else if (kind == "pool_leak") {
+            plan->pool_leak = prob;
+        } else if (kind == "ring_delay") {
+            plan->ring_delay = prob;
+            if (!parse_us(&plan->ring_delay_us)) return false;
+        } else if (kind == "ring_drop") {
+            plan->ring_drop = prob;
         } else {
             return false;
         }
@@ -261,8 +290,11 @@ FaultAction FaultInjection::Decide(FaultOp op, const EndPoint& peer,
     DoublyBufferedData<FaultPlan>::ScopedPtr p;
     if (e.plan.Read(&p) != 0) return action;
     // Scope check BEFORE consuming a tick: unrelated traffic must not
-    // shift the replayed sequence.
-    if (!p->Matches(peer)) return action;
+    // shift the replayed sequence. The staging ring has NO peer (its
+    // completions come from the local device stream), so a per-peer
+    // plan must not silently disable ring_delay/ring_drop — ring
+    // decisions bypass the filter.
+    if (op != FaultOp::kRingComplete && !p->Matches(peer)) return action;
     const uint64_t n = e.seq.fetch_add(1, std::memory_order_relaxed);
     const uint64_t r =
         splitmix64(e.seed.load(std::memory_order_relaxed) +
@@ -271,6 +303,30 @@ FaultAction FaultInjection::Decide(FaultOp op, const EndPoint& peer,
     e.ndecisions << 1;
     if (op == FaultOp::kAccept || op == FaultOp::kConnect) {
         if (u < p->refuse) action.kind = FaultAction::kRefuse;
+    } else if (op == FaultOp::kPoolResolve) {
+        // Descriptor resolve: corrupt the crc verdict or inject a stale
+        // pool epoch — both must fail ONLY the call (TERR_REQUEST /
+        // TERR_STALE_EPOCH), never the connection. (No aux byte
+        // position: the peer pool is mapped read-only, so "corrupt"
+        // means the verdict, not the bytes.)
+        double acc = 0.0;
+        if (u < (acc += p->pool_corrupt)) {
+            action.kind = FaultAction::kCorrupt;
+        } else if (u < (acc += p->pool_stale)) {
+            action.kind = FaultAction::kStaleEpoch;
+        }
+    } else if (op == FaultOp::kRingComplete) {
+        double acc = 0.0;
+        if (u < (acc += p->ring_drop)) {
+            action.kind = FaultAction::kDrop;
+        } else if (u < (acc += p->ring_delay)) {
+            action.kind = FaultAction::kDelay;
+            action.delay_us = p->ring_delay_us;
+        }
+    } else if (op == FaultOp::kLeaseRelease) {
+        // Leaked-pin simulation: EndRPC "forgets" the release; the
+        // expiry reaper must reclaim it (rpc_pool_reaped > 0).
+        if (u < p->pool_leak) action.kind = FaultAction::kDrop;
     } else {
         double acc = 0.0;
         if (u < (acc += p->drop)) {

@@ -41,6 +41,11 @@ enum class FaultOp {
     kRead = 1,     // inbound bytes (fd read / TLS read / shm pump)
     kAccept = 2,   // server accept time
     kConnect = 3,  // client connect time
+    // Zero-copy data-path seams (ISSUE 10d) — the pool/ring layer the
+    // PR-1 chaos plan never reached:
+    kPoolResolve = 4,   // server-side descriptor resolve (crc / epoch)
+    kRingComplete = 5,  // device staging-ring completion
+    kLeaseRelease = 6,  // pinned-block release at EndRPC (leak sim)
 };
 
 // What the consulting seam should do.
@@ -53,6 +58,10 @@ struct FaultAction {
         kCorrupt,  // flip one byte of the payload (crc32c's job to catch)
         kReset,    // fail the operation with ECONNRESET
         kRefuse,   // refuse the connection (accept/connect only)
+        // Pool-descriptor staleness (kPoolResolve only): resolve as if
+        // the descriptor's pool_epoch predated the mapping — the call
+        // must fail retriable (TERR_STALE_EPOCH), never the connection.
+        kStaleEpoch,
         kKindCount  // sentinel (counter array size)
     };
     Kind kind = kNone;

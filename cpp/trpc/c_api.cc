@@ -6,6 +6,7 @@
 #include "rpc_meta.pb.h"
 #include "tbase/crc32c.h"
 #include "tbase/iobuf.h"
+#include "tici/block_lease.h"
 #include "tici/block_pool.h"
 #include "trpc/pb_compat.h"
 #include "trpc/policy_tpu_std.h"
@@ -61,6 +62,17 @@ long tpurpc_slab_recycled() {
 
 uint64_t tpurpc_pool_id() { return tpurpc::IciBlockPool::pool_id(); }
 
+uint64_t tpurpc_pool_epoch() {
+    return tpurpc::IciBlockPool::pool_epoch();
+}
+
+uint64_t tpurpc_lease_pinned() { return tpurpc::block_lease::pinned(); }
+
+uint64_t tpurpc_lease_reaped() {
+    return tpurpc::block_lease::expired_reaped() +
+           tpurpc::block_lease::peer_released();
+}
+
 void* tpurpc_ring_create(uint32_t depth, size_t slot_bytes) {
     return tpurpc::DeviceStagingRing::Create(depth, slot_bytes);
 }
@@ -75,6 +87,14 @@ int tpurpc_ring_acquire(void* ring, long timeout_us) {
 
 int tpurpc_ring_complete(void* ring, uint32_t slot) {
     return ((tpurpc::DeviceStagingRing*)ring)->Complete(slot);
+}
+
+void tpurpc_ring_abort(void* ring) {
+    ((tpurpc::DeviceStagingRing*)ring)->Abort();
+}
+
+int tpurpc_ring_aborted(void* ring) {
+    return ((tpurpc::DeviceStagingRing*)ring)->aborted() ? 1 : 0;
 }
 
 void* tpurpc_ring_slot(void* ring, uint32_t slot) {

@@ -45,15 +45,30 @@ uint64_t tpurpc_pool_id();
 // out slots in FIFO order, blocking up to timeout_us (<0 = forever)
 // while all slots are in flight; Complete releases them (out-of-order
 // completes are held until the predecessors finish).
+// Acquire returns the slot index, -1 on timeout, -2 once the ring is
+// aborted (poisoned): a device-stream error must unblock parked Python
+// threads instead of wedging them forever (ISSUE 10c).
 void* tpurpc_ring_create(uint32_t depth, size_t slot_bytes);
 void tpurpc_ring_destroy(void* ring);
 int tpurpc_ring_acquire(void* ring, long timeout_us);
 int tpurpc_ring_complete(void* ring, uint32_t slot);
+// Poison the ring: every parked and future acquire returns -2.
+void tpurpc_ring_abort(void* ring);
+int tpurpc_ring_aborted(void* ring);
 void* tpurpc_ring_slot(void* ring, uint32_t slot);
 size_t tpurpc_ring_slot_bytes(void* ring);
 uint32_t tpurpc_ring_depth(void* ring);
 int tpurpc_ring_registered(void* ring);
 uint64_t tpurpc_ring_inflight_highwater(void* ring);
+
+// ---- block leases (ISSUE 10a) ----
+// Crash-safety counters of the pinned-block lease registry
+// (tici/block_lease.h): live pins, expiry-reaped pins, and the local
+// pool's current epoch — the leak/staleness evidence the device-ring
+// tests and bench.py record.
+uint64_t tpurpc_lease_pinned();
+uint64_t tpurpc_lease_reaped();
+uint64_t tpurpc_pool_epoch();
 
 // Frame `payload` as one tpu_std frame: "TRPC" header + RpcMeta
 // {correlation_id, body_checksum=crc32c(payload)} + payload as raw

@@ -19,7 +19,9 @@
 #include "tnet/socket_map.h"
 #include "trpc/channel.h"
 #include "trpc/lb_with_naming.h"
+#include "tici/block_lease.h"
 #include "tici/block_pool.h"
+#include "tnet/fault_injection.h"
 #include "trpc/pb_compat.h"
 #include "trpc/retry_policy.h"
 #include "trpc/policy_tpu_std.h"
@@ -54,8 +56,15 @@ static LazyAdder g_pool_desc_bytes("rpc_pool_descriptor_send_bytes");
 // Ineligible set_request_pool_attachment calls folded back to the
 // inline path (multi-block or non-shared memory).
 static LazyAdder g_pool_desc_fallbacks("rpc_pool_descriptor_fallbacks");
+// Leases released by EndRPC that were ALREADY reclaimed underneath the
+// call (expiry reaper / peer death): the stale-descriptor signature.
+static LazyAdder g_pool_lease_gone("rpc_pool_lease_already_reclaimed");
 
 void Controller::set_request_pool_attachment(IOBuf&& buf) {
+    // A second call replaces the first attachment: release the prior
+    // lease or its pin would be orphaned for good (overwriting the id
+    // alone leaks the slab slot).
+    ReleasePoolLease();
     // Eligibility is decided HERE, once, not per retry: the bytes must
     // be one contiguous block ref inside the shared registered pool so
     // a single (offset, len) names them all. Anything else falls back
@@ -69,21 +78,50 @@ void Controller::set_request_pool_attachment(IOBuf&& buf) {
         IciBlockPool::OffsetOf(data, &off) &&
         IciBlockPool::pool_id() != 0) {
         // Stash the resolved descriptor (crc computed ONCE — retries
-        // re-send the same reference without re-reading the bytes).
+        // re-send the same reference without re-reading the bytes) and
+        // hand the pin to the lease registry: from here the block's
+        // lifetime is crash-safe (exactly-once release, expiry reaper,
+        // peer-death reclamation) instead of riding this controller.
         pool_attachment_.data = data;
         pool_attachment_.length = flen;
         pool_attachment_.pool_id = IciBlockPool::pool_id();
         pool_attachment_.offset = off;
         pool_attachment_.crc32c = crc32c_extend(0, data, flen);
-        request_pool_buf_ = std::move(buf);
+        pool_attachment_.pool_epoch = IciBlockPool::pool_epoch();
+        pool_lease_id_ = block_lease::Pin(std::move(buf));
         return;
     }
     *g_pool_desc_fallbacks << 1;
     request_attachment_.append(std::move(buf));
 }
 
+// One-sided completion (ISSUE 10a): release the pinned block back to
+// the owner's pool — the descriptor analog of the shm ring's released_-
+// counter advance. Exactly-once across every termination path (EndRPC,
+// Reset-for-reuse, destruction, retry/backup re-issues): the lease
+// registry arbitrates, so a pin the reaper or peer-death path already
+// reclaimed is a counted no-op here, never a double free. The chaos
+// leak simulation (chaos_pool pool_leak) "forgets" this release so the
+// soak can prove the reaper reclaims orphaned pins.
+void Controller::ReleasePoolLease() {
+    if (pool_lease_id_ == 0) return;
+    const uint64_t id = pool_lease_id_;
+    pool_lease_id_ = 0;
+    if (__builtin_expect(fault_injection_enabled(), 0)) {
+        const FaultAction fault = FaultInjection::Decide(
+            FaultOp::kLeaseRelease, remote_side_, 0);
+        if (fault.kind == FaultAction::kDrop) {
+            return;  // leaked on purpose: the reaper must reclaim it
+        }
+    }
+    if (!block_lease::Release(id)) {
+        *g_pool_lease_gone << 1;
+    }
+}
+
 Controller::~Controller() {
     RunCancelClosure();  // contract: an unfired closure still runs once
+    ReleasePoolLease();  // a pin must not outlive its controller
     delete excluded_;
     delete span_;  // non-null only if the RPC never reached EndRPC/submit
 }
@@ -98,7 +136,7 @@ void Controller::Reset() {
     canceled_.store(false, std::memory_order_relaxed);
     request_attachment_.clear();
     response_attachment_.clear();
-    request_pool_buf_.clear();
+    ReleasePoolLease();  // reuse ends the previous RPC's pin
     pool_attachment_ = PoolAttachment();
     remote_side_ = EndPoint();
     local_side_ = EndPoint();
@@ -332,6 +370,12 @@ static bool is_retryable(int error) {
         // backoff) is safe — but it SPENDS retry budget, because under
         // overload re-issues amplify the very load being shed.
         case TERR_OVERLOAD:
+        // Stale zero-copy reference (pool epoch fence): the server
+        // refused to resolve a descriptor minted under an old pool
+        // generation — the handler never saw the bytes, so a re-issue
+        // is safe; the remap/re-handshake underneath the retry carries
+        // the fresh generation.
+        case TERR_STALE_EPOCH:
             return true;
         default:
             return false;
@@ -720,14 +764,48 @@ void Controller::IssueRPC() {
     meta.set_attachment_size((uint32_t)request_attachment_.size());
     // One-sided pool attachment (ISSUE 9): the frame carries ONLY the
     // header + meta (+ inline payload pb); the attachment crosses the
-    // seam as a block reference the receiver maps in place. The pinned
-    // block (request_pool_buf_) is released at EndRPC.
-    if (!request_pool_buf_.empty()) {
+    // seam as a block reference the receiver maps in place. The pin is
+    // a lease (released exactly once at EndRPC; reaper/peer-death are
+    // the crash backstops). Arm it with this try's identity: owning
+    // call id, expiry derived from the propagated RPC deadline, and the
+    // socket the descriptor rides — so a SIGKILLed peer releases
+    // exactly the pins posted toward it (server_call::OnSocketFailed).
+    if (pool_lease_id_ != 0) {
+        // Arm is the liveness check AND the re-key, in one registry
+        // lock acquisition (a separate Alive() probe would leave a
+        // window where reclamation lands between check and arm). A
+        // false return means the pin was reclaimed underneath us
+        // (lease expired, or a previous try's peer died and took the
+        // pin with it): the referenced bytes may already be recycled,
+        // and the ONLY copy of the payload was that block — so every
+        // subsequent try must keep failing with the stale-reference
+        // error (lease id deliberately NOT cleared: a later try that
+        // silently framed without the attachment would hand the
+        // server an empty payload and report success — data loss).
+        // Bounded by max_retry/deadline like any other retriable
+        // failure; the terminal error is TERR_STALE_EPOCH.
+        // A backup re-issue ADDS this try's socket to the lease's
+        // entitled peers (the original try — still in flight — may be
+        // mid-read on its own socket); a plain retry replaces it.
+        const bool backup_in_flight =
+            unfinished_cid_ != INVALID_CALL_ID;
+        if (!block_lease::Arm(pool_lease_id_, (uint64_t)correlation_id_,
+                              deadline_us_, (uint64_t)s->id(),
+                              backup_in_flight)) {
+            id_error(current_cid_, TERR_STALE_EPOCH);
+            return;
+        }
+        // Re-issues restamp the CURRENT pool generation: the pin (and
+        // its offset) is still valid — the lease holds it — so a retry
+        // after a TERR_STALE_EPOCH re-handshake carries the epoch the
+        // receiver's fresh mapping expects.
+        pool_attachment_.pool_epoch = IciBlockPool::pool_epoch();
         auto* pd = meta.mutable_pool_attachment();
         pd->set_pool_id(pool_attachment_.pool_id);
         pd->set_offset(pool_attachment_.offset);
         pd->set_length(pool_attachment_.length);
         pd->set_crc32c(pool_attachment_.crc32c);
+        pd->set_pool_epoch(pool_attachment_.pool_epoch);
         *g_pool_desc_sends << 1;
         *g_pool_desc_bytes << (int64_t)pool_attachment_.length;
     }
@@ -848,11 +926,12 @@ void Controller::ReleaseFlySockets() {
 
 void Controller::EndRPC(CallId locked_id) {
     latency_us_ = monotonic_time_us() - start_us_;
-    // One-sided completion (ISSUE 9): the response (or terminal failure)
-    // means the peer will never again read our posted descriptor —
-    // release the pinned block back to the owner's pool. This is the
-    // descriptor analog of the shm ring's released_-counter advance.
-    request_pool_buf_.clear();
+    // One-sided completion (ISSUE 9/10): the response (or terminal
+    // failure) means the peer will never again read our posted
+    // descriptor — release the lease, returning the pinned block to the
+    // owner's pool. Exactly-once even across retry/backup re-issues and
+    // against the reaper/peer-death reclamation paths (block_lease.h).
+    ReleasePoolLease();
     pool_attachment_ = PoolAttachment();
     // The RPC is over: an unfired NotifyOnCancel closure runs now
     // (protobuf contract — exactly once whether or not canceled).
@@ -969,17 +1048,21 @@ void ProcessTpuStdResponse(TpuStdMessage* msg, const rpc::RpcMeta& meta) {
         }
     }
     if (rmeta.error_code() != 0) {
-        if (rmeta.error_code() == TERR_OVERLOAD) {
-            // Priority-aware shed: the handler never ran. Stash the
-            // server-suggested backoff, then route through the ERROR
-            // funnel (we hold the id lock — HandleError's contract), so
-            // the standard retry machinery applies: budget token spent,
-            // jittered backoff honored, LB re-selects away from the
-            // overloaded server via ExcludedServers.
-            if (rmeta.has_backoff_ms()) {
+        if (rmeta.error_code() == TERR_OVERLOAD ||
+            rmeta.error_code() == TERR_STALE_EPOCH) {
+            // The handler never ran — a priority-aware shed or an
+            // epoch fence refusing a stale zero-copy reference. Route
+            // through the ERROR funnel (we hold the id lock —
+            // HandleError's contract) so the standard retry machinery
+            // applies: budget token spent, backoff honored, LB
+            // re-selects via ExcludedServers; a stale-epoch re-issue
+            // re-arms the lease and restamps the current pool
+            // generation.
+            if (rmeta.error_code() == TERR_OVERLOAD &&
+                rmeta.has_backoff_ms()) {
                 cntl->set_suggested_backoff_ms(rmeta.backoff_ms());
             }
-            cntl->HandleError(cid, TERR_OVERLOAD);
+            cntl->HandleError(cid, rmeta.error_code());
             return;
         }
         cntl->SetFailed(rmeta.error_code(), "%s", rmeta.error_text().c_str());

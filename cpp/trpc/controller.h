@@ -74,23 +74,30 @@ public:
     IOBuf& response_attachment() { return response_attachment_; }
 
     // ---- one-sided pool attachment (ISSUE 9) ----
-    // Client: send `buf` as a (pool_id, offset, len, crc32c) descriptor
-    // instead of inline frame bytes. Eligible when buf is one contiguous
-    // block inside this process's SHARED registered pool (any IOBuf
-    // block is, after IciBlockPool::Init, until it spills past the
+    // Client: send `buf` as a (pool_id, offset, len, crc32c, epoch)
+    // descriptor instead of inline frame bytes. Eligible when buf is one
+    // contiguous block inside this process's SHARED registered pool (any
+    // IOBuf block is, after IciBlockPool::Init, until it spills past the
     // primary region); ineligible bytes fall back to the inline
-    // attachment transparently. The framework holds the block ref until
-    // the RPC completes, then releases it back to the owner's pool —
-    // the completion of the one-sided transfer. Descriptors only
-    // resolve on ici/shm links whose HANDSHAKE mapped our pool: the
+    // attachment transparently. The pin is held as a block LEASE
+    // (tici/block_lease.h, ISSUE 10): the registry owns the block ref
+    // until the RPC completes; EndRPC's release is exactly-once by
+    // construction, the expiry reaper reclaims the pin if the call
+    // wedges past its deadline, and peer death releases it through the
+    // socket failure observer — the slab can never leak. Descriptors
+    // only resolve on ici/shm links whose HANDSHAKE mapped our pool: the
     // receiver binds resolution to the connection's registered peer
     // pool (Socket::peer_pool_id), so a plain-TCP peer — or any
     // connection naming a pool that is not its own — answers
-    // TERR_REQUEST.
+    // TERR_REQUEST; an epoch mismatch answers the retriable
+    // TERR_STALE_EPOCH.
     void set_request_pool_attachment(IOBuf&& buf);
     bool has_request_pool_attachment() const {
-        return !request_pool_buf_.empty();
+        return pool_lease_id_ != 0;
     }
+    // Lease handle of the pinned request attachment (0 = none/released);
+    // tests assert exactly-once release through it.
+    uint64_t pool_lease_id() const { return pool_lease_id_; }
     // Server: the resolved zero-copy view of a descriptor attachment —
     // bytes read IN PLACE from the receiver's mapping of the sender's
     // pool. Valid until the done closure runs; handlers must not retain
@@ -101,6 +108,8 @@ public:
         uint64_t pool_id = 0;
         uint64_t offset = 0;
         uint32_t crc32c = 0;
+        // Pool generation the descriptor was minted under (epoch fence).
+        uint64_t pool_epoch = 0;
     };
     const PoolAttachment& request_pool_attachment() const {
         return pool_attachment_;
@@ -250,6 +259,9 @@ private:
     void FeedbackToLB(int error);
     // Pool-return / close this RPC's pooled/short connections (EndRPC).
     void ReleaseFlySockets();
+    // Exactly-once release of the pinned pool-attachment lease (see
+    // set_request_pool_attachment); safe on every termination path.
+    void ReleasePoolLease();
     // Best-effort wire CANCEL for the in-flight tries (tpu_std CANCEL
     // meta / h2 RST_STREAM) so the server stops burning CPU on a call
     // nobody waits for. Runs with the id locked.
@@ -275,10 +287,11 @@ private:
     std::atomic<google::protobuf::Closure*> on_cancel_{nullptr};
     IOBuf request_attachment_;
     IOBuf response_attachment_;
-    // One-sided descriptor state: the pinned pool block (client; one
-    // contiguous ref — released at EndRPC, returning the block to the
-    // owner's pool) and the resolved in-place view (server).
-    IOBuf request_pool_buf_;
+    // One-sided descriptor state: the lease of the pinned pool block
+    // (client; the block_lease registry owns the ref — EndRPC releases
+    // it exactly once, the reaper/peer-death paths are the crash-safe
+    // backstops) and the resolved in-place view (server).
+    uint64_t pool_lease_id_ = 0;
     PoolAttachment pool_attachment_;
     EndPoint remote_side_;
     EndPoint local_side_;

@@ -17,6 +17,7 @@
 #include "thttp/http_protocol.h"
 #include "tici/block_pool.h"
 #include "tici/shm_link.h"
+#include "tnet/fault_injection.h"
 #include "tnet/input_messenger.h"
 #include "trpc/auth.h"
 #include "trpc/controller.h"
@@ -56,6 +57,10 @@ static LazyAdder g_pool_desc_resolves("rpc_pool_descriptor_resolves");
 static LazyAdder g_pool_desc_resolve_bytes(
     "rpc_pool_descriptor_resolve_bytes");
 static LazyAdder g_pool_desc_rejects("rpc_pool_descriptor_rejects");
+// Epoch-fence rejections (ISSUE 10b): descriptors minted under a pool
+// generation this mapping no longer matches — answered with the
+// retriable TERR_STALE_EPOCH, never a connection failure.
+static LazyAdder g_pool_epoch_rejects("rpc_pool_epoch_rejects");
 
 int TpuStdProtocolIndex() { return g_tpu_std_index; }
 
@@ -630,9 +635,10 @@ void ProcessTpuStdRequest(TpuStdMessage* msg, const rpc::RpcMeta& meta) {
               pd.pool_id() == IciBlockPool::pool_id()));
         const char* pool_base = nullptr;
         size_t pool_size = 0;
+        uint64_t map_epoch = 0;
         if (!in_scope ||
             !pool_registry::Resolve(pd.pool_id(), &pool_base,
-                                    &pool_size) ||
+                                    &pool_size, &map_epoch) ||
             pd.offset() > pool_size ||
             pd.length() > pool_size - pd.offset()) {
             *g_pool_desc_rejects << 1;
@@ -644,9 +650,39 @@ void ProcessTpuStdRequest(TpuStdMessage* msg, const rpc::RpcMeta& meta) {
                               "bounds)");
             return;
         }
-        if (pd.has_crc32c() &&
-            crc32c_extend(0, pool_base + pd.offset(), pd.length()) !=
-                pd.crc32c()) {
+        // Chaos seam (chaos_pool, ISSUE 10d): crc corruption and stale-
+        // epoch injection on the resolve path — both must fail ONLY
+        // this call while the connection (and every other in-flight
+        // descriptor) keeps working.
+        bool chaos_corrupt = false;
+        bool chaos_stale = false;
+        if (__builtin_expect(fault_injection_enabled(), 0)) {
+            const FaultAction fault = FaultInjection::Decide(
+                FaultOp::kPoolResolve, s->remote_side(), pd.length());
+            chaos_corrupt = fault.kind == FaultAction::kCorrupt;
+            chaos_stale = fault.kind == FaultAction::kStaleEpoch;
+        }
+        // Epoch fence BEFORE the crc read: a descriptor minted under an
+        // older (or injected-stale) generation may point at recycled
+        // bytes — reject it as the RETRIABLE stale-reference error
+        // without touching the memory. Absent/0 epoch = pre-epoch
+        // sender, fence skipped (mixed-version caveat).
+        if ((pd.has_pool_epoch() && pd.pool_epoch() != 0 &&
+             pd.pool_epoch() != map_epoch) ||
+            chaos_stale) {
+            *g_pool_epoch_rejects << 1;
+            guard->Finish(TERR_STALE_EPOCH);
+            delete guard;
+            SendErrorResponse(sid, cid, TERR_STALE_EPOCH,
+                              "stale pool descriptor epoch (mapping at " +
+                                  std::to_string(map_epoch) +
+                                  "): remap and retry");
+            return;
+        }
+        if ((pd.has_crc32c() &&
+             crc32c_extend(0, pool_base + pd.offset(), pd.length()) !=
+                 pd.crc32c()) ||
+            chaos_corrupt) {
             *g_pool_desc_rejects << 1;
             guard->Finish(TERR_REQUEST);
             delete guard;
@@ -659,6 +695,7 @@ void ProcessTpuStdRequest(TpuStdMessage* msg, const rpc::RpcMeta& meta) {
         pool_view.pool_id = pd.pool_id();
         pool_view.offset = pd.offset();
         pool_view.crc32c = pd.crc32c();
+        pool_view.pool_epoch = pd.pool_epoch();
         *g_pool_desc_resolves << 1;
         *g_pool_desc_resolve_bytes << (int64_t)pd.length();
         // The logical payload is exempt from the inline-dispatch byte
@@ -879,6 +916,9 @@ void GlobalInitializeOrDie() {
         // it (the observer hops to a fresh fiber before running any
         // cancellation, so SetFailed's callers never execute user code).
         Socket::set_failure_observer(&server_call::OnSocketFailed);
+        // Epoch-fence family visible from the first scrape (lint
+        // contract: a 0-valued counter is data; a missing one is not).
+        *g_pool_epoch_rejects << 0;
         Protocol p;
         p.parse = ParseTpuStdMessage;
         p.process = ProcessTpuStdMessage;

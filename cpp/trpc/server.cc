@@ -21,6 +21,7 @@
 #include "thttp/http2_protocol.h"
 #include "tvar/default_variables.h"
 #include "tvar/series.h"
+#include "tici/block_lease.h"
 #include "tici/shm_link.h"
 #include "trpc/policy_tpu_std.h"
 #include "trpc/span.h"
@@ -311,6 +312,26 @@ void Server::GracefulStop(int64_t max_drain_ms) {
         LOG(WARNING) << "GracefulStop: drain window (" << max_drain_ms
                      << "ms) expired with " << remaining
                      << " requests still in flight; stopping hard";
+    }
+    // 3b. Drain in-flight pinned descriptors (ISSUE 10c): blocks this
+    //     process pinned for one-sided attachments still being read by
+    //     peers. Stopping with live pins would tear the pool down under
+    //     a peer's in-place resolve; bounded by the same drain deadline
+    //     (plus a short floor so a zero-drain Stop still yields) — the
+    //     expiry reaper is the backstop for anything left.
+    {
+        const int64_t pin_deadline =
+            std::max(deadline, monotonic_time_us() + 100 * 1000);
+        while (block_lease::pinned() > 0 &&
+               monotonic_time_us() < pin_deadline) {
+            fiber_usleep(5 * 1000);
+        }
+        const uint64_t pins = block_lease::pinned();
+        if (pins > 0) {
+            LOG(WARNING) << "GracefulStop: " << pins
+                         << " pool block(s) still pinned at teardown "
+                            "(lease reaper will reclaim)";
+        }
     }
     // 4. Flush queued response bytes: a response that finished its
     //    handler but still sits in a socket's write queue would be

@@ -10,6 +10,7 @@
 #include "tfiber/fiber.h"
 #include "tfiber/fiber_key.h"
 #include "tfiber/timer_thread.h"
+#include "tici/block_lease.h"
 #include "tvar/reducer.h"
 
 namespace tpurpc {
@@ -105,6 +106,15 @@ void CancelAllOnSocket(SocketId sid) {
 }
 
 void OnSocketFailed(SocketId sid) {
+    // Peer-death pin reclamation (ISSUE 10a): every pool block pinned
+    // for a descriptor posted ON this socket is released — the peer
+    // that was entitled to read it can never read again, so holding
+    // the slab would be a pure leak. (A retrying call whose lease
+    // vanishes under it fails that try with TERR_STALE_EPOCH instead
+    // of reading recycled bytes — see Controller::IssueRPC.) This runs
+    // before the registered-call fast path below: CLIENT sockets carry
+    // leases but never registered server calls.
+    block_lease::ReleasePeer((uint64_t)sid);
     {
         // Fast path: most failed sockets (client conns, idle server
         // conns) have nothing registered — don't pay a fiber for them.

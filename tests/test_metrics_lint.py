@@ -156,6 +156,17 @@ def test_metrics_exposition_lint(cpp_build, tmp_path):
         assert re.search(
             r'^rpc_tenant_admitted\{tenant="default"\} \d+$', text, re.M), \
             text[:500]
+        # ISSUE 10 zero-copy crash-safety families: the pinned-block
+        # lease ledger (live gauge + reclamation counters) and the
+        # epoch fence — present (0-valued) even before the first pin.
+        assert families.get("rpc_pool_pinned_blocks") == "gauge", \
+            sorted(families)
+        assert families.get("rpc_pool_lease_expired") == "gauge"
+        assert families.get("rpc_pool_reaped") == "gauge"
+        assert families.get("rpc_pool_peer_released") == "gauge"
+        assert families.get("rpc_pool_epoch_rejects") == "gauge"
+        assert re.search(r"^rpc_pool_pinned_blocks \d+$", text, re.M), \
+            text[:500]
 
         # /vars?series= returns the fixed 60/60/24-point ring shape.
         # Poll: on a loaded host the 1Hz sampler may lag a little before

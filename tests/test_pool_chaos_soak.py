@@ -1,0 +1,171 @@
+"""Zero-copy pool chaos soak (ISSUE 10): SIGKILL + stale epochs + leaks.
+
+Four `mesh_node` processes run with --desc_traffic: every node
+continuously pins pool blocks under leases and posts them as one-sided
+(pool_id, offset, len, crc, epoch) descriptors over the shm-ICI links.
+Mid-run the soak
+
+  * SIGKILLs one node while it holds / is entitled to read in-flight
+    pinned descriptors (the peer-death reclamation path),
+  * injects stale-epoch faults at one survivor's resolve seam
+    (chaos_pool `pool_stale`, via its /chaos portal),
+  * injects leaked-pin faults at one survivor's release seam
+    (chaos_pool `pool_leak`) so the lease reaper must reclaim orphans,
+  * heals and restarts the killed node.
+
+Asserted invariants (the ISSUE-10 acceptance gate):
+  * slab/lease ledger returns to baseline on every surviving node —
+    pinned blocks drain to ZERO after quiesce (no leaked pins from the
+    kill, the leak injection, or anything else);
+  * zero lost completions: desc_issued == desc_ok + desc_failed and
+    outstanding == 0 on every node;
+  * injected stale-epoch descriptors fail as retriable call failures
+    (client desc_stale > 0, server rpc_pool_epoch_rejects > 0) while
+    the fenced node KEEPS SERVING on the same connections — never a
+    crash or a wedged link;
+  * the reaper reclaimed the deliberately-leaked pins
+    (rpc_pool_reaped > 0);
+  * clean exit 0 everywhere (Join quiesces every socket).
+"""
+import json
+import time
+
+from test_chaos_soak import NODE_FLAGS, Node, _chaos, _free_ports, \
+    _http_get, _var
+
+NUM_NODES = 4
+
+# Short lease grace so the leak-injection phase's orphans become
+# reapable within the soak window (default grace is 2s on top of the
+# 800ms call deadline).
+POOL_FLAGS = NODE_FLAGS + [
+    "pool_lease_grace_ms=300",
+    "pool_lease_reap_ms=100",
+]
+
+
+def _pools(port):
+    return json.loads(_http_get(port, "/pools?format=json"))
+
+
+def test_pool_chaos_soak(cpp_build, tmp_path):
+    binary = cpp_build / "mesh_node"
+    assert binary.exists(), "mesh_node not built"
+    ports = _free_ports(NUM_NODES)
+    peers_file = tmp_path / "mesh_members"
+    peers_file.write_text("".join("127.0.0.1:%d\n" % p for p in ports))
+
+    nodes = [
+        Node(binary, ports[i], i, peers_file, flags=POOL_FLAGS,
+             extra_args=("--desc_traffic",))
+        for i in range(NUM_NODES)
+    ]
+    try:
+        for n in nodes:
+            assert n.wait_ready(), "node %d never became ready" % n.idx
+
+        # Descriptor traffic is really flowing (lease pins being taken).
+        deadline = time.time() + 20.0
+        while time.time() < deadline:
+            sends = sum(
+                _var(p, "rpc_pool_descriptor_sends") for p in ports)
+            if sends >= 20:
+                break
+            time.sleep(0.5)
+        assert sends >= 20, "descriptor traffic never started"
+        assert sum(_pools(p)["pins_total"] for p in ports) >= 20
+
+        # --- kill a node holding in-flight pinned descriptors ---------
+        kill_idx = 3
+        nodes[kill_idx].kill9()
+        survivors = [i for i in range(NUM_NODES) if i != kill_idx]
+
+        # Peer death must not strand pins on the survivors: their leases
+        # to the dead node resolve via EndRPC (failed call) or the
+        # socket-failure ReleasePeer path; steady state returns to a
+        # small in-flight transient, never a growing leak.
+        deadline = time.time() + 20.0
+        ok = False
+        while time.time() < deadline:
+            pinned = [_pools(ports[i])["pinned"] for i in survivors]
+            if all(p <= 4 for p in pinned):
+                ok = True
+                break
+            time.sleep(0.5)
+        assert ok, "pins stranded after peer kill: %s" % pinned
+
+        # --- stale-epoch injection at node 0's resolve seam -----------
+        _chaos(ports[0], enable=1, seed=777, plan="pool_stale=0.5")
+        deadline = time.time() + 20.0
+        rejects = 0
+        while time.time() < deadline:
+            rejects = _var(ports[0], "rpc_pool_epoch_rejects")
+            if rejects >= 3:
+                break
+            time.sleep(0.5)
+        assert rejects >= 3, "stale-epoch fence never fired"
+        # The fenced node is alive and still serving its portal + RPCs.
+        assert _http_get(ports[0], "/health").strip() == "OK"
+
+        # --- leaked-pin injection at node 1's release seam ------------
+        _chaos(ports[1], enable=1, seed=778, plan="pool_leak=1")
+        time.sleep(2.0)  # leak a few pins
+        _chaos(ports[1], enable=0)
+        deadline = time.time() + 20.0
+        reaped = 0
+        while time.time() < deadline:
+            reaped = _var(ports[1], "rpc_pool_reaped")
+            if reaped >= 1:
+                break
+            time.sleep(0.5)
+        assert reaped >= 1, "reaper never reclaimed the leaked pins"
+
+        # --- heal + restart the killed node ---------------------------
+        _chaos(ports[0], enable=0)
+        nodes[kill_idx] = Node(binary, ports[kill_idx], kill_idx,
+                               peers_file, flags=POOL_FLAGS,
+                               extra_args=("--desc_traffic",))
+        assert nodes[kill_idx].wait_ready()
+        time.sleep(4.0)  # links re-establish, fresh handshakes map pools
+
+        # --- drain + invariants ---------------------------------------
+        reports = []
+        for n in nodes:
+            rep = n.stop_and_report()
+            assert rep is not None, "node %d produced no report" % n.idx
+            reports.append(rep)
+
+        stale_total = 0
+        for rep in reports:
+            # Zero lost completions on the descriptor plane (and all
+            # others), and the lease ledger is EMPTY after quiesce —
+            # the headline crash-safety invariant.
+            assert rep["outstanding"] == 0, rep
+            assert rep["desc_issued"] == (
+                rep["desc_ok"] + rep["desc_failed"]), rep
+            assert rep["lb_issued"] == rep["lb_ok"] + rep["lb_failed"], rep
+            assert rep["shm_issued"] == rep["shm_ok"] + rep["shm_failed"], rep
+            assert rep["pool_pinned"] == 0, rep
+            stale_total += rep["desc_stale"]
+        # Descriptor traffic did useful work on every node (incl. the
+        # restarted one), and the stale injection surfaced client-side
+        # as retriable call failures, not crashes.
+        for rep in reports:
+            assert rep["desc_ok"] > 0, rep
+        assert stale_total >= 1, reports
+        assert reports[0]["epoch_rejects"] >= 3, reports[0]
+        # The deliberately-leaked pins were reaped, not stranded.
+        assert reports[1]["pool_reaped"] >= 1, reports[1]
+
+        # Ledger empty via the portal too (pre-shutdown, post-quiesce).
+        for i in range(NUM_NODES):
+            assert _pools(ports[i])["pinned"] == 0
+
+        for n in nodes:
+            assert n.shutdown() == 0, "node %d unclean exit" % n.idx
+    finally:
+        for n in nodes:
+            try:
+                n.proc.kill()
+            except OSError:
+                pass

@@ -24,6 +24,7 @@
 #include "tbase/fast_rand.h"
 #include "tbase/flags.h"
 #include "tbase/time.h"
+#include "tici/block_lease.h"
 #include "tici/block_pool.h"
 #include "tici/ici_link.h"
 #include "tici/shm_link.h"
@@ -466,16 +467,26 @@ int main(int argc, char** argv) {
         const double mbps =
             run_pool_desc_round(stub, kDescBytes, kIters, &zero_copy_ok);
         if (mbps < 0) return 1;
+        // Leak gauge (ISSUE 10 satellite): after the round every pinned
+        // block must be back in the pool — a nonzero pinned_after in a
+        // BENCH record is the descriptor path leaking under load.
+        const long long pinned_after = (long long)block_lease::pinned();
+        const long long reaped = (long long)(
+            block_lease::expired_reaped() + block_lease::peer_released());
         if (json) {
             printf("{\"pool_desc_mbps\": %.1f, \"pool_desc_calls\": %d, "
                    "\"pool_desc_bytes\": %zu, \"pool_desc_zero_copy\": "
-                   "%d}\n",
-                   mbps, kIters, kDescBytes, zero_copy_ok);
+                   "%d, \"pool_desc_pinned_after\": %lld, "
+                   "\"pool_desc_reaped\": %lld}\n",
+                   mbps, kIters, kDescBytes, zero_copy_ok, pinned_after,
+                   reaped);
         } else {
             printf("pool-descriptor echo: %.1f MB/s logical (%d calls x "
-                   "%zu bytes, zero-copy %s)\n",
+                   "%zu bytes, zero-copy %s, pinned-after %lld, "
+                   "reaped %lld)\n",
                    mbps, kIters, kDescBytes,
-                   zero_copy_ok ? "verified" : "FAILED");
+                   zero_copy_ok ? "verified" : "FAILED", pinned_after,
+                   reaped);
         }
         if (xproc_pid > 0) {
             close(xproc_stdin);

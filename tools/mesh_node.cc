@@ -45,10 +45,12 @@
 #include "bench_echo.pb.h"
 #include "rpc_meta.pb.h"
 #include "tbase/endpoint.h"
+#include "tbase/errno.h"
 #include "tbase/flags.h"
 #include "tbase/logging.h"
 #include "tbase/time.h"
 #include "tfiber/fiber.h"
+#include "tici/block_lease.h"
 #include "tici/block_pool.h"
 #include "tici/shm_link.h"
 #include "trpc/channel.h"
@@ -136,6 +138,11 @@ struct Counters {
     std::atomic<int64_t> lb_issued{0}, lb_ok{0}, lb_failed{0};
     std::atomic<int64_t> shm_issued{0}, shm_ok{0}, shm_failed{0};
     std::atomic<int64_t> stale_issued{0}, stale_ok{0}, stale_failed{0};
+    // One-sided descriptor traffic (ISSUE 10): every call pins a pool
+    // block under a lease; desc_stale counts TERR_STALE_EPOCH fences
+    // (EXPECTED retriable failures under chaos_pool stale injection).
+    std::atomic<int64_t> desc_issued{0}, desc_ok{0}, desc_failed{0};
+    std::atomic<int64_t> desc_stale{0};
     std::atomic<int64_t> expired_probes{0};
     std::atomic<int64_t> outstanding{0};
     std::atomic<int64_t> reconnects{0};
@@ -254,6 +261,61 @@ void* ShmTrafficFiber(void* arg) {
             st->counters.outstanding.fetch_sub(1);
         }
         fiber_usleep(3000);
+    }
+    return nullptr;
+}
+
+// One-sided descriptor traffic (--desc_traffic, ISSUE 10): every call
+// pins a fresh pool block under a lease and posts it as a
+// (pool_id, offset, len, crc, epoch) reference over the shm links —
+// the zero-copy path the pool chaos soak SIGKILLs nodes under. The
+// invariants the soak asserts ride the REPORT line: every issued call
+// terminates, the lease ledger returns to pinned=0 after quiesce, and
+// stale-epoch fences fail ONLY the call (counted desc_stale, the node
+// keeps serving).
+void* DescTrafficFiber(void* arg) {
+    auto* st = (NodeState*)arg;
+    TrafficStartDelay(st);
+    constexpr size_t kDescBytes = 48 * 1024;
+    size_t next = 0;
+    while (!st->stop.load(std::memory_order_relaxed)) {
+        if (st->links.empty()) break;
+        PeerLink& link = *st->links[next++ % st->links.size()];
+        std::shared_ptr<Channel> ch;
+        {
+            std::lock_guard<std::mutex> g(link.mu);
+            ch = link.ch;
+        }
+        if (ch != nullptr) {
+            st->counters.outstanding.fetch_add(1);
+            st->counters.desc_issued.fetch_add(1);
+            IOBuf att;
+            char* data = nullptr;
+            bool ok = false;
+            bool stale = false;
+            if (IciBlockPool::AllocatePoolAttachment(kDescBytes, &att,
+                                                     &data)) {
+                memset(data, (int)('a' + next % 26), kDescBytes);
+                benchpb::EchoService_Stub stub(ch.get());
+                Controller cntl;
+                cntl.set_timeout_ms(800);
+                cntl.set_request_pool_attachment(std::move(att));
+                benchpb::EchoRequest req;
+                benchpb::EchoResponse res;
+                req.set_send_ts_us(monotonic_time_us());
+                stub.Echo(&cntl, &req, &res, nullptr);  // sync
+                ok = !cntl.Failed();
+                stale = cntl.ErrorCode() == TERR_STALE_EPOCH;
+            }
+            if (ok) {
+                st->counters.desc_ok.fetch_add(1);
+            } else {
+                st->counters.desc_failed.fetch_add(1);
+                if (stale) st->counters.desc_stale.fetch_add(1);
+            }
+            st->counters.outstanding.fetch_sub(1);
+        }
+        fiber_usleep(4000);
     }
     return nullptr;
 }
@@ -457,6 +519,10 @@ void PrintReport(int id, int port, const Counters& c) {
         "\"stale_issued\": %lld, \"stale_ok\": %lld, "
         "\"stale_failed\": %lld, \"stale_executed\": %lld, "
         "\"expired_probes\": %lld, "
+        "\"desc_issued\": %lld, \"desc_ok\": %lld, "
+        "\"desc_failed\": %lld, \"desc_stale\": %lld, "
+        "\"pool_pinned\": %lld, \"pool_reaped\": %lld, "
+        "\"pool_peer_released\": %lld, \"epoch_rejects\": %lld, "
         "\"outstanding\": %lld, \"reconnects\": %lld, "
         "\"reissues\": %lld, \"budget_exhausted\": %lld, "
         "\"drain_reroutes\": %lld, \"drain_notices\": %lld, "
@@ -468,6 +534,12 @@ void PrintReport(int id, int port, const Counters& c) {
         (long long)c.stale_failed.load(),
         (long long)g_stale_executed.load(),
         (long long)c.expired_probes.load(),
+        (long long)c.desc_issued.load(), (long long)c.desc_ok.load(),
+        (long long)c.desc_failed.load(), (long long)c.desc_stale.load(),
+        (long long)block_lease::pinned(),
+        (long long)block_lease::expired_reaped(),
+        (long long)block_lease::peer_released(),
+        (long long)VarInt("rpc_pool_epoch_rejects"),
         (long long)c.outstanding.load(), (long long)c.reconnects.load(),
         reissues, (long long)VarInt("rpc_retry_budget_exhausted"),
         (long long)VarInt("rpc_client_drain_reroutes"),
@@ -529,6 +601,7 @@ int main(int argc, char** argv) {
     int drain_ms = 1200;
     bool lb_only = false;
     bool inline_echo = false;
+    bool desc_traffic = false;
     const char* peers_file = nullptr;
     for (int i = 1; i < argc; ++i) {
         if (strcmp(argv[i], "--port") == 0 && i + 1 < argc) {
@@ -560,6 +633,11 @@ int main(int argc, char** argv) {
             // which violate the inline-safe contract; the delay command
             // clears the flag for its phase.
             inline_echo = true;
+        } else if (strcmp(argv[i], "--desc_traffic") == 0) {
+            // Pool chaos soak mode (ISSUE 10): drive one-sided
+            // descriptor traffic (pinned pool blocks) over the shm
+            // links so kills/chaos hit the zero-copy data path.
+            desc_traffic = true;
         } else if (strcmp(argv[i], "--lb_only") == 0) {
             // Rolling-restart soak mode: only the naming/LB plane runs.
             // The shm-ICI links die hard when a peer exits (no drain
@@ -581,7 +659,8 @@ int main(int argc, char** argv) {
     if (port <= 0 || peers_file == nullptr) {
         fprintf(stderr,
                 "usage: mesh_node --port N --peers FILE [--id K] "
-                "[--lb_only] [--inline_echo] [--drain_ms N] "
+                "[--lb_only] [--inline_echo] [--desc_traffic] "
+                "[--drain_ms N] "
                 "[--timeout_cl_ms N] [--tenant NAME] [--priority 0..7] "
                 "[--flag name=value]...\n"
                 "  with --flag graceful_quit_on_sigterm=true: SIGTERM "
@@ -658,6 +737,11 @@ int main(int argc, char** argv) {
     if (!lb_only) {
         if (fiber_start_background(&tid, nullptr, ShmTrafficFiber, &st) ==
             0) {
+            fibers.push_back(tid);
+        }
+        if (desc_traffic &&
+            fiber_start_background(&tid, nullptr, DescTrafficFiber, &st) ==
+                0) {
             fibers.push_back(tid);
         }
         if (fiber_start_background(&tid, nullptr, StaleTrafficFiber, &st) ==

@@ -5,9 +5,18 @@
 //
 //   rpc_press --server=ip:port [--qps=10000] [--duration_s=10]
 //             [--payload=4096] [--callers=8] [--press_threads=1]
-//             [--pooled] [--timeout_ms=5000] [--metrics_csv=path]
-//             [--tenant=name] [--priority=0..7]
+//             [--pooled] [--pool_desc] [--timeout_ms=5000]
+//             [--metrics_csv=path] [--tenant=name] [--priority=0..7]
 //             [--tenants=a:8,b:1  or  a:8:7,b:1:1]
+//
+// --pool_desc (ISSUE 10 satellite, mirrors echo_bench --pool-desc):
+// connect over the shm-ICI link (IciBlockPool + Channel::InitIci) and
+// send every payload as a one-sided (pool_id, offset, len, crc, epoch)
+// descriptor pinned under a block lease — descriptor traffic at target
+// QPS, for pool/lease/epoch soaks and bench rounds. Responses carrying
+// TERR_STALE_EPOCH are counted separately (press_stale_epoch): under
+// chaos_pool stale injection they are EXPECTED retriable failures, not
+// generator errors.
 //
 // --press_threads=N drives N independent pinned channels (one connection
 // each, callers spread round-robin), so the generator scales past a
@@ -52,6 +61,7 @@
 #include "tbase/errno.h"
 #include "tbase/time.h"
 #include "tfiber/fiber.h"
+#include "tici/block_pool.h"
 #include "trpc/channel.h"
 #include "trpc/controller.h"
 #include "tvar/latency_recorder.h"
@@ -72,6 +82,7 @@ struct TenantGen {
     std::atomic<int64_t> sent{0};
     std::atomic<int64_t> failed{0};
     std::atomic<int64_t> shed{0};  // TERR_OVERLOAD rejections
+    std::atomic<int64_t> stale{0};  // TERR_STALE_EPOCH fences (pool_desc)
     int64_t granted = 0;
     int64_t last_sent = 0;  // interval reporting
 };
@@ -82,6 +93,8 @@ struct PressCtx {
     std::atomic<bool>* stop;
     IOBuf* filler;
     int64_t timeout_ms;
+    bool pool_desc = false;
+    size_t payload = 0;
 };
 
 // Ctrl-C / SIGINT: finish the current interval cleanly — flush the final
@@ -108,12 +121,30 @@ void* PressCaller(void* arg) {
         benchpb::EchoRequest req;
         benchpb::EchoResponse res;
         req.set_send_ts_us(monotonic_time_us());
-        cntl.request_attachment().append(*c->filler);
+        if (c->pool_desc) {
+            // One-sided descriptor load: pin a fresh pool block per call
+            // (lease-managed; EndRPC releases it) so the generator
+            // drives the full pin/resolve/release cycle, not a reused
+            // buffer.
+            IOBuf att;
+            char* data = nullptr;
+            if (IciBlockPool::AllocatePoolAttachment(c->payload, &att,
+                                                     &data)) {
+                memset(data, 'p', c->payload);
+                cntl.set_request_pool_attachment(std::move(att));
+            } else {
+                cntl.request_attachment().append(*c->filler);
+            }
+        } else {
+            cntl.request_attachment().append(*c->filler);
+        }
         c->stub->Echo(&cntl, &req, &res, nullptr);
         if (cntl.Failed()) {
             g->failed.fetch_add(1, std::memory_order_relaxed);
             if (cntl.ErrorCode() == TERR_OVERLOAD) {
                 g->shed.fetch_add(1, std::memory_order_relaxed);
+            } else if (cntl.ErrorCode() == TERR_STALE_EPOCH) {
+                g->stale.fetch_add(1, std::memory_order_relaxed);
             }
         } else {
             g->lat << (monotonic_time_us() - res.send_ts_us());
@@ -161,6 +192,7 @@ int main(int argc, char** argv) {
     int press_threads = 1;
     long long timeout_ms = 5000;
     bool pooled = false;
+    bool pool_desc = false;
     bool json = false;
     const char* metrics_csv = nullptr;
     const char* tenants_spec = nullptr;
@@ -206,13 +238,18 @@ int main(int argc, char** argv) {
             tenants_spec = argv[i] + 10;
         }
         if (strcmp(argv[i], "--pooled") == 0) pooled = true;
+        if (strcmp(argv[i], "--pool_desc") == 0 ||
+            strcmp(argv[i], "--pool-desc") == 0) {
+            pool_desc = true;
+        }
         if (strcmp(argv[i], "--json") == 0) json = true;
     }
     if (server_str.empty()) {
         fprintf(stderr,
                 "usage: rpc_press --server=ip:port [--qps=N] "
                 "[--duration_s=N] [--payload=N] [--callers=N] "
-                "[--press_threads=N] [--pooled] [--timeout_ms=N] "
+                "[--press_threads=N] [--pooled] [--pool_desc] "
+                "[--timeout_ms=N] "
                 "[--max_retry=N] [--tenant=NAME] [--priority=0..7] "
                 "[--tenants=a:8,b:1 | a:8:7,b:1:1] [--json]\n");
         return 1;
@@ -265,11 +302,33 @@ int main(int argc, char** argv) {
     // be bypassed and just leak one idle connection per channel) and the
     // pool's FIFO rotation already spreads load across connections.
     copts.pin_connection = press_threads > 1 && !pooled;
+    if (pool_desc) {
+        // Descriptor traffic needs the registered pool AND an shm-ICI
+        // link whose handshake maps it on the server (plain TCP would
+        // fall back inline / get TERR_REQUEST).
+        if (IciBlockPool::Init() != 0 ||
+            IciBlockPool::shm_name()[0] == '\0') {
+            fprintf(stderr,
+                    "--pool_desc: IciBlockPool init failed (no /dev/shm?)\n");
+            return 1;
+        }
+    }
     std::vector<std::unique_ptr<Channel>> channels;
     std::vector<std::unique_ptr<benchpb::EchoService_Stub>> stubs;
     for (int i = 0; i < press_threads; ++i) {
         channels.emplace_back(new Channel);
-        if (channels.back()->Init(server, &copts) != 0) return 1;
+        const int rc = pool_desc
+                           ? channels.back()->InitIci(server, &copts)
+                           : channels.back()->Init(server, &copts);
+        if (rc != 0) {
+            if (pool_desc) {
+                fprintf(stderr,
+                        "--pool_desc: ICI handshake with %s failed (is "
+                        "the server on this host with a shared pool?)\n",
+                        server_str.c_str());
+            }
+            return 1;
+        }
         stubs.emplace_back(
             new benchpb::EchoService_Stub(channels.back().get()));
     }
@@ -304,7 +363,7 @@ int main(int argc, char** argv) {
     for (int i = 0; i < callers; ++i) {
         ctxs.push_back(PressCtx{stubs[(size_t)(i % press_threads)].get(),
                                 assignment[(size_t)i], &stop, &filler,
-                                timeout_ms});
+                                timeout_ms, pool_desc, (size_t)payload});
     }
     std::vector<fiber_t> tids((size_t)callers);
     for (size_t i = 0; i < tids.size(); ++i) {
@@ -414,10 +473,12 @@ int main(int argc, char** argv) {
     for (auto tid : tids) fiber_join(tid, nullptr);
     const double secs = (double)(monotonic_time_us() - t0) / 1e6;
     int64_t total_sent = 0, total_failed = 0, total_shed = 0;
+    int64_t total_stale = 0;
     for (auto& g : gens) {
         total_sent += g->sent.load();
         total_failed += g->failed.load();
         total_shed += g->shed.load();
+        total_stale += g->stale.load();
     }
     const double achieved = (double)total_sent / secs;
     // Headline percentiles from the largest class (see report()).
@@ -434,13 +495,15 @@ int main(int argc, char** argv) {
                "\"press_p50_us\": %lld, "
                "\"press_p99_us\": %lld, \"press_p999_us\": %lld, "
                "\"press_threads\": %d, \"press_callers\": %d, "
-               "\"press_payload\": %d, \"press_pooled\": %d",
+               "\"press_payload\": %d, \"press_pooled\": %d, "
+               "\"press_pool_desc\": %d, \"press_stale_epoch\": %lld",
                achieved, qps, (long long)total_failed,
                (long long)total_shed,
                (long long)head->lat.latency_percentile(0.5),
                (long long)head->lat.latency_percentile(0.99),
                (long long)head->lat.latency_percentile(0.999),
-               press_threads, callers, payload, pooled ? 1 : 0);
+               press_threads, callers, payload, pooled ? 1 : 0,
+               pool_desc ? 1 : 0, (long long)total_stale);
         if (gens.size() > 1 || !gens[0]->name.empty()) {
             printf(", \"press_tenants\": {");
             for (size_t i = 0; i < gens.size(); ++i) {
@@ -462,11 +525,13 @@ int main(int argc, char** argv) {
         }
         printf("}\n");
     } else {
-        printf("sent %lld ok (%lld failed, %lld shed) in %.1fs: %.0f qps "
-               "(target %lld, %d channels x %d callers)\n",
+        printf("sent %lld ok (%lld failed, %lld shed, %lld stale-epoch) "
+               "in %.1fs: %.0f qps (target %lld, %d channels x %d "
+               "callers%s)\n",
                (long long)total_sent, (long long)total_failed,
-               (long long)total_shed, secs, achieved, qps, press_threads,
-               callers);
+               (long long)total_shed, (long long)total_stale, secs,
+               achieved, qps, press_threads, callers,
+               pool_desc ? ", pool-desc" : "");
         printf("latency_us: p50 %lld  p99 %lld  p999 %lld  max %lld\n",
                (long long)head->lat.latency_percentile(0.5),
                (long long)head->lat.latency_percentile(0.99),

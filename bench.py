@@ -385,6 +385,11 @@ _SKIP_KEYS = {"metric", "unit", "vs_baseline", "reps", "error",
               "device_path_ok", "device_path_registered_staging",
               "device_path_cores", "pool_desc_calls", "pool_desc_bytes",
               "pool_desc_zero_copy",
+              # Response-direction descriptor round (ISSUE 12):
+              # pool_desc_rsp_mbps IS compared (the symmetric-zero-copy
+              # rate); shape/boolean evidence keys are not magnitudes.
+              "pool_desc_rsp_calls", "pool_desc_rsp_zero_copy",
+              "pool_desc_rsp_inline_bytes",
               # Lease leak gauges (ISSUE 10): evidence, not a rate — a
               # healthy round records pinned_after == 0; reaped counts
               # chaos/crash reclamations, so neither is a compare metric.
@@ -522,10 +527,12 @@ def run_bench():
     tail = run_tool("echo_bench", ["--json", "--tail"], timeout=600)
     scale = run_tool("echo_bench", ["--json", "--scale", "--ici"],
                      timeout=600)
-    # One-sided descriptor round (ISSUE 9): attachments as pool
-    # references over the in-process ici link; pool_desc_mbps is the
-    # logical rate, pool_desc_zero_copy the server-side proof.
-    pool_desc = run_tool("echo_bench", ["--json", "--ici", "--pool-desc"],
+    # One-sided descriptor round, BOTH directions (ISSUE 9/12):
+    # attachments as pool references over the in-process ici link.
+    # pool_desc_mbps / pool_desc_rsp_mbps are the logical rates per
+    # direction (the symmetric-zero-copy gate wants rsp within 20% of
+    # req); the *_zero_copy booleans are the verification proof.
+    pool_desc = run_tool("echo_bench", ["--json", "--ici", "--pool_desc"],
                          timeout=300)
     device = device_path()
     series = series_scrape()

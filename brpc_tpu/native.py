@@ -56,6 +56,18 @@ def lib() -> ctypes.CDLL:
         L.tpurpc_lease_pinned.restype = ctypes.c_uint64
         L.tpurpc_lease_reaped.restype = ctypes.c_uint64
         L.tpurpc_pool_epoch.restype = ctypes.c_uint64
+        L.tpurpc_transport_tier_count.restype = ctypes.c_int
+        L.tpurpc_transport_tier_name.restype = ctypes.c_long
+        L.tpurpc_transport_tier_name.argtypes = [
+            ctypes.c_int, ctypes.c_char_p, ctypes.c_size_t]
+        L.tpurpc_transport_tier_descriptor_capable.restype = ctypes.c_int
+        L.tpurpc_transport_tier_descriptor_capable.argtypes = [ctypes.c_int]
+        L.tpurpc_transport_tier_zero_copy.restype = ctypes.c_int
+        L.tpurpc_transport_tier_zero_copy.argtypes = [ctypes.c_int]
+        L.tpurpc_transport_tier_cross_process.restype = ctypes.c_int
+        L.tpurpc_transport_tier_cross_process.argtypes = [ctypes.c_int]
+        L.tpurpc_transport_tier_ops.restype = ctypes.c_long
+        L.tpurpc_transport_tier_ops.argtypes = [ctypes.c_int]
         L.tpurpc_ring_slot.restype = ctypes.c_void_p
         L.tpurpc_ring_slot.argtypes = [ctypes.c_void_p, ctypes.c_uint32]
         L.tpurpc_ring_slot_bytes.restype = ctypes.c_size_t
@@ -134,6 +146,29 @@ def lease_counters() -> tuple[int, int]:
     records after every round (a healthy round ends pinned == 0)."""
     L = lib()
     return int(L.tpurpc_lease_pinned()), int(L.tpurpc_lease_reaped())
+
+
+def transport_tiers() -> list[dict]:
+    """The first-class Transport registry (ISSUE 12): one dict per
+    registered endpoint type with its capability bits and op count —
+    the uniform tcp/ici/shm_xproc/device tier story, introspected
+    straight from the C++ seam."""
+    L = lib()
+    tiers = []
+    name = ctypes.create_string_buffer(64)
+    for t in range(int(L.tpurpc_transport_tier_count())):
+        if L.tpurpc_transport_tier_name(t, name, len(name)) < 0:
+            continue
+        tiers.append({
+            "name": name.value.decode(),
+            "descriptor_capable": bool(
+                L.tpurpc_transport_tier_descriptor_capable(t)),
+            "zero_copy": bool(L.tpurpc_transport_tier_zero_copy(t)),
+            "cross_process": bool(
+                L.tpurpc_transport_tier_cross_process(t)),
+            "ops": int(L.tpurpc_transport_tier_ops(t)),
+        })
+    return tiers
 
 
 class RingAbortedError(RuntimeError):

@@ -935,3 +935,213 @@ TEST(IciRpc, EchoOverIciLink) {
     server.Stop();
     server.Join();
 }
+
+// ---------------- response-direction descriptors (ISSUE 12) -------------
+
+namespace {
+
+// Handler answering desc_rsp:N:S requests with an N-byte pool-block
+// reference (pattern: byte 0 = S, rest 'a'+S%26); "inline_fallback"
+// exercises the ineligible-shape path (a multi-block IOBuf must fall
+// back to inline response-attachment bytes).
+class RspDescEchoService : public test::EchoService {
+public:
+    void Echo(google::protobuf::RpcController* cntl_base,
+              const test::EchoRequest* req, test::EchoResponse* res,
+              google::protobuf::Closure* done) override {
+        Controller* cntl = static_cast<Controller*>(cntl_base);
+        unsigned long long n = 0;
+        unsigned seed = 0;
+        if (sscanf(req->message().c_str(), "desc_rsp:%llu:%u", &n,
+                   &seed) == 2 &&
+            n > 0) {
+            IOBuf out;
+            char* data = nullptr;
+            if (IciBlockPool::AllocatePoolAttachment((size_t)n, &out,
+                                                     &data)) {
+                memset(data, 'a' + (int)(seed % 26), (size_t)n);
+                data[0] = (char)seed;
+                cntl->set_response_pool_attachment(std::move(out));
+                res->set_message("ok");
+            } else {
+                cntl->SetFailed(TERR_RESPONSE, "alloc failed");
+            }
+        } else if (req->message() == "inline_fallback") {
+            // Multi-block shape: one (offset, len) cannot name it, so
+            // the set must fall back to inline bytes.
+            IOBuf multi;
+            multi.append(std::string(9000, 'x'));
+            multi.append(std::string(9000, 'y'));
+            cntl->set_response_pool_attachment(std::move(multi));
+            res->set_message("ok");
+        }
+        done->Run();
+    }
+};
+
+}  // namespace
+
+TEST(RspPoolDescriptor, ZeroCopyAndAckLifecycleOverIciLink) {
+    ASSERT_EQ(0, IciBlockPool::Init());
+    RspDescEchoService service;
+    Server server;
+    ASSERT_EQ(0, server.AddService(&service));
+    ASSERT_EQ(0, server.StartNoListen(nullptr));
+
+    IciLink& link = *IciLink::Create();
+    SocketOptions sopts;
+    sopts.fd = link.second()->event_fd();
+    sopts.transport = link.second();
+    sopts.owns_transport = true;
+    sopts.on_edge_triggered_events = InputMessenger::OnNewMessages;
+    sopts.user = server.messenger();
+    SocketId server_sid;
+    ASSERT_EQ(0, Socket::Create(sopts, &server_sid));
+    SocketOptions copts;
+    copts.fd = link.first()->event_fd();
+    copts.transport = link.first();
+    copts.owns_transport = true;
+    copts.on_edge_triggered_events = InputMessenger::OnNewMessages;
+    copts.user = Channel::client_messenger();
+    SocketId client_sid;
+    ASSERT_EQ(0, Socket::Create(copts, &client_sid));
+    Channel channel;
+    ChannelOptions chopts;
+    chopts.timeout_ms = 5000;
+    ASSERT_EQ(0, channel.InitWithSocketId(client_sid, &chopts));
+    test::EchoService_Stub stub(&channel);
+
+    // The ici tier is descriptor-capable by registry contract — the one
+    // seam both descriptor directions consult.
+    {
+        SocketUniquePtr cs;
+        ASSERT_EQ(0, Socket::AddressSocket(client_sid, &cs));
+        ASSERT_EQ(TierIci(), cs->transport_tier());
+        ASSERT_TRUE(TransportDescriptorCapable(cs.get()));
+    }
+
+    const uint64_t pinned0 = block_lease::pinned();
+    const size_t kBytes = 60000;
+    {
+        Controller cntl;
+        cntl.set_timeout_ms(5000);
+        test::EchoRequest req;
+        test::EchoResponse res;
+        char ask[64];
+        snprintf(ask, sizeof(ask), "desc_rsp:%zu:%u", kBytes, 7u);
+        req.set_message(ask);
+        stub.Echo(&cntl, &req, &res, nullptr);
+        ASSERT_FALSE(cntl.Failed());
+        EXPECT_EQ("ok", res.message());
+        const Controller::PoolAttachment& view =
+            cntl.response_pool_attachment();
+        ASSERT_TRUE(view.data != nullptr);
+        EXPECT_EQ((uint64_t)kBytes, view.length);
+        // Zero inline payload bytes; the view reads the server's pool
+        // in place (one address space here, so Contains sees it).
+        EXPECT_EQ((size_t)0, cntl.response_attachment().size());
+        EXPECT_TRUE(IciBlockPool::Contains(view.data));
+        EXPECT_EQ((char)7, view.data[0]);
+        EXPECT_EQ((char)('a' + 7), view.data[1]);
+        // Client role: no local lease — the pin lives on the SERVER
+        // side of the call, held for exactly as long as this view.
+        EXPECT_EQ((uint64_t)0, cntl.response_pool_lease_id());
+        EXPECT_EQ(pinned0 + 1, block_lease::pinned());
+        // Releasing the view (controller reuse) sends the desc_ack; the
+        // server's pin must drop exactly once.
+        cntl.Reset();
+        bool released = false;
+        for (int i = 0; i < 500 && !released; ++i) {
+            released = block_lease::pinned() == pinned0;
+            if (!released) usleep(10 * 1000);
+        }
+        EXPECT_TRUE(released);
+    }
+    // Ineligible multi-block shape: transparent inline fallback — the
+    // handler API is transport/shape-agnostic.
+    {
+        Controller cntl;
+        cntl.set_timeout_ms(5000);
+        test::EchoRequest req;
+        test::EchoResponse res;
+        req.set_message("inline_fallback");
+        stub.Echo(&cntl, &req, &res, nullptr);
+        ASSERT_FALSE(cntl.Failed());
+        EXPECT_TRUE(cntl.response_pool_attachment().data == nullptr);
+        EXPECT_EQ((size_t)18000, cntl.response_attachment().size());
+        EXPECT_EQ(pinned0, block_lease::pinned());
+    }
+
+    SocketUniquePtr cs;
+    ASSERT_EQ(0, Socket::AddressSocket(client_sid, &cs));
+    cs->SetFailedWithError(TERR_CLOSE);
+    cs.reset();
+    server.Stop();
+    server.Join();
+}
+
+TEST(RspPoolDescriptor, ClientDeathReleasesServerPins) {
+    // The chaos-soak invariant at unit scale: a client that dies
+    // mid-view (no ack ever sent) must not strand the server's rsp pin
+    // — the socket failure observer releases every lease armed against
+    // the dead connection (server_call::OnSocketFailed -> ReleasePeer).
+    ASSERT_EQ(0, IciBlockPool::Init());
+    RspDescEchoService service;
+    Server server;
+    ASSERT_EQ(0, server.AddService(&service));
+    ASSERT_EQ(0, server.StartNoListen(nullptr));
+
+    IciLink& link = *IciLink::Create();
+    SocketOptions sopts;
+    sopts.fd = link.second()->event_fd();
+    sopts.transport = link.second();
+    sopts.owns_transport = true;
+    sopts.on_edge_triggered_events = InputMessenger::OnNewMessages;
+    sopts.user = server.messenger();
+    SocketId server_sid;
+    ASSERT_EQ(0, Socket::Create(sopts, &server_sid));
+    SocketOptions copts;
+    copts.fd = link.first()->event_fd();
+    copts.transport = link.first();
+    copts.owns_transport = true;
+    copts.on_edge_triggered_events = InputMessenger::OnNewMessages;
+    copts.user = Channel::client_messenger();
+    SocketId client_sid;
+    ASSERT_EQ(0, Socket::Create(copts, &client_sid));
+    Channel channel;
+    ChannelOptions chopts;
+    chopts.timeout_ms = 5000;
+    ASSERT_EQ(0, channel.InitWithSocketId(client_sid, &chopts));
+    test::EchoService_Stub stub(&channel);
+
+    const uint64_t pinned0 = block_lease::pinned();
+    auto* cntl = new Controller;  // leaked past the socket death below
+    cntl->set_timeout_ms(5000);
+    test::EchoRequest req;
+    test::EchoResponse res;
+    req.set_message("desc_rsp:30000:3");
+    stub.Echo(cntl, &req, &res, nullptr);
+    ASSERT_FALSE(cntl->Failed());
+    ASSERT_EQ(pinned0 + 1, block_lease::pinned());
+
+    // "SIGKILL" the client: fail its socket with the view still held
+    // and never run the controller's teardown ack.
+    SocketUniquePtr cs;
+    ASSERT_EQ(0, Socket::AddressSocket(client_sid, &cs));
+    cs->SetFailedWithError(TERR_CLOSE);
+    cs.reset();
+    bool released = false;
+    for (int i = 0; i < 500 && !released; ++i) {
+        released = block_lease::pinned() == pinned0;
+        if (!released) usleep(10 * 1000);
+    }
+    EXPECT_TRUE(released);
+    const uint64_t peer_released0 = block_lease::peer_released();
+    EXPECT_GE(peer_released0, (uint64_t)1);
+
+    // The leaked controller's destructor fires a best-effort ack at a
+    // dead socket: must be a harmless no-op, not a crash/double free.
+    delete cntl;
+    server.Stop();
+    server.Join();
+}

@@ -1,6 +1,7 @@
 // I/O core loopback tests: real sockets, real epoll, full read/write paths —
 // the in-process loopback style of the reference's tests (e.g.
 // test/brpc_channel_unittest.cpp:195 starts a real listener in-process).
+#include <sys/socket.h>
 #include <unistd.h>
 
 #include <algorithm>
@@ -581,4 +582,86 @@ TEST(Net, ConnectFailureFailsSocket) {
         usleep(10000);
     }
     EXPECT_TRUE(s->Failed());
+}
+
+// ---------------- transport tier registry (ISSUE 12) ----------------
+
+TEST(TransportTier, RegistryBuiltinsAndIdempotence) {
+    // Built-ins exist with the capability story the descriptor seam
+    // relies on: tcp moves bytes only; ici/shm_xproc are zero-copy and
+    // descriptor-capable; device is the staging-ring tier.
+    const int tcp = TierTcp();
+    const int ici = TierIci();
+    const int shm = TierShmXproc();
+    const int dev = TierDevice();
+    ASSERT_GE(tcp, 0);
+    ASSERT_NE(tcp, ici);
+    ASSERT_NE(ici, shm);
+    ASSERT_NE(shm, dev);
+    const TransportTier* t = GetTransportTier(tcp);
+    ASSERT_TRUE(t != nullptr);
+    EXPECT_FALSE(t->descriptor_capable);
+    EXPECT_FALSE(t->zero_copy);
+    EXPECT_TRUE(t->cross_process);
+    t = GetTransportTier(ici);
+    ASSERT_TRUE(t != nullptr);
+    EXPECT_TRUE(t->descriptor_capable);
+    EXPECT_TRUE(t->zero_copy);
+    EXPECT_FALSE(t->cross_process);
+    t = GetTransportTier(shm);
+    ASSERT_TRUE(t != nullptr);
+    EXPECT_TRUE(t->descriptor_capable);
+    EXPECT_TRUE(t->cross_process);
+    // Registration is idempotent by name (re-register returns the
+    // existing id) and lookup by name round-trips.
+    EXPECT_EQ(tcp, RegisterTransportTier({"tcp", true, true, false}));
+    EXPECT_EQ(ici, FindTransportTier("ici"));
+    EXPECT_EQ(-1, FindTransportTier("no_such_tier"));
+    EXPECT_TRUE(GetTransportTier(-1) == nullptr);
+    EXPECT_TRUE(GetTransportTier(10000) == nullptr);
+    EXPECT_GE(TransportTierCount(), 4);
+}
+
+TEST(TransportTier, StatsAttributeByTier) {
+    const int ici = TierIci();
+    const int64_t in0 = transport_stats::in_bytes(ici);
+    const int64_t stalls0 = transport_stats::credit_stalls(ici);
+    transport_stats::AddIn(ici, 1234);
+    transport_stats::AddCreditStall(ici);
+    transport_stats::AddDescOut(ici, 99);
+    EXPECT_EQ(in0 + 1234, transport_stats::in_bytes(ici));
+    EXPECT_EQ(stalls0 + 1, transport_stats::credit_stalls(ici));
+    EXPECT_GE(transport_stats::desc_out_bytes(ici), (int64_t)99);
+    // Bad ids are ignored, never a crash.
+    transport_stats::AddIn(-1, 5);
+    transport_stats::AddIn(9999, 5);
+    EXPECT_EQ((int64_t)0, transport_stats::in_bytes(9999));
+    // The /pools section renders one line per tier.
+    const std::string dump = transport_stats::DebugString();
+    EXPECT_TRUE(dump.find("tier tcp") != std::string::npos);
+    EXPECT_TRUE(dump.find("tier ici") != std::string::npos);
+    EXPECT_TRUE(dump.find("tier shm_xproc") != std::string::npos);
+    EXPECT_TRUE(dump.find("tier device") != std::string::npos);
+}
+
+TEST(TransportTier, DescriptorSeamGatesOnTierAndPool) {
+    // Null socket: never capable, never in scope.
+    EXPECT_FALSE(TransportDescriptorCapable(nullptr));
+    EXPECT_FALSE(TransportDescriptorScopeOk(nullptr, 1));
+    // A plain-fd socket is the tcp tier: bytes only, no descriptors —
+    // regardless of what pool id a request names.
+    int fds[2];
+    ASSERT_EQ(0, socketpair(AF_UNIX, SOCK_STREAM, 0, fds));
+    SocketOptions opts;
+    opts.fd = fds[0];
+    SocketId sid;
+    ASSERT_EQ(0, Socket::Create(opts, &sid));
+    SocketUniquePtr s;
+    ASSERT_EQ(0, Socket::AddressSocket(sid, &s));
+    EXPECT_EQ(TierTcp(), s->transport_tier());
+    EXPECT_FALSE(TransportDescriptorCapable(s.get()));
+    EXPECT_FALSE(TransportDescriptorScopeOk(s.get(), 42));
+    s->SetFailedWithError(TERR_CLOSE);
+    s.reset();
+    close(fds[1]);
 }

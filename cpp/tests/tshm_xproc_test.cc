@@ -198,6 +198,55 @@ TEST(ShmXproc, PoolDescriptorHandoffIsZeroCopy) {
     child.Shutdown();
 }
 
+TEST(ShmXproc, ResponseDescriptorHandoffIsZeroCopy) {
+    // Response-direction one-sided descriptor across REAL process
+    // boundaries (ISSUE 12): the SERVER answers with a reference into
+    // ITS pool; this client resolves it against the mapping the
+    // handshake made of that pool and reads the seeded pattern in place
+    // — zero inline payload bytes in the response. Releasing the view
+    // (controller reuse) sends the desc_ack that unpins the server's
+    // block.
+    ASSERT_EQ(0, IciBlockPool::Init());
+    ServerChild child;
+    ASSERT_TRUE(child.Spawn());
+    EndPoint ep;
+    str2endpoint("127.0.0.1", child.port, &ep);
+    Channel ch;
+    ChannelOptions copts;
+    copts.timeout_ms = 3000;
+    ASSERT_EQ(0, ch.InitIci(ep, &copts));
+    benchpb::EchoService_Stub stub(&ch);
+
+    const size_t kBytes = 150000;
+    for (int round = 0; round < 3; ++round) {
+        Controller cntl;
+        cntl.set_timeout_ms(3000);
+        benchpb::EchoRequest req;
+        benchpb::EchoResponse res;
+        char ask[64];
+        snprintf(ask, sizeof(ask), "desc_rsp:%zu:%d", kBytes, round);
+        req.set_payload(ask);
+        req.set_send_ts_us(round);
+        stub.Echo(&cntl, &req, &res, nullptr);
+        ASSERT_FALSE(cntl.Failed());
+        const Controller::PoolAttachment& view =
+            cntl.response_pool_attachment();
+        ASSERT_TRUE(view.data != nullptr);
+        EXPECT_EQ((uint64_t)kBytes, view.length);
+        // The view lives in the MAPPED PEER pool, not ours — the bytes
+        // never entered this process's pool or the wire.
+        EXPECT_FALSE(IciBlockPool::Contains(view.data));
+        EXPECT_EQ((size_t)0, cntl.response_attachment().size());
+        EXPECT_EQ((char)round, view.data[0]);
+        EXPECT_EQ((char)('a' + round % 26), view.data[1]);
+        // No local pin for a response-direction descriptor: the pin
+        // lives in the SERVER process.
+        EXPECT_EQ((uint64_t)0, cntl.response_pool_lease_id());
+        // cntl teardown acks the server's pin.
+    }
+    child.Shutdown();
+}
+
 TEST(ShmXproc, HandshakeBadVersionRejected) {
     ServerChild child;
     ASSERT_TRUE(child.Spawn());

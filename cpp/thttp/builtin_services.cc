@@ -69,9 +69,11 @@ void HandleIndex(Server*, const HttpRequest&, HttpResponse* res) {
         "              /hotspots/heap, /hotspots/growth,\n"
         "              /hotspots/contention)\n"
         "/chaos        fault injection (?enable=1&seed=N&plan=...&peers=...)\n"
-        "/pools        zero-copy pool state: live pinned-block leases,\n"
-        "              per-class slab occupancy, mapped peer pools +\n"
-        "              epochs (?format=json machine form)\n"
+        "/pools        zero-copy pool state: live pinned-block leases\n"
+        "              (with direction: req/rsp), per-class slab\n"
+        "              occupancy, mapped peer pools + epochs, and the\n"
+        "              transport-tier byte attribution\n"
+        "              (?format=json machine form)\n"
         "/metrics      prometheus exposition\n");
 }
 
@@ -675,6 +677,36 @@ void HandlePools(Server*, const HttpRequest& req, HttpResponse* res) {
                      st.freelist, st.carved);
             out += line;
         }
+        // Live leases with their direction column (req = client request
+        // pin, rsp = server response pin awaiting the client's ack).
+        out += "], \"leases\": ";
+        out += block_lease::JsonLeases(64);
+        // Transport-tier registry + byte attribution (ISSUE 12): one
+        // entry per registered endpoint type. Own buffer: the format
+        // literals alone approach the shared line[192], so real
+        // multi-digit counters would truncate the JSON mid-object.
+        out += ", \"transports\": [";
+        char tline[512];
+        for (int t = 0; t < TransportTierCount(); ++t) {
+            const TransportTier* tier = GetTransportTier(t);
+            if (tier == nullptr) break;
+            snprintf(tline, sizeof(tline),
+                     "%s{\"name\": \"%s\", \"descriptor_capable\": %d, "
+                     "\"zero_copy\": %d, \"cross_process\": %d, "
+                     "\"in_bytes\": %lld, \"out_bytes\": %lld, "
+                     "\"desc_in_bytes\": %lld, \"desc_out_bytes\": %lld, "
+                     "\"credit_stalls\": %lld, \"ops\": %lld}",
+                     t == 0 ? "" : ", ", tier->name,
+                     tier->descriptor_capable ? 1 : 0,
+                     tier->zero_copy ? 1 : 0, tier->cross_process ? 1 : 0,
+                     (long long)transport_stats::in_bytes(t),
+                     (long long)transport_stats::out_bytes(t),
+                     (long long)transport_stats::desc_in_bytes(t),
+                     (long long)transport_stats::desc_out_bytes(t),
+                     (long long)transport_stats::credit_stalls(t),
+                     (long long)transport_stats::ops(t));
+            out += tline;
+        }
         out += "]}";
         res->Append(out);
         return;
@@ -701,6 +733,8 @@ void HandlePools(Server*, const HttpRequest& req, HttpResponse* res) {
              (unsigned long long)pool_registry::resolves(),
              (unsigned long long)pool_registry::resolve_failures());
     res->Append(line);
+    res->Append("-- transport tiers (capabilities + attribution) --\n");
+    res->Append(transport_stats::DebugString());
 }
 
 // /tenants: the multi-tenant QoS tier (ISSUE 8) — configured quotas,
@@ -733,9 +767,11 @@ void HandleMetrics(Server*, const HttpRequest&, HttpResponse* res) {
 }  // namespace
 
 void AddBuiltinHttpServices(Server* server) {
-    // The /pools + /metrics pages report the lease families even on a
-    // server that never pinned a block (0 is data; absent is not).
+    // The /pools + /metrics pages report the lease + transport families
+    // even on a server that never pinned a block or moved a transport
+    // byte (0 is data; absent is not).
     block_lease::ExposeVars();
+    transport_stats::ExposeVars();
     server->RegisterHttpHandler("/", HandleIndex);
     server->RegisterHttpHandler("/health", HandleHealth);
     server->RegisterHttpHandler("/status", HandleStatus);

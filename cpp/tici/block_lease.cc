@@ -29,6 +29,9 @@ namespace {
 
 struct Lease {
     IOBuf pinned;        // the one ref keeping the slab slot alive
+    // Ledger direction: "req" (client request pin, EndRPC releases) or
+    // "rsp" (server response pin, the client's desc_ack releases).
+    const char* direction = "req";
     uint64_t call_id = 0;
     // Always > 0: Pin stamps now + -pool_lease_default_ms so even a
     // lease whose owner dies before Arm is reapable (no unreapable
@@ -97,7 +100,7 @@ void drop_pins(std::vector<IOBuf>* pins) { pins->clear(); }
 
 }  // namespace
 
-uint64_t Pin(IOBuf&& buf) {
+uint64_t Pin(IOBuf&& buf, const char* direction) {
     StartReaper();
     const uint64_t id =
         g_next_id.fetch_add(1, std::memory_order_relaxed);
@@ -105,6 +108,7 @@ uint64_t Pin(IOBuf&& buf) {
         std::lock_guard<std::mutex> g(mu());
         Lease& l = leases()[id];
         l.pinned = std::move(buf);
+        l.direction = direction;
         // Default lifetime from the moment of the pin: a lease whose
         // owner never reaches Arm (setup failure + dropped release) is
         // still reapable — no unreapable pin state exists.
@@ -237,6 +241,58 @@ size_t ReleasePeer(uint64_t peer_key) {
     return n;
 }
 
+size_t ReleaseByCall(uint64_t call_id, uint64_t peer_key) {
+    if (call_id == 0) return 0;
+    std::vector<IOBuf> pins;
+    {
+        std::lock_guard<std::mutex> g(mu());
+        auto& m = leases();
+        for (auto it = m.begin(); it != m.end();) {
+            Lease& l = it->second;
+            bool entitled = false;
+            for (int i = 0; i < l.npeers; ++i) {
+                entitled = entitled || l.peer_keys[i] == peer_key;
+            }
+            if (l.call_id == call_id && entitled) {
+                pins.push_back(std::move(l.pinned));
+                it = m.erase(it);
+            } else {
+                ++it;
+            }
+        }
+    }
+    const size_t n = pins.size();
+    if (n > 0) {
+        g_pinned.fetch_sub(n, std::memory_order_relaxed);
+        g_released.fetch_add(n, std::memory_order_relaxed);
+        drop_pins(&pins);
+    }
+    return n;
+}
+
+bool ReleaseAcked(uint64_t lease_id, uint64_t call_id,
+                  uint64_t peer_key) {
+    if (lease_id == 0 || call_id == 0) return false;
+    IOBuf pin;
+    {
+        std::lock_guard<std::mutex> g(mu());
+        auto it = leases().find(lease_id);
+        if (it == leases().end()) return false;  // already released
+        Lease& l = it->second;
+        bool entitled = false;
+        for (int i = 0; i < l.npeers; ++i) {
+            entitled = entitled || l.peer_keys[i] == peer_key;
+        }
+        if (l.call_id != call_id || !entitled) return false;
+        pin = std::move(l.pinned);
+        leases().erase(it);
+    }
+    g_pinned.fetch_sub(1, std::memory_order_relaxed);
+    g_released.fetch_add(1, std::memory_order_relaxed);
+    pin.clear();  // dec_ref -> slab recycle, outside the lock
+    return true;
+}
+
 uint64_t pinned() { return g_pinned.load(std::memory_order_relaxed); }
 uint64_t pins_total() {
     return g_pins_total.load(std::memory_order_relaxed);
@@ -277,15 +333,40 @@ std::string DebugString() {
         }
         const Lease& l = kv.second;
         snprintf(line, sizeof(line),
-                 "lease %llu bytes=%zu call=%llu deadline_in_ms=%lld "
-                 "peer=%llu peer2=%llu\n",
-                 (unsigned long long)kv.first, l.pinned.size(),
-                 (unsigned long long)l.call_id,
+                 "lease %llu dir=%s bytes=%zu call=%llu "
+                 "deadline_in_ms=%lld peer=%llu peer2=%llu\n",
+                 (unsigned long long)kv.first, l.direction,
+                 l.pinned.size(), (unsigned long long)l.call_id,
                  (long long)((l.deadline_us - now) / 1000),
                  (unsigned long long)l.peer_keys[0],
                  (unsigned long long)l.peer_keys[1]);
         out += line;
     }
+    return out;
+}
+
+std::string JsonLeases(size_t max) {
+    const int64_t now = monotonic_time_us();
+    std::string out = "[";
+    char line[192];
+    std::lock_guard<std::mutex> g(mu());
+    size_t shown = 0;
+    for (const auto& kv : leases()) {
+        if (shown >= max) break;
+        const Lease& l = kv.second;
+        snprintf(line, sizeof(line),
+                 "%s{\"id\": %llu, \"direction\": \"%s\", \"bytes\": %zu, "
+                 "\"call\": %llu, \"deadline_in_ms\": %lld, "
+                 "\"peer\": %llu}",
+                 shown == 0 ? "" : ", ", (unsigned long long)kv.first,
+                 l.direction, l.pinned.size(),
+                 (unsigned long long)l.call_id,
+                 (long long)((l.deadline_us - now) / 1000),
+                 (unsigned long long)l.peer_keys[0]);
+        out += line;
+        ++shown;
+    }
+    out += "]";
     return out;
 }
 

@@ -45,7 +45,11 @@ namespace block_lease {
 
 // Pin `buf` (ownership moves into the registry). Returns a nonzero
 // lease id. The bytes stay readable by peers until the first Release.
-uint64_t Pin(IOBuf&& buf);
+// `direction` tags the lease for the /pools ledger: "req" = a client
+// pinning a request attachment (released at EndRPC), "rsp" = a server
+// pinning a response attachment (released by the client's desc_ack).
+// Must be a string with static storage duration.
+uint64_t Pin(IOBuf&& buf, const char* direction = "req");
 
 // Stamp ownership + expiry on a pinned lease (idempotent). `deadline_us`
 // is an absolute monotonic_time_us instant; <= 0 applies now +
@@ -76,6 +80,26 @@ size_t ReapExpired(int64_t now_us);
 // shm-link teardown). Returns released count.
 size_t ReleasePeer(uint64_t peer_key);
 
+// Release every lease armed with `call_id` AND entitled to `peer_key` —
+// the response-direction completion: the client's desc_ack names the
+// wire correlation id the server armed its response pin under, and the
+// ack arrives on the very connection the descriptor left on. BOTH keys
+// must match: correlation ids are only unique within one client
+// process, so an unscoped release could free another connection's pin.
+// Exactly-once like Release (a duplicate ack finds nothing). Returns
+// released count. O(live leases) scan — the token-less fallback; acks
+// carrying the descriptor's ack_token take the O(log n) ReleaseAcked
+// path instead.
+size_t ReleaseByCall(uint64_t call_id, uint64_t peer_key);
+
+// O(log n) scoped release by the ack token (= the lease id the server
+// embedded in the response descriptor): direct lookup, then the SAME
+// call-id + entitled-peer validation as ReleaseByCall — a forged or
+// cross-connection token frees nothing. True when this ack dropped the
+// pin.
+bool ReleaseAcked(uint64_t lease_id, uint64_t call_id,
+                  uint64_t peer_key);
+
 // Counters (also exposed as rpc_pool_{pinned_blocks,lease_expired,
 // reaped,peer_released} tvars).
 uint64_t pinned();         // live leases
@@ -84,10 +108,14 @@ uint64_t released();       // releases via Release() (EndRPC path)
 uint64_t expired_reaped(); // releases via ReapExpired
 uint64_t peer_released();  // releases via ReleasePeer
 
-// One "key value" line per stat + one "lease <id> call=<c> deadline_in_
-// ms=<d> peer=<p>" line per live lease (the /pools page body; bounded
-// to the first 64 leases).
+// One "key value" line per stat + one "lease <id> dir=<req|rsp>
+// call=<c> deadline_in_ms=<d> peer=<p>" line per live lease (the /pools
+// page body; bounded to the first 64 leases).
 std::string DebugString();
+
+// JSON array of live leases with a direction column (the /pools
+// ?format=json "leases" field; bounded to `max` entries).
+std::string JsonLeases(size_t max);
 
 // Start the background reaper thread (idempotent; Pin() calls it).
 void StartReaper();

@@ -19,6 +19,7 @@
 #include "tbase/logging.h"
 #include "tbase/fast_rand.h"
 #include "tnet/fault_injection.h"
+#include "tnet/transport.h"
 
 namespace tpurpc {
 
@@ -800,6 +801,9 @@ int DeviceStagingRing::Complete(uint32_t slot) {
     if (!inflight) return -1;
     done_[slot] = true;
     completed_.fetch_add(1, std::memory_order_relaxed);
+    // Device tier attribution: one staged slot cycled through the ring
+    // (ops only — the framed length inside the slot is the caller's).
+    transport_stats::AddOp(TierDevice());
     // FIFO reuse: advance the reusable frontier only over a contiguous
     // prefix of completed slots (out-of-order completes wait here).
     while (tail < head && done_[tail % depth_]) {
@@ -839,6 +843,11 @@ int IciBlockPool::Init(size_t region_bytes) {
                                 pool().shm_base, pool().shm_size,
                                 pool_epoch());
     }
+    // Teach the Transport tier how to name this process's pool: the
+    // descriptor-eligibility seam (tnet/transport.h) answers "may a
+    // descriptor ride/resolve here" for every endpoint type without
+    // tnet depending on the pool layer.
+    SetLocalPoolIdProvider(&IciBlockPool::pool_id);
     // From here on every new IOBuf block is transferable memory (the
     // TLS block cache only recycles blocks whose deallocator matches the
     // current pair, so stale malloc'd blocks are not handed back out).

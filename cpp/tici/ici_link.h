@@ -92,6 +92,7 @@ public:
     ssize_t Pump(IOPortal* dst) override;
     void Close() override;
     void Release() override;  // link frees itself after both sides release
+    int tier() const override { return TierIci(); }
 
     // Doorbell signal count (tests: event-suppression assertions).
     uint64_t signals_sent() const {

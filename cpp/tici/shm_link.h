@@ -150,6 +150,7 @@ public:
     ssize_t Pump(IOPortal* dst) override;
     void Close() override;
     void Release() override;
+    int tier() const override { return TierShmXproc(); }
 
     uint64_t signals_sent() const {
         return signals_sent_.load(std::memory_order_relaxed);

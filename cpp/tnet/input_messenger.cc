@@ -295,6 +295,8 @@ void InputMessenger::OnNewMessages(Socket* s) {
             }
             if (nr > 0) {
                 s->add_bytes_read(nr);
+                // Per-tier byte attribution (the Transport seam).
+                transport_stats::AddIn(s->transport_tier(), nr);
             } else if (nr == 0) {
                 read_eof = true;
             } else {

@@ -775,6 +775,9 @@ bool Socket::FlushOnce(bool allow_block) {
         if (nw < 0) {
             if (errno == EAGAIN || errno == EWOULDBLOCK) {
                 if (!allow_block) return false;  // caller spawns KeepWrite
+                // Out of window credits (queue-pair tiers) or kernel
+                // buffer (fd tier): the writer is about to park.
+                transport_stats::AddCreditStall(transport_tier());
                 const int wrc =
                     transport_ != nullptr
                         ? transport_->WaitWritable(monotonic_time_us() +
@@ -795,6 +798,9 @@ bool Socket::FlushOnce(bool allow_block) {
         unwritten_bytes_.fetch_sub(nw, std::memory_order_relaxed);
         add_bytes_written(nw);
         if (nw > 0) {
+            // Per-tier byte attribution (the Transport seam, ISSUE 12).
+            transport_stats::AddOut(transport_tier(), nw);
+            transport_stats::AddOp(transport_tier());
             // Write-batch attribution: one writev round = one batch.
             nwrite_batches_.fetch_add(1, std::memory_order_relaxed);
             if (nw > max_write_batch_.load(std::memory_order_relaxed)) {

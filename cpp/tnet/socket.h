@@ -28,13 +28,13 @@
 #include "tbase/time.h"
 #include "tbase/versioned_ref.h"
 #include "tnet/circuit_breaker.h"
+#include "tnet/transport.h"
 #include "tfiber/butex.h"
 #include "tfiber/fiber.h"
 
 namespace tpurpc {
 
 class Socket;
-class TransportEndpoint;
 using SocketId = VRefId;
 using SocketUniquePtr = VRefPtr<Socket>;
 
@@ -142,6 +142,14 @@ public:
 
     // Plugged data-plane transport (ICI), or null for the fd path.
     TransportEndpoint* transport() const { return transport_; }
+    // The registry tier of this connection's data plane (tnet/transport.h):
+    // TierTcp() for the plain-fd/TLS path, the endpoint's own tier
+    // otherwise. Descriptor eligibility, credit accounting, and byte
+    // attribution key off this — one seam, no per-transport special
+    // cases.
+    int transport_tier() const {
+        return transport_ != nullptr ? transport_->tier() : TierTcp();
+    }
     // Upgrade a live connection to a transport data plane (server side of
     // the ICI handshake). Must be called from the socket's input fiber
     // with no concurrent writers — i.e. before the peer can have sent any

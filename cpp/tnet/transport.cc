@@ -1,0 +1,265 @@
+#include "tnet/transport.h"
+
+#include <atomic>
+#include <cstring>
+#include <mutex>
+
+#include "tnet/socket.h"
+#include "tvar/multi_dimension.h"
+#include "tvar/reducer.h"
+
+namespace tpurpc {
+
+namespace {
+
+constexpr int kMaxTiers = 16;
+
+// Per-tier attribution cells, pre-resolved at registration so the hot
+// paths (socket write/read, ring complete, descriptor resolve) pay one
+// relaxed fetch_add — the PR-5 IntCell discipline.
+struct TierSlot {
+    TransportTier tier;
+    IntCell* in = nullptr;
+    IntCell* out = nullptr;
+    IntCell* desc_in = nullptr;
+    IntCell* desc_out = nullptr;
+    IntCell* credit_stalls = nullptr;
+    IntCell* ops = nullptr;
+};
+
+// Immortal registry: attribution runs from socket recycling, which can
+// land during static teardown (same rule as the lease registry).
+struct Registry {
+    std::mutex mu;
+    TierSlot slots[kMaxTiers];
+    std::atomic<int> count{0};
+    LabelledMetric<IntCell>* fam_in;
+    LabelledMetric<IntCell>* fam_out;
+    LabelledMetric<IntCell>* fam_desc_in;
+    LabelledMetric<IntCell>* fam_desc_out;
+    LabelledMetric<IntCell>* fam_stalls;
+    LabelledMetric<IntCell>* fam_ops;
+    Registry() {
+        const std::vector<std::string> labels{"transport"};
+        fam_in = new LabelledMetric<IntCell>("rpc_transport_in_bytes",
+                                             labels);
+        fam_out = new LabelledMetric<IntCell>("rpc_transport_out_bytes",
+                                              labels);
+        fam_desc_in = new LabelledMetric<IntCell>(
+            "rpc_transport_desc_in_bytes", labels);
+        fam_desc_out = new LabelledMetric<IntCell>(
+            "rpc_transport_desc_out_bytes", labels);
+        fam_stalls = new LabelledMetric<IntCell>(
+            "rpc_transport_credit_stalls", labels);
+        fam_ops = new LabelledMetric<IntCell>("rpc_transport_ops", labels);
+    }
+};
+
+Registry& reg() {
+    static Registry* r = new Registry;
+    return *r;
+}
+
+std::atomic<uint64_t (*)()> g_local_pool_provider{nullptr};
+
+}  // namespace
+
+int RegisterTransportTier(const TransportTier& t) {
+    Registry& r = reg();
+    std::lock_guard<std::mutex> g(r.mu);
+    const int n = r.count.load(std::memory_order_relaxed);
+    for (int i = 0; i < n; ++i) {
+        if (strcmp(r.slots[i].tier.name, t.name) == 0) return i;
+    }
+    if (n >= kMaxTiers) return -1;
+    TierSlot& s = r.slots[n];
+    s.tier = t;
+    const std::vector<std::string> v{t.name};
+    s.in = r.fam_in->get_stats(v);
+    s.out = r.fam_out->get_stats(v);
+    s.desc_in = r.fam_desc_in->get_stats(v);
+    s.desc_out = r.fam_desc_out->get_stats(v);
+    s.credit_stalls = r.fam_stalls->get_stats(v);
+    s.ops = r.fam_ops->get_stats(v);
+    // Publish AFTER the slot is fully built: lock-free readers index by
+    // id without taking the mutex.
+    r.count.store(n + 1, std::memory_order_release);
+    return n;
+}
+
+const TransportTier* GetTransportTier(int tier) {
+    Registry& r = reg();
+    if (tier < 0 || tier >= r.count.load(std::memory_order_acquire)) {
+        return nullptr;
+    }
+    return &r.slots[tier].tier;
+}
+
+int FindTransportTier(const char* name) {
+    Registry& r = reg();
+    const int n = r.count.load(std::memory_order_acquire);
+    for (int i = 0; i < n; ++i) {
+        if (strcmp(r.slots[i].tier.name, name) == 0) return i;
+    }
+    return -1;
+}
+
+int TransportTierCount() {
+    return reg().count.load(std::memory_order_acquire);
+}
+
+// Built-ins: one static per tier keeps the id resolution free after the
+// first call, and the registration order deterministic per process.
+int TierTcp() {
+    static const int id = RegisterTransportTier(
+        {"tcp", /*descriptor_capable=*/false, /*zero_copy=*/false,
+         /*cross_process=*/true});
+    return id;
+}
+int TierIci() {
+    static const int id = RegisterTransportTier(
+        {"ici", /*descriptor_capable=*/true, /*zero_copy=*/true,
+         /*cross_process=*/false});
+    return id;
+}
+int TierShmXproc() {
+    static const int id = RegisterTransportTier(
+        {"shm_xproc", /*descriptor_capable=*/true, /*zero_copy=*/true,
+         /*cross_process=*/true});
+    return id;
+}
+int TierDevice() {
+    static const int id = RegisterTransportTier(
+        {"device", /*descriptor_capable=*/true, /*zero_copy=*/true,
+         /*cross_process=*/false});
+    return id;
+}
+
+void SetLocalPoolIdProvider(uint64_t (*provider)()) {
+    g_local_pool_provider.store(provider, std::memory_order_release);
+}
+
+uint64_t TransportLocalPoolId() {
+    uint64_t (*p)() = g_local_pool_provider.load(std::memory_order_acquire);
+    return p != nullptr ? p() : 0;
+}
+
+bool TransportDescriptorCapable(const Socket* s) {
+    if (s == nullptr) return false;
+    const TransportTier* t = GetTransportTier(s->transport_tier());
+    if (t == nullptr || !t->descriptor_capable) return false;
+    // A capable tier still needs a pool to reference: cross-process
+    // peers mapped ours at handshake (peer_pool_id is the evidence the
+    // handshake ran); in-process peers resolve the local pool directly.
+    if (!t->cross_process) return TransportLocalPoolId() != 0;
+    return s->peer_pool_id() != 0 || TransportLocalPoolId() != 0;
+}
+
+bool TransportDescriptorScopeOk(const Socket* s, uint64_t pool_id) {
+    if (s == nullptr || pool_id == 0) return false;
+    const TransportTier* t = GetTransportTier(s->transport_tier());
+    if (t == nullptr || !t->descriptor_capable) return false;
+    if (pool_id == s->peer_pool_id()) return true;
+    // In-process transport links (and loopback xproc links in one
+    // process) may reference this process's own pool.
+    return pool_id == TransportLocalPoolId();
+}
+
+namespace transport_stats {
+
+namespace {
+inline TierSlot* slot(int tier) {
+    Registry& r = reg();
+    if (tier < 0 || tier >= r.count.load(std::memory_order_acquire)) {
+        return nullptr;
+    }
+    return &r.slots[tier];
+}
+}  // namespace
+
+void AddIn(int tier, int64_t bytes) {
+    TierSlot* s = slot(tier);
+    if (s != nullptr) s->in->add(bytes);
+}
+void AddOut(int tier, int64_t bytes) {
+    TierSlot* s = slot(tier);
+    if (s != nullptr) s->out->add(bytes);
+}
+void AddDescIn(int tier, int64_t bytes) {
+    TierSlot* s = slot(tier);
+    if (s != nullptr) s->desc_in->add(bytes);
+}
+void AddDescOut(int tier, int64_t bytes) {
+    TierSlot* s = slot(tier);
+    if (s != nullptr) s->desc_out->add(bytes);
+}
+void AddCreditStall(int tier) {
+    TierSlot* s = slot(tier);
+    if (s != nullptr) s->credit_stalls->add(1);
+}
+void AddOp(int tier) {
+    TierSlot* s = slot(tier);
+    if (s != nullptr) s->ops->add(1);
+}
+
+int64_t in_bytes(int tier) {
+    TierSlot* s = slot(tier);
+    return s != nullptr ? s->in->get() : 0;
+}
+int64_t out_bytes(int tier) {
+    TierSlot* s = slot(tier);
+    return s != nullptr ? s->out->get() : 0;
+}
+int64_t desc_in_bytes(int tier) {
+    TierSlot* s = slot(tier);
+    return s != nullptr ? s->desc_in->get() : 0;
+}
+int64_t desc_out_bytes(int tier) {
+    TierSlot* s = slot(tier);
+    return s != nullptr ? s->desc_out->get() : 0;
+}
+int64_t credit_stalls(int tier) {
+    TierSlot* s = slot(tier);
+    return s != nullptr ? s->credit_stalls->get() : 0;
+}
+int64_t ops(int tier) {
+    TierSlot* s = slot(tier);
+    return s != nullptr ? s->ops->get() : 0;
+}
+
+std::string DebugString() {
+    ExposeVars();
+    Registry& r = reg();
+    const int n = r.count.load(std::memory_order_acquire);
+    std::string out;
+    char line[256];
+    for (int i = 0; i < n; ++i) {
+        const TierSlot& s = r.slots[i];
+        snprintf(line, sizeof(line),
+                 "tier %-9s desc=%d zero_copy=%d xproc=%d in=%lld "
+                 "out=%lld desc_in=%lld desc_out=%lld stalls=%lld "
+                 "ops=%lld\n",
+                 s.tier.name, s.tier.descriptor_capable ? 1 : 0,
+                 s.tier.zero_copy ? 1 : 0, s.tier.cross_process ? 1 : 0,
+                 (long long)s.in->get(), (long long)s.out->get(),
+                 (long long)s.desc_in->get(), (long long)s.desc_out->get(),
+                 (long long)s.credit_stalls->get(),
+                 (long long)s.ops->get());
+        out += line;
+    }
+    return out;
+}
+
+void ExposeVars() {
+    // Touch the built-ins so the four baseline tiers (and their labelled
+    // family series) exist from the first scrape even on a server that
+    // never moved a transport byte.
+    TierTcp();
+    TierIci();
+    TierShmXproc();
+    TierDevice();
+}
+
+}  // namespace transport_stats
+
+}  // namespace tpurpc

@@ -1,23 +1,134 @@
-// TransportEndpoint: the pluggable data-plane seam of Socket — how an
-// ICI/shm queue-pair transport takes over reads and writes while Socket
-// keeps the id/lifecycle/wait-free-queue semantics.
+// The Transport tier: the first-class peer-endpoint seam of the stack.
 //
-// Modeled on the role of reference src/brpc/rdma/rdma_endpoint.h: the
-// RDMA endpoint bypasses the fd write path (CutFromIOBufList
-// rdma_endpoint.cpp:777 posts IOBuf blocks as SGEs zero-copy), delivers
-// completions through a comp-channel fd registered with the normal
-// EventDispatcher (PollCq rdma_endpoint.cpp:1364), and rejoins the
-// standard InputMessenger parse pipeline (input_messenger.cpp:416). The
-// four pillars preserved here (SURVEY §2.9): zero-copy block posting,
-// windowed credit flow control, event suppression/batched completions,
-// completions unified into the one event dispatcher.
+// Two layers live here:
+//
+//  1. TransportEndpoint — the pluggable DATA-PLANE of one Socket: how an
+//     ICI/shm queue-pair (or TLS) transport takes over reads and writes
+//     while Socket keeps the id/lifecycle/wait-free-queue semantics.
+//     Modeled on the role of reference src/brpc/rdma/rdma_endpoint.h: the
+//     RDMA endpoint bypasses the fd write path (CutFromIOBufList
+//     rdma_endpoint.cpp:777 posts IOBuf blocks as SGEs zero-copy),
+//     delivers completions through a comp-channel fd registered with the
+//     normal EventDispatcher (PollCq rdma_endpoint.cpp:1364), and rejoins
+//     the standard InputMessenger parse pipeline
+//     (input_messenger.cpp:416). The four pillars preserved here (SURVEY
+//     §2.9): zero-copy block posting, windowed credit flow control, event
+//     suppression/batched completions, completions unified into the one
+//     event dispatcher.
+//
+//  2. TransportTier — the REGISTRY of endpoint types (ISSUE 12): fd/tcp,
+//     in-process ici, cross-process shm, device staging — each described
+//     once (name, descriptor capability, zero-copy, process scope) so
+//     descriptor eligibility, credit-flow accounting, and byte
+//     attribution live in ONE seam instead of per-transport special
+//     cases scattered through socket/policy code. This is the layering
+//     the reference's RDMA endpoint implies and the prerequisite for a
+//     DCN-class tier: a new transport is a new registry entry + endpoint
+//     implementation, not a fork of the data path.
 #pragma once
 
 #include <sys/types.h>
 
+#include <cstdint>
+#include <string>
+
 #include "tbase/iobuf.h"
 
 namespace tpurpc {
+
+class Socket;
+
+// ---- the transport tier registry ----
+
+// Static properties of one peer-endpoint type. Registered once; the id
+// is stable for the process lifetime and labels the per-tier
+// rpc_transport_* attribution families.
+struct TransportTier {
+    const char* name = "";
+    // One-sided pool descriptors may ride this transport: the peers'
+    // handshake maps each other's registered pools (or the peer IS this
+    // process), so a (pool_id, offset, len) reference resolves on the
+    // other side. Send-side eligibility AND resolve-side scope both
+    // consult this — the one seam deciding "may a payload cross as a
+    // reference here".
+    bool descriptor_capable = false;
+    // Payload blocks post by reference (ring descriptors), not by copy
+    // through a byte stream.
+    bool zero_copy = false;
+    // The peer lives in another process (its pool is mapped shm, not
+    // this process's own allocator).
+    bool cross_process = false;
+};
+
+// Register a tier; returns its id (stable, small). Re-registering an
+// existing name returns the existing id. Bounded (16) — a runaway
+// registration is a bug, not a workload.
+int RegisterTransportTier(const TransportTier& t);
+const TransportTier* GetTransportTier(int tier);  // null for bad ids
+int FindTransportTier(const char* name);          // -1 when unknown
+int TransportTierCount();
+
+// Built-in tiers, registered lazily on first use (stable within a
+// process; always present once any socket/pool code ran).
+int TierTcp();       // plain fd byte stream (TLS included)
+int TierIci();       // in-process queue-pair link (loopback ICI)
+int TierShmXproc();  // cross-process shared-memory queue pair
+int TierDevice();    // device staging ring (peer = the accelerator)
+
+// ---- descriptor eligibility / scope (the one seam) ----
+
+// The pool layer (tici/block_pool.cc Init) tells the transport tier how
+// to name THIS process's shared pool without tnet depending on tici.
+void SetLocalPoolIdProvider(uint64_t (*provider)());
+uint64_t TransportLocalPoolId();  // 0 when no shared pool exists
+
+// Send-side eligibility: may a pool descriptor (either direction) ride
+// this socket? True exactly when the socket's tier is
+// descriptor-capable — the peer either mapped our pool at handshake
+// (cross-process tiers map both ways) or IS this process (in-process
+// tiers resolve the local pool directly).
+bool TransportDescriptorCapable(const Socket* s);
+
+// Resolve-side scope: may a descriptor arriving ON this socket name
+// `pool_id`? Only the pool this connection's handshake mapped
+// (Socket::peer_pool_id) or — on an in-process transport — this
+// process's own pool. The global pool registry alone must never
+// authorize: any connection could otherwise name another tenant's
+// mapped pool and read memory it was never handed.
+bool TransportDescriptorScopeOk(const Socket* s, uint64_t pool_id);
+
+// ---- per-tier byte/credit attribution ----
+// Every transport's data-plane volume lands in one labelled family set
+// (rpc_transport_{in,out}_bytes / rpc_transport_desc_{in,out}_bytes /
+// rpc_transport_credit_stalls / rpc_transport_ops{transport=...}) so
+// /pools and /metrics show WHERE bytes move without per-transport
+// special cases. Hot paths add to pre-resolved cells — one relaxed
+// fetch_add per call.
+namespace transport_stats {
+void AddIn(int tier, int64_t bytes);    // bytes received/pumped
+void AddOut(int tier, int64_t bytes);   // bytes written/posted
+void AddDescIn(int tier, int64_t bytes);   // descriptor-referenced, in
+void AddDescOut(int tier, int64_t bytes);  // descriptor-referenced, out
+void AddCreditStall(int tier);  // writer parked waiting for window credits
+void AddOp(int tier);           // writes/pumps/ring completes
+
+// Test/portal reads.
+int64_t in_bytes(int tier);
+int64_t out_bytes(int tier);
+int64_t desc_in_bytes(int tier);
+int64_t desc_out_bytes(int tier);
+int64_t credit_stalls(int tier);
+int64_t ops(int tier);
+
+// One "tier <name> caps=... in=... out=... desc_in=... desc_out=...
+// stalls=... ops=..." line per registered tier (the /pools section).
+std::string DebugString();
+// Register the labelled rpc_transport_* families eagerly (idempotent)
+// so /metrics and the lint see them before the first byte moves.
+void ExposeVars();
+}  // namespace transport_stats
+
+// ---- the per-socket data-plane endpoint ----
 
 class TransportEndpoint {
 public:
@@ -55,6 +166,11 @@ public:
     // endpoint is released — the socket and the peer's socket can tear
     // down in any order without dangling pipes.
     virtual void Release() {}
+
+    // Which registry tier this endpoint belongs to. The TLS transport is
+    // still the fd byte-stream tier (encrypted TCP); queue-pair
+    // endpoints override with their own tier.
+    virtual int tier() const { return TierTcp(); }
 };
 
 }  // namespace tpurpc

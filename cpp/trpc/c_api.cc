@@ -8,6 +8,7 @@
 #include "tbase/iobuf.h"
 #include "tici/block_lease.h"
 #include "tici/block_pool.h"
+#include "tnet/transport.h"
 #include "trpc/pb_compat.h"
 #include "trpc/policy_tpu_std.h"
 
@@ -71,6 +72,40 @@ uint64_t tpurpc_lease_pinned() { return tpurpc::block_lease::pinned(); }
 uint64_t tpurpc_lease_reaped() {
     return tpurpc::block_lease::expired_reaped() +
            tpurpc::block_lease::peer_released();
+}
+
+int tpurpc_transport_tier_count() {
+    tpurpc::transport_stats::ExposeVars();  // built-ins registered
+    return tpurpc::TransportTierCount();
+}
+
+long tpurpc_transport_tier_name(int tier, char* out, size_t cap) {
+    const tpurpc::TransportTier* t = tpurpc::GetTransportTier(tier);
+    if (t == nullptr || out == nullptr || cap == 0) return -1;
+    const size_t n = strlen(t->name);
+    const size_t ncopy = n < cap - 1 ? n : cap - 1;
+    memcpy(out, t->name, ncopy);
+    out[ncopy] = '\0';
+    return (long)n;
+}
+
+int tpurpc_transport_tier_descriptor_capable(int tier) {
+    const tpurpc::TransportTier* t = tpurpc::GetTransportTier(tier);
+    return t != nullptr ? (t->descriptor_capable ? 1 : 0) : -1;
+}
+
+int tpurpc_transport_tier_zero_copy(int tier) {
+    const tpurpc::TransportTier* t = tpurpc::GetTransportTier(tier);
+    return t != nullptr ? (t->zero_copy ? 1 : 0) : -1;
+}
+
+int tpurpc_transport_tier_cross_process(int tier) {
+    const tpurpc::TransportTier* t = tpurpc::GetTransportTier(tier);
+    return t != nullptr ? (t->cross_process ? 1 : 0) : -1;
+}
+
+long tpurpc_transport_tier_ops(int tier) {
+    return (long)tpurpc::transport_stats::ops(tier);
 }
 
 void* tpurpc_ring_create(uint32_t depth, size_t slot_bytes) {

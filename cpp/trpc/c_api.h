@@ -70,6 +70,23 @@ uint64_t tpurpc_lease_pinned();
 uint64_t tpurpc_lease_reaped();
 uint64_t tpurpc_pool_epoch();
 
+// ---- transport tier registry (ISSUE 12) ----
+// Introspection of the first-class Transport seam (tnet/transport.h):
+// how many endpoint types are registered, their names, and their
+// capabilities — so the Python side can assert the uniform tier story
+// (tcp/ici/shm_xproc/device) without parsing a portal page.
+int tpurpc_transport_tier_count();
+// Copies the tier's name into out[0..cap) (NUL-terminated, truncated to
+// cap-1). Returns the name length, or -1 for a bad tier id.
+long tpurpc_transport_tier_name(int tier, char* out, size_t cap);
+// 1/0 capability bits; -1 for a bad tier id.
+int tpurpc_transport_tier_descriptor_capable(int tier);
+int tpurpc_transport_tier_zero_copy(int tier);
+int tpurpc_transport_tier_cross_process(int tier);
+// Per-tier attribution counters (ops for the device tier's staging-ring
+// completes; bytes for socket-attached tiers).
+long tpurpc_transport_tier_ops(int tier);
+
 // Frame `payload` as one tpu_std frame: "TRPC" header + RpcMeta
 // {correlation_id, body_checksum=crc32c(payload)} + payload as raw
 // attachment. Writes into out[0..out_cap). Returns the frame size in

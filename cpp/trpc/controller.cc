@@ -59,6 +59,12 @@ static LazyAdder g_pool_desc_fallbacks("rpc_pool_descriptor_fallbacks");
 // Leases released by EndRPC that were ALREADY reclaimed underneath the
 // call (expiry reaper / peer death): the stale-descriptor signature.
 static LazyAdder g_pool_lease_gone("rpc_pool_lease_already_reclaimed");
+// Tries whose pinned request attachment went INLINE because the try's
+// transport tier cannot carry a descriptor (plain TCP pick by the LB):
+// same payload on the wire, copied — eligibility decided at the
+// Transport seam instead of failing on the server (ISSUE 12).
+static LazyAdder g_pool_desc_wire_fallbacks(
+    "rpc_pool_descriptor_wire_fallbacks");
 
 void Controller::set_request_pool_attachment(IOBuf&& buf) {
     // A second call replaces the first attachment: release the prior
@@ -119,9 +125,74 @@ void Controller::ReleasePoolLease() {
     }
 }
 
+// Response-direction twin of set_request_pool_attachment (ISSUE 12):
+// the handler answers with a pool-block reference. Eligibility adds one
+// check the request side decides at IssueRPC time instead — the CALL's
+// connection must ride a descriptor-capable transport tier (the client
+// either mapped our pool at handshake or is this process); on an
+// ineligible shape or tier the bytes fall back to the inline response
+// attachment, so handlers never need to know the transport.
+void Controller::set_response_pool_attachment(IOBuf&& buf) {
+    // Replacing a prior response attachment releases its pin first.
+    if (rsp_pool_lease_id_ != 0) {
+        block_lease::Release(rsp_pool_lease_id_);
+        rsp_pool_lease_id_ = 0;
+        rsp_pool_stash_ = PoolAttachment();
+    }
+    uint64_t off = 0;
+    size_t flen = 0;
+    const char* data =
+        buf.backing_block_num() == 1 ? buf.backing_block_data(0, &flen)
+                                     : nullptr;
+    bool tier_ok = false;
+    if (server_socket_ != INVALID_VREF_ID) {
+        SocketUniquePtr s;
+        if (Socket::AddressSocket(server_socket_, &s) == 0) {
+            tier_ok = TransportDescriptorCapable(s.get());
+        }
+    }
+    if (tier_ok && data != nullptr && flen == buf.size() &&
+        IciBlockPool::OffsetOf(data, &off) &&
+        IciBlockPool::pool_id() != 0) {
+        rsp_pool_stash_.data = data;
+        rsp_pool_stash_.length = flen;
+        rsp_pool_stash_.pool_id = IciBlockPool::pool_id();
+        rsp_pool_stash_.offset = off;
+        rsp_pool_stash_.crc32c = crc32c_extend(0, data, flen);
+        rsp_pool_stash_.pool_epoch = IciBlockPool::pool_epoch();
+        rsp_pool_lease_id_ = block_lease::Pin(std::move(buf), "rsp");
+        return;
+    }
+    rsp_desc::CountFallback();
+    response_attachment_.append(std::move(buf));
+}
+
+void Controller::ReleaseResponsePoolState() {
+    // Server role: a pin whose ownership the response closure never
+    // took (failed call, handler ran on a non-tpu_std protocol whose
+    // response path ignores descriptors) must not outlive the
+    // controller. Exactly-once through the registry as always.
+    if (rsp_pool_lease_id_ != 0) {
+        block_lease::Release(rsp_pool_lease_id_);
+        rsp_pool_lease_id_ = 0;
+    }
+    rsp_pool_stash_ = PoolAttachment();
+    // Client role: releasing the view acks the server's pin. Best-
+    // effort — a dead connection drops the ack and the server's reaper
+    // reclaims instead.
+    if (rsp_ack_sid_ != INVALID_VREF_ID && rsp_ack_cid_ != 0) {
+        SendTpuStdDescAck(rsp_ack_sid_, rsp_ack_cid_,
+                          rsp_pool_view_.ack_token);
+    }
+    rsp_pool_view_ = PoolAttachment();
+    rsp_ack_sid_ = INVALID_VREF_ID;
+    rsp_ack_cid_ = 0;
+}
+
 Controller::~Controller() {
     RunCancelClosure();  // contract: an unfired closure still runs once
     ReleasePoolLease();  // a pin must not outlive its controller
+    ReleaseResponsePoolState();  // ack the peer's pin / drop our own
     delete excluded_;
     delete span_;  // non-null only if the RPC never reached EndRPC/submit
 }
@@ -138,6 +209,7 @@ void Controller::Reset() {
     response_attachment_.clear();
     ReleasePoolLease();  // reuse ends the previous RPC's pin
     pool_attachment_ = PoolAttachment();
+    ReleaseResponsePoolState();  // reuse acks/releases the rsp direction
     remote_side_ = EndPoint();
     local_side_ = EndPoint();
     latency_us_ = 0;
@@ -761,7 +833,16 @@ void Controller::IssueRPC() {
     if (request_compress_type_ != COMPRESS_NONE) {
         meta.set_compress_type(request_compress_type_);
     }
-    meta.set_attachment_size((uint32_t)request_attachment_.size());
+    // The wire attachment: the user's inline bytes, plus — when this
+    // try's transport tier cannot carry a one-sided reference — the
+    // pinned pool bytes appended inline. Eligibility is the Transport
+    // seam's verdict (ISSUE 12): an LB that picks a plain-TCP replica
+    // for one try of a descriptor-pinned call degrades that try to
+    // inline instead of failing it on the server. The common paths (no
+    // pinned attachment, or a capable tier) pay no IOBuf copy — the
+    // combined buffer is materialized only inside the fallback branch.
+    const IOBuf* wire_att = &request_attachment_;
+    IOBuf inline_fallback_att;
     // One-sided pool attachment (ISSUE 9): the frame carries ONLY the
     // header + meta (+ inline payload pb); the attachment crosses the
     // seam as a block reference the receiver maps in place. The pin is
@@ -795,23 +876,39 @@ void Controller::IssueRPC() {
             id_error(current_cid_, TERR_STALE_EPOCH);
             return;
         }
-        // Re-issues restamp the CURRENT pool generation: the pin (and
-        // its offset) is still valid — the lease holds it — so a retry
-        // after a TERR_STALE_EPOCH re-handshake carries the epoch the
-        // receiver's fresh mapping expects.
-        pool_attachment_.pool_epoch = IciBlockPool::pool_epoch();
-        auto* pd = meta.mutable_pool_attachment();
-        pd->set_pool_id(pool_attachment_.pool_id);
-        pd->set_offset(pool_attachment_.offset);
-        pd->set_length(pool_attachment_.length);
-        pd->set_crc32c(pool_attachment_.crc32c);
-        pd->set_pool_epoch(pool_attachment_.pool_epoch);
-        *g_pool_desc_sends << 1;
-        *g_pool_desc_bytes << (int64_t)pool_attachment_.length;
+        if (TransportDescriptorCapable(s.get())) {
+            // Re-issues restamp the CURRENT pool generation: the pin
+            // (and its offset) is still valid — the lease holds it — so
+            // a retry after a TERR_STALE_EPOCH re-handshake carries the
+            // epoch the receiver's fresh mapping expects.
+            pool_attachment_.pool_epoch = IciBlockPool::pool_epoch();
+            auto* pd = meta.mutable_pool_attachment();
+            pd->set_pool_id(pool_attachment_.pool_id);
+            pd->set_offset(pool_attachment_.offset);
+            pd->set_length(pool_attachment_.length);
+            pd->set_crc32c(pool_attachment_.crc32c);
+            pd->set_pool_epoch(pool_attachment_.pool_epoch);
+            *g_pool_desc_sends << 1;
+            *g_pool_desc_bytes << (int64_t)pool_attachment_.length;
+            transport_stats::AddDescOut(s->transport_tier(),
+                                        (int64_t)pool_attachment_.length);
+        } else {
+            // Descriptor-incapable tier for THIS try: the Arm above
+            // proved the pin (and therefore the stashed view) is still
+            // live, so the bytes go inline — the payload arrives either
+            // way, the zero-copy win is simply unavailable on this
+            // transport.
+            inline_fallback_att.append(request_attachment_);
+            inline_fallback_att.append(pool_attachment_.data,
+                                       pool_attachment_.length);
+            wire_att = &inline_fallback_att;
+            *g_pool_desc_wire_fallbacks << 1;
+        }
     }
+    meta.set_attachment_size((uint32_t)wire_att->size());
     if (FLAGS_rpc_checksum.get()) {
         uint32_t crc = crc32c_iobuf(0, request_buf_);
-        crc = crc32c_iobuf(crc, request_attachment_);
+        crc = crc32c_iobuf(crc, *wire_att);
         meta.set_body_checksum(crc);
     }
     if (request_stream_ != INVALID_VREF_ID) {
@@ -822,7 +919,7 @@ void Controller::IssueRPC() {
     IOBuf meta_buf;
     SerializePbToIOBuf(meta, &meta_buf);
     IOBuf frame;
-    PackTpuStdFrame(&frame, meta_buf, request_buf_, request_attachment_);
+    PackTpuStdFrame(&frame, meta_buf, request_buf_, *wire_att);
     if (span_ != nullptr) {
         span_->request_bytes = (int64_t)frame.size();
         span_->sent_us = monotonic_time_us();
@@ -1008,16 +1105,32 @@ void Controller::EndRPC(CallId locked_id) {
 
 void ProcessTpuStdResponse(TpuStdMessage* msg, const rpc::RpcMeta& meta) {
     const CallId cid = meta.correlation_id();
+    // A dropped response that carried a pool descriptor still acks: the
+    // server pinned a block for us, and nobody will ever resolve this
+    // copy of the reference — without the ack the pin would sit until
+    // the deadline-derived reaper. Covers the finished-RPC and
+    // abandoned-try drops below (a late response behind a timeout or a
+    // backup winner is exactly descriptor-heavy load's common case).
+    const auto ack_dropped_descriptor = [&] {
+        if (meta.response().has_pool_attachment()) {
+            SendTpuStdDescAck(msg->socket_id, cid,
+                              meta.response().pool_attachment()
+                                  .ack_token());
+        }
+    };
     void* data = nullptr;
     // Ranged lock: with a backup request out, TWO versions are in flight
     // and either response may win. Versions outside the live set (retried
     // tries, duplicates, finished RPCs) are dropped below / by the lock.
     if (id_lock_range(cid, &data) != 0) {
-        return;  // destroyed (finished) or stale beyond the range: drop
+        // destroyed (finished) or stale beyond the range: drop
+        ack_dropped_descriptor();
+        return;
     }
     Controller* cntl = (Controller*)data;
     if (cid != cntl->current_cid_ && cid != cntl->unfinished_cid_) {
         id_unlock(cid);  // an abandoned try's late response
+        ack_dropped_descriptor();
         return;
     }
     if (cntl->span_ != nullptr) {
@@ -1095,6 +1208,74 @@ void ProcessTpuStdResponse(TpuStdMessage* msg, const rpc::RpcMeta& meta) {
             return;
         }
         payload.swap(raw);
+    }
+    // Response-direction descriptor (ISSUE 12): the server answered with
+    // a reference into ITS registered pool — resolve it against the
+    // mapping this connection's handshake made of that pool, fence the
+    // epoch, verify the crc, and hand user code the in-place view with
+    // zero inline payload bytes. Scope is the Transport seam's verdict:
+    // only the handshake-mapped pool (or our own, on an in-process
+    // link) resolves. Every never-will-read path acks immediately so
+    // the server's pin frees without waiting for the reaper.
+    if (rmeta.has_pool_attachment()) {
+        const auto& pd = rmeta.pool_attachment();
+        SocketUniquePtr ds;
+        const bool have_sock =
+            Socket::AddressSocket(msg->socket_id, &ds) == 0;
+        const char* pool_base = nullptr;
+        size_t pool_size = 0;
+        uint64_t map_epoch = 0;
+        if (!have_sock ||
+            !TransportDescriptorScopeOk(ds.get(), pd.pool_id()) ||
+            !pool_registry::Resolve(pd.pool_id(), &pool_base, &pool_size,
+                                    &map_epoch) ||
+            pd.offset() > pool_size ||
+            pd.length() > pool_size - pd.offset()) {
+            rsp_desc::CountReject();
+            SendTpuStdDescAck(msg->socket_id, cid, pd.ack_token());
+            cntl->SetFailed(TERR_RESPONSE,
+                            "unresolvable response pool descriptor "
+                            "(server pool not mapped on this link, or "
+                            "out of bounds)");
+            cntl->EndRPC(cid);
+            return;
+        }
+        // Epoch fence BEFORE the crc read — the symmetric twin of the
+        // request direction: a stale generation may point at recycled
+        // bytes; fail ONLY this call with the retriable error (the
+        // re-handshake under the retry refreshes the mapping).
+        if (pd.has_pool_epoch() && pd.pool_epoch() != 0 &&
+            pd.pool_epoch() != map_epoch) {
+            rsp_desc::CountReject();
+            SendTpuStdDescAck(msg->socket_id, cid, pd.ack_token());
+            cntl->HandleError(cid, TERR_STALE_EPOCH);
+            return;
+        }
+        if (pd.has_crc32c() &&
+            crc32c_extend(0, pool_base + pd.offset(), pd.length()) !=
+                pd.crc32c()) {
+            rsp_desc::CountReject();
+            SendTpuStdDescAck(msg->socket_id, cid, pd.ack_token());
+            cntl->SetFailed(TERR_RESPONSE,
+                            "response pool descriptor crc32c mismatch");
+            cntl->EndRPC(cid);
+            return;
+        }
+        Controller::PoolAttachment view;
+        view.data = pool_base + pd.offset();
+        view.length = pd.length();
+        view.pool_id = pd.pool_id();
+        view.offset = pd.offset();
+        view.crc32c = pd.crc32c();
+        view.pool_epoch = pd.pool_epoch();
+        view.ack_token = pd.ack_token();
+        cntl->SetResponsePoolAttachmentView(view, msg->socket_id, cid);
+        rsp_desc::CountResolve((int64_t)pd.length());
+        // The logical bytes are this connection's data-plane
+        // throughput even though they never crossed the fd/ring.
+        ds->add_descriptor_bytes_read((int64_t)pd.length());
+        transport_stats::AddDescIn(ds->transport_tier(),
+                                   (int64_t)pd.length());
     }
     if (cntl->response_ != nullptr &&
         !ParsePbFromIOBuf(cntl->response_, payload)) {

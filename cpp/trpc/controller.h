@@ -110,6 +110,9 @@ public:
         uint32_t crc32c = 0;
         // Pool generation the descriptor was minted under (epoch fence).
         uint64_t pool_epoch = 0;
+        // Response direction only: the completion token the view's
+        // release echoes in its desc_ack (0 = token-less).
+        uint64_t ack_token = 0;
     };
     const PoolAttachment& request_pool_attachment() const {
         return pool_attachment_;
@@ -120,6 +123,69 @@ public:
     // Server-protocol internal: install the resolved view.
     void SetRequestPoolAttachmentView(const PoolAttachment& view) {
         pool_attachment_ = view;
+    }
+
+    // ---- response-direction pool attachment (ISSUE 12) ----
+    // Server handler side: answer with `buf` as a pool descriptor — the
+    // symmetric twin of set_request_pool_attachment. Eligible when buf
+    // is one contiguous block inside this process's shared pool AND the
+    // call's connection rides a descriptor-capable transport tier
+    // (tnet/transport.h — the client mapped our pool at handshake, or
+    // is this process); anything else falls back to inline
+    // response_attachment bytes transparently. The pin is a "rsp"
+    // lease: the response closure arms it (owner = the wire correlation
+    // id, expiry = the client's propagated deadline + grace, peer = the
+    // server-side socket) and hands ownership to the registry — the
+    // client's desc_ack releases it exactly once; the expiry reaper and
+    // peer-death reclamation (a SIGKILLed client's socket failure)
+    // are the crash-safe backstops.
+    void set_response_pool_attachment(IOBuf&& buf);
+    bool has_response_pool_attachment() const {
+        return rsp_pool_lease_id_ != 0;
+    }
+    uint64_t response_pool_lease_id() const { return rsp_pool_lease_id_; }
+    // Server-protocol internal: the stashed descriptor fields of the
+    // pinned response attachment (valid while the lease lives).
+    const PoolAttachment& response_pool_descriptor() const {
+        return rsp_pool_stash_;
+    }
+    // Server-protocol internal: move the pin's ownership out of the
+    // controller and into the wire/ack path (the response closure calls
+    // this once it emits the descriptor; the controller's teardown then
+    // no longer releases the pin — the ack/reaper/peer-death paths own
+    // it). Returns 0 when there is nothing to take.
+    uint64_t TakeResponsePoolLease() {
+        const uint64_t id = rsp_pool_lease_id_;
+        rsp_pool_lease_id_ = 0;
+        return id;
+    }
+    // Client side: the resolved zero-copy view of a response descriptor
+    // — bytes read IN PLACE from this process's mapping of the server's
+    // pool. Valid until Reset()/destruction/reuse: releasing the view
+    // sends the desc_ack that lets the server unpin the block, so user
+    // code may read it after the call completes (sync callers included).
+    // CAVEAT — the server's pin is deadline-bounded: its lease expires
+    // at this call's propagated deadline + the server's
+    // -pool_lease_grace_ms (or -pool_lease_default_ms for deadline-less
+    // calls), after which the reaper may recycle the block even though
+    // the view is still held. Consume the view promptly after the call
+    // completes; a reader that dawdles past its own RPC deadline + the
+    // grace window may observe recycled bytes (copy out early if you
+    // must hold data longer).
+    const PoolAttachment& response_pool_attachment() const {
+        return rsp_pool_view_;
+    }
+    bool has_response_pool_attachment_view() const {
+        return rsp_pool_view_.data != nullptr;
+    }
+    // Client-protocol internal: install the resolved view + the ack
+    // identity (the socket the response arrived on and its wire
+    // correlation id).
+    void SetResponsePoolAttachmentView(const PoolAttachment& view,
+                                       SocketId sid, uint64_t wire_cid) {
+        rsp_pool_view_ = view;
+        rsp_ack_sid_ = sid;
+        rsp_ack_cid_ = wire_cid;
     }
     // Payload compression (reference set_request_compress_type /
     // set_response_compress_type; see trpc/compress.h). Attachments stay
@@ -262,6 +328,13 @@ private:
     // Exactly-once release of the pinned pool-attachment lease (see
     // set_request_pool_attachment); safe on every termination path.
     void ReleasePoolLease();
+    // Response-direction teardown, both roles: a server-side pin whose
+    // ownership was never taken by the response closure (failed call,
+    // non-tpu_std protocol) releases through the registry; a client-side
+    // view sends the desc_ack that unpins the server's block. Runs on
+    // Reset/reuse/destruction — never on EndRPC, so a sync caller can
+    // still read the view after the call returns.
+    void ReleaseResponsePoolState();
     // Best-effort wire CANCEL for the in-flight tries (tpu_std CANCEL
     // meta / h2 RST_STREAM) so the server stops burning CPU on a call
     // nobody waits for. Runs with the id locked.
@@ -293,6 +366,15 @@ private:
     // backstops) and the resolved in-place view (server).
     uint64_t pool_lease_id_ = 0;
     PoolAttachment pool_attachment_;
+    // Response-direction descriptor state (ISSUE 12). Server role: the
+    // "rsp" lease of the handler's pinned answer + its stashed
+    // descriptor fields. Client role: the resolved in-place view and
+    // the (socket, wire cid) identity its release acks.
+    uint64_t rsp_pool_lease_id_ = 0;
+    PoolAttachment rsp_pool_stash_;
+    PoolAttachment rsp_pool_view_;
+    SocketId rsp_ack_sid_ = INVALID_VREF_ID;
+    uint64_t rsp_ack_cid_ = 0;
     EndPoint remote_side_;
     EndPoint local_side_;
     int64_t latency_us_;

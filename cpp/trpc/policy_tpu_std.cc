@@ -15,6 +15,7 @@
 #include "thttp/http2_client.h"
 #include "thttp/http2_protocol.h"
 #include "thttp/http_protocol.h"
+#include "tici/block_lease.h"
 #include "tici/block_pool.h"
 #include "tici/shm_link.h"
 #include "tnet/fault_injection.h"
@@ -61,6 +62,31 @@ static LazyAdder g_pool_desc_rejects("rpc_pool_descriptor_rejects");
 // generation this mapping no longer matches — answered with the
 // retriable TERR_STALE_EPOCH, never a connection failure.
 static LazyAdder g_pool_epoch_rejects("rpc_pool_epoch_rejects");
+// Response-direction descriptor families (ISSUE 12): handlers answering
+// with pool-block references — the symmetric twin of the request-side
+// rpc_pool_descriptor_* counters.
+static LazyAdder g_rsp_desc_sends("rpc_pool_desc_rsp_sends");
+static LazyAdder g_rsp_desc_send_bytes("rpc_pool_desc_rsp_send_bytes");
+static LazyAdder g_rsp_desc_fallbacks("rpc_pool_desc_rsp_fallbacks");
+static LazyAdder g_rsp_desc_resolves("rpc_pool_desc_rsp_resolves");
+static LazyAdder g_rsp_desc_resolve_bytes(
+    "rpc_pool_desc_rsp_resolve_bytes");
+static LazyAdder g_rsp_desc_rejects("rpc_pool_desc_rsp_rejects");
+static LazyAdder g_rsp_desc_acks("rpc_pool_desc_rsp_acks");
+
+namespace rsp_desc {
+void CountSend(int64_t bytes) {
+    *g_rsp_desc_sends << 1;
+    *g_rsp_desc_send_bytes << bytes;
+}
+void CountFallback() { *g_rsp_desc_fallbacks << 1; }
+void CountResolve(int64_t bytes) {
+    *g_rsp_desc_resolves << 1;
+    *g_rsp_desc_resolve_bytes << bytes;
+}
+void CountReject() { *g_rsp_desc_rejects << 1; }
+void CountAck() { *g_rsp_desc_acks << 1; }
+}  // namespace rsp_desc
 
 int TpuStdProtocolIndex() { return g_tpu_std_index; }
 
@@ -129,6 +155,21 @@ void SendTpuStdCancel(SocketId sid, uint64_t cid) {
     rpc::RpcMeta meta;
     meta.set_correlation_id(cid);
     meta.set_cancel(true);
+    IOBuf meta_buf;
+    SerializePbToIOBuf(meta, &meta_buf);
+    IOBuf frame;
+    PackTpuStdFrame(&frame, meta_buf, IOBuf(), IOBuf());
+    SocketUniquePtr s;
+    if (Socket::AddressSocket(sid, &s) == 0) {
+        s->Write(&frame);
+    }
+}
+
+void SendTpuStdDescAck(SocketId sid, uint64_t cid, uint64_t ack_token) {
+    rpc::RpcMeta meta;
+    meta.set_correlation_id(cid);
+    meta.set_desc_ack(true);
+    if (ack_token != 0) meta.set_desc_ack_token(ack_token);
     IOBuf meta_buf;
     SerializePbToIOBuf(meta, &meta_buf);
     IOBuf frame;
@@ -231,6 +272,60 @@ public:
                 }  // else: send uncompressed (compress_type stays unset)
             }
         }
+        // Response-direction descriptor (ISSUE 12): the handler pinned a
+        // pool block — arm its "rsp" lease with this call's identity
+        // (owner = wire cid, expiry = the client's propagated deadline +
+        // grace, peer = this connection) and emit the REFERENCE instead
+        // of bytes. Ownership moves to the registry the moment the
+        // descriptor goes on the wire: the client's desc_ack releases it
+        // exactly once; a SIGKILLed client frees it through the socket
+        // failure observer (server_call::OnSocketFailed -> ReleasePeer),
+        // and the reaper covers a client that never acks.
+        SocketUniquePtr s;
+        const bool have_sock = Socket::AddressSocket(sid_, &s) == 0;
+        if (cntl_->has_response_pool_attachment()) {
+            const uint64_t rsp_lease = cntl_->TakeResponsePoolLease();
+            const Controller::PoolAttachment& st =
+                cntl_->response_pool_descriptor();
+            const int64_t deadline = cntl_->has_server_deadline()
+                                         ? cntl_->server_deadline_us()
+                                         : 0;
+            if (!cntl_->Failed() && have_sock &&
+                block_lease::Arm(rsp_lease, cid_, deadline,
+                                 (uint64_t)sid_)) {
+                auto* pd = rmeta->mutable_pool_attachment();
+                pd->set_pool_id(st.pool_id);
+                pd->set_offset(st.offset);
+                pd->set_length(st.length);
+                pd->set_crc32c(st.crc32c);
+                // Stamped at SEND time: a remap between the handler's
+                // pin and this response carries the generation the
+                // client's (re-)handshaken mapping expects.
+                pd->set_pool_epoch(IciBlockPool::pool_epoch());
+                // Completion token = the lease id: the ack releases by
+                // direct lookup (call + connection still validated).
+                pd->set_ack_token(rsp_lease);
+                rsp_desc::CountSend((int64_t)st.length);
+                transport_stats::AddDescOut(s->transport_tier(),
+                                            (int64_t)st.length);
+            } else {
+                // Failed call, dead connection, or a pin the reaper
+                // reclaimed under a wedged call: no reference may go
+                // out. Drop the pin (exactly-once; a reaped lease is a
+                // counted no-op) and — when the call would otherwise
+                // report success — fail it with the retriable
+                // stale-reference error instead of silently answering
+                // without the attachment (data loss).
+                block_lease::Release(rsp_lease);
+                if (!cntl_->Failed()) {
+                    rmeta->set_error_code(TERR_STALE_EPOCH);
+                    rmeta->set_error_text(
+                        "response pool pin reclaimed before send: "
+                        "remap and retry");
+                    payload.clear();
+                }
+            }
+        }
         const IOBuf& att = cntl_->response_attachment();
         meta.set_attachment_size((uint32_t)att.size());
         if (FLAGS_rpc_checksum.get()) {
@@ -242,8 +337,7 @@ public:
         SerializePbToIOBuf(meta, &meta_buf);
         IOBuf frame;
         PackTpuStdFrame(&frame, meta_buf, payload, att);
-        SocketUniquePtr s;
-        if (Socket::AddressSocket(sid_, &s) == 0) {
+        if (have_sock) {
             s->Write(&frame);
         }
         if (cntl_->span_ != nullptr) {
@@ -622,17 +716,16 @@ void ProcessTpuStdRequest(TpuStdMessage* msg, const rpc::RpcMeta& meta) {
     Controller::PoolAttachment pool_view;
     if (meta.has_pool_attachment()) {
         const auto& pd = meta.pool_attachment();
-        // Scope check BEFORE the registry: a connection may only
-        // reference the pool its OWN handshake mapped (or, on an
-        // in-process transport link, this process's pool). The global
-        // registry alone must never authorize — any connection could
-        // otherwise name another tenant's mapped pool, or a plain-TCP
-        // peer this server's own, and read memory it was never handed.
+        // Scope check BEFORE the registry — now the Transport seam's
+        // verdict (ISSUE 12): a connection may only reference the pool
+        // its OWN handshake mapped (or, on an in-process transport
+        // link, this process's pool), and only on a descriptor-capable
+        // tier. The global registry alone must never authorize — any
+        // connection could otherwise name another tenant's mapped pool,
+        // or a plain-TCP peer this server's own, and read memory it was
+        // never handed.
         const bool in_scope =
-            pd.pool_id() != 0 &&
-            (pd.pool_id() == s->peer_pool_id() ||
-             (s->transport() != nullptr &&
-              pd.pool_id() == IciBlockPool::pool_id()));
+            TransportDescriptorScopeOk(s.get(), pd.pool_id());
         const char* pool_base = nullptr;
         size_t pool_size = 0;
         uint64_t map_epoch = 0;
@@ -706,6 +799,8 @@ void ProcessTpuStdRequest(TpuStdMessage* msg, const rpc::RpcMeta& meta) {
             inline_dispatch::ExemptDescriptorBytes(pd.length());
         }
         s->add_descriptor_bytes_read((int64_t)pd.length());
+        transport_stats::AddDescIn(s->transport_tier(),
+                                   (int64_t)pd.length());
     }
 
     const int64_t start_us = monotonic_time_us();
@@ -891,6 +986,26 @@ void ProcessTpuStdMessage(InputMessageBase* raw) {
         server_call::Cancel(msg->socket_id, meta.correlation_id());
         return;
     }
+    if (meta.desc_ack()) {
+        // Response-descriptor completion (ISSUE 12): the client finished
+        // reading the descriptor we answered correlation_id with — drop
+        // the pin. Scoped to the delivering connection (correlation ids
+        // are only unique per client process) and exactly-once through
+        // the lease registry: a duplicate or post-reap ack finds nothing
+        // and is a no-op. Token-carrying acks release by direct lookup
+        // (still call+connection validated); token-less acks pay the
+        // ledger scan.
+        if (meta.has_desc_ack_token() && meta.desc_ack_token() != 0) {
+            block_lease::ReleaseAcked(meta.desc_ack_token(),
+                                      meta.correlation_id(),
+                                      (uint64_t)msg->socket_id);
+        } else {
+            block_lease::ReleaseByCall(meta.correlation_id(),
+                                       (uint64_t)msg->socket_id);
+        }
+        rsp_desc::CountAck();
+        return;
+    }
     if (meta.has_request()) {
         ProcessTpuStdRequest(msg.get(), meta);
     } else {
@@ -916,9 +1031,18 @@ void GlobalInitializeOrDie() {
         // it (the observer hops to a fresh fiber before running any
         // cancellation, so SetFailed's callers never execute user code).
         Socket::set_failure_observer(&server_call::OnSocketFailed);
-        // Epoch-fence family visible from the first scrape (lint
-        // contract: a 0-valued counter is data; a missing one is not).
+        // Epoch-fence + response-direction descriptor + transport-tier
+        // families visible from the first scrape (lint contract: a
+        // 0-valued counter is data; a missing one is not).
         *g_pool_epoch_rejects << 0;
+        *g_rsp_desc_sends << 0;
+        *g_rsp_desc_send_bytes << 0;
+        *g_rsp_desc_fallbacks << 0;
+        *g_rsp_desc_resolves << 0;
+        *g_rsp_desc_resolve_bytes << 0;
+        *g_rsp_desc_rejects << 0;
+        *g_rsp_desc_acks << 0;
+        transport_stats::ExposeVars();
         Protocol p;
         p.parse = ParseTpuStdMessage;
         p.process = ProcessTpuStdMessage;

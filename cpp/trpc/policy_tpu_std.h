@@ -39,6 +39,28 @@ int TpuStdProtocolIndex();
 // (a meta-only frame with `cancel` set; the receiver drops unknown ids).
 void SendTpuStdCancel(SocketId sid, uint64_t cid);
 
+// Response-descriptor completion ack (ISSUE 12): tells the server the
+// client finished reading the response descriptor of `cid` — the
+// server's pinned block releases through the lease registry
+// (exactly-once; a late/duplicate ack is a no-op). `ack_token` is the
+// descriptor's PoolDescriptor.ack_token (0 = none: the server falls
+// back to a ledger scan). Best-effort: a dead socket drops the ack and
+// the lease reaper / peer-death reclamation free the pin instead.
+void SendTpuStdDescAck(SocketId sid, uint64_t cid,
+                       uint64_t ack_token = 0);
+
+// Response-direction descriptor counters (the rpc_pool_desc_rsp_*
+// families; defined in policy_tpu_std.cc, shared with controller.cc —
+// the send/fallback sites live on the server response path, the
+// resolve/reject sites on the client response path).
+namespace rsp_desc {
+void CountSend(int64_t bytes);
+void CountFallback();
+void CountResolve(int64_t bytes);
+void CountReject();
+void CountAck();
+}  // namespace rsp_desc
+
 // Drain announcement (the tpu_std GOAWAY): a meta-only frame with
 // `goaway` set, queued on `s`. The receiving client marks the socket
 // draining — in-flight calls complete, new calls steer away. Sent by

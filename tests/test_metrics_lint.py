@@ -167,6 +167,35 @@ def test_metrics_exposition_lint(cpp_build, tmp_path):
         assert families.get("rpc_pool_epoch_rejects") == "gauge"
         assert re.search(r"^rpc_pool_pinned_blocks \d+$", text, re.M), \
             text[:500]
+        # ISSUE 12 response-direction descriptor families: present
+        # (0-valued) from the first scrape, same lint as everything else.
+        for fam in ("rpc_pool_desc_rsp_sends",
+                    "rpc_pool_desc_rsp_send_bytes",
+                    "rpc_pool_desc_rsp_fallbacks",
+                    "rpc_pool_desc_rsp_resolves",
+                    "rpc_pool_desc_rsp_resolve_bytes",
+                    "rpc_pool_desc_rsp_rejects",
+                    "rpc_pool_desc_rsp_acks"):
+            assert families.get(fam) == "gauge", (fam, sorted(families))
+        # ISSUE 12 transport-tier attribution: labelled families with one
+        # series per registered endpoint type (tcp/ici/shm_xproc/device).
+        for fam in ("rpc_transport_in_bytes", "rpc_transport_out_bytes",
+                    "rpc_transport_desc_in_bytes",
+                    "rpc_transport_desc_out_bytes",
+                    "rpc_transport_credit_stalls", "rpc_transport_ops"):
+            assert families.get(fam) == "gauge", (fam, sorted(families))
+        for tier in ("tcp", "ici", "shm_xproc", "device"):
+            assert re.search(
+                r'^rpc_transport_out_bytes\{transport="%s"\} \d+$' % tier,
+                text, re.M), tier
+        # /pools json carries the lease direction column + tier table.
+        pools = json.loads(_http_get(port, "/pools?format=json"))
+        assert isinstance(pools.get("leases"), list), pools
+        tiers = {t["name"]: t for t in pools.get("transports", [])}
+        assert set(tiers) >= {"tcp", "ici", "shm_xproc", "device"}, tiers
+        assert tiers["tcp"]["descriptor_capable"] == 0
+        assert tiers["ici"]["descriptor_capable"] == 1
+        assert tiers["shm_xproc"]["cross_process"] == 1
 
         # /vars?series= returns the fixed 60/60/24-point ring shape.
         # Poll: on a loaded host the 1Hz sampler may lag a little before

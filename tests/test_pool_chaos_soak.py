@@ -1,12 +1,18 @@
-"""Zero-copy pool chaos soak (ISSUE 10): SIGKILL + stale epochs + leaks.
+"""Zero-copy pool chaos soak (ISSUE 10/12): SIGKILL + stale epochs +
+leaks, now SYMMETRIC.
 
 Four `mesh_node` processes run with --desc_traffic: every node
 continuously pins pool blocks under leases and posts them as one-sided
-(pool_id, offset, len, crc, epoch) descriptors over the shm-ICI links.
-Mid-run the soak
+(pool_id, offset, len, crc, epoch) descriptors over the shm-ICI links —
+and (ISSUE 12) every call also ASKS for a response-direction descriptor,
+so each node holds server-side "rsp" pins that only its CLIENTS' acks
+release. Mid-run the soak
 
   * SIGKILLs one node while it holds / is entitled to read in-flight
-    pinned descriptors (the peer-death reclamation path),
+    pinned descriptors in BOTH roles — as a client mid-response-
+    descriptor (its unsent acks must not strand the survivors' rsp
+    pins: the socket failure observer releases them) and as a server
+    holding pins for the survivors' requests,
   * injects stale-epoch faults at one survivor's resolve seam
     (chaos_pool `pool_stale`, via its /chaos portal),
   * injects leaked-pin faults at one survivor's release seam
@@ -64,26 +70,48 @@ def test_pool_chaos_soak(cpp_build, tmp_path):
         for n in nodes:
             assert n.wait_ready(), "node %d never became ready" % n.idx
 
-        # Descriptor traffic is really flowing (lease pins being taken).
+        # Descriptor traffic is really flowing (lease pins being taken)
+        # in BOTH directions: request sends AND response-direction
+        # sends/resolves (ISSUE 12).
         deadline = time.time() + 20.0
         while time.time() < deadline:
             sends = sum(
                 _var(p, "rpc_pool_descriptor_sends") for p in ports)
-            if sends >= 20:
+            rsp_sends = sum(
+                _var(p, "rpc_pool_desc_rsp_sends") for p in ports)
+            if sends >= 20 and rsp_sends >= 10:
                 break
             time.sleep(0.5)
         assert sends >= 20, "descriptor traffic never started"
+        assert rsp_sends >= 10, \
+            "response-direction descriptors never flowed"
+        assert sum(
+            _var(p, "rpc_pool_desc_rsp_resolves") for p in ports) >= 10
         assert sum(_pools(p)["pins_total"] for p in ports) >= 20
+        # The /pools ledger shows rsp-direction leases with their
+        # direction column while acks are in flight.
+        directions = set()
+        for p in ports:
+            for lease in _pools(p).get("leases", []):
+                directions.add(lease.get("direction"))
+        assert directions <= {"req", "rsp"}, directions
 
         # --- kill a node holding in-flight pinned descriptors ---------
+        # The victim is BOTH a client mid-response-descriptor (its
+        # controllers' desc_acks die with it — the survivors' server-
+        # side "rsp" pins must release through the socket failure
+        # observer, rpc_pool_pinned_blocks draining to ~0) and a server
+        # holding pins of its own.
         kill_idx = 3
         nodes[kill_idx].kill9()
         survivors = [i for i in range(NUM_NODES) if i != kill_idx]
 
         # Peer death must not strand pins on the survivors: their leases
-        # to the dead node resolve via EndRPC (failed call) or the
-        # socket-failure ReleasePeer path; steady state returns to a
-        # small in-flight transient, never a growing leak.
+        # to the dead node resolve via EndRPC (failed call), the
+        # socket-failure ReleasePeer path (both req pins posted TOWARD
+        # the dead node and rsp pins awaiting ITS acks), or the reaper;
+        # steady state returns to a small in-flight transient, never a
+        # growing leak.
         deadline = time.time() + 20.0
         ok = False
         while time.time() < deadline:
@@ -138,28 +166,41 @@ def test_pool_chaos_soak(cpp_build, tmp_path):
         stale_total = 0
         for rep in reports:
             # Zero lost completions on the descriptor plane (and all
-            # others), and the lease ledger is EMPTY after quiesce —
-            # the headline crash-safety invariant.
+            # others) — the headline crash-safety invariant.
             assert rep["outstanding"] == 0, rep
             assert rep["desc_issued"] == (
                 rep["desc_ok"] + rep["desc_failed"]), rep
             assert rep["lb_issued"] == rep["lb_ok"] + rep["lb_failed"], rep
             assert rep["shm_issued"] == rep["shm_ok"] + rep["shm_failed"], rep
-            assert rep["pool_pinned"] == 0, rep
             stale_total += rep["desc_stale"]
         # Descriptor traffic did useful work on every node (incl. the
-        # restarted one), and the stale injection surfaced client-side
-        # as retriable call failures, not crashes.
+        # restarted one) in BOTH directions, and the stale injection
+        # surfaced client-side as retriable call failures, not crashes.
         for rep in reports:
             assert rep["desc_ok"] > 0, rep
+            assert rep["desc_rsp_ok"] > 0, rep
+            assert rep["desc_rsp_sends"] > 0, rep
+            assert rep["desc_rsp_resolves"] > 0, rep
         assert stale_total >= 1, reports
         assert reports[0]["epoch_rejects"] >= 3, reports[0]
         # The deliberately-leaked pins were reaped, not stranded.
         assert reports[1]["pool_reaped"] >= 1, reports[1]
 
-        # Ledger empty via the portal too (pre-shutdown, post-quiesce).
-        for i in range(NUM_NODES):
-            assert _pools(ports[i])["pinned"] == 0
+        # Lease ledger EMPTY everywhere after quiesce. Response-
+        # direction pins drain asynchronously (a node's "rsp" pins
+        # release on OTHER nodes' acks, which are still arriving while
+        # the reports print in sequence): poll the portal, don't assert
+        # the instantaneous REPORT value.
+        deadline = time.time() + 20.0
+        pinned = None
+        while time.time() < deadline:
+            pinned = [_pools(ports[i])["pinned"]
+                      for i in range(NUM_NODES)]
+            if all(p == 0 for p in pinned):
+                break
+            time.sleep(0.5)
+        assert all(p == 0 for p in pinned), \
+            "pins stranded after quiesce: %s" % pinned
 
         for n in nodes:
             assert n.shutdown() == 0, "node %d unclean exit" % n.idx

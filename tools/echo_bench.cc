@@ -21,6 +21,7 @@
 #include "bench_echo.pb.h"
 #include "tbase/cpu_profiler.h"
 #include "tbase/crc32c.h"
+#include "tbase/errno.h"
 #include "tbase/fast_rand.h"
 #include "tbase/flags.h"
 #include "tbase/time.h"
@@ -81,6 +82,30 @@ public:
                      (unsigned long long)pa.length,
                      cntl->request_attachment().size());
             response->set_payload(verdict);
+        }
+        // Response-direction descriptor (ISSUE 12): a "desc_rsp:N:S"
+        // request asks for N bytes answered as a pool-block REFERENCE —
+        // the handler fills a slab slot in its OWN pool (pattern seeded
+        // by S: byte 0 = S, the rest 'a'+S%26) and pins it; the client
+        // resolves it against its handshake-made mapping of this pool
+        // with zero inline payload bytes.
+        unsigned long long rsp_n = 0;
+        unsigned rsp_seed = 0;
+        if (sscanf(request->payload().c_str(), "desc_rsp:%llu:%u", &rsp_n,
+                   &rsp_seed) == 2 &&
+            rsp_n > 0) {
+            IOBuf out;
+            char* data = nullptr;
+            if (IciBlockPool::AllocatePoolAttachment((size_t)rsp_n, &out,
+                                                     &data)) {
+                memset(data, 'a' + (int)(rsp_seed % 26), (size_t)rsp_n);
+                data[0] = (char)rsp_seed;
+                cntl->set_response_pool_attachment(std::move(out));
+                response->set_payload("desc_rsp_ok");
+            } else {
+                cntl->SetFailed(TERR_RESPONSE,
+                                "pool attachment alloc failed");
+            }
         }
         cntl->response_attachment().append(cntl->request_attachment());
         done->Run();
@@ -203,6 +228,59 @@ double run_pool_desc_round(benchpb::EchoService_Stub& stub,
     t.stop();
     const double secs = (double)t.n_elapsed() / 1e9;
     return (double)attachment_bytes * iters / (1024.0 * 1024.0) / secs;
+}
+
+// Response-direction descriptor round (ISSUE 12): a tiny request asks
+// the server to answer `rsp_bytes` as a pool-block reference; the
+// client's resolve path crc-verifies the in-place view against the
+// descriptor (the wire contract), and this round additionally
+// spot-checks the server's seeded pattern and that ZERO payload bytes
+// arrived inline. Returns logical MB/s, or -1 on verification failure.
+// Each iteration's controller teardown sends the desc_ack that unpins
+// the server's block — the pinned_after gauge proves the cycle.
+double run_pool_desc_rsp_round(benchpb::EchoService_Stub& stub,
+                               size_t rsp_bytes, int iters,
+                               int* zero_copy_ok) {
+    *zero_copy_ok = 1;
+    Timer t;
+    t.start();
+    for (int i = 0; i < iters; ++i) {
+        Controller cntl;
+        cntl.set_timeout_ms(10000);
+        benchpb::EchoRequest req;
+        benchpb::EchoResponse res;
+        char ask[64];
+        snprintf(ask, sizeof(ask), "desc_rsp:%zu:%u", rsp_bytes,
+                 (unsigned)i);
+        req.set_payload(ask);
+        req.set_send_ts_us(monotonic_time_us());
+        stub.Echo(&cntl, &req, &res, nullptr);
+        if (cntl.Failed()) {
+            fprintf(stderr, "pool-desc rsp rpc failed: %s\n",
+                    cntl.ErrorText().c_str());
+            return -1;
+        }
+        const Controller::PoolAttachment& view =
+            cntl.response_pool_attachment();
+        if (view.data == nullptr || view.length != rsp_bytes ||
+            cntl.response_attachment().size() != 0 ||
+            view.data[0] != (char)i ||
+            view.data[1] != (char)('a' + i % 26)) {
+            fprintf(stderr,
+                    "pool-desc rsp verdict mismatch: view=%p len=%llu "
+                    "inline=%zu\n",
+                    (const void*)view.data,
+                    (unsigned long long)view.length,
+                    cntl.response_attachment().size());
+            *zero_copy_ok = 0;
+            return -1;
+        }
+        // Controller goes out of scope here: the view release acks the
+        // server's pin.
+    }
+    t.stop();
+    const double secs = (double)t.n_elapsed() / 1e9;
+    return (double)rsp_bytes * iters / (1024.0 * 1024.0) / secs;
 }
 
 // qps-vs-caller-fibers scaling sweep (reference docs/cn/benchmark.md:110
@@ -359,8 +437,27 @@ int main(int argc, char** argv) {
         if (strcmp(argv[i], "--tail") == 0) tail = true;
         if (strcmp(argv[i], "--scale") == 0) scale = true;
         if (strcmp(argv[i], "--pooled") == 0) pooled = true;
-        if (strcmp(argv[i], "--pool-desc") == 0) pool_desc = true;
+        // Canonical spelling: --pool_desc (matches rpc_press and every
+        // other underscore flag); the historical --pool-desc is still
+        // accepted.
+        if (strcmp(argv[i], "--pool_desc") == 0 ||
+            strcmp(argv[i], "--pool-desc") == 0) {
+            pool_desc = true;
+        }
         if (strcmp(argv[i], "--ici-server") == 0) ici_server = true;
+        if (strcmp(argv[i], "--help") == 0 || strcmp(argv[i], "-h") == 0) {
+            printf(
+                "usage: echo_bench [--json] [--ici | --xproc] [--tail] "
+                "[--scale] [--pooled]\n"
+                "                  [--pool_desc] [--prof FILE] "
+                "[--tls-cert F --tls-key F]\n"
+                "  --pool_desc   one-sided descriptor rounds, BOTH "
+                "directions (requires\n"
+                "                --ici or --xproc). Canonical spelling; "
+                "--pool-desc is an\n"
+                "                accepted alias.\n");
+            return 0;
+        }
         if (strcmp(argv[i], "--tls-cert") == 0 && i + 1 < argc) {
             g_tls_cert = argv[++i];
         }
@@ -451,11 +548,13 @@ int main(int argc, char** argv) {
     }
 
     if (pool_desc) {
-        // One-sided descriptor round: requires a pool-mapped link (--ici
-        // in-process loopback or --xproc shm link); plain TCP peers
-        // cannot resolve our pool and would fail the calls.
+        // One-sided descriptor rounds, BOTH directions (ISSUE 12):
+        // requires a pool-mapped link (--ici in-process loopback or
+        // --xproc shm link) — the Transport seam degrades plain-TCP
+        // tries to inline instead, which is exactly what this round must
+        // NOT measure.
         if (!use_ici && !xproc) {
-            fprintf(stderr, "--pool-desc requires --ici or --xproc\n");
+            fprintf(stderr, "--pool_desc requires --ici or --xproc\n");
             return 1;
         }
         // 1MB-class slot minus the block header: the largest payload a
@@ -467,25 +566,45 @@ int main(int argc, char** argv) {
         const double mbps =
             run_pool_desc_round(stub, kDescBytes, kIters, &zero_copy_ok);
         if (mbps < 0) return 1;
-        // Leak gauge (ISSUE 10 satellite): after the round every pinned
+        // Response direction: the server answers with references into
+        // ITS pool; the client resolves them against the
+        // handshake-mapped peer pool with zero inline payload bytes.
+        int rsp_zero_copy_ok = 0;
+        run_pool_desc_rsp_round(stub, kDescBytes, 20,
+                                &rsp_zero_copy_ok);  // warm
+        const double rsp_mbps = run_pool_desc_rsp_round(
+            stub, kDescBytes, kIters, &rsp_zero_copy_ok);
+        if (rsp_mbps < 0) return 1;
+        // Leak gauge (ISSUE 10 satellite): after the rounds every pinned
         // block must be back in the pool — a nonzero pinned_after in a
-        // BENCH record is the descriptor path leaking under load.
-        const long long pinned_after = (long long)block_lease::pinned();
+        // BENCH record is the descriptor path leaking under load. The
+        // LAST response ack may still be in flight (it rides the wire
+        // after the RPC completes): give it a bounded moment.
+        long long pinned_after = (long long)block_lease::pinned();
+        for (int w = 0; w < 100 && pinned_after != 0; ++w) {
+            usleep(20 * 1000);
+            pinned_after = (long long)block_lease::pinned();
+        }
         const long long reaped = (long long)(
             block_lease::expired_reaped() + block_lease::peer_released());
         if (json) {
             printf("{\"pool_desc_mbps\": %.1f, \"pool_desc_calls\": %d, "
                    "\"pool_desc_bytes\": %zu, \"pool_desc_zero_copy\": "
-                   "%d, \"pool_desc_pinned_after\": %lld, "
+                   "%d, \"pool_desc_rsp_mbps\": %.1f, "
+                   "\"pool_desc_rsp_calls\": %d, "
+                   "\"pool_desc_rsp_zero_copy\": %d, "
+                   "\"pool_desc_rsp_inline_bytes\": 0, "
+                   "\"pool_desc_pinned_after\": %lld, "
                    "\"pool_desc_reaped\": %lld}\n",
-                   mbps, kIters, kDescBytes, zero_copy_ok, pinned_after,
-                   reaped);
+                   mbps, kIters, kDescBytes, zero_copy_ok, rsp_mbps,
+                   kIters, rsp_zero_copy_ok, pinned_after, reaped);
         } else {
-            printf("pool-descriptor echo: %.1f MB/s logical (%d calls x "
-                   "%zu bytes, zero-copy %s, pinned-after %lld, "
-                   "reaped %lld)\n",
-                   mbps, kIters, kDescBytes,
-                   zero_copy_ok ? "verified" : "FAILED", pinned_after,
+            printf("pool-descriptor echo: req %.1f MB/s, rsp %.1f MB/s "
+                   "logical (%d calls x %zu bytes each way, zero-copy "
+                   "req %s rsp %s, pinned-after %lld, reaped %lld)\n",
+                   mbps, rsp_mbps, kIters, kDescBytes,
+                   zero_copy_ok ? "verified" : "FAILED",
+                   rsp_zero_copy_ok ? "verified" : "FAILED", pinned_after,
                    reaped);
         }
         if (xproc_pid > 0) {
@@ -493,7 +612,7 @@ int main(int argc, char** argv) {
             int status = 0;
             waitpid(xproc_pid, &status, 0);
         }
-        return zero_copy_ok ? 0 : 1;
+        return zero_copy_ok && rsp_zero_copy_ok ? 0 : 1;
     }
 
     if (tail) {

@@ -128,6 +128,23 @@ public:
                 }
             }
         }
+        // Response-direction descriptor (ISSUE 12): a "desc_rsp:N"
+        // payload asks for N bytes answered as a reference into THIS
+        // node's pool — the server-side pin the pool chaos soak
+        // SIGKILLs clients under (peer death must release it through
+        // the socket failure observer, never strand it).
+        unsigned long long rsp_n = 0;
+        if (sscanf(request->payload().c_str(), "desc_rsp:%llu", &rsp_n) ==
+                1 &&
+            rsp_n > 0 && rsp_n <= (4u << 20)) {
+            IOBuf out;
+            char* data = nullptr;
+            if (IciBlockPool::AllocatePoolAttachment((size_t)rsp_n, &out,
+                                                     &data)) {
+                memset(data, 'r', (size_t)rsp_n);
+                cntl->set_response_pool_attachment(std::move(out));
+            }
+        }
         response->set_send_ts_us(request->send_ts_us());
         cntl->response_attachment().append(cntl->request_attachment());
         done->Run();
@@ -143,6 +160,10 @@ struct Counters {
     // (EXPECTED retriable failures under chaos_pool stale injection).
     std::atomic<int64_t> desc_issued{0}, desc_ok{0}, desc_failed{0};
     std::atomic<int64_t> desc_stale{0};
+    // Response-direction descriptors resolved by this node's CLIENT
+    // side (ISSUE 12): desc_rsp_ok counts calls whose answer arrived as
+    // a verified in-place view of the peer's pool.
+    std::atomic<int64_t> desc_rsp_issued{0}, desc_rsp_ok{0};
     std::atomic<int64_t> expired_probes{0};
     std::atomic<int64_t> outstanding{0};
     std::atomic<int64_t> reconnects{0};
@@ -302,10 +323,25 @@ void* DescTrafficFiber(void* arg) {
                 cntl.set_request_pool_attachment(std::move(att));
                 benchpb::EchoRequest req;
                 benchpb::EchoResponse res;
+                // Symmetric round (ISSUE 12): ask the peer to answer
+                // with a response-direction descriptor too, so kills
+                // and chaos hit pins in BOTH directions.
+                char ask[48];
+                snprintf(ask, sizeof(ask), "desc_rsp:%zu", kDescBytes);
+                req.set_payload(ask);
+                st->counters.desc_rsp_issued.fetch_add(1);
                 req.set_send_ts_us(monotonic_time_us());
                 stub.Echo(&cntl, &req, &res, nullptr);  // sync
                 ok = !cntl.Failed();
                 stale = cntl.ErrorCode() == TERR_STALE_EPOCH;
+                if (ok &&
+                    cntl.response_pool_attachment().length ==
+                        kDescBytes &&
+                    cntl.response_pool_attachment().data != nullptr &&
+                    cntl.response_pool_attachment().data[0] == 'r') {
+                    st->counters.desc_rsp_ok.fetch_add(1);
+                }
+                // Controller teardown here acks the peer's rsp pin.
             }
             if (ok) {
                 st->counters.desc_ok.fetch_add(1);
@@ -521,6 +557,8 @@ void PrintReport(int id, int port, const Counters& c) {
         "\"expired_probes\": %lld, "
         "\"desc_issued\": %lld, \"desc_ok\": %lld, "
         "\"desc_failed\": %lld, \"desc_stale\": %lld, "
+        "\"desc_rsp_issued\": %lld, \"desc_rsp_ok\": %lld, "
+        "\"desc_rsp_resolves\": %lld, \"desc_rsp_sends\": %lld, "
         "\"pool_pinned\": %lld, \"pool_reaped\": %lld, "
         "\"pool_peer_released\": %lld, \"epoch_rejects\": %lld, "
         "\"outstanding\": %lld, \"reconnects\": %lld, "
@@ -536,6 +574,10 @@ void PrintReport(int id, int port, const Counters& c) {
         (long long)c.expired_probes.load(),
         (long long)c.desc_issued.load(), (long long)c.desc_ok.load(),
         (long long)c.desc_failed.load(), (long long)c.desc_stale.load(),
+        (long long)c.desc_rsp_issued.load(),
+        (long long)c.desc_rsp_ok.load(),
+        (long long)VarInt("rpc_pool_desc_rsp_resolves"),
+        (long long)VarInt("rpc_pool_desc_rsp_sends"),
         (long long)block_lease::pinned(),
         (long long)block_lease::expired_reaped(),
         (long long)block_lease::peer_released(),

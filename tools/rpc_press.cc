@@ -248,7 +248,8 @@ int main(int argc, char** argv) {
         fprintf(stderr,
                 "usage: rpc_press --server=ip:port [--qps=N] "
                 "[--duration_s=N] [--payload=N] [--callers=N] "
-                "[--press_threads=N] [--pooled] [--pool_desc] "
+                "[--press_threads=N] [--pooled] [--pool_desc "
+                "(alias: --pool-desc)] "
                 "[--timeout_ms=N] "
                 "[--max_retry=N] [--tenant=NAME] [--priority=0..7] "
                 "[--tenants=a:8,b:1 | a:8:7,b:1:1] [--json]\n");

@@ -288,6 +288,153 @@ def series_scrape():
                 proc.wait()  # reap: no zombie holding the port
 
 
+class _CollNode:
+    """One mesh_node handle for the collective round: line-buffered
+    stdout reads (READY / COLL lines) + stdin commands."""
+
+    def __init__(self, binary, port, peers):
+        self.proc = subprocess.Popen(
+            [str(binary), "--port", str(port), "--peers", str(peers),
+             "--collective"],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+            stderr=subprocess.DEVNULL,
+        )
+        self.buf = b""
+
+    def readline(self, deadline):
+        while b"\n" not in self.buf:
+            remain = deadline - time.time()
+            if remain <= 0:
+                return None
+            r, _, _ = select.select([self.proc.stdout], [], [], remain)
+            if not r:
+                return None
+            chunk = os.read(self.proc.stdout.fileno(), 4096)
+            if not chunk:
+                return None
+            self.buf += chunk
+        line, self.buf = self.buf.split(b"\n", 1)
+        return line.decode()
+
+    def wait_ready(self, timeout=20.0):
+        deadline = time.time() + timeout
+        while True:
+            line = self.readline(deadline)
+            if line is None:
+                return False
+            if line.startswith("READY"):
+                return True
+
+    def send(self, line):
+        self.proc.stdin.write(line.encode() + b"\n")
+        self.proc.stdin.flush()
+
+    def coll_line(self, deadline):
+        while True:
+            line = self.readline(deadline)
+            if line is None:
+                return None
+            if line.startswith("COLL "):
+                return json.loads(line[5:])
+
+
+def collective_scrape():
+    """ISSUE 13: pod-scale collectives on the 8-process mesh. Drives
+    chunked-pipelined all-reduce / all-gather / all-to-all rounds (and
+    the serial unpipelined all-reduce baseline) through the mesh_node
+    collective driver and records per-algorithm bus bandwidth — the
+    busbw of a round is the SLOWEST node's (the collective is only done
+    when everyone is), and the headline acceptance ratio is pipelined
+    all-reduce vs the serial fan-in measured by the same driver."""
+    node = BUILD / "mesh_node"
+    if not node.exists():
+        return None
+    num = 8
+    socks, ports = [], []
+    for _ in range(num):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    nodes = []
+    try:
+        with tempfile.TemporaryDirectory() as td:
+            peers = Path(td) / "peers"
+            peers.write_text("".join("127.0.0.1:%d\n" % p for p in ports))
+            # Append one at a time: a spawn failure mid-list must leave
+            # the already-started nodes in `nodes` for the finally reap.
+            for p in ports:
+                nodes.append(_CollNode(node, p, peers))
+            for n in nodes:
+                if not n.wait_ready():
+                    return None
+            time.sleep(2.0)  # shm links + pool handshakes
+
+            seq = [10]  # command rounds share one increasing seq space
+
+            def round_once(alg, nbytes):
+                seq[0] += 1
+                for n in nodes:
+                    n.send("coll %s %d %d" % (alg, nbytes, seq[0]))
+                deadline = time.time() + 90.0
+                reps = [n.coll_line(deadline) for n in nodes]
+                if any(r is None or not r.get("ok") or
+                       not r.get("verified") for r in reps):
+                    return None
+                return reps
+
+            def busbw(alg, nbytes, reps=REPS):
+                vals, fallbacks = [], 0
+                for _ in range(reps):
+                    rs = round_once(alg, nbytes)
+                    if rs is None:
+                        return None, fallbacks
+                    vals.append(min(r["busbw_mbps"] for r in rs))
+                    fallbacks += sum(
+                        r.get("desc_fallback_chunks", 0) for r in rs)
+                return statistics.median(vals), fallbacks
+
+            out = {}
+            ar, ar_fb = busbw("allreduce", 4 << 20)
+            ag, ag_fb = busbw("allgather", 512 << 10)
+            a2a, a2a_fb = busbw("alltoall", 256 << 10)
+            serial, _ = busbw("allreduce_serial", 4 << 20)
+            if ar is None:
+                return None
+            out["coll_allreduce_busbw_mbps"] = round(ar, 1)
+            if ag is not None:
+                out["coll_allgather_busbw_mbps"] = round(ag, 1)
+            if a2a is not None:
+                out["coll_alltoall_busbw_mbps"] = round(a2a, 1)
+            if serial is not None and serial > 0:
+                out["coll_allreduce_serial_mbps"] = round(serial, 1)
+                # The acceptance gate: chunked-pipelined >= 1.5x serial.
+                out["coll_allreduce_pipeline_ratio"] = round(
+                    ar / serial, 2)
+            out["coll_nranks"] = num
+            # Zero inline payload bytes on the descriptor path (the
+            # serial baseline is inline BY DESIGN and never attempts
+            # descriptors, so it cannot contribute fallbacks).
+            out["coll_zero_inline"] = int(
+                ar_fb + ag_fb + a2a_fb == 0)
+            return out
+    except Exception:
+        return None
+    finally:
+        for n in nodes:
+            try:
+                n.proc.stdin.close()
+                n.proc.wait(timeout=10)
+            except Exception:
+                try:
+                    n.proc.kill()
+                    n.proc.wait()
+                except Exception:
+                    pass
+
+
 def qos_isolation_scrape():
     """QoS isolation trajectory (ISSUE 8): boot one mesh_node with
     tenant quotas, run one mixed-tenant press where bronze floods at 8x
@@ -393,7 +540,15 @@ _SKIP_KEYS = {"metric", "unit", "vs_baseline", "reps", "error",
               # Lease leak gauges (ISSUE 10): evidence, not a rate — a
               # healthy round records pinned_after == 0; reaped counts
               # chaos/crash reclamations, so neither is a compare metric.
-              "pool_desc_pinned_after", "pool_desc_reaped"}
+              "pool_desc_pinned_after", "pool_desc_reaped",
+              # Collective round (ISSUE 13): the three coll_*_busbw_mbps
+              # keys ARE compared (higher better). The serial baseline
+              # and the derived pipeline ratio are context — the serial
+              # number measures the deliberately-unpipelined path, and
+              # the ratio re-derives from two compared/contextual keys;
+              # nranks is shape, zero_inline a boolean proof.
+              "coll_allreduce_serial_mbps", "coll_allreduce_pipeline_ratio",
+              "coll_nranks", "coll_zero_inline"}
 
 
 def _lower_is_better(key):
@@ -537,6 +692,7 @@ def run_bench():
     device = device_path()
     series = series_scrape()
     qos = qos_isolation_scrape()
+    coll = collective_scrape()
 
     mbps = float(ici["mbps"])
     out = {
@@ -567,6 +723,8 @@ def run_bench():
         out.update(series)
     if qos is not None:
         out.update(qos)
+    if coll is not None:
+        out.update(coll)
     print(json.dumps(out))
 
 

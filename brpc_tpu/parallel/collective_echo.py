@@ -92,6 +92,86 @@ def make_parallel_echo_step(mesh: Mesh):
     return jax.jit(sharded)
 
 
+def make_allreduce_step(mesh: Mesh):
+    """The mesh all-reduce as XLA lowers it (ISSUE 13 cross-check).
+
+    The C++ collective tier (cpp/trpc/collective.h) runs the same
+    pattern as a chunked descriptor-pipelined ring over the RPC mesh;
+    both implementations compute a uint32 WRAPAROUND sum, so their
+    results must agree bit for bit on identical payloads
+    (tests/test_collectives.py drives both).
+
+    Returns a jitted step: uint32[n, words] -> uint32[n, words] where
+    every row holds the elementwise sum over rows.
+    """
+    axis = mesh.axis_names[0]
+
+    def _shard_body(local: jax.Array) -> jax.Array:
+        # local: uint32[1, words]. psum == the ring's reduce; uint32
+        # arithmetic wraps identically on every backend.
+        return jax.lax.psum(local, axis)
+
+    sharded = jax.shard_map(
+        _shard_body,
+        mesh=mesh,
+        in_specs=P(axis, None),
+        out_specs=P(axis, None),
+    )
+    return jax.jit(sharded)
+
+
+def make_allgather_step(mesh: Mesh):
+    """The mesh all-gather lowering: every row collects all rows.
+
+    Twin of the C++ pull-based chunked all-gather. Returns a jitted
+    step: uint32[n, words] -> uint32[n, n*words] (per-row concatenation
+    of every peer's block, rank order).
+    """
+    axis = mesh.axis_names[0]
+
+    def _shard_body(local: jax.Array) -> jax.Array:
+        g = jax.lax.all_gather(local, axis, axis=0, tiled=True)
+        return g.reshape(1, -1)
+
+    sharded = jax.shard_map(
+        _shard_body,
+        mesh=mesh,
+        in_specs=P(axis, None),
+        out_specs=P(axis, None),
+    )
+    return jax.jit(sharded)
+
+
+def make_alltoall_step(mesh: Mesh):
+    """The mesh all-to-all lowering: block i of row r lands on row i.
+
+    Twin of the C++ pairwise-exchange all-to-all (lower rank initiates,
+    the reply carries the reciprocal block). Returns a jitted step:
+    uint32[n, n*block] -> uint32[n, n*block] where the output row r is
+    the concatenation of every rank's block-for-r.
+    """
+    axis = mesh.axis_names[0]
+
+    n = mesh.shape[axis]
+
+    def _shard_body(local: jax.Array) -> jax.Array:
+        # local: uint32[1, n*block] -> [n, block] blocks by destination;
+        # tiled all_to_all swaps block j of rank r with block r of rank j.
+        blocks = local.reshape(n, -1)
+        exchanged = jax.lax.all_to_all(
+            blocks, axis, split_axis=0, concat_axis=0, tiled=True
+        )
+        return exchanged.reshape(1, -1)
+
+    sharded = jax.shard_map(
+        _shard_body,
+        mesh=mesh,
+        in_specs=P(axis, None),
+        out_specs=P(axis, None),
+    )
+    return jax.jit(sharded)
+
+
 def make_partition_echo_step(mesh: Mesh):
     """PartitionChannel sharding lowered to XLA: each peer owns one shard.
 

@@ -1,0 +1,392 @@
+// Collective-engine tests (ISSUE 13): in-process multi-rank meshes —
+// every "rank" is a Server + CollectiveEngine pair in this process,
+// connected over loopback channels — running real chunked all-reduce /
+// all-gather / all-to-all rounds, plus the re-form path when a member
+// dies mid-collective.
+#include <algorithm>
+#include <atomic>
+#include <map>
+#include <memory>
+#include <string>
+#include <vector>
+
+#include "bench_echo.pb.h"
+#include "tbase/endpoint.h"
+#include "tbase/errno.h"
+#include "tfiber/fiber.h"
+#include "trpc/channel.h"
+#include "tfiber/fiber_sync.h"
+#include "tici/block_pool.h"
+#include "trpc/collective.h"
+#include "trpc/collective_benchpb.h"
+#include "trpc/controller.h"
+#include "trpc/server.h"
+#include "ttest/ttest.h"
+
+using namespace tpurpc;
+
+namespace {
+
+// The wire glue (codec + Exchange body) is the SAME code mesh_node
+// serves with — trpc/collective_benchpb.h.
+class TestCollService : public benchpb::CollectiveService {
+public:
+    CollectiveEngine* engine = nullptr;
+    void Exchange(google::protobuf::RpcController* cntl_base,
+                  const benchpb::CollChunk* req, benchpb::CollAck* res,
+                  google::protobuf::Closure* done) override {
+        HandleCollectiveExchange(engine,
+                                 static_cast<Controller*>(cntl_base), req,
+                                 res, done);
+    }
+};
+
+// One in-process "rank": server + engine + static membership view.
+struct TestRank;
+
+class TestMembership : public CollectiveMembership {
+public:
+    std::vector<TestRank*>* ranks = nullptr;
+    TestRank* self = nullptr;
+    void GetMembers(std::vector<Member>* out) override;
+};
+
+struct TestRank {
+    Server server;
+    TestCollService service;
+    BenchpbCollCodec codec;
+    TestMembership membership;
+    std::unique_ptr<CollectiveEngine> engine;
+    std::shared_ptr<Channel> chan;  // TO this rank's server
+    uint64_t key = 0;
+    std::atomic<bool> dead{false};  // excluded from every membership view
+};
+
+void TestMembership::GetMembers(std::vector<Member>* out) {
+    for (TestRank* r : *ranks) {
+        if (r->dead.load(std::memory_order_relaxed)) continue;
+        Member m;
+        m.key = r->key;
+        m.self = r == self;
+        if (!m.self) m.chan = r->chan;
+        out->push_back(m);
+    }
+}
+
+// Builds an N-rank in-process mesh; every rank serves on a loopback
+// port and every OTHER rank reaches it through one shared channel.
+struct TestMesh {
+    std::vector<std::unique_ptr<TestRank>> owned;
+    std::vector<TestRank*> ranks;
+
+    explicit TestMesh(int n, const CollectiveOptions& opts) {
+        IciBlockPool::Init();  // chunk buffers pool-backed where possible
+        for (int i = 0; i < n; ++i) {
+            owned.push_back(std::make_unique<TestRank>());
+            ranks.push_back(owned.back().get());
+        }
+        for (TestRank* r : ranks) {
+            r->server.AddService(&r->service);
+            EndPoint any;
+            str2endpoint("127.0.0.1:0", &any);
+            r->server.Start(any, nullptr);
+            r->key = (uint64_t)r->server.listened_port();
+            r->chan = std::make_shared<Channel>();
+            ChannelOptions copts;
+            copts.timeout_ms = 3000;
+            copts.max_retry = 0;  // the engine sets per-call retries
+            char addr[32];
+            snprintf(addr, sizeof(addr), "127.0.0.1:%d",
+                     r->server.listened_port());
+            r->chan->Init(addr, &copts);
+        }
+        for (TestRank* r : ranks) {
+            r->membership.ranks = &ranks;
+            r->membership.self = r;
+            r->engine.reset(
+                new CollectiveEngine(&r->membership, &r->codec, opts));
+            r->service.engine = r->engine.get();
+        }
+    }
+};
+
+CollectiveOptions SmallOpts() {
+    CollectiveOptions o;
+    o.chunk_bytes = 4 << 10;  // many chunks from small payloads
+    o.step_timeout_ms = 2000;
+    o.attempt_timeout_ms = 2500;
+    o.op_timeout_ms = 15000;
+    return o;
+}
+
+// Drive one op on every live rank concurrently (each driver blocks its
+// fiber — a collective needs all ranks participating).
+struct DriverArg {
+    TestRank* rank = nullptr;
+    uint64_t seq = 0;
+    std::vector<uint32_t> words;
+    std::string out;
+    std::map<uint64_t, std::string> blocks;
+    size_t block_bytes = 0;
+    int op = 0;  // 0 allreduce, 1 serial, 2 allgather, 3 alltoall
+    CollectiveEngine::Result result;
+    int rc = -1;
+    CountdownEvent* finished = nullptr;
+};
+
+void* DriveOne(void* argp) {
+    auto* a = (DriverArg*)argp;
+    switch (a->op) {
+        case 0:
+            a->rc = a->rank->engine->AllReduce(a->seq, a->words.data(),
+                                               a->words.size(), &a->result);
+            break;
+        case 1:
+            a->rc = a->rank->engine->SerialAllReduce(
+                a->seq, a->words.data(), a->words.size(), &a->result);
+            break;
+        case 2:
+            a->rc = a->rank->engine->AllGather(
+                a->seq, a->words.data(), a->words.size() * 4, &a->out,
+                &a->result);
+            break;
+        case 3:
+            a->rc = a->rank->engine->AllToAll(a->seq, a->blocks,
+                                              a->block_bytes, &a->out,
+                                              &a->result);
+            break;
+    }
+    a->finished->signal();
+    return nullptr;
+}
+
+void DriveAll(std::vector<DriverArg>& args) {
+    CountdownEvent ev((int)args.size());
+    for (DriverArg& a : args) {
+        a.finished = &ev;
+        fiber_t t;
+        if (fiber_start_background(&t, nullptr, DriveOne, &a) != 0) {
+            DriveOne(&a);
+        }
+    }
+    ev.wait();
+}
+
+std::vector<uint32_t> ExpectedSum(uint64_t seq,
+                                  const std::vector<uint64_t>& keys,
+                                  size_t nwords) {
+    std::vector<uint32_t> expect(nwords, 0), tmp(nwords);
+    for (uint64_t k : keys) {
+        CollectiveEngine::FillDeterministic(seq, k, tmp.data(), nwords);
+        for (size_t i = 0; i < nwords; ++i) expect[i] += tmp[i];
+    }
+    return expect;
+}
+
+}  // namespace
+
+TEST(Collective, ChecksumAndFillAreStable) {
+    // Golden value locks the cross-language formula (the numpy/JAX twin
+    // in tests/test_collectives.py must match it bit for bit).
+    const uint32_t words[3] = {1, 2, 3};
+    EXPECT_EQ(1310726u, CollectiveEngine::Checksum(words, 3));
+    uint32_t w[4];
+    CollectiveEngine::FillDeterministic(7, 9001, w, 4);
+    EXPECT_EQ((uint32_t)(0x9E3779B1u * 7 + 0x85EBCA77u * 9001),
+              w[0]);
+    EXPECT_EQ((uint32_t)(w[0] + 0xC2B2AE35u), w[1]);
+}
+
+TEST(Collective, RingAllReduceMatchesSum) {
+    TestMesh mesh(4, SmallOpts());
+    const size_t nwords = 8192;  // 32 KiB over 4 KiB chunks => pipelined
+    std::vector<DriverArg> args(4);
+    for (int i = 0; i < 4; ++i) {
+        args[i].rank = mesh.ranks[i];
+        args[i].seq = 1;
+        args[i].op = 0;
+        args[i].words.resize(nwords);
+        CollectiveEngine::FillDeterministic(1, mesh.ranks[i]->key,
+                                            args[i].words.data(), nwords);
+    }
+    DriveAll(args);
+    std::vector<uint64_t> keys;
+    for (TestRank* r : mesh.ranks) keys.push_back(r->key);
+    std::vector<uint32_t> expect = ExpectedSum(1, keys, nwords);
+    for (int i = 0; i < 4; ++i) {
+        ASSERT_EQ(0, args[i].rc);
+        EXPECT_EQ(4u, args[i].result.nranks);
+        EXPECT_TRUE(args[i].words == expect);
+    }
+}
+
+TEST(Collective, SerialAllReduceMatchesRing) {
+    TestMesh mesh(3, SmallOpts());
+    const size_t nwords = 1024;
+    std::vector<DriverArg> args(3);
+    for (int i = 0; i < 3; ++i) {
+        args[i].rank = mesh.ranks[i];
+        args[i].seq = 1;
+        args[i].op = 1;
+        args[i].words.resize(nwords);
+        CollectiveEngine::FillDeterministic(1, mesh.ranks[i]->key,
+                                            args[i].words.data(), nwords);
+    }
+    DriveAll(args);
+    std::vector<uint64_t> keys;
+    for (TestRank* r : mesh.ranks) keys.push_back(r->key);
+    std::vector<uint32_t> expect = ExpectedSum(1, keys, nwords);
+    for (int i = 0; i < 3; ++i) {
+        ASSERT_EQ(0, args[i].rc);
+        EXPECT_TRUE(args[i].words == expect);
+    }
+}
+
+TEST(Collective, AllGatherCollectsBlocksInRankOrder) {
+    TestMesh mesh(4, SmallOpts());
+    const size_t nwords = 3000;  // 12 KB block -> 3 chunks of 4 KiB
+    std::vector<DriverArg> args(4);
+    for (int i = 0; i < 4; ++i) {
+        args[i].rank = mesh.ranks[i];
+        args[i].seq = 1;
+        args[i].op = 2;
+        args[i].words.resize(nwords);
+        CollectiveEngine::FillDeterministic(1, mesh.ranks[i]->key,
+                                            args[i].words.data(), nwords);
+    }
+    DriveAll(args);
+    // Rank order = key order (ports ascending).
+    std::vector<TestRank*> sorted = mesh.ranks;
+    std::sort(sorted.begin(), sorted.end(),
+              [](TestRank* a, TestRank* b) { return a->key < b->key; });
+    std::string expect;
+    std::vector<uint32_t> tmp(nwords);
+    for (TestRank* r : sorted) {
+        CollectiveEngine::FillDeterministic(1, r->key, tmp.data(), nwords);
+        expect.append((const char*)tmp.data(), nwords * 4);
+    }
+    for (int i = 0; i < 4; ++i) {
+        ASSERT_EQ(0, args[i].rc);
+        EXPECT_TRUE(args[i].out == expect);
+    }
+}
+
+TEST(Collective, AllToAllExchangesPairBlocks) {
+    TestMesh mesh(4, SmallOpts());
+    const size_t block = 8 << 10;  // 2 chunks per pair
+    std::vector<DriverArg> args(4);
+    std::vector<uint32_t> tmp(block / 4);
+    for (int i = 0; i < 4; ++i) {
+        args[i].rank = mesh.ranks[i];
+        args[i].seq = 1;
+        args[i].op = 3;
+        args[i].block_bytes = block;
+        for (TestRank* dst : mesh.ranks) {
+            CollectiveEngine::FillDeterministic(
+                1, mesh.ranks[i]->key * 1000003ull + dst->key, tmp.data(),
+                tmp.size());
+            args[i].blocks[dst->key].assign((const char*)tmp.data(),
+                                            block);
+        }
+    }
+    DriveAll(args);
+    std::vector<TestRank*> sorted = mesh.ranks;
+    std::sort(sorted.begin(), sorted.end(),
+              [](TestRank* a, TestRank* b) { return a->key < b->key; });
+    for (int i = 0; i < 4; ++i) {
+        ASSERT_EQ(0, args[i].rc);
+        std::string expect;
+        for (TestRank* src : sorted) {
+            CollectiveEngine::FillDeterministic(
+                1, src->key * 1000003ull + mesh.ranks[i]->key, tmp.data(),
+                tmp.size());
+            expect.append((const char*)tmp.data(), block);
+        }
+        EXPECT_TRUE(args[i].out == expect);
+    }
+}
+
+namespace {
+
+struct KillArg {
+    TestRank* victim = nullptr;
+    std::atomic<bool>* go = nullptr;
+};
+
+void* KillAfterDelay(void* argp) {
+    auto* a = (KillArg*)argp;
+    // Let the survivors' first attempt run into the dead server, then
+    // flip the membership view so the next attempt RE-FORMS.
+    fiber_usleep(600 * 1000);
+    a->victim->dead.store(true, std::memory_order_relaxed);
+    a->go->store(true, std::memory_order_relaxed);
+    return nullptr;
+}
+
+}  // namespace
+
+TEST(Collective, MemberDeathReformsOverSurvivors) {
+    CollectiveOptions opts = SmallOpts();
+    opts.attempt_timeout_ms = 1200;  // fail into the dead peer quickly
+    TestMesh mesh(3, opts);
+    const size_t nwords = 2048;
+
+    // Round 1: everyone alive.
+    {
+        std::vector<DriverArg> args(3);
+        for (int i = 0; i < 3; ++i) {
+            args[i].rank = mesh.ranks[i];
+            args[i].seq = 1;
+            args[i].op = 0;
+            args[i].words.resize(nwords);
+            CollectiveEngine::FillDeterministic(
+                1, mesh.ranks[i]->key, args[i].words.data(), nwords);
+        }
+        DriveAll(args);
+        for (int i = 0; i < 3; ++i) ASSERT_EQ(0, args[i].rc);
+    }
+
+    // Kill rank 2's server (calls to it now fail) but leave it IN the
+    // membership view: the survivors' first round-2 attempt must fail,
+    // then re-form over {0, 1} once the view catches up.
+    TestRank* victim = mesh.ranks[2];
+    victim->engine->Shutdown();
+    victim->server.Stop();
+    victim->server.Join();
+    std::atomic<bool> flipped{false};
+    KillArg ka{victim, &flipped};
+    fiber_t kt;
+    ASSERT_EQ(0, fiber_start_background(&kt, nullptr, KillAfterDelay, &ka));
+
+    std::vector<DriverArg> args(2);
+    for (int i = 0; i < 2; ++i) {
+        args[i].rank = mesh.ranks[i];
+        args[i].seq = 2;
+        args[i].op = 0;
+        args[i].words.resize(nwords);
+        CollectiveEngine::FillDeterministic(2, mesh.ranks[i]->key,
+                                            args[i].words.data(), nwords);
+    }
+    DriveAll(args);
+    fiber_join(kt, nullptr);
+    ASSERT_TRUE(flipped.load());
+
+    std::vector<uint64_t> survivors{mesh.ranks[0]->key,
+                                    mesh.ranks[1]->key};
+    std::sort(survivors.begin(), survivors.end());
+    std::vector<uint32_t> expect = ExpectedSum(2, survivors, nwords);
+    for (int i = 0; i < 2; ++i) {
+        if (args[i].rc != 0) {
+            fprintf(stderr,
+                    "rank %d rc=%d error=%d nranks=%u reforms=%d "
+                    "retries=%d\n",
+                    i, args[i].rc, args[i].result.error,
+                    args[i].result.nranks, args[i].result.reforms,
+                    args[i].result.retries);
+        }
+        ASSERT_EQ(0, args[i].rc);
+        EXPECT_EQ(2u, args[i].result.nranks);
+        EXPECT_GE(args[i].result.reforms, 1);
+        EXPECT_TRUE(args[i].words == expect);
+    }
+}

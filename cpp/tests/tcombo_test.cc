@@ -4,15 +4,20 @@
 // In-process loopback servers, the reference's test style
 // (test/brpc_channel_unittest.cpp combo-channel sections).
 #include <atomic>
+#include <map>
+#include <memory>
+#include <mutex>
 #include <string>
 #include <vector>
 
 #include "echo.pb.h"
 #include "tbase/errno.h"
+#include "tbase/time.h"
 #include "tfiber/fiber_sync.h"
 #include "trpc/combo_channels.h"
 #include "trpc/controller.h"
 #include "trpc/server.h"
+#include "trpc/server_call.h"
 #include "ttest/ttest.h"
 
 using namespace tpurpc;
@@ -345,4 +350,240 @@ TEST(DynamicPartitionChannel, PicksLargestScheme) {
     EXPECT_EQ(1, b1.service.ncalls.load());
     EXPECT_EQ(1, b2.service.ncalls.load());
     EXPECT_EQ(0, a0.service.ncalls.load());
+}
+
+// ---------------- ISSUE 13 satellites: sub-call context ----------------
+
+namespace {
+
+// Echoes the QoS/deadline context the SERVER observed, so tests can
+// assert what actually crossed the wire for combo sub-calls.
+class ContextEchoService : public test::EchoService {
+public:
+    void Echo(google::protobuf::RpcController* cntl_base,
+              const test::EchoRequest* req, test::EchoResponse* res,
+              google::protobuf::Closure* done) override {
+        Controller* cntl = static_cast<Controller*>(cntl_base);
+        ncalls.fetch_add(1, std::memory_order_relaxed);
+        if (fail.load(std::memory_order_relaxed)) {
+            cntl->SetFailed(ECONNABORTED, "injected");
+            done->Run();
+            return;
+        }
+        const long long budget_ms =
+            cntl->has_server_deadline()
+                ? (long long)(cntl->remaining_server_budget_us() / 1000)
+                : -1;
+        char buf[128];
+        snprintf(buf, sizeof(buf), "tenant=%s;prio=%d;budget_ms=%lld",
+                 cntl->tenant().c_str(), cntl->priority(), budget_ms);
+        res->set_message(req->message() + "|" + buf);
+        cntl->response_attachment().append("att:");
+        cntl->response_attachment().append(cntl->request_attachment());
+        done->Run();
+    }
+    std::atomic<int> ncalls{0};
+    std::atomic<bool> fail{false};
+};
+
+struct ContextServer {
+    ContextServer() {
+        server.AddService(&service);
+        EndPoint any;
+        str2endpoint("127.0.0.1:0", &any);
+        server.Start(any, nullptr);
+    }
+    std::string addr() const {
+        return "127.0.0.1:" + std::to_string(server.listened_port());
+    }
+    ContextEchoService service;
+    Server server;
+};
+
+}  // namespace
+
+TEST(ParallelChannel, SubCallsInheritTenantPriorityAndDeadline) {
+    ContextServer s1, s2;
+    Channel c1, c2;
+    ChannelOptions copts;
+    copts.timeout_ms = 5000;
+    ASSERT_EQ(0, c1.Init(s1.addr().c_str(), &copts));
+    ASSERT_EQ(0, c2.Init(s2.addr().c_str(), &copts));
+    ParallelChannel pc;
+    ASSERT_EQ(0, pc.AddChannel(&c1, nullptr, new ConcatMerger));
+    ASSERT_EQ(0, pc.AddChannel(&c2, nullptr, new ConcatMerger));
+
+    // Simulated upstream server call with 400ms of remaining budget:
+    // sub-calls must run under it even though the parent timeout is 5s.
+    Controller upstream;
+    upstream.set_server_deadline_us(monotonic_time_us() + 400 * 1000);
+    ServerCallScope scope(&upstream);
+
+    test::EchoService_Stub stub(&pc);
+    Controller cntl;
+    cntl.set_timeout_ms(5000);
+    cntl.set_tenant("gold-combo");
+    cntl.set_priority(6);
+    test::EchoRequest req;
+    test::EchoResponse res;
+    req.set_message("ctx");
+    stub.Echo(&cntl, &req, &res, nullptr);
+    ASSERT_FALSE(cntl.Failed());
+    // Both sub-responses observed the parent's identity and a budget
+    // capped at the upstream's remaining 400ms.
+    size_t pos = 0;
+    int found = 0;
+    while ((pos = res.message().find("tenant=", pos)) !=
+           std::string::npos) {
+        ++found;
+        const std::string part = res.message().substr(pos);
+        EXPECT_TRUE(part.find("tenant=gold-combo;prio=6;") == 0);
+        long long budget = -1;
+        sscanf(part.c_str(), "tenant=gold-combo;prio=6;budget_ms=%lld",
+               &budget);
+        EXPECT_GT(budget, 0);
+        EXPECT_LE(budget, 400);
+        ++pos;
+    }
+    EXPECT_EQ(2, found);
+}
+
+TEST(SelectiveChannel, RetryHopKeepsTenantPriorityAndDeadline) {
+    ContextServer bad, good;
+    bad.service.fail = true;
+    Channel cb, cg;
+    ChannelOptions copts;
+    copts.timeout_ms = 5000;
+    copts.max_retry = 0;
+    ASSERT_EQ(0, cb.Init(bad.addr().c_str(), &copts));
+    ASSERT_EQ(0, cg.Init(good.addr().c_str(), &copts));
+    SelectiveChannel sc;
+    ASSERT_EQ(0, sc.AddChannel(&cb));
+    ASSERT_EQ(0, sc.AddChannel(&cg));
+
+    Controller upstream;
+    upstream.set_server_deadline_us(monotonic_time_us() + 600 * 1000);
+    ServerCallScope scope(&upstream);
+
+    // Every call eventually lands on the good server; the retry hop
+    // fires on the completion fiber, where the upstream scope must be
+    // REPLAYED for the context to survive (the regression this guards).
+    test::EchoService_Stub stub(&sc);
+    for (int i = 0; i < 4; ++i) {
+        Controller cntl;
+        cntl.set_timeout_ms(5000);
+        cntl.set_max_retry(2);
+        cntl.set_tenant("silver-combo");
+        cntl.set_priority(3);
+        test::EchoRequest req;
+        test::EchoResponse res;
+        req.set_message("hop");
+        stub.Echo(&cntl, &req, &res, nullptr);
+        ASSERT_FALSE(cntl.Failed());
+        EXPECT_TRUE(res.message().find(
+                        "tenant=silver-combo;prio=3;") !=
+                    std::string::npos);
+        long long budget = -1;
+        const size_t p = res.message().find("budget_ms=");
+        ASSERT_TRUE(p != std::string::npos);
+        sscanf(res.message().c_str() + p, "budget_ms=%lld", &budget);
+        EXPECT_GT(budget, 0);
+        EXPECT_LE(budget, 600);
+    }
+    EXPECT_GE(good.service.ncalls.load(), 4);
+}
+
+TEST(SelectiveChannel, CrossChannelRetriesSpendRetryBudget) {
+    ContextServer bad1, bad2;
+    bad1.service.fail = true;
+    bad2.service.fail = true;
+    Channel c1, c2;
+    ChannelOptions copts;
+    copts.timeout_ms = 2000;
+    copts.max_retry = 0;
+    ASSERT_EQ(0, c1.Init(bad1.addr().c_str(), &copts));
+    ASSERT_EQ(0, c2.Init(bad2.addr().c_str(), &copts));
+    SelectiveChannel sc;
+    ASSERT_EQ(0, sc.AddChannel(&c1));
+    ASSERT_EQ(0, sc.AddChannel(&c2));
+    // One burst token and no refill: of the 5 permitted hops only ONE
+    // cross-channel retry may actually go out.
+    sc.ConfigureRetryBudget(1, 0.0);
+
+    test::EchoService_Stub stub(&sc);
+    Controller cntl;
+    cntl.set_timeout_ms(2000);
+    cntl.set_max_retry(5);
+    test::EchoRequest req;
+    test::EchoResponse res;
+    req.set_message("budget");
+    stub.Echo(&cntl, &req, &res, nullptr);
+    EXPECT_TRUE(cntl.Failed());
+    EXPECT_EQ(2, bad1.service.ncalls.load() + bad2.service.ncalls.load());
+    EXPECT_EQ(0, (int)sc.retry_budget().tokens());
+}
+
+namespace {
+
+// Per-sub-call attachments out, per-sub-call responses observed — the
+// combo extension the collective tier fans chunks out through.
+class BlockMapper : public CallMapper {
+public:
+    explicit BlockMapper(SubCallObserver* obs) : obs_(obs) {}
+    SubCall Map(int channel_index, int, const
+                google::protobuf::MethodDescriptor*,
+                const google::protobuf::Message*,
+                google::protobuf::Message*) override {
+        SubCall s;
+        s.request_attachment.append("blk" +
+                                    std::to_string(channel_index));
+        s.observer = obs_;
+        return s;
+    }
+
+private:
+    SubCallObserver* obs_;
+};
+
+class CollectObserver : public SubCallObserver {
+public:
+    void OnSubCallDone(int channel_index, Controller& sub) override {
+        std::lock_guard<std::mutex> g(mu);
+        seen[channel_index] = sub.Failed()
+                                  ? "FAILED"
+                                  : sub.response_attachment().to_string();
+    }
+    std::mutex mu;
+    std::map<int, std::string> seen;
+};
+
+}  // namespace
+
+TEST(ParallelChannel, PerSubCallAttachmentsAndObserver) {
+    ContextServer s1, s2, s3;
+    Channel c1, c2, c3;
+    ChannelOptions copts;
+    copts.timeout_ms = 3000;
+    ASSERT_EQ(0, c1.Init(s1.addr().c_str(), &copts));
+    ASSERT_EQ(0, c2.Init(s2.addr().c_str(), &copts));
+    ASSERT_EQ(0, c3.Init(s3.addr().c_str(), &copts));
+    CollectObserver obs;
+    auto mapper = std::make_shared<BlockMapper>(&obs);
+    ParallelChannel pc;
+    ASSERT_EQ(0, pc.AddChannelShared(&c1, mapper, nullptr));
+    ASSERT_EQ(0, pc.AddChannelShared(&c2, mapper, nullptr));
+    ASSERT_EQ(0, pc.AddChannelShared(&c3, mapper, nullptr));
+
+    test::EchoService_Stub stub(&pc);
+    Controller cntl;
+    cntl.set_timeout_ms(3000);
+    test::EchoRequest req;
+    test::EchoResponse res;
+    req.set_message("m");
+    stub.Echo(&cntl, &req, &res, nullptr);
+    ASSERT_FALSE(cntl.Failed());
+    ASSERT_EQ(3u, obs.seen.size());
+    for (int i = 0; i < 3; ++i) {
+        EXPECT_EQ("att:blk" + std::to_string(i), obs.seen[i]);
+    }
 }

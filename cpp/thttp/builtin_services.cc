@@ -30,6 +30,7 @@
 #include "tnet/fault_injection.h"
 #include "tnet/input_messenger.h"
 #include "tnet/socket.h"
+#include "trpc/collective.h"
 #include "trpc/rpcz_stitch.h"
 #include "trpc/server.h"
 #include "trpc/span.h"
@@ -769,9 +770,11 @@ void HandleMetrics(Server*, const HttpRequest&, HttpResponse* res) {
 void AddBuiltinHttpServices(Server* server) {
     // The /pools + /metrics pages report the lease + transport families
     // even on a server that never pinned a block or moved a transport
-    // byte (0 is data; absent is not).
+    // byte (0 is data; absent is not). Same for the collective families
+    // (ISSUE 13) — linted 0-valued before the first round.
     block_lease::ExposeVars();
     transport_stats::ExposeVars();
+    CollectiveEngine::ExposeVars();
     server->RegisterHttpHandler("/", HandleIndex);
     server->RegisterHttpHandler("/health", HandleHealth);
     server->RegisterHttpHandler("/status", HandleStatus);

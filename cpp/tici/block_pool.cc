@@ -546,6 +546,16 @@ bool IciBlockPool::AllocatePoolAttachment(size_t n, IOBuf* out,
     return true;
 }
 
+bool IciBlockPool::AllocatePoolAttachmentCopy(const void* src, size_t n,
+                                              IOBuf* out) {
+    IOBuf buf;
+    char* data = nullptr;
+    if (!AllocatePoolAttachment(n, &buf, &data)) return false;
+    memcpy(data, src, n);
+    out->swap(buf);
+    return true;
+}
+
 // ---------------- pool registry (ISSUE 9b) ----------------
 
 namespace pool_registry {

@@ -99,6 +99,14 @@ public:
     static bool AllocatePoolAttachment(size_t n, class IOBuf* out,
                                        char** data);
 
+    // Chunk-leasing helper for pipelined transfers (ISSUE 13): allocate
+    // a descriptor-eligible pool block and fill it from `src` in one
+    // step — the shape every collective chunk send needs. Returns false
+    // (out untouched) when the pool can't serve a shared slab of n
+    // bytes; the caller falls back to inline attachment bytes.
+    static bool AllocatePoolAttachmentCopy(const void* src, size_t n,
+                                           class IOBuf* out);
+
     // ---- cross-process registration (the shared primary region) ----
     // Name of the shm segment backing the primary region ("" when the
     // pool fell back to anonymous memory). Peers shm_open this name

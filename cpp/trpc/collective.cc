@@ -1,0 +1,1247 @@
+#include "trpc/collective.h"
+
+#include <algorithm>
+#include <cstring>
+#include <set>
+
+#include "tbase/errno.h"
+#include "tbase/logging.h"
+#include "tbase/time.h"
+#include "tfiber/fiber.h"
+#include "tici/block_pool.h"
+#include "trpc/combo_channels.h"
+#include "trpc/controller.h"
+#include "tvar/multi_dimension.h"
+#include "tvar/reducer.h"
+
+namespace tpurpc {
+
+namespace {
+
+// Subsystem observability (ISSUE 13): completed ops, chunk RPCs,
+// attempt re-runs, membership re-forms, payload bytes pushed, and the
+// chunks that fell back to inline bytes (should stay 0 on
+// descriptor-capable meshes — the bench's zero-inline proof).
+static LazyAdder g_ops("rpc_collective_ops");
+static LazyAdder g_steps("rpc_collective_steps");
+static LazyAdder g_retries("rpc_collective_retries");
+static LazyAdder g_reforms("rpc_collective_reforms");
+static LazyAdder g_bytes("rpc_collective_bytes");
+static LazyAdder g_desc_fallbacks("rpc_collective_desc_fallbacks");
+
+// Per-algorithm bus bandwidth of the most recent completed round
+// (NCCL-style busbw: the payload-derived rate every algorithm can be
+// compared on): rpc_collective_busbw_mbps{alg="allreduce"|...}.
+LabelledMetric<IntCell>* BusbwFamily() {
+    static LabelledMetric<IntCell>* f = new LabelledMetric<IntCell>(
+        "rpc_collective_busbw_mbps", {"alg"});
+    return f;
+}
+
+uint32_t RoundFamily(uint32_t kind) {
+    switch (kind) {
+        case COLL_ALLREDUCE:
+            return COLL_ALLREDUCE;
+        case COLL_ALLGATHER:
+            return COLL_ALLGATHER;
+        case COLL_ALLTOALL:
+            return COLL_ALLTOALL;
+        case COLL_SERIAL_PUSH:
+        case COLL_SERIAL_PULL:
+            return COLL_SERIAL_PUSH;
+        default:
+            return 0;
+    }
+}
+
+uint64_t RoundKey(uint32_t rkind, uint64_t seq) {
+    return ((uint64_t)rkind << 56) | (seq & 0x00FFFFFFFFFFFFFFull);
+}
+
+uint64_t PackChunk(uint32_t src, uint32_t step, uint32_t chunk) {
+    return ((uint64_t)src << 48) | ((uint64_t)(step & 0xFFFFFF) << 24) |
+           (chunk & 0xFFFFFF);
+}
+
+uint64_t HashKeys(const std::vector<CollectiveMembership::Member>& m) {
+    uint64_t h = 1469598103934665603ull;  // FNV-1a 64
+    for (const auto& mem : m) {
+        uint64_t k = mem.key;
+        for (int i = 0; i < 8; ++i) {
+            h ^= (k >> (i * 8)) & 0xFF;
+            h *= 1099511628211ull;
+        }
+    }
+    return h;
+}
+
+// Ring schedule: the shard rank `rank` SENDS at `step` (phase 1 steps
+// 0..n-2 reduce-scatter, phase 2 steps n-1..2n-3 all-gather). The shard
+// it RECEIVES at `step` is OutShard(pred, step) — which equals
+// OutShard(rank, step+1), the classic "forward what you just got"
+// dependency that makes the pipeline overlap transfers with reduces.
+uint32_t OutShard(uint32_t rank, uint32_t step, uint32_t n) {
+    if (step < n - 1) {
+        return (rank + n - (step % n)) % n;
+    }
+    const uint32_t t = step - (n - 1);
+    return (rank + 1 + n - (t % n)) % n;
+}
+
+// Word range of shard k when nwords split over n ranks.
+void ShardRange(uint64_t nwords, uint32_t n, uint32_t k, uint64_t* w0,
+                uint64_t* wn) {
+    const uint64_t q = nwords / n, rem = nwords % n;
+    *w0 = (uint64_t)k * q + std::min<uint64_t>(k, rem);
+    *wn = q + (k < rem ? 1 : 0);
+}
+
+uint32_t ChunksOf(uint64_t shard_words, uint64_t chunk_words) {
+    if (shard_words == 0) return 0;
+    return (uint32_t)((shard_words + chunk_words - 1) / chunk_words);
+}
+
+void AddWordsWraparound(char* dst, const char* src, size_t nbytes) {
+    for (size_t i = 0; i + 4 <= nbytes; i += 4) {
+        uint32_t a, b;
+        memcpy(&a, dst + i, 4);
+        memcpy(&b, src + i, 4);
+        a += b;
+        memcpy(dst + i, &a, 4);
+    }
+}
+
+}  // namespace
+
+// ---------------- round state ----------------
+
+struct CollectiveEngine::Round {
+    uint32_t rkind = 0;
+    uint64_t seq = 0;
+    uint64_t member_hash = 0;
+    uint32_t nranks = 0;
+    uint32_t my_rank = 0;
+    std::vector<CollectiveMembership::Member> members;
+    uint64_t total_bytes = 0;
+    std::string buf;    // working/result buffer
+    std::string input;  // immutable per-attempt input (restarts + pulls)
+    std::set<uint64_t> applied;  // exactly-once chunk application
+    bool complete = false;
+    uint64_t attempt = 0;  // bumped per (re)run; stale callbacks ignore
+    int fail_error = 0;    // sticky abort of the current attempt
+    uint32_t sends_inflight = 0;
+    FiberMutex mu;
+    FiberCond cv;
+};
+
+// ---------------- async chunk send ----------------
+
+struct CollectiveEngine::SendCtx {
+    std::shared_ptr<Round> round;
+    uint64_t attempt = 0;
+    std::unique_ptr<google::protobuf::Message> req;
+    std::unique_ptr<google::protobuf::Message> rsp;
+    Controller cntl;
+
+    static void Done(SendCtx* c) {
+        {
+            FiberMutexGuard g(c->round->mu);
+            if (c->round->attempt == c->attempt) {
+                if (c->round->sends_inflight > 0) {
+                    c->round->sends_inflight--;
+                }
+                if (c->cntl.Failed() && c->round->fail_error == 0) {
+                    c->round->fail_error = c->cntl.ErrorCode();
+                }
+                c->round->cv.notify_all();
+            }
+        }
+        delete c;
+    }
+};
+
+void CollectiveEngine::SendChunkAsync(const std::shared_ptr<Round>& round,
+                                      uint64_t attempt, const CollWire& w,
+                                      Result* r) {
+    auto* c = new SendCtx;
+    c->round = round;
+    c->attempt = attempt;
+    c->req.reset(codec_->NewRequest(w));
+    c->rsp.reset(codec_->NewResponse());
+    c->cntl.set_timeout_ms(opts_.step_timeout_ms);
+    c->cntl.set_max_retry(opts_.max_chunk_retries);
+    std::shared_ptr<google::protobuf::RpcChannel> chan;
+    {
+        FiberMutexGuard g(round->mu);
+        if (round->attempt != attempt || round->fail_error != 0) {
+            delete c;
+            return;
+        }
+        const uint32_t peer = (round->my_rank + 1) % round->nranks;
+        chan = round->members[peer].chan;
+        const char* src = round->buf.data() + w.offset;
+        IOBuf pbuf;
+        if (opts_.pool_descriptors &&
+            IciBlockPool::AllocatePoolAttachmentCopy(src, (size_t)w.len,
+                                                     &pbuf)) {
+            // The pin rides the existing lease machinery: exactly-once
+            // release at EndRPC, reaper + peer-death as backstops.
+            c->cntl.set_request_pool_attachment(std::move(pbuf));
+        } else {
+            c->cntl.request_attachment().append(src, (size_t)w.len);
+            if (r != nullptr) r->desc_fallback_chunks++;
+            *g_desc_fallbacks << 1;
+        }
+        round->sends_inflight++;
+    }
+    *g_steps << 1;
+    *g_bytes << (int64_t)w.len;
+    if (r != nullptr) r->moved_bytes += w.len;
+    chan->CallMethod(codec_->method(), &c->cntl, c->req.get(), c->rsp.get(),
+                     google::protobuf::NewCallback(&SendCtx::Done, c));
+}
+
+// ---------------- engine lifecycle ----------------
+
+CollectiveEngine::CollectiveEngine(CollectiveMembership* membership,
+                                   CollectiveCodec* codec,
+                                   const CollectiveOptions& opts)
+    : membership_(membership), codec_(codec), opts_(opts) {
+    if (opts_.chunk_bytes < 4) opts_.chunk_bytes = 4;
+}
+
+CollectiveEngine::~CollectiveEngine() { Shutdown(); }
+
+void CollectiveEngine::Shutdown() {
+    FiberMutexGuard g(mu_);
+    shutdown_ = true;
+    cv_.notify_all();
+    for (auto& kv : rounds_) {
+        FiberMutexGuard rg(kv.second->mu);
+        if (kv.second->fail_error == 0) {
+            kv.second->fail_error = TERR_CLOSE;
+        }
+        kv.second->cv.notify_all();
+    }
+}
+
+bool CollectiveEngine::ProbeMembers(
+    std::vector<CollectiveMembership::Member>* members, uint32_t* my_rank,
+    uint64_t* hash) {
+    members->clear();
+    membership_->GetMembers(members);
+    std::sort(members->begin(), members->end(),
+              [](const CollectiveMembership::Member& a,
+                 const CollectiveMembership::Member& b) {
+                  return a.key < b.key;
+              });
+    int self = -1;
+    for (size_t i = 0; i < members->size(); ++i) {
+        if ((*members)[i].self) self = (int)i;
+    }
+    if (members->size() < 2 || self < 0) return false;
+    *my_rank = (uint32_t)self;
+    *hash = HashKeys(*members);
+    return true;
+}
+
+std::shared_ptr<CollectiveEngine::Round> CollectiveEngine::GetOrCreateRound(
+    uint32_t rkind, uint64_t seq,
+    std::vector<CollectiveMembership::Member>&& members, uint32_t my_rank,
+    uint64_t hash, const std::string& input, size_t base_bytes, Result* r) {
+    const uint32_t nranks = (uint32_t)members.size();
+    auto reset_buffers = [&](Round* rd) {
+        rd->input = input;
+        switch (rkind) {
+            case COLL_ALLREDUCE:
+            case COLL_SERIAL_PUSH:
+                rd->total_bytes = input.size();
+                rd->buf = input;
+                break;
+            case COLL_ALLGATHER:
+                // input = my block; buf = nranks blocks in rank order.
+                rd->total_bytes = (uint64_t)base_bytes * nranks;
+                rd->buf.assign((size_t)rd->total_bytes, '\0');
+                memcpy(&rd->buf[(size_t)base_bytes * my_rank], input.data(),
+                       base_bytes);
+                break;
+            case COLL_ALLTOALL:
+                // input = nranks outbound blocks; buf = inbound blocks.
+                rd->total_bytes = (uint64_t)base_bytes * nranks;
+                rd->buf.assign((size_t)rd->total_bytes, '\0');
+                memcpy(&rd->buf[(size_t)base_bytes * my_rank],
+                       input.data() + (size_t)base_bytes * my_rank,
+                       base_bytes);
+                break;
+            default:
+                break;
+        }
+    };
+
+    FiberMutexGuard g(mu_);
+    if (shutdown_) return nullptr;
+    const uint64_t key = RoundKey(rkind, seq);
+    auto it = rounds_.find(key);
+    if (it != rounds_.end()) {
+        std::shared_ptr<Round> rd = it->second;
+        FiberMutexGuard rg(rd->mu);
+        rd->attempt++;
+        rd->fail_error = 0;
+        rd->sends_inflight = 0;
+        if (rd->member_hash != hash) {
+            // RE-FORM: the membership changed — renumber over the
+            // survivors and restart the round from its kept input.
+            rd->members = std::move(members);
+            rd->nranks = nranks;
+            rd->my_rank = my_rank;
+            rd->member_hash = hash;
+            rd->applied.clear();
+            rd->complete = false;
+            reset_buffers(rd.get());
+            if (r != nullptr) r->reforms++;
+            *g_reforms << 1;
+        } else {
+            // Transient failure with the same membership: keep the
+            // applied set and buffer, re-issue outgoing work only
+            // (duplicates dedupe server-side). DO adopt the probe's
+            // channels — identical keys mean identical rank order, but
+            // the mesh may have replaced a reconnected peer's channel
+            // underneath the old pointer.
+            rd->members = std::move(members);
+            if (r != nullptr) r->retries++;
+            *g_retries << 1;
+        }
+        rd->cv.notify_all();
+        return rd;
+    }
+    auto rd = std::make_shared<Round>();
+    rd->rkind = rkind;
+    rd->seq = seq;
+    rd->member_hash = hash;
+    rd->nranks = nranks;
+    rd->my_rank = my_rank;
+    rd->members = std::move(members);
+    rd->attempt = 1;
+    reset_buffers(rd.get());
+    rounds_[key] = rd;
+    // GC older rounds of this family, keeping the immediate
+    // predecessor alive for late duplicate acks / straggler pulls.
+    for (auto gc = rounds_.begin(); gc != rounds_.end();) {
+        if (gc->second->rkind == rkind && gc->second->seq + 2 <= seq) {
+            gc = rounds_.erase(gc);
+        } else {
+            ++gc;
+        }
+    }
+    cv_.notify_all();  // handler fibers parked on "round not started yet"
+    return rd;
+}
+
+void CollectiveEngine::FinishRound(const std::shared_ptr<Round>& round,
+                                   int err) {
+    if (round == nullptr) return;
+    if (err == 0) {
+        FiberMutexGuard g(mu_);
+        const uint32_t fam = round->rkind & 7;
+        if (round->seq > completed_seq_[fam]) {
+            completed_seq_[fam] = round->seq;
+        }
+    }
+    FiberMutexGuard rg(round->mu);
+    if (err == 0) round->complete = true;
+    round->cv.notify_all();
+}
+
+int CollectiveEngine::WaitRound(Round* rd, uint64_t attempt,
+                                int64_t deadline_us,
+                                bool (*pred)(Round*, void*), void* arg) {
+    FiberMutexGuard g(rd->mu);
+    for (;;) {
+        if (rd->attempt != attempt) return TERR_STALE_EPOCH;
+        if (rd->fail_error != 0) return rd->fail_error;
+        if (pred(rd, arg)) return 0;
+        if (rd->cv.wait_until(rd->mu, deadline_us) == ETIMEDOUT) {
+            return TERR_RPC_TIMEDOUT;
+        }
+    }
+}
+
+// ---------------- ring all-reduce ----------------
+
+namespace {
+struct KeyWait {
+    uint64_t key;
+};
+struct KeySetWait {
+    const std::vector<uint64_t>* keys;
+    bool need_sends_drained;
+};
+bool PredKeyApplied(CollectiveEngine::Round* rd, void* arg) {
+    auto* kw = (KeyWait*)arg;
+    return rd->applied.count(kw->key) != 0;
+}
+bool PredKeysAppliedAndDrained(CollectiveEngine::Round* rd, void* arg) {
+    auto* ks = (KeySetWait*)arg;
+    if (ks->need_sends_drained && rd->sends_inflight != 0) return false;
+    for (uint64_t k : *ks->keys) {
+        if (rd->applied.count(k) == 0) return false;
+    }
+    return true;
+}
+}  // namespace
+
+int CollectiveEngine::RunRingAttempt(const std::shared_ptr<Round>& round,
+                                     int64_t attempt_deadline_us,
+                                     Result* r) {
+    uint64_t attempt;
+    uint32_t n, me;
+    uint64_t nwords;
+    {
+        FiberMutexGuard g(round->mu);
+        attempt = round->attempt;
+        n = round->nranks;
+        me = round->my_rank;
+        nwords = round->total_bytes / 4;
+    }
+    const uint32_t pred_rank = (me + n - 1) % n;
+    const uint64_t chunk_words = std::max<uint64_t>(1, opts_.chunk_bytes / 4);
+
+    for (uint32_t step = 0; step + 1 < 2 * n - 1; ++step) {
+        const uint32_t oshard = OutShard(me, step, n);
+        uint64_t w0 = 0, wn = 0;
+        ShardRange(nwords, n, oshard, &w0, &wn);
+        const uint32_t nchunks = ChunksOf(wn, chunk_words);
+        for (uint32_t c = 0; c < nchunks; ++c) {
+            if (step > 0) {
+                // The bytes about to go out were produced by the
+                // step-1 incoming chunk: wait for its application.
+                // Transfers of later chunks keep flowing meanwhile —
+                // this is the communication/compute overlap.
+                KeyWait kw{PackChunk(pred_rank, step - 1, c)};
+                const int err = WaitRound(round.get(), attempt,
+                                          attempt_deadline_us,
+                                          &PredKeyApplied, &kw);
+                if (err != 0) return err;
+            }
+            const uint64_t cw0 = w0 + (uint64_t)c * chunk_words;
+            const uint64_t clen =
+                std::min<uint64_t>(chunk_words, wn - (uint64_t)c *
+                                                         chunk_words);
+            CollWire w;
+            w.seq = round->seq;
+            w.kind = COLL_ALLREDUCE;
+            w.step = step;
+            w.chunk = c;
+            w.src_rank = me;
+            w.nranks = n;
+            w.member_hash = round->member_hash;
+            w.total_bytes = nwords * 4;
+            w.offset = cw0 * 4;
+            w.len = clen * 4;
+            SendChunkAsync(round, attempt, w, r);
+        }
+    }
+
+    // Completion: every incoming chunk of every step applied, and our
+    // own sends drained.
+    std::vector<uint64_t> expect;
+    for (uint32_t step = 0; step + 1 < 2 * n - 1; ++step) {
+        const uint32_t ishard = OutShard(pred_rank, step, n);
+        uint64_t w0 = 0, wn = 0;
+        ShardRange(nwords, n, ishard, &w0, &wn);
+        const uint32_t nchunks = ChunksOf(wn, chunk_words);
+        for (uint32_t c = 0; c < nchunks; ++c) {
+            expect.push_back(PackChunk(pred_rank, step, c));
+        }
+    }
+    KeySetWait ks{&expect, true};
+    return WaitRound(round.get(), attempt, attempt_deadline_us,
+                     &PredKeysAppliedAndDrained, &ks);
+}
+
+// ---------------- fan-out phases (ParallelChannel reuse) ----------------
+
+// One sub-call per (peer, chunk): the mapper builds the chunk request
+// (+ outbound block bytes for all-to-all, posted as pool descriptors),
+// the observer applies the reply bytes (pull/exchange payload —
+// response descriptors on capable links) into the round buffer.
+class CollectiveEngine::FanMapper : public CallMapper,
+                                    public SubCallObserver {
+public:
+    struct Item {
+        uint32_t peer_rank = 0;
+        uint32_t chunk_index = 0;  // per-block chunk ordinal (wire)
+        uint64_t off = 0;          // block-relative
+        uint64_t len = 0;
+    };
+
+    CollectiveEngine* eng = nullptr;
+    std::shared_ptr<Round> round;
+    uint64_t attempt = 0;
+    uint32_t kind = 0;
+    uint64_t block_bytes = 0;
+    std::vector<Item> items;
+    Result* res = nullptr;  // driver-fiber only (Map runs there)
+
+    SubCall Map(int channel_index, int, const
+                google::protobuf::MethodDescriptor*,
+                const google::protobuf::Message*,
+                google::protobuf::Message*) override {
+        const Item& it = items[channel_index];
+        CollWire w;
+        w.seq = round->seq;
+        w.kind = kind;
+        w.step = 0;
+        w.chunk = it.chunk_index;
+        w.src_rank = round->my_rank;
+        w.nranks = round->nranks;
+        w.member_hash = round->member_hash;
+        w.total_bytes = round->total_bytes;
+        w.offset = it.off;
+        w.len = it.len;
+        SubCall s;
+        s.method = eng->codec_->method();
+        s.request = eng->codec_->NewRequest(w);
+        s.owns_request = true;
+        s.response = eng->codec_->NewResponse();
+        s.owns_response = true;
+        s.observer = this;
+        if (kind == COLL_ALLTOALL) {
+            // Outbound block chunk for this peer rides the sub-call.
+            const char* src = round->input.data() +
+                              (size_t)(block_bytes * it.peer_rank + it.off);
+            IOBuf pbuf;
+            if (eng->opts_.pool_descriptors &&
+                IciBlockPool::AllocatePoolAttachmentCopy(
+                    src, (size_t)it.len, &pbuf)) {
+                s.request_attachment.swap(pbuf);
+                s.pool_descriptor = true;
+            } else {
+                s.request_attachment.append(src, (size_t)it.len);
+                if (res != nullptr) res->desc_fallback_chunks++;
+                *g_desc_fallbacks << 1;
+            }
+            *g_bytes << (int64_t)it.len;
+            if (res != nullptr) res->moved_bytes += it.len;
+        }
+        *g_steps << 1;
+        return s;
+    }
+
+    void OnSubCallDone(int channel_index, Controller& sub) override {
+        if (sub.Failed()) return;  // the parent's fail_limit reports it
+        const Item& it = items[channel_index];
+        const char* data = nullptr;
+        uint64_t len = 0;
+        std::string inline_copy;
+        if (sub.has_response_pool_attachment_view()) {
+            data = sub.response_pool_attachment().data;
+            len = sub.response_pool_attachment().length;
+        } else {
+            inline_copy = sub.response_attachment().to_string();
+            data = inline_copy.data();
+            len = inline_copy.size();
+        }
+        FiberMutexGuard g(round->mu);
+        if (round->attempt != attempt) return;
+        if (len != it.len) {
+            if (round->fail_error == 0) round->fail_error = TERR_RESPONSE;
+        } else {
+            memcpy(&round->buf[(size_t)(block_bytes * it.peer_rank +
+                                        it.off)],
+                   data, (size_t)len);
+        }
+        round->cv.notify_all();
+    }
+};
+
+int CollectiveEngine::RunFanoutAttempt(const std::shared_ptr<Round>& round,
+                                       uint32_t kind,
+                                       int64_t attempt_deadline_us,
+                                       Result* r) {
+    uint64_t attempt;
+    uint32_t n, me;
+    uint64_t block;
+    {
+        FiberMutexGuard g(round->mu);
+        attempt = round->attempt;
+        n = round->nranks;
+        me = round->my_rank;
+        block = round->total_bytes / n;
+    }
+    auto mapper = std::make_shared<FanMapper>();
+    mapper->eng = this;
+    mapper->round = round;
+    mapper->attempt = attempt;
+    mapper->kind = kind;
+    mapper->block_bytes = block;
+    mapper->res = r;
+    const uint64_t chunk = std::max<uint64_t>(4, opts_.chunk_bytes & ~3ull);
+    for (uint32_t p = 0; p < n; ++p) {
+        if (p == me) continue;
+        // All-to-all pairs exchange once: the LOWER rank initiates and
+        // receives the reciprocal block in the same call's response.
+        if (kind == COLL_ALLTOALL && p < me) continue;
+        uint32_t c = 0;
+        for (uint64_t off = 0; off < block; off += chunk, ++c) {
+            FanMapper::Item it;
+            it.peer_rank = p;
+            it.chunk_index = c;
+            it.off = off;
+            it.len = std::min<uint64_t>(chunk, block - off);
+            mapper->items.push_back(it);
+        }
+    }
+
+    if (!mapper->items.empty()) {
+        const int64_t remaining_ms =
+            std::max<int64_t>(1, (attempt_deadline_us -
+                                  monotonic_time_us()) / 1000);
+        ParallelChannelOptions po;
+        po.fail_limit = 1;  // any lost chunk fails the attempt -> re-form
+        po.timeout_ms = remaining_ms;
+        ParallelChannel pc(&po);
+        for (const FanMapper::Item& it : mapper->items) {
+            pc.AddChannelShared(round->members[it.peer_rank].chan.get(),
+                                mapper, nullptr);
+        }
+        std::unique_ptr<google::protobuf::Message> preq(
+            codec_->NewRequest(CollWire()));
+        std::unique_ptr<google::protobuf::Message> prsp(
+            codec_->NewResponse());
+        Controller pcntl;
+        pcntl.set_timeout_ms(remaining_ms);
+        pcntl.set_max_retry(opts_.max_chunk_retries);
+        pc.CallMethod(codec_->method(), &pcntl, preq.get(), prsp.get(),
+                      nullptr);  // sync: per-chunk funnel retries inside
+        if (pcntl.Failed()) {
+            FiberMutexGuard g(round->mu);
+            return round->fail_error != 0 ? round->fail_error
+                                          : pcntl.ErrorCode();
+        }
+        {
+            // A reply shorter than asked surfaced through the observer.
+            FiberMutexGuard g(round->mu);
+            if (round->fail_error != 0) return round->fail_error;
+            if (round->attempt != attempt) return TERR_STALE_EPOCH;
+        }
+    }
+
+    if (kind == COLL_ALLTOALL) {
+        // Lower-ranked peers initiated toward us (the lower rank of
+        // each pair drives the exchange): wait for their pushes.
+        std::vector<uint64_t> expect;
+        for (uint32_t q = 0; q < me; ++q) {
+            uint32_t c = 0;
+            for (uint64_t off = 0; off < block; off += chunk, ++c) {
+                expect.push_back(PackChunk(q, 0, c));
+            }
+        }
+        KeySetWait ks{&expect, false};
+        return WaitRound(round.get(), attempt, attempt_deadline_us,
+                         &PredKeysAppliedAndDrained, &ks);
+    }
+    return 0;
+}
+
+// ---------------- serial baseline ----------------
+
+int CollectiveEngine::RunSerialAttempt(const std::shared_ptr<Round>& round,
+                                       int64_t attempt_deadline_us,
+                                       Result* r) {
+    uint64_t attempt;
+    uint32_t n, me;
+    uint64_t total;
+    {
+        FiberMutexGuard g(round->mu);
+        attempt = round->attempt;
+        n = round->nranks;
+        me = round->my_rank;
+        total = round->total_bytes;
+    }
+    if (me == 0) {
+        // Root: every non-root pushes its whole payload (reduced by the
+        // handler), then pulls the whole result. Completion = all
+        // pushed AND all pulled — root-side serving is inside the
+        // measured window, as a serial fan-in/fan-out should be.
+        std::vector<uint64_t> expect;
+        for (uint32_t q = 1; q < n; ++q) expect.push_back(PackChunk(q, 0, 0));
+        KeySetWait ks{&expect, false};
+        int err = WaitRound(round.get(), attempt, attempt_deadline_us,
+                            &PredKeysAppliedAndDrained, &ks);
+        if (err != 0) return err;
+        {
+            FiberMutexGuard g(round->mu);
+            round->complete = true;  // pulls may now be served
+            round->cv.notify_all();
+        }
+        std::vector<uint64_t> pulls;
+        for (uint32_t q = 1; q < n; ++q) pulls.push_back(PackChunk(q, 1, 0));
+        KeySetWait ks2{&pulls, false};
+        return WaitRound(round.get(), attempt, attempt_deadline_us,
+                         &PredKeysAppliedAndDrained, &ks2);
+    }
+    // Non-root: inline push, then inline pull. Deliberately ONE
+    // unchunked, undescriptored, unpipelined call each way.
+    std::shared_ptr<google::protobuf::RpcChannel> root =
+        round->members[0].chan;
+    CollWire w;
+    w.seq = round->seq;
+    w.kind = COLL_SERIAL_PUSH;
+    w.src_rank = me;
+    w.nranks = n;
+    w.member_hash = round->member_hash;
+    w.total_bytes = total;
+    w.offset = 0;
+    w.len = total;
+    {
+        std::unique_ptr<google::protobuf::Message> req(
+            codec_->NewRequest(w));
+        std::unique_ptr<google::protobuf::Message> rsp(
+            codec_->NewResponse());
+        Controller cntl;
+        cntl.set_timeout_ms(std::max<int64_t>(
+            1, (attempt_deadline_us - monotonic_time_us()) / 1000));
+        cntl.set_max_retry(opts_.max_chunk_retries + 4);
+        cntl.request_attachment().append(round->input.data(),
+                                         round->input.size());
+        root->CallMethod(codec_->method(), &cntl, req.get(), rsp.get(),
+                         nullptr);
+        *g_steps << 1;
+        *g_bytes << (int64_t)total;
+        if (r != nullptr) r->moved_bytes += total;
+        if (cntl.Failed()) return cntl.ErrorCode();
+    }
+    w.kind = COLL_SERIAL_PULL;
+    std::unique_ptr<google::protobuf::Message> req(codec_->NewRequest(w));
+    std::unique_ptr<google::protobuf::Message> rsp(codec_->NewResponse());
+    Controller cntl;
+    cntl.set_timeout_ms(std::max<int64_t>(
+        1, (attempt_deadline_us - monotonic_time_us()) / 1000));
+    cntl.set_max_retry(opts_.max_chunk_retries + 4);
+    root->CallMethod(codec_->method(), &cntl, req.get(), rsp.get(),
+                     nullptr);
+    *g_steps << 1;
+    if (cntl.Failed()) return cntl.ErrorCode();
+    std::string result = cntl.response_attachment().to_string();
+    if (result.size() != total) return TERR_RESPONSE;
+    FiberMutexGuard g(round->mu);
+    if (round->attempt != attempt) return TERR_STALE_EPOCH;
+    round->buf.assign(result);
+    return 0;
+}
+
+// ---------------- public ops ----------------
+
+namespace {
+
+double BusbwFactor(uint32_t rkind, uint32_t n) {
+    if (rkind == COLL_ALLREDUCE || rkind == COLL_SERIAL_PUSH) {
+        return 2.0 * (n - 1) / n;
+    }
+    return (double)(n - 1) / n;
+}
+
+const char* AlgName(uint32_t rkind) {
+    switch (rkind) {
+        case COLL_ALLREDUCE:
+            return "allreduce";
+        case COLL_ALLGATHER:
+            return "allgather";
+        case COLL_ALLTOALL:
+            return "alltoall";
+        case COLL_SERIAL_PUSH:
+            return "allreduce_serial";
+        default:
+            return "unknown";
+    }
+}
+
+// Fills Result::busbw_mbps and the per-algorithm gauge — the one place
+// the busbw formula lives (drivers print Result, never re-derive).
+void RecordBusbw(uint32_t rkind, uint64_t payload_bytes,
+                 CollectiveEngine::Result* r) {
+    const double secs = r->elapsed_us / 1e6;
+    if (secs <= 0 || r->nranks < 2) return;
+    r->busbw_mbps =
+        BusbwFactor(rkind, r->nranks) * payload_bytes / secs / 1e6;
+    BusbwFamily()->get_stats({AlgName(rkind)})->set(
+        (int64_t)r->busbw_mbps);
+}
+
+}  // namespace
+
+int CollectiveEngine::AllReduce(uint64_t seq, uint32_t* words,
+                                size_t nwords, Result* r) {
+    Result local;
+    if (r == nullptr) r = &local;
+    if (words == nullptr || nwords == 0) {
+        return r->error = TERR_REQUEST;
+    }
+    const int64_t t0 = monotonic_time_us();
+    const int64_t op_deadline = t0 + opts_.op_timeout_ms * 1000;
+    const std::string input((const char*)words, nwords * 4);
+    int err = TERR_INTERNAL;
+    std::shared_ptr<Round> round;
+    for (int attempt = 0;
+         attempt < opts_.max_attempts && monotonic_time_us() < op_deadline;
+         ++attempt) {
+        std::vector<CollectiveMembership::Member> members;
+        uint32_t my_rank = 0;
+        uint64_t hash = 0;
+        if (!ProbeMembers(&members, &my_rank, &hash)) {
+            err = TERR_INTERNAL;
+            fiber_usleep(200 * 1000);  // mesh may be healing
+            continue;
+        }
+        round = GetOrCreateRound(COLL_ALLREDUCE, seq, std::move(members),
+                                 my_rank, hash, input, input.size(), r);
+        if (round == nullptr) {
+            err = TERR_CLOSE;
+            break;
+        }
+        const int64_t attempt_deadline = std::min(
+            op_deadline,
+            monotonic_time_us() + opts_.attempt_timeout_ms * 1000);
+        err = RunRingAttempt(round, attempt_deadline, r);
+        if (err == 0) break;
+        fiber_usleep(100 * 1000);
+    }
+    if (err == 0 && round != nullptr) {
+        FiberMutexGuard g(round->mu);
+        memcpy(words, round->buf.data(), nwords * 4);
+        r->nranks = round->nranks;
+        r->my_rank = round->my_rank;
+        r->member_keys.clear();
+        for (const auto& m : round->members) {
+            r->member_keys.push_back(m.key);
+        }
+    }
+    FinishRound(round, err);
+    r->error = err;
+    r->elapsed_us = monotonic_time_us() - t0;
+    if (err == 0) {
+        *g_ops << 1;
+        RecordBusbw(COLL_ALLREDUCE, nwords * 4, r);
+    }
+    return err;
+}
+
+int CollectiveEngine::AllGather(uint64_t seq, const void* mine,
+                                size_t my_bytes, std::string* out,
+                                Result* r) {
+    Result local;
+    if (r == nullptr) r = &local;
+    if (mine == nullptr || my_bytes == 0 || out == nullptr) {
+        return r->error = TERR_REQUEST;
+    }
+    const int64_t t0 = monotonic_time_us();
+    const int64_t op_deadline = t0 + opts_.op_timeout_ms * 1000;
+    const std::string input((const char*)mine, my_bytes);
+    int err = TERR_INTERNAL;
+    std::shared_ptr<Round> round;
+    for (int attempt = 0;
+         attempt < opts_.max_attempts && monotonic_time_us() < op_deadline;
+         ++attempt) {
+        std::vector<CollectiveMembership::Member> members;
+        uint32_t my_rank = 0;
+        uint64_t hash = 0;
+        if (!ProbeMembers(&members, &my_rank, &hash)) {
+            err = TERR_INTERNAL;
+            fiber_usleep(200 * 1000);
+            continue;
+        }
+        round = GetOrCreateRound(COLL_ALLGATHER, seq, std::move(members),
+                                 my_rank, hash, input, my_bytes, r);
+        if (round == nullptr) {
+            err = TERR_CLOSE;
+            break;
+        }
+        const int64_t attempt_deadline = std::min(
+            op_deadline,
+            monotonic_time_us() + opts_.attempt_timeout_ms * 1000);
+        err = RunFanoutAttempt(round, COLL_ALLGATHER, attempt_deadline, r);
+        if (err == 0) break;
+        fiber_usleep(100 * 1000);
+    }
+    uint64_t total = 0;
+    if (err == 0 && round != nullptr) {
+        FiberMutexGuard g(round->mu);
+        out->assign(round->buf);
+        total = round->total_bytes;
+        r->nranks = round->nranks;
+        r->my_rank = round->my_rank;
+        r->member_keys.clear();
+        for (const auto& m : round->members) {
+            r->member_keys.push_back(m.key);
+        }
+    }
+    FinishRound(round, err);
+    r->error = err;
+    r->elapsed_us = monotonic_time_us() - t0;
+    if (err == 0) {
+        *g_ops << 1;
+        RecordBusbw(COLL_ALLGATHER, total, r);
+    }
+    return err;
+}
+
+int CollectiveEngine::AllToAll(
+    uint64_t seq, const std::map<uint64_t, std::string>& blocks_by_key,
+    size_t block_bytes, std::string* out, Result* r) {
+    Result local;
+    if (r == nullptr) r = &local;
+    if (block_bytes == 0 || out == nullptr) {
+        return r->error = TERR_REQUEST;
+    }
+    const int64_t t0 = monotonic_time_us();
+    const int64_t op_deadline = t0 + opts_.op_timeout_ms * 1000;
+    int err = TERR_INTERNAL;
+    std::shared_ptr<Round> round;
+    for (int attempt = 0;
+         attempt < opts_.max_attempts && monotonic_time_us() < op_deadline;
+         ++attempt) {
+        std::vector<CollectiveMembership::Member> members;
+        uint32_t my_rank = 0;
+        uint64_t hash = 0;
+        if (!ProbeMembers(&members, &my_rank, &hash)) {
+            err = TERR_INTERNAL;
+            fiber_usleep(200 * 1000);
+            continue;
+        }
+        // Outbound blocks in the (possibly re-formed) rank order; keyed
+        // by member identity so survivors keep their intended payloads.
+        std::string input;
+        input.reserve(block_bytes * members.size());
+        bool missing = false;
+        for (const auto& m : members) {
+            auto it = blocks_by_key.find(m.key);
+            if (it == blocks_by_key.end() ||
+                it->second.size() != block_bytes) {
+                missing = true;
+                break;
+            }
+            input.append(it->second);
+        }
+        if (missing) {
+            err = TERR_REQUEST;
+            break;
+        }
+        round = GetOrCreateRound(COLL_ALLTOALL, seq, std::move(members),
+                                 my_rank, hash, input, block_bytes, r);
+        if (round == nullptr) {
+            err = TERR_CLOSE;
+            break;
+        }
+        const int64_t attempt_deadline = std::min(
+            op_deadline,
+            monotonic_time_us() + opts_.attempt_timeout_ms * 1000);
+        err = RunFanoutAttempt(round, COLL_ALLTOALL, attempt_deadline, r);
+        if (err == 0) break;
+        fiber_usleep(100 * 1000);
+    }
+    uint64_t total = 0;
+    if (err == 0 && round != nullptr) {
+        FiberMutexGuard g(round->mu);
+        out->assign(round->buf);
+        total = round->total_bytes;
+        r->nranks = round->nranks;
+        r->my_rank = round->my_rank;
+        r->member_keys.clear();
+        for (const auto& m : round->members) {
+            r->member_keys.push_back(m.key);
+        }
+    }
+    FinishRound(round, err);
+    r->error = err;
+    r->elapsed_us = monotonic_time_us() - t0;
+    if (err == 0) {
+        *g_ops << 1;
+        RecordBusbw(COLL_ALLTOALL, total, r);
+    }
+    return err;
+}
+
+int CollectiveEngine::SerialAllReduce(uint64_t seq, uint32_t* words,
+                                      size_t nwords, Result* r) {
+    Result local;
+    if (r == nullptr) r = &local;
+    if (words == nullptr || nwords == 0) {
+        return r->error = TERR_REQUEST;
+    }
+    const int64_t t0 = monotonic_time_us();
+    const int64_t op_deadline = t0 + opts_.op_timeout_ms * 1000;
+    const std::string input((const char*)words, nwords * 4);
+    int err = TERR_INTERNAL;
+    std::shared_ptr<Round> round;
+    for (int attempt = 0;
+         attempt < opts_.max_attempts && monotonic_time_us() < op_deadline;
+         ++attempt) {
+        std::vector<CollectiveMembership::Member> members;
+        uint32_t my_rank = 0;
+        uint64_t hash = 0;
+        if (!ProbeMembers(&members, &my_rank, &hash)) {
+            err = TERR_INTERNAL;
+            fiber_usleep(200 * 1000);
+            continue;
+        }
+        round = GetOrCreateRound(COLL_SERIAL_PUSH, seq, std::move(members),
+                                 my_rank, hash, input, input.size(), r);
+        if (round == nullptr) {
+            err = TERR_CLOSE;
+            break;
+        }
+        const int64_t attempt_deadline = std::min(
+            op_deadline,
+            monotonic_time_us() + opts_.attempt_timeout_ms * 1000);
+        err = RunSerialAttempt(round, attempt_deadline, r);
+        if (err == 0) break;
+        fiber_usleep(100 * 1000);
+    }
+    if (err == 0 && round != nullptr) {
+        FiberMutexGuard g(round->mu);
+        memcpy(words, round->buf.data(), nwords * 4);
+        r->nranks = round->nranks;
+        r->my_rank = round->my_rank;
+        r->member_keys.clear();
+        for (const auto& m : round->members) {
+            r->member_keys.push_back(m.key);
+        }
+    }
+    FinishRound(round, err);
+    r->error = err;
+    r->elapsed_us = monotonic_time_us() - t0;
+    if (err == 0) {
+        *g_ops << 1;
+        RecordBusbw(COLL_SERIAL_PUSH, nwords * 4, r);
+    }
+    return err;
+}
+
+// ---------------- server side ----------------
+
+int CollectiveEngine::HandleIncoming(const CollWire& w, const char* data,
+                                     size_t len, IOBuf* reply,
+                                     int64_t wait_budget_us,
+                                     int64_t* backoff_ms, int* applied) {
+    *applied = 0;
+    *backoff_ms = 0;
+    const uint32_t rkind = RoundFamily(w.kind);
+    if (rkind == 0 || w.nranks < 2 || w.src_rank >= w.nranks) {
+        return TERR_REQUEST;
+    }
+    // Record the mesh's round position even for chunks we can't serve
+    // yet — a rejoining node fast-forwards its own driver from this.
+    uint64_t prev = observed_seq_.load(std::memory_order_relaxed);
+    while (w.seq > prev && !observed_seq_.compare_exchange_weak(
+                               prev, w.seq, std::memory_order_relaxed)) {
+    }
+    // Park up to handler_wait_ms, bounded by the caller's remaining
+    // budget; an expired budget (<= 0) means answer NOW — parking for
+    // a caller that already timed out only amplifies the skew.
+    int64_t wait_us = opts_.handler_wait_ms * 1000;
+    if (wait_budget_us < wait_us) wait_us = wait_budget_us;
+    if (wait_us < 0) wait_us = 0;
+    const int64_t deadline_us = monotonic_time_us() + wait_us;
+    const uint64_t key = RoundKey(rkind, w.seq);
+    std::shared_ptr<Round> round;
+    {
+        FiberMutexGuard g(mu_);
+        for (;;) {
+            if (shutdown_) return TERR_CLOSE;
+            auto it = rounds_.find(key);
+            if (it != rounds_.end()) {
+                round = it->second;
+                break;
+            }
+            if (w.seq <= completed_seq_[rkind & 7]) {
+                // Round completed and collected. Pushes are duplicates
+                // of applied work; pulls can no longer be served (the
+                // input is gone) — the straggler re-forms upstream.
+                if (w.kind == COLL_ALLGATHER ||
+                    w.kind == COLL_SERIAL_PULL) {
+                    *backoff_ms = 20;
+                    return TERR_OVERLOAD;
+                }
+                *applied = 2;
+                return 0;
+            }
+            // We have not started this round yet: park briefly for our
+            // driver, then push the skew back through the retry funnel.
+            if (cv_.wait_until(mu_, deadline_us) == ETIMEDOUT) {
+                *backoff_ms = 25;
+                return TERR_OVERLOAD;
+            }
+        }
+    }
+
+    FiberMutexGuard g(round->mu);
+    if (round->member_hash != w.member_hash ||
+        round->nranks != w.nranks) {
+        // Divergent membership views: retriable — both sides converge
+        // on the survivor set through their own failure detection.
+        return TERR_STALE_EPOCH;
+    }
+    if (round->total_bytes != w.total_bytes) {
+        return TERR_REQUEST;
+    }
+    const uint64_t block =
+        round->nranks != 0 ? round->total_bytes / round->nranks : 0;
+
+    switch (w.kind) {
+        case COLL_ALLREDUCE: {
+            if (w.offset % 4 != 0 || w.len % 4 != 0 ||
+                w.offset > round->total_bytes ||
+                w.len > round->total_bytes - w.offset || len != w.len) {
+                return TERR_REQUEST;
+            }
+            const uint64_t k = PackChunk(w.src_rank, w.step, w.chunk);
+            if (round->applied.count(k) != 0) {
+                *applied = 2;
+                return 0;
+            }
+            char* dst = &round->buf[(size_t)w.offset];
+            if (w.step + 1 < round->nranks) {
+                AddWordsWraparound(dst, data, (size_t)w.len);  // reduce
+            } else {
+                memcpy(dst, data, (size_t)w.len);  // all-gather phase
+            }
+            round->applied.insert(k);
+            round->cv.notify_all();
+            *applied = 1;
+            return 0;
+        }
+        case COLL_ALLGATHER: {
+            if (w.offset > round->input.size() ||
+                w.len > round->input.size() - w.offset ||
+                reply == nullptr) {
+                return TERR_REQUEST;
+            }
+            const char* src = round->input.data() + (size_t)w.offset;
+            if (!opts_.pool_descriptors ||
+                !IciBlockPool::AllocatePoolAttachmentCopy(
+                    src, (size_t)w.len, reply)) {
+                reply->append(src, (size_t)w.len);
+            }
+            *applied = 1;
+            return 0;
+        }
+        case COLL_ALLTOALL: {
+            if (w.offset > block || w.len > block - w.offset ||
+                len != w.len || w.src_rank == round->my_rank ||
+                reply == nullptr) {
+                return TERR_REQUEST;
+            }
+            const uint64_t k = PackChunk(w.src_rank, 0, w.chunk);
+            if (round->applied.count(k) == 0) {
+                memcpy(&round->buf[(size_t)(block * w.src_rank +
+                                            w.offset)],
+                       data, (size_t)w.len);
+                round->applied.insert(k);
+                round->cv.notify_all();
+                *applied = 1;
+            } else {
+                *applied = 2;
+            }
+            // Reply with the reciprocal chunk of OUR block for the
+            // caller — the response-direction descriptor of the pair
+            // exchange.
+            const char* src = round->input.data() +
+                              (size_t)(block * w.src_rank + w.offset);
+            if (!opts_.pool_descriptors ||
+                !IciBlockPool::AllocatePoolAttachmentCopy(
+                    src, (size_t)w.len, reply)) {
+                reply->append(src, (size_t)w.len);
+            }
+            return 0;
+        }
+        case COLL_SERIAL_PUSH: {
+            if (round->my_rank != 0 || w.len != round->total_bytes ||
+                len != w.len) {
+                return TERR_REQUEST;
+            }
+            const uint64_t k = PackChunk(w.src_rank, 0, 0);
+            if (round->applied.count(k) != 0) {
+                *applied = 2;
+                return 0;
+            }
+            AddWordsWraparound(&round->buf[0], data, (size_t)len);
+            round->applied.insert(k);
+            round->cv.notify_all();
+            *applied = 1;
+            return 0;
+        }
+        case COLL_SERIAL_PULL: {
+            if (round->my_rank != 0 || reply == nullptr ||
+                w.offset > round->total_bytes ||
+                w.len > round->total_bytes - w.offset) {
+                return TERR_REQUEST;
+            }
+            // The result is only servable once every push reduced in.
+            while (!round->complete) {
+                if (round->fail_error != 0) return round->fail_error;
+                if (round->cv.wait_until(round->mu, deadline_us) ==
+                    ETIMEDOUT) {
+                    *backoff_ms = 25;
+                    return TERR_OVERLOAD;
+                }
+            }
+            // Serial baseline stays inline by design.
+            reply->append(round->buf.data() + (size_t)w.offset,
+                          (size_t)w.len);
+            round->applied.insert(PackChunk(w.src_rank, 1, 0));
+            round->cv.notify_all();
+            *applied = 1;
+            return 0;
+        }
+        default:
+            return TERR_REQUEST;
+    }
+}
+
+// ---------------- helpers ----------------
+
+void CollectiveEngine::ExposeVars() {
+    *g_ops << 0;
+    *g_steps << 0;
+    *g_retries << 0;
+    *g_reforms << 0;
+    *g_bytes << 0;
+    *g_desc_fallbacks << 0;
+    BusbwFamily()->get_stats({"allreduce"});
+    BusbwFamily()->get_stats({"allgather"});
+    BusbwFamily()->get_stats({"alltoall"});
+    BusbwFamily()->get_stats({"allreduce_serial"});
+}
+
+void CollectiveEngine::FillDeterministic(uint64_t seq, uint64_t key,
+                                         uint32_t* w, size_t n) {
+    const uint32_t a = 0x9E3779B1u * (uint32_t)seq;
+    const uint32_t b = 0x85EBCA77u * (uint32_t)key;
+    for (size_t i = 0; i < n; ++i) {
+        w[i] = a + b + 0xC2B2AE35u * (uint32_t)i;
+    }
+}
+
+uint32_t CollectiveEngine::Checksum(const uint32_t* w, size_t n) {
+    // Twin of brpc_tpu.parallel.collective_echo._adler_frame_checksum:
+    // interleaved 16-bit halves, uint32 WRAPAROUND cumulative sum, then
+    // the two mod-65521 reductions. The wraparound is part of the
+    // definition — both sides must compute it identically.
+    const uint32_t kMod = 65521;
+    uint32_t s1 = 0;          // wrapping cumsum
+    uint64_t b_acc = 0;       // sum of (s1 % kMod), reduced at the end
+    for (size_t i = 0; i < n; ++i) {
+        const uint32_t lo = w[i] & 0xFFFFu;
+        const uint32_t hi = w[i] >> 16;
+        s1 += lo;
+        b_acc += s1 % kMod;
+        s1 += hi;
+        b_acc += s1 % kMod;
+    }
+    const uint32_t a = s1 % kMod;
+    const uint32_t b = (uint32_t)(b_acc % kMod);
+    return (b << 16) | a;
+}
+
+}  // namespace tpurpc

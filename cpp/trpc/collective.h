@@ -1,0 +1,279 @@
+// Mesh collectives on the descriptor path (ISSUE 13): all-reduce,
+// all-gather and all-to-all across the process mesh, scheduled as
+// chunked descriptor pipelines per T3 (arXiv:2401.16677) and the MLPerf
+// TPU-pod scaling study (arXiv:1909.09756).
+//
+// Shape of the subsystem:
+//  - payloads are split into slab-class chunks
+//    (IciBlockPool::AllocatePoolAttachmentCopy); every schedule step
+//    posts its chunk as a one-sided request PoolDescriptor and — for
+//    the pull-shaped exchanges — receives the peer's bytes as a
+//    response descriptor, so zero payload bytes cross inline on
+//    descriptor-capable links (the Transport seam degrades tcp peers
+//    to inline transparently).
+//  - all-reduce runs the classic chunked ring (reduce-scatter then
+//    all-gather, 2(N-1) steps): in steady state the reduce-compute of
+//    chunk i (in the receiving handler) overlaps the descriptor
+//    transfer of chunk i+1. A serial root fan-in/fan-out baseline
+//    (SerialAllReduce) is kept for the pipelined-vs-serial bench gate.
+//  - all-gather and all-to-all are fan-outs and REUSE ParallelChannel
+//    (combo_channels.h) — one sub-call per (peer, chunk), chunk bytes
+//    riding the new SubCall attachment extension, replies applied
+//    through the new SubCallObserver hook.
+//  - a failed step retries through the existing funnel (the chunk RPCs
+//    are plain Channel calls: retry budget, TERR_OVERLOAD backoff,
+//    TERR_STALE_EPOCH, peer-death reclamation of pinned chunks all
+//    already work); when a member dies the collective RE-FORMS over
+//    the survivors (membership re-probed, ranks renumbered, the round
+//    restarted from its kept input) instead of hanging.
+//
+// Concurrency contract: driver calls (AllReduce/...) block the calling
+// fiber; the server-side HandleIncoming runs on handler fibers and may
+// park briefly (bounded) waiting for the local round to catch up —
+// answering retriable TERR_OVERLOAD (+suggested backoff) when it
+// doesn't, so cross-node round skew resolves through the retry funnel
+// rather than unbounded buffering.
+#pragma once
+
+#include <google/protobuf/service.h>
+
+#include <atomic>
+#include <cstdint>
+#include <map>
+#include <memory>
+#include <string>
+#include <vector>
+
+#include "tbase/iobuf.h"
+#include "tfiber/fiber_sync.h"
+
+namespace tpurpc {
+
+class Controller;
+
+// Wire metadata of one collective chunk RPC (mirrors
+// benchpb.CollChunk; the engine is payload-proto-agnostic — the host
+// tool's CollectiveCodec translates).
+struct CollWire {
+    uint64_t seq = 0;          // round number (per collective program)
+    uint32_t kind = 0;         // CollKind
+    uint32_t step = 0;         // ring step / serial phase
+    uint32_t chunk = 0;        // chunk index within the step's shard
+    uint32_t src_rank = 0;     // sender's rank in the round's membership
+    uint32_t nranks = 0;
+    uint64_t member_hash = 0;  // hash of the sorted member keys
+    uint64_t total_bytes = 0;  // round payload size (per-kind meaning)
+    uint64_t offset = 0;       // byte offset (per-kind: absolute / in-block)
+    uint64_t len = 0;          // chunk byte length
+};
+
+enum CollKind : uint32_t {
+    // Ring push: payload chunk reduced (uint32 wraparound sum) into the
+    // receiver's round buffer at the ABSOLUTE offset; steps >= nranks-1
+    // are the all-gather phase (copy, not reduce).
+    COLL_ALLREDUCE = 1,
+    // Pull: no payload; the reply carries bytes [offset, offset+len) of
+    // the server's own input block (offset is block-relative).
+    COLL_ALLGATHER = 2,
+    // Pairwise exchange (lower rank initiates): payload = the caller's
+    // block-for-me chunk (applied at buf[src_rank*block + offset]); the
+    // reply carries my block-for-the-caller chunk from the same offsets.
+    COLL_ALLTOALL = 3,
+    // Serial baseline, deliberately unpipelined and inline: whole
+    // payload pushed to rank 0 in one call...
+    COLL_SERIAL_PUSH = 4,
+    // ...and the whole reduced result pulled back in one call (the
+    // reply waits for the root's reduction to complete).
+    COLL_SERIAL_PULL = 5,
+};
+
+// Membership probe: the host tool owns link liveness (mesh_node's peer
+// links; tests use static lists). GetMembers returns every CURRENTLY
+// live member including self; the engine sorts by `key` to assign
+// ranks, so all nodes probing the same live set agree on numbering.
+// Keys must be unique and stable per node (mesh: the listen port).
+// Channels ride shared_ptr because the mesh replaces a peer's channel
+// on reconnect — a round holds the channels it was formed over alive
+// until its in-flight chunk calls settle.
+class CollectiveMembership {
+public:
+    struct Member {
+        uint64_t key = 0;
+        std::shared_ptr<google::protobuf::RpcChannel> chan;  // null = self
+        bool self = false;
+    };
+    virtual ~CollectiveMembership() = default;
+    virtual void GetMembers(std::vector<Member>* out) = 0;
+};
+
+// Payload-proto bridge: builds/reads the host's chunk request/response
+// messages (benchpb.CollChunk/CollAck in the mesh tools). Must be
+// thread-safe; messages returned by New* are owned by the engine call.
+class CollectiveCodec {
+public:
+    virtual ~CollectiveCodec() = default;
+    virtual const google::protobuf::MethodDescriptor* method() const = 0;
+    virtual google::protobuf::Message* NewRequest(const CollWire& w)
+        const = 0;
+    virtual google::protobuf::Message* NewResponse() const = 0;
+};
+
+struct CollectiveOptions {
+    // Pipeline chunk size; slab-class sized so chunk buffers recycle
+    // through the per-thread slab caches (ISSUE 9c).
+    size_t chunk_bytes = 256 << 10;
+    // Per-chunk RPC deadline and channel-funnel retries.
+    int64_t step_timeout_ms = 2000;
+    int max_chunk_retries = 3;
+    // Whole-round attempt budget: a failed attempt re-probes membership
+    // and either re-forms (membership changed) or retries (transient).
+    // Deliberately generous — op_timeout_ms is the real bound; attempts
+    // into a dead-but-not-yet-noticed peer fail in microseconds (the
+    // peer-death lease reclamation turns them into instant
+    // TERR_STALE_EPOCH), and the collective must survive that churn
+    // until the membership view converges.
+    int max_attempts = 100;
+    int64_t attempt_timeout_ms = 6000;
+    int64_t op_timeout_ms = 30000;
+    // How long HandleIncoming parks for the local round to catch up
+    // before answering retriable TERR_OVERLOAD (bounded additionally by
+    // the caller-provided wait budget).
+    int64_t handler_wait_ms = 700;
+    // Post chunks as one-sided pool descriptors (ineligible buffers /
+    // transports fall back inline and are counted).
+    bool pool_descriptors = true;
+};
+
+class CollectiveEngine {
+public:
+    // Opaque per-round state (defined in collective.cc; public only so
+    // the file-local wait predicates can name it).
+    struct Round;
+
+    struct Result {
+        int error = 0;
+        uint32_t nranks = 0;
+        uint32_t my_rank = 0;
+        uint64_t moved_bytes = 0;  // payload bytes this rank pushed
+        int64_t elapsed_us = 0;
+        int retries = 0;           // same-membership attempt re-runs
+        int reforms = 0;           // membership-changed restarts
+        uint64_t desc_fallback_chunks = 0;  // chunks that went inline
+        // NCCL-style bus bandwidth of the completed round (also set on
+        // the rpc_collective_busbw_mbps{alg} gauge) — computed HERE so
+        // drivers and the bench report the same number the same way.
+        double busbw_mbps = 0.0;
+        std::vector<uint64_t> member_keys;  // membership of the
+                                            // completed round, rank order
+    };
+
+    // `membership` and `codec` are borrowed and must outlive the engine.
+    CollectiveEngine(CollectiveMembership* membership,
+                     CollectiveCodec* codec, const CollectiveOptions& opts);
+    ~CollectiveEngine();
+
+    // Chunked-pipelined ring all-reduce (uint32 wraparound sum),
+    // in-place. Blocks the calling fiber. Returns 0 or a TERR_* code
+    // (also in r->error).
+    int AllReduce(uint64_t seq, uint32_t* words, size_t nwords, Result* r);
+
+    // Pull-based chunked all-gather: contributes `my_bytes` bytes,
+    // fills *out with nranks blocks in rank order.
+    int AllGather(uint64_t seq, const void* mine, size_t my_bytes,
+                  std::string* out, Result* r);
+
+    // Pairwise-exchange all-to-all: `blocks_by_key` maps every possible
+    // member key to the block (all equal `block_bytes`) destined for
+    // that member; *out receives the blocks the members sent to this
+    // rank, in rank order. Keyed by member key (not rank) so a re-form
+    // re-selects the right blocks for the surviving membership.
+    int AllToAll(uint64_t seq,
+                 const std::map<uint64_t, std::string>& blocks_by_key,
+                 size_t block_bytes, std::string* out, Result* r);
+
+    // Serial unpipelined baseline (inline fan-in to rank 0 + fan-out):
+    // same result contract as AllReduce, measured by the same driver —
+    // the denominator of the bench's pipelined-vs-serial ratio.
+    int SerialAllReduce(uint64_t seq, uint32_t* words, size_t nwords,
+                        Result* r);
+
+    // Server side: apply/serve one incoming chunk. `reply` (may be
+    // null for push-only kinds) receives pull/exchange payload bytes in
+    // a descriptor-eligible buffer when possible. `wait_budget_us` is
+    // the caller's remaining deadline budget: parking for round skew is
+    // bounded by min(it, handler_wait_ms), and a non-positive value
+    // answers immediately (expired caller). Returns 0 (see *applied:
+    // 1 = newly applied, 2 = duplicate) or a TERR_* code the caller
+    // maps onto the response (*backoff_ms rides TERR_OVERLOAD).
+    int HandleIncoming(const CollWire& w, const char* data, size_t len,
+                       IOBuf* reply, int64_t wait_budget_us,
+                       int64_t* backoff_ms, int* applied);
+
+    // Unblock every parked driver and handler (server teardown).
+    void Shutdown();
+
+    // Highest round seq seen on the wire (any kind). A node that
+    // (re)joins a running mesh adopts this as its next round instead of
+    // restarting from 1 — the rejoin path of the continuous-traffic
+    // soak (peers mid-round N would otherwise wait on a node driving
+    // round 1 and vice versa).
+    uint64_t ObservedSeq() const {
+        return observed_seq_.load(std::memory_order_relaxed);
+    }
+
+    // Touch the rpc_collective_* counters + per-algorithm
+    // rpc_collective_busbw_mbps{alg=...} family so they exist 0-valued
+    // from the first /metrics scrape.
+    static void ExposeVars();
+
+    // Deterministic payload + integrity helpers shared by the drivers
+    // and the cross-language validation (tests/test_collectives.py
+    // re-derives both in numpy/JAX):
+    //   word(i) = 0x9E3779B1*seq + 0x85EBCA77*key + 0xC2B2AE35*i  (u32)
+    static void FillDeterministic(uint64_t seq, uint64_t key, uint32_t* w,
+                                  size_t n);
+    // Adler-style order-sensitive checksum over uint32 words, identical
+    // (incl. uint32 cumsum wraparound) to
+    // brpc_tpu.parallel.collective_echo._adler_frame_checksum.
+    static uint32_t Checksum(const uint32_t* w, size_t n);
+
+private:
+    struct SendCtx;
+    friend struct SendCtx;
+    class FanMapper;
+    friend class FanMapper;
+
+    // Probe + sort the live membership; false when a collective is not
+    // currently possible (fewer than 2 live members, or self missing).
+    bool ProbeMembers(std::vector<CollectiveMembership::Member>* members,
+                      uint32_t* my_rank, uint64_t* hash);
+    std::shared_ptr<Round> GetOrCreateRound(
+        uint32_t rkind, uint64_t seq,
+        std::vector<CollectiveMembership::Member>&& members,
+        uint32_t my_rank, uint64_t hash, const std::string& input,
+        size_t base_bytes, Result* r);
+    void FinishRound(const std::shared_ptr<Round>& round, int err);
+    int RunRingAttempt(const std::shared_ptr<Round>& round,
+                       int64_t attempt_deadline_us, Result* r);
+    int RunFanoutAttempt(const std::shared_ptr<Round>& round, uint32_t kind,
+                         int64_t attempt_deadline_us, Result* r);
+    int RunSerialAttempt(const std::shared_ptr<Round>& round,
+                         int64_t attempt_deadline_us, Result* r);
+    void SendChunkAsync(const std::shared_ptr<Round>& round,
+                        uint64_t attempt, const CollWire& w, Result* r);
+    static int WaitRound(Round* rd, uint64_t attempt, int64_t deadline_us,
+                         bool (*pred)(Round*, void*), void* arg);
+
+    CollectiveMembership* membership_;
+    CollectiveCodec* codec_;
+    CollectiveOptions opts_;
+
+    FiberMutex mu_;  // rounds_ + watermarks + shutdown flag
+    FiberCond cv_;   // signaled on round creation / shutdown
+    std::map<uint64_t, std::shared_ptr<Round>> rounds_;
+    uint64_t completed_seq_[8] = {0, 0, 0, 0, 0, 0, 0, 0};
+    std::atomic<uint64_t> observed_seq_{0};
+    bool shutdown_ = false;
+};
+
+}  // namespace tpurpc

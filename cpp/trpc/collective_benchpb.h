@@ -1,0 +1,111 @@
+// Header-only benchpb glue for the collective engine: the codec and
+// the Exchange-handler body shared by every TU that compiles the
+// generated bench_echo.pb.h (tools/mesh_node.cc, the tcollective test
+// mesh). Header-only on purpose — libtpurpc does not build the tools
+// proto, so this cannot live in a trpc .cc; keeping it in ONE place
+// keeps the wire-glue contract (which kinds answer inline vs as
+// response descriptors, the backoff mapping, the attachment-view
+// selection) from diverging between the tool and the test meshes.
+#pragma once
+
+#include <string>
+
+#include "bench_echo.pb.h"
+#include "tbase/errno.h"
+#include "trpc/collective.h"
+#include "trpc/controller.h"
+
+namespace tpurpc {
+
+class BenchpbCollCodec : public CollectiveCodec {
+public:
+    const google::protobuf::MethodDescriptor* method() const override {
+        return benchpb::CollectiveService::descriptor()->method(0);
+    }
+    google::protobuf::Message* NewRequest(const CollWire& w) const override {
+        auto* req = new benchpb::CollChunk;
+        req->set_coll_seq(w.seq);
+        req->set_kind(w.kind);
+        req->set_step(w.step);
+        req->set_chunk(w.chunk);
+        req->set_src_rank(w.src_rank);
+        req->set_nranks(w.nranks);
+        req->set_member_hash(w.member_hash);
+        req->set_total_bytes(w.total_bytes);
+        req->set_offset(w.offset);
+        req->set_len(w.len);
+        return req;
+    }
+    google::protobuf::Message* NewResponse() const override {
+        return new benchpb::CollAck;
+    }
+};
+
+// The body of CollectiveService::Exchange: decode the wire meta, pick
+// the payload view (resolved one-sided descriptor, else inline bytes),
+// hand it to the engine (which may park briefly for round skew), and
+// route the reply — pull/exchange payloads as response-direction
+// descriptors (transparent inline fallback), the serial baseline
+// inline by design. Runs done->Run() on every path.
+inline void HandleCollectiveExchange(CollectiveEngine* eng,
+                                     Controller* cntl,
+                                     const benchpb::CollChunk* req,
+                                     benchpb::CollAck* res,
+                                     google::protobuf::Closure* done) {
+    if (eng == nullptr) {
+        cntl->SetFailed(TERR_NO_METHOD, "collectives not enabled");
+        done->Run();
+        return;
+    }
+    CollWire w;
+    w.seq = req->coll_seq();
+    w.kind = req->kind();
+    w.step = req->step();
+    w.chunk = req->chunk();
+    w.src_rank = req->src_rank();
+    w.nranks = req->nranks();
+    w.member_hash = req->member_hash();
+    w.total_bytes = req->total_bytes();
+    w.offset = req->offset();
+    w.len = req->len();
+    const char* data = nullptr;
+    size_t len = 0;
+    std::string inline_copy;
+    if (cntl->has_request_pool_attachment_view()) {
+        data = cntl->request_pool_attachment().data;
+        len = (size_t)cntl->request_pool_attachment().length;
+    } else if (!cntl->request_attachment().empty()) {
+        inline_copy = cntl->request_attachment().to_string();
+        data = inline_copy.data();
+        len = inline_copy.size();
+    }
+    // Park at most until shortly before the caller's budget expires;
+    // an already-expired budget goes through non-positive, which the
+    // engine treats as "answer immediately" (never burn a handler
+    // fiber waiting on behalf of a caller that gave up).
+    int64_t wait_us = cntl->remaining_server_budget_us();
+    if (wait_us > 100 * 1000) wait_us -= 100 * 1000;  // reply margin
+    IOBuf reply;
+    int64_t backoff_ms = 0;
+    int applied = 0;
+    const int err = eng->HandleIncoming(w, data, len, &reply, wait_us,
+                                        &backoff_ms, &applied);
+    if (err != 0) {
+        if (backoff_ms > 0) cntl->set_suggested_backoff_ms(backoff_ms);
+        cntl->SetFailed(err, "collective chunk (kind=%u step=%u): %d",
+                        w.kind, w.step, err);
+        done->Run();
+        return;
+    }
+    res->set_applied(applied);
+    if (!reply.empty()) {
+        if (w.kind == COLL_SERIAL_PULL) {
+            cntl->response_attachment().append(std::move(reply));
+        } else {
+            cntl->set_response_pool_attachment(std::move(reply));
+        }
+    }
+    done->Run();
+}
+
+}  // namespace tpurpc

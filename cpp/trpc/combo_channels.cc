@@ -10,12 +10,67 @@
 #include <memory>
 
 #include "tbase/errno.h"
+#include "tbase/flags.h"
 #include "tbase/logging.h"
+#include "tbase/time.h"
 #include "tfiber/fiber_sync.h"
 #include "trpc/controller.h"
 #include "trpc/naming_service.h"
+#include "trpc/server_call.h"
+
+// The channel-wide retry-budget defaults (defined in channel.cc);
+// SelectiveChannel's cross-channel retry loop draws on the same knobs.
+DECLARE_int32(rpc_retry_budget_tokens);
+DECLARE_double(rpc_retry_budget_ratio);
 
 namespace tpurpc {
+
+namespace {
+
+// Sub-call context inheritance (ISSUE 13 satellite): combo-channel
+// sub-calls carry the PARENT controller's QoS identity and run under
+// the parent's remaining deadline, exactly like Channel::CallMethod
+// child calls. deadline_us = 0 means "parent set no deadline".
+void InheritSubCallContext(Controller* parent, Controller* sub,
+                           int64_t parent_deadline_us,
+                           int64_t fallback_timeout_ms) {
+    int64_t timeout_ms = fallback_timeout_ms;
+    if (parent_deadline_us > 0) {
+        const int64_t remaining_ms =
+            (parent_deadline_us - monotonic_time_us()) / 1000;
+        // Floor at 1ms (the live-budget floor the deadline stamp uses):
+        // an already-expired parent still issues and fails fast through
+        // the normal expiry path instead of hanging deadline-less.
+        timeout_ms = remaining_ms > 1 ? remaining_ms : 1;
+    }
+    sub->set_timeout_ms(timeout_ms);
+    if (!parent->tenant().empty() && sub->tenant().empty()) {
+        sub->set_tenant(parent->tenant());
+    }
+    if (parent->has_priority() && !sub->has_priority()) {
+        sub->set_priority(parent->priority());
+    }
+}
+
+// The parent call's own absolute deadline: its timeout (or the combo
+// option default), capped at the upstream server call's remaining
+// budget when issued inside a handler (PR-2 inheritance).
+int64_t ComboDeadlineUs(Controller* cntl, int64_t default_timeout_ms) {
+    const int64_t timeout_ms =
+        cntl->timeout_ms() >= 0 ? cntl->timeout_ms() : default_timeout_ms;
+    int64_t deadline_us =
+        timeout_ms > 0 ? monotonic_time_us() + timeout_ms * 1000 : 0;
+    Controller* up = CurrentServerCall();
+    if (up != nullptr && up->has_server_deadline()) {
+        const int64_t upstream = up->server_deadline_us();
+        if (deadline_us == 0 || upstream < deadline_us) {
+            deadline_us = upstream;
+        }
+    }
+    return deadline_us;
+}
+
+}  // namespace
 
 // ---------------- ParallelChannel ----------------
 
@@ -67,10 +122,16 @@ struct FanoutCtx {
     int fail_limit = 0;
 
     static void SubDone(FanoutCtx* ctx, int index) {
+        // Per-sub-call observer BEFORE the parent can complete: the sub
+        // Controller (and its response attachment / descriptor view) is
+        // alive exactly until Finish runs.
+        SubState& s = ctx->subs[index];
+        if (s.call.observer != nullptr) {
+            s.call.observer->OnSubCallDone(index, s.cntl);
+        }
         if (ctx->nleft.fetch_sub(1, std::memory_order_acq_rel) == 1) {
             ctx->Finish();
         }
-        (void)index;
     }
 
     void Finish() {
@@ -171,6 +232,9 @@ void ParallelChannel::CallMethod(
     ctx->done = done;
     ctx->fail_limit = options_.fail_limit;
     ctx->subs.resize(subs_.size());
+    // Parent deadline: own timeout capped at the upstream server call's
+    // remaining budget (PR-2 semantics); every sub-call runs under it.
+    const int64_t deadline_us = ComboDeadlineUs(cntl, options_.timeout_ms);
     const int64_t timeout_ms =
         cntl->timeout_ms() >= 0 ? cntl->timeout_ms() : options_.timeout_ms;
 
@@ -224,8 +288,21 @@ void ParallelChannel::CallMethod(
     for (size_t i = 0; i < subs_.size(); ++i) {
         FanoutCtx::SubState& s = ctx->subs[i];
         if (s.skipped) continue;
-        s.cntl.set_timeout_ms(timeout_ms);
+        // Sub-calls inherit the parent's remaining deadline, tenant and
+        // priority (ISSUE 13 satellite); the trace span and cancel
+        // cascade parent on the upstream server call via the issue
+        // fiber's ServerCallScope, exactly like direct child calls.
+        InheritSubCallContext(cntl, &s.cntl, deadline_us, timeout_ms);
         s.cntl.set_max_retry(cntl->max_retry());
+        if (!s.call.request_attachment.empty()) {
+            if (s.call.pool_descriptor) {
+                s.cntl.set_request_pool_attachment(
+                    std::move(s.call.request_attachment));
+            } else {
+                s.cntl.request_attachment().swap(
+                    s.call.request_attachment);
+            }
+        }
         issues.push_back(Issue{subs_[i].chan, s.call.method, &s.cntl,
                                s.call.request, s.call.response, (int)i});
     }
@@ -370,14 +447,28 @@ void PartitionChannel::CallMethod(
 
 int SelectiveChannel::AddChannel(google::protobuf::RpcChannel* sub) {
     if (sub == nullptr) return -1;
+    // Flag-default budget established at setup time (first AddChannel);
+    // an explicit ConfigureRetryBudget — before OR after AddChannel,
+    // but like AddChannel itself it must precede the first call —
+    // overrides it. Keeping all configuration in the setup phase means
+    // the hot path never races Configure against Withdraw.
+    EnsureBudget();
     subs_.push_back(sub);
     return 0;
+}
+
+void SelectiveChannel::EnsureBudget() {
+    if (!budget_configured_.exchange(true, std::memory_order_acq_rel)) {
+        retry_budget_.Configure(FLAGS_rpc_retry_budget_tokens.get(),
+                                FLAGS_rpc_retry_budget_ratio.get());
+    }
 }
 
 // Per-call retry driver: issues on one sub-channel; a failure triggers the
 // next sub-channel (the reference takes over IssueRPC via the _sender
 // hook, selective_channel.cpp; the retry-on-another-channel semantics are
-// the same).
+// the same). Cross-channel hops run through the channel's RetryBudget and
+// the shared retry counters — the same funnel as in-channel re-issues.
 struct SelectiveCallCtx {
     SelectiveChannel* chan;
     const google::protobuf::MethodDescriptor* method;
@@ -389,11 +480,25 @@ struct SelectiveCallCtx {
     Controller sub_cntl;
     int tries_left = 0;
     uint32_t next_index = 0;
+    // Parent context captured at CallMethod: the absolute deadline every
+    // hop runs under, and the upstream server call whose scope re-issues
+    // replay (a retry fires on the completion fiber, where the caller's
+    // fiber-local scope is gone — without the replay the hop would lose
+    // trace parenting, the deadline cap and the cancel cascade). Valid
+    // until the handler's done->Run(), same contract as Channel.
+    int64_t deadline_us = 0;
+    Controller* upstream = nullptr;
 
     void IssueOne() {
         sub_cntl.Reset();
-        sub_cntl.set_timeout_ms(parent->timeout_ms());
+        InheritSubCallContext(parent, &sub_cntl, deadline_us,
+                              parent->timeout_ms());
         const uint32_t idx = next_index++ % (uint32_t)chan->subs_.size();
+        // Re-publish the upstream server call for the issue (no-op when
+        // null or already current): the sub-channel's CallMethod then
+        // parents its span, caps at the upstream budget and registers
+        // for the cancel cascade exactly like any handler-issued call.
+        ServerCallScope scope(upstream);
         chan->subs_[idx]->CallMethod(
             method, &sub_cntl, request, response,
             google::protobuf::NewCallback(&SelectiveCallCtx::OneDone, this));
@@ -401,12 +506,23 @@ struct SelectiveCallCtx {
 
     static void OneDone(SelectiveCallCtx* ctx) {
         if (ctx->sub_cntl.Failed() && ctx->tries_left-- > 0) {
-            ctx->IssueOne();
-            return;
+            // TERR_DRAINING re-issues are budget-free (the draining
+            // server provably never processed the call); everything
+            // else withdraws a token like the in-channel funnel.
+            const bool budget_free =
+                ctx->sub_cntl.ErrorCode() == TERR_DRAINING;
+            if (budget_free || ctx->chan->retry_budget_.Withdraw()) {
+                if (!budget_free) client_stats::CountRetry();
+                ctx->IssueOne();
+                return;
+            }
+            client_stats::CountBudgetExhausted();
         }
         if (ctx->sub_cntl.Failed()) {
             ctx->parent->SetFailed(ctx->sub_cntl.ErrorCode(), "%s",
                                    ctx->sub_cntl.ErrorText().c_str());
+        } else {
+            ctx->chan->retry_budget_.OnSuccess();
         }
         google::protobuf::Closure* user_done = ctx->done;
         if (user_done != nullptr) {
@@ -438,6 +554,8 @@ void SelectiveChannel::CallMethod(
     ctx->done = done;
     ctx->tries_left = cntl->max_retry();
     ctx->next_index = rr_.fetch_add(1, std::memory_order_relaxed);
+    ctx->deadline_us = ComboDeadlineUs(cntl, cntl->timeout_ms());
+    ctx->upstream = CurrentServerCall();
     const bool sync = done == nullptr;
     ctx->IssueOne();
     if (sync) {

@@ -29,11 +29,25 @@
 #include <string>
 #include <vector>
 
+#include "tbase/iobuf.h"
 #include "trpc/channel.h"
 
 namespace tpurpc {
 
 class Controller;
+
+// Per-sub-call completion hook (combo-channel extension for the
+// collective tier, ISSUE 13): invoked exactly once per non-skipped
+// sub-call, on the sub-call's completion fiber, BEFORE the parent
+// merges/completes — the only window where the sub Controller's
+// response attachment / resolved response-descriptor view is readable.
+// May run concurrently for different indices; implementations
+// synchronize their own state. Borrowed, must outlive the parent call.
+class SubCallObserver {
+public:
+    virtual ~SubCallObserver() = default;
+    virtual void OnSubCallDone(int channel_index, Controller& sub_cntl) = 0;
+};
 
 // Maps the parent call onto sub-channel `channel_index`. Default (null
 // mapper): sub-request = parent request, sub-response = fresh instance of
@@ -49,6 +63,16 @@ public:
         bool owns_request = false;   // delete after the call
         bool owns_response = false;  // delete after merging
         bool skip = false;
+        // Attachment bytes for THIS sub-call (moved into the sub
+        // Controller). With `pool_descriptor` the bytes go out as a
+        // one-sided PoolDescriptor when the buffer/transport is
+        // eligible (Controller::set_request_pool_attachment semantics:
+        // ineligible shapes fall back inline transparently) — how the
+        // collective tier posts slab-class chunks zero-copy through a
+        // plain ParallelChannel fan-out.
+        IOBuf request_attachment;
+        bool pool_descriptor = false;
+        SubCallObserver* observer = nullptr;  // borrowed
         static SubCall Skip() {
             SubCall s;
             s.skip = true;
@@ -170,6 +194,15 @@ private:
 
 // Policy routing: each call goes to ONE sub-channel; a failed call retries
 // on the next one (up to the controller's max_retry).
+//
+// Cross-channel re-issues run through the SAME retry funnel as a plain
+// Channel's in-channel retries (ISSUE 13 satellite): each hop withdraws
+// from this channel's RetryBudget (flag defaults
+// -rpc_retry_budget_tokens/-rpc_retry_budget_ratio; ConfigureRetryBudget
+// overrides) and is counted in rpc_client_retries /
+// rpc_retry_budget_exhausted — a SelectiveChannel can no longer amplify
+// a correlated failure budget-free. TERR_DRAINING hops stay budget-free
+// (the server provably never processed the call, PR-4 semantics).
 class SelectiveChannel : public google::protobuf::RpcChannel {
 public:
     SelectiveChannel() = default;
@@ -179,6 +212,16 @@ public:
     int AddChannel(google::protobuf::RpcChannel* sub);
     int channel_count() const { return (int)subs_.size(); }
 
+    // Override the flag-default budget (tokens <= 0 disables). Setup
+    // phase only — like AddChannel, call it before the first
+    // CallMethod (the budget fields are not written concurrently with
+    // the hot path's Withdraw/OnSuccess).
+    void ConfigureRetryBudget(int64_t max_tokens, double token_ratio) {
+        retry_budget_.Configure(max_tokens, token_ratio);
+        budget_configured_.store(true, std::memory_order_release);
+    }
+    RetryBudget& retry_budget() { return retry_budget_; }
+
     void CallMethod(const google::protobuf::MethodDescriptor* method,
                     google::protobuf::RpcController* controller,
                     const google::protobuf::Message* request,
@@ -187,8 +230,12 @@ public:
 
 private:
     friend struct SelectiveCallCtx;
+    void EnsureBudget();
+
     std::vector<google::protobuf::RpcChannel*> subs_;
     std::atomic<uint32_t> rr_{0};
+    RetryBudget retry_budget_;
+    std::atomic<bool> budget_configured_{false};
 };
 
 // Serves whichever partition scheme has the most capacity right now:

@@ -48,6 +48,13 @@ static LazyAdder g_budget_exhausted("rpc_retry_budget_exhausted");
 // Both are budget-free — the rolling-restart soak asserts zero retry
 // tokens spent across a full mesh restart.
 static LazyAdder g_drain_reroutes("rpc_client_drain_reroutes");
+
+// Shared with the combo-channel retry loops (controller.h client_stats):
+// one process-wide adder per name, whoever drives the re-issue.
+namespace client_stats {
+void CountRetry() { *g_client_retries << 1; }
+void CountBudgetExhausted() { *g_budget_exhausted << 1; }
+}  // namespace client_stats
 // One-sided descriptor sends (ISSUE 9): calls whose attachment crossed
 // the wire as a (pool_id, offset, len, crc) reference — and the logical
 // bytes that never entered the frame/copy path because of it.

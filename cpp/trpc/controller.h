@@ -464,4 +464,13 @@ void CompleteClientUnaryResponse(uint64_t cid, int error_code,
                                  const std::string& error_text,
                                  IOBuf* payload_pb);
 
+// Shared client-side re-issue accounting (the single process-wide
+// rpc_client_retries / rpc_retry_budget_exhausted adders live in
+// controller.cc): combo channels route their own cross-channel retry
+// loops through the same counters as the in-channel funnel.
+namespace client_stats {
+void CountRetry();            // rpc_client_retries
+void CountBudgetExhausted();  // rpc_retry_budget_exhausted
+}  // namespace client_stats
+
 }  // namespace tpurpc

@@ -1,0 +1,170 @@
+"""Mesh-collective chaos soak (ISSUE 13): SIGKILL a node mid-all-reduce,
+the collective RE-FORMS over the survivors and keeps completing rounds.
+
+Five `mesh_node` processes run with --coll_traffic: every node
+continuously drives the same program of chunked-pipelined collectives
+(mostly all-reduce, with all-gather and all-to-all rounds mixed in) over
+the shm-ICI mesh, each chunk posted as a one-sided pool descriptor and
+every completed round VERIFIED bit-for-bit against the deterministic
+inputs of the membership it completed over. Mid-run the soak
+
+  * SIGKILLs one node while rounds are continuously in flight (the kill
+    lands mid-all-reduce by construction),
+  * asserts the survivors re-form (rpc_collective_reforms fires) and
+    keep completing verified rounds as a 4-member mesh,
+  * restarts the killed node and asserts it REJOINS the running
+    collective (adopting the mesh's current round seq) and that rounds
+    complete over all 5 members again.
+
+Asserted invariants (the ISSUE-13 acceptance gate):
+  * zero lost completions: coll_issued == coll_ok + coll_failed and
+    outstanding == 0 on every node;
+  * zero verification failures — a re-form may fail rounds (counted,
+    retriable) but NEVER corrupt one;
+  * rpc_collective_reforms >= 1 across the survivors;
+  * zero leaked pins: /pools pinned drains to 0 everywhere (chunk
+    descriptors ride the lease registry; the killed node's pins release
+    via peer-death reclamation);
+  * clean exit 0 everywhere.
+"""
+import time
+
+from test_chaos_soak import Node, _free_ports, _var
+from test_pool_chaos_soak import POOL_FLAGS, _pools
+
+NUM_NODES = 5
+
+COLL_ARGS = ("--coll_traffic",)
+
+
+def _wait_ops(ports, minimum, timeout=60.0, baseline=None):
+    """Wait until rpc_collective_ops grew past `minimum` over `baseline`
+    on every listed node; returns the last reading."""
+    baseline = baseline or {p: 0 for p in ports}
+    deadline = time.time() + timeout
+    ops = {}
+    while time.time() < deadline:
+        ops = {p: _var(p, "rpc_collective_ops") for p in ports}
+        if all(ops[p] - baseline[p] >= minimum for p in ports):
+            return ops
+        time.sleep(0.5)
+    return ops
+
+
+def test_collective_soak(cpp_build, tmp_path):
+    binary = cpp_build / "mesh_node"
+    assert binary.exists(), "mesh_node not built"
+    ports = _free_ports(NUM_NODES)
+    peers_file = tmp_path / "coll_mesh_members"
+    peers_file.write_text("".join("127.0.0.1:%d\n" % p for p in ports))
+
+    nodes = [
+        Node(binary, ports[i], i, peers_file, flags=POOL_FLAGS,
+             extra_args=COLL_ARGS)
+        for i in range(NUM_NODES)
+    ]
+    try:
+        for n in nodes:
+            assert n.wait_ready(), "node %d never became ready" % n.idx
+
+        # Rounds are flowing on every node (and chunks really ride the
+        # descriptor path: collective steps pin pool blocks).
+        ops0 = _wait_ops(ports, 3)
+        assert all(v >= 3 for v in ops0.values()), \
+            "collective rounds never started: %s" % ops0
+        assert sum(_var(p, "rpc_collective_steps") for p in ports) > 0
+        assert sum(
+            _var(p, "rpc_pool_descriptor_sends") for p in ports) > 0, \
+            "collective chunks are not riding the descriptor path"
+
+        # --- SIGKILL one node mid-all-reduce --------------------------
+        # Traffic is continuous (a round roughly every 50ms), so the
+        # kill lands with rounds in flight on every survivor.
+        kill_idx = NUM_NODES - 1
+        nodes[kill_idx].kill9()
+        survivors = [i for i in range(NUM_NODES) if i != kill_idx]
+        surv_ports = [ports[i] for i in survivors]
+
+        # Survivors re-form over the 4-member mesh and keep completing
+        # rounds (reforms is cumulative across the mesh).
+        deadline = time.time() + 40.0
+        reforms = 0
+        while time.time() < deadline:
+            reforms = sum(
+                _var(p, "rpc_collective_reforms") for p in surv_ports)
+            if reforms >= 1:
+                break
+            time.sleep(0.5)
+        assert reforms >= 1, "survivors never re-formed"
+        base = {p: _var(p, "rpc_collective_ops") for p in surv_ports}
+        ops1 = _wait_ops(surv_ports, 3, baseline=base)
+        assert all(ops1[p] - base[p] >= 3 for p in surv_ports), \
+            "rounds stopped completing after the kill: %s" % ops1
+
+        # Peer death must not strand the killed node's chunk pins on
+        # the survivors (lease peer-death reclamation).
+        deadline = time.time() + 20.0
+        pinned = None
+        while time.time() < deadline:
+            pinned = [_pools(p)["pinned"] for p in surv_ports]
+            if all(v <= 4 for v in pinned):
+                break
+            time.sleep(0.5)
+        assert all(v <= 4 for v in pinned), \
+            "pins stranded after peer kill: %s" % pinned
+
+        # --- restart the killed node: it must REJOIN ------------------
+        nodes[kill_idx] = Node(binary, ports[kill_idx], kill_idx,
+                               peers_file, flags=POOL_FLAGS,
+                               extra_args=COLL_ARGS)
+        assert nodes[kill_idx].wait_ready()
+        # The restarted node adopts the mesh's current round seq and
+        # completes rounds WITH the others (its ops only grow when the
+        # whole 5-member collective completes).
+        ops2 = _wait_ops([ports[kill_idx]], 2, timeout=90.0)
+        assert ops2[ports[kill_idx]] >= 2, \
+            "restarted node never rejoined the collective: %s" % ops2
+
+        # --- drain + invariants ---------------------------------------
+        reports = []
+        for n in nodes:
+            rep = n.stop_and_report(timeout=60.0)
+            assert rep is not None, "node %d produced no report" % n.idx
+            reports.append(rep)
+
+        for rep in reports:
+            # Zero lost completions on the collective plane (and the
+            # background planes), zero verification failures.
+            assert rep["outstanding"] == 0, rep
+            assert rep["coll_issued"] == (
+                rep["coll_ok"] + rep["coll_failed"]), rep
+            assert rep["coll_verify_failed"] == 0, rep
+            assert rep["coll_ok"] > 0, rep
+            assert rep["lb_issued"] == rep["lb_ok"] + rep["lb_failed"], rep
+            assert rep["shm_issued"] == rep["shm_ok"] + rep["shm_failed"], \
+                rep
+        # The mesh re-formed at least once, and after the heal the
+        # last completed rounds ran over all 5 members somewhere.
+        assert sum(rep["coll_reforms"] for rep in reports) >= 1, reports
+        assert any(rep["coll_nranks"] == NUM_NODES for rep in reports), \
+            reports
+
+        # Zero leaked pins after quiesce, everywhere.
+        deadline = time.time() + 20.0
+        pinned = None
+        while time.time() < deadline:
+            pinned = [_pools(p)["pinned"] for p in ports]
+            if all(v == 0 for v in pinned):
+                break
+            time.sleep(0.5)
+        assert all(v == 0 for v in pinned), \
+            "pins stranded after quiesce: %s" % pinned
+
+        for n in nodes:
+            assert n.shutdown() == 0, "node %d unclean exit" % n.idx
+    finally:
+        for n in nodes:
+            try:
+                n.proc.kill()
+            except OSError:
+                pass

@@ -177,6 +177,20 @@ def test_metrics_exposition_lint(cpp_build, tmp_path):
                     "rpc_pool_desc_rsp_rejects",
                     "rpc_pool_desc_rsp_acks"):
             assert families.get(fam) == "gauge", (fam, sorted(families))
+        # ISSUE 13 collective families: counters present (0-valued)
+        # before any round, plus the per-algorithm bus-bandwidth family
+        # with one series per algorithm.
+        for fam in ("rpc_collective_ops", "rpc_collective_steps",
+                    "rpc_collective_retries", "rpc_collective_reforms",
+                    "rpc_collective_bytes",
+                    "rpc_collective_desc_fallbacks"):
+            assert families.get(fam) == "gauge", (fam, sorted(families))
+        assert families.get("rpc_collective_busbw_mbps") == "gauge"
+        for alg in ("allreduce", "allgather", "alltoall",
+                    "allreduce_serial"):
+            assert re.search(
+                r'^rpc_collective_busbw_mbps\{alg="%s"\} \d+$' % alg,
+                text, re.M), alg
         # ISSUE 12 transport-tier attribution: labelled families with one
         # series per registered endpoint type (tcp/ici/shm_xproc/device).
         for fam in ("rpc_transport_in_bytes", "rpc_transport_out_bytes",

@@ -54,6 +54,8 @@
 #include "tici/block_pool.h"
 #include "tici/shm_link.h"
 #include "trpc/channel.h"
+#include "trpc/collective.h"
+#include "trpc/collective_benchpb.h"
 #include "trpc/controller.h"
 #include "trpc/pb_compat.h"
 #include "trpc/policy_tpu_std.h"
@@ -154,6 +156,14 @@ public:
 struct Counters {
     std::atomic<int64_t> lb_issued{0}, lb_ok{0}, lb_failed{0};
     std::atomic<int64_t> shm_issued{0}, shm_ok{0}, shm_failed{0};
+    // Collective rounds driven by this node (ISSUE 13): every issued
+    // round terminates (ok or failed — zero lost completions), and a
+    // completed round's result is VERIFIED against the deterministic
+    // inputs of the membership it completed over (verify_failed must
+    // stay 0 through kills and re-forms).
+    std::atomic<int64_t> coll_issued{0}, coll_ok{0}, coll_failed{0};
+    std::atomic<int64_t> coll_verify_failed{0};
+    std::atomic<int64_t> coll_nranks_last{0};
     std::atomic<int64_t> stale_issued{0}, stale_ok{0}, stale_failed{0};
     // One-sided descriptor traffic (ISSUE 10): every call pins a pool
     // block under a lease; desc_stale counts TERR_STALE_EPOCH fences
@@ -208,6 +218,237 @@ void TrafficStartDelay(NodeState* st) {
            !st->stop.load(std::memory_order_relaxed)) {
         fiber_usleep(20 * 1000);
     }
+}
+
+// ---------------- collectives (ISSUE 13) ----------------
+
+int g_my_port = 0;
+
+// Live membership from the mesh's link table: a peer is a member while
+// its shm channel is up (LinkMaintenanceFiber re-establishes dead ones,
+// so a restarted node rejoins the collective automatically). Keys are
+// listen ports — stable, unique, and identical in every node's view.
+class MeshMembership : public CollectiveMembership {
+public:
+    explicit MeshMembership(NodeState* st) : st_(st) {}
+    void GetMembers(std::vector<Member>* out) override {
+        Member self;
+        self.key = (uint64_t)g_my_port;
+        self.self = true;
+        out->push_back(self);
+        for (auto& lp : st_->links) {
+            std::shared_ptr<Channel> ch;
+            {
+                std::lock_guard<std::mutex> g(lp->mu);
+                ch = lp->ch;
+            }
+            if (ch == nullptr) continue;
+            SocketUniquePtr s = SocketUniquePtr::FromId(ch->pinned_socket());
+            if (!s || s->Failed()) continue;
+            Member m;
+            m.key = (uint64_t)lp->ep.port;
+            m.chan = ch;
+            out->push_back(m);
+        }
+    }
+
+private:
+    NodeState* st_;
+};
+
+CollectiveEngine* g_coll_engine = nullptr;
+
+class CollectiveServiceImpl : public benchpb::CollectiveService {
+public:
+    void Exchange(google::protobuf::RpcController* cntl_base,
+                  const benchpb::CollChunk* req, benchpb::CollAck* res,
+                  google::protobuf::Closure* done) override {
+        HandleCollectiveExchange(g_coll_engine,
+                                 static_cast<Controller*>(cntl_base), req,
+                                 res, done);
+    }
+};
+
+// Deterministic collective inputs: every node can reconstruct every
+// member's contribution from (seq, key) alone, so each node VERIFIES
+// each completed round bit-for-bit — the strongest possible
+// lost/corrupt-chunk detector under chaos. A2A pair payloads fold both
+// endpoints into the key.
+uint64_t A2aKey(uint64_t src_key, uint64_t dst_key) {
+    return src_key * 1000003ull + dst_key;
+}
+
+struct CollRunArgs {
+    NodeState* st = nullptr;
+    std::string alg;     // allreduce | allreduce_serial | allgather | alltoall
+    uint64_t bytes = 0;  // per-kind meaning (payload / block)
+    uint64_t seq = 0;
+    bool print = false;  // stdin-commanded round: emit a COLL line
+};
+
+// Runs ONE collective round, verifies it, updates counters; returns ok.
+bool RunCollectiveRound(const CollRunArgs& a) {
+    CollectiveEngine* eng = g_coll_engine;
+    if (eng == nullptr) return false;
+    Counters& c = a.st->counters;
+    c.outstanding.fetch_add(1);
+    c.coll_issued.fetch_add(1);
+    CollectiveEngine::Result r;
+    bool ok = false;
+    bool verified = true;
+    uint32_t checksum = 0;
+    std::vector<uint32_t> head;
+    double busbw = 0.0;
+    uint64_t moved_total = 0;
+    const uint64_t my_key = (uint64_t)g_my_port;
+
+    if (a.alg == "allreduce" || a.alg == "allreduce_serial") {
+        const size_t nwords = (size_t)(a.bytes / 4 ? a.bytes / 4 : 1);
+        std::vector<uint32_t> words(nwords);
+        CollectiveEngine::FillDeterministic(a.seq, my_key, words.data(),
+                                            nwords);
+        const int err =
+            a.alg == "allreduce"
+                ? eng->AllReduce(a.seq, words.data(), nwords, &r)
+                : eng->SerialAllReduce(a.seq, words.data(), nwords, &r);
+        ok = err == 0;
+        if (ok) {
+            // expected[i] = sum of every member's deterministic word.
+            std::vector<uint32_t> expect(nwords, 0);
+            std::vector<uint32_t> tmp(nwords);
+            for (uint64_t k : r.member_keys) {
+                CollectiveEngine::FillDeterministic(a.seq, k, tmp.data(),
+                                                    nwords);
+                for (size_t i = 0; i < nwords; ++i) expect[i] += tmp[i];
+            }
+            verified = expect == words;
+            checksum = CollectiveEngine::Checksum(words.data(), nwords);
+            for (size_t i = 0; i < nwords && i < 4; ++i) {
+                head.push_back(words[i]);
+            }
+            moved_total = nwords * 4;
+        }
+    } else if (a.alg == "allgather") {
+        const size_t block = (size_t)(a.bytes ? a.bytes & ~3ull : 4);
+        std::vector<uint32_t> mine(block / 4);
+        CollectiveEngine::FillDeterministic(a.seq, my_key, mine.data(),
+                                            mine.size());
+        std::string out;
+        ok = eng->AllGather(a.seq, mine.data(), block, &out, &r) == 0;
+        if (ok) {
+            std::string expect;
+            std::vector<uint32_t> tmp(block / 4);
+            for (uint64_t k : r.member_keys) {
+                CollectiveEngine::FillDeterministic(a.seq, k, tmp.data(),
+                                                    tmp.size());
+                expect.append((const char*)tmp.data(), block);
+            }
+            verified = expect == out;
+            checksum = CollectiveEngine::Checksum(
+                (const uint32_t*)out.data(), out.size() / 4);
+            moved_total = out.size();
+        }
+    } else if (a.alg == "alltoall") {
+        const size_t block = (size_t)(a.bytes ? a.bytes & ~3ull : 4);
+        // Blocks for every POSSIBLE member (self + all configured
+        // peers) so a re-formed round still finds its payloads.
+        std::map<uint64_t, std::string> blocks;
+        std::vector<uint32_t> tmp(block / 4);
+        auto fill_for = [&](uint64_t dst_key) {
+            CollectiveEngine::FillDeterministic(
+                a.seq, A2aKey(my_key, dst_key), tmp.data(), tmp.size());
+            blocks[dst_key].assign((const char*)tmp.data(), block);
+        };
+        fill_for(my_key);
+        for (auto& lp : a.st->links) fill_for((uint64_t)lp->ep.port);
+        std::string out;
+        ok = eng->AllToAll(a.seq, blocks, block, &out, &r) == 0;
+        if (ok) {
+            std::string expect;
+            for (uint64_t k : r.member_keys) {
+                CollectiveEngine::FillDeterministic(
+                    a.seq, A2aKey(k, my_key), tmp.data(), tmp.size());
+                expect.append((const char*)tmp.data(), block);
+            }
+            verified = expect == out;
+            checksum = CollectiveEngine::Checksum(
+                (const uint32_t*)out.data(), out.size() / 4);
+            moved_total = out.size();
+        }
+    }
+
+    if (ok) {
+        busbw = r.busbw_mbps;  // computed once, in the engine
+        c.coll_ok.fetch_add(1);
+        c.coll_nranks_last.store(r.nranks, std::memory_order_relaxed);
+        if (!verified) c.coll_verify_failed.fetch_add(1);
+    } else {
+        c.coll_failed.fetch_add(1);
+    }
+    c.outstanding.fetch_sub(1);
+
+    if (a.print) {
+        std::string head_s;
+        char num[16];
+        for (uint32_t v : head) {
+            snprintf(num, sizeof(num), "%s%u", head_s.empty() ? "" : ",",
+                     v);
+            head_s += num;
+        }
+        printf(
+            "COLL {\"alg\": \"%s\", \"seq\": %llu, \"ok\": %d, "
+            "\"verified\": %d, \"error\": %d, \"nranks\": %u, "
+            "\"bytes\": %llu, \"elapsed_us\": %lld, "
+            "\"busbw_mbps\": %.1f, \"checksum\": %u, \"head\": [%s], "
+            "\"reforms\": %d, \"retries\": %d, "
+            "\"desc_fallback_chunks\": %llu}\n",
+            a.alg.c_str(), (unsigned long long)a.seq, ok ? 1 : 0,
+            verified ? 1 : 0, r.error, r.nranks,
+            (unsigned long long)moved_total, (long long)r.elapsed_us,
+            busbw, checksum, head_s.c_str(), r.reforms, r.retries,
+            (unsigned long long)r.desc_fallback_chunks);
+        fflush(stdout);
+    }
+    return ok && verified;
+}
+
+void* CollCommandFiber(void* arg) {
+    std::unique_ptr<CollRunArgs> a((CollRunArgs*)arg);
+    RunCollectiveRound(*a);
+    return nullptr;
+}
+
+// Continuous collective traffic (--coll_traffic): the same program on
+// every node — mostly all-reduce (the soak SIGKILLs a node mid-op),
+// with all-gather and all-to-all rounds mixed in on a fixed schedule
+// so all nodes stay round-aligned.
+void* CollTrafficFiber(void* arg) {
+    auto* st = (NodeState*)arg;
+    TrafficStartDelay(st);
+    uint64_t seq = 0;
+    CollRunArgs a;
+    a.st = st;
+    while (!st->stop.load(std::memory_order_relaxed)) {
+        // Adopt the mesh's current round when (re)joining: peers
+        // mid-round N must not wait on a node restarting from 1.
+        CollectiveEngine* eng = g_coll_engine;
+        const uint64_t observed = eng != nullptr ? eng->ObservedSeq() : 0;
+        seq = seq + 1 > observed ? seq + 1 : observed;
+        a.seq = seq;
+        if (seq % 5 == 2) {
+            a.alg = "allgather";
+            a.bytes = 32 << 10;  // per-rank block
+        } else if (seq % 5 == 4) {
+            a.alg = "alltoall";
+            a.bytes = 16 << 10;  // per-pair block
+        } else {
+            a.alg = "allreduce";
+            a.bytes = 512 << 10;  // payload
+        }
+        RunCollectiveRound(a);
+        fiber_usleep(50 * 1000);
+    }
+    return nullptr;
 }
 
 // In-process numeric tvar read (the REPORT line carries re-issue and
@@ -552,6 +793,11 @@ void PrintReport(int id, int port, const Counters& c) {
         "REPORT {\"id\": %d, \"port\": %d, \"lb_issued\": %lld, "
         "\"lb_ok\": %lld, \"lb_failed\": %lld, \"shm_issued\": %lld, "
         "\"shm_ok\": %lld, \"shm_failed\": %lld, "
+        "\"coll_issued\": %lld, \"coll_ok\": %lld, "
+        "\"coll_failed\": %lld, \"coll_verify_failed\": %lld, "
+        "\"coll_nranks\": %lld, \"coll_ops\": %lld, "
+        "\"coll_steps\": %lld, \"coll_retries\": %lld, "
+        "\"coll_reforms\": %lld, \"coll_desc_fallbacks\": %lld, "
         "\"stale_issued\": %lld, \"stale_ok\": %lld, "
         "\"stale_failed\": %lld, \"stale_executed\": %lld, "
         "\"expired_probes\": %lld, "
@@ -568,6 +814,15 @@ void PrintReport(int id, int port, const Counters& c) {
         id, port, (long long)c.lb_issued.load(), (long long)c.lb_ok.load(),
         (long long)c.lb_failed.load(), (long long)c.shm_issued.load(),
         (long long)c.shm_ok.load(), (long long)c.shm_failed.load(),
+        (long long)c.coll_issued.load(), (long long)c.coll_ok.load(),
+        (long long)c.coll_failed.load(),
+        (long long)c.coll_verify_failed.load(),
+        (long long)c.coll_nranks_last.load(),
+        (long long)VarInt("rpc_collective_ops"),
+        (long long)VarInt("rpc_collective_steps"),
+        (long long)VarInt("rpc_collective_retries"),
+        (long long)VarInt("rpc_collective_reforms"),
+        (long long)VarInt("rpc_collective_desc_fallbacks"),
         (long long)c.stale_issued.load(), (long long)c.stale_ok.load(),
         (long long)c.stale_failed.load(),
         (long long)g_stale_executed.load(),
@@ -626,6 +881,7 @@ void* GracefulQuitWatcher(void* arg) {
         fflush(stdout);
     }
     fiber_usleep((int64_t)a->drain_ms * 1000);
+    if (g_coll_engine != nullptr) g_coll_engine->Shutdown();
     a->st->StopTraffic();  // our own in-flight client calls complete
     a->server->GracefulStop(2000);
     PrintReport(a->id, a->port, a->st->counters);
@@ -644,6 +900,8 @@ int main(int argc, char** argv) {
     bool lb_only = false;
     bool inline_echo = false;
     bool desc_traffic = false;
+    bool collective = false;
+    bool coll_traffic = false;
     const char* peers_file = nullptr;
     for (int i = 1; i < argc; ++i) {
         if (strcmp(argv[i], "--port") == 0 && i + 1 < argc) {
@@ -680,6 +938,14 @@ int main(int argc, char** argv) {
             // descriptor traffic (pinned pool blocks) over the shm
             // links so kills/chaos hit the zero-copy data path.
             desc_traffic = true;
+        } else if (strcmp(argv[i], "--collective") == 0) {
+            // Mesh collectives (ISSUE 13): serve the CollectiveService
+            // + engine; rounds are driven by stdin "coll ..." commands
+            // (bench.py) or the --coll_traffic fiber (the soak).
+            collective = true;
+        } else if (strcmp(argv[i], "--coll_traffic") == 0) {
+            collective = true;
+            coll_traffic = true;
         } else if (strcmp(argv[i], "--lb_only") == 0) {
             // Rolling-restart soak mode: only the naming/LB plane runs.
             // The shm-ICI links die hard when a peer exits (no drain
@@ -702,6 +968,7 @@ int main(int argc, char** argv) {
         fprintf(stderr,
                 "usage: mesh_node --port N --peers FILE [--id K] "
                 "[--lb_only] [--inline_echo] [--desc_traffic] "
+                "[--collective] [--coll_traffic] "
                 "[--drain_ms N] "
                 "[--timeout_cl_ms N] [--tenant NAME] [--priority 0..7] "
                 "[--flag name=value]...\n"
@@ -715,9 +982,12 @@ int main(int argc, char** argv) {
         return 1;
     }
 
+    g_my_port = port;
     static EchoServiceImpl service;
+    static CollectiveServiceImpl coll_service;
     static Server server;
     if (server.AddService(&service) != 0) return 1;
+    if (collective && server.AddService(&coll_service) != 0) return 1;
     if (inline_echo) {
         server.SetMethodInlineSafe("benchpb.EchoService", "Echo");
     }
@@ -766,11 +1036,32 @@ int main(int argc, char** argv) {
         fclose(f);
     }
 
+    // Collective engine over the shm-link mesh (needs st.links).
+    static std::unique_ptr<MeshMembership> coll_membership;
+    static BenchpbCollCodec coll_codec;
+    static std::unique_ptr<CollectiveEngine> coll_engine;
+    if (collective && !lb_only) {
+        coll_membership.reset(new MeshMembership(&st));
+        CollectiveOptions copts;
+        copts.step_timeout_ms = 1500;
+        copts.attempt_timeout_ms = 4000;
+        // Also bounds how long a rejoin-misaligned round can stall the
+        // mesh before the straggler adopts the observed seq.
+        copts.op_timeout_ms = 15000;
+        coll_engine.reset(new CollectiveEngine(coll_membership.get(),
+                                               &coll_codec, copts));
+        g_coll_engine = coll_engine.get();
+    }
+
     std::vector<fiber_t>& fibers = st.traffic_fibers;
     fiber_t tid;
     if (!lb_only &&
         fiber_start_background(&tid, nullptr, LinkMaintenanceFiber, &st) ==
             0) {
+        fibers.push_back(tid);
+    }
+    if (coll_traffic && g_coll_engine != nullptr &&
+        fiber_start_background(&tid, nullptr, CollTrafficFiber, &st) == 0) {
         fibers.push_back(tid);
     }
     if (fiber_start_background(&tid, nullptr, LbTrafficFiber, &st) == 0) {
@@ -822,6 +1113,35 @@ int main(int argc, char** argv) {
             PrintReport(id, port, st.counters);
         } else if (strncmp(cmd, "report", 6) == 0) {
             PrintReport(id, port, st.counters);
+        } else if (strncmp(cmd, "coll", 4) == 0 && cmd[4] == ' ') {
+            // "coll <alg> <bytes> <seq>": run ONE collective round on a
+            // fiber (the driver sends the same command to every node)
+            // and print a COLL result line. alg: allreduce |
+            // allreduce_serial | allgather | alltoall.
+            char alg[32];
+            unsigned long long cbytes = 0, cseq = 0;
+            if (sscanf(cmd + 5, "%31s %llu %llu", alg, &cbytes, &cseq) ==
+                3) {
+                auto* a = new CollRunArgs;
+                a->st = &st;
+                a->alg = alg;
+                a->bytes = cbytes;
+                a->seq = cseq;
+                a->print = true;
+                fiber_t ct;
+                if (fiber_start_background(&ct, nullptr, CollCommandFiber,
+                                           a) != 0) {
+                    CollCommandFiber(a);
+                } else {
+                    // Track it: teardown must join commanded rounds
+                    // before the stack-local NodeState goes away (and
+                    // before a REPORT claims outstanding == 0).
+                    st.traffic_fibers.push_back(ct);
+                }
+            } else {
+                printf("COLL {\"ok\": 0, \"error\": 22}\n");
+                fflush(stdout);
+            }
         } else if (strncmp(cmd, "chain", 5) == 0) {
             auto* a = new ChainArgs;
             char* save = nullptr;
@@ -861,6 +1181,11 @@ int main(int argc, char** argv) {
         st.watcher_stop.store(true, std::memory_order_release);
         fiber_join(quit_watcher, nullptr);
     }
+    // Unpark collective drivers/handlers BEFORE joining the traffic
+    // fibers (a commanded round blocked in a fan-out would otherwise
+    // hold the join for its op timeout) and before Join (a handler
+    // fiber parked in the engine would hold its connection open).
+    if (g_coll_engine != nullptr) g_coll_engine->Shutdown();
     st.StopTraffic();
     server.Stop();
     server.Join();  // quiesces sockets: a leak would hang (pytest timeout)

@@ -8,10 +8,12 @@
 #   bash tools/mkpbstub.sh [DEST]    # default DEST=/tmp/pbstub
 #
 # Produces DEST/google/protobuf/*.h (minimal API the repo touches) and
-# DEST/gen/{rpc_meta,echo,bench_echo}.pb.h. The rpc_meta stub REALLY
-# encodes/decodes proto2 varint fields 3 (correlation_id),
-# 5 (attachment_size) and 7 (body_checksum), so c_api framing bytes
-# match the protoc build and the Python native tests run for real.
+# DEST/gen/{rpc_meta,echo,bench_echo}.pb.h. Since ISSUE 13 the stubs
+# are WIRE-COMPLETE: every message field really encodes/decodes with
+# the proto2 wire format (gen/pbstub_wire.h — varints, zigzag,
+# length-delimited strings and submessages), so runtime-stub builds of
+# the whole RPC stack speak protoc-compatible bytes over real sockets
+# (request routing, response errors, descriptors, test payloads).
 # Sweep:  g++ -std=c++17 -fsyntax-only -Icpp -Icpp/tests \
 #             -isystem DEST -IDEST/gen <file.cc>
 set -euo pipefail
@@ -97,7 +99,8 @@ class FieldDescriptor {};
 class Message;
 class Reflection {
 public:
-    void Swap(Message*, Message*) const {}
+    // Wire-based swap; defined in message.h once Message is complete.
+    void Swap(Message* a, Message* b) const;
 };
 }  // namespace protobuf
 }  // namespace google
@@ -117,11 +120,36 @@ public:
         static Reflection r;
         return &r;
     }
-    virtual void CopyFrom(const Message&) {}
-    virtual void MergeFrom(const Message&) {}
+    // Wire-based defaults: real enough for the merge/copy paths the
+    // framework exercises (stub messages implement real Serialize/
+    // Parse and a real Clear). Copy/Swap must CLEAR first — serialize
+    // omits default-valued fields, and parse-without-clear would merge
+    // instead of replace (stale nonzero fields surviving a "copy").
+    virtual void CopyFrom(const Message& other) {
+        std::string s;
+        other.SerializeToString(&s);
+        Clear();
+        ParseFromString(s);
+    }
+    // proto2 merge semantics for singular fields (overwrite when set in
+    // `other`) == parse without clearing.
+    virtual void MergeFrom(const Message& other) {
+        std::string s;
+        other.SerializeToString(&s);
+        ParseFromString(s);
+    }
     virtual void Clear() {}
     virtual std::string DebugString() const { return ""; }
 };
+inline void Reflection::Swap(Message* a, Message* b) const {
+    std::string sa, sb;
+    a->SerializeToString(&sa);
+    b->SerializeToString(&sb);
+    a->Clear();
+    b->Clear();
+    a->ParseFromString(sb);
+    b->ParseFromString(sa);
+}
 }  // namespace protobuf
 }  // namespace google
 PBEOF
@@ -269,14 +297,130 @@ inline Status MessageToJsonString(const Message&, std::string*,
 }  // namespace google
 PBEOF
 
+cat > "$DEST/gen/pbstub_wire.h" << 'PBEOF'
+// Minimal proto2 wire helpers shared by the stub pb.h files: REAL
+// varint / length-delimited encoding so runtime-stub builds move
+// protoc-compatible bytes (service routing, error codes, descriptors,
+// test payloads) over real sockets.
+#pragma once
+#include <google/protobuf/message_lite.h>
+#include <cstdint>
+#include <cstring>
+#include <string>
+namespace pbstub {
+namespace wire {
+inline void varint(std::string* o, uint64_t v) {
+    while (v >= 0x80) {
+        o->push_back((char)(0x80 | (v & 0x7f)));
+        v >>= 7;
+    }
+    o->push_back((char)v);
+}
+inline void put_u(std::string* o, uint32_t f, uint64_t v) {
+    varint(o, ((uint64_t)f << 3) | 0);
+    varint(o, v);
+}
+inline void put_str(std::string* o, uint32_t f, const std::string& s) {
+    varint(o, ((uint64_t)f << 3) | 2);
+    varint(o, s.size());
+    o->append(s);
+}
+inline uint64_t zig32(int32_t v) {
+    return (uint32_t)(((uint32_t)v << 1) ^ (uint32_t)(v >> 31));
+}
+inline int32_t unzig32(uint64_t n) {
+    return (int32_t)((uint32_t)(n >> 1) ^ (uint32_t)(-(int64_t)(n & 1)));
+}
+struct Reader {
+    const char* p;
+    const char* end;
+    explicit Reader(const std::string& s)
+        : p(s.data()), end(s.data() + s.size()) {}
+    bool varint(uint64_t* v) {
+        *v = 0;
+        int shift = 0;
+        while (p < end) {
+            const uint8_t b = (uint8_t)*p++;
+            *v |= (uint64_t)(b & 0x7f) << shift;
+            if (!(b & 0x80)) return true;
+            shift += 7;
+            if (shift > 63) return false;
+        }
+        return false;
+    }
+    // One field; returns false at end (ok=true) or on malformed input
+    // (ok=false). wt0 fills v; wt2 fills s; wt1/5 fill v.
+    bool next(uint32_t* field, uint32_t* wt, uint64_t* v, std::string* s,
+              bool* ok) {
+        if (p >= end) {
+            *ok = true;
+            return false;
+        }
+        uint64_t key = 0;
+        if (!varint(&key)) {
+            *ok = false;
+            return false;
+        }
+        *field = (uint32_t)(key >> 3);
+        *wt = (uint32_t)(key & 7);
+        if (*wt == 0) {
+            if (!varint(v)) {
+                *ok = false;
+                return false;
+            }
+        } else if (*wt == 2) {
+            uint64_t n = 0;
+            if (!varint(&n) || (uint64_t)(end - p) < n) {
+                *ok = false;
+                return false;
+            }
+            s->assign(p, (size_t)n);
+            p += n;
+        } else if (*wt == 5) {
+            if (end - p < 4) {
+                *ok = false;
+                return false;
+            }
+            uint32_t x;
+            memcpy(&x, p, 4);
+            p += 4;
+            *v = x;
+        } else if (*wt == 1) {
+            if (end - p < 8) {
+                *ok = false;
+                return false;
+            }
+            uint64_t x;
+            memcpy(&x, p, 8);
+            p += 8;
+            *v = x;
+        } else {
+            *ok = false;
+            return false;
+        }
+        *ok = true;
+        return true;
+    }
+};
+inline void put_msg(std::string* o, uint32_t f,
+                    const google::protobuf::MessageLite& m) {
+    std::string sub;
+    m.SerializeToString(&sub);
+    put_str(o, f, sub);
+}
+}  // namespace wire
+}  // namespace pbstub
+PBEOF
+
 cat > "$DEST/gen/rpc_meta.pb.h" << 'PBEOF'
 // STUB of protoc output for cpp/trpc/proto/rpc_meta.proto (sweep +
-// runtime-stub builds only). Fields 3/5/7 (correlation_id,
-// attachment_size, body_checksum) REALLY encode/decode as proto2
-// varints so tpurpc_frame/unframe produce protoc-compatible bytes;
-// every other field is in-memory only.
+// runtime-stub builds only). EVERY field really encodes/decodes with
+// the proto2 wire format (pbstub_wire.h), so tpu_std framing, request
+// routing, response errors and pool descriptors all match the protoc
+// build — runtime-stub meshes speak the real protocol.
 #pragma once
 #include <google/protobuf/message.h>
+#include "pbstub_wire.h"
 #include <cstdint>
 #include <string>
 namespace tpurpc {
@@ -304,6 +448,36 @@ public:
     }
     uint64_t ack_token() const { return ack_token_; }
     void set_ack_token(uint64_t v) { ack_token_ = v; }
+    void Clear() override { *this = PoolDescriptor(); }
+    bool SerializeToString(std::string* out) const override {
+        out->clear();
+        pbstub::wire::put_u(out, 1, pool_id_);
+        pbstub::wire::put_u(out, 2, offset_);
+        pbstub::wire::put_u(out, 3, length_);
+        if (has_crc32c_) pbstub::wire::put_u(out, 4, crc32c_);
+        if (has_pool_epoch_) pbstub::wire::put_u(out, 5, pool_epoch_);
+        if (ack_token_ != 0) pbstub::wire::put_u(out, 6, ack_token_);
+        return true;
+    }
+    bool ParseFromString(const std::string& s) override {
+        pbstub::wire::Reader r(s);
+        uint32_t f = 0, wt = 0;
+        uint64_t v = 0;
+        std::string sub;
+        bool ok = true;
+        while (r.next(&f, &wt, &v, &sub, &ok)) {
+            switch (f) {
+                case 1: pool_id_ = v; break;
+                case 2: offset_ = v; break;
+                case 3: length_ = v; break;
+                case 4: set_crc32c((uint32_t)v); break;
+                case 5: set_pool_epoch(v); break;
+                case 6: ack_token_ = v; break;
+                default: break;
+            }
+        }
+        return ok;
+    }
 private:
     uint64_t pool_id_ = 0, offset_ = 0, length_ = 0, pool_epoch_ = 0;
     uint64_t ack_token_ = 0;
@@ -349,6 +523,52 @@ public:
     bool has_parent_span_id() const { return parent_span_id_ != 0; }
     uint64_t parent_span_id() const { return parent_span_id_; }
     void set_parent_span_id(uint64_t v) { parent_span_id_ = v; }
+    void Clear() override { *this = RpcRequestMeta(); }
+    bool SerializeToString(std::string* out) const override {
+        out->clear();
+        if (!service_name_.empty()) {
+            pbstub::wire::put_str(out, 1, service_name_);
+        }
+        if (!method_name_.empty()) {
+            pbstub::wire::put_str(out, 2, method_name_);
+        }
+        if (has_timeout_ms_) {
+            pbstub::wire::put_u(out, 3, (uint64_t)timeout_ms_);
+        }
+        if (log_id_ != 0) pbstub::wire::put_u(out, 4, (uint64_t)log_id_);
+        if (has_priority_) {
+            pbstub::wire::put_u(out, 5, pbstub::wire::zig32(priority_));
+        }
+        if (has_trace_id_) pbstub::wire::put_u(out, 6, trace_id_);
+        if (has_span_id_) pbstub::wire::put_u(out, 7, span_id_);
+        if (parent_span_id_ != 0) {
+            pbstub::wire::put_u(out, 8, parent_span_id_);
+        }
+        if (!tenant_.empty()) pbstub::wire::put_str(out, 9, tenant_);
+        return true;
+    }
+    bool ParseFromString(const std::string& s) override {
+        pbstub::wire::Reader r(s);
+        uint32_t f = 0, wt = 0;
+        uint64_t v = 0;
+        std::string sub;
+        bool ok = true;
+        while (r.next(&f, &wt, &v, &sub, &ok)) {
+            switch (f) {
+                case 1: service_name_ = sub; break;
+                case 2: method_name_ = sub; break;
+                case 3: set_timeout_ms((int64_t)v); break;
+                case 4: log_id_ = (int64_t)v; break;
+                case 5: set_priority(pbstub::wire::unzig32(v)); break;
+                case 6: set_trace_id(v); break;
+                case 7: set_span_id(v); break;
+                case 8: parent_span_id_ = v; break;
+                case 9: tenant_ = sub; break;
+                default: break;
+            }
+        }
+        return ok;
+    }
 private:
     std::string service_name_, method_name_, tenant_;
     int64_t timeout_ms_ = 0, log_id_ = 0;
@@ -375,6 +595,44 @@ public:
         has_pool_attachment_ = true;
         return &pool_attachment_;
     }
+    void Clear() override { *this = RpcResponseMeta(); }
+    bool SerializeToString(std::string* out) const override {
+        out->clear();
+        if (error_code_ != 0) {
+            pbstub::wire::put_u(out, 1, (uint64_t)(int64_t)error_code_);
+        }
+        if (!error_text_.empty()) {
+            pbstub::wire::put_str(out, 2, error_text_);
+        }
+        if (backoff_ms_ != 0) {
+            pbstub::wire::put_u(out, 3, (uint64_t)backoff_ms_);
+        }
+        if (has_pool_attachment_) {
+            pbstub::wire::put_msg(out, 4, pool_attachment_);
+        }
+        return true;
+    }
+    bool ParseFromString(const std::string& s) override {
+        pbstub::wire::Reader r(s);
+        uint32_t f = 0, wt = 0;
+        uint64_t v = 0;
+        std::string sub;
+        bool ok = true;
+        while (r.next(&f, &wt, &v, &sub, &ok)) {
+            switch (f) {
+                case 1: error_code_ = (int)(int64_t)v; break;
+                case 2: error_text_ = sub; break;
+                case 3: backoff_ms_ = (int64_t)v; break;
+                case 4:
+                    if (!mutable_pool_attachment()->ParseFromString(sub)) {
+                        return false;
+                    }
+                    break;
+                default: break;
+            }
+        }
+        return ok;
+    }
 private:
     int error_code_ = 0;
     int64_t backoff_ms_ = 0;
@@ -389,6 +647,27 @@ public:
     void set_stream_id(uint64_t v) { stream_id_ = v; }
     int64_t window_size() const { return window_size_; }
     void set_window_size(int64_t v) { window_size_ = v; }
+    void Clear() override { *this = StreamSettings(); }
+    bool SerializeToString(std::string* out) const override {
+        out->clear();
+        pbstub::wire::put_u(out, 1, stream_id_);
+        if (window_size_ != 0) {
+            pbstub::wire::put_u(out, 2, (uint64_t)window_size_);
+        }
+        return true;
+    }
+    bool ParseFromString(const std::string& s) override {
+        pbstub::wire::Reader r(s);
+        uint32_t f = 0, wt = 0;
+        uint64_t v = 0;
+        std::string sub;
+        bool ok = true;
+        while (r.next(&f, &wt, &v, &sub, &ok)) {
+            if (f == 1) stream_id_ = v;
+            if (f == 2) window_size_ = (int64_t)v;
+        }
+        return ok;
+    }
 private:
     uint64_t stream_id_ = 0;
     int64_t window_size_ = 0;
@@ -449,67 +728,83 @@ public:
         return &pool_attachment_;
     }
 
-    // Real proto2 wire format for fields 3/5/7 (c_api framing).
+    // Full real proto2 wire format (pbstub_wire.h helpers).
+    void Clear() override { *this = RpcMeta(); }
     bool SerializeToString(std::string* out) const override {
         out->clear();
-        auto varint = [&](uint64_t v) {
-            while (v >= 0x80) {
-                out->push_back((char)(0x80 | (v & 0x7f)));
-                v >>= 7;
-            }
-            out->push_back((char)v);
-        };
+        if (has_request_) pbstub::wire::put_msg(out, 1, request_);
+        if (has_response_) pbstub::wire::put_msg(out, 2, response_);
         if (correlation_id_ != 0) {
-            out->push_back((char)((3 << 3) | 0));
-            varint(correlation_id_);
+            pbstub::wire::put_u(out, 3, correlation_id_);
+        }
+        if (compress_type_ != 0) {
+            pbstub::wire::put_u(out, 4, (uint64_t)compress_type_);
         }
         if (attachment_size_ != 0) {
-            out->push_back((char)((5 << 3) | 0));
-            varint(attachment_size_);
+            pbstub::wire::put_u(out, 5, attachment_size_);
+        }
+        if (has_stream_settings_) {
+            pbstub::wire::put_msg(out, 6, stream_settings_);
         }
         if (has_body_checksum_) {
-            out->push_back((char)((7 << 3) | 0));
-            varint(body_checksum_);
+            pbstub::wire::put_u(out, 7, body_checksum_);
+        }
+        if (!auth_data_.empty()) pbstub::wire::put_str(out, 8, auth_data_);
+        if (cancel_) pbstub::wire::put_u(out, 9, 1);
+        if (goaway_) pbstub::wire::put_u(out, 10, 1);
+        if (has_pool_attachment_) {
+            pbstub::wire::put_msg(out, 11, pool_attachment_);
+        }
+        if (desc_ack_) pbstub::wire::put_u(out, 12, 1);
+        if (desc_ack_token_ != 0) {
+            pbstub::wire::put_u(out, 13, desc_ack_token_);
         }
         return true;
     }
     bool ParseFromString(const std::string& s) override {
-        size_t i = 0;
-        auto varint = [&](uint64_t* v) {
-            *v = 0;
-            int shift = 0;
-            while (i < s.size()) {
-                const uint8_t b = (uint8_t)s[i++];
-                *v |= (uint64_t)(b & 0x7f) << shift;
-                if (!(b & 0x80)) return true;
-                shift += 7;
-                if (shift > 63) return false;
-            }
-            return false;
-        };
-        while (i < s.size()) {
-            uint64_t key = 0;
-            if (!varint(&key)) return false;
-            const uint32_t field = (uint32_t)(key >> 3);
-            const uint32_t wt = (uint32_t)(key & 7);
-            uint64_t v = 0;
-            if (wt == 0) {
-                if (!varint(&v)) return false;
-            } else if (wt == 2) {
-                if (!varint(&v) || i + v > s.size()) return false;
-                i += (size_t)v;
-                continue;
-            } else {
-                return false;
-            }
-            if (field == 3) correlation_id_ = v;
-            if (field == 5) attachment_size_ = (uint32_t)v;
-            if (field == 7) {
-                body_checksum_ = (uint32_t)v;
-                has_body_checksum_ = true;
+        pbstub::wire::Reader r(s);
+        uint32_t f = 0, wt = 0;
+        uint64_t v = 0;
+        std::string sub;
+        bool ok = true;
+        while (r.next(&f, &wt, &v, &sub, &ok)) {
+            switch (f) {
+                case 1:
+                    if (!mutable_request()->ParseFromString(sub)) {
+                        return false;
+                    }
+                    break;
+                case 2:
+                    if (!mutable_response()->ParseFromString(sub)) {
+                        return false;
+                    }
+                    break;
+                case 3: correlation_id_ = v; break;
+                case 4: compress_type_ = (int)v; break;
+                case 5: attachment_size_ = (uint32_t)v; break;
+                case 6:
+                    if (!mutable_stream_settings()->ParseFromString(sub)) {
+                        return false;
+                    }
+                    break;
+                case 7:
+                    body_checksum_ = (uint32_t)v;
+                    has_body_checksum_ = true;
+                    break;
+                case 8: auth_data_ = sub; break;
+                case 9: cancel_ = v != 0; break;
+                case 10: goaway_ = v != 0; break;
+                case 11:
+                    if (!mutable_pool_attachment()->ParseFromString(sub)) {
+                        return false;
+                    }
+                    break;
+                case 12: desc_ack_ = v != 0; break;
+                case 13: desc_ack_token_ = v; break;
+                default: break;
             }
         }
-        return true;
+        return ok;
     }
 private:
     RpcRequestMeta request_;
@@ -591,9 +886,12 @@ private:
 PBEOF
 
 cat > "$DEST/gen/echo.pb.h" << 'PBEOF'
-// STUB of protoc output for cpp/tests/proto/echo.proto.
+// STUB of protoc output for cpp/tests/proto/echo.proto. Real proto2
+// wire format (pbstub_wire.h), so runtime-stub test servers echo real
+// content.
 #pragma once
 #include "pbstub_service.h"
+#include "pbstub_wire.h"
 #include <string>
 namespace test {
 class EchoRequest : public google::protobuf::Message {
@@ -608,6 +906,31 @@ public:
     google::protobuf::Message* New() const override {
         return new EchoRequest;
     }
+    void Clear() override { *this = EchoRequest(); }
+    bool SerializeToString(std::string* out) const override {
+        out->clear();
+        pbstub::wire::put_str(out, 1, message_);
+        if (sleep_us_ != 0) {
+            pbstub::wire::put_u(out, 2, (uint64_t)(int64_t)sleep_us_);
+        }
+        if (fail_with_ != 0) {
+            pbstub::wire::put_u(out, 3, (uint64_t)(int64_t)fail_with_);
+        }
+        return true;
+    }
+    bool ParseFromString(const std::string& s) override {
+        pbstub::wire::Reader r(s);
+        uint32_t f = 0, wt = 0;
+        uint64_t v = 0;
+        std::string sub;
+        bool ok = true;
+        while (r.next(&f, &wt, &v, &sub, &ok)) {
+            if (f == 1) message_ = sub;
+            if (f == 2) sleep_us_ = (int)(int64_t)v;
+            if (f == 3) fail_with_ = (int)(int64_t)v;
+        }
+        return ok;
+    }
 private:
     std::string message_;
     int sleep_us_ = 0;
@@ -620,6 +943,23 @@ public:
     std::string* mutable_message() { return &message_; }
     google::protobuf::Message* New() const override {
         return new EchoResponse;
+    }
+    void Clear() override { *this = EchoResponse(); }
+    bool SerializeToString(std::string* out) const override {
+        out->clear();
+        pbstub::wire::put_str(out, 1, message_);
+        return true;
+    }
+    bool ParseFromString(const std::string& s) override {
+        pbstub::wire::Reader r(s);
+        uint32_t f = 0, wt = 0;
+        uint64_t v = 0;
+        std::string sub;
+        bool ok = true;
+        while (r.next(&f, &wt, &v, &sub, &ok)) {
+            if (f == 1) message_ = sub;
+        }
+        return ok;
     }
 private:
     std::string message_;
@@ -658,9 +998,11 @@ private:
 PBEOF
 
 cat > "$DEST/gen/bench_echo.pb.h" << 'PBEOF'
-// STUB of protoc output for tools/proto/bench_echo.proto.
+// STUB of protoc output for tools/proto/bench_echo.proto. Real proto2
+// wire format (pbstub_wire.h).
 #pragma once
 #include "pbstub_service.h"
+#include "pbstub_wire.h"
 #include <string>
 #include <vector>
 namespace benchpb {
@@ -679,6 +1021,34 @@ public:
     google::protobuf::Message* New() const override {
         return new EchoRequest;
     }
+    void Clear() override { *this = EchoRequest(); }
+    bool SerializeToString(std::string* out) const override {
+        out->clear();
+        if (send_ts_us_ != 0) {
+            pbstub::wire::put_u(out, 1, (uint64_t)send_ts_us_);
+        }
+        if (!payload_.empty()) pbstub::wire::put_str(out, 2, payload_);
+        if (stale_) pbstub::wire::put_u(out, 3, 1);
+        for (const std::string& c : chain_) {
+            pbstub::wire::put_str(out, 4, c);
+        }
+        return true;
+    }
+    bool ParseFromString(const std::string& s) override {
+        pbstub::wire::Reader r(s);
+        uint32_t f = 0, wt = 0;
+        uint64_t v = 0;
+        std::string sub;
+        bool ok = true;
+        chain_.clear();
+        while (r.next(&f, &wt, &v, &sub, &ok)) {
+            if (f == 1) send_ts_us_ = (int64_t)v;
+            if (f == 2) payload_ = sub;
+            if (f == 3) stale_ = v != 0;
+            if (f == 4) chain_.push_back(sub);
+        }
+        return ok;
+    }
 private:
     int64_t send_ts_us_ = 0;
     std::string payload_;
@@ -694,6 +1064,27 @@ public:
     google::protobuf::Message* New() const override {
         return new EchoResponse;
     }
+    void Clear() override { *this = EchoResponse(); }
+    bool SerializeToString(std::string* out) const override {
+        out->clear();
+        if (send_ts_us_ != 0) {
+            pbstub::wire::put_u(out, 1, (uint64_t)send_ts_us_);
+        }
+        if (!payload_.empty()) pbstub::wire::put_str(out, 2, payload_);
+        return true;
+    }
+    bool ParseFromString(const std::string& s) override {
+        pbstub::wire::Reader r(s);
+        uint32_t f = 0, wt = 0;
+        uint64_t v = 0;
+        std::string sub;
+        bool ok = true;
+        while (r.next(&f, &wt, &v, &sub, &ok)) {
+            if (f == 1) send_ts_us_ = (int64_t)v;
+            if (f == 2) payload_ = sub;
+        }
+        return ok;
+    }
 private:
     int64_t send_ts_us_ = 0;
     std::string payload_;
@@ -705,6 +1096,158 @@ using EchoService = pbstub::EchoServiceT<EchoRequest, EchoResponse,
                                          EchoTag>;
 using EchoService_Stub = pbstub::EchoStubT<EchoRequest, EchoResponse,
                                            EchoTag>;
+
+// Collective chunk messages (ISSUE 13). REAL proto2 varint wire format
+// for every field (all are varints), so runtime-stub builds move
+// correct collective metadata over real sockets — the standalone
+// multi-rank collective drive depends on it.
+class CollChunk : public google::protobuf::Message {
+public:
+    uint64_t coll_seq() const { return coll_seq_; }
+    void set_coll_seq(uint64_t v) { coll_seq_ = v; }
+    uint32_t kind() const { return kind_; }
+    void set_kind(uint32_t v) { kind_ = v; }
+    uint32_t step() const { return step_; }
+    void set_step(uint32_t v) { step_ = v; }
+    uint32_t chunk() const { return chunk_; }
+    void set_chunk(uint32_t v) { chunk_ = v; }
+    uint32_t src_rank() const { return src_rank_; }
+    void set_src_rank(uint32_t v) { src_rank_ = v; }
+    uint32_t nranks() const { return nranks_; }
+    void set_nranks(uint32_t v) { nranks_ = v; }
+    uint64_t member_hash() const { return member_hash_; }
+    void set_member_hash(uint64_t v) { member_hash_ = v; }
+    uint64_t total_bytes() const { return total_bytes_; }
+    void set_total_bytes(uint64_t v) { total_bytes_ = v; }
+    uint64_t offset() const { return offset_; }
+    void set_offset(uint64_t v) { offset_ = v; }
+    uint64_t len() const { return len_; }
+    void set_len(uint64_t v) { len_ = v; }
+    google::protobuf::Message* New() const override {
+        return new CollChunk;
+    }
+    void Clear() override { *this = CollChunk(); }
+    bool SerializeToString(std::string* out) const override {
+        out->clear();
+        auto field = [&](uint32_t num, uint64_t v) {
+            if (v != 0) pbstub::wire::put_u(out, num, v);
+        };
+        field(1, coll_seq_);
+        field(2, kind_);
+        field(3, step_);
+        field(4, chunk_);
+        field(5, src_rank_);
+        field(6, nranks_);
+        field(7, member_hash_);
+        field(8, total_bytes_);
+        field(9, offset_);
+        field(10, len_);
+        return true;
+    }
+    bool ParseFromString(const std::string& s) override {
+        pbstub::wire::Reader r(s);
+        uint32_t f = 0, wt = 0;
+        uint64_t v = 0;
+        std::string sub;
+        bool ok = true;
+        while (r.next(&f, &wt, &v, &sub, &ok)) {
+            switch (f) {
+                case 1: coll_seq_ = v; break;
+                case 2: kind_ = (uint32_t)v; break;
+                case 3: step_ = (uint32_t)v; break;
+                case 4: chunk_ = (uint32_t)v; break;
+                case 5: src_rank_ = (uint32_t)v; break;
+                case 6: nranks_ = (uint32_t)v; break;
+                case 7: member_hash_ = v; break;
+                case 8: total_bytes_ = v; break;
+                case 9: offset_ = v; break;
+                case 10: len_ = v; break;
+                default: break;
+            }
+        }
+        return ok;
+    }
+private:
+    uint64_t coll_seq_ = 0, member_hash_ = 0, total_bytes_ = 0;
+    uint64_t offset_ = 0, len_ = 0;
+    uint32_t kind_ = 0, step_ = 0, chunk_ = 0, src_rank_ = 0, nranks_ = 0;
+};
+class CollAck : public google::protobuf::Message {
+public:
+    uint32_t applied() const { return applied_; }
+    void set_applied(uint32_t v) { applied_ = v; }
+    google::protobuf::Message* New() const override { return new CollAck; }
+    void Clear() override { *this = CollAck(); }
+    bool SerializeToString(std::string* out) const override {
+        out->clear();
+        if (applied_ != 0) pbstub::wire::put_u(out, 1, applied_);
+        return true;
+    }
+    bool ParseFromString(const std::string& s) override {
+        pbstub::wire::Reader r(s);
+        uint32_t f = 0, wt = 0;
+        uint64_t v = 0;
+        std::string sub;
+        bool ok = true;
+        while (r.next(&f, &wt, &v, &sub, &ok)) {
+            if (f == 1) applied_ = (uint32_t)v;
+        }
+        return ok;
+    }
+private:
+    uint32_t applied_ = 0;
+};
+// benchpb.CollectiveService: one "Exchange" method (mirrors the protoc
+// generated_service shape the way EchoServiceT does).
+class CollectiveService : public google::protobuf::Service {
+public:
+    static const google::protobuf::ServiceDescriptor* descriptor() {
+        static google::protobuf::ServiceDescriptor* sd = [] {
+            auto* d = new google::protobuf::ServiceDescriptor(
+                "benchpb.CollectiveService");
+            d->add_method("Exchange");
+            return d;
+        }();
+        return sd;
+    }
+    const google::protobuf::ServiceDescriptor* GetDescriptor() override {
+        return descriptor();
+    }
+    virtual void Exchange(google::protobuf::RpcController* controller,
+                          const CollChunk* request, CollAck* response,
+                          google::protobuf::Closure* done) = 0;
+    void CallMethod(const google::protobuf::MethodDescriptor*,
+                    google::protobuf::RpcController* controller,
+                    const google::protobuf::Message* request,
+                    google::protobuf::Message* response,
+                    google::protobuf::Closure* done) override {
+        Exchange(controller, (const CollChunk*)request, (CollAck*)response,
+                 done);
+    }
+    const google::protobuf::Message& GetRequestPrototype(
+        const google::protobuf::MethodDescriptor*) const override {
+        static CollChunk req;
+        return req;
+    }
+    const google::protobuf::Message& GetResponsePrototype(
+        const google::protobuf::MethodDescriptor*) const override {
+        static CollAck res;
+        return res;
+    }
+};
+class CollectiveService_Stub {
+public:
+    explicit CollectiveService_Stub(google::protobuf::RpcChannel* channel)
+        : channel_(channel) {}
+    void Exchange(google::protobuf::RpcController* controller,
+                  const CollChunk* req, CollAck* res,
+                  google::protobuf::Closure* done) {
+        channel_->CallMethod(CollectiveService::descriptor()->method(0),
+                             controller, req, res, done);
+    }
+private:
+    google::protobuf::RpcChannel* channel_;
+};
 }  // namespace benchpb
 PBEOF
 

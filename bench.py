@@ -292,10 +292,10 @@ class _CollNode:
     """One mesh_node handle for the collective round: line-buffered
     stdout reads (READY / COLL lines) + stdin commands."""
 
-    def __init__(self, binary, port, peers):
+    def __init__(self, binary, port, peers, extra=()):
         self.proc = subprocess.Popen(
             [str(binary), "--port", str(port), "--peers", str(peers),
-             "--collective"],
+             "--collective"] + list(extra),
             stdin=subprocess.PIPE, stdout=subprocess.PIPE,
             stderr=subprocess.DEVNULL,
         )
@@ -435,6 +435,111 @@ def collective_scrape():
                     pass
 
 
+def dcn_collective_scrape():
+    """ISSUE 14: hierarchical vs flat all-reduce on an emulated-DCN
+    two-pod topology. Two mesh groups of 3 nodes; intra-pod links are
+    shm, cross-pod links dcn-tier with -dcn_emu_* WAN shaping (10 ms +
+    25 MB/s per connection, both directions — a real cross-DC RTT class). The flat ring drags every
+    boundary-crossing step through the emulated WAN (per-step latency x
+    2(N-1) steps + the full reduced volume over the boundary edges);
+    the hierarchical composition crosses it once per leader — the
+    acceptance gate is hier busbw >= flat on this topology
+    (coll_hier_vs_flat_ratio >= 1.0)."""
+    node = BUILD / "mesh_node"
+    if not node.exists():
+        return None
+    pod = 3
+    socks, ports = [], []
+    for _ in range(2 * pod):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    pod_a, pod_b = ports[:pod], ports[pod:]
+    nodes = []
+    try:
+        with tempfile.TemporaryDirectory() as td:
+            naming = Path(td) / "naming"
+            naming.write_text(
+                "".join("127.0.0.1:%d zone=A\n" % p for p in pod_a)
+                + "".join("127.0.0.1:%d zone=B\n" % p for p in pod_b))
+            dcn_a = Path(td) / "dcn_a"
+            dcn_a.write_text(
+                "".join("127.0.0.1:%d zone=B\n" % p for p in pod_b))
+            dcn_b = Path(td) / "dcn_b"
+            dcn_b.write_text(
+                "".join("127.0.0.1:%d zone=A\n" % p for p in pod_a))
+            shaping = ["--flag", "dcn_emu_latency_us=10000",
+                       "--flag", "dcn_emu_mbps=25"]
+            for i, p in enumerate(ports):
+                in_a = i < pod
+                nodes.append(_CollNode(
+                    node, p, naming,
+                    extra=["--zone", "A" if in_a else "B",
+                           "--dcn_peers",
+                           str(dcn_a if in_a else dcn_b)] + shaping))
+            for n in nodes:
+                if not n.wait_ready():
+                    return None
+            time.sleep(3.0)  # shm + probed dcn links
+
+            seq = [500]
+
+            def round_once(alg, nbytes):
+                seq[0] += 1
+                for n in nodes:
+                    n.send("coll %s %d %d" % (alg, nbytes, seq[0]))
+                deadline = time.time() + 120.0
+                reps = [n.coll_line(deadline) for n in nodes]
+                if any(r is None or not r.get("ok") or
+                       not r.get("verified") or
+                       r.get("nranks") != 2 * pod for r in reps):
+                    return None
+                return min(r["busbw_mbps"] for r in reps)
+
+            def busbw(alg, nbytes, reps=3):
+                vals = []
+                for _ in range(reps):
+                    v = round_once(alg, nbytes)
+                    if v is None:
+                        return None
+                    vals.append(v)
+                return statistics.median(vals)
+
+            # 512 KiB: large enough that bandwidth matters, small
+            # enough that the flat ring's 2(N-1) latency-synchronized
+            # steps dominate over CPU noise on small containers — the
+            # regime the hierarchical composition exists for.
+            payload = 512 << 10
+            flat = busbw("allreduce", payload)
+            hier = busbw("hier_allreduce", payload)
+            if flat is None or hier is None:
+                return None
+            out = {
+                "coll_flat_dcn_allreduce_busbw_mbps": round(flat, 1),
+                "coll_hier_allreduce_busbw_mbps": round(hier, 1),
+                "coll_hier_vs_flat_ratio": round(hier / flat, 2)
+                if flat > 0 else 0.0,
+                "coll_dcn_pods": 2,
+            }
+            return out
+    except Exception:
+        return None
+    finally:
+        for n in nodes:
+            try:
+                n.proc.stdin.close()
+                n.proc.wait(timeout=10)
+            except Exception:
+                try:
+                    n.proc.kill()
+                    n.proc.wait()
+                except Exception:
+                    pass
+
+
 def qos_isolation_scrape():
     """QoS isolation trajectory (ISSUE 8): boot one mesh_node with
     tenant quotas, run one mixed-tenant press where bronze floods at 8x
@@ -548,7 +653,14 @@ _SKIP_KEYS = {"metric", "unit", "vs_baseline", "reps", "error",
               # the ratio re-derives from two compared/contextual keys;
               # nranks is shape, zero_inline a boolean proof.
               "coll_allreduce_serial_mbps", "coll_allreduce_pipeline_ratio",
-              "coll_nranks", "coll_zero_inline"}
+              "coll_nranks", "coll_zero_inline",
+              # Emulated-DCN round (ISSUE 14): the hier busbw IS
+              # compared; the flat number measures the deliberately-WAN-
+              # dragged baseline on an emulated pipe, and the ratio
+              # re-derives from the two (the >= 1.0 acceptance lives in
+              # the verify recipe); pod count is shape.
+              "coll_flat_dcn_allreduce_busbw_mbps",
+              "coll_hier_vs_flat_ratio", "coll_dcn_pods"}
 
 
 def _lower_is_better(key):
@@ -693,6 +805,7 @@ def run_bench():
     series = series_scrape()
     qos = qos_isolation_scrape()
     coll = collective_scrape()
+    dcn_coll = dcn_collective_scrape()
 
     mbps = float(ici["mbps"])
     out = {
@@ -725,6 +838,8 @@ def run_bench():
         out.update(qos)
     if coll is not None:
         out.update(coll)
+    if dcn_coll is not None:
+        out.update(dcn_coll)
     print(json.dumps(out))
 
 

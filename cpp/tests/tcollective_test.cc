@@ -59,6 +59,7 @@ struct TestRank {
     std::unique_ptr<CollectiveEngine> engine;
     std::shared_ptr<Channel> chan;  // TO this rank's server
     uint64_t key = 0;
+    std::string zone;  // pod identity (hier collectives, ISSUE 14)
     std::atomic<bool> dead{false};  // excluded from every membership view
 };
 
@@ -68,6 +69,7 @@ void TestMembership::GetMembers(std::vector<Member>* out) {
         Member m;
         m.key = r->key;
         m.self = r == self;
+        m.zone = r->zone;
         if (!m.self) m.chan = r->chan;
         out->push_back(m);
     }
@@ -154,6 +156,10 @@ void* DriveOne(void* argp) {
             a->rc = a->rank->engine->AllToAll(a->seq, a->blocks,
                                               a->block_bytes, &a->out,
                                               &a->result);
+            break;
+        case 4:
+            a->rc = a->rank->engine->HierAllReduce(
+                a->seq, a->words.data(), a->words.size(), &a->result);
             break;
     }
     a->finished->signal();
@@ -387,6 +393,142 @@ TEST(Collective, MemberDeathReformsOverSurvivors) {
         ASSERT_EQ(0, args[i].rc);
         EXPECT_EQ(2u, args[i].result.nranks);
         EXPECT_GE(args[i].result.reforms, 1);
+        EXPECT_TRUE(args[i].words == expect);
+    }
+}
+
+// ---------------- hierarchical collectives (ISSUE 14) ----------------
+
+TEST(Collective, HierAllReduceMatchesGlobalSum) {
+    // Two "pods" of two ranks each: the hierarchical composition (zone
+    // ring -> leader exchange -> zone broadcast-ring) must produce the
+    // SAME bits as a flat global all-reduce, and report the full
+    // contributing key set.
+    TestMesh mesh(4, SmallOpts());
+    for (int i = 0; i < 4; ++i) mesh.ranks[i]->zone = i < 2 ? "A" : "B";
+    const size_t nwords = 4096;
+    std::vector<DriverArg> args(4);
+    for (int i = 0; i < 4; ++i) {
+        args[i].rank = mesh.ranks[i];
+        args[i].seq = 1;
+        args[i].op = 4;
+        args[i].words.resize(nwords);
+        CollectiveEngine::FillDeterministic(1, mesh.ranks[i]->key,
+                                            args[i].words.data(), nwords);
+    }
+    DriveAll(args);
+    std::vector<uint64_t> keys;
+    for (TestRank* r : mesh.ranks) keys.push_back(r->key);
+    std::sort(keys.begin(), keys.end());
+    std::vector<uint32_t> expect = ExpectedSum(1, keys, nwords);
+    for (int i = 0; i < 4; ++i) {
+        if (args[i].rc != 0) {
+            fprintf(stderr, "hier rank %d rc=%d error=%d nranks=%u\n", i,
+                    args[i].rc, args[i].result.error,
+                    args[i].result.nranks);
+        }
+        ASSERT_EQ(0, args[i].rc);
+        EXPECT_EQ(4u, args[i].result.nranks);
+        EXPECT_TRUE(args[i].result.member_keys == keys);
+        EXPECT_TRUE(args[i].words == expect);
+        EXPECT_GT(args[i].result.busbw_mbps, 0.0);
+    }
+}
+
+TEST(Collective, HierAllReduceZonelessDegradesToSingleZone) {
+    // No zones configured: one zone of everything — phase 2 is a
+    // single-leader no-op and the result is still the global sum.
+    TestMesh mesh(3, SmallOpts());
+    const size_t nwords = 1024;
+    std::vector<DriverArg> args(3);
+    for (int i = 0; i < 3; ++i) {
+        args[i].rank = mesh.ranks[i];
+        args[i].seq = 1;
+        args[i].op = 4;
+        args[i].words.resize(nwords);
+        CollectiveEngine::FillDeterministic(1, mesh.ranks[i]->key,
+                                            args[i].words.data(), nwords);
+    }
+    DriveAll(args);
+    std::vector<uint64_t> keys;
+    for (TestRank* r : mesh.ranks) keys.push_back(r->key);
+    std::sort(keys.begin(), keys.end());
+    std::vector<uint32_t> expect = ExpectedSum(1, keys, nwords);
+    for (int i = 0; i < 3; ++i) {
+        ASSERT_EQ(0, args[i].rc);
+        EXPECT_EQ(3u, args[i].result.nranks);
+        EXPECT_TRUE(args[i].words == expect);
+    }
+}
+
+TEST(Collective, HierAllReduceSurvivesWholePodPartition) {
+    // Pod B dies mid-program: pod A's hierarchical round must complete
+    // over the SURVIVING pod — the leader exchange degrades to a no-op
+    // and the result is pod A's sum, with member_keys reporting exactly
+    // the survivors (the mesh driver verifies against that set).
+    CollectiveOptions opts = SmallOpts();
+    opts.attempt_timeout_ms = 1200;  // fail into the dead pod quickly
+    TestMesh mesh(4, opts);
+    for (int i = 0; i < 4; ++i) mesh.ranks[i]->zone = i < 2 ? "A" : "B";
+    const size_t nwords = 2048;
+
+    // Round 1: both pods alive (warms rounds + proves the topology).
+    {
+        std::vector<DriverArg> args(4);
+        for (int i = 0; i < 4; ++i) {
+            args[i].rank = mesh.ranks[i];
+            args[i].seq = 1;
+            args[i].op = 4;
+            args[i].words.resize(nwords);
+            CollectiveEngine::FillDeterministic(
+                1, mesh.ranks[i]->key, args[i].words.data(), nwords);
+        }
+        DriveAll(args);
+        for (int i = 0; i < 4; ++i) ASSERT_EQ(0, args[i].rc);
+    }
+
+    // Whole pod B partitions: its servers stop but stay in the
+    // membership view until the detector flips them — pod A's first
+    // leader exchange fails into the dead pod, then re-probes.
+    for (int i = 2; i < 4; ++i) {
+        mesh.ranks[i]->engine->Shutdown();
+        mesh.ranks[i]->server.Stop();
+        mesh.ranks[i]->server.Join();
+    }
+    std::atomic<bool> flipped{false};
+    KillArg ka2{mesh.ranks[2], &flipped};
+    KillArg ka3{mesh.ranks[3], &flipped};
+    fiber_t k2, k3;
+    ASSERT_EQ(0, fiber_start_background(&k2, nullptr, KillAfterDelay, &ka2));
+    ASSERT_EQ(0, fiber_start_background(&k3, nullptr, KillAfterDelay, &ka3));
+
+    std::vector<DriverArg> args(2);
+    for (int i = 0; i < 2; ++i) {
+        args[i].rank = mesh.ranks[i];
+        args[i].seq = 2;
+        args[i].op = 4;
+        args[i].words.resize(nwords);
+        CollectiveEngine::FillDeterministic(2, mesh.ranks[i]->key,
+                                            args[i].words.data(), nwords);
+    }
+    DriveAll(args);
+    fiber_join(k2, nullptr);
+    fiber_join(k3, nullptr);
+
+    std::vector<uint64_t> survivors{mesh.ranks[0]->key,
+                                    mesh.ranks[1]->key};
+    std::sort(survivors.begin(), survivors.end());
+    std::vector<uint32_t> expect = ExpectedSum(2, survivors, nwords);
+    for (int i = 0; i < 2; ++i) {
+        if (args[i].rc != 0) {
+            fprintf(stderr,
+                    "hier-partition rank %d rc=%d error=%d nranks=%u\n",
+                    i, args[i].rc, args[i].result.error,
+                    args[i].result.nranks);
+        }
+        ASSERT_EQ(0, args[i].rc);
+        EXPECT_EQ(2u, args[i].result.nranks);
+        EXPECT_TRUE(args[i].result.member_keys == survivors);
         EXPECT_TRUE(args[i].words == expect);
     }
 }

@@ -607,3 +607,312 @@ TEST(ClusterRecovery, GatesTrafficWhileClusterRefills) {
     }
     EXPECT_EQ(post_ok, 10);
 }
+
+// ---------------- locality zones (ISSUE 14) ----------------
+
+namespace {
+
+// RAII zone flag: every ZoneAware test must leave the process zoneless
+// (the rest of the suite assumes passthrough LBs).
+struct ScopedZone {
+    explicit ScopedZone(const char* z) { SetFlagValue("rpc_zone", z); }
+    ~ScopedZone() { SetFlagValue("rpc_zone", ""); }
+};
+
+ServerNode zoned_node(SocketId id, const char* zone) {
+    ServerNode n;
+    n.id = id;
+    n.weight = 1;
+    str2endpoint("127.0.0.1", 1, &n.ep);
+    n.zone = zone;
+    return n;
+}
+
+void drain_socket(SocketId id) {
+    Socket* s = Socket::Address(id);
+    ASSERT_TRUE(s != nullptr);
+    s->SetDraining();
+    s->Dereference();
+}
+
+}  // namespace
+
+// The two-level fallback ordering, identical across every policy:
+// local-live > local-draining > remote-live (spill counted).
+TEST(ZoneAwareLB, FallbackOrderingAcrossPolicies) {
+    ScopedZone zone("A");
+    const char* const policies[] = {"rr", "wrr", "random", "c_murmurhash",
+                                    "la"};
+    int next_port = 21500;
+    for (const char* policy : policies) {
+        std::unique_ptr<LoadBalancer> lb(LoadBalancer::New(policy));
+        ASSERT_TRUE(lb != nullptr);
+        const SocketId l1 = make_fake_server(next_port++);
+        const SocketId l2 = make_fake_server(next_port++);
+        const SocketId r1 = make_fake_server(next_port++);
+        const SocketId r2 = make_fake_server(next_port++);
+        const std::set<SocketId> locals{l1, l2}, remotes{r1, r2};
+        EXPECT_TRUE(lb->AddServer(zoned_node(l1, "A")));
+        EXPECT_TRUE(lb->AddServer(zoned_node(l2, "A")));
+        EXPECT_TRUE(lb->AddServer(zoned_node(r1, "B")));
+        EXPECT_TRUE(lb->AddServer(zoned_node(r2, "B")));
+        auto* zlb = static_cast<ZoneAwareLoadBalancer*>(lb.get());
+        EXPECT_EQ(2u, zlb->local_count()) << policy;
+        EXPECT_EQ(2u, zlb->remote_count()) << policy;
+        SelectIn in;
+        SelectOut out;
+        // 1) local-live: every pick lands in zone A, never spilled.
+        for (int i = 0; i < 16; ++i) {
+            out = SelectOut();
+            ASSERT_EQ(0, lb->SelectServer(in, &out));
+            EXPECT_TRUE(locals.count(out.ptr->id())) << policy;
+            EXPECT_FALSE(out.zone_spilled) << policy;
+        }
+        // 2) one local draining: picks converge on the other local.
+        drain_socket(l1);
+        for (int i = 0; i < 8; ++i) {
+            out = SelectOut();
+            ASSERT_EQ(0, lb->SelectServer(in, &out));
+            EXPECT_EQ(l2, out.ptr->id()) << policy;
+            EXPECT_FALSE(out.zone_spilled) << policy;
+        }
+        // 3) whole local zone draining: a draining LOCAL still beats a
+        // live remote (it serves, and the pod boundary costs WAN).
+        drain_socket(l2);
+        for (int i = 0; i < 8; ++i) {
+            out = SelectOut();
+            ASSERT_EQ(0, lb->SelectServer(in, &out));
+            EXPECT_TRUE(locals.count(out.ptr->id())) << policy;
+            EXPECT_FALSE(out.zone_spilled) << policy;
+        }
+        // 4) local zone DEAD: spill to a live remote, marked + counted.
+        Socket::SetFailedById(l1);
+        Socket::SetFailedById(l2);
+        for (int i = 0; i < 8; ++i) {
+            out = SelectOut();
+            ASSERT_EQ(0, lb->SelectServer(in, &out));
+            EXPECT_TRUE(remotes.count(out.ptr->id())) << policy;
+            EXPECT_TRUE(out.zone_spilled) << policy;
+        }
+        out = SelectOut();
+        Socket::SetFailedById(r1);
+        Socket::SetFailedById(r2);
+    }
+}
+
+// A retry that already tried the only live local member must reach the
+// OTHER pod before re-hitting it (excluded-local < remote-live).
+TEST(ZoneAwareLB, RetryPrefersRemoteOverTriedLocal) {
+    ScopedZone zone("A");
+    std::unique_ptr<LoadBalancer> lb(LoadBalancer::New("rr"));
+    const SocketId l1 = make_fake_server(21600);
+    const SocketId r1 = make_fake_server(21601);
+    lb->AddServer(zoned_node(l1, "A"));
+    lb->AddServer(zoned_node(r1, "B"));
+    ExcludedServers excluded;
+    excluded.Add(l1);
+    SelectIn in;
+    in.excluded = &excluded;
+    SelectOut out;
+    ASSERT_EQ(0, lb->SelectServer(in, &out));
+    EXPECT_EQ(r1, out.ptr->id());
+    EXPECT_TRUE(out.zone_spilled);
+    Socket::SetFailedById(l1);
+    Socket::SetFailedById(r1);
+}
+
+// -lb_zone_spill_dead_pct below 100: once that fraction of the local
+// zone is DEAD (draining does not count), remote-live wins even while
+// a local member still serves — the breaker-storm escape hatch.
+TEST(ZoneAwareLB, DeadPctThresholdSpillsEarly) {
+    ScopedZone zone("A");
+    SetFlagValue("lb_zone_spill_dead_pct", "50");
+    std::unique_ptr<LoadBalancer> lb(LoadBalancer::New("rr"));
+    const SocketId l1 = make_fake_server(21610);
+    const SocketId l2 = make_fake_server(21611);
+    const SocketId r1 = make_fake_server(21612);
+    lb->AddServer(zoned_node(l1, "A"));
+    lb->AddServer(zoned_node(l2, "A"));
+    lb->AddServer(zoned_node(r1, "B"));
+    SelectIn in;
+    SelectOut out;
+    // Healthy zone: local.
+    ASSERT_EQ(0, lb->SelectServer(in, &out));
+    EXPECT_FALSE(out.zone_spilled);
+    // Half the zone dead (>= 50%): spill even though l2 is live.
+    Socket::SetFailedById(l1);
+    for (int i = 0; i < 6; ++i) {
+        out = SelectOut();
+        ASSERT_EQ(0, lb->SelectServer(in, &out));
+        EXPECT_EQ(r1, out.ptr->id());
+        EXPECT_TRUE(out.zone_spilled);
+    }
+    SetFlagValue("lb_zone_spill_dead_pct", "100");
+    Socket::SetFailedById(l2);
+    Socket::SetFailedById(r1);
+}
+
+// Zoneless processes and zoneless members: the wrapper is a strict
+// passthrough (no spill accounting, identical behavior to the bare
+// policy).
+TEST(ZoneAwareLB, ZonelessPassthrough) {
+    std::unique_ptr<LoadBalancer> lb(LoadBalancer::New("rr"));
+    const SocketId a = make_fake_server(21620);
+    const SocketId b = make_fake_server(21621);
+    lb->AddServer(zoned_node(a, ""));
+    lb->AddServer(zoned_node(b, "B"));  // zoned member, zoneless process
+    auto* zlb = static_cast<ZoneAwareLoadBalancer*>(lb.get());
+    EXPECT_EQ(2u, zlb->local_count());
+    EXPECT_EQ(0u, zlb->remote_count());
+    SelectIn in;
+    SelectOut out;
+    std::set<SocketId> seen;
+    for (int i = 0; i < 8; ++i) {
+        out = SelectOut();
+        ASSERT_EQ(0, lb->SelectServer(in, &out));
+        EXPECT_FALSE(out.zone_spilled);
+        seen.insert(out.ptr->id());
+    }
+    EXPECT_EQ(2u, seen.size());
+    Socket::SetFailedById(a);
+    Socket::SetFailedById(b);
+}
+
+// Per-zone deterministic subsetting (ISSUE 14 satellite): each zone
+// keeps its own -subset_size members and its own live floor — a zone
+// death recomputes THAT zone's group (full-set fallback for it alone)
+// while the other zone's chosen members never churn.
+TEST(ZoneAwareLB, PerZoneSubsetFloorRecompute) {
+    ScopedZone zone("A");
+    SetFlagValue("subset_size", "2");
+    SetFlagValue("min_subset", "2");
+    SetFlagValue("subset_seed", "7");
+    char path[] = "/tmp/tpurpc_zone_ns_XXXXXX";
+    int fd = mkstemp(path);
+    ASSERT_GE(fd, 0);
+    std::string content;
+    for (int p = 9321; p <= 9324; ++p) {
+        content += "127.0.0.1:" + std::to_string(p) + " zone=A\n";
+    }
+    for (int p = 9331; p <= 9334; ++p) {
+        content += "127.0.0.1:" + std::to_string(p) + " zone=B\n";
+    }
+    (void)!write(fd, content.data(), content.size());
+    close(fd);
+
+    LoadBalancerWithNaming lbn;
+    ASSERT_EQ(0, lbn.Init(std::string("file://") + path, "rr"));
+    auto by_zone = [&](const std::vector<SocketId>& ids, bool want_b) {
+        std::set<SocketId> out;
+        for (SocketId id : ids) {
+            // UnsafeAddress: dead members (the full-set fallback keeps
+            // them in the LB so revives can serve again) still resolve
+            // for the port read.
+            Socket* s = Socket::UnsafeAddress(id);
+            if (s == nullptr) continue;
+            if ((s->remote_side().port >= 9331) == want_b) out.insert(id);
+        }
+        return out;
+    };
+    std::vector<SocketId> members = lbn.CurrentLbMembers();
+    std::set<SocketId> a0 = by_zone(members, false);
+    std::set<SocketId> b0 = by_zone(members, true);
+    EXPECT_EQ(2u, a0.size()) << members.size();
+    EXPECT_EQ(2u, b0.size());
+    // Cross-zone members ride the dcn tier (naming created them from
+    // the zone=B tags).
+    for (SocketId id : b0) {
+        Socket* s = Socket::Address(id);
+        ASSERT_TRUE(s != nullptr);
+        EXPECT_EQ(TierDcn(), s->transport_tier());
+        s->Dereference();
+    }
+
+    // A retry that excluded every subset member pins the FULL set for
+    // a pass; once healthy again, BOTH zones must SHRINK BACK to their
+    // subsets (the per-zone shrink-back trigger — a zone must never
+    // stay in full-set fan-out after it healed).
+    {
+        SelectIn in;
+        SelectOut out;
+        ExcludedServers ex;
+        for (SocketId id : members) ex.Add(id);
+        SelectIn exin;
+        exin.excluded = &ex;
+        (void)lbn.SelectServer(exin, &out);
+        out = SelectOut();
+        bool full_seen = false, shrunk = false;
+        for (int wait = 0; wait < 100; ++wait) {
+            members = lbn.CurrentLbMembers();
+            if (members.size() == 8) full_seen = true;
+            if (full_seen && members.size() == 4) {
+                shrunk = true;
+                break;
+            }
+            usleep(25 * 1000);  // past the refresh rate limit
+            (void)lbn.SelectServer(in, &out);
+            out = SelectOut();
+        }
+        EXPECT_TRUE(full_seen) << members.size();
+        EXPECT_TRUE(shrunk) << members.size();
+        members = lbn.CurrentLbMembers();
+        EXPECT_EQ(a0, by_zone(members, false));
+        EXPECT_EQ(b0, by_zone(members, true));
+    }
+
+    // Kill zone B's two CHOSEN members: B regains its floor from the
+    // unchosen B members; A's subset must not move.
+    for (SocketId id : b0) Socket::SetFailedById(id);
+    SelectIn in;
+    SelectOut out;
+    std::set<SocketId> b1;
+    for (int wait = 0; wait < 100; ++wait) {
+        usleep(25 * 1000);  // the refresh sweep is rate-limited (20ms)
+        (void)lbn.SelectServer(in, &out);
+        out = SelectOut();
+        members = lbn.CurrentLbMembers();
+        b1 = by_zone(members, true);
+        bool replaced = !b1.empty();
+        for (SocketId id : b1) replaced &= b0.count(id) == 0;
+        if (replaced && b1.size() == 2) break;
+    }
+    EXPECT_EQ(2u, b1.size());
+    for (SocketId id : b1) {
+        EXPECT_EQ(0u, b0.count(id)) << "chosen-dead member kept";
+    }
+    EXPECT_EQ(a0, by_zone(members, false)) << "zone A churned on B death";
+
+    // Kill ALL of zone B: below the floor, B alone falls back to its
+    // full set; A still holds its 2-member subset.
+    for (SocketId id : b1) Socket::SetFailedById(id);
+    std::set<SocketId> b2;
+    for (int wait = 0; wait < 100; ++wait) {
+        usleep(25 * 1000);
+        (void)lbn.SelectServer(in, &out);
+        out = SelectOut();
+        members = lbn.CurrentLbMembers();
+        b2 = by_zone(members, true);
+        if (b2.size() == 4 && by_zone(members, false).size() == 2) break;
+    }
+    EXPECT_EQ(4u, b2.size()) << "dead zone did not fall back to full set";
+    EXPECT_EQ(a0, by_zone(members, false));
+
+    SetFlagValue("subset_size", "0");
+    SetFlagValue("min_subset", "0");
+    SetFlagValue("subset_seed", "0");
+    unlink(path);
+}
+
+// The zone=... naming tag parses alongside weights, order-independent.
+TEST(NamingService, ZoneTagParses) {
+    NSNode node;
+    ASSERT_EQ(0,
+              ParseNamingLine("127.0.0.1:8002 w=3 zone=pod-a", &node));
+    EXPECT_EQ(3, WeightFromTag(node.tag));
+    EXPECT_EQ("pod-a", ZoneFromTag(node.tag));
+    ASSERT_EQ(0, ParseNamingLine("127.0.0.1:8003 zone=b w=2", &node));
+    EXPECT_EQ(2, WeightFromTag(node.tag));
+    EXPECT_EQ("b", ZoneFromTag(node.tag));
+    EXPECT_EQ("", ZoneFromTag("w=4"));
+    EXPECT_EQ("", ZoneFromTag(""));
+}

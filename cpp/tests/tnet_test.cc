@@ -665,3 +665,95 @@ TEST(TransportTier, DescriptorSeamGatesOnTierAndPool) {
     s.reset();
     close(fds[1]);
 }
+
+TEST(TransportTier, DcnTierRegisteredAndDescriptorIncapable) {
+    // The cross-pod tier (ISSUE 14): a distinct registry entry — plain
+    // byte stream, descriptor-INCAPABLE (the pod boundary shares no
+    // pool mapping), cross-process. A socket forced onto it reports the
+    // tier and fails both descriptor seams, so a pinned try degrades to
+    // inline through the one seam.
+    const int dcn = TierDcn();
+    ASSERT_GE(dcn, 0);
+    ASSERT_NE(dcn, TierTcp());
+    const TransportTier* t = GetTransportTier(dcn);
+    ASSERT_TRUE(t != nullptr);
+    EXPECT_FALSE(t->descriptor_capable);
+    EXPECT_FALSE(t->zero_copy);
+    EXPECT_TRUE(t->cross_process);
+    EXPECT_EQ(dcn, FindTransportTier("dcn"));
+    EXPECT_TRUE(transport_stats::DebugString().find("tier dcn") !=
+                std::string::npos);
+
+    int fds[2];
+    ASSERT_EQ(0, socketpair(AF_UNIX, SOCK_STREAM, 0, fds));
+    SocketOptions opts;
+    opts.fd = fds[0];
+    opts.forced_transport_tier = dcn;
+    SocketId sid;
+    ASSERT_EQ(0, Socket::Create(opts, &sid));
+    SocketUniquePtr s;
+    ASSERT_EQ(0, Socket::AddressSocket(sid, &s));
+    EXPECT_EQ(dcn, s->transport_tier());
+    EXPECT_EQ(dcn, s->forced_transport_tier());
+    EXPECT_FALSE(TransportDescriptorCapable(s.get()));
+    EXPECT_FALSE(TransportDescriptorScopeOk(s.get(), 42));
+    s->SetFailedWithError(TERR_CLOSE);
+    s.reset();
+    close(fds[1]);
+
+    // Shaping arithmetic: latency + bytes/mbps, dcn-tier only.
+    SetFlagValue("dcn_emu_latency_us", "500");
+    SetFlagValue("dcn_emu_mbps", "100");
+    EXPECT_TRUE(DcnShapingEnabled());
+    EXPECT_EQ((int64_t)500 + 1000000 / 100,
+              DcnShapeDelayUs(dcn, 1000000));
+    // Inbound half: bandwidth only (latency is the writer's, once per
+    // message — never per read burst).
+    EXPECT_EQ((int64_t)1000000 / 100, DcnShapeReadDelayUs(dcn, 1000000));
+    EXPECT_EQ((int64_t)0, DcnShapeDelayUs(TierTcp(), 1000000));
+    EXPECT_EQ((int64_t)0, DcnShapeReadDelayUs(TierTcp(), 1000000));
+    SetFlagValue("dcn_emu_latency_us", "0");
+    SetFlagValue("dcn_emu_mbps", "0");
+    EXPECT_FALSE(DcnShapingEnabled());
+    EXPECT_EQ((int64_t)0, DcnShapeDelayUs(dcn, 1000000));
+}
+
+TEST(TransportTier, SocketMapKeyedByEndpointAndTier) {
+    // (endpoint, tier) keying (ISSUE 14 satellite): a tcp and a dcn
+    // "connection" to the SAME address are different sockets with
+    // independent health state — a dcn failure never poisons the tcp
+    // path, and each tier reconnects independently.
+    InputMessenger m;
+    EndPoint ep;
+    str2endpoint("127.0.0.1:1", &ep);  // never connected (no write)
+    SocketId tcp_id = INVALID_VREF_ID, dcn_id = INVALID_VREF_ID;
+    ASSERT_EQ(0, SocketMap::singleton()->GetOrCreate(ep, &m, &tcp_id));
+    ASSERT_EQ(0, SocketMap::singleton()->GetOrCreate(ep, &m, &dcn_id,
+                                                     TierDcn()));
+    EXPECT_NE(tcp_id, dcn_id);
+    {
+        SocketUniquePtr s;
+        ASSERT_EQ(0, Socket::AddressSocket(dcn_id, &s));
+        EXPECT_EQ(TierDcn(), s->transport_tier());
+    }
+    // Lookups are sticky per tier.
+    SocketId again = INVALID_VREF_ID;
+    ASSERT_EQ(0, SocketMap::singleton()->GetOrCreate(ep, &m, &again));
+    EXPECT_EQ(tcp_id, again);
+    ASSERT_EQ(0, SocketMap::singleton()->GetOrCreate(ep, &m, &again,
+                                                     TierDcn()));
+    EXPECT_EQ(dcn_id, again);
+    // Failing the dcn socket replaces only the dcn entry; the tcp one
+    // keeps its id (health state never shared across tiers).
+    Socket::SetFailedById(dcn_id);
+    SocketId fresh = INVALID_VREF_ID;
+    ASSERT_EQ(0, SocketMap::singleton()->GetOrCreate(ep, &m, &fresh,
+                                                     TierDcn()));
+    EXPECT_NE(dcn_id, fresh);
+    ASSERT_EQ(0, SocketMap::singleton()->GetOrCreate(ep, &m, &again));
+    EXPECT_EQ(tcp_id, again);
+    Socket::SetFailedById(tcp_id);
+    Socket::SetFailedById(fresh);
+    SocketMap::singleton()->Remove(ep, tcp_id);
+    SocketMap::singleton()->Remove(ep, fresh, TierDcn());
+}

@@ -31,6 +31,7 @@
 #include "tnet/input_messenger.h"
 #include "tnet/socket.h"
 #include "trpc/collective.h"
+#include "trpc/load_balancer.h"
 #include "trpc/rpcz_stitch.h"
 #include "trpc/server.h"
 #include "trpc/span.h"
@@ -578,7 +579,10 @@ void HandleChaos(Server*, const HttpRequest& req, HttpResponse* res) {
     } params[] = {{"chaos_plan", "plan", false, ""},
                   {"chaos_peers", "peers", false, ""},
                   {"chaos_seed", "seed", false, ""},
-                  {"chaos_enabled", "enable", false, ""}};
+                  {"chaos_enabled", "enable", false, ""},
+                  // Whole-zone partition (ISSUE 14): any zone name (or
+                  // "" to heal) — one request cuts a pod.
+                  {"chaos_partition_zone", "partition_zone", false, ""}};
     for (Param& p : params) {
         p.value = req.QueryParam(p.name, &p.present);
     }
@@ -598,10 +602,10 @@ void HandleChaos(Server*, const HttpRequest& req, HttpResponse* res) {
             char* end = nullptr;
             (void)strtoll(p.value.c_str(), &end, 10);
             ok = end != p.value.c_str() && *end == '\0';
-        } else {  // enable
+        } else if (strcmp(p.name, "enable") == 0) {
             ok = p.value == "0" || p.value == "1" || p.value == "true" ||
                  p.value == "false";
-        }
+        }  // partition_zone: any name is valid; "" heals
         if (!ok) {
             reject(p);
             return;
@@ -775,6 +779,7 @@ void AddBuiltinHttpServices(Server* server) {
     block_lease::ExposeVars();
     transport_stats::ExposeVars();
     CollectiveEngine::ExposeVars();
+    ExposeZoneLbVars();
     server->RegisterHttpHandler("/", HandleIndex);
     server->RegisterHttpHandler("/health", HandleHealth);
     server->RegisterHttpHandler("/status", HandleStatus);

@@ -4,6 +4,8 @@
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
+#include <map>
+#include <mutex>
 #include <vector>
 
 #include "tbase/doubly_buffered_data.h"
@@ -35,6 +37,13 @@ DEFINE_string(chaos_peers, "",
               "comma list of ip:port remote endpoints the plan applies "
               "to; empty = all peers. Non-matching traffic neither "
               "injects nor consumes a decision tick");
+DEFINE_string(chaos_partition_zone, "",
+              "partition THIS node from every peer registered (via "
+              "FaultInjection::SetPeerZone / mesh zone tags) in the "
+              "named zone: their reads/writes reset, connects refuse — "
+              "one command cuts a whole pod (ISSUE 14). Empty = no "
+              "partition. Independent of chaos_plan and the "
+              "deterministic decision sequence");
 
 namespace tpurpc {
 
@@ -83,6 +92,15 @@ struct FaultPlan {
     int64_t delay_us = 2000;
     int64_t ring_delay_us = 2000;
     std::vector<EndPoint> peers;  // empty = every peer
+    // Zone partition (ISSUE 14): all traffic to peers of this zone is
+    // cut. Lives in the doubly-buffered plan so the hot path reads it
+    // with the same scoped read as everything else.
+    std::string partition_zone;
+    // Snapshot of chaos_enabled at apply time: a partition set while
+    // the probability plan is HEALED (enable=0, plan string kept for
+    // replay inspection) must cut the zone WITHOUT resurrecting the
+    // plan — g_chaos_on alone can no longer distinguish the two.
+    bool plan_enabled = false;
 
     bool Matches(const EndPoint& peer) const {
         if (peers.empty()) return true;
@@ -93,12 +111,25 @@ struct FaultPlan {
     }
 };
 
+// Peer -> zone registry feeding the partition check. Small (one entry
+// per configured mesh peer), mutated rarely (startup / naming refresh),
+// read only while chaos is enabled.
+struct ZoneRegistry {
+    std::mutex mu;
+    std::map<EndPoint, std::string> zones;
+};
+ZoneRegistry& zone_registry() {
+    static ZoneRegistry* z = new ZoneRegistry;  // immortal, like Engine
+    return *z;
+}
+
 struct Engine {
     DoublyBufferedData<FaultPlan> plan;
     std::atomic<uint64_t> seed{1};
     std::atomic<uint64_t> seq{0};  // decision counter (determinism core)
     Adder<int64_t> injected[FaultAction::kKindCount];
     Adder<int64_t> ndecisions;
+    Adder<int64_t> zone_cuts;  // whole-zone partition hits (ISSUE 14)
 
     Engine() {
         for (int k = FaultAction::kDelay; k < FaultAction::kKindCount; ++k) {
@@ -106,6 +137,7 @@ struct Engine {
                                kKindNames[k]);
         }
         ndecisions.expose("chaos_decisions");
+        zone_cuts.expose("chaos_zone_partition_cuts");
     }
 };
 
@@ -225,6 +257,10 @@ struct HookInstaller {
         FLAGS_chaos_plan.set_on_change(
             &FaultInjection::ReconfigureAndReset);
         FLAGS_chaos_peers.set_on_change(&FaultInjection::Reconfigure);
+        // Partition flips (set and heal) keep counters AND the plan's
+        // deterministic sequence: a partition layers over a replay.
+        FLAGS_chaos_partition_zone.set_on_change(
+            &FaultInjection::Reconfigure);
     }
 } g_hook_installer;
 
@@ -255,15 +291,20 @@ void FaultInjection::Reconfigure() {
         fault_internal::g_chaos_on.store(false, std::memory_order_release);
         return;
     }
+    parsed.partition_zone = FLAGS_chaos_partition_zone.get();
+    parsed.plan_enabled = FLAGS_chaos_enabled.get();
     e.plan.Modify([&](FaultPlan& p) {
         p = parsed;
         return true;
     });
     e.seed.store((uint64_t)FLAGS_chaos_seed.get(),
                  std::memory_order_release);
-    // Enable LAST so no decision runs against a half-applied plan.
-    fault_internal::g_chaos_on.store(FLAGS_chaos_enabled.get(),
-                                     std::memory_order_release);
+    // Enable LAST so no decision runs against a half-applied plan. A
+    // zone partition keeps the seams consulting Decide even when the
+    // probability plan is off.
+    fault_internal::g_chaos_on.store(
+        FLAGS_chaos_enabled.get() || !parsed.partition_zone.empty(),
+        std::memory_order_release);
 }
 
 void FaultInjection::ReconfigureAndReset() {
@@ -289,6 +330,30 @@ FaultAction FaultInjection::Decide(FaultOp op, const EndPoint& peer,
     Engine& e = engine();
     DoublyBufferedData<FaultPlan>::ScopedPtr p;
     if (e.plan.Read(&p) != 0) return action;
+    // Zone partition (ISSUE 14), checked BEFORE the probability plan
+    // and WITHOUT consuming a decision tick: cutting a pod must not
+    // shift a replayed seed's sequence. Applies to the byte/connection
+    // seams only — the pool/ring seams are local-machine affairs.
+    if (!p->partition_zone.empty() &&
+        (op == FaultOp::kWrite || op == FaultOp::kRead ||
+         op == FaultOp::kAccept || op == FaultOp::kConnect)) {
+        ZoneRegistry& z = zone_registry();
+        std::lock_guard<std::mutex> g(z.mu);
+        auto it = z.zones.find(peer);
+        if (it != z.zones.end() && it->second == p->partition_zone) {
+            action.kind =
+                (op == FaultOp::kAccept || op == FaultOp::kConnect)
+                    ? FaultAction::kRefuse
+                    : FaultAction::kReset;
+            e.zone_cuts << 1;
+            e.injected[action.kind] << 1;
+            return action;
+        }
+    }
+    // Partition-only mode (chaos_enabled=0 but a zone is cut): the
+    // probability plan stays healed — and consumes no ticks, so the
+    // replayed sequence resumes intact when re-enabled.
+    if (!p->plan_enabled) return action;
     // Scope check BEFORE consuming a tick: unrelated traffic must not
     // shift the replayed sequence. The staging ring has NO peer (its
     // completions come from the local device stream), so a per-peer
@@ -360,12 +425,35 @@ int64_t FaultInjection::injected_count(FaultAction::Kind k) {
 
 int64_t FaultInjection::decisions() { return engine().ndecisions.get_value(); }
 
+void FaultInjection::SetPeerZone(const EndPoint& peer,
+                                 const std::string& zone) {
+    ZoneRegistry& z = zone_registry();
+    std::lock_guard<std::mutex> g(z.mu);
+    if (zone.empty()) {
+        z.zones.erase(peer);
+    } else {
+        z.zones[peer] = zone;
+    }
+}
+
+std::string FaultInjection::PeerZone(const EndPoint& peer) {
+    ZoneRegistry& z = zone_registry();
+    std::lock_guard<std::mutex> g(z.mu);
+    auto it = z.zones.find(peer);
+    return it != z.zones.end() ? it->second : "";
+}
+
+int64_t FaultInjection::zone_partition_cuts() {
+    return engine().zone_cuts.get_value();
+}
+
 void FaultInjection::ResetCounters() {
     Engine& e = engine();
     for (int k = FaultAction::kDelay; k < FaultAction::kKindCount; ++k) {
         e.injected[k].reset();
     }
     e.ndecisions.reset();
+    e.zone_cuts.reset();
 }
 
 std::string FaultInjection::DebugString() {
@@ -380,6 +468,10 @@ std::string FaultInjection::DebugString() {
     out += line;
     out += "plan " + FLAGS_chaos_plan.get() + "\n";
     out += "peers " + FLAGS_chaos_peers.get() + "\n";
+    out += "partition_zone " + FLAGS_chaos_partition_zone.get() + "\n";
+    snprintf(line, sizeof(line), "zone_partition_cuts %lld\n",
+             (long long)engine().zone_cuts.get_value());
+    out += line;
     snprintf(line, sizeof(line), "decisions %lld\n",
              (long long)e.ndecisions.get_value());
     out += line;

@@ -106,6 +106,19 @@ public:
     static bool ValidatePlan(const std::string& plan);
     static bool ValidatePeers(const std::string& peers);
 
+    // ---- zone partition (ISSUE 14) ----
+    // Register the locality zone of a peer endpoint (mesh tools and the
+    // naming layer feed this from their zone tags). With the
+    // -chaos_partition_zone flag set to a zone name, EVERY read/write/
+    // connect against a peer registered in that zone fails (kReset /
+    // kRefuse) — one command partitions an entire pod. Partition
+    // matching neither consumes a decision tick nor touches the
+    // deterministic plan sequence, so a partition can be layered over a
+    // replayed seed. Cuts are counted in chaos_zone_partition_cuts.
+    static void SetPeerZone(const EndPoint& peer, const std::string& zone);
+    static std::string PeerZone(const EndPoint& peer);
+    static int64_t zone_partition_cuts();
+
     // Current config + counters, one "key value" pair per line (the
     // /chaos page body; also convenient for tests).
     static std::string DebugString();

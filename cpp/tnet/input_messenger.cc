@@ -297,6 +297,21 @@ void InputMessenger::OnNewMessages(Socket* s) {
                 s->add_bytes_read(nr);
                 // Per-tier byte attribution (the Transport seam).
                 transport_stats::AddIn(s->transport_tier(), nr);
+                // Emulated-WAN shaping, inbound half (ISSUE 14): a
+                // dcn-tier socket charges received bytes too — the
+                // peer's half of the link is an accepted socket with no
+                // forced tier, so without this the response direction
+                // would ride the WAN for free. Each direction is shaped
+                // exactly once (writes on the dcn socket, reads on the
+                // dcn socket). Parking this fiber is legal here, same
+                // as the chaos delay path (the round scopes flush and
+                // detach on park).
+                if (__builtin_expect(s->forced_transport_tier() >= 0, 0) &&
+                    DcnShapingEnabled()) {
+                    const int64_t d = DcnShapeReadDelayUs(
+                        s->transport_tier(), (size_t)nr);
+                    if (d > 0) fiber_usleep(d);
+                }
             } else if (nr == 0) {
                 read_eof = true;
             } else {

@@ -115,6 +115,7 @@ int Socket::Create(const SocketOptions& options, SocketId* id) {
     s->user_ = options.user;
     s->transport_ = options.transport;
     s->owns_transport_ = options.owns_transport;
+    s->forced_tier_ = options.forced_transport_tier;
     s->write_head_.store(nullptr, std::memory_order_relaxed);
     s->write_pending_.store(0, std::memory_order_relaxed);
     s->unwritten_bytes_.store(0, std::memory_order_relaxed);
@@ -662,6 +663,16 @@ bool Socket::FlushOnce(bool allow_block) {
     if (__builtin_expect(fault_injection_enabled(), 0) && !allow_block) {
         return false;  // caller spawns KeepWrite
     }
+    // Emulated-WAN shaping (ISSUE 14): a shaped dcn-tier socket routes
+    // every flush through the KeepWrite fiber too — the shaping sleep
+    // must never park the caller's fiber under its locks. One member
+    // load for the (vast) non-dcn majority.
+    const bool shaped_dcn =
+        __builtin_expect(forced_tier_ >= 0, 0) && transport_ == nullptr &&
+        DcnShapingEnabled() && forced_tier_ == TierDcn();
+    if (shaped_dcn && !allow_block) {
+        return false;  // caller spawns KeepWrite
+    }
     int64_t& consumed = writer_consumed_;
     while (true) {
         // Refill the owned batch.
@@ -762,6 +773,17 @@ bool Socket::FlushOnce(bool allow_block) {
                 default:
                     break;
             }
+        }
+        // Emulated-WAN shaping: park for the configured latency + byte
+        // time before this round's bytes leave. Runs on the KeepWrite
+        // fiber only (the shaped_dcn gate above). A partial write
+        // re-shapes its remainder next round — the emulated pipe is a
+        // floor, not an exact clock.
+        if (shaped_dcn && !fault_io) {
+            size_t total = 0;
+            for (size_t i = 0; i < npieces; ++i) total += pieces[i]->size();
+            const int64_t d = DcnShapeDelayUs(transport_tier(), total);
+            if (d > 0) fiber_usleep(d);
         }
         // Data plane: ICI queue pair when plugged (the RdmaEndpoint
         // bypass — reference socket.cpp checks _rdma_state on the write

@@ -47,6 +47,13 @@ struct SocketOptions {
     void* user = nullptr;  // InputMessenger* / Acceptor* / Server*
     // Optional transport endpoint taking over the data plane (ICI).
     TransportEndpoint* transport = nullptr;
+    // Registry tier of a plain-fd connection when it is NOT the default
+    // tcp tier (ISSUE 14): a cross-pod peer's socket is created with
+    // TierDcn() so descriptor eligibility, byte attribution and the
+    // -dcn_emu_* shaping all key off the tier without a second data
+    // plane. Ignored when `transport` is set (the endpoint knows its
+    // own tier). -1 = default (tcp).
+    int forced_transport_tier = -1;
     // If set, the socket Release()s the endpoint at recycle time (the
     // link frees itself once both sides' sockets are gone).
     bool owns_transport = false;
@@ -148,8 +155,13 @@ public:
     // attribution key off this — one seam, no per-transport special
     // cases.
     int transport_tier() const {
-        return transport_ != nullptr ? transport_->tier() : TierTcp();
+        if (transport_ != nullptr) return transport_->tier();
+        return forced_tier_ >= 0 ? forced_tier_ : TierTcp();
     }
+    // The raw SocketOptions::forced_transport_tier this socket was
+    // created with (-1 = default tcp): the (endpoint, tier) key half the
+    // SocketMap/SocketPool registries re-derive at Return/Remove time.
+    int forced_transport_tier() const { return forced_tier_; }
     // Upgrade a live connection to a transport data plane (server side of
     // the ICI handshake). Must be called from the socket's input fiber
     // with no concurrent writers — i.e. before the peer can have sent any
@@ -414,6 +426,7 @@ private:
     void* user_ = nullptr;
     TransportEndpoint* transport_ = nullptr;
     bool owns_transport_ = false;
+    int forced_tier_ = -1;  // SocketOptions::forced_transport_tier
 
     std::atomic<WriteRequest*> write_head_{nullptr};
     std::atomic<int64_t> write_pending_{0};

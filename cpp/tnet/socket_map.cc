@@ -18,12 +18,13 @@ DEFINE_int32(pooled_idle_close_s, 30,
 namespace tpurpc {
 
 int CreateClientSocket(const EndPoint& remote, InputMessenger* messenger,
-                       SocketId* id) {
+                       SocketId* id, int tier) {
     SocketOptions opts;
     opts.fd = -1;  // connect on first write
     opts.remote_side = remote;
     opts.on_edge_triggered_events = &InputMessenger::OnNewMessages;
     opts.user = messenger;
+    opts.forced_transport_tier = tier;
     return Socket::Create(opts, id);
 }
 
@@ -33,9 +34,10 @@ SocketMap* SocketMap::singleton() {
 }
 
 int SocketMap::GetOrCreate(const EndPoint& remote, InputMessenger* messenger,
-                           SocketId* id) {
+                           SocketId* id, int tier) {
     std::lock_guard<std::mutex> g(mu_);
-    auto it = map_.find(remote);
+    const Key key{remote, tier};
+    auto it = map_.find(key);
     if (it != map_.end()) {
         // Verify liveness: a failed socket is replaced.
         Socket* s = Socket::Address(it->second);
@@ -46,14 +48,15 @@ int SocketMap::GetOrCreate(const EndPoint& remote, InputMessenger* messenger,
         }
         map_.erase(it);
     }
-    if (CreateClientSocket(remote, messenger, id) != 0) return -1;
-    map_[remote] = *id;
+    if (CreateClientSocket(remote, messenger, id, tier) != 0) return -1;
+    map_[key] = *id;
     return 0;
 }
 
-void SocketMap::Remove(const EndPoint& remote, SocketId expected_id) {
+void SocketMap::Remove(const EndPoint& remote, SocketId expected_id,
+                       int tier) {
     std::lock_guard<std::mutex> g(mu_);
-    auto it = map_.find(remote);
+    auto it = map_.find(Key{remote, tier});
     if (it != map_.end() && it->second == expected_id) {
         map_.erase(it);
     }
@@ -63,7 +66,13 @@ std::vector<EndPoint> SocketMap::endpoints() {
     std::lock_guard<std::mutex> g(mu_);
     std::vector<EndPoint> out;
     out.reserve(map_.size());
-    for (const auto& kv : map_) out.push_back(kv.first);
+    for (const auto& kv : map_) {
+        // One entry per remote even when both a tcp and a dcn socket
+        // exist (the stitcher fans out per address, not per tier).
+        if (out.empty() || !(out.back() == kv.first.first)) {
+            out.push_back(kv.first.first);
+        }
+    }
     return out;
 }
 
@@ -76,10 +85,10 @@ SocketPool* SocketPool::singleton() {
 }
 
 int SocketPool::Get(const EndPoint& remote, InputMessenger* messenger,
-                    SocketId* id) {
+                    SocketId* id, int tier) {
     {
         std::lock_guard<std::mutex> g(mu_);
-        auto it = pools_.find(remote);
+        auto it = pools_.find(Key{remote, tier});
         if (it != pools_.end()) {
             auto& idle = it->second;
             // FIFO: take the LEAST recently returned member so load
@@ -113,14 +122,16 @@ int SocketPool::Get(const EndPoint& remote, InputMessenger* messenger,
             }
         }
     }
-    return CreateClientSocket(remote, messenger, id);
+    return CreateClientSocket(remote, messenger, id, tier);
 }
 
 void SocketPool::Return(SocketId id) {
     SocketUniquePtr s = SocketUniquePtr::FromId(id);
     if (!s) return;  // failed meanwhile: nothing to pool
     std::lock_guard<std::mutex> g(mu_);
-    auto& idle = pools_[s->remote_side()];
+    // The tier half of the key comes back off the socket itself, so a
+    // dcn fly connection returns to the dcn pool it was drawn from.
+    auto& idle = pools_[Key{s->remote_side(), s->forced_transport_tier()}];
     if ((int)idle.size() >= FLAGS_max_pooled_connections_per_remote.get()) {
         s->SetFailed();  // over capacity: close instead
         return;
@@ -128,9 +139,9 @@ void SocketPool::Return(SocketId id) {
     idle.push_back(IdleConn{id, monotonic_time_us()});
 }
 
-size_t SocketPool::idle_count(const EndPoint& remote) {
+size_t SocketPool::idle_count(const EndPoint& remote, int tier) {
     std::lock_guard<std::mutex> g(mu_);
-    auto it = pools_.find(remote);
+    auto it = pools_.find(Key{remote, tier});
     return it == pools_.end() ? 0 : it->second.size();
 }
 

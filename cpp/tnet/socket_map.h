@@ -18,8 +18,11 @@ class InputMessenger;
 // Create a fresh client connection (connect-on-first-write) to `remote`
 // fed into `messenger` — the one place client SocketOptions are built
 // (SocketMap, SocketPool and short-lived connections all use it).
+// `tier` (tnet/transport.h registry id; -1 = default tcp) stamps the
+// socket's forced transport tier — how a dcn-class connection differs
+// from a tcp one to the same address (ISSUE 14).
 int CreateClientSocket(const EndPoint& remote, InputMessenger* messenger,
-                       SocketId* id);
+                       SocketId* id, int tier = -1);
 
 class SocketMap {
 public:
@@ -27,10 +30,15 @@ public:
 
     // Get (or create, connect-on-first-write) the shared socket to `remote`
     // whose input is handled by `messenger`. Returns 0 and sets *id.
+    // Keyed by (endpoint, tier) — a tcp and a dcn endpoint at the same
+    // address NEVER share a connection or its health/breaker state: a
+    // WAN-shaped dcn socket tripping its breaker must not poison the
+    // LAN path, and vice versa.
     int GetOrCreate(const EndPoint& remote, InputMessenger* messenger,
-                    SocketId* id);
+                    SocketId* id, int tier = -1);
     // Drop the cached socket (e.g. after SetFailed).
-    void Remove(const EndPoint& remote, SocketId expected_id);
+    void Remove(const EndPoint& remote, SocketId expected_id,
+                int tier = -1);
 
     // Every remote this process holds a shared client connection to —
     // the rpcz stitcher's peer discovery (these are real serving ports,
@@ -38,8 +46,12 @@ public:
     std::vector<EndPoint> endpoints();
 
 private:
+    // -1 ("default tcp") and an explicit TierTcp() are distinct keys on
+    // purpose: normalizing would need the registry initialized before
+    // any map use, and nothing creates explicit-tcp entries today.
+    using Key = std::pair<EndPoint, int>;
     std::mutex mu_;
-    std::map<EndPoint, SocketId> map_;
+    std::map<Key, SocketId> map_;
 };
 
 // Pooled ("pooled" connection mode) client sockets: one in-flight RPC per
@@ -62,14 +74,16 @@ public:
 
     // Pop the least-recently-used idle healthy connection to `remote` or
     // create a fresh one (connect-on-first-write). Returns 0 and sets
-    // *id.
-    int Get(const EndPoint& remote, InputMessenger* messenger, SocketId* id);
+    // *id. Pools are keyed by (endpoint, tier) like the SocketMap — a
+    // pooled dcn connection is never handed to a tcp caller.
+    int Get(const EndPoint& remote, InputMessenger* messenger, SocketId* id,
+            int tier = -1);
     // Return a connection whose RPC received its response. Over-capacity
     // or failed sockets are closed instead of pooled.
     void Return(SocketId id);
 
     // Test/portal introspection: idle connections pooled for `remote`.
-    size_t idle_count(const EndPoint& remote);
+    size_t idle_count(const EndPoint& remote, int tier = -1);
 
 private:
     SocketPool() = default;
@@ -79,8 +93,9 @@ private:
         SocketId id;
         int64_t returned_us;
     };
+    using Key = std::pair<EndPoint, int>;
     std::mutex mu_;
-    std::map<EndPoint, std::deque<IdleConn>> pools_;
+    std::map<Key, std::deque<IdleConn>> pools_;
     bool sweeping_ = false;
 };
 

@@ -4,9 +4,21 @@
 #include <cstring>
 #include <mutex>
 
+#include "tbase/flags.h"
 #include "tnet/socket.h"
 #include "tvar/multi_dimension.h"
 #include "tvar/reducer.h"
+
+// Emulated WAN characteristics of the dcn tier (ISSUE 14): containers
+// without a real data-center network can still exercise cross-pod
+// routing, spill and hierarchical-collective economics. Applied per
+// write op on the KeepWrite fiber; 0/0 = no shaping (LAN-speed dcn).
+DEFINE_int64(dcn_emu_latency_us, 0,
+             "emulated one-way latency added to every dcn-tier write op "
+             "(0 = off)");
+DEFINE_int64(dcn_emu_mbps, 0,
+             "emulated per-connection dcn bandwidth cap in MB/s; writers "
+             "sleep bytes/this per op (0 = unlimited)");
 
 namespace tpurpc {
 
@@ -134,6 +146,32 @@ int TierDevice() {
          /*cross_process=*/false});
     return id;
 }
+int TierDcn() {
+    static const int id = RegisterTransportTier(
+        {"dcn", /*descriptor_capable=*/false, /*zero_copy=*/false,
+         /*cross_process=*/true});
+    return id;
+}
+
+bool DcnShapingEnabled() {
+    return FLAGS_dcn_emu_latency_us.get() > 0 ||
+           FLAGS_dcn_emu_mbps.get() > 0;
+}
+
+int64_t DcnShapeDelayUs(int tier, size_t bytes) {
+    if (tier != TierDcn()) return 0;
+    int64_t us = FLAGS_dcn_emu_latency_us.get();
+    if (us < 0) us = 0;
+    const int64_t mbps = FLAGS_dcn_emu_mbps.get();
+    if (mbps > 0) us += (int64_t)bytes / mbps;  // 1 MB/s == 1 byte/us
+    return us;
+}
+
+int64_t DcnShapeReadDelayUs(int tier, size_t bytes) {
+    if (tier != TierDcn()) return 0;
+    const int64_t mbps = FLAGS_dcn_emu_mbps.get();
+    return mbps > 0 ? (int64_t)bytes / mbps : 0;
+}
 
 void SetLocalPoolIdProvider(uint64_t (*provider)()) {
     g_local_pool_provider.store(provider, std::memory_order_release);
@@ -251,13 +289,14 @@ std::string DebugString() {
 }
 
 void ExposeVars() {
-    // Touch the built-ins so the four baseline tiers (and their labelled
+    // Touch the built-ins so the five baseline tiers (and their labelled
     // family series) exist from the first scrape even on a server that
     // never moved a transport byte.
     TierTcp();
     TierIci();
     TierShmXproc();
     TierDevice();
+    TierDcn();
 }
 
 }  // namespace transport_stats

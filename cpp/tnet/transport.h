@@ -74,6 +74,29 @@ int TierTcp();       // plain fd byte stream (TLS included)
 int TierIci();       // in-process queue-pair link (loopback ICI)
 int TierShmXproc();  // cross-process shared-memory queue pair
 int TierDevice();    // device staging ring (peer = the accelerator)
+// Cross-pod data-center-network tier (ISSUE 14): a plain fd byte
+// stream to a peer in ANOTHER pod. Descriptor-INCAPABLE — the peers
+// share no pool mapping, so descriptor-pinned tries degrade to inline
+// through the existing seam — and shaped by the -dcn_emu_* knobs so
+// non-datacenter containers can emulate WAN latency/bandwidth.
+int TierDcn();
+
+// ---- emulated-WAN shaping for the dcn tier (ISSUE 14) ----
+// Microseconds a writer should park before moving `bytes` on `tier`:
+// -dcn_emu_latency_us (per write op) + bytes/-dcn_emu_mbps. 0 for
+// non-dcn tiers or when both knobs are off. Per-connection shaping by
+// design (each KeepWrite fiber sleeps independently) — the knob
+// emulates a WAN pipe per flow, not an aggregate trunk.
+int64_t DcnShapeDelayUs(int tier, size_t bytes);
+// The inbound half: bytes/-dcn_emu_mbps ONLY — latency is charged once
+// per message at the writer; read-burst boundaries are an artifact of
+// kernel buffer sizes, not messages, so charging the fixed latency per
+// read would tax a large transfer by how it happened to fragment.
+int64_t DcnShapeReadDelayUs(int tier, size_t bytes);
+// One relaxed check for the write hot path: true when any shaping knob
+// is live (writers then route through the KeepWrite fiber, where
+// sleeping is legal).
+bool DcnShapingEnabled();
 
 // ---- descriptor eligibility / scope (the one seam) ----
 

@@ -9,6 +9,7 @@
 #include "tfiber/call_id.h"
 #include "tici/shm_link.h"
 #include "tnet/tls.h"
+#include "tnet/transport.h"
 #include "trpc/lb_with_naming.h"
 #include "trpc/controller.h"
 #include "trpc/pb_compat.h"
@@ -58,6 +59,20 @@ int Channel::Init(const EndPoint& server, const ChannelOptions* options) {
     server_ep_ = server;
     if (options != nullptr) options_ = *options;
     ConfigureRetryBudget();
+    // Resolve the transport-tier name once (ISSUE 14): every connection
+    // this channel draws — pinned, SocketMap-shared, pooled or short —
+    // is created and keyed on this tier.
+    if (!options_.transport.empty()) {
+        forced_tier_ = FindTransportTier(options_.transport.c_str());
+        if (forced_tier_ < 0 && options_.transport == "dcn") {
+            forced_tier_ = TierDcn();  // built-in, registered on demand
+        }
+        if (forced_tier_ < 0) {
+            LOG(ERROR) << "unknown ChannelOptions::transport '"
+                       << options_.transport << "'";
+            return -1;
+        }
+    }
     // grpc/redis and TLS channels pin their OWN connection: the
     // endpoint-keyed SocketMap/SocketPool sockets are shared with
     // tpu_std channels, and installing an h2/redis session (or a TLS
@@ -88,6 +103,7 @@ int Channel::CreateOwnedPinnedSocket(SocketId* sid) {
         sopts.tls_alpn = options_.protocol == "grpc" ? "h2" : "";
         sopts.tls_sni = options_.tls_sni;
     }
+    sopts.forced_transport_tier = forced_tier_;
     if (Socket::Create(sopts, sid) != 0) {
         LOG(ERROR) << "pinned client socket creation failed";
         return -1;

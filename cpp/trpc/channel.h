@@ -63,6 +63,16 @@ struct ChannelOptions {
     // throttling for this channel.
     int64_t retry_budget_tokens = -1;
     double retry_budget_ratio = -1.0;
+    // Transport-tier name of this channel's connections (tnet/transport.h
+    // registry): "" = default tcp; "dcn" marks a CROSS-POD channel whose
+    // sockets are created on the dcn tier — descriptor-incapable (pinned
+    // tries degrade to inline), attributed to
+    // rpc_transport_*{transport="dcn"}, shaped by the -dcn_emu_* WAN
+    // knobs, and never sharing a SocketMap/SocketPool connection (or its
+    // health state) with a tcp channel to the same address. Single-server
+    // init only; LB channels get their tiers per-member from naming zone
+    // tags.
+    std::string transport;
     // Give this channel its OWN connection instead of the process-wide
     // endpoint-keyed SocketMap socket (which every single-mode channel to
     // the same server shares). N channels with pin_connection then drive
@@ -119,6 +129,11 @@ public:
     // ChannelOptions / the rpc_retry_budget_* flags).
     RetryBudget& retry_budget() { return retry_budget_; }
 
+    // Registry id resolved from ChannelOptions::transport at Init
+    // (-1 = default tcp) — the tier half of the (endpoint, tier)
+    // SocketMap/SocketPool key every connection of this channel uses.
+    int transport_tier() const { return forced_tier_; }
+
 private:
     int CreateOwnedPinnedSocket(SocketId* sid);
     void ConfigureRetryBudget();
@@ -130,6 +145,7 @@ private:
     bool owns_pinned_ = false;  // created by Init (not InitWithSocketId)
     std::mutex pin_mu_;         // guards pinned_socket_ recreation
     RetryBudget retry_budget_;
+    int forced_tier_ = -1;  // resolved ChannelOptions::transport
 };
 
 }  // namespace tpurpc

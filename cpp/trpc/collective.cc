@@ -49,13 +49,22 @@ uint32_t RoundFamily(uint32_t kind) {
         case COLL_SERIAL_PUSH:
         case COLL_SERIAL_PULL:
             return COLL_SERIAL_PUSH;
+        case COLL_BCAST:
+            return COLL_BCAST;
         default:
             return 0;
     }
 }
 
-uint64_t RoundKey(uint32_t rkind, uint64_t seq) {
-    return ((uint64_t)rkind << 56) | (seq & 0x00FFFFFFFFFFFFFFull);
+// Round family: the kind folded with the membership scope (ISSUE 14) —
+// an intra-zone hierarchical phase and a flat global round of the same
+// seq live in different key namespaces on BOTH sides of the wire.
+uint32_t FamilyOf(uint32_t rkind, uint32_t scope) {
+    return rkind | (scope << 4);
+}
+
+uint64_t RoundKey(uint32_t family, uint64_t seq) {
+    return ((uint64_t)family << 56) | (seq & 0x00FFFFFFFFFFFFFFull);
 }
 
 uint64_t PackChunk(uint32_t src, uint32_t step, uint32_t chunk) {
@@ -117,6 +126,7 @@ void AddWordsWraparound(char* dst, const char* src, size_t nbytes) {
 
 struct CollectiveEngine::Round {
     uint32_t rkind = 0;
+    uint32_t scope = SCOPE_GLOBAL;  // immutable after creation
     uint64_t seq = 0;
     uint64_t member_hash = 0;
     uint32_t nranks = 0;
@@ -226,10 +236,41 @@ void CollectiveEngine::Shutdown() {
 }
 
 bool CollectiveEngine::ProbeMembers(
-    std::vector<CollectiveMembership::Member>* members, uint32_t* my_rank,
-    uint64_t* hash) {
+    uint32_t scope, std::vector<CollectiveMembership::Member>* members,
+    uint32_t* my_rank, uint64_t* hash) {
     members->clear();
     membership_->GetMembers(members);
+    if (scope == SCOPE_ZONE || scope == SCOPE_ZONE_BCAST) {
+        // My zone only. Every node filters its OWN view the same way,
+        // so agreeing views produce agreeing hashes (the convergence
+        // machinery resolves the rest).
+        std::string my_zone;
+        for (const auto& m : *members) {
+            if (m.self) my_zone = m.zone;
+        }
+        members->erase(
+            std::remove_if(members->begin(), members->end(),
+                           [&](const CollectiveMembership::Member& m) {
+                               return m.zone != my_zone;
+                           }),
+            members->end());
+    } else if (scope == SCOPE_LEADERS) {
+        // Lowest-key member per zone. Valid only when self IS a leader
+        // (the self-missing check below fails otherwise).
+        std::map<std::string, uint64_t> min_key;
+        for (const auto& m : *members) {
+            auto it = min_key.find(m.zone);
+            if (it == min_key.end() || m.key < it->second) {
+                min_key[m.zone] = m.key;
+            }
+        }
+        members->erase(
+            std::remove_if(members->begin(), members->end(),
+                           [&](const CollectiveMembership::Member& m) {
+                               return min_key[m.zone] != m.key;
+                           }),
+            members->end());
+    }
     std::sort(members->begin(), members->end(),
               [](const CollectiveMembership::Member& a,
                  const CollectiveMembership::Member& b) {
@@ -239,14 +280,17 @@ bool CollectiveEngine::ProbeMembers(
     for (size_t i = 0; i < members->size(); ++i) {
         if ((*members)[i].self) self = (int)i;
     }
-    if (members->size() < 2 || self < 0) return false;
+    // Scoped phases may be single-member (a 1-node zone; the only
+    // surviving leader) — the drivers turn those into local no-ops.
+    const size_t min_members = scope == SCOPE_GLOBAL ? 2 : 1;
+    if (members->size() < min_members || self < 0) return false;
     *my_rank = (uint32_t)self;
     *hash = HashKeys(*members);
     return true;
 }
 
 std::shared_ptr<CollectiveEngine::Round> CollectiveEngine::GetOrCreateRound(
-    uint32_t rkind, uint64_t seq,
+    uint32_t rkind, uint32_t scope, uint64_t seq,
     std::vector<CollectiveMembership::Member>&& members, uint32_t my_rank,
     uint64_t hash, const std::string& input, size_t base_bytes, Result* r) {
     const uint32_t nranks = (uint32_t)members.size();
@@ -273,6 +317,17 @@ std::shared_ptr<CollectiveEngine::Round> CollectiveEngine::GetOrCreateRound(
                        input.data() + (size_t)base_bytes * my_rank,
                        base_bytes);
                 break;
+            case COLL_BCAST:
+                // Root: input = the payload, servable immediately
+                // (complete gates the pulls). Non-roots receive.
+                rd->total_bytes = base_bytes;
+                if (!input.empty()) {
+                    rd->buf = input;
+                    rd->complete = true;
+                } else {
+                    rd->buf.assign(base_bytes, '\0');
+                }
+                break;
             default:
                 break;
         }
@@ -280,7 +335,7 @@ std::shared_ptr<CollectiveEngine::Round> CollectiveEngine::GetOrCreateRound(
 
     FiberMutexGuard g(mu_);
     if (shutdown_) return nullptr;
-    const uint64_t key = RoundKey(rkind, seq);
+    const uint64_t key = RoundKey(FamilyOf(rkind, scope), seq);
     auto it = rounds_.find(key);
     if (it != rounds_.end()) {
         std::shared_ptr<Round> rd = it->second;
@@ -316,6 +371,7 @@ std::shared_ptr<CollectiveEngine::Round> CollectiveEngine::GetOrCreateRound(
     }
     auto rd = std::make_shared<Round>();
     rd->rkind = rkind;
+    rd->scope = scope;
     rd->seq = seq;
     rd->member_hash = hash;
     rd->nranks = nranks;
@@ -324,10 +380,12 @@ std::shared_ptr<CollectiveEngine::Round> CollectiveEngine::GetOrCreateRound(
     rd->attempt = 1;
     reset_buffers(rd.get());
     rounds_[key] = rd;
-    // GC older rounds of this family, keeping the immediate
-    // predecessor alive for late duplicate acks / straggler pulls.
+    // GC older rounds of this (kind, scope) family, keeping the
+    // immediate predecessor alive for late duplicate acks / straggler
+    // pulls.
     for (auto gc = rounds_.begin(); gc != rounds_.end();) {
-        if (gc->second->rkind == rkind && gc->second->seq + 2 <= seq) {
+        if (gc->second->rkind == rkind && gc->second->scope == scope &&
+            gc->second->seq + 2 <= seq) {
             gc = rounds_.erase(gc);
         } else {
             ++gc;
@@ -342,10 +400,9 @@ void CollectiveEngine::FinishRound(const std::shared_ptr<Round>& round,
     if (round == nullptr) return;
     if (err == 0) {
         FiberMutexGuard g(mu_);
-        const uint32_t fam = round->rkind & 7;
-        if (round->seq > completed_seq_[fam]) {
-            completed_seq_[fam] = round->seq;
-        }
+        uint64_t& mark =
+            completed_seq_[FamilyOf(round->rkind, round->scope)];
+        if (round->seq > mark) mark = round->seq;
     }
     FiberMutexGuard rg(round->mu);
     if (err == 0) round->complete = true;
@@ -430,6 +487,7 @@ int CollectiveEngine::RunRingAttempt(const std::shared_ptr<Round>& round,
             CollWire w;
             w.seq = round->seq;
             w.kind = COLL_ALLREDUCE;
+            w.scope = round->scope;
             w.step = step;
             w.chunk = c;
             w.src_rank = me;
@@ -491,6 +549,7 @@ public:
         CollWire w;
         w.seq = round->seq;
         w.kind = kind;
+        w.scope = round->scope;
         w.step = 0;
         w.chunk = it.chunk_index;
         w.src_rank = round->my_rank;
@@ -644,6 +703,78 @@ int CollectiveEngine::RunFanoutAttempt(const std::shared_ptr<Round>& round,
     return 0;
 }
 
+// ---------------- pull broadcast (hier phase 3) ----------------
+
+int CollectiveEngine::RunBcastAttempt(const std::shared_ptr<Round>& round,
+                                      int64_t attempt_deadline_us,
+                                      Result* r) {
+    uint64_t attempt;
+    uint32_t n, me;
+    uint64_t total;
+    {
+        FiberMutexGuard g(round->mu);
+        attempt = round->attempt;
+        n = round->nranks;
+        me = round->my_rank;
+        total = round->total_bytes;
+    }
+    const uint64_t chunk = std::max<uint64_t>(4, opts_.chunk_bytes & ~3ull);
+    if (me == 0) {
+        // Root: serve (the handler does the work) until every member
+        // pulled every chunk.
+        std::vector<uint64_t> expect;
+        for (uint32_t q = 1; q < n; ++q) {
+            uint32_t c = 0;
+            for (uint64_t off = 0; off < total; off += chunk, ++c) {
+                expect.push_back(PackChunk(q, 0, c));
+            }
+        }
+        KeySetWait ks{&expect, false};
+        return WaitRound(round.get(), attempt, attempt_deadline_us,
+                         &PredKeysAppliedAndDrained, &ks);
+    }
+    // Non-root: chunked parallel pulls from rank 0, applied at the
+    // absolute offset (peer_rank 0 zeroes the FanMapper's block base).
+    auto mapper = std::make_shared<FanMapper>();
+    mapper->eng = this;
+    mapper->round = round;
+    mapper->attempt = attempt;
+    mapper->kind = COLL_BCAST;
+    mapper->block_bytes = total;
+    mapper->res = r;
+    uint32_t c = 0;
+    for (uint64_t off = 0; off < total; off += chunk, ++c) {
+        FanMapper::Item it;
+        it.peer_rank = 0;
+        it.chunk_index = c;
+        it.off = off;
+        it.len = std::min<uint64_t>(chunk, total - off);
+        mapper->items.push_back(it);
+    }
+    const int64_t remaining_ms = std::max<int64_t>(
+        1, (attempt_deadline_us - monotonic_time_us()) / 1000);
+    ParallelChannelOptions po;
+    po.fail_limit = 1;
+    po.timeout_ms = remaining_ms;
+    ParallelChannel pc(&po);
+    for (size_t i = 0; i < mapper->items.size(); ++i) {
+        pc.AddChannelShared(round->members[0].chan.get(), mapper, nullptr);
+    }
+    std::unique_ptr<google::protobuf::Message> preq(
+        codec_->NewRequest(CollWire()));
+    std::unique_ptr<google::protobuf::Message> prsp(codec_->NewResponse());
+    Controller pcntl;
+    pcntl.set_timeout_ms(remaining_ms);
+    pcntl.set_max_retry(opts_.max_chunk_retries);
+    pc.CallMethod(codec_->method(), &pcntl, preq.get(), prsp.get(),
+                  nullptr);
+    FiberMutexGuard g(round->mu);
+    if (round->fail_error != 0) return round->fail_error;
+    if (round->attempt != attempt) return TERR_STALE_EPOCH;
+    if (pcntl.Failed()) return pcntl.ErrorCode();
+    return 0;
+}
+
 // ---------------- serial baseline ----------------
 
 int CollectiveEngine::RunSerialAttempt(const std::shared_ptr<Round>& round,
@@ -688,6 +819,7 @@ int CollectiveEngine::RunSerialAttempt(const std::shared_ptr<Round>& round,
     CollWire w;
     w.seq = round->seq;
     w.kind = COLL_SERIAL_PUSH;
+    w.scope = round->scope;
     w.src_rank = me;
     w.nranks = n;
     w.member_hash = round->member_hash;
@@ -735,8 +867,13 @@ int CollectiveEngine::RunSerialAttempt(const std::shared_ptr<Round>& round,
 
 namespace {
 
+// Bench-only algorithm tag for the hierarchical composition (not a
+// wire kind — rounds of the hier phases record under their own op).
+constexpr uint32_t kAlgHierAllReduce = 100;
+
 double BusbwFactor(uint32_t rkind, uint32_t n) {
-    if (rkind == COLL_ALLREDUCE || rkind == COLL_SERIAL_PUSH) {
+    if (rkind == COLL_ALLREDUCE || rkind == COLL_SERIAL_PUSH ||
+        rkind == kAlgHierAllReduce) {
         return 2.0 * (n - 1) / n;
     }
     return (double)(n - 1) / n;
@@ -752,6 +889,8 @@ const char* AlgName(uint32_t rkind) {
             return "alltoall";
         case COLL_SERIAL_PUSH:
             return "allreduce_serial";
+        case kAlgHierAllReduce:
+            return "hier_allreduce";
         default:
             return "unknown";
     }
@@ -771,15 +910,16 @@ void RecordBusbw(uint32_t rkind, uint64_t payload_bytes,
 
 }  // namespace
 
-int CollectiveEngine::AllReduce(uint64_t seq, uint32_t* words,
-                                size_t nwords, Result* r) {
-    Result local;
-    if (r == nullptr) r = &local;
-    if (words == nullptr || nwords == 0) {
-        return r->error = TERR_REQUEST;
-    }
-    const int64_t t0 = monotonic_time_us();
-    const int64_t op_deadline = t0 + opts_.op_timeout_ms * 1000;
+// The ring all-reduce driver body, parameterized by membership scope
+// (ISSUE 14): the flat public op runs it SCOPE_GLOBAL; the hierarchical
+// phases run it SCOPE_ZONE / SCOPE_ZONE_BCAST. A single-member scoped
+// round is a local no-op (nothing to exchange — the 1-node zone, or the
+// only surviving leader after a whole-pod partition).
+int CollectiveEngine::ScopedAllReduce(uint32_t scope, uint64_t seq,
+                                      uint32_t* words, size_t nwords,
+                                      Result* r) {
+    const int64_t op_deadline =
+        monotonic_time_us() + opts_.op_timeout_ms * 1000;
     const std::string input((const char*)words, nwords * 4);
     int err = TERR_INTERNAL;
     std::shared_ptr<Round> round;
@@ -789,13 +929,20 @@ int CollectiveEngine::AllReduce(uint64_t seq, uint32_t* words,
         std::vector<CollectiveMembership::Member> members;
         uint32_t my_rank = 0;
         uint64_t hash = 0;
-        if (!ProbeMembers(&members, &my_rank, &hash)) {
+        if (!ProbeMembers(scope, &members, &my_rank, &hash)) {
             err = TERR_INTERNAL;
             fiber_usleep(200 * 1000);  // mesh may be healing
             continue;
         }
-        round = GetOrCreateRound(COLL_ALLREDUCE, seq, std::move(members),
-                                 my_rank, hash, input, input.size(), r);
+        if (members.size() == 1) {
+            r->nranks = 1;
+            r->my_rank = 0;
+            r->member_keys.assign(1, members[0].key);
+            return 0;
+        }
+        round = GetOrCreateRound(COLL_ALLREDUCE, scope, seq,
+                                 std::move(members), my_rank, hash, input,
+                                 input.size(), r);
         if (round == nullptr) {
             err = TERR_CLOSE;
             break;
@@ -818,11 +965,318 @@ int CollectiveEngine::AllReduce(uint64_t seq, uint32_t* words,
         }
     }
     FinishRound(round, err);
+    return err;
+}
+
+int CollectiveEngine::AllReduce(uint64_t seq, uint32_t* words,
+                                size_t nwords, Result* r) {
+    Result local;
+    if (r == nullptr) r = &local;
+    if (words == nullptr || nwords == 0) {
+        return r->error = TERR_REQUEST;
+    }
+    const int64_t t0 = monotonic_time_us();
+    const int err = ScopedAllReduce(SCOPE_GLOBAL, seq, words, nwords, r);
     r->error = err;
     r->elapsed_us = monotonic_time_us() - t0;
     if (err == 0) {
         *g_ops << 1;
         RecordBusbw(COLL_ALLREDUCE, nwords * 4, r);
+    }
+    return err;
+}
+
+// The all-gather driver body, parameterized by membership scope: the
+// flat public op runs it SCOPE_GLOBAL; hier phase 2 runs it
+// SCOPE_LEADERS, where every leader's block is the SAME size (zone-key
+// header padded to a fixed width + the zone-sum payload) and a
+// single-member scope — every other pod gone, or there never was one —
+// degrades to out = input.
+int CollectiveEngine::ScopedAllGather(uint32_t scope, uint64_t seq,
+                                      const std::string& input,
+                                      std::string* out, Result* r) {
+    const int64_t op_deadline =
+        monotonic_time_us() + opts_.op_timeout_ms * 1000;
+    int err = TERR_INTERNAL;
+    std::shared_ptr<Round> round;
+    for (int attempt = 0;
+         attempt < opts_.max_attempts && monotonic_time_us() < op_deadline;
+         ++attempt) {
+        std::vector<CollectiveMembership::Member> members;
+        uint32_t my_rank = 0;
+        uint64_t hash = 0;
+        if (!ProbeMembers(scope, &members, &my_rank, &hash)) {
+            err = TERR_INTERNAL;
+            fiber_usleep(200 * 1000);
+            continue;
+        }
+        if (members.size() == 1) {
+            out->assign(input);
+            r->nranks = 1;
+            r->my_rank = 0;
+            r->member_keys.assign(1, members[0].key);
+            return 0;
+        }
+        round = GetOrCreateRound(COLL_ALLGATHER, scope, seq,
+                                 std::move(members), my_rank, hash, input,
+                                 input.size(), r);
+        if (round == nullptr) {
+            err = TERR_CLOSE;
+            break;
+        }
+        const int64_t attempt_deadline = std::min(
+            op_deadline,
+            monotonic_time_us() + opts_.attempt_timeout_ms * 1000);
+        err = RunFanoutAttempt(round, COLL_ALLGATHER, attempt_deadline, r);
+        if (err == 0) break;
+        fiber_usleep(100 * 1000);
+    }
+    if (err == 0 && round != nullptr) {
+        FiberMutexGuard g(round->mu);
+        out->assign(round->buf);
+        r->nranks = round->nranks;
+        r->my_rank = round->my_rank;
+        r->member_keys.clear();
+        for (const auto& m : round->members) {
+            r->member_keys.push_back(m.key);
+        }
+    }
+    FinishRound(round, err);
+    return err;
+}
+
+// Chunked pull broadcast within a scope (hier phase 3): rank 0 serves
+// its payload, everyone else pulls. A caller whose leadership view
+// disagrees with the live probe (leadership moved mid-op) fails
+// retriable — the hier driver restarts all phases.
+int CollectiveEngine::ScopedBroadcast(uint32_t scope, uint64_t seq,
+                                      char* bytes, size_t nbytes,
+                                      bool leader, Result* r) {
+    const int64_t op_deadline =
+        monotonic_time_us() + opts_.op_timeout_ms * 1000;
+    const std::string input(leader ? std::string(bytes, nbytes)
+                                   : std::string());
+    int err = TERR_INTERNAL;
+    std::shared_ptr<Round> round;
+    for (int attempt = 0;
+         attempt < opts_.max_attempts && monotonic_time_us() < op_deadline;
+         ++attempt) {
+        std::vector<CollectiveMembership::Member> members;
+        uint32_t my_rank = 0;
+        uint64_t hash = 0;
+        if (!ProbeMembers(scope, &members, &my_rank, &hash)) {
+            err = TERR_INTERNAL;
+            fiber_usleep(200 * 1000);
+            continue;
+        }
+        if (members.size() == 1) {
+            r->nranks = 1;
+            r->my_rank = 0;
+            r->member_keys.assign(1, members[0].key);
+            return leader ? 0 : TERR_STALE_EPOCH;  // lone non-leader?
+        }
+        if (leader != (my_rank == 0)) {
+            // Leadership moved between the caller's phase-2 view and
+            // this probe: retriable, the hier driver re-runs phase 1.
+            return TERR_STALE_EPOCH;
+        }
+        round = GetOrCreateRound(COLL_BCAST, scope, seq,
+                                 std::move(members), my_rank, hash, input,
+                                 nbytes, r);
+        if (round == nullptr) {
+            err = TERR_CLOSE;
+            break;
+        }
+        const int64_t attempt_deadline = std::min(
+            op_deadline,
+            monotonic_time_us() + opts_.attempt_timeout_ms * 1000);
+        err = RunBcastAttempt(round, attempt_deadline, r);
+        if (err == 0) break;
+        fiber_usleep(100 * 1000);
+    }
+    if (err == 0 && round != nullptr) {
+        FiberMutexGuard g(round->mu);
+        if (!leader) memcpy(bytes, round->buf.data(), nbytes);
+        r->nranks = round->nranks;
+        r->my_rank = round->my_rank;
+        r->member_keys.clear();
+        for (const auto& m : round->members) {
+            r->member_keys.push_back(m.key);
+        }
+    }
+    FinishRound(round, err);
+    return err;
+}
+
+namespace {
+// Phase-3 payload header: [u32 nkeys][u64 key * kMaxHierKeys] as uint32
+// words, followed by the delta payload the leader broadcasts (pull
+// bcast — non-leaders receive it verbatim, no reduce, half the ring's
+// byte volume).
+constexpr size_t kMaxHierKeys = 64;
+constexpr size_t kHierHdrWords = 1 + 2 * kMaxHierKeys;
+
+void PackHierKeys(uint32_t* w, const std::vector<uint64_t>& keys) {
+    w[0] = (uint32_t)keys.size();
+    for (size_t i = 0; i < keys.size() && i < kMaxHierKeys; ++i) {
+        w[1 + 2 * i] = (uint32_t)(keys[i] & 0xFFFFFFFFu);
+        w[2 + 2 * i] = (uint32_t)(keys[i] >> 32);
+    }
+}
+
+bool UnpackHierKeys(const uint32_t* w, std::vector<uint64_t>* keys) {
+    const uint32_t nk = w[0];
+    if (nk == 0 || nk > kMaxHierKeys) return false;
+    keys->clear();
+    for (uint32_t i = 0; i < nk; ++i) {
+        keys->push_back((uint64_t)w[1 + 2 * i] |
+                        ((uint64_t)w[2 + 2 * i] << 32));
+    }
+    return true;
+}
+}  // namespace
+
+int CollectiveEngine::HierAllReduce(uint64_t seq, uint32_t* words,
+                                    size_t nwords, Result* r) {
+    Result local;
+    if (r == nullptr) r = &local;
+    if (words == nullptr || nwords == 0) {
+        return r->error = TERR_REQUEST;
+    }
+    const int64_t t0 = monotonic_time_us();
+    const int64_t op_deadline = t0 + opts_.op_timeout_ms * 1000;
+    int err = TERR_INTERNAL;
+    const auto fold = [&](const Result& ph) {
+        r->retries += ph.retries;
+        r->reforms += ph.reforms;
+        r->desc_fallback_chunks += ph.desc_fallback_chunks;
+        r->moved_bytes += ph.moved_bytes;
+    };
+    for (int attempt = 0;
+         attempt < opts_.max_attempts && monotonic_time_us() < op_deadline;
+         ++attempt) {
+        // Phase 1: zone-sum over the fast intra-pod tier. Restarted
+        // attempts begin from the ORIGINAL input again (a completed
+        // phase with unchanged membership re-converges instantly
+        // through the round's dedupe state).
+        std::vector<uint32_t> zsum(words, words + nwords);
+        Result ph1;
+        err = ScopedAllReduce(SCOPE_ZONE, seq, zsum.data(), nwords, &ph1);
+        fold(ph1);
+        if (err != 0) {
+            fiber_usleep(100 * 1000);
+            continue;
+        }
+        const std::vector<uint64_t>& zone_keys = ph1.member_keys;
+        const uint64_t my_key = zone_keys[ph1.my_rank];
+        if (zone_keys.size() > kMaxHierKeys) {
+            // Permanent topology bound (the phase-2/3 key header holds
+            // kMaxHierKeys) — EVERY rank fails fast here; a leader-only
+            // check would leave non-leaders spinning to op timeout.
+            err = TERR_REQUEST;
+            break;
+        }
+
+        // Phase 2 (zone leader only): exchange [zone keys | zone sum]
+        // blocks with the other pods' leaders — the ONLY bytes that
+        // cross the pod boundary.
+        std::vector<uint32_t> p3(kHierHdrWords + nwords, 0);
+        const bool is_leader = my_key == zone_keys.front();
+        if (is_leader) {
+            std::string block((kHierHdrWords + nwords) * 4, '\0');
+            auto* bw = (uint32_t*)&block[0];
+            PackHierKeys(bw, zone_keys);
+            memcpy(bw + kHierHdrWords, zsum.data(), nwords * 4);
+            std::string gathered;
+            Result ph2;
+            err = ScopedAllGather(SCOPE_LEADERS, seq, block,
+                                  &gathered, &ph2);
+            fold(ph2);
+            if (err != 0) {
+                fiber_usleep(100 * 1000);
+                continue;
+            }
+            const size_t bwords = kHierHdrWords + nwords;
+            const size_t nblocks = gathered.size() / (bwords * 4);
+            std::vector<uint32_t> gsum(nwords, 0);
+            std::set<uint64_t> contrib;
+            bool bad = nblocks == 0;
+            for (size_t b = 0; b < nblocks && !bad; ++b) {
+                const auto* gw =
+                    (const uint32_t*)(gathered.data() + b * bwords * 4);
+                std::vector<uint64_t> keys;
+                if (!UnpackHierKeys(gw, &keys)) {
+                    bad = true;
+                    break;
+                }
+                contrib.insert(keys.begin(), keys.end());
+                for (size_t i = 0; i < nwords; ++i) {
+                    gsum[i] += gw[kHierHdrWords + i];
+                }
+            }
+            if (contrib.size() > kMaxHierKeys) {
+                err = TERR_REQUEST;  // total membership past the bound
+                break;               // — permanent, don't burn attempts
+            }
+            if (bad) {
+                err = TERR_STALE_EPOCH;  // mid-exchange membership churn
+                fiber_usleep(100 * 1000);
+                continue;
+            }
+            // Broadcast payload: the contributing-key union + the
+            // delta my zone still needs (wraparound-exact).
+            std::vector<uint64_t> contrib_sorted(contrib.begin(),
+                                                 contrib.end());
+            PackHierKeys(p3.data(), contrib_sorted);
+            for (size_t i = 0; i < nwords; ++i) {
+                p3[kHierHdrWords + i] = gsum[i] - zsum[i];
+            }
+        }
+
+        // Phase 3: pull-broadcast [contributing keys | delta] back
+        // through the zone over the fast tier — no reduce, each
+        // non-leader pulls exactly one payload's worth of bytes.
+        Result ph3;
+        err = ScopedBroadcast(SCOPE_ZONE_BCAST, seq, (char*)p3.data(),
+                              p3.size() * 4, is_leader, &ph3);
+        fold(ph3);
+        if (err != 0) {
+            fiber_usleep(100 * 1000);
+            continue;
+        }
+        if (ph3.member_keys != ph1.member_keys) {
+            // Zone membership moved between the phases: the delta was
+            // computed against a different zone sum. Restart.
+            err = TERR_STALE_EPOCH;
+            r->reforms++;
+            *g_reforms << 1;
+            continue;
+        }
+        std::vector<uint64_t> contrib;
+        if (!UnpackHierKeys(p3.data(), &contrib)) {
+            // Leader churn mid-phase-3 (no one contributed a header, or
+            // two did): retriable.
+            err = TERR_STALE_EPOCH;
+            fiber_usleep(100 * 1000);
+            continue;
+        }
+        std::sort(contrib.begin(), contrib.end());
+        for (size_t i = 0; i < nwords; ++i) {
+            words[i] = zsum[i] + p3[kHierHdrWords + i];
+        }
+        r->nranks = (uint32_t)contrib.size();
+        r->my_rank = (uint32_t)(std::find(contrib.begin(), contrib.end(),
+                                          my_key) -
+                                contrib.begin());
+        r->member_keys = std::move(contrib);
+        err = 0;
+        break;
+    }
+    r->error = err;
+    r->elapsed_us = monotonic_time_us() - t0;
+    if (err == 0) {
+        *g_ops << 1;
+        RecordBusbw(kAlgHierAllReduce, nwords * 4, r);
     }
     return err;
 }
@@ -836,52 +1290,13 @@ int CollectiveEngine::AllGather(uint64_t seq, const void* mine,
         return r->error = TERR_REQUEST;
     }
     const int64_t t0 = monotonic_time_us();
-    const int64_t op_deadline = t0 + opts_.op_timeout_ms * 1000;
     const std::string input((const char*)mine, my_bytes);
-    int err = TERR_INTERNAL;
-    std::shared_ptr<Round> round;
-    for (int attempt = 0;
-         attempt < opts_.max_attempts && monotonic_time_us() < op_deadline;
-         ++attempt) {
-        std::vector<CollectiveMembership::Member> members;
-        uint32_t my_rank = 0;
-        uint64_t hash = 0;
-        if (!ProbeMembers(&members, &my_rank, &hash)) {
-            err = TERR_INTERNAL;
-            fiber_usleep(200 * 1000);
-            continue;
-        }
-        round = GetOrCreateRound(COLL_ALLGATHER, seq, std::move(members),
-                                 my_rank, hash, input, my_bytes, r);
-        if (round == nullptr) {
-            err = TERR_CLOSE;
-            break;
-        }
-        const int64_t attempt_deadline = std::min(
-            op_deadline,
-            monotonic_time_us() + opts_.attempt_timeout_ms * 1000);
-        err = RunFanoutAttempt(round, COLL_ALLGATHER, attempt_deadline, r);
-        if (err == 0) break;
-        fiber_usleep(100 * 1000);
-    }
-    uint64_t total = 0;
-    if (err == 0 && round != nullptr) {
-        FiberMutexGuard g(round->mu);
-        out->assign(round->buf);
-        total = round->total_bytes;
-        r->nranks = round->nranks;
-        r->my_rank = round->my_rank;
-        r->member_keys.clear();
-        for (const auto& m : round->members) {
-            r->member_keys.push_back(m.key);
-        }
-    }
-    FinishRound(round, err);
+    const int err = ScopedAllGather(SCOPE_GLOBAL, seq, input, out, r);
     r->error = err;
     r->elapsed_us = monotonic_time_us() - t0;
     if (err == 0) {
         *g_ops << 1;
-        RecordBusbw(COLL_ALLGATHER, total, r);
+        RecordBusbw(COLL_ALLGATHER, out->size(), r);
     }
     return err;
 }
@@ -904,7 +1319,7 @@ int CollectiveEngine::AllToAll(
         std::vector<CollectiveMembership::Member> members;
         uint32_t my_rank = 0;
         uint64_t hash = 0;
-        if (!ProbeMembers(&members, &my_rank, &hash)) {
+        if (!ProbeMembers(SCOPE_GLOBAL, &members, &my_rank, &hash)) {
             err = TERR_INTERNAL;
             fiber_usleep(200 * 1000);
             continue;
@@ -927,7 +1342,8 @@ int CollectiveEngine::AllToAll(
             err = TERR_REQUEST;
             break;
         }
-        round = GetOrCreateRound(COLL_ALLTOALL, seq, std::move(members),
+        round = GetOrCreateRound(COLL_ALLTOALL, SCOPE_GLOBAL, seq,
+                                 std::move(members),
                                  my_rank, hash, input, block_bytes, r);
         if (round == nullptr) {
             err = TERR_CLOSE;
@@ -980,12 +1396,13 @@ int CollectiveEngine::SerialAllReduce(uint64_t seq, uint32_t* words,
         std::vector<CollectiveMembership::Member> members;
         uint32_t my_rank = 0;
         uint64_t hash = 0;
-        if (!ProbeMembers(&members, &my_rank, &hash)) {
+        if (!ProbeMembers(SCOPE_GLOBAL, &members, &my_rank, &hash)) {
             err = TERR_INTERNAL;
             fiber_usleep(200 * 1000);
             continue;
         }
-        round = GetOrCreateRound(COLL_SERIAL_PUSH, seq, std::move(members),
+        round = GetOrCreateRound(COLL_SERIAL_PUSH, SCOPE_GLOBAL, seq,
+                                 std::move(members),
                                  my_rank, hash, input, input.size(), r);
         if (round == nullptr) {
             err = TERR_CLOSE;
@@ -1027,7 +1444,8 @@ int CollectiveEngine::HandleIncoming(const CollWire& w, const char* data,
     *applied = 0;
     *backoff_ms = 0;
     const uint32_t rkind = RoundFamily(w.kind);
-    if (rkind == 0 || w.nranks < 2 || w.src_rank >= w.nranks) {
+    if (rkind == 0 || w.nranks < 2 || w.src_rank >= w.nranks ||
+        w.scope > SCOPE_ZONE_BCAST) {
         return TERR_REQUEST;
     }
     // Record the mesh's round position even for chunks we can't serve
@@ -1043,7 +1461,8 @@ int CollectiveEngine::HandleIncoming(const CollWire& w, const char* data,
     if (wait_budget_us < wait_us) wait_us = wait_budget_us;
     if (wait_us < 0) wait_us = 0;
     const int64_t deadline_us = monotonic_time_us() + wait_us;
-    const uint64_t key = RoundKey(rkind, w.seq);
+    const uint32_t family = FamilyOf(rkind, w.scope);
+    const uint64_t key = RoundKey(family, w.seq);
     std::shared_ptr<Round> round;
     {
         FiberMutexGuard g(mu_);
@@ -1054,7 +1473,9 @@ int CollectiveEngine::HandleIncoming(const CollWire& w, const char* data,
                 round = it->second;
                 break;
             }
-            if (w.seq <= completed_seq_[rkind & 7]) {
+            const auto done_it = completed_seq_.find(family);
+            if (done_it != completed_seq_.end() &&
+                w.seq <= done_it->second) {
                 // Round completed and collected. Pushes are duplicates
                 // of applied work; pulls can no longer be served (the
                 // input is gone) — the straggler re-forms upstream.
@@ -1171,6 +1592,35 @@ int CollectiveEngine::HandleIncoming(const CollWire& w, const char* data,
             *applied = 1;
             return 0;
         }
+        case COLL_BCAST: {
+            if (round->my_rank != 0 || reply == nullptr ||
+                w.offset > round->total_bytes ||
+                w.len > round->total_bytes - w.offset ||
+                w.src_rank == 0) {
+                return TERR_REQUEST;
+            }
+            // Servable from creation on the root (complete is set with
+            // the payload); a racing pull that beat the local driver's
+            // round creation parks above, never here.
+            while (!round->complete) {
+                if (round->fail_error != 0) return round->fail_error;
+                if (round->cv.wait_until(round->mu, deadline_us) ==
+                    ETIMEDOUT) {
+                    *backoff_ms = 25;
+                    return TERR_OVERLOAD;
+                }
+            }
+            const char* src = round->buf.data() + (size_t)w.offset;
+            if (!opts_.pool_descriptors ||
+                !IciBlockPool::AllocatePoolAttachmentCopy(
+                    src, (size_t)w.len, reply)) {
+                reply->append(src, (size_t)w.len);
+            }
+            round->applied.insert(PackChunk(w.src_rank, 0, w.chunk));
+            round->cv.notify_all();
+            *applied = 1;
+            return 0;
+        }
         case COLL_SERIAL_PULL: {
             if (round->my_rank != 0 || reply == nullptr ||
                 w.offset > round->total_bytes ||
@@ -1212,6 +1662,7 @@ void CollectiveEngine::ExposeVars() {
     BusbwFamily()->get_stats({"allgather"});
     BusbwFamily()->get_stats({"alltoall"});
     BusbwFamily()->get_stats({"allreduce_serial"});
+    BusbwFamily()->get_stats({"hier_allreduce"});
 }
 
 void CollectiveEngine::FillDeterministic(uint64_t seq, uint64_t key,

@@ -65,6 +65,19 @@ struct CollWire {
     uint64_t total_bytes = 0;  // round payload size (per-kind meaning)
     uint64_t offset = 0;       // byte offset (per-kind: absolute / in-block)
     uint64_t len = 0;          // chunk byte length
+    uint32_t scope = 0;        // CollScope (round-key namespace, ISSUE 14)
+};
+
+// Membership scope of a round (ISSUE 14): hierarchical collectives run
+// each phase over a FILTERED membership — the scope is part of the
+// round key, so an intra-zone phase and a flat global round of the
+// same seq never collide, and both sides of a chunk RPC filter their
+// own membership view the same way.
+enum CollScope : uint32_t {
+    SCOPE_GLOBAL = 0,      // every live member (the flat collectives)
+    SCOPE_ZONE = 1,        // members of MY zone only (hier phase 1)
+    SCOPE_LEADERS = 2,     // lowest-key member of each zone (phase 2)
+    SCOPE_ZONE_BCAST = 3,  // my zone again, phase-3 key namespace
 };
 
 enum CollKind : uint32_t {
@@ -85,6 +98,10 @@ enum CollKind : uint32_t {
     // ...and the whole reduced result pulled back in one call (the
     // reply waits for the root's reduction to complete).
     COLL_SERIAL_PULL = 5,
+    // Pull-based broadcast from rank 0 (ISSUE 14, hier phase 3):
+    // non-roots pull chunks [offset, offset+len) of the root's buffer;
+    // the root completes once every member pulled every chunk.
+    COLL_BCAST = 6,
 };
 
 // Membership probe: the host tool owns link liveness (mesh_node's peer
@@ -101,6 +118,11 @@ public:
         uint64_t key = 0;
         std::shared_ptr<google::protobuf::RpcChannel> chan;  // null = self
         bool self = false;
+        // Locality zone (pod) of the member; "" = zoneless. Drives the
+        // SCOPE_ZONE/SCOPE_LEADERS membership filters of hierarchical
+        // collectives (ISSUE 14). Same-zone members should be reachable
+        // over the fast intra-pod tier, cross-zone ones over dcn.
+        std::string zone;
     };
     virtual ~CollectiveMembership() = default;
     virtual void GetMembers(std::vector<Member>* out) = 0;
@@ -177,6 +199,26 @@ public:
     // (also in r->error).
     int AllReduce(uint64_t seq, uint32_t* words, size_t nwords, Result* r);
 
+    // Hierarchical all-reduce (ISSUE 14, per the MLPerf pod study
+    // arXiv:1909.09756): (1) ring all-reduce INTRA-ZONE over the fast
+    // tier, (2) zone leaders (lowest key per zone) exchange their zone
+    // sums — plus the zone member lists — over the cross-pod links via
+    // a leaders-scoped all-gather, (3) each leader pull-broadcasts the
+    // global-minus-zone delta (and the contributing-key union) back
+    // through the zone (uint32 wraparound makes zsum + delta exact).
+    // Bulk bytes cross the pod boundary exactly once per leader
+    // instead of riding every ring step. A phase
+    // failure (e.g. the OTHER pod partitions mid-round) re-probes and
+    // restarts all phases over the surviving membership — on a
+    // fully-partitioned topology the leader exchange degrades to a
+    // no-op and the result is the surviving pod's sum. member_keys /
+    // nranks of the Result are the keys that actually CONTRIBUTED
+    // (union of the leaders' zone lists), so drivers can verify
+    // bit-for-bit. busbw lands on rpc_collective_busbw_mbps{alg=
+    // "hier_allreduce"}.
+    int HierAllReduce(uint64_t seq, uint32_t* words, size_t nwords,
+                      Result* r);
+
     // Pull-based chunked all-gather: contributes `my_bytes` bytes,
     // fills *out with nranks blocks in rank order.
     int AllGather(uint64_t seq, const void* mine, size_t my_bytes,
@@ -243,15 +285,37 @@ private:
     class FanMapper;
     friend class FanMapper;
 
-    // Probe + sort the live membership; false when a collective is not
-    // currently possible (fewer than 2 live members, or self missing).
-    bool ProbeMembers(std::vector<CollectiveMembership::Member>* members,
+    // Probe + sort the live membership filtered by `scope`; false when
+    // a collective is not currently possible (self missing; for the
+    // GLOBAL scope also fewer than 2 live members — scoped phases may
+    // legitimately be single-member and degrade to local no-ops).
+    bool ProbeMembers(uint32_t scope,
+                      std::vector<CollectiveMembership::Member>* members,
                       uint32_t* my_rank, uint64_t* hash);
     std::shared_ptr<Round> GetOrCreateRound(
-        uint32_t rkind, uint64_t seq,
+        uint32_t rkind, uint32_t scope, uint64_t seq,
         std::vector<CollectiveMembership::Member>&& members,
         uint32_t my_rank, uint64_t hash, const std::string& input,
         size_t base_bytes, Result* r);
+    // Scoped ring all-reduce / leaders all-gather / zone broadcast: the
+    // phase bodies of HierAllReduce (no busbw/op accounting of their
+    // own).
+    int ScopedAllReduce(uint32_t scope, uint64_t seq, uint32_t* words,
+                        size_t nwords, Result* r);
+    // The shared all-gather driver body: AllGather runs it
+    // SCOPE_GLOBAL; hier phase 2 runs it SCOPE_LEADERS (where a
+    // single-member scope degrades to out = input).
+    int ScopedAllGather(uint32_t scope, uint64_t seq,
+                        const std::string& input, std::string* out,
+                        Result* r);
+    // Chunked pull broadcast of `nbytes` within `scope`: the caller
+    // that is the scope's rank 0 passes `leader` = true and the
+    // payload in `bytes`; everyone else receives into `bytes`. A
+    // leadership view that disagrees with the probe fails retriable.
+    int ScopedBroadcast(uint32_t scope, uint64_t seq, char* bytes,
+                        size_t nbytes, bool leader, Result* r);
+    int RunBcastAttempt(const std::shared_ptr<Round>& round,
+                        int64_t attempt_deadline_us, Result* r);
     void FinishRound(const std::shared_ptr<Round>& round, int err);
     int RunRingAttempt(const std::shared_ptr<Round>& round,
                        int64_t attempt_deadline_us, Result* r);
@@ -271,7 +335,10 @@ private:
     FiberMutex mu_;  // rounds_ + watermarks + shutdown flag
     FiberCond cv_;   // signaled on round creation / shutdown
     std::map<uint64_t, std::shared_ptr<Round>> rounds_;
-    uint64_t completed_seq_[8] = {0, 0, 0, 0, 0, 0, 0, 0};
+    // Highest completed seq per (kind, scope) round family — scoped
+    // hierarchical phases never satisfy (or GC) a flat round's
+    // straggler queries and vice versa.
+    std::map<uint32_t, uint64_t> completed_seq_;
     std::atomic<uint64_t> observed_seq_{0};
     bool shutdown_ = false;
 };

@@ -34,6 +34,7 @@ public:
         req->set_total_bytes(w.total_bytes);
         req->set_offset(w.offset);
         req->set_len(w.len);
+        req->set_scope(w.scope);
         return req;
     }
     google::protobuf::Message* NewResponse() const override {
@@ -68,6 +69,7 @@ inline void HandleCollectiveExchange(CollectiveEngine* eng,
     w.total_bytes = req->total_bytes();
     w.offset = req->offset();
     w.len = req->len();
+    w.scope = req->scope();
     const char* data = nullptr;
     size_t len = 0;
     std::string inline_copy;

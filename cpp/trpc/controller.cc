@@ -663,6 +663,13 @@ void Controller::IssueRPC() {
                 span_->Annotate("server draining, re-routed");
             }
         }
+        if (out.zone_spilled && span_ != nullptr) {
+            // Cross-pod spill (ISSUE 14): the local zone could not serve
+            // this pick — the counter lives in the zone LB layer, the
+            // trace evidence here.
+            span_->Annotate("cross-zone spill to " +
+                            endpoint2str(out.ptr->remote_side()));
+        }
         s = std::move(out.ptr);
         current_server_id_ = s->id();
         if (excluded_ == nullptr) excluded_ = new ExcludedServers;
@@ -670,9 +677,9 @@ void Controller::IssueRPC() {
     } else {
         SocketId sid = channel_->AcquirePinnedSocket();
         if (sid == INVALID_VREF_ID &&
-            SocketMap::singleton()->GetOrCreate(channel_->server(),
-                                                Channel::client_messenger(),
-                                                &sid) != 0) {
+            SocketMap::singleton()->GetOrCreate(
+                channel_->server(), Channel::client_messenger(), &sid,
+                channel_->transport_tier()) != 0) {
             id_error(current_cid_, TERR_FAILED_SOCKET);
             return;
         }
@@ -706,12 +713,19 @@ void Controller::IssueRPC() {
     if (ct != CONNECTION_TYPE_SINGLE) {
         SocketId fly = INVALID_VREF_ID;
         int rc2;
+        // Fly connections inherit the main socket's forced tier: a dcn
+        // LB member's pooled/short connections are dcn too (and pool
+        // under the (endpoint, tier) key, never mixing with tcp).
+        const int fly_tier =
+            s->transport() == nullptr ? s->forced_transport_tier() : -1;
         if (ct == CONNECTION_TYPE_POOLED) {
-            rc2 = SocketPool::singleton()->Get(
-                s->remote_side(), Channel::client_messenger(), &fly);
+            rc2 = SocketPool::singleton()->Get(s->remote_side(),
+                                               Channel::client_messenger(),
+                                               &fly, fly_tier);
         } else {  // SHORT: fresh connection, closed after the response
             rc2 = CreateClientSocket(s->remote_side(),
-                                     Channel::client_messenger(), &fly);
+                                     Channel::client_messenger(), &fly,
+                                     fly_tier);
         }
         if (rc2 != 0) {
             id_error(current_cid_, TERR_FAILED_SOCKET);

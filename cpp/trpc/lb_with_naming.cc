@@ -36,6 +36,10 @@ DEFINE_int32(min_subset, 0,
 DEFINE_int64(subset_seed, 0,
              "rendezvous seed for -subset_size (0 = random per process; "
              "fixed values make subsets reproducible for tests)");
+// This process's pod identity (defined in load_balancer.cc): naming
+// entries tagged with another zone get dcn-tier sockets here and land
+// on the remote side of every ZoneAwareLoadBalancer.
+DECLARE_string(rpc_zone);
 
 namespace tpurpc {
 
@@ -138,8 +142,10 @@ void NamingServiceThread::ResetServers(const std::vector<NSNode>& servers) {
             }
         }
         // Additions: in fresh, not yet tracked.
+        const std::string my_zone = FLAGS_rpc_zone.get();
         for (const NSNode& node : fresh) {
             if (entries_.count(node)) continue;
+            const std::string zone = ZoneFromTag(node.tag);
             SocketOptions opts;
             opts.fd = -1;
             opts.remote_side = node.ep;
@@ -147,6 +153,13 @@ void NamingServiceThread::ResetServers(const std::vector<NSNode>& servers) {
             opts.user = Channel::client_messenger();
             opts.health_check_interval_ms =
                 FLAGS_ns_health_check_interval_ms.get();
+            // Cross-pod entries ride the dcn tier (ISSUE 14): the
+            // forced tier flips descriptor eligibility off, attributes
+            // bytes to rpc_transport_*{transport="dcn"}, and subjects
+            // the connection to the -dcn_emu_* WAN shaping.
+            if (!zone.empty() && !my_zone.empty() && zone != my_zone) {
+                opts.forced_transport_tier = TierDcn();
+            }
             SocketId id;
             if (Socket::Create(opts, &id) != 0) {
                 LOG(ERROR) << "Socket::Create failed for "
@@ -154,7 +167,7 @@ void NamingServiceThread::ResetServers(const std::vector<NSNode>& servers) {
                 continue;
             }
             entries_[node] = id;
-            added.push_back({id, WeightFromTag(node.tag), node.ep});
+            added.push_back({id, WeightFromTag(node.tag), node.ep, zone});
         }
         watchers_snapshot = watchers_;
     }
@@ -177,7 +190,8 @@ void NamingServiceThread::AddWatcher(Watcher* w) {
         std::lock_guard<std::mutex> g(mu_);
         watchers_.insert(w);
         for (const auto& [node, id] : entries_) {
-            current.push_back({id, WeightFromTag(node.tag), node.ep});
+            current.push_back({id, WeightFromTag(node.tag), node.ep,
+                               ZoneFromTag(node.tag)});
         }
     }
     if (!current.empty()) w->OnServersChanged(current, {});
@@ -274,41 +288,57 @@ std::vector<SocketId> LoadBalancerWithNaming::CurrentLbMembers() const {
 void LoadBalancerWithNaming::ApplySubset(bool force_full) {
     const int k = FLAGS_subset_size.get();
     std::lock_guard<std::mutex> g(subset_mu_);
+    // Grouped by zone (ISSUE 14): the subset target and the live floor
+    // apply PER ZONE, so a dying pod's recompute swaps members within
+    // that pod only — the other pod's chosen members (and their warm
+    // connections) never churn because of a remote breaker storm.
     // Live = addressable and not draining; the ring of candidates the
     // rendezvous hash scores. Keys come from registration-time endpoints
     // so every fleet member scores the same server identically.
-    std::vector<SocketId> live_ids;
-    std::vector<std::string> live_keys;
+    struct ZoneGroup {
+        std::vector<SocketId> ids;       // every member of the zone
+        std::vector<SocketId> live_ids;  // addressable + not draining
+        std::vector<std::string> live_keys;
+    };
+    std::map<std::string, ZoneGroup> groups;
     for (const auto& [id, node] : all_nodes_) {
+        ZoneGroup& grp = groups[node.zone];
+        grp.ids.push_back(id);
         Socket* s = Socket::Address(id);
         if (s == nullptr) continue;
         const bool draining = s->Draining();
         s->Dereference();
         if (draining) continue;
-        live_ids.push_back(id);
-        live_keys.push_back(endpoint2str(node.ep));
+        grp.live_ids.push_back(id);
+        grp.live_keys.push_back(endpoint2str(node.ep));
     }
     const int eff_min = FLAGS_min_subset.get() > 0
                             ? FLAGS_min_subset.get()
                             : (k + 1) / 2;
     std::set<SocketId> desired;
-    if (force_full || k <= 0 || (int)all_nodes_.size() <= k ||
-        (int)live_ids.size() < eff_min) {
-        // Full-set fallback: too few live members to subset (or a retry
-        // already burned through the subset) — better to spread over
-        // everything than to hammer the survivors.
-        for (const auto& [id, node] : all_nodes_) desired.insert(id);
-        subset_full_ = true;
-    } else {
-        // Rendezvous over the LIVE members only: a dead/draining chosen
-        // member is replaced by the next-highest scorer while every
-        // other choice stays put (HRW stability).
-        for (size_t idx :
-             RendezvousSubset(subset_seed_, live_keys, (size_t)k)) {
-            desired.insert(live_ids[idx]);
+    bool any_subsetted = false;
+    for (auto& [zone, grp] : groups) {
+        if (force_full || k <= 0 || (int)grp.ids.size() <= k ||
+            (int)grp.live_ids.size() < eff_min) {
+            // Full-set fallback FOR THIS ZONE: too few live members to
+            // subset (or a retry already burned through the subset) —
+            // better to spread over everything than to hammer the
+            // survivors. A zone below its floor (e.g. freshly dead)
+            // falls back alone; healthy zones keep their subsets.
+            for (SocketId id : grp.ids) desired.insert(id);
+        } else {
+            // Rendezvous over the LIVE members only: a dead/draining
+            // chosen member is replaced by the next-highest scorer
+            // while every other choice stays put (HRW stability).
+            for (size_t idx :
+                 RendezvousSubset(subset_seed_, grp.live_keys,
+                                  (size_t)k)) {
+                desired.insert(grp.live_ids[idx]);
+            }
+            any_subsetted = true;
         }
-        subset_full_ = false;
     }
+    subset_full_ = !any_subsetted;
     // Diff into the LB policy; in_lb_ itself is simply replaced below.
     for (SocketId id : desired) {
         if (in_lb_.count(id) == 0) {
@@ -346,25 +376,56 @@ void LoadBalancerWithNaming::MaybeRefreshSubset(const SelectIn& in) {
                 last, now, std::memory_order_relaxed)) {
             return;  // another selector is checking this tick
         }
-        int live = 0;
-        int eff_min;
         {
             std::lock_guard<std::mutex> g(subset_mu_);
             const int k = FLAGS_subset_size.get();
-            eff_min = FLAGS_min_subset.get() > 0 ? FLAGS_min_subset.get()
-                                                 : (k + 1) / 2;
+            const int eff_min = FLAGS_min_subset.get() > 0
+                                    ? FLAGS_min_subset.get()
+                                    : (k + 1) / 2;
+            // Per-zone sweep (ISSUE 14): a zone whose chosen members
+            // fell below the floor triggers the recompute even while
+            // the other zone is perfectly healthy — and a healthy
+            // zone's subset never churns because a remote one died.
+            struct ZoneHealth {
+                int live = 0;    // addressable + not draining, in lb
+                int in_lb = 0;   // members this zone holds in the LB
+                int total = 0;   // members this zone has in naming
+            };
+            std::map<std::string, ZoneHealth> zones;
+            for (const auto& [id, node] : all_nodes_) {
+                zones[node.zone].total++;
+            }
             for (SocketId id : in_lb_) {
+                auto node_it = all_nodes_.find(id);
+                if (node_it == all_nodes_.end()) continue;
+                // Touch the zone's entry even when this member is dead:
+                // a zone whose members ALL died must still read as
+                // live=0 below the floor, not vanish from the sweep.
+                ZoneHealth& z = zones[node_it->second.zone];
+                z.in_lb++;
                 Socket* s = Socket::Address(id);
                 if (s == nullptr) continue;
                 const bool draining = s->Draining();
                 s->Dereference();
-                if (!draining) ++live;
+                if (!draining) ++z.live;
             }
-            // A full-set LB with everything healthy should shrink back
-            // to the subset; a healthy subset needs nothing.
-            if (!subset_full_ && live >= eff_min) return;
+            bool recompute = zones.empty();
+            for (const auto& [zone, z] : zones) {
+                if (z.in_lb > 0 && z.live < eff_min) {
+                    recompute = true;  // chosen members dying
+                }
+                // Shrink-back: a zone sitting in FULL-set fallback
+                // (more members in the LB than the subset target) that
+                // has healed above the floor should return to its
+                // k-member subset — per zone, so one zone's recovery
+                // never waits on (or churns) another.
+                if (k > 0 && z.total > k && z.in_lb > k &&
+                    z.live >= eff_min) {
+                    recompute = true;
+                }
+            }
+            if (!recompute) return;
         }
-        (void)eff_min;
     }
     ApplySubset(force_full);
 }

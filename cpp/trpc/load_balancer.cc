@@ -6,6 +6,7 @@
 
 #include <algorithm>
 #include <atomic>
+#include <cstdio>
 #include <map>
 #include <mutex>
 #include <numeric>
@@ -14,10 +15,36 @@
 #include "tbase/doubly_buffered_data.h"
 #include "tbase/endpoint.h"
 #include "tbase/fast_rand.h"
+#include "tbase/flags.h"
 #include "tbase/logging.h"
 #include "tbase/time.h"
+#include "tvar/reducer.h"
+
+// Pod identity of THIS process (ISSUE 14). Naming entries tagged with a
+// different zone are cross-pod: reached over the dcn transport tier and
+// spilled to only when the local zone cannot serve.
+DEFINE_string(rpc_zone, "",
+              "locality zone (pod) of this process; naming entries "
+              "tagged zone=OTHER are treated as cross-pod (dcn tier, "
+              "spill-only LB). Empty = zoneless (all peers local)");
+DEFINE_int32(lb_zone_spill_dead_pct, 100,
+             "prefer a cross-zone live replica over a degraded local "
+             "pick once at least this percent of the local zone's "
+             "members are dead (unaddressable; draining still counts "
+             "as alive). 100 = only when the whole local zone is dead");
 
 namespace tpurpc {
+
+// Spill accounting (ISSUE 14): every cross-zone pick is a deliberate,
+// countable event — the two-pod soak asserts these fire during a
+// whole-pod partition and stay quiet while the local zone is healthy.
+static LazyAdder g_zone_spills("rpc_lb_zone_spills");
+static LazyAdder g_zone_local_picks("rpc_lb_zone_local_picks");
+
+void ExposeZoneLbVars() {
+    *g_zone_spills << 0;
+    *g_zone_local_picks << 0;
+}
 
 void LoadBalancer::Describe(std::string* out) const {
     out->append(name());
@@ -479,6 +506,16 @@ public:
         if (rc == 0) OnPicked(out->ptr->id());
         return rc;
     }
+    void DiscardPick(SocketId id) override {
+        // Un-count a select-time inflight whose RPC never issued (the
+        // zone layer's unused side pick): weight state only, no
+        // latency signal.
+        std::lock_guard<std::mutex> g(stats_mu_);
+        auto it = stats_.find(id);
+        if (it != stats_.end()) {
+            it->second->inflight.fetch_sub(1, std::memory_order_relaxed);
+        }
+    }
     void Feedback(const CallInfo& info) override {
         std::shared_ptr<Stats> st;
         {
@@ -522,9 +559,191 @@ private:
     std::unordered_map<SocketId, std::shared_ptr<Stats>> stats_;
 };
 
+// ---------------- locality-zone two-level wrapper ----------------
+
+ZoneAwareLoadBalancer::ZoneAwareLoadBalancer(LoadBalancer* local,
+                                             LoadBalancer* remote)
+    : local_(local), remote_(remote) {}
+
+ZoneAwareLoadBalancer::~ZoneAwareLoadBalancer() = default;
+
+bool ZoneAwareLoadBalancer::AddServer(const ServerNode& s) {
+    // Zoneless members (and everything, in a zoneless process) are
+    // local: the wrapper is a passthrough until both sides exist.
+    const std::string my_zone = FLAGS_rpc_zone.get();
+    const bool local =
+        my_zone.empty() || s.zone.empty() || s.zone == my_zone;
+    const bool added =
+        local ? local_->AddServer(s) : remote_->AddServer(s);
+    if (added) {
+        std::lock_guard<std::mutex> g(mu_);
+        side_[s.id] = local;
+        if (local) {
+            nlocal_.fetch_add(1, std::memory_order_relaxed);
+        } else {
+            nremote_.fetch_add(1, std::memory_order_relaxed);
+        }
+    }
+    return added;
+}
+
+bool ZoneAwareLoadBalancer::RemoveServer(SocketId id) {
+    bool local = true;
+    {
+        std::lock_guard<std::mutex> g(mu_);
+        auto it = side_.find(id);
+        if (it == side_.end()) return false;
+        local = it->second;
+        side_.erase(it);
+        (local ? nlocal_ : nremote_)
+            .fetch_sub(1, std::memory_order_relaxed);
+    }
+    return local ? local_->RemoveServer(id) : remote_->RemoveServer(id);
+}
+
+bool ZoneAwareLoadBalancer::LocalZoneMostlyDead() const {
+    const int pct = FLAGS_lb_zone_spill_dead_pct.get();
+    size_t total = 0, dead = 0;
+    {
+        std::lock_guard<std::mutex> g(mu_);
+        for (const auto& [id, local] : side_) {
+            if (!local) continue;
+            ++total;
+            // Dead = unaddressable (failed/recycled). A DRAINING member
+            // still serves — it keeps the zone "alive" on purpose, per
+            // the local-draining > remote-live ordering.
+            Socket* s = Socket::Address(id);
+            if (s == nullptr) {
+                ++dead;
+            } else {
+                s->Dereference();
+            }
+        }
+    }
+    if (total == 0) return true;  // no local members at all
+    return dead * 100 >= total * (size_t)std::max(pct, 1);
+}
+
+int ZoneAwareLoadBalancer::SelectServer(const SelectIn& in,
+                                        SelectOut* out) {
+    const size_t nlocal = nlocal_.load(std::memory_order_relaxed);
+    const size_t nremote = nremote_.load(std::memory_order_relaxed);
+    if (nremote == 0) {
+        // Pure passthrough (the common, zoneless case): no counters, no
+        // health sweep.
+        return local_->SelectServer(in, out);
+    }
+    if (nlocal == 0) {
+        const int rc = remote_->SelectServer(in, out);
+        if (rc == 0) {
+            out->zone_spilled = true;
+            *g_zone_spills << 1;
+        }
+        return rc;
+    }
+    const auto excluded = [&](const SelectOut& o) {
+        return in.excluded != nullptr && o.ptr &&
+               in.excluded->IsExcluded(o.ptr->id());
+    };
+    SelectOut lout;
+    const int lrc = local_->SelectServer(in, &lout);
+    // A clean local pick: live, not draining, not already tried by an
+    // earlier attempt of this RPC (the policies fall back to excluded
+    // members as a last resort — a retry should reach the OTHER pod
+    // before re-hitting a tried local server).
+    const bool local_clean = lrc == 0 && !lout.ptr->Draining() &&
+                             !excluded(lout);
+    // Dead-percent sweep, evaluated LAZILY: at the default threshold
+    // (100) a clean local pick already proves at least one local
+    // member alive, so the common healthy-zone path pays no O(zone)
+    // Socket::Address walk per pick. Only a degraded pick — or an
+    // explicit sub-100 threshold — pays for the sweep.
+    const bool spill_threshold =
+        (!local_clean || FLAGS_lb_zone_spill_dead_pct.get() < 100) &&
+        LocalZoneMostlyDead();
+    if (local_clean && !spill_threshold) {
+        *out = std::move(lout);
+        *g_zone_local_picks << 1;
+        return 0;
+    }
+    SelectOut rout;
+    const int rrc = remote_->SelectServer(in, &rout);
+    const bool remote_clean = rrc == 0 && !rout.ptr->Draining() &&
+                              !excluded(rout);
+    // Exactly one of the two picks issues; the other must be handed
+    // back to its policy (la counts inflight at select time — a
+    // silently dropped pick would leak it and skew that side's weights
+    // forever).
+    const auto use_local = [&] {
+        if (rrc == 0 && rout.ptr) remote_->DiscardPick(rout.ptr->id());
+        *out = std::move(lout);
+        *g_zone_local_picks << 1;
+        return 0;
+    };
+    const auto use_remote = [&] {
+        if (lrc == 0 && lout.ptr) local_->DiscardPick(lout.ptr->id());
+        *out = std::move(rout);
+        out->zone_spilled = true;
+        *g_zone_spills << 1;
+        return 0;
+    };
+    // Threshold breach: the local zone is (mostly) dead — remote-live
+    // wins even over a nominally-clean local pick.
+    if (spill_threshold && remote_clean) return use_remote();
+    if (local_clean) return use_local();
+    // local-draining (still serving, untried) beats remote-live.
+    if (lrc == 0 && !excluded(lout)) return use_local();
+    if (remote_clean) return use_remote();
+    // Everything degraded: any local pick (excluded fallback), then any
+    // remote one.
+    if (lrc == 0) return use_local();
+    if (rrc == 0) return use_remote();
+    return lrc != ENODATA ? lrc : rrc;
+}
+
+void ZoneAwareLoadBalancer::Feedback(const CallInfo& info) {
+    if (nremote_.load(std::memory_order_relaxed) == 0) {
+        local_->Feedback(info);  // passthrough: no side lookup, no lock
+        return;
+    }
+    bool local = true;
+    {
+        std::lock_guard<std::mutex> g(mu_);
+        auto it = side_.find(info.server_id);
+        if (it == side_.end()) return;
+        local = it->second;
+    }
+    if (local) {
+        local_->Feedback(info);
+    } else {
+        remote_->Feedback(info);
+    }
+}
+
+void ZoneAwareLoadBalancer::Describe(std::string* out) const {
+    local_->Describe(out);
+    const size_t nremote = nremote_.load(std::memory_order_relaxed);
+    if (nremote > 0) {
+        char buf[64];
+        snprintf(buf, sizeof(buf), " [zone local=%zu remote=%zu]",
+                 nlocal_.load(std::memory_order_relaxed), nremote);
+        out->append(buf);
+    }
+}
+
+const char* ZoneAwareLoadBalancer::name() const { return local_->name(); }
+
+size_t ZoneAwareLoadBalancer::local_count() const {
+    return nlocal_.load(std::memory_order_relaxed);
+}
+
+size_t ZoneAwareLoadBalancer::remote_count() const {
+    return nremote_.load(std::memory_order_relaxed);
+}
+
 // ---------------- factory ----------------
 
-LoadBalancer* LoadBalancer::New(const std::string& name) {
+static LoadBalancer* NewPolicy(const std::string& name) {
     if (name == "rr") return new RoundRobinLoadBalancer;
     if (name == "random") return new RandomizedLoadBalancer;
     if (name == "wrr") return new WeightedRoundRobinLoadBalancer;
@@ -537,6 +756,15 @@ LoadBalancer* LoadBalancer::New(const std::string& name) {
     }
     if (name == "la") return new LocalityAwareLoadBalancer;
     return nullptr;
+}
+
+LoadBalancer* LoadBalancer::New(const std::string& name) {
+    LoadBalancer* local = NewPolicy(name);
+    if (local == nullptr) return nullptr;
+    // Always wrapped: the wrapper is a strict passthrough until a
+    // cross-zone member shows up, and every policy gets the two-level
+    // zone pick for free — no per-policy zone forks (ISSUE 14).
+    return new ZoneAwareLoadBalancer(local, NewPolicy(name));
 }
 
 }  // namespace tpurpc

@@ -9,8 +9,11 @@
 // churn on transient failures.
 #pragma once
 
+#include <atomic>
 #include <cstdint>
+#include <map>
 #include <memory>
+#include <mutex>
 #include <string>
 #include <vector>
 
@@ -57,16 +60,23 @@ struct SelectOut {
     // the call's span ("server draining, re-routed") so reroutes are
     // visible in stitched traces.
     bool skipped_draining = false;
+    // The pick SPILLED across the zone boundary (ISSUE 14): the local
+    // zone had no usable replica (dead, fully draining+excluded, or
+    // past -lb_zone_spill_dead_pct) and `ptr` is a cross-pod server.
+    // Counted (rpc_lb_zone_spills) and span-annotated by the
+    // controller.
+    bool zone_spilled = false;
 };
 
 // A server as registered by the naming layer: stable socket id + weight
 // (from naming tags like "host:port w=10") + endpoint (captured at
 // registration so consistent-hash ring keys never depend on transient
-// socket liveness).
+// socket liveness) + locality zone ("zone=A" tag; "" = zoneless).
 struct ServerNode {
     SocketId id = INVALID_VREF_ID;
     int weight = 1;
     EndPoint ep;
+    std::string zone;
 };
 
 class LoadBalancer {
@@ -100,6 +110,12 @@ public:
     };
     virtual void Feedback(const CallInfo&) {}
 
+    // A pick returned by SelectServer that will NOT be issued (the
+    // zone layer selects from both sides of the pod boundary and keeps
+    // one): policies holding select-time state (la's inflight count)
+    // release it here — no RPC means no Feedback will ever arrive.
+    virtual void DiscardPick(SocketId) {}
+
     // Describe current servers (diagnostics / builtin portal).
     virtual void Describe(std::string* out) const;
 
@@ -107,9 +123,64 @@ public:
 
     // Factory over the registered policy set ("rr", "wrr", "random",
     // "c_murmurhash", "c_md5"(alias to murmur ring w/ different seed),
-    // "la"). Returns nullptr for unknown names.
+    // "la"). Returns nullptr for unknown names. Every policy comes back
+    // wrapped in the locality-zone layer (ZoneAwareLoadBalancer) — a
+    // free passthrough until a ServerNode carries a zone different from
+    // this process's -rpc_zone.
     static LoadBalancer* New(const std::string& name);
 };
+
+// Locality-zone two-level pick (ISSUE 14): one instance of the SAME
+// policy per side of the pod boundary — `local` holds same-zone (and
+// zoneless) members, `remote` holds cross-pod ones — so every policy
+// (rr/wrr/random/c-hash/la) is zone-aware without per-policy forks, and
+// a breaker storm in one pod cannot isolate picks in the other (each
+// side's candidates, exclusions and ring keys never mix).
+//
+// Fallback ordering (asserted by tlb ZoneAware* tests):
+//   local-live > local-draining > remote-live > remote-draining/any
+// with one exception: when at least -lb_zone_spill_dead_pct percent of
+// the local zone's members are DEAD (unaddressable — a draining member
+// still serves and counts as alive), remote-live is preferred over a
+// degraded local pick (the whole-pod-outage / breaker-storm spill).
+// Every cross-zone pick sets SelectOut::zone_spilled and bumps
+// rpc_lb_zone_spills; local picks bump rpc_lb_zone_local_picks.
+class ZoneAwareLoadBalancer : public LoadBalancer {
+public:
+    // Takes ownership of both policies (same concrete type).
+    ZoneAwareLoadBalancer(LoadBalancer* local, LoadBalancer* remote);
+    ~ZoneAwareLoadBalancer() override;
+
+    bool AddServer(const ServerNode& server) override;
+    bool RemoveServer(SocketId id) override;
+    int SelectServer(const SelectIn& in, SelectOut* out) override;
+    void Feedback(const CallInfo& info) override;
+    void Describe(std::string* out) const override;
+    const char* name() const override;
+
+    // Introspection (tests/portal): members per side.
+    size_t local_count() const;
+    size_t remote_count() const;
+
+private:
+    bool LocalZoneMostlyDead() const;
+
+    std::unique_ptr<LoadBalancer> local_;
+    std::unique_ptr<LoadBalancer> remote_;
+    mutable std::mutex mu_;
+    // id -> is-local side (routes RemoveServer/Feedback) + the
+    // local-side ids the dead-percent sweep walks.
+    std::map<SocketId, bool> side_;
+    // Mirrors of the side_ partition sizes: the hot SelectServer path
+    // reads these WITHOUT the mutex — the common zoneless/passthrough
+    // pick must stay as lock-free as the wrapped policy itself.
+    std::atomic<size_t> nlocal_{0};
+    std::atomic<size_t> nremote_{0};
+};
+
+// Register the rpc_lb_zone_* counters eagerly (idempotent) so /metrics
+// and the lint see them 0-valued before the first pick.
+void ExposeZoneLbVars();
 
 // Common helper: try up to all candidates starting at `start`, skipping
 // excluded and failed ids; holds the first addressable live one.

@@ -37,12 +37,33 @@ int ParseNamingLine(const std::string& raw, NSNode* out) {
     return 0;
 }
 
+// One tag token by prefix ("w=", "zone="), scanning the space-separated
+// list; "" when absent.
+static std::string TagToken(const std::string& tag, const char* prefix) {
+    const size_t plen = strlen(prefix);
+    size_t pos = 0;
+    while (pos < tag.size()) {
+        size_t sp = tag.find(' ', pos);
+        if (sp == std::string::npos) sp = tag.size();
+        if (sp - pos > plen && tag.compare(pos, plen, prefix) == 0) {
+            return tag.substr(pos + plen, sp - pos - plen);
+        }
+        pos = sp + 1;
+    }
+    return "";
+}
+
 int WeightFromTag(const std::string& tag) {
-    if (tag.rfind("w=", 0) == 0) {
-        const int w = atoi(tag.c_str() + 2);
-        if (w > 0) return w;
+    const std::string w = TagToken(tag, "w=");
+    if (!w.empty()) {
+        const int n = atoi(w.c_str());
+        if (n > 0) return n;
     }
     return 1;
+}
+
+std::string ZoneFromTag(const std::string& tag) {
+    return TagToken(tag, "zone=");
 }
 
 // ---------------- periodic base ----------------

@@ -78,8 +78,16 @@ private:
 };
 
 // Parse "host:port w=2" / "ip:port tag" entries (shared by list/file).
+// A tag is a space-separated token list; known tokens: "w=N" (weight),
+// "zone=NAME" (locality zone / pod identity, ISSUE 14).
 int ParseNamingLine(const std::string& line, NSNode* out);
-// Weight from a node tag ("w=N"); 1 when absent/invalid.
+// Weight from a node tag ("w=N" token anywhere in it); 1 when
+// absent/invalid.
 int WeightFromTag(const std::string& tag);
+// Zone/pod tag ("zone=NAME" token); "" when absent. Entries whose zone
+// differs from this process's -rpc_zone are cross-pod: their client
+// sockets are created on the dcn transport tier and every LB policy
+// prefers same-zone replicas over them (load_balancer.h).
+std::string ZoneFromTag(const std::string& tag);
 
 }  // namespace tpurpc

@@ -186,30 +186,44 @@ def test_metrics_exposition_lint(cpp_build, tmp_path):
                     "rpc_collective_desc_fallbacks"):
             assert families.get(fam) == "gauge", (fam, sorted(families))
         assert families.get("rpc_collective_busbw_mbps") == "gauge"
+        # hier_allreduce: the ISSUE 14 hierarchical (zone ring -> leader
+        # exchange over dcn -> broadcast) series, 0-valued before the
+        # first cross-pod round.
         for alg in ("allreduce", "allgather", "alltoall",
-                    "allreduce_serial"):
+                    "allreduce_serial", "hier_allreduce"):
             assert re.search(
                 r'^rpc_collective_busbw_mbps\{alg="%s"\} \d+$' % alg,
                 text, re.M), alg
-        # ISSUE 12 transport-tier attribution: labelled families with one
-        # series per registered endpoint type (tcp/ici/shm_xproc/device).
+        # ISSUE 12/14 transport-tier attribution: labelled families with
+        # one series per registered endpoint type, now including the
+        # cross-pod dcn tier.
         for fam in ("rpc_transport_in_bytes", "rpc_transport_out_bytes",
                     "rpc_transport_desc_in_bytes",
                     "rpc_transport_desc_out_bytes",
                     "rpc_transport_credit_stalls", "rpc_transport_ops"):
             assert families.get(fam) == "gauge", (fam, sorted(families))
-        for tier in ("tcp", "ici", "shm_xproc", "device"):
+        for tier in ("tcp", "ici", "shm_xproc", "device", "dcn"):
             assert re.search(
                 r'^rpc_transport_out_bytes\{transport="%s"\} \d+$' % tier,
                 text, re.M), tier
-        # /pools json carries the lease direction column + tier table.
+        # ISSUE 14 locality-zone LB: spill accounting present (0-valued)
+        # before any cross-zone member exists.
+        assert families.get("rpc_lb_zone_spills") == "gauge", \
+            sorted(families)
+        assert families.get("rpc_lb_zone_local_picks") == "gauge"
+        assert re.search(r"^rpc_lb_zone_spills \d+$", text, re.M)
+        # /pools json carries the lease direction column + tier table
+        # (dcn: descriptor-INCAPABLE cross-process byte stream).
         pools = json.loads(_http_get(port, "/pools?format=json"))
         assert isinstance(pools.get("leases"), list), pools
         tiers = {t["name"]: t for t in pools.get("transports", [])}
-        assert set(tiers) >= {"tcp", "ici", "shm_xproc", "device"}, tiers
+        assert set(tiers) >= {"tcp", "ici", "shm_xproc", "device",
+                              "dcn"}, tiers
         assert tiers["tcp"]["descriptor_capable"] == 0
         assert tiers["ici"]["descriptor_capable"] == 1
         assert tiers["shm_xproc"]["cross_process"] == 1
+        assert tiers["dcn"]["descriptor_capable"] == 0
+        assert tiers["dcn"]["cross_process"] == 1
 
         # /vars?series= returns the fixed 60/60/24-point ring shape.
         # Poll: on a loaded host the 1Hz sampler may lag a little before

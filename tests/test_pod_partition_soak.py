@@ -1,0 +1,252 @@
+"""Two-pod DCN soak (ISSUE 14): pod-aware routing, cross-pod spill,
+whole-pod partition survival, clean heal.
+
+Two mesh groups ("pods") of 3 mesh_node processes each run under mixed
+load. Intra-pod traffic rides the shm-ICI links; cross-pod traffic rides
+pinned dcn-tier channels (descriptor-incapable, WAN-shaped by the
+-dcn_emu_* knobs) built from --dcn_peers; the LB plane resolves ONE
+naming file whose entries carry zone tags, so every node's LB is the
+locality-zone two-level pick. Collective traffic mixes flat global
+rounds with hierarchical all-reduce (zone ring -> leader exchange over
+dcn -> zone broadcast).
+
+Phases:
+  1. warm-up — cross-pod bytes flow on the dcn tier, hierarchical
+     rounds complete over all 6 ranks (busbw gauge non-zero);
+  2. single-node own-pod partition — ONE chaos command
+     (partition_zone=A on an A-node) cuts that node from its whole own
+     pod: its LB must SPILL cross-pod (rpc_lb_zone_spills fires) and
+     keep completing calls via pod B;
+  3. whole-pod partition — every node partitions the OTHER pod: the
+     two pods run as independent meshes (collectives re-form per pod,
+     nranks drops to 3), nothing is lost;
+  4. heal — links re-establish, hierarchical rounds reunite at
+     nranks 6.
+
+Final invariants: zero lost completions on every plane (issued ==
+ok + failed, outstanding == 0), zero collective verification failures,
+spill + partition-cut counters fired where expected, re-issues stayed
+budget-bounded, descriptor pins drain to 0, clean exit 0 everywhere.
+"""
+import json
+import re
+import time
+
+from test_chaos_soak import NODE_FLAGS, Node, _chaos, _free_ports, \
+    _http_get, _var
+
+POD_SIZE = 3
+NUM_NODES = 2 * POD_SIZE
+
+POD_FLAGS = NODE_FLAGS + [
+    # Light emulated WAN: enough to exercise the shaping path without
+    # slowing the soak.
+    "dcn_emu_latency_us=300",
+    "dcn_emu_mbps=200",
+    "pool_lease_grace_ms=300",
+    "pool_lease_reap_ms=100",
+]
+
+
+def _pools(port):
+    return json.loads(_http_get(port, "/pools?format=json"))
+
+
+def _report(node, timeout=20.0):
+    """Mid-run REPORT snapshot via the stdin 'report' command."""
+    node.send("report")
+    deadline = time.time() + timeout
+    while True:
+        line = node._readline(deadline)
+        assert line is not None, "node %d: no REPORT" % node.idx
+        if line.startswith("REPORT "):
+            return json.loads(line[len("REPORT "):])
+
+
+def _metric_re(port, pattern):
+    """True when /metrics matches the regex (labelled families are not
+    addressable through /vars/<name>)."""
+    try:
+        return re.search(pattern, _http_get(port, "/metrics"), re.M)
+    except Exception:
+        return None
+
+
+def test_two_pod_partition_soak(cpp_build, tmp_path):
+    binary = cpp_build / "mesh_node"
+    assert binary.exists(), "mesh_node not built"
+    ports = _free_ports(NUM_NODES)
+    pod_a, pod_b = ports[:POD_SIZE], ports[POD_SIZE:]
+
+    # One naming file for the whole front door: every entry zone-tagged.
+    naming = tmp_path / "naming"
+    naming.write_text(
+        "".join("127.0.0.1:%d zone=A\n" % p for p in pod_a)
+        + "".join("127.0.0.1:%d zone=B\n" % p for p in pod_b))
+    # Per-pod peer files (shm mesh) + cross-pod dcn files.
+    peers_a = tmp_path / "peers_a"
+    peers_a.write_text("".join("127.0.0.1:%d zone=A\n" % p for p in pod_a))
+    peers_b = tmp_path / "peers_b"
+    peers_b.write_text("".join("127.0.0.1:%d zone=B\n" % p for p in pod_b))
+    dcn_a = tmp_path / "dcn_a"  # what pod A reaches over dcn: pod B
+    dcn_a.write_text("".join("127.0.0.1:%d zone=B\n" % p for p in pod_b))
+    dcn_b = tmp_path / "dcn_b"
+    dcn_b.write_text("".join("127.0.0.1:%d zone=A\n" % p for p in pod_a))
+
+    nodes = []
+    try:
+        for i, p in enumerate(ports):
+            in_a = i < POD_SIZE
+            # --peers carries the full zone-tagged naming set (the LB
+            # plane); mesh_node links shm to same-zone entries only and
+            # dcn to the --dcn_peers file.
+            nodes.append(Node(
+                binary, p, i, naming, flags=POD_FLAGS,
+                extra_args=("--zone", "A" if in_a else "B",
+                            "--dcn_peers",
+                            str(dcn_a if in_a else dcn_b),
+                            "--coll_traffic", "--desc_traffic",
+                            "--traffic_delay_ms", "1500")))
+        for n in nodes:
+            assert n.wait_ready(), "node %d never became ready" % n.idx
+
+        # --- phase 1: warm-up — cross-pod traffic + hier rounds -------
+        deadline = time.time() + 60.0
+        warmed = False
+        while time.time() < deadline:
+            reps = [_report(n) for n in nodes]
+            if (all(r["dcn_out_bytes"] > 0 for r in reps)
+                    and all(r["coll_ok"] >= 2 for r in reps)
+                    and any(r["coll_nranks"] == NUM_NODES for r in reps)):
+                warmed = True
+                break
+            time.sleep(1.0)
+        assert warmed, "cross-pod traffic/hier rounds never warmed: %s" % [
+            (r["dcn_out_bytes"], r["coll_ok"], r["coll_nranks"])
+            for r in reps]
+        # The hierarchical busbw gauge is live on at least one node.
+        assert any(
+            _metric_re(p,
+                       r'^rpc_collective_busbw_mbps\{alg="hier_allreduce"\}'
+                       r' [1-9]')
+            for p in ports), "hier busbw gauge never recorded"
+        # Healthy pods never spill.
+        for r in reps:
+            assert r["zone"] in ("A", "B"), r
+            assert r["zone_local_picks"] > 0, r
+
+        # --- phase 2: ONE command cuts a node from its whole own pod --
+        spill_idx = 2  # an A-node (not the zone leader port ordering)
+        _chaos(ports[spill_idx], partition_zone="A")
+        deadline = time.time() + 30.0
+        spilled = False
+        while time.time() < deadline:
+            rep = _report(nodes[spill_idx])
+            if (rep["zone_spills"] > 0 and rep["zone_partition_cuts"] > 0
+                    and rep["lb_ok"] > 0):
+                spilled = True
+                break
+            time.sleep(1.0)
+        assert spilled, "own-pod partition never spilled cross-pod: %s" % rep
+        # The spilling node keeps completing LB calls via pod B.
+        before = _report(nodes[spill_idx])["lb_ok"]
+        time.sleep(3.0)
+        assert _report(nodes[spill_idx])["lb_ok"] > before, \
+            "no LB progress while spilling cross-pod"
+        _chaos(ports[spill_idx], partition_zone="")  # heal
+
+        # --- phase 3: whole-pod partition -----------------------------
+        for p in pod_a:
+            _chaos(p, partition_zone="B")
+        for p in pod_b:
+            _chaos(p, partition_zone="A")
+        deadline = time.time() + 60.0
+        split = False
+        while time.time() < deadline:
+            reps = [_report(n) for n in nodes]
+            # Each pod's collectives re-formed over its own 3 ranks and
+            # keep completing under the partition.
+            if all(r["coll_nranks"] == POD_SIZE for r in reps) and all(
+                    r["zone_partition_cuts"] > 0 for r in reps):
+                split = True
+                break
+            time.sleep(1.0)
+        assert split, "pods never re-formed as independent meshes: %s" % [
+            (r["coll_nranks"], r["zone_partition_cuts"]) for r in reps]
+        # Both pods still make collective progress while partitioned.
+        before = [_report(n)["coll_ok"] for n in nodes]
+        time.sleep(4.0)
+        after = [_report(n)["coll_ok"] for n in nodes]
+        assert sum(after) > sum(before), (before, after)
+
+        # --- phase 4: heal --------------------------------------------
+        for p in ports:
+            _chaos(p, partition_zone="")
+        deadline = time.time() + 90.0
+        healed = False
+        while time.time() < deadline:
+            reps = [_report(n) for n in nodes]
+            if all(r["coll_nranks"] == NUM_NODES for r in reps):
+                healed = True
+                break
+            time.sleep(1.0)
+        assert healed, "hier rounds never reunited after heal: %s" % [
+            r["coll_nranks"] for r in reps]
+
+        # --- drain + invariants ---------------------------------------
+        reports = []
+        for n in nodes:
+            rep = n.stop_and_report(timeout=60.0)
+            assert rep is not None, "node %d produced no report" % n.idx
+            reports.append(rep)
+
+        for rep in reports:
+            # Zero lost completions on every plane — the headline
+            # partition-survival invariant.
+            assert rep["outstanding"] == 0, rep
+            assert rep["lb_issued"] == rep["lb_ok"] + rep["lb_failed"], rep
+            assert rep["shm_issued"] == rep["shm_ok"] + rep["shm_failed"], \
+                rep
+            assert rep["coll_issued"] == rep["coll_ok"] + rep["coll_failed"], \
+                rep
+            assert rep["desc_issued"] == rep["desc_ok"] + rep["desc_failed"], \
+                rep
+            # Every completed collective round verified bit-for-bit
+            # against the membership it completed over — through both
+            # partitions and the heal.
+            assert rep["coll_verify_failed"] == 0, rep
+            assert rep["coll_ok"] > 0, rep
+            # Cross-pod bytes really rode the dcn tier.
+            assert rep["dcn_out_bytes"] > 0 and rep["dcn_in_bytes"] > 0, rep
+            # Re-issues stayed budget-bounded: each channel's budget is
+            # a 100-token burst earned back at 0.1/success — the mesh's
+            # re-issue total must sit far below the unbudgeted ceiling
+            # (max_retry x every failure under two partitions).
+            ok_total = rep["lb_ok"] + rep["shm_ok"] + rep["desc_ok"]
+            assert rep["reissues"] <= 800 + 0.3 * ok_total, rep
+        # The partitioned node spilled; everyone cut the other pod.
+        assert reports[spill_idx]["zone_spills"] > 0, reports[spill_idx]
+        for rep in reports:
+            assert rep["zone_partition_cuts"] > 0, rep
+
+        # Descriptor pins drain to 0 everywhere (rsp pins release on
+        # other nodes' acks — poll, don't read the instantaneous value).
+        deadline = time.time() + 20.0
+        pinned = None
+        while time.time() < deadline:
+            pinned = [_pools(p)["pinned"] for p in ports]
+            if all(v == 0 for v in pinned):
+                break
+            time.sleep(0.5)
+        assert all(v == 0 for v in pinned), \
+            "pins stranded after quiesce: %s" % pinned
+
+        for n in nodes:
+            assert n.shutdown(timeout=60.0) == 0, \
+                "node %d unclean exit" % n.idx
+    finally:
+        for n in nodes:
+            try:
+                n.proc.kill()
+            except OSError:
+                pass

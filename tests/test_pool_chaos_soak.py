@@ -43,9 +43,13 @@ NUM_NODES = 4
 
 # Short lease grace so the leak-injection phase's orphans become
 # reapable within the soak window (default grace is 2s on top of the
-# 800ms call deadline).
+# 800ms call deadline). pool_lease_default_ms bounds the PRE-ARM
+# lifetime the same way: a pin leaked before its call armed (setup/
+# pre-issue failure under load) carries the default 30s lifetime, which
+# outlives the final 20s pinned==0 poll on a slow host.
 POOL_FLAGS = NODE_FLAGS + [
     "pool_lease_grace_ms=300",
+    "pool_lease_default_ms=2000",
     "pool_lease_reap_ms=100",
 ]
 

@@ -50,6 +50,9 @@
 #include "tbase/logging.h"
 #include "tbase/time.h"
 #include "tfiber/fiber.h"
+#include "tnet/fault_injection.h"
+#include "tnet/transport.h"
+#include "trpc/naming_service.h"
 #include "tici/block_lease.h"
 #include "tici/block_pool.h"
 #include "tici/shm_link.h"
@@ -179,10 +182,16 @@ struct Counters {
     std::atomic<int64_t> reconnects{0};
 };
 
-// One shm link to a peer; the channel is replaced on reconnect (a
-// Channel pins one socket for its lifetime).
+// One link to a peer; the channel is replaced on reconnect (a Channel
+// pins one socket for its lifetime). Intra-pod peers ride shm-ICI
+// links; cross-pod peers (--dcn_peers, ISSUE 14) ride pinned dcn-tier
+// channels — plain TCP flagged dcn, so descriptors degrade to inline,
+// bytes land on rpc_transport_*{transport="dcn"}, and the -dcn_emu_*
+// knobs shape them.
 struct PeerLink {
     EndPoint ep;
+    bool dcn = false;
+    std::string zone;  // the peer's zone ("" = mine)
     std::mutex mu;
     std::shared_ptr<Channel> ch;  // null until connected
 };
@@ -223,6 +232,7 @@ void TrafficStartDelay(NodeState* st) {
 // ---------------- collectives (ISSUE 13) ----------------
 
 int g_my_port = 0;
+std::string g_my_zone;  // --zone (also sets -rpc_zone)
 
 // Live membership from the mesh's link table: a peer is a member while
 // its shm channel is up (LinkMaintenanceFiber re-establishes dead ones,
@@ -235,6 +245,7 @@ public:
         Member self;
         self.key = (uint64_t)g_my_port;
         self.self = true;
+        self.zone = g_my_zone;
         out->push_back(self);
         for (auto& lp : st_->links) {
             std::shared_ptr<Channel> ch;
@@ -248,6 +259,7 @@ public:
             Member m;
             m.key = (uint64_t)lp->ep.port;
             m.chan = ch;
+            m.zone = lp->dcn ? lp->zone : g_my_zone;
             out->push_back(m);
         }
     }
@@ -302,15 +314,22 @@ bool RunCollectiveRound(const CollRunArgs& a) {
     uint64_t moved_total = 0;
     const uint64_t my_key = (uint64_t)g_my_port;
 
-    if (a.alg == "allreduce" || a.alg == "allreduce_serial") {
+    if (a.alg == "allreduce" || a.alg == "allreduce_serial" ||
+        a.alg == "hier_allreduce") {
         const size_t nwords = (size_t)(a.bytes / 4 ? a.bytes / 4 : 1);
         std::vector<uint32_t> words(nwords);
         CollectiveEngine::FillDeterministic(a.seq, my_key, words.data(),
                                             nwords);
+        // hier (ISSUE 14): intra-pod ring + leader exchange over dcn +
+        // broadcast ring — verified exactly like the flat all-reduce,
+        // against the CONTRIBUTING key set the engine reports.
         const int err =
             a.alg == "allreduce"
                 ? eng->AllReduce(a.seq, words.data(), nwords, &r)
-                : eng->SerialAllReduce(a.seq, words.data(), nwords, &r);
+                : a.alg == "hier_allreduce"
+                      ? eng->HierAllReduce(a.seq, words.data(), nwords, &r)
+                      : eng->SerialAllReduce(a.seq, words.data(), nwords,
+                                             &r);
         ok = err == 0;
         if (ok) {
             // expected[i] = sum of every member's deterministic word.
@@ -435,15 +454,28 @@ void* CollTrafficFiber(void* arg) {
         const uint64_t observed = eng != nullptr ? eng->ObservedSeq() : 0;
         seq = seq + 1 > observed ? seq + 1 : observed;
         a.seq = seq;
+        // With dcn peers configured (two-pod topology, ISSUE 14) the
+        // mix leans on hierarchical all-reduce — the operation the
+        // whole-pod-partition soak must prove re-forms over the
+        // surviving pod. Every node derives the same schedule from seq.
+        const bool have_dcn = [&] {
+            for (auto& lp : st->links) {
+                if (lp->dcn) return true;
+            }
+            return false;
+        }();
         if (seq % 5 == 2) {
             a.alg = "allgather";
             a.bytes = 32 << 10;  // per-rank block
         } else if (seq % 5 == 4) {
             a.alg = "alltoall";
             a.bytes = 16 << 10;  // per-pair block
+        } else if (have_dcn && seq % 5 != 0) {
+            a.alg = "hier_allreduce";
+            a.bytes = 256 << 10;
         } else {
             a.alg = "allreduce";
-            a.bytes = 512 << 10;  // payload
+            a.bytes = have_dcn ? 128 << 10 : 512 << 10;  // payload
         }
         RunCollectiveRound(a);
         fiber_usleep(50 * 1000);
@@ -729,7 +761,35 @@ void* LinkMaintenanceFiber(void* arg) {
             ChannelOptions copts;
             copts.timeout_ms = 800;
             copts.max_retry = 0;  // the maintenance loop IS the retry
-            if (fresh->InitIci(link.ep, &copts) == 0) {
+            bool up = false;
+            if (link.dcn) {
+                // Cross-pod link (ISSUE 14): a pinned dcn-tier channel.
+                // Plain TCP connects lazily, so prove the peer is
+                // really there with one short probe echo before
+                // installing — the membership view (pinned socket not
+                // failed) must mean "verified reachable", exactly what
+                // the shm handshake gives intra-pod links.
+                copts.transport = "dcn";
+                copts.pin_connection = true;
+                if (fresh->Init(link.ep, &copts) == 0) {
+                    benchpb::EchoService_Stub stub(fresh.get());
+                    Controller probe;
+                    probe.set_timeout_ms(400);
+                    probe.set_max_retry(0);
+                    benchpb::EchoRequest req;
+                    benchpb::EchoResponse res;
+                    req.set_send_ts_us(monotonic_time_us());
+                    stub.Echo(&probe, &req, &res, nullptr);  // sync
+                    up = !probe.Failed();
+                    if (!up) {
+                        // Don't leak a half-open pinned connection.
+                        Socket::SetFailedById(fresh->pinned_socket());
+                    }
+                }
+            } else {
+                up = fresh->InitIci(link.ep, &copts) == 0;
+            }
+            if (up) {
                 std::lock_guard<std::mutex> g(link.mu);
                 const bool was_connected = link.ch != nullptr;
                 link.ch = std::move(fresh);
@@ -810,7 +870,10 @@ void PrintReport(int id, int port, const Counters& c) {
         "\"outstanding\": %lld, \"reconnects\": %lld, "
         "\"reissues\": %lld, \"budget_exhausted\": %lld, "
         "\"drain_reroutes\": %lld, \"drain_notices\": %lld, "
-        "\"goaways_sent\": %lld}\n",
+        "\"goaways_sent\": %lld, "
+        "\"zone\": \"%s\", \"zone_spills\": %lld, "
+        "\"zone_local_picks\": %lld, \"zone_partition_cuts\": %lld, "
+        "\"dcn_out_bytes\": %lld, \"dcn_in_bytes\": %lld}\n",
         id, port, (long long)c.lb_issued.load(), (long long)c.lb_ok.load(),
         (long long)c.lb_failed.load(), (long long)c.shm_issued.load(),
         (long long)c.shm_ok.load(), (long long)c.shm_failed.load(),
@@ -841,7 +904,12 @@ void PrintReport(int id, int port, const Counters& c) {
         reissues, (long long)VarInt("rpc_retry_budget_exhausted"),
         (long long)VarInt("rpc_client_drain_reroutes"),
         (long long)VarInt("rpc_client_drain_notices"),
-        (long long)VarInt("rpc_server_drain_goaways_sent"));
+        (long long)VarInt("rpc_server_drain_goaways_sent"),
+        g_my_zone.c_str(), (long long)VarInt("rpc_lb_zone_spills"),
+        (long long)VarInt("rpc_lb_zone_local_picks"),
+        (long long)FaultInjection::zone_partition_cuts(),
+        (long long)transport_stats::out_bytes(TierDcn()),
+        (long long)transport_stats::in_bytes(TierDcn()));
     fflush(stdout);
 }
 
@@ -903,6 +971,7 @@ int main(int argc, char** argv) {
     bool collective = false;
     bool coll_traffic = false;
     const char* peers_file = nullptr;
+    const char* dcn_peers_file = nullptr;
     for (int i = 1; i < argc; ++i) {
         if (strcmp(argv[i], "--port") == 0 && i + 1 < argc) {
             port = atoi(argv[++i]);
@@ -910,6 +979,15 @@ int main(int argc, char** argv) {
             id = atoi(argv[++i]);
         } else if (strcmp(argv[i], "--peers") == 0 && i + 1 < argc) {
             peers_file = argv[++i];
+        } else if (strcmp(argv[i], "--zone") == 0 && i + 1 < argc) {
+            // Pod identity (ISSUE 14): feeds -rpc_zone (zone-aware LB +
+            // dcn-tier naming sockets) and the collective membership.
+            g_my_zone = argv[++i];
+            SetFlagValue("rpc_zone", g_my_zone);
+        } else if (strcmp(argv[i], "--dcn_peers") == 0 && i + 1 < argc) {
+            // Cross-pod peers (naming-line format, "ip:port zone=B"):
+            // linked over pinned dcn-tier channels instead of shm.
+            dcn_peers_file = argv[++i];
         } else if (strcmp(argv[i], "--timeout_cl_ms") == 0 && i + 1 < argc) {
             timeout_cl_ms = atoi(argv[++i]);
         } else if (strcmp(argv[i], "--tenant") == 0 && i + 1 < argc) {
@@ -967,6 +1045,7 @@ int main(int argc, char** argv) {
     if (port <= 0 || peers_file == nullptr) {
         fprintf(stderr,
                 "usage: mesh_node --port N --peers FILE [--id K] "
+                "[--zone NAME] [--dcn_peers FILE] "
                 "[--lb_only] [--inline_echo] [--desc_traffic] "
                 "[--collective] [--coll_traffic] "
                 "[--drain_ms N] "
@@ -1018,22 +1097,46 @@ int main(int argc, char** argv) {
         fprintf(stderr, "LB channel init failed for %s\n", url.c_str());
         return 1;
     }
-    // Mesh links: one shm channel per peer (self excluded).
+    // Mesh links: one shm channel per same-zone peer (self excluded;
+    // cross-zone entries in the naming file belong to the OTHER pod and
+    // are reached through --dcn_peers links, never shm). Peer zones are
+    // registered with the fault-injection layer so one
+    // chaos_partition_zone command can cut a whole pod.
     if (!lb_only) {
         FILE* f = fopen(peers_file, "r");
         if (f == nullptr) return 1;
         char line[128];
         while (fgets(line, sizeof(line), f) != nullptr) {
-            EndPoint ep;
-            char* nl = strchr(line, '\n');
-            if (nl != nullptr) *nl = '\0';
-            if (line[0] == '\0' || str2endpoint(line, &ep) != 0) continue;
-            if (ep.port == port) continue;  // self
+            NSNode node;
+            if (ParseNamingLine(line, &node) != 0) continue;
+            const std::string zone = ZoneFromTag(node.tag);
+            if (!zone.empty()) {
+                FaultInjection::SetPeerZone(node.ep, zone);
+            }
+            if (node.ep.port == port) continue;  // self
+            if (!zone.empty() && zone != g_my_zone) continue;  // other pod
             auto link = std::make_unique<PeerLink>();
-            link->ep = ep;
+            link->ep = node.ep;
+            link->zone = g_my_zone;
             st.links.push_back(std::move(link));
         }
         fclose(f);
+        if (dcn_peers_file != nullptr) {
+            FILE* df = fopen(dcn_peers_file, "r");
+            if (df == nullptr) return 1;
+            while (fgets(line, sizeof(line), df) != nullptr) {
+                NSNode node;
+                if (ParseNamingLine(line, &node) != 0) continue;
+                if (node.ep.port == port) continue;
+                auto link = std::make_unique<PeerLink>();
+                link->ep = node.ep;
+                link->dcn = true;
+                link->zone = ZoneFromTag(node.tag);
+                FaultInjection::SetPeerZone(node.ep, link->zone);
+                st.links.push_back(std::move(link));
+            }
+            fclose(df);
+        }
     }
 
     // Collective engine over the shm-link mesh (needs st.links).

@@ -1123,6 +1123,8 @@ public:
     void set_offset(uint64_t v) { offset_ = v; }
     uint64_t len() const { return len_; }
     void set_len(uint64_t v) { len_ = v; }
+    uint32_t scope() const { return scope_; }
+    void set_scope(uint32_t v) { scope_ = v; }
     google::protobuf::Message* New() const override {
         return new CollChunk;
     }
@@ -1142,6 +1144,7 @@ public:
         field(8, total_bytes_);
         field(9, offset_);
         field(10, len_);
+        field(11, scope_);
         return true;
     }
     bool ParseFromString(const std::string& s) override {
@@ -1162,6 +1165,7 @@ public:
                 case 8: total_bytes_ = v; break;
                 case 9: offset_ = v; break;
                 case 10: len_ = v; break;
+                case 11: scope_ = (uint32_t)v; break;
                 default: break;
             }
         }
@@ -1171,6 +1175,7 @@ private:
     uint64_t coll_seq_ = 0, member_hash_ = 0, total_bytes_ = 0;
     uint64_t offset_ = 0, len_ = 0;
     uint32_t kind_ = 0, step_ = 0, chunk_ = 0, src_rank_ = 0, nranks_ = 0;
+    uint32_t scope_ = 0;
 };
 class CollAck : public google::protobuf::Message {
 public:

@@ -59,16 +59,26 @@
 #include "bench_echo.pb.h"
 #include "tbase/endpoint.h"
 #include "tbase/errno.h"
+#include "tbase/flags.h"
 #include "tbase/time.h"
 #include "tfiber/fiber.h"
 #include "tici/block_pool.h"
+#include "tnet/transport.h"
 #include "trpc/channel.h"
 #include "trpc/controller.h"
 #include "tvar/latency_recorder.h"
+#include "tvar/variable.h"
 
 using namespace tpurpc;
 
 namespace {
+
+// In-process numeric tvar read (per-zone LB counters for the report).
+int64_t VarInt(const char* name) {
+    std::string v;
+    if (!Variable::describe_exposed(name, &v)) return 0;
+    return atoll(v.c_str());
+}
 
 // One traffic class of the generator: its own pacing bucket and stats,
 // so per-tenant isolation is measurable from the CLIENT side too.
@@ -197,6 +207,8 @@ int main(int argc, char** argv) {
     const char* metrics_csv = nullptr;
     const char* tenants_spec = nullptr;
     std::string tenant;
+    std::string zone;       // --zone: this generator's pod (ISSUE 14)
+    std::string dcn_peers;  // --dcn_peers=h:p[,h:p]: cross-pod servers
     int priority = -1;
     int max_retry = -1;  // <0 = channel default (3)
     for (int i = 1; i < argc; ++i) {
@@ -224,6 +236,10 @@ int main(int argc, char** argv) {
             callers = atoi(argv[i] + 10);
         }
         if (strncmp(argv[i], "--tenant=", 9) == 0) tenant = argv[i] + 9;
+        if (strncmp(argv[i], "--zone=", 7) == 0) zone = argv[i] + 7;
+        if (strncmp(argv[i], "--dcn_peers=", 12) == 0) {
+            dcn_peers = argv[i] + 12;
+        }
         if (strncmp(argv[i], "--priority=", 11) == 0) {
             priority = atoi(argv[i] + 11);
         }
@@ -252,7 +268,11 @@ int main(int argc, char** argv) {
                 "(alias: --pool-desc)] "
                 "[--timeout_ms=N] "
                 "[--max_retry=N] [--tenant=NAME] [--priority=0..7] "
-                "[--tenants=a:8,b:1 | a:8:7,b:1:1] [--json]\n");
+                "[--tenants=a:8,b:1 | a:8:7,b:1:1] "
+                "[--zone=NAME] [--dcn_peers=ip:port,...] [--json]\n"
+                "  --zone/--dcn_peers: zone-aware LB over the local "
+                "server + cross-pod dcn-tier peers; per-zone picks and "
+                "spills are reported\n");
         return 1;
     }
     EndPoint server;
@@ -314,13 +334,43 @@ int main(int argc, char** argv) {
             return 1;
         }
     }
+    // Mixed intra/cross-pod load (ISSUE 14): with --zone/--dcn_peers the
+    // generator drives a zone-aware LB channel over a list:// naming set
+    // — the local --server tagged with this zone, every --dcn_peers
+    // entry tagged zone=remote (reached over dcn-tier sockets). Picks
+    // stay local while the local server serves; kill it and the spill
+    // counters reported below fire.
+    std::string lb_url;
+    if (!dcn_peers.empty()) {
+        const std::string my_zone = zone.empty() ? "local" : zone;
+        SetFlagValue("rpc_zone", my_zone);
+        lb_url = "list://" + server_str + " zone=" + my_zone;
+        size_t pos = 0;
+        while (pos < dcn_peers.size()) {
+            size_t comma = dcn_peers.find(',', pos);
+            if (comma == std::string::npos) comma = dcn_peers.size();
+            const std::string ep = dcn_peers.substr(pos, comma - pos);
+            pos = comma + 1;
+            if (ep.empty()) continue;
+            // Entries may carry their own "ip:port zone=B" tag (space
+            // separated); bare addresses default to zone=remote.
+            lb_url += "," + ep;
+            if (ep.find("zone=") == std::string::npos) {
+                lb_url += " zone=remote";
+            }
+        }
+    } else if (!zone.empty()) {
+        SetFlagValue("rpc_zone", zone);
+    }
     std::vector<std::unique_ptr<Channel>> channels;
     std::vector<std::unique_ptr<benchpb::EchoService_Stub>> stubs;
     for (int i = 0; i < press_threads; ++i) {
         channels.emplace_back(new Channel);
-        const int rc = pool_desc
-                           ? channels.back()->InitIci(server, &copts)
-                           : channels.back()->Init(server, &copts);
+        const int rc =
+            pool_desc ? channels.back()->InitIci(server, &copts)
+            : !lb_url.empty()
+                ? channels.back()->Init(lb_url.c_str(), "rr", &copts)
+                : channels.back()->Init(server, &copts);
         if (rc != 0) {
             if (pool_desc) {
                 fprintf(stderr,
@@ -505,6 +555,16 @@ int main(int argc, char** argv) {
                (long long)head->lat.latency_percentile(0.999),
                press_threads, callers, payload, pooled ? 1 : 0,
                pool_desc ? 1 : 0, (long long)total_stale);
+        if (!lb_url.empty()) {
+            printf(", \"press_zone\": \"%s\", "
+                   "\"press_zone_local_picks\": %lld, "
+                   "\"press_zone_spills\": %lld, "
+                   "\"press_dcn_out_bytes\": %lld",
+                   zone.empty() ? "local" : zone.c_str(),
+                   (long long)VarInt("rpc_lb_zone_local_picks"),
+                   (long long)VarInt("rpc_lb_zone_spills"),
+                   (long long)transport_stats::out_bytes(TierDcn()));
+        }
         if (gens.size() > 1 || !gens[0]->name.empty()) {
             printf(", \"press_tenants\": {");
             for (size_t i = 0; i < gens.size(); ++i) {
@@ -538,6 +598,14 @@ int main(int argc, char** argv) {
                (long long)head->lat.latency_percentile(0.99),
                (long long)head->lat.latency_percentile(0.999),
                (long long)head->lat.max_latency());
+        if (!lb_url.empty()) {
+            printf("zone %s: local_picks %lld  spills %lld  "
+                   "dcn_out_bytes %lld\n",
+                   zone.empty() ? "local" : zone.c_str(),
+                   (long long)VarInt("rpc_lb_zone_local_picks"),
+                   (long long)VarInt("rpc_lb_zone_spills"),
+                   (long long)transport_stats::out_bytes(TierDcn()));
+        }
         for (auto& g : gens) {
             if (gens.size() <= 1) break;
             printf("  tenant %-12s prio=%d target=%lld qps=%.0f "

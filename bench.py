@@ -603,6 +603,78 @@ def qos_isolation_scrape():
                 proc.wait()
 
 
+def qos_cost_scrape():
+    """Work-priced admission round (ISSUE 15): bronze floods 64KiB
+    bodies INSIDE its request-count rate (a shape a request-counting
+    door admits wholesale) while gold trickles light.
+    qos_cost_gold_p99_us is the compared isolation metric; bronze's
+    shed volume and the server's learned cost estimate are context.
+    Boots its OWN node: -rpc_tenant_quotas only applies at server
+    start (cost units, no conc= — the gradient limiter owns
+    concurrency), and a fresh node keeps the request-count round's
+    learned state out of this measurement."""
+    node = BUILD / "mesh_node"
+    press = BUILD / "rpc_press"
+    if not node.exists() or not press.exists():
+        return None
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    proc = None
+    try:
+        with tempfile.TemporaryDirectory() as td:
+            peers = Path(td) / "peers"
+            peers.write_text("127.0.0.1:%d\n" % port)
+            proc, ready = _spawn_node_ready(
+                node, port, peers,
+                ["--flag", "rpc_qos_enabled=true", "--flag",
+                 "rpc_tenant_quotas=bronze:qps=400,burst=100,w=1;"
+                 "gold:w=8"])
+            if not ready:
+                return None
+            res = subprocess.run(
+                [str(press), "--server=127.0.0.1:%d" % port,
+                 "--tenants=gold:4:7:128,bronze:7:1:65536", "--qps=550",
+                 "--duration_s=3", "--callers=12", "--max_retry=0",
+                 "--json"],
+                capture_output=True, timeout=90, text=True,
+            )
+            line = None
+            for ln in reversed(res.stdout.splitlines()):
+                if ln.startswith("{"):
+                    line = json.loads(ln)
+                    break
+            if line is None or "press_tenants" not in line:
+                return None
+            gold = line["press_tenants"].get("gold", {})
+            bronze = line["press_tenants"].get("bronze", {})
+            tj = json.loads(
+                urllib.request.urlopen(
+                    "http://127.0.0.1:%d/tenants?format=json" % port,
+                    timeout=5).read().decode())
+            srv_bronze = tj.get("tenants", {}).get("bronze", {})
+            return {
+                "qos_cost_gold_p99_us": int(gold.get("p99_us", 0)),
+                "qos_cost_gold_qps": int(gold.get("qps", 0)),
+                "qos_cost_bronze_shed": int(bronze.get("shed", 0)),
+                "qos_cost_bronze_ewma_milli": int(
+                    srv_bronze.get("cost_ewma_milli", 0)),
+                "qos_cost_backoff_ms_max": int(
+                    line.get("press_backoff_ms_max", 0)),
+            }
+    except Exception:
+        return None
+    finally:
+        if proc is not None:
+            try:
+                proc.stdin.close()
+                proc.wait(timeout=10)
+            except Exception:
+                proc.kill()
+                proc.wait()
+
+
 # Compare-mode metric directions: latency-ish keys regress UP, the rest
 # (throughput/qps/counts) regress DOWN. Non-numeric values, series
 # arrays, evidence paths, and derived ratios are skipped — as are the
@@ -624,6 +696,12 @@ _SKIP_KEYS = {"metric", "unit", "vs_baseline", "reps", "error",
               # the flood shape and how hard it is shed, not on code
               # quality — gold qps/p99 are the compared isolation metrics.
               "qos_bronze_shed", "qos_bronze_qps", "qos_gold_failed",
+              # Work-priced round (ISSUE 15): qos_cost_gold_p99_us /
+              # qos_cost_gold_qps ARE compared (isolation under a
+              # mixed-COST flood); shed volume, the learned estimate,
+              # and the backoff hint are flood-shape context.
+              "qos_cost_bronze_shed", "qos_cost_bronze_ewma_milli",
+              "qos_cost_backoff_ms_max",
               # Device ring (ISSUE 9): device_path_gbps is THE compared
               # metric. device_path_mbps is the RETIRED pre-ring key —
               # skip-keyed so the MB/s -> GB/s unit change never flags as
@@ -804,6 +882,7 @@ def run_bench():
     device = device_path()
     series = series_scrape()
     qos = qos_isolation_scrape()
+    qos_cost = qos_cost_scrape()
     coll = collective_scrape()
     dcn_coll = dcn_collective_scrape()
 
@@ -836,6 +915,8 @@ def run_bench():
         out.update(series)
     if qos is not None:
         out.update(qos)
+    if qos_cost is not None:
+        out.update(qos_cost)
     if coll is not None:
         out.update(coll)
     if dcn_coll is not None:

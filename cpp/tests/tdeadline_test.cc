@@ -339,6 +339,54 @@ TEST(Backoff, DeadlineWinsOverHangingRetry) {
     ::close(listener);
 }
 
+TEST(Backoff, OverloadSuggestionCappedByDeadline) {
+    // ISSUE 15 satellite regression: a server-suggested TERR_OVERLOAD
+    // backoff LARGER than the remaining deadline used to fall through
+    // the overshoot guard and re-issue IMMEDIATELY — hammering the
+    // server that just said "not now" and burning every retry within
+    // milliseconds. The jittered hint must instead be CAPPED by the
+    // remaining budget: the client waits out the useful fraction of
+    // its deadline between tries.
+    DeadlineServer ds;
+    ASSERT_TRUE(ds.start());
+    TenantQuota q;
+    q.qps = 0.5;  // one token every 2s: the refill-derived backoff hint
+    q.burst = 1;  // (~2000ms) always dwarfs the 400ms deadline below
+    ds.server.SetTenantQuota("default", q);
+
+    Channel channel;
+    ChannelOptions opts;
+    opts.timeout_ms = 400;
+    opts.max_retry = 3;
+    ASSERT_EQ(channel.Init(ds.ep, &opts), 0);
+    test::EchoService_Stub stub(&channel);
+    {
+        // Burn the single token so the measured call is always shed.
+        Controller warm;
+        test::EchoRequest req;
+        req.set_message("warm");
+        test::EchoResponse res;
+        stub.Echo(&warm, &req, &res, nullptr);
+        ASSERT_FALSE(warm.Failed());
+    }
+    Controller cntl;
+    test::EchoRequest req;
+    req.set_message("x");
+    test::EchoResponse res;
+    const int64_t t0 = monotonic_time_us();
+    stub.Echo(&cntl, &req, &res, nullptr);
+    const int64_t elapsed_us = monotonic_time_us() - t0;
+    EXPECT_TRUE(cntl.Failed());
+    EXPECT_GE(cntl.retried_count(), 1);
+    // The clamped backoff was really waited out (the old
+    // immediate-reissue path finished in a few milliseconds)...
+    EXPECT_GE(elapsed_us, 130 * 1000);
+    // ...but the call never slept past its deadline and died on time.
+    EXPECT_LT(elapsed_us, 1200 * 1000);
+    ds.server.Stop();
+    ds.server.Join();
+}
+
 // ---------------- server-side deadline ----------------
 
 TEST(Deadline, ExpiredOnArrivalIsShedBeforeHandler) {

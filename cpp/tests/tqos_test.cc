@@ -16,6 +16,7 @@
 #include "tbase/errno.h"
 #include "tbase/flags.h"
 #include "tbase/time.h"
+#include "tnet/fault_injection.h"
 #include "trpc/concurrency_limiter.h"
 #include "trpc/qos.h"
 #include "ttest/ttest.h"
@@ -47,11 +48,15 @@ void ShedCb(void* arg, int64_t backoff_ms) {
     delete it;
 }
 
-QosDispatcher::Item MakeItem(const std::string& tag) {
+QosDispatcher::Item MakeItem(const std::string& tag,
+                             int64_t cost_milli = kCostUnitMilli,
+                             bool spill = false) {
     QosDispatcher::Item item;
     item.run = RunCb;
     item.shed = ShedCb;
     item.arg = new TestItem{tag};
+    item.cost_milli = cost_milli;
+    item.spill = spill;
     return item;
 }
 
@@ -467,6 +472,330 @@ TEST(Qos, StopDrainerShedsEvenWhenNeverStarted) {
     EXPECT_EQ(shed.size(), 2u);
     EXPECT_EQ(q.queue_depth(), 0);
     g_shed_order = nullptr;
+}
+
+// ---------------- work-priced admission (ISSUE 15) ----------------
+
+TEST(Qos, ComputeCostMilliMath) {
+    // Defaults: 1000us of service = 1 unit, 16KiB of payload = 1 unit.
+    EXPECT_EQ(ComputeCostMilli(0, 0), kCostUnitMilli);       // floor
+    EXPECT_EQ(ComputeCostMilli(100, 128), kCostUnitMilli);   // light call
+    EXPECT_EQ(ComputeCostMilli(4000, 0), 4 * kCostUnitMilli);
+    EXPECT_EQ(ComputeCostMilli(0, 64 * 1024), 4 * kCostUnitMilli);
+    EXPECT_EQ(ComputeCostMilli(2000, 32 * 1024), 4 * kCostUnitMilli);
+    // Capped: one pathological sample cannot mint unbounded debt.
+    EXPECT_EQ(ComputeCostMilli(1 << 30, 1LL << 40),
+              1024 * kCostUnitMilli);
+}
+
+TEST(Qos, SpillCostAdjustment) {
+    // Zone-neutral until both ends are zone-tagged.
+    EXPECT_FALSE(SpillArrival(""));
+    EXPECT_FALSE(SpillArrival("B"));  // we have no zone of our own
+    SetFlagValue("rpc_zone", "A");
+    EXPECT_FALSE(SpillArrival("A"));  // same pod = local
+    EXPECT_TRUE(SpillArrival("B"));   // cross-pod spill
+    SetFlagValue("rpc_zone", "");
+    // Default multiplier 2.0, capped at the model maximum.
+    EXPECT_EQ(SpillAdjustedCostMilli(kCostUnitMilli), 2 * kCostUnitMilli);
+    EXPECT_EQ(SpillAdjustedCostMilli(1024 * kCostUnitMilli),
+              1024 * kCostUnitMilli);
+}
+
+TEST(Qos, TokenBucketCostWithdraw) {
+    TokenBucket b;
+    b.Configure(100, 10);  // 100 units/s, burst 10 units
+    const int64_t t0 = monotonic_time_us();
+    int64_t wait_ms = 0;
+    // One 4-unit call burns four baseline calls' worth.
+    EXPECT_TRUE(b.TryWithdrawCost(t0, 4 * kCostUnitMilli, &wait_ms));
+    for (int i = 0; i < 6; ++i) {
+        EXPECT_TRUE(b.TryWithdraw(t0, &wait_ms));
+    }
+    EXPECT_FALSE(b.TryWithdraw(t0, &wait_ms));
+    EXPECT_GE(wait_ms, 1);
+    // A 3-unit withdrawal when dry reports a LONGER wait than a 1-unit
+    // one would (the deficit is cost-sized).
+    int64_t wait3 = 0;
+    EXPECT_FALSE(b.TryWithdrawCost(t0, 3 * kCostUnitMilli, &wait3));
+    EXPECT_GE(wait3, wait_ms);
+    // A call costing MORE than the whole burst admits at a full bucket
+    // (and leaves it in debt) instead of starving forever.
+    TokenBucket heavy;
+    heavy.Configure(10, 4);  // burst 4 units
+    const int64_t t1 = monotonic_time_us();
+    EXPECT_TRUE(heavy.TryWithdrawCost(t1, 20 * kCostUnitMilli, &wait_ms));
+    // Deep in debt now: even a baseline call must wait.
+    EXPECT_FALSE(heavy.TryWithdraw(t1, &wait_ms));
+    EXPECT_GE(wait_ms, 100);  // >= 1 unit of debt at 10 units/s
+}
+
+TEST(Qos, CostModelEwmaFoldAndEstimate) {
+    QosDispatcher q;
+    auto* t = q.Acquire("cost_model_t");
+    const std::string echo = "svc.Echo";
+    // Unmeasured method: one baseline unit.
+    EXPECT_EQ(q.EstimateCostMilli(t, echo), kCostUnitMilli);
+    // Teach it: 8ms of service + 64KiB of payload = ~12 units. The
+    // first sample seeds the EWMA directly.
+    QosDispatcher::CompletionInfo ci;
+    ci.method = &echo;
+    ci.logical_bytes = 64 * 1024;
+    q.BeginServed(t);
+    q.OnDone(t, 8000, ci);
+    const int64_t est = q.EstimateCostMilli(t, echo);
+    EXPECT_GE(est, 10 * kCostUnitMilli);
+    EXPECT_LE(est, 14 * kCostUnitMilli);
+    // A light sample folds the estimate DOWN (alpha 1/4), not to zero.
+    ci.logical_bytes = 0;
+    q.BeginServed(t);
+    q.OnDone(t, 100, ci);
+    const int64_t est2 = q.EstimateCostMilli(t, echo);
+    EXPECT_LT(est2, est);
+    EXPECT_GT(est2, kCostUnitMilli);
+}
+
+TEST(Qos, CostModelMethodCardinalityFolds) {
+    SetFlagValue("rpc_cost_max_methods", "2");
+    {
+        QosDispatcher q;
+        auto* t = q.Acquire("cost_card_t");
+        std::string m1 = "svc.A", m2 = "svc.B", m3 = "svc.C";
+        QosDispatcher::CompletionInfo ci;
+        for (std::string* m : {&m1, &m2}) {
+            ci.method = m;
+            ci.logical_bytes = 0;
+            q.BeginServed(t);
+            q.OnDone(t, 100, ci);
+        }
+        // Past the cap, a fresh method teaches the OVERFLOW bucket —
+        // and an unknown method's estimate reads it.
+        ci.method = &m3;
+        ci.logical_bytes = 64 * 1024;
+        q.BeginServed(t);
+        q.OnDone(t, 8000, ci);
+        std::string m4 = "svc.D";
+        EXPECT_GE(q.EstimateCostMilli(t, m4), 4 * kCostUnitMilli);
+        // Known methods keep their own (light) estimates.
+        EXPECT_EQ(q.EstimateCostMilli(t, m1), kCostUnitMilli);
+    }
+    SetFlagValue("rpc_cost_max_methods", "32");
+}
+
+TEST(Qos, AdmitCostPricesHeavyCalls) {
+    QosDispatcher q;
+    // 8 units/s, burst 8: within an 8-REQUEST count budget, but heavy
+    // calls must still shed.
+    q.SetTenantQuota("cost_admit_t", TenantQuota{8, 8, 1, 0});
+    auto* t = q.Acquire("cost_admit_t");
+    const int64_t now = monotonic_time_us();
+    int64_t backoff = 0;
+    // Two 4-unit calls drain the burst that held 8 baseline requests.
+    EXPECT_TRUE(q.AdmitCost(t, now, 4 * kCostUnitMilli, &backoff));
+    EXPECT_TRUE(q.AdmitCost(t, now, 4 * kCostUnitMilli, &backoff));
+    EXPECT_FALSE(q.AdmitCost(t, now, kCostUnitMilli, &backoff));
+    EXPECT_GE(backoff, 1);
+    EXPECT_GE(t->cost_shed->get(), kCostUnitMilli);
+    EXPECT_GE(t->cost_admitted->get(), 0);  // admit counts at dispatch
+}
+
+TEST(Qos, DrrCostProportionalService) {
+    QosDispatcher q;
+    q.SetTenantQuota("drr_heavy", TenantQuota{0, 0, 1, 0});
+    q.SetTenantQuota("drr_light", TenantQuota{0, 0, 1, 0});
+    auto* heavy = q.Acquire("drr_heavy");
+    auto* light = q.Acquire("drr_light");
+    for (int i = 0; i < 20; ++i) {
+        EXPECT_TRUE(q.Enqueue(heavy, kDefaultPriority,
+                              MakeItem("H", 4 * kCostUnitMilli)));
+        EXPECT_TRUE(q.Enqueue(light, kDefaultPriority, MakeItem("L")));
+    }
+    std::vector<std::string> order;
+    g_ran_order = &order;
+    QosDispatcher::Item it;
+    QosDispatcher::TenantState* owner;
+    int prio;
+    for (int i = 0; i < 10; ++i) {
+        ASSERT_TRUE(q.Pop(&it, &owner, &prio));
+        it.run(it.arg);
+        q.OnDone(owner, 10);
+    }
+    // Equal weights, 4:1 cost ratio: the heavy tenant serves ~1 item
+    // per 4 of the light tenant's — SERVICE IN COST UNITS stays equal,
+    // so one heavy call burns proportionally more of its turn.
+    int h = 0, l = 0;
+    for (const auto& tag : order) (tag == "H" ? h : l)++;
+    EXPECT_GE(h, 1);
+    EXPECT_LE(h, 3);
+    EXPECT_EQ(l, 10 - h);
+    const int64_t units_h = (int64_t)h * 4, units_l = l;
+    EXPECT_LE(units_h > units_l ? units_h - units_l : units_l - units_h,
+              4);
+    g_ran_order = nullptr;
+    DrainAll(&q);
+}
+
+TEST(Qos, SpillShedsFirstWithinLevel) {
+    SetFlagValue("rpc_fair_queue_highwater", "4");
+    {
+        QosDispatcher q;
+        auto* local_t = q.Acquire("spill_local");
+        auto* spill_t = q.Acquire("spill_remote");
+        EXPECT_TRUE(q.Enqueue(local_t, 1, MakeItem("l0")));
+        EXPECT_TRUE(q.Enqueue(spill_t, 1,
+                              MakeItem("s0", 2 * kCostUnitMilli,
+                                       /*spill=*/true)));
+        EXPECT_TRUE(q.Enqueue(local_t, 1, MakeItem("l1")));
+        EXPECT_TRUE(q.Enqueue(local_t, 1, MakeItem("l2")));
+        std::vector<std::string> shed;
+        g_shed_order = &shed;
+        // Full queue + higher-priority arrival: the SPILL item is
+        // evicted first even though local l2 is newer and local's
+        // queue is deeper.
+        EXPECT_TRUE(q.Enqueue(spill_t, 6, MakeItem("hi0")));
+        ASSERT_EQ(shed.size(), 1u);
+        EXPECT_EQ(shed[0], "s0");
+        // With no spills left, eviction falls back to the newest item
+        // of the deepest queue (the flooder).
+        EXPECT_TRUE(q.Enqueue(spill_t, 6, MakeItem("hi1")));
+        ASSERT_EQ(shed.size(), 2u);
+        EXPECT_EQ(shed[1], "l2");
+        g_shed_order = nullptr;
+        DrainAll(&q);
+    }
+    SetFlagValue("rpc_fair_queue_highwater", "1024");
+}
+
+TEST(Qos, QueueDelayShedAndDrainBackoff) {
+    SetFlagValue("rpc_queue_delay_target_ms", "5");
+    SetFlagValue("rpc_queue_delay_interval_ms", "1");
+    {
+        QosDispatcher q;
+        auto* t = q.Acquire("delay_t");
+        // Four items that have "waited" 300ms already (pre-stamped):
+        // every sojourn measurement lands far above the 5ms target.
+        const int64_t stale = monotonic_time_us() - 300 * 1000;
+        for (int i = 0; i < 4; ++i) {
+            QosDispatcher::Item item =
+                MakeItem("old" + std::to_string(i), 8 * kCostUnitMilli);
+            item.enqueue_us = stale;
+            EXPECT_TRUE(q.Enqueue(t, 3, item));
+        }
+        QosDispatcher::Item it;
+        QosDispatcher::TenantState* owner;
+        int prio;
+        ASSERT_TRUE(q.Pop(&it, &owner, &prio));
+        it.run(it.arg);
+        q.OnDone(owner, 10);
+        usleep(3 * 1000);  // a full observation interval elapses
+        ASSERT_TRUE(q.Pop(&it, &owner, &prio));
+        it.run(it.arg);
+        q.OnDone(owner, 10);
+        // The measured sojourn never dipped below target for a whole
+        // interval: the queue is in overload — a depth of TWO (far
+        // below the 1024 high-water) now sheds arrivals, because the
+        // signal is the MEASURED delay, not a static depth.
+        EXPECT_TRUE(q.OverDelayTarget());
+        EXPECT_GE(q.QueueDelayEwmaUs(), 10 * 1000);
+        std::vector<std::string> shed;
+        g_shed_order = &shed;
+        EXPECT_FALSE(q.Enqueue(t, 3, MakeItem("shed_me")));
+        ASSERT_EQ(shed.size(), 1u);
+        // The backoff hint is drain-derived: a measured rate exists and
+        // the hint respects the flag floor / 2s cap.
+        EXPECT_GT(q.DrainRateCostPerS(), 0);
+        const int64_t hint = q.SuggestedBackoffMs();
+        EXPECT_GE(hint, 1);
+        EXPECT_LE(hint, 2000);
+        g_shed_order = nullptr;
+        // Draining to empty clears the overload verdict.
+        DrainAll(&q);
+        EXPECT_FALSE(q.OverDelayTarget());
+        EXPECT_TRUE(q.Enqueue(t, 3, MakeItem("fine_again")));
+        DrainAll(&q);
+    }
+    SetFlagValue("rpc_queue_delay_target_ms", "20");
+    SetFlagValue("rpc_queue_delay_interval_ms", "100");
+}
+
+TEST(Qos, GradientLimitGatesDispatch) {
+    QosDispatcher q;
+    AutoConcurrencyLimiter::Options opt;
+    opt.initial_max_concurrency = 2;
+    opt.min_max_concurrency = 2;
+    q.SetGradientOptions(opt);
+    // NO conc= share configured: the tenant's own gradient limiter
+    // gates, starting from its initial limit.
+    auto* t = q.Acquire("gradient_t");
+    EXPECT_EQ(q.TenantConcurrencyLimit(t), 2);
+    EXPECT_TRUE(q.TryDirectDispatch(t));
+    EXPECT_TRUE(q.TryDirectDispatch(t));
+    EXPECT_FALSE(q.TryDirectDispatch(t));  // over the gradient limit
+    q.OnDone(t, 100);
+    q.OnDone(t, 100);
+    // An EXPLICIT share always wins over the gradient.
+    q.SetTenantQuota("gradient_t", TenantQuota{0, 0, 1, 5});
+    EXPECT_EQ(q.TenantConcurrencyLimit(t), 5);
+    // And the flag turns the mechanism off entirely.
+    q.SetTenantQuota("gradient_t", TenantQuota{0, 0, 1, 0});
+    SetFlagValue("rpc_tenant_gradient_limit", "false");
+    EXPECT_EQ(q.TenantConcurrencyLimit(t), 0);  // unlimited
+    SetFlagValue("rpc_tenant_gradient_limit", "true");
+}
+
+TEST(Qos, GradientConvergesFromMeasurement) {
+    // The limiter the per-tenant tier instantiates: with tight windows
+    // it must recompute its limit from observed latency — update_count
+    // is the "converged from measurement, not hand-set" proof the soak
+    // asserts through /tenants?format=json.
+    AutoConcurrencyLimiter::Options opt;
+    opt.initial_max_concurrency = 40;
+    opt.min_max_concurrency = 4;
+    opt.sampling_interval_us = 0;
+    opt.sample_window_us = 1000;
+    opt.min_sample_count = 5;
+    opt.max_sample_count = 10;
+    AutoConcurrencyLimiter lim(opt);
+    EXPECT_EQ(lim.update_count(), 0);
+    for (int i = 0; i < 60; ++i) {
+        lim.OnResponded(0, 200);
+        if (i % 10 == 9) usleep(2000);  // let windows close
+    }
+    EXPECT_GE(lim.update_count(), 1);
+    EXPECT_GE(lim.MaxConcurrency(), opt.min_max_concurrency);
+    EXPECT_GT(lim.min_latency_us(), 0);
+}
+
+TEST(Qos, CostInflateChaosPlan) {
+    // Plan grammar: cost_inflate takes prob[:multiplier].
+    EXPECT_TRUE(FaultInjection::ValidatePlan("cost_inflate=1:8"));
+    EXPECT_TRUE(FaultInjection::ValidatePlan("cost_inflate=0.5"));
+    EXPECT_FALSE(FaultInjection::ValidatePlan("cost_inflate=1:0"));
+    EXPECT_FALSE(FaultInjection::ValidatePlan("cost_inflate=2:8"));
+    SetFlagValue("chaos_plan", "cost_inflate=1:8");
+    SetFlagValue("chaos_seed", "7");
+    SetFlagValue("chaos_enabled", "true");
+    // The seam decision: kCostMeasure ops inflate, byte ops do not.
+    const FaultAction a =
+        FaultInjection::Decide(FaultOp::kCostMeasure, EndPoint(), 128);
+    EXPECT_EQ((int)a.kind, (int)FaultAction::kInflate);
+    EXPECT_EQ((int64_t)a.aux, 8);
+    const FaultAction w =
+        FaultInjection::Decide(FaultOp::kWrite, EndPoint(), 128);
+    EXPECT_NE((int)w.kind, (int)FaultAction::kInflate);
+    // End to end: under the plan, one completion teaches an 8x-priced
+    // estimate (measured ~1 unit -> ~8 units).
+    QosDispatcher q;
+    auto* t = q.Acquire("inflate_t");
+    const std::string m = "svc.Inflated";
+    QosDispatcher::CompletionInfo ci;
+    ci.method = &m;
+    ci.logical_bytes = 0;
+    q.BeginServed(t);
+    q.OnDone(t, 500, ci);
+    EXPECT_GE(q.EstimateCostMilli(t, m), 4 * kCostUnitMilli);
+    SetFlagValue("chaos_enabled", "false");
+    SetFlagValue("chaos_plan", "");
 }
 
 TEST(Qos, StopDrainerShedsBacklog) {

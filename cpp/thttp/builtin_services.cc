@@ -56,8 +56,10 @@ void HandleIndex(Server*, const HttpRequest&, HttpResponse* res) {
         "/flags        runtime flags (/flags/<name>?setvalue=v to set)\n"
         "/connections  accepted connections + per-socket I/O attribution\n"
         "/loops        event-dispatcher + fiber-scheduler telemetry\n"
-        "/tenants      multi-tenant QoS: quotas, fair-queue depth,\n"
-        "              per-tenant admitted/shed/queued/p99\n"
+        "/tenants      multi-tenant QoS: cost quotas, fair-queue depth,\n"
+        "              measured queue delay + drain-rate backoff,\n"
+        "              per-tenant admitted/shed/queued/p99 + cost\n"
+        "              units + gradient concurrency limit\n"
         "              (?format=json machine form)\n"
         "/rpcz         sampled per-RPC spans (enable_rpcz flag;\n"
         "              ?trace_id=N filter, &format=json machine form)\n"

@@ -259,10 +259,13 @@ struct GrpcCallCtx {
     std::unique_ptr<google::protobuf::Message> res;
     Controller cntl;
     // Multi-tenant accounting (ISSUE 8): x-tpu-tenant/x-tpu-priority
-    // identity parsed at dispatch; completion reports to the QoS tier.
+    // identity parsed at dispatch; completion reports to the QoS tier
+    // (and teaches the cost model — ISSUE 15).
     QosDispatcher* qos = nullptr;
     QosDispatcher::TenantState* qos_tenant = nullptr;
     int64_t qos_start_us = 0;
+    std::string qos_method;    // cost-model key
+    int64_t qos_bytes = 0;     // grpc message payload bytes
 };
 
 // gRPC spec: grpc-message is percent-encoded (and h2 forbids CR/LF/NUL
@@ -295,10 +298,16 @@ void* RunGrpcCall(void* arg) {
         server_call::Unregister(c->sid, c->stream_id);
         c->cntl.DestroyServerCallId();
         // Per-tenant completion BEFORE Finish (which is the last legal
-        // touch of Server memory).
+        // touch of Server memory). Teaches the cost model + the
+        // tenant's gradient limiter.
         if (c->qos_tenant != nullptr) {
+            QosDispatcher::CompletionInfo ci;
+            ci.error_code = error_code;
+            ci.method = &c->qos_method;
+            ci.logical_bytes = c->qos_bytes;
+            ci.peer = c->cntl.remote_side();
             c->qos->OnDone(c->qos_tenant,
-                           monotonic_time_us() - c->qos_start_us);
+                           monotonic_time_us() - c->qos_start_us, ci);
         }
         c->guard->Finish(error_code);
         delete c->guard;
@@ -459,12 +468,19 @@ void DispatchCompleteStream(Socket* s, H2Session* sess, uint32_t stream_id,
         const int priority =
             PriorityFromHeader(FindHeader(req_headers, "x-tpu-priority"));
         QosDispatcher::TenantState* tstate = nullptr;
+        // Work-priced admission (ISSUE 15): the h2 door charges the
+        // same per-(tenant, method) cost estimate as tpu_std.
+        const std::string method_key =
+            mp->method->service()->full_name() + "." + mp->method->name();
+        int64_t cost_milli = kCostUnitMilli;
         if (qos->enabled()) {
             tstate = qos->Acquire(xt != nullptr ? *xt : "");
+            cost_milli = qos->EstimateCostMilli(tstate, method_key);
             int64_t backoff_ms = 0;
-            if (!qos->AdmitQps(tstate, arrival_us, &backoff_ms)) {
+            if (!qos->AdmitCost(tstate, arrival_us, cost_milli,
+                                &backoff_ms)) {
                 RespondGrpcError(s->id(), stream_id, 8,
-                                 "tenant over its qps quota; retry after " +
+                                 "tenant over its cost quota; retry after " +
                                      std::to_string(backoff_ms) + "ms");
                 return;
             }
@@ -478,7 +494,7 @@ void DispatchCompleteStream(Socket* s, H2Session* sess, uint32_t stream_id,
             if (shed) {
                 server_call::CountShed();
             } else if (tstate != nullptr) {
-                qos->CountShed(tstate);
+                qos->CountShed(tstate, cost_milli);
             }
             RespondGrpcError(s->id(), stream_id, 8,
                              shed ? "remaining deadline budget below "
@@ -536,10 +552,12 @@ void DispatchCompleteStream(Socket* s, H2Session* sess, uint32_t stream_id,
         // every BeginServed must reach RunGrpcCall's finish/OnDone, or
         // the tenant's concurrency share leaks and eventually bricks it.
         if (tstate != nullptr) {
-            qos->BeginServed(tstate);
+            qos->BeginServed(tstate, cost_milli);
             ctx->qos = qos;
             ctx->qos_tenant = tstate;
             ctx->qos_start_us = arrival_us;
+            ctx->qos_method = method_key;
+            ctx->qos_bytes = (int64_t)msg_len;
         }
         // Cancelable handle keyed by the h2 stream id: RST_STREAM and
         // connection death deliver the cancel; RunGrpcCall tears both

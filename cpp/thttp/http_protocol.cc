@@ -100,14 +100,20 @@ bool DispatchHttpRpc(Server* server, const HttpRequest& req,
         PriorityFromHeader(req.FindHeader("x-tpu-priority"));
     QosDispatcher::TenantState* tstate = nullptr;
     const int64_t arrival_us = monotonic_time_us();
+    // Work-priced admission (ISSUE 15): the json door charges the same
+    // per-(tenant, method) cost estimate as the native protocols.
+    const std::string method_key =
+        mp->method->service()->full_name() + "." + mp->method->name();
+    int64_t cost_milli = kCostUnitMilli;
     if (qos->enabled()) {
         tstate = qos->Acquire(xt != nullptr ? *xt : "");
+        cost_milli = qos->EstimateCostMilli(tstate, method_key);
         int64_t backoff_ms = 0;
-        if (!qos->AdmitQps(tstate, arrival_us, &backoff_ms)) {
+        if (!qos->AdmitCost(tstate, arrival_us, cost_milli, &backoff_ms)) {
             res->status = 429;
             res->headers["Retry-After"] =
                 std::to_string((backoff_ms + 999) / 1000);
-            res->Append("{\"error\":\"tenant over its qps quota\","
+            res->Append("{\"error\":\"tenant over its cost quota\","
                         "\"backoff_ms\":" +
                         std::to_string(backoff_ms) + "}\n");
             return true;
@@ -116,12 +122,12 @@ bool DispatchHttpRpc(Server* server, const HttpRequest& req,
     // Admission + stats + Join accounting shared with the native protocol.
     Server::MethodCallGuard guard(server, mp, -1, priority);
     if (guard.rejected()) {
-        if (tstate != nullptr) qos->CountShed(tstate);
+        if (tstate != nullptr) qos->CountShed(tstate, cost_milli);
         res->status = qos->enabled() ? 429 : 503;
         res->Append("{\"error\":\"concurrency limit\"}\n");
         return true;
     }
-    if (tstate != nullptr) qos->BeginServed(tstate);
+    if (tstate != nullptr) qos->BeginServed(tstate, cost_milli);
 
     std::unique_ptr<google::protobuf::Message> pb_req(
         mp->service->GetRequestPrototype(mp->method).New());
@@ -181,8 +187,15 @@ bool DispatchHttpRpc(Server* server, const HttpRequest& req,
     }
     // Per-tenant completion, then feed the limiter/stats the RPC error
     // (the same signal the native protocol uses), not the HTTP status.
+    // The completion teaches the cost model (body bytes = the logical
+    // payload of a json call) and the tenant's gradient limiter.
     if (tstate != nullptr) {
-        qos->OnDone(tstate, monotonic_time_us() - arrival_us);
+        QosDispatcher::CompletionInfo ci;
+        ci.error_code = cntl.Failed() ? cntl.ErrorCode() : 0;
+        ci.method = &method_key;
+        ci.logical_bytes = (int64_t)body.size();
+        ci.peer = remote_side;
+        qos->OnDone(tstate, monotonic_time_us() - arrival_us, ci);
     }
     guard.Finish(cntl.Failed() ? cntl.ErrorCode()
                                : (res->status == 200 ? 0 : res->status));

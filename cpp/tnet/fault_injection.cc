@@ -28,11 +28,14 @@ DEFINE_string(chaos_plan, "",
               "comma list of kind=probability[:param] entries; kinds: "
               "drop, delay (param = microseconds, default 2000), short, "
               "corrupt, reset (read/write ops), refuse "
-              "(accept/connect), and the zero-copy pool seams "
+              "(accept/connect), the zero-copy pool seams "
               "pool_corrupt, pool_stale (descriptor resolve), "
               "pool_leak (pinned-block release), ring_delay (param = "
-              "microseconds), ring_drop (staging-ring completes); e.g. "
-              "'drop=0.01,delay=0.05:2000,pool_stale=0.2,ring_drop=0.1'");
+              "microseconds), ring_drop (staging-ring completes), and "
+              "cost_inflate (param = multiplier, default 10: inflate a "
+              "completion's measured cost before it feeds the QoS "
+              "admission cost model); e.g. "
+              "'drop=0.01,delay=0.05:2000,cost_inflate=1:8'");
 DEFINE_string(chaos_peers, "",
               "comma list of ip:port remote endpoints the plan applies "
               "to; empty = all peers. Non-matching traffic neither "
@@ -68,8 +71,8 @@ inline double to_unit(uint64_t r) {
 // Kind -> name, indexed by FaultAction::Kind (tvar suffixes AND the
 // /chaos page lines — one table so they can never desynchronize).
 const char* const kKindNames[FaultAction::kKindCount] = {
-    "none",    "delay", "short",  "drop",
-    "corrupt", "reset", "refuse", "stale_epoch"};
+    "none",    "delay", "short",  "drop",        "corrupt",
+    "reset",   "refuse", "stale_epoch", "cost_inflate"};
 
 struct FaultPlan {
     // Read/write fault probabilities (selected by one uniform draw over
@@ -89,8 +92,13 @@ struct FaultPlan {
     double pool_leak = 0.0;
     double ring_delay = 0.0;
     double ring_drop = 0.0;
+    // Work-priced admission seam (ISSUE 15): probability that a
+    // completion's measured cost is inflated before feeding the QoS
+    // cost model, and the multiplier applied.
+    double cost_inflate = 0.0;
     int64_t delay_us = 2000;
     int64_t ring_delay_us = 2000;
+    int64_t cost_inflate_mult = 10;
     std::vector<EndPoint> peers;  // empty = every peer
     // Zone partition (ISSUE 14): all traffic to peers of this zone is
     // cut. Lives in the doubly-buffered plan so the hot path reads it
@@ -181,10 +189,12 @@ bool ParsePlan(const std::string& text, FaultPlan* plan) {
                           &prob)) {
             return false;
         }
-        // Only the delay kinds take a :param (microseconds); junk like
-        // "5ms" or a param on another kind must REJECT, not silently
-        // half-apply (the /chaos page promises validate-before-mutate).
-        if (!param_str.empty() && kind != "delay" && kind != "ring_delay") {
+        // Only the delay kinds (param = microseconds) and cost_inflate
+        // (param = multiplier) take a :param; junk like "5ms" or a
+        // param on another kind must REJECT, not silently half-apply
+        // (the /chaos page promises validate-before-mutate).
+        if (!param_str.empty() && kind != "delay" &&
+            kind != "ring_delay" && kind != "cost_inflate") {
             return false;
         }
         const auto parse_us = [&](int64_t* out) {
@@ -221,6 +231,9 @@ bool ParsePlan(const std::string& text, FaultPlan* plan) {
             if (!parse_us(&plan->ring_delay_us)) return false;
         } else if (kind == "ring_drop") {
             plan->ring_drop = prob;
+        } else if (kind == "cost_inflate") {
+            plan->cost_inflate = prob;
+            if (!parse_us(&plan->cost_inflate_mult)) return false;
         } else {
             return false;
         }
@@ -392,6 +405,14 @@ FaultAction FaultInjection::Decide(FaultOp op, const EndPoint& peer,
         // Leaked-pin simulation: EndRPC "forgets" the release; the
         // expiry reaper must reclaim it (rpc_pool_reaped > 0).
         if (u < p->pool_leak) action.kind = FaultAction::kDrop;
+    } else if (op == FaultOp::kCostMeasure) {
+        // Cost inflation (ISSUE 15): the QoS cost model multiplies this
+        // completion's measured cost by aux before the EWMA fold —
+        // work-priced shedding without moving real bytes.
+        if (u < p->cost_inflate) {
+            action.kind = FaultAction::kInflate;
+            action.aux = (uint64_t)p->cost_inflate_mult;
+        }
     } else {
         double acc = 0.0;
         if (u < (acc += p->drop)) {

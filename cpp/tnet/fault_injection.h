@@ -46,6 +46,10 @@ enum class FaultOp {
     kPoolResolve = 4,   // server-side descriptor resolve (crc / epoch)
     kRingComplete = 5,  // device staging-ring completion
     kLeaseRelease = 6,  // pinned-block release at EndRPC (leak sim)
+    // Work-priced admission seam (ISSUE 15): consulted when a handler
+    // completion feeds its measured cost into the QoS cost model, so a
+    // soak can inflate a method's price without moving real bytes.
+    kCostMeasure = 7,
 };
 
 // What the consulting seam should do.
@@ -62,12 +66,16 @@ struct FaultAction {
         // the descriptor's pool_epoch predated the mapping — the call
         // must fail retriable (TERR_STALE_EPOCH), never the connection.
         kStaleEpoch,
+        // Cost inflation (kCostMeasure only, ISSUE 15): multiply the
+        // measured handler cost by `aux` before it feeds the admission
+        // cost model — drives work-priced shedding in soaks.
+        kInflate,
         kKindCount  // sentinel (counter array size)
     };
     Kind kind = kNone;
     int64_t delay_us = 0;   // kDelay
     size_t max_bytes = 0;   // kShort: cap for this operation
-    uint64_t aux = 0;       // kCorrupt: deterministic byte-position seed
+    uint64_t aux = 0;       // kCorrupt: byte-position seed; kInflate: mult
 };
 
 namespace fault_internal {

@@ -59,6 +59,7 @@ void AutoConcurrencyLimiter::OnResponded(int error_code, int64_t latency_us) {
         max_concurrency_.store(
             std::max(opt_.min_max_concurrency, cur / 2),
             std::memory_order_relaxed);
+        nupdates_.fetch_add(1, std::memory_order_relaxed);
     }
     ResetSampleWindow(now_us);
 }
@@ -134,6 +135,7 @@ void AutoConcurrencyLimiter::UpdateMaxConcurrency(int64_t now_us) {
     }
     max_concurrency_.store(std::max(opt_.min_max_concurrency, next),
                            std::memory_order_relaxed);
+    nupdates_.fetch_add(1, std::memory_order_relaxed);
 }
 
 }  // namespace tpurpc

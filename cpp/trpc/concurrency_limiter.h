@@ -202,6 +202,13 @@ public:
     // Exposed for tests: the smoothed no-load latency estimate.
     int64_t min_latency_us() const { return min_latency_us_; }
     double ema_max_qps() const { return ema_max_qps_; }
+    // Completed limit recomputations (steady-state updates, remeasure
+    // probes, and all-failed halvings). The per-tenant gradient tier
+    // (ISSUE 15) exposes it so "the limit converged from measurement,
+    // not a hand-set constant" is an assertable fact, not a belief.
+    int64_t update_count() const {
+        return nupdates_.load(std::memory_order_relaxed);
+    }
 
 private:
     // All called under sw_mu_.
@@ -218,6 +225,7 @@ private:
 
     const Options opt_;
     std::atomic<int64_t> max_concurrency_;
+    std::atomic<int64_t> nupdates_{0};
     // Window state (sampled path only).
     int64_t remeasure_start_us_;
     int64_t reset_latency_us_;

@@ -33,6 +33,7 @@
 DEFINE_bool(rpc_checksum, false,
             "crc32c-protect tpu_std frame bodies (verified when present)");
 DECLARE_bool(chaos_enabled);
+DECLARE_string(rpc_zone);
 
 #include "trpc/server_call.h"
 
@@ -567,10 +568,36 @@ int Controller::HandleError(CallId id, int error) {
                 // policy's own (longer) backoff wins if larger.
                 if (error == TERR_OVERLOAD && suggested_backoff_ms_ > 0) {
                     const int64_t s = suggested_backoff_ms_;
-                    backoff_ms = std::max<int64_t>(
-                        backoff_ms,
+                    int64_t jittered =
                         s / 2 + (int64_t)(fast_rand() %
-                                          (uint64_t)(s / 2 + 1)));
+                                          (uint64_t)(s / 2 + 1));
+                    // Capped by the call's remaining deadline budget
+                    // (ISSUE 15 satellite): a suggestion past the
+                    // deadline used to fall through the overshoot
+                    // guard below and re-issue IMMEDIATELY at a server
+                    // that just said "not now" — hammering it AND
+                    // burning the try. Sleep the useful fraction of
+                    // what's left (7/8, so the retry itself still has
+                    // budget to run) instead.
+                    if (deadline_us_ > 0) {
+                        const int64_t remaining_ms =
+                            (deadline_us_ - monotonic_time_us()) / 1000;
+                        const int64_t cap =
+                            remaining_ms -
+                            std::max<int64_t>(1, remaining_ms / 8);
+                        if (jittered > cap) {
+                            jittered = std::max<int64_t>(cap, 0);
+                            if (span_ != nullptr) {
+                                span_->Annotate(
+                                    "overload backoff clamped to "
+                                    "deadline budget: " +
+                                    std::to_string(jittered) +
+                                    "ms (server suggested " +
+                                    std::to_string(s) + "ms)");
+                            }
+                        }
+                    }
+                    backoff_ms = std::max<int64_t>(backoff_ms, jittered);
                 }
                 error_code_ = 0;  // a later try owns the final verdict
                 error_text_.clear();
@@ -834,6 +861,13 @@ void Controller::IssueRPC() {
     // the default tenant/priority.
     if (!tenant_.empty()) req_meta->set_tenant(tenant_);
     if (priority_ >= 0) req_meta->set_priority(priority_);
+    // Pod identity (ISSUE 15d): a zone-tagged sender announces itself
+    // so the receiver can price cross-pod spill arrivals above local
+    // work (and shed them first within a priority level).
+    {
+        const std::string my_zone = FLAGS_rpc_zone.get();
+        if (!my_zone.empty()) req_meta->set_zone(my_zone);
+    }
     if (span_ != nullptr) {
         req_meta->set_trace_id(span_->trace_id);
         req_meta->set_span_id(span_->span_id);

@@ -22,11 +22,11 @@
 
 // Pod identity of THIS process (ISSUE 14). Naming entries tagged with a
 // different zone are cross-pod: reached over the dcn transport tier and
-// spilled to only when the local zone cannot serve.
-DEFINE_string(rpc_zone, "",
-              "locality zone (pod) of this process; naming entries "
-              "tagged zone=OTHER are treated as cross-pod (dcn tier, "
-              "spill-only LB). Empty = zoneless (all peers local)");
+// spilled to only when the local zone cannot serve. The flag itself
+// lives in trpc/qos.cc (ISSUE 15: admission prices spill arrivals, and
+// the qos tier links into the pb-free standalone suites this file
+// doesn't).
+DECLARE_string(rpc_zone);
 DEFINE_int32(lb_zone_spill_dead_pct, 100,
              "prefer a cross-zone live replica over a degraded local "
              "pick once at least this percent of the local zone's "

@@ -219,12 +219,20 @@ public:
     // pop) — only then does Run() report completion to the QoS tier. A
     // queued item shed before service runs this closure with counted
     // still false: its shed was already counted at the eviction site,
-    // and its latency must not pollute the tenant's served-p99.
+    // and its latency must not pollute the tenant's served-p99 or teach
+    // the cost model. `method`/`bytes`/`peer` feed the work-priced cost
+    // model (ISSUE 15): measured service time + logical payload bytes
+    // (inline + descriptor-exempt) fold into the estimate the NEXT
+    // request of this (tenant, method) is charged.
     void set_qos(QosDispatcher* qos, QosDispatcher::TenantState* tenant,
-                 int64_t start_us) {
+                 int64_t start_us, const std::string& method,
+                 int64_t logical_bytes, const EndPoint& peer) {
         qos_ = qos;
         qos_tenant_ = tenant;
         qos_start_us_ = start_us;
+        qos_method_ = method;
+        qos_bytes_ = logical_bytes;
+        qos_peer_ = peer;
     }
     void set_qos_counted() { qos_counted_ = true; }
 
@@ -353,10 +361,17 @@ public:
         server_call::Unregister(sid_, cid_);
         cntl_->DestroyServerCallId();
         // Per-tenant completion BEFORE Finish: OnDone touches the
-        // Server's QoS tier, and Finish must stay the LAST touch.
+        // Server's QoS tier, and Finish must stay the LAST touch. The
+        // completion info teaches the cost model and the tenant's
+        // gradient limiter (failures punish the latency average).
         if (qos_tenant_ != nullptr && qos_counted_) {
+            QosDispatcher::CompletionInfo ci;
+            ci.error_code = cntl_->ErrorCode();
+            ci.method = &qos_method_;
+            ci.logical_bytes = qos_bytes_;
+            ci.peer = qos_peer_;
             qos_->OnDone(qos_tenant_,
-                         monotonic_time_us() - qos_start_us_);
+                         monotonic_time_us() - qos_start_us_, ci);
         }
         // Stats + limiter + Join wakeup; Finish is the LAST touch of
         // Server memory (the Server may be destroyed right after).
@@ -380,6 +395,9 @@ private:
     QosDispatcher::TenantState* qos_tenant_ = nullptr;
     int64_t qos_start_us_ = 0;
     bool qos_counted_ = false;
+    std::string qos_method_;   // cost-model key ("Service.Method")
+    int64_t qos_bytes_ = 0;    // inline + descriptor-exempt payload
+    EndPoint qos_peer_;        // chaos cost_inflate scoping
 };
 
 // Carries one parsed request to its user-code fiber.
@@ -611,23 +629,37 @@ void ProcessTpuStdRequest(TpuStdMessage* msg, const rpc::RpcMeta& meta) {
         }
         deadline_us = arrival_us + req_meta.timeout_ms() * 1000;
     }
-    // Multi-tenant QoS stage 1 (ISSUE 8): identity + rate quota. The
-    // tenant's token bucket answers BEFORE admission, parse, or any
-    // allocation — a flooding tenant is shed at the cost of one bucket
-    // CAS, with TERR_OVERLOAD and a computed "come back in N ms" that
-    // the client jitters while spending retry budget.
+    // Multi-tenant QoS stage 1 (ISSUE 8 + 15): identity + WORK-PRICED
+    // rate quota. The tenant's token bucket answers BEFORE admission,
+    // parse, or any allocation — charged this (tenant, method)'s
+    // measured cost estimate, not a flat request count, so a tenant
+    // inside its request rate cannot sink the server with
+    // few-but-heavy calls. Cross-zone spill arrivals pay the
+    // -rpc_spill_cost_multiplier on top. A flooding tenant is shed at
+    // the cost of one bucket CAS, with TERR_OVERLOAD and a computed
+    // "come back in N ms" that the client jitters (deadline-capped)
+    // while spending retry budget.
     QosDispatcher* qos = server->qos();
     const bool qos_on = qos->enabled();
     QosDispatcher::TenantState* tstate = nullptr;
     const int priority = ClampPriority(
         req_meta.has_priority() ? req_meta.priority() : kDefaultPriority);
+    const std::string method_key =
+        req_meta.service_name() + "." + req_meta.method_name();
+    int64_t cost_milli = kCostUnitMilli;
+    bool spill = false;
     if (qos_on) {
         tstate = qos->Acquire(req_meta.tenant());
+        cost_milli = qos->EstimateCostMilli(tstate, method_key);
+        if (req_meta.has_zone() && SpillArrival(req_meta.zone())) {
+            spill = true;
+            cost_milli = SpillAdjustedCostMilli(cost_milli);
+        }
         int64_t backoff_ms = 0;
-        if (!qos->AdmitQps(tstate, arrival_us, &backoff_ms)) {
+        if (!qos->AdmitCost(tstate, arrival_us, cost_milli, &backoff_ms)) {
             SendErrorResponse(sid, cid, TERR_OVERLOAD,
                               "tenant '" + tstate->name +
-                                  "' over its qps quota",
+                                  "' over its cost quota",
                               backoff_ms);
             return;
         }
@@ -662,7 +694,7 @@ void ProcessTpuStdRequest(TpuStdMessage* msg, const rpc::RpcMeta& meta) {
             // Overload, and nothing below this priority to evict: shed
             // with the retriable-with-backoff error so well-behaved
             // clients spread their re-issues.
-            qos->CountShed(tstate);
+            qos->CountShed(tstate, cost_milli);
             SendErrorResponse(sid, cid, TERR_OVERLOAD,
                               "overloaded: concurrency limit, no lower-"
                               "priority work to shed",
@@ -874,7 +906,16 @@ void ProcessTpuStdRequest(TpuStdMessage* msg, const rpc::RpcMeta& meta) {
     }
     auto* done = new SendResponseClosure(server, guard, cntl, req, res, sid,
                                          cid);
-    if (qos_on) done->set_qos(qos, tstate, arrival_us);
+    if (qos_on) {
+        // Logical payload = inline body + attachment + the descriptor-
+        // exempt referenced bytes (they never rode the message path but
+        // they ARE the work this request represents).
+        const int64_t logical_bytes =
+            (int64_t)payload_size + (int64_t)att_size +
+            (pool_view.data != nullptr ? (int64_t)pool_view.length : 0);
+        done->set_qos(qos, tstate, arrival_us, method_key, logical_bytes,
+                      s->remote_side());
+    }
     if (!ParsePbFromIOBuf(req, payload)) {
         cntl->SetFailed(TERR_REQUEST, "parse request failed");
         done->Run();
@@ -890,12 +931,17 @@ void ProcessTpuStdRequest(TpuStdMessage* msg, const rpc::RpcMeta& meta) {
     // fair order; past the high-water the lowest-priority queued
     // request is shed first.
     if (qos_on) {
-        if (!qos->TryDirectDispatch(tstate)) {
+        if (!qos->TryDirectDispatch(tstate, cost_milli)) {
             auto* qd = new QueuedCall{server, mp, cntl, req, res, done};
             QosDispatcher::Item item;
             item.run = RunQueuedCall;
             item.shed = ShedQueuedCall;
             item.arg = qd;
+            // The queued item carries its estimated (spill-adjusted)
+            // charge: the DRR dequeue burns it against the tenant's
+            // deficit, and spill items shed first within their level.
+            item.cost_milli = cost_milli;
+            item.spill = spill;
             qos->Enqueue(tstate, priority, item);
             return;
         }

@@ -8,6 +8,7 @@
 #include "tbase/logging.h"
 #include "tbase/time.h"
 #include "tfiber/butex.h"
+#include "tnet/fault_injection.h"
 
 // The multi-tenant QoS tier is OFF by default: with no quotas configured
 // and the flag off, a request pays one relaxed load and the dispatch
@@ -20,16 +21,54 @@ DEFINE_string(rpc_tenant_quotas, "",
               "per-tenant quotas: 'name:qps=300,burst=64,w=1,conc=8;...' "
               "(qps/conc 0 = unlimited; w = weighted-fair share)");
 DEFINE_int32(rpc_fair_queue_highwater, 1024,
-             "fair dispatch queue depth that triggers lowest-priority-"
-             "first shedding");
+             "fair dispatch queue depth BACKSTOP for lowest-priority-"
+             "first shedding (the primary shed signal is the measured "
+             "queue delay; see -rpc_queue_delay_target_ms)");
 DEFINE_int32(rpc_overload_backoff_ms, 50,
-             "server-suggested client backoff attached to TERR_OVERLOAD "
-             "sheds (rate-quota sheds compute their own from the refill "
-             "time)");
+             "FLOOR of the server-suggested client backoff attached to "
+             "TERR_OVERLOAD sheds; queue sheds derive the actual hint "
+             "from the cost backlog over the measured drain rate "
+             "(rate-quota sheds compute theirs from the refill time)");
 DEFINE_int32(rpc_max_tenants, 64,
              "distinct tenant label values tracked; newcomers beyond "
              "this fold into the 'other' tenant (metric-cardinality "
              "bound)");
+// ---- work-priced admission (ISSUE 15) ----
+DEFINE_int32(rpc_cost_ref_us, 1000,
+             "handler service-time microseconds that equal one cost "
+             "unit (the time half of the cost model)");
+DEFINE_int32(rpc_cost_ref_kb, 16,
+             "logical payload KiB (inline + descriptor-exempt) that "
+             "equal one cost unit (the bytes half of the cost model)");
+DEFINE_int32(rpc_cost_max_methods, 32,
+             "distinct methods tracked per tenant by the cost model; "
+             "newcomers beyond this fold into one overflow bucket");
+DEFINE_bool(rpc_tenant_gradient_limit, true,
+            "tenants without an explicit conc= share get their own "
+            "gradient (auto) concurrency limiter that converges from "
+            "observed latency — no manual -max_concurrency tuning");
+DEFINE_int32(rpc_queue_delay_target_ms, 20,
+             "fair-queue sojourn target: when the MINIMUM sojourn over "
+             "a full interval stays above this, arrivals shed (CoDel-"
+             "style overload signal derived from measurement, not a "
+             "static depth)");
+DEFINE_int32(rpc_queue_delay_interval_ms, 100,
+             "queue-delay observation interval (and the drain-rate "
+             "estimation window)");
+DEFINE_double(rpc_spill_cost_multiplier, 2.0,
+              "admission-cost multiplier for cross-zone spill arrivals "
+              "(request meta zone != -rpc_zone): a partitioned pod's "
+              "overflow is priced above local work and sheds first "
+              "within its priority level");
+// Pod identity of THIS process (ISSUE 14; definition moved here in
+// ISSUE 15 so the pb-free standalone qos suite links without the LB
+// layer). Clients stamp it on the request meta; receivers price
+// mismatching arrivals as spills; the zone-aware LB reads it too.
+DEFINE_string(rpc_zone, "",
+              "locality zone (pod) of this process; naming entries "
+              "tagged zone=OTHER are treated as cross-pod (dcn tier, "
+              "spill-only LB), and arrivals stamped with another zone "
+              "are priced as spills. Empty = zoneless");
 
 namespace tpurpc {
 
@@ -58,10 +97,57 @@ LabelledMetric<LatencyRecorder>* tenant_latency() {
         "rpc_tenant_latency_us", {"tenant"});
     return m;
 }
+// Work-priced admission families (ISSUE 15): estimated milli-cost
+// admitted/shed per tenant, the measured per-request cost distribution,
+// and the gradient limiter's live limit.
+LabelledMetric<IntCell>* tenant_cost_admitted() {
+    static auto* m =
+        new LabelledMetric<IntCell>("rpc_tenant_cost_admitted", {"tenant"});
+    return m;
+}
+LabelledMetric<IntCell>* tenant_cost_shed() {
+    static auto* m =
+        new LabelledMetric<IntCell>("rpc_tenant_cost_shed", {"tenant"});
+    return m;
+}
+LabelledMetric<LatencyRecorder>* tenant_cost_units() {
+    static auto* m = new LabelledMetric<LatencyRecorder>(
+        "rpc_tenant_cost_units", {"tenant"});
+    return m;
+}
+LabelledMetric<IntCell>* tenant_gradient_limit() {
+    static auto* m = new LabelledMetric<IntCell>(
+        "rpc_tenant_gradient_limit", {"tenant"});
+    return m;
+}
 
 // Process-wide overload accounting (the soak's cross-tenant asserts).
 LazyAdder g_overload_sheds("rpc_server_overload_sheds");
 LazyAdder g_overload_evictions("rpc_server_overload_evictions");
+// Process-wide cost totals (milli-units; the mesh_node REPORT reads
+// them by name so a dying incarnation's numbers survive its portal).
+LazyAdder g_cost_admitted_milli("rpc_server_cost_admitted");
+LazyAdder g_cost_shed_milli("rpc_server_cost_shed");
+
+// Measured fair-queue sojourn distribution (the soak asserts its p99;
+// exposed eagerly from the first Configure so the lint sees the family
+// on an idle qos-enabled node).
+LatencyRecorder* queue_delay_recorder() {
+    static LatencyRecorder* r = [] {
+        auto* x = new LatencyRecorder;
+        x->expose("rpc_server_queue_delay_us");
+        return x;
+    }();
+    return r;
+}
+
+// Eager 0-valued exposure (lint contract: a 0-valued family is data, a
+// missing one is not).
+void ExposeCostVars() {
+    *g_cost_admitted_milli << 0;
+    *g_cost_shed_milli << 0;
+    queue_delay_recorder();
+}
 
 uint64_t mix64(uint64_t k) {
     k ^= k >> 33;
@@ -78,7 +164,56 @@ uint64_t hash_key(uint64_t seed, const std::string& s) {
     return mix64(h);
 }
 
+// Cost-model bounds: one sample is capped at 1024 units so a wedged
+// handler cannot park its tenant's bucket in unbounded debt; the DRR
+// charge is capped lower still so a single item's deficit repayment
+// stays within one bounded grant loop.
+constexpr int64_t kMaxCostMilli = 1024 * kCostUnitMilli;
+constexpr int64_t kDrrMaxChargeMilli = 64 * kCostUnitMilli;
+// One DRR grant round adds weight * this to a tenant's deficit.
+constexpr int64_t kDrrQuantumMilli = kCostUnitMilli;
+// Grant-round bound per Pop: enough to repay the biggest chargeable
+// item at weight 1, plus slack (pure in-memory math, so cheap).
+constexpr int kMaxDrrGrantRounds =
+    (int)(kDrrMaxChargeMilli / kDrrQuantumMilli) + 8;
+// EWMA smoothing for the per-method cost model: fast enough that a
+// chaos cost_inflate plan visibly moves the estimate within a soak
+// phase, slow enough that one outlier doesn't reprice the tenant.
+constexpr int kCostEwmaShift = 2;  // new = old + (sample - old) / 4
+// Method-cost overflow bucket (cardinality bound).
+const char kOtherMethod[] = "other";
+
 }  // namespace
+
+// ---------------- cost model ----------------
+
+int64_t ComputeCostMilli(int64_t svc_us, int64_t logical_bytes) {
+    const int64_t ref_us =
+        std::max(1, FLAGS_rpc_cost_ref_us.get());
+    const int64_t ref_bytes =
+        (int64_t)std::max(1, FLAGS_rpc_cost_ref_kb.get()) * 1024;
+    int64_t m = 0;
+    if (svc_us > 0) m += svc_us * kCostUnitMilli / ref_us;
+    if (logical_bytes > 0) {
+        m += logical_bytes * kCostUnitMilli / ref_bytes;
+    }
+    if (m < kCostUnitMilli) return kCostUnitMilli;
+    if (m > kMaxCostMilli) return kMaxCostMilli;
+    return m;
+}
+
+bool SpillArrival(const std::string& peer_zone) {
+    if (peer_zone.empty()) return false;
+    const std::string my_zone = FLAGS_rpc_zone.get();
+    return !my_zone.empty() && peer_zone != my_zone;
+}
+
+int64_t SpillAdjustedCostMilli(int64_t cost_milli) {
+    const double mult =
+        std::max(1.0, FLAGS_rpc_spill_cost_multiplier.get());
+    const double adj = (double)cost_milli * mult;
+    return adj > (double)kMaxCostMilli ? kMaxCostMilli : (int64_t)adj;
+}
 
 // ---------------- quota spec ----------------
 
@@ -172,21 +307,28 @@ void TokenBucket::RefillLocked(int64_t now_us) {
     }
 }
 
-bool TokenBucket::TryWithdraw(int64_t now_us, int64_t* wait_ms) {
+bool TokenBucket::TryWithdrawCost(int64_t now_us, int64_t cost_milli,
+                                  int64_t* wait_ms) {
     const int64_t rate = rate_milli_per_s_.load(std::memory_order_relaxed);
     if (rate <= 0) return true;
+    if (cost_milli < 1) cost_milli = 1;
     RefillLocked(now_us);
+    // A cost above the burst depth could never see `tokens >= cost`:
+    // admit it at a FULL bucket instead and let the balance go negative
+    // (debt) — the call is rate-priced exactly, never starved forever.
+    const int64_t burst = burst_milli_.load(std::memory_order_relaxed);
+    const int64_t need = std::min(cost_milli, std::max<int64_t>(burst, 1));
     int64_t cur = tokens_milli_.load(std::memory_order_relaxed);
-    while (cur >= 1000) {
-        if (tokens_milli_.compare_exchange_weak(cur, cur - 1000,
+    while (cur >= need) {
+        if (tokens_milli_.compare_exchange_weak(cur, cur - cost_milli,
                                                 std::memory_order_relaxed)) {
             return true;
         }
     }
     if (wait_ms != nullptr) {
-        // Time until one whole token accrues at the configured rate,
+        // Time until the required tokens accrue at the configured rate,
         // clamped to something a client can reasonably sleep.
-        const int64_t deficit_milli = 1000 - std::max<int64_t>(cur, 0);
+        const int64_t deficit_milli = need - std::min<int64_t>(cur, need);
         int64_t ms = deficit_milli * 1000 / std::max<int64_t>(rate, 1);
         *wait_ms = std::min<int64_t>(std::max<int64_t>(ms, 1), 2000);
     }
@@ -254,8 +396,9 @@ void QosDispatcher::Configure(const std::map<std::string, TenantQuota>& quotas,
         auto it = tenants_.find(name);
         if (it != tenants_.end()) ApplyQuota(it->second.get(), q);
     }
-    enabled_.store(force_enable || !configured_.empty(),
-                   std::memory_order_release);
+    const bool on = force_enable || !configured_.empty();
+    if (on) ExposeCostVars();
+    enabled_.store(on, std::memory_order_release);
 }
 
 void QosDispatcher::SetTenantQuota(const std::string& tenant,
@@ -266,6 +409,7 @@ void QosDispatcher::SetTenantQuota(const std::string& tenant,
     configured_[name] = q;
     auto it = tenants_.find(name);
     if (it != tenants_.end()) ApplyQuota(it->second.get(), q);
+    ExposeCostVars();
     enabled_.store(true, std::memory_order_release);
 }
 
@@ -303,56 +447,212 @@ QosDispatcher::TenantState* QosDispatcher::Acquire(
         st->shed = tenant_shed()->get_stats({name});
         st->queued = tenant_queued()->get_stats({name});
         st->latency = tenant_latency()->get_stats({name});
+        st->cost_admitted = tenant_cost_admitted()->get_stats({name});
+        st->cost_shed = tenant_cost_shed()->get_stats({name});
+        st->cost_units = tenant_cost_units()->get_stats({name});
+        st->gradient_limit_cell = tenant_gradient_limit()->get_stats({name});
+        // Every tenant carries a gradient limiter; it only GATES when no
+        // explicit conc= share is configured (TenantConcurrencyLimit),
+        // so a runtime re-quota flips cleanly between the two without a
+        // lifetime race on the dispatch paths.
+        st->gradient =
+            std::make_unique<AutoConcurrencyLimiter>(gradient_opts_);
+        st->gradient_limit_cell->set(st->gradient->MaxConcurrency());
         it = tenants_.emplace(name, std::move(st)).first;
     }
     return it->second.get();
 }
 
-bool QosDispatcher::AdmitQps(TenantState* t, int64_t now_us,
-                             int64_t* backoff_ms) {
-    if (t->bucket.TryWithdraw(now_us, backoff_ms)) return true;
-    CountShed(t);
+void QosDispatcher::SetGradientOptions(
+    const AutoConcurrencyLimiter::Options& opt) {
+    std::unique_lock<std::shared_mutex> g(tenants_mu_);
+    gradient_opts_ = opt;
+}
+
+int64_t QosDispatcher::EstimateCostMilli(TenantState* t,
+                                         const std::string& method) const {
+    std::shared_lock<std::shared_mutex> g(t->cost_mu);
+    auto it = t->method_cost_milli.find(method);
+    if (it == t->method_cost_milli.end()) {
+        it = t->method_cost_milli.find(kOtherMethod);
+        if (it == t->method_cost_milli.end()) return kCostUnitMilli;
+    }
+    return it->second;
+}
+
+bool QosDispatcher::AdmitCost(TenantState* t, int64_t now_us,
+                              int64_t cost_milli, int64_t* backoff_ms) {
+    if (t->bucket.TryWithdrawCost(now_us, cost_milli, backoff_ms)) {
+        return true;
+    }
+    CountShed(t, cost_milli);
     return false;
 }
 
-bool QosDispatcher::TryDirectDispatch(TenantState* t) {
+int64_t QosDispatcher::TenantConcurrencyLimit(const TenantState* t) const {
+    const int64_t maxc = t->max_concurrency.load(std::memory_order_relaxed);
+    if (maxc > 0) return maxc;  // explicit share wins
+    if (t->gradient != nullptr && FLAGS_rpc_tenant_gradient_limit.get()) {
+        return t->gradient->MaxConcurrency();
+    }
+    return 0;
+}
+
+bool QosDispatcher::TryDirectDispatch(TenantState* t, int64_t cost_milli) {
     if (depth_.load(std::memory_order_relaxed) != 0) {
         return false;  // fairness first: join the queue behind the others
     }
-    const int64_t maxc = t->max_concurrency.load(std::memory_order_relaxed);
-    if (maxc > 0) {
-        const int64_t cur =
-            t->inflight.fetch_add(1, std::memory_order_relaxed) + 1;
-        if (cur > maxc) {
-            t->inflight.fetch_sub(1, std::memory_order_relaxed);
-            return false;  // over its share: queue (drainer re-checks)
-        }
-    } else {
-        t->inflight.fetch_add(1, std::memory_order_relaxed);
+    const int64_t limit = TenantConcurrencyLimit(t);
+    const int64_t cur =
+        t->inflight.fetch_add(1, std::memory_order_relaxed) + 1;
+    if (limit > 0 && cur > limit) {
+        t->inflight.fetch_sub(1, std::memory_order_relaxed);
+        return false;  // over its limit: queue (drainer re-checks)
     }
     t->admitted->add(1);
+    t->cost_admitted->add(cost_milli);
+    *g_cost_admitted_milli << cost_milli;
     return true;
 }
 
-void QosDispatcher::BeginServed(TenantState* t) {
+void QosDispatcher::BeginServed(TenantState* t, int64_t cost_milli) {
     t->inflight.fetch_add(1, std::memory_order_relaxed);
     t->admitted->add(1);
+    t->cost_admitted->add(cost_milli);
+    *g_cost_admitted_milli << cost_milli;
 }
 
-void QosDispatcher::OnDone(TenantState* t, int64_t latency_us) {
+void QosDispatcher::OnDone(TenantState* t, int64_t latency_us,
+                           const CompletionInfo& info) {
     t->inflight.fetch_sub(1, std::memory_order_relaxed);
     *t->latency << latency_us;
+    // Gradient feedback: only while the gradient actually gates (an
+    // explicit conc= share wins) — its estimate then converges from the
+    // tenant's own observed latency, failures punishing the average.
+    if (t->gradient != nullptr &&
+        t->max_concurrency.load(std::memory_order_relaxed) <= 0 &&
+        FLAGS_rpc_tenant_gradient_limit.get()) {
+        t->gradient->OnResponded(info.error_code, latency_us);
+        t->gradient_limit_cell->set(t->gradient->MaxConcurrency());
+    }
+    // Cost observation: fold the measured work into the (tenant,
+    // method) EWMA the NEXT request of this shape is charged.
+    if (info.method != nullptr) {
+        int64_t measured =
+            ComputeCostMilli(latency_us, info.logical_bytes);
+        // Chaos seam (ISSUE 15): a cost_inflate plan inflates the
+        // MEASURED cost so soaks can reprice a method without moving
+        // real bytes (deterministic, per-peer scopable like every
+        // other chaos decision).
+        if (__builtin_expect(fault_injection_enabled(), 0)) {
+            const FaultAction fault = FaultInjection::Decide(
+                FaultOp::kCostMeasure, info.peer,
+                (size_t)info.logical_bytes);
+            if (fault.kind == FaultAction::kInflate) {
+                measured = std::min<int64_t>(
+                    kMaxCostMilli,
+                    measured * std::max<int64_t>(2, (int64_t)fault.aux));
+            }
+        }
+        *t->cost_units << measured;
+        std::unique_lock<std::shared_mutex> g(t->cost_mu);
+        std::string key = *info.method;
+        auto it = t->method_cost_milli.find(key);
+        if (it == t->method_cost_milli.end() &&
+            (int64_t)t->method_cost_milli.size() >=
+                (int64_t)std::max(1, FLAGS_rpc_cost_max_methods.get())) {
+            key = kOtherMethod;  // cardinality bound, like tenants
+            it = t->method_cost_milli.find(key);
+        }
+        if (it == t->method_cost_milli.end()) {
+            t->method_cost_milli[key] = measured;
+        } else {
+            it->second += (measured - it->second) >> kCostEwmaShift;
+        }
+    }
     // A freed concurrency share may unblock this tenant's queued work.
     if (depth_.load(std::memory_order_relaxed) > 0) WakeDrainer();
 }
 
-void QosDispatcher::CountShed(TenantState* t) {
+void QosDispatcher::CountShed(TenantState* t, int64_t cost_milli) {
     t->shed->add(1);
+    t->cost_shed->add(cost_milli);
     *g_overload_sheds << 1;
+    *g_cost_shed_milli << cost_milli;
 }
 
 int64_t QosDispatcher::SuggestedBackoffMs() const {
-    return std::max(1, FLAGS_rpc_overload_backoff_ms.get());
+    const int64_t floor_ms = std::max(1, FLAGS_rpc_overload_backoff_ms.get());
+    // Drain-derived hint: time until the current cost backlog drains at
+    // the measured rate — "come back when the queue has emptied", not a
+    // static guess. Cold queue (no drain measurement yet): the floor.
+    const int64_t rate =
+        drain_rate_milli_per_s_.load(std::memory_order_relaxed);
+    const int64_t backlog =
+        backlog_cost_milli_.load(std::memory_order_relaxed);
+    int64_t ms = floor_ms;
+    if (rate > 0 && backlog > 0) {
+        ms = std::max(ms, backlog * 1000 / rate);
+    }
+    return std::min<int64_t>(ms, 2000);
+}
+
+void QosDispatcher::AccountDequeueLocked(const Item& it, int64_t now_us,
+                                         bool served) {
+    backlog_cost_milli_.fetch_sub(it.cost_milli,
+                                  std::memory_order_relaxed);
+    if (!served) return;
+    // Sojourn measurement (the CoDel signal): how long this item really
+    // waited. Evictions are excluded — a shed's wait says nothing about
+    // the speed of the SERVING path.
+    const int64_t sojourn_us =
+        it.enqueue_us > 0 ? std::max<int64_t>(0, now_us - it.enqueue_us)
+                          : 0;
+    int64_t ewma = queue_delay_ewma_us_.load(std::memory_order_relaxed);
+    queue_delay_ewma_us_.store(ewma + ((sojourn_us - ewma) >> 3),
+                               std::memory_order_relaxed);
+    const int64_t interval_us =
+        (int64_t)std::max(1, FLAGS_rpc_queue_delay_interval_ms.get()) *
+        1000;
+    const int64_t target_us =
+        (int64_t)std::max(1, FLAGS_rpc_queue_delay_target_ms.get()) * 1000;
+    if (interval_start_us_ == 0) interval_start_us_ = now_us;
+    if (interval_min_sojourn_us_ < 0 ||
+        sojourn_us < interval_min_sojourn_us_) {
+        interval_min_sojourn_us_ = sojourn_us;
+    }
+    if (now_us - interval_start_us_ >= interval_us) {
+        // A whole interval where even the BEST-off dequeue waited past
+        // the target = standing queue (overload); one good interval (or
+        // an empty queue, below) clears it.
+        over_target_.store(interval_min_sojourn_us_ > target_us,
+                           std::memory_order_relaxed);
+        interval_start_us_ = now_us;
+        interval_min_sojourn_us_ = -1;
+    }
+    // Drain-rate window: cost served per second, EWMA-folded.
+    if (drain_window_start_us_ == 0) drain_window_start_us_ = now_us;
+    drain_window_cost_milli_ += it.cost_milli;
+    const int64_t elapsed = now_us - drain_window_start_us_;
+    if (elapsed >= interval_us) {
+        const int64_t rate = drain_window_cost_milli_ * 1000000 / elapsed;
+        int64_t cur =
+            drain_rate_milli_per_s_.load(std::memory_order_relaxed);
+        drain_rate_milli_per_s_.store(
+            cur <= 0 ? rate : cur + ((rate - cur) >> 2),
+            std::memory_order_relaxed);
+        drain_window_start_us_ = now_us;
+        drain_window_cost_milli_ = 0;
+    }
+    if (depth_.load(std::memory_order_relaxed) == 0) {
+        // Empty queue = no standing delay, whatever the last interval
+        // said.
+        over_target_.store(false, std::memory_order_relaxed);
+        interval_start_us_ = 0;
+        interval_min_sojourn_us_ = -1;
+    }
+    // Recorder write is cheap (TLS cell) and safe under mu_.
+    *queue_delay_recorder() << sojourn_us;
 }
 
 bool QosDispatcher::EvictLowestLocked(int limit_prio,
@@ -361,25 +661,53 @@ bool QosDispatcher::EvictLowestLocked(int limit_prio,
     for (int p = kMinPriority; p < limit_prio; ++p) {
         Level& lvl = levels_[p];
         if (lvl.active.empty()) continue;
-        // The deepest queue at this level sheds first: under a flood
-        // that is the flooder, so a polite same-priority tenant keeps
-        // its (short) backlog.
+        // Spills shed first within a level (ISSUE 15d): a partitioned
+        // pod's overflow must not survive at local work's expense. The
+        // deepest spill-HOLDING queue loses its NEWEST spill item; the
+        // per-tenant spill_count keeps the (common) no-spill case from
+        // walking any queue's items under mu_.
         TenantState* victim = nullptr;
+        size_t victim_idx = 0;
         for (TenantState* t : lvl.active) {
-            if (t->q[p].empty()) continue;
-            if (victim == nullptr || t->q[p].size() > victim->q[p].size()) {
+            if (t->spill_count[p] <= 0) continue;
+            if (victim == nullptr ||
+                t->q[p].size() > victim->q[p].size()) {
                 victim = t;
             }
         }
-        if (victim == nullptr) continue;
-        // Newest first (LIFO shed): the oldest queued request is closest
-        // to being served; the newest has waited least and its client
-        // retries latest.
-        out_shed->push_back(victim->q[p].back());
+        if (victim != nullptr) {
+            for (size_t i = victim->q[p].size(); i-- > 0;) {
+                if (victim->q[p][i].spill) {
+                    victim_idx = i;  // newest spill of the victim
+                    break;
+                }
+            }
+        }
+        if (victim == nullptr) {
+            // No spills: the deepest queue at this level sheds first —
+            // under a flood that is the flooder, so a polite
+            // same-priority tenant keeps its (short) backlog. Newest
+            // first (LIFO shed): the oldest queued request is closest
+            // to being served; the newest has waited least and its
+            // client retries latest.
+            for (TenantState* t : lvl.active) {
+                if (t->q[p].empty()) continue;
+                if (victim == nullptr ||
+                    t->q[p].size() > victim->q[p].size()) {
+                    victim = t;
+                }
+            }
+            if (victim == nullptr) continue;
+            victim_idx = victim->q[p].size() - 1;
+        }
+        const Item it = victim->q[p][victim_idx];
+        out_shed->push_back(it);
         out_owners->push_back(victim);
-        victim->q[p].pop_back();
+        victim->q[p].erase(victim->q[p].begin() + (ptrdiff_t)victim_idx);
         victim->queued->add(-1);
+        if (it.spill) --victim->spill_count[p];
         depth_.fetch_sub(1, std::memory_order_relaxed);
+        AccountDequeueLocked(it, monotonic_time_us(), /*served=*/false);
         return true;
     }
     return false;
@@ -395,17 +723,34 @@ bool QosDispatcher::Enqueue(TenantState* t, int priority, const Item& item) {
         if (stop_.load(std::memory_order_acquire)) {
             self_shed = true;  // draining dispatcher: answer, don't hold
         } else {
+            // Shed signal (ISSUE 15c): the MEASURED queue delay — a
+            // standing sojourn above the target for a whole interval —
+            // with the static high-water kept only as the absolute
+            // depth backstop. Either way the eviction ordering stays
+            // lowest-priority-first (spills before local work).
             const int64_t hw =
                 std::max(1, FLAGS_rpc_fair_queue_highwater.get());
-            if (depth_.load(std::memory_order_relaxed) >= hw &&
+            const int64_t depth = depth_.load(std::memory_order_relaxed);
+            const bool overloaded =
+                depth >= hw ||
+                (depth > 0 &&
+                 over_target_.load(std::memory_order_relaxed));
+            if (overloaded &&
                 !EvictLowestLocked(p, &to_shed, &shed_owners)) {
                 self_shed = true;  // nothing below this priority: shed self
             }
         }
         if (!self_shed) {
-            t->q[p].push_back(item);
+            Item stamped = item;
+            if (stamped.enqueue_us == 0) {
+                stamped.enqueue_us = monotonic_time_us();
+            }
+            t->q[p].push_back(stamped);
             t->queued->add(1);
+            if (stamped.spill) ++t->spill_count[p];
             depth_.fetch_add(1, std::memory_order_relaxed);
+            backlog_cost_milli_.fetch_add(stamped.cost_milli,
+                                          std::memory_order_relaxed);
             if (!t->in_active[p]) {
                 levels_[p].active.push_back(t);
                 t->in_active[p] = true;
@@ -414,12 +759,12 @@ bool QosDispatcher::Enqueue(TenantState* t, int priority, const Item& item) {
     }
     const int64_t backoff = SuggestedBackoffMs();
     for (size_t i = 0; i < to_shed.size(); ++i) {
-        CountShed(shed_owners[i]);
+        CountShed(shed_owners[i], to_shed[i].cost_milli);
         *g_overload_evictions << 1;
         to_shed[i].shed(to_shed[i].arg, backoff);
     }
     if (self_shed) {
-        CountShed(t);
+        CountShed(t, item.cost_milli);
         item.shed(item.arg, backoff);
         return false;
     }
@@ -437,7 +782,7 @@ bool QosDispatcher::EvictOneBelow(int priority) {
         }
     }
     const int64_t backoff = SuggestedBackoffMs();
-    CountShed(owners[0]);
+    CountShed(owners[0], to_shed[0].cost_milli);
     *g_overload_evictions << 1;
     to_shed[0].shed(to_shed[0].arg, backoff);
     return true;
@@ -447,46 +792,76 @@ bool QosDispatcher::PopLocked(Item* out, TenantState** owner,
                               int* priority) {
     for (int p = kMaxPriority; p >= kMinPriority; --p) {
         Level& lvl = levels_[p];
-        // Bounded walk: each active tenant is visited at most twice per
-        // call (once for a possible rotation, once for service) before
-        // we conclude the level is drained or concurrency-blocked.
-        size_t walk = lvl.active.size() * 2 + 2;
-        while (!lvl.active.empty() && walk-- > 0) {
-            TenantState* t = lvl.active.front();
-            if (t->q[p].empty()) {
-                lvl.active.pop_front();
-                t->in_active[p] = false;
-                t->deficit[p] = 0;
-                continue;
+        if (lvl.active.empty()) continue;
+        // Cost-DRR (ISSUE 15a): a tenant serves when its deficit covers
+        // its head item's (capped) cost; a pass where nothing is
+        // servable grants every eligible tenant weight * quantum and
+        // tries again — so one heavy dequeue burns many turns' worth of
+        // deficit and the tenant waits proportionally before its next.
+        // Bounded: grant rounds repay the biggest chargeable item in
+        // <= kMaxDrrGrantRounds passes of pure in-memory math.
+        for (int round = 0; round < kMaxDrrGrantRounds; ++round) {
+            bool any_eligible = false;
+            size_t n = lvl.active.size();
+            for (size_t i = 0; i < n && !lvl.active.empty(); ++i) {
+                TenantState* t = lvl.active.front();
+                if (t->q[p].empty()) {
+                    lvl.active.pop_front();
+                    t->in_active[p] = false;
+                    t->deficit[p] = 0;
+                    continue;
+                }
+                const int64_t limit = TenantConcurrencyLimit(t);
+                if (limit > 0 &&
+                    t->inflight.load(std::memory_order_relaxed) >= limit) {
+                    // Over its concurrency limit: rotate so the other
+                    // tenants at this level aren't blocked behind it
+                    // (OnDone re-wakes the drainer when a share frees).
+                    lvl.active.pop_front();
+                    lvl.active.push_back(t);
+                    continue;
+                }
+                any_eligible = true;
+                const int64_t charge = std::min(
+                    t->q[p].front().cost_milli, kDrrMaxChargeMilli);
+                if (t->deficit[p] < charge) {
+                    lvl.active.pop_front();
+                    lvl.active.push_back(t);
+                    continue;  // not this tenant's turn yet
+                }
+                *out = t->q[p].front();
+                t->q[p].pop_front();
+                t->queued->add(-1);
+                if (out->spill) --t->spill_count[p];
+                depth_.fetch_sub(1, std::memory_order_relaxed);
+                t->deficit[p] -= charge;
+                if (t->q[p].empty()) {
+                    lvl.active.pop_front();
+                    t->in_active[p] = false;
+                    t->deficit[p] = 0;  // classic DRR: no hoarding
+                } else if (t->deficit[p] <
+                           std::min(t->q[p].front().cost_milli,
+                                    kDrrMaxChargeMilli)) {
+                    lvl.active.pop_front();
+                    lvl.active.push_back(t);
+                }
+                *owner = t;
+                *priority = p;
+                return true;
             }
-            const int64_t maxc =
-                t->max_concurrency.load(std::memory_order_relaxed);
-            if (maxc > 0 &&
-                t->inflight.load(std::memory_order_relaxed) >= maxc) {
-                // Over its concurrency share: rotate so the other
-                // tenants at this level aren't blocked behind it
-                // (OnDone re-wakes the drainer when a share frees).
-                lvl.active.pop_front();
-                lvl.active.push_back(t);
-                continue;
+            if (!any_eligible) break;  // level drained / all blocked
+            // Nothing servable with current deficits: one grant round.
+            for (TenantState* t : lvl.active) {
+                if (t->q[p].empty()) continue;
+                const int64_t limit = TenantConcurrencyLimit(t);
+                if (limit > 0 &&
+                    t->inflight.load(std::memory_order_relaxed) >= limit) {
+                    continue;  // blocked tenants don't accrue deficit
+                }
+                t->deficit[p] +=
+                    (int64_t)t->weight.load(std::memory_order_relaxed) *
+                    kDrrQuantumMilli;
             }
-            // DRR: a fresh turn grants `weight` cost-1 service slots;
-            // the tenant keeps the head until they're spent.
-            if (t->deficit[p] <= 0) {
-                t->deficit[p] = t->weight.load(std::memory_order_relaxed);
-            }
-            *out = t->q[p].front();
-            t->q[p].pop_front();
-            t->queued->add(-1);
-            depth_.fetch_sub(1, std::memory_order_relaxed);
-            if (--t->deficit[p] <= 0 || t->q[p].empty()) {
-                lvl.active.pop_front();
-                lvl.active.push_back(t);
-                t->deficit[p] = std::max(t->deficit[p], 0);
-            }
-            *owner = t;
-            *priority = p;
-            return true;
         }
     }
     return false;
@@ -495,9 +870,14 @@ bool QosDispatcher::PopLocked(Item* out, TenantState** owner,
 bool QosDispatcher::Pop(Item* out, TenantState** owner, int* priority) {
     std::lock_guard<std::mutex> g(mu_);
     if (!PopLocked(out, owner, priority)) return false;
-    // Popped = admitted to service: same accounting as direct dispatch.
+    // Popped = admitted to service: same accounting as direct dispatch,
+    // plus the sojourn/drain measurements the shed signal and the
+    // backoff hint derive from.
     (*owner)->inflight.fetch_add(1, std::memory_order_relaxed);
     (*owner)->admitted->add(1);
+    (*owner)->cost_admitted->add(out->cost_milli);
+    *g_cost_admitted_milli << out->cost_milli;
+    AccountDequeueLocked(*out, monotonic_time_us(), /*served=*/true);
     return true;
 }
 
@@ -570,6 +950,9 @@ void QosDispatcher::StopDrainer() {
                 for (TenantState* t : levels_[p].active) {
                     while (!t->q[p].empty()) {
                         items.push_back(t->q[p].front());
+                        backlog_cost_milli_.fetch_sub(
+                            t->q[p].front().cost_milli,
+                            std::memory_order_relaxed);
                         owners.push_back(t);
                         t->q[p].pop_front();
                         t->queued->add(-1);
@@ -577,13 +960,17 @@ void QosDispatcher::StopDrainer() {
                     }
                     t->in_active[p] = false;
                     t->deficit[p] = 0;
+                    t->spill_count[p] = 0;
                 }
                 levels_[p].active.clear();
             }
+            over_target_.store(false, std::memory_order_relaxed);
+            interval_start_us_ = 0;
+            interval_min_sojourn_us_ = -1;
         }
         if (items.empty()) break;
         for (size_t i = 0; i < items.size(); ++i) {
-            CountShed(owners[i]);
+            CountShed(owners[i], items[i].cost_milli);
             items[i].shed(items[i].arg, SuggestedBackoffMs());
         }
     }
@@ -595,27 +982,54 @@ std::string QosDispatcher::DescribeText() const {
        << (enabled() ? "enabled" : "disabled (set -rpc_qos_enabled or "
                                    "-rpc_tenant_quotas)")
        << "\nfair queue depth: " << queue_depth()
-       << " (highwater " << FLAGS_rpc_fair_queue_highwater.get() << ")\n\n";
-    char line[256];
+       << " (highwater " << FLAGS_rpc_fair_queue_highwater.get()
+       << " backstop)"
+       << "\nqueue delay: ewma " << QueueDelayEwmaUs() << "us, p99 "
+       << queue_delay_recorder()->latency_percentile(0.99)
+       << "us (target " << FLAGS_rpc_queue_delay_target_ms.get()
+       << "ms, over_target " << (OverDelayTarget() ? 1 : 0) << ")"
+       << "\ndrain rate: " << DrainRateCostPerS()
+       << " cost units/s; cost backlog: "
+       << backlog_cost_milli_.load(std::memory_order_relaxed) /
+              kCostUnitMilli
+       << " units; suggested backoff: " << SuggestedBackoffMs()
+       << "ms\n\n";
+    char line[320];
     snprintf(line, sizeof(line),
-             "%-16s %6s %8s %6s %6s %9s %10s %10s %8s %10s\n", "tenant",
-             "weight", "qps_cap", "burst", "conc", "inflight", "admitted",
-             "shed", "queued", "p99_us");
+             "%-16s %6s %8s %6s %6s %6s %9s %10s %10s %8s %10s %10s %10s "
+             "%9s\n",
+             "tenant", "weight", "cost_cap", "burst", "conc", "glimit",
+             "inflight", "admitted", "shed", "queued", "p99_us",
+             "cost_adm", "cost_shed", "est_cost");
     os << line;
     std::shared_lock<std::shared_mutex> g(tenants_mu_);
     for (const auto& [name, t] : tenants_) {
+        // est_cost: the priciest method EWMA this tenant has taught the
+        // model (whole units); glimit: the gradient limit actually
+        // gating (0 = explicit share or unlimited).
+        int64_t est = kCostUnitMilli;
+        {
+            std::shared_lock<std::shared_mutex> cg(t->cost_mu);
+            for (const auto& [m, c] : t->method_cost_milli) {
+                est = std::max(est, c);
+            }
+        }
+        const int64_t maxc =
+            t->max_concurrency.load(std::memory_order_relaxed);
         snprintf(line, sizeof(line),
-                 "%-16s %6d %8.0f %6lld %6lld %9lld %10lld %10lld %8lld "
-                 "%10lld\n",
+                 "%-16s %6d %8.0f %6lld %6lld %6lld %9lld %10lld %10lld "
+                 "%8lld %10lld %10lld %10lld %9lld\n",
                  name.c_str(),
                  t->weight.load(std::memory_order_relaxed), t->quota.qps,
-                 (long long)t->quota.burst,
-                 (long long)t->max_concurrency.load(
-                     std::memory_order_relaxed),
+                 (long long)t->quota.burst, (long long)maxc,
+                 (long long)(maxc > 0 ? 0 : TenantConcurrencyLimit(t.get())),
                  (long long)t->inflight.load(std::memory_order_relaxed),
                  (long long)t->admitted->get(), (long long)t->shed->get(),
                  (long long)t->queued->get(),
-                 (long long)t->latency->latency_percentile(0.99));
+                 (long long)t->latency->latency_percentile(0.99),
+                 (long long)(t->cost_admitted->get() / kCostUnitMilli),
+                 (long long)(t->cost_shed->get() / kCostUnitMilli),
+                 (long long)(est / kCostUnitMilli));
         os << line;
     }
     return os.str();
@@ -624,7 +1038,16 @@ std::string QosDispatcher::DescribeText() const {
 std::string QosDispatcher::DescribeJson() const {
     std::ostringstream os;
     os << "{\"enabled\":" << (enabled() ? 1 : 0)
-       << ",\"queue_depth\":" << queue_depth() << ",\"tenants\":{";
+       << ",\"queue_depth\":" << queue_depth()
+       << ",\"queue_delay_ewma_us\":" << QueueDelayEwmaUs()
+       << ",\"queue_delay_p99_us\":"
+       << queue_delay_recorder()->latency_percentile(0.99)
+       << ",\"over_delay_target\":" << (OverDelayTarget() ? 1 : 0)
+       << ",\"drain_rate_cost_per_s\":" << DrainRateCostPerS()
+       << ",\"cost_backlog_milli\":"
+       << backlog_cost_milli_.load(std::memory_order_relaxed)
+       << ",\"suggested_backoff_ms\":" << SuggestedBackoffMs()
+       << ",\"tenants\":{";
     std::shared_lock<std::shared_mutex> g(tenants_mu_);
     bool first = true;
     for (const auto& [name, t] : tenants_) {
@@ -636,16 +1059,34 @@ std::string QosDispatcher::DescribeJson() const {
         for (char& c : safe) {
             if (c == '"' || c == '\\' || (unsigned char)c < 0x20) c = '_';
         }
+        int64_t est = kCostUnitMilli;
+        {
+            std::shared_lock<std::shared_mutex> cg(t->cost_mu);
+            for (const auto& [m, c] : t->method_cost_milli) {
+                est = std::max(est, c);
+            }
+        }
+        const int64_t maxc =
+            t->max_concurrency.load(std::memory_order_relaxed);
+        const bool gradient_gates =
+            maxc <= 0 && t->gradient != nullptr &&
+            FLAGS_rpc_tenant_gradient_limit.get();
         os << "\"" << safe << "\":{"
            << "\"weight\":" << t->weight.load(std::memory_order_relaxed)
            << ",\"qps_cap\":" << (int64_t)t->quota.qps
-           << ",\"max_concurrency\":"
-           << t->max_concurrency.load(std::memory_order_relaxed)
+           << ",\"max_concurrency\":" << maxc
+           << ",\"gradient_limit\":"
+           << (gradient_gates ? t->gradient->MaxConcurrency() : 0)
+           << ",\"gradient_updates\":"
+           << (t->gradient != nullptr ? t->gradient->update_count() : 0)
            << ",\"inflight\":"
            << t->inflight.load(std::memory_order_relaxed)
            << ",\"admitted\":" << t->admitted->get()
            << ",\"shed\":" << t->shed->get()
            << ",\"queued\":" << t->queued->get()
+           << ",\"cost_admitted_milli\":" << t->cost_admitted->get()
+           << ",\"cost_shed_milli\":" << t->cost_shed->get()
+           << ",\"cost_ewma_milli\":" << est
            << ",\"p50_us\":" << t->latency->latency_percentile(0.5)
            << ",\"p99_us\":" << t->latency->latency_percentile(0.99)
            << ",\"count\":" << t->latency->count() << "}";

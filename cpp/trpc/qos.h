@@ -1,5 +1,6 @@
-// Multi-tenant QoS: per-tenant quotas, weighted-fair dispatch, and
-// priority-aware overload shedding (ISSUE 8, ROADMAP item 3).
+// Multi-tenant QoS: work-priced admission, per-tenant gradient
+// concurrency, weighted-fair dispatch, and queue-delay-driven overload
+// shedding (ISSUE 8 + ISSUE 15, ROADMAP items 3/4).
 //
 // The reference's admission tier (auto_concurrency_limiter) bounds TOTAL
 // concurrency but is tenant-blind: one flooding tenant drives the
@@ -7,16 +8,39 @@
 // spawn and makes graceful degradation mean "low priority sheds first,
 // high-priority p99 stays flat":
 //
-//  * TokenBucket — per-tenant QPS quota (milli-token precision, refilled
-//    by elapsed monotonic time, bounded burst).
+//  * Cost model (ISSUE 15) — admission PRICES WORK instead of counting
+//    requests: each completion folds its measured service time and
+//    logical bytes (inline + descriptor-exempt) into milli-cost units
+//    (1000 = one baseline request; ComputeCostMilli), tracked as a
+//    per-(tenant, method) EWMA. A request is charged its tenant's
+//    current estimate at admission, so a tenant inside its request-rate
+//    quota can no longer sink the server with few-but-heavy calls.
+//    Cross-zone spill arrivals (a partitioned pod's overflow) pay
+//    -rpc_spill_cost_multiplier on top, and shed first within a
+//    priority level.
+//  * TokenBucket — per-tenant quota in COST units/second (milli-token
+//    precision, refilled by elapsed monotonic time, bounded burst; a
+//    call costing more than the burst admits only at a full bucket and
+//    leaves the bucket in debt).
+//  * Per-tenant gradient concurrency (ISSUE 15) — tenants without an
+//    explicit conc= share get their own AutoConcurrencyLimiter, so each
+//    tenant's limit CONVERGES from observed latency gradients with no
+//    manual -max_concurrency tuning (-rpc_tenant_gradient_limit;
+//    cardinality-bounded exactly like the tenant registry itself).
 //  * QosDispatcher — per-server: tenant registry (quota + inflight +
 //    labelled tvars), a weighted-fair dispatch queue (strict priority
-//    levels, deficit-round-robin across tenants within a level), and
-//    priority-aware shedding when the queue crosses its high-water or
-//    the concurrency limiter rejects (evict lowest-priority-first, never
-//    first-come-first-served collapse). Shed responses carry
-//    TERR_OVERLOAD plus a server-suggested backoff the client honors
-//    with jitter while SPENDING retry budget (no free re-issue storms).
+//    levels, deficit-round-robin across tenants within a level — each
+//    dequeue charges the item's estimated COST against the tenant's
+//    deficit, so a heavy call burns proportionally more of its turn),
+//    and priority-aware shedding (evict lowest-priority-first, spills
+//    before local work, never first-come-first-served collapse). Shed
+//    decisions derive from the MEASURED fair-queue sojourn time
+//    (CoDel-style -rpc_queue_delay_target_ms/-rpc_queue_delay_
+//    interval_ms) with -rpc_fair_queue_highwater as the absolute
+//    backstop; the TERR_OVERLOAD backoff hint derives from the queue's
+//    cost backlog over its measured drain rate. The client honors the
+//    hint with jitter while SPENDING retry budget (no free re-issue
+//    storms).
 //  * RendezvousSubset — deterministic client-side subsetting (HRW hash)
 //    so huge client fleets don't full-mesh every server; stable under
 //    node churn (removing one member only pulls in the next-highest
@@ -32,12 +56,15 @@
 #include <cstdlib>
 #include <deque>
 #include <map>
+#include <memory>
 #include <mutex>
 #include <shared_mutex>
 #include <string>
 #include <vector>
 
+#include "tbase/endpoint.h"
 #include "tfiber/fiber.h"
+#include "trpc/concurrency_limiter.h"
 #include "tvar/latency_recorder.h"
 #include "tvar/multi_dimension.h"
 #include "tvar/reducer.h"
@@ -71,15 +98,52 @@ inline int PriorityFromHeader(const std::string* v) {
 }
 
 // Per-tenant quota. qps <= 0 means "no rate cap"; max_concurrency <= 0
-// means "no concurrency share cap"; weight is the DRR share of dispatch
-// slots under contention (relative to other tenants at the same
-// priority level).
+// means "no EXPLICIT concurrency share cap" (the tenant then gets its
+// own self-tuning gradient limiter — see TenantState::gradient); weight
+// is the DRR share of dispatch COST under contention (relative to other
+// tenants at the same priority level).
 struct TenantQuota {
-    double qps = 0;            // admitted requests/second (0 = unlimited)
-    int64_t burst = 0;         // bucket depth; 0 = max(qps/10, 8)
+    // Admitted COST UNITS/second (0 = unlimited). One baseline request
+    // (light payload, ~-rpc_cost_ref_us of service time) costs one
+    // unit, so for ordinary traffic this keeps its request-per-second
+    // reading; heavy calls are priced by their measured cost.
+    double qps = 0;
+    int64_t burst = 0;         // bucket depth in units; 0 = max(qps/10, 8)
     int weight = 1;            // weighted-fair dispatch share
-    int64_t max_concurrency = 0;  // concurrent handlers (0 = unlimited)
+    int64_t max_concurrency = 0;  // concurrent handlers (0 = gradient)
 };
+
+// ---- cost model (ISSUE 15) ----
+
+// Milli-cost units: 1000 = one baseline request.
+constexpr int64_t kCostUnitMilli = 1000;
+
+// Fold measured service time + logical payload bytes (inline AND
+// descriptor-exempt — the referenced bytes never ride the message path
+// but they ARE the work) into milli-cost: svc_us/-rpc_cost_ref_us plus
+// bytes/-rpc_cost_ref_kb KiB, floored at one unit and capped so one
+// pathological sample cannot park a tenant's bucket in unbounded debt.
+int64_t ComputeCostMilli(int64_t svc_us, int64_t logical_bytes);
+
+// True when `peer_zone` names a zone and it differs from this node's
+// -rpc_zone (both set): the request is a cross-pod spill arrival.
+bool SpillArrival(const std::string& peer_zone);
+
+// The -rpc_spill_cost_multiplier applied to a spill arrival's charge: a
+// partitioned pod's overflow must not starve local gold traffic.
+int64_t SpillAdjustedCostMilli(int64_t cost_milli);
+
+// Default tuning for per-TENANT gradient limiters: a tenant is a whole
+// traffic class, not one method, so its floor/initial sit well above
+// the per-method limiter's — a briefly-congested light tenant must
+// never be pinched below the handful of concurrent handlers its steady
+// trickle needs (ServerOptions::tenant_gradient_options overrides).
+inline AutoConcurrencyLimiter::Options DefaultTenantGradientOptions() {
+    AutoConcurrencyLimiter::Options o;
+    o.initial_max_concurrency = 64;
+    o.min_max_concurrency = 16;
+    return o;
+}
 
 // "tenant:qps=300,burst=64,w=1,conc=8;other:w=8" -> quotas. Unknown keys
 // and malformed entries are skipped (returns false if ANYTHING was
@@ -95,14 +159,25 @@ bool ParseQuotaSpec(const std::string& spec,
 class TokenBucket {
 public:
     TokenBucket() = default;
-    // rate_per_s <= 0 disables (TryWithdraw always grants).
+    // rate_per_s <= 0 disables (TryWithdraw always grants). Rate/burst
+    // are in COST units (see kCostUnitMilli).
     void Configure(double rate_per_s, int64_t burst);
     bool enabled() const {
         return rate_milli_per_s_.load(std::memory_order_relaxed) > 0;
     }
-    // Take one token at `now_us`; false = dry. On false, *wait_ms is the
-    // suggested wait until a token accrues (>= 1).
-    bool TryWithdraw(int64_t now_us, int64_t* wait_ms);
+    // Take one baseline unit at `now_us`; false = dry. On false,
+    // *wait_ms is the suggested wait until it accrues (>= 1).
+    bool TryWithdraw(int64_t now_us, int64_t* wait_ms) {
+        return TryWithdrawCost(now_us, kCostUnitMilli, wait_ms);
+    }
+    // Work-priced withdrawal (ISSUE 15): take `cost_milli` milli-units.
+    // A cost above the burst depth admits only at a FULL bucket and
+    // leaves the bucket in debt — heavy calls are rate-priced exactly,
+    // never permanently starved by their own size. On false, *wait_ms
+    // is the wait until the required tokens accrue at the configured
+    // rate (clamped to something a client can reasonably sleep).
+    bool TryWithdrawCost(int64_t now_us, int64_t cost_milli,
+                         int64_t* wait_ms);
     int64_t tokens() const {
         return tokens_milli_.load(std::memory_order_relaxed) / 1000;
     }
@@ -138,6 +213,27 @@ public:
         void (*run)(void* arg) = nullptr;
         void (*shed)(void* arg, int64_t backoff_ms) = nullptr;
         void* arg = nullptr;
+        // Estimated charge (spill-adjusted): burned against the
+        // tenant's DRR deficit at dequeue and against the queue's cost
+        // backlog for the drain-rate/backoff math.
+        int64_t cost_milli = kCostUnitMilli;
+        // Enqueue stamp for the sojourn measurement. 0 = Enqueue stamps
+        // `now` (tests may pre-stamp to simulate a stale queue).
+        int64_t enqueue_us = 0;
+        // Cross-zone spill arrival: shed FIRST within its priority
+        // level — a partitioned pod's overflow never evicts local work
+        // of the same class.
+        bool spill = false;
+    };
+
+    // Completion context for OnDone (ISSUE 15): everything the cost
+    // model and the gradient limiter learn from. A default-constructed
+    // info (method == nullptr) feeds latency/inflight only.
+    struct CompletionInfo {
+        int error_code = 0;
+        const std::string* method = nullptr;  // cost-model key
+        int64_t logical_bytes = 0;  // inline + descriptor-exempt payload
+        EndPoint peer;              // chaos cost_inflate scoping
     };
 
     struct TenantState {
@@ -156,11 +252,34 @@ public:
         IntCell* shed = nullptr;
         IntCell* queued = nullptr;
         LatencyRecorder* latency = nullptr;
+        // Cost accounting (ISSUE 15): estimated milli-cost admitted /
+        // shed, the measured per-request cost distribution, and the
+        // gradient limiter's current limit.
+        IntCell* cost_admitted = nullptr;
+        IntCell* cost_shed = nullptr;
+        LatencyRecorder* cost_units = nullptr;
+        IntCell* gradient_limit_cell = nullptr;
+        // Self-tuning concurrency (ISSUE 15): consulted whenever no
+        // explicit conc= share is configured (max_concurrency <= 0) and
+        // -rpc_tenant_gradient_limit is on. Created with the tenant, so
+        // dispatch paths read it without the registry lock.
+        std::unique_ptr<AutoConcurrencyLimiter> gradient;
+        // Per-method measured-cost EWMAs (milli-units). Bounded by
+        // -rpc_cost_max_methods; strangers fold into "other" exactly
+        // like the tenant registry itself.
+        mutable std::shared_mutex cost_mu;
+        std::map<std::string, int64_t> method_cost_milli;
 
         // ---- DRR state, all guarded by QosDispatcher::mu_ ----
         std::deque<Item> q[kNumPriorities];
         bool in_active[kNumPriorities] = {};
-        int deficit[kNumPriorities] = {};
+        // Cost-deficit (milli-units): a dequeue charges the item's
+        // estimated cost, so one heavy call burns many turns' worth.
+        int64_t deficit[kNumPriorities] = {};
+        // Queued spill items per level: eviction only walks a queue's
+        // items when this says a spill is actually in it, keeping the
+        // common no-spill eviction O(#tenants), not O(queue depth).
+        int spill_count[kNumPriorities] = {};
     };
 
     QosDispatcher();
@@ -183,20 +302,36 @@ public:
     // registry). The pointer lives as long as the dispatcher.
     TenantState* Acquire(const std::string& tenant);
 
-    // Stage 1 — rate quota: one token at `now`; false = shed NOW with
-    // TERR_OVERLOAD and the returned suggested backoff (also counted on
-    // the tenant's shed tvar).
-    bool AdmitQps(TenantState* t, int64_t now_us, int64_t* backoff_ms);
+    // Per-tenant gradient limiter tuning applied to tenants created
+    // AFTER this call (ServerOptions::tenant_gradient_options; tests
+    // tighten the windows). Call before traffic.
+    void SetGradientOptions(const AutoConcurrencyLimiter::Options& opt);
+
+    // Cost estimate for one request of `method` from tenant `t`: the
+    // measured EWMA when one exists (exact method, else the method
+    // overflow bucket), else one baseline unit. Milli-units; spill
+    // adjustment is the CALLER's job (SpillAdjustedCostMilli) so the
+    // model itself stays zone-neutral.
+    int64_t EstimateCostMilli(TenantState* t,
+                              const std::string& method) const;
+
+    // Stage 1 — rate quota, work-priced: withdraw `cost_milli` at
+    // `now`; false = shed NOW with TERR_OVERLOAD and the returned
+    // suggested backoff (also counted on the tenant's shed tvars).
+    bool AdmitCost(TenantState* t, int64_t now_us, int64_t cost_milli,
+                   int64_t* backoff_ms);
 
     // Stage 3a — uncontended fast path: true when the fair queue is
-    // empty AND `t` is under its concurrency share; the request is
-    // accounted (inflight + admitted) and the caller dispatches directly
+    // empty AND `t` is under its concurrency limit (explicit share, or
+    // its gradient limiter's converged limit); the request is accounted
+    // (inflight + admitted + cost) and the caller dispatches directly
     // (the PR-6 inline path stays legal exactly here).
-    bool TryDirectDispatch(TenantState* t);
+    bool TryDirectDispatch(TenantState* t,
+                           int64_t cost_milli = kCostUnitMilli);
     // Same accounting without the queue-empty gate — protocols that
     // don't ride the fair queue (h2/HTTP) still get per-tenant
     // accounting and concurrency visibility.
-    void BeginServed(TenantState* t);
+    void BeginServed(TenantState* t, int64_t cost_milli = kCostUnitMilli);
 
     // Stage 3b — fair queue: enqueue under (priority, tenant-DRR). Past
     // the high-water the LOWEST-priority queued item below `priority` is
@@ -212,16 +347,42 @@ public:
     bool EvictOneBelow(int priority);
 
     // Handler completion for every admitted (direct or popped) request:
-    // inflight decrement, latency feed, drainer wake (a freed
-    // concurrency share may unblock a queued tenant).
-    void OnDone(TenantState* t, int64_t latency_us);
+    // inflight decrement, latency feed, gradient-limiter feedback, cost
+    // observation (with the chaos cost_inflate seam applied), drainer
+    // wake (a freed concurrency share may unblock a queued tenant).
+    void OnDone(TenantState* t, int64_t latency_us,
+                const CompletionInfo& info);
+    void OnDone(TenantState* t, int64_t latency_us) {
+        OnDone(t, latency_us, CompletionInfo());
+    }
 
-    // Count a shed that happened outside the queue (qps quota, limiter
-    // reject without eviction relief).
-    void CountShed(TenantState* t);
+    // Count a shed that happened outside the queue (rate quota, limiter
+    // reject without eviction relief). `cost_milli` lands on the
+    // tenant's cost_shed tvar.
+    void CountShed(TenantState* t, int64_t cost_milli = kCostUnitMilli);
 
-    // Suggested backoff for queue/limiter sheds (-rpc_overload_backoff_ms).
+    // Suggested backoff for queue/limiter sheds: the queue's current
+    // cost backlog over its MEASURED drain rate (time until the queue
+    // empties at the observed service speed), floored at
+    // -rpc_overload_backoff_ms and capped at 2s. With no drain
+    // measurement yet (cold queue), the flag floor alone.
     int64_t SuggestedBackoffMs() const;
+
+    // Observability reads for /tenants + the soaks.
+    int64_t QueueDelayEwmaUs() const {
+        return queue_delay_ewma_us_.load(std::memory_order_relaxed);
+    }
+    int64_t DrainRateCostPerS() const {
+        return drain_rate_milli_per_s_.load(std::memory_order_relaxed) /
+               kCostUnitMilli;
+    }
+    bool OverDelayTarget() const {
+        return over_target_.load(std::memory_order_relaxed);
+    }
+    // Effective concurrency limit for one tenant: the explicit share if
+    // set, else the gradient limiter's current limit, else 0
+    // (unlimited). Public for tests and the portal.
+    int64_t TenantConcurrencyLimit(const TenantState* t) const;
 
     // Drainer lifecycle (Server::StartNoListen / Server::Stop). Stop
     // sheds everything still queued so admission accounting drains.
@@ -249,10 +410,15 @@ private:
 
     bool PopLocked(Item* out, TenantState** owner, int* priority);
     // Evict one item from the lowest non-empty level strictly below
-    // `limit_prio`, from the tenant with the deepest queue there (the
-    // flooder sheds first). Appends the item to *out_shed.
+    // `limit_prio` — a SPILL item first (newest, from the deepest
+    // spill-holding queue), else the newest item of the deepest queue
+    // there (the flooder sheds first). Appends the item to *out_shed.
     bool EvictLowestLocked(int limit_prio, std::vector<Item>* out_shed,
                            std::vector<TenantState*>* out_owners);
+    // Sojourn + drain-rate bookkeeping for one dequeued/evicted item
+    // (mu_ held). `served` items feed the CoDel window; evictions only
+    // reduce the backlog.
+    void AccountDequeueLocked(const Item& it, int64_t now_us, bool served);
     void WakeDrainer();
     static void* DrainerThunk(void* arg);
     void DrainerLoop();
@@ -274,6 +440,31 @@ private:
     mutable std::mutex mu_;  // queue + DRR state
     Level levels_[kNumPriorities];
     std::atomic<int64_t> depth_{0};
+    // Cost backlog of everything queued (milli-units): the numerator of
+    // the drain-derived backoff hint.
+    std::atomic<int64_t> backlog_cost_milli_{0};
+
+    // ---- queue-delay shedding state (ISSUE 15; mu_ held for writes,
+    // atomics for the lock-free Enqueue/portal reads) ----
+    // CoDel-style window: the MINIMUM sojourn observed this interval
+    // (-1 = none yet; 0 is a LEGITIMATE minimum — an instant dequeue
+    // means no standing queue). Staying above the target for a whole
+    // interval flips over_target_; one below-target pop (or an empty
+    // queue) clears it.
+    int64_t interval_start_us_ = 0;
+    int64_t interval_min_sojourn_us_ = -1;
+    // Drain-rate window: cost dequeued since window start.
+    int64_t drain_window_start_us_ = 0;
+    int64_t drain_window_cost_milli_ = 0;
+    std::atomic<bool> over_target_{false};
+    std::atomic<int64_t> queue_delay_ewma_us_{0};
+    std::atomic<int64_t> drain_rate_milli_per_s_{0};
+
+    // Gradient-limiter template for tenants created after the call
+    // (SetGradientOptions; reads race-free because tenants are created
+    // under the registry's exclusive lock).
+    AutoConcurrencyLimiter::Options gradient_opts_ =
+        DefaultTenantGradientOptions();
 
     void* wake_butex_ = nullptr;
     fiber_t drainer_ = 0;

@@ -193,6 +193,9 @@ int Server::StartNoListen(const ServerOptions* options) {
     // Multi-tenant QoS (ISSUE 8): quotas from the flag (explicit
     // SetTenantQuota calls made before Start survive — Configure only
     // overwrites tenants the flag names), drainer for the fair queue.
+    // Gradient options FIRST: tenants minted by Configure-time traffic
+    // must already carry the tuned limiter (ISSUE 15).
+    qos_.SetGradientOptions(options_.tenant_gradient_options);
     {
         std::map<std::string, TenantQuota> quotas;
         const std::string spec = FLAGS_rpc_tenant_quotas.get();

@@ -72,6 +72,14 @@ struct ServerOptions {
     // budget. Takes precedence over auto/constant when set.
     bool timeout_concurrency = false;
     TimeoutConcurrencyLimiter::Options timeout_cl_options;
+    // Per-TENANT gradient limiter tuning (ISSUE 15): QoS tenants
+    // without an explicit conc= share each run their own
+    // AutoConcurrencyLimiter with these options, so a tenant's
+    // concurrency limit converges from its own observed latency —
+    // -rpc_tenant_gradient_limit gates the whole mechanism; tests
+    // tighten the windows here.
+    AutoConcurrencyLimiter::Options tenant_gradient_options =
+        DefaultTenantGradientOptions();
     // Run user service methods inline on the per-message fiber instead of
     // a fresh one. Default OFF: inline user code head-of-line-blocks the
     // connection's input fiber, defeating backup requests and pipelining

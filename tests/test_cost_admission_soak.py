@@ -1,0 +1,204 @@
+"""Work-priced admission soak (ISSUE 15 capstone).
+
+Six `mesh_node` processes form the usual full mesh, every node running
+the QoS tier with COST-unit quotas and NO hand-set concurrency limits:
+
+    bronze: qps=400 (cost units/s) burst=100 w=1   (the heavy class)
+    gold:   unlimited, w=8                         (the protected class)
+
+The attack this soak exists for: bronze floods WITHIN its request-count
+rate (350 req/s < 400) but with 64KiB bodies — each request measures at
+~4-6 cost units, so its offered COST is several times its quota. A
+request-counting front door (PR 7) admits all of it and gold pays; the
+work-priced door must shed it.
+
+Phases:
+  1 (baseline): gold alone at 200 qps, 128-byte bodies -> unloaded p99;
+  2 (cost flood): ONE mixed press — gold keeps its light 200 qps at
+    priority 7 while bronze floods heavy bodies at priority 1 inside
+    its request rate;
+  3 (chaos repricing): a `cost_inflate` chaos plan on node 0 multiplies
+    bronze's MEASURED cost 20x while bronze sends light traffic — the
+    admission price must follow the injected measurement.
+
+Asserted invariants (the acceptance criteria):
+  * gold success >= 99% and gold p99 <= 2x its unloaded baseline
+    THROUGH the cost flood (noise-floored for the 1-core CI host);
+  * bronze absorbs >= 95% of the sheds, with nonzero COST shed
+    (/tenants?format=json cost columns — the machine-readable face the
+    portal satellite added);
+  * bronze's learned per-method estimate (cost_ewma_milli) reflects the
+    heavy bodies (>= 2 units), and the chaos phase visibly reprices it;
+  * per-tenant gradient concurrency CONVERGED from measurement: gold's
+    gradient_limit > 0 with gradient_updates >= 1, and no conc= was
+    ever configured;
+  * shed responses carry a real backoff hint (press records the max
+    TERR_OVERLOAD backoff_ms it saw) and the server derives its hint
+    from measured rates (drain_rate/suggested_backoff_ms in json);
+  * zero lost completions (REPORT outstanding == 0 on every node) and
+    pins drain to 0 (pool_pinned == 0);
+  * clean exit 0 everywhere with the tier on.
+"""
+import json
+import subprocess
+import time
+
+from test_chaos_soak import NODE_FLAGS, Node, _chaos, _free_ports, \
+    _http_get, _var
+
+NUM_NODES = 6
+
+COST_FLAGS = NODE_FLAGS + [
+    "rpc_qos_enabled=true",
+    # Cost-unit quotas, NO conc= anywhere: concurrency comes from each
+    # tenant's gradient limiter.
+    "rpc_tenant_quotas=bronze:qps=400,burst=100,w=1;gold:w=8",
+    # Queue-delay shedding tuned for a seconds-scale soak.
+    "rpc_queue_delay_target_ms=20",
+    "rpc_queue_delay_interval_ms=100",
+]
+
+
+def _run_press(binary, port, args, timeout=90):
+    out = subprocess.run(
+        [str(binary), "--server=127.0.0.1:%d" % port, "--json"] + args,
+        capture_output=True, timeout=timeout, text=True,
+    )
+    assert out.returncode == 0, out.stderr
+    for line in reversed(out.stdout.splitlines()):
+        if line.startswith("{"):
+            return json.loads(line)
+    raise AssertionError("no json line from rpc_press:\n" + out.stdout)
+
+
+def test_cost_admission_isolation(cpp_build, tmp_path):
+    node_bin = cpp_build / "mesh_node"
+    press_bin = cpp_build / "rpc_press"
+    assert node_bin.exists(), "mesh_node not built"
+    assert press_bin.exists(), "rpc_press not built"
+    ports = _free_ports(NUM_NODES)
+    peers_file = tmp_path / "mesh_members"
+    peers_file.write_text("".join("127.0.0.1:%d\n" % p for p in ports))
+
+    nodes = [
+        Node(node_bin, ports[i], i, peers_file, flags=COST_FLAGS)
+        for i in range(NUM_NODES)
+    ]
+    try:
+        for n in nodes:
+            assert n.wait_ready(), "node %d never became ready" % n.idx
+        time.sleep(2.0)  # mesh links up, background traffic flowing
+
+        # The tier is live, and the portal leads with the cost columns.
+        tenants_page = _http_get(ports[0], "/tenants")
+        assert "multi-tenant QoS: enabled" in tenants_page, tenants_page
+        assert "cost_adm" in tenants_page, tenants_page
+        assert "drain rate" in tenants_page, tenants_page
+
+        # --- phase 1: unloaded gold baseline --------------------------
+        base = _run_press(press_bin, ports[0],
+                          ["--tenant=gold", "--priority=7", "--qps=200",
+                           "--duration_s=4", "--callers=4",
+                           "--max_retry=0", "--body_bytes=128"])
+        base_sent = base["press_tenants"]["gold"]["sent"]
+        base_p99 = base["press_tenants"]["gold"]["p99_us"]
+        assert base_sent > 400, base
+        assert base["press_tenants"]["gold"]["shed"] == 0, base
+
+        # --- phase 2: bronze floods COST inside its request rate ------
+        # gold 200 qps x 128B (priority 7) + bronze 350 req/s x 64KiB
+        # (priority 1). Bronze's request RATE is inside its 400/s
+        # quota; only its measured COST (~4-6 units/req once the model
+        # has samples) exceeds it.
+        flood = _run_press(
+            press_bin, ports[0],
+            ["--tenants=gold:4:7:128,bronze:7:1:65536", "--qps=550",
+             "--duration_s=6", "--callers=16", "--press_threads=2",
+             "--max_retry=0"],
+            timeout=150)
+        gold = flood["press_tenants"]["gold"]
+        bronze = flood["press_tenants"]["bronze"]
+
+        # The flood was real and was shed on COST: bronze emitted its
+        # offered request rate but the server priced it out.
+        assert bronze["sent"] + bronze["failed"] > 350 * 6 * 0.5, flood
+        assert bronze["shed"] >= 200, flood
+        # Its shed responses carried a real backoff hint.
+        assert bronze["backoff_ms_max"] >= 1, flood
+
+        # Isolation invariant 1: gold success rate >= 99%.
+        gold_total = gold["sent"] + gold["failed"]
+        assert gold_total > 600, flood
+        assert gold["sent"] / gold_total >= 0.99, flood
+
+        # Isolation invariant 2: gold p99 within 2x of unloaded
+        # baseline (floored for the shared 1-core CI host).
+        bound = 2 * max(base_p99, 25000)
+        assert gold["p99_us"] <= bound, (gold["p99_us"], base_p99, flood)
+
+        # Server-side cost accounting (machine-readable portal).
+        tj = json.loads(_http_get(ports[0], "/tenants?format=json"))
+        srv_bronze = tj["tenants"]["bronze"]
+        srv_gold = tj["tenants"]["gold"]
+        # Sheds landed on bronze, and they were COST sheds.
+        assert srv_bronze["shed"] >= 200, tj
+        assert srv_bronze["cost_shed_milli"] > 0, tj
+        total_shed = sum(t["shed"] for t in tj["tenants"].values())
+        assert srv_bronze["shed"] >= 0.95 * total_shed, tj
+        assert srv_gold["shed"] <= max(5, 0.01 * srv_gold["admitted"]), tj
+        # The model LEARNED bronze's heavy shape: >= 2 cost units.
+        assert srv_bronze["cost_ewma_milli"] >= 2000, tj
+        bronze_ewma_after_flood = srv_bronze["cost_ewma_milli"]
+        # Gold stayed cheap.
+        assert srv_gold["cost_ewma_milli"] <= 2000, tj
+        # Gradient concurrency converged from measurement — no conc=
+        # was ever configured, yet gold runs under a live learned limit.
+        assert srv_gold["max_concurrency"] == 0, tj
+        assert srv_gold["gradient_limit"] > 0, tj
+        assert srv_gold["gradient_updates"] >= 1, tj
+        assert srv_bronze["gradient_limit"] > 0, tj
+        # Queue-delay machinery is wired: measured fields present and
+        # the suggested backoff respects floor/cap.
+        assert tj["queue_delay_ewma_us"] >= 0, tj
+        assert tj["drain_rate_cost_per_s"] >= 0, tj
+        assert 1 <= tj["suggested_backoff_ms"] <= 2000, tj
+
+        # The labelled cost families feed /metrics too (spot check; the
+        # full lint lives in test_metrics_lint.py).
+        metrics = _http_get(ports[0], "/metrics")
+        assert 'rpc_tenant_cost_shed{tenant="bronze"}' in metrics
+        assert 'rpc_tenant_gradient_limit{tenant="gold"}' in metrics
+
+        # --- phase 3: chaos cost_inflate reprices a method ------------
+        # Bronze goes LIGHT (128B ~ 1 unit measured) but the chaos plan
+        # inflates every measured sample 20x: the admission price must
+        # follow the measurement seam, not the wire bytes.
+        _chaos(ports[0], enable=1, seed=99, plan="cost_inflate=1:20")
+        _run_press(press_bin, ports[0],
+                   ["--tenant=bronze", "--priority=1", "--qps=100",
+                    "--duration_s=3", "--callers=4", "--max_retry=0",
+                    "--body_bytes=128"])
+        assert _var(ports[0], "chaos_injected_cost_inflate") > 0
+        tj2 = json.loads(_http_get(ports[0], "/tenants?format=json"))
+        inflated = tj2["tenants"]["bronze"]["cost_ewma_milli"]
+        assert inflated >= 6000, (inflated, tj2)
+        assert inflated >= bronze_ewma_after_flood, (
+            inflated, bronze_ewma_after_flood)
+        _chaos(ports[0], enable=0)
+
+        # --- zero lost completions + pins drain + clean exit ----------
+        for n in nodes:
+            rep = n.stop_and_report()
+            assert rep is not None, "node %d produced no report" % n.idx
+            assert rep["outstanding"] == 0, rep
+            assert rep["pool_pinned"] == 0, rep
+            if n.idx == 0:
+                assert rep["cost_admitted_milli"] > 0, rep
+        for n in nodes:
+            assert n.shutdown() == 0, "node %d unclean exit" % n.idx
+    finally:
+        for n in nodes:
+            try:
+                n.proc.kill()
+            except OSError:
+                pass

@@ -156,6 +156,28 @@ def test_metrics_exposition_lint(cpp_build, tmp_path):
         assert re.search(
             r'^rpc_tenant_admitted\{tenant="default"\} \d+$', text, re.M), \
             text[:500]
+        # ISSUE 15 work-priced admission families: per-tenant estimated
+        # milli-cost counters, the measured per-request cost summary,
+        # the gradient concurrency-limit gauge, the process-wide cost
+        # totals, and the fair-queue sojourn summary — all present on a
+        # qos-enabled node from its own self-echo traffic.
+        assert families.get("rpc_tenant_cost_admitted") == "gauge", \
+            sorted(families)
+        assert families.get("rpc_tenant_cost_shed") == "gauge"
+        assert families.get("rpc_tenant_cost_units") == "summary"
+        assert families.get("rpc_tenant_gradient_limit") == "gauge"
+        assert families.get("rpc_server_cost_admitted") == "gauge"
+        assert families.get("rpc_server_cost_shed") == "gauge"
+        assert families.get("rpc_server_queue_delay_us") == "summary"
+        assert re.search(
+            r'^rpc_tenant_cost_admitted\{tenant="default"\} \d+$', text,
+            re.M), text[:500]
+        # The gradient limit is a LIVE positive limit (converging from
+        # the node's own traffic), not a placeholder zero.
+        m = re.search(
+            r'^rpc_tenant_gradient_limit\{tenant="default"\} (\d+)$',
+            text, re.M)
+        assert m is not None and int(m.group(1)) > 0, m
         # ISSUE 10 zero-copy crash-safety families: the pinned-block
         # lease ledger (live gauge + reclamation counters) and the
         # epoch fence — present (0-valued) even before the first pin.

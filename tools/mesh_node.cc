@@ -867,6 +867,8 @@ void PrintReport(int id, int port, const Counters& c) {
         "\"desc_rsp_resolves\": %lld, \"desc_rsp_sends\": %lld, "
         "\"pool_pinned\": %lld, \"pool_reaped\": %lld, "
         "\"pool_peer_released\": %lld, \"epoch_rejects\": %lld, "
+        "\"cost_admitted_milli\": %lld, \"cost_shed_milli\": %lld, "
+        "\"overload_sheds\": %lld, "
         "\"outstanding\": %lld, \"reconnects\": %lld, "
         "\"reissues\": %lld, \"budget_exhausted\": %lld, "
         "\"drain_reroutes\": %lld, \"drain_notices\": %lld, "
@@ -900,6 +902,9 @@ void PrintReport(int id, int port, const Counters& c) {
         (long long)block_lease::expired_reaped(),
         (long long)block_lease::peer_released(),
         (long long)VarInt("rpc_pool_epoch_rejects"),
+        (long long)VarInt("rpc_server_cost_admitted"),
+        (long long)VarInt("rpc_server_cost_shed"),
+        (long long)VarInt("rpc_server_overload_sheds"),
         (long long)c.outstanding.load(), (long long)c.reconnects.load(),
         reissues, (long long)VarInt("rpc_retry_budget_exhausted"),
         (long long)VarInt("rpc_client_drain_reroutes"),

@@ -508,6 +508,9 @@ public:
         priority_ = v;
         has_priority_ = true;
     }
+    bool has_zone() const { return !zone_.empty(); }
+    const std::string& zone() const { return zone_; }
+    void set_zone(const std::string& v) { zone_ = v; }
     bool has_trace_id() const { return has_trace_id_; }
     uint64_t trace_id() const { return trace_id_; }
     void set_trace_id(uint64_t v) {
@@ -545,6 +548,7 @@ public:
             pbstub::wire::put_u(out, 8, parent_span_id_);
         }
         if (!tenant_.empty()) pbstub::wire::put_str(out, 9, tenant_);
+        if (!zone_.empty()) pbstub::wire::put_str(out, 10, zone_);
         return true;
     }
     bool ParseFromString(const std::string& s) override {
@@ -564,13 +568,14 @@ public:
                 case 7: set_span_id(v); break;
                 case 8: parent_span_id_ = v; break;
                 case 9: tenant_ = sub; break;
+                case 10: zone_ = sub; break;
                 default: break;
             }
         }
         return ok;
     }
 private:
-    std::string service_name_, method_name_, tenant_;
+    std::string service_name_, method_name_, tenant_, zone_;
     int64_t timeout_ms_ = 0, log_id_ = 0;
     uint64_t trace_id_ = 0, span_id_ = 0, parent_span_id_ = 0;
     int priority_ = 0;

@@ -4,10 +4,11 @@
 // rdma_performance client.cpp:50-68).
 //
 //   rpc_press --server=ip:port [--qps=10000] [--duration_s=10]
-//             [--payload=4096] [--callers=8] [--press_threads=1]
-//             [--pooled] [--pool_desc] [--timeout_ms=5000]
-//             [--metrics_csv=path] [--tenant=name] [--priority=0..7]
-//             [--tenants=a:8,b:1  or  a:8:7,b:1:1]
+//             [--payload=4096 | --body_bytes=4096] [--callers=8]
+//             [--press_threads=1] [--pooled] [--pool_desc]
+//             [--timeout_ms=5000] [--metrics_csv=path] [--tenant=name]
+//             [--priority=0..7]
+//             [--tenants=a:8,b:1 | a:8:7,b:1:1 | a:8:7:128,b:1:1:65536]
 //
 // --pool_desc (ISSUE 10 satellite, mirrors echo_bench --pool-desc):
 // connect over the shm-ICI link (IciBlockPool + Channel::InitIci) and
@@ -81,18 +82,27 @@ int64_t VarInt(const char* name) {
 }
 
 // One traffic class of the generator: its own pacing bucket and stats,
-// so per-tenant isolation is measurable from the CLIENT side too.
+// so per-tenant isolation is measurable from the CLIENT side too. A
+// per-tenant payload override (the 4th --tenants spec field, ISSUE 15)
+// makes one generator emit MIXED-COST load: a "heavy" tenant flooding
+// big bodies inside its request-count rate while a light tenant
+// trickles — the shape that proves work-priced admission.
 struct TenantGen {
     std::string name;       // empty = no identity stamped
     int priority = -1;      // <0 = unset
     int weight = 1;
+    int payload = -1;       // <0 = the global --body_bytes/--payload
     long long qps = 0;      // this tenant's share of the target
     LatencyRecorder lat;
+    IOBuf filler;           // this class's request body
     std::atomic<int64_t> tokens{0};
     std::atomic<int64_t> sent{0};
     std::atomic<int64_t> failed{0};
     std::atomic<int64_t> shed{0};  // TERR_OVERLOAD rejections
     std::atomic<int64_t> stale{0};  // TERR_STALE_EPOCH fences (pool_desc)
+    // Largest server-suggested backoff seen on a shed: the soak asserts
+    // the hint is real (drain-rate-derived), not just the flag floor.
+    std::atomic<int64_t> backoff_ms_max{0};
     int64_t granted = 0;
     int64_t last_sent = 0;  // interval reporting
 };
@@ -101,10 +111,8 @@ struct PressCtx {
     benchpb::EchoService_Stub* stub;
     TenantGen* gen;
     std::atomic<bool>* stop;
-    IOBuf* filler;
     int64_t timeout_ms;
     bool pool_desc = false;
-    size_t payload = 0;
 };
 
 // Ctrl-C / SIGINT: finish the current interval cleanly — flush the final
@@ -131,6 +139,7 @@ void* PressCaller(void* arg) {
         benchpb::EchoRequest req;
         benchpb::EchoResponse res;
         req.set_send_ts_us(monotonic_time_us());
+        const size_t payload = g->filler.size();
         if (c->pool_desc) {
             // One-sided descriptor load: pin a fresh pool block per call
             // (lease-managed; EndRPC releases it) so the generator
@@ -138,21 +147,28 @@ void* PressCaller(void* arg) {
             // buffer.
             IOBuf att;
             char* data = nullptr;
-            if (IciBlockPool::AllocatePoolAttachment(c->payload, &att,
+            if (IciBlockPool::AllocatePoolAttachment(payload, &att,
                                                      &data)) {
-                memset(data, 'p', c->payload);
+                memset(data, 'p', payload);
                 cntl.set_request_pool_attachment(std::move(att));
             } else {
-                cntl.request_attachment().append(*c->filler);
+                cntl.request_attachment().append(g->filler);
             }
         } else {
-            cntl.request_attachment().append(*c->filler);
+            cntl.request_attachment().append(g->filler);
         }
         c->stub->Echo(&cntl, &req, &res, nullptr);
         if (cntl.Failed()) {
             g->failed.fetch_add(1, std::memory_order_relaxed);
             if (cntl.ErrorCode() == TERR_OVERLOAD) {
                 g->shed.fetch_add(1, std::memory_order_relaxed);
+                const int64_t hint = cntl.suggested_backoff_ms();
+                int64_t cur =
+                    g->backoff_ms_max.load(std::memory_order_relaxed);
+                while (hint > cur &&
+                       !g->backoff_ms_max.compare_exchange_weak(
+                           cur, hint, std::memory_order_relaxed)) {
+                }
             } else if (cntl.ErrorCode() == TERR_STALE_EPOCH) {
                 g->stale.fetch_add(1, std::memory_order_relaxed);
             }
@@ -164,7 +180,9 @@ void* PressCaller(void* arg) {
     return nullptr;
 }
 
-// "--tenants=a:8,b:1" or "a:8:7,b:1:1" -> name:weight[:priority] specs.
+// "--tenants=a:8,b:1", "a:8:7,b:1:1", or "a:8:7:128,b:1:1:65536" ->
+// name:weight[:priority[:payload_bytes]] specs. The 4th field gives the
+// class its own body size — one generator then emits mixed-COST load.
 bool ParseTenantsSpec(const char* spec, int default_priority,
                       std::vector<std::unique_ptr<TenantGen>>* gens) {
     std::string s(spec);
@@ -185,6 +203,11 @@ bool ParseTenantsSpec(const char* spec, int default_priority,
         if (g->weight <= 0) return false;
         if (c2 != std::string::npos) {
             g->priority = atoi(entry.c_str() + c2 + 1);
+            const size_t c3 = entry.find(':', c2 + 1);
+            if (c3 != std::string::npos) {
+                g->payload = atoi(entry.c_str() + c3 + 1);
+                if (g->payload < 0) return false;
+            }
         }
         gens->push_back(std::move(g));
     }
@@ -232,6 +255,11 @@ int main(int argc, char** argv) {
         if (strncmp(argv[i], "--payload=", 10) == 0) {
             payload = atoi(argv[i] + 10);
         }
+        // --body_bytes: the cost-model-facing spelling of --payload
+        // (ISSUE 15) — the logical bytes half of a request's price.
+        if (strncmp(argv[i], "--body_bytes=", 13) == 0) {
+            payload = atoi(argv[i] + 13);
+        }
         if (strncmp(argv[i], "--callers=", 10) == 0) {
             callers = atoi(argv[i] + 10);
         }
@@ -266,9 +294,9 @@ int main(int argc, char** argv) {
                 "[--duration_s=N] [--payload=N] [--callers=N] "
                 "[--press_threads=N] [--pooled] [--pool_desc "
                 "(alias: --pool-desc)] "
-                "[--timeout_ms=N] "
+                "[--timeout_ms=N] [--body_bytes=N (alias: --payload)] "
                 "[--max_retry=N] [--tenant=NAME] [--priority=0..7] "
-                "[--tenants=a:8,b:1 | a:8:7,b:1:1] "
+                "[--tenants=name:weight[:prio[:payload_bytes]],...] "
                 "[--zone=NAME] [--dcn_peers=ip:port,...] [--json]\n"
                 "  --zone/--dcn_peers: zone-aware LB over the local "
                 "server + cross-pod dcn-tier peers; per-zone picks and "
@@ -384,8 +412,12 @@ int main(int argc, char** argv) {
             new benchpb::EchoService_Stub(channels.back().get()));
     }
 
-    IOBuf filler;
-    filler.append(std::string((size_t)payload, 'p'));
+    // Per-class request bodies: the spec's payload override, else the
+    // global --body_bytes/--payload.
+    for (auto& g : gens) {
+        const int pbytes = g->payload >= 0 ? g->payload : payload;
+        g->filler.append(std::string((size_t)pbytes, 'p'));
+    }
     std::atomic<bool> stop{false};
     // Caller -> tenant assignment by weight (every tenant gets at least
     // one caller), channels round-robin underneath.
@@ -413,8 +445,8 @@ int main(int argc, char** argv) {
     ctxs.reserve((size_t)callers);
     for (int i = 0; i < callers; ++i) {
         ctxs.push_back(PressCtx{stubs[(size_t)(i % press_threads)].get(),
-                                assignment[(size_t)i], &stop, &filler,
-                                timeout_ms, pool_desc, (size_t)payload});
+                                assignment[(size_t)i], &stop,
+                                timeout_ms, pool_desc});
     }
     std::vector<fiber_t> tids((size_t)callers);
     for (size_t i = 0; i < tids.size(); ++i) {
@@ -532,6 +564,10 @@ int main(int argc, char** argv) {
         total_stale += g->stale.load();
     }
     const double achieved = (double)total_sent / secs;
+    int64_t backoff_max = 0;
+    for (auto& g : gens) {
+        backoff_max = std::max(backoff_max, g->backoff_ms_max.load());
+    }
     // Headline percentiles from the largest class (see report()).
     const TenantGen* head = gens[0].get();
     for (auto& g : gens) {
@@ -543,13 +579,14 @@ int main(int argc, char** argv) {
         // completely different server paths.
         printf("{\"press_qps\": %.0f, \"press_target_qps\": %lld, "
                "\"press_failed\": %lld, \"press_shed\": %lld, "
+               "\"press_backoff_ms_max\": %lld, "
                "\"press_p50_us\": %lld, "
                "\"press_p99_us\": %lld, \"press_p999_us\": %lld, "
                "\"press_threads\": %d, \"press_callers\": %d, "
                "\"press_payload\": %d, \"press_pooled\": %d, "
                "\"press_pool_desc\": %d, \"press_stale_epoch\": %lld",
                achieved, qps, (long long)total_failed,
-               (long long)total_shed,
+               (long long)total_shed, (long long)backoff_max,
                (long long)head->lat.latency_percentile(0.5),
                (long long)head->lat.latency_percentile(0.99),
                (long long)head->lat.latency_percentile(0.999),
@@ -570,15 +607,19 @@ int main(int argc, char** argv) {
             for (size_t i = 0; i < gens.size(); ++i) {
                 const auto& g = gens[i];
                 printf("%s\"%s\": {\"qps\": %.0f, \"target_qps\": %lld, "
-                       "\"priority\": %d, \"sent\": %lld, "
+                       "\"priority\": %d, \"payload\": %lld, "
+                       "\"sent\": %lld, "
                        "\"failed\": %lld, \"shed\": %lld, "
+                       "\"backoff_ms_max\": %lld, "
                        "\"p50_us\": %lld, \"p99_us\": %lld}",
                        i == 0 ? "" : ", ",
                        g->name.empty() ? "default" : g->name.c_str(),
                        (double)g->sent.load() / secs, g->qps, g->priority,
+                       (long long)g->filler.size(),
                        (long long)g->sent.load(),
                        (long long)g->failed.load(),
                        (long long)g->shed.load(),
+                       (long long)g->backoff_ms_max.load(),
                        (long long)g->lat.latency_percentile(0.5),
                        (long long)g->lat.latency_percentile(0.99));
             }

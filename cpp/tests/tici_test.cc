@@ -555,6 +555,50 @@ TEST(BlockLease, BackupTryHoldsBothPeersEntitled) {
     EXPECT_EQ(live0, IciBlockPool::slab_allocated());
 }
 
+TEST(BlockLease, LateLoserAckValidatesCallAndPeer) {
+    // ISSUE 16 regression: a hedged call posts the SAME pinned request
+    // block to TWO peers; the winner's ack releases the lease, and the
+    // LOSING try's response can land AFTER that, on a DIFFERENT
+    // connection. Its drop-path ack must validate (call, peer) and can
+    // never double-release — the slab may already be repinned by a
+    // fresh lease when the late ack arrives.
+    ASSERT_EQ(0, IciBlockPool::Init());
+    const size_t live0 = IciBlockPool::slab_allocated();
+    char* data = nullptr;
+    IOBuf att;
+    ASSERT_TRUE(IciBlockPool::AllocatePoolAttachment(8000, &att, &data));
+    const uint64_t l = block_lease::Pin(std::move(att));
+    const int64_t dl = monotonic_time_us() + (int64_t)60e6;
+    ASSERT_TRUE(block_lease::Arm(l, 7, dl, 111, /*add_peer=*/false));
+    ASSERT_TRUE(block_lease::Arm(l, 7, dl, 222, /*add_peer=*/true));
+    // Wrong call id (a forged or cross-call token): frees nothing.
+    EXPECT_FALSE(block_lease::ReleaseAcked(l, 8, 222));
+    EXPECT_TRUE(block_lease::Alive(l));
+    // Right call, NON-entitled peer: frees nothing.
+    EXPECT_FALSE(block_lease::ReleaseAcked(l, 7, 999));
+    EXPECT_TRUE(block_lease::Alive(l));
+    // The winner (the backup try, peer 222) acks: released exactly once.
+    EXPECT_TRUE(block_lease::ReleaseAcked(l, 7, 222));
+    EXPECT_FALSE(block_lease::Alive(l));
+    EXPECT_EQ(live0, IciBlockPool::slab_allocated());
+
+    // Repin a fresh block — it may reuse the very slab the winner just
+    // freed — then deliver the loser's LATE ack (its own peer 111, the
+    // ORIGINAL call id): it must find nothing, and the new lease must
+    // be untouched even from its own entitled peer under a stale call.
+    IOBuf att2;
+    ASSERT_TRUE(IciBlockPool::AllocatePoolAttachment(8000, &att2, &data));
+    const uint64_t l2 = block_lease::Pin(std::move(att2));
+    ASSERT_TRUE(block_lease::Arm(l2, 9, dl, 111, /*add_peer=*/false));
+    EXPECT_FALSE(block_lease::ReleaseAcked(l, 7, 111));   // late loser
+    EXPECT_TRUE(block_lease::Alive(l2));
+    EXPECT_FALSE(block_lease::ReleaseAcked(l2, 7, 111));  // stale call
+    EXPECT_TRUE(block_lease::Alive(l2));
+    EXPECT_TRUE(block_lease::ReleaseAcked(l2, 9, 111));
+    EXPECT_FALSE(block_lease::ReleaseAcked(l2, 9, 111));  // exactly once
+    EXPECT_EQ(live0, IciBlockPool::slab_allocated());
+}
+
 TEST(BlockLease, PeerDeathReleasesOnlyThatPeersPins) {
     ASSERT_EQ(0, IciBlockPool::Init());
     const size_t live0 = IciBlockPool::slab_allocated();

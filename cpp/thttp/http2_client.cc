@@ -547,7 +547,8 @@ void H2ClientCancel(SocketId sid, uint64_t cid) {
 int H2ClientSendUnary(Socket* s, uint64_t cid, const std::string& grpc_path,
                       const std::string& authority, const IOBuf& request_pb,
                       int64_t deadline_us, const std::string& authorization,
-                      const std::string& tenant, int priority) {
+                      const std::string& tenant, int priority,
+                      const std::string& session) {
     if (g_h2_client_index < 0) return -1;
     H2ClientSession* sess = client_session_of(s);
     std::string out;
@@ -588,6 +589,10 @@ int H2ClientSendUnary(Socket* s, uint64_t cid, const std::string& grpc_path,
     }
     if (priority >= 0) {
         headers.emplace_back("x-tpu-priority", std::to_string(priority));
+    }
+    // Sticky-session identity (ISSUE 16).
+    if (!session.empty()) {
+        headers.emplace_back("x-tpu-session", session);
     }
     if (deadline_us > 0) {
         const int64_t remain_us = deadline_us - monotonic_time_us();

@@ -21,13 +21,14 @@ namespace tpurpc {
 // + SETTINGS on first use of the connection). The response completes the
 // RPC via CompleteClientUnaryResponse(cid, ...). `grpc_path` is
 // "/package.Service/Method". QoS identity rides as x-tpu-tenant /
-// x-tpu-priority headers (empty/negative = omitted). Returns 0 on
-// success (frames queued).
+// x-tpu-priority headers; the sticky-session id as x-tpu-session
+// (empty/negative = omitted). Returns 0 on success (frames queued).
 int H2ClientSendUnary(Socket* s, uint64_t cid, const std::string& grpc_path,
                       const std::string& authority, const IOBuf& request_pb,
                       int64_t deadline_us,
                       const std::string& authorization = "",
-                      const std::string& tenant = "", int priority = -1);
+                      const std::string& tenant = "", int priority = -1,
+                      const std::string& session = "");
 
 // Cancel the in-flight unary call `cid` on the h2 client session of
 // `sid`: RST_STREAM(CANCEL) the matching stream and drop its response

@@ -541,6 +541,10 @@ void DispatchCompleteStream(Socket* s, H2Session* sess, uint32_t stream_id,
         ctx->cntl.set_server_deadline_us(deadline_us);
         if (xt != nullptr) ctx->cntl.set_tenant(*xt);
         ctx->cntl.set_priority(priority);
+        // Sticky-session identity (ISSUE 16), h2 spelling of the tpu_std
+        // meta's session field.
+        const std::string* xs = FindHeader(req_headers, "x-tpu-session");
+        if (xs != nullptr) ctx->cntl.set_session(*xs);
         if (!ParsePbFromIOBuf(ctx->req.get(), req_body)) {
             guard->Finish(TERR_REQUEST);
             delete guard;

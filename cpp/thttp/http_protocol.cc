@@ -137,6 +137,10 @@ bool DispatchHttpRpc(Server* server, const HttpRequest& req,
     cntl.InitServerSide(server, remote_side);
     if (xt != nullptr) cntl.set_tenant(*xt);
     cntl.set_priority(priority);
+    // Sticky-session identity (ISSUE 16): the json door carries it on
+    // the same x-tpu-session header as the h2 door.
+    const std::string* xs = req.FindHeader("x-tpu-session");
+    if (xs != nullptr) cntl.set_session(*xs);
     if (server->options().interceptor != nullptr) {
         int ierr = 0;
         std::string ietext;

@@ -311,6 +311,9 @@ void Channel::CallMethod(const google::protobuf::MethodDescriptor* method,
         if (!cntl->has_priority() && parent->has_priority()) {
             cntl->set_priority(parent->priority());
         }
+        if (cntl->session().empty() && !parent->session().empty()) {
+            cntl->set_session(parent->session());
+        }
     }
     if (cntl->deadline_us_ > 0) {
         cntl->timeout_timer_ = TimerThread::singleton()->schedule(

@@ -50,6 +50,15 @@ void InheritSubCallContext(Controller* parent, Controller* sub,
     if (parent->has_priority() && !sub->has_priority()) {
         sub->set_priority(parent->priority());
     }
+    if (!parent->session().empty() && sub->session().empty()) {
+        sub->set_session(parent->session());
+    }
+    // Per-call hedge override (ISSUE 16): the router arms an adaptive
+    // backup delay on the PARENT controller; the sub-call that actually
+    // rides the wire must carry it or hedging silently never fires.
+    if (parent->backup_request_ms() >= 0) {
+        sub->set_backup_request_ms(parent->backup_request_ms());
+    }
 }
 
 // The parent call's own absolute deadline: its timeout (or the combo
@@ -493,6 +502,13 @@ struct SelectiveCallCtx {
         sub_cntl.Reset();
         InheritSubCallContext(parent, &sub_cntl, deadline_us,
                               parent->timeout_ms());
+        // Attachment bridge (ISSUE 16): a front door forwards the client's
+        // inline attachment bytes; without the copy the backend would see
+        // an empty attachment on every routed call. (Copy, not swap — a
+        // cross-channel retry re-issues from the parent's intact buffer.)
+        if (!parent->request_attachment().empty()) {
+            sub_cntl.request_attachment() = parent->request_attachment();
+        }
         const uint32_t idx = next_index++ % (uint32_t)chan->subs_.size();
         // Re-publish the upstream server call for the issue (no-op when
         // null or already current): the sub-channel's CallMethod then
@@ -505,6 +521,13 @@ struct SelectiveCallCtx {
     }
 
     static void OneDone(SelectiveCallCtx* ctx) {
+        // Mirror hedge telemetry BEFORE any re-issue resets the
+        // sub-controller: "a backup went out" is sticky across hops.
+        if (ctx->sub_cntl.backup_issued()) {
+            ctx->parent->set_backup_telemetry(
+                true,
+                ctx->parent->backup_won() || ctx->sub_cntl.backup_won());
+        }
         if (ctx->sub_cntl.Failed() && ctx->tries_left-- > 0) {
             // TERR_DRAINING re-issues are budget-free (the draining
             // server provably never processed the call); everything
@@ -521,8 +544,20 @@ struct SelectiveCallCtx {
         if (ctx->sub_cntl.Failed()) {
             ctx->parent->SetFailed(ctx->sub_cntl.ErrorCode(), "%s",
                                    ctx->sub_cntl.ErrorText().c_str());
+            // Shed verdicts carry the server's backoff hint through to
+            // the caller (the router forwards it to ITS client).
+            if (ctx->sub_cntl.suggested_backoff_ms() > 0) {
+                ctx->parent->set_suggested_backoff_ms(
+                    ctx->sub_cntl.suggested_backoff_ms());
+            }
         } else {
             ctx->chan->retry_budget_.OnSuccess();
+            // Response-attachment bridge: hand the backend's attachment
+            // bytes to the parent (move — the sub-controller is done).
+            if (!ctx->sub_cntl.response_attachment().empty()) {
+                ctx->parent->response_attachment().swap(
+                    ctx->sub_cntl.response_attachment());
+            }
         }
         google::protobuf::Closure* user_done = ctx->done;
         if (user_done != nullptr) {

@@ -244,7 +244,11 @@ void Controller::Reset() {
     response_compress_type_ = 0;
     tenant_.clear();
     priority_ = -1;
+    session_.clear();
     suggested_backoff_ms_ = 0;
+    unfinished_server_id_ = INVALID_VREF_ID;
+    backup_issued_ = false;
+    backup_won_ = false;
     current_fly_sid_ = INVALID_VREF_ID;
     unfinished_fly_sid_ = INVALID_VREF_ID;
     reusable_fly_sid_ = INVALID_VREF_ID;
@@ -410,10 +414,11 @@ void Controller::DestroyServerCallId() {
 void Controller::SendWireCancel() {
     if (channel_ == nullptr) return;
     const bool grpc = channel_->options().protocol == "grpc";
-    const auto send_one = [&](CallId cid, SocketId fly_sid) {
+    const auto send_one = [&](CallId cid, SocketId fly_sid,
+                              SocketId server_sid) {
         if (cid == INVALID_CALL_ID) return;
         SocketId sid = fly_sid;
-        if (sid == INVALID_VREF_ID) sid = current_server_id_;
+        if (sid == INVALID_VREF_ID) sid = server_sid;
         if (sid == INVALID_VREF_ID) sid = single_server_id_;
         if (sid == INVALID_VREF_ID) return;
         if (grpc) {
@@ -422,8 +427,11 @@ void Controller::SendWireCancel() {
             SendTpuStdCancel(sid, cid);
         }
     };
-    send_one(current_cid_, current_fly_sid_);
-    send_one(unfinished_cid_, unfinished_fly_sid_);
+    send_one(current_cid_, current_fly_sid_, current_server_id_);
+    // The unfinished (pre-backup) try lives on ITS OWN server: the
+    // backup's FeedbackToLB cleared current_server_id_, so the saved
+    // unfinished_server_id_ is the only address that still names it.
+    send_one(unfinished_cid_, unfinished_fly_sid_, unfinished_server_id_);
 }
 
 // ---------------- client call machinery ----------------
@@ -478,6 +486,7 @@ int Controller::HandleError(CallId id, int error) {
         // (the original behind a backup request): only that call dies;
         // the current call may still complete the RPC.
         unfinished_cid_ = INVALID_CALL_ID;
+        unfinished_server_id_ = INVALID_VREF_ID;
         if (unfinished_fly_sid_ != INVALID_VREF_ID) {
             Socket::SetFailedById(unfinished_fly_sid_);
             unfinished_fly_sid_ = INVALID_VREF_ID;
@@ -496,6 +505,13 @@ int Controller::HandleError(CallId id, int error) {
         }
         current_fly_sid_ = unfinished_fly_sid_;
         unfinished_fly_sid_ = INVALID_VREF_ID;
+        // The original is current again — restore its server id so
+        // EndRPC's final LB feedback (and any wire CANCEL) attributes
+        // the verdict to the server actually handling the call, not to
+        // the dead backup's.
+        current_server_id_ = unfinished_server_id_;
+        unfinished_server_id_ = INVALID_VREF_ID;
+        backup_won_ = false;  // the backup did NOT complete the RPC
         return id_unlock(id);
     }
     // Cancellation (StartCancel, or the cascade from a canceled upstream
@@ -795,7 +811,7 @@ void Controller::IssueRPC() {
         if (H2ClientSendUnary(s.get(), current_cid_, path,
                               endpoint2str(remote_side_), request_buf_,
                               deadline_us_, authorization, tenant_,
-                              priority_) != 0) {
+                              priority_, session_) != 0) {
             id_error(current_cid_, errno != 0 ? errno : TERR_FAILED_SOCKET);
         }
         return;
@@ -861,6 +877,9 @@ void Controller::IssueRPC() {
     // the default tenant/priority.
     if (!tenant_.empty()) req_meta->set_tenant(tenant_);
     if (priority_ >= 0) req_meta->set_priority(priority_);
+    // Sticky-session identity (ISSUE 16): named so an L7 front door can
+    // pin the whole session to one backend; hop-to-hop like tenant.
+    if (!session_.empty()) req_meta->set_session(session_);
     // Pod identity (ISSUE 15d): a zone-tagged sender announces itself
     // so the receiver can price cross-pod spill arrivals above local
     // work (and shed them first within a priority level).
@@ -1043,9 +1062,14 @@ void Controller::MaybeIssueBackup() {
     unfinished_cid_ = current_cid_;
     unfinished_fly_sid_ = current_fly_sid_;
     current_fly_sid_ = INVALID_VREF_ID;
+    // Save the original's server BEFORE the feedback clears
+    // current_server_id_: the loser-cancel at EndRPC (and the fall-back
+    // when the backup's connection dies) still needs its address.
+    unfinished_server_id_ = current_server_id_;
     FeedbackToLB(0);
     current_cid_ = next;
     ++current_try_;
+    backup_issued_ = true;
     *g_client_backups << 1;
     IssueRPC();
 }
@@ -1104,6 +1128,24 @@ void Controller::EndRPC(CallId locked_id) {
             }
         }
         auth_fight_sid_ = INVALID_VREF_ID;
+    }
+    // Hedge loser cancel (ISSUE 16): the RPC completed but the OTHER try
+    // is still live on its server — a wire CANCEL stops that server from
+    // burning CPU on a call nobody waits for, and lets it ack/release any
+    // descriptor lease the abandoned try carried. Skip when the whole RPC
+    // was canceled (SendWireCancel already covered both tries).
+    if (unfinished_cid_ != INVALID_CALL_ID &&
+        !canceled_.load(std::memory_order_relaxed) && channel_ != nullptr) {
+        SocketId sid = unfinished_fly_sid_;
+        if (sid == INVALID_VREF_ID) sid = unfinished_server_id_;
+        if (sid == INVALID_VREF_ID) sid = single_server_id_;
+        if (sid != INVALID_VREF_ID) {
+            if (channel_->options().protocol == "grpc") {
+                H2ClientCancel(sid, unfinished_cid_);
+            } else {
+                SendTpuStdCancel(sid, unfinished_cid_);
+            }
+        }
     }
     ReleaseFlySockets();
     if (span_ != nullptr) {
@@ -1188,6 +1230,20 @@ void ProcessTpuStdResponse(TpuStdMessage* msg, const rpc::RpcMeta& meta) {
         ack_dropped_descriptor();
         return;
     }
+    // Hedge winner normalization (ISSUE 16): whichever live try delivered
+    // THIS response is the winner — relabel it "current" so every
+    // termination path below (fly-sid reuse, LB feedback, the loser
+    // cancel at EndRPC) uniformly treats "unfinished" as the loser.
+    if (cid == cntl->unfinished_cid_) {
+        std::swap(cntl->current_cid_, cntl->unfinished_cid_);
+        std::swap(cntl->current_fly_sid_, cntl->unfinished_fly_sid_);
+        std::swap(cntl->current_server_id_, cntl->unfinished_server_id_);
+    } else if (cntl->unfinished_cid_ != INVALID_CALL_ID) {
+        // The BACKUP try's response is completing the RPC (cleared again
+        // in HandleError if this response is a retryable error and the
+        // call falls back to the still-live original).
+        cntl->backup_won_ = true;
+    }
     if (cntl->span_ != nullptr) {
         cntl->span_->received_us = monotonic_time_us();
         cntl->span_->response_bytes = (int64_t)msg->body.size();
@@ -1216,6 +1272,11 @@ void ProcessTpuStdResponse(TpuStdMessage* msg, const rpc::RpcMeta& meta) {
         }
     }
     if (rmeta.error_code() != 0) {
+        // An error response never hands user code the descriptor view:
+        // ack a piggybacked response pool attachment NOW so the server's
+        // pin frees without waiting for the reaper (satellite-1 audit —
+        // these terminal paths used to strand the pin).
+        ack_dropped_descriptor();
         if (rmeta.error_code() == TERR_OVERLOAD ||
             rmeta.error_code() == TERR_STALE_EPOCH) {
             // The handler never ran — a priority-aware shed or an
@@ -1239,6 +1300,7 @@ void ProcessTpuStdResponse(TpuStdMessage* msg, const rpc::RpcMeta& meta) {
     }
     if (meta.has_body_checksum() &&
         crc32c_iobuf(0, msg->body) != meta.body_checksum()) {
+        ack_dropped_descriptor();  // corrupt response: view never taken
         cntl->SetFailed(TERR_RESPONSE, "response body checksum mismatch");
         cntl->EndRPC(cid);
         return;
@@ -1246,6 +1308,7 @@ void ProcessTpuStdResponse(TpuStdMessage* msg, const rpc::RpcMeta& meta) {
     // Split payload/attachment and deserialize.
     const uint32_t att_size = meta.attachment_size();
     if ((size_t)att_size > msg->body.size()) {
+        ack_dropped_descriptor();  // malformed response: view never taken
         cntl->SetFailed(TERR_RESPONSE, "attachment_size %u > body %zu",
                         att_size, msg->body.size());
         cntl->EndRPC(cid);
@@ -1258,6 +1321,7 @@ void ProcessTpuStdResponse(TpuStdMessage* msg, const rpc::RpcMeta& meta) {
     if (meta.compress_type() != COMPRESS_NONE) {
         IOBuf raw;
         if (!DecompressBody(meta.compress_type(), payload, &raw)) {
+            ack_dropped_descriptor();  // failing call: view never taken
             cntl->SetFailed(TERR_RESPONSE, "decompress response failed");
             cntl->EndRPC(cid);
             return;
@@ -1363,6 +1427,14 @@ void CompleteClientUnaryResponse(uint64_t cid, int error_code,
     if (cid != cntl->current_cid_ && cid != cntl->unfinished_cid_) {
         id_unlock(cid);  // an abandoned try's late response
         return;
+    }
+    // Hedge winner normalization — the h2 twin of the tpu_std path.
+    if (cid == cntl->unfinished_cid_) {
+        std::swap(cntl->current_cid_, cntl->unfinished_cid_);
+        std::swap(cntl->current_fly_sid_, cntl->unfinished_fly_sid_);
+        std::swap(cntl->current_server_id_, cntl->unfinished_server_id_);
+    } else if (cntl->unfinished_cid_ != INVALID_CALL_ID) {
+        cntl->backup_won_ = true;
     }
     if (cntl->span_ != nullptr) {
         cntl->span_->received_us = monotonic_time_us();

@@ -64,6 +64,13 @@ public:
     void set_priority(int p) { priority_ = p; }
     int priority() const { return priority_; }
     bool has_priority() const { return priority_ >= 0; }
+    // Sticky-session identity (ISSUE 16): names the client session this
+    // call belongs to, so an L7 front door can pin the whole session to
+    // one backend (rendezvous-hashed) and re-pin it atomically when that
+    // backend drains. Rides the tpu_std request meta / the x-tpu-session
+    // h2+HTTP header; propagates hop-to-hop like tenant/priority.
+    void set_session(const std::string& s) { session_ = s; }
+    const std::string& session() const { return session_; }
     // Server-suggested backoff attached to a TERR_OVERLOAD shed; on the
     // client it steers the retry delay (jittered), on the server the
     // response path copies it into the response meta.
@@ -205,6 +212,22 @@ public:
     EndPoint remote_side() const { return remote_side_; }
     EndPoint local_side() const { return local_side_; }
     int retried_count() const { return current_try_; }
+    // Hedge telemetry (ISSUE 16): whether a backup request actually went
+    // out for this call, and whether the BACKUP try's response completed
+    // the RPC (false when the original outran it, or the backup's
+    // connection died and the call fell back to the original). An L7
+    // router reads these after each forwarded call to account
+    // rpc_router_hedges / rpc_router_hedge_wins without guessing from
+    // global counters.
+    bool backup_issued() const { return backup_issued_; }
+    bool backup_won() const { return backup_won_; }
+    // Combo-channel propagation hook: a SelectiveChannel sub-call runs
+    // the backup machinery on its own sub-controller and mirrors the
+    // telemetry onto the user-visible parent here.
+    void set_backup_telemetry(bool issued, bool won) {
+        backup_issued_ = issued;
+        backup_won_ = won;
+    }
 
     // The correlation id of this RPC (join it to wait for async calls).
     CallId call_id() const { return correlation_id_; }
@@ -304,6 +327,7 @@ public:
     // Arm a backup request for this call at the given delay (overrides
     // ChannelOptions::backup_request_ms; <0 disables).
     void set_backup_request_ms(int64_t ms) { backup_request_ms_ = ms; }
+    int64_t backup_request_ms() const { return backup_request_ms_; }
 
 private:
 
@@ -399,6 +423,14 @@ private:
     TimerId timeout_timer_;
     SocketId single_server_id_;
     SocketId current_server_id_;  // server of the in-flight try (LB mode)
+    // Server of the still-live unfinished try once a backup went out:
+    // FeedbackToLB(0) clears current_server_id_ when the backup issues,
+    // so this keeps the loser's server addressable for the wire CANCEL
+    // at EndRPC, and restores current_server_id_ when the backup's
+    // connection dies and the call falls back to the original.
+    SocketId unfinished_server_id_;
+    bool backup_issued_;  // a backup try actually went out
+    bool backup_won_;     // the backup try's response completed the RPC
     int64_t try_start_us_;        // start of the current try (LB feedback)
     uint64_t request_code_;
     bool has_request_code_;
@@ -407,6 +439,7 @@ private:
     // QoS identity (shared by both sides; see the accessors above).
     std::string tenant_;
     int priority_;  // -1 = unset
+    std::string session_;  // sticky-session id (empty = none)
     int64_t suggested_backoff_ms_;
     // Pooled/short connection of the current try and of the still-live
     // original behind a backup (INVALID in single mode). A socket whose

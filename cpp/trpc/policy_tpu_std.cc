@@ -851,6 +851,7 @@ void ProcessTpuStdRequest(TpuStdMessage* msg, const rpc::RpcMeta& meta) {
     // traffic through the mesh.
     if (req_meta.has_tenant()) cntl->set_tenant(req_meta.tenant());
     cntl->set_priority(priority);
+    if (req_meta.has_session()) cntl->set_session(req_meta.session());
     // Interceptor (reference interceptor.h:30 Interceptor::Accept runs
     // before the service method; rejection answers the error directly).
     if (server->options().interceptor != nullptr) {

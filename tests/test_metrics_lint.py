@@ -279,3 +279,64 @@ def test_metrics_exposition_lint(cpp_build, tmp_path):
             node.proc.kill()
         except OSError:
             pass
+
+
+def test_router_metrics_lint(cpp_build, tmp_path):
+    """ISSUE 16: a live tpu_router node passes the same exposition lint
+    and publishes every rpc_router_* family 0-valued from the very
+    first scrape — dashboards never see a family pop into existence."""
+    import subprocess
+
+    mesh_bin = cpp_build / "mesh_node"
+    router_bin = cpp_build / "tpu_router"
+    assert router_bin.exists(), "tpu_router not built"
+    backend_port, router_port = _free_ports(2)
+    backends_file = tmp_path / "backends"
+    backends_file.write_text("127.0.0.1:%d\n" % backend_port)
+    backend = Node(mesh_bin, backend_port, 0, backends_file,
+                   extra_args=("--lb_only", "--traffic_delay_ms",
+                               "600000"))
+    router = None
+    try:
+        assert backend.wait_ready(), "backend never became ready"
+        router = subprocess.Popen(
+            [str(router_bin), "--port", str(router_port),
+             "--backends", str(backends_file)],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+            stderr=subprocess.DEVNULL)
+        # READY handshake (same stdout contract as mesh_node).
+        deadline = time.time() + 30.0
+        line = b""
+        while not line.startswith(b"READY"):
+            assert time.time() < deadline, "router never became ready"
+            line = router.stdout.readline()
+
+        text = _http_get(router_port, "/metrics")
+        families, errors = _lint_exposition(text)
+        assert not errors, "router lint failed:\n" + "\n".join(errors)
+        for fam in ("rpc_router_forwards", "rpc_router_forward_failures",
+                    "rpc_router_hedges", "rpc_router_hedge_wins",
+                    "rpc_router_reroutes", "rpc_router_session_repins",
+                    "rpc_router_edge_sheds"):
+            assert families.get(fam) == "gauge", (fam, sorted(families))
+            assert re.search(r"^%s \d+$" % fam, text, re.M), fam
+        # The backend-latency recorder exports a real summary family.
+        assert families.get("rpc_router_backend_latency") == "summary", \
+            sorted(families)
+        # /router renders in both forms and the json has the shape the
+        # restart soak polls.
+        state = json.loads(
+            _http_get(router_port, "/router?format=json"))
+        assert isinstance(state["backends"], list) and state["backends"]
+        assert "sessions" in state and "hedges" in state, state
+        assert "router state" in _http_get(router_port, "/router")
+    finally:
+        try:
+            backend.proc.kill()
+        except OSError:
+            pass
+        if router is not None:
+            try:
+                router.kill()
+            except OSError:
+                pass

@@ -1,0 +1,222 @@
+"""Front-door router restart soak (ISSUE 16 capstone).
+
+Six `mesh_node` backends (pure servers: traffic fibers parked) sit
+behind ONE `tpu_router`. A single mixed rpc_press load — two tenants at
+two priorities, four sticky sessions plus sessionless callers — drives
+the router for the whole run while EVERY backend is SIGTERM-restarted
+in sequence (graceful drain: GOAWAY -> serve the window -> exit 0).
+One backend also gets a "delay 80 0" handler sleep so the router's
+30ms hedge floor deterministically fires backup requests to a faster
+peer.
+
+Asserted invariants — the stream-preserving contract:
+  * ZERO failed completions at the press (the client saw nothing), and
+    ZERO forward failures at the router;
+  * ZERO lost sticky sessions: at every /router?format=json poll taken
+    during the restarts, every session maps to exactly one backend and
+    that backend is in the json's own live set;
+  * sessions actually MOVED (session_repins > 0) and the router
+    re-issued around draining backends (hedges observed > 0, with
+    hedge wins);
+  * the retry budget was never exhausted at the router;
+  * descriptor-lease pins drain to 0 by the router's final report;
+  * the router itself drains gracefully: SIGTERM -> DRAINING -> final
+    REPORT -> exit 0.
+"""
+import json
+import signal
+import subprocess
+import time
+
+from test_chaos_soak import Node, _free_ports, _http_get
+
+NUM_BACKENDS = 6
+
+BACKEND_FLAGS = [
+    "ns_health_check_interval_ms=200",
+    "graceful_quit_on_sigterm=true",
+]
+# Backends are pure servers: park the traffic fibers past the test
+# horizon so every observed call came through the router.
+BACKEND_ARGS = ("--lb_only", "--drain_ms", "800",
+                "--traffic_delay_ms", "600000")
+
+PRESS_DURATION_S = 32
+
+
+def _wait_line(node, prefix, timeout):
+    deadline = time.time() + timeout
+    while True:
+        line = node._readline(deadline)
+        if line is None:
+            return None
+        if line.startswith(prefix):
+            return line
+
+
+class Router:
+    def __init__(self, binary, port, backends_file):
+        self.port = port
+        self.proc = subprocess.Popen(
+            [str(binary), "--port", str(port),
+             "--backends", str(backends_file),
+             "--drain_ms", "800",
+             "--hedge_floor_ms", "30",
+             "--probe_interval_ms", "100",
+             "--flag", "graceful_quit_on_sigterm=true",
+             "--flag", "ns_health_check_interval_ms=200",
+             # Hedge provisioning: a front door that hedges a steady
+             # slow-backend stream must budget for it — the default 10%
+             # retry ratio is sized for failure retries, not planned
+             # backups (README "Front door").
+             "--flag", "rpc_retry_budget_ratio=0.5"],
+            stdin=subprocess.PIPE,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.DEVNULL,
+        )
+        self._buf = b""
+
+    # Reuse Node's buffered line reader / READY handshake verbatim.
+    _readline = Node._readline
+    wait_ready = Node.wait_ready
+
+    def state(self):
+        return json.loads(_http_get(self.port, "/router?format=json",
+                                    timeout=2.0))
+
+
+def _assert_sessions_consistent(state, when):
+    """Every pinned session maps to exactly ONE backend, and that
+    backend is live in the SAME snapshot (the atomic-re-pin contract)."""
+    live = {b["endpoint"] for b in state["backends"] if b["live"]}
+    if not live:
+        return  # mid-restart gap with no live backend: nothing to pin to
+    for sid, ep in state["sessions"].items():
+        assert ep in live, (
+            "session %s pinned to non-live backend %s at %s: %r"
+            % (sid, ep, when, state))
+
+
+def test_router_restart_soak(cpp_build, tmp_path):
+    mesh_bin = cpp_build / "mesh_node"
+    router_bin = cpp_build / "tpu_router"
+    press_bin = cpp_build / "rpc_press"
+    for b in (mesh_bin, router_bin, press_bin):
+        assert b.exists(), "%s not built" % b
+
+    ports = _free_ports(NUM_BACKENDS + 1)
+    backend_ports, router_port = ports[:NUM_BACKENDS], ports[NUM_BACKENDS]
+    backends_file = tmp_path / "router_backends"
+    backends_file.write_text(
+        "".join("127.0.0.1:%d\n" % p for p in backend_ports))
+
+    def spawn_backend(i):
+        return Node(mesh_bin, backend_ports[i], i, backends_file,
+                    flags=BACKEND_FLAGS, extra_args=BACKEND_ARGS)
+
+    backends = [spawn_backend(i) for i in range(NUM_BACKENDS)]
+    router = None
+    press = None
+    try:
+        for n in backends:
+            assert n.wait_ready(), "backend %d never became ready" % n.idx
+        router = Router(router_bin, router_port, backends_file)
+        assert router.wait_ready(), "router never became ready"
+        time.sleep(0.5)  # first probe pass marks the backends live
+
+        # One backend serves slowly: with the 30ms hedge floor, every
+        # sessionless call that lands on it overruns the hedge delay and
+        # a backup try fires to a faster peer — deterministic hedging.
+        backends[NUM_BACKENDS - 1].send("delay 80 0")
+
+        press = subprocess.Popen(
+            [str(press_bin),
+             "--via=127.0.0.1:%d" % router_port,
+             "--qps=250", "--duration_s=%d" % PRESS_DURATION_S,
+             "--payload=512", "--callers=8", "--sessions=4",
+             "--tenants=gold:1:7,bronze:1:1",
+             "--timeout_ms=3000", "--max_retry=0", "--json"],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL)
+        time.sleep(2.0)  # sessions pin + hedge model warms under load
+
+        # --- SIGTERM-restart every backend under load -----------------
+        for i in range(NUM_BACKENDS):
+            n = backends[i]
+            n.proc.send_signal(signal.SIGTERM)
+            assert _wait_line(n, "DRAINING", 10.0) is not None, (
+                "backend %d never announced its drain" % i)
+            # While it drains and dies, the sticky invariant must hold
+            # at every observable instant.
+            deadline = time.time() + 6.0
+            exited = False
+            while time.time() < deadline:
+                _assert_sessions_consistent(router.state(),
+                                            "restart of backend %d" % i)
+                if n.proc.poll() is not None:
+                    exited = True
+                    break
+                time.sleep(0.05)
+            if not exited:
+                assert n.proc.wait(timeout=20) is not None
+            assert n.proc.returncode == 0, (
+                "backend %d unclean graceful exit: %d"
+                % (i, n.proc.returncode))
+            backends[i] = spawn_backend(i)
+            assert backends[i].wait_ready(), "backend %d restart failed" % i
+            # Keep the slow-server phase alive across its own restart.
+            if i == NUM_BACKENDS - 1:
+                time.sleep(0.3)
+                backends[i].send("delay 80 0")
+            _assert_sessions_consistent(router.state(),
+                                        "after restart of backend %d" % i)
+            time.sleep(0.5)
+
+        # --- the press finishes; the client saw a flawless service ----
+        out, _ = press.communicate(timeout=PRESS_DURATION_S + 30)
+        assert press.returncode == 0, "rpc_press failed"
+        last = [l for l in out.decode().splitlines()
+                if l.startswith("{")][-1]
+        rep = json.loads(last)
+        assert rep["press_failed"] == 0, (
+            "client-visible failures through the router: %r" % rep)
+        assert rep["press_qps"] > 0, rep
+        assert rep["press_hedges"] > 0, (
+            "router never hedged despite the slow backend: %r" % rep)
+        assert rep["press_via_p99_us"] >= 0, rep
+
+        # --- router's own accounting ----------------------------------
+        state = router.state()
+        _assert_sessions_consistent(state, "end of load")
+        assert state["forward_failures"] == 0, state
+        assert state["forwards"] > 200, state
+        assert state["hedges"] > 0, state
+        assert state["hedge_wins"] > 0, state
+        assert state["session_repins"] > 0, (
+            "no session ever moved across six backend restarts: %r"
+            % state)
+        assert state["budget_exhausted"] == 0, state
+        assert len(state["sessions"]) == 4, state
+
+        # --- the router itself drains gracefully ----------------------
+        router.proc.send_signal(signal.SIGTERM)
+        assert _wait_line(router, "DRAINING", 10.0) is not None, (
+            "router never announced its drain")
+        line = _wait_line(router, "REPORT ", 30.0)
+        assert line is not None, "router produced no exit report"
+        final = json.loads(line[len("REPORT "):])
+        assert final["forward_failures"] == 0, final
+        assert final["budget_exhausted"] == 0, final
+        assert final["pool_pinned"] == 0, (
+            "descriptor-lease pins leaked at router exit: %r" % final)
+        assert router.proc.wait(timeout=30) == 0, "router unclean exit"
+
+        for n in backends:
+            assert n.shutdown() == 0, "backend %d unclean exit" % n.idx
+    finally:
+        for p in [router, press] + backends:
+            if p is None:
+                continue
+            try:
+                p.proc.kill() if hasattr(p, "proc") else p.kill()
+            except OSError:
+                pass

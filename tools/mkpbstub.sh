@@ -511,6 +511,9 @@ public:
     bool has_zone() const { return !zone_.empty(); }
     const std::string& zone() const { return zone_; }
     void set_zone(const std::string& v) { zone_ = v; }
+    bool has_session() const { return !session_.empty(); }
+    const std::string& session() const { return session_; }
+    void set_session(const std::string& v) { session_ = v; }
     bool has_trace_id() const { return has_trace_id_; }
     uint64_t trace_id() const { return trace_id_; }
     void set_trace_id(uint64_t v) {
@@ -549,6 +552,7 @@ public:
         }
         if (!tenant_.empty()) pbstub::wire::put_str(out, 9, tenant_);
         if (!zone_.empty()) pbstub::wire::put_str(out, 10, zone_);
+        if (!session_.empty()) pbstub::wire::put_str(out, 11, session_);
         return true;
     }
     bool ParseFromString(const std::string& s) override {
@@ -569,13 +573,14 @@ public:
                 case 8: parent_span_id_ = v; break;
                 case 9: tenant_ = sub; break;
                 case 10: zone_ = sub; break;
+                case 11: session_ = sub; break;
                 default: break;
             }
         }
         return ok;
     }
 private:
-    std::string service_name_, method_name_, tenant_, zone_;
+    std::string service_name_, method_name_, tenant_, zone_, session_;
     int64_t timeout_ms_ = 0, log_id_ = 0;
     uint64_t trace_id_ = 0, span_id_ = 0, parent_span_id_ = 0;
     int priority_ = 0;

@@ -9,6 +9,17 @@
 //             [--timeout_ms=5000] [--metrics_csv=path] [--tenant=name]
 //             [--priority=0..7]
 //             [--tenants=a:8,b:1 | a:8:7,b:1:1 | a:8:7:128,b:1:1:65536]
+//             [--via=ip:port] [--sessions=N]
+//
+// --via=ROUTER_ADDR (ISSUE 16): drive the load THROUGH a tpu_router
+// front door instead of a backend directly. At the end the tool scrapes
+// the router's /router?format=json and reports the ROUTER-ADDED latency
+// — the client-observed p99 minus the router's backend-measured p99 —
+// plus the router's hedge count (text + `press_via_p99_us` /
+// `press_hedges` in --json). --sessions=N gives the FIRST N callers a
+// sticky session id each ("s0".."s<N-1>", stamped on every request) so
+// one run exercises the router's pinned path AND — from the remaining
+// sessionless callers — its hedged path.
 //
 // --pool_desc (ISSUE 10 satellite, mirrors echo_bench --pool-desc):
 // connect over the shm-ICI link (IciBlockPool + Channel::InitIci) and
@@ -46,7 +57,10 @@
 // as CSV (elapsed_s,qps,p50_us,p99_us,p999_us,failed_total,tenant) —
 // the BENCH trajectory input. Prints qps achieved + latency percentiles
 // at the end; --json for one JSON line.
+#include <netinet/in.h>
+#include <poll.h>
 #include <signal.h>
+#include <sys/socket.h>
 #include <unistd.h>
 
 #include <atomic>
@@ -81,6 +95,52 @@ int64_t VarInt(const char* name) {
     return atoll(v.c_str());
 }
 
+// Minimal blocking HTTP/1.1 GET against the router portal (--via): one
+// scrape at end-of-run, so a plain blocking socket with a deadline is
+// plenty — no reason to drag the RPC stack into reading its own proxy.
+bool PortalGet(const EndPoint& ep, const std::string& path,
+               std::string* body) {
+    const int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (fd < 0) return false;
+    timeval tv{2, 0};
+    setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+    setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
+    sockaddr_in addr;
+    endpoint2sockaddr(ep, &addr);
+    if (::connect(fd, (sockaddr*)&addr, sizeof(addr)) != 0) {
+        ::close(fd);
+        return false;
+    }
+    const std::string req = "GET " + path +
+                            " HTTP/1.1\r\nHost: router\r\n"
+                            "Connection: close\r\n\r\n";
+    if (::send(fd, req.data(), req.size(), 0) != (ssize_t)req.size()) {
+        ::close(fd);
+        return false;
+    }
+    std::string raw;
+    char chunk[4096];
+    for (;;) {
+        const ssize_t n = ::recv(fd, chunk, sizeof(chunk), 0);
+        if (n <= 0) break;
+        raw.append(chunk, (size_t)n);
+    }
+    ::close(fd);
+    const size_t hdr_end = raw.find("\r\n\r\n");
+    if (hdr_end == std::string::npos) return false;
+    body->assign(raw, hdr_end + 4, std::string::npos);
+    return !body->empty();
+}
+
+// Pull `"key": <int>` out of the /router json — the two fields we read
+// are flat integers, so a substring scan beats a JSON parser here.
+int64_t JsonIntField(const std::string& body, const char* key) {
+    const std::string needle = std::string("\"") + key + "\":";
+    const size_t pos = body.find(needle);
+    if (pos == std::string::npos) return -1;
+    return atoll(body.c_str() + pos + needle.size());
+}
+
 // One traffic class of the generator: its own pacing bucket and stats,
 // so per-tenant isolation is measurable from the CLIENT side too. A
 // per-tenant payload override (the 4th --tenants spec field, ISSUE 15)
@@ -113,6 +173,7 @@ struct PressCtx {
     std::atomic<bool>* stop;
     int64_t timeout_ms;
     bool pool_desc = false;
+    std::string session;  // --sessions: sticky id stamped on every call
 };
 
 // Ctrl-C / SIGINT: finish the current interval cleanly — flush the final
@@ -136,6 +197,7 @@ void* PressCaller(void* arg) {
         cntl.set_timeout_ms(c->timeout_ms);
         if (!g->name.empty()) cntl.set_tenant(g->name);
         if (g->priority >= 0) cntl.set_priority(g->priority);
+        if (!c->session.empty()) cntl.set_session(c->session);
         benchpb::EchoRequest req;
         benchpb::EchoResponse res;
         req.set_send_ts_us(monotonic_time_us());
@@ -232,6 +294,8 @@ int main(int argc, char** argv) {
     std::string tenant;
     std::string zone;       // --zone: this generator's pod (ISSUE 14)
     std::string dcn_peers;  // --dcn_peers=h:p[,h:p]: cross-pod servers
+    std::string via_str;    // --via: a tpu_router front door (ISSUE 16)
+    int sessions = 0;       // --sessions: sticky ids stamped per caller
     int priority = -1;
     int max_retry = -1;  // <0 = channel default (3)
     for (int i = 1; i < argc; ++i) {
@@ -242,6 +306,13 @@ int main(int argc, char** argv) {
             press_threads = atoi(argv[i] + 16);
         }
         if (strncmp(argv[i], "--server=", 9) == 0) server_str = argv[i] + 9;
+        if (strncmp(argv[i], "--via=", 6) == 0) {
+            via_str = argv[i] + 6;
+            server_str = via_str;  // the router IS the target
+        }
+        if (strncmp(argv[i], "--sessions=", 11) == 0) {
+            sessions = atoi(argv[i] + 11);
+        }
         if (strncmp(argv[i], "--qps=", 6) == 0) qps = atoll(argv[i] + 6);
         if (strncmp(argv[i], "--timeout_ms=", 13) == 0) {
             timeout_ms = atoll(argv[i] + 13);
@@ -297,7 +368,8 @@ int main(int argc, char** argv) {
                 "[--timeout_ms=N] [--body_bytes=N (alias: --payload)] "
                 "[--max_retry=N] [--tenant=NAME] [--priority=0..7] "
                 "[--tenants=name:weight[:prio[:payload_bytes]],...] "
-                "[--zone=NAME] [--dcn_peers=ip:port,...] [--json]\n"
+                "[--zone=NAME] [--dcn_peers=ip:port,...] "
+                "[--via=ip:port] [--sessions=N] [--json]\n"
                 "  --zone/--dcn_peers: zone-aware LB over the local "
                 "server + cross-pod dcn-tier peers; per-zone picks and "
                 "spills are reported\n");
@@ -446,7 +518,10 @@ int main(int argc, char** argv) {
     for (int i = 0; i < callers; ++i) {
         ctxs.push_back(PressCtx{stubs[(size_t)(i % press_threads)].get(),
                                 assignment[(size_t)i], &stop,
-                                timeout_ms, pool_desc});
+                                timeout_ms, pool_desc,
+                                i < sessions
+                                    ? "s" + std::to_string(i)
+                                    : std::string()});
     }
     std::vector<fiber_t> tids((size_t)callers);
     for (size_t i = 0; i < tids.size(); ++i) {
@@ -573,6 +648,25 @@ int main(int argc, char** argv) {
     for (auto& g : gens) {
         if (g->lat.count() > head->lat.count()) head = g.get();
     }
+    // --via: one scrape of the router's own view — backend-measured p99
+    // and the hedge count — then the router-added latency is simply
+    // client-observed p99 minus what the backends took.
+    int64_t via_backend_p99 = -1, via_hedges = -1, via_added_p99 = -1;
+    if (!via_str.empty()) {
+        std::string rj;
+        if (PortalGet(server, "/router?format=json", &rj)) {
+            via_backend_p99 = JsonIntField(rj, "backend_p99_us");
+            via_hedges = JsonIntField(rj, "hedges");
+            const int64_t client_p99 = head->lat.latency_percentile(0.99);
+            if (via_backend_p99 >= 0 && client_p99 > 0) {
+                via_added_p99 =
+                    std::max<int64_t>(0, client_p99 - via_backend_p99);
+            }
+        } else {
+            fprintf(stderr, "--via: scrape of %s/router failed\n",
+                    via_str.c_str());
+        }
+    }
     if (json) {
         // Generator config rides along so BENCH records are
         // reproducible: the same qps from 1 vs 16 connections stresses
@@ -592,6 +686,13 @@ int main(int argc, char** argv) {
                (long long)head->lat.latency_percentile(0.999),
                press_threads, callers, payload, pooled ? 1 : 0,
                pool_desc ? 1 : 0, (long long)total_stale);
+        if (!via_str.empty()) {
+            printf(", \"press_via_p99_us\": %lld, "
+                   "\"press_via_backend_p99_us\": %lld, "
+                   "\"press_hedges\": %lld, \"press_sessions\": %d",
+                   (long long)via_added_p99, (long long)via_backend_p99,
+                   (long long)via_hedges, sessions);
+        }
         if (!lb_url.empty()) {
             printf(", \"press_zone\": \"%s\", "
                    "\"press_zone_local_picks\": %lld, "
@@ -639,6 +740,14 @@ int main(int argc, char** argv) {
                (long long)head->lat.latency_percentile(0.99),
                (long long)head->lat.latency_percentile(0.999),
                (long long)head->lat.max_latency());
+        if (!via_str.empty()) {
+            printf("via router %s: client p99 %lldus, backend p99 "
+                   "%lldus, router-added p99 %lldus, hedges %lld\n",
+                   via_str.c_str(),
+                   (long long)head->lat.latency_percentile(0.99),
+                   (long long)via_backend_p99, (long long)via_added_p99,
+                   (long long)via_hedges);
+        }
         if (!lb_url.empty()) {
             printf("zone %s: local_picks %lld  spills %lld  "
                    "dcn_out_bytes %lld\n",

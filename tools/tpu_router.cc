@@ -1,0 +1,708 @@
+// tpu_router: the L7 front door (ISSUE 16). One standalone node that
+// stands between clients and the mesh:
+//
+//  - terminates client connections exactly like any serving node (a
+//    normal Server: tpu_std + gRPC/h2 + HTTP json doors, the whole
+//    builtin portal) — PR-15 edge admission runs HERE when the QoS
+//    flags are on (-rpc_qos_enabled, -rpc_tenant_quotas, ...), so a
+//    tenant flood is priced and shed before it consumes any mesh
+//    bandwidth, and shed verdicts carry backoff hints to clients;
+//  - forwards each Echo call over the mesh from INSIDE the handler, so
+//    deadline / tenant / priority / session / trace context and the
+//    cancel cascade all inherit hop-to-hop (PR 2/3/7/16 plumbing):
+//      * sessionless calls ride a SelectiveChannel wrapping the
+//        zone-aware LB + deterministic-subsetting stack (PR 14) over
+//        file://backends, with HEDGED (backup) requests: after a
+//        per-(tenant,method) adaptive delay (p99-derived EWMA with a
+//        --hedge_floor_ms floor) a second try goes to a DIFFERENT
+//        backend (ExcludedServers), first answer wins, the loser is
+//        wire-canceled and its descriptor leases acked (EndRPC).
+//        Hedges spend retry budget, and a TERR_OVERLOAD verdict from
+//        the mesh disables hedging for the suggested-backoff window —
+//        hedging can never amplify an overload;
+//      * sticky-session calls (x-tpu-session / request-meta session)
+//        are pinned to ONE backend by rendezvous hash over the live
+//        set; the pin re-assigns ATOMICALLY (one mutex, observable via
+//        /router?format=json) when that backend drains or dies, and a
+//        call that lands in the dead window reroutes mid-flight.
+//
+// Rolling restarts behind the router are client-invisible: the probe
+// fiber watches each backend's shared connection for the drain GOAWAY
+// (Socket::Draining) and for death, moves the pinned sessions, and the
+// LB plane steers sessionless traffic away on its own. The router
+// itself drains gracefully on SIGTERM (announce, serve the window,
+// GracefulStop, REPORT, exit 0) like every mesh node.
+//
+// stdin protocol (test_router_restart_soak.py): "report\n" prints one
+// "REPORT {json}" line; EOF shuts down (exit 0 after a clean quiesce).
+#include <signal.h>
+#include <sys/prctl.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+#include "bench_echo.pb.h"
+#include "tbase/endpoint.h"
+#include "tbase/errno.h"
+#include "tbase/flags.h"
+#include "tbase/logging.h"
+#include "tbase/time.h"
+#include "tfiber/fiber.h"
+#include "thttp/http_message.h"
+#include "tici/block_lease.h"
+#include "tnet/socket.h"
+#include "tnet/socket_map.h"
+#include "trpc/channel.h"
+#include "trpc/combo_channels.h"
+#include "trpc/controller.h"
+#include "trpc/naming_service.h"
+#include "trpc/qos.h"
+#include "trpc/server.h"
+#include "tvar/latency_recorder.h"
+#include "tvar/reducer.h"
+#include "tvar/variable.h"
+
+using namespace tpurpc;
+
+namespace {
+
+// ---- observability (satellite 3): the rpc_router_* families ----
+LazyAdder g_forwards("rpc_router_forwards");
+LazyAdder g_forward_failures("rpc_router_forward_failures");
+LazyAdder g_hedges("rpc_router_hedges");
+LazyAdder g_hedge_wins("rpc_router_hedge_wins");
+LazyAdder g_reroutes("rpc_router_reroutes");
+LazyAdder g_session_repins("rpc_router_session_repins");
+LazyAdder g_edge_sheds("rpc_router_edge_sheds");
+// Backend-measured forwarding latency (the mesh-side time of each
+// forwarded call): rpc_press --via subtracts its client-side p99 from
+// this family's p99 to report the router-added latency.
+LatencyRecorder g_downstream_latency;
+
+int64_t VarInt(const char* name) {
+    std::string v;
+    if (!Variable::describe_exposed(name, &v)) return 0;
+    return atoll(v.c_str());
+}
+
+// ---- adaptive hedge delay (per tenant+method) ----
+// p99-derived EWMA: each completed un-hedged forward feeds the key's
+// windowed p99 into an EWMA (alpha 1/8); the hedge delay is that EWMA
+// (scaled by --hedge_mult_pct) floored at --hedge_floor_ms. With no
+// samples yet the floor alone drives — a cold router hedges only calls
+// that are already slower than the floor.
+int g_hedge_floor_ms = 5;
+int g_hedge_mult_pct = 100;  // % of the p99 EWMA
+bool g_hedge_enabled = true;
+
+struct HedgeKeyState {
+    LatencyRecorder rec;  // hidden (never exposed): windowed p99 source
+    std::atomic<int64_t> ewma_p99_us{0};
+};
+
+std::mutex g_hedge_mu;
+std::unordered_map<std::string, std::unique_ptr<HedgeKeyState>> g_hedge;
+
+// Overload backpressure: while the mesh sheds (TERR_OVERLOAD seen on a
+// forward), hedging is OFF — a hedge is a re-issue, and re-issues are
+// exactly what an overloaded fleet cannot absorb.
+std::atomic<int64_t> g_hedge_hold_until_us{0};
+
+HedgeKeyState* HedgeStateFor(const std::string& key) {
+    std::lock_guard<std::mutex> g(g_hedge_mu);
+    auto& slot = g_hedge[key];
+    if (slot == nullptr) slot.reset(new HedgeKeyState);
+    return slot.get();
+}
+
+int64_t HedgeDelayMs(HedgeKeyState* hs) {
+    if (!g_hedge_enabled) return -1;
+    if (monotonic_time_us() <
+        g_hedge_hold_until_us.load(std::memory_order_relaxed)) {
+        return -1;  // overload hold window: hedging disabled
+    }
+    const int64_t ewma_us = hs->ewma_p99_us.load(std::memory_order_relaxed);
+    const int64_t derived_ms = ewma_us * g_hedge_mult_pct / 100 / 1000;
+    return derived_ms > g_hedge_floor_ms ? derived_ms : g_hedge_floor_ms;
+}
+
+void FeedHedgeSample(HedgeKeyState* hs, int64_t latency_us) {
+    hs->rec << latency_us;
+    const int64_t p99 = hs->rec.latency_percentile(0.99);
+    if (p99 <= 0) return;
+    const int64_t prev = hs->ewma_p99_us.load(std::memory_order_relaxed);
+    hs->ewma_p99_us.store(prev == 0 ? p99 : (prev * 7 + p99) / 8,
+                          std::memory_order_relaxed);
+}
+
+// ---- backend table + sticky-session pinning ----
+
+struct Backend {
+    EndPoint ep;
+    std::string key;  // "ip:port" — the rendezvous hash input
+    std::unique_ptr<Channel> ch;  // single-server (SocketMap revives it)
+    // Pinnable = last probe answered AND the shared connection has not
+    // seen the drain GOAWAY. Written by the probe fiber and the sticky
+    // failure path; read under g_sticky_mu for atomic re-pins.
+    std::atomic<bool> live{false};
+    std::atomic<bool> draining{false};
+};
+
+std::vector<std::unique_ptr<Backend>> g_backends;
+
+// One mutex guards the session map AND every read of the live set used
+// for (re-)pinning, so an observer of /router?format=json can never see
+// a session pinned to zero or two live backends mid-transition.
+std::mutex g_sticky_mu;
+std::unordered_map<std::string, int> g_session_pin;  // session -> index
+
+uint64_t Fnv1a64(const std::string& s) {
+    uint64_t h = 1469598103934665603ULL;
+    for (unsigned char c : s) {
+        h ^= c;
+        h *= 1099511628211ULL;
+    }
+    return h;
+}
+
+bool Pinnable(const Backend& b) {
+    return b.live.load(std::memory_order_acquire) &&
+           !b.draining.load(std::memory_order_acquire);
+}
+
+// Pick (or keep) the backend for `session`. Returns the index, or -1
+// when no backend is pinnable. Runs under g_sticky_mu.
+int PinLocked(const std::string& session) {
+    auto it = g_session_pin.find(session);
+    if (it != g_session_pin.end() && Pinnable(*g_backends[it->second])) {
+        return it->second;
+    }
+    std::vector<std::string> keys;
+    std::vector<int> idx;
+    for (size_t i = 0; i < g_backends.size(); ++i) {
+        if (Pinnable(*g_backends[i])) {
+            keys.push_back(g_backends[i]->key);
+            idx.push_back((int)i);
+        }
+    }
+    if (keys.empty()) return -1;
+    // Rendezvous (HRW) over the LIVE set: stable under churn — only the
+    // sessions of the departed backend move, everyone else stays put.
+    const int pick = idx[RendezvousSubset(Fnv1a64(session), keys, 1)[0]];
+    if (it != g_session_pin.end()) {
+        if (it->second != pick) {
+            it->second = pick;
+            *g_session_repins << 1;
+        }
+    } else {
+        g_session_pin.emplace(session, pick);  // initial pin, not a repin
+    }
+    return pick;
+}
+
+int PinForSession(const std::string& session) {
+    std::lock_guard<std::mutex> g(g_sticky_mu);
+    return PinLocked(session);
+}
+
+// Flip a backend's health AND move its pinned sessions in ONE critical
+// section (the whole point of the one-mutex design): a /router snapshot
+// — which renders the live set and the session map under the same lock
+// — can never see a session pinned to a backend that the very same
+// snapshot reports dead.
+void SetHealthAndRepin(int idx, bool live, bool draining) {
+    std::lock_guard<std::mutex> g(g_sticky_mu);
+    Backend* b = g_backends[idx].get();
+    const bool was = Pinnable(*b);
+    b->draining.store(draining, std::memory_order_release);
+    b->live.store(live, std::memory_order_release);
+    if (was && !Pinnable(*b)) {
+        for (auto& kv : g_session_pin) {
+            if (kv.second == idx) PinLocked(kv.first);
+        }
+    }
+}
+
+// ---- forwarding fabric ----
+
+// Sessionless path: SelectiveChannel -> one zone-aware LB channel over
+// file://backends. The LB skips draining/broken servers on its own;
+// cross-channel hops (TERR_DRAINING budget-free) ride the Selective
+// retry driver; hedges ride the inner channel's backup machinery.
+SelectiveChannel g_select;
+std::unique_ptr<Channel> g_lb_channel;
+
+bool SessionRetryable(int err) {
+    // Errors that prove the pinned backend is gone/refusing — the call
+    // was not processed, so a re-pin + re-issue is safe. Deliberately
+    // excludes timeouts (the backend may have executed the handler).
+    switch (err) {
+        case TERR_FAILED_SOCKET:
+        case TERR_EOF:
+        case TERR_DRAINING:
+        case ECONNREFUSED:
+        case ECONNRESET:
+        case EPIPE:
+        case EHOSTDOWN:
+            return true;
+        default:
+            return false;
+    }
+}
+
+void CopyEchoResponse(Controller* up, Controller* down,
+                      const benchpb::EchoResponse& dres,
+                      benchpb::EchoResponse* response) {
+    response->set_send_ts_us(dres.send_ts_us());
+    if (!dres.payload().empty()) response->set_payload(dres.payload());
+    if (!down->response_attachment().empty()) {
+        up->response_attachment().swap(down->response_attachment());
+    }
+}
+
+void FailUpstream(Controller* up, Controller* down) {
+    *g_forward_failures << 1;
+    if (down->ErrorCode() == TERR_OVERLOAD) {
+        // Mesh overload: hold hedging for the backoff window, and hand
+        // the hint to OUR client (the response meta carries it).
+        const int64_t backoff =
+            down->suggested_backoff_ms() > 0 ? down->suggested_backoff_ms()
+                                             : 200;
+        g_hedge_hold_until_us.store(monotonic_time_us() + backoff * 1000,
+                                    std::memory_order_relaxed);
+        up->set_suggested_backoff_ms(backoff);
+    }
+    up->SetFailed(down->ErrorCode(), "router->backend: %s",
+                  down->ErrorText().c_str());
+}
+
+class RouterEchoService : public benchpb::EchoService {
+public:
+    void Echo(google::protobuf::RpcController* cntl_base,
+              const benchpb::EchoRequest* request,
+              benchpb::EchoResponse* response,
+              google::protobuf::Closure* done) override {
+        Controller* cntl = static_cast<Controller*>(cntl_base);
+        *g_forwards << 1;
+        // The downstream call is issued INSIDE this handler, so the
+        // whole context inherits through the fiber-local server call:
+        // deadline cap, tenant/priority/session, trace parenting and
+        // the cancel cascade (Channel::CallMethod / combo inheritance).
+        if (!cntl->session().empty()) {
+            ForwardSticky(cntl, request, response);
+        } else {
+            ForwardHedged(cntl, request, response);
+        }
+        done->Run();
+    }
+
+private:
+    static void ForwardHedged(Controller* cntl,
+                              const benchpb::EchoRequest* request,
+                              benchpb::EchoResponse* response) {
+        HedgeKeyState* hs = HedgeStateFor(cntl->tenant() + "/Echo");
+        Controller dcntl;
+        dcntl.set_max_retry(2);
+        dcntl.set_backup_request_ms(HedgeDelayMs(hs));  // -1 = disabled
+        dcntl.request_attachment() = cntl->request_attachment();
+        benchpb::EchoResponse dres;
+        benchpb::EchoService_Stub stub(&g_select);
+        const int64_t t0 = monotonic_time_us();
+        stub.Echo(&dcntl, request, &dres, nullptr);  // sync
+        const int64_t elapsed = monotonic_time_us() - t0;
+        if (dcntl.backup_issued()) {
+            *g_hedges << 1;
+            if (dcntl.backup_won()) *g_hedge_wins << 1;
+        } else if (!dcntl.Failed()) {
+            // Only clean un-hedged completions teach the delay model —
+            // a hedge-truncated latency would drag the p99 down and
+            // make hedging self-amplifying.
+            FeedHedgeSample(hs, elapsed);
+        }
+        if (dcntl.Failed()) {
+            FailUpstream(cntl, &dcntl);
+            return;
+        }
+        g_downstream_latency << elapsed;
+        CopyEchoResponse(cntl, &dcntl, dres, response);
+    }
+
+    static void ForwardSticky(Controller* cntl,
+                              const benchpb::EchoRequest* request,
+                              benchpb::EchoResponse* response) {
+        int attempts = 0;
+        int last_idx = -1;
+        while (true) {
+            const int idx = PinForSession(cntl->session());
+            if (idx < 0) {
+                *g_forward_failures << 1;
+                cntl->SetFailed(EHOSTDOWN, "no live backend for session %s",
+                                cntl->session().c_str());
+                return;
+            }
+            Backend* b = g_backends[idx].get();
+            Controller dcntl;
+            dcntl.set_max_retry(0);  // the router drives its own re-pin
+            dcntl.request_attachment() = cntl->request_attachment();
+            benchpb::EchoResponse dres;
+            benchpb::EchoService_Stub stub(b->ch.get());
+            const int64_t t0 = monotonic_time_us();
+            stub.Echo(&dcntl, request, &dres, nullptr);  // sync
+            if (!dcntl.Failed()) {
+                if (last_idx >= 0 && last_idx != idx) *g_reroutes << 1;
+                g_downstream_latency << monotonic_time_us() - t0;
+                CopyEchoResponse(cntl, &dcntl, dres, response);
+                return;
+            }
+            if (++attempts > 4 || !SessionRetryable(dcntl.ErrorCode())) {
+                FailUpstream(cntl, &dcntl);
+                return;
+            }
+            // The pinned backend is provably not serving: demote it
+            // (moving its sessions atomically), then go around — the
+            // next PinForSession picks the re-pinned target.
+            SetHealthAndRepin(idx, /*live=*/false,
+                              b->draining.load(std::memory_order_acquire));
+            last_idx = idx;
+        }
+    }
+};
+
+// ---- backend probing + session maintenance ----
+
+int g_probe_interval_ms = 150;
+std::atomic<bool> g_stop{false};
+std::atomic<bool> g_watcher_stop{false};
+
+// Mirrored shed count: edge admission runs inside the Server/QoS tier
+// (cost quotas + queue sheds); the router republishes those verdicts as
+// one rpc_router_edge_sheds family. rpc_server_cost_shed counts COST
+// MILLI-UNITS (qos.h kCostUnitMilli = 1000 per request-unit).
+int64_t g_last_shed_mirror = 0;
+
+int64_t EdgeShedSourceNow() {
+    return VarInt("rpc_server_overload_sheds") +
+           VarInt("rpc_server_cost_shed") / 1000;
+}
+
+void* ProbeFiber(void*) {
+    benchpb::EchoRequest preq;
+    while (!g_stop.load(std::memory_order_acquire)) {
+        for (size_t i = 0; i < g_backends.size(); ++i) {
+            Backend* b = g_backends[i].get();
+            Controller pc;
+            pc.set_timeout_ms(g_probe_interval_ms);
+            pc.set_max_retry(0);
+            preq.set_send_ts_us(monotonic_time_us());
+            benchpb::EchoResponse pres;
+            benchpb::EchoService_Stub stub(b->ch.get());
+            stub.Echo(&pc, &preq, &pres, nullptr);
+            const bool up = !pc.Failed();
+            // Drain detection: the backend's StartDraining GOAWAY marks
+            // the shared SocketMap connection (policy_tpu_std). A
+            // draining backend still SERVES (in-flight sticky calls
+            // finish) but must lose its pins now, not at exit.
+            bool draining = false;
+            SocketId sid;
+            if (up && SocketMap::singleton()->GetOrCreate(
+                          b->ep, Channel::client_messenger(), &sid) == 0) {
+                SocketUniquePtr s;
+                if (Socket::AddressSocket(sid, &s) == 0) {
+                    draining = s->Draining();
+                }
+            }
+            SetHealthAndRepin((int)i, up, draining);
+        }
+        const int64_t shed_now = EdgeShedSourceNow();
+        if (shed_now > g_last_shed_mirror) {
+            *g_edge_sheds << (shed_now - g_last_shed_mirror);
+            g_last_shed_mirror = shed_now;
+        }
+        fiber_usleep((int64_t)g_probe_interval_ms * 1000);
+    }
+    return nullptr;
+}
+
+// ---- /router portal page (+json) and the REPORT line ----
+
+void RouterStateJson(std::string* out) {
+    char buf[256];
+    // Live set and session map render under ONE g_sticky_mu hold, the
+    // same lock every health flip + re-pin runs under: each snapshot is
+    // a consistent cut — a session can never appear pinned to a backend
+    // the same snapshot calls dead (the soak polls exactly this).
+    std::unique_lock<std::mutex> lk(g_sticky_mu);
+    out->append("{\"backends\": [");
+    for (size_t i = 0; i < g_backends.size(); ++i) {
+        const Backend& b = *g_backends[i];
+        snprintf(buf, sizeof(buf),
+                 "%s{\"endpoint\": \"%s\", \"live\": %d, \"draining\": %d}",
+                 i == 0 ? "" : ", ", b.key.c_str(), Pinnable(b) ? 1 : 0,
+                 b.draining.load(std::memory_order_acquire) ? 1 : 0);
+        out->append(buf);
+    }
+    out->append("], \"sessions\": {");
+    {
+        bool first = true;
+        for (const auto& kv : g_session_pin) {
+            snprintf(buf, sizeof(buf), "%s\"%s\": \"%s\"",
+                     first ? "" : ", ", kv.first.c_str(),
+                     g_backends[kv.second]->key.c_str());
+            out->append(buf);
+            first = false;
+        }
+    }
+    lk.unlock();
+    snprintf(
+        buf, sizeof(buf),
+        "}, \"forwards\": %lld, \"forward_failures\": %lld, "
+        "\"hedges\": %lld, \"hedge_wins\": %lld, \"reroutes\": %lld, "
+        "\"session_repins\": %lld, \"edge_sheds\": %lld, ",
+        (long long)VarInt("rpc_router_forwards"),
+        (long long)VarInt("rpc_router_forward_failures"),
+        (long long)VarInt("rpc_router_hedges"),
+        (long long)VarInt("rpc_router_hedge_wins"),
+        (long long)VarInt("rpc_router_reroutes"),
+        (long long)VarInt("rpc_router_session_repins"),
+        (long long)VarInt("rpc_router_edge_sheds"));
+    out->append(buf);
+    snprintf(buf, sizeof(buf),
+             "\"backend_p99_us\": %lld, \"backend_avg_us\": %lld, "
+             "\"budget_exhausted\": %lld, \"backup_requests\": %lld}",
+             (long long)g_downstream_latency.latency_percentile(0.99),
+             (long long)g_downstream_latency.latency(),
+             (long long)VarInt("rpc_retry_budget_exhausted"),
+             (long long)VarInt("rpc_client_backup_requests"));
+    out->append(buf);
+}
+
+void RouterPage(Server*, const HttpRequest& req, HttpResponse* res) {
+    std::string json;
+    RouterStateJson(&json);
+    if (req.QueryParam("format") == "json") {
+        res->set_content_type("application/json");
+        res->Append(json);
+        res->Append("\n");
+        return;
+    }
+    res->set_content_type("text/plain");
+    res->Append("router state (append ?format=json for the raw object)\n\n");
+    res->Append(json);
+    res->Append("\n");
+}
+
+void PrintReport() {
+    std::string json;
+    RouterStateJson(&json);
+    // Splice the process-level tail the soak asserts on (pins must
+    // drain to 0 by exit) into the same REPORT object.
+    json.pop_back();  // trailing '}'
+    char buf[128];
+    snprintf(buf, sizeof(buf), ", \"pool_pinned\": %lld}",
+             (long long)block_lease::pinned());
+    json.append(buf);
+    printf("REPORT %s\n", json.c_str());
+    fflush(stdout);
+}
+
+// SIGTERM watcher (the -graceful_quit_on_sigterm wiring; same shape as
+// mesh_node): announce the drain, serve through the window so clients
+// steer away, then stop, report, exit 0.
+struct QuitWatchArgs {
+    Server* server;
+    int drain_ms;
+};
+
+void* GracefulQuitWatcher(void* arg) {
+    std::unique_ptr<QuitWatchArgs> a((QuitWatchArgs*)arg);
+    bool announced = false;
+    while (!IsAskedToQuit()) {
+        if (g_watcher_stop.load(std::memory_order_acquire)) return nullptr;
+        if (!announced && IsAskedToDrain()) {
+            a->server->StartDraining();
+            announced = true;
+            printf("DRAINING\n");
+            fflush(stdout);
+        }
+        fiber_usleep(20 * 1000);
+    }
+    a->server->StartDraining();
+    if (!announced) {
+        printf("DRAINING\n");
+        fflush(stdout);
+    }
+    fiber_usleep((int64_t)a->drain_ms * 1000);
+    g_stop.store(true, std::memory_order_release);
+    a->server->GracefulStop(2000);
+    PrintReport();
+    fflush(nullptr);
+    _exit(0);
+    return nullptr;
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+    prctl(PR_SET_PDEATHSIG, SIGKILL);  // die with the driving pytest
+    int port = 0;
+    int drain_ms = 800;
+    const char* backends_file = nullptr;
+    for (int i = 1; i < argc; ++i) {
+        if (strcmp(argv[i], "--port") == 0 && i + 1 < argc) {
+            port = atoi(argv[++i]);
+        } else if (strcmp(argv[i], "--backends") == 0 && i + 1 < argc) {
+            backends_file = argv[++i];
+        } else if (strcmp(argv[i], "--drain_ms") == 0 && i + 1 < argc) {
+            drain_ms = atoi(argv[++i]);
+        } else if (strcmp(argv[i], "--hedge_floor_ms") == 0 && i + 1 < argc) {
+            g_hedge_floor_ms = atoi(argv[++i]);
+        } else if (strcmp(argv[i], "--hedge_mult_pct") == 0 && i + 1 < argc) {
+            g_hedge_mult_pct = atoi(argv[++i]);
+        } else if (strcmp(argv[i], "--no_hedge") == 0) {
+            g_hedge_enabled = false;
+        } else if (strcmp(argv[i], "--probe_interval_ms") == 0 &&
+                   i + 1 < argc) {
+            g_probe_interval_ms = atoi(argv[++i]);
+        } else if (strcmp(argv[i], "--zone") == 0 && i + 1 < argc) {
+            SetFlagValue("rpc_zone", argv[++i]);
+        } else if (strcmp(argv[i], "--flag") == 0 && i + 1 < argc) {
+            std::string kv = argv[++i];
+            const size_t eq = kv.find('=');
+            if (eq == std::string::npos ||
+                !SetFlagValue(kv.substr(0, eq), kv.substr(eq + 1))) {
+                fprintf(stderr, "bad --flag %s\n", kv.c_str());
+                return 2;
+            }
+        }
+    }
+    if (port <= 0 || backends_file == nullptr) {
+        fprintf(stderr,
+                "usage: tpu_router --port N --backends FILE [--drain_ms N] "
+                "[--hedge_floor_ms N] [--hedge_mult_pct N] [--no_hedge] "
+                "[--probe_interval_ms N] [--zone NAME] [--flag name=value]"
+                "...\n"
+                "  with --flag graceful_quit_on_sigterm=true: SIGTERM "
+                "drains gracefully and exits 0\n");
+        return 2;
+    }
+
+    // Backend table from the naming file (same format the LB resolves).
+    {
+        FILE* f = fopen(backends_file, "r");
+        if (f == nullptr) {
+            fprintf(stderr, "cannot read %s\n", backends_file);
+            return 1;
+        }
+        char line[128];
+        while (fgets(line, sizeof(line), f) != nullptr) {
+            NSNode node;
+            if (ParseNamingLine(line, &node) != 0) continue;
+            auto b = std::make_unique<Backend>();
+            b->ep = node.ep;
+            b->key = endpoint2str(node.ep);
+            b->ch.reset(new Channel);
+            ChannelOptions copts;
+            copts.timeout_ms = 2000;  // capped at the inherited budget
+            copts.max_retry = 0;
+            if (b->ch->Init(b->ep, &copts) != 0) {
+                fprintf(stderr, "backend channel init failed for %s\n",
+                        b->key.c_str());
+                fclose(f);
+                return 1;
+            }
+            g_backends.push_back(std::move(b));
+        }
+        fclose(f);
+    }
+    if (g_backends.empty()) {
+        fprintf(stderr, "no backends in %s\n", backends_file);
+        return 1;
+    }
+
+    // The sessionless fabric: zone-aware LB (+ subsetting flags) over
+    // the shared naming file, wrapped in the Selective retry driver.
+    g_lb_channel.reset(new Channel);
+    {
+        ChannelOptions lopts;
+        lopts.timeout_ms = 2000;
+        lopts.max_retry = 2;
+        const std::string url = std::string("file://") + backends_file;
+        if (g_lb_channel->Init(url.c_str(), "rr", &lopts) != 0) {
+            fprintf(stderr, "LB channel init failed for %s\n", url.c_str());
+            return 1;
+        }
+    }
+    if (g_select.AddChannel(g_lb_channel.get()) != 0) return 1;
+
+    // Eager-expose every router family so the FIRST scrape already
+    // carries 0-valued counters (metrics-lint contract).
+    *g_forwards << 0;
+    *g_forward_failures << 0;
+    *g_hedges << 0;
+    *g_hedge_wins << 0;
+    *g_reroutes << 0;
+    *g_session_repins << 0;
+    *g_edge_sheds << 0;
+    g_downstream_latency.expose("rpc_router_backend_latency");
+    g_last_shed_mirror = EdgeShedSourceNow();
+
+    static RouterEchoService service;
+    static Server server;
+    if (server.AddService(&service) != 0) return 1;
+    server.RegisterHttpHandler(
+        "/router", [](Server* s, const HttpRequest& req, HttpResponse* res) {
+            RouterPage(s, req, res);
+        });
+    EndPoint listen;
+    str2endpoint("127.0.0.1", port, &listen);
+    if (server.Start(listen, nullptr) != 0) {
+        fprintf(stderr, "listen failed on port %d\n", port);
+        return 1;
+    }
+
+    fiber_t probe;
+    bool have_probe =
+        fiber_start_background(&probe, nullptr, ProbeFiber, nullptr) == 0;
+
+    fiber_t quit_watcher;
+    bool have_quit_watcher = false;
+    {
+        auto* qa = new QuitWatchArgs{&server, drain_ms};
+        if (fiber_start_background(&quit_watcher, nullptr,
+                                   GracefulQuitWatcher, qa) == 0) {
+            have_quit_watcher = true;
+        } else {
+            delete qa;
+        }
+    }
+
+    printf("READY %d\n", port);
+    fflush(stdout);
+
+    char cmd[256];
+    while (fgets(cmd, sizeof(cmd), stdin) != nullptr) {
+        if (strncmp(cmd, "report", 6) == 0 || strncmp(cmd, "stop", 4) == 0) {
+            PrintReport();
+        }
+    }
+    // EOF teardown: the watcher holds a pointer to the stack server —
+    // stop and join it first (a racing SIGTERM path _exits instead).
+    if (have_quit_watcher) {
+        g_watcher_stop.store(true, std::memory_order_release);
+        fiber_join(quit_watcher, nullptr);
+    }
+    g_stop.store(true, std::memory_order_release);
+    if (have_probe) fiber_join(probe, nullptr);
+    server.Stop();
+    server.Join();
+    fflush(nullptr);
+    _exit(0);  // skip static dtors (long-lived server discipline)
+}

@@ -288,6 +288,153 @@ def series_scrape():
                 proc.wait()  # reap: no zombie holding the port
 
 
+def _spawn_ready_argv(argv, timeout_s=20.0):
+    """Boot a binary with an explicit argv and wait for its READY line
+    (infer_server takes positional port + long flags, not the mesh_node
+    --port/--peers shape _spawn_node_ready assumes)."""
+    proc = subprocess.Popen(
+        [str(a) for a in argv],
+        stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL,
+    )
+    deadline = time.time() + timeout_s
+    buf = b""
+    while b"READY" not in buf:
+        remain = deadline - time.time()
+        if remain <= 0:
+            return proc, False
+        r, _, _ = select.select([proc.stdout], [], [], remain)
+        if not r:
+            return proc, False
+        chunk = os.read(proc.stdout.fileno(), 4096)
+        if not chunk:
+            return proc, False
+        buf += chunk
+    return proc, True
+
+
+def _reap(proc):
+    if proc is None:
+        return
+    try:
+        proc.kill()
+    except Exception:
+        pass
+    try:
+        proc.wait(timeout=10)
+    except Exception:
+        pass
+
+
+def infer_scrape():
+    """Continuous micro-batching round (ISSUE 17): boot the
+    examples/infer_server serve plane and drive it with rpc_press
+    --stream_tokens through the resumable push-stream tier.
+
+    Three phases on fresh servers:
+      1. batched — tokens/s, TTFT p50/p99, inter-token p99 (the
+         compared serving metrics);
+      2. unbatched baseline (--unbatched: one sequence per device
+         step) — same load, the deliberately-serial number the batched
+         rate is read against;
+      3. resume — SIGTERM + restart the server mid-stream; the presses'
+         seq-contiguity assertion makes infer_stream_resume_loss a real
+         exactly-once proof, and it MUST stay 0.
+    """
+    server = BUILD / "infer_server"
+    press = BUILD / "rpc_press"
+    if not server.exists() or not press.exists():
+        return None
+
+    def one_press(port, duration_s, tokens=32):
+        r = subprocess.run(
+            [str(press), "--server=127.0.0.1:%d" % port,
+             "--stream_tokens=%d" % tokens, "--qps=400",
+             "--duration_s=%d" % duration_s, "--callers=8",
+             "--timeout_ms=3000", "--json"],
+            capture_output=True, timeout=duration_s + 60)
+        lines = [l for l in r.stdout.decode().splitlines()
+                 if l.startswith("{")]
+        return json.loads(lines[-1]) if lines else None
+
+    def fresh_port():
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return port
+
+    proc = None
+    try:
+        # --- batched serving --------------------------------------
+        port = fresh_port()
+        proc, ready = _spawn_ready_argv(
+            [server, port, "--step_us", 2000, "--max_batch", 8])
+        if not ready:
+            return None
+        dur = 5
+        rep = one_press(port, dur)
+        _reap(proc)
+        proc = None
+        if rep is None or rep.get("press_stream_tokens", 0) <= 0:
+            return None
+        out = {
+            "infer_batched_tokens_per_s": int(
+                rep["press_stream_tokens"] / dur),
+            "infer_ttft_p50_us": int(rep["press_ttft_us"]["p50"]),
+            "infer_ttft_p99_us": int(rep["press_ttft_us"]["p99"]),
+            "infer_itl_p99_us": int(rep["press_itl_us"]["p99"]),
+        }
+
+        # --- unbatched baseline -----------------------------------
+        port = fresh_port()
+        proc, ready = _spawn_ready_argv(
+            [server, port, "--step_us", 2000, "--max_batch", 8,
+             "--unbatched"])
+        if ready:
+            urep = one_press(port, dur)
+            if urep is not None and \
+                    urep.get("press_stream_tokens", 0) > 0:
+                ups = int(urep["press_stream_tokens"] / dur)
+                out["infer_unbatched_tokens_per_s"] = ups
+                if ups > 0:
+                    out["infer_batch_ratio"] = round(
+                        out["infer_batched_tokens_per_s"] / ups, 2)
+        _reap(proc)
+        proc = None
+
+        # --- restart mid-stream: exactly-once across the resume ---
+        port = fresh_port()
+        proc, ready = _spawn_ready_argv(
+            [server, port, "--step_us", 2000, "--max_batch", 8])
+        if ready:
+            pp = subprocess.Popen(
+                [str(press), "--server=127.0.0.1:%d" % port,
+                 "--stream_tokens=64", "--qps=8", "--duration_s=8",
+                 "--callers=4", "--timeout_ms=3000", "--json"],
+                stdout=subprocess.PIPE, stderr=subprocess.DEVNULL)
+            time.sleep(3.0)  # streams in flight
+            _reap(proc)
+            proc, ready = _spawn_ready_argv(
+                [server, port, "--step_us", 2000, "--max_batch", 8])
+            pout, _ = pp.communicate(timeout=90)
+            lines = [l for l in pout.decode().splitlines()
+                     if l.startswith("{")]
+            if ready and lines:
+                rrep = json.loads(lines[-1])
+                out["infer_stream_resumes"] = int(
+                    rrep.get("press_stream_resumes", 0))
+                # Lost/duplicated/corrupt tokens across the restart:
+                # the acceptance gate — MUST stay 0.
+                out["infer_stream_resume_loss"] = int(
+                    rrep.get("press_stream_seq_errors", 0))
+        return out
+    except Exception:
+        return None
+    finally:
+        _reap(proc)
+
+
 class _CollNode:
     """One mesh_node handle for the collective round: line-buffered
     stdout reads (READY / COLL lines) + stdin commands."""
@@ -738,7 +885,17 @@ _SKIP_KEYS = {"metric", "unit", "vs_baseline", "reps", "error",
               # re-derives from the two (the >= 1.0 acceptance lives in
               # the verify recipe); pod count is shape.
               "coll_flat_dcn_allreduce_busbw_mbps",
-              "coll_hier_vs_flat_ratio", "coll_dcn_pods"}
+              "coll_hier_vs_flat_ratio", "coll_dcn_pods",
+              # Inference-serving round (ISSUE 17): batched tokens/s and
+              # the TTFT/ITL latencies ARE compared. The unbatched
+              # number measures the deliberately-serial baseline, the
+              # ratio re-derives from the two, resume counts are
+              # restart-timing context, and resume_loss is a MUST-BE-0
+              # acceptance gate (asserted in the verify recipe — a 0->1
+              # flip would read as "improved" to the direction
+              # heuristic, so it must not be compared).
+              "infer_unbatched_tokens_per_s", "infer_batch_ratio",
+              "infer_stream_resumes", "infer_stream_resume_loss"}
 
 
 def _lower_is_better(key):
@@ -885,6 +1042,7 @@ def run_bench():
     qos_cost = qos_cost_scrape()
     coll = collective_scrape()
     dcn_coll = dcn_collective_scrape()
+    infer = infer_scrape()
 
     mbps = float(ici["mbps"])
     out = {
@@ -921,6 +1079,8 @@ def run_bench():
         out.update(coll)
     if dcn_coll is not None:
         out.update(dcn_coll)
+    if infer is not None:
+        out.update(infer)
     print(json.dumps(out))
 
 

@@ -32,6 +32,7 @@
 #include "tnet/socket.h"
 #include "trpc/collective.h"
 #include "trpc/load_balancer.h"
+#include "trpc/stream.h"
 #include "trpc/rpcz_stitch.h"
 #include "trpc/server.h"
 #include "trpc/span.h"
@@ -77,6 +78,9 @@ void HandleIndex(Server*, const HttpRequest&, HttpResponse* res) {
         "              (with direction: req/rsp), per-class slab\n"
         "              occupancy, mapped peer pools + epochs, and the\n"
         "              transport-tier byte attribution\n"
+        "              (?format=json machine form)\n"
+        "/streams      push-stream tier: rpc_stream_* counters, replay-\n"
+        "              ring high-water, live server/client stream rows\n"
         "              (?format=json machine form)\n"
         "/metrics      prometheus exposition\n");
 }
@@ -749,6 +753,19 @@ void HandlePools(Server*, const HttpRequest& req, HttpResponse* res) {
 // with the served-latency p99. The same numbers ride /metrics as the
 // labelled rpc_tenant_* families; ?format=json is what the overload
 // soak asserts on.
+// /streams: push-stream tier (ISSUE 17) — the rpc_stream_* counters,
+// replay-ring high-water and one row per live server/client stream;
+// ?format=json is what the restart soak and bench.py scrape.
+void HandleStreams(Server*, const HttpRequest& req, HttpResponse* res) {
+    if (req.QueryParam("format") == "json") {
+        res->set_content_type("application/json");
+        res->Append(push_stream::DescribeJson());
+        return;
+    }
+    res->set_content_type("text/plain");
+    res->Append(push_stream::DescribeText());
+}
+
 void HandleTenants(Server* server, const HttpRequest& req,
                    HttpResponse* res) {
     if (req.QueryParam("format") == "json") {
@@ -806,6 +823,7 @@ void AddBuiltinHttpServices(Server* server) {
                                 HandleHotspotsContention);
     server->RegisterHttpHandler("/chaos", HandleChaos);
     server->RegisterHttpHandler("/pools", HandlePools);
+    server->RegisterHttpHandler("/streams", HandleStreams);
     server->RegisterHttpHandler("/metrics", HandleMetrics);
 }
 

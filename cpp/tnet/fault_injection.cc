@@ -34,8 +34,12 @@ DEFINE_string(chaos_plan, "",
               "microseconds), ring_drop (staging-ring completes), and "
               "cost_inflate (param = multiplier, default 10: inflate a "
               "completion's measured cost before it feeds the QoS "
-              "admission cost model); e.g. "
-              "'drop=0.01,delay=0.05:2000,cost_inflate=1:8'");
+              "admission cost model), and the server-push stream seam "
+              "stream_stall (param = microseconds, default 5000: delay a "
+              "STREAM_DATA chunk send — a slow consumer) / "
+              "stream_drop_chunk (discard a chunk send; the receiver's "
+              "dup-ack retransmit recovers it from the replay ring); "
+              "e.g. 'drop=0.01,delay=0.05:2000,cost_inflate=1:8'");
 DEFINE_string(chaos_peers, "",
               "comma list of ip:port remote endpoints the plan applies "
               "to; empty = all peers. Non-matching traffic neither "
@@ -96,9 +100,15 @@ struct FaultPlan {
     // completion's measured cost is inflated before feeding the QoS
     // cost model, and the multiplier applied.
     double cost_inflate = 0.0;
+    // Server-push stream seam (ISSUE 17): stall a chunk send (slow
+    // consumer sim) or drop it outright (the receiver's dup-ack NAK
+    // recovers it from the replay ring).
+    double stream_stall = 0.0;
+    double stream_drop_chunk = 0.0;
     int64_t delay_us = 2000;
     int64_t ring_delay_us = 2000;
     int64_t cost_inflate_mult = 10;
+    int64_t stream_stall_us = 5000;
     std::vector<EndPoint> peers;  // empty = every peer
     // Zone partition (ISSUE 14): all traffic to peers of this zone is
     // cut. Lives in the doubly-buffered plan so the hot path reads it
@@ -194,7 +204,8 @@ bool ParsePlan(const std::string& text, FaultPlan* plan) {
         // param on another kind must REJECT, not silently half-apply
         // (the /chaos page promises validate-before-mutate).
         if (!param_str.empty() && kind != "delay" &&
-            kind != "ring_delay" && kind != "cost_inflate") {
+            kind != "ring_delay" && kind != "cost_inflate" &&
+            kind != "stream_stall") {
             return false;
         }
         const auto parse_us = [&](int64_t* out) {
@@ -234,6 +245,11 @@ bool ParsePlan(const std::string& text, FaultPlan* plan) {
         } else if (kind == "cost_inflate") {
             plan->cost_inflate = prob;
             if (!parse_us(&plan->cost_inflate_mult)) return false;
+        } else if (kind == "stream_stall") {
+            plan->stream_stall = prob;
+            if (!parse_us(&plan->stream_stall_us)) return false;
+        } else if (kind == "stream_drop_chunk") {
+            plan->stream_drop_chunk = prob;
         } else {
             return false;
         }
@@ -412,6 +428,19 @@ FaultAction FaultInjection::Decide(FaultOp op, const EndPoint& peer,
         if (u < p->cost_inflate) {
             action.kind = FaultAction::kInflate;
             action.aux = (uint64_t)p->cost_inflate_mult;
+        }
+    } else if (op == FaultOp::kStreamWrite) {
+        // Server-push chunk send (ISSUE 17): a stalled send simulates a
+        // slow consumer parking the writer; a dropped chunk stays in the
+        // replay ring and must come back via the receiver's dup-ack
+        // retransmit — both fail only the stream's timing, never the
+        // connection.
+        double acc = 0.0;
+        if (u < (acc += p->stream_drop_chunk)) {
+            action.kind = FaultAction::kDrop;
+        } else if (u < (acc += p->stream_stall)) {
+            action.kind = FaultAction::kDelay;
+            action.delay_us = p->stream_stall_us;
         }
     } else {
         double acc = 0.0;

@@ -50,6 +50,12 @@ enum class FaultOp {
     // completion feeds its measured cost into the QoS cost model, so a
     // soak can inflate a method's price without moving real bytes.
     kCostMeasure = 7,
+    // Server-push stream chunk send (ISSUE 17): consulted per
+    // STREAM_DATA chunk so a soak can inject slow consumers
+    // (stream_stall=prob[:ms] -> kDelay) and lost chunks
+    // (stream_drop_chunk=prob -> kDrop, recovered by the receiver's
+    // dup-ack retransmit path) deterministically.
+    kStreamWrite = 8,
 };
 
 // What the consulting seam should do.

@@ -263,6 +263,11 @@ void Controller::Reset() {
     remote_stream_window_ = 0;
     accepted_stream_ = INVALID_VREF_ID;
     accepted_stream_window_ = 0;
+    push_open_id_ = 0;
+    push_open_rx_window_ = 0;
+    push_open_resume_from_ = 0;
+    has_push_open_ = false;
+    accepted_push_stream_ = 0;
     server_socket_ = INVALID_VREF_ID;
     server_ = nullptr;
     server_deadline_us_ = 0;
@@ -989,6 +994,16 @@ void Controller::IssueRPC() {
         auto* ss = meta.mutable_stream_settings();
         ss->set_stream_id(request_stream_);
         ss->set_window_size(request_stream_window_);
+    } else if (push_open_id_ != 0 && !has_push_open_) {
+        // push_stream open/resume (ISSUE 17): client side only —
+        // has_push_open_ means this Controller is serving a push open,
+        // not issuing one.
+        auto* ss = meta.mutable_stream_settings();
+        ss->set_stream_id(push_open_id_);
+        ss->set_version(push_stream::kStreamVersion);
+        ss->set_rx_window(push_open_rx_window_);
+        ss->set_resume_from_seq(push_open_resume_from_);
+        ss->set_push(true);
     }
     IOBuf meta_buf;
     SerializePbToIOBuf(meta, &meta_buf);

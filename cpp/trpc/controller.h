@@ -31,6 +31,9 @@ class RpcMeta;
 
 class Channel;
 class Server;
+namespace push_stream {
+class StreamWriter;
+}
 
 class Controller : public google::protobuf::RpcController {
 public:
@@ -314,6 +317,40 @@ public:
         return accepted_stream_window_;
     }
 
+    // ---- server-push streams (ISSUE 17, push_stream tier) ----
+    // Client: stamp a push-stream open/resume on the request meta
+    // (StreamSettings{push=true, version, rx_window, resume_from_seq}).
+    // StreamCall::PrepareOpen is the normal entry.
+    void set_push_stream_request(uint64_t id, int64_t rx_window,
+                                 uint64_t resume_from) {
+        push_open_id_ = id;
+        push_open_rx_window_ = rx_window;
+        push_open_resume_from_ = resume_from;
+    }
+    // Server: the open parsed from the request meta (push=true).
+    void SetPushStreamOpen(uint64_t id, int64_t rx_window,
+                           uint64_t resume_from) {
+        push_open_id_ = id;
+        push_open_rx_window_ = rx_window;
+        push_open_resume_from_ = resume_from;
+        has_push_open_ = true;
+    }
+    bool has_push_stream_open() const { return has_push_open_; }
+    uint64_t push_stream_id() const { return push_open_id_; }
+    int64_t push_rx_window() const { return push_open_rx_window_; }
+    uint64_t push_resume_from() const { return push_open_resume_from_; }
+    // Accept the push open INSIDE the handler: registers (or resumes)
+    // the server stream keyed by (session, stream_id) and returns the
+    // writer. Chunks written before the response goes out queue in the
+    // replay ring; the response closure binds the connection
+    // (push_stream::Activate) and the writer starts/continues pushing.
+    // Defined in stream.cc.
+    push_stream::StreamWriter accept_stream();
+    void set_accepted_push_stream(uint64_t id) {
+        accepted_push_stream_ = id;
+    }
+    uint64_t accepted_push_stream() const { return accepted_push_stream_; }
+
 private:
     friend class Channel;
     friend class Server;
@@ -464,6 +501,13 @@ private:
     int64_t remote_stream_window_;
     VRefId accepted_stream_;
     int64_t accepted_stream_window_;
+    // push_stream tier (ISSUE 17): the open parsed from / stamped into
+    // the request meta, and the stream id accepted by the handler.
+    uint64_t push_open_id_;
+    int64_t push_open_rx_window_;
+    uint64_t push_open_resume_from_;
+    bool has_push_open_;
+    uint64_t accepted_push_stream_;
     SocketId server_socket_;
 
     // --- server call state ---

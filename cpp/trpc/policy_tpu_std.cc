@@ -180,6 +180,61 @@ void SendTpuStdDescAck(SocketId sid, uint64_t cid, uint64_t ack_token) {
     }
 }
 
+// ---- push-stream frames (ISSUE 17): meta-only frames with stream_frame
+// set; DATA's chunk bytes ride as the frame payload.
+
+int SendTpuStdStreamData(SocketId sid, uint64_t stream_id, uint64_t seq,
+                         uint32_t flags, const std::string& chunk) {
+    rpc::RpcMeta meta;
+    auto* sf = meta.mutable_stream_frame();
+    sf->set_stream_id(stream_id);
+    sf->set_kind(1);  // KIND_DATA
+    sf->set_seq(seq);
+    if (flags != 0) sf->set_flags(flags);
+    IOBuf meta_buf;
+    SerializePbToIOBuf(meta, &meta_buf);
+    IOBuf payload;
+    payload.append(chunk);
+    IOBuf frame;
+    PackTpuStdFrame(&frame, meta_buf, payload, IOBuf());
+    SocketUniquePtr s;
+    if (Socket::AddressSocket(sid, &s) != 0) return -1;
+    return s->Write(&frame);
+}
+
+int SendTpuStdStreamAck(SocketId sid, uint64_t stream_id, uint64_t ack_seq,
+                        int64_t credits) {
+    rpc::RpcMeta meta;
+    auto* sf = meta.mutable_stream_frame();
+    sf->set_stream_id(stream_id);
+    sf->set_kind(2);  // KIND_ACK
+    sf->set_ack_seq(ack_seq);
+    if (credits != 0) sf->set_credits(credits);
+    IOBuf meta_buf;
+    SerializePbToIOBuf(meta, &meta_buf);
+    IOBuf frame;
+    PackTpuStdFrame(&frame, meta_buf, IOBuf(), IOBuf());
+    SocketUniquePtr s;
+    if (Socket::AddressSocket(sid, &s) != 0) return -1;
+    return s->Write(&frame);
+}
+
+int SendTpuStdStreamClose(SocketId sid, uint64_t stream_id,
+                          int error_code) {
+    rpc::RpcMeta meta;
+    auto* sf = meta.mutable_stream_frame();
+    sf->set_stream_id(stream_id);
+    sf->set_kind(3);  // KIND_CLOSE
+    if (error_code != 0) sf->set_error_code(error_code);
+    IOBuf meta_buf;
+    SerializePbToIOBuf(meta, &meta_buf);
+    IOBuf frame;
+    PackTpuStdFrame(&frame, meta_buf, IOBuf(), IOBuf());
+    SocketUniquePtr s;
+    if (Socket::AddressSocket(sid, &s) != 0) return -1;
+    return s->Write(&frame);
+}
+
 void PackTpuStdFrame(IOBuf* out, const IOBuf& meta_pb, const IOBuf& payload,
                      const IOBuf& attachment) {
     char header[kHeaderLen];
@@ -264,6 +319,14 @@ public:
             auto* ss = meta.mutable_stream_settings();
             ss->set_stream_id(cntl_->accepted_stream());
             ss->set_window_size(cntl_->accepted_stream_window());
+        } else if (cntl_->accepted_push_stream() != 0) {
+            // Push-stream accept echo (ISSUE 17): confirm the stream the
+            // handler accepted; DATA starts flowing only after this
+            // response is on the wire (Activate below).
+            auto* ss = meta.mutable_stream_settings();
+            ss->set_stream_id(cntl_->accepted_push_stream());
+            ss->set_version(push_stream::kStreamVersion);
+            ss->set_push(true);
         }
         IOBuf payload;
         if (!cntl_->Failed()) {
@@ -345,8 +408,25 @@ public:
         SerializePbToIOBuf(meta, &meta_buf);
         IOBuf frame;
         PackTpuStdFrame(&frame, meta_buf, payload, att);
+        int wrc = -1;
         if (have_sock) {
-            s->Write(&frame);
+            wrc = s->Write(&frame);
+        }
+        // Push-stream bind point (ISSUE 17): the accept echo is on the
+        // wire — bind the stream to this connection, grant the open's
+        // credit window and replay unacked ring entries. A failed call
+        // or a dead connection aborts the open instead (without
+        // unregistering an in-place resume's live generator: a fresh
+        // resume re-open can still rescue it).
+        if (cntl_->accepted_push_stream() != 0) {
+            if (!cntl_->Failed() && wrc == 0) {
+                push_stream::Activate(cntl_->accepted_push_stream(), sid_);
+            } else {
+                push_stream::AbortServerStream(
+                    cntl_->accepted_push_stream(),
+                    cntl_->Failed() ? cntl_->ErrorCode()
+                                    : TERR_FAILED_SOCKET);
+            }
         }
         if (cntl_->span_ != nullptr) {
             cntl_->span_->response_bytes = (int64_t)payload.size();
@@ -889,8 +969,18 @@ void ProcessTpuStdRequest(TpuStdMessage* msg, const rpc::RpcMeta& meta) {
         cntl->span_ = span;
     }
     if (meta.has_stream_settings()) {
-        cntl->SetRemoteStream(meta.stream_settings().stream_id(),
-                              meta.stream_settings().window_size());
+        const auto& ss = meta.stream_settings();
+        if (ss.push()) {
+            // Push-stream open/resume (ISSUE 17). A version newer than
+            // ours is rejected below (fails the CALL — retriable at the
+            // caller — never the connection).
+            if (ss.version() <= push_stream::kStreamVersion) {
+                cntl->SetPushStreamOpen(ss.stream_id(), ss.rx_window(),
+                                        ss.resume_from_seq());
+            }
+        } else {
+            cntl->SetRemoteStream(ss.stream_id(), ss.window_size());
+        }
     }
     cntl->request_attachment() = attachment;
     if (pool_view.data != nullptr) {
@@ -919,6 +1009,15 @@ void ProcessTpuStdRequest(TpuStdMessage* msg, const rpc::RpcMeta& meta) {
     }
     if (!ParsePbFromIOBuf(req, payload)) {
         cntl->SetFailed(TERR_REQUEST, "parse request failed");
+        done->Run();
+        return;
+    }
+    if (meta.has_stream_settings() && meta.stream_settings().push() &&
+        meta.stream_settings().version() > push_stream::kStreamVersion) {
+        // Version-skewed push open: answer the call with a clean error
+        // (the handler never runs, the connection stays healthy).
+        cntl->SetFailed(TERR_REQUEST,
+                        "unsupported push-stream version");
         done->Run();
         return;
     }
@@ -1053,6 +1152,19 @@ void ProcessTpuStdMessage(InputMessageBase* raw) {
         rsp_desc::CountAck();
         return;
     }
+    if (meta.has_stream_frame() && !meta.has_request() &&
+        !meta.has_response()) {
+        // Push-stream tier frame (ISSUE 17): DATA/ACK/CLOSE keyed by
+        // stream_id, not correlation_id. DATA's chunk bytes are the
+        // frame body. Unknown kinds fail the STREAM inside OnFrame,
+        // never this connection.
+        const auto& sf = meta.stream_frame();
+        push_stream::OnFrame(msg->socket_id, sf.stream_id(),
+                             sf.kind() == 0 ? 1 : sf.kind(), sf.seq(),
+                             sf.flags(), sf.ack_seq(), sf.credits(),
+                             sf.error_code(), &msg->body);
+        return;
+    }
     if (meta.has_request()) {
         ProcessTpuStdRequest(msg.get(), meta);
     } else {
@@ -1090,6 +1202,7 @@ void GlobalInitializeOrDie() {
         *g_rsp_desc_rejects << 0;
         *g_rsp_desc_acks << 0;
         transport_stats::ExposeVars();
+        push_stream::ExposeVars();
         Protocol p;
         p.parse = ParseTpuStdMessage;
         p.process = ProcessTpuStdMessage;

@@ -49,6 +49,16 @@ void SendTpuStdCancel(SocketId sid, uint64_t cid);
 void SendTpuStdDescAck(SocketId sid, uint64_t cid,
                        uint64_t ack_token = 0);
 
+// Push-stream frames (ISSUE 17, RpcMeta.stream_frame): DATA carries the
+// chunk as the frame payload; ACK/CLOSE are meta-only. Return 0 on
+// queued write, nonzero when the socket is dead/failed (the chunk stays
+// in the sender's replay ring — resume recovers it).
+int SendTpuStdStreamData(SocketId sid, uint64_t stream_id, uint64_t seq,
+                         uint32_t flags, const std::string& chunk);
+int SendTpuStdStreamAck(SocketId sid, uint64_t stream_id, uint64_t ack_seq,
+                        int64_t credits);
+int SendTpuStdStreamClose(SocketId sid, uint64_t stream_id, int error_code);
+
 // Response-direction descriptor counters (the rpc_pool_desc_rsp_*
 // families; defined in policy_tpu_std.cc, shared with controller.cc —
 // the send/fallback sites live on the server response path, the

@@ -2,18 +2,32 @@
 
 #include <arpa/inet.h>
 
+#include <algorithm>
 #include <cerrno>
 #include <cstring>
+#include <deque>
+#include <map>
 #include <mutex>
+#include <sstream>
+#include <unordered_map>
+#include <utility>
+#include <vector>
 
 #include "tbase/errno.h"
+#include "tbase/fast_rand.h"
+#include "tbase/flags.h"
 #include "tbase/logging.h"
 #include "tbase/time.h"
 #include "tfiber/butex.h"
 #include "tfiber/execution_queue.h"
+#include "tfiber/fiber.h"
+#include "tnet/fault_injection.h"
 #include "tnet/input_messenger.h"
 #include "tnet/socket.h"
 #include "trpc/controller.h"
+#include "trpc/policy_tpu_std.h"
+#include "tvar/latency_recorder.h"
+#include "tvar/reducer.h"
 
 namespace tpurpc {
 
@@ -456,5 +470,787 @@ void RegisterStreamProtocolOrDie() {
 int StreamProtocolIndex() { return g_stream_protocol_index; }
 
 }  // namespace stream_internal
+
+// ===================== server-push streams (ISSUE 17) =====================
+
+// Receiver-granted chunk credits announced in a push-stream open: the
+// server may have at most this many unconsumed chunks toward the client.
+DEFINE_int32(stream_rx_window, 32,
+             "push-stream flow-control window, in chunks");
+// Bounded per-stream replay ring of unacked chunks (memory backstop on
+// top of the credit window; resumes replay from here).
+DEFINE_int32(stream_replay_ring, 128,
+             "push-stream replay ring capacity, in chunks");
+// A server stream whose connection died is kept registered (awaiting a
+// resume) for this long before the parked writer aborts.
+DEFINE_int32(stream_registry_ttl_ms, 15000,
+             "ms an unbound push-stream awaits a resume before aborting");
+
+namespace push_stream {
+
+namespace {
+
+// ---- metrics (eagerly exposed 0-valued by ExposeVars) ----
+LazyAdder g_opens("rpc_stream_open");
+LazyAdder g_resumed("rpc_stream_resumed");
+LazyAdder g_replayed("rpc_stream_replayed_chunks");
+LazyAdder g_credit_stalls("rpc_stream_credit_stalls");
+LazyAdder g_aborts("rpc_stream_aborts");
+
+LatencyRecorder* ttft_recorder() {
+    static LatencyRecorder* r = [] {
+        auto* x = new LatencyRecorder;
+        x->expose("rpc_stream_ttft_us");
+        return x;
+    }();
+    return r;
+}
+
+// Process-wide replay-ring occupancy high-water (all streams).
+std::atomic<int64_t> g_ring_hw{0};
+void NoteRingSize(size_t n) {
+    int64_t cur = g_ring_hw.load(std::memory_order_relaxed);
+    while ((int64_t)n > cur &&
+           !g_ring_hw.compare_exchange_weak(cur, (int64_t)n)) {
+    }
+}
+
+// Retransmit pacing: min gap between ring replays for one stream, and
+// max entries per replay burst.
+constexpr int64_t kRetxMinGapUs = 20 * 1000;
+constexpr size_t kRetxBurstCap = 64;
+// Client-side NAK pacing (gap detected) and stall-probe period.
+constexpr int64_t kNakMinGapUs = 20 * 1000;
+constexpr int64_t kStallProbeUs = 150 * 1000;
+
+}  // namespace
+
+// Server half of one push stream. `mu` guards everything except the
+// atomics; the writer fiber parks on `wbutex` while credits, ring space
+// or a bound connection are missing.
+//
+// LOCK ORDER: g_srv_mu may take st->mu, NEVER the reverse — completion
+// flags are collected under st->mu and the registry erase happens after
+// release.
+struct ServerStreamState {
+    uint64_t id = 0;
+    std::string session;       // sticky-session owner (resume identity)
+    int64_t open_rx_window = 0;
+
+    std::atomic<VRefId> socket{INVALID_VREF_ID};
+
+    std::mutex mu;
+    // Unacked chunks, ascending seq — the replay ring. Bounded by
+    // ring_cap; normally bounded tighter by the credit window.
+    std::deque<std::pair<uint64_t, std::string>> ring;
+    uint64_t last_sent = 0;  // highest seq assigned
+    uint64_t acked = 0;      // receiver's contiguous-arrival floor
+    int64_t credits = 0;     // receiver-granted sends remaining
+    uint64_t eos_seq = 0;    // 0 = not yet written
+    uint64_t resume_from = 0;
+    bool resumed_in_place = false;
+    bool aborted = false;
+    int error = 0;
+    bool first_write_done = false;  // TTFT latch
+    int64_t open_us = 0;
+    int64_t last_retx_us = 0;
+    int64_t unbound_since_us = 0;  // 0 = bound
+    size_t ring_cap = 0;
+
+    void* wbutex = nullptr;
+
+    ServerStreamState() : wbutex(butex_create()) {}
+    ~ServerStreamState() { butex_destroy(wbutex); }
+    void Wake() {
+        butex_word(wbutex)->fetch_add(1, std::memory_order_release);
+        butex_wake_all(wbutex);
+    }
+};
+
+// Client half: reorder + dedupe state for one logical stream across any
+// number of resumes.
+struct ReceiverState {
+    uint64_t id = 0;
+    std::atomic<VRefId> src_socket{INVALID_VREF_ID};
+
+    std::mutex mu;
+    std::map<uint64_t, std::string> pending;  // out-of-order arrivals
+    std::deque<std::pair<uint64_t, std::string>> ready;  // contiguous
+    uint64_t delivered = 0;  // last contiguous seq ARRIVED (ack floor)
+    uint64_t read_upto = 0;  // last seq handed to Read
+    uint64_t eos_seq = 0;
+    uint64_t dups = 0;       // deduped arrivals (exactly-once proof)
+    int close_error = 0;
+    bool closed = false;
+    int64_t rx_window = 0;
+    int64_t consumed_since_grant = 0;
+    int64_t last_nak_us = 0;
+    int64_t last_progress_us = 0;
+
+    void* rbutex = nullptr;
+
+    ReceiverState() : rbutex(butex_create()) {}
+    ~ReceiverState() { butex_destroy(rbutex); }
+    void Wake() {
+        butex_word(rbutex)->fetch_add(1, std::memory_order_release);
+        butex_wake_all(rbutex);
+    }
+};
+
+namespace {
+
+std::mutex g_srv_mu;
+std::unordered_map<uint64_t, std::shared_ptr<ServerStreamState>>&
+ServerRegistry() {
+    static auto* m =
+        new std::unordered_map<uint64_t, std::shared_ptr<ServerStreamState>>;
+    return *m;
+}
+
+std::mutex g_rx_mu;
+std::unordered_map<uint64_t, std::shared_ptr<ReceiverState>>&
+RxRegistry() {
+    static auto* m =
+        new std::unordered_map<uint64_t, std::shared_ptr<ReceiverState>>;
+    return *m;
+}
+
+std::shared_ptr<ServerStreamState> FindServer(uint64_t id) {
+    std::lock_guard<std::mutex> g(g_srv_mu);
+    auto& reg = ServerRegistry();
+    auto it = reg.find(id);
+    return it == reg.end() ? nullptr : it->second;
+}
+
+std::shared_ptr<ReceiverState> FindReceiver(uint64_t id) {
+    std::lock_guard<std::mutex> g(g_rx_mu);
+    auto& reg = RxRegistry();
+    auto it = reg.find(id);
+    return it == reg.end() ? nullptr : it->second;
+}
+
+void UnregisterServer(uint64_t id) {
+    std::lock_guard<std::mutex> g(g_srv_mu);
+    ServerRegistry().erase(id);
+}
+
+// Mark st aborted (under its mu), wake the writer, best-effort CLOSE the
+// peer. Caller unregisters.
+void AbortLocked(const std::shared_ptr<ServerStreamState>& st, int err) {
+    VRefId sid = INVALID_VREF_ID;
+    {
+        std::lock_guard<std::mutex> g(st->mu);
+        if (st->aborted) return;
+        st->aborted = true;
+        st->error = err;
+        sid = st->socket.load(std::memory_order_acquire);
+    }
+    *g_aborts << 1;
+    if (sid != INVALID_VREF_ID) {
+        SendTpuStdStreamClose(sid, st->id, err);
+    }
+    st->Wake();
+}
+
+}  // namespace
+
+// Registry lookup keyed by stream_id, identity-checked by session:
+//  - hit + same session  -> in-place resume: trim ring <= resume_from,
+//    rebind deferred to Activate, the parked writer continues, the ring
+//    replays — the ORIGINAL generator covers continuation.
+//  - hit + other session -> stale owner: abort the old stream, fresh.
+//  - miss                -> fresh; resume_from>0 means the process
+//    restarted and the handler must REGENERATE from that offset.
+std::shared_ptr<ServerStreamState> AcceptOpen(uint64_t id,
+                                              const std::string& session,
+                                              int64_t rx_window,
+                                              uint64_t resume_from) {
+    *g_opens << 1;
+    const int64_t now = monotonic_time_us();
+    std::shared_ptr<ServerStreamState> st;
+    std::shared_ptr<ServerStreamState> stale;
+    {
+        std::lock_guard<std::mutex> g(g_srv_mu);
+        auto& reg = ServerRegistry();
+        auto it = reg.find(id);
+        if (it != reg.end() && it->second->session == session) {
+            st = it->second;
+            std::lock_guard<std::mutex> g2(st->mu);
+            st->socket.store(INVALID_VREF_ID, std::memory_order_release);
+            st->credits = 0;
+            st->unbound_since_us = now;
+            st->resume_from = resume_from;
+            st->resumed_in_place = true;
+            st->open_rx_window = rx_window;
+            if (resume_from > st->acked) st->acked = resume_from;
+            while (!st->ring.empty() && st->ring.front().first <= st->acked) {
+                st->ring.pop_front();
+            }
+            *g_resumed << 1;
+            return st;
+        }
+        if (it != reg.end()) {
+            stale = it->second;  // session mismatch: new owner wins
+            reg.erase(it);
+        }
+        st = std::make_shared<ServerStreamState>();
+        st->id = id;
+        st->session = session;
+        st->open_rx_window = rx_window;
+        st->last_sent = resume_from;
+        st->acked = resume_from;
+        st->resume_from = resume_from;
+        st->resumed_in_place = false;
+        st->open_us = now;
+        st->unbound_since_us = now;
+        st->ring_cap = (size_t)std::max<int32_t>(
+            1, FLAGS_stream_replay_ring.get());
+        reg[id] = st;
+    }
+    if (stale != nullptr) AbortLocked(stale, TERR_CLOSE);
+    if (resume_from > 0) *g_resumed << 1;
+    return st;
+}
+
+void Activate(uint64_t stream_id, VRefId socket_id) {
+    std::shared_ptr<ServerStreamState> st = FindServer(stream_id);
+    if (st == nullptr) return;
+    std::vector<std::pair<uint64_t, std::string>> replay;
+    uint64_t eos_seq = 0;
+    {
+        std::lock_guard<std::mutex> g(st->mu);
+        if (st->aborted) return;
+        st->socket.store(socket_id, std::memory_order_release);
+        st->credits = st->open_rx_window;
+        st->unbound_since_us = 0;
+        eos_seq = st->eos_seq;
+        for (const auto& e : st->ring) {
+            if (e.first > st->acked) replay.push_back(e);
+        }
+        st->credits -= (int64_t)replay.size();
+    }
+    for (const auto& e : replay) {
+        *g_replayed << 1;
+        SendTpuStdStreamData(socket_id, stream_id, e.first,
+                             e.first == eos_seq ? kFlagEos : 0, e.second);
+    }
+    st->Wake();
+}
+
+void AbortServerStream(uint64_t stream_id, int error_code) {
+    std::shared_ptr<ServerStreamState> st = FindServer(stream_id);
+    if (st == nullptr) return;
+    AbortLocked(st, error_code);
+    UnregisterServer(stream_id);
+}
+
+// ---- StreamWriter ----
+
+StreamWriter::StreamWriter(std::shared_ptr<ServerStreamState> st)
+    : state_(std::move(st)) {}
+
+uint64_t StreamWriter::stream_id() const {
+    return state_ ? state_->id : 0;
+}
+
+uint64_t StreamWriter::resume_from() const {
+    return state_ ? state_->resume_from : 0;
+}
+
+bool StreamWriter::resumed_in_place() const {
+    return state_ != nullptr && state_->resumed_in_place;
+}
+
+uint64_t StreamWriter::last_seq() const {
+    if (state_ == nullptr) return 0;
+    std::lock_guard<std::mutex> g(state_->mu);
+    return state_->last_sent;
+}
+
+int StreamWriter::Write(const std::string& chunk, bool eos) {
+    if (state_ == nullptr) return TERR_INTERNAL;
+    const std::shared_ptr<ServerStreamState>& st = state_;
+    bool stall_counted = false;  // one credit_stall per park episode
+    for (;;) {
+        const int expected =
+            butex_word(st->wbutex)->load(std::memory_order_acquire);
+        VRefId sid = INVALID_VREF_ID;
+        uint64_t seq = 0;
+        uint32_t flags = 0;
+        {
+            std::lock_guard<std::mutex> g(st->mu);
+            if (st->aborted) {
+                return st->error != 0 ? st->error : TERR_CLOSE;
+            }
+            sid = st->socket.load(std::memory_order_acquire);
+            if (sid != INVALID_VREF_ID && st->credits > 0 &&
+                st->ring.size() < st->ring_cap) {
+                seq = ++st->last_sent;
+                st->ring.emplace_back(seq, chunk);
+                NoteRingSize(st->ring.size());
+                st->credits--;
+                if (eos) {
+                    st->eos_seq = seq;
+                    flags |= kFlagEos;
+                }
+                if (!st->first_write_done) {
+                    st->first_write_done = true;
+                    *ttft_recorder() << monotonic_time_us() - st->open_us;
+                }
+            } else if (sid != INVALID_VREF_ID) {
+                // Bound but out of credits/ring: the consumer is slow —
+                // park (this is the backpressure that bounds memory).
+                if (!stall_counted) {
+                    *g_credit_stalls << 1;
+                    stall_counted = true;
+                }
+            } else if (st->unbound_since_us > 0 &&
+                       monotonic_time_us() - st->unbound_since_us >
+                           (int64_t)FLAGS_stream_registry_ttl_ms.get() *
+                               1000) {
+                // No resume arrived in time: give up.
+                st->aborted = true;
+                st->error = TERR_RPC_TIMEDOUT;
+            }
+        }
+        if (seq != 0) {
+            if (fault_injection_enabled()) {
+                EndPoint peer;
+                {
+                    SocketUniquePtr s;
+                    if (Socket::AddressSocket(sid, &s) == 0) {
+                        peer = s->remote_side();
+                    }
+                }
+                const FaultAction a = FaultInjection::Decide(
+                    FaultOp::kStreamWrite, peer, chunk.size());
+                if (a.kind == FaultAction::kDelay) {
+                    fiber_usleep(a.delay_us);
+                } else if (a.kind == FaultAction::kDrop) {
+                    // Never sent, but it IS in the ring: the receiver's
+                    // gap-NAK / stall-probe retransmit path recovers it.
+                    return 0;
+                }
+            }
+            if (SendTpuStdStreamData(sid, st->id, seq, flags, chunk) != 0) {
+                // Connection died under us; the chunk stays ringed for
+                // the resume. Start the registry TTL.
+                std::lock_guard<std::mutex> g(st->mu);
+                if (st->socket.load(std::memory_order_acquire) == sid) {
+                    st->socket.store(INVALID_VREF_ID,
+                                     std::memory_order_release);
+                    st->unbound_since_us = monotonic_time_us();
+                }
+            }
+            return 0;
+        }
+        const int64_t abst = monotonic_time_us() + 100 * 1000;
+        butex_wait(st->wbutex, expected, &abst);
+    }
+}
+
+void StreamWriter::Abort(int error_code) {
+    if (state_ == nullptr) return;
+    AbortLocked(state_, error_code);
+    UnregisterServer(state_->id);
+}
+
+// ---- frame handlers ----
+
+namespace {
+
+void HandleAck(const std::shared_ptr<ServerStreamState>& st,
+               uint64_t ack_seq, int64_t credits) {
+    std::vector<std::pair<uint64_t, std::string>> retx;
+    VRefId sid = INVALID_VREF_ID;
+    uint64_t eos_seq = 0;
+    bool complete = false;
+    {
+        std::lock_guard<std::mutex> g(st->mu);
+        sid = st->socket.load(std::memory_order_acquire);
+        bool advanced = false;
+        if (ack_seq > st->acked) {
+            st->acked = ack_seq;
+            advanced = true;
+        }
+        while (!st->ring.empty() && st->ring.front().first <= st->acked) {
+            st->ring.pop_front();
+        }
+        st->credits += credits;
+        eos_seq = st->eos_seq;
+        const int64_t now = monotonic_time_us();
+        if (!advanced && credits == 0 && ack_seq < st->last_sent &&
+            sid != INVALID_VREF_ID && !st->aborted &&
+            now - st->last_retx_us > kRetxMinGapUs) {
+            // Non-advancing zero-credit ack = NAK/stall probe: the
+            // receiver is missing everything past ack_seq.
+            st->last_retx_us = now;
+            for (const auto& e : st->ring) {
+                if (e.first > ack_seq && retx.size() < kRetxBurstCap) {
+                    retx.push_back(e);
+                }
+            }
+        }
+        if (st->eos_seq != 0 && st->acked >= st->eos_seq) complete = true;
+    }
+    for (const auto& e : retx) {
+        *g_replayed << 1;
+        SendTpuStdStreamData(sid, st->id, e.first,
+                             e.first == eos_seq ? kFlagEos : 0, e.second);
+    }
+    st->Wake();
+    if (complete) UnregisterServer(st->id);
+}
+
+void HandleData(const std::shared_ptr<ReceiverState>& rx, VRefId sid,
+                uint64_t seq, uint32_t flags, IOBuf* payload) {
+    bool nak = false;
+    uint64_t nak_floor = 0;
+    {
+        std::lock_guard<std::mutex> g(rx->mu);
+        rx->src_socket.store(sid, std::memory_order_release);
+        if (flags & kFlagAbort) {
+            rx->closed = true;
+            rx->close_error = TERR_CLOSE;
+        } else {
+            if (flags & kFlagEos) rx->eos_seq = seq;
+            if (seq <= rx->delivered || rx->pending.count(seq) != 0) {
+                // Exactly-once: replays/retransmits of delivered or
+                // buffered seqs are dropped (and NOT re-acked — the
+                // periodic grant/probe acks carry the floor, avoiding
+                // ack-storm retransmit loops).
+                rx->dups++;
+            } else {
+                rx->pending[seq] = payload->to_string();
+                auto it = rx->pending.find(rx->delivered + 1);
+                while (it != rx->pending.end()) {
+                    rx->ready.emplace_back(it->first,
+                                           std::move(it->second));
+                    rx->delivered = it->first;
+                    rx->pending.erase(it);
+                    it = rx->pending.find(rx->delivered + 1);
+                }
+                rx->last_progress_us = monotonic_time_us();
+            }
+            if (!rx->pending.empty()) {
+                // Gap: NAK the contiguous floor (rate-limited).
+                const int64_t now = monotonic_time_us();
+                if (now - rx->last_nak_us > kNakMinGapUs) {
+                    rx->last_nak_us = now;
+                    nak = true;
+                    nak_floor = rx->delivered;
+                }
+            }
+        }
+    }
+    if (nak) SendTpuStdStreamAck(sid, rx->id, nak_floor, 0);
+    rx->Wake();
+}
+
+}  // namespace
+
+void OnFrame(VRefId socket_id, uint64_t stream_id, int kind, uint64_t seq,
+             uint32_t flags, uint64_t ack_seq, int64_t credits,
+             int error_code, IOBuf* payload) {
+    switch (kind) {
+        case KIND_DATA: {
+            std::shared_ptr<ReceiverState> rx = FindReceiver(stream_id);
+            if (rx == nullptr) {
+                // No such receiver (caller gone): tell the sender to
+                // stop pushing.
+                SendTpuStdStreamClose(socket_id, stream_id, TERR_CLOSE);
+                return;
+            }
+            HandleData(rx, socket_id, seq, flags, payload);
+            return;
+        }
+        case KIND_ACK: {
+            std::shared_ptr<ServerStreamState> st = FindServer(stream_id);
+            if (st == nullptr) return;  // late ack after completion: drop
+            HandleAck(st, ack_seq, credits);
+            return;
+        }
+        case KIND_CLOSE: {
+            std::shared_ptr<ServerStreamState> st = FindServer(stream_id);
+            if (st != nullptr) {
+                AbortLocked(st,
+                            error_code != 0 ? error_code : TERR_CLOSE);
+                UnregisterServer(stream_id);
+                return;
+            }
+            std::shared_ptr<ReceiverState> rx = FindReceiver(stream_id);
+            if (rx != nullptr) {
+                {
+                    std::lock_guard<std::mutex> g(rx->mu);
+                    rx->closed = true;
+                    rx->close_error = error_code;
+                }
+                rx->Wake();
+            }
+            return;
+        }
+        default:
+            // Unknown frame kind: a version-skewed peer. Fail the
+            // STREAM, never the connection.
+            *g_aborts << 1;
+            SendTpuStdStreamClose(socket_id, stream_id, TERR_REQUEST);
+            return;
+    }
+}
+
+// ---- StreamCall (client) ----
+
+uint64_t NewClientStreamId() {
+    // Random seed + odd golden-ratio stride: ids from different client
+    // processes collide with negligible probability, and the SAME call
+    // object keeps its id across resumes.
+    static std::atomic<uint64_t> g_next{fast_rand() | 1};
+    uint64_t id = g_next.fetch_add(0x9E3779B97F4A7C15ull,
+                                   std::memory_order_relaxed);
+    if (id == 0) {
+        id = g_next.fetch_add(0x9E3779B97F4A7C15ull,
+                              std::memory_order_relaxed);
+    }
+    return id;
+}
+
+StreamCall::StreamCall() : id_(NewClientStreamId()) {
+    rx_ = std::make_shared<ReceiverState>();
+    rx_->id = id_;
+    rx_->rx_window =
+        std::max<int64_t>(1, FLAGS_stream_rx_window.get());
+    rx_->last_progress_us = monotonic_time_us();
+    std::lock_guard<std::mutex> g(g_rx_mu);
+    RxRegistry()[id_] = rx_;
+}
+
+StreamCall::~StreamCall() {
+    {
+        std::lock_guard<std::mutex> g(g_rx_mu);
+        RxRegistry().erase(id_);
+    }
+    const VRefId sid = rx_->src_socket.load(std::memory_order_acquire);
+    if (sid != INVALID_VREF_ID) {
+        SendTpuStdStreamClose(sid, id_, TERR_CLOSE);
+    }
+}
+
+uint64_t StreamCall::last_seq() const {
+    std::lock_guard<std::mutex> g(rx_->mu);
+    return rx_->delivered;
+}
+
+uint64_t StreamCall::duplicates() const {
+    std::lock_guard<std::mutex> g(rx_->mu);
+    return rx_->dups;
+}
+
+void StreamCall::SeedResume(uint64_t from) {
+    std::lock_guard<std::mutex> g(rx_->mu);
+    if (rx_->delivered == 0 && rx_->read_upto == 0 && rx_->ready.empty() &&
+        rx_->pending.empty()) {
+        rx_->delivered = from;
+        rx_->read_upto = from;
+    }
+}
+
+void StreamCall::PrepareOpen(Controller* cntl) {
+    uint64_t from = 0;
+    {
+        std::lock_guard<std::mutex> g(rx_->mu);
+        from = rx_->delivered;
+        rx_->closed = false;
+        rx_->close_error = 0;
+        rx_->consumed_since_grant = 0;
+        rx_->last_nak_us = 0;
+        rx_->last_progress_us = monotonic_time_us();
+        rx_->src_socket.store(INVALID_VREF_ID, std::memory_order_release);
+    }
+    cntl->set_push_stream_request(id_, rx_->rx_window, from);
+}
+
+int StreamCall::Read(std::string* chunk, uint64_t* seq, int timeout_ms) {
+    const std::shared_ptr<ReceiverState>& rx = rx_;
+    const int64_t deadline =
+        monotonic_time_us() + (int64_t)timeout_ms * 1000;
+    for (;;) {
+        const int expected =
+            butex_word(rx->rbutex)->load(std::memory_order_acquire);
+        VRefId sid = INVALID_VREF_ID;
+        int64_t grant = 0;
+        uint64_t floor = 0;
+        bool probe = false;
+        int rc = -1;
+        {
+            std::lock_guard<std::mutex> g(rx->mu);
+            sid = rx->src_socket.load(std::memory_order_acquire);
+            if (!rx->ready.empty()) {
+                auto& f = rx->ready.front();
+                *seq = f.first;
+                *chunk = std::move(f.second);
+                rx->ready.pop_front();
+                rx->read_upto = *seq;
+                rx->consumed_since_grant++;
+                const bool final_read =
+                    rx->eos_seq != 0 && rx->read_upto >= rx->eos_seq;
+                if (rx->consumed_since_grant >=
+                        std::max<int64_t>(1, rx->rx_window / 2) ||
+                    final_read) {
+                    // Consumption-based credit grant: this is what a
+                    // slow consumer WITHHOLDS, parking the writer.
+                    grant = rx->consumed_since_grant;
+                    rx->consumed_since_grant = 0;
+                    floor = rx->delivered;
+                }
+                rc = 0;
+            } else if (rx->eos_seq != 0 && rx->read_upto >= rx->eos_seq) {
+                rc = 1;  // complete
+            } else if (rx->closed) {
+                rc = rx->close_error != 0 ? rx->close_error : TERR_EOF;
+            } else if (sid != INVALID_VREF_ID) {
+                // Mid-stream silence: probe with a non-advancing
+                // zero-credit ack — if the tail chunk was lost, the
+                // server's ring retransmits it.
+                const int64_t now = monotonic_time_us();
+                if (now - rx->last_progress_us > kStallProbeUs &&
+                    now - rx->last_nak_us > kStallProbeUs) {
+                    rx->last_nak_us = now;
+                    probe = true;
+                    floor = rx->delivered;
+                }
+            }
+        }
+        if (grant > 0 && sid != INVALID_VREF_ID) {
+            SendTpuStdStreamAck(sid, rx->id, floor, grant);
+        } else if (probe) {
+            SendTpuStdStreamAck(sid, rx->id, floor, 0);
+        }
+        if (rc >= 0) return rc;
+        if (sid != INVALID_VREF_ID) {
+            SocketUniquePtr s;
+            if (Socket::AddressSocket(sid, &s) != 0) {
+                // Source connection died: resume via PrepareOpen.
+                return TERR_EOF;
+            }
+        }
+        const int64_t now = monotonic_time_us();
+        if (now >= deadline) return TERR_RPC_TIMEDOUT;
+        const int64_t abst = std::min(deadline, now + 50 * 1000);
+        butex_wait(rx->rbutex, expected, &abst);
+    }
+}
+
+// ---- portal / metrics surface ----
+
+void ExposeVars() {
+    *g_opens << 0;
+    *g_resumed << 0;
+    *g_replayed << 0;
+    *g_credit_stalls << 0;
+    *g_aborts << 0;
+    ttft_recorder();
+}
+
+int64_t RingHighwater() {
+    return g_ring_hw.load(std::memory_order_relaxed);
+}
+int64_t Opens() { return (*g_opens).get_value(); }
+int64_t Resumed() { return (*g_resumed).get_value(); }
+int64_t ReplayedChunks() { return (*g_replayed).get_value(); }
+int64_t CreditStalls() { return (*g_credit_stalls).get_value(); }
+int64_t Aborts() { return (*g_aborts).get_value(); }
+
+std::string DescribeText() {
+    std::ostringstream os;
+    os << "push streams (resumable server-push tier)\n"
+       << "open " << Opens() << "\nresumed " << Resumed()
+       << "\nreplayed_chunks " << ReplayedChunks() << "\ncredit_stalls "
+       << CreditStalls() << "\naborts " << Aborts() << "\nring_highwater "
+       << RingHighwater() << "\n";
+    {
+        std::lock_guard<std::mutex> g(g_srv_mu);
+        for (const auto& kv : ServerRegistry()) {
+            const auto& st = kv.second;
+            std::lock_guard<std::mutex> g2(st->mu);
+            os << "server_stream " << kv.first << " session="
+               << st->session << " last_sent=" << st->last_sent
+               << " acked=" << st->acked << " credits=" << st->credits
+               << " ring=" << st->ring.size()
+               << " bound=" << (st->socket.load() != INVALID_VREF_ID)
+               << " eos=" << st->eos_seq << "\n";
+        }
+    }
+    {
+        std::lock_guard<std::mutex> g(g_rx_mu);
+        for (const auto& kv : RxRegistry()) {
+            const auto& rx = kv.second;
+            std::lock_guard<std::mutex> g2(rx->mu);
+            os << "client_stream " << kv.first << " delivered="
+               << rx->delivered << " read_upto=" << rx->read_upto
+               << " pending=" << rx->pending.size()
+               << " dups=" << rx->dups << " eos=" << rx->eos_seq << "\n";
+        }
+    }
+    return os.str();
+}
+
+std::string DescribeJson() {
+    std::ostringstream os;
+    os << "{\"open\":" << Opens() << ",\"resumed\":" << Resumed()
+       << ",\"replayed_chunks\":" << ReplayedChunks()
+       << ",\"credit_stalls\":" << CreditStalls()
+       << ",\"aborts\":" << Aborts()
+       << ",\"ring_highwater\":" << RingHighwater()
+       << ",\"server_streams\":[";
+    {
+        std::lock_guard<std::mutex> g(g_srv_mu);
+        bool first = true;
+        for (const auto& kv : ServerRegistry()) {
+            const auto& st = kv.second;
+            std::lock_guard<std::mutex> g2(st->mu);
+            if (!first) os << ",";
+            first = false;
+            os << "{\"id\":" << kv.first << ",\"last_sent\":"
+               << st->last_sent << ",\"acked\":" << st->acked
+               << ",\"credits\":" << st->credits
+               << ",\"ring\":" << st->ring.size() << ",\"bound\":"
+               << (st->socket.load() != INVALID_VREF_ID ? "true"
+                                                        : "false")
+               << ",\"eos\":" << st->eos_seq << "}";
+        }
+    }
+    os << "],\"client_streams\":[";
+    {
+        std::lock_guard<std::mutex> g(g_rx_mu);
+        bool first = true;
+        for (const auto& kv : RxRegistry()) {
+            const auto& rx = kv.second;
+            std::lock_guard<std::mutex> g2(rx->mu);
+            if (!first) os << ",";
+            first = false;
+            os << "{\"id\":" << kv.first << ",\"delivered\":"
+               << rx->delivered << ",\"read_upto\":" << rx->read_upto
+               << ",\"pending\":" << rx->pending.size()
+               << ",\"dups\":" << rx->dups << ",\"eos\":" << rx->eos_seq
+               << "}";
+        }
+    }
+    os << "]}";
+    return os.str();
+}
+
+}  // namespace push_stream
+
+// Defined here (not controller.cc) so the Controller surface stays free
+// of push_stream internals.
+push_stream::StreamWriter Controller::accept_stream() {
+    if (!has_push_open_ || push_open_id_ == 0) {
+        return push_stream::StreamWriter();
+    }
+    accepted_push_stream_ = push_open_id_;
+    return push_stream::StreamWriter(push_stream::AcceptOpen(
+        push_open_id_, session_, push_open_rx_window_,
+        push_open_resume_from_));
+}
 
 }  // namespace tpurpc

@@ -18,6 +18,8 @@
 
 #include <atomic>
 #include <cstdint>
+#include <memory>
+#include <string>
 
 #include "tbase/iobuf.h"
 #include "tbase/versioned_ref.h"
@@ -81,6 +83,122 @@ int StreamWait(StreamId id, int64_t abstime_us);
 // Close: sends a CLOSE frame, fails the local stream; the peer's handler
 // gets on_closed after delivering queued data. Idempotent-ish.
 int StreamClose(StreamId id);
+
+// ---- server-push streams (ISSUE 17) ----
+//
+// A second, durable stream tier alongside the legacy STRM side channel
+// above: chunks ride STREAM_DATA metas of the tpu_std protocol itself
+// (RpcMeta.stream_frame), flow-controlled by receiver-granted chunk
+// credits, and the stream is a RESUMABLE object — the client holds
+// (stream_id, last contiguous seq) and re-issues the open with
+// resume_from_seq on GOAWAY/EOF/backend death; the server replays from
+// a bounded per-stream ring (same process) or regenerates
+// deterministically from the offset (restarted process). Exactly-once
+// delivery at the client by seq dedupe + reorder.
+//
+// Shape: reference brpc streaming RPC (StreamSettings handshake riding
+// the rpc meta, data on the same connection) + the staged bounded-buffer
+// orchestration of DMA-streaming-style token planes: a stalled consumer
+// parks the WRITER fiber — queues never grow unbounded.
+
+namespace push_stream {
+
+constexpr int kStreamVersion = 1;
+
+// StreamFrame.kind values (rpc_meta.proto).
+enum FrameKind { KIND_DATA = 1, KIND_ACK = 2, KIND_CLOSE = 3 };
+// StreamFrame.flags bits on DATA.
+constexpr uint32_t kFlagEos = 1u;
+constexpr uint32_t kFlagAbort = 2u;
+
+struct ServerStreamState;
+struct ReceiverState;
+
+// Handler-facing writer returned by Controller::accept_stream(). Cheap
+// shared handle; Write parks the calling fiber while the receiver's
+// credit window or the replay ring is exhausted and while the stream is
+// awaiting (re)binding to a connection.
+class StreamWriter {
+public:
+    StreamWriter() = default;
+    explicit StreamWriter(std::shared_ptr<ServerStreamState> st);
+    bool valid() const { return state_ != nullptr; }
+    uint64_t stream_id() const;
+    // Client-held last contiguous seq at (re)open: generate/replay from
+    // resume_from()+1. 0 = fresh stream.
+    uint64_t resume_from() const;
+    // Same-process resume rebind: the original generator fiber still
+    // owns this stream (parked on the dead socket) — the handler must
+    // NOT start a second generator; the replay ring + the woken writer
+    // cover continuation.
+    bool resumed_in_place() const;
+    // Queue + send one chunk (seq auto-assigned). Parks until credits,
+    // ring space and a bound connection are available. Returns 0, or a
+    // TERR_* code once the stream is aborted/expired.
+    int Write(const std::string& chunk, bool eos = false);
+    uint64_t last_seq() const;  // highest seq handed to Write
+    void Abort(int error_code);
+
+private:
+    std::shared_ptr<ServerStreamState> state_;
+};
+
+// Client-side stream call: owns the receiver registration for one
+// logical stream across open + any number of resumes (SAME stream_id —
+// the server's resume registry and the client's dedupe state key on it).
+class StreamCall {
+public:
+    StreamCall();
+    ~StreamCall();
+    StreamCall(const StreamCall&) = delete;
+    StreamCall& operator=(const StreamCall&) = delete;
+    uint64_t stream_id() const { return id_; }
+    uint64_t last_seq() const;     // last contiguous seq delivered
+    uint64_t duplicates() const;   // deduped chunk arrivals (exactly-once)
+    // Seed the resume origin of a FRESH call (relay use: a front door
+    // resuming a client's offset against a new backend): PrepareOpen
+    // stamps resume_from = `from` and delivery starts at from+1. No-op
+    // once anything has arrived.
+    void SeedResume(uint64_t from);
+    // Stamp open/resume settings (push=true, version, -stream_rx_window,
+    // resume_from = last_seq()) on the RPC about to be issued. Call
+    // before EVERY open attempt, including resumes.
+    void PrepareOpen(Controller* cntl);
+    // Next contiguous chunk. Returns 0 (chunk+seq filled), 1 = stream
+    // complete (EOS delivered), or a TERR_* code — on a retriable code
+    // (TERR_EOF / TERR_RPC_TIMEDOUT) re-issue the open via PrepareOpen
+    // to resume.
+    int Read(std::string* chunk, uint64_t* seq, int timeout_ms);
+
+private:
+    uint64_t id_ = 0;
+    std::shared_ptr<ReceiverState> rx_;
+};
+
+// ---- internals shared with policy_tpu_std / the portal ----
+
+// One STREAM_* frame arrived on `socket_id` (DATA payload in *payload).
+void OnFrame(VRefId socket_id, uint64_t stream_id, int kind, uint64_t seq,
+             uint32_t flags, uint64_t ack_seq, int64_t credits,
+             int error_code, IOBuf* payload);
+// The accept response for `stream_id` was written to `socket_id`: bind
+// the stream, grant the open's credit window, replay unacked ring
+// entries, wake the writer.
+void Activate(uint64_t stream_id, VRefId socket_id);
+// The open's call failed after accept_stream(): abort without a bind.
+void AbortServerStream(uint64_t stream_id, int error_code);
+uint64_t NewClientStreamId();
+void ExposeVars();              // rpc_stream_* families, 0-valued
+int64_t RingHighwater();        // process-wide replay-ring high-water
+std::string DescribeText();     // /streams
+std::string DescribeJson();     // /streams?format=json
+int64_t Opens();
+int64_t Resumed();
+int64_t ReplayedChunks();
+int64_t CreditStalls();
+int64_t Aborts();
+
+}  // namespace push_stream
 
 // ---- internals shared with the protocol layer ----
 

@@ -228,6 +228,23 @@ def test_metrics_exposition_lint(cpp_build, tmp_path):
             assert re.search(
                 r'^rpc_transport_out_bytes\{transport="%s"\} \d+$' % tier,
                 text, re.M), tier
+        # ISSUE 17 resumable push-stream families: every counter present
+        # (0-valued, eagerly exposed) before the first stream, plus the
+        # time-to-first-token summary — and /streams renders in both
+        # forms with the counters the restart soak scrapes.
+        for fam in ("rpc_stream_open", "rpc_stream_resumed",
+                    "rpc_stream_replayed_chunks",
+                    "rpc_stream_credit_stalls", "rpc_stream_aborts"):
+            assert families.get(fam) == "gauge", (fam, sorted(families))
+            assert re.search(r"^%s \d+$" % fam, text, re.M), fam
+        assert families.get("rpc_stream_ttft_us") == "summary", \
+            sorted(families)
+        streams = json.loads(_http_get(port, "/streams?format=json"))
+        for key in ("open", "resumed", "replayed_chunks",
+                    "credit_stalls", "aborts", "ring_highwater"):
+            assert key in streams, (key, streams)
+        assert isinstance(streams.get("server_streams"), list), streams
+        assert "push streams" in _http_get(port, "/streams")
         # ISSUE 14 locality-zone LB: spill accounting present (0-valued)
         # before any cross-zone member exists.
         assert families.get("rpc_lb_zone_spills") == "gauge", \

@@ -1,0 +1,217 @@
+"""Resumable server-push stream restart soak (ISSUE 17 capstone).
+
+Six `mesh_node` backends sit behind one `tpu_router`. A gold-tenant
+rpc_press opens resumable 256-token server-push streams (sticky
+sessions, seq contiguity + deterministic token content asserted at the
+client on EVERY chunk) while a bronze-tenant press floods the plain
+admission path — and EVERY backend is SIGTERM-restarted under that
+load. The router terminates client streams and pumps them from the
+backends, so backend death must be client-invisible: the pump re-pins
+and resumes downstream, the upstream replay ring covers what the dead
+backend never delivered.
+
+Asserted invariants — the exactly-once token contract:
+  * ZERO client-visible stream failures and ZERO sequencing errors:
+    every delivered token arrived exactly once, in order, with the
+    content regeneration determinism demands (press_stream_seq_errors
+    == 0, press_failed == 0 at the gold press);
+  * streams actually RESUMED: the router re-opened backend streams
+    with a resume offset (stream_relay_resumes > 0) and restarted
+    backends regenerated from the client floor (the backends'
+    rpc_stream_resumed metric fired);
+  * gold stayed responsive: TTFT p99 under chaos + bronze flood within
+    2x the unloaded baseline (100ms floor absorbs tiny-baseline CI
+    noise);
+  * a credit-stalled slow consumer bounds server memory: the stall
+    parks the writer (rpc_stream_credit_stalls > 0 at the router) and
+    the replay ring high-water respects -stream_replay_ring;
+  * descriptor-lease pins drain to 0 and every process exits clean.
+"""
+import json
+import signal
+import subprocess
+import time
+
+from test_chaos_soak import Node, _free_ports, _http_get, _var
+from test_router_restart_soak import (BACKEND_ARGS, BACKEND_FLAGS, Router,
+                                      _wait_line)
+
+NUM_BACKENDS = 6
+STREAM_TOKENS = 256
+CHAOS_DURATION_S = 30
+REPLAY_RING_CAP = 128  # -stream_replay_ring default
+# 20ms/token => a 256-token stream runs ~5s, far past the 800ms drain
+# window. That is the point: a SIGTERMed backend CANNOT finish its
+# in-flight streams inside the drain, so the router pump must resume
+# them on a survivor (registry miss + resume_from => regeneration).
+# With the default 2ms pacing every stream slips out during the drain
+# and the resume path is never exercised.
+TOKEN_DELAY_US = 20000
+STREAM_ARGS = BACKEND_ARGS + ("--stream_token_delay_us",
+                              str(TOKEN_DELAY_US))
+
+
+def _press_json(out):
+    lines = [l for l in out.decode().splitlines() if l.startswith("{")]
+    assert lines, "press produced no json report: %r" % out
+    return json.loads(lines[-1])
+
+
+def _stream_press(press_bin, router_port, tokens, qps, duration_s,
+                  sessions, callers, extra=()):
+    return subprocess.Popen(
+        [str(press_bin),
+         "--server=127.0.0.1:%d" % router_port,
+         "--stream_tokens=%d" % tokens,
+         "--qps=%d" % qps, "--duration_s=%d" % duration_s,
+         "--callers=%d" % callers, "--sessions=%d" % sessions,
+         "--tenant=gold", "--priority=7",
+         "--timeout_ms=3000", "--max_retry=0", "--json"] + list(extra),
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL)
+
+
+def test_stream_restart_soak(cpp_build, tmp_path):
+    mesh_bin = cpp_build / "mesh_node"
+    router_bin = cpp_build / "tpu_router"
+    press_bin = cpp_build / "rpc_press"
+    for b in (mesh_bin, router_bin, press_bin):
+        assert b.exists(), "%s not built" % b
+
+    ports = _free_ports(NUM_BACKENDS + 1)
+    backend_ports, router_port = ports[:NUM_BACKENDS], ports[NUM_BACKENDS]
+    backends_file = tmp_path / "stream_backends"
+    backends_file.write_text(
+        "".join("127.0.0.1:%d\n" % p for p in backend_ports))
+
+    def spawn_backend(i):
+        return Node(mesh_bin, backend_ports[i], i, backends_file,
+                    flags=BACKEND_FLAGS, extra_args=STREAM_ARGS)
+
+    backends = [spawn_backend(i) for i in range(NUM_BACKENDS)]
+    router = None
+    procs = []
+    try:
+        for n in backends:
+            assert n.wait_ready(), "backend %d never became ready" % n.idx
+        router = Router(router_bin, router_port, backends_file)
+        assert router.wait_ready(), "router never became ready"
+        time.sleep(0.5)  # first probe pass marks the backends live
+
+        # --- unloaded TTFT baseline: short gold-only stream press -----
+        base = _stream_press(press_bin, router_port, tokens=64, qps=6,
+                             duration_s=6, sessions=4, callers=4)
+        procs.append(base)
+        out, _ = base.communicate(timeout=40)
+        assert base.returncode == 0, "baseline press failed"
+        base_rep = _press_json(out)
+        assert base_rep["press_failed"] == 0, base_rep
+        assert base_rep["press_stream_seq_errors"] == 0, base_rep
+        assert base_rep["press_stream_tokens"] > 0, base_rep
+        baseline_ttft_p99 = base_rep["press_ttft_us"]["p99"]
+        assert baseline_ttft_p99 > 0, base_rep
+
+        # --- chaos: gold streams + bronze flood + rolling restarts ----
+        gold = _stream_press(press_bin, router_port, tokens=STREAM_TOKENS,
+                             qps=4, duration_s=CHAOS_DURATION_S,
+                             sessions=4, callers=4)
+        bronze = subprocess.Popen(
+            [str(press_bin),
+             "--server=127.0.0.1:%d" % router_port,
+             "--qps=300", "--duration_s=%d" % CHAOS_DURATION_S,
+             "--payload=2048", "--callers=8",
+             "--tenant=bronze", "--priority=1",
+             "--timeout_ms=3000", "--json"],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL)
+        procs += [gold, bronze]
+        time.sleep(2.5)  # streams open, sessions pin, flood warms
+
+        for i in range(NUM_BACKENDS):
+            n = backends[i]
+            n.proc.send_signal(signal.SIGTERM)
+            assert _wait_line(n, "DRAINING", 10.0) is not None, (
+                "backend %d never announced its drain" % i)
+            assert n.proc.wait(timeout=20) is not None
+            assert n.proc.returncode == 0, (
+                "backend %d unclean graceful exit: %d"
+                % (i, n.proc.returncode))
+            backends[i] = spawn_backend(i)
+            assert backends[i].wait_ready(), (
+                "backend %d restart failed" % i)
+            time.sleep(1.0)  # streams re-pin + resume before the next kill
+
+        out, _ = gold.communicate(timeout=CHAOS_DURATION_S + 60)
+        assert gold.returncode == 0, "gold press failed"
+        rep = _press_json(out)
+        bout, _ = bronze.communicate(timeout=30)
+        assert bronze.returncode == 0, "bronze press failed"
+        bronze_rep = _press_json(bout)
+        assert bronze_rep["press_qps"] > 0, bronze_rep
+
+        # Exactly-once, in order, right content — across six restarts.
+        assert rep["press_failed"] == 0, (
+            "client-visible stream failures: %r" % rep)
+        assert rep["press_stream_seq_errors"] == 0, (
+            "lost/duplicated/corrupt tokens reached a client: %r" % rep)
+        assert rep["press_qps"] > 0, "no gold stream ever completed"
+        assert rep["press_stream_tokens"] >= STREAM_TOKENS, rep
+
+        # Gold TTFT under chaos + flood stays within 2x unloaded.
+        allowed = max(2 * baseline_ttft_p99, 100000)
+        assert rep["press_ttft_us"]["p99"] <= allowed, (
+            "gold TTFT p99 %dus vs allowed %dus (baseline %dus): %r"
+            % (rep["press_ttft_us"]["p99"], allowed, baseline_ttft_p99,
+               rep))
+        assert rep["press_itl_us"]["p99"] > 0, rep
+
+        # The resume machinery actually fired: the router re-opened
+        # backend streams at an offset...
+        state = router.state()
+        assert state["stream_relays"] > 0, state
+        assert state["stream_relay_resumes"] > 0, (
+            "no downstream stream ever resumed across six backend "
+            "restarts: %r" % state)
+        # ...and restarted backends regenerated from the client floor.
+        resumed = sum(_var(p, "rpc_stream_resumed")
+                      for p in backend_ports)
+        assert resumed > 0, (
+            "no backend counted rpc_stream_resumed after the restarts")
+
+        # --- slow consumer: credits park the writer, ring stays bounded
+        # Producer paces at 20ms/token; a 100ms-per-read consumer falls
+        # behind by ~40 tokens/s, exhausting the rx window well inside
+        # the press — the writer must park on credits, not buffer.
+        slow = _stream_press(press_bin, router_port, tokens=64, qps=1,
+                             duration_s=6, sessions=1, callers=1,
+                             extra=("--stream_read_delay_ms=100",))
+        procs.append(slow)
+        sout, _ = slow.communicate(timeout=60)
+        assert slow.returncode == 0, "slow-consumer press failed"
+        slow_rep = _press_json(sout)
+        assert slow_rep["press_stream_seq_errors"] == 0, slow_rep
+        streams = json.loads(
+            _http_get(router_port, "/streams?format=json", timeout=2.0))
+        assert streams["credit_stalls"] > 0, (
+            "slow consumer never parked the writer: %r" % streams)
+        assert 0 < streams["ring_highwater"] <= REPLAY_RING_CAP, (
+            "replay ring exceeded its bound: %r" % streams)
+
+        # --- clean drains: router REPORT, pins at 0, backends exit 0 --
+        router.proc.send_signal(signal.SIGTERM)
+        assert _wait_line(router, "DRAINING", 10.0) is not None, (
+            "router never announced its drain")
+        line = _wait_line(router, "REPORT ", 30.0)
+        assert line is not None, "router produced no exit report"
+        final = json.loads(line[len("REPORT "):])
+        assert final["pool_pinned"] == 0, (
+            "descriptor-lease pins leaked at router exit: %r" % final)
+        assert router.proc.wait(timeout=30) == 0, "router unclean exit"
+        for n in backends:
+            assert n.shutdown() == 0, "backend %d unclean exit" % n.idx
+    finally:
+        for p in [router] + backends + procs:
+            if p is None:
+                continue
+            try:
+                p.proc.kill() if hasattr(p, "proc") else p.kill()
+            except OSError:
+                pass

@@ -63,6 +63,7 @@
 #include "trpc/pb_compat.h"
 #include "trpc/policy_tpu_std.h"
 #include "trpc/server.h"
+#include "trpc/stream.h"
 #include "tvar/variable.h"
 
 using namespace tpurpc;
@@ -72,6 +73,9 @@ namespace {
 // Delay-phase knobs (stdin "delay H S"): handler sleep + stale-call
 // budget. Stale executions are the soak's proof of (non-)shedding.
 std::atomic<int> g_handler_delay_ms{0};
+// Inter-token generation delay of the push-stream handler (ISSUE 17) —
+// models a device decode step per token.
+std::atomic<int64_t> g_stream_token_delay_us{2000};
 std::atomic<int> g_stale_budget_ms{0};
 std::atomic<int64_t> g_stale_executed{0};
 // --traffic_delay_ms: traffic fibers idle this long after launch so a
@@ -82,6 +86,30 @@ std::atomic<int> g_traffic_delay_ms{0};
 
 struct NodeState;
 void TrafficStartDelay(NodeState* st);
+
+// Detached token generator for one accepted push stream (ISSUE 17):
+// writes "tok:<key>:<i>" for i = resume_from+1 .. n with a per-token
+// delay. DETERMINISTIC in (key, i) — a restarted process regenerates
+// exactly the tokens the client has not seen, which is what makes the
+// resume exactly-once across process death.
+struct StreamGenArgs {
+    push_stream::StreamWriter w;
+    unsigned long long n = 0;
+    std::string key;
+};
+
+void* RunStreamGen(void* arg) {
+    std::unique_ptr<StreamGenArgs> a((StreamGenArgs*)arg);
+    const int64_t delay =
+        g_stream_token_delay_us.load(std::memory_order_relaxed);
+    for (unsigned long long i = a->w.resume_from() + 1; i <= a->n; ++i) {
+        char tok[128];
+        snprintf(tok, sizeof(tok), "tok:%s:%llu", a->key.c_str(), i);
+        if (a->w.Write(tok, i == a->n) != 0) break;
+        if (delay > 0 && i < a->n) fiber_usleep(delay);
+    }
+    return nullptr;
+}
 
 class EchoServiceImpl : public benchpb::EchoService {
 public:
@@ -148,6 +176,34 @@ public:
                                                      &data)) {
                 memset(data, 'r', (size_t)rsp_n);
                 cntl->set_response_pool_attachment(std::move(out));
+            }
+        }
+        // Push-stream serving (ISSUE 17): a "stream:N:key" payload asks
+        // for N tokens streamed after this response. An in-place resume
+        // (same process, generator still live) must NOT start a second
+        // generator — the replay ring + the rebound writer continue it.
+        unsigned long long stream_n = 0;
+        char stream_key[64] = {0};
+        if (sscanf(request->payload().c_str(), "stream:%llu:%63s",
+                   &stream_n, stream_key) == 2 &&
+            stream_n > 0 && stream_n <= (1u << 20)) {
+            push_stream::StreamWriter w = cntl->accept_stream();
+            if (!w.valid()) {
+                cntl->SetFailed(TERR_REQUEST,
+                                "stream payload without push open");
+            } else if (!w.resumed_in_place()) {
+                auto* a = new StreamGenArgs;
+                a->w = w;
+                a->n = stream_n;
+                a->key = stream_key;
+                fiber_t tid;
+                if (fiber_start_background(&tid, nullptr, RunStreamGen,
+                                           a) != 0) {
+                    delete a;
+                    w.Abort(TERR_INTERNAL);
+                    cntl->SetFailed(TERR_INTERNAL,
+                                    "stream generator spawn failed");
+                }
             }
         }
         response->set_send_ts_us(request->send_ts_us());
@@ -875,7 +931,10 @@ void PrintReport(int id, int port, const Counters& c) {
         "\"goaways_sent\": %lld, "
         "\"zone\": \"%s\", \"zone_spills\": %lld, "
         "\"zone_local_picks\": %lld, \"zone_partition_cuts\": %lld, "
-        "\"dcn_out_bytes\": %lld, \"dcn_in_bytes\": %lld}\n",
+        "\"dcn_out_bytes\": %lld, \"dcn_in_bytes\": %lld, "
+        "\"stream_open\": %lld, \"stream_resumed\": %lld, "
+        "\"stream_replayed\": %lld, \"stream_credit_stalls\": %lld, "
+        "\"stream_aborts\": %lld, \"stream_ring_hw\": %lld}\n",
         id, port, (long long)c.lb_issued.load(), (long long)c.lb_ok.load(),
         (long long)c.lb_failed.load(), (long long)c.shm_issued.load(),
         (long long)c.shm_ok.load(), (long long)c.shm_failed.load(),
@@ -914,7 +973,12 @@ void PrintReport(int id, int port, const Counters& c) {
         (long long)VarInt("rpc_lb_zone_local_picks"),
         (long long)FaultInjection::zone_partition_cuts(),
         (long long)transport_stats::out_bytes(TierDcn()),
-        (long long)transport_stats::in_bytes(TierDcn()));
+        (long long)transport_stats::in_bytes(TierDcn()),
+        (long long)push_stream::Opens(), (long long)push_stream::Resumed(),
+        (long long)push_stream::ReplayedChunks(),
+        (long long)push_stream::CreditStalls(),
+        (long long)push_stream::Aborts(),
+        (long long)push_stream::RingHighwater());
     fflush(stdout);
 }
 
@@ -1004,6 +1068,10 @@ int main(int argc, char** argv) {
             // before the final GracefulStop (rolling restarts observe
             // /status draining:1 during it).
             drain_ms = atoi(argv[++i]);
+        } else if (strcmp(argv[i], "--stream_token_delay_us") == 0 &&
+                   i + 1 < argc) {
+            g_stream_token_delay_us.store(atoll(argv[++i]),
+                                          std::memory_order_relaxed);
         } else if (strcmp(argv[i], "--traffic_delay_ms") == 0 &&
                    i + 1 < argc) {
             g_traffic_delay_ms.store(atoi(argv[++i]),

@@ -657,6 +657,14 @@ public:
     void set_stream_id(uint64_t v) { stream_id_ = v; }
     int64_t window_size() const { return window_size_; }
     void set_window_size(int64_t v) { window_size_ = v; }
+    int version() const { return version_; }
+    void set_version(int v) { version_ = v; }
+    int64_t rx_window() const { return rx_window_; }
+    void set_rx_window(int64_t v) { rx_window_ = v; }
+    uint64_t resume_from_seq() const { return resume_from_seq_; }
+    void set_resume_from_seq(uint64_t v) { resume_from_seq_ = v; }
+    bool push() const { return push_; }
+    void set_push(bool v) { push_ = v; }
     void Clear() override { *this = StreamSettings(); }
     bool SerializeToString(std::string* out) const override {
         out->clear();
@@ -664,6 +672,14 @@ public:
         if (window_size_ != 0) {
             pbstub::wire::put_u(out, 2, (uint64_t)window_size_);
         }
+        if (version_ != 0) pbstub::wire::put_u(out, 3, (uint64_t)version_);
+        if (rx_window_ != 0) {
+            pbstub::wire::put_u(out, 4, (uint64_t)rx_window_);
+        }
+        if (resume_from_seq_ != 0) {
+            pbstub::wire::put_u(out, 5, resume_from_seq_);
+        }
+        if (push_) pbstub::wire::put_u(out, 6, 1);
         return true;
     }
     bool ParseFromString(const std::string& s) override {
@@ -675,12 +691,72 @@ public:
         while (r.next(&f, &wt, &v, &sub, &ok)) {
             if (f == 1) stream_id_ = v;
             if (f == 2) window_size_ = (int64_t)v;
+            if (f == 3) version_ = (int)v;
+            if (f == 4) rx_window_ = (int64_t)v;
+            if (f == 5) resume_from_seq_ = v;
+            if (f == 6) push_ = v != 0;
         }
         return ok;
     }
 private:
-    uint64_t stream_id_ = 0;
-    int64_t window_size_ = 0;
+    uint64_t stream_id_ = 0, resume_from_seq_ = 0;
+    int64_t window_size_ = 0, rx_window_ = 0;
+    int version_ = 0;
+    bool push_ = false;
+};
+
+class StreamFrame : public google::protobuf::Message {
+public:
+    uint64_t stream_id() const { return stream_id_; }
+    void set_stream_id(uint64_t v) { stream_id_ = v; }
+    uint64_t seq() const { return seq_; }
+    void set_seq(uint64_t v) { seq_ = v; }
+    int kind() const { return kind_; }
+    void set_kind(int v) { kind_ = v; }
+    uint32_t flags() const { return flags_; }
+    void set_flags(uint32_t v) { flags_ = v; }
+    uint64_t ack_seq() const { return ack_seq_; }
+    void set_ack_seq(uint64_t v) { ack_seq_ = v; }
+    int64_t credits() const { return credits_; }
+    void set_credits(int64_t v) { credits_ = v; }
+    int error_code() const { return error_code_; }
+    void set_error_code(int v) { error_code_ = v; }
+    void Clear() override { *this = StreamFrame(); }
+    bool SerializeToString(std::string* out) const override {
+        out->clear();
+        pbstub::wire::put_u(out, 1, stream_id_);
+        if (seq_ != 0) pbstub::wire::put_u(out, 2, seq_);
+        if (kind_ != 0) pbstub::wire::put_u(out, 3, (uint64_t)kind_);
+        if (flags_ != 0) pbstub::wire::put_u(out, 4, flags_);
+        if (ack_seq_ != 0) pbstub::wire::put_u(out, 5, ack_seq_);
+        if (credits_ != 0) pbstub::wire::put_u(out, 6, (uint64_t)credits_);
+        if (error_code_ != 0) {
+            pbstub::wire::put_u(out, 7, (uint64_t)error_code_);
+        }
+        return true;
+    }
+    bool ParseFromString(const std::string& s) override {
+        pbstub::wire::Reader r(s);
+        uint32_t f = 0, wt = 0;
+        uint64_t v = 0;
+        std::string sub;
+        bool ok = true;
+        while (r.next(&f, &wt, &v, &sub, &ok)) {
+            if (f == 1) stream_id_ = v;
+            if (f == 2) seq_ = v;
+            if (f == 3) kind_ = (int)v;
+            if (f == 4) flags_ = (uint32_t)v;
+            if (f == 5) ack_seq_ = v;
+            if (f == 6) credits_ = (int64_t)v;
+            if (f == 7) error_code_ = (int)v;
+        }
+        return ok;
+    }
+private:
+    uint64_t stream_id_ = 0, seq_ = 0, ack_seq_ = 0;
+    int64_t credits_ = 0;
+    uint32_t flags_ = 0;
+    int kind_ = 0, error_code_ = 0;
 };
 
 class RpcMeta : public google::protobuf::Message {
@@ -737,6 +813,12 @@ public:
         has_pool_attachment_ = true;
         return &pool_attachment_;
     }
+    bool has_stream_frame() const { return has_stream_frame_; }
+    const StreamFrame& stream_frame() const { return stream_frame_; }
+    StreamFrame* mutable_stream_frame() {
+        has_stream_frame_ = true;
+        return &stream_frame_;
+    }
 
     // Full real proto2 wire format (pbstub_wire.h helpers).
     void Clear() override { *this = RpcMeta(); }
@@ -768,6 +850,9 @@ public:
         if (desc_ack_) pbstub::wire::put_u(out, 12, 1);
         if (desc_ack_token_ != 0) {
             pbstub::wire::put_u(out, 13, desc_ack_token_);
+        }
+        if (has_stream_frame_) {
+            pbstub::wire::put_msg(out, 14, stream_frame_);
         }
         return true;
     }
@@ -811,6 +896,11 @@ public:
                     break;
                 case 12: desc_ack_ = v != 0; break;
                 case 13: desc_ack_token_ = v; break;
+                case 14:
+                    if (!mutable_stream_frame()->ParseFromString(sub)) {
+                        return false;
+                    }
+                    break;
                 default: break;
             }
         }
@@ -821,6 +911,7 @@ private:
     RpcResponseMeta response_;
     StreamSettings stream_settings_;
     PoolDescriptor pool_attachment_;
+    StreamFrame stream_frame_;
     std::string auth_data_;
     uint64_t correlation_id_ = 0, desc_ack_token_ = 0;
     uint32_t attachment_size_ = 0, body_checksum_ = 0;
@@ -828,7 +919,7 @@ private:
     bool has_request_ = false, has_response_ = false;
     bool has_stream_settings_ = false, has_body_checksum_ = false;
     bool cancel_ = false, goaway_ = false, desc_ack_ = false;
-    bool has_pool_attachment_ = false;
+    bool has_pool_attachment_ = false, has_stream_frame_ = false;
 };
 
 }  // namespace rpc

@@ -81,6 +81,7 @@
 #include "tnet/transport.h"
 #include "trpc/channel.h"
 #include "trpc/controller.h"
+#include "trpc/stream.h"
 #include "tvar/latency_recorder.h"
 #include "tvar/variable.h"
 
@@ -165,6 +166,16 @@ struct TenantGen {
     std::atomic<int64_t> backoff_ms_max{0};
     int64_t granted = 0;
     int64_t last_sent = 0;  // interval reporting
+    // --stream_tokens mode: per-class inference-serving latencies —
+    // time-to-first-token from the FIRST open attempt, and the gap
+    // between consecutive delivered tokens (resume pauses included:
+    // both are what the end user of a token stream actually waits).
+    LatencyRecorder ttft;
+    LatencyRecorder itl;
+    std::atomic<int64_t> stream_tokens_rx{0};
+    std::atomic<int64_t> stream_resumes{0};
+    std::atomic<int64_t> stream_seq_errors{0};
+    std::atomic<int64_t> stream_dups{0};
 };
 
 struct PressCtx {
@@ -174,6 +185,8 @@ struct PressCtx {
     int64_t timeout_ms;
     bool pool_desc = false;
     std::string session;  // --sessions: sticky id stamped on every call
+    long long stream_tokens = 0;   // --stream_tokens: tokens per stream
+    int stream_read_delay_ms = 0;  // --stream_read_delay_ms: slow consumer
 };
 
 // Ctrl-C / SIGINT: finish the current interval cleanly — flush the final
@@ -181,6 +194,101 @@ struct PressCtx {
 // summary — instead of dying mid-write with a torn CSV.
 volatile sig_atomic_t g_sigint = 0;
 void OnSigint(int) { g_sigint = 1; }
+
+// One streamed inference "call" (--stream_tokens, ISSUE 17): open a
+// server-push stream, consume the token stream asserting contiguous
+// seqs AND deterministic content ("tok:<key>:<seq>"), and drive the
+// resume funnel through the SAME StreamCall on EOF/timeout/backend
+// death — the generator is the exactly-once prover. Returns true when
+// the full stream (all N tokens + EOS) was delivered.
+bool StreamOnce(PressCtx* c, TenantGen* g) {
+    push_stream::StreamCall call;
+    char key[32];
+    snprintf(key, sizeof(key), "k%llx",
+             (unsigned long long)call.stream_id());
+    char payload[96];
+    snprintf(payload, sizeof(payload), "stream:%lld:%s",
+             c->stream_tokens, key);
+    const int64_t t_open = monotonic_time_us();
+    uint64_t expect = 0;  // last contiguous seq we verified
+    int opens = 0;
+    bool ttft_done = false;
+    int64_t last_tok_us = 0;
+    bool complete = false;
+    while (!complete && !c->stop->load(std::memory_order_relaxed)) {
+        Controller cntl;
+        cntl.set_timeout_ms(c->timeout_ms);
+        if (!g->name.empty()) cntl.set_tenant(g->name);
+        if (g->priority >= 0) cntl.set_priority(g->priority);
+        if (!c->session.empty()) cntl.set_session(c->session);
+        call.PrepareOpen(&cntl);
+        benchpb::EchoRequest req;
+        benchpb::EchoResponse res;
+        req.set_send_ts_us(monotonic_time_us());
+        req.set_payload(payload);
+        c->stub->Echo(&cntl, &req, &res, nullptr);
+        if (++opens > 1) {
+            g->stream_resumes.fetch_add(1, std::memory_order_relaxed);
+        }
+        if (cntl.Failed()) {
+            // Any open failure is retriable through the funnel: the
+            // router/backend that refused may be mid-restart. Bounded
+            // so a misconfigured target still terminates.
+            if (opens < 25) {
+                fiber_usleep(100 * 1000);
+                continue;
+            }
+            break;
+        }
+        bool reopen = false;
+        while (!c->stop->load(std::memory_order_relaxed)) {
+            std::string chunk;
+            uint64_t seq = 0;
+            const int rc = call.Read(
+                &chunk, &seq,
+                (int)std::max<int64_t>(1, c->timeout_ms));
+            if (rc == 0) {
+                const int64_t now = monotonic_time_us();
+                if (!ttft_done) {
+                    g->ttft << now - t_open;
+                    ttft_done = true;
+                } else {
+                    g->itl << now - last_tok_us;
+                }
+                last_tok_us = now;
+                char want[64];
+                snprintf(want, sizeof(want), "tok:%s:%llu", key,
+                         (unsigned long long)seq);
+                if (seq != expect + 1 || chunk != want) {
+                    g->stream_seq_errors.fetch_add(
+                        1, std::memory_order_relaxed);
+                }
+                expect = seq;
+                g->stream_tokens_rx.fetch_add(1,
+                                              std::memory_order_relaxed);
+                if (c->stream_read_delay_ms > 0) {
+                    // Slow consumer: stops granting credits while
+                    // sleeping — the server-side writer must park.
+                    fiber_usleep((int64_t)c->stream_read_delay_ms * 1000);
+                }
+            } else if (rc == 1) {
+                complete = expect == (uint64_t)c->stream_tokens;
+                break;
+            } else if (rc == TERR_EOF || rc == TERR_RPC_TIMEDOUT ||
+                       rc == TERR_FAILED_SOCKET) {
+                reopen = opens < 25;
+                break;
+            } else {
+                break;  // non-retriable abort
+            }
+        }
+        if (!reopen) break;
+    }
+    g->stream_dups.fetch_add((int64_t)call.duplicates(),
+                             std::memory_order_relaxed);
+    if (complete) g->lat << (monotonic_time_us() - t_open);
+    return complete;
+}
 
 void* PressCaller(void* arg) {
     auto* c = (PressCtx*)arg;
@@ -191,6 +299,16 @@ void* PressCaller(void* arg) {
         if (g->tokens.fetch_sub(1, std::memory_order_relaxed) <= 0) {
             g->tokens.fetch_add(1, std::memory_order_relaxed);
             fiber_usleep(200);
+            continue;
+        }
+        if (c->stream_tokens > 0) {
+            // One paced "call" = one full token stream. A stream cut
+            // short by shutdown is neither success nor failure.
+            if (StreamOnce(c, g)) {
+                g->sent.fetch_add(1, std::memory_order_relaxed);
+            } else if (!c->stop->load(std::memory_order_relaxed)) {
+                g->failed.fetch_add(1, std::memory_order_relaxed);
+            }
             continue;
         }
         Controller cntl;
@@ -298,6 +416,8 @@ int main(int argc, char** argv) {
     int sessions = 0;       // --sessions: sticky ids stamped per caller
     int priority = -1;
     int max_retry = -1;  // <0 = channel default (3)
+    long long stream_tokens = 0;  // --stream_tokens: push-stream mode
+    int stream_read_delay_ms = 0;
     for (int i = 1; i < argc; ++i) {
         if (strncmp(argv[i], "--metrics_csv=", 14) == 0) {
             metrics_csv = argv[i] + 14;
@@ -352,6 +472,12 @@ int main(int argc, char** argv) {
         if (strncmp(argv[i], "--tenants=", 10) == 0) {
             tenants_spec = argv[i] + 10;
         }
+        if (strncmp(argv[i], "--stream_tokens=", 16) == 0) {
+            stream_tokens = atoll(argv[i] + 16);
+        }
+        if (strncmp(argv[i], "--stream_read_delay_ms=", 23) == 0) {
+            stream_read_delay_ms = atoi(argv[i] + 23);
+        }
         if (strcmp(argv[i], "--pooled") == 0) pooled = true;
         if (strcmp(argv[i], "--pool_desc") == 0 ||
             strcmp(argv[i], "--pool-desc") == 0) {
@@ -369,10 +495,15 @@ int main(int argc, char** argv) {
                 "[--max_retry=N] [--tenant=NAME] [--priority=0..7] "
                 "[--tenants=name:weight[:prio[:payload_bytes]],...] "
                 "[--zone=NAME] [--dcn_peers=ip:port,...] "
-                "[--via=ip:port] [--sessions=N] [--json]\n"
+                "[--via=ip:port] [--sessions=N] "
+                "[--stream_tokens=N [--stream_read_delay_ms=N]] "
+                "[--json]\n"
                 "  --zone/--dcn_peers: zone-aware LB over the local "
                 "server + cross-pod dcn-tier peers; per-zone picks and "
-                "spills are reported\n");
+                "spills are reported\n"
+                "  --stream_tokens=N: each paced call opens a resumable "
+                "server-push stream of N tokens; contiguity is asserted "
+                "and TTFT p50/p99 + inter-token p99 reported\n");
         return 1;
     }
     EndPoint server;
@@ -521,7 +652,8 @@ int main(int argc, char** argv) {
                                 timeout_ms, pool_desc,
                                 i < sessions
                                     ? "s" + std::to_string(i)
-                                    : std::string()});
+                                    : std::string(),
+                                stream_tokens, stream_read_delay_ms});
     }
     std::vector<fiber_t> tids((size_t)callers);
     for (size_t i = 0; i < tids.size(); ++i) {
@@ -536,8 +668,11 @@ int main(int argc, char** argv) {
         const bool fresh = access(metrics_csv, F_OK) != 0;
         csv = fopen(metrics_csv, "a");
         if (csv != nullptr && fresh) {
+            // Stream columns APPENDED at the end: bench.py's
+            // series_scrape indexes qps/p99 positionally (c[1], c[3]).
             fprintf(csv,
-                    "elapsed_s,qps,p50_us,p99_us,p999_us,failed,tenant\n");
+                    "elapsed_s,qps,p50_us,p99_us,p999_us,failed,tenant,"
+                    "ttft_p50_us,ttft_p99_us,itl_p99_us\n");
         }
     }
 
@@ -573,26 +708,45 @@ int main(int argc, char** argv) {
                 }
             }
         }
+        // Headline stream latencies: the class with the most tokens.
+        long long ttft50 = 0, ttft99 = 0, itl99 = 0;
+        {
+            int64_t cnt = -1;
+            for (auto& g : gens) {
+                if (g->ttft.count() > cnt) {
+                    cnt = g->ttft.count();
+                    ttft50 = g->ttft.latency_percentile(0.5);
+                    ttft99 = g->ttft.latency_percentile(0.99);
+                    itl99 = g->itl.latency_percentile(0.99);
+                }
+            }
+        }
         printf("t=%llds qps=%lld p50=%lldus p99=%lldus p999=%lldus "
                "failed=%lld\n",
                elapsed_s, (long long)iqps, p50, p99, p999,
                (long long)total_failed);
         fflush(stdout);
         if (csv != nullptr) {
-            fprintf(csv, "%lld,%lld,%lld,%lld,%lld,%lld,all\n", elapsed_s,
-                    (long long)iqps, p50, p99, p999,
-                    (long long)total_failed);
+            fprintf(csv,
+                    "%lld,%lld,%lld,%lld,%lld,%lld,all,%lld,%lld,%lld\n",
+                    elapsed_s, (long long)iqps, p50, p99, p999,
+                    (long long)total_failed, ttft50, ttft99, itl99);
             if (gens.size() > 1) {
                 for (auto& g : gens) {
                     const int64_t s = g->sent.load(std::memory_order_relaxed);
-                    fprintf(csv, "%lld,%lld,%lld,%lld,%lld,%lld,%s\n",
+                    fprintf(csv,
+                            "%lld,%lld,%lld,%lld,%lld,%lld,%s,"
+                            "%lld,%lld,%lld\n",
                             elapsed_s, (long long)(s - g->last_sent),
                             (long long)g->lat.latency_percentile(0.5),
                             (long long)g->lat.latency_percentile(0.99),
                             (long long)g->lat.latency_percentile(0.999),
                             (long long)g->failed.load(
                                 std::memory_order_relaxed),
-                            g->name.empty() ? "default" : g->name.c_str());
+                            g->name.empty() ? "default" : g->name.c_str(),
+                            (long long)g->ttft.latency_percentile(0.5),
+                            (long long)g->ttft.latency_percentile(0.99),
+                            (long long)g->itl.latency_percentile(0.99));
                     g->last_sent = s;
                 }
             }
@@ -648,6 +802,16 @@ int main(int argc, char** argv) {
     for (auto& g : gens) {
         if (g->lat.count() > head->lat.count()) head = g.get();
     }
+    int64_t stream_rx = 0, stream_resumes = 0, stream_seq_errors = 0;
+    int64_t stream_dups = 0;
+    const TenantGen* shead = gens[0].get();  // most-token stream class
+    for (auto& g : gens) {
+        stream_rx += g->stream_tokens_rx.load();
+        stream_resumes += g->stream_resumes.load();
+        stream_seq_errors += g->stream_seq_errors.load();
+        stream_dups += g->stream_dups.load();
+        if (g->ttft.count() > shead->ttft.count()) shead = g.get();
+    }
     // --via: one scrape of the router's own view — backend-measured p99
     // and the hedge count — then the router-added latency is simply
     // client-observed p99 minus what the backends took.
@@ -686,6 +850,19 @@ int main(int argc, char** argv) {
                (long long)head->lat.latency_percentile(0.999),
                press_threads, callers, payload, pooled ? 1 : 0,
                pool_desc ? 1 : 0, (long long)total_stale);
+        if (stream_tokens > 0) {
+            printf(", \"press_ttft_us\": {\"p50\": %lld, \"p99\": %lld}, "
+                   "\"press_itl_us\": {\"p99\": %lld}, "
+                   "\"press_stream_tokens\": %lld, "
+                   "\"press_stream_resumes\": %lld, "
+                   "\"press_stream_seq_errors\": %lld, "
+                   "\"press_stream_dups\": %lld",
+                   (long long)shead->ttft.latency_percentile(0.5),
+                   (long long)shead->ttft.latency_percentile(0.99),
+                   (long long)shead->itl.latency_percentile(0.99),
+                   (long long)stream_rx, (long long)stream_resumes,
+                   (long long)stream_seq_errors, (long long)stream_dups);
+        }
         if (!via_str.empty()) {
             printf(", \"press_via_p99_us\": %lld, "
                    "\"press_via_backend_p99_us\": %lld, "
@@ -740,6 +917,16 @@ int main(int argc, char** argv) {
                (long long)head->lat.latency_percentile(0.99),
                (long long)head->lat.latency_percentile(0.999),
                (long long)head->lat.max_latency());
+        if (stream_tokens > 0) {
+            printf("streams: tokens %lld  resumes %lld  seq_errors %lld "
+                   " dups %lld  ttft_us p50 %lld p99 %lld  itl_us p99 "
+                   "%lld\n",
+                   (long long)stream_rx, (long long)stream_resumes,
+                   (long long)stream_seq_errors, (long long)stream_dups,
+                   (long long)shead->ttft.latency_percentile(0.5),
+                   (long long)shead->ttft.latency_percentile(0.99),
+                   (long long)shead->itl.latency_percentile(0.99));
+        }
         if (!via_str.empty()) {
             printf("via router %s: client p99 %lldus, backend p99 "
                    "%lldus, router-added p99 %lldus, hedges %lld\n",

@@ -66,6 +66,7 @@
 #include "trpc/naming_service.h"
 #include "trpc/qos.h"
 #include "trpc/server.h"
+#include "trpc/stream.h"
 #include "tvar/latency_recorder.h"
 #include "tvar/reducer.h"
 #include "tvar/variable.h"
@@ -82,6 +83,11 @@ LazyAdder g_hedge_wins("rpc_router_hedge_wins");
 LazyAdder g_reroutes("rpc_router_reroutes");
 LazyAdder g_session_repins("rpc_router_session_repins");
 LazyAdder g_edge_sheds("rpc_router_edge_sheds");
+// Push-stream relay (ISSUE 17): opened relays, backend-side resumes the
+// relay performed invisibly to the client, and relayed chunks.
+LazyAdder g_stream_relays("rpc_router_stream_relays");
+LazyAdder g_stream_relay_resumes("rpc_router_stream_relay_resumes");
+LazyAdder g_stream_relay_chunks("rpc_router_stream_relay_chunks");
 // Backend-measured forwarding latency (the mesh-side time of each
 // forwarded call): rpc_press --via subtracts its client-side p99 from
 // this family's p99 to report the router-added latency.
@@ -284,6 +290,82 @@ void FailUpstream(Controller* up, Controller* down) {
                   down->ErrorText().c_str());
 }
 
+// ---- push-stream relay (ISSUE 17) ----
+//
+// A streaming request is TERMINATED at the router: the client's stream
+// binds to the router (its server registry + replay ring), and a pump
+// fiber owns a SEPARATE downstream StreamCall against the pinned
+// backend. On backend death the pump re-pins and re-opens downstream
+// with resume_from = its own progress — the client never notices; its
+// own resumes (router connection loss) hit the router's registry and
+// replay from the router's ring.
+struct StreamRelayArgs {
+    push_stream::StreamWriter up;
+    std::string session;
+    std::string payload;       // the original "stream:N:key"
+    unsigned long long total = 0;  // N (EOS when relaying seq == N)
+};
+
+void* RunStreamRelay(void* arg) {
+    std::unique_ptr<StreamRelayArgs> a((StreamRelayArgs*)arg);
+    push_stream::StreamCall dcall;
+    dcall.SeedResume(a->up.resume_from());
+    int idle_rounds = 0;
+    bool first_open = true;
+    while (true) {
+        const int idx = PinForSession(a->session);
+        if (idx < 0) {
+            if (++idle_rounds > 100) {
+                a->up.Abort(EHOSTDOWN);
+                return nullptr;
+            }
+            fiber_usleep(100 * 1000);
+            continue;
+        }
+        idle_rounds = 0;
+        Backend* b = g_backends[idx].get();
+        Controller dcntl;
+        dcntl.set_max_retry(0);
+        dcntl.set_timeout_ms(2000);
+        dcntl.set_session(a->session);
+        dcall.PrepareOpen(&dcntl);
+        benchpb::EchoRequest dreq;
+        dreq.set_payload(a->payload);
+        dreq.set_send_ts_us(monotonic_time_us());
+        benchpb::EchoResponse dres;
+        benchpb::EchoService_Stub stub(b->ch.get());
+        stub.Echo(&dcntl, &dreq, &dres, nullptr);  // sync
+        if (dcntl.Failed()) {
+            if (SessionRetryable(dcntl.ErrorCode())) {
+                SetHealthAndRepin(
+                    idx, /*live=*/false,
+                    b->draining.load(std::memory_order_acquire));
+                continue;
+            }
+            a->up.Abort(dcntl.ErrorCode());
+            return nullptr;
+        }
+        if (!first_open) *g_stream_relay_resumes << 1;
+        first_open = false;
+        while (true) {
+            std::string chunk;
+            uint64_t seq = 0;
+            const int rc = dcall.Read(&chunk, &seq, 3000);
+            if (rc == 0) {
+                if (a->up.Write(chunk, seq == a->total) != 0) {
+                    return nullptr;  // upstream gone for good
+                }
+                *g_stream_relay_chunks << 1;
+            } else if (rc == 1) {
+                return nullptr;  // complete; EOS rode the last chunk
+            } else {
+                // TERR_EOF (backend died) / timeout: resume downstream.
+                break;
+            }
+        }
+    }
+}
+
 class RouterEchoService : public benchpb::EchoService {
 public:
     void Echo(google::protobuf::RpcController* cntl_base,
@@ -296,7 +378,9 @@ public:
         // whole context inherits through the fiber-local server call:
         // deadline cap, tenant/priority/session, trace parenting and
         // the cancel cascade (Channel::CallMethod / combo inheritance).
-        if (!cntl->session().empty()) {
+        if (cntl->has_push_stream_open()) {
+            ForwardStream(cntl, request, response);
+        } else if (!cntl->session().empty()) {
             ForwardSticky(cntl, request, response);
         } else {
             ForwardHedged(cntl, request, response);
@@ -333,6 +417,45 @@ private:
         }
         g_downstream_latency << elapsed;
         CopyEchoResponse(cntl, &dcntl, dres, response);
+    }
+
+    static void ForwardStream(Controller* cntl,
+                              const benchpb::EchoRequest* request,
+                              benchpb::EchoResponse* response) {
+        push_stream::StreamWriter up = cntl->accept_stream();
+        if (!up.valid()) {
+            *g_forward_failures << 1;
+            cntl->SetFailed(TERR_INTERNAL, "push-stream accept failed");
+            return;
+        }
+        response->set_send_ts_us(request->send_ts_us());
+        if (up.resumed_in_place()) {
+            // Client-side resume of a live relay: the router's replay
+            // ring + the rebound pump cover it — no second pump.
+            return;
+        }
+        unsigned long long n = 0;
+        char key[64] = {0};
+        if (sscanf(request->payload().c_str(), "stream:%llu:%63s", &n,
+                   key) != 2 ||
+            n == 0) {
+            up.Abort(TERR_REQUEST);
+            cntl->SetFailed(TERR_REQUEST, "bad stream payload");
+            return;
+        }
+        *g_stream_relays << 1;
+        auto* a = new StreamRelayArgs;
+        a->up = up;
+        a->session = cntl->session();
+        a->payload = request->payload();
+        a->total = n;
+        fiber_t tid;
+        if (fiber_start_background(&tid, nullptr, RunStreamRelay, a) !=
+            0) {
+            delete a;
+            up.Abort(TERR_INTERNAL);
+            cntl->SetFailed(TERR_INTERNAL, "relay spawn failed");
+        }
     }
 
     static void ForwardSticky(Controller* cntl,
@@ -434,7 +557,7 @@ void* ProbeFiber(void*) {
 // ---- /router portal page (+json) and the REPORT line ----
 
 void RouterStateJson(std::string* out) {
-    char buf[256];
+    char buf[512];
     // Live set and session map render under ONE g_sticky_mu hold, the
     // same lock every health flip + re-pin runs under: each snapshot is
     // a consistent cut — a session can never appear pinned to a backend
@@ -465,14 +588,19 @@ void RouterStateJson(std::string* out) {
         buf, sizeof(buf),
         "}, \"forwards\": %lld, \"forward_failures\": %lld, "
         "\"hedges\": %lld, \"hedge_wins\": %lld, \"reroutes\": %lld, "
-        "\"session_repins\": %lld, \"edge_sheds\": %lld, ",
+        "\"session_repins\": %lld, \"edge_sheds\": %lld, "
+        "\"stream_relays\": %lld, \"stream_relay_resumes\": %lld, "
+        "\"stream_relay_chunks\": %lld, ",
         (long long)VarInt("rpc_router_forwards"),
         (long long)VarInt("rpc_router_forward_failures"),
         (long long)VarInt("rpc_router_hedges"),
         (long long)VarInt("rpc_router_hedge_wins"),
         (long long)VarInt("rpc_router_reroutes"),
         (long long)VarInt("rpc_router_session_repins"),
-        (long long)VarInt("rpc_router_edge_sheds"));
+        (long long)VarInt("rpc_router_edge_sheds"),
+        (long long)VarInt("rpc_router_stream_relays"),
+        (long long)VarInt("rpc_router_stream_relay_resumes"),
+        (long long)VarInt("rpc_router_stream_relay_chunks"));
     out->append(buf);
     snprintf(buf, sizeof(buf),
              "\"backend_p99_us\": %lld, \"backend_avg_us\": %lld, "

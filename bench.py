@@ -687,6 +687,95 @@ def dcn_collective_scrape():
                     pass
 
 
+def verbs_scrape():
+    """ISSUE 18: verbs-backed collective exchange vs per-chunk RPCs on
+    the same mesh. Four --collective nodes; commanded rounds are lane-
+    pinned by alg name — `allreduce_verbs` posts ONE scatter-gather
+    REMOTE_WRITE per ring step into the successor's leased pool window
+    (plus a sync doorbell), `allreduce_chunks` forces the per-chunk
+    descriptor-RPC exchange the verbs lane replaces. The recorded
+    ratio is the acceptance gate (>= 1.0: one SGL verb per step must
+    not be slower than N chunk RPCs), and the verbs rounds' zero-
+    fallback counter proves the lane really ran one-sided instead of
+    silently degrading to the chunk path."""
+    node = BUILD / "mesh_node"
+    if not node.exists():
+        return None
+    num = 4
+    socks, ports = [], []
+    for _ in range(num):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    nodes = []
+    try:
+        with tempfile.TemporaryDirectory() as td:
+            peers = Path(td) / "peers"
+            peers.write_text("".join("127.0.0.1:%d\n" % p for p in ports))
+            for p in ports:
+                nodes.append(_CollNode(node, p, peers))
+            for n in nodes:
+                if not n.wait_ready():
+                    return None
+            time.sleep(2.0)  # shm links + pool handshakes
+
+            seq = [400]  # distinct command-seq space from other rounds
+
+            def round_once(alg, nbytes):
+                seq[0] += 1
+                for n in nodes:
+                    n.send("coll %s %d %d" % (alg, nbytes, seq[0]))
+                deadline = time.time() + 90.0
+                reps = [n.coll_line(deadline) for n in nodes]
+                if any(r is None or not r.get("ok") or
+                       not r.get("verified") for r in reps):
+                    return None
+                return reps
+
+            def busbw(alg, nbytes, reps=REPS):
+                """Median-of-reps slowest-node busbw + the verb lane's
+                step/fallback evidence summed over every round."""
+                vals, steps, fallbacks = [], 0, 0
+                for _ in range(reps):
+                    rs = round_once(alg, nbytes)
+                    if rs is None:
+                        return None, steps, fallbacks
+                    vals.append(min(r["busbw_mbps"] for r in rs))
+                    steps += sum(r.get("verb_steps", 0) for r in rs)
+                    fallbacks += sum(
+                        r.get("verb_fallback_chunks", 0) for r in rs)
+                return statistics.median(vals), steps, fallbacks
+
+            verbs, vsteps, vfall = busbw("allreduce_verbs", 4 << 20)
+            chunk, _, _ = busbw("allreduce_chunks", 4 << 20)
+            if verbs is None or chunk is None or chunk <= 0:
+                return None
+            return {
+                "coll_verbs_busbw_mbps": round(verbs, 1),
+                "coll_chunk_busbw_mbps": round(chunk, 1),
+                "coll_verbs_vs_chunk_ratio": round(verbs / chunk, 2),
+                "coll_verbs_steps": vsteps,
+                "coll_verbs_zero_fallback": int(vfall == 0),
+                "coll_verbs_nranks": num,
+            }
+    except Exception:
+        return None
+    finally:
+        for n in nodes:
+            try:
+                n.proc.stdin.close()
+                n.proc.wait(timeout=10)
+            except Exception:
+                try:
+                    n.proc.kill()
+                    n.proc.wait()
+                except Exception:
+                    pass
+
+
 def qos_isolation_scrape():
     """QoS isolation trajectory (ISSUE 8): boot one mesh_node with
     tenant quotas, run one mixed-tenant press where bronze floods at 8x
@@ -886,6 +975,15 @@ _SKIP_KEYS = {"metric", "unit", "vs_baseline", "reps", "error",
               # the verify recipe); pod count is shape.
               "coll_flat_dcn_allreduce_busbw_mbps",
               "coll_hier_vs_flat_ratio", "coll_dcn_pods",
+              # One-sided verbs round (ISSUE 18): coll_verbs_busbw_mbps
+              # IS compared (higher better). The chunk number measures
+              # the deliberately-two-sided baseline, the ratio
+              # re-derives from the two (its >= 1.0 acceptance lives in
+              # the verify recipe), steps/nranks are shape, and
+              # zero_fallback is a boolean proof.
+              "coll_chunk_busbw_mbps", "coll_verbs_vs_chunk_ratio",
+              "coll_verbs_steps", "coll_verbs_zero_fallback",
+              "coll_verbs_nranks",
               # Inference-serving round (ISSUE 17): batched tokens/s and
               # the TTFT/ITL latencies ARE compared. The unbatched
               # number measures the deliberately-serial baseline, the
@@ -1042,6 +1140,7 @@ def run_bench():
     qos_cost = qos_cost_scrape()
     coll = collective_scrape()
     dcn_coll = dcn_collective_scrape()
+    verbs = verbs_scrape()
     infer = infer_scrape()
 
     mbps = float(ici["mbps"])
@@ -1079,6 +1178,8 @@ def run_bench():
         out.update(coll)
     if dcn_coll is not None:
         out.update(dcn_coll)
+    if verbs is not None:
+        out.update(verbs)
     if infer is not None:
         out.update(infer)
     print(json.dumps(out))

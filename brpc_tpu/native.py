@@ -68,6 +68,13 @@ def lib() -> ctypes.CDLL:
         L.tpurpc_transport_tier_cross_process.argtypes = [ctypes.c_int]
         L.tpurpc_transport_tier_ops.restype = ctypes.c_long
         L.tpurpc_transport_tier_ops.argtypes = [ctypes.c_int]
+        L.tpurpc_transport_tier_one_sided.restype = ctypes.c_int
+        L.tpurpc_transport_tier_one_sided.argtypes = [ctypes.c_int]
+        L.tpurpc_transport_tier_sgl_max.restype = ctypes.c_long
+        L.tpurpc_transport_tier_sgl_max.argtypes = [ctypes.c_int]
+        for fn in ("posted", "completed", "bytes", "stale_rejects",
+                   "cq_parks", "windows", "pending"):
+            getattr(L, f"tpurpc_verbs_{fn}").restype = ctypes.c_long
         L.tpurpc_ring_slot.restype = ctypes.c_void_p
         L.tpurpc_ring_slot.argtypes = [ctypes.c_void_p, ctypes.c_uint32]
         L.tpurpc_ring_slot_bytes.restype = ctypes.c_size_t
@@ -166,9 +173,28 @@ def transport_tiers() -> list[dict]:
             "zero_copy": bool(L.tpurpc_transport_tier_zero_copy(t)),
             "cross_process": bool(
                 L.tpurpc_transport_tier_cross_process(t)),
+            "one_sided": bool(L.tpurpc_transport_tier_one_sided(t)),
+            "sgl_max": int(L.tpurpc_transport_tier_sgl_max(t)),
             "ops": int(L.tpurpc_transport_tier_ops(t)),
         })
     return tiers
+
+
+def verbs_counters() -> dict:
+    """One-sided verb plane counters (ISSUE 18): posted/completed verbs,
+    bytes moved, stale-epoch rejects, CQ parks, plus the live window and
+    pending-post gauges (leak evidence: a clean shutdown ends with
+    windows == 0 and pending == 0)."""
+    L = lib()
+    return {
+        "posted": int(L.tpurpc_verbs_posted()),
+        "completed": int(L.tpurpc_verbs_completed()),
+        "bytes": int(L.tpurpc_verbs_bytes()),
+        "stale_rejects": int(L.tpurpc_verbs_stale_rejects()),
+        "cq_parks": int(L.tpurpc_verbs_cq_parks()),
+        "windows": int(L.tpurpc_verbs_windows()),
+        "pending": int(L.tpurpc_verbs_pending()),
+    }
 
 
 class RingAbortedError(RuntimeError):

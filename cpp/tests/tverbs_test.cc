@@ -1,0 +1,346 @@
+// One-sided verb plane unit tests (ISSUE 18): window grant/mode/bounds
+// guards, epoch fencing and lease-expiry/peer-death reclamation, the
+// loopback scatter-gather round-trip through a doorbell CompletionQueue,
+// SIGKILL-mid-verb reclamation, and the CQ exactly-once arbitration
+// under an 8-thread duplicate-delivery race.
+//
+// Everything here is protobuf-free: the suite also links into the
+// standalone (toolchain-less container) harness — test_main + this file
+// + tici/{verbs,block_pool,block_lease}.cc + tnet/{transport,
+// fault_injection}.cc and the tbase/tvar deps — where the race test is
+// the ASan/UBSan acceptance gate.
+#include <cstring>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "tbase/errno.h"
+#include "tbase/iobuf.h"
+#include "tbase/time.h"
+#include "tici/block_lease.h"
+#include "tici/block_pool.h"
+#include "tici/verbs.h"
+#include "ttest/ttest.h"
+
+using namespace tpurpc;
+
+TEST(Verbs, WindowGrantModesBoundsAndStaleEpoch) {
+    ASSERT_EQ(0, IciBlockPool::Init());
+    const uint64_t pinned0 = block_lease::pinned();
+    const size_t wins0 = verbs::window_count();
+
+    verbs::WindowInfo info;
+    ASSERT_EQ(0, verbs::GrantWindow(/*peer=*/0, 32768,
+                                    verbs::kWinRead | verbs::kWinWrite,
+                                    60 * 1000, &info));
+    ASSERT_NE(0ull, info.window_id);
+    EXPECT_EQ(IciBlockPool::pool_id(), info.pool_id);
+    EXPECT_EQ(IciBlockPool::pool_epoch(), info.epoch);
+    EXPECT_EQ(32768ull, info.length);
+    EXPECT_EQ(pinned0 + 1, block_lease::pinned());
+    EXPECT_EQ(wins0 + 1, verbs::window_count());
+
+    // Valid resolve: the span is registered pool memory.
+    char* p = nullptr;
+    ASSERT_EQ(0, verbs::WindowPtr(info.window_id, 0, 32768, info.epoch,
+                                  verbs::kWinWrite, &p));
+    ASSERT_TRUE(p != nullptr);
+    EXPECT_TRUE(IciBlockPool::Contains(p));
+
+    // Epoch fence: a descriptor minted under another generation is the
+    // RETRIABLE stale error, never a pointer.
+    EXPECT_EQ(TERR_STALE_EPOCH,
+              verbs::WindowPtr(info.window_id, 0, 100, info.epoch + 1,
+                               verbs::kWinRead, &p));
+    // Bounds: len past the window end.
+    EXPECT_EQ(TERR_REQUEST,
+              verbs::WindowPtr(info.window_id, 32000, 1000, info.epoch,
+                               verbs::kWinRead, &p));
+    // Unknown window id: stale (a reclaimed id must NEVER hand out
+    // recycled bytes).
+    EXPECT_EQ(TERR_STALE_EPOCH,
+              verbs::WindowPtr(info.window_id + 999, 0, 100, info.epoch,
+                               verbs::kWinRead, &p));
+
+    // Mode enforcement: a read-only grant refuses writes.
+    verbs::WindowInfo ro;
+    ASSERT_EQ(0,
+              verbs::GrantWindow(0, 8192, verbs::kWinRead, 60000, &ro));
+    EXPECT_EQ(0, verbs::WindowPtr(ro.window_id, 0, 100, ro.epoch,
+                                  verbs::kWinRead, &p));
+    EXPECT_EQ(TERR_REQUEST, verbs::WindowPtr(ro.window_id, 0, 100,
+                                             ro.epoch, verbs::kWinWrite,
+                                             &p));
+
+    // Close releases the pin exactly once.
+    EXPECT_TRUE(verbs::CloseWindow(info.window_id));
+    EXPECT_FALSE(verbs::CloseWindow(info.window_id));
+    EXPECT_EQ(TERR_STALE_EPOCH,
+              verbs::WindowPtr(info.window_id, 0, 100, info.epoch,
+                               verbs::kWinRead, &p));
+    EXPECT_TRUE(verbs::CloseWindow(ro.window_id));
+    EXPECT_EQ(pinned0, block_lease::pinned());
+    EXPECT_EQ(wins0, verbs::window_count());
+}
+
+TEST(Verbs, LeaseExpiryAndPeerDeathReclaimWindows) {
+    ASSERT_EQ(0, IciBlockPool::Init());
+    const uint64_t pinned0 = block_lease::pinned();
+    char* p = nullptr;
+
+    // Peer death reclaims exactly that peer's grants (the SIGKILL path:
+    // server_call::OnSocketFailed -> verbs::OnPeerDead).
+    verbs::WindowInfo w1, w2, w3;
+    ASSERT_EQ(0, verbs::GrantWindow(111, 8192, verbs::kWinWrite, 60000,
+                                    &w1));
+    ASSERT_EQ(0, verbs::GrantWindow(111, 8192, verbs::kWinWrite, 60000,
+                                    &w2));
+    ASSERT_EQ(0, verbs::GrantWindow(222, 8192, verbs::kWinWrite, 60000,
+                                    &w3));
+    EXPECT_EQ(pinned0 + 3, block_lease::pinned());
+    verbs::OnPeerDead(111);
+    EXPECT_EQ(TERR_STALE_EPOCH,
+              verbs::WindowPtr(w1.window_id, 0, 100, w1.epoch,
+                               verbs::kWinWrite, &p));
+    EXPECT_EQ(TERR_STALE_EPOCH,
+              verbs::WindowPtr(w2.window_id, 0, 100, w2.epoch,
+                               verbs::kWinWrite, &p));
+    EXPECT_EQ(0, verbs::WindowPtr(w3.window_id, 0, 100, w3.epoch,
+                                  verbs::kWinWrite, &p));
+    EXPECT_EQ(pinned0 + 1, block_lease::pinned());
+    EXPECT_TRUE(verbs::CloseWindow(w3.window_id));
+
+    // Lease expiry: the reaper frees the pin through the same lease
+    // machinery the descriptor plane uses; the window answers stale
+    // from then on (and the stale resolve erases the husk).
+    verbs::WindowInfo we;
+    ASSERT_EQ(0,
+              verbs::GrantWindow(0, 8192, verbs::kWinWrite, 50, &we));
+    EXPECT_EQ(0, verbs::WindowPtr(we.window_id, 0, 100, we.epoch,
+                                  verbs::kWinWrite, &p));
+    EXPECT_GE(block_lease::ReapExpired(monotonic_time_us() +
+                                       (int64_t)3600e6),
+              (size_t)1);
+    EXPECT_EQ(TERR_STALE_EPOCH,
+              verbs::WindowPtr(we.window_id, 0, 100, we.epoch,
+                               verbs::kWinWrite, &p));
+    EXPECT_FALSE(verbs::CloseWindow(we.window_id));  // already gone
+    EXPECT_EQ(pinned0, block_lease::pinned());
+}
+
+TEST(Verbs, LoopbackSglRoundTripThroughCompletionQueue) {
+    ASSERT_EQ(0, IciBlockPool::Init());
+    const uint64_t pinned0 = block_lease::pinned();
+    constexpr size_t kBytes = 64 * 1024;
+    constexpr uint32_t kNsge = 4;
+
+    verbs::WindowInfo info;
+    ASSERT_EQ(0, verbs::GrantWindow(0, kBytes,
+                                    verbs::kWinRead | verbs::kWinWrite,
+                                    60000, &info));
+    verbs::RemoteWindow w;
+    w.window_id = info.window_id;
+    w.pool_id = info.pool_id;
+    w.offset = info.offset;
+    w.length = info.length;
+    w.epoch = info.epoch;
+    w.mode = info.mode;
+    w.peer = 0;  // loopback: the direct memcpy path
+    w.deadline_us = monotonic_time_us() + (int64_t)60e6;
+
+    std::string src(kBytes, 0);
+    for (size_t i = 0; i < kBytes; ++i) src[i] = (char)(i * 2654435761u >> 9);
+    verbs::CompletionQueue cq;
+    verbs::Sge sgl[kNsge];
+    const size_t piece = kBytes / kNsge;
+    for (uint32_t i = 0; i < kNsge; ++i) {
+        sgl[i].addr = &src[i * piece];
+        sgl[i].len = piece;
+    }
+    const int64_t posted0 = verbs::posted();
+    ASSERT_EQ(0, verbs::PostWrite(&cq, 71, w, 0, sgl, kNsge));
+    verbs::Completion c;
+    ASSERT_TRUE(cq.Park(&c, 5 * 1000 * 1000));
+    EXPECT_EQ(71ull, c.wr_id);
+    EXPECT_EQ(0, c.status);
+    EXPECT_EQ((uint64_t)kBytes, c.bytes);
+    EXPECT_EQ((int)verbs::kRemoteWrite, c.op);
+    EXPECT_EQ(posted0 + 1, verbs::posted());
+
+    // The gathered SGL landed contiguously in the granted window.
+    char* wp = nullptr;
+    ASSERT_EQ(0, verbs::WindowPtr(info.window_id, 0, kBytes, info.epoch,
+                                  verbs::kWinRead, &wp));
+    EXPECT_EQ(0, memcmp(wp, src.data(), kBytes));
+
+    // REMOTE_READ scatters the window back across a fresh SGL.
+    std::string dst(kBytes, 0);
+    for (uint32_t i = 0; i < kNsge; ++i) sgl[i].addr = &dst[i * piece];
+    ASSERT_EQ(0, verbs::PostRead(&cq, 72, w, 0, sgl, kNsge));
+    ASSERT_TRUE(cq.Park(&c, 5 * 1000 * 1000));
+    EXPECT_EQ(72ull, c.wr_id);
+    EXPECT_EQ(0, c.status);
+    EXPECT_EQ(0, memcmp(dst.data(), src.data(), kBytes));
+
+    // Shape guards: SGL above the cap, span past the window end, and a
+    // verb against a mode the grant never gave are refused at post time.
+    std::vector<verbs::Sge> many(verbs::kDefaultSglMax + 1);
+    for (auto& sg : many) {
+        sg.addr = &src[0];
+        sg.len = 1;
+    }
+    EXPECT_EQ(TERR_REQUEST,
+              verbs::PostWrite(&cq, 73, w, 0, many.data(),
+                               (uint32_t)many.size()));
+    EXPECT_EQ(TERR_REQUEST,
+              verbs::PostWrite(&cq, 74, w, kBytes - 100, sgl, kNsge));
+
+    // A post under a moved epoch completes TERR_STALE_EPOCH through the
+    // CQ — the initiator-side fence, not a wedge and not stale bytes.
+    verbs::RemoteWindow stale = w;
+    stale.epoch = w.epoch + 1;
+    ASSERT_EQ(0, verbs::PostRead(&cq, 75, stale, 0, sgl, kNsge));
+    ASSERT_TRUE(cq.Park(&c, 5 * 1000 * 1000));
+    EXPECT_EQ(75ull, c.wr_id);
+    EXPECT_EQ(TERR_STALE_EPOCH, c.status);
+
+    // A post whose grant lease already ended locally: same fence.
+    verbs::RemoteWindow expired = w;
+    expired.deadline_us = monotonic_time_us() - 1;
+    ASSERT_EQ(0, verbs::PostWrite(&cq, 76, expired, 0, sgl, kNsge));
+    ASSERT_TRUE(cq.Park(&c, 5 * 1000 * 1000));
+    EXPECT_EQ(TERR_STALE_EPOCH, c.status);
+
+    EXPECT_EQ((size_t)0, verbs::pending_posts());
+    EXPECT_TRUE(verbs::CloseWindow(info.window_id));
+    EXPECT_EQ(pinned0, block_lease::pinned());
+    cq.Shutdown();
+}
+
+namespace {
+
+// Wire-sender stub that swallows posts: the verb stays pending until a
+// completion (or peer death / the reaper) finishes it — the seam the
+// exactly-once and SIGKILL tests race against.
+int SwallowVerbSend(uint64_t, int, uint64_t, uint64_t, uint64_t,
+                    uint64_t, uint64_t, uint32_t, const IOBuf&) {
+    return 0;
+}
+bool NeverOneSided(uint64_t) { return false; }
+
+}  // namespace
+
+TEST(Verbs, SigkillMidVerbStrandsZeroPinsAndFailsPendingPosts) {
+    // The chaos-soak invariant at unit scale: a peer that dies with
+    // verbs in flight against its link must strand neither the grantor
+    // pins nor the initiator's parked completion.
+    ASSERT_EQ(0, IciBlockPool::Init());
+    verbs::SetVerbWireSender(&SwallowVerbSend);
+    verbs::SetOneSidedProbe(&NeverOneSided);
+    const uint64_t pinned0 = block_lease::pinned();
+
+    // Grantor side: two windows leased to the doomed peer.
+    verbs::WindowInfo g1, g2;
+    ASSERT_EQ(0, verbs::GrantWindow(777, 16384, verbs::kWinWrite, 60000,
+                                    &g1));
+    ASSERT_EQ(0, verbs::GrantWindow(777, 16384, verbs::kWinRead, 60000,
+                                    &g2));
+    EXPECT_EQ(pinned0 + 2, block_lease::pinned());
+
+    // Initiator side: a write in flight TOWARD the doomed peer (the
+    // swallow sender models the SIGKILL landing mid-verb: posted on the
+    // wire, no completion will ever come back).
+    char payload[4096];
+    memset(payload, 'v', sizeof(payload));
+    verbs::Sge sge{payload, sizeof(payload)};
+    verbs::RemoteWindow rw;
+    rw.window_id = 4242;  // the peer's window; never resolved locally
+    rw.pool_id = 0xdead;
+    rw.length = sizeof(payload);
+    rw.epoch = 1;
+    rw.mode = verbs::kWinWrite;
+    rw.peer = 777;
+    rw.deadline_us = monotonic_time_us() + (int64_t)60e6;
+    verbs::CompletionQueue cq;
+    ASSERT_EQ(0, verbs::PostWrite(&cq, 91, rw, 0, &sge, 1));
+    EXPECT_GE(verbs::pending_posts(), (size_t)1);
+
+    // The socket failure observer fires for the dead peer.
+    verbs::OnPeerDead(777);
+
+    // Grantor pins: both reclaimed, staleness fences the ids forever.
+    char* p = nullptr;
+    EXPECT_EQ(pinned0, block_lease::pinned());
+    EXPECT_EQ(TERR_STALE_EPOCH,
+              verbs::WindowPtr(g1.window_id, 0, 100, g1.epoch,
+                               verbs::kWinWrite, &p));
+    // Initiator: the pending post completes with a terminal error
+    // instead of wedging its parked poller.
+    verbs::Completion c;
+    ASSERT_TRUE(cq.Park(&c, 5 * 1000 * 1000));
+    EXPECT_EQ(91ull, c.wr_id);
+    EXPECT_NE(0, c.status);
+    EXPECT_EQ((size_t)0, verbs::pending_posts());
+    cq.Shutdown();
+}
+
+TEST(Verbs, CqExactlyOnceUnder8ThreadCompletionRace) {
+    // Exactly-once arbitration: wire completion, reaper timeout, and
+    // peer-death sweep may all race to finish the same wr_id — the
+    // pending-erase is the arbitration point, so each post surfaces in
+    // its CQ EXACTLY once no matter how many deliverers fire.
+    ASSERT_EQ(0, IciBlockPool::Init());
+    verbs::SetVerbWireSender(&SwallowVerbSend);
+    verbs::SetOneSidedProbe(&NeverOneSided);
+    constexpr int kPosts = 100;
+    constexpr int kThreads = 8;
+
+    char payload[512];
+    memset(payload, 'x', sizeof(payload));
+    verbs::Sge sge{payload, sizeof(payload)};
+    verbs::RemoteWindow rw;
+    rw.window_id = 5151;
+    rw.pool_id = 0xbeef;
+    rw.length = sizeof(payload);
+    rw.epoch = 1;
+    rw.mode = verbs::kWinWrite;
+    rw.peer = 778;
+    rw.deadline_us = monotonic_time_us() + (int64_t)60e6;
+    verbs::CompletionQueue cq;
+    for (int i = 0; i < kPosts; ++i) {
+        ASSERT_EQ(0, verbs::PostWrite(&cq, 1000 + (uint64_t)i, rw, 0,
+                                      &sge, 1));
+    }
+    ASSERT_GE(verbs::pending_posts(), (size_t)kPosts);
+
+    // 8 threads each deliver a completion for EVERY wr_id — 8x
+    // duplicate delivery of all 100 posts, concurrently.
+    std::vector<std::thread> threads;
+    for (int t = 0; t < kThreads; ++t) {
+        threads.emplace_back([] {
+            for (int i = 0; i < kPosts; ++i) {
+                verbs::HandleWireCompletion(1000 + (uint64_t)i, 0,
+                                            IOBuf(), 0);
+            }
+        });
+    }
+    for (auto& th : threads) th.join();
+
+    // Drain: exactly kPosts completions, all distinct wr_ids.
+    std::vector<int> seen(kPosts, 0);
+    verbs::Completion c;
+    int drained = 0;
+    while (cq.Poll(&c)) {
+        const int idx = (int)(c.wr_id - 1000);
+        ASSERT_GE(idx, 0);
+        ASSERT_LT(idx, kPosts);
+        seen[idx]++;
+        drained++;
+    }
+    EXPECT_EQ(kPosts, drained);
+    for (int i = 0; i < kPosts; ++i) {
+        EXPECT_EQ(1, seen[i]);
+    }
+    EXPECT_EQ((size_t)0, verbs::pending_posts());
+    cq.Shutdown();
+}

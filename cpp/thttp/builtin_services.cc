@@ -704,12 +704,14 @@ void HandlePools(Server*, const HttpRequest& req, HttpResponse* res) {
             snprintf(tline, sizeof(tline),
                      "%s{\"name\": \"%s\", \"descriptor_capable\": %d, "
                      "\"zero_copy\": %d, \"cross_process\": %d, "
+                     "\"one_sided\": %d, \"sgl_max\": %u, "
                      "\"in_bytes\": %lld, \"out_bytes\": %lld, "
                      "\"desc_in_bytes\": %lld, \"desc_out_bytes\": %lld, "
                      "\"credit_stalls\": %lld, \"ops\": %lld}",
                      t == 0 ? "" : ", ", tier->name,
                      tier->descriptor_capable ? 1 : 0,
                      tier->zero_copy ? 1 : 0, tier->cross_process ? 1 : 0,
+                     tier->one_sided ? 1 : 0, tier->sgl_max,
                      (long long)transport_stats::in_bytes(t),
                      (long long)transport_stats::out_bytes(t),
                      (long long)transport_stats::desc_in_bytes(t),

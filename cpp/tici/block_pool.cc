@@ -5,6 +5,7 @@
 #include <sys/stat.h>
 #include <unistd.h>
 
+#include <algorithm>
 #include <atomic>
 #include <chrono>
 #include <condition_variable>
@@ -459,8 +460,14 @@ void* IciBlockPool::AllocateSlab(size_t n) {
     if (sc.carve_base == nullptr || sc.carve_off + slot > sc.carve_size) {
         // New arena: a large aligned registered slab (~16 slots, min 1
         // region-friendly chunk) carved from the pool's regions, then
-        // published append-only for lock-free class lookup.
-        const size_t arena_bytes = slot * 16;
+        // published append-only for lock-free class lookup. Capped at
+        // 16MB: the jumbo classes must still fit INSIDE the shm region
+        // (a 4MB-class x16 arena would be the whole 64MB pool, land in
+        // an anonymous overflow region, and silently disqualify every
+        // jumbo slot from descriptor/verb-window use forever).
+        const size_t arena_bytes =
+            std::min<size_t>(slot * 16,
+                             std::max<size_t>(slot, (size_t)16 << 20));
         char* base = (char*)AllocateRegistered(arena_bytes);
         if (base == nullptr) return nullptr;
         {
@@ -578,6 +585,13 @@ std::map<uint64_t, Mapping>& reg() {
 }
 std::atomic<uint64_t> g_resolves{0};
 std::atomic<uint64_t> g_resolve_failures{0};
+// id -> shm name (ISSUE 18): kept apart from the mapping table — it
+// survives Unregister so a verbs re-grant after link churn can still
+// locate the segment for a writable remap.
+std::map<uint64_t, std::string>& name_reg() {
+    static auto* m = new std::map<uint64_t, std::string>;
+    return *m;
+}
 }  // namespace
 
 uint64_t IdFromName(const char* name) {
@@ -643,6 +657,21 @@ std::string DebugString() {
         out += line;
     }
     return out;
+}
+
+void SetName(uint64_t id, const char* name) {
+    if (id == 0 || name == nullptr || name[0] == '\0') return;
+    std::lock_guard<std::mutex> g(reg_mu());
+    name_reg()[id] = name;
+}
+
+bool NameOf(uint64_t id, char* buf, size_t n) {
+    if (buf == nullptr || n == 0) return false;
+    std::lock_guard<std::mutex> g(reg_mu());
+    auto it = name_reg().find(id);
+    if (it == name_reg().end() || it->second.size() + 1 > n) return false;
+    memcpy(buf, it->second.c_str(), it->second.size() + 1);
+    return true;
 }
 
 uint64_t resolves() { return g_resolves.load(std::memory_order_relaxed); }
@@ -852,6 +881,8 @@ int IciBlockPool::Init(size_t region_bytes) {
         pool_registry::Register(pool_registry::IdFromName(pool().shm_name),
                                 pool().shm_base, pool().shm_size,
                                 pool_epoch());
+        pool_registry::SetName(pool_registry::IdFromName(pool().shm_name),
+                               pool().shm_name);
     }
     // Teach the Transport tier how to name this process's pool: the
     // descriptor-eligibility seam (tnet/transport.h) answers "may a

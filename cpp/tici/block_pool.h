@@ -172,6 +172,15 @@ void RaiseEpoch(uint64_t id, uint64_t epoch);
 // the caller's stale-descriptor fence.
 bool Resolve(uint64_t id, const char** base, size_t* size,
              uint64_t* epoch = nullptr);
+// Pool id -> shm segment name (ISSUE 18). The verbs layer needs the
+// NAME to open its own WRITABLE mapping of a peer pool (the handshake
+// mapping is PROT_READ; a granted REMOTE_WRITE window is the rkey-
+// equivalent authorization to remap O_RDWR). Registered alongside the
+// mapping; survives Unregister so a re-grant after link churn can
+// still find the segment. NameOf copies into buf (NUL-terminated),
+// false when unknown or buf too small.
+void SetName(uint64_t id, const char* name);
+bool NameOf(uint64_t id, char* buf, size_t n);
 // Resolution stats (tests + /vars).
 uint64_t resolves();
 uint64_t resolve_failures();

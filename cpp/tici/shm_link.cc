@@ -126,6 +126,9 @@ int AcquirePeerPool(const char* name, size_t size, uint64_t epoch,
     if (id != IciBlockPool::pool_id()) {
         pool_registry::Register(id, (char*)mem, size,
                                 epoch != 0 ? epoch : 1);
+        // The verbs layer remaps peer pools O_RDWR by NAME for granted
+        // REMOTE_WRITE windows (this handshake mapping is read-only).
+        pool_registry::SetName(id, name);
     }
     out->base = (char*)mem;
     out->size = size;

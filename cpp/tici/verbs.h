@@ -1,0 +1,264 @@
+// One-sided verbs on leased pool windows (ISSUE 18).
+//
+// The descriptor plane (ISSUE 9/10) moves payloads as references, but
+// every chunk still costs a remote dispatch: a handler fiber parses the
+// descriptor, resolves it, and writes a response frame. "RPC Considered
+// Harmful" (arXiv:1805.08430) argues DL data movement wants one-sided
+// memory semantics — zero remote CPU on the data path — and the
+// reference's RdmaEndpoint (src/brpc/rdma/rdma_endpoint.cpp) is the
+// shape template: post work requests against registered remote memory,
+// collect completions from a queue, ring a doorbell.
+//
+// This layer reproduces that shape on the pool/transport substrate:
+//
+//  - WINDOW = pool_id + epoch + (offset, len) lease carved from
+//    IciBlockPool. The grantor allocates a descriptor-eligible slab,
+//    pins it through block_lease (direction "win", armed against the
+//    requesting link's socket), and answers a `window_grant` meta
+//    exchange with the rkey-equivalent: the (window_id, pool, offset,
+//    len, epoch, lease) tuple. Every guard the descriptor plane
+//    already has applies unchanged — epoch fencing, crc32c, lease
+//    expiry reaping, peer-death reclamation — so a stale or reclaimed
+//    window answers TERR_STALE_EPOCH, never recycled bytes.
+//
+//  - VERBS = REMOTE_READ / REMOTE_WRITE posted by the initiator
+//    against a granted window, each carrying a scatter-gather list so
+//    one post covers N local blocks. On a one-sided-capable tier
+//    (TransportTier.one_sided: shm_xproc/ici today) the data moves by
+//    direct memcpy against the mapped pool — the handshake mapping is
+//    read-only, so REMOTE_WRITE lazily re-opens the peer segment
+//    O_RDWR by name (pool_registry::NameOf); the grant IS the write
+//    authorization. Verb-incapable tiers (dcn/tcp) degrade to an
+//    emulated two-sided exchange through wire hooks the policy layer
+//    registers — same post/completion API, the seam just schedules a
+//    meta frame instead of a memcpy.
+//
+//  - COMPLETION QUEUE = the doorbell: completions land in a per-
+//    endpoint CQ the initiator polls or parks on, with exactly-once
+//    arbitration — a completion is delivered only by whoever erases
+//    the pending work request (wire completion vs. timeout reaper vs.
+//    peer-death sweep race safely), and a bounded recent-wr_id set
+//    absorbs duplicated wire completions.
+//
+// Failure model: a posted verb that vanishes (chaos verb_drop, peer
+// death mid-flight) is reaped by its per-attempt deadline and retried
+// a bounded number of times before completing TERR_RPC_TIMEDOUT; a
+// window past its lease deadline is refused initiator-side BEFORE the
+// grantor's reaper frees the pin (the grant carries the lease span;
+// same-host CLOCK_MONOTONIC makes the comparison meaningful, and the
+// reaper's -pool_lease_grace_ms covers the skew).
+//
+// Thread contract: plain std::mutex/condvar (fibers, Python threads
+// through the C ABI, and plain test threads all post). pb-free: links
+// into the standalone ASan/UBSan suite with no proto runtime.
+#pragma once
+
+#include <cstddef>
+#include <cstdint>
+#include <string>
+
+#include "tbase/iobuf.h"
+
+namespace tpurpc {
+namespace verbs {
+
+enum VerbOp {
+    kRemoteRead = 1,   // window bytes -> local SGL
+    kRemoteWrite = 2,  // local SGL -> window bytes
+};
+
+// Window access mode bits (grant request / validation).
+enum : uint32_t {
+    kWinRead = 1u,
+    kWinWrite = 2u,
+};
+
+// One scatter-gather entry: a local span the verb reads into (READ) or
+// gathers from (WRITE). The memory must stay valid until the post's
+// completion is delivered.
+struct Sge {
+    char* addr = nullptr;
+    uint64_t len = 0;
+};
+
+// The initiator's handle on a granted remote window.
+struct RemoteWindow {
+    uint64_t window_id = 0;
+    uint64_t pool_id = 0;
+    uint64_t offset = 0;  // into the grantor's pool
+    uint64_t length = 0;
+    uint64_t epoch = 0;  // grantor pool epoch at grant time
+    uint32_t mode = 0;   // kWinRead|kWinWrite
+    uint64_t peer = 0;   // SocketId of the granting link (0 = loopback)
+    // Initiator-side refusal fence: posts after this monotonic instant
+    // complete TERR_STALE_EPOCH locally (the grantor's reaper may free
+    // the pin any time after; its grace period covers the skew).
+    int64_t deadline_us = 0;
+};
+
+struct Completion {
+    uint64_t wr_id = 0;
+    int status = 0;  // 0 = ok, else TERR_* (stale/timeout/failed socket)
+    uint64_t bytes = 0;
+    int op = 0;  // VerbOp
+};
+
+// Doorbell completion queue: one per initiating endpoint (or per
+// collective lane). Push-side arbitration is exactly-once; the
+// consumer either polls opportunistically or parks a fiber/thread.
+class CompletionQueue {
+public:
+    CompletionQueue();
+    ~CompletionQueue();
+    CompletionQueue(const CompletionQueue&) = delete;
+    CompletionQueue& operator=(const CompletionQueue&) = delete;
+
+    // Non-blocking: true + one completion when one is ready (chaos
+    // doorbell_delay may hold entries back; they become visible once
+    // their delay elapses). Drives the pending-post reaper as a side
+    // effect, so a dropped verb's retry/timeout needs no extra thread.
+    bool Poll(Completion* out);
+
+    // Blocking poll: parks up to timeout_us (<0 = forever). False on
+    // timeout or shutdown. Each wait that actually parks bumps
+    // rpc_verbs_cq_parks.
+    bool Park(Completion* out, int64_t timeout_us);
+
+    // Wake every parked waiter; subsequent Parks return false
+    // immediately. Pending posts routed here still complete (Poll
+    // after shutdown drains them).
+    void Shutdown();
+
+    size_t depth();  // entries queued (ready or delay-held)
+
+    // Internal delivery seam (the verbs layer pushes through this; not
+    // a consumer API). Dedupes by wr_id against a bounded recent set;
+    // ready_at_us > now holds the entry back (chaos doorbell_delay).
+    void Push(const Completion& c, int64_t ready_at_us);
+
+private:
+    struct Impl;
+    Impl* impl_;
+};
+
+// ---- grantor side ----
+
+// Wire-facing grant fields (what the window_grant response carries).
+struct WindowInfo {
+    uint64_t window_id = 0;
+    uint64_t pool_id = 0;
+    uint64_t offset = 0;
+    uint64_t length = 0;
+    uint64_t epoch = 0;
+    uint32_t mode = 0;
+    int64_t lease_ms = 0;
+};
+
+// Carve + pin + arm a window for `peer_key` (the requesting link's
+// SocketId; 0 for in-process tests). Returns 0 and fills *out, or
+// TERR_OVERLOAD when the pool cannot serve the slab. lease_ms <= 0
+// applies the default (-verbs_lease_default_ms).
+int GrantWindow(uint64_t peer_key, uint64_t length, uint32_t mode,
+                int64_t lease_ms, WindowInfo* out);
+
+// Release a granted window (idempotent; the lease release is
+// exactly-once underneath). True when this call dropped it.
+bool CloseWindow(uint64_t window_id);
+
+// Validate + resolve a local window span for an incoming wire verb or
+// a doorbell apply: window exists, lease alive, `wire_epoch` matches
+// both the grant and the CURRENT pool epoch, bounds hold, `need` mode
+// granted. Returns 0 and sets *ptr, TERR_STALE_EPOCH on any
+// staleness/reclamation (counted in rpc_verbs_stale_rejects), or
+// TERR_REQUEST on bounds/mode violations.
+int WindowPtr(uint64_t window_id, uint64_t offset, uint64_t len,
+              uint64_t wire_epoch, uint32_t need, char** ptr);
+
+// Peer-death reclamation: drop every window granted to `peer_key` and
+// fail (TERR_FAILED_SOCKET) every pending post / grant wait against
+// it. Called from the same socket-failure observer that already runs
+// block_lease::ReleasePeer.
+void OnPeerDead(uint64_t peer_key);
+
+// ---- initiator side ----
+
+// Ask `sid` for a window of `length` bytes (blocking, timeout_ms).
+// Returns 0 and fills *out, or TERR_* (timeout / refusal / no sender
+// hook registered).
+int RequestWindow(uint64_t sid, uint64_t length, uint32_t mode,
+                  int64_t timeout_ms, RemoteWindow* out);
+
+// Post one verb. wr_id must be unique process-wide among pending
+// posts (TERR_REQUEST otherwise); sgl spans must stay valid until the
+// completion is delivered into *cq. Returns 0 when the post was
+// accepted (the outcome arrives as a Completion), TERR_REQUEST for
+// malformed posts (bad sgl, length overflow, wrong mode, sgl_max
+// exceeded). A window past its deadline still accepts the post — the
+// completion carries TERR_STALE_EPOCH.
+int PostRead(CompletionQueue* cq, uint64_t wr_id, const RemoteWindow& w,
+             uint64_t window_off, Sge* sgl, uint32_t nsge);
+int PostWrite(CompletionQueue* cq, uint64_t wr_id, const RemoteWindow& w,
+              uint64_t window_off, const Sge* sgl, uint32_t nsge);
+
+// ---- policy wiring (hooks; pb lives above this layer) ----
+
+// Send a window_grant REQUEST on `sid`; `token` correlates the
+// response back into HandleGrantResponse. Returns 0 when queued.
+void SetGrantRequestSender(int (*fn)(uint64_t sid, uint64_t token,
+                                     uint64_t length, uint32_t mode,
+                                     int64_t lease_ms));
+
+// Send one emulated wire verb on `sid` (payload = gathered WRITE
+// bytes + its crc32c; empty for READ). Returns 0 when queued.
+void SetVerbWireSender(int (*fn)(uint64_t sid, int op, uint64_t wr_id,
+                                 uint64_t window_id, uint64_t offset,
+                                 uint64_t len, uint64_t epoch,
+                                 uint32_t crc, const IOBuf& payload));
+
+// May verbs move data DIRECTLY (memcpy against the mapped pool) on
+// this socket? The policy layer answers with the transport tier's
+// one_sided bit. Unregistered (unit tests): direct whenever the pool
+// resolves locally.
+void SetOneSidedProbe(bool (*fn)(uint64_t sid));
+// Max SGL entries the socket's tier accepts (0 = emulate-only caller
+// should split). Unregistered: kDefaultSglMax.
+void SetSglMaxProbe(uint32_t (*fn)(uint64_t sid));
+
+// Inbound dispatch (called by the policy layer):
+// grant REQUEST arrived on `sid` -> grant + fill *out; returns status
+// for the response.
+int HandleGrantRequest(uint64_t sid, uint64_t length, uint32_t mode,
+                       int64_t lease_ms, WindowInfo* out);
+// grant RESPONSE arrived: wake the RequestWindow waiter.
+void HandleGrantResponse(uint64_t token, int status,
+                         const WindowInfo& info);
+// Emulated wire verb arrived at the TARGET: validates via WindowPtr,
+// applies WRITE payload (crc-checked) or fills *out with READ bytes
+// (+ *out_crc). Returns the status the completion frame should carry.
+int HandleWireVerb(int op, uint64_t wr_id, uint64_t window_id,
+                   uint64_t offset, uint64_t len, uint64_t epoch,
+                   uint32_t crc, const IOBuf& payload, IOBuf* out,
+                   uint32_t* out_crc);
+// Wire completion arrived back at the INITIATOR.
+void HandleWireCompletion(uint64_t wr_id, int status,
+                          const IOBuf& payload, uint32_t crc);
+
+// Default/bounds.
+enum : uint32_t { kDefaultSglMax = 16 };
+
+// ---- observability ----
+// rpc_verbs_{posted,completed,bytes,stale_rejects,cq_parks} tvars.
+void ExposeVars();
+int64_t posted();
+int64_t completed();
+int64_t bytes_moved();
+int64_t stale_rejects();
+int64_t cq_parks();
+size_t window_count();   // live granted windows
+size_t pending_posts();  // posts awaiting completion
+// "window <id> len=.. mode=.. peer=.. deadline_in_ms=.." lines + the
+// counter block (the /pools verbs section).
+std::string DebugString();
+
+}  // namespace verbs
+}  // namespace tpurpc

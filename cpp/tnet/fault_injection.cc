@@ -29,7 +29,8 @@ DEFINE_string(chaos_plan, "",
               "drop, delay (param = microseconds, default 2000), short, "
               "corrupt, reset (read/write ops), refuse "
               "(accept/connect), the zero-copy pool seams "
-              "pool_corrupt, pool_stale (descriptor resolve), "
+              "pool_corrupt, pool_stale (descriptor AND wire-verb "
+              "resolve), "
               "pool_leak (pinned-block release), ring_delay (param = "
               "microseconds), ring_drop (staging-ring completes), and "
               "cost_inflate (param = multiplier, default 10: inflate a "
@@ -38,7 +39,12 @@ DEFINE_string(chaos_plan, "",
               "stream_stall (param = microseconds, default 5000: delay a "
               "STREAM_DATA chunk send — a slow consumer) / "
               "stream_drop_chunk (discard a chunk send; the receiver's "
-              "dup-ack retransmit recovers it from the replay ring); "
+              "dup-ack retransmit recovers it from the replay ring), "
+              "and the one-sided verb plane (ISSUE 18): verb_drop "
+              "(discard a posted REMOTE_READ/REMOTE_WRITE in flight; "
+              "the initiator's pending-wr deadline reaps and retries "
+              "it) / doorbell_delay (param = microseconds, default "
+              "2000: deliver a CQ completion late, parking pollers); "
               "e.g. 'drop=0.01,delay=0.05:2000,cost_inflate=1:8'");
 DEFINE_string(chaos_peers, "",
               "comma list of ip:port remote endpoints the plan applies "
@@ -105,10 +111,16 @@ struct FaultPlan {
     // recovers it from the replay ring).
     double stream_stall = 0.0;
     double stream_drop_chunk = 0.0;
+    // One-sided verb plane (ISSUE 18): drop a posted verb in flight
+    // (the pending-wr deadline reaps and retries) or ring the doorbell
+    // late (CQ completion delivered after doorbell_delay_us).
+    double verb_drop = 0.0;
+    double doorbell_delay = 0.0;
     int64_t delay_us = 2000;
     int64_t ring_delay_us = 2000;
     int64_t cost_inflate_mult = 10;
     int64_t stream_stall_us = 5000;
+    int64_t doorbell_delay_us = 2000;
     std::vector<EndPoint> peers;  // empty = every peer
     // Zone partition (ISSUE 14): all traffic to peers of this zone is
     // cut. Lives in the doubly-buffered plan so the hot path reads it
@@ -205,7 +217,7 @@ bool ParsePlan(const std::string& text, FaultPlan* plan) {
         // (the /chaos page promises validate-before-mutate).
         if (!param_str.empty() && kind != "delay" &&
             kind != "ring_delay" && kind != "cost_inflate" &&
-            kind != "stream_stall") {
+            kind != "stream_stall" && kind != "doorbell_delay") {
             return false;
         }
         const auto parse_us = [&](int64_t* out) {
@@ -250,6 +262,11 @@ bool ParsePlan(const std::string& text, FaultPlan* plan) {
             if (!parse_us(&plan->stream_stall_us)) return false;
         } else if (kind == "stream_drop_chunk") {
             plan->stream_drop_chunk = prob;
+        } else if (kind == "verb_drop") {
+            plan->verb_drop = prob;
+        } else if (kind == "doorbell_delay") {
+            plan->doorbell_delay = prob;
+            if (!parse_us(&plan->doorbell_delay_us)) return false;
         } else {
             return false;
         }
@@ -387,8 +404,13 @@ FaultAction FaultInjection::Decide(FaultOp op, const EndPoint& peer,
     // shift the replayed sequence. The staging ring has NO peer (its
     // completions come from the local device stream), so a per-peer
     // plan must not silently disable ring_delay/ring_drop — ring
-    // decisions bypass the filter.
-    if (op != FaultOp::kRingComplete && !p->Matches(peer)) return action;
+    // decisions bypass the filter. The verb plane is keyed by socket/
+    // window ids, not endpoints (posts carry no EndPoint), so verb and
+    // doorbell decisions bypass it too.
+    if (op != FaultOp::kRingComplete && op != FaultOp::kVerbPost &&
+        op != FaultOp::kCqComplete && !p->Matches(peer)) {
+        return action;
+    }
     const uint64_t n = e.seq.fetch_add(1, std::memory_order_relaxed);
     const uint64_t r =
         splitmix64(e.seed.load(std::memory_order_relaxed) +
@@ -441,6 +463,18 @@ FaultAction FaultInjection::Decide(FaultOp op, const EndPoint& peer,
         } else if (u < (acc += p->stream_stall)) {
             action.kind = FaultAction::kDelay;
             action.delay_us = p->stream_stall_us;
+        }
+    } else if (op == FaultOp::kVerbPost) {
+        // A dropped post vanishes in flight: no completion arrives, the
+        // initiator's pending-wr deadline reaps and retries it — the
+        // retransmit path the verbs soak proves.
+        if (u < p->verb_drop) action.kind = FaultAction::kDrop;
+    } else if (op == FaultOp::kCqComplete) {
+        // The doorbell rings late: the completion is delivered after
+        // the delay, parking CQ pollers (rpc_verbs_cq_parks climbs).
+        if (u < p->doorbell_delay) {
+            action.kind = FaultAction::kDelay;
+            action.delay_us = p->doorbell_delay_us;
         }
     } else {
         double acc = 0.0;

@@ -56,6 +56,16 @@ enum class FaultOp {
     // (stream_drop_chunk=prob -> kDrop, recovered by the receiver's
     // dup-ack retransmit path) deterministically.
     kStreamWrite = 8,
+    // One-sided verb plane (ISSUE 18). kVerbPost: consulted when a
+    // REMOTE_READ/REMOTE_WRITE is posted (verb_drop=prob -> kDrop: the
+    // post vanishes in flight; the initiator's pending-wr deadline
+    // reaps and retries it). kCqComplete: consulted when a completion
+    // is delivered into a doorbell CQ (doorbell_delay=prob[:us] ->
+    // kDelay: the doorbell rings late, parking pollers). Neither is
+    // peer-filtered — verbs are keyed by socket/window ids, not
+    // endpoints.
+    kVerbPost = 9,
+    kCqComplete = 10,
 };
 
 // What the consulting seam should do.

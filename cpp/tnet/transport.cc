@@ -131,13 +131,13 @@ int TierTcp() {
 int TierIci() {
     static const int id = RegisterTransportTier(
         {"ici", /*descriptor_capable=*/true, /*zero_copy=*/true,
-         /*cross_process=*/false});
+         /*cross_process=*/false, /*one_sided=*/true, /*sgl_max=*/16});
     return id;
 }
 int TierShmXproc() {
     static const int id = RegisterTransportTier(
         {"shm_xproc", /*descriptor_capable=*/true, /*zero_copy=*/true,
-         /*cross_process=*/true});
+         /*cross_process=*/true, /*one_sided=*/true, /*sgl_max=*/16});
     return id;
 }
 int TierDevice() {
@@ -191,6 +191,21 @@ bool TransportDescriptorCapable(const Socket* s) {
     // handshake ran); in-process peers resolve the local pool directly.
     if (!t->cross_process) return TransportLocalPoolId() != 0;
     return s->peer_pool_id() != 0 || TransportLocalPoolId() != 0;
+}
+
+bool TransportOneSided(const Socket* s) {
+    if (s == nullptr) return false;
+    const TransportTier* t = GetTransportTier(s->transport_tier());
+    if (t == nullptr || !t->one_sided) return false;
+    // A window is a pool reference — the same mapping evidence that
+    // gates descriptors gates direct verb data movement.
+    return TransportDescriptorCapable(s);
+}
+
+uint32_t TransportSglMax(const Socket* s) {
+    if (s == nullptr) return 0;
+    const TransportTier* t = GetTransportTier(s->transport_tier());
+    return (t != nullptr && t->one_sided) ? t->sgl_max : 0;
 }
 
 bool TransportDescriptorScopeOk(const Socket* s, uint64_t pool_id) {
@@ -274,11 +289,13 @@ std::string DebugString() {
     for (int i = 0; i < n; ++i) {
         const TierSlot& s = r.slots[i];
         snprintf(line, sizeof(line),
-                 "tier %-9s desc=%d zero_copy=%d xproc=%d in=%lld "
+                 "tier %-9s desc=%d zero_copy=%d xproc=%d one_sided=%d "
+                 "sgl_max=%u in=%lld "
                  "out=%lld desc_in=%lld desc_out=%lld stalls=%lld "
                  "ops=%lld\n",
                  s.tier.name, s.tier.descriptor_capable ? 1 : 0,
                  s.tier.zero_copy ? 1 : 0, s.tier.cross_process ? 1 : 0,
+                 s.tier.one_sided ? 1 : 0, s.tier.sgl_max,
                  (long long)s.in->get(), (long long)s.out->get(),
                  (long long)s.desc_in->get(), (long long)s.desc_out->get(),
                  (long long)s.credit_stalls->get(),

@@ -58,6 +58,14 @@ struct TransportTier {
     // The peer lives in another process (its pool is mapped shm, not
     // this process's own allocator).
     bool cross_process = false;
+    // One-sided verbs (ISSUE 18): REMOTE_READ/REMOTE_WRITE posted
+    // against leased pool windows move data with ZERO remote CPU on the
+    // data path (shm_xproc memcpy-direct today). Tiers without the bit
+    // degrade to wire-emulated two-sided verbs through the same seam.
+    bool one_sided = false;
+    // Max scatter-gather entries one posted verb may carry (0 = no SGL;
+    // a multi-block post must be emulated entry-by-entry).
+    uint32_t sgl_max = 0;
 };
 
 // Register a tier; returns its id (stable, small). Re-registering an
@@ -119,6 +127,14 @@ bool TransportDescriptorCapable(const Socket* s);
 // authorize: any connection could otherwise name another tenant's
 // mapped pool and read memory it was never handed.
 bool TransportDescriptorScopeOk(const Socket* s, uint64_t pool_id);
+
+// Verb eligibility (ISSUE 18): may one-sided verbs move data directly
+// on this socket? Tier one_sided bit AND descriptor eligibility (a
+// window is a pool reference, so the same pool-mapping evidence
+// applies). False routes posts through the emulated two-sided path.
+bool TransportOneSided(const Socket* s);
+// The socket tier's sgl_max (0 when null/one-sided-incapable).
+uint32_t TransportSglMax(const Socket* s);
 
 // ---- per-tier byte/credit attribution ----
 // Every transport's data-plane volume lands in one labelled family set

@@ -8,6 +8,7 @@
 #include "tbase/iobuf.h"
 #include "tici/block_lease.h"
 #include "tici/block_pool.h"
+#include "tici/verbs.h"
 #include "tnet/transport.h"
 #include "trpc/pb_compat.h"
 #include "trpc/policy_tpu_std.h"
@@ -102,6 +103,40 @@ int tpurpc_transport_tier_zero_copy(int tier) {
 int tpurpc_transport_tier_cross_process(int tier) {
     const tpurpc::TransportTier* t = tpurpc::GetTransportTier(tier);
     return t != nullptr ? (t->cross_process ? 1 : 0) : -1;
+}
+
+int tpurpc_transport_tier_one_sided(int tier) {
+    const tpurpc::TransportTier* t = tpurpc::GetTransportTier(tier);
+    return t != nullptr ? (t->one_sided ? 1 : 0) : -1;
+}
+
+long tpurpc_transport_tier_sgl_max(int tier) {
+    const tpurpc::TransportTier* t = tpurpc::GetTransportTier(tier);
+    return t != nullptr ? (long)t->sgl_max : -1;
+}
+
+long tpurpc_verbs_posted() { return (long)tpurpc::verbs::posted(); }
+
+long tpurpc_verbs_completed() {
+    return (long)tpurpc::verbs::completed();
+}
+
+long tpurpc_verbs_bytes() {
+    return (long)tpurpc::verbs::bytes_moved();
+}
+
+long tpurpc_verbs_stale_rejects() {
+    return (long)tpurpc::verbs::stale_rejects();
+}
+
+long tpurpc_verbs_cq_parks() { return (long)tpurpc::verbs::cq_parks(); }
+
+long tpurpc_verbs_windows() {
+    return (long)tpurpc::verbs::window_count();
+}
+
+long tpurpc_verbs_pending() {
+    return (long)tpurpc::verbs::pending_posts();
 }
 
 long tpurpc_transport_tier_ops(int tier) {

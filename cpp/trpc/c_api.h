@@ -70,6 +70,19 @@ uint64_t tpurpc_lease_pinned();
 uint64_t tpurpc_lease_reaped();
 uint64_t tpurpc_pool_epoch();
 
+// ---- one-sided verbs (ISSUE 18) ----
+// Counters of the verb plane (tici/verbs.h): posted/completed verbs,
+// bytes moved by REMOTE_READ/REMOTE_WRITE, stale-epoch rejects, and CQ
+// parks — plus the live window / pending-post gauges the soak uses as
+// leak evidence (a healthy run ends with both at 0).
+long tpurpc_verbs_posted();
+long tpurpc_verbs_completed();
+long tpurpc_verbs_bytes();
+long tpurpc_verbs_stale_rejects();
+long tpurpc_verbs_cq_parks();
+long tpurpc_verbs_windows();
+long tpurpc_verbs_pending();
+
 // ---- transport tier registry (ISSUE 12) ----
 // Introspection of the first-class Transport seam (tnet/transport.h):
 // how many endpoint types are registered, their names, and their
@@ -83,6 +96,11 @@ long tpurpc_transport_tier_name(int tier, char* out, size_t cap);
 int tpurpc_transport_tier_descriptor_capable(int tier);
 int tpurpc_transport_tier_zero_copy(int tier);
 int tpurpc_transport_tier_cross_process(int tier);
+// One-sided verb plane (ISSUE 18): does the tier take REMOTE_READ /
+// REMOTE_WRITE against leased pool windows, and how many scatter-gather
+// entries fit in one verb (0 = one-sided-incapable).
+int tpurpc_transport_tier_one_sided(int tier);
+long tpurpc_transport_tier_sgl_max(int tier);
 // Per-tier attribution counters (ops for the device tier's staging-ring
 // completes; bytes for socket-attached tiers).
 long tpurpc_transport_tier_ops(int tier);

@@ -4,11 +4,14 @@
 #include <cstring>
 #include <set>
 
+#include "tbase/crc32c.h"
 #include "tbase/errno.h"
 #include "tbase/logging.h"
 #include "tbase/time.h"
 #include "tfiber/fiber.h"
 #include "tici/block_pool.h"
+#include "tici/verbs.h"
+#include "trpc/channel.h"
 #include "trpc/combo_channels.h"
 #include "trpc/controller.h"
 #include "tvar/multi_dimension.h"
@@ -28,6 +31,19 @@ static LazyAdder g_retries("rpc_collective_retries");
 static LazyAdder g_reforms("rpc_collective_reforms");
 static LazyAdder g_bytes("rpc_collective_bytes");
 static LazyAdder g_desc_fallbacks("rpc_collective_desc_fallbacks");
+// Verbs lane (ISSUE 18): ring steps that moved as one scatter-gather
+// REMOTE_WRITE + doorbell, and the chunks that had to ride the
+// per-chunk RPC path although the verbs lane was requested (lane grant
+// refused, stale window, doorbell failure). A healthy verbs mesh keeps
+// fallbacks at 0 — the bench's verbs-vs-chunks proof.
+static LazyAdder g_verb_steps("rpc_collective_verb_steps");
+static LazyAdder g_verb_fallbacks("rpc_collective_verb_fallbacks");
+
+// wr_id namespace tag for collective verb posts (uniqueness among
+// pending posts is process-wide; the mesh traffic fiber uses its own
+// tag).
+constexpr uint64_t kCollWrTag = 0x434Full << 48;
+std::atomic<uint64_t> g_coll_wr{1};
 
 // Per-algorithm bus bandwidth of the most recent completed round
 // (NCCL-style busbw: the payload-derived rate every algorithm can be
@@ -463,12 +479,56 @@ int CollectiveEngine::RunRingAttempt(const std::shared_ptr<Round>& round,
     const uint32_t pred_rank = (me + n - 1) % n;
     const uint64_t chunk_words = std::max<uint64_t>(1, opts_.chunk_bytes / 4);
 
+    // ---- verbs lane setup (ISSUE 18) ----
+    // One leased window on the ring SUCCESSOR, sized to the largest
+    // shard: every step REMOTE_WRITEs its whole shard there with one
+    // scatter-gather verb, then rings the doorbell with a payload-free
+    // CollChunk RPC. Lane setup needs the successor's pinned socket
+    // (the grant exchange and emulated verbs ride that connection);
+    // anything missing falls back to the per-chunk path, counted.
+    const bool verbs_wanted = opts_.verbs_lane && nwords > 0 && n >= 2;
+    bool use_verbs = false;
+    verbs::CompletionQueue lane_cq;
+    verbs::RemoteWindow lane;
+    if (verbs_wanted) {
+        const uint32_t succ = (me + 1) % n;
+        uint64_t lane_sid = 0;
+        auto* ch =
+            dynamic_cast<Channel*>(round->members[succ].chan.get());
+        if (ch != nullptr) lane_sid = ch->pinned_socket();
+        const uint64_t max_shard_bytes =
+            (nwords / n + (nwords % n != 0 ? 1 : 0)) * 4;
+        if (lane_sid != 0 && lane_sid != INVALID_VREF_ID &&
+            verbs::RequestWindow(lane_sid, max_shard_bytes,
+                                 verbs::kWinWrite, opts_.step_timeout_ms,
+                                 &lane) == 0) {
+            use_verbs = true;
+        }
+    }
+
     for (uint32_t step = 0; step + 1 < 2 * n - 1; ++step) {
         const uint32_t oshard = OutShard(me, step, n);
         uint64_t w0 = 0, wn = 0;
         ShardRange(nwords, n, oshard, &w0, &wn);
         const uint32_t nchunks = ChunksOf(wn, chunk_words);
+        if (use_verbs && nchunks > 0) {
+            const int verr =
+                VerbsRingStep(round, attempt, step, w0, wn, nchunks,
+                              chunk_words, &lane_cq, lane,
+                              attempt_deadline_us, r);
+            if (verr == 0) continue;
+            if (verr > 0) return verr;
+            // Lane died (stale window, post/doorbell failure): the
+            // remaining steps — starting with a resend of THIS one —
+            // ride the per-chunk path; key dedupe absorbs any overlap
+            // with verb work that did land.
+            use_verbs = false;
+        }
         for (uint32_t c = 0; c < nchunks; ++c) {
+            if (verbs_wanted && !use_verbs) {
+                if (r != nullptr) r->verb_fallback_chunks++;
+                *g_verb_fallbacks << 1;
+            }
             if (step > 0) {
                 // The bytes about to go out were produced by the
                 // step-1 incoming chunk: wait for its application.
@@ -515,6 +575,123 @@ int CollectiveEngine::RunRingAttempt(const std::shared_ptr<Round>& round,
     KeySetWait ks{&expect, true};
     return WaitRound(round.get(), attempt, attempt_deadline_us,
                      &PredKeysAppliedAndDrained, &ks);
+}
+
+int CollectiveEngine::VerbsRingStep(const std::shared_ptr<Round>& round,
+                                    uint64_t attempt, uint32_t step,
+                                    uint64_t w0, uint64_t wn,
+                                    uint32_t nchunks, uint64_t chunk_words,
+                                    verbs::CompletionQueue* cq,
+                                    const verbs::RemoteWindow& lane,
+                                    int64_t attempt_deadline_us,
+                                    Result* r) {
+    uint32_t n, me, pred_rank;
+    {
+        FiberMutexGuard g(round->mu);
+        if (round->attempt != attempt) return TERR_STALE_EPOCH;
+        if (round->fail_error != 0) return round->fail_error;
+        n = round->nranks;
+        me = round->my_rank;
+    }
+    pred_rank = (me + n - 1) % n;
+    // The bytes about to go out were produced by the step-1 incoming
+    // applies: wait for ALL of them (the SGL write moves the whole
+    // shard at once, so the per-chunk overlap of the RPC path becomes
+    // per-step here — the verb itself is the bulk win).
+    if (step > 0) {
+        std::vector<uint64_t> deps;
+        deps.reserve(nchunks);
+        for (uint32_t c = 0; c < nchunks; ++c) {
+            deps.push_back(PackChunk(pred_rank, step - 1, c));
+        }
+        KeySetWait ks{&deps, false};
+        const int err = WaitRound(round.get(), attempt, attempt_deadline_us,
+                                  &PredKeysAppliedAndDrained, &ks);
+        if (err != 0) return err;
+    }
+    // Snapshot wire fields + the shard base under the lock. The buffer
+    // never reallocates during a round and the dep wait above ordered
+    // the producer writes, so gathering from it lock-free is safe.
+    CollWire w;
+    char* base = nullptr;
+    {
+        FiberMutexGuard g(round->mu);
+        if (round->attempt != attempt) return TERR_STALE_EPOCH;
+        if (round->fail_error != 0) return round->fail_error;
+        base = &round->buf[(size_t)(w0 * 4)];
+        w.seq = round->seq;
+        w.scope = round->scope;
+        w.member_hash = round->member_hash;
+        w.total_bytes = round->total_bytes;
+    }
+    w.kind = COLL_ALLREDUCE;
+    w.step = step;
+    w.chunk = kVerbDoorbellChunk;
+    w.src_rank = me;
+    w.nranks = n;
+    w.offset = w0 * 4;
+    w.len = wn * 4;
+    w.verb_window = lane.window_id;
+    w.verb_nchunks = nchunks;
+    w.verb_epoch = lane.epoch;
+
+    // One scatter-gather WRITE covering the step's chunks (window
+    // offset 0 every step — the sync doorbell below orders the reuse).
+    std::vector<verbs::Sge> sgl;
+    sgl.reserve(nchunks);
+    for (uint32_t c = 0; c < nchunks; ++c) {
+        const uint64_t cw = std::min<uint64_t>(
+            chunk_words, wn - (uint64_t)c * chunk_words);
+        verbs::Sge sg;
+        sg.addr = base + (size_t)c * chunk_words * 4;
+        sg.len = cw * 4;
+        sgl.push_back(sg);
+    }
+    const uint64_t wr = kCollWrTag | g_coll_wr.fetch_add(1);
+    if (verbs::PostWrite(cq, wr, lane, 0, sgl.data(),
+                         (uint32_t)sgl.size()) != 0) {
+        return -1;
+    }
+    // The completion ALWAYS arrives while we park (the CQ drives the
+    // pending-post reaper; a dropped verb retries a bounded number of
+    // times and then completes TERR_RPC_TIMEDOUT) — and it MUST be
+    // collected before returning: the CQ is the attempt's stack frame.
+    verbs::Completion comp;
+    for (;;) {
+        if (!cq->Park(&comp, 8 * 1000 * 1000)) return TERR_INTERNAL;
+        if (comp.wr_id == wr) break;  // stray: an older step's retry
+    }
+    if (comp.status != 0) return -1;
+    w.verb_crc = crc32c_extend(0, base, (size_t)(wn * 4));
+
+    // Ring the doorbell: a payload-free chunk RPC through the normal
+    // funnel (its retries absorb receiver round skew the same way the
+    // chunk path's do).
+    std::shared_ptr<google::protobuf::RpcChannel> chan;
+    {
+        FiberMutexGuard g(round->mu);
+        if (round->attempt != attempt) return TERR_STALE_EPOCH;
+        if (round->fail_error != 0) return round->fail_error;
+        chan = round->members[(me + 1) % n].chan;
+    }
+    std::unique_ptr<google::protobuf::Message> req(codec_->NewRequest(w));
+    std::unique_ptr<google::protobuf::Message> rsp(codec_->NewResponse());
+    Controller cntl;
+    cntl.set_timeout_ms(std::max<int64_t>(
+        1, std::min(opts_.step_timeout_ms,
+                    (attempt_deadline_us - monotonic_time_us()) / 1000)));
+    cntl.set_max_retry(opts_.max_chunk_retries + 4);
+    chan->CallMethod(codec_->method(), &cntl, req.get(), rsp.get(),
+                     nullptr);
+    *g_steps << 1;
+    *g_verb_steps << 1;
+    *g_bytes << (int64_t)(wn * 4);
+    if (r != nullptr) {
+        r->moved_bytes += wn * 4;
+        r->verb_steps++;
+    }
+    if (cntl.Failed()) return -1;
+    return 0;
 }
 
 // ---------------- fan-out phases (ParallelChannel reuse) ----------------
@@ -870,10 +1047,14 @@ namespace {
 // Bench-only algorithm tag for the hierarchical composition (not a
 // wire kind — rounds of the hier phases record under their own op).
 constexpr uint32_t kAlgHierAllReduce = 100;
+// Bench-only tag for a ring all-reduce whose every step rode the verbs
+// lane (ISSUE 18) — recorded apart from the chunked ring so the bench
+// can gate verbs-vs-chunks directly off the two gauges.
+constexpr uint32_t kAlgVerbsAllReduce = 101;
 
 double BusbwFactor(uint32_t rkind, uint32_t n) {
     if (rkind == COLL_ALLREDUCE || rkind == COLL_SERIAL_PUSH ||
-        rkind == kAlgHierAllReduce) {
+        rkind == kAlgHierAllReduce || rkind == kAlgVerbsAllReduce) {
         return 2.0 * (n - 1) / n;
     }
     return (double)(n - 1) / n;
@@ -891,6 +1072,8 @@ const char* AlgName(uint32_t rkind) {
             return "allreduce_serial";
         case kAlgHierAllReduce:
             return "hier_allreduce";
+        case kAlgVerbsAllReduce:
+            return "allreduce_verbs";
         default:
             return "unknown";
     }
@@ -981,7 +1164,13 @@ int CollectiveEngine::AllReduce(uint64_t seq, uint32_t* words,
     r->elapsed_us = monotonic_time_us() - t0;
     if (err == 0) {
         *g_ops << 1;
-        RecordBusbw(COLL_ALLREDUCE, nwords * 4, r);
+        // A round whose EVERY step rode the verbs lane records under
+        // its own gauge (the bench's verbs-vs-chunks numerator); any
+        // fallback taints the sample back onto the chunked gauge.
+        RecordBusbw(r->verb_steps > 0 && r->verb_fallback_chunks == 0
+                        ? kAlgVerbsAllReduce
+                        : COLL_ALLREDUCE,
+                    nwords * 4, r);
     }
     return err;
 }
@@ -1511,6 +1700,49 @@ int CollectiveEngine::HandleIncoming(const CollWire& w, const char* data,
 
     switch (w.kind) {
         case COLL_ALLREDUCE: {
+            if (w.verb_nchunks > 0 && w.chunk == kVerbDoorbellChunk) {
+                // Verbs doorbell (ISSUE 18): the step's shard bytes
+                // were already REMOTE_WRITTEN into OUR granted window —
+                // validate the window (lease + epoch fence, counted
+                // stale rejects inside WindowPtr), crc the span, apply
+                // it whole, and mark every chunk key the driver's
+                // completion wait expects. Chunk size must agree with
+                // ours or the key accounting diverges.
+                const uint64_t cw =
+                    std::max<uint64_t>(1, opts_.chunk_bytes / 4);
+                if (w.offset % 4 != 0 || w.len % 4 != 0 || w.len == 0 ||
+                    w.offset > round->total_bytes ||
+                    w.len > round->total_bytes - w.offset ||
+                    ChunksOf(w.len / 4, cw) != w.verb_nchunks) {
+                    return TERR_REQUEST;
+                }
+                const uint64_t k0 = PackChunk(w.src_rank, w.step, 0);
+                if (round->applied.count(k0) != 0) {
+                    *applied = 2;
+                    return 0;
+                }
+                char* p = nullptr;
+                const int vrc = verbs::WindowPtr(
+                    w.verb_window, 0, w.len, w.verb_epoch,
+                    verbs::kWinWrite, &p);
+                if (vrc != 0) return vrc;  // stale window: retriable
+                if (crc32c_extend(0, p, (size_t)w.len) != w.verb_crc) {
+                    return TERR_REQUEST;
+                }
+                char* dst = &round->buf[(size_t)w.offset];
+                if (w.step + 1 < round->nranks) {
+                    AddWordsWraparound(dst, p, (size_t)w.len);
+                } else {
+                    memcpy(dst, p, (size_t)w.len);
+                }
+                for (uint32_t c = 0; c < w.verb_nchunks; ++c) {
+                    round->applied.insert(
+                        PackChunk(w.src_rank, w.step, c));
+                }
+                round->cv.notify_all();
+                *applied = 1;
+                return 0;
+            }
             if (w.offset % 4 != 0 || w.len % 4 != 0 ||
                 w.offset > round->total_bytes ||
                 w.len > round->total_bytes - w.offset || len != w.len) {
@@ -1658,11 +1890,14 @@ void CollectiveEngine::ExposeVars() {
     *g_reforms << 0;
     *g_bytes << 0;
     *g_desc_fallbacks << 0;
+    *g_verb_steps << 0;
+    *g_verb_fallbacks << 0;
     BusbwFamily()->get_stats({"allreduce"});
     BusbwFamily()->get_stats({"allgather"});
     BusbwFamily()->get_stats({"alltoall"});
     BusbwFamily()->get_stats({"allreduce_serial"});
     BusbwFamily()->get_stats({"hier_allreduce"});
+    BusbwFamily()->get_stats({"allreduce_verbs"});
 }
 
 void CollectiveEngine::FillDeterministic(uint64_t seq, uint64_t key,

@@ -50,6 +50,10 @@
 namespace tpurpc {
 
 class Controller;
+namespace verbs {
+class CompletionQueue;
+struct RemoteWindow;
+}  // namespace verbs
 
 // Wire metadata of one collective chunk RPC (mirrors
 // benchpb.CollChunk; the engine is payload-proto-agnostic — the host
@@ -66,7 +70,22 @@ struct CollWire {
     uint64_t offset = 0;       // byte offset (per-kind: absolute / in-block)
     uint64_t len = 0;          // chunk byte length
     uint32_t scope = 0;        // CollScope (round-key namespace, ISSUE 14)
+    // Verbs doorbell (ISSUE 18): when verb_nchunks > 0 (and chunk is
+    // the kVerbDoorbellChunk sentinel) the step's whole shard was
+    // already REMOTE_WRITTEN into the receiver's granted window
+    // `verb_window` by one scatter-gather verb — this RPC carries no
+    // payload and just asks for the apply. offset/len span the whole
+    // shard; verb_crc covers the window bytes; verb_epoch is the
+    // grant-time pool epoch (the staleness fence).
+    uint64_t verb_window = 0;
+    uint32_t verb_nchunks = 0;
+    uint32_t verb_crc = 0;
+    uint64_t verb_epoch = 0;
 };
+
+// CollWire.chunk value marking a verbs doorbell (never a real chunk
+// ordinal: chunk indices are bounded far below 2^24 by slab sizing).
+constexpr uint32_t kVerbDoorbellChunk = 0xFFFFFF;
 
 // Membership scope of a round (ISSUE 14): hierarchical collectives run
 // each phase over a FILTERED membership — the scope is part of the
@@ -164,6 +183,14 @@ struct CollectiveOptions {
     // Post chunks as one-sided pool descriptors (ineligible buffers /
     // transports fall back inline and are counted).
     bool pool_descriptors = true;
+    // Ring all-reduce steps move through the one-sided verb plane
+    // (ISSUE 18): one scatter-gather REMOTE_WRITE into the successor's
+    // leased window per step + one payload-free doorbell RPC, instead
+    // of per-chunk descriptor RPCs. Lane setup failure (grant refused,
+    // epoch bump, verb-incapable peer without the emulated seam) falls
+    // back to the chunk path and counts
+    // rpc_collective_verb_fallbacks.
+    bool verbs_lane = false;
 };
 
 class CollectiveEngine {
@@ -181,6 +208,11 @@ public:
         int retries = 0;           // same-membership attempt re-runs
         int reforms = 0;           // membership-changed restarts
         uint64_t desc_fallback_chunks = 0;  // chunks that went inline
+        // Verbs lane accounting (ISSUE 18): ring steps that moved as
+        // one SGL verb + doorbell, and chunks that fell back to the
+        // per-chunk RPC path although verbs_lane was requested.
+        uint64_t verb_steps = 0;
+        uint64_t verb_fallback_chunks = 0;
         // NCCL-style bus bandwidth of the completed round (also set on
         // the rpc_collective_busbw_mbps{alg} gauge) — computed HERE so
         // drivers and the bench report the same number the same way.
@@ -254,6 +286,12 @@ public:
     // Unblock every parked driver and handler (server teardown).
     void Shutdown();
 
+    // Flip the verbs lane between rounds (the mesh driver's
+    // allreduce_verbs / allreduce_chunks A/B switch). NOT synchronized
+    // against in-flight driver calls — call only from the (single)
+    // driving fiber between ops.
+    void set_verbs_lane(bool v) { opts_.verbs_lane = v; }
+
     // Highest round seq seen on the wire (any kind). A node that
     // (re)joins a running mesh adopts this as its next round instead of
     // restarting from 1 — the rejoin path of the continuous-traffic
@@ -319,6 +357,21 @@ private:
     void FinishRound(const std::shared_ptr<Round>& round, int err);
     int RunRingAttempt(const std::shared_ptr<Round>& round,
                        int64_t attempt_deadline_us, Result* r);
+    // One verbs-backed ring step (ISSUE 18): wait the step's reduce
+    // dependencies, post one scatter-gather REMOTE_WRITE of the whole
+    // shard into the successor's leased window, park on the doorbell
+    // CQ, then fire the payload-free apply RPC. Returns 0 on success,
+    // a positive TERR_* that fails the attempt (stale attempt /
+    // deadline), or -1 meaning "lane unusable — resend this step
+    // through the per-chunk path" (the handler's key dedupe makes the
+    // overlap safe). `cq` and `lane` are the attempt's stack lane; the
+    // step never returns with its post still pending.
+    int VerbsRingStep(const std::shared_ptr<Round>& round, uint64_t attempt,
+                      uint32_t step, uint64_t w0, uint64_t wn,
+                      uint32_t nchunks, uint64_t chunk_words,
+                      verbs::CompletionQueue* cq,
+                      const verbs::RemoteWindow& lane,
+                      int64_t attempt_deadline_us, Result* r);
     int RunFanoutAttempt(const std::shared_ptr<Round>& round, uint32_t kind,
                          int64_t attempt_deadline_us, Result* r);
     int RunSerialAttempt(const std::shared_ptr<Round>& round,

@@ -35,6 +35,14 @@ public:
         req->set_offset(w.offset);
         req->set_len(w.len);
         req->set_scope(w.scope);
+        if (w.verb_nchunks > 0) {
+            // Verbs doorbell (ISSUE 18): window coordinates instead of
+            // payload bytes.
+            req->set_verb_window(w.verb_window);
+            req->set_verb_nchunks(w.verb_nchunks);
+            req->set_verb_crc(w.verb_crc);
+            req->set_verb_epoch(w.verb_epoch);
+        }
         return req;
     }
     google::protobuf::Message* NewResponse() const override {
@@ -70,6 +78,10 @@ inline void HandleCollectiveExchange(CollectiveEngine* eng,
     w.offset = req->offset();
     w.len = req->len();
     w.scope = req->scope();
+    w.verb_window = req->verb_window();
+    w.verb_nchunks = req->verb_nchunks();
+    w.verb_crc = req->verb_crc();
+    w.verb_epoch = req->verb_epoch();
     const char* data = nullptr;
     size_t len = 0;
     std::string inline_copy;

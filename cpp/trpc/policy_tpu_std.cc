@@ -18,6 +18,8 @@
 #include "tici/block_lease.h"
 #include "tici/block_pool.h"
 #include "tici/shm_link.h"
+#include "tici/verbs.h"
+#include "tnet/transport.h"
 #include "tnet/fault_injection.h"
 #include "tnet/input_messenger.h"
 #include "trpc/auth.h"
@@ -40,6 +42,14 @@ DECLARE_bool(rpc_checksum);
 // pthread-blocking user code cannot starve the IO path. <=0 disables.
 DEFINE_int32(usercode_backup_threshold, 512,
              "in-flight user handlers before overflow is isolated");
+
+// Push-stream descriptor eligibility (ISSUE 18 satellite): chunks at or
+// above this ride descriptor-capable links as pool references instead
+// of inline frame bytes; smaller chunks are not worth the pin+ack.
+DEFINE_int64(stream_desc_min_bytes, 4096,
+             "min push-stream chunk size sent as a pool descriptor on "
+             "descriptor-capable links (first sends only; replays stay "
+             "inline)");
 
 namespace tpurpc {
 
@@ -73,6 +83,15 @@ static LazyAdder g_rsp_desc_resolve_bytes(
     "rpc_pool_desc_rsp_resolve_bytes");
 static LazyAdder g_rsp_desc_rejects("rpc_pool_desc_rsp_rejects");
 static LazyAdder g_rsp_desc_acks("rpc_pool_desc_rsp_acks");
+// Push-stream chunks as descriptors (ISSUE 18 satellite): chunk sends
+// that rode as pool references, shapes that fell back to inline bytes,
+// receiver-side in-place resolves, and references the receiver could
+// not honor (dropped frame — the stream's gap-NAK retransmit recovers
+// the chunk inline).
+static LazyAdder g_stream_desc_chunks("rpc_stream_desc_chunks");
+static LazyAdder g_stream_desc_fallbacks("rpc_stream_desc_fallbacks");
+static LazyAdder g_stream_desc_resolves("rpc_stream_desc_resolves");
+static LazyAdder g_stream_desc_rejects("rpc_stream_desc_rejects");
 
 namespace rsp_desc {
 void CountSend(int64_t bytes) {
@@ -184,21 +203,61 @@ void SendTpuStdDescAck(SocketId sid, uint64_t cid, uint64_t ack_token) {
 // set; DATA's chunk bytes ride as the frame payload.
 
 int SendTpuStdStreamData(SocketId sid, uint64_t stream_id, uint64_t seq,
-                         uint32_t flags, const std::string& chunk) {
+                         uint32_t flags, const std::string& chunk,
+                         bool try_desc) {
     rpc::RpcMeta meta;
     auto* sf = meta.mutable_stream_frame();
     sf->set_stream_id(stream_id);
     sf->set_kind(1);  // KIND_DATA
     sf->set_seq(seq);
     if (flags != 0) sf->set_flags(flags);
-    IOBuf meta_buf;
-    SerializePbToIOBuf(meta, &meta_buf);
-    IOBuf payload;
-    payload.append(chunk);
-    IOBuf frame;
-    PackTpuStdFrame(&frame, meta_buf, payload, IOBuf());
     SocketUniquePtr s;
     if (Socket::AddressSocket(sid, &s) != 0) return -1;
+    // Descriptor-eligible chunk (ISSUE 18 satellite): pin a pool copy
+    // and send the REFERENCE; the receiver resolves in place and
+    // desc_acks with correlation id = seq (the lease's armed call id).
+    // Every failure mode falls back to inline bytes — and a pin whose
+    // frame never reaches the peer is freed by the lease reaper.
+    IOBuf payload;
+    bool desc_sent = false;
+    if (try_desc && !chunk.empty() &&
+        (int64_t)chunk.size() >= FLAGS_stream_desc_min_bytes.get() &&
+        TransportDescriptorCapable(s.get())) {
+        IOBuf pin;
+        if (IciBlockPool::AllocatePoolAttachmentCopy(
+                chunk.data(), chunk.size(), &pin)) {
+            size_t blen = 0;
+            const char* bdata = pin.backing_block_data(0, &blen);
+            uint64_t off = 0;
+            if (blen == chunk.size() &&
+                IciBlockPool::OffsetOf(bdata, &off)) {
+                const uint32_t crc = crc32c_extend(0, bdata, blen);
+                const uint64_t lease =
+                    block_lease::Pin(std::move(pin), "rsp");
+                if (block_lease::Arm(lease, seq, 0, (uint64_t)sid)) {
+                    auto* pd = sf->mutable_pool_attachment();
+                    pd->set_pool_id(IciBlockPool::pool_id());
+                    pd->set_offset(off);
+                    pd->set_length(chunk.size());
+                    pd->set_crc32c(crc);
+                    pd->set_pool_epoch(IciBlockPool::pool_epoch());
+                    pd->set_ack_token(lease);
+                    desc_sent = true;
+                    *g_stream_desc_chunks << 1;
+                    transport_stats::AddDescOut(s->transport_tier(),
+                                                (int64_t)chunk.size());
+                } else {
+                    block_lease::Release(lease);
+                }
+            }
+        }
+        if (!desc_sent) *g_stream_desc_fallbacks << 1;
+    }
+    if (!desc_sent) payload.append(chunk);
+    IOBuf meta_buf;
+    SerializePbToIOBuf(meta, &meta_buf);
+    IOBuf frame;
+    PackTpuStdFrame(&frame, meta_buf, payload, IOBuf());
     return s->Write(&frame);
 }
 
@@ -234,6 +293,69 @@ int SendTpuStdStreamClose(SocketId sid, uint64_t stream_id,
     if (Socket::AddressSocket(sid, &s) != 0) return -1;
     return s->Write(&frame);
 }
+
+// ---- one-sided verbs (ISSUE 18): meta-only grant/verb frames and the
+// hooks the pb-free tici/verbs layer calls through. WindowGrant frames
+// correlate by correlation_id; verb frames correlate by wr_id.
+
+namespace {
+
+int SendVerbGrantRequest(uint64_t sid, uint64_t token, uint64_t length,
+                         uint32_t mode, int64_t lease_ms) {
+    rpc::RpcMeta meta;
+    meta.set_correlation_id(token);
+    auto* wg = meta.mutable_window_grant();
+    wg->set_kind(1);  // REQUEST
+    wg->set_length(length);
+    wg->set_mode(mode);
+    if (lease_ms > 0) wg->set_lease_ms(lease_ms);
+    IOBuf meta_buf;
+    SerializePbToIOBuf(meta, &meta_buf);
+    IOBuf frame;
+    PackTpuStdFrame(&frame, meta_buf, IOBuf(), IOBuf());
+    SocketUniquePtr s;
+    if (Socket::AddressSocket((SocketId)sid, &s) != 0) return -1;
+    return s->Write(&frame);
+}
+
+// The wire emulation of one posted verb (verb-incapable tiers, and
+// capable tiers whose mapping went stale): WRITE's gathered bytes ride
+// as the frame body; READ is meta-only out, bytes come back on the
+// completion frame.
+int SendVerbWire(uint64_t sid, int op, uint64_t wr_id,
+                 uint64_t window_id, uint64_t offset, uint64_t len,
+                 uint64_t epoch, uint32_t crc, const IOBuf& payload) {
+    rpc::RpcMeta meta;
+    auto* vp = meta.mutable_verb_post();
+    vp->set_op(op);
+    vp->set_wr_id(wr_id);
+    vp->set_window_id(window_id);
+    vp->set_offset(offset);
+    vp->set_length(len);
+    vp->set_pool_epoch(epoch);
+    if (crc != 0 || op == verbs::kRemoteWrite) vp->set_crc32c(crc);
+    IOBuf meta_buf;
+    SerializePbToIOBuf(meta, &meta_buf);
+    IOBuf frame;
+    PackTpuStdFrame(&frame, meta_buf, payload, IOBuf());
+    SocketUniquePtr s;
+    if (Socket::AddressSocket((SocketId)sid, &s) != 0) return -1;
+    return s->Write(&frame);
+}
+
+bool VerbOneSidedProbe(uint64_t sid) {
+    SocketUniquePtr s;
+    if (Socket::AddressSocket((SocketId)sid, &s) != 0) return false;
+    return TransportOneSided(s.get());
+}
+
+uint32_t VerbSglMaxProbe(uint64_t sid) {
+    SocketUniquePtr s;
+    if (Socket::AddressSocket((SocketId)sid, &s) != 0) return 0;
+    return TransportSglMax(s.get());
+}
+
+}  // namespace
 
 void PackTpuStdFrame(IOBuf* out, const IOBuf& meta_pb, const IOBuf& payload,
                      const IOBuf& attachment) {
@@ -1152,6 +1274,93 @@ void ProcessTpuStdMessage(InputMessageBase* raw) {
         rsp_desc::CountAck();
         return;
     }
+    if (meta.has_window_grant()) {
+        // Verb window grant exchange (ISSUE 18): REQUEST carves + pins
+        // a window and answers GRANT on the same connection; GRANT
+        // wakes the RequestWindow waiter by correlation token. Both
+        // are meta-only frames.
+        const auto& wg = meta.window_grant();
+        if (wg.kind() == 1) {
+            verbs::WindowInfo info;
+            const int rc = verbs::HandleGrantRequest(
+                (uint64_t)msg->socket_id, wg.length(), wg.mode(),
+                wg.has_lease_ms() ? wg.lease_ms() : 0, &info);
+            rpc::RpcMeta rsp;
+            rsp.set_correlation_id(meta.correlation_id());
+            auto* out = rsp.mutable_window_grant();
+            out->set_kind(2);  // GRANT
+            if (rc != 0) {
+                out->set_status(rc);
+            } else {
+                out->set_window_id(info.window_id);
+                out->set_pool_id(info.pool_id);
+                out->set_offset(info.offset);
+                out->set_length(info.length);
+                out->set_pool_epoch(info.epoch);
+                out->set_mode(info.mode);
+                out->set_lease_ms(info.lease_ms);
+            }
+            IOBuf meta_buf;
+            SerializePbToIOBuf(rsp, &meta_buf);
+            IOBuf frame;
+            PackTpuStdFrame(&frame, meta_buf, IOBuf(), IOBuf());
+            SocketUniquePtr s;
+            if (Socket::AddressSocket(msg->socket_id, &s) == 0) {
+                s->Write(&frame);
+            }
+        } else {
+            verbs::WindowInfo info;
+            info.window_id = wg.window_id();
+            info.pool_id = wg.pool_id();
+            info.offset = wg.offset();
+            info.length = wg.length();
+            info.epoch = wg.pool_epoch();
+            info.mode = wg.mode();
+            info.lease_ms = wg.lease_ms();
+            verbs::HandleGrantResponse(meta.correlation_id(),
+                                       wg.status(), info);
+        }
+        return;
+    }
+    if (meta.has_verb_post()) {
+        // Emulated two-sided verb at the TARGET (ISSUE 18): validate
+        // against the granted window (epoch/lease/bounds/crc) and
+        // answer a completion frame — READ's bytes ride back as its
+        // body. A stale window answers TERR_STALE_EPOCH in the
+        // completion status; the connection never fails.
+        const auto& vp = meta.verb_post();
+        IOBuf back;
+        uint32_t crc = 0;
+        const int rc = verbs::HandleWireVerb(
+            (int)vp.op(), vp.wr_id(), vp.window_id(), vp.offset(),
+            vp.length(), vp.pool_epoch(), vp.crc32c(), msg->body, &back,
+            &crc);
+        rpc::RpcMeta rsp;
+        auto* vc = rsp.mutable_verb_completion();
+        vc->set_wr_id(vp.wr_id());
+        if (rc != 0) {
+            vc->set_status(rc);
+            back.clear();
+        } else {
+            vc->set_bytes(vp.length());
+            if (!back.empty()) vc->set_crc32c(crc);
+        }
+        IOBuf meta_buf;
+        SerializePbToIOBuf(rsp, &meta_buf);
+        IOBuf frame;
+        PackTpuStdFrame(&frame, meta_buf, back, IOBuf());
+        SocketUniquePtr s;
+        if (Socket::AddressSocket(msg->socket_id, &s) == 0) {
+            s->Write(&frame);
+        }
+        return;
+    }
+    if (meta.has_verb_completion()) {
+        const auto& vc = meta.verb_completion();
+        verbs::HandleWireCompletion(vc.wr_id(), (int)vc.status(),
+                                    msg->body, vc.crc32c());
+        return;
+    }
     if (meta.has_stream_frame() && !meta.has_request() &&
         !meta.has_response()) {
         // Push-stream tier frame (ISSUE 17): DATA/ACK/CLOSE keyed by
@@ -1159,6 +1368,48 @@ void ProcessTpuStdMessage(InputMessageBase* raw) {
         // frame body. Unknown kinds fail the STREAM inside OnFrame,
         // never this connection.
         const auto& sf = meta.stream_frame();
+        if (sf.has_pool_attachment() &&
+            (sf.kind() == 0 || sf.kind() == 1)) {
+            // Descriptor-carried DATA chunk (ISSUE 18 satellite):
+            // resolve the reference in place (scope -> registry ->
+            // epoch -> crc, same fences as request descriptors), copy
+            // into the frame body the stream layer expects, and ack so
+            // the sender's pin drops. Any failure drops the FRAME only
+            // — the stream's gap-NAK retransmit recovers the chunk
+            // inline, and the sender's reaper frees the orphan pin.
+            const auto& pd = sf.pool_attachment();
+            bool ok = false;
+            SocketUniquePtr s;
+            if (Socket::AddressSocket(msg->socket_id, &s) == 0 &&
+                TransportDescriptorScopeOk(s.get(), pd.pool_id())) {
+                const char* base = nullptr;
+                size_t size = 0;
+                uint64_t ep = 0;
+                if (pool_registry::Resolve(pd.pool_id(), &base, &size,
+                                           &ep) &&
+                    pd.offset() <= size &&
+                    pd.length() <= size - pd.offset() &&
+                    (!pd.has_pool_epoch() || pd.pool_epoch() == 0 ||
+                     pd.pool_epoch() == ep) &&
+                    (!pd.has_crc32c() ||
+                     crc32c_extend(0, base + pd.offset(),
+                                   pd.length()) == pd.crc32c())) {
+                    msg->body.clear();
+                    msg->body.append(base + pd.offset(),
+                                     (size_t)pd.length());
+                    *g_stream_desc_resolves << 1;
+                    transport_stats::AddDescIn(s->transport_tier(),
+                                               (int64_t)pd.length());
+                    SendTpuStdDescAck(msg->socket_id, sf.seq(),
+                                      pd.ack_token());
+                    ok = true;
+                }
+            }
+            if (!ok) {
+                *g_stream_desc_rejects << 1;
+                return;
+            }
+        }
         push_stream::OnFrame(msg->socket_id, sf.stream_id(),
                              sf.kind() == 0 ? 1 : sf.kind(), sf.seq(),
                              sf.flags(), sf.ack_seq(), sf.credits(),
@@ -1201,8 +1452,20 @@ void GlobalInitializeOrDie() {
         *g_rsp_desc_resolve_bytes << 0;
         *g_rsp_desc_rejects << 0;
         *g_rsp_desc_acks << 0;
+        *g_stream_desc_chunks << 0;
+        *g_stream_desc_fallbacks << 0;
+        *g_stream_desc_resolves << 0;
+        *g_stream_desc_rejects << 0;
         transport_stats::ExposeVars();
         push_stream::ExposeVars();
+        // One-sided verb plane (ISSUE 18): the pb-free tici layer moves
+        // data; the wire seams (grant exchange + emulated two-sided
+        // fallback) live here where the pb runtime is.
+        verbs::SetGrantRequestSender(&SendVerbGrantRequest);
+        verbs::SetVerbWireSender(&SendVerbWire);
+        verbs::SetOneSidedProbe(&VerbOneSidedProbe);
+        verbs::SetSglMaxProbe(&VerbSglMaxProbe);
+        verbs::ExposeVars();
         Protocol p;
         p.parse = ParseTpuStdMessage;
         p.process = ProcessTpuStdMessage;

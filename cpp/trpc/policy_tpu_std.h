@@ -52,9 +52,16 @@ void SendTpuStdDescAck(SocketId sid, uint64_t cid,
 // Push-stream frames (ISSUE 17, RpcMeta.stream_frame): DATA carries the
 // chunk as the frame payload; ACK/CLOSE are meta-only. Return 0 on
 // queued write, nonzero when the socket is dead/failed (the chunk stays
-// in the sender's replay ring — resume recovers it).
+// in the sender's replay ring — resume recovers it). `try_desc`
+// (ISSUE 18 satellite): on a descriptor-capable link, a first-send
+// chunk >= -stream_desc_min_bytes rides as a pool REFERENCE
+// (StreamFrame.pool_attachment, empty frame body) pinned through the
+// lease registry; the receiver resolves it in place and desc_acks with
+// correlation id = seq. Replay/retransmit sends stay inline (the pin
+// was already released by the first delivery's ack or the reaper).
 int SendTpuStdStreamData(SocketId sid, uint64_t stream_id, uint64_t seq,
-                         uint32_t flags, const std::string& chunk);
+                         uint32_t flags, const std::string& chunk,
+                         bool try_desc = false);
 int SendTpuStdStreamAck(SocketId sid, uint64_t stream_id, uint64_t ack_seq,
                         int64_t credits);
 int SendTpuStdStreamClose(SocketId sid, uint64_t stream_id, int error_code);

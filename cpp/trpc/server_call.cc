@@ -11,6 +11,7 @@
 #include "tfiber/fiber_key.h"
 #include "tfiber/timer_thread.h"
 #include "tici/block_lease.h"
+#include "tici/verbs.h"
 #include "tvar/reducer.h"
 
 namespace tpurpc {
@@ -115,6 +116,11 @@ void OnSocketFailed(SocketId sid) {
     // before the registered-call fast path below: CLIENT sockets carry
     // leases but never registered server calls.
     block_lease::ReleasePeer((uint64_t)sid);
+    // Verb-plane reclamation (ISSUE 18): windows granted to this link
+    // drop (their leases release exactly-once underneath) and pending
+    // posts / grant waits against it fail TERR_FAILED_SOCKET — a
+    // SIGKILLed peer mid-verb strands zero pins.
+    verbs::OnPeerDead((uint64_t)sid);
     {
         // Fast path: most failed sockets (client conns, idle server
         // conns) have nothing registered — don't pay a fiber for them.

@@ -832,7 +832,10 @@ int StreamWriter::Write(const std::string& chunk, bool eos) {
                     return 0;
                 }
             }
-            if (SendTpuStdStreamData(sid, st->id, seq, flags, chunk) != 0) {
+            // First sends may ride as pool descriptors on capable links
+            // (ISSUE 18 satellite); replay/retransmit paths stay inline.
+            if (SendTpuStdStreamData(sid, st->id, seq, flags, chunk,
+                                     /*try_desc=*/true) != 0) {
                 // Connection died under us; the chunk stays ringed for
                 // the resume. Start the registry TTL.
                 std::lock_guard<std::mutex> g(st->mu);

@@ -216,6 +216,16 @@ def test_metrics_exposition_lint(cpp_build, tmp_path):
             assert re.search(
                 r'^rpc_collective_busbw_mbps\{alg="%s"\} \d+$' % alg,
                 text, re.M), alg
+        # ISSUE 18 one-sided verb families: the verb plane's counters
+        # (posted/completed verbs, bytes moved, stale-epoch rejects, CQ
+        # parks) and the collective verbs-lane step/fallback counters —
+        # all present (0-valued, eagerly exposed) before the first post.
+        for fam in ("rpc_verbs_posted", "rpc_verbs_completed",
+                    "rpc_verbs_bytes", "rpc_verbs_stale_rejects",
+                    "rpc_verbs_cq_parks", "rpc_collective_verb_steps",
+                    "rpc_collective_verb_fallbacks"):
+            assert families.get(fam) == "gauge", (fam, sorted(families))
+            assert re.search(r"^%s \d+$" % fam, text, re.M), fam
         # ISSUE 12/14 transport-tier attribution: labelled families with
         # one series per registered endpoint type, now including the
         # cross-pod dcn tier.
@@ -239,6 +249,13 @@ def test_metrics_exposition_lint(cpp_build, tmp_path):
             assert re.search(r"^%s \d+$" % fam, text, re.M), fam
         assert families.get("rpc_stream_ttft_us") == "summary", \
             sorted(families)
+        # ISSUE 18 satellite: push-stream chunks are descriptor-eligible
+        # on capable links — sends/fallbacks/resolves/rejects counted,
+        # present 0-valued from the first scrape.
+        for fam in ("rpc_stream_desc_chunks", "rpc_stream_desc_fallbacks",
+                    "rpc_stream_desc_resolves", "rpc_stream_desc_rejects"):
+            assert families.get(fam) == "gauge", (fam, sorted(families))
+            assert re.search(r"^%s \d+$" % fam, text, re.M), fam
         streams = json.loads(_http_get(port, "/streams?format=json"))
         for key in ("open", "resumed", "replayed_chunks",
                     "credit_stalls", "aborts", "ring_highwater"):
@@ -263,6 +280,14 @@ def test_metrics_exposition_lint(cpp_build, tmp_path):
         assert tiers["shm_xproc"]["cross_process"] == 1
         assert tiers["dcn"]["descriptor_capable"] == 0
         assert tiers["dcn"]["cross_process"] == 1
+        # ISSUE 18 capability bits: shm-ICI tiers take one-sided verbs
+        # with a real SGL budget; byte-stream tiers do not (their posts
+        # run the emulated two-sided wire path).
+        assert tiers["ici"]["one_sided"] == 1, tiers
+        assert tiers["ici"]["sgl_max"] >= 4, tiers
+        assert tiers["shm_xproc"]["one_sided"] == 1, tiers
+        assert tiers["tcp"]["one_sided"] == 0, tiers
+        assert tiers["dcn"]["one_sided"] == 0, tiers
 
         # /vars?series= returns the fixed 60/60/24-point ring shape.
         # Poll: on a loaded host the 1Hz sampler may lag a little before

@@ -1,0 +1,249 @@
+"""One-sided verbs chaos soak (ISSUE 18): SIGKILL mid-verb, chaos at
+every verb seam, pins drain to zero.
+
+Two pods of two `mesh_node` processes run with --verbs_traffic: every
+node continuously leases REMOTE_READ/REMOTE_WRITE windows from each
+link peer and round-trips patterned scatter-gather verbs through its
+doorbell completion queue. Intra-pod links are shm-ICI (one-sided
+capable: posts move by direct memcpy); cross-pod links are dcn-tier
+channels (one-sided INCAPABLE: the same posts degrade to the emulated
+two-sided wire exchange through the ISSUE-12 seam) — so both data paths
+run continuously in one mesh. Mid-run the soak
+
+  * drops posted verbs at one node's post seam (chaos `verb_drop`):
+    the initiator's pending-wr reaper must retry/terminate every post,
+    never losing a completion,
+  * delays doorbell delivery at another node (chaos `doorbell_delay`):
+    pollers park and completions arrive late but exactly once,
+  * injects stale-epoch faults at a GRANTOR's wire-verb resolve seam
+    (chaos `pool_stale`): initiators see TERR_STALE_EPOCH completions,
+    re-grant fresh windows, and keep going while the fenced node keeps
+    serving,
+  * SIGKILLs a node while verbs are in flight against its windows in
+    both roles (grantor of survivors' windows + initiator holding
+    leases on theirs), then restarts it.
+
+Asserted invariants (the ISSUE-18 acceptance gate):
+  * zero lost verb completions: verbs_issued == verbs_ok + verbs_failed
+    and outstanding == 0 on every node, pending posts 0 after drain;
+  * stale injections surface as retriable completions (client
+    verbs_stale > 0, grantor rpc_verbs_stale_rejects > 0) and windows
+    re-grant (verbs_regrants > 0) — never a crash or a wedged CQ;
+  * SIGKILL-mid-verb strands ZERO pins: /pools pinned returns to 0 on
+    every survivor (windows reclaim via peer-death + lease expiry);
+  * clean exit 0 everywhere.
+"""
+import json
+import time
+
+from test_chaos_soak import Node, _chaos, _free_ports, _http_get, _var
+from test_pool_chaos_soak import POOL_FLAGS, _pools
+from test_pod_partition_soak import _report
+
+POD_SIZE = 2
+NUM_NODES = 2 * POD_SIZE
+
+# Short verb leases so final window reclamation (grantor-side pins of
+# windows whose initiators stopped without closing) fits the drain poll;
+# light dcn shaping so the emulated wire path is exercised, not slow.
+VERB_FLAGS = POOL_FLAGS + [
+    "verbs_lease_default_ms=2500",
+    "dcn_emu_latency_us=200",
+    "dcn_emu_mbps=400",
+]
+
+
+def _wait_verbs_ok(nodes, minimum, timeout=60.0, baseline=None):
+    """Wait until every node's REPORT verbs_ok grew past `minimum` over
+    `baseline`; returns the last reading keyed by node idx."""
+    baseline = baseline or {n.idx: 0 for n in nodes}
+    deadline = time.time() + timeout
+    ok = {}
+    while time.time() < deadline:
+        ok = {n.idx: _report(n)["verbs_ok"] for n in nodes}
+        if all(ok[n.idx] - baseline[n.idx] >= minimum for n in nodes):
+            return ok
+        time.sleep(0.5)
+    return ok
+
+
+def test_verbs_soak(cpp_build, tmp_path):
+    binary = cpp_build / "mesh_node"
+    assert binary.exists(), "mesh_node not built"
+    ports = _free_ports(NUM_NODES)
+    pod_a, pod_b = ports[:POD_SIZE], ports[POD_SIZE:]
+
+    naming = tmp_path / "naming"
+    naming.write_text(
+        "".join("127.0.0.1:%d zone=A\n" % p for p in pod_a)
+        + "".join("127.0.0.1:%d zone=B\n" % p for p in pod_b))
+    dcn_a = tmp_path / "dcn_a"  # what pod A reaches over dcn: pod B
+    dcn_a.write_text("".join("127.0.0.1:%d zone=B\n" % p for p in pod_b))
+    dcn_b = tmp_path / "dcn_b"
+    dcn_b.write_text("".join("127.0.0.1:%d zone=A\n" % p for p in pod_a))
+
+    def _node(i):
+        in_a = i < POD_SIZE
+        return Node(binary, ports[i], i, naming, flags=VERB_FLAGS,
+                    extra_args=("--zone", "A" if in_a else "B",
+                                "--dcn_peers",
+                                str(dcn_a if in_a else dcn_b),
+                                "--verbs_traffic"))
+
+    nodes = [_node(i) for i in range(NUM_NODES)]
+    try:
+        for n in nodes:
+            assert n.wait_ready(), "node %d never became ready" % n.idx
+
+        # --- warm-up: verbs flow on BOTH data paths -------------------
+        ok0 = _wait_verbs_ok(nodes, 10)
+        assert all(v >= 10 for v in ok0.values()), \
+            "verb traffic never started: %s" % ok0
+        assert sum(_var(p, "rpc_verbs_posted") for p in ports) > 0
+        assert sum(_var(p, "rpc_verbs_bytes") for p in ports) > 0
+        # The tier registry carries the new capability bits: shm-ICI is
+        # one-sided with a real SGL budget, dcn is not (its posts run
+        # the emulated two-sided wire path the soak also exercises).
+        tiers = {t["name"]: t
+                 for t in _pools(ports[0]).get("transports", [])}
+        assert tiers["ici"]["one_sided"] == 1, tiers
+        assert tiers["ici"]["sgl_max"] >= 4, tiers
+        assert tiers["shm_xproc"]["one_sided"] == 1, tiers
+        assert tiers["dcn"]["one_sided"] == 0, tiers
+        assert tiers["tcp"]["one_sided"] == 0, tiers
+        # Windows are live while traffic runs (leased, pinned).
+        assert any(_report(n)["verbs_windows"] > 0 for n in nodes)
+
+        # --- chaos 1: drop posted verbs at node 0's post seam ---------
+        # The pending-wr reaper must retry dropped posts (or terminate
+        # them retriable after the budget); progress never stops and no
+        # completion is lost (checked at drain).
+        _chaos(ports[0], enable=1, seed=991, plan="verb_drop=0.4")
+        base = {nodes[0].idx: _report(nodes[0])["verbs_ok"]}
+        ok1 = _wait_verbs_ok([nodes[0]], 5, timeout=40.0, baseline=base)
+        assert ok1[0] - base[0] >= 5, \
+            "no verb progress under verb_drop: %s" % ok1
+        _chaos(ports[0], enable=0)
+
+        # --- chaos 2: delay doorbells at node 1 -----------------------
+        # Completions are held back 30ms: pollers park (cq_parks grows)
+        # and every delayed completion still arrives exactly once.
+        parks0 = _var(ports[1], "rpc_verbs_cq_parks")
+        _chaos(ports[1], enable=1, seed=992,
+               plan="doorbell_delay=0.6:30000")
+        base = {nodes[1].idx: _report(nodes[1])["verbs_ok"]}
+        ok2 = _wait_verbs_ok([nodes[1]], 5, timeout=40.0, baseline=base)
+        assert ok2[1] - base[1] >= 5, \
+            "no verb progress under doorbell_delay: %s" % ok2
+        assert _var(ports[1], "rpc_verbs_cq_parks") > parks0, \
+            "delayed doorbells never parked a poller"
+        _chaos(ports[1], enable=0)
+
+        # --- chaos 3: stale-epoch fence at a grantor's resolve seam ---
+        # Node 2 (pod B) serves wire verbs for pod A's initiators over
+        # dcn; pool_stale fences its resolve seam, so those initiators
+        # get TERR_STALE_EPOCH completions and must re-grant.
+        _chaos(ports[2], enable=1, seed=993, plan="pool_stale=0.5")
+        deadline = time.time() + 30.0
+        rejects = 0
+        while time.time() < deadline:
+            rejects = _var(ports[2], "rpc_verbs_stale_rejects")
+            if rejects >= 3:
+                break
+            time.sleep(0.5)
+        assert rejects >= 3, "stale-epoch fence never fired on verbs"
+        # The fenced node is alive and still serving.
+        assert _http_get(ports[2], "/health").strip() == "OK"
+        # Initiators saw the stales and re-granted fresh windows.
+        deadline = time.time() + 20.0
+        stales = regrants = 0
+        while time.time() < deadline:
+            reps = [_report(nodes[i]) for i in (0, 1)]
+            stales = sum(r["verbs_stale"] for r in reps)
+            regrants = sum(r["verbs_regrants"] for r in reps)
+            if stales >= 1 and regrants >= 1:
+                break
+            time.sleep(0.5)
+        assert stales >= 1, "initiators never saw a stale completion"
+        assert regrants >= 1, "stale windows were never re-granted"
+        _chaos(ports[2], enable=0)
+
+        # --- SIGKILL a node mid-verb ----------------------------------
+        # Traffic is continuous, so the kill lands with verbs in flight
+        # against node 3's windows (it grants to node 2 over shm and to
+        # pod A over dcn) and with node 3 holding leases on everyone
+        # else's pools.
+        kill_idx = 3
+        nodes[kill_idx].kill9()
+        survivors = [n for n in nodes if n.idx != kill_idx]
+        surv_ports = [ports[n.idx] for n in survivors]
+
+        # Peer death must not strand pins: windows granted TO the dead
+        # node reclaim via the socket-failure ReleasePeer sweep and the
+        # lease reaper backstop.
+        deadline = time.time() + 25.0
+        ok = False
+        while time.time() < deadline:
+            pinned = [_pools(p)["pinned"] for p in surv_ports]
+            if all(v <= 4 for v in pinned):
+                ok = True
+                break
+            time.sleep(0.5)
+        assert ok, "pins stranded after peer kill: %s" % pinned
+        # Survivors keep completing verbs on their remaining links.
+        base = {n.idx: _report(n)["verbs_ok"] for n in survivors}
+        ok3 = _wait_verbs_ok(survivors, 5, timeout=40.0, baseline=base)
+        assert all(ok3[n.idx] - base[n.idx] >= 5 for n in survivors), \
+            "verb progress stopped after the kill: %s" % ok3
+
+        # --- restart the killed node ----------------------------------
+        nodes[kill_idx] = _node(kill_idx)
+        assert nodes[kill_idx].wait_ready()
+        ok4 = _wait_verbs_ok([nodes[kill_idx]], 5, timeout=60.0)
+        assert ok4[kill_idx] >= 5, \
+            "restarted node never resumed verb traffic: %s" % ok4
+
+        # --- drain + invariants ---------------------------------------
+        reports = []
+        for n in nodes:
+            rep = n.stop_and_report(timeout=60.0)
+            assert rep is not None, "node %d produced no report" % n.idx
+            reports.append(rep)
+
+        for rep in reports:
+            # Zero lost completions on the verb plane (and the
+            # background planes) — the headline crash-safety invariant.
+            assert rep["outstanding"] == 0, rep
+            assert rep["verbs_issued"] == (
+                rep["verbs_ok"] + rep["verbs_failed"]), rep
+            assert rep["verbs_ok"] > 0, rep
+            assert rep["verbs_pending"] == 0, rep
+            assert rep["lb_issued"] == rep["lb_ok"] + rep["lb_failed"], rep
+            assert rep["shm_issued"] == rep["shm_ok"] + rep["shm_failed"], \
+                rep
+        # The chaos phases left their evidence.
+        assert sum(rep["verbs_stale"] for rep in reports) >= 1, reports
+        assert sum(rep["verbs_regrants"] for rep in reports) >= 1, reports
+        assert reports[2]["verbs_stale_rejects"] >= 3, reports[2]
+
+        # Lease ledger EMPTY everywhere after quiesce: granted windows
+        # expire (2.5s lease) and the reaper returns every pinned block.
+        # THE acceptance gate: SIGKILL-mid-verb strands zero pins.
+        deadline = time.time() + 25.0
+        pinned = None
+        while time.time() < deadline:
+            pinned = [_pools(p)["pinned"] for p in ports]
+            if all(v == 0 for v in pinned):
+                break
+            time.sleep(0.5)
+        assert all(v == 0 for v in pinned), \
+            "pins stranded after quiesce: %s" % pinned
+
+        for n in nodes:
+            assert n.shutdown() == 0, "node %d unclean exit" % n.idx
+    finally:
+        for n in nodes:
+            try:
+                n.proc.kill()
+            except OSError:
+                pass

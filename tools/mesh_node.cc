@@ -56,6 +56,7 @@
 #include "tici/block_lease.h"
 #include "tici/block_pool.h"
 #include "tici/shm_link.h"
+#include "tici/verbs.h"
 #include "trpc/channel.h"
 #include "trpc/collective.h"
 #include "trpc/collective_benchpb.h"
@@ -229,6 +230,12 @@ struct Counters {
     // (EXPECTED retriable failures under chaos_pool stale injection).
     std::atomic<int64_t> desc_issued{0}, desc_ok{0}, desc_failed{0};
     std::atomic<int64_t> desc_stale{0};
+    // One-sided verb traffic (ISSUE 18): REMOTE_WRITE + REMOTE_READ
+    // round-trips against leased peer windows; verbs_stale counts
+    // TERR_STALE_EPOCH fences (expected retriable failures under
+    // pool_stale chaos), regrants counts window (re-)grants.
+    std::atomic<int64_t> verbs_issued{0}, verbs_ok{0}, verbs_failed{0};
+    std::atomic<int64_t> verbs_stale{0}, verbs_regrants{0};
     // Response-direction descriptors resolved by this node's CLIENT
     // side (ISSUE 12): desc_rsp_ok counts calls whose answer arrived as
     // a verified in-place view of the peer's pool.
@@ -370,8 +377,19 @@ bool RunCollectiveRound(const CollRunArgs& a) {
     uint64_t moved_total = 0;
     const uint64_t my_key = (uint64_t)g_my_port;
 
-    if (a.alg == "allreduce" || a.alg == "allreduce_serial" ||
-        a.alg == "hier_allreduce") {
+    // Lane-pinned stdin variants (ISSUE 18): "allreduce_verbs" /
+    // "allreduce_chunks" select the ring's transport for THIS round —
+    // bench verbs_scrape drives one of each and compares the
+    // allreduce_verbs vs allreduce busbw gauges. The driver serializes
+    // commanded rounds, so flipping the engine flag here is safe.
+    std::string alg = a.alg;
+    if (alg == "allreduce_verbs" || alg == "allreduce_chunks") {
+        eng->set_verbs_lane(alg == "allreduce_verbs");
+        alg = "allreduce";
+    }
+
+    if (alg == "allreduce" || alg == "allreduce_serial" ||
+        alg == "hier_allreduce") {
         const size_t nwords = (size_t)(a.bytes / 4 ? a.bytes / 4 : 1);
         std::vector<uint32_t> words(nwords);
         CollectiveEngine::FillDeterministic(a.seq, my_key, words.data(),
@@ -380,9 +398,9 @@ bool RunCollectiveRound(const CollRunArgs& a) {
         // broadcast ring — verified exactly like the flat all-reduce,
         // against the CONTRIBUTING key set the engine reports.
         const int err =
-            a.alg == "allreduce"
+            alg == "allreduce"
                 ? eng->AllReduce(a.seq, words.data(), nwords, &r)
-                : a.alg == "hier_allreduce"
+                : alg == "hier_allreduce"
                       ? eng->HierAllReduce(a.seq, words.data(), nwords, &r)
                       : eng->SerialAllReduce(a.seq, words.data(), nwords,
                                              &r);
@@ -403,7 +421,7 @@ bool RunCollectiveRound(const CollRunArgs& a) {
             }
             moved_total = nwords * 4;
         }
-    } else if (a.alg == "allgather") {
+    } else if (alg == "allgather") {
         const size_t block = (size_t)(a.bytes ? a.bytes & ~3ull : 4);
         std::vector<uint32_t> mine(block / 4);
         CollectiveEngine::FillDeterministic(a.seq, my_key, mine.data(),
@@ -423,7 +441,7 @@ bool RunCollectiveRound(const CollRunArgs& a) {
                 (const uint32_t*)out.data(), out.size() / 4);
             moved_total = out.size();
         }
-    } else if (a.alg == "alltoall") {
+    } else if (alg == "alltoall") {
         const size_t block = (size_t)(a.bytes ? a.bytes & ~3ull : 4);
         // Blocks for every POSSIBLE member (self + all configured
         // peers) so a re-formed round still finds its payloads.
@@ -476,12 +494,15 @@ bool RunCollectiveRound(const CollRunArgs& a) {
             "\"bytes\": %llu, \"elapsed_us\": %lld, "
             "\"busbw_mbps\": %.1f, \"checksum\": %u, \"head\": [%s], "
             "\"reforms\": %d, \"retries\": %d, "
-            "\"desc_fallback_chunks\": %llu}\n",
+            "\"desc_fallback_chunks\": %llu, "
+            "\"verb_steps\": %llu, \"verb_fallback_chunks\": %llu}\n",
             a.alg.c_str(), (unsigned long long)a.seq, ok ? 1 : 0,
             verified ? 1 : 0, r.error, r.nranks,
             (unsigned long long)moved_total, (long long)r.elapsed_us,
             busbw, checksum, head_s.c_str(), r.reforms, r.retries,
-            (unsigned long long)r.desc_fallback_chunks);
+            (unsigned long long)r.desc_fallback_chunks,
+            (unsigned long long)r.verb_steps,
+            (unsigned long long)r.verb_fallback_chunks);
         fflush(stdout);
     }
     return ok && verified;
@@ -682,6 +703,122 @@ void* DescTrafficFiber(void* arg) {
         }
         fiber_usleep(4000);
     }
+    return nullptr;
+}
+
+// One-sided verb traffic (--verbs_traffic, ISSUE 18): each round leases
+// a 64 KB window in a peer's pool, REMOTE_WRITEs a patterned payload
+// through a 4-entry scatter-gather list, then REMOTE_READs it back and
+// verifies byte-for-byte — the round-trip the verb chaos soak SIGKILLs
+// nodes under. Windows are cached per link and re-granted on failure,
+// near lease expiry, or after a reconnect rebinds the link's socket; a
+// window dropped on the floor is reclaimed by the grantor's lease
+// reaper (pinned must still drain to 0). dcn links ride the emulated
+// two-sided wire path — same verbs, degraded transport.
+constexpr uint64_t kMeshWrTag = 0x4D45ull << 48;  // 'ME'
+std::atomic<uint64_t> g_mesh_wr{1};
+
+// Parks until the CQ delivers wr_id (this fiber posts one verb at a
+// time, so no other completion can appear). The 8 s bound is far
+// beyond the verb plane's post-timeout terminal guarantee — a pending
+// post can never outlive the caller's stack CQ.
+bool ParkForWr(verbs::CompletionQueue* cq, uint64_t wr,
+               verbs::Completion* out) {
+    const int64_t give_up = monotonic_time_us() + 8 * 1000 * 1000;
+    while (monotonic_time_us() < give_up) {
+        if (!cq->Park(out, 500 * 1000)) continue;
+        if (out->wr_id == wr) return true;
+    }
+    return false;
+}
+
+void* VerbsTrafficFiber(void* arg) {
+    auto* st = (NodeState*)arg;
+    TrafficStartDelay(st);
+    constexpr size_t kVerbBytes = 64 * 1024;
+    constexpr uint32_t kNsge = 4;
+    verbs::CompletionQueue cq;
+    std::vector<verbs::RemoteWindow> wins(st->links.size());
+    std::vector<char> wr_buf(kVerbBytes), rd_buf(kVerbBytes);
+    size_t next = 0;
+    uint64_t round = 0;
+    while (!st->stop.load(std::memory_order_relaxed)) {
+        if (st->links.empty()) break;
+        const size_t li = next++ % st->links.size();
+        PeerLink& link = *st->links[li];
+        std::shared_ptr<Channel> ch;
+        {
+            std::lock_guard<std::mutex> g(link.mu);
+            ch = link.ch;
+        }
+        if (ch == nullptr) {
+            fiber_usleep(5000);
+            continue;
+        }
+        const uint64_t sid = (uint64_t)ch->pinned_socket();
+        st->counters.outstanding.fetch_add(1);
+        st->counters.verbs_issued.fetch_add(1);
+        verbs::RemoteWindow& w = wins[li];
+        bool ok = false;
+        bool stale = false;
+        if (w.window_id == 0 || w.peer != sid ||
+            (w.deadline_us != 0 &&
+             monotonic_time_us() > w.deadline_us - 500 * 1000)) {
+            w = verbs::RemoteWindow();
+            if (verbs::RequestWindow(sid, kVerbBytes,
+                                     verbs::kWinRead | verbs::kWinWrite,
+                                     800, &w) == 0) {
+                st->counters.verbs_regrants.fetch_add(1);
+            }
+        }
+        if (w.window_id != 0) {
+            ++round;
+            for (size_t i = 0; i < kVerbBytes; ++i) {
+                wr_buf[i] = (char)('a' + (round + i) % 26);
+            }
+            // 4-entry SGL: the write gathers local pieces, the
+            // read-back scatters into a second buffer.
+            const size_t piece = kVerbBytes / kNsge;
+            verbs::Sge sgl[kNsge];
+            for (uint32_t i = 0; i < kNsge; ++i) {
+                sgl[i].addr = wr_buf.data() + i * piece;
+                sgl[i].len = piece;
+            }
+            const uint64_t wid = kMeshWrTag | g_mesh_wr.fetch_add(1);
+            verbs::Completion comp;
+            if (verbs::PostWrite(&cq, wid, w, 0, sgl, kNsge) == 0 &&
+                ParkForWr(&cq, wid, &comp)) {
+                stale = comp.status == TERR_STALE_EPOCH;
+                if (comp.status == 0) {
+                    memset(rd_buf.data(), 0, kVerbBytes);
+                    for (uint32_t i = 0; i < kNsge; ++i) {
+                        sgl[i].addr = rd_buf.data() + i * piece;
+                    }
+                    const uint64_t rid =
+                        kMeshWrTag | g_mesh_wr.fetch_add(1);
+                    if (verbs::PostRead(&cq, rid, w, 0, sgl, kNsge) ==
+                            0 &&
+                        ParkForWr(&cq, rid, &comp)) {
+                        stale = comp.status == TERR_STALE_EPOCH;
+                        ok = comp.status == 0 &&
+                             comp.bytes == kVerbBytes &&
+                             memcmp(wr_buf.data(), rd_buf.data(),
+                                    kVerbBytes) == 0;
+                    }
+                }
+            }
+            if (!ok) w = verbs::RemoteWindow();  // re-grant next visit
+        }
+        if (ok) {
+            st->counters.verbs_ok.fetch_add(1);
+        } else {
+            st->counters.verbs_failed.fetch_add(1);
+            if (stale) st->counters.verbs_stale.fetch_add(1);
+        }
+        st->counters.outstanding.fetch_sub(1);
+        fiber_usleep(4000);
+    }
+    cq.Shutdown();
     return nullptr;
 }
 
@@ -921,6 +1058,13 @@ void PrintReport(int id, int port, const Counters& c) {
         "\"desc_failed\": %lld, \"desc_stale\": %lld, "
         "\"desc_rsp_issued\": %lld, \"desc_rsp_ok\": %lld, "
         "\"desc_rsp_resolves\": %lld, \"desc_rsp_sends\": %lld, "
+        "\"verbs_issued\": %lld, \"verbs_ok\": %lld, "
+        "\"verbs_failed\": %lld, \"verbs_stale\": %lld, "
+        "\"verbs_regrants\": %lld, \"verbs_posted\": %lld, "
+        "\"verbs_completed\": %lld, \"verbs_bytes\": %lld, "
+        "\"verbs_stale_rejects\": %lld, \"verbs_windows\": %lld, "
+        "\"verbs_pending\": %lld, \"coll_verb_steps\": %lld, "
+        "\"coll_verb_fallbacks\": %lld, "
         "\"pool_pinned\": %lld, \"pool_reaped\": %lld, "
         "\"pool_peer_released\": %lld, \"epoch_rejects\": %lld, "
         "\"cost_admitted_milli\": %lld, \"cost_shed_milli\": %lld, "
@@ -957,6 +1101,17 @@ void PrintReport(int id, int port, const Counters& c) {
         (long long)c.desc_rsp_ok.load(),
         (long long)VarInt("rpc_pool_desc_rsp_resolves"),
         (long long)VarInt("rpc_pool_desc_rsp_sends"),
+        (long long)c.verbs_issued.load(), (long long)c.verbs_ok.load(),
+        (long long)c.verbs_failed.load(),
+        (long long)c.verbs_stale.load(),
+        (long long)c.verbs_regrants.load(),
+        (long long)verbs::posted(), (long long)verbs::completed(),
+        (long long)verbs::bytes_moved(),
+        (long long)verbs::stale_rejects(),
+        (long long)verbs::window_count(),
+        (long long)verbs::pending_posts(),
+        (long long)VarInt("rpc_collective_verb_steps"),
+        (long long)VarInt("rpc_collective_verb_fallbacks"),
         (long long)block_lease::pinned(),
         (long long)block_lease::expired_reaped(),
         (long long)block_lease::peer_released(),
@@ -1037,8 +1192,10 @@ int main(int argc, char** argv) {
     bool lb_only = false;
     bool inline_echo = false;
     bool desc_traffic = false;
+    bool verbs_traffic = false;
     bool collective = false;
     bool coll_traffic = false;
+    bool coll_verbs = false;
     const char* peers_file = nullptr;
     const char* dcn_peers_file = nullptr;
     for (int i = 1; i < argc; ++i) {
@@ -1089,6 +1246,15 @@ int main(int argc, char** argv) {
             // descriptor traffic (pinned pool blocks) over the shm
             // links so kills/chaos hit the zero-copy data path.
             desc_traffic = true;
+        } else if (strcmp(argv[i], "--verbs_traffic") == 0) {
+            // Verb chaos soak mode (ISSUE 18): drive one-sided
+            // REMOTE_WRITE/REMOTE_READ round-trips against leased peer
+            // windows so kills/chaos hit the verb plane.
+            verbs_traffic = true;
+        } else if (strcmp(argv[i], "--coll_verbs") == 0) {
+            // Collective rounds default to the verbs-backed step
+            // exchange (one SGL verb + doorbell per ring step).
+            coll_verbs = true;
         } else if (strcmp(argv[i], "--collective") == 0) {
             // Mesh collectives (ISSUE 13): serve the CollectiveService
             // + engine; rounds are driven by stdin "coll ..." commands
@@ -1120,7 +1286,8 @@ int main(int argc, char** argv) {
                 "usage: mesh_node --port N --peers FILE [--id K] "
                 "[--zone NAME] [--dcn_peers FILE] "
                 "[--lb_only] [--inline_echo] [--desc_traffic] "
-                "[--collective] [--coll_traffic] "
+                "[--verbs_traffic] "
+                "[--collective] [--coll_traffic] [--coll_verbs] "
                 "[--drain_ms N] "
                 "[--timeout_cl_ms N] [--tenant NAME] [--priority 0..7] "
                 "[--flag name=value]...\n"
@@ -1221,6 +1388,7 @@ int main(int argc, char** argv) {
         CollectiveOptions copts;
         copts.step_timeout_ms = 1500;
         copts.attempt_timeout_ms = 4000;
+        copts.verbs_lane = coll_verbs;
         // Also bounds how long a rejoin-misaligned round can stall the
         // mesh before the straggler adopts the observed seq.
         copts.op_timeout_ms = 15000;
@@ -1251,6 +1419,11 @@ int main(int argc, char** argv) {
         if (desc_traffic &&
             fiber_start_background(&tid, nullptr, DescTrafficFiber, &st) ==
                 0) {
+            fibers.push_back(tid);
+        }
+        if (verbs_traffic &&
+            fiber_start_background(&tid, nullptr, VerbsTrafficFiber,
+                                   &st) == 0) {
             fibers.push_back(tid);
         }
         if (fiber_start_background(&tid, nullptr, StaleTrafficFiber, &st) ==
@@ -1293,7 +1466,8 @@ int main(int argc, char** argv) {
             // "coll <alg> <bytes> <seq>": run ONE collective round on a
             // fiber (the driver sends the same command to every node)
             // and print a COLL result line. alg: allreduce |
-            // allreduce_serial | allgather | alltoall.
+            // allreduce_serial | allgather | alltoall |
+            // allreduce_verbs | allreduce_chunks (lane-pinned, ISSUE 18).
             char alg[32];
             unsigned long long cbytes = 0, cseq = 0;
             if (sscanf(cmd + 5, "%31s %llu %llu", alg, &cbytes, &cseq) ==

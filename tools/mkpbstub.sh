@@ -721,6 +721,14 @@ public:
     void set_credits(int64_t v) { credits_ = v; }
     int error_code() const { return error_code_; }
     void set_error_code(int v) { error_code_ = v; }
+    bool has_pool_attachment() const { return has_pool_attachment_; }
+    const PoolDescriptor& pool_attachment() const {
+        return pool_attachment_;
+    }
+    PoolDescriptor* mutable_pool_attachment() {
+        has_pool_attachment_ = true;
+        return &pool_attachment_;
+    }
     void Clear() override { *this = StreamFrame(); }
     bool SerializeToString(std::string* out) const override {
         out->clear();
@@ -732,6 +740,9 @@ public:
         if (credits_ != 0) pbstub::wire::put_u(out, 6, (uint64_t)credits_);
         if (error_code_ != 0) {
             pbstub::wire::put_u(out, 7, (uint64_t)error_code_);
+        }
+        if (has_pool_attachment_) {
+            pbstub::wire::put_msg(out, 8, pool_attachment_);
         }
         return true;
     }
@@ -749,6 +760,10 @@ public:
             if (f == 5) ack_seq_ = v;
             if (f == 6) credits_ = (int64_t)v;
             if (f == 7) error_code_ = (int)v;
+            if (f == 8 &&
+                !mutable_pool_attachment()->ParseFromString(sub)) {
+                return false;
+            }
         }
         return ok;
     }
@@ -757,6 +772,191 @@ private:
     int64_t credits_ = 0;
     uint32_t flags_ = 0;
     int kind_ = 0, error_code_ = 0;
+    PoolDescriptor pool_attachment_;
+    bool has_pool_attachment_ = false;
+};
+
+// Verb-plane wire messages (ISSUE 18): the window grant exchange and
+// the emulated two-sided verb/completion frames. All-varint fields.
+class WindowGrant : public google::protobuf::Message {
+public:
+    uint32_t kind() const { return kind_; }
+    void set_kind(uint32_t v) { kind_ = v; }
+    int status() const { return status_; }
+    void set_status(int v) { status_ = v; }
+    uint64_t window_id() const { return window_id_; }
+    void set_window_id(uint64_t v) { window_id_ = v; }
+    uint64_t length() const { return length_; }
+    void set_length(uint64_t v) { length_ = v; }
+    uint32_t mode() const { return mode_; }
+    void set_mode(uint32_t v) { mode_ = v; }
+    uint64_t pool_id() const { return pool_id_; }
+    void set_pool_id(uint64_t v) { pool_id_ = v; }
+    uint64_t offset() const { return offset_; }
+    void set_offset(uint64_t v) { offset_ = v; }
+    uint64_t pool_epoch() const { return pool_epoch_; }
+    void set_pool_epoch(uint64_t v) { pool_epoch_ = v; }
+    bool has_lease_ms() const { return has_lease_ms_; }
+    int64_t lease_ms() const { return lease_ms_; }
+    void set_lease_ms(int64_t v) {
+        lease_ms_ = v;
+        has_lease_ms_ = true;
+    }
+    google::protobuf::Message* New() const override {
+        return new WindowGrant;
+    }
+    void Clear() override { *this = WindowGrant(); }
+    bool SerializeToString(std::string* out) const override {
+        out->clear();
+        auto field = [&](uint32_t num, uint64_t v) {
+            if (v != 0) pbstub::wire::put_u(out, num, v);
+        };
+        field(1, kind_);
+        field(2, (uint64_t)(int64_t)status_);
+        field(3, window_id_);
+        field(4, length_);
+        field(5, mode_);
+        field(6, pool_id_);
+        field(7, offset_);
+        field(8, pool_epoch_);
+        if (has_lease_ms_) {
+            pbstub::wire::put_u(out, 9, (uint64_t)lease_ms_);
+        }
+        return true;
+    }
+    bool ParseFromString(const std::string& s) override {
+        pbstub::wire::Reader r(s);
+        uint32_t f = 0, wt = 0;
+        uint64_t v = 0;
+        std::string sub;
+        bool ok = true;
+        while (r.next(&f, &wt, &v, &sub, &ok)) {
+            switch (f) {
+                case 1: kind_ = (uint32_t)v; break;
+                case 2: status_ = (int)(int64_t)v; break;
+                case 3: window_id_ = v; break;
+                case 4: length_ = v; break;
+                case 5: mode_ = (uint32_t)v; break;
+                case 6: pool_id_ = v; break;
+                case 7: offset_ = v; break;
+                case 8: pool_epoch_ = v; break;
+                case 9: set_lease_ms((int64_t)v); break;
+                default: break;
+            }
+        }
+        return ok;
+    }
+private:
+    uint64_t window_id_ = 0, length_ = 0, pool_id_ = 0, offset_ = 0;
+    uint64_t pool_epoch_ = 0;
+    int64_t lease_ms_ = 0;
+    uint32_t kind_ = 0, mode_ = 0;
+    int status_ = 0;
+    bool has_lease_ms_ = false;
+};
+
+class VerbPost : public google::protobuf::Message {
+public:
+    uint32_t op() const { return op_; }
+    void set_op(uint32_t v) { op_ = v; }
+    uint64_t wr_id() const { return wr_id_; }
+    void set_wr_id(uint64_t v) { wr_id_ = v; }
+    uint64_t window_id() const { return window_id_; }
+    void set_window_id(uint64_t v) { window_id_ = v; }
+    uint64_t offset() const { return offset_; }
+    void set_offset(uint64_t v) { offset_ = v; }
+    uint64_t length() const { return length_; }
+    void set_length(uint64_t v) { length_ = v; }
+    uint64_t pool_epoch() const { return pool_epoch_; }
+    void set_pool_epoch(uint64_t v) { pool_epoch_ = v; }
+    uint32_t crc32c() const { return crc32c_; }
+    void set_crc32c(uint32_t v) { crc32c_ = v; }
+    google::protobuf::Message* New() const override {
+        return new VerbPost;
+    }
+    void Clear() override { *this = VerbPost(); }
+    bool SerializeToString(std::string* out) const override {
+        out->clear();
+        auto field = [&](uint32_t num, uint64_t v) {
+            if (v != 0) pbstub::wire::put_u(out, num, v);
+        };
+        field(1, op_);
+        field(2, wr_id_);
+        field(3, window_id_);
+        field(4, offset_);
+        field(5, length_);
+        field(6, pool_epoch_);
+        field(7, crc32c_);
+        return true;
+    }
+    bool ParseFromString(const std::string& s) override {
+        pbstub::wire::Reader r(s);
+        uint32_t f = 0, wt = 0;
+        uint64_t v = 0;
+        std::string sub;
+        bool ok = true;
+        while (r.next(&f, &wt, &v, &sub, &ok)) {
+            switch (f) {
+                case 1: op_ = (uint32_t)v; break;
+                case 2: wr_id_ = v; break;
+                case 3: window_id_ = v; break;
+                case 4: offset_ = v; break;
+                case 5: length_ = v; break;
+                case 6: pool_epoch_ = v; break;
+                case 7: crc32c_ = (uint32_t)v; break;
+                default: break;
+            }
+        }
+        return ok;
+    }
+private:
+    uint64_t wr_id_ = 0, window_id_ = 0, offset_ = 0, length_ = 0;
+    uint64_t pool_epoch_ = 0;
+    uint32_t op_ = 0, crc32c_ = 0;
+};
+
+class VerbCompletion : public google::protobuf::Message {
+public:
+    uint64_t wr_id() const { return wr_id_; }
+    void set_wr_id(uint64_t v) { wr_id_ = v; }
+    int status() const { return status_; }
+    void set_status(int v) { status_ = v; }
+    uint64_t bytes() const { return bytes_; }
+    void set_bytes(uint64_t v) { bytes_ = v; }
+    uint32_t crc32c() const { return crc32c_; }
+    void set_crc32c(uint32_t v) { crc32c_ = v; }
+    google::protobuf::Message* New() const override {
+        return new VerbCompletion;
+    }
+    void Clear() override { *this = VerbCompletion(); }
+    bool SerializeToString(std::string* out) const override {
+        out->clear();
+        if (wr_id_ != 0) pbstub::wire::put_u(out, 1, wr_id_);
+        if (status_ != 0) {
+            pbstub::wire::put_u(out, 2, (uint64_t)(int64_t)status_);
+        }
+        if (bytes_ != 0) pbstub::wire::put_u(out, 3, bytes_);
+        if (crc32c_ != 0) pbstub::wire::put_u(out, 4, crc32c_);
+        return true;
+    }
+    bool ParseFromString(const std::string& s) override {
+        pbstub::wire::Reader r(s);
+        uint32_t f = 0, wt = 0;
+        uint64_t v = 0;
+        std::string sub;
+        bool ok = true;
+        while (r.next(&f, &wt, &v, &sub, &ok)) {
+            if (f == 1) wr_id_ = v;
+            if (f == 2) status_ = (int)(int64_t)v;
+            if (f == 3) bytes_ = v;
+            if (f == 4) crc32c_ = (uint32_t)v;
+        }
+        return ok;
+    }
+private:
+    uint64_t wr_id_ = 0, bytes_ = 0;
+    uint32_t crc32c_ = 0;
+    int status_ = 0;
 };
 
 class RpcMeta : public google::protobuf::Message {
@@ -819,6 +1019,26 @@ public:
         has_stream_frame_ = true;
         return &stream_frame_;
     }
+    bool has_window_grant() const { return has_window_grant_; }
+    const WindowGrant& window_grant() const { return window_grant_; }
+    WindowGrant* mutable_window_grant() {
+        has_window_grant_ = true;
+        return &window_grant_;
+    }
+    bool has_verb_post() const { return has_verb_post_; }
+    const VerbPost& verb_post() const { return verb_post_; }
+    VerbPost* mutable_verb_post() {
+        has_verb_post_ = true;
+        return &verb_post_;
+    }
+    bool has_verb_completion() const { return has_verb_completion_; }
+    const VerbCompletion& verb_completion() const {
+        return verb_completion_;
+    }
+    VerbCompletion* mutable_verb_completion() {
+        has_verb_completion_ = true;
+        return &verb_completion_;
+    }
 
     // Full real proto2 wire format (pbstub_wire.h helpers).
     void Clear() override { *this = RpcMeta(); }
@@ -853,6 +1073,13 @@ public:
         }
         if (has_stream_frame_) {
             pbstub::wire::put_msg(out, 14, stream_frame_);
+        }
+        if (has_window_grant_) {
+            pbstub::wire::put_msg(out, 15, window_grant_);
+        }
+        if (has_verb_post_) pbstub::wire::put_msg(out, 16, verb_post_);
+        if (has_verb_completion_) {
+            pbstub::wire::put_msg(out, 17, verb_completion_);
         }
         return true;
     }
@@ -901,6 +1128,21 @@ public:
                         return false;
                     }
                     break;
+                case 15:
+                    if (!mutable_window_grant()->ParseFromString(sub)) {
+                        return false;
+                    }
+                    break;
+                case 16:
+                    if (!mutable_verb_post()->ParseFromString(sub)) {
+                        return false;
+                    }
+                    break;
+                case 17:
+                    if (!mutable_verb_completion()->ParseFromString(sub)) {
+                        return false;
+                    }
+                    break;
                 default: break;
             }
         }
@@ -912,6 +1154,9 @@ private:
     StreamSettings stream_settings_;
     PoolDescriptor pool_attachment_;
     StreamFrame stream_frame_;
+    WindowGrant window_grant_;
+    VerbPost verb_post_;
+    VerbCompletion verb_completion_;
     std::string auth_data_;
     uint64_t correlation_id_ = 0, desc_ack_token_ = 0;
     uint32_t attachment_size_ = 0, body_checksum_ = 0;
@@ -920,6 +1165,8 @@ private:
     bool has_stream_settings_ = false, has_body_checksum_ = false;
     bool cancel_ = false, goaway_ = false, desc_ack_ = false;
     bool has_pool_attachment_ = false, has_stream_frame_ = false;
+    bool has_window_grant_ = false, has_verb_post_ = false;
+    bool has_verb_completion_ = false;
 };
 
 }  // namespace rpc
@@ -1226,6 +1473,14 @@ public:
     void set_len(uint64_t v) { len_ = v; }
     uint32_t scope() const { return scope_; }
     void set_scope(uint32_t v) { scope_ = v; }
+    uint64_t verb_window() const { return verb_window_; }
+    void set_verb_window(uint64_t v) { verb_window_ = v; }
+    uint32_t verb_nchunks() const { return verb_nchunks_; }
+    void set_verb_nchunks(uint32_t v) { verb_nchunks_ = v; }
+    uint32_t verb_crc() const { return verb_crc_; }
+    void set_verb_crc(uint32_t v) { verb_crc_ = v; }
+    uint64_t verb_epoch() const { return verb_epoch_; }
+    void set_verb_epoch(uint64_t v) { verb_epoch_ = v; }
     google::protobuf::Message* New() const override {
         return new CollChunk;
     }
@@ -1246,6 +1501,10 @@ public:
         field(9, offset_);
         field(10, len_);
         field(11, scope_);
+        field(12, verb_window_);
+        field(13, verb_nchunks_);
+        field(14, verb_crc_);
+        field(15, verb_epoch_);
         return true;
     }
     bool ParseFromString(const std::string& s) override {
@@ -1267,6 +1526,10 @@ public:
                 case 9: offset_ = v; break;
                 case 10: len_ = v; break;
                 case 11: scope_ = (uint32_t)v; break;
+                case 12: verb_window_ = v; break;
+                case 13: verb_nchunks_ = (uint32_t)v; break;
+                case 14: verb_crc_ = (uint32_t)v; break;
+                case 15: verb_epoch_ = v; break;
                 default: break;
             }
         }
@@ -1274,9 +1537,9 @@ public:
     }
 private:
     uint64_t coll_seq_ = 0, member_hash_ = 0, total_bytes_ = 0;
-    uint64_t offset_ = 0, len_ = 0;
+    uint64_t offset_ = 0, len_ = 0, verb_window_ = 0, verb_epoch_ = 0;
     uint32_t kind_ = 0, step_ = 0, chunk_ = 0, src_rank_ = 0, nranks_ = 0;
-    uint32_t scope_ = 0;
+    uint32_t scope_ = 0, verb_nchunks_ = 0, verb_crc_ = 0;
 };
 class CollAck : public google::protobuf::Message {
 public:

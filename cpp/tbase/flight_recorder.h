@@ -1,0 +1,138 @@
+// Always-on flight recorder: per-thread lock-free rings of compact binary
+// events, dumped as a "black box" on fatal signals or on demand.
+//
+// The reference debugs live nodes with rpcz/vars; this is the post-mortem
+// twin (T3-style step event tracking, arXiv:2401.16677): every load-bearing
+// seam records a 32-byte event into a thread-local ring at ~single-digit-ns
+// cost, and a crash (SIGSEGV/SIGABRT/LOG(FATAL)) snapshots all rings to a
+// file that tools/blackbox_merge.py can correlate across nodes.
+//
+// Hot-path contract: Record() is one relaxed atomic load when disabled, and
+// one rdtsc + four plain stores when enabled. No locks, no allocation after
+// the ring is registered (first event on a thread), single writer per ring.
+#pragma once
+
+#include <atomic>
+#include <cstddef>
+#include <cstdint>
+#include <string>
+
+namespace tpurpc {
+namespace flight {
+
+// Event kinds. Numeric values are a wire format shared with
+// tools/blackbox_merge.py — append only, never renumber.
+enum EventKind : uint32_t {
+    kNone = 0,
+    // RPC lifecycle. a=correlation id unless noted.
+    kRpcIssue = 1,       // client issues a call        b=trace id
+    kRpcDispatch = 2,    // server parsed the request   b=request bytes
+    kRpcHandlerIn = 3,   // user handler entered        b=trace id
+    kRpcHandlerOut = 4,  // user handler returned       b=error code
+    kRpcWrite = 5,       // response queued to socket   b=response bytes
+    kRpcRespRecv = 6,    // client received response    b=error code
+    // One-sided verbs. a=wr_id.
+    kVerbPost = 7,      // verb posted locally          b=verb<<32|bytes
+    kVerbWire = 8,      // grantor saw the wire verb    b=verb<<32|bytes
+    kVerbComplete = 9,  // completion delivered         b=status
+    kVerbReap = 10,     // pending post reaped          b=error code
+    // Block leases. a=lease id.
+    kLeasePin = 11,       // b=bytes
+    kLeaseArm = 12,       // b=call id
+    kLeaseRelease = 13,   // b=bytes
+    kLeaseExpire = 14,    // b=age_ms
+    kLeasePeerDeath = 15, // a=peer key hash  b=leases reclaimed
+    // Streams. a=stream id.
+    kStreamChunk = 16,        // b=chunk seq
+    kStreamCreditStall = 17,  // b=chunk seq at stall
+    kStreamResume = 18,       // b=resume-from seq
+    // Collectives. a=step/epoch.
+    kCollStep = 19,    // b=op<<32|chunk
+    kCollReform = 20,  // a=new epoch  b=world size
+    // Scheduler.
+    kSchedInline = 21,  // inline dispatch on IO thread  a=bytes
+    kSchedPark = 22,    // worker parked                 a=signal count
+    // Chaos. a=decision index; b packs seed_low32<<32|op<<8|action kind so a
+    // seed replay aligns decision-for-decision with the timeline.
+    kChaosInject = 23,
+
+    kKindCount = 24,
+};
+
+// Stable names for dumps (indexed by EventKind, length kKindCount).
+extern const char* const kKindNames[];
+
+namespace internal {
+
+// One fixed-size ring owned by exactly one writer thread. Kept trivially
+// copyable so a signal handler can dump raw memory.
+struct Event {
+    uint64_t tsc;   // cpuwide_ticks() at record time
+    uint32_t kind;  // EventKind
+    uint32_t seq;   // low 32 bits of this ring's event counter
+    uint64_t a;
+    uint64_t b;
+};
+static_assert(sizeof(Event) == 32, "event must stay compact");
+
+struct ThreadRing {
+    Event* slots;
+    uint32_t cap;       // power of two
+    uint32_t tid;       // kernel tid of the owner
+    char name[16];      // thread name at registration
+    // Total events ever recorded; slot = next & (cap-1). Only the owner
+    // writes it; dumpers read it racily (torn tails are dropped by seq).
+    std::atomic<uint64_t> next;
+};
+
+constexpr int kMaxRings = 256;
+
+extern std::atomic<bool> g_on;
+extern std::atomic<int> g_nrings;
+extern ThreadRing* g_rings[kMaxRings];
+
+void RecordSlow(EventKind kind, uint64_t a, uint64_t b);
+
+}  // namespace internal
+
+// Record one event. Safe from any thread at any time (including before
+// and after Init); compiles to a relaxed load + branch when disabled.
+inline void Record(EventKind kind, uint64_t a, uint64_t b) {
+    if (!internal::g_on.load(std::memory_order_relaxed)) return;
+    internal::RecordSlow(kind, a, b);
+}
+
+// Identity stamped into dumps so the merge tool can label lanes. Safe to
+// call once at process start (copies into a static buffer).
+void SetNodeName(const std::string& name);
+
+// Dump every registered ring.
+//  - DumpToFd: async-signal-safe (write(2) only, preformatted header); this
+//    is what the crash handler uses. Returns bytes written or -1.
+//  - DumpToFile: open+DumpToFd, bumps rpc_flight_dump_count on success.
+//  - DumpJson/DumpText: for the /blackbox portal on a live node.
+int64_t DumpToFd(int fd);
+bool DumpToFile(const std::string& path);
+void DumpJson(std::string* out);
+void DumpText(std::string* out);
+
+// Install SIGSEGV/SIGABRT/SIGBUS/SIGFPE/SIGILL handlers that dump all rings
+// to `path` and re-raise. LOG(FATAL) aborts, so it is covered via SIGABRT.
+// Also mirrors into -flight_blackbox_path for live retargeting.
+void InstallCrashHandler(const std::string& path);
+
+// Dump to the crash-handler path if one was installed (unclean-exit paths
+// in mesh_node/tpu_router). No-op without a configured path.
+bool DumpToConfiguredPath();
+
+// Expose rpc_blackbox_{events,dropped,ring_highwater} + rpc_flight_dump_count.
+void ExposeVars();
+
+// Introspection for tests/counters.
+uint64_t TotalEvents();     // sum of ring next counters
+uint64_t TotalDropped();    // overwritten events + lost-ring events
+uint64_t RingHighwater();   // max valid events in any one ring
+uint64_t DumpCount();       // successful file dumps
+
+}  // namespace flight
+}  // namespace tpurpc

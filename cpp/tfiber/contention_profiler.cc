@@ -98,6 +98,59 @@ std::string ContentionProfileText(size_t topn) {
     return out;
 }
 
+std::string ContentionProfileJson(size_t topn) {
+    struct Row {
+        uintptr_t pc;
+        int64_t count;
+        int64_t wait_us;
+    };
+    std::vector<Row> rows;
+    int64_t total_count = 0, total_wait = 0;
+    for (Slot& s : g_slots) {
+        const uintptr_t pc = s.pc.load(std::memory_order_acquire);
+        if (pc == 0) continue;
+        const int64_t c = s.count.load(std::memory_order_relaxed);
+        const int64_t w = s.wait_us.load(std::memory_order_relaxed);
+        if (c == 0) continue;
+        rows.push_back({pc, c, w});
+        total_count += c;
+        total_wait += w;
+    }
+    const int64_t oc = g_overflow.count.load(std::memory_order_relaxed);
+    total_count += oc;
+    total_wait += g_overflow.wait_us.load(std::memory_order_relaxed);
+    std::sort(rows.begin(), rows.end(), [](const Row& a, const Row& b) {
+        return a.wait_us > b.wait_us;
+    });
+    if (rows.size() > topn) rows.resize(topn);
+    std::string out;
+    char line[512];
+    snprintf(line, sizeof(line),
+             "{\"total_count\": %lld, \"total_wait_us\": %lld, "
+             "\"other_count\": %lld, \"sites\": [",
+             (long long)total_count, (long long)total_wait, (long long)oc);
+    out += line;
+    bool first = true;
+    for (const Row& r : rows) {
+        std::string sym = SymbolizePc(r.pc);
+        // Symbol names may carry quotes/backslashes in pathological
+        // cases; escape minimally so the document stays valid JSON.
+        std::string esc;
+        for (char c : sym) {
+            if (c == '"' || c == '\\') esc.push_back('\\');
+            if ((unsigned char)c >= 0x20) esc.push_back(c);
+        }
+        snprintf(line, sizeof(line),
+                 "%s{\"site\": \"%s\", \"count\": %lld, \"wait_us\": %lld}",
+                 first ? "" : ", ", esc.c_str(), (long long)r.count,
+                 (long long)r.wait_us);
+        out += line;
+        first = false;
+    }
+    out += "]}";
+    return out;
+}
+
 void ResetContentionProfile() {
     // Counters only — the pc claims stay. Zeroing pc would let a racing
     // recorder (which already matched this slot) add its wait to a slot

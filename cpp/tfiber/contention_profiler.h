@@ -21,6 +21,11 @@ void RecordContention(uintptr_t site_pc, int64_t wait_us);
 // Symbolized text report of the top-N wait sites (plus totals).
 std::string ContentionProfileText(size_t topn = 30);
 
+// Same data as JSON (the /hotspots/contention?format=json view):
+// {"total_count":N,"total_wait_us":N,"other_count":N,
+//  "sites":[{"site":"sym","count":N,"wait_us":N},...]}.
+std::string ContentionProfileJson(size_t topn = 30);
+
 // Zero all counters (each /hotspots/contention view starts a fresh
 // observation window).
 void ResetContentionProfile();

@@ -12,6 +12,7 @@
 #include "tbase/fast_rand.h"
 #include "tbase/time.h"
 #include "tbase/flags.h"
+#include "tbase/flight_recorder.h"
 #include "tbase/logging.h"
 #include "tbase/resource_pool.h"
 #include "tfiber/butex.h"
@@ -222,6 +223,7 @@ void TaskGroup::sched_park() {
     // pointers.
     run_park_hooks();
     WakeBatcher::FlushCurrent();
+    flight::Record(flight::kSchedPark, (uint64_t)m->tid, 0);
     const int saved_errno = read_errno_here();
     asan_before_jump(&m->asan_fake, worker_stack_base_,
                      worker_stack_size_);

@@ -14,6 +14,7 @@
 
 #include "tbase/cpu_profiler.h"
 #include "tbase/flags.h"
+#include "tbase/flight_recorder.h"
 #include "tbase/heap_profiler.h"
 #include "tbase/symbolize.h"
 #include "tnet/event_dispatcher.h"
@@ -74,6 +75,9 @@ void HandleIndex(Server*, const HttpRequest&, HttpResponse* res) {
         "              /hotspots/heap, /hotspots/growth,\n"
         "              /hotspots/contention)\n"
         "/chaos        fault injection (?enable=1&seed=N&plan=...&peers=...)\n"
+        "/blackbox     flight-recorder rings: newest events per thread\n"
+        "              (?format=json: full ring contents for\n"
+        "              blackbox_merge.py)\n"
         "/pools        zero-copy pool state: live pinned-block leases\n"
         "              (with direction: req/rsp), per-class slab\n"
         "              occupancy, mapped peer pools + epochs, and the\n"
@@ -314,16 +318,41 @@ void HandleLoops(Server*, const HttpRequest& req, HttpResponse* res) {
 
 void HandleHotspotsContention(Server*, const HttpRequest& req,
                               HttpResponse* res) {
-    res->set_content_type("text/plain");
     if (req.QueryParam("reset") == "1") {
         ResetContentionProfile();
+        res->set_content_type("text/plain");
         res->Append("contention counters reset\n");
         return;
     }
+    if (req.QueryParam("format") == "json") {
+        res->set_content_type("application/json");
+        res->Append(ContentionProfileJson());
+        // Same fresh-window semantics as the text view.
+        ResetContentionProfile();
+        return;
+    }
+    res->set_content_type("text/plain");
     res->Append(ContentionProfileText());
     // Each view starts a fresh window (matches the reference's
     // per-request contention observation).
     ResetContentionProfile();
+}
+
+// /blackbox: the flight recorder's live view — newest events per thread
+// ring as text, or the full ring contents as JSON (?format=json; what
+// blackbox_merge.py fetches from survivors of a crash drill).
+void HandleBlackbox(Server*, const HttpRequest& req, HttpResponse* res) {
+    if (req.QueryParam("format") == "json") {
+        res->set_content_type("application/json");
+        std::string out;
+        flight::DumpJson(&out);
+        res->Append(out);
+        return;
+    }
+    res->set_content_type("text/plain");
+    std::string out;
+    flight::DumpText(&out);
+    res->Append(out);
 }
 
 // /fibers: live fiber-runtime introspection; ?st=1 adds per-fiber stack
@@ -801,6 +830,7 @@ void AddBuiltinHttpServices(Server* server) {
     transport_stats::ExposeVars();
     CollectiveEngine::ExposeVars();
     ExposeZoneLbVars();
+    flight::ExposeVars();
     server->RegisterHttpHandler("/", HandleIndex);
     server->RegisterHttpHandler("/health", HandleHealth);
     server->RegisterHttpHandler("/status", HandleStatus);
@@ -824,6 +854,7 @@ void AddBuiltinHttpServices(Server* server) {
     server->RegisterHttpHandler("/hotspots/contention",
                                 HandleHotspotsContention);
     server->RegisterHttpHandler("/chaos", HandleChaos);
+    server->RegisterHttpHandler("/blackbox", HandleBlackbox);
     server->RegisterHttpHandler("/pools", HandlePools);
     server->RegisterHttpHandler("/streams", HandleStreams);
     server->RegisterHttpHandler("/metrics", HandleMetrics);

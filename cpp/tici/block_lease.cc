@@ -8,6 +8,7 @@
 #include <vector>
 
 #include "tbase/flags.h"
+#include "tbase/flight_recorder.h"
 #include "tbase/logging.h"
 #include "tbase/time.h"
 #include "tvar/reducer.h"
@@ -104,6 +105,7 @@ uint64_t Pin(IOBuf&& buf, const char* direction) {
     StartReaper();
     const uint64_t id =
         g_next_id.fetch_add(1, std::memory_order_relaxed);
+    flight::Record(flight::kLeasePin, id, buf.size());
     {
         std::lock_guard<std::mutex> g(mu());
         Lease& l = leases()[id];
@@ -146,6 +148,7 @@ bool Arm(uint64_t lease_id, uint64_t call_id, int64_t deadline_us,
         l.peer_keys[1] = 0;
         l.npeers = peer_key != 0 ? 1 : 0;
     }
+    flight::Record(flight::kLeaseArm, lease_id, call_id);
     return true;
 }
 
@@ -161,6 +164,7 @@ bool Release(uint64_t lease_id) {
     }
     g_pinned.fetch_sub(1, std::memory_order_relaxed);
     g_released.fetch_add(1, std::memory_order_relaxed);
+    flight::Record(flight::kLeaseRelease, lease_id, pin.size());
     pin.clear();  // the dec_ref -> slab recycle, outside the lock
     return true;
 }
@@ -179,6 +183,9 @@ size_t ReapExpired(int64_t now_us) {
         for (auto it = m.begin(); it != m.end();) {
             if (it->second.deadline_us > 0 &&
                 now_us >= it->second.deadline_us) {
+                flight::Record(
+                    flight::kLeaseExpire, it->first,
+                    (uint64_t)((now_us - it->second.deadline_us) / 1000));
                 pins.push_back(std::move(it->second.pinned));
                 it = m.erase(it);
             } else {
@@ -236,6 +243,7 @@ size_t ReleasePeer(uint64_t peer_key) {
         g_peer_released.fetch_add(n, std::memory_order_relaxed);
         *g_var_peer_released << (int64_t)n;
         *g_var_reaped << (int64_t)n;
+        flight::Record(flight::kLeasePeerDeath, peer_key, n);
         drop_pins(&pins);
     }
     return n;
@@ -289,6 +297,7 @@ bool ReleaseAcked(uint64_t lease_id, uint64_t call_id,
     }
     g_pinned.fetch_sub(1, std::memory_order_relaxed);
     g_released.fetch_add(1, std::memory_order_relaxed);
+    flight::Record(flight::kLeaseRelease, lease_id, pin.size());
     pin.clear();  // dec_ref -> slab recycle, outside the lock
     return true;
 }

@@ -18,6 +18,7 @@
 #include "tbase/crc32c.h"
 #include "tbase/errno.h"
 #include "tbase/flags.h"
+#include "tbase/flight_recorder.h"
 #include "tbase/logging.h"
 #include "tbase/time.h"
 #include "tici/block_lease.h"
@@ -460,6 +461,7 @@ void CompletePending(uint64_t wr_id, int status, const IOBuf* payload) {
     c.status = status;
     c.bytes = status == 0 ? e.total : 0;
     c.op = e.op;
+    flight::Record(flight::kVerbComplete, wr_id, (uint64_t)(uint32_t)status);
     Deliver(e.cq, c);
 }
 
@@ -573,6 +575,7 @@ void ReapPendingPosts(int64_t now) {
         }
     }
     for (uint64_t id : timed_out) {
+        flight::Record(flight::kVerbReap, id, (uint64_t)TERR_RPC_TIMEDOUT);
         CompletePending(id, TERR_RPC_TIMEDOUT, nullptr);
     }
     for (uint64_t id : retry) ExecutePending(id);
@@ -615,6 +618,8 @@ int Post(CompletionQueue* cq, int op, uint64_t wr_id,
         s.pending[wr_id] = e;
     }
     *g_posted << 1;
+    flight::Record(flight::kVerbPost, wr_id,
+                   ((uint64_t)(uint32_t)op << 32) | (total & 0xffffffffu));
     ExecutePending(wr_id);
     return 0;
 }
@@ -710,7 +715,10 @@ int HandleWireVerb(int op, uint64_t wr_id, uint64_t window_id,
                    uint64_t offset, uint64_t len, uint64_t epoch,
                    uint32_t crc, const IOBuf& payload, IOBuf* out,
                    uint32_t* out_crc) {
-    (void)wr_id;
+    // Grantor-side wire event: the initiator's kVerbPost for this wr_id
+    // pairs with this record in the merged cross-node timeline.
+    flight::Record(flight::kVerbWire, wr_id,
+                   ((uint64_t)(uint32_t)op << 32) | (len & 0xffffffffu));
     // The wire-verb resolve seam inherits the chaos pool_stale kind (the
     // same fence the descriptor resolve path injects): answer the
     // retriable stale error without touching window state, so the soak

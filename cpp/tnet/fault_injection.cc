@@ -10,6 +10,7 @@
 
 #include "tbase/doubly_buffered_data.h"
 #include "tbase/flags.h"
+#include "tbase/flight_recorder.h"
 #include "tbase/logging.h"
 #include "tvar/reducer.h"
 
@@ -45,6 +46,9 @@ DEFINE_string(chaos_plan, "",
               "the initiator's pending-wr deadline reaps and retries "
               "it) / doorbell_delay (param = microseconds, default "
               "2000: deliver a CQ completion late, parking pollers); "
+              "and crash (ISSUE 19: a ticked decision kills the process "
+              "with a real SIGSEGV so the flight recorder's black-box "
+              "signal path fires); "
               "e.g. 'drop=0.01,delay=0.05:2000,cost_inflate=1:8'");
 DEFINE_string(chaos_peers, "",
               "comma list of ip:port remote endpoints the plan applies "
@@ -81,8 +85,8 @@ inline double to_unit(uint64_t r) {
 // Kind -> name, indexed by FaultAction::Kind (tvar suffixes AND the
 // /chaos page lines — one table so they can never desynchronize).
 const char* const kKindNames[FaultAction::kKindCount] = {
-    "none",    "delay", "short",  "drop",        "corrupt",
-    "reset",   "refuse", "stale_epoch", "cost_inflate"};
+    "none",    "delay",  "short",       "drop",         "corrupt",
+    "reset",   "refuse", "stale_epoch", "cost_inflate", "crash"};
 
 struct FaultPlan {
     // Read/write fault probabilities (selected by one uniform draw over
@@ -116,6 +120,10 @@ struct FaultPlan {
     // late (CQ completion delivered after doorbell_delay_us).
     double verb_drop = 0.0;
     double doorbell_delay = 0.0;
+    // Process crash (ISSUE 19): probability that a ticked decision kills
+    // the process with a genuine SIGSEGV — the flight recorder's
+    // fatal-signal black-box path is the thing under test.
+    double crash = 0.0;
     int64_t delay_us = 2000;
     int64_t ring_delay_us = 2000;
     int64_t cost_inflate_mult = 10;
@@ -267,6 +275,8 @@ bool ParsePlan(const std::string& text, FaultPlan* plan) {
         } else if (kind == "doorbell_delay") {
             plan->doorbell_delay = prob;
             if (!parse_us(&plan->doorbell_delay_us)) return false;
+        } else if (kind == "crash") {
+            plan->crash = prob;
         } else {
             return false;
         }
@@ -370,6 +380,17 @@ void FaultInjection::ReconfigureAndReset() {
     Reconfigure();
 }
 
+// The crash action's wild store is DELIBERATE undefined behavior (the
+// black-box dump must come from the fatal-signal handler, exactly as a
+// production crash would) — keep sanitizers from turning it into a
+// UBSan abort before the real SIGSEGV fires.
+#if defined(__clang__) || defined(__GNUC__)
+__attribute__((no_sanitize("undefined")))
+#endif
+static void CrashWithRealSegv() {
+    *(volatile int*)0 = 0;
+}
+
 FaultAction FaultInjection::Decide(FaultOp op, const EndPoint& peer,
                                    size_t len) {
     FaultAction action;
@@ -412,11 +433,25 @@ FaultAction FaultInjection::Decide(FaultOp op, const EndPoint& peer,
         return action;
     }
     const uint64_t n = e.seq.fetch_add(1, std::memory_order_relaxed);
-    const uint64_t r =
-        splitmix64(e.seed.load(std::memory_order_relaxed) +
-                   n * 0x9e3779b97f4a7c15ull);
+    const uint64_t seed = e.seed.load(std::memory_order_relaxed);
+    const uint64_t r = splitmix64(seed + n * 0x9e3779b97f4a7c15ull);
     const double u = to_unit(r);
     e.ndecisions << 1;
+    // Flight-recorder stamp for chaos decisions: a = decision index, b
+    // packs (seed_low32, op, action kind) so a seed replay aligns
+    // decision-for-decision with the merged timeline.
+    const auto chaos_stamp = [&](FaultAction::Kind kind) {
+        flight::Record(flight::kChaosInject, n,
+                       ((uint64_t)(uint32_t)seed << 32) |
+                           ((uint64_t)(uint32_t)op << 8) | (uint64_t)kind);
+    };
+    if (p->crash > 0.0 && u < p->crash) {
+        e.injected[FaultAction::kCrash] << 1;
+        chaos_stamp(FaultAction::kCrash);
+        // A real SIGSEGV, not exit(): the black-box dump must come from
+        // the fatal-signal handler, exactly as a production crash would.
+        CrashWithRealSegv();
+    }
     if (op == FaultOp::kAccept || op == FaultOp::kConnect) {
         if (u < p->refuse) action.kind = FaultAction::kRefuse;
     } else if (op == FaultOp::kPoolResolve) {
@@ -498,6 +533,7 @@ FaultAction FaultInjection::Decide(FaultOp op, const EndPoint& peer,
     }
     if (action.kind != FaultAction::kNone) {
         e.injected[action.kind] << 1;
+        chaos_stamp(action.kind);
     }
     return action;
 }

@@ -86,6 +86,12 @@ struct FaultAction {
         // measured handler cost by `aux` before it feeds the admission
         // cost model — drives work-priced shedding in soaks.
         kInflate,
+        // Process crash (ISSUE 19): Decide itself dies on a genuine
+        // SIGSEGV (null write) after recording the chaos event — the
+        // flight recorder's signal path must produce the black-box dump.
+        // Never returned to a seam; the sentinel below stays the counter
+        // array size.
+        kCrash,
         kKindCount  // sentinel (counter array size)
     };
     Kind kind = kNone;

@@ -7,6 +7,7 @@
 
 #include "tbase/errno.h"
 #include "tbase/flags.h"
+#include "tbase/flight_recorder.h"
 #include "tbase/logging.h"
 #include "tfiber/fiber.h"
 #include "tfiber/task_group.h"
@@ -99,6 +100,7 @@ bool Acquire(size_t nbytes) {
     --g_budget;
     g_acquired_current = true;
     **dispatches_adder() << 1;
+    flight::Record(flight::kSchedInline, nbytes, 0);
     return true;
 }
 

@@ -4,6 +4,7 @@
 
 #include "tbase/errno.h"
 #include "tbase/fast_rand.h"
+#include "tbase/flight_recorder.h"
 #include "tbase/logging.h"
 #include "tbase/time.h"
 #include "tfiber/call_id.h"
@@ -348,6 +349,7 @@ void Channel::CallMethod(const google::protobuf::MethodDescriptor* method,
             cntl->start_us_ + backup_ms * 1000);
     }
 
+    flight::Record(flight::kRpcIssue, cid, cntl->sampled_trace_id_);
     cntl->IssueRPC();
     id_unlock(cid);  // delivers any queued early error
     // `cntl` may already be gone here (async completion).

@@ -6,6 +6,7 @@
 
 #include "tbase/crc32c.h"
 #include "tbase/errno.h"
+#include "tbase/flight_recorder.h"
 #include "tbase/logging.h"
 #include "tbase/time.h"
 #include "tfiber/fiber.h"
@@ -31,6 +32,14 @@ static LazyAdder g_retries("rpc_collective_retries");
 static LazyAdder g_reforms("rpc_collective_reforms");
 static LazyAdder g_bytes("rpc_collective_bytes");
 static LazyAdder g_desc_fallbacks("rpc_collective_desc_fallbacks");
+
+// Flight-recorder step event (ROADMAP item 4: the overlap metric needs
+// step-timestamped events): a=round seq, b packs kind/step/chunk.
+static inline void RecordStepEvent(const CollWire& w) {
+    flight::Record(flight::kCollStep, w.seq,
+                   ((uint64_t)w.kind << 48) | ((uint64_t)w.step << 32) |
+                       (uint64_t)w.chunk);
+}
 // Verbs lane (ISSUE 18): ring steps that moved as one scatter-gather
 // REMOTE_WRITE + doorbell, and the chunks that had to ride the
 // per-chunk RPC path although the verbs lane was requested (lane grant
@@ -221,6 +230,7 @@ void CollectiveEngine::SendChunkAsync(const std::shared_ptr<Round>& round,
         round->sends_inflight++;
     }
     *g_steps << 1;
+    RecordStepEvent(w);
     *g_bytes << (int64_t)w.len;
     if (r != nullptr) r->moved_bytes += w.len;
     chan->CallMethod(codec_->method(), &c->cntl, c->req.get(), c->rsp.get(),
@@ -371,6 +381,8 @@ std::shared_ptr<CollectiveEngine::Round> CollectiveEngine::GetOrCreateRound(
             reset_buffers(rd.get());
             if (r != nullptr) r->reforms++;
             *g_reforms << 1;
+            flight::Record(flight::kCollReform, rd->member_hash,
+                           (uint64_t)rd->nranks);
         } else {
             // Transient failure with the same membership: keep the
             // applied set and buffer, re-issue outgoing work only
@@ -684,6 +696,7 @@ int CollectiveEngine::VerbsRingStep(const std::shared_ptr<Round>& round,
     chan->CallMethod(codec_->method(), &cntl, req.get(), rsp.get(),
                      nullptr);
     *g_steps << 1;
+    RecordStepEvent(w);
     *g_verb_steps << 1;
     *g_bytes << (int64_t)(wn * 4);
     if (r != nullptr) {
@@ -761,6 +774,7 @@ public:
             if (res != nullptr) res->moved_bytes += it.len;
         }
         *g_steps << 1;
+        RecordStepEvent(w);
         return s;
     }
 
@@ -1017,6 +1031,7 @@ int CollectiveEngine::RunSerialAttempt(const std::shared_ptr<Round>& round,
         root->CallMethod(codec_->method(), &cntl, req.get(), rsp.get(),
                          nullptr);
         *g_steps << 1;
+        RecordStepEvent(w);
         *g_bytes << (int64_t)total;
         if (r != nullptr) r->moved_bytes += total;
         if (cntl.Failed()) return cntl.ErrorCode();
@@ -1031,6 +1046,7 @@ int CollectiveEngine::RunSerialAttempt(const std::shared_ptr<Round>& round,
     root->CallMethod(codec_->method(), &cntl, req.get(), rsp.get(),
                      nullptr);
     *g_steps << 1;
+    RecordStepEvent(w);
     if (cntl.Failed()) return cntl.ErrorCode();
     std::string result = cntl.response_attachment().to_string();
     if (result.size() != total) return TERR_RESPONSE;
@@ -1439,6 +1455,8 @@ int CollectiveEngine::HierAllReduce(uint64_t seq, uint32_t* words,
             err = TERR_STALE_EPOCH;
             r->reforms++;
             *g_reforms << 1;
+            flight::Record(flight::kCollReform, seq,
+                           (uint64_t)ph3.member_keys.size());
             continue;
         }
         std::vector<uint64_t> contrib;

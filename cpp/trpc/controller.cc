@@ -13,6 +13,7 @@
 #include "tbase/errno.h"
 #include "thttp/http2_client.h"
 #include "tbase/flags.h"
+#include "tbase/flight_recorder.h"
 #include "tbase/logging.h"
 #include "tbase/time.h"
 #include "tfiber/fiber.h"
@@ -1275,6 +1276,7 @@ void ProcessTpuStdResponse(TpuStdMessage* msg, const rpc::RpcMeta& meta) {
         cntl->unfinished_fly_sid_ = INVALID_VREF_ID;
     }
     const auto& rmeta = meta.response();
+    flight::Record(flight::kRpcRespRecv, cid, (uint64_t)rmeta.error_code());
     // Any NON-auth-error response proves the server accepted this
     // connection's credential: release the auth-fight waiters (a bad
     // credential fails the connection instead, waking them with an

@@ -10,6 +10,7 @@
 #include "tbase/errno.h"
 #include "tbase/fast_rand.h"
 #include "tbase/flags.h"
+#include "tbase/flight_recorder.h"
 #include "tbase/logging.h"
 #include "tbase/time.h"
 #include "thttp/http2_client.h"
@@ -412,8 +413,11 @@ public:
         qos_peer_ = peer;
     }
     void set_qos_counted() { qos_counted_ = true; }
+    uint64_t wire_cid() const { return cid_; }
 
     void Run() override {
+        flight::Record(flight::kRpcHandlerOut, cid_,
+                       (uint64_t)cntl_->ErrorCode());
         if (cntl_->span_ != nullptr) {
             cntl_->span_->process_end_us = monotonic_time_us();
             // Annotated HERE, not in the cancel delivery path: the span is
@@ -534,6 +538,7 @@ public:
         if (have_sock) {
             wrc = s->Write(&frame);
         }
+        flight::Record(flight::kRpcWrite, cid_, payload.size());
         // Push-stream bind point (ISSUE 17): the accept echo is on the
         // wire — bind the stream to this connection, grant the open's
         // credit window and replay unacked ring entries. A failed call
@@ -655,8 +660,16 @@ void CallUserMethod(Server::MethodProperty* mp, Controller* cntl,
         done->Run();
         return;
     }
+    // Within this protocol `done` is always the SendResponseClosure built
+    // in ProcessTpuStdRequest — the only holder of the wire cid here.
+    const uint64_t wire_cid =
+        static_cast<SendResponseClosure*>(done)->wire_cid();
+    flight::Record(flight::kRpcHandlerIn, wire_cid,
+                   cntl->span_ != nullptr ? cntl->span_->trace_id : 0);
     ServerCallScope scope(cntl);
     mp->service->CallMethod(mp->method, cntl, req, res, done);
+    // kRpcHandlerOut is recorded by SendResponseClosure::Run — a
+    // synchronous handler has already run `done` (and freed cntl) here.
 }
 
 void* RunUserCall(void* arg) {
@@ -777,6 +790,7 @@ void ShedQueuedCall(void* arg, int64_t backoff_ms) {
 void ProcessTpuStdRequest(TpuStdMessage* msg, const rpc::RpcMeta& meta) {
     const SocketId sid = msg->socket_id;
     const uint64_t cid = meta.correlation_id();
+    flight::Record(flight::kRpcDispatch, cid, msg->body.size());
     // rpc_dump: capture the raw meta+body of sampled requests (reference
     // rpc_dump.cpp via the bvar Collector; appending IOBufs only bumps
     // block refcounts, so the hot path pays two flag/gate loads).

@@ -16,6 +16,7 @@
 #include "tbase/errno.h"
 #include "tbase/fast_rand.h"
 #include "tbase/flags.h"
+#include "tbase/flight_recorder.h"
 #include "tbase/logging.h"
 #include "tbase/time.h"
 #include "tfiber/butex.h"
@@ -687,6 +688,7 @@ std::shared_ptr<ServerStreamState> AcceptOpen(uint64_t id,
                 st->ring.pop_front();
             }
             *g_resumed << 1;
+            flight::Record(flight::kStreamResume, id, resume_from);
             return st;
         }
         if (it != reg.end()) {
@@ -708,7 +710,10 @@ std::shared_ptr<ServerStreamState> AcceptOpen(uint64_t id,
         reg[id] = st;
     }
     if (stale != nullptr) AbortLocked(stale, TERR_CLOSE);
-    if (resume_from > 0) *g_resumed << 1;
+    if (resume_from > 0) {
+        *g_resumed << 1;
+        flight::Record(flight::kStreamResume, id, resume_from);
+    }
     return st;
 }
 
@@ -802,6 +807,8 @@ int StreamWriter::Write(const std::string& chunk, bool eos) {
                 // park (this is the backpressure that bounds memory).
                 if (!stall_counted) {
                     *g_credit_stalls << 1;
+                    flight::Record(flight::kStreamCreditStall, st->id,
+                                   st->last_sent);
                     stall_counted = true;
                 }
             } else if (st->unbound_since_us > 0 &&
@@ -814,6 +821,7 @@ int StreamWriter::Write(const std::string& chunk, bool eos) {
             }
         }
         if (seq != 0) {
+            flight::Record(flight::kStreamChunk, st->id, seq);
             if (fault_injection_enabled()) {
                 EndPoint peer;
                 {

@@ -1,0 +1,175 @@
+"""Crash black-box forensics (ISSUE 19): chaos-crash one node of a
+4-node verbs mesh and reconstruct its last moments across nodes.
+
+Two pods of two `mesh_node` processes run --verbs_traffic with
+--blackbox dump paths (the same dcn-emulated topology as
+test_verbs_soak, so cross-pod verb posts traverse the emulated wire
+seam and the GRANTOR records VERB_WIRE events a merge can pair with the
+initiator's VERB_POST). Mid-traffic, one node gets a deterministic
+`crash=1` chaos plan scoped to a bogus peer filter — only the
+peer-filter-bypassing verb seams consume decisions, so the node's very
+next verb post records CHAOS_INJECT and dies on a genuine SIGSEGV.
+
+Asserted:
+  * the fatal-signal path left a parseable TFRBOX1 black box (and the
+    process exit status still reports SIGSEGV — the handler re-raises);
+  * tools/blackbox_merge.py merges the dead node's binary dump with the
+    survivors' live /blackbox?format=json rings into ONE timeline in
+    which the dying node's final verb posts appear WITH a surviving
+    peer's matching VERB_WIRE event (same wr id, wire after post);
+  * the chaos injection that killed the node is in the timeline,
+    stamped with the plan seed and the crash action kind;
+  * survivors keep making verb progress and shut down cleanly.
+"""
+import json
+import signal
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+from test_chaos_soak import Node, _chaos, _free_ports, _http_get
+from test_pod_partition_soak import _report
+from test_verbs_soak import VERB_FLAGS, _wait_verbs_ok
+
+POD_SIZE = 2
+NUM_NODES = 2 * POD_SIZE
+MERGE_TOOL = Path(__file__).resolve().parent.parent / "tools" / \
+    "blackbox_merge.py"
+
+
+def test_blackbox_forensics(cpp_build, tmp_path):
+    binary = cpp_build / "mesh_node"
+    assert binary.exists(), "mesh_node not built"
+    ports = _free_ports(NUM_NODES)
+    pod_a, pod_b = ports[:POD_SIZE], ports[POD_SIZE:]
+
+    naming = tmp_path / "naming"
+    naming.write_text(
+        "".join("127.0.0.1:%d zone=A\n" % p for p in pod_a)
+        + "".join("127.0.0.1:%d zone=B\n" % p for p in pod_b))
+    dcn_a = tmp_path / "dcn_a"
+    dcn_a.write_text("".join("127.0.0.1:%d zone=B\n" % p for p in pod_b))
+    dcn_b = tmp_path / "dcn_b"
+    dcn_b.write_text("".join("127.0.0.1:%d zone=A\n" % p for p in pod_a))
+
+    def _bb(i):
+        return tmp_path / ("blackbox_%d.bin" % i)
+
+    def _node(i):
+        in_a = i < POD_SIZE
+        return Node(binary, ports[i], i, naming, flags=VERB_FLAGS,
+                    extra_args=("--zone", "A" if in_a else "B",
+                                "--dcn_peers",
+                                str(dcn_a if in_a else dcn_b),
+                                "--verbs_traffic",
+                                "--blackbox", str(_bb(i))))
+
+    nodes = [_node(i) for i in range(NUM_NODES)]
+    try:
+        for n in nodes:
+            assert n.wait_ready(), "node %d never became ready" % n.idx
+
+        # Warm-up: verb traffic on both data paths, plus enough LB RPC
+        # round trips for the merge tool's envelope clock normalization.
+        ok0 = _wait_verbs_ok(nodes, 10)
+        assert all(v >= 10 for v in ok0.values()), \
+            "verb traffic never started: %s" % ok0
+
+        # --- chaos-crash node 0 ---------------------------------------
+        # Node 0 (pod A) initiates cross-pod verbs against pod B's
+        # windows over the dcn wire seam, so its final posts have
+        # grantor-side VERB_WIRE twins on the survivors.
+        victim = 0
+        try:
+            _chaos(ports[victim], enable=1, seed=20260807, plan="crash=1",
+                   peers="9.9.9.9:1")
+        except Exception:
+            pass  # the crash can beat the HTTP response off the box
+        rc = nodes[victim].proc.wait(timeout=30.0)
+        assert rc == -signal.SIGSEGV, \
+            "victim exit %r is not the re-raised SIGSEGV" % rc
+
+        # --- the signal path left a black box -------------------------
+        dump = _bb(victim)
+        assert dump.exists(), "crash handler wrote no dump"
+        blob = dump.read_bytes()
+        assert blob[:8] == b"TFRBOX1\0", blob[:8]
+        assert len(blob) > 136, "dump is header-only"
+
+        # Survivors: snapshot their rings live over /blackbox.
+        survivors = [n for n in nodes if n.idx != victim]
+        for n in survivors:
+            _bb(n.idx).write_text(
+                _http_get(ports[n.idx], "/blackbox?format=json",
+                          timeout=10.0))
+        # And the metrics families are live (lint checks 0-valued
+        # exposure; here the rings demonstrably recorded).
+        metrics = _http_get(ports[survivors[0].idx], "/metrics")
+        for fam in ("rpc_blackbox_events", "rpc_blackbox_dropped",
+                    "rpc_blackbox_ring_highwater", "rpc_flight_dump_count"):
+            assert fam in metrics, "missing %s in /metrics" % fam
+        line = [ln for ln in metrics.splitlines()
+                if ln.startswith("rpc_blackbox_events")][0]
+        assert float(line.split()[-1]) > 0, line
+
+        # --- one merged causal timeline -------------------------------
+        out = subprocess.run(
+            [sys.executable, str(MERGE_TOOL), "--json"]
+            + [str(_bb(i)) for i in range(NUM_NODES)],
+            capture_output=True, text=True, timeout=60)
+        assert out.returncode == 0, out.stderr
+        doc = json.loads(out.stdout)
+        assert len(doc["nodes"]) == NUM_NODES, doc["nodes"]
+        victim_name = "node%d:%d" % (victim, ports[victim])
+        by_name = {n["name"]: n for n in doc["nodes"]}
+        assert victim_name in by_name, by_name.keys()
+        assert by_name[victim_name]["events"] > 0
+
+        events = doc["events"]
+        v_posts = [e for e in events
+                   if e["node"] == victim_name and e["kind"] == "VERB_POST"]
+        assert v_posts, "dying node's verb posts missing from timeline"
+        peer_wires = {}
+        for e in events:
+            if e["kind"] == "VERB_WIRE" and e["node"] != victim_name:
+                peer_wires.setdefault(e["a"], []).append(e)
+        # The dying node's final posts must pair with a surviving peer's
+        # wire event: same wr id (pid-salted, so unique across nodes),
+        # wire AFTER post once clocks normalize.
+        matched = None
+        for post in sorted(v_posts, key=lambda e: -e["t_us"]):
+            for wire in peer_wires.get(post["a"], ()):
+                if wire["t_us"] > post["t_us"]:
+                    matched = (post, wire)
+                    break
+            if matched is not None:
+                break
+        assert matched is not None, \
+            "no (VERB_POST, peer VERB_WIRE) pair for the dying node"
+
+        # The injection that killed it is on the record, crash-stamped
+        # with the plan seed (b packs seed_lo32<<32 | op<<8 | kind).
+        chaos = [e for e in events
+                 if e["node"] == victim_name and e["kind"] == "CHAOS_INJECT"]
+        assert chaos, "CHAOS_INJECT missing from the dying node's ring"
+        last = chaos[-1]
+        assert last["b"] & 0xff == 9, last     # FaultAction::kCrash
+        assert last["b"] >> 32 == 20260807 & 0xFFFFFFFF, last
+
+        # --- survivors are healthy ------------------------------------
+        base = {n.idx: _report(n)["verbs_ok"] for n in survivors}
+        ok1 = _wait_verbs_ok(survivors, 5, timeout=40.0, baseline=base)
+        assert all(ok1[n.idx] - base[n.idx] >= 5 for n in survivors), \
+            "verb progress stopped after the crash: %s" % ok1
+        for n in survivors:
+            rep = n.stop_and_report(timeout=60.0)
+            assert rep is not None
+            assert rep["outstanding"] == 0, rep
+            assert n.shutdown() == 0, "node %d unclean exit" % n.idx
+    finally:
+        for n in nodes:
+            try:
+                n.proc.kill()
+            except OSError:
+                pass

@@ -226,6 +226,37 @@ def test_metrics_exposition_lint(cpp_build, tmp_path):
                     "rpc_collective_verb_fallbacks"):
             assert families.get(fam) == "gauge", (fam, sorted(families))
             assert re.search(r"^%s \d+$" % fam, text, re.M), fam
+        # ISSUE 19 flight-recorder families: exposed from the first
+        # scrape (the recorder is always-on, so events may already be
+        # non-zero from the node's own traffic; dump_count must still be
+        # 0 — nothing crashed).
+        for fam in ("rpc_blackbox_events", "rpc_blackbox_dropped",
+                    "rpc_blackbox_ring_highwater", "rpc_flight_dump_count"):
+            assert families.get(fam) == "gauge", (fam, sorted(families))
+            assert re.search(r"^%s \d+$" % fam, text, re.M), fam
+        assert re.search(r"^rpc_flight_dump_count 0$", text, re.M), \
+            "a dump happened on a healthy node"
+        # /blackbox renders in both forms; the json is the exact document
+        # tools/blackbox_merge.py consumes for live nodes.
+        bb = json.loads(_http_get(port, "/blackbox?format=json"))
+        for key in ("node", "pid", "wall_us", "ticks_per_us", "rings"):
+            assert key in bb, (key, sorted(bb))
+        assert isinstance(bb["rings"], list) and bb["rings"], bb
+        assert any(r["events"] for r in bb["rings"]), \
+            "always-on recorder captured nothing"
+        assert "flight recorder:" in _http_get(port, "/blackbox")
+        # Satellite: the contention profiler page grew a machine form
+        # with the same fresh-window semantics as the text view.
+        cont = json.loads(
+            _http_get(port, "/hotspots/contention?format=json"))
+        for key in ("total_count", "total_wait_us", "other_count",
+                    "sites"):
+            assert key in cont, (key, sorted(cont))
+        assert isinstance(cont["sites"], list), cont
+        for site in cont["sites"]:
+            assert set(site) == {"site", "count", "wait_us"}, site
+        assert "fiber-mutex contention" in _http_get(
+            port, "/hotspots/contention")
         # ISSUE 12/14 transport-tier attribution: labelled families with
         # one series per registered endpoint type, now including the
         # cross-pod dcn tier.

@@ -1,0 +1,318 @@
+#!/usr/bin/env python3
+"""blackbox_merge.py [--json] [--last N] DUMP... — merge N flight-recorder
+dumps (binary TFRBOX1 files from crash handlers / --blackbox exits, or JSON
+documents fetched from live nodes' /blackbox?format=json) into ONE causal
+timeline.
+
+Cross-node clock normalization uses the RPC envelope technique (the same
+NTP-style math the rpcz stitcher uses): for every call id seen as
+RPC_ISSUE(t1)/RPC_RESP_RECV(t4) on the client and RPC_DISPATCH(t2)/
+RPC_WRITE(t3) on the server, the pairwise offset estimate is
+((t2-t1)+(t3-t4))/2; the median over matched cids cancels asymmetric
+delay outliers. Offsets propagate from a reference node (the one with the
+most events) over the pairwise graph; nodes with no RPC overlap fall back
+to their absolute wall-clock anchors.
+
+Event args echo the recording seams (cpp/tbase/flight_recorder.h):
+RPC_* carry a=cid; VERB_* carry a=wr_id (POST/WIRE pack op<<32|len in b);
+CHAOS_INJECT packs seed_lo32<<32|op<<8|kind in b. The text timeline
+decodes these; --json emits the normalized events raw for scripting
+(tests/test_blackbox_forensics.py asserts on that form).
+"""
+import json
+import struct
+import sys
+
+FILE_HDR = struct.Struct("<8sIIqqQdqQII64s")
+RING_HDR = struct.Struct("<8sIIQII16s")
+EVENT = struct.Struct("<QIIQQ")
+
+KIND_NAMES = [
+    "NONE", "RPC_ISSUE", "RPC_DISPATCH", "RPC_HANDLER_IN",
+    "RPC_HANDLER_OUT", "RPC_WRITE", "RPC_RESP_RECV", "VERB_POST",
+    "VERB_WIRE", "VERB_COMPLETE", "VERB_REAP", "LEASE_PIN", "LEASE_ARM",
+    "LEASE_RELEASE", "LEASE_EXPIRE", "LEASE_PEER_DEATH", "STREAM_CHUNK",
+    "STREAM_CREDIT_STALL", "STREAM_RESUME", "COLL_STEP", "COLL_REFORM",
+    "SCHED_INLINE", "SCHED_PARK", "CHAOS_INJECT",
+]
+K_RPC_ISSUE, K_RPC_DISPATCH = 1, 2
+K_RPC_WRITE, K_RPC_RESP_RECV = 5, 6
+
+CHAOS_KIND_NAMES = [
+    "none", "delay", "short", "drop", "corrupt", "reset", "refuse",
+    "stale_epoch", "cost_inflate", "crash",
+]
+
+
+def cstr(b):
+    return b.split(b"\0", 1)[0].decode("ascii", "replace")
+
+
+class Node:
+    def __init__(self, name, pid, source):
+        self.name = name
+        self.pid = pid
+        self.source = source
+        self.wall_us = 0
+        self.mono_us = 0
+        self.tsc = 0
+        self.ticks_per_us = 0.0
+        self.dump_mono_us = 0
+        self.dump_tsc = 0
+        self.dropped = 0
+        self.events = []  # dicts: tsc, seq, k, kind, a, b, tid, tname
+        self.offset_us = 0.0  # this node's clock minus the reference's
+        self.offset_how = "wall-anchor"
+
+    def tpu(self):
+        """Ticks per us: prefer the dump-time re-capture (measures THIS
+        run's actual rate over the whole process lifetime) when sane."""
+        reported = self.ticks_per_us if self.ticks_per_us > 0 else 1.0
+        dt_us = self.dump_mono_us - self.mono_us
+        dt_tsc = self.dump_tsc - self.tsc
+        if dt_us > 1000 and dt_tsc > 0:
+            measured = dt_tsc / dt_us
+            if 0.5 * reported <= measured <= 2.0 * reported:
+                return measured
+        return reported
+
+    def wall_of(self, tsc):
+        return self.wall_us + (tsc - self.tsc) / self.tpu()
+
+
+def parse_binary(path, data):
+    if len(data) < FILE_HDR.size:
+        raise ValueError("truncated header")
+    (magic, version, pid, wall_us, mono_us, tsc, tpu, dump_mono_us,
+     dump_tsc, nrings, _res, node_name) = FILE_HDR.unpack_from(data, 0)
+    if magic != b"TFRBOX1\0":
+        raise ValueError("bad magic %r" % magic)
+    if version != 1:
+        raise ValueError("unknown version %d" % version)
+    n = Node(cstr(node_name) or path, pid, path)
+    n.wall_us, n.mono_us, n.tsc, n.ticks_per_us = wall_us, mono_us, tsc, tpu
+    n.dump_mono_us, n.dump_tsc = dump_mono_us, dump_tsc
+    off = FILE_HDR.size
+    for _ in range(nrings):
+        if off + RING_HDR.size > len(data):
+            break  # torn dump (crash mid-write): keep what parsed
+        (rmagic, tid, cap, nxt, nvalid, _rres,
+         tname) = RING_HDR.unpack_from(data, off)
+        if rmagic != b"TFRRING\0":
+            break
+        off += RING_HDR.size
+        nslots = min(nvalid, (len(data) - off) // EVENT.size)
+        slots = [EVENT.unpack_from(data, off + i * EVENT.size)
+                 for i in range(nslots)]
+        off += nslots * EVENT.size
+        tname = cstr(tname)
+        # Raw slot order on disk; reconstruct [next-nvalid, next) by seq,
+        # dropping slots overwritten under the dumper (seq mismatch).
+        for s in range(nxt - nvalid, nxt):
+            i = s & (cap - 1)
+            if i >= nslots:
+                continue
+            etsc, ekind, eseq, ea, eb = slots[i]
+            if eseq != (s & 0xFFFFFFFF):
+                continue
+            kname = KIND_NAMES[ekind] if ekind < len(KIND_NAMES) else "?"
+            n.events.append({"tsc": etsc, "seq": s, "k": ekind,
+                             "kind": kname, "a": ea, "b": eb,
+                             "tid": tid, "tname": tname})
+        if nslots < nvalid:
+            break
+    return n
+
+
+def parse_json(path, data):
+    doc = json.loads(data)
+    n = Node(doc.get("node") or path, doc.get("pid", 0), path)
+    n.wall_us = doc.get("wall_us", 0)
+    n.mono_us = doc.get("mono_us", 0)
+    n.tsc = doc.get("tsc", 0)
+    n.ticks_per_us = doc.get("ticks_per_us", 0.0)
+    n.dump_mono_us = doc.get("dump_mono_us", 0)
+    n.dump_tsc = doc.get("dump_tsc", 0)
+    n.dropped = doc.get("dropped", 0)
+    for ring in doc.get("rings", []):
+        for e in ring.get("events", []):
+            n.events.append({"tsc": e["tsc"], "seq": e["seq"], "k": e["k"],
+                             "kind": e.get("kind", "?"), "a": e["a"],
+                             "b": e["b"], "tid": ring.get("tid", 0),
+                             "tname": ring.get("name", "")})
+    return n
+
+
+def load(path):
+    with open(path, "rb") as f:
+        data = f.read()
+    if data[:8] == b"TFRBOX1\0":
+        return parse_binary(path, data)
+    return parse_json(path, data)
+
+
+def median(xs):
+    xs = sorted(xs)
+    m = len(xs) // 2
+    if len(xs) % 2:
+        return xs[m]
+    return (xs[m - 1] + xs[m]) / 2.0
+
+
+def pair_offset(a, b):
+    """Envelope offset estimate of node b's clock minus node a's, from
+    RPCs a issued to b. Returns (offset_us, nsamples) or None."""
+    t1, t4, t2, t3 = {}, {}, {}, {}
+    for e in a.events:
+        if e["k"] == K_RPC_ISSUE:
+            t1.setdefault(e["a"], a.wall_of(e["tsc"]))
+        elif e["k"] == K_RPC_RESP_RECV:
+            t4.setdefault(e["a"], a.wall_of(e["tsc"]))
+    for e in b.events:
+        if e["k"] == K_RPC_DISPATCH:
+            t2.setdefault(e["a"], b.wall_of(e["tsc"]))
+        elif e["k"] == K_RPC_WRITE:
+            t3.setdefault(e["a"], b.wall_of(e["tsc"]))
+    samples = []
+    for cid in t1:
+        if cid in t2 and cid in t3 and cid in t4:
+            samples.append(((t2[cid] - t1[cid]) + (t3[cid] - t4[cid])) / 2.0)
+    if not samples:
+        return None
+    return median(samples), len(samples)
+
+
+def normalize(nodes):
+    """Assign every node an offset relative to the reference node by
+    propagating pairwise envelope offsets breadth-first."""
+    if not nodes:
+        return
+    ref = max(range(len(nodes)), key=lambda i: len(nodes[i].events))
+    edges = {}  # (i, j) -> offset of j relative to i
+    for i in range(len(nodes)):
+        for j in range(len(nodes)):
+            if i == j:
+                continue
+            po = pair_offset(nodes[i], nodes[j])
+            if po is not None:
+                edges[(i, j)] = po
+    done = {ref}
+    nodes[ref].offset_us = 0.0
+    nodes[ref].offset_how = "reference"
+    frontier = [ref]
+    while frontier:
+        nxt = []
+        for i in frontier:
+            for j in range(len(nodes)):
+                if j in done:
+                    continue
+                if (i, j) in edges:
+                    off, ns = edges[(i, j)]
+                    nodes[j].offset_us = nodes[i].offset_us + off
+                    nodes[j].offset_how = "envelope, %d samples" % ns
+                elif (j, i) in edges:
+                    off, ns = edges[(j, i)]
+                    nodes[j].offset_us = nodes[i].offset_us - off
+                    nodes[j].offset_how = "envelope, %d samples" % ns
+                else:
+                    continue
+                done.add(j)
+                nxt.append(j)
+        frontier = nxt
+    # Unreached nodes keep offset 0: their wall anchors stand alone.
+
+
+def decode_args(e):
+    k, kind, a, b = e["k"], e["kind"], e["a"], e["b"]
+    if kind.startswith("RPC_"):
+        return "cid=%d b=%d" % (a, b)
+    if kind in ("VERB_POST", "VERB_WIRE"):
+        return "wr=%d op=%d len=%d" % (a, b >> 32, b & 0xFFFFFFFF)
+    if kind in ("VERB_COMPLETE", "VERB_REAP"):
+        return "wr=%d status=%d" % (a, b)
+    if kind.startswith("LEASE_"):
+        return "lease=%d b=%d" % (a, b)
+    if kind.startswith("STREAM_"):
+        return "stream=%d b=%d" % (a, b)
+    if kind == "COLL_STEP":
+        return "seq=%d kind=%d step=%d chunk=%d" % (
+            a, b >> 48, (b >> 32) & 0xFFFF, b & 0xFFFFFFFF)
+    if kind == "CHAOS_INJECT":
+        fk = b & 0xFF
+        fkname = (CHAOS_KIND_NAMES[fk]
+                  if fk < len(CHAOS_KIND_NAMES) else str(fk))
+        return "decision=%d seed_lo=%d op=%d fault=%s" % (
+            a, b >> 32, (b >> 8) & 0xFFFFFF, fkname)
+    del k
+    return "a=%d b=%d" % (a, b)
+
+
+def main(argv):
+    as_json = False
+    last = 0
+    paths = []
+    i = 1
+    while i < len(argv):
+        arg = argv[i]
+        if arg == "--json":
+            as_json = True
+        elif arg == "--last":
+            i += 1
+            last = int(argv[i])
+        elif arg.startswith("--last="):
+            last = int(arg.split("=", 1)[1])
+        else:
+            paths.append(arg)
+        i += 1
+    if not paths:
+        sys.stderr.write(__doc__ + "\n")
+        return 2
+    nodes = []
+    for p in paths:
+        try:
+            nodes.append(load(p))
+        except (ValueError, OSError, KeyError, json.JSONDecodeError) as ex:
+            sys.stderr.write("skip %s: %s\n" % (p, ex))
+    if not nodes:
+        sys.stderr.write("no parsable dumps\n")
+        return 1
+    normalize(nodes)
+    merged = []
+    for n in nodes:
+        for e in n.events:
+            merged.append({
+                "t_us": n.wall_of(e["tsc"]) - n.offset_us,
+                "node": n.name, "pid": n.pid, "tid": e["tid"],
+                "tname": e["tname"], "seq": e["seq"], "k": e["k"],
+                "kind": e["kind"], "a": e["a"], "b": e["b"],
+            })
+    merged.sort(key=lambda e: e["t_us"])
+    if last > 0:
+        merged = merged[-last:]
+    if as_json:
+        json.dump({
+            "nodes": [{"name": n.name, "pid": n.pid, "source": n.source,
+                       "events": len(n.events), "dropped": n.dropped,
+                       "offset_us": n.offset_us, "offset_how": n.offset_how}
+                      for n in nodes],
+            "events": merged,
+        }, sys.stdout)
+        sys.stdout.write("\n")
+        return 0
+    print("blackbox merge: %d nodes, %d events" %
+          (len(nodes), len(merged)))
+    for n in nodes:
+        print("  node %-20s pid=%-7d events=%-7d offset_us=%+.1f (%s)" %
+              (n.name, n.pid, len(n.events), n.offset_us, n.offset_how))
+    if merged:
+        t0 = merged[0]["t_us"]
+        print("timeline (us since first event, normalized):")
+        for e in merged:
+            print("  +%-12.1f %-20s %-16s %-20s %s" %
+                  (e["t_us"] - t0, e["node"], e["tname"], e["kind"],
+                   decode_args(e)))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

@@ -47,6 +47,7 @@
 #include "tbase/endpoint.h"
 #include "tbase/errno.h"
 #include "tbase/flags.h"
+#include "tbase/flight_recorder.h"
 #include "tbase/logging.h"
 #include "tbase/time.h"
 #include "tfiber/fiber.h"
@@ -718,6 +719,15 @@ void* DescTrafficFiber(void* arg) {
 constexpr uint64_t kMeshWrTag = 0x4D45ull << 48;  // 'ME'
 std::atomic<uint64_t> g_mesh_wr{1};
 
+// Mesh wr ids are salted with the pid (bits 32..47) so ids are unique
+// ACROSS nodes, not just within one: the black-box merge pairs an
+// initiator's VERB_POST with the grantor's VERB_WIRE by wr id, and a
+// bare per-process counter would collide between initiators.
+uint64_t NextMeshWr() {
+    static const uint64_t salt = ((uint64_t)(getpid() & 0xffff)) << 32;
+    return kMeshWrTag | salt | (g_mesh_wr.fetch_add(1) & 0xffffffffu);
+}
+
 // Parks until the CQ delivers wr_id (this fiber posts one verb at a
 // time, so no other completion can appear). The 8 s bound is far
 // beyond the verb plane's post-timeout terminal guarantee — a pending
@@ -784,7 +794,7 @@ void* VerbsTrafficFiber(void* arg) {
                 sgl[i].addr = wr_buf.data() + i * piece;
                 sgl[i].len = piece;
             }
-            const uint64_t wid = kMeshWrTag | g_mesh_wr.fetch_add(1);
+            const uint64_t wid = NextMeshWr();
             verbs::Completion comp;
             if (verbs::PostWrite(&cq, wid, w, 0, sgl, kNsge) == 0 &&
                 ParkForWr(&cq, wid, &comp)) {
@@ -794,8 +804,7 @@ void* VerbsTrafficFiber(void* arg) {
                     for (uint32_t i = 0; i < kNsge; ++i) {
                         sgl[i].addr = rd_buf.data() + i * piece;
                     }
-                    const uint64_t rid =
-                        kMeshWrTag | g_mesh_wr.fetch_add(1);
+                    const uint64_t rid = NextMeshWr();
                     if (verbs::PostRead(&cq, rid, w, 0, sgl, kNsge) ==
                             0 &&
                         ParkForWr(&cq, rid, &comp)) {
@@ -1182,6 +1191,13 @@ void* GracefulQuitWatcher(void* arg) {
     return nullptr;
 }
 
+// Unclean-exit black box: dump the flight rings to --blackbox before
+// bailing with an error (the crash handler only covers signal deaths).
+int FailExit(int code) {
+    flight::DumpToConfiguredPath();
+    return code;
+}
+
 }  // namespace
 
 int main(int argc, char** argv) {
@@ -1189,6 +1205,7 @@ int main(int argc, char** argv) {
     int port = 0, id = 0;
     int timeout_cl_ms = 0;
     int drain_ms = 1200;
+    const char* blackbox_path = nullptr;
     bool lb_only = false;
     bool inline_echo = false;
     bool desc_traffic = false;
@@ -1269,6 +1286,11 @@ int main(int argc, char** argv) {
             // protocol on the queue pair yet) — the zero-failed-
             // completions invariant is an LB-plane contract.
             lb_only = true;
+        } else if (strcmp(argv[i], "--blackbox") == 0 && i + 1 < argc) {
+            // Flight-recorder black box (ISSUE 19): install the fatal-
+            // signal dump handler writing to this path, and dump there
+            // on unclean (non-signal) exits too.
+            blackbox_path = argv[++i];
         } else if (strcmp(argv[i], "--flag") == 0 && i + 1 < argc) {
             // --flag name=value: soak-tuned knobs (breaker windows,
             // health-check cadence, ...) without bespoke plumbing.
@@ -1290,23 +1312,35 @@ int main(int argc, char** argv) {
                 "[--collective] [--coll_traffic] [--coll_verbs] "
                 "[--drain_ms N] "
                 "[--timeout_cl_ms N] [--tenant NAME] [--priority 0..7] "
-                "[--flag name=value]...\n"
+                "[--blackbox PATH] [--flag name=value]...\n"
                 "  with --flag graceful_quit_on_sigterm=true: SIGTERM "
                 "drains gracefully and exits 0; SIGUSR2 drains without "
                 "quitting\n");
         return 2;
     }
+    // Node identity stamps every dump (blackbox_merge.py keys timelines
+    // on it); the crash handler is installed only when a path was given.
+    {
+        char nn[32];
+        snprintf(nn, sizeof(nn), "node%d:%d", id, port);
+        flight::SetNodeName(nn);
+    }
+    if (blackbox_path != nullptr) {
+        flight::InstallCrashHandler(blackbox_path);
+    }
     if (IciBlockPool::Init() != 0) {
         fprintf(stderr, "IciBlockPool::Init failed\n");
-        return 1;
+        return FailExit(1);
     }
 
     g_my_port = port;
     static EchoServiceImpl service;
     static CollectiveServiceImpl coll_service;
     static Server server;
-    if (server.AddService(&service) != 0) return 1;
-    if (collective && server.AddService(&coll_service) != 0) return 1;
+    if (server.AddService(&service) != 0) return FailExit(1);
+    if (collective && server.AddService(&coll_service) != 0) {
+        return FailExit(1);
+    }
     if (inline_echo) {
         server.SetMethodInlineSafe("benchpb.EchoService", "Echo");
     }
@@ -1321,7 +1355,7 @@ int main(int argc, char** argv) {
     }
     if (server.Start(listen, timeout_cl_ms > 0 ? &sopts : nullptr) != 0) {
         fprintf(stderr, "listen failed on port %d\n", port);
-        return 1;
+        return FailExit(1);
     }
 
     static NodeState st;
@@ -1335,7 +1369,7 @@ int main(int argc, char** argv) {
     const std::string url = std::string("file://") + peers_file;
     if (st.lb_channel->Init(url.c_str(), "rr", &lopts) != 0) {
         fprintf(stderr, "LB channel init failed for %s\n", url.c_str());
-        return 1;
+        return FailExit(1);
     }
     // Mesh links: one shm channel per same-zone peer (self excluded;
     // cross-zone entries in the naming file belong to the OTHER pod and
@@ -1344,7 +1378,7 @@ int main(int argc, char** argv) {
     // chaos_partition_zone command can cut a whole pod.
     if (!lb_only) {
         FILE* f = fopen(peers_file, "r");
-        if (f == nullptr) return 1;
+        if (f == nullptr) return FailExit(1);
         char line[128];
         while (fgets(line, sizeof(line), f) != nullptr) {
             NSNode node;
@@ -1363,7 +1397,7 @@ int main(int argc, char** argv) {
         fclose(f);
         if (dcn_peers_file != nullptr) {
             FILE* df = fopen(dcn_peers_file, "r");
-            if (df == nullptr) return 1;
+            if (df == nullptr) return FailExit(1);
             while (fgets(line, sizeof(line), df) != nullptr) {
                 NSNode node;
                 if (ParseNamingLine(line, &node) != 0) continue;

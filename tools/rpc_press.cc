@@ -75,6 +75,7 @@
 #include "tbase/endpoint.h"
 #include "tbase/errno.h"
 #include "tbase/flags.h"
+#include "tbase/flight_recorder.h"
 #include "tbase/time.h"
 #include "tfiber/fiber.h"
 #include "tici/block_pool.h"
@@ -418,6 +419,7 @@ int main(int argc, char** argv) {
     int max_retry = -1;  // <0 = channel default (3)
     long long stream_tokens = 0;  // --stream_tokens: push-stream mode
     int stream_read_delay_ms = 0;
+    const char* blackbox_path = nullptr;  // --blackbox=PATH (ISSUE 19)
     for (int i = 1; i < argc; ++i) {
         if (strncmp(argv[i], "--metrics_csv=", 14) == 0) {
             metrics_csv = argv[i] + 14;
@@ -483,6 +485,12 @@ int main(int argc, char** argv) {
             strcmp(argv[i], "--pool-desc") == 0) {
             pool_desc = true;
         }
+        // --blackbox=PATH: dump the CLIENT-side flight rings there at
+        // exit (and on a fatal signal) — the initiator half of a merged
+        // causal timeline.
+        if (strncmp(argv[i], "--blackbox=", 11) == 0) {
+            blackbox_path = argv[i] + 11;
+        }
         if (strcmp(argv[i], "--json") == 0) json = true;
     }
     if (server_str.empty()) {
@@ -497,7 +505,7 @@ int main(int argc, char** argv) {
                 "[--zone=NAME] [--dcn_peers=ip:port,...] "
                 "[--via=ip:port] [--sessions=N] "
                 "[--stream_tokens=N [--stream_read_delay_ms=N]] "
-                "[--json]\n"
+                "[--blackbox=PATH] [--json]\n"
                 "  --zone/--dcn_peers: zone-aware LB over the local "
                 "server + cross-pod dcn-tier peers; per-zone picks and "
                 "spills are reported\n"
@@ -505,6 +513,10 @@ int main(int argc, char** argv) {
                 "server-push stream of N tokens; contiguity is asserted "
                 "and TTFT p50/p99 + inter-token p99 reported\n");
         return 1;
+    }
+    if (blackbox_path != nullptr) {
+        flight::SetNodeName("rpc_press");
+        flight::InstallCrashHandler(blackbox_path);
     }
     EndPoint server;
     if (hostname2endpoint(server_str.c_str(), &server) != 0) {
@@ -953,6 +965,9 @@ int main(int argc, char** argv) {
                    (long long)g->failed.load(), (long long)g->shed.load(),
                    (long long)g->lat.latency_percentile(0.99));
         }
+    }
+    if (blackbox_path != nullptr) {
+        flight::DumpToConfiguredPath();
     }
     return 0;
 }

@@ -53,6 +53,7 @@
 #include "tbase/endpoint.h"
 #include "tbase/errno.h"
 #include "tbase/flags.h"
+#include "tbase/flight_recorder.h"
 #include "tbase/logging.h"
 #include "tbase/time.h"
 #include "tfiber/fiber.h"
@@ -676,6 +677,13 @@ void* GracefulQuitWatcher(void* arg) {
     return nullptr;
 }
 
+// Unclean-exit black box: dump the flight rings to --blackbox before
+// bailing with an error (the crash handler only covers signal deaths).
+int FailExit(int code) {
+    flight::DumpToConfiguredPath();
+    return code;
+}
+
 }  // namespace
 
 int main(int argc, char** argv) {
@@ -683,6 +691,7 @@ int main(int argc, char** argv) {
     int port = 0;
     int drain_ms = 800;
     const char* backends_file = nullptr;
+    const char* blackbox_path = nullptr;
     for (int i = 1; i < argc; ++i) {
         if (strcmp(argv[i], "--port") == 0 && i + 1 < argc) {
             port = atoi(argv[++i]);
@@ -701,6 +710,10 @@ int main(int argc, char** argv) {
             g_probe_interval_ms = atoi(argv[++i]);
         } else if (strcmp(argv[i], "--zone") == 0 && i + 1 < argc) {
             SetFlagValue("rpc_zone", argv[++i]);
+        } else if (strcmp(argv[i], "--blackbox") == 0 && i + 1 < argc) {
+            // Flight-recorder black box (ISSUE 19): fatal-signal dump
+            // handler + dump-on-unclean-exit, both to this path.
+            blackbox_path = argv[++i];
         } else if (strcmp(argv[i], "--flag") == 0 && i + 1 < argc) {
             std::string kv = argv[++i];
             const size_t eq = kv.find('=');
@@ -715,11 +728,21 @@ int main(int argc, char** argv) {
         fprintf(stderr,
                 "usage: tpu_router --port N --backends FILE [--drain_ms N] "
                 "[--hedge_floor_ms N] [--hedge_mult_pct N] [--no_hedge] "
-                "[--probe_interval_ms N] [--zone NAME] [--flag name=value]"
+                "[--probe_interval_ms N] [--zone NAME] [--blackbox PATH] "
+                "[--flag name=value]"
                 "...\n"
                 "  with --flag graceful_quit_on_sigterm=true: SIGTERM "
                 "drains gracefully and exits 0\n");
         return 2;
+    }
+
+    {
+        char nn[32];
+        snprintf(nn, sizeof(nn), "router:%d", port);
+        flight::SetNodeName(nn);
+    }
+    if (blackbox_path != nullptr) {
+        flight::InstallCrashHandler(blackbox_path);
     }
 
     // Backend table from the naming file (same format the LB resolves).
@@ -727,7 +750,7 @@ int main(int argc, char** argv) {
         FILE* f = fopen(backends_file, "r");
         if (f == nullptr) {
             fprintf(stderr, "cannot read %s\n", backends_file);
-            return 1;
+            return FailExit(1);
         }
         char line[128];
         while (fgets(line, sizeof(line), f) != nullptr) {
@@ -744,7 +767,7 @@ int main(int argc, char** argv) {
                 fprintf(stderr, "backend channel init failed for %s\n",
                         b->key.c_str());
                 fclose(f);
-                return 1;
+                return FailExit(1);
             }
             g_backends.push_back(std::move(b));
         }
@@ -752,7 +775,7 @@ int main(int argc, char** argv) {
     }
     if (g_backends.empty()) {
         fprintf(stderr, "no backends in %s\n", backends_file);
-        return 1;
+        return FailExit(1);
     }
 
     // The sessionless fabric: zone-aware LB (+ subsetting flags) over
@@ -765,10 +788,12 @@ int main(int argc, char** argv) {
         const std::string url = std::string("file://") + backends_file;
         if (g_lb_channel->Init(url.c_str(), "rr", &lopts) != 0) {
             fprintf(stderr, "LB channel init failed for %s\n", url.c_str());
-            return 1;
+            return FailExit(1);
         }
     }
-    if (g_select.AddChannel(g_lb_channel.get()) != 0) return 1;
+    if (g_select.AddChannel(g_lb_channel.get()) != 0) {
+        return FailExit(1);
+    }
 
     // Eager-expose every router family so the FIRST scrape already
     // carries 0-valued counters (metrics-lint contract).
@@ -784,7 +809,7 @@ int main(int argc, char** argv) {
 
     static RouterEchoService service;
     static Server server;
-    if (server.AddService(&service) != 0) return 1;
+    if (server.AddService(&service) != 0) return FailExit(1);
     server.RegisterHttpHandler(
         "/router", [](Server* s, const HttpRequest& req, HttpResponse* res) {
             RouterPage(s, req, res);
@@ -793,7 +818,7 @@ int main(int argc, char** argv) {
     str2endpoint("127.0.0.1", port, &listen);
     if (server.Start(listen, nullptr) != 0) {
         fprintf(stderr, "listen failed on port %d\n", port);
-        return 1;
+        return FailExit(1);
     }
 
     fiber_t probe;

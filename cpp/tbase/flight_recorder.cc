@@ -54,6 +54,8 @@ const char* const kKindNames[] = {
     "SCHED_INLINE",
     "SCHED_PARK",
     "CHAOS_INJECT",
+    "OUTLIER_EJECT",
+    "OUTLIER_REINSTATE",
 };
 static_assert(sizeof(kKindNames) / sizeof(kKindNames[0]) == kKindCount,
               "kKindNames must cover every EventKind");

@@ -55,8 +55,17 @@ enum EventKind : uint32_t {
     // Chaos. a=decision index; b packs seed_low32<<32|op<<8|action kind so a
     // seed replay aligns decision-for-decision with the timeline.
     kChaosInject = 23,
+    // Outlier ejection (ISSUE 20). a packs the backend's identity
+    // (ip4<<16|port — no cid exists for a routing decision); EJECT's b
+    // packs reason<<56|detail (detail = ewma/median ratio x100 for
+    // latency outliers, the consecutive-error threshold otherwise);
+    // REINSTATE's b = probe passes. blackbox_merge decodes both, so a
+    // merged timeline shows WHY routing shifted between a grey node's
+    // last slow rpc and the first re-routed pick.
+    kOutlierEject = 24,
+    kOutlierReinstate = 25,
 
-    kKindCount = 24,
+    kKindCount = 26,
 };
 
 // Stable names for dumps (indexed by EventKind, length kKindCount).

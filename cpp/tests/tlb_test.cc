@@ -656,7 +656,8 @@ TEST(ZoneAwareLB, FallbackOrderingAcrossPolicies) {
         EXPECT_TRUE(lb->AddServer(zoned_node(l2, "A")));
         EXPECT_TRUE(lb->AddServer(zoned_node(r1, "B")));
         EXPECT_TRUE(lb->AddServer(zoned_node(r2, "B")));
-        auto* zlb = static_cast<ZoneAwareLoadBalancer*>(lb.get());
+        auto* zlb = static_cast<ZoneAwareLoadBalancer*>(
+            static_cast<outlier::OutlierLoadBalancer*>(lb.get())->wrapped());
         EXPECT_EQ(2u, zlb->local_count()) << policy;
         EXPECT_EQ(2u, zlb->remote_count()) << policy;
         SelectIn in;
@@ -761,7 +762,8 @@ TEST(ZoneAwareLB, ZonelessPassthrough) {
     const SocketId b = make_fake_server(21621);
     lb->AddServer(zoned_node(a, ""));
     lb->AddServer(zoned_node(b, "B"));  // zoned member, zoneless process
-    auto* zlb = static_cast<ZoneAwareLoadBalancer*>(lb.get());
+    auto* zlb = static_cast<ZoneAwareLoadBalancer*>(
+        static_cast<outlier::OutlierLoadBalancer*>(lb.get())->wrapped());
     EXPECT_EQ(2u, zlb->local_count());
     EXPECT_EQ(0u, zlb->remote_count());
     SelectIn in;
@@ -915,4 +917,149 @@ TEST(NamingService, ZoneTagParses) {
     EXPECT_EQ("b", ZoneFromTag(node.tag));
     EXPECT_EQ("", ZoneFromTag("w=4"));
     EXPECT_EQ("", ZoneFromTag(""));
+}
+
+// ---------------- outlier ejection (ISSUE 20) ----------------
+
+// Consecutive hard errors eject; TERR_OVERLOAD never counts (admission
+// pushing back is not a grey failure); a health-check revive re-enters
+// through PROBING — never straight back at full weight (the regression:
+// ReviveAfterHealthCheck cleared DRAINING unconditionally and the LB
+// would pick the node immediately) — and probe passes graduate to the
+// slow-start RAMP.
+TEST(OutlierLB, EjectReviveProbeRamp) {
+    std::unique_ptr<LoadBalancer> lb(LoadBalancer::New("rr"));
+    ASSERT_TRUE(lb != nullptr);
+    auto* olb = static_cast<outlier::OutlierLoadBalancer*>(lb.get());
+    const SocketId a = make_fake_server(21700);
+    const SocketId b = make_fake_server(21701);
+    const SocketId c = make_fake_server(21702);
+    EXPECT_TRUE(lb->AddServer({a, 1}));
+    EXPECT_TRUE(lb->AddServer({b, 1}));
+    EXPECT_TRUE(lb->AddServer({c, 1}));
+
+    LoadBalancer::CallInfo info;
+    info.server_id = a;
+    info.latency_us = 1000;
+    info.error_code = TERR_FAILED_SOCKET;
+    for (int i = 0; i < 4; ++i) lb->Feedback(info);
+    // Overload feedback: no eject, but no streak reset either.
+    info.error_code = TERR_OVERLOAD;
+    lb->Feedback(info);
+    EXPECT_EQ(outlier::State::kHealthy, olb->tracker()->StateOf(a));
+    info.error_code = TERR_FAILED_SOCKET;
+    lb->Feedback(info);  // 5th hard error
+    EXPECT_EQ(outlier::State::kEjected, olb->tracker()->StateOf(a));
+    EXPECT_TRUE(olb->tracker()->IsEjected(a));
+
+    // Normal picks avoid the ejected backend and carry the reason.
+    SelectIn in;
+    bool saw_skip = false;
+    for (int i = 0; i < 12; ++i) {
+        SelectOut out;
+        ASSERT_EQ(0, lb->SelectServer(in, &out));
+        EXPECT_TRUE(out.ptr->id() == b || out.ptr->id() == c);
+        if (out.skipped_ejected) {
+            saw_skip = true;
+            EXPECT_TRUE(out.outlier_note.find("consecutive errors") !=
+                        std::string::npos)
+                << out.outlier_note;
+        }
+    }
+    EXPECT_TRUE(saw_skip);
+
+    // Revive: PROBING, still withheld from normal picks.
+    olb->tracker()->OnRevive(a);
+    EXPECT_EQ(outlier::State::kProbing, olb->tracker()->StateOf(a));
+    EXPECT_TRUE(olb->tracker()->IsEjected(a));
+    int probes = 0;
+    for (int i = 0; i < 12; ++i) {
+        SelectOut out;
+        ASSERT_EQ(0, lb->SelectServer(in, &out));
+        if (out.outlier_probe) {
+            EXPECT_EQ(a, out.ptr->id());
+            ++probes;
+        } else {
+            EXPECT_TRUE(out.ptr->id() == b || out.ptr->id() == c);
+        }
+    }
+    EXPECT_GE(probes, 1);  // the first probe diverts immediately
+
+    // Probe passes -> RAMPING (slow start), not instant full weight.
+    info.error_code = 0;
+    info.latency_us = 800;
+    for (int i = 0; i < 3; ++i) lb->Feedback(info);
+    EXPECT_EQ(outlier::State::kRamping, olb->tracker()->StateOf(a));
+
+    Socket::SetFailedById(a);
+    Socket::SetFailedById(b);
+    Socket::SetFailedById(c);
+}
+
+// A failed reinstatement probe relapses to EJECTED with a grown window.
+TEST(OutlierLB, ProbeFailureRelapses) {
+    SetFlagValue("outlier_ejection_ms", "1");
+    std::unique_ptr<LoadBalancer> lb(LoadBalancer::New("rr"));
+    auto* olb = static_cast<outlier::OutlierLoadBalancer*>(lb.get());
+    const SocketId a = make_fake_server(21710);
+    const SocketId b = make_fake_server(21711);
+    const SocketId c = make_fake_server(21712);
+    lb->AddServer({a, 1});
+    lb->AddServer({b, 1});
+    lb->AddServer({c, 1});
+    LoadBalancer::CallInfo info;
+    info.server_id = a;
+    info.latency_us = 1000;
+    info.error_code = TERR_FAILED_SOCKET;
+    for (int i = 0; i < 5; ++i) lb->Feedback(info);
+    ASSERT_EQ(outlier::State::kEjected, olb->tracker()->StateOf(a));
+    usleep(5 * 1000);  // the 1ms window expires
+    SelectIn in;
+    SelectOut out;
+    ASSERT_EQ(0, lb->SelectServer(in, &out));
+    EXPECT_TRUE(out.outlier_probe);
+    EXPECT_EQ(a, out.ptr->id());
+    lb->Feedback(info);  // the probe fails
+    EXPECT_EQ(outlier::State::kEjected, olb->tracker()->StateOf(a));
+    outlier::BackendSnapshot snap;
+    ASSERT_TRUE(olb->tracker()->Snapshot(a, &snap));
+    EXPECT_EQ(2, snap.eject_count);  // window doubled on relapse
+    SetFlagValue("outlier_ejection_ms", "2000");
+    Socket::SetFailedById(a);
+    Socket::SetFailedById(b);
+    Socket::SetFailedById(c);
+}
+
+// The ejection budget: with 3 backends and -outlier_max_ejection_pct=40
+// only one may be withheld — a second eject-worthy backend is vetoed
+// and STAYS routable (a grey majority must not amputate the mesh).
+TEST(OutlierLB, EjectionBoundedByMaxPct) {
+    std::unique_ptr<LoadBalancer> lb(LoadBalancer::New("rr"));
+    auto* olb = static_cast<outlier::OutlierLoadBalancer*>(lb.get());
+    const SocketId a = make_fake_server(21720);
+    const SocketId b = make_fake_server(21721);
+    const SocketId c = make_fake_server(21722);
+    lb->AddServer({a, 1});
+    lb->AddServer({b, 1});
+    lb->AddServer({c, 1});
+    LoadBalancer::CallInfo info;
+    info.latency_us = 1000;
+    info.error_code = TERR_FAILED_SOCKET;
+    info.server_id = a;
+    for (int i = 0; i < 5; ++i) lb->Feedback(info);
+    EXPECT_EQ(outlier::State::kEjected, olb->tracker()->StateOf(a));
+    info.server_id = b;
+    for (int i = 0; i < 5; ++i) lb->Feedback(info);
+    EXPECT_EQ(outlier::State::kHealthy, olb->tracker()->StateOf(b));
+    EXPECT_EQ(1u, olb->tracker()->ejected_now());
+    // Every pick still succeeds (b and c carry the traffic).
+    SelectIn in;
+    for (int i = 0; i < 6; ++i) {
+        SelectOut out;
+        ASSERT_EQ(0, lb->SelectServer(in, &out));
+        EXPECT_TRUE(out.ptr->id() == b || out.ptr->id() == c);
+    }
+    Socket::SetFailedById(a);
+    Socket::SetFailedById(b);
+    Socket::SetFailedById(c);
 }

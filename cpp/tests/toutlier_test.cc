@@ -1,0 +1,453 @@
+// Outlier-detection tier (trpc/outlier.h): tracker state machine
+// (eject -> probe -> ramp -> healthy), median-relative latency detector
+// (uniform slowness ejects nobody), ejection-budget vetoes, revive
+// routing, the hedge-delay starvation refresh (trpc/hedge_model.h) and
+// the grey-failure chaos kinds (slow_node / error_rate at the kHandler
+// seam). Pb-free: everything here drives the detectors directly, no
+// channels or sockets — it also links into the toolchain-less
+// standalone runner (see .claude/skills/verify/SKILL.md, Round 23).
+#include <unistd.h>
+
+#include <string>
+
+#include "tbase/endpoint.h"
+#include "tbase/errno.h"
+#include "tbase/flags.h"
+#include "tbase/time.h"
+#include "tnet/fault_injection.h"
+#include "trpc/hedge_model.h"
+#include "trpc/outlier.h"
+#include "ttest/ttest.h"
+
+using namespace tpurpc;
+
+DECLARE_bool(outlier_detection_enabled);
+DECLARE_int32(outlier_consecutive_errors);
+DECLARE_int32(outlier_check_interval_ms);
+DECLARE_int32(outlier_latency_ratio_pct);
+DECLARE_int32(outlier_latency_mad_k);
+DECLARE_int32(outlier_min_delta_us);
+DECLARE_int32(outlier_min_samples);
+DECLARE_int32(outlier_max_ejection_pct);
+DECLARE_int32(outlier_ejection_ms);
+DECLARE_int32(outlier_max_ejection_window_ms);
+DECLARE_int32(outlier_probe_interval_ms);
+DECLARE_int32(outlier_probe_passes);
+DECLARE_int32(outlier_rampup_ms);
+DECLARE_bool(chaos_enabled);
+DECLARE_int64(chaos_seed);
+DECLARE_string(chaos_plan);
+DECLARE_string(chaos_peers);
+
+namespace {
+
+// Suites share the runner binary: every test leaves the outlier flags
+// at their compiled defaults and the process chaos-free.
+struct FlagsReset {
+    ~FlagsReset() {
+        FLAGS_outlier_detection_enabled.set(true);
+        FLAGS_outlier_consecutive_errors.set(5);
+        FLAGS_outlier_check_interval_ms.set(250);
+        FLAGS_outlier_latency_ratio_pct.set(300);
+        FLAGS_outlier_latency_mad_k.set(4);
+        FLAGS_outlier_min_delta_us.set(5000);
+        FLAGS_outlier_min_samples.set(8);
+        FLAGS_outlier_max_ejection_pct.set(40);
+        FLAGS_outlier_ejection_ms.set(2000);
+        FLAGS_outlier_max_ejection_window_ms.set(60000);
+        FLAGS_outlier_probe_interval_ms.set(200);
+        FLAGS_outlier_probe_passes.set(3);
+        FLAGS_outlier_rampup_ms.set(3000);
+        FLAGS_chaos_plan.set("");
+        FLAGS_chaos_peers.set("");
+        FLAGS_chaos_seed.set(1);
+        FLAGS_chaos_enabled.set(false);
+    }
+};
+
+ServerNode MakeNode(SocketId id, int port) {
+    ServerNode n;
+    n.id = id;
+    char buf[32];
+    snprintf(buf, sizeof(buf), "10.0.0.%d:%d", (int)id + 1, port);
+    str2endpoint(buf, &n.ep);
+    return n;
+}
+
+// Drive one backend's EWMA to ~target: the first sample seeds it
+// exactly, repeats keep it there while accumulating `samples`.
+void FeedLatency(outlier::OutlierTracker* t, SocketId id, int64_t us,
+                 int n) {
+    for (int i = 0; i < n; ++i) t->Feed(id, us, 0);
+}
+
+int HardError() { return ECONNRESET; }
+
+}  // namespace
+
+TEST(Outlier, ConsecutiveErrorsEject) {
+    FlagsReset reset;
+    FLAGS_outlier_consecutive_errors.set(3);
+    outlier::OutlierTracker t("ut-consecutive");
+    for (SocketId id = 0; id < 3; ++id) t.AddServer(MakeNode(id, 8000));
+    ASSERT_EQ(t.size(), 3u);
+    EXPECT_TRUE(t.all_healthy());
+
+    const int64_t ejections0 = outlier::ejections();
+    // Two hard errors arm the trigger; a success disarms it.
+    t.Feed(1, 1000, HardError());
+    t.Feed(1, 1000, HardError());
+    t.Feed(1, 1000, 0);
+    EXPECT_EQ(t.StateOf(1), outlier::State::kHealthy);
+    // Three in a row eject.
+    t.Feed(1, 1000, HardError());
+    t.Feed(1, 1000, HardError());
+    t.Feed(1, 1000, HardError());
+    EXPECT_EQ(t.StateOf(1), outlier::State::kEjected);
+    EXPECT_TRUE(t.IsEjected(1));
+    EXPECT_FALSE(t.all_healthy());
+    EXPECT_EQ(t.ejected_now(), 1u);
+    EXPECT_EQ(outlier::ejections(), ejections0 + 1);
+
+    // The pick gate skips it and hands back the span-annotation note.
+    std::string note;
+    EXPECT_EQ(t.OnPick(1, &note), outlier::OutlierTracker::Verdict::kSkip);
+    EXPECT_NE(note.find("consecutive errors"), std::string::npos);
+    EXPECT_EQ(t.OnPick(0, &note), outlier::OutlierTracker::Verdict::kAllow);
+
+    outlier::BackendSnapshot snap;
+    ASSERT_TRUE(t.Snapshot(1, &snap));
+    EXPECT_EQ(snap.reason, outlier::Reason::kConsecutiveErrors);
+    EXPECT_EQ(snap.eject_count, 1);
+    EXPECT_GT(snap.ejected_for_ms, 0);
+}
+
+TEST(Outlier, OverloadPushbackNeverEjects) {
+    FlagsReset reset;
+    FLAGS_outlier_consecutive_errors.set(3);
+    outlier::OutlierTracker t("ut-overload");
+    for (SocketId id = 0; id < 3; ++id) t.AddServer(MakeNode(id, 8010));
+    // TERR_OVERLOAD is admission pushback, not grey failure: feeding it
+    // forever must not trip the consecutive-error detector.
+    for (int i = 0; i < 50; ++i) t.Feed(1, 1000, TERR_OVERLOAD);
+    EXPECT_EQ(t.StateOf(1), outlier::State::kHealthy);
+    EXPECT_TRUE(t.all_healthy());
+}
+
+TEST(Outlier, UniformSlownessEjectsNobody) {
+    FlagsReset reset;
+    FLAGS_outlier_check_interval_ms.set(0);  // sweep on every feed
+    outlier::OutlierTracker t("ut-uniform");
+    for (SocketId id = 0; id < 5; ++id) t.AddServer(MakeNode(id, 8020));
+    // The whole mesh is slow the same way: the median moves with it,
+    // k*MAD finds no outlier, nobody is ejected.
+    for (SocketId id = 0; id < 5; ++id) {
+        FeedLatency(&t, id, 50000 + (int64_t)id * 200, 12);
+    }
+    EXPECT_TRUE(t.all_healthy());
+    EXPECT_EQ(t.ejected_now(), 0u);
+
+    // One backend drifts to many multiples of the live median: only IT
+    // is ejected, with the ratio recorded for the span annotation.
+    FeedLatency(&t, 2, 400000, 12);
+    EXPECT_EQ(t.StateOf(2), outlier::State::kEjected);
+    EXPECT_EQ(t.ejected_now(), 1u);
+    outlier::BackendSnapshot snap;
+    ASSERT_TRUE(t.Snapshot(2, &snap));
+    EXPECT_EQ(snap.reason, outlier::Reason::kLatencyOutlier);
+    EXPECT_GE(snap.ratio_x100, FLAGS_outlier_latency_ratio_pct.get());
+    std::string note;
+    EXPECT_EQ(t.OnPick(2, &note), outlier::OutlierTracker::Verdict::kSkip);
+    EXPECT_NE(note.find("latency outlier"), std::string::npos);
+    for (SocketId id = 0; id < 5; ++id) {
+        if (id != 2) EXPECT_EQ(t.StateOf(id), outlier::State::kHealthy);
+    }
+}
+
+TEST(Outlier, EjectionBudgetVetoes) {
+    FlagsReset reset;
+    FLAGS_outlier_consecutive_errors.set(3);
+    FLAGS_outlier_max_ejection_pct.set(40);
+    outlier::OutlierTracker t("ut-budget");
+    for (SocketId id = 0; id < 3; ++id) t.AddServer(MakeNode(id, 8030));
+    // 40% of 3 backends floors at one ejection. The first goes out...
+    for (int i = 0; i < 3; ++i) t.Feed(0, 1000, HardError());
+    ASSERT_EQ(t.StateOf(0), outlier::State::kEjected);
+    // ...the second is vetoed no matter how sick it looks, and the veto
+    // re-arms the trigger instead of re-proposing every feedback.
+    const int64_t ejections0 = outlier::ejections();
+    for (int i = 0; i < 9; ++i) t.Feed(1, 1000, HardError());
+    EXPECT_EQ(t.StateOf(1), outlier::State::kHealthy);
+    EXPECT_EQ(t.ejected_now(), 1u);
+    EXPECT_EQ(outlier::ejections(), ejections0);
+    outlier::BackendSnapshot snap;
+    ASSERT_TRUE(t.Snapshot(1, &snap));
+    EXPECT_LT(snap.consecutive_errors, 3);  // trigger was reset
+}
+
+TEST(Outlier, SubsetFloorVetoesFirstEjection) {
+    FlagsReset reset;
+    FLAGS_outlier_consecutive_errors.set(3);
+    FLAGS_outlier_max_ejection_pct.set(100);
+    outlier::OutlierTracker t("ut-floor");
+    for (SocketId id = 0; id < 3; ++id) t.AddServer(MakeNode(id, 8040));
+    // The naming layer's subset floor: never leave fewer than 3 backends
+    // un-ejected -> with exactly 3 members even the FIRST eject is
+    // vetoed.
+    t.set_min_unejected(3);
+    for (int i = 0; i < 6; ++i) t.Feed(2, 1000, HardError());
+    EXPECT_EQ(t.StateOf(2), outlier::State::kHealthy);
+    EXPECT_EQ(t.ejected_now(), 0u);
+    EXPECT_TRUE(t.all_healthy());
+}
+
+TEST(Outlier, ProbeRampReinstatement) {
+    FlagsReset reset;
+    FLAGS_outlier_consecutive_errors.set(3);
+    FLAGS_outlier_ejection_ms.set(30);
+    FLAGS_outlier_probe_interval_ms.set(1);
+    FLAGS_outlier_probe_passes.set(2);
+    FLAGS_outlier_rampup_ms.set(40);
+    outlier::OutlierTracker t("ut-probe");
+    for (SocketId id = 0; id < 3; ++id) t.AddServer(MakeNode(id, 8050));
+    for (int i = 0; i < 3; ++i) t.Feed(1, 1000, HardError());
+    ASSERT_EQ(t.StateOf(1), outlier::State::kEjected);
+
+    // Inside the window: no probe is due.
+    EXPECT_EQ(t.ProbeCandidate(monotonic_time_us()), INVALID_VREF_ID);
+    usleep(40 * 1000);  // window expires
+    // Window expiry moves it to PROBING and nominates it for ONE
+    // diverted real RPC...
+    ASSERT_EQ(t.ProbeCandidate(monotonic_time_us()), (SocketId)1);
+    EXPECT_EQ(t.StateOf(1), outlier::State::kProbing);
+    // ...but normal picks still skip it.
+    EXPECT_EQ(t.OnPick(1, nullptr),
+              outlier::OutlierTracker::Verdict::kSkip);
+    // The probe interval gates the next nomination.
+    EXPECT_EQ(t.ProbeCandidate(monotonic_time_us()), INVALID_VREF_ID);
+
+    const int64_t reinstatements0 = outlier::reinstatements();
+    t.Feed(1, 500, 0);  // probe 1 passes
+    EXPECT_EQ(t.StateOf(1), outlier::State::kProbing);
+    usleep(2 * 1000);
+    ASSERT_EQ(t.ProbeCandidate(monotonic_time_us()), (SocketId)1);
+    t.Feed(1, 500, 0);  // probe 2 passes -> reinstated, ramping
+    EXPECT_EQ(t.StateOf(1), outlier::State::kRamping);
+    EXPECT_EQ(outlier::reinstatements(), reinstatements0 + 1);
+    EXPECT_EQ(t.ejected_now(), 0u);  // ramping takes normal traffic
+
+    // Slow start: early in the ramp some picks are skipped; once the
+    // window elapses a pick graduates it to HEALTHY.
+    int allowed = 0, skipped = 0;
+    for (int i = 0; i < 200; ++i) {
+        if (t.OnPick(1, nullptr) ==
+            outlier::OutlierTracker::Verdict::kAllow) {
+            ++allowed;
+        } else {
+            ++skipped;
+        }
+    }
+    EXPECT_GT(allowed, 0);  // admission is floored at 10%
+    usleep(45 * 1000);  // past the ramp window
+    EXPECT_EQ(t.OnPick(1, nullptr),
+              outlier::OutlierTracker::Verdict::kAllow);
+    EXPECT_EQ(t.StateOf(1), outlier::State::kHealthy);
+    EXPECT_TRUE(t.all_healthy());
+}
+
+TEST(Outlier, ReinstatementForgetsGreyHistory) {
+    FlagsReset reset;
+    FLAGS_outlier_check_interval_ms.set(0);  // sweep on every feed
+    FLAGS_outlier_ejection_ms.set(30);
+    FLAGS_outlier_probe_interval_ms.set(1);
+    FLAGS_outlier_probe_passes.set(2);
+    FLAGS_outlier_rampup_ms.set(40);
+    outlier::OutlierTracker t("ut-fresh");
+    for (SocketId id = 0; id < 4; ++id) t.AddServer(MakeNode(id, 8070));
+    for (SocketId id = 0; id < 3; ++id) FeedLatency(&t, id, 1000, 12);
+    // The grey phase poisons the EWMA far above the live median.
+    FeedLatency(&t, 3, 80000, 12);
+    ASSERT_EQ(t.StateOf(3), outlier::State::kEjected);
+    const int64_t ejections0 = outlier::ejections();
+
+    usleep(40 * 1000);  // window expires -> probing
+    ASSERT_EQ(t.ProbeCandidate(monotonic_time_us()), (SocketId)3);
+    t.Feed(3, 900, 0);  // probe 1 passes (the node healed)
+    usleep(2 * 1000);
+    ASSERT_EQ(t.ProbeCandidate(monotonic_time_us()), (SocketId)3);
+    t.Feed(3, 900, 0);  // probe 2 passes -> reinstated, ramping
+    ASSERT_EQ(t.StateOf(3), outlier::State::kRamping);
+
+    // Fresh healthy samples re-earn min_samples. The grey-era EWMA is
+    // forgotten at reinstatement, so the sweep judges ~900us — not an
+    // alpha-1/8 decay tail of 80ms that would re-eject the healed node
+    // onto a DOUBLED relapse window it sits out for most of a run.
+    FeedLatency(&t, 3, 900, 12);
+    EXPECT_NE(t.StateOf(3), outlier::State::kEjected);
+    EXPECT_EQ(outlier::ejections(), ejections0);
+    usleep(45 * 1000);  // past the ramp window
+    EXPECT_EQ(t.OnPick(3, nullptr),
+              outlier::OutlierTracker::Verdict::kAllow);
+    EXPECT_EQ(t.StateOf(3), outlier::State::kHealthy);
+}
+
+TEST(Outlier, ProbeFailRelapseDoublesWindow) {
+    FlagsReset reset;
+    FLAGS_outlier_consecutive_errors.set(3);
+    FLAGS_outlier_ejection_ms.set(30);
+    FLAGS_outlier_probe_interval_ms.set(1);
+    outlier::OutlierTracker t("ut-relapse");
+    for (SocketId id = 0; id < 3; ++id) t.AddServer(MakeNode(id, 8060));
+    for (int i = 0; i < 3; ++i) t.Feed(1, 1000, HardError());
+    ASSERT_EQ(t.StateOf(1), outlier::State::kEjected);
+    usleep(40 * 1000);
+    ASSERT_EQ(t.ProbeCandidate(monotonic_time_us()), (SocketId)1);
+    const int64_t probe_fails0 = outlier::probe_fails();
+    t.Feed(1, 1000, HardError());  // probe fails -> relapse
+    EXPECT_EQ(t.StateOf(1), outlier::State::kEjected);
+    EXPECT_EQ(outlier::probe_fails(), probe_fails0 + 1);
+    outlier::BackendSnapshot snap;
+    ASSERT_TRUE(t.Snapshot(1, &snap));
+    EXPECT_EQ(snap.eject_count, 2);
+    // The relapse window doubled (base 30ms -> 60ms).
+    EXPECT_GT(snap.ejected_for_ms, 35);
+}
+
+TEST(Outlier, ReviveRoutesThroughProbeRamp) {
+    FlagsReset reset;
+    FLAGS_outlier_consecutive_errors.set(3);
+    FLAGS_outlier_ejection_ms.set(60000);  // window would hold for ages
+    FLAGS_outlier_probe_interval_ms.set(1);
+    outlier::OutlierTracker t("ut-revive");
+    for (SocketId id = 0; id < 3; ++id) t.AddServer(MakeNode(id, 8070));
+    for (int i = 0; i < 3; ++i) t.Feed(1, 1000, HardError());
+    ASSERT_EQ(t.StateOf(1), outlier::State::kEjected);
+    // The health-check revive (satellite fix): the transport came back,
+    // so skip the remaining window — but re-enter through PROBING, not
+    // at full weight.
+    t.OnRevive(1);
+    EXPECT_EQ(t.StateOf(1), outlier::State::kProbing);
+    EXPECT_EQ(t.OnPick(1, nullptr),
+              outlier::OutlierTracker::Verdict::kSkip);
+    EXPECT_EQ(t.ProbeCandidate(monotonic_time_us()), (SocketId)1);
+}
+
+TEST(Outlier, DisabledFlagIsNoop) {
+    FlagsReset reset;
+    FLAGS_outlier_detection_enabled.set(false);
+    outlier::OutlierTracker t("ut-disabled");
+    for (SocketId id = 0; id < 3; ++id) t.AddServer(MakeNode(id, 8080));
+    for (int i = 0; i < 50; ++i) t.Feed(1, 1000, HardError());
+    EXPECT_EQ(t.StateOf(1), outlier::State::kHealthy);
+    EXPECT_EQ(t.OnPick(1, nullptr),
+              outlier::OutlierTracker::Verdict::kAllow);
+    EXPECT_EQ(t.ProbeCandidate(monotonic_time_us()), INVALID_VREF_ID);
+}
+
+// ---- hedge-delay starvation refresh (tools/tpu_router.cc bugfix) ----
+
+TEST(HedgeModel, CleanFeedOwnsTheEstimate) {
+    HedgeDelayModel m;
+    int64_t now = 1000000;
+    m.FeedClean(8000, now);
+    EXPECT_EQ(m.ewma_p99_us(), 8000);
+    // EWMA alpha 1/8.
+    m.FeedClean(16000, now + 1000);
+    EXPECT_EQ(m.ewma_p99_us(), 9000);
+    // A hedged completion right after a clean sample teaches NOTHING —
+    // hedge-truncated latencies must not feed back into the delay.
+    EXPECT_FALSE(m.FeedHedged(500000, now + 2000));
+    EXPECT_EQ(m.ewma_p99_us(), 9000);
+    EXPECT_EQ(m.starved_refreshes(), 0);
+}
+
+TEST(HedgeModel, StarvedRaiseOnlyRefresh) {
+    HedgeDelayModel m;
+    int64_t now = 1000000;
+    m.FeedClean(8000, now);
+    // THE regression: backend slows past the delay, every forward gets
+    // hedged, no clean sample arrives for >= kStarvedRefreshUs. Before
+    // the fix the estimate froze at 8ms and the router hedged 100% of
+    // traffic forever. Now a hedged completion may RAISE the estimate.
+    now += HedgeDelayModel::kStarvedRefreshUs + 1;
+    // Raise-only: a hedged elapsed below the estimate still teaches
+    // nothing even when starved.
+    EXPECT_FALSE(m.FeedHedged(4000, now));
+    EXPECT_EQ(m.ewma_p99_us(), 8000);
+    EXPECT_TRUE(m.FeedHedged(80000, now));
+    EXPECT_GT(m.ewma_p99_us(), 8000);
+    EXPECT_EQ(m.starved_refreshes(), 1);
+    // Clean completions resume ownership and reset the starvation clock:
+    // the very next hedged completion is ignored again.
+    m.FeedClean(10000, now + 1000);
+    EXPECT_FALSE(m.FeedHedged(500000, now + 2000));
+}
+
+TEST(HedgeModel, DelayFlooredForColdCallers) {
+    HedgeDelayModel m;
+    // No samples: the floor alone drives (a cold caller hedges only
+    // calls already slower than the floor).
+    EXPECT_EQ(m.DelayMs(150, 30), 30);
+    m.FeedClean(100000, 1);  // 100ms p99
+    EXPECT_EQ(m.DelayMs(150, 30), 150);
+    EXPECT_EQ(m.DelayMs(150, 200), 200);
+}
+
+// ---- grey-failure chaos kinds (kHandler seam) ----
+
+TEST(GreyChaos, HandlerPlanValidates) {
+    EXPECT_TRUE(FaultInjection::ValidatePlan("slow_node=1:80"));
+    EXPECT_TRUE(
+        FaultInjection::ValidatePlan("slow_node=1:80,error_rate=0.05"));
+    EXPECT_TRUE(FaultInjection::ValidatePlan("error_rate=0.25"));
+    EXPECT_FALSE(FaultInjection::ValidatePlan("error_rate=1.5"));
+    EXPECT_FALSE(FaultInjection::ValidatePlan("slow_node=0.5:junk"));
+    // error_rate carries no parameter.
+    EXPECT_FALSE(FaultInjection::ValidatePlan("error_rate=0.5:123"));
+}
+
+TEST(GreyChaos, SlowNodeAndErrorRateAtHandlerSeam) {
+    FlagsReset reset;
+    EndPoint peer;
+    str2endpoint("127.0.0.1:7001", &peer);
+    FLAGS_chaos_plan.set("slow_node=1:80,error_rate=0.25");
+    // The handler seam is NOT peer-filtered: the plan runs ON the grey
+    // server, whose peers are its clients, not chaos_peers targets. A
+    // filter naming someone else must not shield the seam.
+    FLAGS_chaos_peers.set("10.9.9.9:9999");
+    FLAGS_chaos_seed.set(20260807);
+    FLAGS_chaos_enabled.set(true);
+    ASSERT_TRUE(fault_injection_enabled());
+
+    int fails = 0, delays = 0, none = 0;
+    for (int i = 0; i < 2000; ++i) {
+        const FaultAction a =
+            FaultInjection::Decide(FaultOp::kHandler, peer, 128);
+        if (a.kind == FaultAction::kFail) {
+            ++fails;
+        } else if (a.kind == FaultAction::kDelay) {
+            EXPECT_EQ(a.delay_us, 80 * 1000);
+            ++delays;
+        } else {
+            ++none;
+        }
+    }
+    // error_rate draws FIRST (a grey node errors instead of answering
+    // slowly), so even with slow_node=1.0 the failures still land at
+    // ~25%; everything else is delayed.
+    EXPECT_GT(fails, 2000 / 4 / 2);
+    EXPECT_LT(fails, 2000 / 2);
+    EXPECT_EQ(none, 0);
+    EXPECT_EQ(delays, 2000 - fails);
+
+    // Deterministic replay: re-applying the seed restarts the sequence.
+    FLAGS_chaos_seed.set(20260807);
+    int fails2 = 0;
+    for (int i = 0; i < 2000; ++i) {
+        if (FaultInjection::Decide(FaultOp::kHandler, peer, 128).kind ==
+            FaultAction::kFail) {
+            ++fails2;
+        }
+    }
+    EXPECT_EQ(fails, fails2);
+}

@@ -33,6 +33,7 @@
 #include "tnet/socket.h"
 #include "trpc/collective.h"
 #include "trpc/load_balancer.h"
+#include "trpc/outlier.h"
 #include "trpc/stream.h"
 #include "trpc/rpcz_stitch.h"
 #include "trpc/server.h"
@@ -85,6 +86,10 @@ void HandleIndex(Server*, const HttpRequest&, HttpResponse* res) {
         "              (?format=json machine form)\n"
         "/streams      push-stream tier: rpc_stream_* counters, replay-\n"
         "              ring high-water, live server/client stream rows\n"
+        "              (?format=json machine form)\n"
+        "/outliers     client-side outlier ejection: per-backend state\n"
+        "              (healthy/ejected/probing/ramping), latency EWMAs,\n"
+        "              ejection reasons + windows, probe progress\n"
         "              (?format=json machine form)\n"
         "/metrics      prometheus exposition\n");
 }
@@ -797,6 +802,21 @@ void HandleStreams(Server*, const HttpRequest& req, HttpResponse* res) {
     res->Append(push_stream::DescribeText());
 }
 
+// /outliers: the outlier-ejection tier (ISSUE 20) — one section per
+// client LB in this process, one row per backend: state, latency EWMA,
+// ejection reason + remaining window, probe progress. The grey-node
+// soak asserts on ?format=json; the text form is for humans asking
+// "why did traffic move off that node".
+void HandleOutliers(Server*, const HttpRequest& req, HttpResponse* res) {
+    if (req.QueryParam("format") == "json") {
+        res->set_content_type("application/json");
+        res->Append(outlier::DescribeAllJson());
+        return;
+    }
+    res->set_content_type("text/plain");
+    res->Append(outlier::DescribeAll());
+}
+
 void HandleTenants(Server* server, const HttpRequest& req,
                    HttpResponse* res) {
     if (req.QueryParam("format") == "json") {
@@ -831,6 +851,7 @@ void AddBuiltinHttpServices(Server* server) {
     CollectiveEngine::ExposeVars();
     ExposeZoneLbVars();
     flight::ExposeVars();
+    outlier::ExposeVars();
     server->RegisterHttpHandler("/", HandleIndex);
     server->RegisterHttpHandler("/health", HandleHealth);
     server->RegisterHttpHandler("/status", HandleStatus);
@@ -857,6 +878,7 @@ void AddBuiltinHttpServices(Server* server) {
     server->RegisterHttpHandler("/blackbox", HandleBlackbox);
     server->RegisterHttpHandler("/pools", HandlePools);
     server->RegisterHttpHandler("/streams", HandleStreams);
+    server->RegisterHttpHandler("/outliers", HandleOutliers);
     server->RegisterHttpHandler("/metrics", HandleMetrics);
 }
 

@@ -49,7 +49,13 @@ DEFINE_string(chaos_plan, "",
               "and crash (ISSUE 19: a ticked decision kills the process "
               "with a real SIGSEGV so the flight recorder's black-box "
               "signal path fires); "
-              "e.g. 'drop=0.01,delay=0.05:2000,cost_inflate=1:8'");
+              "and the grey-failure handler seam (ISSUE 20): slow_node "
+              "(param = MILLISECONDS, default 50: inflate service time "
+              "at handler dispatch — the node stays healthy to connect "
+              "probes, only slower) / error_rate (answer the call with a "
+              "synthetic retriable failure without running the handler); "
+              "e.g. 'drop=0.01,delay=0.05:2000,cost_inflate=1:8' or "
+              "'slow_node=1:80,error_rate=0.05'");
 DEFINE_string(chaos_peers, "",
               "comma list of ip:port remote endpoints the plan applies "
               "to; empty = all peers. Non-matching traffic neither "
@@ -86,7 +92,8 @@ inline double to_unit(uint64_t r) {
 // /chaos page lines — one table so they can never desynchronize).
 const char* const kKindNames[FaultAction::kKindCount] = {
     "none",    "delay",  "short",       "drop",         "corrupt",
-    "reset",   "refuse", "stale_epoch", "cost_inflate", "crash"};
+    "reset",   "refuse", "stale_epoch", "cost_inflate", "crash",
+    "fail"};
 
 struct FaultPlan {
     // Read/write fault probabilities (selected by one uniform draw over
@@ -124,11 +131,19 @@ struct FaultPlan {
     // the process with a genuine SIGSEGV — the flight recorder's
     // fatal-signal black-box path is the thing under test.
     double crash = 0.0;
+    // Grey-failure handler seam (ISSUE 20): inflate service time
+    // (slow_node, param in MILLISECONDS — grey degradation lives on the
+    // handler timescale, not the I/O one) and/or answer calls with a
+    // synthetic retriable failure without running the handler
+    // (error_rate). Connection health stays perfect either way.
+    double slow_node = 0.0;
+    double error_rate = 0.0;
     int64_t delay_us = 2000;
     int64_t ring_delay_us = 2000;
     int64_t cost_inflate_mult = 10;
     int64_t stream_stall_us = 5000;
     int64_t doorbell_delay_us = 2000;
+    int64_t slow_node_us = 50000;  // param is ms; stored as us (50ms default)
     std::vector<EndPoint> peers;  // empty = every peer
     // Zone partition (ISSUE 14): all traffic to peers of this zone is
     // cut. Lives in the doubly-buffered plan so the hot path reads it
@@ -225,7 +240,8 @@ bool ParsePlan(const std::string& text, FaultPlan* plan) {
         // (the /chaos page promises validate-before-mutate).
         if (!param_str.empty() && kind != "delay" &&
             kind != "ring_delay" && kind != "cost_inflate" &&
-            kind != "stream_stall" && kind != "doorbell_delay") {
+            kind != "stream_stall" && kind != "doorbell_delay" &&
+            kind != "slow_node") {
             return false;
         }
         const auto parse_us = [&](int64_t* out) {
@@ -277,6 +293,14 @@ bool ParsePlan(const std::string& text, FaultPlan* plan) {
             if (!parse_us(&plan->doorbell_delay_us)) return false;
         } else if (kind == "crash") {
             plan->crash = prob;
+        } else if (kind == "slow_node") {
+            // Param is MILLISECONDS (handler timescale) — stored as us.
+            plan->slow_node = prob;
+            int64_t ms = 50;
+            if (!parse_us(&ms)) return false;
+            plan->slow_node_us = ms * 1000;
+        } else if (kind == "error_rate") {
+            plan->error_rate = prob;
         } else {
             return false;
         }
@@ -428,8 +452,12 @@ FaultAction FaultInjection::Decide(FaultOp op, const EndPoint& peer,
     // decisions bypass the filter. The verb plane is keyed by socket/
     // window ids, not endpoints (posts carry no EndPoint), so verb and
     // doorbell decisions bypass it too.
+    // The handler seam bypasses it as well: the grey-node plan is
+    // applied ON the degraded server, whose peers at dispatch time are
+    // clients — not the targets a chaos_peers list names.
     if (op != FaultOp::kRingComplete && op != FaultOp::kVerbPost &&
-        op != FaultOp::kCqComplete && !p->Matches(peer)) {
+        op != FaultOp::kCqComplete && op != FaultOp::kHandler &&
+        !p->Matches(peer)) {
         return action;
     }
     const uint64_t n = e.seq.fetch_add(1, std::memory_order_relaxed);
@@ -510,6 +538,18 @@ FaultAction FaultInjection::Decide(FaultOp op, const EndPoint& peer,
         if (u < p->doorbell_delay) {
             action.kind = FaultAction::kDelay;
             action.delay_us = p->doorbell_delay_us;
+        }
+    } else if (op == FaultOp::kHandler) {
+        // Grey-failure dispatch seam (ISSUE 20). error_rate FIRST in the
+        // cumulative draw: a soak's slow_node=1 (every call slow) must
+        // not absorb the error slice — 'error_rate=0.05,slow_node=1:80'
+        // means 5% fail, 95% slow, exactly as written.
+        double acc = 0.0;
+        if (u < (acc += p->error_rate)) {
+            action.kind = FaultAction::kFail;
+        } else if (u < (acc += p->slow_node)) {
+            action.kind = FaultAction::kDelay;
+            action.delay_us = p->slow_node_us;
         }
     } else {
         double acc = 0.0;

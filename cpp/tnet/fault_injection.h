@@ -66,6 +66,16 @@ enum class FaultOp {
     // endpoints.
     kVerbPost = 9,
     kCqComplete = 10,
+    // Grey-failure seam (ISSUE 20): consulted at handler dispatch, after
+    // admission, before the user method runs. slow_node=prob[:ms] ->
+    // kDelay inflates service time (the node is SLOW, not dead: connect
+    // probes still pass, health checks stay green — only the outlier
+    // tier can see it). error_rate=prob -> kFail answers the call with a
+    // synthetic failure without running the handler. Not peer-filtered:
+    // the plan is applied ON the degraded server itself, and its peers
+    // at this seam are clients, not the targets a chaos_peers list
+    // names.
+    kHandler = 11,
 };
 
 // What the consulting seam should do.
@@ -92,6 +102,11 @@ struct FaultAction {
         // Never returned to a seam; the sentinel below stays the counter
         // array size.
         kCrash,
+        // Synthetic handler failure (kHandler only, ISSUE 20): the call
+        // is answered with a retriable error without running the user
+        // method — a grey node that computes wrong/errors, yet whose
+        // connection-level health stays perfect.
+        kFail,
         kKindCount  // sentinel (counter array size)
     };
     Kind kind = kNone;

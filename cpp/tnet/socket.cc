@@ -181,10 +181,15 @@ int Socket::Create(const SocketOptions& options, SocketId* id) {
 
 namespace {
 std::atomic<Socket::FailureObserver> g_failure_observer{nullptr};
+std::atomic<Socket::ReviveObserver> g_revive_observer{nullptr};
 }  // namespace
 
 void Socket::set_failure_observer(FailureObserver ob) {
     g_failure_observer.store(ob, std::memory_order_release);
+}
+
+void Socket::set_revive_observer(ReviveObserver ob) {
+    g_revive_observer.store(ob, std::memory_order_release);
 }
 
 void Socket::OnFailed() {
@@ -345,6 +350,10 @@ int Socket::ReviveAfterHealthCheck() {
         *g_hc_revives << 1;
         LOG(INFO) << "Revived socket id=" << id()
                   << " remote=" << endpoint2str(remote_side_);
+        // After the slot is LIVE: an ejected backend must re-enter via
+        // the outlier probe ramp, not at full weight.
+        ReviveObserver ob = g_revive_observer.load(std::memory_order_acquire);
+        if (ob != nullptr) ob(id());
     }
     return rc;
 }

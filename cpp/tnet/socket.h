@@ -124,6 +124,13 @@ public:
     // arbitrary locks — it must not run user code inline.
     using FailureObserver = void (*)(SocketId);
     static void set_failure_observer(FailureObserver ob);
+    // Process-wide revive observer, invoked from ReviveAfterHealthCheck
+    // after the socket is usable again (draining cleared, breaker reset).
+    // Lets the outlier tier re-enter a revived-but-previously-ejected
+    // backend through its probe ramp instead of at full weight: the
+    // health probe only proves the process answers, not that it is fast.
+    using ReviveObserver = void (*)(SocketId);
+    static void set_revive_observer(ReviveObserver ob);
     // Stop the revive loop (set when the naming layer removes this server
     // for good; the health-check fiber then drops its ref and the socket
     // recycles).

@@ -719,6 +719,19 @@ void Controller::IssueRPC() {
             span_->Annotate("cross-zone spill to " +
                             endpoint2str(out.ptr->remote_side()));
         }
+        if (out.skipped_ejected && span_ != nullptr) {
+            // An ejected outlier was passed over (ISSUE 20): the note
+            // carries WHY ("ejected: latency outlier 8.2x median") so a
+            // trace reader sees the routing shift without the portal.
+            span_->Annotate(out.outlier_note.empty()
+                                ? "outlier ejected, re-routed"
+                                : out.outlier_note + ", re-routed");
+        }
+        if (out.outlier_probe && span_ != nullptr) {
+            // This call IS the reinstatement probe for an ejected node.
+            span_->Annotate("outlier reinstatement probe to " +
+                            endpoint2str(out.ptr->remote_side()));
+        }
         s = std::move(out.ptr);
         current_server_id_ = s->id();
         if (excluded_ == nullptr) excluded_ = new ExcludedServers;
@@ -1295,15 +1308,19 @@ void ProcessTpuStdResponse(TpuStdMessage* msg, const rpc::RpcMeta& meta) {
         // these terminal paths used to strand the pin).
         ack_dropped_descriptor();
         if (rmeta.error_code() == TERR_OVERLOAD ||
+            rmeta.error_code() == TERR_OVERCROWDED ||
             rmeta.error_code() == TERR_STALE_EPOCH) {
-            // The handler never ran — a priority-aware shed or an
-            // epoch fence refusing a stale zero-copy reference. Route
-            // through the ERROR funnel (we hold the id lock —
-            // HandleError's contract) so the standard retry machinery
-            // applies: budget token spent, backoff honored, LB
-            // re-selects via ExcludedServers; a stale-epoch re-issue
-            // re-arms the lease and restamps the current pool
-            // generation.
+            // The handler never ran — a priority-aware shed, a socket
+            // too crowded to enqueue the work, or an epoch fence
+            // refusing a stale zero-copy reference. Route through the
+            // ERROR funnel (we hold the id lock — HandleError's
+            // contract) so the standard retry machinery applies: budget
+            // token spent, backoff honored, LB re-selects via
+            // ExcludedServers; a stale-epoch re-issue re-arms the lease
+            // and restamps the current pool generation. Without the
+            // OVERCROWDED arm a server-side pushback that is_retryable
+            // says to retry was terminal anyway — a degraded node's
+            // refusals became lost completions instead of re-routes.
             if (rmeta.error_code() == TERR_OVERLOAD &&
                 rmeta.has_backoff_ms()) {
                 cntl->set_suggested_backoff_ms(rmeta.backoff_ms());

@@ -1,0 +1,83 @@
+// Adaptive hedge-delay model (ISSUE 20 bugfix; extracted from
+// tools/tpu_router.cc so the starvation path is unit-testable).
+//
+// The router hedges a forward when it outlives a per-(tenant,method)
+// delay derived from an EWMA of the key's windowed p99. Clean un-hedged
+// completions teach the EWMA; hedged completions are normally ignored —
+// a hedge-truncated latency would drag the p99 down and make hedging
+// self-amplifying.
+//
+// The bug that ignoring them unconditionally creates: when the backend
+// slows past the current delay, EVERY forward gets hedged, no clean
+// sample ever arrives, and the estimate is frozen at the stale (low)
+// value — the router hedges 100% of traffic forever, doubling load on a
+// mesh that is already slow. The fix is a RAISE-ONLY refresh: once the
+// model has been starved of clean samples for kStarvedRefreshUs, a
+// hedged completion's elapsed time (a lower bound on the un-hedged
+// latency — the first try had at least that long and hadn't answered,
+// or the answer took that long) may fold in, but only upward. The delay
+// grows until calls complete un-hedged again, at which point the clean
+// path resumes ownership.
+#pragma once
+
+#include <atomic>
+#include <cstdint>
+
+namespace tpurpc {
+
+class HedgeDelayModel {
+public:
+    // No clean sample for this long => hedged completions may refresh.
+    static constexpr int64_t kStarvedRefreshUs = 1000 * 1000;
+
+    // Clean un-hedged completion: fold the caller's current windowed p99
+    // into the EWMA (alpha 1/8) and reset the starvation clock.
+    void FeedClean(int64_t windowed_p99_us, int64_t now_us) {
+        last_clean_feed_us_.store(now_us, std::memory_order_relaxed);
+        if (windowed_p99_us <= 0) return;
+        const int64_t prev = ewma_p99_us_.load(std::memory_order_relaxed);
+        ewma_p99_us_.store(
+            prev == 0 ? windowed_p99_us : (prev * 7 + windowed_p99_us) / 8,
+            std::memory_order_relaxed);
+    }
+
+    // Hedged completion: no-op unless the model is starved AND the
+    // elapsed time would raise the estimate. Returns whether it taught.
+    bool FeedHedged(int64_t elapsed_us, int64_t now_us) {
+        if (elapsed_us <= 0) return false;
+        const int64_t last =
+            last_clean_feed_us_.load(std::memory_order_relaxed);
+        if (last != 0 && now_us - last < kStarvedRefreshUs) return false;
+        const int64_t prev = ewma_p99_us_.load(std::memory_order_relaxed);
+        if (elapsed_us <= prev) return false;  // raise-only
+        ewma_p99_us_.store(prev == 0 ? elapsed_us
+                                     : (prev * 7 + elapsed_us) / 8,
+                           std::memory_order_relaxed);
+        starved_refreshes_.fetch_add(1, std::memory_order_relaxed);
+        return true;
+    }
+
+    // The hedge delay: EWMA scaled by mult_pct, floored at floor_ms
+    // (with no samples yet the floor alone drives — a cold caller hedges
+    // only calls already slower than the floor).
+    int64_t DelayMs(int mult_pct, int floor_ms) const {
+        const int64_t derived_ms =
+            ewma_p99_us_.load(std::memory_order_relaxed) * mult_pct / 100 /
+            1000;
+        return derived_ms > floor_ms ? derived_ms : (int64_t)floor_ms;
+    }
+
+    int64_t ewma_p99_us() const {
+        return ewma_p99_us_.load(std::memory_order_relaxed);
+    }
+    int64_t starved_refreshes() const {
+        return starved_refreshes_.load(std::memory_order_relaxed);
+    }
+
+private:
+    std::atomic<int64_t> ewma_p99_us_{0};
+    std::atomic<int64_t> last_clean_feed_us_{0};
+    std::atomic<int64_t> starved_refreshes_{0};
+};
+
+}  // namespace tpurpc

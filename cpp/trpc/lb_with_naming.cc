@@ -225,6 +225,10 @@ int LoadBalancerWithNaming::Init(const std::string& naming_url,
         LOG(ERROR) << "unknown load balancer: " << lb_name;
         return -1;
     }
+    // The factory's outermost layer is the outlier wrapper (ISSUE 20):
+    // keep a typed handle so subset recomputes can feed its ejection
+    // floor (never eject below the per-zone subset minimum).
+    outlier_lb_ = static_cast<outlier::OutlierLoadBalancer*>(lb_.get());
     // Per-client rendezvous identity: every client fleet member draws a
     // DIFFERENT subset (that is what spreads load), unless a fixed
     // -subset_seed pins it for reproducibility.
@@ -315,6 +319,12 @@ void LoadBalancerWithNaming::ApplySubset(bool force_full) {
     const int eff_min = FLAGS_min_subset.get() > 0
                             ? FLAGS_min_subset.get()
                             : (k + 1) / 2;
+    // Outlier-ejection floor (ISSUE 20): the detectors may never hold
+    // more backends out of the pick set than would leave a zone's
+    // subset below its live minimum.
+    if (outlier_lb_ != nullptr) {
+        outlier_lb_->tracker()->set_min_unejected(k > 0 ? eff_min : 1);
+    }
     std::set<SocketId> desired;
     bool any_subsetted = false;
     for (auto& [zone, grp] : groups) {

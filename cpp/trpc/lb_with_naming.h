@@ -17,6 +17,7 @@
 
 #include "trpc/load_balancer.h"
 #include "trpc/naming_service.h"
+#include "trpc/outlier.h"
 
 namespace tpurpc {
 
@@ -121,6 +122,8 @@ private:
     void MaybeRefreshSubset(const SelectIn& in);
 
     std::unique_ptr<LoadBalancer> lb_;
+    // Typed view of lb_'s outermost (outlier) layer — owned by lb_.
+    outlier::OutlierLoadBalancer* outlier_lb_ = nullptr;
     std::shared_ptr<NamingServiceThread> ns_thread_;
     std::mutex servers_mu_;
     std::vector<SocketId> server_ids_;  // mirror for usable counting

@@ -18,6 +18,7 @@
 #include "tbase/flags.h"
 #include "tbase/logging.h"
 #include "tbase/time.h"
+#include "trpc/outlier.h"
 #include "tvar/reducer.h"
 
 // Pod identity of THIS process (ISSUE 14). Naming entries tagged with a
@@ -761,10 +762,14 @@ static LoadBalancer* NewPolicy(const std::string& name) {
 LoadBalancer* LoadBalancer::New(const std::string& name) {
     LoadBalancer* local = NewPolicy(name);
     if (local == nullptr) return nullptr;
-    // Always wrapped: the wrapper is a strict passthrough until a
+    // Always wrapped: the zone wrapper is a strict passthrough until a
     // cross-zone member shows up, and every policy gets the two-level
-    // zone pick for free — no per-policy zone forks (ISSUE 14).
-    return new ZoneAwareLoadBalancer(local, NewPolicy(name));
+    // zone pick for free — no per-policy zone forks (ISSUE 14). The
+    // outlier wrapper sits OUTERMOST (ISSUE 20): ejection skips and
+    // reinstatement probes compose over the zone fallback ordering,
+    // and cost one relaxed load while every backend is healthy.
+    return new outlier::OutlierLoadBalancer(
+        new ZoneAwareLoadBalancer(local, NewPolicy(name)));
 }
 
 }  // namespace tpurpc

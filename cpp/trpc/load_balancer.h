@@ -66,6 +66,15 @@ struct SelectOut {
     // Counted (rpc_lb_zone_spills) and span-annotated by the
     // controller.
     bool zone_spilled = false;
+    // Outlier tier (ISSUE 20): at least one EJECTED backend was passed
+    // over to pick `ptr` — a budget-free re-route like a draining skip.
+    // `outlier_note` carries the first skipped backend's ejection
+    // reason ("ejected: latency outlier 8.2x median") for the span.
+    bool skipped_ejected = false;
+    std::string outlier_note;
+    // `ptr` is a reinstatement probe diverted to an ejected backend
+    // whose window expired: one real rpc, deliberately routed there.
+    bool outlier_probe = false;
 };
 
 // A server as registered by the naming layer: stable socket id + weight
@@ -126,7 +135,9 @@ public:
     // "la"). Returns nullptr for unknown names. Every policy comes back
     // wrapped in the locality-zone layer (ZoneAwareLoadBalancer) — a
     // free passthrough until a ServerNode carries a zone different from
-    // this process's -rpc_zone.
+    // this process's -rpc_zone — and, outermost, in the outlier-
+    // ejection layer (OutlierLoadBalancer, ISSUE 20) — one relaxed
+    // load of passthrough while every backend is healthy.
     static LoadBalancer* New(const std::string& name);
 };
 

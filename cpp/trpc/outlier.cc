@@ -1,0 +1,745 @@
+// Outlier detection + ejection engine (ISSUE 20). See outlier.h for the
+// design; this file holds the detector math, the state machine, the
+// rpc_outlier_* families and the /outliers describers. Pb-free.
+#include "trpc/outlier.h"
+
+#include <algorithm>
+#include <cstdio>
+#include <vector>
+
+#include "tbase/errno.h"
+#include "tbase/flags.h"
+#include "tbase/flight_recorder.h"
+#include "tbase/logging.h"
+#include "tbase/time.h"
+#include "tnet/socket.h"
+#include "tvar/reducer.h"
+
+DEFINE_bool(outlier_detection_enabled, true,
+            "watch passive per-RPC feedback and eject grey backends "
+            "(slow/lossy but probe-alive) from the LB pick set");
+DEFINE_int32(outlier_consecutive_errors, 5,
+             "eject a backend after this many hard failures in a row");
+DEFINE_int32(outlier_check_interval_ms, 250,
+             "latency-outlier sweep cadence (median + MAD over the "
+             "live set's latency EWMAs)");
+DEFINE_int32(outlier_latency_ratio_pct, 300,
+             "latency ejection needs ewma >= this percent of the "
+             "live-set median (300 = 3x)");
+DEFINE_int32(outlier_latency_mad_k, 4,
+             "latency ejection needs ewma > median + k*MAD (scale-"
+             "relative guard: a uniformly slow mesh ejects nobody)");
+DEFINE_int32(outlier_min_delta_us, 5000,
+             "latency ejection needs ewma - median >= this many us "
+             "(absolute guard against microsecond-scale jitter)");
+DEFINE_int32(outlier_min_samples, 8,
+             "a backend needs this many feedbacks since its last state "
+             "change before the latency detector may judge it");
+DEFINE_int32(outlier_max_ejection_pct, 40,
+             "never hold more than this percent of a tracker's "
+             "backends out of the pick set at once");
+DEFINE_int32(outlier_ejection_ms, 2000,
+             "base ejection window; doubles per relapse");
+DEFINE_int32(outlier_max_ejection_window_ms, 60000,
+             "cap on the exponentially-growing ejection window");
+DEFINE_int32(outlier_probe_interval_ms, 200,
+             "after the window expires, divert one REAL rpc to the "
+             "backend at most this often");
+DEFINE_int32(outlier_probe_passes, 3,
+             "consecutive probe successes required before the "
+             "slow-start ramp re-admits the backend");
+DEFINE_int32(outlier_rampup_ms, 3000,
+             "slow-start window: pick admission probability ramps "
+             "0->100% over this span after probes pass");
+
+namespace tpurpc {
+namespace outlier {
+
+namespace {
+
+LazyAdder g_ejections("rpc_outlier_ejections");
+LazyAdder g_reinstatements("rpc_outlier_reinstatements");
+LazyAdder g_probe_passes("rpc_outlier_probe_passes");
+LazyAdder g_probe_fails("rpc_outlier_probe_fails");
+// Ejections the bounds vetoed (max pct / subset floor): a grey MAJORITY
+// stays routable even if individually eject-worthy.
+LazyAdder g_eject_vetoes("rpc_outlier_eject_vetoes");
+
+// Process-global tracker list: /outliers and the revive observer walk
+// every channel's tracker.
+std::mutex g_trackers_mu;
+std::vector<OutlierTracker*> g_trackers;
+
+uint64_t splitmix64(uint64_t x) {
+    x += 0x9e3779b97f4a7c15ULL;
+    x = (x ^ (x >> 30)) * 0xbf58476d1ce4e5b9ULL;
+    x = (x ^ (x >> 27)) * 0x94d049bb133111ebULL;
+    return x ^ (x >> 31);
+}
+
+// Flight-recorder identity of a backend: routable across dumps without
+// a cid (ip4 << 16 | port). blackbox_merge decodes it back.
+uint64_t PackEp(const EndPoint& ep) {
+    return ((uint64_t)ntohl(ep.ip.s_addr) << 16) |
+           ((uint64_t)ep.port & 0xFFFF);
+}
+
+void ReviveObserver(SocketId id) {
+    std::lock_guard<std::mutex> g(g_trackers_mu);
+    for (OutlierTracker* t : g_trackers) t->OnRevive(id);
+}
+
+int64_t EjectionWindowUs(int eject_count) {
+    const int64_t base_ms =
+        std::max<int64_t>(1, FLAGS_outlier_ejection_ms.get());
+    const int shift = std::min(eject_count > 0 ? eject_count - 1 : 0, 16);
+    const int64_t ms = std::min<int64_t>(
+        base_ms << shift,
+        std::max<int64_t>(base_ms,
+                          FLAGS_outlier_max_ejection_window_ms.get()));
+    return ms * 1000;
+}
+
+}  // namespace
+
+const char* StateName(State s) {
+    switch (s) {
+        case State::kHealthy: return "HEALTHY";
+        case State::kEjected: return "EJECTED";
+        case State::kProbing: return "PROBING";
+        case State::kRamping: return "RAMPING";
+    }
+    return "?";
+}
+
+const char* ReasonName(Reason r) {
+    switch (r) {
+        case Reason::kNone: return "none";
+        case Reason::kConsecutiveErrors: return "consecutive_errors";
+        case Reason::kLatencyOutlier: return "latency_outlier";
+    }
+    return "?";
+}
+
+OutlierTracker::OutlierTracker(const std::string& name) : name_(name) {
+    ExposeVars();  // idempotent: families + revive observer ready
+    std::lock_guard<std::mutex> g(g_trackers_mu);
+    g_trackers.push_back(this);
+}
+
+OutlierTracker::~OutlierTracker() {
+    std::lock_guard<std::mutex> g(g_trackers_mu);
+    for (size_t i = 0; i < g_trackers.size(); ++i) {
+        if (g_trackers[i] == this) {
+            g_trackers.erase(g_trackers.begin() + (long)i);
+            break;
+        }
+    }
+}
+
+void OutlierTracker::AddServer(const ServerNode& node) {
+    std::lock_guard<std::mutex> g(mu_);
+    Backend& b = backends_[node.id];
+    b.ep = node.ep;
+    b.zone = node.zone;
+}
+
+void OutlierTracker::RemoveServer(SocketId id) {
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = backends_.find(id);
+    if (it == backends_.end()) return;
+    if (it->second.state != State::kHealthy) {
+        nonhealthy_.fetch_sub(1, std::memory_order_relaxed);
+    }
+    backends_.erase(it);
+}
+
+bool OutlierTracker::EjectLocked(SocketId id, Backend* b, Reason reason,
+                                 int64_t now_us) {
+    // Bounds: the detectors propose, the budget disposes. Count every
+    // backend currently withheld from normal picks (ejected/probing).
+    int withheld = 0;
+    for (const auto& kv : backends_) {
+        if (kv.second.state == State::kEjected ||
+            kv.second.state == State::kProbing) {
+            ++withheld;
+        }
+    }
+    const int total = (int)backends_.size();
+    const int max_pct = FLAGS_outlier_max_ejection_pct.get();
+    if ((withheld + 1) * 100 > max_pct * total ||
+        total - (withheld + 1) < std::max(1, min_unejected_)) {
+        *g_eject_vetoes << 1;
+        // Still reset the trigger so a vetoed backend re-arms instead
+        // of re-proposing on every feedback.
+        b->consecutive_errors = 0;
+        b->samples = 0;
+        return false;
+    }
+    if (b->state == State::kHealthy) {
+        nonhealthy_.fetch_add(1, std::memory_order_relaxed);
+    }
+    b->eject_count += 1;
+    b->state = State::kEjected;
+    b->reason = reason;
+    b->ejected_until_us = now_us + EjectionWindowUs(b->eject_count);
+    b->probe_passes = 0;
+    b->samples = 0;
+    b->consecutive_errors = 0;
+    char note[96];
+    if (reason == Reason::kLatencyOutlier) {
+        snprintf(note, sizeof(note),
+                 "ejected: latency outlier %lld.%llux median",
+                 (long long)(b->ratio_x100 / 100),
+                 (unsigned long long)((b->ratio_x100 / 10) % 10));
+    } else {
+        snprintf(note, sizeof(note), "ejected: %d consecutive errors",
+                 FLAGS_outlier_consecutive_errors.get());
+        b->ratio_x100 = 0;
+    }
+    b->note = note;
+    *g_ejections << 1;
+    // b packs reason<<56 | detail (ratio_x100 for latency, consecutive
+    // error threshold for errors) — the forensic WHY of a routing shift.
+    const uint64_t detail =
+        reason == Reason::kLatencyOutlier
+            ? (uint64_t)(b->ratio_x100 & 0xFFFFFFFFFFFFFFULL)
+            : (uint64_t)FLAGS_outlier_consecutive_errors.get();
+    flight::Record(flight::kOutlierEject, PackEp(b->ep),
+                   ((uint64_t)reason << 56) | detail);
+    LOG(WARNING) << "outlier[" << name_ << "]: " << endpoint2str(b->ep)
+                 << " " << b->note << " (window "
+                 << EjectionWindowUs(b->eject_count) / 1000 << "ms)";
+    return true;
+}
+
+void OutlierTracker::MaybeSweepLocked(int64_t now_us) {
+    const int64_t interval_us =
+        (int64_t)FLAGS_outlier_check_interval_ms.get() * 1000;
+    if (now_us - last_sweep_us_.load(std::memory_order_relaxed) <
+        interval_us) {
+        return;
+    }
+    last_sweep_us_.store(now_us, std::memory_order_relaxed);
+    // Live set = backends currently taking normal traffic with enough
+    // samples to mean something.
+    std::vector<int64_t> ewmas;
+    ewmas.reserve(backends_.size());
+    const int64_t min_samples = FLAGS_outlier_min_samples.get();
+    for (const auto& kv : backends_) {
+        const Backend& b = kv.second;
+        if ((b.state == State::kHealthy || b.state == State::kRamping) &&
+            b.samples >= min_samples && b.latency_ewma_us > 0) {
+            ewmas.push_back(b.latency_ewma_us);
+        }
+    }
+    // Median over fewer than 3 contributors is just "the other guy":
+    // no statistical ground to eject anyone.
+    if (ewmas.size() < 3) return;
+    std::sort(ewmas.begin(), ewmas.end());
+    const size_t mid = ewmas.size() / 2;
+    const int64_t median =
+        ewmas.size() % 2 ? ewmas[mid]
+                         : (ewmas[mid - 1] + ewmas[mid]) / 2;
+    if (median <= 0) return;
+    live_median_us_ = median;
+    std::vector<int64_t> devs;
+    devs.reserve(ewmas.size());
+    for (int64_t v : ewmas) {
+        devs.push_back(v > median ? v - median : median - v);
+    }
+    std::sort(devs.begin(), devs.end());
+    const int64_t mad =
+        devs.size() % 2 ? devs[mid]
+                        : (devs[mid - 1] + devs[mid]) / 2;
+    const int64_t ratio_pct = FLAGS_outlier_latency_ratio_pct.get();
+    const int64_t k = FLAGS_outlier_latency_mad_k.get();
+    const int64_t min_delta = FLAGS_outlier_min_delta_us.get();
+    for (auto& kv : backends_) {
+        Backend& b = kv.second;
+        if (b.state != State::kHealthy && b.state != State::kRamping) {
+            continue;
+        }
+        if (b.samples < min_samples || b.latency_ewma_us <= 0) continue;
+        const int64_t ewma = b.latency_ewma_us;
+        // All three guards must agree: relative ratio (grey = many
+        // multiples of the median), scale-relative k*MAD (a noisy but
+        // uniform mesh widens its own MAD), absolute delta (us-scale
+        // jitter can't eject).
+        if (ewma * 100 >= median * ratio_pct &&
+            ewma > median + k * mad && ewma - median >= min_delta) {
+            b.ratio_x100 = ewma * 100 / median;
+            EjectLocked(kv.first, &b, Reason::kLatencyOutlier, now_us);
+        }
+    }
+}
+
+void OutlierTracker::Feed(SocketId id, int64_t latency_us,
+                          int error_code) {
+    if (!FLAGS_outlier_detection_enabled.get()) return;
+    const int64_t now_us = monotonic_time_us();
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = backends_.find(id);
+    if (it == backends_.end()) return;
+    Backend& b = it->second;
+    if (latency_us > 0) {
+        b.latency_ewma_us = b.latency_ewma_us == 0
+                                ? latency_us
+                                : (b.latency_ewma_us * 7 + latency_us) / 8;
+        b.samples += 1;
+    }
+    // TERR_OVERLOAD is the server deliberately pushing back — admission
+    // doing its job, not a grey failure; it must not feed the eject
+    // trigger (shedding under load would then amputate healthy nodes).
+    const bool hard_error = error_code != 0 && error_code != TERR_OVERLOAD;
+    switch (b.state) {
+        case State::kProbing: {
+            // Any feedback for a PROBING backend is a probe result:
+            // normal picks skip it, only the diverted probes reach it.
+            const int64_t median = live_median_us_;
+            const int64_t pass_ceiling =
+                median > 0
+                    ? std::max(median *
+                                   FLAGS_outlier_latency_ratio_pct.get() /
+                                   100,
+                               median + FLAGS_outlier_min_delta_us.get())
+                    : 0;
+            const bool pass =
+                !hard_error &&
+                (pass_ceiling <= 0 || latency_us <= pass_ceiling);
+            if (pass) {
+                *g_probe_passes << 1;
+                b.probe_passes += 1;
+                if (b.probe_passes >= FLAGS_outlier_probe_passes.get()) {
+                    b.state = State::kRamping;
+                    b.ramp_start_us = now_us;
+                    // The healed node is judged on FRESH evidence: the
+                    // grey-era EWMA (alpha 1/8 folds out over ~25
+                    // samples) would otherwise survive into the sweep
+                    // and re-eject a healthy backend onto a doubled
+                    // relapse window the moment it re-earns min_samples.
+                    b.samples = 0;
+                    b.latency_ewma_us = 0;
+                    b.consecutive_errors = 0;
+                    b.note = "ramping after reinstatement";
+                    *g_reinstatements << 1;
+                    flight::Record(flight::kOutlierReinstate, PackEp(b.ep),
+                                   (uint64_t)b.probe_passes);
+                    LOG(INFO) << "outlier[" << name_
+                              << "]: " << endpoint2str(b.ep)
+                              << " reinstated after " << b.probe_passes
+                              << " probe passes; ramping";
+                }
+            } else {
+                *g_probe_fails << 1;
+                b.probe_passes = 0;
+                // Relapse: back to EJECTED with a doubled window.
+                b.eject_count += 1;
+                b.state = State::kEjected;
+                b.ejected_until_us =
+                    now_us + EjectionWindowUs(b.eject_count);
+            }
+            break;
+        }
+        case State::kHealthy:
+        case State::kRamping:
+            if (hard_error) {
+                b.consecutive_errors += 1;
+                if (b.consecutive_errors >=
+                    FLAGS_outlier_consecutive_errors.get()) {
+                    EjectLocked(id, &b, Reason::kConsecutiveErrors,
+                                now_us);
+                    break;
+                }
+            } else if (error_code == 0) {
+                b.consecutive_errors = 0;
+            }
+            MaybeSweepLocked(now_us);
+            break;
+        case State::kEjected:
+            // In-flight stragglers from before the ejection: keep the
+            // EWMA current (a recovered backend probes faster) but run
+            // no detectors.
+            break;
+    }
+}
+
+OutlierTracker::Verdict OutlierTracker::OnPick(SocketId id,
+                                               std::string* note) {
+    if (all_healthy() || !FLAGS_outlier_detection_enabled.get()) {
+        return Verdict::kAllow;
+    }
+    const int64_t now_us = monotonic_time_us();
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = backends_.find(id);
+    if (it == backends_.end()) return Verdict::kAllow;
+    Backend& b = it->second;
+    switch (b.state) {
+        case State::kHealthy:
+            return Verdict::kAllow;
+        case State::kEjected:
+        case State::kProbing:
+            if (note != nullptr) *note = b.note;
+            return Verdict::kSkip;
+        case State::kRamping: {
+            // Slow start: admission probability grows linearly over the
+            // ramp window (floored at 10% so re-entry actually starts),
+            // then the backend graduates to HEALTHY.
+            const int64_t window_us =
+                std::max<int64_t>(1, (int64_t)FLAGS_outlier_rampup_ms.get()
+                                         * 1000);
+            const int64_t elapsed = now_us - b.ramp_start_us;
+            if (elapsed >= window_us) {
+                b.state = State::kHealthy;
+                b.reason = Reason::kNone;
+                b.note.clear();
+                b.samples = 0;
+                nonhealthy_.fetch_sub(1, std::memory_order_relaxed);
+                return Verdict::kAllow;
+            }
+            const uint64_t draw = splitmix64(ramp_seq_++) % 1000;
+            const uint64_t admit =
+                std::max<int64_t>(100, elapsed * 1000 / window_us);
+            if (draw < admit) return Verdict::kAllow;
+            if (note != nullptr) *note = b.note;
+            return Verdict::kSkip;
+        }
+    }
+    return Verdict::kAllow;
+}
+
+SocketId OutlierTracker::ProbeCandidate(int64_t now_us) {
+    if (all_healthy() || !FLAGS_outlier_detection_enabled.get()) {
+        return INVALID_VREF_ID;
+    }
+    const int64_t probe_interval_us =
+        (int64_t)FLAGS_outlier_probe_interval_ms.get() * 1000;
+    std::lock_guard<std::mutex> g(mu_);
+    for (auto& kv : backends_) {
+        Backend& b = kv.second;
+        if (b.state == State::kEjected &&
+            now_us >= b.ejected_until_us) {
+            b.state = State::kProbing;
+            b.probe_passes = 0;
+            b.last_probe_us = 0;
+        }
+        if (b.state == State::kProbing &&
+            now_us - b.last_probe_us >= probe_interval_us) {
+            b.last_probe_us = now_us;
+            return kv.first;
+        }
+    }
+    return INVALID_VREF_ID;
+}
+
+void OutlierTracker::OnRevive(SocketId id) {
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = backends_.find(id);
+    if (it == backends_.end()) return;
+    Backend& b = it->second;
+    // The revive bugfix (ISSUE 20 satellite): a health-check revive used
+    // to clear the socket's DRAINING mark and hand the backend straight
+    // back to the pick set at full weight. A backend this tracker holds
+    // non-healthy re-enters through the probe ramp instead — revive
+    // proves the TRANSPORT works, the probes prove the SERVICE does.
+    if (b.state == State::kEjected || b.state == State::kRamping) {
+        b.state = State::kProbing;
+        b.probe_passes = 0;
+        b.last_probe_us = 0;
+        b.ejected_until_us = 0;
+    }
+}
+
+bool OutlierTracker::IsEjected(SocketId id) const {
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = backends_.find(id);
+    return it != backends_.end() &&
+           (it->second.state == State::kEjected ||
+            it->second.state == State::kProbing);
+}
+
+State OutlierTracker::StateOf(SocketId id) const {
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = backends_.find(id);
+    return it == backends_.end() ? State::kHealthy : it->second.state;
+}
+
+void OutlierTracker::FillSnapshotLocked(SocketId id, const Backend& b,
+                                        int64_t now_us,
+                                        BackendSnapshot* out) const {
+    out->id = id;
+    out->ep = b.ep;
+    out->state = b.state;
+    out->reason = b.reason;
+    out->latency_ewma_us = b.latency_ewma_us;
+    out->consecutive_errors = b.consecutive_errors;
+    out->eject_count = b.eject_count;
+    out->ejected_for_ms =
+        b.state == State::kEjected && b.ejected_until_us > now_us
+            ? (b.ejected_until_us - now_us) / 1000
+            : 0;
+    out->probe_passes = b.probe_passes;
+    out->ratio_x100 = b.ratio_x100;
+}
+
+bool OutlierTracker::Snapshot(SocketId id, BackendSnapshot* out) const {
+    const int64_t now_us = monotonic_time_us();
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = backends_.find(id);
+    if (it == backends_.end()) return false;
+    FillSnapshotLocked(id, it->second, now_us, out);
+    return true;
+}
+
+size_t OutlierTracker::size() const {
+    std::lock_guard<std::mutex> g(mu_);
+    return backends_.size();
+}
+
+size_t OutlierTracker::ejected_now() const {
+    std::lock_guard<std::mutex> g(mu_);
+    size_t n = 0;
+    for (const auto& kv : backends_) {
+        if (kv.second.state == State::kEjected ||
+            kv.second.state == State::kProbing) {
+            ++n;
+        }
+    }
+    return n;
+}
+
+void OutlierTracker::set_min_unejected(int n) {
+    std::lock_guard<std::mutex> g(mu_);
+    min_unejected_ = std::max(1, n);
+}
+
+void OutlierTracker::Describe(std::string* out) const {
+    const int64_t now_us = monotonic_time_us();
+    std::lock_guard<std::mutex> g(mu_);
+    char line[256];
+    snprintf(line, sizeof(line),
+             "tracker %s: %zu backends, median_us=%lld\n", name_.c_str(),
+             backends_.size(), (long long)live_median_us_);
+    out->append(line);
+    for (const auto& kv : backends_) {
+        BackendSnapshot s;
+        FillSnapshotLocked(kv.first, kv.second, now_us, &s);
+        snprintf(line, sizeof(line),
+                 "  %-21s %-8s ewma_us=%-8lld consec_err=%-3d "
+                 "ejects=%-3d window_ms_left=%-6lld probe_passes=%d "
+                 "reason=%s ratio_x100=%lld\n",
+                 endpoint2str(s.ep).c_str(), StateName(s.state),
+                 (long long)s.latency_ewma_us, s.consecutive_errors,
+                 s.eject_count, (long long)s.ejected_for_ms,
+                 s.probe_passes, ReasonName(s.reason),
+                 (long long)s.ratio_x100);
+        out->append(line);
+    }
+}
+
+void OutlierTracker::DescribeJson(std::string* out) const {
+    const int64_t now_us = monotonic_time_us();
+    std::lock_guard<std::mutex> g(mu_);
+    char buf[320];
+    snprintf(buf, sizeof(buf),
+             "{\"name\": \"%s\", \"backends\": [", name_.c_str());
+    out->append(buf);
+    bool first = true;
+    for (const auto& kv : backends_) {
+        BackendSnapshot s;
+        FillSnapshotLocked(kv.first, kv.second, now_us, &s);
+        snprintf(buf, sizeof(buf),
+                 "%s{\"endpoint\": \"%s\", \"state\": \"%s\", "
+                 "\"reason\": \"%s\", \"latency_ewma_us\": %lld, "
+                 "\"consecutive_errors\": %d, \"eject_count\": %d, "
+                 "\"window_ms_left\": %lld, \"probe_passes\": %d, "
+                 "\"ratio_x100\": %lld}",
+                 first ? "" : ", ", endpoint2str(s.ep).c_str(),
+                 StateName(s.state), ReasonName(s.reason),
+                 (long long)s.latency_ewma_us, s.consecutive_errors,
+                 s.eject_count, (long long)s.ejected_for_ms,
+                 s.probe_passes, (long long)s.ratio_x100);
+        out->append(buf);
+        first = false;
+    }
+    snprintf(buf, sizeof(buf), "], \"median_us\": %lld}",
+             (long long)live_median_us_);
+    out->append(buf);
+}
+
+// ---- the wrapper ----
+
+OutlierLoadBalancer::OutlierLoadBalancer(LoadBalancer* inner)
+    : inner_(inner), tracker_(inner->name()) {}
+
+OutlierLoadBalancer::~OutlierLoadBalancer() = default;
+
+bool OutlierLoadBalancer::AddServer(const ServerNode& server) {
+    const bool added = inner_->AddServer(server);
+    if (added) tracker_.AddServer(server);
+    return added;
+}
+
+bool OutlierLoadBalancer::RemoveServer(SocketId id) {
+    const bool removed = inner_->RemoveServer(id);
+    if (removed) tracker_.RemoveServer(id);
+    return removed;
+}
+
+int OutlierLoadBalancer::SelectServer(const SelectIn& in, SelectOut* out) {
+    // Fast path: nothing ejected anywhere — one relaxed load, then the
+    // wrapped stack runs exactly as before this tier existed.
+    if (tracker_.all_healthy()) return inner_->SelectServer(in, out);
+
+    // Reinstatement probes: divert ONE real rpc per interval to an
+    // ejected backend whose window expired. Real traffic is the probe —
+    // no synthetic load, and the probe result arrives through the same
+    // passive Feedback funnel as every other call.
+    const int64_t now_us = monotonic_time_us();
+    const SocketId probe_id = tracker_.ProbeCandidate(now_us);
+    if (probe_id != INVALID_VREF_ID &&
+        (in.excluded == nullptr || !in.excluded->IsExcluded(probe_id))) {
+        Socket* s = Socket::Address(probe_id);
+        if (s != nullptr) {
+            out->ptr = SocketUniquePtr(s);
+            out->outlier_probe = true;
+            return 0;
+        }
+    }
+
+    // Normal pick with ejection skips: re-select with the ejected id
+    // added to the exclusion set. Bounded by the ExcludedServers
+    // capacity; if every candidate is ejected the LAST pick stands —
+    // a degraded backend still beats failing the call (ejection must
+    // never be able to fail what a breaker would have served).
+    ExcludedServers ex;
+    if (in.excluded != nullptr) ex = *in.excluded;
+    SelectIn sub = in;
+    sub.excluded = &ex;
+    std::string note;
+    bool skipped_ejected = false;
+    std::string first_note;
+    for (int attempt = 0; attempt < 8; ++attempt) {
+        SelectOut candidate;
+        const int rc = inner_->SelectServer(sub, &candidate);
+        if (rc != 0) {
+            if (skipped_ejected) break;  // fall through to last resort
+            return rc;
+        }
+        const SocketId id = candidate.ptr->id();
+        note.clear();
+        if (tracker_.OnPick(id, &note) ==
+            OutlierTracker::Verdict::kAllow) {
+            *out = std::move(candidate);
+            out->skipped_ejected = skipped_ejected;
+            out->outlier_note = first_note;
+            return 0;
+        }
+        inner_->DiscardPick(id);
+        skipped_ejected = true;
+        if (first_note.empty()) first_note = note;
+        ex.Add(id);
+    }
+    // Last resort: everything pickable is ejected/ramp-rejected. Serve
+    // through the wrapped stack ignoring ejection state.
+    const int rc = inner_->SelectServer(in, out);
+    if (rc == 0) {
+        out->skipped_ejected = false;
+        out->outlier_note.clear();
+    }
+    return rc;
+}
+
+void OutlierLoadBalancer::Feedback(const CallInfo& info) {
+    // A PROBING backend's feedback is a diverted probe the wrapped
+    // policies never selected (la's inflight count would underflow):
+    // settle it in the tracker only.
+    const bool diverted_probe =
+        tracker_.StateOf(info.server_id) == State::kProbing;
+    tracker_.Feed(info.server_id, info.latency_us, info.error_code);
+    if (!diverted_probe) inner_->Feedback(info);
+}
+
+void OutlierLoadBalancer::DiscardPick(SocketId id) {
+    inner_->DiscardPick(id);
+}
+
+void OutlierLoadBalancer::Describe(std::string* out) const {
+    inner_->Describe(out);
+    out->append("\n");
+    tracker_.Describe(out);
+}
+
+const char* OutlierLoadBalancer::name() const { return inner_->name(); }
+
+// ---- process-wide exposure ----
+
+namespace {
+
+int64_t PassiveEjectedNow(void*) { return ejected_now_total(); }
+
+}  // namespace
+
+void ExposeVars() {
+    static std::atomic<bool> done{false};
+    bool expected = false;
+    if (!done.compare_exchange_strong(expected, true)) return;
+    *g_ejections << 0;
+    *g_reinstatements << 0;
+    *g_probe_passes << 0;
+    *g_probe_fails << 0;
+    *g_eject_vetoes << 0;
+    static PassiveStatus<int64_t> ejected(PassiveEjectedNow, nullptr);
+    ejected.expose("rpc_outlier_ejected_now");
+    // Health-check revives re-enter through the probe ramp, not at
+    // full weight (the DRAINING-clear bug this PR fixes).
+    Socket::set_revive_observer(ReviveObserver);
+}
+
+std::string DescribeAll() {
+    std::string out;
+    std::lock_guard<std::mutex> g(g_trackers_mu);
+    if (g_trackers.empty()) {
+        out = "no outlier trackers (no LB channels in this process)\n";
+        return out;
+    }
+    for (OutlierTracker* t : g_trackers) t->Describe(&out);
+    return out;
+}
+
+std::string DescribeAllJson() {
+    std::string out = "{\"trackers\": [";
+    {
+        std::lock_guard<std::mutex> g(g_trackers_mu);
+        for (size_t i = 0; i < g_trackers.size(); ++i) {
+            if (i > 0) out.append(", ");
+            g_trackers[i]->DescribeJson(&out);
+        }
+    }
+    char tail[256];
+    snprintf(tail, sizeof(tail),
+             "], \"ejections\": %lld, \"reinstatements\": %lld, "
+             "\"ejected_now\": %lld, \"probe_passes\": %lld, "
+             "\"probe_fails\": %lld, \"eject_vetoes\": %lld}",
+             (long long)ejections(), (long long)reinstatements(),
+             (long long)ejected_now_total(), (long long)probe_passes(),
+             (long long)probe_fails(),
+             (long long)(*g_eject_vetoes).get_value());
+    out.append(tail);
+    return out;
+}
+
+int64_t ejections() { return (*g_ejections).get_value(); }
+int64_t reinstatements() { return (*g_reinstatements).get_value(); }
+int64_t probe_passes() { return (*g_probe_passes).get_value(); }
+int64_t probe_fails() { return (*g_probe_fails).get_value(); }
+
+int64_t ejected_now_total() {
+    std::lock_guard<std::mutex> g(g_trackers_mu);
+    int64_t n = 0;
+    for (OutlierTracker* t : g_trackers) n += (int64_t)t->ejected_now();
+    return n;
+}
+
+}  // namespace outlier
+}  // namespace tpurpc

@@ -1,0 +1,218 @@
+// Outlier detection + ejection (ISSUE 20): grey-failure immunity for the
+// LB plane. A node that is slow or lossy while still answering connect
+// probes defeats every binary defense — the circuit breaker needs hard
+// errors, the zone layer sees it live, hedging papers over it per-call
+// while the sick backend keeps absorbing picks. This tier watches the
+// PASSIVE per-try feedback every RPC already produces (EndRPC ->
+// Controller::FeedbackToLB -> LoadBalancer::Feedback) and ejects
+// statistical outliers from the pick set the same way draining members
+// are skipped: a budget-free re-route, never a breaker trip.
+//
+// Shape mirrors the zone layer (ISSUE 14): ONE wrapper —
+// OutlierLoadBalancer, applied outermost by LoadBalancer::New — makes
+// every policy (rr/wrr/random/c-hash/la) outlier-aware without
+// per-policy forks. Reference point: Envoy's outlier detection
+// (consecutive-5xx + success-rate ejection with max_ejection_percent)
+// re-grounded on brpc-style passive feedback.
+//
+// Detectors (both cheap, both fed from Feed()):
+//  - consecutive-error: N hard failures in a row ejects immediately.
+//  - latency-outlier: a rate-limited sweep compares each backend's
+//    latency EWMA against the LIVE-SET MEDIAN + k*MAD with a minimum
+//    ratio and absolute-delta guard — a uniformly slow mesh moves its
+//    own median and ejects NOBODY (asserted by the grey-failure soak's
+//    second phase).
+//
+// Ejection is bounded (-outlier_max_ejection_pct, and never below a
+// floor the naming layer derives from its per-zone subset minimum) and
+// temporary: windows grow exponentially per relapse, expiry moves the
+// backend to PROBING where rate-limited REAL RPCs (no synthetic probe
+// traffic) must pass N consecutive times before a slow-start RAMP
+// re-admits full weight — no cliff re-entry.
+//
+// Everything is first-class observable: rpc_outlier_* tvar families,
+// the /outliers portal page (text + json), EJECT/REINSTATE flight-
+// recorder events (blackbox_merge shows WHY routing shifted), and span
+// annotations ("ejected: latency outlier 8.2x median") on re-routed
+// calls. Pb-free: links into the standalone toolchain-less suites.
+#pragma once
+
+#include <atomic>
+#include <cstdint>
+#include <map>
+#include <mutex>
+#include <string>
+
+#include "tbase/endpoint.h"
+#include "trpc/load_balancer.h"
+
+namespace tpurpc {
+namespace outlier {
+
+enum class State {
+    kHealthy = 0,  // full member of the pick set
+    kEjected = 1,  // skipped entirely until the window expires
+    kProbing = 2,  // window expired: rate-limited real-RPC probes only
+    kRamping = 3,  // probes passed: pick probability ramps to full
+};
+
+enum class Reason {
+    kNone = 0,
+    kConsecutiveErrors = 1,
+    kLatencyOutlier = 2,
+};
+
+const char* StateName(State s);
+const char* ReasonName(Reason r);
+
+// Snapshot of one backend's detector state (tests + /outliers page).
+struct BackendSnapshot {
+    SocketId id = INVALID_VREF_ID;
+    EndPoint ep;
+    State state = State::kHealthy;
+    Reason reason = Reason::kNone;
+    int64_t latency_ewma_us = 0;
+    int consecutive_errors = 0;
+    int eject_count = 0;          // lifetime ejections (window doubling)
+    int64_t ejected_for_ms = 0;   // remaining window (kEjected only)
+    int probe_passes = 0;         // consecutive passes so far (kProbing)
+    // ewma/median ratio x100 at ejection time (kLatencyOutlier only).
+    int64_t ratio_x100 = 0;
+};
+
+// Per-channel detector registry. One instance lives inside each
+// OutlierLoadBalancer; all instances self-register on a process-global
+// list so /outliers and the revive observer reach every channel.
+class OutlierTracker {
+public:
+    explicit OutlierTracker(const std::string& name);
+    ~OutlierTracker();
+
+    void AddServer(const ServerNode& node);
+    void RemoveServer(SocketId id);
+
+    // Passive per-try feedback (latency in us; error_code 0 = success).
+    // Runs the consecutive-error detector inline, probe/ramp state
+    // transitions, and the rate-limited latency-outlier sweep.
+    void Feed(SocketId id, int64_t latency_us, int error_code);
+
+    // Pick-time gate. kAllow: issue to this backend. kSkip: re-pick
+    // (fills *note with the span-annotation reason, e.g. "ejected:
+    // latency outlier 8.2x median"). A backend in kRamping is admitted
+    // probabilistically (slow start); rejects come back kSkip.
+    enum class Verdict { kAllow, kSkip };
+    Verdict OnPick(SocketId id, std::string* note);
+
+    // An ejected backend whose window expired and whose probe interval
+    // elapsed: the wrapper diverts ONE real RPC to it. INVALID_VREF_ID
+    // when nobody needs probing now.
+    SocketId ProbeCandidate(int64_t now_us);
+
+    // Health-check revive hook (ISSUE 20 satellite: revive used to
+    // clear DRAINING and re-enter at full weight). A non-healthy
+    // backend re-enters through the probe ramp instead.
+    void OnRevive(SocketId id);
+
+    // True when this id must not receive normal picks (kEjected or
+    // kProbing — probes are diverted explicitly, never picked).
+    bool IsEjected(SocketId id) const;
+    State StateOf(SocketId id) const;
+    bool Snapshot(SocketId id, BackendSnapshot* out) const;
+    size_t size() const;
+    // Backends currently withheld from the normal pick set.
+    size_t ejected_now() const;
+
+    // Floor under the ejection bound: never leave fewer than this many
+    // backends un-ejected (naming layer feeds its subset floor here).
+    void set_min_unejected(int n);
+
+    // Fast-path gate: true when every backend is kHealthy (OnPick and
+    // ProbeCandidate are then skipped without taking the mutex).
+    bool all_healthy() const {
+        return nonhealthy_.load(std::memory_order_relaxed) == 0;
+    }
+
+    void Describe(std::string* out) const;
+    void DescribeJson(std::string* out) const;
+    const std::string& name() const { return name_; }
+
+private:
+    struct Backend {
+        EndPoint ep;
+        std::string zone;
+        State state = State::kHealthy;
+        Reason reason = Reason::kNone;
+        int64_t latency_ewma_us = 0;  // alpha 1/8
+        int64_t samples = 0;          // since last state change
+        int consecutive_errors = 0;
+        int eject_count = 0;
+        int64_t ejected_until_us = 0;
+        int64_t last_probe_us = 0;
+        int probe_passes = 0;
+        int64_t ramp_start_us = 0;
+        int64_t ratio_x100 = 0;  // at ejection (latency reason)
+        std::string note;        // span-annotation text while ejected
+    };
+
+    void MaybeSweepLocked(int64_t now_us);
+    bool EjectLocked(SocketId id, Backend* b, Reason reason,
+                     int64_t now_us);
+    void FillSnapshotLocked(SocketId id, const Backend& b, int64_t now_us,
+                            BackendSnapshot* out) const;
+
+    const std::string name_;
+    mutable std::mutex mu_;
+    std::map<SocketId, Backend> backends_;
+    std::atomic<int> nonhealthy_{0};
+    std::atomic<int64_t> last_sweep_us_{0};
+    int64_t live_median_us_ = 0;  // last sweep's median (probe threshold)
+    int min_unejected_ = 1;
+    uint64_t ramp_seq_ = 0;  // deterministic slow-start admission draws
+};
+
+// The one wrapper (same shape as ZoneAwareLoadBalancer): applied
+// outermost by LoadBalancer::New, so ejection skips compose with zone
+// fallback ordering and deterministic subsetting unchanged. Never fails
+// a call on its own: when every candidate is ejected, the original pick
+// stands (degraded beats dead).
+class OutlierLoadBalancer : public LoadBalancer {
+public:
+    // Takes ownership of the wrapped (zone-aware) balancer.
+    explicit OutlierLoadBalancer(LoadBalancer* inner);
+    ~OutlierLoadBalancer() override;
+
+    bool AddServer(const ServerNode& server) override;
+    bool RemoveServer(SocketId id) override;
+    int SelectServer(const SelectIn& in, SelectOut* out) override;
+    void Feedback(const CallInfo& info) override;
+    void DiscardPick(SocketId id) override;
+    void Describe(std::string* out) const override;
+    const char* name() const override;
+
+    OutlierTracker* tracker() { return &tracker_; }
+    LoadBalancer* wrapped() { return inner_.get(); }
+
+private:
+    std::unique_ptr<LoadBalancer> inner_;
+    OutlierTracker tracker_;
+};
+
+// Register the rpc_outlier_* families eagerly (idempotent) so /metrics
+// and the lint see them 0-valued before the first ejection. Also
+// installs the Socket revive observer that routes ejected-then-revived
+// backends into the probe ramp.
+void ExposeVars();
+
+// All live trackers' state (the /outliers portal page).
+std::string DescribeAll();
+std::string DescribeAllJson();
+
+// Counter reads for tests/tools.
+int64_t ejections();
+int64_t reinstatements();
+int64_t probe_passes();
+int64_t probe_fails();
+int64_t ejected_now_total();
+
+}  // namespace outlier
+}  // namespace tpurpc

@@ -13,6 +13,7 @@
 #include "tbase/flight_recorder.h"
 #include "tbase/logging.h"
 #include "tbase/time.h"
+#include "tfiber/fiber.h"
 #include "thttp/http2_client.h"
 #include "thttp/http2_protocol.h"
 #include "thttp/http_protocol.h"
@@ -659,6 +660,29 @@ void CallUserMethod(Server::MethodProperty* mp, Controller* cntl,
     if (ShedIfExpired(mp, cntl)) {
         done->Run();
         return;
+    }
+    // Grey-failure chaos seam (ISSUE 20): AFTER admission/shedding so
+    // the fault degrades only what the server actually accepted —
+    // health probes, QoS and the connection stay perfect; nothing but a
+    // latency/error-observing client (the outlier tier) can tell.
+    if (__builtin_expect(fault_injection_enabled(), 0)) {
+        const FaultAction fa = FaultInjection::Decide(
+            FaultOp::kHandler, cntl->remote_side(), 0);
+        if (fa.kind == FaultAction::kFail) {
+            // Synthetic post-admission failure WITHOUT running the
+            // handler. TERR_OVERCROWDED: retriable (the soak must lose
+            // zero completions — the client re-issues elsewhere) yet a
+            // hard error to the breaker and the outlier detector
+            // (unlike TERR_OVERLOAD, which admission control owns).
+            cntl->SetFailed(TERR_OVERCROWDED,
+                            "chaos: synthetic handler failure");
+            done->Run();
+            return;
+        }
+        if (fa.kind == FaultAction::kDelay) {
+            // Service-time inflation: the node is SLOW, not dead.
+            fiber_usleep(fa.delay_us);
+        }
     }
     // Within this protocol `done` is always the SendResponseClosure built
     // in ProcessTpuStdRequest — the only holder of the wire cid here.

@@ -299,6 +299,31 @@ def test_metrics_exposition_lint(cpp_build, tmp_path):
             sorted(families)
         assert families.get("rpc_lb_zone_local_picks") == "gauge"
         assert re.search(r"^rpc_lb_zone_spills \d+$", text, re.M)
+        # ISSUE 20 outlier-ejection families: present (0-valued, eagerly
+        # exposed) from the first scrape of a healthy node — and the live
+        # ejected-now gauge must actually be zero, nothing on a healthy
+        # single-node mesh qualifies for ejection.
+        for fam in ("rpc_outlier_ejections", "rpc_outlier_reinstatements",
+                    "rpc_outlier_probe_passes", "rpc_outlier_probe_fails",
+                    "rpc_outlier_eject_vetoes", "rpc_outlier_ejected_now"):
+            assert families.get(fam) == "gauge", (fam, sorted(families))
+            assert re.search(r"^%s \d+$" % fam, text, re.M), fam
+        assert re.search(r"^rpc_outlier_ejected_now 0$", text, re.M), \
+            "a healthy mesh ejected someone"
+        # /outliers renders in both forms; every mesh_node runs at least
+        # the naming-service LB channel, so one tracker is always live
+        # and its (self) backend reports healthy.
+        outl = json.loads(_http_get(port, "/outliers?format=json"))
+        for key in ("trackers", "ejections", "reinstatements",
+                    "ejected_now", "probe_passes", "probe_fails",
+                    "eject_vetoes"):
+            assert key in outl, (key, sorted(outl))
+        assert isinstance(outl["trackers"], list) and outl["trackers"], \
+            outl
+        tr = outl["trackers"][0]
+        assert isinstance(tr.get("backends"), list) and tr["backends"], tr
+        assert tr["backends"][0]["state"] == "HEALTHY", tr
+        assert "tracker " in _http_get(port, "/outliers")
         # /pools json carries the lease direction column + tier table
         # (dcn: descriptor-INCAPABLE cross-process byte stream).
         pools = json.loads(_http_get(port, "/pools?format=json"))
@@ -390,7 +415,8 @@ def test_router_metrics_lint(cpp_build, tmp_path):
         for fam in ("rpc_router_forwards", "rpc_router_forward_failures",
                     "rpc_router_hedges", "rpc_router_hedge_wins",
                     "rpc_router_reroutes", "rpc_router_session_repins",
-                    "rpc_router_edge_sheds"):
+                    "rpc_router_edge_sheds",
+                    "rpc_router_hedge_refreshes"):
             assert families.get(fam) == "gauge", (fam, sorted(families))
             assert re.search(r"^%s \d+$" % fam, text, re.M), fam
         # The backend-latency recorder exports a real summary family.

@@ -33,14 +33,15 @@ KIND_NAMES = [
     "VERB_WIRE", "VERB_COMPLETE", "VERB_REAP", "LEASE_PIN", "LEASE_ARM",
     "LEASE_RELEASE", "LEASE_EXPIRE", "LEASE_PEER_DEATH", "STREAM_CHUNK",
     "STREAM_CREDIT_STALL", "STREAM_RESUME", "COLL_STEP", "COLL_REFORM",
-    "SCHED_INLINE", "SCHED_PARK", "CHAOS_INJECT",
+    "SCHED_INLINE", "SCHED_PARK", "CHAOS_INJECT", "OUTLIER_EJECT",
+    "OUTLIER_REINSTATE",
 ]
 K_RPC_ISSUE, K_RPC_DISPATCH = 1, 2
 K_RPC_WRITE, K_RPC_RESP_RECV = 5, 6
 
 CHAOS_KIND_NAMES = [
     "none", "delay", "short", "drop", "corrupt", "reset", "refuse",
-    "stale_epoch", "cost_inflate", "crash",
+    "stale_epoch", "cost_inflate", "crash", "fail",
 ]
 
 
@@ -161,25 +162,65 @@ def median(xs):
 
 def pair_offset(a, b):
     """Envelope offset estimate of node b's clock minus node a's, from
-    RPCs a issued to b. Returns (offset_us, nsamples) or None."""
+    RPCs a issued to b. Returns (offset_us, nsamples) or None.
+
+    Correlation ids are only unique within one client PROCESS lifetime:
+    a restarted client (each rpc_press phase, a bounced mesh node)
+    reuses the same id space, and a server ring that retains history
+    then holds MULTIPLE handlings of the "same" cid. Marrying a fresh
+    issue to a stale dispatch skews the estimate by the inter-run gap —
+    seconds, not RTTs — and because a restarted client replays at a
+    similar rate, the stale pairings form their OWN tight cluster that
+    can outnumber the true one (e.g. the server was ejected early in
+    the fresh run). Two defenses, in order: (1) the wall-clock anchors
+    both dump headers carry window the server-side candidates to the
+    client dump's own wall span (+/- 1 s slack — same-host clocks are
+    identical and NTP keeps peers well inside that; the inter-run gaps
+    that create collisions are seconds); (2) the densest 50 ms offset
+    cluster among the survivors wins, shedding asymmetric-delay
+    stragglers before the median.
+    """
     t1, t4, t2, t3 = {}, {}, {}, {}
     for e in a.events:
         if e["k"] == K_RPC_ISSUE:
             t1.setdefault(e["a"], a.wall_of(e["tsc"]))
         elif e["k"] == K_RPC_RESP_RECV:
             t4.setdefault(e["a"], a.wall_of(e["tsc"]))
+    if not t1:
+        return None
+    slack_us = 1_000_000.0
+    a_lo = min(t1.values()) - slack_us
+    a_hi = max(t4.values()) + slack_us if t4 else max(t1.values()) + slack_us
     for e in b.events:
         if e["k"] == K_RPC_DISPATCH:
-            t2.setdefault(e["a"], b.wall_of(e["tsc"]))
+            w = b.wall_of(e["tsc"])
+            if a_lo <= w <= a_hi:
+                t2.setdefault(e["a"], []).append(w)
         elif e["k"] == K_RPC_WRITE:
-            t3.setdefault(e["a"], b.wall_of(e["tsc"]))
+            w = b.wall_of(e["tsc"])
+            if a_lo <= w <= a_hi:
+                t3.setdefault(e["a"], []).append(w)
     samples = []
     for cid in t1:
         if cid in t2 and cid in t3 and cid in t4:
-            samples.append(((t2[cid] - t1[cid]) + (t3[cid] - t4[cid])) / 2.0)
+            # Chronological zip: each server-side handling of this cid
+            # is a dispatch->write pair; order aligns them.
+            for d_us, w_us in zip(sorted(t2[cid]), sorted(t3[cid])):
+                samples.append(
+                    ((d_us - t1[cid]) + (w_us - t4[cid])) / 2.0)
     if not samples:
         return None
-    return median(samples), len(samples)
+    bin_us = 50000.0  # true samples agree well inside one bin
+    bins = {}
+    for s in samples:
+        k = int(s // bin_us)
+        bins[k] = bins.get(k, 0) + 1
+    best = max(bins,
+               key=lambda k: bins.get(k - 1, 0) + bins[k] +
+                             bins.get(k + 1, 0))
+    keep = [s for s in samples
+            if best - 1 <= int(s // bin_us) <= best + 1]
+    return median(keep), len(keep)
 
 
 def normalize(nodes):
@@ -243,6 +284,20 @@ def decode_args(e):
                   if fk < len(CHAOS_KIND_NAMES) else str(fk))
         return "decision=%d seed_lo=%d op=%d fault=%s" % (
             a, b >> 32, (b >> 8) & 0xFFFFFF, fkname)
+    if kind in ("OUTLIER_EJECT", "OUTLIER_REINSTATE"):
+        # a packs the backend identity ip4<<16|port; EJECT's b packs
+        # reason<<56|detail (cpp/trpc/outlier.cc EjectLocked).
+        ip = (a >> 16) & 0xFFFFFFFF
+        backend = "%d.%d.%d.%d:%d" % (
+            (ip >> 24) & 0xFF, (ip >> 16) & 0xFF, (ip >> 8) & 0xFF,
+            ip & 0xFF, a & 0xFFFF)
+        if kind == "OUTLIER_EJECT":
+            reason = b >> 56
+            rname = {1: "consecutive_errors",
+                     2: "latency_outlier"}.get(reason, str(reason))
+            return "backend=%s reason=%s detail=%d" % (
+                backend, rname, b & 0xFFFFFFFFFFFFFF)
+        return "backend=%s probe_passes=%d" % (backend, b)
     del k
     return "a=%d b=%d" % (a, b)
 

@@ -52,6 +52,16 @@
 // per tenant per interval (tenant column; the aggregate row says
 // "all") and --json adds a per-tenant breakdown.
 //
+// Grey-failure soaks (ISSUE 20): --server=h:p,h:p,... runs the full
+// client-side LB stack (round-robin under the outlier-ejection wrapper)
+// over a list:// naming set, so the GENERATOR is the process that
+// detects and ejects a degraded backend. Each completed call is
+// attributed to the backend that served it (cntl.remote_side()): the
+// end-of-run report gains a per-backend picks/errors/p99 table (and a
+// press_backends object + rpc_outlier_* counters in --json), and
+// --backend_csv=<path> appends per-interval per-backend delta rows —
+// the pick-share trace an ejection/reinstatement assertion reads.
+//
 // While running, one stats line per second (interval qps + windowed
 // p50/p99/p999); --metrics_csv=<path> appends the same row per interval
 // as CSV (elapsed_s,qps,p50_us,p99_us,p999_us,failed_total,tenant) —
@@ -67,7 +77,9 @@
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
+#include <map>
 #include <memory>
+#include <mutex>
 #include <string>
 #include <vector>
 
@@ -82,6 +94,7 @@
 #include "tnet/transport.h"
 #include "trpc/channel.h"
 #include "trpc/controller.h"
+#include "trpc/outlier.h"
 #include "trpc/stream.h"
 #include "tvar/latency_recorder.h"
 #include "tvar/variable.h"
@@ -178,6 +191,42 @@ struct TenantGen {
     std::atomic<int64_t> stream_seq_errors{0};
     std::atomic<int64_t> stream_dups{0};
 };
+
+// Per-backend client-side stats (ISSUE 20): when --server is a comma
+// list the channel runs the full LB stack — outlier tier included — in
+// THIS process, and every completed call says which backend served it
+// (cntl.remote_side()). The table is how a grey-failure soak watches
+// traffic steer off an ejected node and return after reinstatement,
+// without trusting the grey node's own telemetry.
+struct BackendStat {
+    std::atomic<int64_t> picks{0};
+    std::atomic<int64_t> errors{0};
+    LatencyRecorder lat;
+    int64_t last_picks = 0;  // interval deltas (--backend_csv)
+    int64_t last_errors = 0;
+};
+std::mutex g_backend_mu;
+std::map<std::string, std::unique_ptr<BackendStat>> g_backends;
+std::atomic<bool> g_track_backends{false};
+
+void RecordBackend(const Controller& cntl, int64_t latency_us) {
+    if (!g_track_backends.load(std::memory_order_relaxed)) return;
+    const EndPoint ep = cntl.remote_side();
+    if (ep.port == 0) return;  // failed before any backend was picked
+    BackendStat* bs = nullptr;
+    {
+        std::lock_guard<std::mutex> lock(g_backend_mu);
+        auto& slot = g_backends[endpoint2str(ep)];
+        if (slot == nullptr) slot.reset(new BackendStat);
+        bs = slot.get();
+    }
+    bs->picks.fetch_add(1, std::memory_order_relaxed);
+    if (cntl.Failed()) {
+        bs->errors.fetch_add(1, std::memory_order_relaxed);
+    } else if (latency_us > 0) {
+        bs->lat << latency_us;
+    }
+}
 
 struct PressCtx {
     benchpb::EchoService_Stub* stub;
@@ -340,6 +389,7 @@ void* PressCaller(void* arg) {
         }
         c->stub->Echo(&cntl, &req, &res, nullptr);
         if (cntl.Failed()) {
+            RecordBackend(cntl, 0);
             g->failed.fetch_add(1, std::memory_order_relaxed);
             if (cntl.ErrorCode() == TERR_OVERLOAD) {
                 g->shed.fetch_add(1, std::memory_order_relaxed);
@@ -354,7 +404,9 @@ void* PressCaller(void* arg) {
                 g->stale.fetch_add(1, std::memory_order_relaxed);
             }
         } else {
-            g->lat << (monotonic_time_us() - res.send_ts_us());
+            const int64_t lat_us = monotonic_time_us() - res.send_ts_us();
+            RecordBackend(cntl, lat_us);
+            g->lat << lat_us;
             g->sent.fetch_add(1, std::memory_order_relaxed);
         }
     }
@@ -420,9 +472,13 @@ int main(int argc, char** argv) {
     long long stream_tokens = 0;  // --stream_tokens: push-stream mode
     int stream_read_delay_ms = 0;
     const char* blackbox_path = nullptr;  // --blackbox=PATH (ISSUE 19)
+    const char* backend_csv = nullptr;    // --backend_csv=PATH (ISSUE 20)
     for (int i = 1; i < argc; ++i) {
         if (strncmp(argv[i], "--metrics_csv=", 14) == 0) {
             metrics_csv = argv[i] + 14;
+        }
+        if (strncmp(argv[i], "--backend_csv=", 14) == 0) {
+            backend_csv = argv[i] + 14;
         }
         if (strncmp(argv[i], "--press_threads=", 16) == 0) {
             press_threads = atoi(argv[i] + 16);
@@ -491,11 +547,24 @@ int main(int argc, char** argv) {
         if (strncmp(argv[i], "--blackbox=", 11) == 0) {
             blackbox_path = argv[i] + 11;
         }
+        // --flag=name=value: tune any registered flag in the PRESS
+        // process (mesh_node's --flag twin) — the grey-failure soak
+        // enlarges flight_recorder_ring so the in-press EJECT event
+        // survives to the end-of-run dump.
+        if (strncmp(argv[i], "--flag=", 7) == 0) {
+            const std::string kv = argv[i] + 7;
+            const size_t eq = kv.find('=');
+            if (eq == std::string::npos ||
+                !SetFlagValue(kv.substr(0, eq), kv.substr(eq + 1))) {
+                fprintf(stderr, "bad --flag %s\n", kv.c_str());
+                return 2;
+            }
+        }
         if (strcmp(argv[i], "--json") == 0) json = true;
     }
     if (server_str.empty()) {
         fprintf(stderr,
-                "usage: rpc_press --server=ip:port [--qps=N] "
+                "usage: rpc_press --server=ip:port[,ip:port...] [--qps=N] "
                 "[--duration_s=N] [--payload=N] [--callers=N] "
                 "[--press_threads=N] [--pooled] [--pool_desc "
                 "(alias: --pool-desc)] "
@@ -505,7 +574,12 @@ int main(int argc, char** argv) {
                 "[--zone=NAME] [--dcn_peers=ip:port,...] "
                 "[--via=ip:port] [--sessions=N] "
                 "[--stream_tokens=N [--stream_read_delay_ms=N]] "
-                "[--blackbox=PATH] [--json]\n"
+                "[--blackbox=PATH] [--backend_csv=PATH] "
+                "[--flag=name=value] [--json]\n"
+                "  --server with a comma list drives a client-side LB "
+                "channel (rr + outlier ejection); per-backend picks/"
+                "errors/p99 and rpc_outlier_* counters are reported, "
+                "--backend_csv appends per-interval per-backend rows\n"
                 "  --zone/--dcn_peers: zone-aware LB over the local "
                 "server + cross-pod dcn-tier peers; per-zone picks and "
                 "spills are reported\n"
@@ -517,6 +591,18 @@ int main(int argc, char** argv) {
     if (blackbox_path != nullptr) {
         flight::SetNodeName("rpc_press");
         flight::InstallCrashHandler(blackbox_path);
+    }
+    // --server=h:p,h:p (ISSUE 20): a comma list turns the generator into
+    // an LB client — the channel below runs the full load-balancer stack
+    // (round-robin under the outlier wrapper) over a list:// naming set,
+    // so ejection and reinstatement decisions happen IN this process and
+    // the per-backend table (--backend_csv / press_backends) shows
+    // traffic steering around a grey node. The first entry doubles as
+    // the plain EndPoint the non-LB paths keep using.
+    std::string server_list;
+    if (server_str.find(',') != std::string::npos) {
+        server_list = server_str;
+        server_str.resize(server_str.find(','));
     }
     EndPoint server;
     if (hostname2endpoint(server_str.c_str(), &server) != 0) {
@@ -605,6 +691,16 @@ int main(int argc, char** argv) {
     } else if (!zone.empty()) {
         SetFlagValue("rpc_zone", zone);
     }
+    if (lb_url.empty() && !server_list.empty()) {
+        lb_url = "list://" + server_list;
+    }
+    if (!lb_url.empty()) {
+        // Client-side outlier tier: seed the rpc_outlier_* counters read
+        // below and route health-check revives of ejected sockets
+        // through the reinstatement probe ramp.
+        outlier::ExposeVars();
+        g_track_backends.store(true, std::memory_order_relaxed);
+    }
     std::vector<std::unique_ptr<Channel>> channels;
     std::vector<std::unique_ptr<benchpb::EchoService_Stub>> stubs;
     for (int i = 0; i < press_threads; ++i) {
@@ -687,6 +783,18 @@ int main(int argc, char** argv) {
                     "ttft_p50_us,ttft_p99_us,itl_p99_us\n");
         }
     }
+    // Per-interval per-backend rows (--backend_csv): interval pick and
+    // error DELTAS — the soak's pick-share-recovery assertion reads the
+    // tail rows, so cumulative totals (which remember the outage) would
+    // be the wrong shape.
+    FILE* bcsv = nullptr;
+    if (backend_csv != nullptr) {
+        const bool fresh = access(backend_csv, F_OK) != 0;
+        bcsv = fopen(backend_csv, "a");
+        if (bcsv != nullptr && fresh) {
+            fprintf(bcsv, "elapsed_s,backend,picks,errors,p99_us\n");
+        }
+    }
 
     // Refill by elapsed time (exact pacing for any target, including
     // qps below the 100Hz refill cadence), per tenant class; buckets
@@ -764,6 +872,22 @@ int main(int argc, char** argv) {
             }
             fflush(csv);
         }
+        if (bcsv != nullptr) {
+            std::lock_guard<std::mutex> lock(g_backend_mu);
+            for (auto& kv : g_backends) {
+                BackendStat* b = kv.second.get();
+                const int64_t p = b->picks.load(std::memory_order_relaxed);
+                const int64_t e =
+                    b->errors.load(std::memory_order_relaxed);
+                fprintf(bcsv, "%lld,%s,%lld,%lld,%lld\n", elapsed_s,
+                        kv.first.c_str(), (long long)(p - b->last_picks),
+                        (long long)(e - b->last_errors),
+                        (long long)b->lat.latency_percentile(0.99));
+                b->last_picks = p;
+                b->last_errors = e;
+            }
+            fflush(bcsv);
+        }
     };
     signal(SIGINT, OnSigint);  // clean early stop (full final report)
     while (monotonic_time_us() < end && !g_sigint) {
@@ -793,6 +917,7 @@ int main(int argc, char** argv) {
     // complete row rather than a torn write.
     report(monotonic_time_us());
     if (csv != nullptr) fclose(csv);
+    if (bcsv != nullptr) fclose(bcsv);
     stop.store(true, std::memory_order_relaxed);
     for (auto tid : tids) fiber_join(tid, nullptr);
     const double secs = (double)(monotonic_time_us() - t0) / 1e6;
@@ -882,7 +1007,7 @@ int main(int argc, char** argv) {
                    (long long)via_added_p99, (long long)via_backend_p99,
                    (long long)via_hedges, sessions);
         }
-        if (!lb_url.empty()) {
+        if (!dcn_peers.empty()) {
             printf(", \"press_zone\": \"%s\", "
                    "\"press_zone_local_picks\": %lld, "
                    "\"press_zone_spills\": %lld, "
@@ -891,6 +1016,33 @@ int main(int argc, char** argv) {
                    (long long)VarInt("rpc_lb_zone_local_picks"),
                    (long long)VarInt("rpc_lb_zone_spills"),
                    (long long)transport_stats::out_bytes(TierDcn()));
+        }
+        if (g_track_backends.load(std::memory_order_relaxed)) {
+            // The outlier counters are CLIENT-side: the LB channel (and
+            // its ejection decisions) live in this process.
+            printf(", \"press_outlier_ejections\": %lld, "
+                   "\"press_outlier_reinstatements\": %lld, "
+                   "\"press_outlier_ejected_now\": %lld, "
+                   "\"press_retry_budget_exhausted\": %lld, "
+                   "\"press_backends\": {",
+                   (long long)VarInt("rpc_outlier_ejections"),
+                   (long long)VarInt("rpc_outlier_reinstatements"),
+                   (long long)VarInt("rpc_outlier_ejected_now"),
+                   (long long)VarInt("rpc_retry_budget_exhausted"));
+            std::lock_guard<std::mutex> lock(g_backend_mu);
+            bool first = true;
+            for (auto& kv : g_backends) {
+                BackendStat* b = kv.second.get();
+                printf("%s\"%s\": {\"picks\": %lld, \"errors\": %lld, "
+                       "\"p50_us\": %lld, \"p99_us\": %lld}",
+                       first ? "" : ", ", kv.first.c_str(),
+                       (long long)b->picks.load(),
+                       (long long)b->errors.load(),
+                       (long long)b->lat.latency_percentile(0.5),
+                       (long long)b->lat.latency_percentile(0.99));
+                first = false;
+            }
+            printf("}");
         }
         if (gens.size() > 1 || !gens[0]->name.empty()) {
             printf(", \"press_tenants\": {");
@@ -947,13 +1099,29 @@ int main(int argc, char** argv) {
                    (long long)via_backend_p99, (long long)via_added_p99,
                    (long long)via_hedges);
         }
-        if (!lb_url.empty()) {
+        if (!dcn_peers.empty()) {
             printf("zone %s: local_picks %lld  spills %lld  "
                    "dcn_out_bytes %lld\n",
                    zone.empty() ? "local" : zone.c_str(),
                    (long long)VarInt("rpc_lb_zone_local_picks"),
                    (long long)VarInt("rpc_lb_zone_spills"),
                    (long long)transport_stats::out_bytes(TierDcn()));
+        }
+        if (g_track_backends.load(std::memory_order_relaxed)) {
+            printf("outliers: ejections %lld  reinstatements %lld  "
+                   "ejected_now %lld\n",
+                   (long long)VarInt("rpc_outlier_ejections"),
+                   (long long)VarInt("rpc_outlier_reinstatements"),
+                   (long long)VarInt("rpc_outlier_ejected_now"));
+            std::lock_guard<std::mutex> lock(g_backend_mu);
+            for (auto& kv : g_backends) {
+                BackendStat* b = kv.second.get();
+                printf("  backend %-21s picks=%lld errors=%lld "
+                       "p99=%lldus\n",
+                       kv.first.c_str(), (long long)b->picks.load(),
+                       (long long)b->errors.load(),
+                       (long long)b->lat.latency_percentile(0.99));
+            }
         }
         for (auto& g : gens) {
             if (gens.size() <= 1) break;

@@ -64,6 +64,7 @@
 #include "trpc/channel.h"
 #include "trpc/combo_channels.h"
 #include "trpc/controller.h"
+#include "trpc/hedge_model.h"
 #include "trpc/naming_service.h"
 #include "trpc/qos.h"
 #include "trpc/server.h"
@@ -81,6 +82,9 @@ LazyAdder g_forwards("rpc_router_forwards");
 LazyAdder g_forward_failures("rpc_router_forward_failures");
 LazyAdder g_hedges("rpc_router_hedges");
 LazyAdder g_hedge_wins("rpc_router_hedge_wins");
+// Raise-only hedge-delay refreshes from hedged completions while the
+// model is starved of clean samples (ISSUE 20 bugfix).
+LazyAdder g_hedge_refreshes("rpc_router_hedge_refreshes");
 LazyAdder g_reroutes("rpc_router_reroutes");
 LazyAdder g_session_repins("rpc_router_session_repins");
 LazyAdder g_edge_sheds("rpc_router_edge_sheds");
@@ -101,18 +105,21 @@ int64_t VarInt(const char* name) {
 }
 
 // ---- adaptive hedge delay (per tenant+method) ----
-// p99-derived EWMA: each completed un-hedged forward feeds the key's
-// windowed p99 into an EWMA (alpha 1/8); the hedge delay is that EWMA
-// (scaled by --hedge_mult_pct) floored at --hedge_floor_ms. With no
-// samples yet the floor alone drives — a cold router hedges only calls
-// that are already slower than the floor.
+// p99-derived EWMA (trpc/hedge_model.h): each completed un-hedged
+// forward feeds the key's windowed p99 into an EWMA (alpha 1/8); the
+// hedge delay is that EWMA (scaled by --hedge_mult_pct) floored at
+// --hedge_floor_ms. With no samples yet the floor alone drives — a cold
+// router hedges only calls that are already slower than the floor.
+// Hedged completions may refresh the estimate raise-only once the model
+// is starved of clean samples (ISSUE 20 bugfix: an always-hedged-around
+// backend froze its own estimate forever).
 int g_hedge_floor_ms = 5;
 int g_hedge_mult_pct = 100;  // % of the p99 EWMA
 bool g_hedge_enabled = true;
 
 struct HedgeKeyState {
     LatencyRecorder rec;  // hidden (never exposed): windowed p99 source
-    std::atomic<int64_t> ewma_p99_us{0};
+    HedgeDelayModel model;
 };
 
 std::mutex g_hedge_mu;
@@ -136,18 +143,13 @@ int64_t HedgeDelayMs(HedgeKeyState* hs) {
         g_hedge_hold_until_us.load(std::memory_order_relaxed)) {
         return -1;  // overload hold window: hedging disabled
     }
-    const int64_t ewma_us = hs->ewma_p99_us.load(std::memory_order_relaxed);
-    const int64_t derived_ms = ewma_us * g_hedge_mult_pct / 100 / 1000;
-    return derived_ms > g_hedge_floor_ms ? derived_ms : g_hedge_floor_ms;
+    return hs->model.DelayMs(g_hedge_mult_pct, g_hedge_floor_ms);
 }
 
 void FeedHedgeSample(HedgeKeyState* hs, int64_t latency_us) {
     hs->rec << latency_us;
-    const int64_t p99 = hs->rec.latency_percentile(0.99);
-    if (p99 <= 0) return;
-    const int64_t prev = hs->ewma_p99_us.load(std::memory_order_relaxed);
-    hs->ewma_p99_us.store(prev == 0 ? p99 : (prev * 7 + p99) / 8,
-                          std::memory_order_relaxed);
+    hs->model.FeedClean(hs->rec.latency_percentile(0.99),
+                        monotonic_time_us());
 }
 
 // ---- backend table + sticky-session pinning ----
@@ -406,6 +408,17 @@ private:
         if (dcntl.backup_issued()) {
             *g_hedges << 1;
             if (dcntl.backup_won()) *g_hedge_wins << 1;
+            // Normally a hedged completion teaches nothing (truncated
+            // latency). But a key whose EVERY forward gets hedged never
+            // sees a clean sample — its estimate would stay frozen low
+            // and the router would hedge 100% of traffic forever. Once
+            // starved of clean samples, let the hedged elapsed refresh
+            // the estimate raise-only until un-hedged completions
+            // return.
+            if (!dcntl.Failed() &&
+                hs->model.FeedHedged(elapsed, monotonic_time_us())) {
+                *g_hedge_refreshes << 1;
+            }
         } else if (!dcntl.Failed()) {
             // Only clean un-hedged completions teach the delay model —
             // a hedge-truncated latency would drag the p99 down and
@@ -588,7 +601,8 @@ void RouterStateJson(std::string* out) {
     snprintf(
         buf, sizeof(buf),
         "}, \"forwards\": %lld, \"forward_failures\": %lld, "
-        "\"hedges\": %lld, \"hedge_wins\": %lld, \"reroutes\": %lld, "
+        "\"hedges\": %lld, \"hedge_wins\": %lld, "
+        "\"hedge_refreshes\": %lld, \"reroutes\": %lld, "
         "\"session_repins\": %lld, \"edge_sheds\": %lld, "
         "\"stream_relays\": %lld, \"stream_relay_resumes\": %lld, "
         "\"stream_relay_chunks\": %lld, ",
@@ -596,6 +610,7 @@ void RouterStateJson(std::string* out) {
         (long long)VarInt("rpc_router_forward_failures"),
         (long long)VarInt("rpc_router_hedges"),
         (long long)VarInt("rpc_router_hedge_wins"),
+        (long long)VarInt("rpc_router_hedge_refreshes"),
         (long long)VarInt("rpc_router_reroutes"),
         (long long)VarInt("rpc_router_session_repins"),
         (long long)VarInt("rpc_router_edge_sheds"),
@@ -801,6 +816,7 @@ int main(int argc, char** argv) {
     *g_forward_failures << 0;
     *g_hedges << 0;
     *g_hedge_wins << 0;
+    *g_hedge_refreshes << 0;
     *g_reroutes << 0;
     *g_session_repins << 0;
     *g_edge_sheds << 0;
